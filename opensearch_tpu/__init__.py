@@ -1,0 +1,23 @@
+"""opensearch_tpu — a TPU-native distributed search & analytics engine.
+
+A from-scratch reimplementation of the capability surface of OpenSearch core
+(reference: sandeshkr419/OpenSearch, Java/Lucene) built idiomatically on
+JAX/XLA/Pallas:
+
+- index shards are immutable "segment array bundles" resident in TPU HBM
+  (postings as CSR int32 arrays, doc-values as dense columns, vectors as
+  [n, d] bf16 arrays),
+- lexical (BM25) and vector (exact / IVF-PQ k-NN) scoring run as fused XLA
+  programs ending in jax.lax.top_k,
+- the cross-shard merge that OpenSearch runs on the coordinator JVM heap
+  (SearchPhaseController.mergeTopDocs) is an on-device all_gather + top_k
+  over the ICI mesh,
+- a pure-Python control plane (election, state publication, allocation)
+  reimplements the coordination semantics of cluster/coordination/*.
+
+Layer map mirrors SURVEY.md §1: common (L0/L1) → index (L5) → ops/search
+(L6) → parallel (scatter-gather, §2.5) → cluster (L3/L4) → transport (L2) →
+rest (L8).
+"""
+
+__version__ = "0.1.0"

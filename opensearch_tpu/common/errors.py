@@ -1,0 +1,111 @@
+"""Exception hierarchy with REST status mapping.
+
+The analog of OpenSearchException + RestStatus
+(libs/core/src/main/java/org/opensearch/OpenSearchException.java,
+core/rest/RestStatus.java): every engine error carries an HTTP status and a
+stable `type` string so the REST layer can render the same error envelope
+({"error": {"type": ..., "reason": ...}, "status": N}) the reference does.
+"""
+
+from __future__ import annotations
+
+
+class OpenSearchTpuException(Exception):
+    status = 500
+    error_type = "exception"
+
+    def __init__(self, reason: str, **metadata):
+        super().__init__(reason)
+        self.reason = reason
+        self.metadata = metadata
+
+    def to_dict(self) -> dict:
+        body = {"type": self.error_type, "reason": self.reason}
+        body.update(self.metadata)
+        return body
+
+
+class ParsingException(OpenSearchTpuException):
+    status = 400
+    error_type = "parsing_exception"
+
+
+class IllegalArgumentException(OpenSearchTpuException):
+    status = 400
+    error_type = "illegal_argument_exception"
+
+
+class MapperParsingException(OpenSearchTpuException):
+    status = 400
+    error_type = "mapper_parsing_exception"
+
+
+class StrictDynamicMappingException(MapperParsingException):
+    error_type = "strict_dynamic_mapping_exception"
+
+
+class IndexNotFoundException(OpenSearchTpuException):
+    status = 404
+    error_type = "index_not_found_exception"
+
+    def __init__(self, index: str):
+        super().__init__(
+            f"no such index [{index}]",
+            **{"resource.type": "index_or_alias", "resource.id": index, "index": index},
+        )
+        self.index = index
+
+
+class ResourceAlreadyExistsException(OpenSearchTpuException):
+    status = 400
+    error_type = "resource_already_exists_exception"
+
+
+class DocumentMissingException(OpenSearchTpuException):
+    status = 404
+    error_type = "document_missing_exception"
+
+
+class VersionConflictException(OpenSearchTpuException):
+    status = 409
+    error_type = "version_conflict_engine_exception"
+
+
+class ShardNotFoundException(OpenSearchTpuException):
+    status = 404
+    error_type = "shard_not_found_exception"
+
+
+class SearchPhaseExecutionException(OpenSearchTpuException):
+    status = 500
+    error_type = "search_phase_execution_exception"
+
+
+class TaskCancelledException(OpenSearchTpuException):
+    status = 400
+    error_type = "task_cancelled_exception"
+
+
+class CircuitBreakingException(OpenSearchTpuException):
+    status = 429
+    error_type = "circuit_breaking_exception"
+
+
+class ClusterBlockException(OpenSearchTpuException):
+    status = 503
+    error_type = "cluster_block_exception"
+
+
+class NotClusterManagerException(OpenSearchTpuException):
+    status = 503
+    error_type = "not_cluster_manager_exception"
+
+
+class ConnectTransportException(OpenSearchTpuException):
+    status = 503
+    error_type = "connect_transport_exception"
+
+
+class ActionNotFoundException(OpenSearchTpuException):
+    status = 400
+    error_type = "action_not_found_transport_exception"

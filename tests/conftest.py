@@ -1,0 +1,23 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX imports.
+
+Mirrors the reference's test strategy (SURVEY.md §4): multi-"node" behavior is
+tested without real hardware — here via xla_force_host_platform_device_count,
+the analog of InternalTestCluster booting N nodes in one JVM.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

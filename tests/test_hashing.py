@@ -1,0 +1,55 @@
+from opensearch_tpu.common.hashing import (
+    murmur3_x86_32,
+    routing_hash,
+    shard_id_for_routing,
+)
+
+
+def _u32(x: int) -> int:
+    return x & 0xFFFFFFFF
+
+
+def test_murmur3_known_vectors():
+    # Standard murmur3_x86_32 test vectors (seed 0)
+    assert _u32(murmur3_x86_32(b"")) == 0
+    assert _u32(murmur3_x86_32(b"hello")) == 0x248BFA47
+    assert _u32(murmur3_x86_32(b"test")) == 0xBA6BD213
+    assert _u32(murmur3_x86_32(b"Hello, world!")) == 0xC0363E43
+    assert (
+        _u32(murmur3_x86_32(b"The quick brown fox jumps over the lazy dog"))
+        == 0x2E4FF723
+    )
+
+
+def test_routing_hash_matches_reference():
+    # Values from the reference's Murmur3HashFunctionTests
+    # (server/src/test/java/org/opensearch/cluster/routing/Murmur3HashFunctionTests.java),
+    # which hash the string as 2 LE bytes per UTF-16 code unit, seed 0.
+    assert _u32(routing_hash("hell")) == 0x5A0CB7C3
+    assert _u32(routing_hash("hello")) == 0xD7C31989
+    assert _u32(routing_hash("hello w")) == 0x22AB2984
+    assert _u32(routing_hash("hello wo")) == 0xDF0CA123
+    assert _u32(routing_hash("hello wor")) == 0xE7744D61
+    assert (
+        _u32(routing_hash("The quick brown fox jumps over the lazy dog")) == 0xE07DB09C
+    )
+    assert (
+        _u32(routing_hash("The quick brown fox jumps over the lazy cog")) == 0x4E63D2AD
+    )
+
+
+def test_shard_routing_stable_and_in_range():
+    for n in (1, 2, 5, 16):
+        for key in ("doc1", "doc2", "user:42", "ünïcode"):
+            sid = shard_id_for_routing(key, n)
+            assert 0 <= sid < n
+            assert sid == shard_id_for_routing(key, n)
+
+
+def test_routing_hash_astral_plane_matches_utf16le():
+    # non-BMP chars must hash as their UTF-16 surrogate pair byte sequence
+    s = "\U00010000a"
+    assert routing_hash(s) == murmur3_x86_32(s.encode("utf-16-le"), 0)
+    # and position of following chars matters (regression: low surrogate
+    # must precede subsequent chars, not be appended at the end)
+    assert routing_hash("\U0001F600x") != routing_hash("x\U0001F600")

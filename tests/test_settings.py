@@ -1,0 +1,88 @@
+import pytest
+
+from opensearch_tpu.common.settings import (
+    ClusterSettings,
+    Property,
+    Setting,
+    Settings,
+    SettingsException,
+    parse_bytes,
+    parse_time_millis,
+)
+
+
+def test_typed_parsing_and_defaults():
+    s = Setting.int_setting("node.shards", 5, Property.NODE_SCOPE, min_value=1)
+    assert s.get(Settings.EMPTY) == 5
+    assert s.get(Settings.builder().put("node.shards", "7").build()) == 7
+    with pytest.raises(SettingsException):
+        s.get(Settings.builder().put("node.shards", "0").build())
+    with pytest.raises(SettingsException):
+        s.get(Settings.builder().put("node.shards", "abc").build())
+
+
+def test_bool_and_time_and_bytes():
+    b = Setting.bool_setting("x.enabled", False)
+    assert b.get(Settings.builder().put("x.enabled", "true").build()) is True
+    t = Setting.time_setting("x.timeout", 30_000, Property.DYNAMIC)
+    assert t.get(Settings.builder().put("x.timeout", "1m").build()) == 60_000
+    assert parse_time_millis("500ms") == 500
+    assert parse_bytes("2kb") == 2048
+    assert parse_bytes("1gb") == 1024**3
+
+
+def test_registry_rejects_unknown_and_non_dynamic():
+    dyn = Setting.int_setting("c.dyn", 1, Property.DYNAMIC, Property.NODE_SCOPE)
+    fixed = Setting.int_setting("c.fixed", 2, Property.NODE_SCOPE)
+    reg = ClusterSettings(Settings.EMPTY, [dyn, fixed])
+    with pytest.raises(SettingsException, match="unknown setting"):
+        reg.apply_settings(Settings.builder().put("c.nope", 1).build())
+    with pytest.raises(SettingsException, match="non-dynamic"):
+        reg.apply_settings(Settings.builder().put("c.fixed", 3).build())
+
+
+def test_dynamic_update_notifies_consumer():
+    dyn = Setting.int_setting("c.dyn", 1, Property.DYNAMIC, Property.NODE_SCOPE)
+    reg = ClusterSettings(Settings.EMPTY, [dyn])
+    seen = []
+    reg.add_settings_update_consumer(dyn, seen.append)
+    reg.apply_settings(Settings.builder().put("c.dyn", 9).build())
+    assert seen == [9]
+    assert reg.get(dyn) == 9
+
+
+def test_nested_flattening_roundtrip():
+    s = Settings.from_nested({"index": {"number_of_shards": 4, "refresh": {"interval": "1s"}}})
+    assert s.raw_get("index.number_of_shards") == 4
+    assert s.raw_get("index.refresh.interval") == "1s"
+    assert s.as_nested()["index"]["refresh"]["interval"] == "1s"
+
+
+def test_as_nested_conflict_raises():
+    s = Settings.from_flat({"a": 1, "a.b": 2})
+    with pytest.raises(SettingsException, match="conflicts"):
+        s.as_nested()
+
+
+def test_failing_consumer_does_not_block_others():
+    d1 = Setting.int_setting("c.a", 1, Property.DYNAMIC, Property.NODE_SCOPE)
+    d2 = Setting.int_setting("c.b", 1, Property.DYNAMIC, Property.NODE_SCOPE)
+    reg = ClusterSettings(Settings.EMPTY, [d1, d2])
+
+    def bad(_v):
+        raise RuntimeError("boom")
+
+    seen = []
+    reg.add_settings_update_consumer(d1, bad)
+    reg.add_settings_update_consumer(d2, seen.append)
+    with pytest.raises(SettingsException, match="consumer"):
+        reg.apply_settings(Settings.builder().put("c.a", 2).put("c.b", 3).build())
+    # registry state is consistent and the healthy consumer still fired
+    assert reg.get(d1) == 2 and reg.get(d2) == 3
+    assert seen == [3]
+
+
+def test_settings_hashable():
+    s1 = Settings.from_flat({"a": 1})
+    s2 = Settings.from_flat({"a": 1})
+    assert len({s1, s2}) == 1
