@@ -1,0 +1,164 @@
+"""Device-resident segment bundles: padded jnp arrays in HBM.
+
+The "refresh publishes immutable arrays" half of the segment story
+(SURVEY.md §7 design stance): a HostSegment is sealed once, then `to_device`
+pads every column to the segment's bucketed n_pad and jax.device_put's the
+bundle. Readers (query phase) only ever see these immutable arrays — the
+segment-replication model (indices/replication/ in the reference) falls out
+naturally: replicas fetch the same immutable bundles instead of re-indexing.
+
+Padding invariants relied on by the ops kernels:
+- doc column index in [0, n_pad); docs >= n_docs are padding (live=False)
+- postings arrays padded with zeros (never addressed: window mask guards)
+- keyword CSR padded with ord=-2, doc=0 (ord -2 matches no query ordinal)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opensearch_tpu.index.segment import (
+    HostSegment,
+    pad_size,
+    split_i64,
+)
+
+
+def _pad1(a: np.ndarray, n: int, fill=0) -> np.ndarray:
+    if a.shape[0] >= n:
+        return a[:n]
+    out = np.full((n, *a.shape[1:]), fill, dtype=a.dtype)
+    out[: a.shape[0]] = a
+    return out
+
+
+@dataclass
+class DeviceTextField:
+    postings_docs: jnp.ndarray    # int32 [P_pad]
+    postings_tfs: jnp.ndarray     # float32 [P_pad]
+    doc_len: jnp.ndarray          # float32 [n_pad]
+
+
+@dataclass
+class DeviceKeywordField:
+    first_ord: jnp.ndarray        # int32 [n_pad], -1 missing
+    mv_ords: jnp.ndarray          # int32 [E_pad], pad = -2
+    mv_docs: jnp.ndarray          # int32 [E_pad], pad = 0
+
+
+@dataclass
+class DeviceNumericField:
+    kind: str                     # "int" | "float"
+    hi: jnp.ndarray | None        # int32 [n_pad] (int kind)
+    lo: jnp.ndarray | None
+    values: jnp.ndarray | None    # float32 [n_pad] (float kind)
+    present: jnp.ndarray          # bool [n_pad]
+
+
+@dataclass
+class DeviceVectorField:
+    vectors: jnp.ndarray          # float32 [n_pad, dims]
+    norms_sq: jnp.ndarray         # float32 [n_pad]
+    present: jnp.ndarray          # bool [n_pad]
+    dims: int
+    similarity: str
+
+
+@dataclass
+class DeviceSegment:
+    name: str
+    n_docs: int
+    n_pad: int
+    live: jnp.ndarray             # bool [n_pad] (padding rows are False)
+    text_fields: dict[str, DeviceTextField]
+    keyword_fields: dict[str, DeviceKeywordField]
+    numeric_fields: dict[str, DeviceNumericField]
+    vector_fields: dict[str, DeviceVectorField]
+
+    def with_live(self, live_host: np.ndarray) -> "DeviceSegment":
+        """Republishes the deletes bitmap (refresh after deletes)."""
+        live = np.zeros(self.n_pad, dtype=bool)
+        live[: self.n_docs] = live_host[: self.n_docs]
+        return DeviceSegment(
+            name=self.name,
+            n_docs=self.n_docs,
+            n_pad=self.n_pad,
+            live=jax.device_put(jnp.asarray(live)),
+            text_fields=self.text_fields,
+            keyword_fields=self.keyword_fields,
+            numeric_fields=self.numeric_fields,
+            vector_fields=self.vector_fields,
+        )
+
+
+def to_device(seg: HostSegment, device=None) -> DeviceSegment:
+    n_pad = pad_size(seg.n_docs)
+    put = lambda a: jax.device_put(jnp.asarray(a), device)
+
+    live = np.zeros(n_pad, dtype=bool)
+    live[: seg.n_docs] = seg.live
+
+    text_fields: dict[str, DeviceTextField] = {}
+    for fname, tf in seg.text_fields.items():
+        p_pad = pad_size(max(len(tf.postings_docs), 1))
+        text_fields[fname] = DeviceTextField(
+            postings_docs=put(_pad1(tf.postings_docs, p_pad)),
+            postings_tfs=put(_pad1(tf.postings_tfs, p_pad)),
+            doc_len=put(_pad1(tf.doc_len, n_pad)),
+        )
+
+    keyword_fields: dict[str, DeviceKeywordField] = {}
+    for fname, kf in seg.keyword_fields.items():
+        e_pad = pad_size(max(len(kf.mv_ords), 1))
+        keyword_fields[fname] = DeviceKeywordField(
+            first_ord=put(_pad1(kf.first_ord, n_pad, fill=-1)),
+            mv_ords=put(_pad1(kf.mv_ords, e_pad, fill=-2)),
+            mv_docs=put(_pad1(kf.mv_docs, e_pad, fill=0)),
+        )
+
+    numeric_fields: dict[str, DeviceNumericField] = {}
+    for fname, nf in seg.numeric_fields.items():
+        present = put(_pad1(nf.present, n_pad, fill=False))
+        if nf.kind == "int":
+            hi, lo = split_i64(nf.values_i64)
+            numeric_fields[fname] = DeviceNumericField(
+                kind="int",
+                hi=put(_pad1(hi, n_pad)),
+                lo=put(_pad1(lo, n_pad)),
+                values=None,
+                present=present,
+            )
+        else:
+            numeric_fields[fname] = DeviceNumericField(
+                kind="float",
+                hi=None,
+                lo=None,
+                values=put(_pad1(nf.values_f64.astype(np.float32), n_pad)),
+                present=present,
+            )
+
+    vector_fields: dict[str, DeviceVectorField] = {}
+    for fname, vf in seg.vector_fields.items():
+        vecs = _pad1(vf.vectors, n_pad)
+        vector_fields[fname] = DeviceVectorField(
+            vectors=put(vecs),
+            norms_sq=put((vecs.astype(np.float64) ** 2).sum(axis=1).astype(np.float32)),
+            present=put(_pad1(vf.present, n_pad, fill=False)),
+            dims=vf.dims,
+            similarity=vf.similarity,
+        )
+
+    return DeviceSegment(
+        name=seg.name,
+        n_docs=seg.n_docs,
+        n_pad=n_pad,
+        live=put(live),
+        text_fields=text_fields,
+        keyword_fields=keyword_fields,
+        numeric_fields=numeric_fields,
+        vector_fields=vector_fields,
+    )

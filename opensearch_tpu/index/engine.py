@@ -1,0 +1,329 @@
+"""The per-shard engine: write path kernel + NRT reader publication.
+
+The analog of InternalEngine
+(server/src/main/java/org/opensearch/index/engine/InternalEngine.java:152):
+
+- index/delete ops get a sequence number and a version plan from the live
+  version map (dedup + conflict detection, `LiveVersionMap`), are buffered
+  in RAM and appended to the translog before being acknowledged
+  (InternalEngine.index:863 → indexIntoLucene:1138 + Translog.add:606)
+- `refresh` seals the RAM buffer into an immutable HostSegment, publishes
+  its padded arrays to device HBM, and swaps the searcher snapshot (the NRT
+  reader model); deletes republish the affected segments' live bitmaps
+- `flush` = persist segments + a commit point, then roll/trim the translog
+  (Lucene commit + CombinedDeletionPolicy analog)
+- crash recovery = load last commit, replay translog ops with
+  seq_no > commit max_seq_no (TranslogRecoveryRunner)
+
+Searcher snapshots are immutable lists of (host, device) segment pairs —
+holding one is the PIT/scroll `ReaderContext` refcount analog.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field as dc_field
+from pathlib import Path
+
+from opensearch_tpu.common.errors import (
+    OpenSearchTpuException,
+    VersionConflictException,
+)
+from opensearch_tpu.index.device import DeviceSegment, to_device
+from opensearch_tpu.index.mapper import MapperService, ParsedDocument
+from opensearch_tpu.index.segment import (
+    HostSegment,
+    SegmentBuilder,
+    load_segment,
+    save_segment,
+)
+from opensearch_tpu.index.translog import Translog
+
+
+@dataclass
+class OpResult:
+    doc_id: str
+    seq_no: int
+    version: int
+    created: bool = False
+    found: bool = True
+    result: str = "created"   # created | updated | deleted | not_found
+
+
+@dataclass
+class VersionEntry:
+    seq_no: int
+    version: int
+    deleted: bool = False
+
+
+@dataclass
+class SearcherSnapshot:
+    """Immutable point-in-time view over sealed segments + live masks."""
+
+    segments: list[tuple[HostSegment, DeviceSegment]]
+    generation: int
+
+    @property
+    def num_docs(self) -> int:
+        return sum(h.live_count for h, _ in self.segments)
+
+    @property
+    def max_doc(self) -> int:
+        return sum(h.n_docs for h, _ in self.segments)
+
+
+class Engine:
+    def __init__(self, path: str | Path, mapper_service: MapperService):
+        self.path = Path(path)
+        self.path.mkdir(parents=True, exist_ok=True)
+        self.mapper_service = mapper_service
+        self.translog = Translog(self.path / "translog")
+        self.version_map: dict[str, VersionEntry] = {}
+        self._seq_no = -1
+        self._segment_counter = 0
+        self._segments: list[tuple[HostSegment, DeviceSegment]] = []
+        self._buffer: list[tuple[ParsedDocument, int] | None] = []
+        self._buffer_pos: dict[str, int] = {}
+        self._refresh_generation = 0
+        self._searcher = SearcherSnapshot([], 0)
+        self._dirty_live: set[str] = set()  # segment names needing live republish
+        self.local_checkpoint = -1
+        self.stats = {"index_total": 0, "delete_total": 0, "refresh_total": 0,
+                      "flush_total": 0, "index_time_ms": 0.0}
+        self._recover()
+
+    # -- sequence numbers --------------------------------------------------
+
+    def _next_seq_no(self) -> int:
+        self._seq_no += 1
+        # single-writer engine: checkpoint advances with every issued seq_no
+        self.local_checkpoint = self._seq_no
+        return self._seq_no
+
+    @property
+    def max_seq_no(self) -> int:
+        return self._seq_no
+
+    # -- write path --------------------------------------------------------
+
+    def index(
+        self,
+        doc_id: str,
+        source: dict,
+        routing: str | None = None,
+        if_seq_no: int | None = None,
+        if_primary_term: int | None = None,
+        seq_no: int | None = None,
+    ) -> OpResult:
+        """Index one document (InternalEngine.index:863). `seq_no` is set
+        only on the replica/recovery replay path."""
+        t0 = time.monotonic()
+        entry = self.version_map.get(doc_id)
+        if if_seq_no is not None:
+            current_seq = entry.seq_no if entry and not entry.deleted else -1
+            if current_seq != if_seq_no:
+                raise VersionConflictException(
+                    f"[{doc_id}]: version conflict, required seqNo [{if_seq_no}], "
+                    f"current document has seqNo [{current_seq}]"
+                )
+        parsed = self.mapper_service.parse_document(doc_id, source, routing)
+        op_seq = seq_no if seq_no is not None else self._next_seq_no()
+        if seq_no is not None:
+            self._seq_no = max(self._seq_no, seq_no)
+            self.local_checkpoint = self._seq_no
+        created = entry is None or entry.deleted
+        version = 1 if created else entry.version + 1
+        self._delete_from_live_segments(doc_id)
+        self._buffer_put(parsed, op_seq)
+        self.version_map[doc_id] = VersionEntry(op_seq, version)
+        self.translog.add(
+            {"op": "index", "id": doc_id, "seq_no": op_seq, "version": version,
+             "source": source, "routing": routing}
+        )
+        self.translog.sync()
+        self.stats["index_total"] += 1
+        self.stats["index_time_ms"] += (time.monotonic() - t0) * 1e3
+        return OpResult(doc_id, op_seq, version, created=created,
+                        result="created" if created else "updated")
+
+    def delete(self, doc_id: str, seq_no: int | None = None) -> OpResult:
+        entry = self.version_map.get(doc_id)
+        found = (entry is not None and not entry.deleted) or doc_id in self._buffer_pos
+        op_seq = seq_no if seq_no is not None else self._next_seq_no()
+        if seq_no is not None:
+            self._seq_no = max(self._seq_no, seq_no)
+            self.local_checkpoint = self._seq_no
+        version = (entry.version + 1) if entry else 1
+        self._buffer_remove(doc_id)
+        self._delete_from_live_segments(doc_id)
+        self.version_map[doc_id] = VersionEntry(op_seq, version, deleted=True)
+        self.translog.add(
+            {"op": "delete", "id": doc_id, "seq_no": op_seq, "version": version}
+        )
+        self.translog.sync()
+        self.stats["delete_total"] += 1
+        return OpResult(doc_id, op_seq, version, found=found,
+                        result="deleted" if found else "not_found")
+
+    def _buffer_put(self, parsed: ParsedDocument, seq_no: int) -> None:
+        pos = self._buffer_pos.get(parsed.doc_id)
+        if pos is not None:
+            self._buffer[pos] = None  # supersede older buffered version
+        self._buffer_pos[parsed.doc_id] = len(self._buffer)
+        self._buffer.append((parsed, seq_no))
+
+    def _buffer_remove(self, doc_id: str) -> None:
+        pos = self._buffer_pos.pop(doc_id, None)
+        if pos is not None:
+            self._buffer[pos] = None
+
+    def _delete_from_live_segments(self, doc_id: str) -> None:
+        for host, _dev in self._segments:
+            if host.delete_doc(doc_id):
+                self._dirty_live.add(host.name)
+
+    # -- read path ---------------------------------------------------------
+
+    def get(self, doc_id: str) -> dict | None:
+        """Realtime GET (index/get in the reference: reads through the
+        version map + buffer without waiting for refresh)."""
+        entry = self.version_map.get(doc_id)
+        if entry is not None and entry.deleted:
+            return None
+        pos = self._buffer_pos.get(doc_id)
+        if pos is not None and self._buffer[pos] is not None:
+            parsed, seq = self._buffer[pos]
+            return {"_source": parsed.source, "_seq_no": seq,
+                    "_version": entry.version if entry else 1}
+        for host, _dev in self._segments:
+            d = host.local_doc(doc_id)
+            if d is not None:
+                return {"_source": json.loads(host.sources[d]),
+                        "_seq_no": entry.seq_no if entry else -1,
+                        "_version": entry.version if entry else 1}
+        return None
+
+    def acquire_searcher(self) -> SearcherSnapshot:
+        return self._searcher
+
+    # -- refresh / flush ---------------------------------------------------
+
+    def refresh(self) -> SearcherSnapshot:
+        """Seal the RAM buffer into a new segment + republish live masks."""
+        live_buffer = [e for e in self._buffer if e is not None]
+        if live_buffer:
+            self._segment_counter += 1
+            builder = SegmentBuilder(self.mapper_service, f"_{self._segment_counter}")
+            for parsed, seq in live_buffer:
+                builder.add(parsed, seq)
+            host = builder.build()
+            dev = to_device(host)
+            self._segments.append((host, dev))
+            self._buffer = []
+            self._buffer_pos = {}
+        if self._dirty_live:
+            self._segments = [
+                (h, d.with_live(h.live) if h.name in self._dirty_live else d)
+                for h, d in self._segments
+            ]
+            self._dirty_live.clear()
+        self._refresh_generation += 1
+        self._searcher = SearcherSnapshot(list(self._segments), self._refresh_generation)
+        self.stats["refresh_total"] += 1
+        return self._searcher
+
+    def flush(self) -> None:
+        """Commit: refresh, persist segments + commit point, roll translog."""
+        self.refresh()
+        seg_dir = self.path / "segments"
+        for host, _dev in self._segments:
+            if not (seg_dir / f"{host.name}.json").exists():
+                save_segment(host, seg_dir)
+            else:
+                # live bitmap may have changed since last commit
+                save_segment(host, seg_dir)
+        commit = {
+            "segments": [h.name for h, _ in self._segments],
+            "max_seq_no": self._seq_no,
+            "local_checkpoint": self.local_checkpoint,
+            "segment_counter": self._segment_counter,
+            "translog_generation": self.translog.current_generation + 1,
+            "version_map": {
+                doc_id: [e.seq_no, e.version, e.deleted]
+                for doc_id, e in self.version_map.items()
+            },
+        }
+        tmp = self.path / "commit.json.tmp"
+        with open(tmp, "w") as f:
+            json.dump(commit, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path / "commit.json")
+        self.translog.roll_generation()
+        self.translog.trim_below(self.translog.current_generation)
+        self.stats["flush_total"] += 1
+
+    # -- recovery ----------------------------------------------------------
+
+    def _recover(self) -> None:
+        commit_path = self.path / "commit.json"
+        replay_from_seq = -1
+        if commit_path.exists():
+            commit = json.loads(commit_path.read_text())
+            seg_dir = self.path / "segments"
+            for name in commit["segments"]:
+                host = load_segment(seg_dir, name)
+                self._segments.append((host, to_device(host)))
+            self._seq_no = commit["max_seq_no"]
+            self.local_checkpoint = commit["local_checkpoint"]
+            self._segment_counter = commit["segment_counter"]
+            self.version_map = {
+                doc_id: VersionEntry(seq, ver, deleted)
+                for doc_id, (seq, ver, deleted) in commit["version_map"].items()
+            }
+            replay_from_seq = commit["max_seq_no"]
+        replayed = 0
+        for op in self.translog.read_ops():
+            if int(op["seq_no"]) <= replay_from_seq:
+                continue
+            if op["op"] == "index":
+                parsed = self.mapper_service.parse_document(
+                    op["id"], op["source"], op.get("routing")
+                )
+                self._seq_no = max(self._seq_no, op["seq_no"])
+                self.local_checkpoint = self._seq_no
+                self._delete_from_live_segments(op["id"])
+                self._buffer_put(parsed, op["seq_no"])
+                self.version_map[op["id"]] = VersionEntry(op["seq_no"], op["version"])
+            else:
+                self._seq_no = max(self._seq_no, op["seq_no"])
+                self.local_checkpoint = self._seq_no
+                self._buffer_remove(op["id"])
+                self._delete_from_live_segments(op["id"])
+                self.version_map[op["id"]] = VersionEntry(
+                    op["seq_no"], op["version"], deleted=True
+                )
+            replayed += 1
+        if self._segments or replayed:
+            self.refresh()
+
+    # -- stats / lifecycle -------------------------------------------------
+
+    @property
+    def num_docs(self) -> int:
+        buffered = len([e for e in self._buffer if e is not None])
+        return buffered + sum(h.live_count for h, _ in self._segments)
+
+    def segment_stats(self) -> dict:
+        return {
+            "count": len(self._segments),
+            "docs": sum(h.n_docs for h, _ in self._segments),
+            "live_docs": sum(h.live_count for h, _ in self._segments),
+            "buffered_docs": len([e for e in self._buffer if e is not None]),
+        }
+
+    def close(self) -> None:
+        self.translog.close()

@@ -1,0 +1,375 @@
+"""Mappings: field types, document parsing, dynamic mapping.
+
+The analog of the reference's mapper layer
+(server/src/main/java/org/opensearch/index/mapper/ — MapperService,
+DocumentMapper, DocumentParser.java:66, MappedFieldType subclasses): a
+MapperService owns the schema for one index, parses JSON documents into typed
+per-field values ("LuceneDocument fields" become typed column/posting inputs
+for the segment builder), infers mappings dynamically, and validates merges.
+
+Field value encodings chosen for the TPU segment layout:
+- text      -> analyzed terms (postings + doc length norm)
+- keyword   -> ordinal doc-values + exact-term postings
+- long/integer/short/byte/date -> int64 doc-values column
+- double/float/half_float      -> float64 doc-values column
+- boolean   -> int64 column (0/1)
+- dense_vector -> row in the segment's [n, dims] matrix
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import math
+from dataclasses import dataclass, field as dc_field
+from typing import Any
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentException,
+    MapperParsingException,
+    StrictDynamicMappingException,
+)
+from opensearch_tpu.index.analysis import AnalysisRegistry, Analyzer
+
+INT_TYPES = {"long", "integer", "short", "byte"}
+FLOAT_TYPES = {"double", "float", "half_float"}
+NUMERIC_TYPES = INT_TYPES | FLOAT_TYPES
+
+_INT_RANGES = {
+    "long": (-(2**63), 2**63 - 1),
+    "integer": (-(2**31), 2**31 - 1),
+    "short": (-(2**15), 2**15 - 1),
+    "byte": (-(2**7), 2**7 - 1),
+}
+
+
+@dataclass
+class FieldMapper:
+    """One mapped field (a MappedFieldType + its Mapper in the reference)."""
+
+    name: str
+    type: str
+    analyzer: str = "standard"
+    search_analyzer: str | None = None
+    index: bool = True
+    doc_values: bool = True
+    store: bool = False
+    # dense_vector
+    dims: int = 0
+    similarity: str = "l2_norm"  # l2_norm | cosine | dot_product
+    # date
+    format: str = "strict_date_optional_time||epoch_millis"
+    # extra sub-fields ("fields": {"raw": {"type": "keyword"}})
+    fields: dict[str, "FieldMapper"] = dc_field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {"type": self.type}
+        if self.type == "text" and self.analyzer != "standard":
+            out["analyzer"] = self.analyzer
+        if self.search_analyzer and self.search_analyzer != self.analyzer:
+            out["search_analyzer"] = self.search_analyzer
+        if self.type == "dense_vector" or self.type == "knn_vector":
+            out["dims"] = self.dims
+            out["similarity"] = self.similarity
+        if not self.index:
+            out["index"] = False
+        if self.fields:
+            out["fields"] = {n: m.to_dict() for n, m in self.fields.items()}
+        return out
+
+
+@dataclass
+class ParsedField:
+    """Typed value(s) extracted from one document field."""
+
+    terms: list[str] | None = None        # text: analyzed term stream
+    exact: list[str] | None = None        # keyword: untokenized values
+    numeric: list[float] | None = None    # numeric/date/boolean column values
+    vector: list[float] | None = None     # dense_vector row
+
+
+@dataclass
+class ParsedDocument:
+    doc_id: str
+    source: dict
+    fields: dict[str, ParsedField]
+    routing: str | None = None
+
+
+# epoch range guard so dates stay in int64 millis
+_MAX_MILLIS = 2**62
+
+
+def parse_date_millis(value: Any) -> int:
+    """strict_date_optional_time || epoch_millis, like the reference default."""
+    if isinstance(value, bool):
+        raise ValueError("booleans are not dates")
+    if isinstance(value, (int, float)):
+        v = int(value)
+        if abs(v) > _MAX_MILLIS:
+            raise ValueError(f"epoch_millis out of range: {value}")
+        return v
+    s = str(value).strip()
+    if s.lstrip("-").isdigit():
+        return int(s)
+    # ISO-8601 family
+    txt = s.replace("Z", "+00:00")
+    try:
+        dt = _dt.datetime.fromisoformat(txt)
+    except ValueError:
+        # date-only variants fromisoformat already handles in 3.11+; re-raise
+        raise ValueError(f"failed to parse date field [{s}]")
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    return int(dt.timestamp() * 1000)
+
+
+def _parse_boolean(value: Any) -> int:
+    if isinstance(value, bool):
+        return 1 if value else 0
+    if isinstance(value, str):
+        if value == "true":
+            return 1
+        if value == "false" or value == "":
+            return 0
+    raise ValueError(f"failed to parse boolean [{value!r}]")
+
+
+class MapperService:
+    """Schema owner for one index (MapperService + DocumentParser)."""
+
+    def __init__(
+        self,
+        mappings: dict | None = None,
+        analysis_registry: AnalysisRegistry | None = None,
+    ):
+        self.analysis = analysis_registry or AnalysisRegistry()
+        self.mappers: dict[str, FieldMapper] = {}
+        self.dynamic: str | bool = True  # True | False | "strict"
+        self._source_enabled = True
+        if mappings:
+            self.merge(mappings)
+
+    # -- mapping CRUD ------------------------------------------------------
+
+    def merge(self, mappings: dict) -> None:
+        """Apply a mappings dict {"properties": {...}, "dynamic": ...}."""
+        if "dynamic" in mappings:
+            d = mappings["dynamic"]
+            if d not in (True, False, "true", "false", "strict"):
+                raise MapperParsingException(f"invalid dynamic value [{d}]")
+            self.dynamic = {"true": True, "false": False}.get(d, d)
+        src = mappings.get("_source")
+        if isinstance(src, dict) and "enabled" in src:
+            self._source_enabled = bool(src["enabled"])
+        for name, conf in (mappings.get("properties") or {}).items():
+            self._merge_field("", name, conf)
+
+    def _merge_field(self, prefix: str, name: str, conf: dict) -> None:
+        full = f"{prefix}{name}"
+        if "properties" in conf and "type" not in conf:
+            # object field: flatten children with dotted names
+            for child, child_conf in conf["properties"].items():
+                self._merge_field(f"{full}.", child, child_conf)
+            return
+        ftype = conf.get("type")
+        if ftype is None:
+            raise MapperParsingException(f"no type specified for field [{full}]")
+        if ftype == "knn_vector":  # k-NN plugin compat alias
+            ftype = "dense_vector"
+        known = (
+            {"text", "keyword", "date", "boolean", "dense_vector", "match_only_text"}
+            | NUMERIC_TYPES
+        )
+        if ftype not in known:
+            raise MapperParsingException(
+                f"No handler for type [{ftype}] declared on field [{full}]"
+            )
+        if ftype == "match_only_text":
+            ftype = "text"
+        mapper = FieldMapper(
+            name=full,
+            type=ftype,
+            analyzer=conf.get("analyzer", "standard"),
+            search_analyzer=conf.get("search_analyzer"),
+            index=conf.get("index", True),
+            doc_values=conf.get("doc_values", True),
+            store=conf.get("store", False),
+            dims=int(conf.get("dims", conf.get("dimension", 0))),
+            similarity=conf.get("similarity", conf.get("space_type", "l2_norm")),
+            format=conf.get("format", "strict_date_optional_time||epoch_millis"),
+        )
+        if ftype == "dense_vector" and mapper.dims <= 0:
+            raise MapperParsingException(
+                f"dense_vector field [{full}] requires positive [dims]"
+            )
+        existing = self.mappers.get(full)
+        if existing is not None and existing.type != mapper.type:
+            raise IllegalArgumentException(
+                f"mapper [{full}] cannot be changed from type "
+                f"[{existing.type}] to [{mapper.type}]"
+            )
+        # multi-fields
+        for sub, sub_conf in (conf.get("fields") or {}).items():
+            self._merge_field(f"{full}.", sub, sub_conf)
+        self.mappers[full] = mapper
+
+    def field_mapper(self, name: str) -> FieldMapper | None:
+        return self.mappers.get(name)
+
+    def to_dict(self) -> dict:
+        props: dict[str, Any] = {}
+        for name, m in sorted(self.mappers.items()):
+            # re-nest dotted names into object properties
+            parts = name.split(".")
+            node = props
+            for p in parts[:-1]:
+                node = node.setdefault(p, {}).setdefault("properties", {})
+            node[parts[-1]] = m.to_dict()
+        out: dict[str, Any] = {"properties": props}
+        if self.dynamic is not True:
+            out["dynamic"] = self.dynamic
+        return out
+
+    # -- document parsing --------------------------------------------------
+
+    def _analyzer_for(self, mapper: FieldMapper, search: bool = False) -> Analyzer:
+        name = (mapper.search_analyzer if search else None) or mapper.analyzer
+        return self.analysis.get(name)
+
+    def parse_document(
+        self, doc_id: str, source: dict, routing: str | None = None
+    ) -> ParsedDocument:
+        """DocumentParser.parseDocument:78 — JSON → typed field values,
+        applying dynamic mapping for unseen fields."""
+        fields: dict[str, ParsedField] = {}
+        self._parse_object(source, "", fields)
+        return ParsedDocument(doc_id=doc_id, source=source, fields=fields, routing=routing)
+
+    def _parse_object(self, obj: dict, prefix: str, out: dict[str, ParsedField]) -> None:
+        for key, value in obj.items():
+            full = f"{prefix}{key}"
+            if isinstance(value, dict):
+                mapper = self.mappers.get(full)
+                if mapper is not None and mapper.type == "dense_vector":
+                    raise MapperParsingException(
+                        f"dense_vector field [{full}] must be an array of numbers"
+                    )
+                self._parse_object(value, f"{full}.", out)
+                continue
+            mapper = self.mappers.get(full)
+            if mapper is None:
+                mapper = self._dynamic_mapper(full, value)
+                if mapper is None:
+                    continue  # dynamic: false -> ignore; strict raises inside
+                self.mappers[full] = mapper
+            self._parse_value(mapper, full, value, out)
+
+    def _dynamic_mapper(self, name: str, value: Any) -> FieldMapper | None:
+        if self.dynamic == "strict":
+            raise StrictDynamicMappingException(
+                f"mapping set to strict, dynamic introduction of [{name}] is not allowed"
+            )
+        if self.dynamic is False:
+            return None
+        if isinstance(value, bool):
+            return FieldMapper(name, "boolean")
+        if isinstance(value, int):
+            return FieldMapper(name, "long")
+        if isinstance(value, float):
+            return FieldMapper(name, "float")
+        if isinstance(value, str):
+            try:
+                parse_date_millis(value)
+                if not value.lstrip("-").isdigit():
+                    return FieldMapper(name, "date")
+            except ValueError:
+                pass
+            # dynamic strings get text + .keyword sub-field, like the reference
+            kw = FieldMapper(f"{name}.keyword", "keyword")
+            self.mappers[f"{name}.keyword"] = kw
+            return FieldMapper(name, "text")
+        if isinstance(value, list):
+            if value and all(isinstance(v, (int, float)) and not isinstance(v, bool) for v in value):
+                # plain numeric array -> numeric field (NOT dense_vector: the
+                # reference requires explicit mapping for vectors)
+                if all(isinstance(v, int) for v in value):
+                    return FieldMapper(name, "long")
+                return FieldMapper(name, "float")
+            for v in value:
+                if v is not None:
+                    return self._dynamic_mapper(name, v)
+            return None
+        if value is None:
+            return None
+        raise MapperParsingException(f"cannot infer mapping for [{name}]={value!r}")
+
+    def _parse_value(
+        self, mapper: FieldMapper, name: str, value: Any, out: dict[str, ParsedField]
+    ) -> None:
+        if value is None:
+            return
+        values = value if isinstance(value, list) else [value]
+        pf = out.setdefault(name, ParsedField())
+        try:
+            if mapper.type == "text":
+                analyzer = self._analyzer_for(mapper)
+                terms: list[str] = pf.terms or []
+                for v in values:
+                    if v is None:
+                        continue
+                    terms.extend(analyzer.analyze(str(v)))
+                pf.terms = terms
+            elif mapper.type == "keyword":
+                exact = pf.exact or []
+                exact.extend(str(v) for v in values if v is not None)
+                pf.exact = exact
+            elif mapper.type in NUMERIC_TYPES:
+                nums = pf.numeric or []
+                for v in values:
+                    if v is None:
+                        continue
+                    if isinstance(v, bool):
+                        raise ValueError("booleans are not numbers")
+                    x = float(v)
+                    if mapper.type in INT_TYPES:
+                        if not float(v).is_integer() and not isinstance(v, int):
+                            # the reference rejects "3.5" for integer types
+                            raise ValueError(f"[{v}] is not an integer")
+                        lo, hi = _INT_RANGES[mapper.type]
+                        if not (lo <= int(v) <= hi):
+                            raise ValueError(f"[{v}] out of range for [{mapper.type}]")
+                        x = float(int(v))
+                    elif not math.isfinite(x):
+                        raise ValueError(f"[{v}] is not finite")
+                    nums.append(x)
+                pf.numeric = nums
+            elif mapper.type == "date":
+                nums = pf.numeric or []
+                nums.extend(float(parse_date_millis(v)) for v in values if v is not None)
+                pf.numeric = nums
+            elif mapper.type == "boolean":
+                nums = pf.numeric or []
+                nums.extend(float(_parse_boolean(v)) for v in values if v is not None)
+                pf.numeric = nums
+            elif mapper.type == "dense_vector":
+                if pf.vector is not None:
+                    raise ValueError("multiple vectors for one field")
+                vec = [float(v) for v in values]
+                if len(vec) != mapper.dims:
+                    raise ValueError(
+                        f"vector length {len(vec)} != dims {mapper.dims}"
+                    )
+                pf.vector = vec
+            else:  # pragma: no cover
+                raise ValueError(f"unhandled type [{mapper.type}]")
+        except (ValueError, TypeError) as e:
+            raise MapperParsingException(
+                f"failed to parse field [{name}] of type [{mapper.type}]: {e}"
+            ) from e
+
+    def analyze_query_text(self, field: str, text: str) -> list[str]:
+        """Analyze query text with the field's search analyzer (match query)."""
+        mapper = self.mappers.get(field)
+        if mapper is None or mapper.type != "text":
+            return [text]
+        return self._analyzer_for(mapper, search=True).analyze(str(text))

@@ -1,0 +1,470 @@
+"""Immutable segment array bundles: host build + device residency.
+
+The analog of a Lucene segment (what IndexWriter writes and a LeafReader
+serves, reference: server/src/main/java/org/opensearch/index/engine/
+InternalEngine.java:1138 addDocs → IndexWriter) re-designed for TPU HBM:
+
+- postings: flat CSR int32/float32 arrays sorted by (term_id, doc_id); the
+  term dictionary stays host-side (hash map), postings go to device; BM25
+  scoring gathers padded per-term windows and scatter-adds into a dense
+  score column (opensearch_tpu/ops/bm25.py)
+- doc-values: dense columns. int-family (long/integer/date/boolean) columns
+  are split into two int32 words on device (TPU JAX is 32-bit by default and
+  epoch-millis don't fit float32); float-family stored as float32
+- keyword: ordinal encoding, CSR for multi-valued + first-ord column for sort
+- vectors: [n_docs, dims] float32 matrix (bf16 variant for the MXU path)
+- stored fields (_source, _id): host-side only — fetch phase is host work
+
+All device arrays are padded: n_docs to a bucketed n_pad so XLA compile
+cache entries stay bounded across segments.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field as dc_field
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from opensearch_tpu.common.errors import IllegalArgumentException
+from opensearch_tpu.index.mapper import (
+    INT_TYPES,
+    MapperService,
+    ParsedDocument,
+)
+
+
+def pad_size(n: int) -> int:
+    """Bucketed padding: multiples of 128 up to 1024, powers of two above."""
+    n = max(n, 128)
+    if n <= 1024:
+        return ((n + 127) // 128) * 128
+    p = 1024
+    while p < n:
+        p *= 2
+    return p
+
+
+def pad_window(n: int) -> int:
+    """Bucketed postings-window length (per-term gather width)."""
+    n = max(n, 8)
+    p = 8
+    while p < n:
+        p *= 2
+    return p
+
+
+def split_i64(values: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """int64 -> (hi, lo) int32 words; lexicographic (hi, lo-as-unsigned)
+    compare preserves int64 ordering."""
+    v = values.astype(np.int64)
+    hi = (v >> 32).astype(np.int32)
+    lo = (v & 0xFFFFFFFF).astype(np.uint32).astype(np.int64)
+    # store lo with the sign-flip trick so signed int32 compare == unsigned
+    lo = (lo - 0x80000000).astype(np.int32)
+    return hi, lo
+
+
+def i64_query_words(value: int) -> tuple[int, int]:
+    """Encode a query-side int64 bound the same way as split_i64."""
+    hi = int(np.int64(value) >> np.int64(32))
+    lo = int((np.int64(value) & np.int64(0xFFFFFFFF)) - np.int64(0x80000000))
+    return hi, lo
+
+
+# --------------------------------------------------------------------------
+# Host-side per-field column formats (numpy; persistable)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class HostTextField:
+    terms: list[str]                 # term_id -> term (sorted lexicographically)
+    term_dict: dict[str, int]        # term -> term_id
+    term_offsets: np.ndarray         # int64 [T+1] into postings arrays
+    postings_docs: np.ndarray        # int32 [P]
+    postings_tfs: np.ndarray         # float32 [P]
+    doc_len: np.ndarray              # float32 [n_docs] (0 = field absent)
+    total_terms: float               # sum(doc_len) — feeds shard-level avgdl
+    docs_with_field: int
+
+    def doc_freq(self, term: str) -> int:
+        tid = self.term_dict.get(term)
+        if tid is None:
+            return 0
+        return int(self.term_offsets[tid + 1] - self.term_offsets[tid])
+
+
+@dataclass
+class HostKeywordField:
+    ord_values: list[str]            # ordinal -> value (sorted)
+    ord_dict: dict[str, int]
+    first_ord: np.ndarray            # int32 [n_docs], -1 = missing (sort key)
+    mv_offsets: np.ndarray           # int32 [n_docs+1] CSR into mv_ords
+    mv_ords: np.ndarray              # int32 [E] ordinals per doc (sorted per doc)
+    mv_docs: np.ndarray              # int32 [E] owning doc of each entry
+
+
+@dataclass
+class HostNumericField:
+    kind: str                        # "int" | "float"
+    values_i64: np.ndarray | None    # int64 [n_docs] (int kind)
+    values_f64: np.ndarray | None    # float64 [n_docs] (float kind)
+    present: np.ndarray              # bool [n_docs]
+
+
+@dataclass
+class HostVectorField:
+    vectors: np.ndarray              # float32 [n_docs, dims]
+    present: np.ndarray              # bool [n_docs]
+    dims: int
+    similarity: str
+
+
+@dataclass
+class HostSegment:
+    """One sealed, immutable segment (host representation)."""
+
+    name: str
+    n_docs: int
+    doc_ids: list[str]                       # local docid -> _id
+    sources: list[bytes]                     # local docid -> _source JSON
+    text_fields: dict[str, HostTextField] = dc_field(default_factory=dict)
+    keyword_fields: dict[str, HostKeywordField] = dc_field(default_factory=dict)
+    numeric_fields: dict[str, HostNumericField] = dc_field(default_factory=dict)
+    vector_fields: dict[str, HostVectorField] = dc_field(default_factory=dict)
+    # live docs bitmap — mutated by deletes, republished to device on refresh
+    live: np.ndarray = dc_field(default_factory=lambda: np.zeros(0, bool))
+    min_seq_no: int = -1
+    max_seq_no: int = -1
+
+    def __post_init__(self) -> None:
+        if self.live.size == 0:
+            self.live = np.ones(self.n_docs, dtype=bool)
+        self._id_to_doc = {id_: i for i, id_ in enumerate(self.doc_ids)}
+
+    def local_doc(self, doc_id: str) -> int | None:
+        d = self._id_to_doc.get(doc_id)
+        if d is None or not self.live[d]:
+            return None
+        return d
+
+    def delete_doc(self, doc_id: str) -> bool:
+        d = self._id_to_doc.get(doc_id)
+        if d is None or not self.live[d]:
+            return False
+        self.live[d] = False
+        return True
+
+    @property
+    def live_count(self) -> int:
+        return int(self.live.sum())
+
+
+# --------------------------------------------------------------------------
+# Builder: accumulates parsed docs, seals into a HostSegment
+# --------------------------------------------------------------------------
+
+
+class SegmentBuilder:
+    """The in-memory indexing buffer (the IndexWriter RAM buffer analog)."""
+
+    def __init__(self, mapper_service: MapperService, name: str):
+        self.mapper_service = mapper_service
+        self.name = name
+        self.docs: list[ParsedDocument] = []
+        self.seq_nos: list[int] = []
+
+    def add(self, doc: ParsedDocument, seq_no: int) -> int:
+        self.docs.append(doc)
+        self.seq_nos.append(seq_no)
+        return len(self.docs) - 1
+
+    def __len__(self) -> int:
+        return len(self.docs)
+
+    @property
+    def ram_docs(self) -> int:
+        return len(self.docs)
+
+    def build(self) -> HostSegment:
+        if not self.docs:
+            raise IllegalArgumentException("cannot build an empty segment")
+        n = len(self.docs)
+        seg = HostSegment(
+            name=self.name,
+            n_docs=n,
+            doc_ids=[d.doc_id for d in self.docs],
+            sources=[json.dumps(d.source).encode() for d in self.docs],
+            min_seq_no=min(self.seq_nos),
+            max_seq_no=max(self.seq_nos),
+        )
+        mappers = self.mapper_service.mappers
+        for fname, mapper in mappers.items():
+            if mapper.type == "text":
+                tf = self._build_text(fname, n)
+                if tf is not None:
+                    seg.text_fields[fname] = tf
+            elif mapper.type == "keyword":
+                kf = self._build_keyword(fname, n)
+                if kf is not None:
+                    seg.keyword_fields[fname] = kf
+            elif mapper.type in ("date", "boolean") or mapper.type in INT_TYPES:
+                nf = self._build_numeric(fname, n, "int")
+                if nf is not None:
+                    seg.numeric_fields[fname] = nf
+            elif mapper.type == "dense_vector":
+                vf = self._build_vector(fname, n, mapper.dims, mapper.similarity)
+                if vf is not None:
+                    seg.vector_fields[fname] = vf
+            else:  # float family
+                nf = self._build_numeric(fname, n, "float")
+                if nf is not None:
+                    seg.numeric_fields[fname] = nf
+        return seg
+
+    def _build_text(self, fname: str, n: int) -> HostTextField | None:
+        # per-doc term frequency maps
+        doc_tfs: list[dict[str, int] | None] = []
+        any_field = False
+        for doc in self.docs:
+            pf = doc.fields.get(fname)
+            if pf is None or pf.terms is None:
+                doc_tfs.append(None)
+                continue
+            any_field = True
+            tf: dict[str, int] = {}
+            for t in pf.terms:
+                tf[t] = tf.get(t, 0) + 1
+            doc_tfs.append(tf)
+        if not any_field:
+            return None
+        terms = sorted({t for tf in doc_tfs if tf for t in tf})
+        term_dict = {t: i for i, t in enumerate(terms)}
+        # postings sorted by (term_id, doc_id): walk terms, then docs in order
+        per_term_docs: list[list[int]] = [[] for _ in terms]
+        per_term_tfs: list[list[float]] = [[] for _ in terms]
+        doc_len = np.zeros(n, dtype=np.float32)
+        docs_with_field = 0
+        for d, tf in enumerate(doc_tfs):
+            if tf is None:
+                continue
+            docs_with_field += 1
+            doc_len[d] = sum(tf.values())
+            for t, c in tf.items():
+                tid = term_dict[t]
+                per_term_docs[tid].append(d)
+                per_term_tfs[tid].append(float(c))
+        offsets = np.zeros(len(terms) + 1, dtype=np.int64)
+        for i, docs in enumerate(per_term_docs):
+            offsets[i + 1] = offsets[i] + len(docs)
+        postings_docs = np.concatenate(
+            [np.asarray(d, dtype=np.int32) for d in per_term_docs]
+        ) if terms else np.zeros(0, np.int32)
+        postings_tfs = np.concatenate(
+            [np.asarray(t, dtype=np.float32) for t in per_term_tfs]
+        ) if terms else np.zeros(0, np.float32)
+        return HostTextField(
+            terms=terms,
+            term_dict=term_dict,
+            term_offsets=offsets,
+            postings_docs=postings_docs,
+            postings_tfs=postings_tfs,
+            doc_len=doc_len,
+            total_terms=float(doc_len.sum()),
+            docs_with_field=docs_with_field,
+        )
+
+    def _build_keyword(self, fname: str, n: int) -> HostKeywordField | None:
+        per_doc: list[list[str]] = []
+        any_field = False
+        for doc in self.docs:
+            pf = doc.fields.get(fname)
+            vals = pf.exact if pf is not None and pf.exact else []
+            if vals:
+                any_field = True
+            per_doc.append(vals)
+        if not any_field:
+            return None
+        ord_values = sorted({v for vals in per_doc for v in vals})
+        ord_dict = {v: i for i, v in enumerate(ord_values)}
+        first_ord = np.full(n, -1, dtype=np.int32)
+        mv_offsets = np.zeros(n + 1, dtype=np.int32)
+        flat_ords: list[int] = []
+        flat_docs: list[int] = []
+        for d, vals in enumerate(per_doc):
+            ords = sorted(ord_dict[v] for v in vals)
+            if ords:
+                first_ord[d] = ords[0]
+            flat_ords.extend(ords)
+            flat_docs.extend([d] * len(ords))
+            mv_offsets[d + 1] = mv_offsets[d] + len(ords)
+        return HostKeywordField(
+            ord_values=ord_values,
+            ord_dict=ord_dict,
+            first_ord=first_ord,
+            mv_offsets=mv_offsets,
+            mv_ords=np.asarray(flat_ords, dtype=np.int32),
+            mv_docs=np.asarray(flat_docs, dtype=np.int32),
+        )
+
+    def _build_numeric(self, fname: str, n: int, kind: str) -> HostNumericField | None:
+        present = np.zeros(n, dtype=bool)
+        vals = np.zeros(n, dtype=np.int64 if kind == "int" else np.float64)
+        any_field = False
+        for d, doc in enumerate(self.docs):
+            pf = doc.fields.get(fname)
+            if pf is None or not pf.numeric:
+                continue
+            any_field = True
+            present[d] = True
+            # multi-valued numerics: store the first value for now (CSR TODO,
+            # the reference keeps all via SortedNumericDocValues)
+            vals[d] = int(pf.numeric[0]) if kind == "int" else pf.numeric[0]
+        if not any_field:
+            return None
+        return HostNumericField(
+            kind=kind,
+            values_i64=vals if kind == "int" else None,
+            values_f64=vals if kind == "float" else None,
+            present=present,
+        )
+
+    def _build_vector(
+        self, fname: str, n: int, dims: int, similarity: str
+    ) -> HostVectorField | None:
+        present = np.zeros(n, dtype=bool)
+        mat = np.zeros((n, dims), dtype=np.float32)
+        any_field = False
+        for d, doc in enumerate(self.docs):
+            pf = doc.fields.get(fname)
+            if pf is None or pf.vector is None:
+                continue
+            any_field = True
+            present[d] = True
+            mat[d] = np.asarray(pf.vector, dtype=np.float32)
+        if not any_field:
+            return None
+        return HostVectorField(vectors=mat, present=present, dims=dims, similarity=similarity)
+
+
+# --------------------------------------------------------------------------
+# Persistence (flush/commit writes segments to disk; recovery reads them)
+# --------------------------------------------------------------------------
+
+
+def save_segment(seg: HostSegment, directory: Path) -> None:
+    directory.mkdir(parents=True, exist_ok=True)
+    arrays: dict[str, np.ndarray] = {"live": seg.live}
+    meta: dict[str, Any] = {
+        "name": seg.name,
+        "n_docs": seg.n_docs,
+        "doc_ids": seg.doc_ids,
+        "min_seq_no": seg.min_seq_no,
+        "max_seq_no": seg.max_seq_no,
+        "text_fields": {},
+        "keyword_fields": {},
+        "numeric_fields": {},
+        "vector_fields": {},
+    }
+    for fname, tf in seg.text_fields.items():
+        key = f"text:{fname}"
+        arrays[f"{key}:offsets"] = tf.term_offsets
+        arrays[f"{key}:docs"] = tf.postings_docs
+        arrays[f"{key}:tfs"] = tf.postings_tfs
+        arrays[f"{key}:doc_len"] = tf.doc_len
+        meta["text_fields"][fname] = {
+            "terms": tf.terms,
+            "total_terms": tf.total_terms,
+            "docs_with_field": tf.docs_with_field,
+        }
+    for fname, kf in seg.keyword_fields.items():
+        key = f"kw:{fname}"
+        arrays[f"{key}:first_ord"] = kf.first_ord
+        arrays[f"{key}:mv_offsets"] = kf.mv_offsets
+        arrays[f"{key}:mv_ords"] = kf.mv_ords
+        arrays[f"{key}:mv_docs"] = kf.mv_docs
+        meta["keyword_fields"][fname] = {"ord_values": kf.ord_values}
+    for fname, nf in seg.numeric_fields.items():
+        key = f"num:{fname}"
+        arrays[f"{key}:values"] = (
+            nf.values_i64 if nf.kind == "int" else nf.values_f64
+        )
+        arrays[f"{key}:present"] = nf.present
+        meta["numeric_fields"][fname] = {"kind": nf.kind}
+    for fname, vf in seg.vector_fields.items():
+        key = f"vec:{fname}"
+        arrays[f"{key}:vectors"] = vf.vectors
+        arrays[f"{key}:present"] = vf.present
+        meta["vector_fields"][fname] = {"dims": vf.dims, "similarity": vf.similarity}
+    np.savez_compressed(directory / f"{seg.name}.npz", **arrays)
+    (directory / f"{seg.name}.json").write_text(json.dumps(meta))
+    with open(directory / f"{seg.name}.sources", "wb") as f:
+        for src in seg.sources:
+            f.write(len(src).to_bytes(4, "little"))
+            f.write(src)
+
+
+def load_segment(directory: Path, name: str) -> HostSegment:
+    meta = json.loads((directory / f"{name}.json").read_text())
+    arrays = np.load(directory / f"{name}.npz", allow_pickle=False)
+    sources: list[bytes] = []
+    with open(directory / f"{name}.sources", "rb") as f:
+        while True:
+            hdr = f.read(4)
+            if not hdr:
+                break
+            sources.append(f.read(int.from_bytes(hdr, "little")))
+    seg = HostSegment(
+        name=meta["name"],
+        n_docs=meta["n_docs"],
+        doc_ids=meta["doc_ids"],
+        sources=sources,
+        live=arrays["live"].copy(),
+        min_seq_no=meta["min_seq_no"],
+        max_seq_no=meta["max_seq_no"],
+    )
+    for fname, m in meta["text_fields"].items():
+        key = f"text:{fname}"
+        terms = m["terms"]
+        seg.text_fields[fname] = HostTextField(
+            terms=terms,
+            term_dict={t: i for i, t in enumerate(terms)},
+            term_offsets=arrays[f"{key}:offsets"],
+            postings_docs=arrays[f"{key}:docs"],
+            postings_tfs=arrays[f"{key}:tfs"],
+            doc_len=arrays[f"{key}:doc_len"],
+            total_terms=m["total_terms"],
+            docs_with_field=m["docs_with_field"],
+        )
+    for fname, m in meta["keyword_fields"].items():
+        key = f"kw:{fname}"
+        ord_values = m["ord_values"]
+        seg.keyword_fields[fname] = HostKeywordField(
+            ord_values=ord_values,
+            ord_dict={v: i for i, v in enumerate(ord_values)},
+            first_ord=arrays[f"{key}:first_ord"],
+            mv_offsets=arrays[f"{key}:mv_offsets"],
+            mv_ords=arrays[f"{key}:mv_ords"],
+            mv_docs=arrays[f"{key}:mv_docs"],
+        )
+    for fname, m in meta["numeric_fields"].items():
+        key = f"num:{fname}"
+        vals = arrays[f"{key}:values"]
+        seg.numeric_fields[fname] = HostNumericField(
+            kind=m["kind"],
+            values_i64=vals if m["kind"] == "int" else None,
+            values_f64=vals if m["kind"] == "float" else None,
+            present=arrays[f"{key}:present"],
+        )
+    for fname, m in meta["vector_fields"].items():
+        key = f"vec:{fname}"
+        seg.vector_fields[fname] = HostVectorField(
+            vectors=arrays[f"{key}:vectors"],
+            present=arrays[f"{key}:present"],
+            dims=m["dims"],
+            similarity=m["similarity"],
+        )
+    return seg

@@ -1,0 +1,98 @@
+"""BM25 lexical scoring as a fused XLA program.
+
+Replaces the reference's per-doc Lucene collector loop (the ★★ hot loop in
+SURVEY.md §3.2: search/internal/ContextIndexSearcher.java:242 driving
+BM25Similarity) with a vectorized formulation:
+
+for each query term q (padded to a static Q):
+    gather a padded window [window] of its postings (docs, tfs),
+    compute idf * tf / (tf + k1*(1 - b + b*dl/avgdl)) on the VPU,
+    scatter-add contributions into a dense [n_pad] score column.
+
+Only (offset, length, idf) per query term crosses host→device at query time;
+postings stay resident in HBM. Scoring ends in jax.lax.top_k downstream.
+
+Scoring math matches Lucene's BM25Similarity (idf = ln(1 + (N-df+0.5)/(df+0.5)))
+with exact doc lengths instead of Lucene's lossy SmallFloat norm encoding —
+scores are therefore slightly *more* accurate than the reference's.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+K1_DEFAULT = 1.2
+B_DEFAULT = 0.75
+
+
+def idf(doc_freq: int, doc_count: int) -> float:
+    """Lucene BM25Similarity.idfExplain."""
+    return math.log(1.0 + (doc_count - doc_freq + 0.5) / (doc_freq + 0.5))
+
+
+def bm25_term_scores(
+    postings_docs: jnp.ndarray,   # int32 [P_pad] flat CSR postings
+    postings_tfs: jnp.ndarray,    # float32 [P_pad]
+    doc_len: jnp.ndarray,         # float32 [n_pad]
+    offsets: jnp.ndarray,         # int32 [Q] per-query-term start into postings
+    lengths: jnp.ndarray,         # int32 [Q] per-query-term postings count
+    idfs: jnp.ndarray,            # float32 [Q] precomputed idf weights
+    avgdl: jnp.ndarray,           # float32 scalar (shard-level average doc len)
+    n_pad: int,                   # static: padded doc-column size
+    window: int,                  # static: padded per-term postings window
+    k1: float = K1_DEFAULT,
+    b: float = B_DEFAULT,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (scores [n_pad] f32, match_counts [n_pad] i32).
+
+    match_counts[d] = number of query terms matching doc d — the bool-query
+    building block (must => count == n_required, should => count >= minimum).
+    Terms whose postings exceed `window` must be split by the caller into
+    multiple (offset, length) rows; idf weight rides along unchanged.
+    """
+    q = offsets.shape[0]
+    win = jnp.arange(window, dtype=jnp.int32)                     # [window]
+    idx = offsets[:, None] + win[None, :]                         # [Q, window]
+    valid = win[None, :] < lengths[:, None]                       # [Q, window]
+    idx = jnp.where(valid, idx, 0)
+    docs = postings_docs[idx]                                     # [Q, window]
+    tfs = postings_tfs[idx]
+    dl = doc_len[docs]
+    denom = tfs + k1 * (1.0 - b + b * dl / avgdl)
+    contrib = idfs[:, None] * tfs / jnp.maximum(denom, 1e-9)
+    contrib = jnp.where(valid, contrib, 0.0)
+    docs = jnp.where(valid, docs, 0)                              # 0-contrib dump slot
+    flat_docs = docs.reshape(q * window)
+    scores = jnp.zeros(n_pad, jnp.float32).at[flat_docs].add(
+        contrib.reshape(q * window)
+    )
+    counts = jnp.zeros(n_pad, jnp.int32).at[flat_docs].add(
+        valid.reshape(q * window).astype(jnp.int32)
+    )
+    return scores, counts
+
+
+def constant_term_scores(
+    postings_docs: jnp.ndarray,
+    offsets: jnp.ndarray,
+    lengths: jnp.ndarray,
+    weights: jnp.ndarray,
+    n_pad: int,
+    window: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Constant-score variant (filter/term-in-constant-score context):
+    each matching doc gets `weight` per term, no tf/norm math."""
+    win = jnp.arange(window, dtype=jnp.int32)
+    idx = offsets[:, None] + win[None, :]
+    valid = win[None, :] < lengths[:, None]
+    idx = jnp.where(valid, idx, 0)
+    docs = jnp.where(valid, postings_docs[idx], 0)
+    contrib = jnp.where(valid, weights[:, None], 0.0)
+    flat = docs.reshape(-1)
+    scores = jnp.zeros(n_pad, jnp.float32).at[flat].add(contrib.reshape(-1))
+    counts = jnp.zeros(n_pad, jnp.int32).at[flat].add(
+        valid.reshape(-1).astype(jnp.int32)
+    )
+    return scores, counts

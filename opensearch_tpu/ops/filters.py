@@ -1,0 +1,86 @@
+"""Doc-values filter primitives: boolean masks over the dense doc column.
+
+The analog of Lucene filter clauses / points-range queries executing against
+doc values (reference: index/query/* compiled through QueryShardContext into
+Lucene queries). Here every filter compiles to a [n_pad] bool mask computed
+on the VPU; bool-query composition is elementwise &, |, &~.
+
+int64 columns arrive as two int32 words (see segment.split_i64): range
+comparison is lexicographic (hi, lo) with lo pre-offset so signed compare
+behaves as unsigned — exact int64 semantics without x64 mode.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def i64_ge(hi: jnp.ndarray, lo: jnp.ndarray, qhi: jnp.ndarray, qlo: jnp.ndarray) -> jnp.ndarray:
+    return (hi > qhi) | ((hi == qhi) & (lo >= qlo))
+
+
+def i64_le(hi: jnp.ndarray, lo: jnp.ndarray, qhi: jnp.ndarray, qlo: jnp.ndarray) -> jnp.ndarray:
+    return (hi < qhi) | ((hi == qhi) & (lo <= qlo))
+
+
+def range_mask_i64(
+    hi: jnp.ndarray,          # int32 [n_pad] high words
+    lo: jnp.ndarray,          # int32 [n_pad] offset-encoded low words
+    present: jnp.ndarray,     # bool [n_pad]
+    gte_hi: jnp.ndarray, gte_lo: jnp.ndarray,   # scalar int32 lower bound words
+    lte_hi: jnp.ndarray, lte_lo: jnp.ndarray,   # scalar int32 upper bound words
+) -> jnp.ndarray:
+    """Closed-interval int64 range; callers encode open/absent bounds as
+    int64 min/max sentinels (gt x == gte x+1, lt x == lte x-1)."""
+    return present & i64_ge(hi, lo, gte_hi, gte_lo) & i64_le(hi, lo, lte_hi, lte_lo)
+
+
+def range_mask_f32(
+    values: jnp.ndarray, present: jnp.ndarray,
+    gte: jnp.ndarray, lte: jnp.ndarray,
+    gt_open: jnp.ndarray, lt_open: jnp.ndarray,  # bool scalars: strict bounds
+) -> jnp.ndarray:
+    lower = jnp.where(gt_open, values > gte, values >= gte)
+    upper = jnp.where(lt_open, values < lte, values <= lte)
+    return present & lower & upper
+
+
+def term_mask_keyword(
+    mv_ords: jnp.ndarray,     # int32 [E_pad] CSR ordinals (pad = -2)
+    mv_docs: jnp.ndarray,     # int32 [E_pad] owning doc (pad = 0)
+    query_ord: jnp.ndarray,   # scalar int32 (-3 = term not in segment dict)
+    n_pad: int,
+) -> jnp.ndarray:
+    hit = (mv_ords == query_ord).astype(jnp.int32)
+    mask = jnp.zeros(n_pad, jnp.int32).at[mv_docs].max(hit)
+    return mask.astype(bool)
+
+
+def terms_mask_keyword(
+    mv_ords: jnp.ndarray,
+    mv_docs: jnp.ndarray,
+    query_ords: jnp.ndarray,  # int32 [T_pad], pad slots = -3
+    n_pad: int,
+) -> jnp.ndarray:
+    hit = jnp.any(mv_ords[:, None] == query_ords[None, :], axis=1).astype(jnp.int32)
+    mask = jnp.zeros(n_pad, jnp.int32).at[mv_docs].max(hit)
+    return mask.astype(bool)
+
+
+def exists_mask(present: jnp.ndarray) -> jnp.ndarray:
+    return present
+
+
+def docs_mask_from_postings(
+    postings_docs: jnp.ndarray,
+    offset: jnp.ndarray, length: jnp.ndarray,   # int32 scalars
+    n_pad: int,
+    window: int,
+) -> jnp.ndarray:
+    """Mask of docs containing one text term (term filter on a text field)."""
+    win = jnp.arange(window, dtype=jnp.int32)
+    valid = win < length
+    idx = jnp.where(valid, offset + win, 0)
+    docs = jnp.where(valid, postings_docs[idx], 0)
+    mask = jnp.zeros(n_pad, jnp.int32).at[docs].max(valid.astype(jnp.int32))
+    return mask.astype(bool)

@@ -1,0 +1,44 @@
+"""Top-k selection and cross-shard/segment merge.
+
+Per-segment: jax.lax.top_k over the dense score column (XLA's TopK breaks
+score ties by taking the lower index first, which — because our doc column is
+indexed by local doc id — reproduces Lucene/OpenSearch's doc-id-ascending
+tie-break inside a segment; tested in tests/test_ops.py).
+
+Cross-shard: the reference merges QuerySearchResults on the coordinator heap
+(action/search/SearchPhaseController.java:224 mergeTopDocs). Device-side
+equivalent in parallel/merge.py gathers per-shard (score, global_doc) pairs
+over the mesh and runs one more top_k; host fallback here covers the
+single-host path and exact tie-break semantics (score desc, shard asc,
+doc asc).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def segment_top_k(scores: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(values [k], local_doc_ids [k]) — scores must already be -inf-masked
+    for non-matching / deleted / padding docs."""
+    return jax.lax.top_k(scores, k)
+
+
+def merge_shard_hits(
+    per_shard: list[tuple[np.ndarray, np.ndarray]],  # [(scores[k], docs[k])...]
+    k: int,
+) -> list[tuple[float, int, int]]:
+    """Host k-way merge with OpenSearch tie-break: score desc, then shard
+    index asc, then doc id asc. Returns [(score, shard_idx, doc)] with
+    -inf (= no hit) entries dropped."""
+    rows: list[tuple[float, int, int]] = []
+    for shard_idx, (scores, docs) in enumerate(per_shard):
+        s = np.asarray(scores)
+        d = np.asarray(docs)
+        for i in range(len(s)):
+            if np.isfinite(s[i]):
+                rows.append((float(s[i]), shard_idx, int(d[i])))
+    rows.sort(key=lambda r: (-r[0], r[1], r[2]))
+    return rows[:k]

@@ -1,0 +1,107 @@
+"""Engine write path: buffer, versioning, refresh, flush, crash recovery."""
+
+import pytest
+
+from opensearch_tpu.common.errors import VersionConflictException
+from opensearch_tpu.index.engine import Engine
+from opensearch_tpu.index.mapper import MapperService
+
+MAPPINGS = {
+    "properties": {
+        "title": {"type": "text"},
+        "n": {"type": "long"},
+    }
+}
+
+
+@pytest.fixture
+def engine(tmp_path):
+    e = Engine(tmp_path / "shard0", MapperService(MAPPINGS))
+    yield e
+    e.close()
+
+
+def test_index_get_update_delete(engine):
+    r1 = engine.index("1", {"title": "hello world", "n": 1})
+    assert (r1.seq_no, r1.version, r1.result) == (0, 1, "created")
+    # realtime get before refresh
+    got = engine.get("1")
+    assert got["_source"]["n"] == 1
+    r2 = engine.index("1", {"title": "hello again", "n": 2})
+    assert (r2.seq_no, r2.version, r2.result) == (1, 2, "updated")
+    assert engine.get("1")["_source"]["n"] == 2
+    rd = engine.delete("1")
+    assert rd.result == "deleted" and rd.version == 3
+    assert engine.get("1") is None
+    assert engine.delete("missing").result == "not_found"
+
+
+def test_optimistic_concurrency(engine):
+    r = engine.index("1", {"title": "a", "n": 1})
+    with pytest.raises(VersionConflictException):
+        engine.index("1", {"title": "b", "n": 2}, if_seq_no=r.seq_no + 5)
+    r2 = engine.index("1", {"title": "b", "n": 2}, if_seq_no=r.seq_no)
+    assert r2.version == 2
+
+
+def test_refresh_creates_segment_and_update_across_segments(engine):
+    engine.index("1", {"title": "first doc", "n": 1})
+    engine.index("2", {"title": "second doc", "n": 2})
+    snap = engine.refresh()
+    assert snap.num_docs == 2
+    assert len(snap.segments) == 1
+    # update doc 1 -> old copy must die in the sealed segment
+    engine.index("1", {"title": "updated doc", "n": 10})
+    snap2 = engine.refresh()
+    assert snap2.num_docs == 2
+    assert len(snap2.segments) == 2
+    host0 = snap2.segments[0][0]
+    assert host0.live_count == 1  # doc "1" deleted in old segment
+    assert engine.get("1")["_source"]["n"] == 10
+
+
+def test_flush_and_recover(tmp_path):
+    path = tmp_path / "shardX"
+    e = Engine(path, MapperService(MAPPINGS))
+    e.index("1", {"title": "persisted doc", "n": 1})
+    e.index("2", {"title": "also persisted", "n": 2})
+    e.flush()
+    # post-flush ops live only in translog
+    e.index("3", {"title": "translog only", "n": 3})
+    e.delete("2")
+    e.close()
+
+    # simulate restart
+    e2 = Engine(path, MapperService(MAPPINGS))
+    assert e2.num_docs == 2
+    assert e2.get("1")["_source"]["n"] == 1
+    assert e2.get("2") is None
+    assert e2.get("3")["_source"]["n"] == 3
+    assert e2.max_seq_no == 3
+    # versions survive recovery
+    r = e2.index("3", {"title": "bumped", "n": 4})
+    assert r.version == 2
+    e2.close()
+
+
+def test_recover_without_flush(tmp_path):
+    path = tmp_path / "shardY"
+    e = Engine(path, MapperService(MAPPINGS))
+    e.index("a", {"title": "one", "n": 1})
+    e.index("b", {"title": "two", "n": 2})
+    e.delete("a")
+    e.close()
+    e2 = Engine(path, MapperService(MAPPINGS))
+    assert e2.num_docs == 1
+    assert e2.get("a") is None
+    assert e2.get("b")["_source"]["n"] == 2
+    e2.close()
+
+
+def test_segment_stats(engine):
+    engine.index("1", {"title": "x", "n": 1})
+    st = engine.segment_stats()
+    assert st == {"count": 0, "docs": 0, "live_docs": 0, "buffered_docs": 1}
+    engine.refresh()
+    st = engine.segment_stats()
+    assert st["count"] == 1 and st["docs"] == 1 and st["buffered_docs"] == 0

@@ -1,0 +1,116 @@
+import pytest
+
+from opensearch_tpu.common.errors import (
+    MapperParsingException,
+    StrictDynamicMappingException,
+)
+from opensearch_tpu.index.analysis import AnalysisRegistry, porter_stem
+from opensearch_tpu.index.mapper import MapperService, parse_date_millis
+
+
+def test_standard_analyzer():
+    reg = AnalysisRegistry()
+    assert reg.get("standard").analyze("The QUICK brown-fox, 42!") == [
+        "the", "quick", "brown", "fox", "42",
+    ]
+    assert reg.get("whitespace").analyze("a B  c") == ["a", "B", "c"]
+    assert reg.get("keyword").analyze("New York") == ["New York"]
+    assert reg.get("stop").analyze("the quick AND lazy") == ["quick", "lazy"]
+
+
+def test_english_analyzer_stems_and_stops():
+    reg = AnalysisRegistry()
+    assert reg.get("english").analyze("the running dogs are jumping") == [
+        "run", "dog", "jump",
+    ]
+
+
+def test_porter_stem_cases():
+    cases = {
+        "caresses": "caress", "ponies": "poni", "cats": "cat",
+        "feed": "feed", "agreed": "agre", "plastered": "plaster",
+        "motoring": "motor", "sing": "sing", "conflated": "conflat",
+        "troubled": "troubl", "sized": "size", "hopping": "hop",
+        "relational": "relat", "conditional": "condit", "rational": "ration",
+        "happy": "happi", "generalization": "gener",
+    }
+    for word, stem in cases.items():
+        assert porter_stem(word) == stem, word
+
+
+def test_custom_analyzer_from_settings():
+    reg = AnalysisRegistry.from_index_settings(
+        {
+            "filter": {"my_stop": {"type": "stop", "stopwords": ["foo"]}},
+            "analyzer": {
+                "my_an": {"tokenizer": "whitespace", "filter": ["lowercase", "my_stop"]}
+            },
+        }
+    )
+    assert reg.get("my_an").analyze("FOO Bar baz") == ["bar", "baz"]
+
+
+def test_date_parsing():
+    assert parse_date_millis("2024-01-01T00:00:00Z") == 1704067200000
+    assert parse_date_millis(1704067200000) == 1704067200000
+    assert parse_date_millis("2024-01-01T01:00:00+01:00") == 1704067200000
+    with pytest.raises(ValueError):
+        parse_date_millis("not a date")
+
+
+def test_dynamic_mapping_inference():
+    ms = MapperService()
+    ms.parse_document("1", {
+        "name": "alice", "age": 30, "score": 1.5, "active": True,
+        "joined": "2024-03-01T12:00:00Z", "nested": {"deep": "value"},
+    })
+    assert ms.mappers["name"].type == "text"
+    assert ms.mappers["name.keyword"].type == "keyword"
+    assert ms.mappers["age"].type == "long"
+    assert ms.mappers["score"].type == "float"
+    assert ms.mappers["active"].type == "boolean"
+    assert ms.mappers["joined"].type == "date"
+    assert ms.mappers["nested.deep"].type == "text"
+
+
+def test_strict_and_false_dynamic():
+    ms = MapperService({"dynamic": "strict", "properties": {"a": {"type": "keyword"}}})
+    ms.parse_document("1", {"a": "ok"})
+    with pytest.raises(StrictDynamicMappingException):
+        ms.parse_document("2", {"b": "nope"})
+    ms2 = MapperService({"dynamic": False, "properties": {"a": {"type": "keyword"}}})
+    doc = ms2.parse_document("1", {"a": "x", "unknown": "ignored"})
+    assert "unknown" not in doc.fields
+
+
+def test_type_validation():
+    ms = MapperService({"properties": {"n": {"type": "integer"}}})
+    with pytest.raises(MapperParsingException):
+        ms.parse_document("1", {"n": "not-a-number"})
+    with pytest.raises(MapperParsingException):
+        ms.parse_document("1", {"n": 2**40})  # out of integer range
+    with pytest.raises(MapperParsingException):
+        MapperService({"properties": {"x": {"type": "no_such_type"}}})
+    with pytest.raises(MapperParsingException):
+        MapperService({"properties": {"v": {"type": "dense_vector"}}})  # no dims
+
+
+def test_mapping_roundtrip_and_merge_conflict():
+    ms = MapperService({"properties": {
+        "a": {"type": "keyword"},
+        "obj": {"properties": {"inner": {"type": "long"}}},
+    }})
+    d = ms.to_dict()
+    assert d["properties"]["a"]["type"] == "keyword"
+    assert d["properties"]["obj"]["properties"]["inner"]["type"] == "long"
+    from opensearch_tpu.common.errors import IllegalArgumentException
+    with pytest.raises(IllegalArgumentException):
+        ms.merge({"properties": {"a": {"type": "long"}}})
+
+
+def test_knn_vector_alias():
+    ms = MapperService({"properties": {
+        "v": {"type": "knn_vector", "dimension": 8, "space_type": "cosinesimil"}
+    }})
+    m = ms.mappers["v"]
+    assert m.type == "dense_vector" and m.dims == 8
