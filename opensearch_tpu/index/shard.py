@@ -1,0 +1,93 @@
+"""IndexShard: the per-shard state machine gluing engine + search.
+
+The analog of server/src/main/java/org/opensearch/index/shard/IndexShard.java
+(:271): owns one Engine, exposes the primary/replica operation entry points
+(applyIndexOperationOnPrimary:1109 / OnReplica:1135), refresh scheduling and
+shard-level stats. Replication fan-out lives above (cluster layer); replicas
+replay ops through `apply_on_replica` with the primary's seq_no, and the
+segment-replication path ships sealed HostSegments instead.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+
+from opensearch_tpu.index.engine import Engine, OpResult, SearcherSnapshot
+from opensearch_tpu.index.mapper import MapperService
+
+
+@dataclass(frozen=True)
+class ShardId:
+    index: str
+    shard: int
+
+    def __str__(self) -> str:
+        return f"[{self.index}][{self.shard}]"
+
+
+class IndexShard:
+    def __init__(self, shard_id: ShardId, path: Path, mapper_service: MapperService):
+        self.shard_id = shard_id
+        self.mapper_service = mapper_service
+        self.engine = Engine(path, mapper_service)
+        self.primary = True
+
+    # -- write ops ---------------------------------------------------------
+
+    def apply_index_on_primary(
+        self, doc_id: str, source: dict, routing: str | None = None,
+        if_seq_no: int | None = None,
+    ) -> OpResult:
+        return self.engine.index(doc_id, source, routing, if_seq_no=if_seq_no)
+
+    def apply_index_on_replica(
+        self, doc_id: str, source: dict, seq_no: int, routing: str | None = None
+    ) -> OpResult:
+        return self.engine.index(doc_id, source, routing, seq_no=seq_no)
+
+    def apply_delete_on_primary(self, doc_id: str) -> OpResult:
+        return self.engine.delete(doc_id)
+
+    def apply_delete_on_replica(self, doc_id: str, seq_no: int) -> OpResult:
+        return self.engine.delete(doc_id, seq_no=seq_no)
+
+    # -- read ops ----------------------------------------------------------
+
+    def get(self, doc_id: str) -> dict | None:
+        return self.engine.get(doc_id)
+
+    def acquire_searcher(self) -> SearcherSnapshot:
+        return self.engine.acquire_searcher()
+
+    def refresh(self) -> None:
+        self.engine.refresh()
+
+    def flush(self) -> None:
+        self.engine.flush()
+
+    @property
+    def num_docs(self) -> int:
+        return self.engine.num_docs
+
+    def stats(self) -> dict:
+        return {
+            "docs": {"count": self.engine.num_docs},
+            "indexing": {
+                "index_total": self.engine.stats["index_total"],
+                "delete_total": self.engine.stats["delete_total"],
+                "index_time_in_millis": int(self.engine.stats["index_time_ms"]),
+            },
+            "refresh": {"total": self.engine.stats["refresh_total"]},
+            "flush": {"total": self.engine.stats["flush_total"]},
+            "segments": self.engine.segment_stats(),
+            "translog": self.engine.translog.stats(),
+            "seq_no": {
+                "max_seq_no": self.engine.max_seq_no,
+                "local_checkpoint": self.engine.local_checkpoint,
+                "global_checkpoint": self.engine.local_checkpoint,
+            },
+        }
+
+    def close(self) -> None:
+        self.engine.close()

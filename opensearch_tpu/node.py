@@ -1,0 +1,430 @@
+"""TpuNode: single-node engine facade (IndicesService + NodeClient analog).
+
+The single-process composition root, mirroring the reference's Node wiring
+(server/src/main/java/org/opensearch/node/Node.java:494 constructs
+IndicesService:979, SearchService:1515, ActionModule:1165): owns the index
+registry, routes documents to shards (OperationRouting: murmur3 % shards),
+executes the document/bulk/search APIs with OpenSearch response shapes.
+
+The multi-node story (cluster/ package: coordination, allocation,
+replication fan-out) layers on top of this same class — a TpuNode hosts the
+shards the cluster state assigns to it.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from pathlib import Path
+from typing import Any
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentException,
+    IndexNotFoundException,
+    OpenSearchTpuException,
+    ResourceAlreadyExistsException,
+    VersionConflictException,
+)
+from opensearch_tpu.common.hashing import shard_id_for_routing
+from opensearch_tpu.common.settings import Settings
+from opensearch_tpu.index.analysis import AnalysisRegistry
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.shard import IndexShard, ShardId
+from opensearch_tpu.search import service as search_service
+
+_VALID_INDEX_NAME = re.compile(r"^[a-z0-9][a-z0-9_\-.]*$")
+
+
+class IndexService:
+    """Per-index container (index module + its shards)."""
+
+    def __init__(self, name: str, path: Path, settings: dict, mappings: dict | None):
+        self.name = name
+        self.path = path
+        self.settings = settings
+        analysis = AnalysisRegistry.from_index_settings(
+            (settings.get("analysis") if isinstance(settings.get("analysis"), dict) else None)
+        )
+        self.mapper_service = MapperService(mappings, analysis)
+        self.num_shards = int(settings.get("number_of_shards", 1))
+        self.num_replicas = int(settings.get("number_of_replicas", 1))
+        self.creation_date = int(time.time() * 1000)
+        self.shards: dict[int, IndexShard] = {}
+        for s in range(self.num_shards):
+            self.shards[s] = IndexShard(
+                ShardId(name, s), path / str(s), self.mapper_service
+            )
+
+    def shard_for(self, doc_id: str, routing: str | None) -> IndexShard:
+        sid = shard_id_for_routing(routing or doc_id, self.num_shards)
+        return self.shards[sid]
+
+    def close(self) -> None:
+        for shard in self.shards.values():
+            shard.close()
+
+
+class TpuNode:
+    def __init__(self, data_path: str | Path, node_name: str = "node-0"):
+        self.data_path = Path(data_path)
+        self.node_name = node_name
+        self.indices: dict[str, IndexService] = {}
+        self._state_file = self.data_path / "indices.json"
+        self._recover_indices()
+
+    # -- index lifecycle ---------------------------------------------------
+
+    def _index_path(self, name: str) -> Path:
+        return self.data_path / "indices" / name
+
+    def _persist_index_registry(self) -> None:
+        self.data_path.mkdir(parents=True, exist_ok=True)
+        registry = {
+            name: {"settings": svc.settings, "mappings": svc.mapper_service.to_dict()}
+            for name, svc in self.indices.items()
+        }
+        self._state_file.write_text(json.dumps(registry))
+
+    def _recover_indices(self) -> None:
+        if not self._state_file.exists():
+            return
+        registry = json.loads(self._state_file.read_text())
+        for name, meta in registry.items():
+            self.indices[name] = IndexService(
+                name, self._index_path(name), meta["settings"], meta["mappings"]
+            )
+
+    def create_index(self, name: str, body: dict | None = None) -> dict:
+        if not _VALID_INDEX_NAME.match(name) or name.startswith(("_", "-")):
+            raise IllegalArgumentException(f"invalid index name [{name}]")
+        if name in self.indices:
+            raise ResourceAlreadyExistsException(f"index [{name}] already exists")
+        body = body or {}
+        settings = body.get("settings") or {}
+        # accept both flat ("index.number_of_shards") and nested forms
+        flat = Settings.from_nested(settings).as_dict()
+        norm = {}
+        for k, v in flat.items():
+            norm[k[len("index."):] if k.startswith("index.") else k] = v
+        # analysis config must stay nested
+        nested = Settings.from_flat(norm).as_nested()
+        self.indices[name] = IndexService(
+            name, self._index_path(name), nested, body.get("mappings")
+        )
+        self._persist_index_registry()
+        return {"acknowledged": True, "shards_acknowledged": True, "index": name}
+
+    def delete_index(self, name: str) -> dict:
+        svc = self._get_index(name)
+        svc.close()
+        del self.indices[name]
+        self._persist_index_registry()
+        import shutil
+
+        shutil.rmtree(self._index_path(name), ignore_errors=True)
+        return {"acknowledged": True}
+
+    def _get_index(self, name: str) -> IndexService:
+        svc = self.indices.get(name)
+        if svc is None:
+            raise IndexNotFoundException(name)
+        return svc
+
+    def _get_or_autocreate(self, name: str) -> IndexService:
+        if name not in self.indices:
+            self.create_index(name, {})
+        return self.indices[name]
+
+    def resolve_indices(self, expr: str) -> list[str]:
+        """Index name/pattern resolution (comma lists, wildcards, _all)."""
+        if expr in ("_all", "*", ""):
+            return sorted(self.indices)
+        names: list[str] = []
+        import fnmatch
+
+        for part in expr.split(","):
+            part = part.strip()
+            if "*" in part or "?" in part:
+                names.extend(n for n in sorted(self.indices) if fnmatch.fnmatch(n, part))
+            else:
+                if part not in self.indices:
+                    raise IndexNotFoundException(part)
+                names.append(part)
+        seen = set()
+        return [n for n in names if not (n in seen or seen.add(n))]
+
+    def put_mapping(self, index: str, body: dict) -> dict:
+        for name in self.resolve_indices(index):
+            self._get_index(name).mapper_service.merge(body)
+        self._persist_index_registry()
+        return {"acknowledged": True}
+
+    def get_mapping(self, index: str) -> dict:
+        return {
+            name: {"mappings": self._get_index(name).mapper_service.to_dict()}
+            for name in self.resolve_indices(index)
+        }
+
+    def get_settings(self, index: str) -> dict:
+        out = {}
+        for name in self.resolve_indices(index):
+            svc = self._get_index(name)
+            out[name] = {
+                "settings": {
+                    "index": {
+                        **svc.settings,
+                        "number_of_shards": str(svc.num_shards),
+                        "number_of_replicas": str(svc.num_replicas),
+                        "creation_date": str(svc.creation_date),
+                        "uuid": name,
+                        "provided_name": name,
+                    }
+                }
+            }
+        return out
+
+    # -- document APIs -----------------------------------------------------
+
+    def index_doc(
+        self,
+        index: str,
+        doc_id: str | None,
+        source: dict,
+        routing: str | None = None,
+        if_seq_no: int | None = None,
+        refresh: bool = False,
+    ) -> dict:
+        svc = self._get_or_autocreate(index)
+        if doc_id is None:
+            import uuid
+
+            doc_id = uuid.uuid4().hex[:20]
+        shard = svc.shard_for(doc_id, routing)
+        mappers_before = len(svc.mapper_service.mappers)
+        result = shard.apply_index_on_primary(doc_id, source, routing, if_seq_no=if_seq_no)
+        if refresh:
+            shard.refresh()
+        if len(svc.mapper_service.mappers) != mappers_before:
+            # dynamic mapping introduced new fields — persist the registry
+            # (the cluster-state "mapping update" publication analog)
+            self._persist_index_registry()
+        return {
+            "_index": index,
+            "_id": doc_id,
+            "_version": result.version,
+            "result": result.result,
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+            "_seq_no": result.seq_no,
+            "_primary_term": 1,
+        }
+
+    def get_doc(self, index: str, doc_id: str, routing: str | None = None) -> dict:
+        svc = self._get_index(index)
+        shard = svc.shard_for(doc_id, routing)
+        got = shard.get(doc_id)
+        if got is None:
+            return {"_index": index, "_id": doc_id, "found": False}
+        return {
+            "_index": index,
+            "_id": doc_id,
+            "_version": got["_version"],
+            "_seq_no": got["_seq_no"],
+            "_primary_term": 1,
+            "found": True,
+            "_source": got["_source"],
+        }
+
+    def delete_doc(self, index: str, doc_id: str, routing: str | None = None,
+                   refresh: bool = False) -> dict:
+        svc = self._get_index(index)
+        shard = svc.shard_for(doc_id, routing)
+        result = shard.apply_delete_on_primary(doc_id)
+        if refresh:
+            shard.refresh()
+        return {
+            "_index": index,
+            "_id": doc_id,
+            "_version": result.version,
+            "result": result.result,
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+            "_seq_no": result.seq_no,
+            "_primary_term": 1,
+        }
+
+    def update_doc(self, index: str, doc_id: str, body: dict,
+                   routing: str | None = None, refresh: bool = False) -> dict:
+        """Partial update via doc merge (the scripted path is TODO —
+        reference: action/update/UpdateHelper.java)."""
+        svc = self._get_index(index)
+        shard = svc.shard_for(doc_id, routing)
+        current = shard.get(doc_id)
+        if "doc" in body:
+            if current is None:
+                if body.get("doc_as_upsert"):
+                    return self.index_doc(index, doc_id, body["doc"], routing, refresh=refresh)
+                from opensearch_tpu.common.errors import DocumentMissingException
+
+                raise DocumentMissingException(f"[{doc_id}]: document missing")
+            merged = _deep_merge(current["_source"], body["doc"])
+            out = self.index_doc(index, doc_id, merged, routing, refresh=refresh)
+            out["result"] = "updated"
+            return out
+        if "upsert" in body and current is None:
+            return self.index_doc(index, doc_id, body["upsert"], routing, refresh=refresh)
+        raise IllegalArgumentException("update requires [doc] or [upsert]")
+
+    def bulk(self, operations: list[tuple[str, dict, dict | None]],
+             refresh: bool = False) -> dict:
+        """operations: [(action, metadata, source)]; action in
+        index|create|update|delete."""
+        t0 = time.monotonic()
+        items = []
+        errors = False
+        touched: set[tuple[str, int]] = set()
+        for action, meta, source in operations:
+            index = meta.get("_index")
+            doc_id = meta.get("_id")
+            routing = meta.get("routing") or meta.get("_routing")
+            try:
+                if action in ("index", "create"):
+                    if action == "create" and doc_id is not None:
+                        existing = None
+                        if index in self.indices:
+                            existing = self._get_index(index).shard_for(doc_id, routing).get(doc_id)
+                        if existing is not None:
+                            raise VersionConflictException(
+                                f"[{doc_id}]: version conflict, document already exists"
+                            )
+                    resp = self.index_doc(index, doc_id, source, routing)
+                    status = 201 if resp["result"] == "created" else 200
+                elif action == "update":
+                    resp = self.update_doc(index, doc_id, source, routing)
+                    status = 200
+                elif action == "delete":
+                    resp = self.delete_doc(index, doc_id, routing)
+                    status = 200 if resp["result"] == "deleted" else 404
+                else:
+                    raise IllegalArgumentException(f"unknown bulk action [{action}]")
+                svc = self.indices.get(index)
+                if svc is not None:
+                    sid = shard_id_for_routing(routing or resp["_id"], svc.num_shards)
+                    touched.add((index, sid))
+                items.append({action: {**resp, "status": status}})
+            except OpenSearchTpuException as e:
+                errors = True
+                items.append({
+                    action: {
+                        "_index": index, "_id": doc_id, "status": e.status,
+                        "error": e.to_dict(),
+                    }
+                })
+        if refresh:
+            for index, sid in touched:
+                self.indices[index].shards[sid].refresh()
+        return {
+            "took": int((time.monotonic() - t0) * 1000),
+            "errors": errors,
+            "items": items,
+        }
+
+    # -- search / refresh --------------------------------------------------
+
+    def refresh(self, index: str = "_all") -> dict:
+        count = 0
+        for name in self.resolve_indices(index):
+            for shard in self._get_index(name).shards.values():
+                shard.refresh()
+                count += 1
+        return {"_shards": {"total": count, "successful": count, "failed": 0}}
+
+    def flush(self, index: str = "_all") -> dict:
+        count = 0
+        for name in self.resolve_indices(index):
+            for shard in self._get_index(name).shards.values():
+                shard.flush()
+                count += 1
+        return {"_shards": {"total": count, "successful": count, "failed": 0}}
+
+    def search(self, index: str, body: dict | None = None) -> dict:
+        names = self.resolve_indices(index)
+        shards: list = []
+        for name in names:
+            shards.extend(self._get_index(name).shards.values())
+        # per-hit _index comes from each shard's ShardId inside the service
+        return search_service.search(shards, body, ",".join(names))
+
+    def msearch(self, searches: list[tuple[dict, dict]]) -> dict:
+        responses = []
+        for header, body in searches:
+            index = header.get("index", "_all")
+            try:
+                responses.append(self.search(index, body))
+            except OpenSearchTpuException as e:
+                responses.append({"error": e.to_dict(), "status": e.status})
+        return {"took": 0, "responses": responses}
+
+    def count(self, index: str, body: dict | None = None) -> dict:
+        body = dict(body or {})
+        body["size"] = 0
+        resp = self.search(index, body)
+        return {
+            "count": resp["hits"]["total"]["value"],
+            "_shards": resp["_shards"],
+        }
+
+    # -- cluster/stats APIs ------------------------------------------------
+
+    def cluster_health(self) -> dict:
+        total_shards = sum(svc.num_shards for svc in self.indices.values())
+        return {
+            "cluster_name": "opensearch-tpu",
+            "status": "green" if self.indices else "green",
+            "timed_out": False,
+            "number_of_nodes": 1,
+            "number_of_data_nodes": 1,
+            "active_primary_shards": total_shards,
+            "active_shards": total_shards,
+            "relocating_shards": 0,
+            "initializing_shards": 0,
+            "unassigned_shards": 0,
+            "delayed_unassigned_shards": 0,
+            "number_of_pending_tasks": 0,
+            "number_of_in_flight_fetch": 0,
+            "task_max_waiting_in_queue_millis": 0,
+            "active_shards_percent_as_number": 100.0,
+        }
+
+    def index_stats(self, index: str = "_all") -> dict:
+        out: dict[str, Any] = {"indices": {}}
+        total_docs = 0
+        for name in self.resolve_indices(index):
+            svc = self._get_index(name)
+            shard_stats = [s.stats() for s in svc.shards.values()]
+            docs = sum(s["docs"]["count"] for s in shard_stats)
+            total_docs += docs
+            out["indices"][name] = {
+                "primaries": {
+                    "docs": {"count": docs},
+                    "indexing": {
+                        "index_total": sum(s["indexing"]["index_total"] for s in shard_stats)
+                    },
+                },
+                "total": {"docs": {"count": docs}},
+            }
+        out["_all"] = {"primaries": {"docs": {"count": total_docs}}}
+        return out
+
+    def close(self) -> None:
+        for svc in self.indices.values():
+            svc.close()
+
+
+def _deep_merge(base: dict, update: dict) -> dict:
+    out = dict(base)
+    for k, v in update.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out

@@ -1,0 +1,444 @@
+"""Aggregations: per-segment partials + reduce.
+
+The analog of the reference's two-tier aggregation compute
+(search/aggregations/: per-shard Aggregator collectors produce
+InternalAggregation partials; InternalAggregations.reduce:162 merges them on
+the coordinator). Here: per-segment numpy partials over exact host columns
+(int64/float64 — no float32 truncation of dates/longs), restricted by the
+query-phase match masks, merged shard-side; the same merge functions serve
+the cross-shard reduce in the coordinator layer.
+
+Implemented: terms (keyword/numeric/boolean), min, max, sum, avg,
+value_count, stats, cardinality (exact), histogram, date_histogram
+(fixed + calendar month/quarter/year), range, filter, filters, missing,
+global — all with arbitrarily nested sub-aggregations.
+
+Device offload note: the masks arrive from the device query phase; the
+bucket/metric math here is host numpy for exactness. The hot aggregations
+(terms on keyword ords = bincount, stats = masked reductions) have direct
+device formulations planned in ops/ for the large-corpus path.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, Callable
+
+import numpy as np
+
+from opensearch_tpu.common.errors import IllegalArgumentException, ParsingException
+from opensearch_tpu.index.mapper import MapperService, parse_date_millis
+from opensearch_tpu.index.segment import HostSegment
+from opensearch_tpu.common.settings import parse_time_millis
+
+AGG_TYPES = {
+    "terms", "min", "max", "sum", "avg", "value_count", "stats", "cardinality",
+    "histogram", "date_histogram", "range", "filter", "filters", "missing", "global",
+}
+
+# executor callback: (query_node_body, segment_index) -> bool mask [n_docs]
+FilterFn = Callable[[dict, int], np.ndarray]
+
+
+def compute_aggs(
+    segments: list[HostSegment],
+    mapper_service: MapperService,
+    aggs_body: dict,
+    masks: list[np.ndarray],
+    filter_fn: FilterFn | None = None,
+) -> dict:
+    out = {}
+    for name, body in aggs_body.items():
+        out[name] = _compute_one(name, body, segments, mapper_service, masks, filter_fn)
+    return out
+
+
+def _split_body(body: dict) -> tuple[str, dict, dict | None]:
+    sub = body.get("aggs") or body.get("aggregations")
+    agg_keys = [k for k in body if k in AGG_TYPES]
+    if len(agg_keys) != 1:
+        raise ParsingException(
+            f"aggregation must have exactly one known type, got {sorted(body)}"
+        )
+    return agg_keys[0], body[agg_keys[0]], sub
+
+
+def _field_values(
+    seg: HostSegment, field: str, mask: np.ndarray, mapper_service: MapperService
+) -> np.ndarray:
+    """Masked exact values of a numeric-ish field (int64/float64)."""
+    nf = seg.numeric_fields.get(field)
+    if nf is not None:
+        vals = nf.values_i64 if nf.kind == "int" else nf.values_f64
+        m = mask & nf.present
+        return vals[m]
+    return np.zeros(0)
+
+
+def _compute_one(
+    name: str,
+    body: dict,
+    segments: list[HostSegment],
+    ms: MapperService,
+    masks: list[np.ndarray],
+    filter_fn: FilterFn | None,
+) -> dict:
+    typ, conf, sub = _split_body(body)
+
+    if typ in ("min", "max", "sum", "avg", "value_count", "stats"):
+        return _metric(typ, conf, segments, ms, masks)
+    if typ == "cardinality":
+        return _cardinality(conf, segments, ms, masks)
+    if typ == "terms":
+        return _terms(conf, sub, segments, ms, masks, filter_fn)
+    if typ == "histogram":
+        return _histogram(conf, sub, segments, ms, masks, filter_fn, date=False)
+    if typ == "date_histogram":
+        return _histogram(conf, sub, segments, ms, masks, filter_fn, date=True)
+    if typ == "range":
+        return _range_agg(conf, sub, segments, ms, masks, filter_fn)
+    if typ == "filter":
+        return _filter_agg(conf, sub, segments, ms, masks, filter_fn)
+    if typ == "filters":
+        return _filters_agg(conf, sub, segments, ms, masks, filter_fn)
+    if typ == "missing":
+        return _missing_agg(conf, sub, segments, ms, masks, filter_fn)
+    if typ == "global":
+        g_masks = [s.live.copy() for s in segments]
+        out = {"doc_count": int(sum(m.sum() for m in g_masks))}
+        if sub:
+            out.update(compute_aggs(segments, ms, sub, g_masks, filter_fn))
+        return out
+    raise ParsingException(f"unknown aggregation type [{typ}]")
+
+
+def _sub_aggs(
+    sub: dict | None,
+    segments: list[HostSegment],
+    ms: MapperService,
+    bucket_masks: list[np.ndarray],
+    filter_fn: FilterFn | None,
+) -> dict:
+    if not sub:
+        return {}
+    return compute_aggs(segments, ms, sub, bucket_masks, filter_fn)
+
+
+# -- metrics ----------------------------------------------------------------
+
+
+def _metric(typ, conf, segments, ms, masks) -> dict:
+    field = conf["field"]
+    chunks = [
+        _field_values(seg, field, masks[i], ms) for i, seg in enumerate(segments)
+    ]
+    vals = np.concatenate(chunks) if chunks else np.zeros(0)
+    n = len(vals)
+    mapper = ms.field_mapper(field)
+    is_date = mapper is not None and mapper.type == "date"
+
+    def fmt(v):
+        if v is None:
+            return None
+        return float(v)
+
+    if typ == "value_count":
+        return {"value": n}
+    if n == 0:
+        if typ == "stats":
+            return {"count": 0, "min": None, "max": None, "avg": None, "sum": 0.0}
+        return {"value": None if typ != "sum" else 0.0}
+    s = float(vals.sum(dtype=np.float64))
+    if typ == "min":
+        return {"value": fmt(vals.min())}
+    if typ == "max":
+        return {"value": fmt(vals.max())}
+    if typ == "sum":
+        return {"value": s}
+    if typ == "avg":
+        return {"value": s / n}
+    return {
+        "count": n,
+        "min": fmt(vals.min()),
+        "max": fmt(vals.max()),
+        "avg": s / n,
+        "sum": s,
+    }
+
+
+def _cardinality(conf, segments, ms, masks) -> dict:
+    field = conf["field"]
+    # exact distinct count (the reference uses HLL++ with precision_threshold;
+    # HLL sketch merge is the planned device path for large corpora)
+    seen: set = set()
+    for i, seg in enumerate(segments):
+        kf = seg.keyword_fields.get(field)
+        if kf is not None:
+            m = masks[i]
+            entry_mask = m[kf.mv_docs]
+            for o in np.unique(kf.mv_ords[entry_mask]):
+                seen.add(kf.ord_values[int(o)])
+            continue
+        vals = _field_values(seg, field, masks[i], ms)
+        seen.update(vals.tolist())
+    return {"value": len(seen)}
+
+
+# -- terms ------------------------------------------------------------------
+
+
+def _terms(conf, sub, segments, ms, masks, filter_fn) -> dict:
+    field = conf["field"]
+    size = int(conf.get("size", 10))
+    # merge per-segment counts keyed by value
+    counts: dict[Any, int] = {}
+    is_keyword = any(field in seg.keyword_fields for seg in segments)
+    for i, seg in enumerate(segments):
+        kf = seg.keyword_fields.get(field)
+        if kf is not None:
+            entry_mask = masks[i][kf.mv_docs]
+            seg_counts = np.bincount(
+                kf.mv_ords[entry_mask], minlength=len(kf.ord_values)
+            )
+            for o in np.nonzero(seg_counts)[0]:
+                key = kf.ord_values[int(o)]
+                counts[key] = counts.get(key, 0) + int(seg_counts[o])
+        else:
+            vals = _field_values(seg, field, masks[i], ms)
+            uniq, c = np.unique(vals, return_counts=True)
+            for v, n in zip(uniq.tolist(), c.tolist()):
+                counts[v] = counts.get(v, 0) + n
+    order_conf = conf.get("order", {"_count": "desc"})
+    (order_key, order_dir), = order_conf.items() if isinstance(order_conf, dict) else [("_count", "desc")]
+    items = list(counts.items())
+    if order_key == "_key":
+        items.sort(key=lambda kv: kv[0], reverse=(order_dir == "desc"))
+    else:
+        items.sort(key=lambda kv: (-kv[1], kv[0]) if order_dir == "desc" else (kv[1], kv[0]))
+    top = items[:size]
+    other = sum(c for _, c in items[size:])
+
+    mapper = ms.field_mapper(field)
+    is_bool = mapper is not None and mapper.type == "boolean"
+    buckets = []
+    for key, count in top:
+        bucket: dict[str, Any] = {}
+        if is_bool:
+            bucket["key"] = int(key)
+            bucket["key_as_string"] = "true" if key else "false"
+        elif isinstance(key, str):
+            bucket["key"] = key
+        else:
+            bucket["key"] = int(key) if float(key).is_integer() and not is_keyword else key
+        bucket["doc_count"] = count
+        if sub:
+            bucket_masks = _value_masks(segments, field, key, masks)
+            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn))
+        buckets.append(bucket)
+    return {
+        "doc_count_error_upper_bound": 0,
+        "sum_other_doc_count": other,
+        "buckets": buckets,
+    }
+
+
+def _value_masks(segments, field, key, masks) -> list[np.ndarray]:
+    out = []
+    for i, seg in enumerate(segments):
+        kf = seg.keyword_fields.get(field)
+        if kf is not None:
+            o = kf.ord_dict.get(key if isinstance(key, str) else str(key))
+            m = np.zeros(seg.n_docs, bool)
+            if o is not None:
+                hit_docs = kf.mv_docs[kf.mv_ords == o]
+                m[hit_docs] = True
+            out.append(masks[i] & m)
+            continue
+        nf = seg.numeric_fields.get(field)
+        if nf is not None:
+            vals = nf.values_i64 if nf.kind == "int" else nf.values_f64
+            out.append(masks[i] & nf.present & (vals == key))
+        else:
+            out.append(np.zeros(seg.n_docs, bool))
+    return out
+
+
+# -- histogram --------------------------------------------------------------
+
+_CALENDAR_UNITS = {"month", "1M", "quarter", "1q", "year", "1y"}
+
+
+def _histogram(conf, sub, segments, ms, masks, filter_fn, date: bool) -> dict:
+    field = conf["field"]
+    if date:
+        interval_conf = (
+            conf.get("fixed_interval") or conf.get("calendar_interval")
+            or conf.get("interval")
+        )
+        if interval_conf is None:
+            raise ParsingException("date_histogram requires an interval")
+        calendar = str(interval_conf) in _CALENDAR_UNITS or conf.get("calendar_interval") in _CALENDAR_UNITS
+    else:
+        interval_conf = conf["interval"]
+        calendar = False
+    offset = float(conf.get("offset", 0))
+    min_doc_count = int(conf.get("min_doc_count", 1 if not date else 0))
+
+    # collect (key -> count) and per-key masks lazily for sub-aggs
+    key_counts: dict[float, int] = {}
+    per_seg_keys: list[np.ndarray] = []   # bucket key per masked doc
+    per_seg_docs: list[np.ndarray] = []
+    for i, seg in enumerate(segments):
+        nf = seg.numeric_fields.get(field)
+        if nf is None:
+            per_seg_keys.append(np.zeros(0))
+            per_seg_docs.append(np.zeros(0, np.int64))
+            continue
+        m = masks[i] & nf.present
+        docs = np.nonzero(m)[0]
+        vals = (nf.values_i64 if nf.kind == "int" else nf.values_f64)[docs]
+        if calendar:
+            keys = _calendar_keys(vals, str(interval_conf))
+        else:
+            interval = (
+                parse_time_millis(interval_conf) if date else float(interval_conf)
+            )
+            keys = np.floor((vals.astype(np.float64) - offset) / interval) * interval + offset
+        per_seg_keys.append(keys)
+        per_seg_docs.append(docs)
+        uniq, c = np.unique(keys, return_counts=True)
+        for k_, n_ in zip(uniq.tolist(), c.tolist()):
+            key_counts[k_] = key_counts.get(k_, 0) + n_
+
+    buckets = []
+    for key in sorted(key_counts):
+        count = key_counts[key]
+        if count < min_doc_count:
+            continue
+        bucket: dict[str, Any] = {"key": int(key) if date else key, "doc_count": count}
+        if date:
+            bucket["key_as_string"] = (
+                _dt.datetime.fromtimestamp(key / 1000, _dt.timezone.utc)
+                .isoformat()
+                .replace("+00:00", "Z")
+            )
+        if sub:
+            bucket_masks = []
+            for i, seg in enumerate(segments):
+                bm = np.zeros(seg.n_docs, bool)
+                sel = per_seg_docs[i][per_seg_keys[i] == key]
+                bm[sel] = True
+                bucket_masks.append(bm)
+            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn))
+        buckets.append(bucket)
+    return {"buckets": buckets}
+
+
+def _calendar_keys(vals_ms: np.ndarray, unit: str) -> np.ndarray:
+    out = np.empty(len(vals_ms), np.float64)
+    for i, v in enumerate(vals_ms):
+        dt = _dt.datetime.fromtimestamp(float(v) / 1000, _dt.timezone.utc)
+        if unit in ("month", "1M"):
+            key_dt = dt.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+        elif unit in ("quarter", "1q"):
+            key_dt = dt.replace(
+                month=(dt.month - 1) // 3 * 3 + 1,
+                day=1, hour=0, minute=0, second=0, microsecond=0,
+            )
+        else:  # year
+            key_dt = dt.replace(month=1, day=1, hour=0, minute=0, second=0, microsecond=0)
+        out[i] = key_dt.timestamp() * 1000
+    return out
+
+
+# -- range / filter family --------------------------------------------------
+
+
+def _range_agg(conf, sub, segments, ms, masks, filter_fn) -> dict:
+    field = conf["field"]
+    ranges = conf["ranges"]
+    mapper = ms.field_mapper(field)
+    is_date = mapper is not None and mapper.type == "date"
+    buckets = []
+    for r in ranges:
+        frm = r.get("from")
+        to = r.get("to")
+        if is_date:
+            frm = parse_date_millis(frm) if frm is not None else None
+            to = parse_date_millis(to) if to is not None else None
+        count = 0
+        bucket_masks = []
+        for i, seg in enumerate(segments):
+            nf = seg.numeric_fields.get(field)
+            if nf is None:
+                bucket_masks.append(np.zeros(seg.n_docs, bool))
+                continue
+            vals = (nf.values_i64 if nf.kind == "int" else nf.values_f64)
+            m = masks[i] & nf.present
+            if frm is not None:
+                m = m & (vals >= frm)
+            if to is not None:
+                m = m & (vals < to)
+            bucket_masks.append(m)
+            count += int(m.sum())
+        key = r.get("key")
+        if key is None:
+            key = f"{frm if frm is not None else '*'}-{to if to is not None else '*'}"
+        bucket: dict[str, Any] = {"key": key, "doc_count": count}
+        if frm is not None:
+            bucket["from"] = float(frm)
+        if to is not None:
+            bucket["to"] = float(to)
+        if sub:
+            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn))
+        buckets.append(bucket)
+    return {"buckets": buckets}
+
+
+def _run_filter(filter_fn, body, segments, masks) -> list[np.ndarray]:
+    if filter_fn is None:
+        raise IllegalArgumentException("filter aggregations need a filter executor")
+    return [
+        masks[i] & filter_fn(body, i)[: seg.n_docs] for i, seg in enumerate(segments)
+    ]
+
+
+def _filter_agg(conf, sub, segments, ms, masks, filter_fn) -> dict:
+    f_masks = _run_filter(filter_fn, conf, segments, masks)
+    out = {"doc_count": int(sum(m.sum() for m in f_masks))}
+    out.update(_sub_aggs(sub, segments, ms, f_masks, filter_fn))
+    return out
+
+
+def _filters_agg(conf, sub, segments, ms, masks, filter_fn) -> dict:
+    named = conf.get("filters")
+    buckets: dict[str, Any] = {}
+    for fname, body in named.items():
+        f_masks = _run_filter(filter_fn, body, segments, masks)
+        bucket = {"doc_count": int(sum(m.sum() for m in f_masks))}
+        bucket.update(_sub_aggs(sub, segments, ms, f_masks, filter_fn))
+        buckets[fname] = bucket
+    return {"buckets": buckets}
+
+
+def _missing_agg(conf, sub, segments, ms, masks, filter_fn) -> dict:
+    field = conf["field"]
+    m_masks = []
+    for i, seg in enumerate(segments):
+        present = np.zeros(seg.n_docs, bool)
+        nf = seg.numeric_fields.get(field)
+        if nf is not None:
+            present |= nf.present
+        kf = seg.keyword_fields.get(field)
+        if kf is not None:
+            present |= kf.first_ord >= 0
+        tf = seg.text_fields.get(field)
+        if tf is not None:
+            present |= tf.doc_len > 0
+        vf = seg.vector_fields.get(field)
+        if vf is not None:
+            present |= vf.present
+        m_masks.append(masks[i] & ~present)
+    out = {"doc_count": int(sum(m.sum() for m in m_masks))}
+    out.update(_sub_aggs(sub, segments, ms, m_masks, filter_fn))
+    return out

@@ -1,0 +1,655 @@
+"""Per-shard query execution: query node tree -> device score/mask ops.
+
+The analog of the reference's per-shard query phase
+(search/query/QueryPhase.java:96 + ContextIndexSearcher.java:242 and the
+QueryBuilder.toQuery compile step): each query node is executed against each
+segment's device arrays, producing a dense (scores[n_pad] f32, mask[n_pad]
+bool) pair; composition (bool logic) is elementwise on the VPU instead of
+Lucene's doc-at-a-time conjunction/disjunction iterators.
+
+Scoring follows Lucene semantics: BM25 with shard-level stats (idf over
+summed per-segment doc freqs, avgdl over all segments — matching
+IndexSearcher collection statistics), constant 1.0*boost for filter-ish
+queries in scoring position, 0.0 scores for filter-only bools.
+
+Sort-by-field runs host-side on the exact int64/float64 host columns (device
+computes the match mask; numpy does the argsort) — exact semantics first,
+device sort keys are a later optimization. Score sort runs fully on device
+ending in lax.top_k.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ParsingException,
+)
+from opensearch_tpu.index.device import DeviceSegment
+from opensearch_tpu.index.mapper import (
+    FLOAT_TYPES,
+    INT_TYPES,
+    MapperService,
+    parse_date_millis,
+)
+from opensearch_tpu.index.engine import SearcherSnapshot
+from opensearch_tpu.index.segment import (
+    HostSegment,
+    i64_query_words,
+    pad_window,
+)
+from opensearch_tpu.ops import bm25, filters, knn
+from opensearch_tpu.search import query_dsl as q
+
+I64_MIN = -(2**63)
+I64_MAX = 2**63 - 1
+
+
+# --------------------------------------------------------------------------
+# Shard-level statistics (Lucene collection statistics analog)
+# --------------------------------------------------------------------------
+
+
+class ShardContext:
+    def __init__(self, snapshot: SearcherSnapshot, mapper_service: MapperService):
+        self.snapshot = snapshot
+        self.mapper_service = mapper_service
+
+    def text_stats(self, field: str) -> tuple[int, float]:
+        """(doc_count, avgdl) across all segments of the shard."""
+        doc_count = 0
+        total_terms = 0.0
+        for host, _ in self.snapshot.segments:
+            tf = host.text_fields.get(field)
+            if tf is not None:
+                doc_count += tf.docs_with_field
+                total_terms += tf.total_terms
+        if doc_count == 0:
+            return 0, 1.0
+        return doc_count, total_terms / doc_count
+
+    def text_df(self, field: str, term: str) -> int:
+        return sum(
+            host.text_fields[field].doc_freq(term)
+            for host, _ in self.snapshot.segments
+            if field in host.text_fields
+        )
+
+    def keyword_df(self, field: str, value: str) -> int:
+        df = 0
+        for host, _ in self.snapshot.segments:
+            kf = host.keyword_fields.get(field)
+            if kf is None:
+                continue
+            o = kf.ord_dict.get(value)
+            if o is not None:
+                df += int(np.sum(kf.mv_ords == o))
+        return df
+
+    def keyword_doc_count(self, field: str) -> int:
+        return sum(
+            int((host.keyword_fields[field].first_ord >= 0).sum())
+            for host, _ in self.snapshot.segments
+            if field in host.keyword_fields
+        )
+
+
+# --------------------------------------------------------------------------
+# Node execution against one segment
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class NodeResult:
+    scores: jnp.ndarray            # f32 [n_pad], 0 where not matching
+    mask: jnp.ndarray              # bool [n_pad]
+    scoring: bool                  # False => pure filter (score ignored)
+
+
+def _const_result(mask: jnp.ndarray, boost: float, scoring: bool) -> NodeResult:
+    scores = jnp.where(mask, jnp.float32(boost), jnp.float32(0.0))
+    return NodeResult(scores=scores, mask=mask, scoring=scoring)
+
+
+def _empty(dev: DeviceSegment) -> NodeResult:
+    z = jnp.zeros(dev.n_pad, jnp.float32)
+    return NodeResult(scores=z, mask=jnp.zeros(dev.n_pad, bool), scoring=False)
+
+
+class SegmentExecutor:
+    def __init__(self, ctx: ShardContext, host: HostSegment, dev: DeviceSegment):
+        self.ctx = ctx
+        self.host = host
+        self.dev = dev
+
+    # -- text scoring ------------------------------------------------------
+
+    def _bm25(self, field: str, terms: list[str], boost: float) -> tuple[NodeResult, jnp.ndarray]:
+        """Returns (result, per-doc matched-term counts)."""
+        dev_tf = self.dev.text_fields.get(field)
+        host_tf = self.host.text_fields.get(field)
+        if dev_tf is None or host_tf is None or not terms:
+            return _empty(self.dev), jnp.zeros(self.dev.n_pad, jnp.int32)
+        doc_count, avgdl = self.ctx.text_stats(field)
+        offs, lens, idfs = [], [], []
+        for t in terms:
+            tid = host_tf.term_dict.get(t)
+            if tid is None:
+                offs.append(0)
+                lens.append(0)
+                idfs.append(0.0)
+            else:
+                offs.append(int(host_tf.term_offsets[tid]))
+                lens.append(int(host_tf.term_offsets[tid + 1] - host_tf.term_offsets[tid]))
+                idfs.append(bm25.idf(self.ctx.text_df(field, t), doc_count))
+        window = pad_window(max(lens) if lens else 1)
+        scores, counts = bm25.bm25_term_scores(
+            dev_tf.postings_docs,
+            dev_tf.postings_tfs,
+            dev_tf.doc_len,
+            jnp.asarray(offs, jnp.int32),
+            jnp.asarray(lens, jnp.int32),
+            jnp.asarray(idfs, jnp.float32),
+            jnp.float32(avgdl),
+            n_pad=self.dev.n_pad,
+            window=window,
+        )
+        mask = counts > 0
+        return NodeResult(scores=scores * boost, mask=mask, scoring=True), counts
+
+    # -- dispatch ----------------------------------------------------------
+
+    def execute(self, node: q.QueryNode) -> NodeResult:
+        method = getattr(self, f"_exec_{type(node).__name__}", None)
+        if method is None:
+            raise ParsingException(f"unexecutable query node [{type(node).__name__}]")
+        return method(node)
+
+    def _exec_MatchAllQuery(self, node: q.MatchAllQuery) -> NodeResult:
+        return _const_result(self.dev.live, node.boost, scoring=True)
+
+    def _exec_MatchNoneQuery(self, node: q.MatchNoneQuery) -> NodeResult:
+        return _empty(self.dev)
+
+    def _exec_MatchQuery(self, node: q.MatchQuery) -> NodeResult:
+        mapper = self.ctx.mapper_service.field_mapper(node.field)
+        if mapper is not None and mapper.type != "text":
+            # match on non-text behaves like a term query (no analysis)
+            return self._exec_TermQuery(
+                q.TermQuery(field=node.field, value=node.query, boost=node.boost)
+            )
+        terms = self.ctx.mapper_service.analyze_query_text(node.field, node.query)
+        if not terms:
+            # zero analyzed tokens (e.g. all stopwords) matches nothing,
+            # like the reference's MatchNoDocsQuery rewrite
+            return _empty(self.dev)
+        result, counts = self._bm25(node.field, terms, node.boost)
+        if node.operator == "and":
+            result = NodeResult(
+                scores=result.scores, mask=counts >= len(terms), scoring=True
+            )
+        elif node.minimum_should_match is not None:
+            result = NodeResult(
+                scores=result.scores,
+                mask=counts >= node.minimum_should_match,
+                scoring=True,
+            )
+        return NodeResult(result.scores, result.mask & self.dev.live, True)
+
+    def _exec_MatchPhraseQuery(self, node: q.MatchPhraseQuery) -> NodeResult:
+        # Position-less approximation: conjunction of all terms (real phrase
+        # matching needs position postings — planned; reference:
+        # MatchPhraseQueryBuilder -> Lucene PhraseQuery).
+        terms = self.ctx.mapper_service.analyze_query_text(node.field, node.query)
+        if not terms:
+            return _empty(self.dev)
+        result, counts = self._bm25(node.field, terms, node.boost)
+        return NodeResult(result.scores, (counts >= len(terms)) & self.dev.live, True)
+
+    def _exec_MultiMatchQuery(self, node: q.MultiMatchQuery) -> NodeResult:
+        subs = [
+            self._exec_MatchQuery(q.MatchQuery(field=f, query=node.query, boost=node.boost))
+            for f in node.fields
+        ]
+        if not subs:
+            return _empty(self.dev)
+        mask = subs[0].mask
+        for s in subs[1:]:
+            mask = mask | s.mask
+        if node.type == "most_fields":
+            scores = sum((s.scores for s in subs[1:]), subs[0].scores)
+        else:  # best_fields: max over fields
+            scores = subs[0].scores
+            for s in subs[1:]:
+                scores = jnp.maximum(scores, s.scores)
+        return NodeResult(scores=scores, mask=mask, scoring=True)
+
+    def _exec_TermQuery(self, node: q.TermQuery) -> NodeResult:
+        field, value = node.field, node.value
+        mapper = self.ctx.mapper_service.field_mapper(field)
+        ftype = mapper.type if mapper else None
+        if ftype == "text":
+            result, _counts = self._bm25(field, [str(value)], node.boost)
+            return NodeResult(result.scores, result.mask & self.dev.live, True)
+        if ftype == "keyword" or (ftype is None and field in self.host.keyword_fields):
+            kf_dev = self.dev.keyword_fields.get(field)
+            kf_host = self.host.keyword_fields.get(field)
+            if kf_dev is None:
+                return _empty(self.dev)
+            qord = kf_host.ord_dict.get(str(value), -3)
+            mask = filters.term_mask_keyword(
+                kf_dev.mv_ords, kf_dev.mv_docs, jnp.int32(qord), self.dev.n_pad
+            ) & self.dev.live
+            # keyword term scoring: norms omitted -> idf * tf/(tf+k1), tf=1
+            df = self.ctx.keyword_df(field, str(value))
+            doc_count = max(self.ctx.keyword_doc_count(field), 1)
+            score = bm25.idf(df, doc_count) / (1.0 + bm25.K1_DEFAULT) if df else 0.0
+            return _const_result(mask, score * node.boost, scoring=True)
+        if ftype in ("boolean",):
+            want = 1 if value in (True, "true", 1) else 0
+            return self._numeric_range(field, want, None, want, None, node.boost)
+        if ftype == "date":
+            ms = parse_date_millis(value)
+            return self._numeric_range(field, ms, None, ms, None, node.boost)
+        if ftype in INT_TYPES or ftype in FLOAT_TYPES or ftype is None:
+            return self._numeric_range(field, value, None, value, None, node.boost)
+        raise IllegalArgumentException(f"term query on unsupported field [{field}]")
+
+    def _exec_TermsQuery(self, node: q.TermsQuery) -> NodeResult:
+        mapper = self.ctx.mapper_service.field_mapper(node.field)
+        ftype = mapper.type if mapper else None
+        if ftype == "keyword":
+            kf_dev = self.dev.keyword_fields.get(node.field)
+            kf_host = self.host.keyword_fields.get(node.field)
+            if kf_dev is None:
+                return _empty(self.dev)
+            ords = [kf_host.ord_dict.get(str(v), -3) for v in node.values]
+            t_pad = max(pad_window(len(ords)), 8)
+            ords_arr = np.full(t_pad, -3, np.int32)
+            ords_arr[: len(ords)] = ords
+            mask = filters.terms_mask_keyword(
+                kf_dev.mv_ords, kf_dev.mv_docs, jnp.asarray(ords_arr), self.dev.n_pad
+            ) & self.dev.live
+            return _const_result(mask, node.boost, scoring=True)
+        # numeric/text fallback: OR of term queries
+        out: NodeResult | None = None
+        for v in node.values:
+            r = self._exec_TermQuery(q.TermQuery(field=node.field, value=v, boost=node.boost))
+            out = r if out is None else NodeResult(
+                jnp.maximum(out.scores, r.scores), out.mask | r.mask, True
+            )
+        return out if out is not None else _empty(self.dev)
+
+    def _numeric_range(
+        self, field: str, gte: Any, gt: Any, lte: Any, lt: Any, boost: float
+    ) -> NodeResult:
+        nf_dev = self.dev.numeric_fields.get(field)
+        nf_host = self.host.numeric_fields.get(field)
+        if nf_dev is None:
+            return _empty(self.dev)
+        mapper = self.ctx.mapper_service.field_mapper(field)
+        is_date = mapper is not None and mapper.type == "date"
+
+        def conv(v: Any) -> Any:
+            if v is None:
+                return None
+            return parse_date_millis(v) if is_date else v
+
+        gte, gt, lte, lt = conv(gte), conv(gt), conv(lte), conv(lt)
+        if nf_dev.kind == "int":
+            lo_bound = I64_MIN if gte is None and gt is None else (
+                int(gte) if gte is not None else int(gt) + 1
+            )
+            hi_bound = I64_MAX if lte is None and lt is None else (
+                int(lte) if lte is not None else int(lt) - 1
+            )
+            ghi, glo = i64_query_words(lo_bound)
+            lhi, llo = i64_query_words(hi_bound)
+            mask = filters.range_mask_i64(
+                nf_dev.hi, nf_dev.lo, nf_dev.present,
+                jnp.int32(ghi), jnp.int32(glo), jnp.int32(lhi), jnp.int32(llo),
+            )
+        else:
+            lo_v = float(gte) if gte is not None else (float(gt) if gt is not None else -np.inf)
+            hi_v = float(lte) if lte is not None else (float(lt) if lt is not None else np.inf)
+            mask = filters.range_mask_f32(
+                nf_dev.values, nf_dev.present,
+                jnp.float32(lo_v), jnp.float32(hi_v),
+                jnp.asarray(gt is not None), jnp.asarray(lt is not None),
+            )
+        return _const_result(mask & self.dev.live, boost, scoring=True)
+
+    def _exec_RangeQuery(self, node: q.RangeQuery) -> NodeResult:
+        mapper = self.ctx.mapper_service.field_mapper(node.field)
+        if mapper is not None and mapper.type == "keyword":
+            # lexicographic range over ordinals (ordinals are sorted)
+            kf_host = self.host.keyword_fields.get(node.field)
+            kf_dev = self.dev.keyword_fields.get(node.field)
+            if kf_host is None:
+                return _empty(self.dev)
+            import bisect
+
+            vals = kf_host.ord_values
+            lo = 0
+            hi = len(vals) - 1
+            if node.gte is not None:
+                lo = bisect.bisect_left(vals, str(node.gte))
+            if node.gt is not None:
+                lo = max(lo, bisect.bisect_right(vals, str(node.gt)))
+            if node.lte is not None:
+                hi = bisect.bisect_right(vals, str(node.lte)) - 1
+            if node.lt is not None:
+                hi = min(hi, bisect.bisect_left(vals, str(node.lt)) - 1)
+            if hi < lo:
+                return _empty(self.dev)
+            in_range = (kf_dev.mv_ords >= lo) & (kf_dev.mv_ords <= hi)
+            mask = (
+                jnp.zeros(self.dev.n_pad, jnp.int32)
+                .at[kf_dev.mv_docs]
+                .max(in_range.astype(jnp.int32))
+                .astype(bool)
+                & self.dev.live
+            )
+            return _const_result(mask, node.boost, scoring=True)
+        return self._exec_range_numeric(node)
+
+    def _exec_range_numeric(self, node: q.RangeQuery) -> NodeResult:
+        return self._numeric_range(node.field, node.gte, node.gt, node.lte, node.lt, node.boost)
+
+    def _exec_ExistsQuery(self, node: q.ExistsQuery) -> NodeResult:
+        field = node.field
+        masks = []
+        if field in self.dev.numeric_fields:
+            masks.append(self.dev.numeric_fields[field].present)
+        if field in self.dev.vector_fields:
+            masks.append(self.dev.vector_fields[field].present)
+        if field in self.dev.keyword_fields:
+            masks.append(self.dev.keyword_fields[field].first_ord >= 0)
+        if field in self.dev.text_fields:
+            masks.append(self.dev.text_fields[field].doc_len > 0)
+        if not masks:
+            return _empty(self.dev)
+        mask = masks[0]
+        for m in masks[1:]:
+            mask = mask | m
+        return _const_result(mask & self.dev.live, node.boost, scoring=True)
+
+    def _exec_IdsQuery(self, node: q.IdsQuery) -> NodeResult:
+        mask_host = np.zeros(self.dev.n_pad, dtype=bool)
+        for doc_id in node.values:
+            d = self.host.local_doc(doc_id)
+            if d is not None:
+                mask_host[d] = True
+        return _const_result(jnp.asarray(mask_host) & self.dev.live, node.boost, True)
+
+    def _exec_ConstantScoreQuery(self, node: q.ConstantScoreQuery) -> NodeResult:
+        inner = self.execute(node.filter)
+        return _const_result(inner.mask, node.boost, scoring=True)
+
+    def _exec_BoolQuery(self, node: q.BoolQuery) -> NodeResult:
+        n_pad = self.dev.n_pad
+        mask = self.dev.live
+        scores = jnp.zeros(n_pad, jnp.float32)
+        any_scoring = False
+        for sub in node.must:
+            r = self.execute(sub)
+            mask = mask & r.mask
+            if r.scoring:
+                any_scoring = True
+            scores = scores + r.scores
+        for sub in node.filter:
+            r = self.execute(sub)
+            mask = mask & r.mask
+        for sub in node.must_not:
+            r = self.execute(sub)
+            mask = mask & ~r.mask
+        if node.should:
+            should_results = [self.execute(sub) for sub in node.should]
+            should_count = jnp.zeros(n_pad, jnp.int32)
+            for r in should_results:
+                should_count = should_count + r.mask.astype(jnp.int32)
+                scores = scores + jnp.where(r.mask, r.scores, 0.0)
+                if r.scoring:
+                    any_scoring = True
+            msm = node.minimum_should_match
+            if msm is None:
+                msm = 1 if not (node.must or node.filter) else 0
+            if msm > 0:
+                mask = mask & (should_count >= msm)
+        # scores of non-matching docs must be zeroed (a must_not can strike
+        # a doc that a should scored)
+        scores = jnp.where(mask, scores, 0.0) * node.boost
+        return NodeResult(scores=scores, mask=mask, scoring=any_scoring)
+
+    def _exec_KnnQuery(self, node: q.KnnQuery) -> NodeResult:
+        vf = self.dev.vector_fields.get(node.field)
+        if vf is None:
+            return _empty(self.dev)
+        valid = vf.present & self.dev.live
+        if node.filter is not None:
+            valid = valid & self.execute(node.filter).mask
+        qv = jnp.asarray([node.vector], jnp.float32)
+        scores = knn.exact_knn_scores(qv, vf.vectors, vf.norms_sq, valid, vf.similarity)[0]
+        k = min(node.k, self.dev.n_pad)
+        top_vals, top_ids = jax.lax.top_k(scores, k)
+        sel = jnp.zeros(self.dev.n_pad, bool).at[top_ids].set(jnp.isfinite(top_vals))
+        out_scores = jnp.where(sel, jnp.where(jnp.isfinite(scores), scores, 0.0), 0.0)
+        return NodeResult(scores=out_scores * node.boost, mask=sel, scoring=True)
+
+    def _exec_ScriptScoreQuery(self, node: q.ScriptScoreQuery) -> NodeResult:
+        inner = self.execute(node.query) if node.query else self._exec_MatchAllQuery(q.MatchAllQuery())
+        vf = self.dev.vector_fields.get(node.field)
+        if vf is None:
+            return _empty(self.dev)
+        valid = vf.present & inner.mask
+        qv = jnp.asarray([node.query_vector], jnp.float32)
+        if node.function == "knn_score":
+            scores = knn.exact_knn_scores(qv, vf.vectors, vf.norms_sq, valid, node.space_type)[0]
+            scores = jnp.where(valid, scores, 0.0)
+        else:
+            raw = knn.raw_similarity(
+                qv, vf.vectors, vf.norms_sq,
+                "l2_norm" if node.space_type == "l2_raw" else node.space_type,
+            )[0]
+            if node.space_type == "l2_raw":
+                raw = jnp.maximum(-raw, 0.0)  # l2Squared returns the distance
+            scores = jnp.where(valid, raw + node.add_constant, 0.0)
+        return NodeResult(scores=scores * node.boost, mask=valid, scoring=True)
+
+
+# --------------------------------------------------------------------------
+# Shard-level query phase
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ShardHit:
+    score: float
+    segment: int          # index into snapshot.segments
+    doc: int              # local doc id
+    sort_values: list = dc_field(default_factory=list)
+
+
+@dataclass
+class ShardQueryResult:
+    hits: list[ShardHit]
+    total: int
+    max_score: float | None
+    # per-segment match masks (host bool arrays) for the aggs phase
+    masks: list[np.ndarray] = dc_field(default_factory=list)
+
+
+def execute_query_phase(
+    snapshot: SearcherSnapshot,
+    mapper_service: MapperService,
+    query_node: q.QueryNode,
+    size: int,
+    sort: list[dict] | None = None,
+    need_masks: bool = False,
+    track_total_hits: bool | int = True,
+    min_score: float | None = None,
+) -> ShardQueryResult:
+    ctx = ShardContext(snapshot, mapper_service)
+    masks: list[np.ndarray] = []
+    total = 0
+    max_score: float | None = None
+    all_hits: list[ShardHit] = []
+
+    for seg_idx, (host, dev) in enumerate(snapshot.segments):
+        ex = SegmentExecutor(ctx, host, dev)
+        result = ex.execute(query_node)
+        mask = result.mask & dev.live
+        if min_score is not None:
+            # min_score excludes docs from hits AND total (reference:
+            # QueryPhase applies MinScoreCollectorContext before counting)
+            mask = mask & (result.scores >= jnp.float32(min_score))
+        mask_host = np.asarray(mask)[: host.n_docs]
+        if need_masks:
+            masks.append(mask_host)
+        total += int(mask_host.sum())
+        if size > 0:
+            if not sort:
+                k = min(size, dev.n_pad)
+                masked = jnp.where(mask, result.scores, -jnp.inf)
+                vals, ids = jax.lax.top_k(masked, k)
+                vals_h, ids_h = np.asarray(vals), np.asarray(ids)
+                for v, d in zip(vals_h, ids_h):
+                    if np.isfinite(v):
+                        all_hits.append(ShardHit(float(v), seg_idx, int(d)))
+                        if max_score is None or v > max_score:
+                            max_score = float(v)
+            else:
+                scores_h = np.asarray(result.scores)[: host.n_docs]
+                all_hits.extend(
+                    _sorted_segment_hits(
+                        host, mask_host, scores_h, sort, size, seg_idx, mapper_service
+                    )
+                )
+
+    if not sort:
+        all_hits.sort(key=lambda h: (-h.score, h.segment, h.doc))
+        all_hits = all_hits[:size]
+    else:
+        keys = _sort_key_fn(sort)
+        all_hits.sort(key=keys)
+        all_hits = all_hits[:size]
+        if all_hits and max_score is None:
+            max_score = None
+    return ShardQueryResult(hits=all_hits, total=total, max_score=max_score, masks=masks)
+
+
+def _field_sort_values(
+    host: HostSegment, field: str, docs: np.ndarray, mapper_service: MapperService
+) -> tuple[np.ndarray, np.ndarray]:
+    """(values float64/int64, present bool) for the requested docs."""
+    nf = host.numeric_fields.get(field)
+    if nf is not None:
+        vals = nf.values_i64 if nf.kind == "int" else nf.values_f64
+        return vals[docs], nf.present[docs]
+    kf = host.keyword_fields.get(field)
+    if kf is not None:
+        # ordinal sort within a segment is NOT globally consistent across
+        # segments; use the string values for cross-segment correctness
+        ords = kf.first_ord[docs]
+        return ords, ords >= 0
+    raise IllegalArgumentException(f"no sortable field [{field}]")
+
+
+def _sorted_segment_hits(
+    host: HostSegment,
+    mask: np.ndarray,
+    scores: np.ndarray,
+    sort: list[dict],
+    size: int,
+    seg_idx: int,
+    mapper_service: MapperService,
+) -> list[ShardHit]:
+    docs = np.nonzero(mask)[0]
+    if len(docs) == 0:
+        return []
+    hits = []
+    sort_cols = []
+    for spec in sort:
+        fname, order, _missing = _sort_spec(spec)
+        if fname == "_score":
+            sort_cols.append((scores[docs], np.ones(len(docs), bool), order, None))
+        elif fname == "_doc":
+            sort_cols.append((docs.astype(np.float64), np.ones(len(docs), bool), order, None))
+        else:
+            vals, present = _field_sort_values(host, fname, docs, mapper_service)
+            kf = host.keyword_fields.get(fname)
+            sort_cols.append((vals, present, order, kf.ord_values if kf is not None else None))
+    for i, d in enumerate(docs):
+        sv = []
+        for vals, present, order, ord_values in sort_cols:
+            if not present[i]:
+                sv.append(None)
+            elif ord_values is not None:
+                sv.append(ord_values[int(vals[i])])
+            else:
+                v = vals[i]
+                sv.append(int(v) if isinstance(v, (np.integer,)) else float(v))
+        hits.append(ShardHit(float(scores[d]), seg_idx, int(d), sort_values=sv))
+    keys = _sort_key_fn(sort)
+    hits.sort(key=keys)
+    return hits[:size]
+
+
+def _sort_spec(spec: dict | str) -> tuple[str, str, Any]:
+    if isinstance(spec, str):
+        return spec, ("desc" if spec == "_score" else "asc"), None
+    if len(spec) != 1:
+        raise ParsingException("each sort entry must have a single field")
+    fname, conf = next(iter(spec.items()))
+    if isinstance(conf, str):
+        return fname, conf, None
+    return fname, conf.get("order", "desc" if fname == "_score" else "asc"), conf.get("missing")
+
+
+def _sort_key_fn(sort: list[dict]):
+    specs = [_sort_spec(s) for s in sort]
+
+    def key(hit: ShardHit):
+        parts = []
+        for i, (fname, order, _missing) in enumerate(specs):
+            if fname == "_score":
+                v = hit.score
+                parts.append(-v if order == "desc" else v)
+                continue
+            if fname == "_doc":
+                parts.append((hit.segment, hit.doc) if order == "asc" else (-hit.segment, -hit.doc))
+                continue
+            v = hit.sort_values[i] if i < len(hit.sort_values) else None
+            if v is None:
+                # missing sorts last in asc, last in desc (OpenSearch: _last default)
+                parts.append((1, 0))
+            elif isinstance(v, str):
+                # invert strings for desc via codepoint complement is messy;
+                # handled by sorting twice is worse — use tuple trick
+                parts.append((0, _StrKey(v, order == "desc")))
+            else:
+                parts.append((0, -v if order == "desc" else v))
+        parts.append((hit.segment, hit.doc))
+        return tuple(parts)
+
+    return key
+
+
+class _StrKey:
+    __slots__ = ("v", "desc")
+
+    def __init__(self, v: str, desc: bool):
+        self.v = v
+        self.desc = desc
+
+    def __lt__(self, other: "_StrKey") -> bool:
+        return (self.v > other.v) if self.desc else (self.v < other.v)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, _StrKey) and self.v == other.v

@@ -1,0 +1,348 @@
+"""Query DSL: JSON -> query node tree.
+
+The analog of the reference's 86 QueryBuilder classes + parsing
+(server/src/main/java/org/opensearch/index/query/ — AbstractQueryBuilder,
+QueryShardContext): `parse_query` turns the JSON DSL into a typed node tree;
+opensearch_tpu/search/executor.py compiles nodes against a segment into
+device score/mask ops (the `toQuery(QueryShardContext)` step).
+
+Supported (growing set): match_all, match_none, match, multi_match, term,
+terms, range, exists, ids, bool, constant_score, boost on all nodes,
+match_phrase (position-less approximation: all terms must match), knn,
+script_score (k-NN script patterns), function_score (subset).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any
+
+from opensearch_tpu.common.errors import ParsingException
+
+
+@dataclass
+class QueryNode:
+    boost: float = 1.0
+
+
+@dataclass
+class MatchAllQuery(QueryNode):
+    pass
+
+
+@dataclass
+class MatchNoneQuery(QueryNode):
+    pass
+
+
+@dataclass
+class MatchQuery(QueryNode):
+    field: str = ""
+    query: str = ""
+    operator: str = "or"          # or | and
+    minimum_should_match: int | None = None
+
+
+@dataclass
+class MatchPhraseQuery(QueryNode):
+    field: str = ""
+    query: str = ""
+
+
+@dataclass
+class MultiMatchQuery(QueryNode):
+    fields: list[str] = dc_field(default_factory=list)
+    query: str = ""
+    type: str = "best_fields"     # best_fields | most_fields
+
+
+@dataclass
+class TermQuery(QueryNode):
+    field: str = ""
+    value: Any = None
+
+
+@dataclass
+class TermsQuery(QueryNode):
+    field: str = ""
+    values: list[Any] = dc_field(default_factory=list)
+
+
+@dataclass
+class RangeQuery(QueryNode):
+    field: str = ""
+    gte: Any = None
+    gt: Any = None
+    lte: Any = None
+    lt: Any = None
+
+
+@dataclass
+class ExistsQuery(QueryNode):
+    field: str = ""
+
+
+@dataclass
+class IdsQuery(QueryNode):
+    values: list[str] = dc_field(default_factory=list)
+
+
+@dataclass
+class BoolQuery(QueryNode):
+    must: list[QueryNode] = dc_field(default_factory=list)
+    should: list[QueryNode] = dc_field(default_factory=list)
+    filter: list[QueryNode] = dc_field(default_factory=list)
+    must_not: list[QueryNode] = dc_field(default_factory=list)
+    minimum_should_match: int | None = None
+
+
+@dataclass
+class ConstantScoreQuery(QueryNode):
+    filter: QueryNode | None = None
+
+
+@dataclass
+class KnnQuery(QueryNode):
+    field: str = ""
+    vector: list[float] = dc_field(default_factory=list)
+    k: int = 10
+    filter: QueryNode | None = None
+
+
+@dataclass
+class ScriptScoreQuery(QueryNode):
+    query: QueryNode | None = None
+    # recognized vector scoring functions (the k-NN plugin script patterns)
+    function: str = ""            # knn_score | cosineSimilarity | dotProduct | l2Squared
+    field: str = ""
+    query_vector: list[float] = dc_field(default_factory=list)
+    space_type: str = "l2"
+    add_constant: float = 0.0     # e.g. "cosineSimilarity(...) + 1.0"
+
+
+def _single_kv(body: dict, name: str) -> tuple[str, Any]:
+    if not isinstance(body, dict) or len(body) != 1:
+        raise ParsingException(f"[{name}] query must have a single field")
+    return next(iter(body.items()))
+
+
+def parse_query(body: dict | None) -> QueryNode:
+    if body is None:
+        return MatchAllQuery()
+    if not isinstance(body, dict) or len(body) != 1:
+        raise ParsingException(
+            "query must be an object with a single top-level key, got "
+            f"{list(body) if isinstance(body, dict) else type(body).__name__}"
+        )
+    qtype, qbody = next(iter(body.items()))
+    parser = _PARSERS.get(qtype)
+    if parser is None:
+        raise ParsingException(f"unknown query [{qtype}]")
+    if not isinstance(qbody, dict):
+        raise ParsingException(
+            f"[{qtype}] query malformed, expected an object but got "
+            f"[{type(qbody).__name__}]"
+        )
+    return parser(qbody)
+
+
+def _parse_match_all(body: dict) -> QueryNode:
+    return MatchAllQuery(boost=float(body.get("boost", 1.0)))
+
+
+def _parse_match_none(_body: dict) -> QueryNode:
+    return MatchNoneQuery()
+
+
+def _parse_match(body: dict) -> QueryNode:
+    fname, conf = _single_kv(body, "match")
+    if isinstance(conf, dict):
+        return MatchQuery(
+            field=fname,
+            query=str(conf.get("query", "")),
+            operator=str(conf.get("operator", "or")).lower(),
+            minimum_should_match=_parse_msm(conf.get("minimum_should_match")),
+            boost=float(conf.get("boost", 1.0)),
+        )
+    return MatchQuery(field=fname, query=str(conf))
+
+
+def _parse_match_phrase(body: dict) -> QueryNode:
+    fname, conf = _single_kv(body, "match_phrase")
+    if isinstance(conf, dict):
+        return MatchPhraseQuery(field=fname, query=str(conf.get("query", "")),
+                                boost=float(conf.get("boost", 1.0)))
+    return MatchPhraseQuery(field=fname, query=str(conf))
+
+
+def _parse_multi_match(body: dict) -> QueryNode:
+    return MultiMatchQuery(
+        fields=[f.split("^")[0] for f in body.get("fields", [])],
+        query=str(body.get("query", "")),
+        type=body.get("type", "best_fields"),
+        boost=float(body.get("boost", 1.0)),
+    )
+
+
+def _parse_term(body: dict) -> QueryNode:
+    fname, conf = _single_kv(body, "term")
+    if isinstance(conf, dict):
+        return TermQuery(field=fname, value=conf.get("value"),
+                         boost=float(conf.get("boost", 1.0)))
+    return TermQuery(field=fname, value=conf)
+
+
+def _parse_terms(body: dict) -> QueryNode:
+    body = dict(body)
+    boost = float(body.pop("boost", 1.0))
+    if len(body) != 1:
+        raise ParsingException("[terms] query must have a single field")
+    fname, values = next(iter(body.items()))
+    if not isinstance(values, list):
+        raise ParsingException("[terms] query values must be an array")
+    return TermsQuery(field=fname, values=values, boost=boost)
+
+
+def _parse_range(body: dict) -> QueryNode:
+    fname, conf = _single_kv(body, "range")
+    if not isinstance(conf, dict):
+        raise ParsingException("[range] body must be an object")
+    known = {"gte", "gt", "lte", "lt", "boost", "format", "time_zone", "relation",
+             "from", "to", "include_lower", "include_upper"}
+    unknown = set(conf) - known
+    if unknown:
+        raise ParsingException(f"[range] unknown options {sorted(unknown)}")
+    gte, gt, lte, lt = conf.get("gte"), conf.get("gt"), conf.get("lte"), conf.get("lt")
+    # legacy from/to form
+    if "from" in conf:
+        if conf.get("include_lower", True):
+            gte = conf["from"]
+        else:
+            gt = conf["from"]
+    if "to" in conf:
+        if conf.get("include_upper", True):
+            lte = conf["to"]
+        else:
+            lt = conf["to"]
+    return RangeQuery(field=fname, gte=gte, gt=gt, lte=lte, lt=lt,
+                      boost=float(conf.get("boost", 1.0)))
+
+
+def _parse_exists(body: dict) -> QueryNode:
+    return ExistsQuery(field=str(body["field"]), boost=float(body.get("boost", 1.0)))
+
+
+def _parse_ids(body: dict) -> QueryNode:
+    return IdsQuery(values=[str(v) for v in body.get("values", [])])
+
+
+def _parse_msm(v: Any) -> int | None:
+    if v is None:
+        return None
+    s = str(v)
+    if s.endswith("%"):
+        raise ParsingException("percentage minimum_should_match not yet supported")
+    return int(s)
+
+
+def _as_list(v: Any) -> list:
+    return v if isinstance(v, list) else [v]
+
+
+def _parse_bool(body: dict) -> QueryNode:
+    return BoolQuery(
+        must=[parse_query(q) for q in _as_list(body.get("must", []))],
+        should=[parse_query(q) for q in _as_list(body.get("should", []))],
+        filter=[parse_query(q) for q in _as_list(body.get("filter", []))],
+        must_not=[parse_query(q) for q in _as_list(body.get("must_not", []))],
+        minimum_should_match=_parse_msm(body.get("minimum_should_match")),
+        boost=float(body.get("boost", 1.0)),
+    )
+
+
+def _parse_constant_score(body: dict) -> QueryNode:
+    return ConstantScoreQuery(
+        filter=parse_query(body.get("filter")),
+        boost=float(body.get("boost", 1.0)),
+    )
+
+
+def _parse_knn(body: dict) -> QueryNode:
+    fname, conf = _single_kv(body, "knn")
+    if not isinstance(conf, dict) or "vector" not in conf:
+        raise ParsingException("[knn] requires {field: {vector: [...], k: N}}")
+    filt = conf.get("filter")
+    return KnnQuery(
+        field=fname,
+        vector=[float(x) for x in conf["vector"]],
+        k=int(conf.get("k", 10)),
+        filter=parse_query(filt) if filt else None,
+        boost=float(conf.get("boost", 1.0)),
+    )
+
+
+_VECTOR_FUNCS = ("cosineSimilarity", "dotProduct", "l2Squared", "knn_score")
+
+
+def _parse_script_score(body: dict) -> QueryNode:
+    inner = parse_query(body.get("query"))
+    script = body.get("script") or {}
+    source = script.get("source", "")
+    params = script.get("params") or {}
+    if source == "knn_score":
+        # legacy k-NN plugin script: params {field, query_value, space_type}
+        return ScriptScoreQuery(
+            query=inner,
+            function="knn_score",
+            field=str(params.get("field", "")),
+            query_vector=[float(x) for x in params.get("query_value", [])],
+            space_type=params.get("space_type", "l2"),
+            boost=float(body.get("boost", 1.0)),
+        )
+    for fn in _VECTOR_FUNCS:
+        if fn in source:
+            # e.g. "cosineSimilarity(params.query_vector, doc['vec']) + 1.0"
+            import re
+
+            m = re.search(
+                rf"{fn}\(\s*params\.(\w+)\s*,\s*doc\[['\"]([\w.]+)['\"]\]\s*\)"
+                r"(?:\s*\+\s*([0-9.]+))?",
+                source,
+            )
+            if not m:
+                raise ParsingException(f"unsupported script_score source [{source}]")
+            pname, fieldname, const = m.groups()
+            if pname not in params:
+                raise ParsingException(f"missing script param [{pname}]")
+            space = {"cosineSimilarity": "cosine", "dotProduct": "dot_product",
+                     "l2Squared": "l2_raw"}[fn] if fn != "knn_score" else "l2"
+            return ScriptScoreQuery(
+                query=inner,
+                function=fn,
+                field=fieldname,
+                query_vector=[float(x) for x in params[pname]],
+                space_type=space,
+                add_constant=float(const) if const else 0.0,
+                boost=float(body.get("boost", 1.0)),
+            )
+    raise ParsingException(
+        f"script_score supports vector functions {_VECTOR_FUNCS}, got [{source}]"
+    )
+
+
+_PARSERS = {
+    "match_all": _parse_match_all,
+    "match_none": _parse_match_none,
+    "match": _parse_match,
+    "match_phrase": _parse_match_phrase,
+    "multi_match": _parse_multi_match,
+    "term": _parse_term,
+    "terms": _parse_terms,
+    "range": _parse_range,
+    "exists": _parse_exists,
+    "ids": _parse_ids,
+    "bool": _parse_bool,
+    "constant_score": _parse_constant_score,
+    "knn": _parse_knn,
+    "script_score": _parse_script_score,
+}

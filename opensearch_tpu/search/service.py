@@ -1,0 +1,282 @@
+"""Search service: query phase -> reduce -> fetch phase -> response.
+
+The single-host analog of the coordinator pipeline (SURVEY.md §3.2):
+TransportSearchAction fan-out → per-shard QueryPhase →
+SearchPhaseController.reducedQueryPhase (merge top docs + aggs) →
+FetchSearchPhase (fetch only winning doc ids) → final SearchResponse merge.
+
+Here the per-shard query phase runs the device executor; the reduce is a
+host merge with the exact OpenSearch tie-break (score desc, shard asc, doc
+asc); aggregations reduce across all shards' segments in one pass. The
+multi-chip path (parallel/) replaces the host merge with an on-device
+all_gather + top_k over the mesh.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import time
+from typing import Any
+
+import numpy as np
+
+from opensearch_tpu.common.errors import ParsingException
+from opensearch_tpu.index.shard import IndexShard
+from opensearch_tpu.search import query_dsl
+from opensearch_tpu.search.aggs import compute_aggs
+from opensearch_tpu.search.executor import (
+    SegmentExecutor,
+    ShardContext,
+    _sort_key_fn,
+    _sort_spec,
+    _StrKey,
+    execute_query_phase,
+)
+
+DEFAULT_SIZE = 10
+
+
+def search(
+    shards: list[IndexShard],
+    body: dict | None,
+    index_name: str,
+) -> dict[str, Any]:
+    t0 = time.monotonic()
+    body = body or {}
+    known_keys = {
+        "query", "size", "from", "sort", "_source", "aggs", "aggregations",
+        "track_total_hits", "min_score", "search_after", "timeout", "version",
+        "seq_no_primary_term", "stored_fields", "explain", "highlight",
+    }
+    unknown = set(body) - known_keys
+    if unknown:
+        raise ParsingException(f"unknown search request keys {sorted(unknown)}")
+
+    node = query_dsl.parse_query(body.get("query"))
+    size = int(body.get("size", DEFAULT_SIZE))
+    from_ = int(body.get("from", 0))
+    sort = body.get("sort")
+    if isinstance(sort, (str, dict)):
+        sort = [sort]
+    aggs_body = body.get("aggs") or body.get("aggregations")
+    min_score = body.get("min_score")
+    search_after = body.get("search_after")
+    if search_after is not None and not sort:
+        raise ParsingException("[search_after] requires [sort] to be set")
+
+    fetch_k = from_ + size
+    per_shard_results = []
+    for shard in shards:
+        snapshot = shard.acquire_searcher()
+        per_shard_results.append(
+            (
+                shard,
+                snapshot,
+                execute_query_phase(
+                    snapshot,
+                    shard.mapper_service,
+                    node,
+                    # search_after cursors can reach arbitrarily deep into a
+                    # shard; fall back to all matching docs per shard
+                    size=snapshot.max_doc if search_after is not None else fetch_k,
+                    sort=sort,
+                    need_masks=aggs_body is not None,
+                    min_score=float(min_score) if min_score is not None else None,
+                ),
+            )
+        )
+
+    # ---- reduce phase (SearchPhaseController analog) ----
+    merged = []
+    total = 0
+    max_score = None
+    for shard_idx, (shard, snapshot, result) in enumerate(per_shard_results):
+        total += result.total
+        if result.max_score is not None and (
+            max_score is None or result.max_score > max_score
+        ):
+            max_score = result.max_score
+        for h in result.hits:
+            merged.append((shard_idx, h))
+    if not sort:
+        merged.sort(key=lambda sh: (-sh[1].score, sh[0], sh[1].segment, sh[1].doc))
+    else:
+        key_fn = _sort_key_fn(sort)
+        merged.sort(key=lambda sh: key_fn(sh[1]))
+        if search_after is not None:
+            cursor = _search_after_key(sort, search_after)
+            merged = [
+                sh for sh in merged if _sort_values_key(sort, sh[1]) > cursor
+            ]
+    page = merged[from_ : from_ + size]
+
+    # ---- fetch phase (only winning docs) ----
+    source_filter = _source_filter(body.get("_source", True))
+    hits_json = []
+    for shard_idx, h in page:
+        shard, snapshot, _ = per_shard_results[shard_idx]
+        host = snapshot.segments[h.segment][0]
+        hit: dict[str, Any] = {
+            "_index": shard.shard_id.index,
+            "_id": host.doc_ids[h.doc],
+            "_score": None if sort else h.score,
+        }
+        src = source_filter(json.loads(host.sources[h.doc]))
+        if src is not None:
+            hit["_source"] = src
+        if sort:
+            hit["sort"] = h.sort_values
+        hits_json.append(hit)
+
+    response: dict[str, Any] = {
+        "took": int((time.monotonic() - t0) * 1000),
+        "timed_out": False,
+        "_shards": {
+            "total": len(shards),
+            "successful": len(shards),
+            "skipped": 0,
+            "failed": 0,
+        },
+        "hits": {
+            "total": {"value": total, "relation": "eq"},
+            "max_score": max_score if not sort else None,
+            "hits": hits_json,
+        },
+    }
+
+    # ---- aggregations (reduce across every shard's segments) ----
+    if aggs_body:
+        all_segments = []
+        all_masks = []
+        seg_ctx: list[tuple[ShardContext, int]] = []  # (shard ctx, seg idx in shard)
+        for shard_idx, (shard, snapshot, result) in enumerate(per_shard_results):
+            ctx = ShardContext(snapshot, shard.mapper_service)
+            for seg_i, (host, dev) in enumerate(snapshot.segments):
+                all_segments.append(host)
+                all_masks.append(result.masks[seg_i])
+                seg_ctx.append((ctx, seg_i))
+
+        def filter_fn(filter_body: dict, flat_idx: int) -> np.ndarray:
+            ctx, seg_i = seg_ctx[flat_idx]
+            host, dev = ctx.snapshot.segments[seg_i]
+            ex = SegmentExecutor(ctx, host, dev)
+            f_node = query_dsl.parse_query(filter_body)
+            return np.asarray(ex.execute(f_node).mask)
+
+        # multi-index search: resolve field types across every index's
+        # mappings (first index to map the field wins, like the reference's
+        # field-caps conflict handling)
+        mapper_service = _MultiMapperView([s.mapper_service for s in shards])
+        response["aggregations"] = compute_aggs(
+            all_segments, mapper_service, aggs_body, all_masks, filter_fn
+        )
+    return response
+
+
+class _MultiMapperView:
+    """Read-only MapperService facade over several indices' mappings."""
+
+    def __init__(self, services: list):
+        # dedupe while preserving order
+        seen: set[int] = set()
+        self.services = [
+            s for s in services if not (id(s) in seen or seen.add(id(s)))
+        ]
+
+    def field_mapper(self, name: str):
+        for s in self.services:
+            m = s.field_mapper(name)
+            if m is not None:
+                return m
+        return None
+
+
+def _values_key(sort: list, values: list) -> tuple:
+    """Ordering key for a row of sort values, consistent with
+    executor._sort_key_fn (minus its (segment, doc) tiebreak tail)."""
+    specs = [_sort_spec(s) for s in sort]
+    parts = []
+    for (fname, order, _missing), v in zip(specs, values):
+        if fname == "_score":
+            parts.append(-v if order == "desc" else v)
+        elif v is None:
+            parts.append((1, 0))
+        elif isinstance(v, str):
+            parts.append((0, _StrKey(v, order == "desc")))
+        else:
+            parts.append((0, -v if order == "desc" else v))
+    return tuple(parts)
+
+
+def _sort_values_key(sort: list, hit) -> tuple:
+    return _values_key(sort, hit.sort_values)
+
+
+def _search_after_key(sort: list, search_after: list) -> tuple:
+    if len(search_after) != len(sort):
+        raise ParsingException(
+            f"search_after must have {len(sort)} value(s) matching sort"
+        )
+    return _values_key(sort, search_after)
+
+
+def _source_filter(spec: Any):
+    if spec is False:
+        return lambda src: None
+    if spec is True or spec is None:
+        return lambda src: src
+    if isinstance(spec, str):
+        spec = [spec]
+    if isinstance(spec, list):
+        includes, excludes = spec, []
+    elif isinstance(spec, dict):
+        includes = spec.get("includes") or spec.get("include") or []
+        excludes = spec.get("excludes") or spec.get("exclude") or []
+        if isinstance(includes, str):
+            includes = [includes]
+        if isinstance(excludes, str):
+            excludes = [excludes]
+    else:
+        raise ParsingException(f"invalid _source spec [{spec!r}]")
+
+    def apply(src: dict) -> dict:
+        flat = _flatten(src)
+        out: dict[str, Any] = {}
+        for key, value in flat.items():
+            if includes and not any(_match(key, p) for p in includes):
+                continue
+            if excludes and any(_match(key, p) for p in excludes):
+                continue
+            _put_nested(out, key, value)
+        return out
+
+    return apply
+
+
+def _match(key: str, pattern: str) -> bool:
+    # "user.*" matches nested keys; "user" matches the whole subtree
+    return (
+        fnmatch.fnmatch(key, pattern)
+        or fnmatch.fnmatch(key, pattern + ".*")
+        or key.startswith(pattern + ".")
+    )
+
+
+def _flatten(obj: dict, prefix: str = "") -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for k, v in obj.items():
+        full = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, f"{full}."))
+        else:
+            out[full] = v
+    return out
+
+
+def _put_nested(out: dict, key: str, value: Any) -> None:
+    parts = key.split(".")
+    node = out
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value

@@ -1,0 +1,437 @@
+"""End-to-end single-node search: index -> refresh -> query DSL -> hits/aggs."""
+
+import pytest
+
+from opensearch_tpu.common.errors import (
+    IndexNotFoundException,
+    ParsingException,
+    ResourceAlreadyExistsException,
+)
+from opensearch_tpu.node import TpuNode
+
+DOCS = [
+    {"id": "1", "title": "the quick brown fox", "tag": "animal", "price": 10,
+     "rating": 4.5, "created": "2024-01-05T00:00:00Z", "in_stock": True,
+     "vec": [1.0, 0.0, 0.0, 0.0]},
+    {"id": "2", "title": "the lazy brown dog sleeps", "tag": "animal", "price": 25,
+     "rating": 3.0, "created": "2024-02-10T00:00:00Z", "in_stock": False,
+     "vec": [0.0, 1.0, 0.0, 0.0]},
+    {"id": "3", "title": "quick quick quick fox jumps", "tag": "speed", "price": 30,
+     "rating": 5.0, "created": "2024-02-20T00:00:00Z", "in_stock": True,
+     "vec": [0.9, 0.1, 0.0, 0.0]},
+    {"id": "4", "title": "an unrelated essay", "tag": "other", "price": 7,
+     "rating": 1.0, "created": "2024-03-01T12:30:00Z", "in_stock": True,
+     "vec": [0.0, 0.0, 1.0, 0.0]},
+    {"id": "5", "title": "brown bears eat fish", "tag": "animal", "price": 50,
+     "rating": 2.5, "created": "2023-12-25T00:00:00Z", "in_stock": False,
+     "vec": [0.1, 0.2, 0.3, 0.9]},
+]
+
+MAPPINGS = {
+    "properties": {
+        "title": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "price": {"type": "long"},
+        "rating": {"type": "float"},
+        "created": {"type": "date"},
+        "in_stock": {"type": "boolean"},
+        "vec": {"type": "dense_vector", "dims": 4, "similarity": "l2_norm"},
+    }
+}
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = TpuNode(tmp_path_factory.mktemp("node"))
+    n.create_index("items", {"settings": {"number_of_shards": 2}, "mappings": MAPPINGS})
+    for d in DOCS:
+        doc = dict(d)
+        doc_id = doc.pop("id")
+        n.index_doc("items", doc_id, doc)
+    n.refresh("items")
+    yield n
+    n.close()
+
+
+def _ids(resp):
+    return [h["_id"] for h in resp["hits"]["hits"]]
+
+
+def test_match_all(node):
+    resp = node.search("items", {"query": {"match_all": {}}})
+    assert resp["hits"]["total"]["value"] == 5
+    assert len(resp["hits"]["hits"]) == 5
+    assert resp["_shards"]["total"] == 2
+    assert all(h["_score"] == 1.0 for h in resp["hits"]["hits"])
+
+
+def test_match_query_ranking(node, tmp_path_factory):
+    resp = node.search("items", {"query": {"match": {"title": "quick fox"}}})
+    assert resp["hits"]["total"]["value"] == 2
+    assert set(_ids(resp)) == {"1", "3"}
+    # BM25 stats are per-shard (query_then_fetch, no DFS — same as the
+    # reference's default), so exact ranking needs a single-shard index
+    n1 = TpuNode(tmp_path_factory.mktemp("rank"))
+    n1.create_index("r1", {"settings": {"number_of_shards": 1}, "mappings": MAPPINGS})
+    for d in DOCS:
+        doc = dict(d)
+        n1.index_doc("r1", doc.pop("id"), doc)
+    n1.refresh("r1")
+    resp = n1.search("r1", {"query": {"match": {"title": "quick fox"}}})
+    assert _ids(resp) == ["3", "1"]  # doc 3 has tf=3 for quick
+    assert resp["hits"]["hits"][0]["_score"] > resp["hits"]["hits"][1]["_score"]
+    n1.close()
+
+
+def test_match_operator_and(node):
+    or_resp = node.search("items", {"query": {"match": {"title": {"query": "brown fox"}}}})
+    assert set(_ids(or_resp)) == {"1", "2", "3", "5"}
+    and_resp = node.search(
+        "items", {"query": {"match": {"title": {"query": "brown fox", "operator": "and"}}}}
+    )
+    assert _ids(and_resp) == ["1"]
+
+
+def test_term_and_terms_keyword(node):
+    resp = node.search("items", {"query": {"term": {"tag": "animal"}}})
+    assert resp["hits"]["total"]["value"] == 3
+    resp = node.search("items", {"query": {"terms": {"tag": ["speed", "other"]}}})
+    assert set(_ids(resp)) == {"3", "4"}
+    resp = node.search("items", {"query": {"term": {"tag": "nope"}}})
+    assert resp["hits"]["total"]["value"] == 0
+
+
+def test_range_numeric_and_date(node):
+    resp = node.search("items", {"query": {"range": {"price": {"gte": 25, "lt": 50}}}})
+    assert set(_ids(resp)) == {"2", "3"}
+    resp = node.search(
+        "items", {"query": {"range": {"created": {"gte": "2024-02-01T00:00:00Z"}}}}
+    )
+    assert set(_ids(resp)) == {"2", "3", "4"}
+    resp = node.search("items", {"query": {"range": {"rating": {"gt": 4.5}}}})
+    assert _ids(resp) == ["3"]
+
+
+def test_bool_query(node):
+    resp = node.search("items", {
+        "query": {
+            "bool": {
+                "must": [{"match": {"title": "brown"}}],
+                "filter": [{"range": {"price": {"lte": 30}}}],
+                "must_not": [{"term": {"tag": "speed"}}],
+            }
+        }
+    })
+    assert set(_ids(resp)) == {"1", "2"}
+
+
+def test_bool_should_minimum_match(node):
+    resp = node.search("items", {
+        "query": {
+            "bool": {
+                "should": [
+                    {"term": {"tag": "animal"}},
+                    {"range": {"price": {"gte": 40}}},
+                ],
+                "minimum_should_match": 2,
+            }
+        }
+    })
+    assert _ids(resp) == ["5"]
+
+
+def test_boolean_field_and_exists(node):
+    resp = node.search("items", {"query": {"term": {"in_stock": True}}})
+    assert set(_ids(resp)) == {"1", "3", "4"}
+    resp = node.search("items", {"query": {"exists": {"field": "vec"}}})
+    assert resp["hits"]["total"]["value"] == 5
+
+
+def test_ids_query(node):
+    resp = node.search("items", {"query": {"ids": {"values": ["2", "4", "zzz"]}}})
+    assert set(_ids(resp)) == {"2", "4"}
+
+
+def test_sort_by_field(node):
+    resp = node.search("items", {"sort": [{"price": "desc"}]})
+    assert _ids(resp) == ["5", "3", "2", "1", "4"]
+    assert resp["hits"]["hits"][0]["sort"] == [50]
+    resp = node.search("items", {"sort": [{"created": {"order": "asc"}}]})
+    assert _ids(resp) == ["5", "1", "2", "3", "4"]
+    resp = node.search("items", {"sort": [{"tag": "asc"}, {"price": "desc"}]})
+    assert _ids(resp) == ["5", "2", "1", "4", "3"]
+
+
+def test_from_size_pagination(node):
+    resp = node.search("items", {"sort": [{"price": "asc"}], "size": 2})
+    assert _ids(resp) == ["4", "1"]
+    resp = node.search("items", {"sort": [{"price": "asc"}], "size": 2, "from": 2})
+    assert _ids(resp) == ["2", "3"]
+    assert resp["hits"]["total"]["value"] == 5
+
+
+def test_source_filtering(node):
+    resp = node.search("items", {"query": {"ids": {"values": ["1"]}}, "_source": ["title", "price"]})
+    src = resp["hits"]["hits"][0]["_source"]
+    assert set(src) == {"title", "price"}
+    resp = node.search("items", {"query": {"ids": {"values": ["1"]}}, "_source": False})
+    assert "_source" not in resp["hits"]["hits"][0]
+
+
+def test_knn_query(node):
+    # k is per-shard (k-NN plugin semantics): up to k*shards candidates,
+    # trimmed by size
+    resp = node.search("items", {
+        "query": {"knn": {"vec": {"vector": [1.0, 0.0, 0.0, 0.0], "k": 2}}},
+        "size": 2,
+    })
+    assert _ids(resp) == ["1", "3"]
+    assert resp["hits"]["hits"][0]["_score"] == pytest.approx(1.0)
+    # with filter
+    resp = node.search("items", {
+        "query": {"knn": {"vec": {"vector": [1.0, 0.0, 0.0, 0.0], "k": 2,
+                                  "filter": {"term": {"tag": "animal"}}}}}
+    })
+    assert _ids(resp)[0] == "1"
+    assert set(_ids(resp)) <= {"1", "2", "5"}
+
+
+def test_script_score_knn(node):
+    resp = node.search("items", {
+        "query": {
+            "script_score": {
+                "query": {"match_all": {}},
+                "script": {
+                    "source": "knn_score",
+                    "params": {"field": "vec", "query_value": [1.0, 0.0, 0.0, 0.0],
+                               "space_type": "l2"},
+                },
+            }
+        }
+    })
+    assert _ids(resp)[0] == "1"
+    assert resp["hits"]["total"]["value"] == 5
+
+    resp = node.search("items", {
+        "query": {
+            "script_score": {
+                "query": {"match_all": {}},
+                "script": {
+                    "source": "cosineSimilarity(params.query_vector, doc['vec']) + 1.0",
+                    "params": {"query_vector": [0.9, 0.1, 0.0, 0.0]},
+                },
+            }
+        }
+    })
+    assert _ids(resp)[0] == "3"
+    assert resp["hits"]["hits"][0]["_score"] == pytest.approx(2.0, abs=1e-4)
+
+
+def test_aggs_terms_with_sub(node):
+    resp = node.search("items", {
+        "size": 0,
+        "aggs": {
+            "by_tag": {
+                "terms": {"field": "tag"},
+                "aggs": {"avg_price": {"avg": {"field": "price"}}},
+            }
+        },
+    })
+    buckets = resp["aggregations"]["by_tag"]["buckets"]
+    assert buckets[0]["key"] == "animal" and buckets[0]["doc_count"] == 3
+    assert buckets[0]["avg_price"]["value"] == pytest.approx((10 + 25 + 50) / 3)
+    assert {b["key"] for b in buckets} == {"animal", "speed", "other"}
+
+
+def test_aggs_metrics_and_query_scoped(node):
+    resp = node.search("items", {
+        "size": 0,
+        "query": {"term": {"tag": "animal"}},
+        "aggs": {
+            "stats_price": {"stats": {"field": "price"}},
+            "n_tags": {"cardinality": {"field": "tag"}},
+        },
+    })
+    st = resp["aggregations"]["stats_price"]
+    assert st == {"count": 3, "min": 10.0, "max": 50.0,
+                  "avg": pytest.approx(85 / 3), "sum": 85.0}
+    assert resp["aggregations"]["n_tags"]["value"] == 1
+
+
+def test_aggs_histogram_and_date_histogram(node):
+    resp = node.search("items", {
+        "size": 0,
+        "aggs": {
+            "price_hist": {"histogram": {"field": "price", "interval": 20}},
+            "monthly": {"date_histogram": {"field": "created", "calendar_interval": "month"}},
+        },
+    })
+    hist = resp["aggregations"]["price_hist"]["buckets"]
+    assert [(b["key"], b["doc_count"]) for b in hist] == [(0.0, 2), (20.0, 2), (40.0, 1)]
+    monthly = resp["aggregations"]["monthly"]["buckets"]
+    assert [b["doc_count"] for b in monthly] == [1, 1, 2, 1]
+    assert monthly[0]["key_as_string"].startswith("2023-12-01")
+
+
+def test_aggs_range_and_filter(node):
+    resp = node.search("items", {
+        "size": 0,
+        "aggs": {
+            "price_ranges": {
+                "range": {"field": "price", "ranges": [
+                    {"to": 20}, {"from": 20, "to": 40}, {"from": 40},
+                ]},
+            },
+            "cheap_animals": {
+                "filter": {"term": {"tag": "animal"}},
+                "aggs": {"max_price": {"max": {"field": "price"}}},
+            },
+        },
+    })
+    ranges = resp["aggregations"]["price_ranges"]["buckets"]
+    assert [b["doc_count"] for b in ranges] == [2, 2, 1]
+    cheap = resp["aggregations"]["cheap_animals"]
+    assert cheap["doc_count"] == 3
+    assert cheap["max_price"]["value"] == 50.0
+
+
+def test_count_and_msearch(node):
+    assert node.count("items", {"query": {"term": {"tag": "animal"}}})["count"] == 3
+    resp = node.msearch([
+        ({"index": "items"}, {"query": {"match_all": {}}, "size": 1}),
+        ({"index": "items"}, {"query": {"term": {"tag": "speed"}}}),
+    ])
+    assert resp["responses"][0]["hits"]["total"]["value"] == 5
+    assert resp["responses"][1]["hits"]["total"]["value"] == 1
+
+
+def test_unknown_query_and_index_errors(node):
+    with pytest.raises(ParsingException):
+        node.search("items", {"query": {"frobnicate": {}}})
+    with pytest.raises(IndexNotFoundException):
+        node.search("missing_index", {})
+    with pytest.raises(ResourceAlreadyExistsException):
+        node.create_index("items")
+
+
+def test_docs_crud_roundtrip(tmp_path):
+    n = TpuNode(tmp_path / "crud")
+    n.index_doc("autoidx", "1", {"msg": "hello world", "n": 5})
+    got = n.get_doc("autoidx", "1")
+    assert got["found"] and got["_source"]["n"] == 5
+    n.update_doc("autoidx", "1", {"doc": {"n": 6}})
+    assert n.get_doc("autoidx", "1")["_source"] == {"msg": "hello world", "n": 6}
+    resp = n.delete_doc("autoidx", "1")
+    assert resp["result"] == "deleted"
+    assert not n.get_doc("autoidx", "1")["found"]
+    n.close()
+
+
+def test_bulk_api(tmp_path):
+    n = TpuNode(tmp_path / "bulk")
+    resp = n.bulk([
+        ("index", {"_index": "b", "_id": "1"}, {"x": 1}),
+        ("index", {"_index": "b", "_id": "2"}, {"x": 2}),
+        ("create", {"_index": "b", "_id": "1"}, {"x": 99}),   # conflict
+        ("delete", {"_index": "b", "_id": "2"}, None),
+        ("update", {"_index": "b", "_id": "1"}, {"doc": {"y": 3}}),
+    ], refresh=True)
+    assert resp["errors"] is True
+    statuses = [list(item.values())[0]["status"] for item in resp["items"]]
+    assert statuses[0] == 201 and statuses[1] == 201
+    assert statuses[2] == 500 or statuses[2] == 409
+    assert statuses[3] == 200 and statuses[4] == 200
+    search = n.search("b", {"query": {"match_all": {}}})
+    assert search["hits"]["total"]["value"] == 1
+    assert search["hits"]["hits"][0]["_source"] == {"x": 1, "y": 3}
+    n.close()
+
+
+def test_multi_index_search(tmp_path):
+    n = TpuNode(tmp_path / "multi")
+    n.index_doc("logs-1", "a", {"msg": "error in system"})
+    n.index_doc("logs-2", "b", {"msg": "error in network"})
+    n.refresh()
+    resp = n.search("logs-*", {"query": {"match": {"msg": "error"}}})
+    assert resp["hits"]["total"]["value"] == 2
+    assert {h["_index"] for h in resp["hits"]["hits"]} == {"logs-1", "logs-2"}
+    n.close()
+
+
+def test_node_restart_recovers_indices(tmp_path):
+    path = tmp_path / "restart"
+    n = TpuNode(path)
+    n.create_index("persist", {"mappings": {"properties": {"v": {"type": "long"}}}})
+    n.index_doc("persist", "1", {"v": 42})
+    n.flush("persist")
+    n.index_doc("persist", "2", {"v": 43})  # translog only
+    n.close()
+    n2 = TpuNode(path)
+    n2.refresh("persist")
+    resp = n2.search("persist", {"query": {"match_all": {}}})
+    assert resp["hits"]["total"]["value"] == 2
+    assert n2.get_doc("persist", "2")["_source"]["v"] == 43
+    n2.close()
+
+
+def test_malformed_query_body_rejected(node):
+    with pytest.raises(ParsingException, match="expected an object"):
+        node.search("items", {"query": {"bool": "not-an-object"}})
+    with pytest.raises(ParsingException, match="unknown options"):
+        node.search("items", {"query": {"range": {"price": {"gte": 1, "bogus": 2}}}})
+
+
+def test_empty_analyzed_query_matches_nothing(tmp_path):
+    n = TpuNode(tmp_path / "stop")
+    n.create_index("s", {"mappings": {"properties": {
+        "body": {"type": "text", "analyzer": "stop"}}}})
+    n.index_doc("s", "1", {"body": "interesting content here"}, refresh=True)
+    # "the" analyzes to zero tokens -> no hits (not all hits)
+    assert n.search("s", {"query": {"match": {"body": "the"}}})["hits"]["total"]["value"] == 0
+    assert n.search("s", {"query": {"match_phrase": {"body": "the"}}})["hits"]["total"]["value"] == 0
+    n.close()
+
+
+def test_min_score_affects_total(node):
+    base = node.search("items", {"query": {"match": {"title": "brown"}}})
+    top_score = base["hits"]["hits"][0]["_score"]
+    resp = node.search("items", {
+        "query": {"match": {"title": "brown"}},
+        "min_score": top_score - 1e-6,
+    })
+    assert resp["hits"]["total"]["value"] == len(resp["hits"]["hits"])
+    assert resp["hits"]["total"]["value"] < base["hits"]["total"]["value"]
+
+
+def test_search_after_pagination(node):
+    page1 = node.search("items", {"sort": [{"price": "asc"}], "size": 2})
+    assert _ids(page1) == ["4", "1"]
+    after = page1["hits"]["hits"][-1]["sort"]
+    page2 = node.search("items", {"sort": [{"price": "asc"}], "size": 2,
+                                  "search_after": after})
+    assert _ids(page2) == ["2", "3"]
+    page3 = node.search("items", {"sort": [{"price": "asc"}], "size": 2,
+                                  "search_after": page2["hits"]["hits"][-1]["sort"]})
+    assert _ids(page3) == ["5"]
+    with pytest.raises(ParsingException, match="requires \\[sort\\]"):
+        node.search("items", {"search_after": [10]})
+
+
+def test_bulk_create_conflict_is_409(tmp_path):
+    n = TpuNode(tmp_path / "b409")
+    n.index_doc("c", "1", {"x": 1})
+    resp = n.bulk([("create", {"_index": "c", "_id": "1"}, {"x": 2})])
+    item = resp["items"][0]["create"]
+    assert item["status"] == 409
+    assert item["error"]["type"] == "version_conflict_engine_exception"
+    n.close()
+
+
+def test_bulk_refresh_with_routing(tmp_path):
+    n = TpuNode(tmp_path / "brout")
+    n.create_index("r", {"settings": {"number_of_shards": 4}})
+    resp = n.bulk([("index", {"_index": "r", "routing": "somekey"}, {"v": 1})],
+                  refresh=True)
+    assert resp["errors"] is False
+    assert n.search("r", {})["hits"]["total"]["value"] == 1
+    n.close()
