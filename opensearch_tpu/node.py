@@ -352,7 +352,7 @@ class TpuNode:
         for name in names:
             shards.extend(self._get_index(name).shards.values())
         # per-hit _index comes from each shard's ShardId inside the service
-        return search_service.search(shards, body, ",".join(names))
+        return search_service.search(shards, body)
 
     def msearch(self, searches: list[tuple[dict, dict]]) -> dict:
         responses = []

@@ -1,0 +1,113 @@
+"""Fused single-chip query programs: score + top_k in one XLA executable.
+
+The flagship forward step (the analog of the reference's hot query loop,
+ContextIndexSearcher.search + TopScoreDocCollector, SURVEY.md §3.2 ★★):
+hybrid BM25 + exact-kNN scoring over one segment's HBM-resident arrays,
+ending in jax.lax.top_k — one compiled program, no host round-trips.
+
+The general executor (search/executor.py) composes eager jnp ops for
+arbitrary query trees; these fused paths serve the common shapes (match,
+knn, hybrid) and the benchmark/graft entry.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def hybrid_score_topk(
+    postings_docs: jnp.ndarray,   # int32 [p_pad]
+    postings_tfs: jnp.ndarray,    # f32 [p_pad]
+    doc_len: jnp.ndarray,         # f32 [n_pad]
+    vectors: jnp.ndarray,         # f32/bf16 [n_pad, d]
+    norms_sq: jnp.ndarray,        # f32 [n_pad]
+    valid: jnp.ndarray,           # bool [n_pad]
+    offsets: jnp.ndarray,         # int32 [Q]
+    lengths: jnp.ndarray,         # int32 [Q]
+    idfs: jnp.ndarray,            # f32 [Q]
+    avgdl: jnp.ndarray,           # f32 scalar
+    queries: jnp.ndarray,         # f32 [B, d]
+    lexical_weight: jnp.ndarray,  # f32 scalar
+    vector_weight: jnp.ndarray,   # f32 scalar
+    *,
+    k: int,
+    window: int,
+    similarity: str = "l2_norm",
+    k1: float = 1.2,
+    b: float = 0.75,
+):
+    """Returns (scores [B, k], doc_ids [B, k])."""
+    n_pad = doc_len.shape[0]
+
+    # lexical: masked postings-window gather + scatter-add (VPU)
+    win = jnp.arange(window, dtype=jnp.int32)
+    idx = offsets[:, None] + win[None, :]
+    tvalid = win[None, :] < lengths[:, None]
+    idx = jnp.where(tvalid, idx, 0)
+    docs = postings_docs[idx]
+    tfs = postings_tfs[idx]
+    dl = doc_len[docs]
+    denom = tfs + k1 * (1.0 - b + b * dl / jnp.maximum(avgdl, 1e-6))
+    contrib = idfs[:, None] * tfs / jnp.maximum(denom, 1e-9)
+    contrib = jnp.where(tvalid, contrib, 0.0)
+    docs = jnp.where(tvalid, docs, 0)
+    lex = jnp.zeros(n_pad, jnp.float32).at[docs.reshape(-1)].add(contrib.reshape(-1))
+
+    # vector: one [B,d]x[d,n] matmul (MXU) + score-space transform
+    dots = jnp.einsum(
+        "bd,nd->bn", queries, vectors.astype(queries.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    if similarity == "l2_norm":
+        q_sq = jnp.sum(queries * queries, axis=-1, keepdims=True)
+        d_sq = jnp.maximum(q_sq - 2.0 * dots + norms_sq[None, :], 0.0)
+        vec = 1.0 / (1.0 + d_sq)
+    elif similarity == "cosine":
+        q_norm = jnp.sqrt(jnp.sum(queries * queries, axis=-1, keepdims=True))
+        vec = (1.0 + dots / jnp.maximum(q_norm * jnp.sqrt(norms_sq)[None, :], 1e-12)) / 2.0
+    else:
+        vec = jnp.where(dots >= 0, dots + 1.0, 1.0 / (1.0 - dots))
+
+    scores = vector_weight * vec + lexical_weight * lex[None, :]
+    scores = jnp.where(valid[None, :], scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+def knn_topk(
+    vectors: jnp.ndarray,
+    norms_sq: jnp.ndarray,
+    valid: jnp.ndarray,
+    queries: jnp.ndarray,
+    *,
+    k: int,
+    similarity: str = "l2_norm",
+):
+    """Pure exact-kNN fused path (the BASELINE config #1 program)."""
+    dots = jnp.einsum(
+        "bd,nd->bn", queries, vectors.astype(queries.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    if similarity == "l2_norm":
+        q_sq = jnp.sum(queries * queries, axis=-1, keepdims=True)
+        d_sq = jnp.maximum(q_sq - 2.0 * dots + norms_sq[None, :], 0.0)
+        scores = 1.0 / (1.0 + d_sq)
+    elif similarity == "cosine":
+        q_norm = jnp.sqrt(jnp.sum(queries * queries, axis=-1, keepdims=True))
+        scores = (1.0 + dots / jnp.maximum(q_norm * jnp.sqrt(norms_sq)[None, :], 1e-12)) / 2.0
+    else:
+        scores = jnp.where(dots >= 0, dots + 1.0, 1.0 / (1.0 - dots))
+    scores = jnp.where(valid[None, :], scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+def jit_hybrid(k: int, window: int, similarity: str = "l2_norm"):
+    return jax.jit(
+        functools.partial(hybrid_score_topk, k=k, window=window, similarity=similarity)
+    )
+
+
+def jit_knn(k: int, similarity: str = "l2_norm"):
+    return jax.jit(functools.partial(knn_topk, k=k, similarity=similarity))

@@ -208,13 +208,49 @@ def _terms(conf, sub, segments, ms, masks, filter_fn) -> dict:
             uniq, c = np.unique(vals, return_counts=True)
             for v, n in zip(uniq.tolist(), c.tolist()):
                 counts[v] = counts.get(v, 0) + n
+    # order: {"_count": "desc"} | {"_key": "asc"} | {"<sub-agg-path>": dir},
+    # or a list of such single-entry dicts (multi-criteria)
     order_conf = conf.get("order", {"_count": "desc"})
-    (order_key, order_dir), = order_conf.items() if isinstance(order_conf, dict) else [("_count", "desc")]
-    items = list(counts.items())
-    if order_key == "_key":
-        items.sort(key=lambda kv: kv[0], reverse=(order_dir == "desc"))
+    if isinstance(order_conf, dict):
+        order_specs = list(order_conf.items())
+    elif isinstance(order_conf, list):
+        order_specs = [next(iter(o.items())) for o in order_conf]
     else:
-        items.sort(key=lambda kv: (-kv[1], kv[0]) if order_dir == "desc" else (kv[1], kv[0]))
+        raise ParsingException(f"invalid terms order [{order_conf!r}]")
+    needs_sub_order = any(k not in ("_count", "_key") for k, _ in order_specs)
+
+    # compute sub-aggs per bucket up-front when ordering needs them (or
+    # lazily after the cut otherwise)
+    sub_results: dict[Any, dict] = {}
+    if sub and needs_sub_order:
+        for key in counts:
+            bucket_masks = _value_masks(segments, field, key, masks)
+            sub_results[key] = _sub_aggs(sub, segments, ms, bucket_masks, filter_fn)
+
+    def _agg_path_value(key: Any, path: str) -> Any:
+        name, _, prop = path.partition(".")
+        result = sub_results.get(key, {}).get(name)
+        if result is None:
+            raise ParsingException(f"terms order references unknown agg [{path}]")
+        v = result.get(prop or "value")
+        return v if v is not None else float("-inf")
+
+    def sort_key(kv):
+        key, count = kv
+        parts = []
+        for okey, odir in order_specs:
+            desc = odir == "desc"
+            if okey == "_count":
+                parts.append(-count if desc else count)
+            elif okey == "_key":
+                parts.append(_KeyOrd(key, desc))
+            else:
+                v = _agg_path_value(key, okey)
+                parts.append(-v if desc else v)
+        parts.append(_KeyOrd(key, False))  # stable tiebreak: key asc
+        return tuple(parts)
+
+    items = sorted(counts.items(), key=sort_key)
     top = items[:size]
     other = sum(c for _, c in items[size:])
 
@@ -232,14 +268,33 @@ def _terms(conf, sub, segments, ms, masks, filter_fn) -> dict:
             bucket["key"] = int(key) if float(key).is_integer() and not is_keyword else key
         bucket["doc_count"] = count
         if sub:
-            bucket_masks = _value_masks(segments, field, key, masks)
-            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn))
+            if key in sub_results:
+                bucket.update(sub_results[key])
+            else:
+                bucket_masks = _value_masks(segments, field, key, masks)
+                bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn))
         buckets.append(bucket)
     return {
         "doc_count_error_upper_bound": 0,
         "sum_other_doc_count": other,
         "buckets": buckets,
     }
+
+
+class _KeyOrd:
+    """Orderable wrapper for bucket keys (str or numeric) with direction."""
+
+    __slots__ = ("v", "desc")
+
+    def __init__(self, v, desc: bool):
+        self.v = v
+        self.desc = desc
+
+    def __lt__(self, other: "_KeyOrd") -> bool:
+        return (self.v > other.v) if self.desc else (self.v < other.v)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, _KeyOrd) and self.v == other.v
 
 
 def _value_masks(segments, field, key, masks) -> list[np.ndarray]:
@@ -281,7 +336,10 @@ def _histogram(conf, sub, segments, ms, masks, filter_fn, date: bool) -> dict:
     else:
         interval_conf = conf["interval"]
         calendar = False
-    offset = float(conf.get("offset", 0))
+    raw_offset = conf.get("offset", 0)
+    # date offsets come as duration strings ("6h", "-1d"); numeric histograms
+    # take plain numbers
+    offset = float(parse_time_millis(raw_offset)) if date else float(raw_offset)
     min_doc_count = int(conf.get("min_doc_count", 1 if not date else 0))
 
     # collect (key -> count) and per-key masks lazily for sub-aggs

@@ -60,6 +60,52 @@ class ShardContext:
     def __init__(self, snapshot: SearcherSnapshot, mapper_service: MapperService):
         self.snapshot = snapshot
         self.mapper_service = mapper_service
+        # per-query cache: knn nodes select k docs PER SHARD (k-NN plugin
+        # semantics), so the top-k cut must span all segments of the shard
+        self._knn_cache: dict[int, list] = {}
+
+    def shard_knn_selection(self, node) -> list:
+        """Per-segment (sel_mask bool[n_pad], scores f32[n_pad]) numpy pairs
+        for a KnnQuery, with the top-k cut applied across the whole shard."""
+        cached = self._knn_cache.get(id(node))
+        if cached is not None:
+            return cached
+        from opensearch_tpu.ops import knn as knn_ops
+
+        per_seg_scores: list[np.ndarray | None] = []
+        candidates: list[tuple[float, int, int]] = []
+        for seg_idx, (host, dev) in enumerate(self.snapshot.segments):
+            vf = dev.vector_fields.get(node.field)
+            if vf is None:
+                per_seg_scores.append(None)
+                continue
+            valid = vf.present & dev.live
+            if node.filter is not None:
+                ex = SegmentExecutor(self, host, dev)
+                valid = valid & ex.execute(node.filter).mask
+            qv = jnp.asarray([node.vector], jnp.float32)
+            scores = np.asarray(
+                knn_ops.exact_knn_scores(qv, vf.vectors, vf.norms_sq, valid, vf.similarity)[0]
+            )
+            per_seg_scores.append(scores)
+            n_take = min(node.k, host.n_docs)
+            top = np.argpartition(-scores[: host.n_docs], min(n_take, host.n_docs - 1))[:n_take]
+            for d in top:
+                if np.isfinite(scores[d]):
+                    candidates.append((float(scores[d]), seg_idx, int(d)))
+        candidates.sort(key=lambda c: (-c[0], c[1], c[2]))
+        winners = candidates[: node.k]
+        out = []
+        for seg_idx, (host, dev) in enumerate(self.snapshot.segments):
+            scores = per_seg_scores[seg_idx]
+            sel = np.zeros(dev.n_pad, bool)
+            if scores is not None:
+                for s, si, d in winners:
+                    if si == seg_idx:
+                        sel[d] = True
+            out.append((sel, scores))
+        self._knn_cache[id(node)] = out
+        return out
 
     def text_stats(self, field: str) -> tuple[int, float]:
         """(doc_count, avgdl) across all segments of the shard."""
@@ -428,18 +474,18 @@ class SegmentExecutor:
         return NodeResult(scores=scores, mask=mask, scoring=any_scoring)
 
     def _exec_KnnQuery(self, node: q.KnnQuery) -> NodeResult:
-        vf = self.dev.vector_fields.get(node.field)
-        if vf is None:
+        # k applies per SHARD (top-k cut across all its segments) — the
+        # ShardContext caches the shard-wide selection per query node
+        selections = self.ctx.shard_knn_selection(node)
+        seg_idx = next(
+            i for i, (h, d) in enumerate(self.ctx.snapshot.segments) if d is self.dev
+        )
+        sel_host, scores_host = selections[seg_idx]
+        if scores_host is None:
             return _empty(self.dev)
-        valid = vf.present & self.dev.live
-        if node.filter is not None:
-            valid = valid & self.execute(node.filter).mask
-        qv = jnp.asarray([node.vector], jnp.float32)
-        scores = knn.exact_knn_scores(qv, vf.vectors, vf.norms_sq, valid, vf.similarity)[0]
-        k = min(node.k, self.dev.n_pad)
-        top_vals, top_ids = jax.lax.top_k(scores, k)
-        sel = jnp.zeros(self.dev.n_pad, bool).at[top_ids].set(jnp.isfinite(top_vals))
-        out_scores = jnp.where(sel, jnp.where(jnp.isfinite(scores), scores, 0.0), 0.0)
+        sel = jnp.asarray(sel_host)
+        scores = jnp.asarray(np.where(np.isfinite(scores_host), scores_host, 0.0))
+        out_scores = jnp.where(sel, scores, 0.0)
         return NodeResult(scores=out_scores * node.boost, mask=sel, scoring=True)
 
     def _exec_ScriptScoreQuery(self, node: q.ScriptScoreQuery) -> NodeResult:
@@ -492,7 +538,6 @@ def execute_query_phase(
     size: int,
     sort: list[dict] | None = None,
     need_masks: bool = False,
-    track_total_hits: bool | int = True,
     min_score: float | None = None,
 ) -> ShardQueryResult:
     ctx = ShardContext(snapshot, mapper_service)
@@ -536,18 +581,17 @@ def execute_query_phase(
         all_hits.sort(key=lambda h: (-h.score, h.segment, h.doc))
         all_hits = all_hits[:size]
     else:
-        keys = _sort_key_fn(sort)
-        all_hits.sort(key=keys)
+        all_hits.sort(key=_sort_key_fn(sort))
         all_hits = all_hits[:size]
-        if all_hits and max_score is None:
-            max_score = None
     return ShardQueryResult(hits=all_hits, total=total, max_score=max_score, masks=masks)
 
 
 def _field_sort_values(
     host: HostSegment, field: str, docs: np.ndarray, mapper_service: MapperService
 ) -> tuple[np.ndarray, np.ndarray]:
-    """(values float64/int64, present bool) for the requested docs."""
+    """(values float64/int64, present bool) for the requested docs. A field
+    absent from this whole segment means every doc's value is missing (the
+    reference sorts those by the `missing` policy rather than erroring)."""
     nf = host.numeric_fields.get(field)
     if nf is not None:
         vals = nf.values_i64 if nf.kind == "int" else nf.values_f64
@@ -558,7 +602,7 @@ def _field_sort_values(
         # segments; use the string values for cross-segment correctness
         ords = kf.first_ord[docs]
         return ords, ords >= 0
-    raise IllegalArgumentException(f"no sortable field [{field}]")
+    return np.zeros(len(docs)), np.zeros(len(docs), bool)
 
 
 def _sorted_segment_hits(
@@ -617,7 +661,7 @@ def _sort_key_fn(sort: list[dict]):
 
     def key(hit: ShardHit):
         parts = []
-        for i, (fname, order, _missing) in enumerate(specs):
+        for i, (fname, order, missing) in enumerate(specs):
             if fname == "_score":
                 v = hit.score
                 parts.append(-v if order == "desc" else v)
@@ -626,12 +670,14 @@ def _sort_key_fn(sort: list[dict]):
                 parts.append((hit.segment, hit.doc) if order == "asc" else (-hit.segment, -hit.doc))
                 continue
             v = hit.sort_values[i] if i < len(hit.sort_values) else None
+            if v is None and missing not in (None, "_last", "_first"):
+                v = missing  # substitute the user-provided missing value
             if v is None:
-                # missing sorts last in asc, last in desc (OpenSearch: _last default)
-                parts.append((1, 0))
+                # _last (default): sorts after every real value in either
+                # order; _first: before
+                parts.append((-1, 0) if missing == "_first" else (1, 0))
             elif isinstance(v, str):
-                # invert strings for desc via codepoint complement is messy;
-                # handled by sorting twice is worse — use tuple trick
+                # desc string order via a reflected-comparison wrapper
                 parts.append((0, _StrKey(v, order == "desc")))
             else:
                 parts.append((0, -v if order == "desc" else v))

@@ -233,7 +233,8 @@ def _parse_exists(body: dict) -> QueryNode:
 
 
 def _parse_ids(body: dict) -> QueryNode:
-    return IdsQuery(values=[str(v) for v in body.get("values", [])])
+    return IdsQuery(values=[str(v) for v in body.get("values", [])],
+                    boost=float(body.get("boost", 1.0)))
 
 
 def _parse_msm(v: Any) -> int | None:

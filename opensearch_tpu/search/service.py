@@ -40,7 +40,6 @@ DEFAULT_SIZE = 10
 def search(
     shards: list[IndexShard],
     body: dict | None,
-    index_name: str,
 ) -> dict[str, Any]:
     t0 = time.monotonic()
     body = body or {}
@@ -64,6 +63,11 @@ def search(
     search_after = body.get("search_after")
     if search_after is not None and not sort:
         raise ParsingException("[search_after] requires [sort] to be set")
+    if search_after is not None and from_ > 0:
+        raise ParsingException(
+            "[from] parameter must be set to 0 when [search_after] is used"
+        )
+    track_total = body.get("track_total_hits", True)
 
     fetch_k = from_ + size
     per_shard_results = []
@@ -129,6 +133,20 @@ def search(
             hit["sort"] = h.sort_values
         hits_json.append(hit)
 
+    hits_obj: dict[str, Any] = {
+        "max_score": max_score if not sort else None,
+        "hits": hits_json,
+    }
+    # track_total_hits: True -> exact; int N -> capped with relation gte;
+    # False -> no total object (the reference's contract)
+    if track_total is True:
+        hits_obj["total"] = {"value": total, "relation": "eq"}
+    elif track_total is not False:
+        cap = int(track_total)
+        hits_obj["total"] = (
+            {"value": cap, "relation": "gte"} if total > cap
+            else {"value": total, "relation": "eq"}
+        )
     response: dict[str, Any] = {
         "took": int((time.monotonic() - t0) * 1000),
         "timed_out": False,
@@ -138,11 +156,7 @@ def search(
             "skipped": 0,
             "failed": 0,
         },
-        "hits": {
-            "total": {"value": total, "relation": "eq"},
-            "max_score": max_score if not sort else None,
-            "hits": hits_json,
-        },
+        "hits": hits_obj,
     }
 
     # ---- aggregations (reduce across every shard's segments) ----

@@ -7,12 +7,15 @@ the analog of InternalTestCluster booting N nodes in one JVM.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # force CPU even if axon/tpu is present
+
+# jax may already be imported by the environment's sitecustomize (TPU plugin
+# registration), in which case the env var was read long ago — override the
+# live config before any backend initializes.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np
 import pytest

@@ -435,3 +435,79 @@ def test_bulk_refresh_with_routing(tmp_path):
     assert resp["errors"] is False
     assert n.search("r", {})["hits"]["total"]["value"] == 1
     n.close()
+
+
+def test_sort_missing_field_and_missing_value(tmp_path):
+    n = TpuNode(tmp_path / "sortmiss")
+    n.create_index("m", {"settings": {"number_of_shards": 1}})
+    n.index_doc("m", "1", {"a": 1}, refresh=True)          # segment without b
+    n.index_doc("m", "2", {"a": 2, "b": 5}, refresh=True)  # segment with b
+    n.index_doc("m", "3", {"a": 3, "b": 2}, refresh=True)
+    # missing sorts last by default
+    resp = n.search("m", {"sort": [{"b": "asc"}]})
+    assert [h["_id"] for h in resp["hits"]["hits"]] == ["3", "2", "1"]
+    # user-provided missing value
+    resp = n.search("m", {"sort": [{"b": {"order": "asc", "missing": 0}}]})
+    assert [h["_id"] for h in resp["hits"]["hits"]] == ["1", "3", "2"]
+    resp = n.search("m", {"sort": [{"b": {"order": "asc", "missing": "_first"}}]})
+    assert [h["_id"] for h in resp["hits"]["hits"]][0] == "1"
+    n.close()
+
+
+def test_knn_k_is_per_shard_not_per_segment(tmp_path):
+    n = TpuNode(tmp_path / "knnseg")
+    n.create_index("kv", {"settings": {"number_of_shards": 1}, "mappings": {
+        "properties": {"v": {"type": "dense_vector", "dims": 2}}}})
+    # three segments, 2 docs each
+    for seg in range(3):
+        for i in range(2):
+            n.index_doc("kv", f"{seg}-{i}", {"v": [seg + i * 0.1, 0.0]})
+        n.refresh("kv")
+    resp = n.search("kv", {"query": {"knn": {"v": {"vector": [0.0, 0.0], "k": 3}}}})
+    assert resp["hits"]["total"]["value"] == 3  # k per shard, not 3 per segment
+    assert [h["_id"] for h in resp["hits"]["hits"]] == ["0-0", "0-1", "1-0"]
+    n.close()
+
+
+def test_terms_agg_order_by_subagg_and_key(node):
+    resp = node.search("items", {
+        "size": 0,
+        "aggs": {"by_tag": {
+            "terms": {"field": "tag", "order": {"avg_price": "desc"}},
+            "aggs": {"avg_price": {"avg": {"field": "price"}}},
+        }},
+    })
+    buckets = resp["aggregations"]["by_tag"]["buckets"]
+    avgs = [b["avg_price"]["value"] for b in buckets]
+    assert avgs == sorted(avgs, reverse=True)
+    assert buckets[0]["key"] == "speed"  # avg 30
+    resp = node.search("items", {
+        "size": 0,
+        "aggs": {"by_tag": {"terms": {"field": "tag", "order": {"_key": "asc"}}}},
+    })
+    keys = [b["key"] for b in resp["aggregations"]["by_tag"]["buckets"]]
+    assert keys == sorted(keys)
+
+
+def test_date_histogram_offset_duration(node):
+    resp = node.search("items", {
+        "size": 0,
+        "aggs": {"d": {"date_histogram": {"field": "created",
+                                          "fixed_interval": "30d", "offset": "6h"}}},
+    })
+    assert resp["aggregations"]["d"]["buckets"]
+
+
+def test_track_total_hits(node):
+    resp = node.search("items", {"track_total_hits": False})
+    assert "total" not in resp["hits"]
+    resp = node.search("items", {"track_total_hits": 3})
+    assert resp["hits"]["total"] == {"value": 3, "relation": "gte"}
+    resp = node.search("items", {"track_total_hits": 10})
+    assert resp["hits"]["total"] == {"value": 5, "relation": "eq"}
+
+
+def test_search_after_rejects_from(node):
+    with pytest.raises(ParsingException, match="from"):
+        node.search("items", {"sort": [{"price": "asc"}], "from": 5,
+                              "search_after": [10]})
