@@ -1,0 +1,427 @@
+"""REST API handlers: the OpenSearch HTTP surface over a TpuNode.
+
+One function per API, mirroring the reference's rest/action/** handlers
+(e.g. RestSearchAction.java:91, RestBulkAction.java:66, the ~20 cat tables
+under rest/action/cat/). Handlers receive (node, params, query, body) and
+return (status, payload) — the HTTP server is transport-only.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from opensearch_tpu import __version__
+from opensearch_tpu.common.errors import (
+    IllegalArgumentException,
+    OpenSearchTpuException,
+)
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.rest.router import Router
+
+
+def build_router() -> Router:
+    r = Router()
+    reg = r.register
+
+    reg("GET", "/", root_info)
+    # index lifecycle
+    reg("PUT", "/{index}", create_index)
+    reg("DELETE", "/{index}", delete_index)
+    reg("GET", "/{index}", get_index)
+    reg("GET", "/{index}/_mapping", get_mapping)
+    reg("PUT", "/{index}/_mapping", put_mapping)
+    reg("GET", "/{index}/_settings", get_settings)
+    # documents
+    reg("PUT", "/{index}/_doc/{id}", index_doc)
+    reg("POST", "/{index}/_doc/{id}", index_doc)
+    reg("POST", "/{index}/_doc", index_doc_auto_id)
+    reg("PUT", "/{index}/_create/{id}", create_doc)
+    reg("POST", "/{index}/_create/{id}", create_doc)
+    reg("GET", "/{index}/_doc/{id}", get_doc)
+    reg("GET", "/{index}/_source/{id}", get_source)
+    reg("DELETE", "/{index}/_doc/{id}", delete_doc)
+    reg("POST", "/{index}/_update/{id}", update_doc)
+    reg("POST", "/_bulk", bulk)
+    reg("PUT", "/_bulk", bulk)
+    reg("POST", "/{index}/_bulk", bulk)
+    reg("GET", "/{index}/_count", count)
+    reg("POST", "/{index}/_count", count)
+    reg("GET", "/_count", count_all)
+    reg("POST", "/_count", count_all)
+    # search
+    reg("GET", "/{index}/_search", search)
+    reg("POST", "/{index}/_search", search)
+    reg("GET", "/_search", search_all)
+    reg("POST", "/_search", search_all)
+    reg("GET", "/_msearch", msearch)
+    reg("POST", "/_msearch", msearch)
+    reg("POST", "/{index}/_msearch", msearch)
+    # maintenance
+    reg("POST", "/{index}/_refresh", refresh)
+    reg("GET", "/{index}/_refresh", refresh)
+    reg("POST", "/_refresh", refresh_all)
+    reg("POST", "/{index}/_flush", flush)
+    reg("POST", "/_flush", flush_all)
+    # cluster / stats
+    reg("GET", "/_cluster/health", cluster_health)
+    reg("GET", "/_cluster/stats", cluster_stats)
+    reg("GET", "/_stats", all_stats)
+    reg("GET", "/{index}/_stats", index_stats)
+    reg("GET", "/_nodes/stats", nodes_stats)
+    reg("GET", "/_cat/indices", cat_indices)
+    reg("GET", "/_cat/health", cat_health)
+    reg("GET", "/_cat/shards", cat_shards)
+    reg("GET", "/_cat/count", cat_count)
+    return r
+
+
+# -- info --------------------------------------------------------------------
+
+
+def root_info(node: TpuNode, params, query, body):
+    return 200, {
+        "name": node.node_name,
+        "cluster_name": "opensearch-tpu",
+        "cluster_uuid": "tpu-native",
+        "version": {
+            "distribution": "opensearch-tpu",
+            "number": __version__,
+            "minimum_wire_compatibility_version": "7.10.0",
+            "minimum_index_compatibility_version": "7.0.0",
+        },
+        "tagline": "The OpenSearch Project: TPU-native engine",
+    }
+
+
+# -- index lifecycle ---------------------------------------------------------
+
+
+def create_index(node: TpuNode, params, query, body):
+    return 200, node.create_index(params["index"], body)
+
+
+def delete_index(node: TpuNode, params, query, body):
+    return 200, node.delete_index(params["index"])
+
+
+def get_index(node: TpuNode, params, query, body):
+    out = {}
+    for name in node.resolve_indices(params["index"]):
+        out[name] = {
+            "aliases": {},
+            "mappings": node.indices[name].mapper_service.to_dict(),
+            "settings": node.get_settings(name)[name]["settings"],
+        }
+    return 200, out
+
+
+def get_mapping(node: TpuNode, params, query, body):
+    return 200, node.get_mapping(params["index"])
+
+
+def put_mapping(node: TpuNode, params, query, body):
+    return 200, node.put_mapping(params["index"], body or {})
+
+
+def get_settings(node: TpuNode, params, query, body):
+    return 200, node.get_settings(params["index"])
+
+
+# -- documents ---------------------------------------------------------------
+
+
+def _refresh_param(query) -> bool:
+    v = query.get("refresh", "false")
+    return v in ("true", "", "wait_for")
+
+
+def index_doc(node: TpuNode, params, query, body):
+    if body is None:
+        raise IllegalArgumentException("request body is required")
+    if_seq_no = query.get("if_seq_no")
+    resp = node.index_doc(
+        params["index"], params["id"], body,
+        routing=query.get("routing"),
+        if_seq_no=int(if_seq_no) if if_seq_no is not None else None,
+        refresh=_refresh_param(query),
+    )
+    return (201 if resp["result"] == "created" else 200), resp
+
+
+def index_doc_auto_id(node: TpuNode, params, query, body):
+    if body is None:
+        raise IllegalArgumentException("request body is required")
+    resp = node.index_doc(
+        params["index"], None, body,
+        routing=query.get("routing"), refresh=_refresh_param(query),
+    )
+    return 201, resp
+
+
+def create_doc(node: TpuNode, params, query, body):
+    from opensearch_tpu.common.errors import VersionConflictException
+
+    existing = None
+    if params["index"] in node.indices:
+        existing = node.indices[params["index"]].shard_for(
+            params["id"], query.get("routing")
+        ).get(params["id"])
+    if existing is not None:
+        raise VersionConflictException(
+            f"[{params['id']}]: version conflict, document already exists"
+        )
+    return index_doc(node, params, query, body)
+
+
+def get_doc(node: TpuNode, params, query, body):
+    resp = node.get_doc(params["index"], params["id"], routing=query.get("routing"))
+    return (200 if resp.get("found") else 404), resp
+
+
+def get_source(node: TpuNode, params, query, body):
+    resp = node.get_doc(params["index"], params["id"], routing=query.get("routing"))
+    if not resp.get("found"):
+        return 404, {"error": f"document [{params['id']}] not found"}
+    return 200, resp["_source"]
+
+
+def delete_doc(node: TpuNode, params, query, body):
+    resp = node.delete_doc(
+        params["index"], params["id"],
+        routing=query.get("routing"), refresh=_refresh_param(query),
+    )
+    return (200 if resp["result"] == "deleted" else 404), resp
+
+
+def update_doc(node: TpuNode, params, query, body):
+    resp = node.update_doc(
+        params["index"], params["id"], body or {},
+        routing=query.get("routing"), refresh=_refresh_param(query),
+    )
+    return 200, resp
+
+
+def bulk(node: TpuNode, params, query, body):
+    if not isinstance(body, list):
+        raise IllegalArgumentException("bulk body must be NDJSON lines")
+    default_index = params.get("index")
+    ops: list[tuple[str, dict, dict | None]] = []
+    i = 0
+    while i < len(body):
+        action_line = body[i]
+        i += 1
+        if not isinstance(action_line, dict) or len(action_line) != 1:
+            raise IllegalArgumentException(
+                f"Malformed action/metadata line [{i}], expected a single action"
+            )
+        action, meta = next(iter(action_line.items()))
+        if action not in ("index", "create", "update", "delete"):
+            raise IllegalArgumentException(f"Unknown bulk action [{action}]")
+        meta = dict(meta or {})
+        meta.setdefault("_index", default_index)
+        if meta.get("_index") is None:
+            raise IllegalArgumentException(
+                f"action [{action}] requires [_index] (line {i})"
+            )
+        source = None
+        if action != "delete":
+            if i >= len(body):
+                raise IllegalArgumentException(
+                    f"missing source line for [{action}] (line {i})"
+                )
+            source = body[i]
+            i += 1
+        ops.append((action, meta, source))
+    return 200, node.bulk(ops, refresh=_refresh_param(query))
+
+
+# -- search ------------------------------------------------------------------
+
+
+def _body_with_query_params(query, body):
+    body = dict(body or {})
+    if "q" in query:
+        # Lucene-lite query string: fall back to a match on _all-style text —
+        # support field:value and bare terms via simple translation
+        qs = query["q"]
+        if ":" in qs:
+            fname, value = qs.split(":", 1)
+            body.setdefault("query", {"match": {fname: value}})
+        else:
+            body.setdefault("query", {"multi_match": {"query": qs, "fields": ["*"]}})
+    for key in ("size", "from"):
+        if key in query:
+            body.setdefault(key, int(query[key]))
+    return body
+
+
+def search(node: TpuNode, params, query, body):
+    return 200, node.search(params["index"], _body_with_query_params(query, body))
+
+
+def search_all(node: TpuNode, params, query, body):
+    return 200, node.search("_all", _body_with_query_params(query, body))
+
+
+def msearch(node: TpuNode, params, query, body):
+    if not isinstance(body, list):
+        raise IllegalArgumentException("msearch body must be NDJSON lines")
+    default_index = params.get("index", "_all")
+    searches = []
+    for i in range(0, len(body) - 1, 2):
+        header = body[i] or {}
+        header.setdefault("index", default_index)
+        searches.append((header, body[i + 1]))
+    return 200, node.msearch(searches)
+
+
+def count(node: TpuNode, params, query, body):
+    return 200, node.count(params["index"], _body_with_query_params(query, body))
+
+
+def count_all(node: TpuNode, params, query, body):
+    return 200, node.count("_all", _body_with_query_params(query, body))
+
+
+# -- maintenance -------------------------------------------------------------
+
+
+def refresh(node: TpuNode, params, query, body):
+    return 200, node.refresh(params["index"])
+
+
+def refresh_all(node: TpuNode, params, query, body):
+    return 200, node.refresh("_all")
+
+
+def flush(node: TpuNode, params, query, body):
+    return 200, node.flush(params["index"])
+
+
+def flush_all(node: TpuNode, params, query, body):
+    return 200, node.flush("_all")
+
+
+# -- cluster / stats ---------------------------------------------------------
+
+
+def cluster_health(node: TpuNode, params, query, body):
+    return 200, node.cluster_health()
+
+
+def cluster_stats(node: TpuNode, params, query, body):
+    stats = node.index_stats("_all")
+    return 200, {
+        "cluster_name": "opensearch-tpu",
+        "status": "green",
+        "indices": {
+            "count": len(node.indices),
+            "docs": {"count": stats["_all"]["primaries"]["docs"]["count"]},
+            "shards": {
+                "total": sum(s.num_shards for s in node.indices.values()),
+            },
+        },
+        "nodes": {"count": {"total": 1, "data": 1, "cluster_manager": 1}},
+    }
+
+
+def all_stats(node: TpuNode, params, query, body):
+    return 200, node.index_stats("_all")
+
+
+def index_stats(node: TpuNode, params, query, body):
+    return 200, node.index_stats(params["index"])
+
+
+def nodes_stats(node: TpuNode, params, query, body):
+    import resource
+
+    usage = resource.getrusage(resource.RUSAGE_SELF)
+    stats = node.index_stats("_all")
+    return 200, {
+        "_nodes": {"total": 1, "successful": 1, "failed": 0},
+        "cluster_name": "opensearch-tpu",
+        "nodes": {
+            "node-0": {
+                "name": node.node_name,
+                "roles": ["cluster_manager", "data", "ingest"],
+                "indices": {
+                    "docs": {"count": stats["_all"]["primaries"]["docs"]["count"]},
+                },
+                "process": {"max_rss_bytes": usage.ru_maxrss * 1024},
+            }
+        },
+    }
+
+
+# -- cat tables --------------------------------------------------------------
+
+
+def _cat_format(query, rows: list[dict]) -> Any:
+    if query.get("format") == "json":
+        return rows
+    if not rows:
+        return ""
+    cols = list(rows[0].keys())
+    show_header = "v" in query or query.get("v") == ""
+    widths = {
+        c: max(len(str(c)) if show_header else 0, *(len(str(r[c])) for r in rows))
+        for c in cols
+    }
+    lines = []
+    if show_header:
+        lines.append(" ".join(str(c).ljust(widths[c]) for c in cols))
+    for r in rows:
+        lines.append(" ".join(str(r[c]).ljust(widths[c]) for c in cols))
+    return "\n".join(lines) + "\n"
+
+
+def cat_indices(node: TpuNode, params, query, body):
+    rows = []
+    for name in sorted(node.indices):
+        svc = node.indices[name]
+        docs = sum(s.num_docs for s in svc.shards.values())
+        rows.append({
+            "health": "green",
+            "status": "open",
+            "index": name,
+            "pri": svc.num_shards,
+            "rep": svc.num_replicas,
+            "docs.count": docs,
+        })
+    return 200, _cat_format(query, rows)
+
+
+def cat_health(node: TpuNode, params, query, body):
+    h = node.cluster_health()
+    return 200, _cat_format(query, [{
+        "cluster": h["cluster_name"],
+        "status": h["status"],
+        "node.total": h["number_of_nodes"],
+        "shards": h["active_shards"],
+        "pri": h["active_primary_shards"],
+        "unassign": h["unassigned_shards"],
+    }])
+
+
+def cat_shards(node: TpuNode, params, query, body):
+    rows = []
+    for name in sorted(node.indices):
+        for sid, shard in sorted(node.indices[name].shards.items()):
+            rows.append({
+                "index": name,
+                "shard": sid,
+                "prirep": "p",
+                "state": "STARTED",
+                "docs": shard.num_docs,
+                "node": node.node_name,
+            })
+    return 200, _cat_format(query, rows)
+
+
+def cat_count(node: TpuNode, params, query, body):
+    total = sum(
+        s.num_docs for svc in node.indices.values() for s in svc.shards.values()
+    )
+    return 200, _cat_format(query, [{"epoch": 0, "timestamp": "-", "count": total}])

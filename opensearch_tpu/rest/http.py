@@ -1,0 +1,205 @@
+"""Asyncio HTTP/1.1 server hosting the REST layer.
+
+The analog of the reference's HTTP transport
+(server/src/main/java/org/opensearch/http/AbstractHttpServerTransport.java +
+modules/transport-netty4 Netty4HttpServerTransport): stdlib asyncio streams,
+keep-alive, content-length bodies, NDJSON detection for _bulk/_msearch, and
+the OpenSearch error envelope ({"error": {...}, "status": N}).
+
+Run: python -m opensearch_tpu.rest.http --port 9200 --data /tmp/data
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import traceback
+from typing import Any
+from urllib.parse import parse_qsl, unquote, urlsplit
+
+from opensearch_tpu.common.errors import OpenSearchTpuException
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.rest.handlers import build_router
+
+MAX_BODY = 100 * 1024 * 1024  # the reference's http.max_content_length default
+
+
+class HttpServer:
+    def __init__(self, node: TpuNode, host: str = "127.0.0.1", port: int = 9200):
+        self.node = node
+        self.host = host
+        self.port = port
+        self.router = build_router()
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_connection, self.host, self.port
+        )
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # -- connection handling ----------------------------------------------
+
+    async def _handle_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                request = await self._read_request(reader)
+                if request is None:
+                    break
+                method, path, query, headers, body = request
+                status, payload, content_type = await self._dispatch(
+                    method, path, query, body
+                )
+                keep_alive = headers.get("connection", "keep-alive") != "close"
+                await self._write_response(
+                    writer, status, payload, content_type,
+                    keep_alive=keep_alive, head=(method == "HEAD"),
+                )
+                if not keep_alive:
+                    break
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader: asyncio.StreamReader):
+        try:
+            request_line = await reader.readline()
+        except (ConnectionResetError, asyncio.LimitOverrunError):
+            return None
+        if not request_line:
+            return None
+        try:
+            method, target, _version = request_line.decode("latin1").split(" ", 2)
+        except ValueError:
+            return None
+        headers: dict[str, str] = {}
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = line.decode("latin1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+        length = int(headers.get("content-length", 0))
+        if length > MAX_BODY:
+            return method, "/_too_large", {}, headers, None
+        body = await reader.readexactly(length) if length else b""
+        split = urlsplit(target)
+        query = dict(parse_qsl(split.query, keep_blank_values=True))
+        return method, unquote(split.path), query, headers, body
+
+    # -- dispatch ----------------------------------------------------------
+
+    async def _dispatch(
+        self, method: str, path: str, query: dict, raw_body: bytes
+    ) -> tuple[int, Any, str]:
+        try:
+            if path == "/_too_large":
+                raise OpenSearchTpuException("request entity too large")
+            handler, params = self.router.resolve(method, path)
+            body = _parse_body(path, raw_body)
+            # handlers are synchronous CPU/TPU work; run them off the event
+            # loop so slow searches don't block other connections
+            status, payload = await asyncio.get_running_loop().run_in_executor(
+                None, handler, self.node, params, query, body
+            )
+            content_type = (
+                "text/plain" if isinstance(payload, str) else "application/json"
+            )
+            return status, payload, content_type
+        except OpenSearchTpuException as e:
+            return e.status, _error_envelope(e), "application/json"
+        except json.JSONDecodeError as e:
+            return 400, {
+                "error": {"type": "parse_exception", "reason": str(e)},
+                "status": 400,
+            }, "application/json"
+        except Exception as e:  # noqa: BLE001 - top-level 500 guard
+            traceback.print_exc()
+            return 500, {
+                "error": {"type": "exception", "reason": str(e)},
+                "status": 500,
+            }, "application/json"
+
+    async def _write_response(
+        self, writer, status: int, payload: Any, content_type: str,
+        keep_alive: bool, head: bool,
+    ) -> None:
+        if isinstance(payload, str):
+            data = payload.encode()
+        else:
+            data = json.dumps(payload).encode()
+        reason = {200: "OK", 201: "Created", 400: "Bad Request", 404: "Not Found",
+                  405: "Method Not Allowed", 409: "Conflict",
+                  429: "Too Many Requests", 500: "Internal Server Error",
+                  503: "Service Unavailable"}.get(status, "OK")
+        head_lines = (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"content-type: {content_type}; charset=UTF-8\r\n"
+            f"content-length: {len(data)}\r\n"
+            f"connection: {'keep-alive' if keep_alive else 'close'}\r\n\r\n"
+        )
+        writer.write(head_lines.encode() + (b"" if head else data))
+        await writer.drain()
+
+
+def _parse_body(path: str, raw: bytes) -> Any:
+    if not raw:
+        return None
+    if path.rstrip("/").endswith(("_bulk", "_msearch")):
+        lines = []
+        for line in raw.split(b"\n"):
+            line = line.strip()
+            if line:
+                lines.append(json.loads(line))
+        return lines
+    return json.loads(raw)
+
+
+def _error_envelope(e: OpenSearchTpuException) -> dict:
+    detail = e.to_dict()
+    return {
+        "error": {
+            "root_cause": [detail],
+            **detail,
+        },
+        "status": e.status,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="opensearch-tpu node")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=9200)
+    parser.add_argument("--data", default="./data")
+    args = parser.parse_args()
+    node = TpuNode(args.data)
+    server = HttpServer(node, args.host, args.port)
+    print(f"opensearch-tpu listening on http://{args.host}:{args.port}")
+    try:
+        asyncio.run(server.serve_forever())
+    except KeyboardInterrupt:
+        pass
+    finally:
+        node.close()
+
+
+if __name__ == "__main__":
+    main()

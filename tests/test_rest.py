@@ -1,0 +1,201 @@
+"""REST layer black-box tests: real HTTP against a live server.
+
+The single-node analog of the reference's yamlRestTest strategy (SURVEY.md
+§4: protocol-level suites that only speak HTTP)."""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.rest.http import HttpServer
+
+PORT = 19257
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    node = TpuNode(tmp_path_factory.mktemp("rest-node"))
+    srv = HttpServer(node, "127.0.0.1", PORT)
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        try:
+            loop.run_until_complete(srv.serve_forever())
+        except RuntimeError:
+            pass  # loop.stop() at teardown interrupts serve_forever
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    for _ in range(100):
+        try:
+            _req("GET", "/")
+            break
+        except Exception:
+            time.sleep(0.05)
+    yield srv
+    loop.call_soon_threadsafe(loop.stop)
+    node.close()
+
+
+def _req(method, path, body=None, ndjson=None, raw=False):
+    url = f"http://127.0.0.1:{PORT}{path}"
+    data = None
+    headers = {"Content-Type": "application/json"}
+    if ndjson is not None:
+        data = ("\n".join(json.dumps(l) for l in ndjson) + "\n").encode()
+        headers["Content-Type"] = "application/x-ndjson"
+    elif body is not None:
+        data = json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method, headers=headers)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, payload if raw else (json.loads(payload) if payload else None)
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, payload if raw else (json.loads(payload) if payload else None)
+
+
+def test_root_info(server):
+    status, body = _req("GET", "/")
+    assert status == 200
+    assert body["version"]["distribution"] == "opensearch-tpu"
+
+
+def test_index_lifecycle_and_doc_crud(server):
+    status, body = _req("PUT", "/books", {
+        "settings": {"index": {"number_of_shards": 2}},
+        "mappings": {"properties": {
+            "title": {"type": "text"},
+            "year": {"type": "integer"},
+        }},
+    })
+    assert status == 200 and body["acknowledged"] is True
+
+    status, body = _req("PUT", "/books/_doc/1", {"title": "Dune", "year": 1965})
+    assert status == 201 and body["result"] == "created"
+    status, body = _req("PUT", "/books/_doc/1", {"title": "Dune", "year": 1966})
+    assert status == 200 and body["result"] == "updated" and body["_version"] == 2
+
+    status, body = _req("GET", "/books/_doc/1")
+    assert status == 200 and body["_source"]["year"] == 1966
+    status, body = _req("GET", "/books/_source/1")
+    assert status == 200 and body == {"title": "Dune", "year": 1966}
+
+    status, body = _req("GET", "/books/_doc/404")
+    assert status == 404 and body["found"] is False
+
+    status, body = _req("POST", "/books/_update/1", {"doc": {"year": 1965}})
+    assert status == 200
+    status, body = _req("POST", "/books/_doc", {"title": "Hyperion", "year": 1989})
+    assert status == 201 and body["_id"]
+
+    # create conflict
+    status, body = _req("PUT", "/books/_create/1", {"title": "x"})
+    assert status == 409
+    assert body["error"]["type"] == "version_conflict_engine_exception"
+
+
+def test_search_and_count_over_http(server):
+    _req("PUT", "/lib")
+    for i, title in enumerate(["red fish", "blue fish", "old boat"]):
+        _req("PUT", f"/lib/_doc/{i}", {"title": title, "n": i})
+    _req("POST", "/lib/_refresh")
+    status, body = _req("POST", "/lib/_search", {"query": {"match": {"title": "fish"}}})
+    assert status == 200
+    assert body["hits"]["total"]["value"] == 2
+    status, body = _req("GET", "/lib/_search?q=title:boat")
+    assert body["hits"]["total"]["value"] == 1
+    status, body = _req("GET", "/lib/_count")
+    assert body["count"] == 3
+    # aggs over HTTP
+    status, body = _req("POST", "/lib/_search", {
+        "size": 0, "aggs": {"max_n": {"max": {"field": "n"}}}})
+    assert body["aggregations"]["max_n"]["value"] == 2.0
+
+
+def test_bulk_ndjson(server):
+    status, body = _req("POST", "/_bulk", ndjson=[
+        {"index": {"_index": "bk", "_id": "1"}}, {"v": 1},
+        {"index": {"_index": "bk", "_id": "2"}}, {"v": 2},
+        {"delete": {"_index": "bk", "_id": "2"}},
+    ])
+    assert status == 200 and body["errors"] is False
+    _req("POST", "/bk/_refresh")
+    status, body = _req("POST", "/bk/_search", {})
+    assert body["hits"]["total"]["value"] == 1
+
+    # default index from path
+    status, body = _req("POST", "/bk/_bulk", ndjson=[
+        {"index": {"_id": "3"}}, {"v": 3},
+    ])
+    assert status == 200 and body["items"][0]["index"]["_index"] == "bk"
+
+
+def test_msearch(server):
+    status, body = _req("POST", "/_msearch", ndjson=[
+        {"index": "lib"}, {"query": {"match_all": {}}},
+        {"index": "bk"}, {"size": 0},
+    ])
+    assert status == 200
+    assert len(body["responses"]) == 2
+    assert body["responses"][0]["hits"]["total"]["value"] == 3
+
+
+def test_cluster_and_cat_apis(server):
+    status, body = _req("GET", "/_cluster/health")
+    assert status == 200 and body["status"] == "green"
+    status, body = _req("GET", "/_cluster/stats")
+    assert body["nodes"]["count"]["total"] == 1
+    status, body = _req("GET", "/_cat/indices?format=json")
+    assert any(r["index"] == "books" for r in body)
+    status, text = _req("GET", "/_cat/indices?v", raw=True)
+    assert b"books" in text and b"health" in text
+    status, body = _req("GET", "/_nodes/stats")
+    assert body["_nodes"]["total"] == 1
+    status, body = _req("GET", "/_stats")
+    assert "_all" in body
+
+
+def test_errors_over_http(server):
+    status, body = _req("GET", "/missing_index/_search")
+    assert status == 404
+    assert body["error"]["type"] == "index_not_found_exception"
+    assert body["status"] == 404
+    status, body = _req("POST", "/lib/_search", {"query": {"bogus_query": {}}})
+    assert status == 400
+    assert body["error"]["type"] == "parsing_exception"
+    status, body = _req("DELETE", "/_cluster/health")
+    assert status == 405
+    status, body = _req("GET", "/no/such/route/at/all")
+    assert status == 400
+    # malformed JSON body
+    import urllib.request as ur
+
+    req = ur.Request(f"http://127.0.0.1:{PORT}/lib/_search",
+                     data=b"{not json", method="POST",
+                     headers={"Content-Type": "application/json"})
+    try:
+        with ur.urlopen(req) as resp:
+            status = resp.status
+    except urllib.error.HTTPError as e:
+        status = e.code
+        body = json.loads(e.read())
+    assert status == 400 and body["error"]["type"] == "parse_exception"
+
+
+def test_index_delete_and_head(server):
+    _req("PUT", "/tmpidx")
+    status, _ = _req("HEAD", "/tmpidx")
+    assert status == 200
+    status, body = _req("DELETE", "/tmpidx")
+    assert status == 200 and body["acknowledged"] is True
+    status, _ = _req("GET", "/tmpidx")
+    assert status == 404
