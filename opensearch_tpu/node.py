@@ -194,6 +194,7 @@ class TpuNode:
         routing: str | None = None,
         if_seq_no: int | None = None,
         refresh: bool = False,
+        op_type: str = "index",
     ) -> dict:
         svc = self._get_or_autocreate(index)
         if doc_id is None:
@@ -201,6 +202,13 @@ class TpuNode:
 
             doc_id = uuid.uuid4().hex[:20]
         shard = svc.shard_for(doc_id, routing)
+        if op_type == "create" and shard.get(doc_id) is not None:
+            # atomic here: all doc mutations are serialized through the
+            # node's single writer (see rest/http.py executor)
+            raise VersionConflictException(
+                f"[{doc_id}]: version conflict, document already exists "
+                "(current version [1])"
+            )
         mappers_before = len(svc.mapper_service.mappers)
         result = shard.apply_index_on_primary(doc_id, source, routing, if_seq_no=if_seq_no)
         if refresh:
@@ -288,15 +296,8 @@ class TpuNode:
             routing = meta.get("routing") or meta.get("_routing")
             try:
                 if action in ("index", "create"):
-                    if action == "create" and doc_id is not None:
-                        existing = None
-                        if index in self.indices:
-                            existing = self._get_index(index).shard_for(doc_id, routing).get(doc_id)
-                        if existing is not None:
-                            raise VersionConflictException(
-                                f"[{doc_id}]: version conflict, document already exists"
-                            )
-                    resp = self.index_doc(index, doc_id, source, routing)
+                    resp = self.index_doc(index, doc_id, source, routing,
+                                          op_type=action)
                     status = 201 if resp["result"] == "created" else 200
                 elif action == "update":
                     resp = self.update_doc(index, doc_id, source, routing)

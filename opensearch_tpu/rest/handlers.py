@@ -160,18 +160,14 @@ def index_doc_auto_id(node: TpuNode, params, query, body):
 
 
 def create_doc(node: TpuNode, params, query, body):
-    from opensearch_tpu.common.errors import VersionConflictException
-
-    existing = None
-    if params["index"] in node.indices:
-        existing = node.indices[params["index"]].shard_for(
-            params["id"], query.get("routing")
-        ).get(params["id"])
-    if existing is not None:
-        raise VersionConflictException(
-            f"[{params['id']}]: version conflict, document already exists"
-        )
-    return index_doc(node, params, query, body)
+    if body is None:
+        raise IllegalArgumentException("request body is required")
+    resp = node.index_doc(
+        params["index"], params["id"], body,
+        routing=query.get("routing"), refresh=_refresh_param(query),
+        op_type="create",
+    )
+    return 201, resp
 
 
 def get_doc(node: TpuNode, params, query, body):

@@ -15,6 +15,7 @@ import argparse
 import asyncio
 import json
 import traceback
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 from urllib.parse import parse_qsl, unquote, urlsplit
 
@@ -25,6 +26,14 @@ from opensearch_tpu.rest.handlers import build_router
 MAX_BODY = 100 * 1024 * 1024  # the reference's http.max_content_length default
 
 
+class _BadRequest(Exception):
+    pass
+
+
+class _EntityTooLarge(Exception):
+    pass
+
+
 class HttpServer:
     def __init__(self, node: TpuNode, host: str = "127.0.0.1", port: int = 9200):
         self.node = node
@@ -32,6 +41,11 @@ class HttpServer:
         self.port = port
         self.router = build_router()
         self._server: asyncio.AbstractServer | None = None
+        # single worker: TpuNode/IndexShard mutation paths are not
+        # thread-safe; the engine is single-writer (like the reference's
+        # per-shard write semantics). Read/write concurrency is a later
+        # refinement (per-shard executors).
+        self._executor = ThreadPoolExecutor(max_workers=1)
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -56,7 +70,25 @@ class HttpServer:
     ) -> None:
         try:
             while True:
-                request = await self._read_request(reader)
+                try:
+                    request = await self._read_request(reader)
+                except _BadRequest as e:
+                    await self._write_response(
+                        writer, 400,
+                        {"error": {"type": "parse_exception", "reason": str(e)},
+                         "status": 400},
+                        "application/json", keep_alive=False, head=False,
+                    )
+                    break
+                except _EntityTooLarge:
+                    await self._write_response(
+                        writer, 413,
+                        {"error": {"type": "content_too_large_exception",
+                                   "reason": "request entity too large"},
+                         "status": 413},
+                        "application/json", keep_alive=False, head=False,
+                    )
+                    break
                 if request is None:
                     break
                 method, path, query, headers, body = request
@@ -97,9 +129,12 @@ class HttpServer:
                 break
             name, _, value = line.decode("latin1").partition(":")
             headers[name.strip().lower()] = value.strip()
-        length = int(headers.get("content-length", 0))
+        try:
+            length = int(headers.get("content-length", 0))
+        except ValueError as e:
+            raise _BadRequest(f"invalid Content-Length header") from e
         if length > MAX_BODY:
-            return method, "/_too_large", {}, headers, None
+            raise _EntityTooLarge()
         body = await reader.readexactly(length) if length else b""
         split = urlsplit(target)
         query = dict(parse_qsl(split.query, keep_blank_values=True))
@@ -111,14 +146,12 @@ class HttpServer:
         self, method: str, path: str, query: dict, raw_body: bytes
     ) -> tuple[int, Any, str]:
         try:
-            if path == "/_too_large":
-                raise OpenSearchTpuException("request entity too large")
             handler, params = self.router.resolve(method, path)
             body = _parse_body(path, raw_body)
-            # handlers are synchronous CPU/TPU work; run them off the event
-            # loop so slow searches don't block other connections
+            # handlers are synchronous work; run them off the event loop so
+            # slow searches don't stall socket IO (single worker — see ctor)
             status, payload = await asyncio.get_running_loop().run_in_executor(
-                None, handler, self.node, params, query, body
+                self._executor, handler, self.node, params, query, body
             )
             content_type = (
                 "text/plain" if isinstance(payload, str) else "application/json"
@@ -148,7 +181,8 @@ class HttpServer:
             data = json.dumps(payload).encode()
         reason = {200: "OK", 201: "Created", 400: "Bad Request", 404: "Not Found",
                   405: "Method Not Allowed", 409: "Conflict",
-                  429: "Too Many Requests", 500: "Internal Server Error",
+                  413: "Content Too Large", 429: "Too Many Requests",
+                  500: "Internal Server Error",
                   503: "Service Unavailable"}.get(status, "OK")
         head_lines = (
             f"HTTP/1.1 {status} {reason}\r\n"
@@ -163,7 +197,9 @@ class HttpServer:
 def _parse_body(path: str, raw: bytes) -> Any:
     if not raw:
         return None
-    if path.rstrip("/").endswith(("_bulk", "_msearch")):
+    # NDJSON only when the LAST path segment is the bulk/msearch endpoint
+    # (a doc id like "report_bulk" must not trigger NDJSON parsing)
+    if path.rstrip("/").rsplit("/", 1)[-1] in ("_bulk", "_msearch"):
         lines = []
         for line in raw.split(b"\n"):
             line = line.strip()

@@ -1,0 +1,200 @@
+"""Deterministic simulation: virtual time + disruptable in-memory transport.
+
+The analog of the reference's coordination test harness (SURVEY.md §4 tier
+3): DeterministicTaskQueue (test/framework/.../coordination/
+DeterministicTaskQueue.java:62 — virtual time, runAllTasksInTimeOrder:111,
+advanceTime:201) and DisruptableMockTransport (programmable partitions and
+delays, no threads, no sockets). Seeded randomness makes every run
+replayable; safety properties of the election/publication protocol are
+checked over thousands of virtual-time steps in milliseconds of real time.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+@dataclass(order=True)
+class _Task:
+    time_ms: int
+    seq: int
+    fn: Callable[[], None] = field(compare=False)
+    cancelled: bool = field(default=False, compare=False)
+
+
+class Cancellable:
+    def __init__(self, task: _Task):
+        self._task = task
+
+    def cancel(self) -> None:
+        self._task.cancelled = True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._task.cancelled
+
+
+class DeterministicTaskQueue:
+    """Virtual-time scheduler. All protocol timers and message deliveries
+    run through here, in (time, insertion) order."""
+
+    def __init__(self, seed: int = 0):
+        self.now_ms = 0
+        self.random = random.Random(seed)
+        self._seq = 0
+        self._heap: list[_Task] = []
+
+    def schedule(self, delay_ms: int, fn: Callable[[], None]) -> Cancellable:
+        self._seq += 1
+        task = _Task(self.now_ms + max(int(delay_ms), 0), self._seq, fn)
+        heapq.heappush(self._heap, task)
+        return Cancellable(task)
+
+    def has_tasks(self) -> bool:
+        return any(not t.cancelled for t in self._heap)
+
+    def run_one(self) -> bool:
+        while self._heap:
+            task = heapq.heappop(self._heap)
+            if task.cancelled:
+                continue
+            self.now_ms = max(self.now_ms, task.time_ms)
+            task.fn()
+            return True
+        return False
+
+    def run_until(self, time_ms: int) -> None:
+        while self._heap:
+            # drop cancelled heads first so the deadline check sees the next
+            # LIVE task (a cancelled head must not let later tasks run early)
+            while self._heap and self._heap[0].cancelled:
+                heapq.heappop(self._heap)
+            if not self._heap or self._heap[0].time_ms > time_ms:
+                break
+            self.run_one()
+        self.now_ms = max(self.now_ms, time_ms)
+
+    def run_all(self, max_tasks: int = 100_000) -> None:
+        n = 0
+        while self.run_one():
+            n += 1
+            if n >= max_tasks:
+                raise RuntimeError("task queue did not quiesce (livelock?)")
+
+
+class MockTransport:
+    """In-memory message bus with programmable disruption.
+
+    Handlers: register(node, action, handler) where
+    handler(sender_id, payload) -> response payload (or raises).
+    send(...) delivers via the task queue with a random bounded delay;
+    blackholed links silently drop (the two-sided NetworkDisruption
+    scheme); a dropped request surfaces as a timeout-style failure callback
+    after `timeout_ms` of virtual time.
+    """
+
+    def __init__(self, queue: DeterministicTaskQueue,
+                 min_delay_ms: int = 1, max_delay_ms: int = 20,
+                 timeout_ms: int = 1_000):
+        self.queue = queue
+        self.min_delay_ms = min_delay_ms
+        self.max_delay_ms = max_delay_ms
+        self.timeout_ms = timeout_ms
+        self.handlers: dict[tuple[str, str], Callable] = {}
+        self.blackholed: set[tuple[str, str]] = set()
+        self.down: set[str] = set()
+        self.stats = {"sent": 0, "dropped": 0, "delivered": 0}
+
+    # -- disruption schemes (test/framework/.../disruption analog) ---------
+
+    def partition(self, group_a: set[str], group_b: set[str]) -> None:
+        for a in group_a:
+            for b in group_b:
+                self.blackholed.add((a, b))
+                self.blackholed.add((b, a))
+
+    def heal(self) -> None:
+        self.blackholed.clear()
+
+    def isolate(self, node_id: str, others: set[str]) -> None:
+        self.partition({node_id}, others - {node_id})
+
+    def take_down(self, node_id: str) -> None:
+        self.down.add(node_id)
+
+    def bring_up(self, node_id: str) -> None:
+        self.down.discard(node_id)
+
+    def _link_ok(self, a: str, b: str) -> bool:
+        return (
+            (a, b) not in self.blackholed
+            and a not in self.down
+            and b not in self.down
+        )
+
+    # -- messaging ---------------------------------------------------------
+
+    def register(self, node_id: str, action: str, handler: Callable) -> None:
+        self.handlers[(node_id, action)] = handler
+
+    def send(
+        self,
+        sender: str,
+        target: str,
+        action: str,
+        payload: Any,
+        on_response: Callable[[Any], None] | None = None,
+        on_failure: Callable[[Exception], None] | None = None,
+    ) -> None:
+        self.stats["sent"] += 1
+        delay = self.queue.random.randint(self.min_delay_ms, self.max_delay_ms)
+
+        if not self._link_ok(sender, target):
+            self.stats["dropped"] += 1
+            if on_failure is not None:
+                self.queue.schedule(
+                    self.timeout_ms,
+                    lambda: on_failure(TimeoutError(f"{action} to {target} timed out")),
+                )
+            return
+
+        def deliver() -> None:
+            # the link (or target) may have failed while in flight
+            if not self._link_ok(sender, target):
+                self.stats["dropped"] += 1
+                if on_failure is not None:
+                    self.queue.schedule(
+                        self.timeout_ms - delay,
+                        lambda: on_failure(TimeoutError(f"{action} to {target} timed out")),
+                    )
+                return
+            handler = self.handlers.get((target, action))
+            if handler is None:
+                if on_failure is not None:
+                    on_failure(RuntimeError(f"no handler for {action} on {target}"))
+                return
+            self.stats["delivered"] += 1
+            try:
+                response = handler(sender, payload)
+            except Exception as e:  # noqa: BLE001 - remote errors travel back
+                if on_failure is not None:
+                    back = self.queue.random.randint(self.min_delay_ms, self.max_delay_ms)
+                    # bind eagerly: the except variable is unbound once the
+                    # block exits
+                    self.queue.schedule(back, lambda err=e: on_failure(err))
+                return
+            if on_response is not None:
+                back = self.queue.random.randint(self.min_delay_ms, self.max_delay_ms)
+
+                def respond() -> None:
+                    if self._link_ok(target, sender):
+                        on_response(response)
+                    elif on_failure is not None:
+                        on_failure(TimeoutError(f"response from {target} lost"))
+
+                self.queue.schedule(back, respond)
+
+        self.queue.schedule(delay, deliver)

@@ -1,0 +1,337 @@
+"""Deterministic simulation of the coordination protocol (no threads, no
+sockets — virtual time). Mirrors the reference's AbstractCoordinatorTestCase
+safety checks: at most one leader per term, committed-state lineage is
+linear, the cluster re-forms after partitions and leader loss."""
+
+import pytest
+
+from opensearch_tpu.cluster.coordination import (
+    CoordinationError,
+    CoordinationState,
+    PublishRequest,
+    PublishResponse,
+    StartJoinRequest,
+)
+from opensearch_tpu.cluster.coordinator import Coordinator, Mode
+from opensearch_tpu.cluster.state import (
+    ClusterState,
+    DiscoveryNode,
+    VotingConfiguration,
+    apply_diff,
+    diff_states,
+)
+from opensearch_tpu.testing.sim import DeterministicTaskQueue, MockTransport
+
+
+# --------------------------------------------------------------------------
+# CoordinationState unit safety
+# --------------------------------------------------------------------------
+
+
+def _state(term=1, version=1, config=("n1", "n2", "n3")):
+    vc = VotingConfiguration(frozenset(config))
+    return ClusterState(term=term, version=version,
+                        last_committed_config=vc, last_accepted_config=vc)
+
+
+def test_single_vote_per_term():
+    cs = CoordinationState("n1")
+    cs.persisted.accepted_state = _state(term=0, version=0)
+    join = cs.handle_start_join(StartJoinRequest("n2", 1))
+    assert join.term == 1 and join.candidate_id == "n2"
+    with pytest.raises(CoordinationError, match="not greater"):
+        cs.handle_start_join(StartJoinRequest("n3", 1))  # second vote, same term
+
+
+def test_stale_candidate_rejected():
+    cs = CoordinationState("n1")
+    cs.persisted.accepted_state = _state(term=5, version=10)
+    cs.handle_start_join(StartJoinRequest("n1", 6))
+    # a voter that has accepted a NEWER state than ours must be rejected
+    from opensearch_tpu.cluster.coordination import Join
+
+    with pytest.raises(CoordinationError, match="higher"):
+        cs.handle_join(Join("n2", "n1", 6, last_accepted_term=7,
+                            last_accepted_version=1))
+    with pytest.raises(CoordinationError, match="higher"):
+        cs.handle_join(Join("n2", "n1", 6, last_accepted_term=5,
+                            last_accepted_version=11))
+    # equal/behind is fine
+    cs.handle_join(Join("n2", "n1", 6, 5, 10))
+
+
+def test_election_requires_quorum_of_both_configs():
+    cs = CoordinationState("n1")
+    state = _state(term=0, version=1)
+    cs.persisted.accepted_state = state
+    cs.handle_start_join(StartJoinRequest("n1", 1))
+    from opensearch_tpu.cluster.coordination import Join
+
+    assert not cs.handle_join(Join("n1", "n1", 1, 0, 1))   # 1/3 votes
+    assert cs.handle_join(Join("n2", "n1", 1, 0, 1))       # 2/3 -> quorum
+    assert cs.election_won
+
+
+def test_publish_and_commit_quorum():
+    cs = CoordinationState("n1")
+    cs.persisted.accepted_state = _state(term=0, version=1)
+    cs.handle_start_join(StartJoinRequest("n1", 1))
+    from opensearch_tpu.cluster.coordination import Join
+
+    cs.handle_join(Join("n1", "n1", 1, 0, 1))
+    cs.handle_join(Join("n2", "n1", 1, 0, 1))
+    new_state = _state(term=1, version=2)
+    pub = cs.handle_client_value(new_state)
+    resp = cs.handle_publish_request(pub)    # self-accept
+    assert cs.handle_publish_response("n1", resp) is None  # 1/3
+    commit = cs.handle_publish_response("n2", resp)        # 2/3
+    assert commit is not None and commit.version == 2
+    applied = cs.handle_commit(commit)
+    assert applied.version == 2
+    # commit for a mismatched version must fail
+    from opensearch_tpu.cluster.coordination import ApplyCommitRequest
+
+    with pytest.raises(CoordinationError):
+        cs.handle_commit(ApplyCommitRequest(term=1, version=99))
+
+
+def test_state_diff_roundtrip():
+    s1 = _state(term=1, version=1)
+    s2 = s1.next_version(
+        nodes={"n1": DiscoveryNode("n1"), "n2": DiscoveryNode("n2")},
+        leader_id="n1", term=2,
+    )
+    diff = diff_states(s1, s2)
+    restored = apply_diff(s1, diff)
+    assert restored == s2
+    with pytest.raises(ValueError):
+        apply_diff(_state(term=1, version=7), diff)
+
+
+# --------------------------------------------------------------------------
+# whole-cluster simulation
+# --------------------------------------------------------------------------
+
+
+class SimCluster:
+    def __init__(self, n_nodes: int, seed: int):
+        self.queue = DeterministicTaskQueue(seed)
+        self.transport = MockTransport(self.queue, timeout_ms=400)
+        self.node_ids = [f"n{i}" for i in range(n_nodes)]
+        self.coordinators: dict[str, Coordinator] = {}
+        self.committed_log: list[tuple[str, int, int]] = []  # (node, term, version)
+        for nid in self.node_ids:
+            node = DiscoveryNode(node_id=nid, name=nid)
+            coord = Coordinator(
+                node, list(self.node_ids), self.transport, self.queue,
+                on_state_applied=self._track(nid),
+            )
+            self.coordinators[nid] = coord
+        # bootstrap the voting config on every node (same initial config)
+        for coord in self.coordinators.values():
+            coord.bootstrap(self.node_ids)
+
+    def _track(self, nid):
+        def cb(state):
+            self.committed_log.append((nid, state.term, state.version))
+        return cb
+
+    def start(self):
+        for c in self.coordinators.values():
+            c.start()
+
+    def run(self, ms: int):
+        self.queue.run_until(self.queue.now_ms + ms)
+
+    def leaders(self):
+        return [c for c in self.coordinators.values() if c.mode == Mode.LEADER]
+
+    def assert_safety(self):
+        # 1. at most one leader per term (across the whole history we only
+        #    check the current instant here; term uniqueness is below)
+        leaders = self.leaders()
+        terms = [c.coord.current_term for c in leaders]
+        assert len(set(terms)) == len(terms), f"two leaders share a term: {terms}"
+        # 2. committed lineage: for a given (term, version) every node that
+        #    applied it must have identical content — here versions must be
+        #    monotonic per node
+        per_node: dict[str, int] = {}
+        for nid, term, version in self.committed_log:
+            assert version >= per_node.get(nid, 0), (
+                f"{nid} applied version {version} after {per_node.get(nid)}"
+            )
+            per_node[nid] = version
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 7])
+def test_cluster_elects_single_leader(seed):
+    sim = SimCluster(3, seed)
+    sim.start()
+    sim.run(5_000)
+    leaders = sim.leaders()
+    assert len(leaders) == 1, f"expected one leader, got {[c.node_id for c in leaders]}"
+    leader = leaders[0]
+    # every other node follows it
+    for c in sim.coordinators.values():
+        if c is not leader:
+            assert c.mode == Mode.FOLLOWER
+            assert c.leader_id == leader.node_id
+    # the leader published a state containing the cluster
+    assert leader.applied_state.leader_id == leader.node_id
+    assert set(leader.applied_state.nodes) >= {leader.node_id}
+    sim.assert_safety()
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_leader_failure_triggers_reelection(seed):
+    sim = SimCluster(3, seed)
+    sim.start()
+    sim.run(5_000)
+    (old_leader,) = sim.leaders()
+    sim.transport.take_down(old_leader.node_id)
+    sim.run(10_000)
+    live = [c for c in sim.coordinators.values()
+            if c.node_id != old_leader.node_id]
+    new_leaders = [c for c in live if c.mode == Mode.LEADER]
+    assert len(new_leaders) == 1
+    assert new_leaders[0].coord.current_term > old_leader.coord.current_term
+    sim.assert_safety()
+
+
+@pytest.mark.parametrize("seed", [5, 13])
+def test_partition_minority_cannot_elect(seed):
+    sim = SimCluster(5, seed)
+    sim.start()
+    sim.run(5_000)
+    (leader,) = sim.leaders()
+    # partition the leader with one other node (minority side)
+    others = [nid for nid in sim.node_ids if nid != leader.node_id]
+    minority = {leader.node_id, others[0]}
+    majority = set(others[1:])
+    sim.transport.partition(minority, majority)
+    sim.run(15_000)
+    majority_leaders = [
+        c for c in sim.coordinators.values()
+        if c.node_id in majority and c.mode == Mode.LEADER
+    ]
+    assert len(majority_leaders) == 1, "majority side must elect a leader"
+    # the minority MUST NOT have a leader that committed anything new:
+    # its publications can't reach quorum
+    new_leader = majority_leaders[0]
+    assert new_leader.coord.current_term > 0
+    sim.assert_safety()
+    # heal: everyone converges on one leader again
+    sim.transport.heal()
+    sim.run(15_000)
+    final_leaders = sim.leaders()
+    assert len(final_leaders) == 1
+    final = final_leaders[0]
+    for c in sim.coordinators.values():
+        if c is not final:
+            assert c.mode == Mode.FOLLOWER and c.leader_id == final.node_id
+    sim.assert_safety()
+
+
+def test_committed_states_identical_across_nodes():
+    sim = SimCluster(3, seed=21)
+    sim.start()
+    sim.run(5_000)
+    (leader,) = sim.leaders()
+    # push a few metadata updates through the leader
+    from opensearch_tpu.cluster.state import IndexMeta
+
+    for i in range(3):
+        name = f"idx-{i}"
+        leader.submit_state_update(
+            lambda s, name=name: s.with_(
+                indices={**s.indices, name: IndexMeta(name, 2, 1)}
+            )
+        )
+        sim.run(2_000)
+    for c in sim.coordinators.values():
+        assert set(c.applied_state.indices) == {"idx-0", "idx-1", "idx-2"}, c.node_id
+        assert c.applied_state.version == leader.applied_state.version
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_disruption_safety(seed):
+    """Random partitions/node-kills/heals over virtual hours: committed
+    (term, version) pairs must be globally consistent and per-node versions
+    monotonic (the linearizability-style check of AbstractCoordinatorTestCase)."""
+    sim = SimCluster(5, seed=100 + seed)
+    committed_content: dict[tuple[int, int], str] = {}
+
+    for nid, c in sim.coordinators.items():
+        def cb(state, nid=nid):
+            key = (state.term, state.version)
+            content = repr(sorted(state.nodes)) + repr(sorted(state.indices))
+            if key in committed_content:
+                assert committed_content[key] == content, (
+                    f"divergent committed state at {key}"
+                )
+            else:
+                committed_content[key] = content
+            sim.committed_log.append((nid, state.term, state.version))
+        c.on_state_applied = cb
+
+    sim.start()
+    rng = sim.queue.random
+    all_nodes = set(sim.node_ids)
+    for _round in range(12):
+        sim.run(rng.randint(500, 4_000))
+        action = rng.choice(["partition", "kill", "heal", "nothing"])
+        if action == "partition":
+            k = rng.randint(1, 2)
+            side = set(rng.sample(sim.node_ids, k))
+            sim.transport.heal()
+            sim.transport.partition(side, all_nodes - side)
+        elif action == "kill":
+            victim = rng.choice(sim.node_ids)
+            sim.transport.take_down(victim)
+        elif action == "heal":
+            sim.transport.heal()
+            for nid in list(sim.transport.down):
+                sim.transport.bring_up(nid)
+        sim.assert_safety()
+    # final heal: the cluster must converge to exactly one leader
+    sim.transport.heal()
+    for nid in list(sim.transport.down):
+        sim.transport.bring_up(nid)
+    sim.run(30_000)
+    assert len(sim.leaders()) == 1
+    sim.assert_safety()
+
+
+def test_reconfiguration_requires_quorum_in_new_config():
+    """A leader may not publish a voting-config change unless its join votes
+    also have quorum in the NEW config (split-brain guard)."""
+    from opensearch_tpu.cluster.coordination import Join
+
+    cs = CoordinationState("nA")
+    cs.persisted.accepted_state = _state(term=0, version=1, config=("nA", "nB", "nC"))
+    cs.handle_start_join(StartJoinRequest("nA", 1))
+    cs.handle_join(Join("nA", "nA", 1, 0, 1))
+    cs.handle_join(Join("nB", "nA", 1, 0, 1))
+    assert cs.election_won
+    # try to reconfigure to a disjoint config the leader has no votes in
+    new_cfg = VotingConfiguration.of("nD", "nE", "nF")
+    bad = cs.last_accepted_state.with_(term=1, version=2, last_accepted_config=new_cfg)
+    with pytest.raises(CoordinationError, match="quorum for new config"):
+        cs.handle_client_value(bad)
+    # reconfiguring to a config our voters do cover is fine
+    ok_cfg = VotingConfiguration.of("nA", "nB")
+    ok = cs.last_accepted_state.with_(term=1, version=2, last_accepted_config=ok_cfg)
+    cs.handle_client_value(ok)
+
+
+def test_run_until_does_not_execute_past_deadline():
+    q = DeterministicTaskQueue(0)
+    fired = []
+    c = q.schedule(50, lambda: fired.append("cancelled-timer"))
+    q.schedule(500, lambda: fired.append("late"))
+    c.cancel()
+    q.run_until(100)
+    assert fired == []          # the 500ms task must NOT run at t<=100
+    assert q.now_ms == 100
+    q.run_until(600)
+    assert fired == ["late"]

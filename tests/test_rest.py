@@ -199,3 +199,34 @@ def test_index_delete_and_head(server):
     assert status == 200 and body["acknowledged"] is True
     status, _ = _req("GET", "/tmpidx")
     assert status == 404
+
+
+def test_ndjson_only_for_last_segment(server):
+    # a doc id ending in _bulk must not trigger NDJSON parsing
+    status, body = _req("PUT", "/lib/_doc/report_bulk", {"title": "report"})
+    assert status == 201
+    status, body = _req("GET", "/lib/_doc/report_bulk")
+    assert body["_source"] == {"title": "report"}
+
+
+def test_malformed_content_length(server):
+    import socket
+
+    s = socket.create_connection(("127.0.0.1", PORT))
+    s.sendall(b"POST /lib/_search HTTP/1.1\r\ncontent-length: abc\r\n\r\n")
+    resp = s.recv(65536).decode()
+    s.close()
+    assert resp.startswith("HTTP/1.1 400")
+    assert "parse_exception" in resp
+
+
+def test_oversized_body_rejected_413(server):
+    import socket
+
+    s = socket.create_connection(("127.0.0.1", PORT))
+    s.sendall(
+        b"POST /_bulk HTTP/1.1\r\ncontent-length: 200000000\r\n\r\n"
+    )
+    resp = s.recv(65536).decode()
+    s.close()
+    assert resp.startswith("HTTP/1.1 413")
