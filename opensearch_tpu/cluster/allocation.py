@@ -1,0 +1,172 @@
+"""Shard allocation: deciders + balanced allocator.
+
+The analog of the reference's allocation service
+(server/src/main/java/org/opensearch/cluster/routing/allocation/ —
+AllocationService.reroute, BalancedShardsAllocator, and the decider chain
+under allocation/decider/). Implemented deciders (of the reference's 25):
+
+- SameShardAllocationDecider: never two copies of a shard on one node
+- FilterAllocationDecider: index.routing.allocation.{require,exclude}._name
+- ThrottlingAllocationDecider: bounded concurrent recoveries per node
+- MaxRetryAllocationDecider analog is implicit (unassigned stays unassigned)
+
+The allocator assigns primaries first (availability), then replicas, always
+to the data node with the fewest shards that all deciders approve
+(BalancedShardsAllocator's weight function reduced to shard count; the
+full weight function with index-level balance is a later refinement).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from opensearch_tpu.cluster.state import (
+    ClusterState,
+    ShardRoutingEntry,
+)
+
+
+@dataclass
+class AllocationSettings:
+    max_concurrent_recoveries_per_node: int = 4
+
+
+def _decide(
+    state: ClusterState,
+    entry: ShardRoutingEntry,
+    node_id: str,
+    assignments: list[ShardRoutingEntry],
+    settings: AllocationSettings,
+) -> bool:
+    node = state.nodes.get(node_id)
+    if node is None or not node.is_data:
+        return False
+    # SameShardAllocationDecider
+    for r in assignments:
+        if (
+            r.index == entry.index
+            and r.shard == entry.shard
+            and r.node_id == node_id
+            and r.state != "UNASSIGNED"
+        ):
+            return False
+    # FilterAllocationDecider
+    meta = state.indices.get(entry.index)
+    if meta is not None:
+        require = meta.settings.get("routing.allocation.require._name")
+        if require is not None and node.name != require:
+            return False
+        exclude = meta.settings.get("routing.allocation.exclude._name")
+        if exclude is not None and node.name in str(exclude).split(","):
+            return False
+    # ThrottlingAllocationDecider: cap INITIALIZING shards per node
+    initializing = sum(
+        1 for r in assignments
+        if r.node_id == node_id and r.state == "INITIALIZING"
+    )
+    if initializing >= settings.max_concurrent_recoveries_per_node:
+        return False
+    return True
+
+
+def reroute(state: ClusterState, settings: AllocationSettings | None = None) -> ClusterState:
+    """Compute a new routing table: build desired shard copies from index
+    metadata, keep valid existing assignments, allocate the rest."""
+    settings = settings or AllocationSettings()
+    existing: dict[tuple[str, int, bool, str | None], ShardRoutingEntry] = {}
+    for r in state.routing:
+        existing[(r.index, r.shard, r.primary, r.node_id)] = r
+
+    new_routing: list[ShardRoutingEntry] = []
+    data_nodes = [n.node_id for n in state.nodes.values() if n.is_data]
+
+    def node_load(node_id: str) -> int:
+        return sum(1 for r in new_routing if r.node_id == node_id)
+
+    for index_name in sorted(state.indices):
+        meta = state.indices[index_name]
+        for shard in range(meta.num_shards):
+            copies_needed = [True] + [False] * meta.num_replicas  # primary first
+            # keep currently assigned copies whose node still exists
+            current = [
+                r for r in state.routing
+                if r.index == index_name and r.shard == shard
+                and r.node_id in state.nodes and r.state != "UNASSIGNED"
+            ]
+            current_primary = next((r for r in current if r.primary), None)
+            current_replicas = [r for r in current if not r.primary]
+
+            if current_primary is not None:
+                new_routing.append(current_primary)
+            else:
+                # promote a started replica to primary (failover) before
+                # allocating a fresh one (the in-sync promotion path)
+                promoted = next(
+                    (r for r in current_replicas if r.state == "STARTED"), None
+                )
+                if promoted is not None:
+                    current_replicas.remove(promoted)
+                    new_routing.append(
+                        ShardRoutingEntry(index_name, shard, promoted.node_id,
+                                          primary=True, state=promoted.state)
+                    )
+                else:
+                    # fresh primary allocation
+                    candidates = sorted(
+                        (nid for nid in data_nodes
+                         if _decide(state, ShardRoutingEntry(index_name, shard, None, True),
+                                    nid, new_routing, settings)),
+                        key=lambda nid: (node_load(nid), nid),
+                    )
+                    if candidates:
+                        new_routing.append(
+                            ShardRoutingEntry(index_name, shard, candidates[0],
+                                              primary=True, state="INITIALIZING")
+                        )
+                    else:
+                        new_routing.append(
+                            ShardRoutingEntry(index_name, shard, None,
+                                              primary=True, state="UNASSIGNED")
+                        )
+
+            kept = current_replicas[: meta.num_replicas]
+            new_routing.extend(kept)
+            for _ in range(meta.num_replicas - len(kept)):
+                entry = ShardRoutingEntry(index_name, shard, None, primary=False)
+                candidates = sorted(
+                    (nid for nid in data_nodes
+                     if _decide(state, entry, nid, new_routing, settings)),
+                    key=lambda nid: (node_load(nid), nid),
+                )
+                if candidates:
+                    new_routing.append(
+                        ShardRoutingEntry(index_name, shard, candidates[0],
+                                          primary=False, state="INITIALIZING")
+                    )
+                else:
+                    new_routing.append(entry)  # UNASSIGNED
+
+    return state.with_(routing=tuple(new_routing))
+
+
+def mark_shard_started(
+    state: ClusterState, index: str, shard: int, node_id: str
+) -> ClusterState:
+    """shard-started master task (ShardStateAction analog)."""
+    routing = tuple(
+        r if not (r.index == index and r.shard == shard and r.node_id == node_id)
+        else ShardRoutingEntry(r.index, r.shard, r.node_id, r.primary, "STARTED")
+        for r in state.routing
+    )
+    return state.with_(routing=routing)
+
+
+def mark_shard_failed(
+    state: ClusterState, index: str, shard: int, node_id: str
+) -> ClusterState:
+    routing = tuple(
+        r if not (r.index == index and r.shard == shard and r.node_id == node_id)
+        else ShardRoutingEntry(r.index, r.shard, None, r.primary, "UNASSIGNED")
+        for r in state.routing
+    )
+    return reroute(state.with_(routing=routing))

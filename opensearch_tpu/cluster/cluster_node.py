@@ -1,0 +1,566 @@
+"""ClusterNode: a full node — coordinator + data shards + action handlers.
+
+Ties the control plane to the data plane the way the reference wires
+Node.java: the Coordinator publishes cluster states; every node's
+IndicesClusterStateService analog (`_apply_cluster_state`) creates/removes
+local IndexShards to match the routing table and runs replica recovery;
+write operations route to the primary and fan out to started replicas
+(TransportReplicationAction / ReplicationOperation.java:77 semantics);
+search scatter-gathers over one copy of each shard (SURVEY.md §3.2).
+
+Transport actions (names mirror the reference's):
+    cluster:admin/create_index, cluster:admin/delete_index   (leader)
+    internal:cluster/shard_started                           (leader)
+    indices:data/write[p]  indices:data/write[r]             (data)
+    indices:data/read/get, indices:data/read/search[shard]   (data)
+    internal:index/shard/recovery/start                      (data: source)
+
+Recovery model (v1, ops-based): the replica pulls a full live-doc dump +
+seq_nos from the primary (the retention-lease ops path of
+RecoverySourceHandler.recoverToTarget:171 reduced to its logical core),
+then reports shard-started to the leader. Segment(-file) replication is the
+planned physical path (indices/replication/ analog) once transport carries
+binary payloads.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Callable
+
+from opensearch_tpu.common.errors import (
+    IndexNotFoundException,
+    OpenSearchTpuException,
+    ShardNotFoundException,
+)
+from opensearch_tpu.common.hashing import shard_id_for_routing
+from opensearch_tpu.cluster.allocation import (
+    mark_shard_started,
+    reroute,
+)
+from opensearch_tpu.cluster.coordinator import Coordinator, Mode
+from opensearch_tpu.cluster.state import (
+    ClusterState,
+    DiscoveryNode,
+    IndexMeta,
+    ShardRoutingEntry,
+)
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.shard import IndexShard, ShardId
+from opensearch_tpu.search import query_dsl
+from opensearch_tpu.search.executor import execute_query_phase
+from opensearch_tpu.search.service import _source_filter
+
+
+class ClusterNode:
+    def __init__(
+        self,
+        node_id: str,
+        data_path: str | Path,
+        transport,
+        scheduler,
+        peers: list[str],
+        roles: tuple[str, ...] = ("cluster_manager", "data"),
+    ):
+        self.node_id = node_id
+        self.data_path = Path(data_path)
+        self.transport = transport
+        self.scheduler = scheduler
+        self.node = DiscoveryNode(node_id=node_id, name=node_id, roles=roles)
+        self.coordinator = Coordinator(
+            self.node, peers, transport, scheduler,
+            on_state_applied=self._apply_cluster_state,
+            # every publication passes through allocation: node joins/leaves
+            # re-assign shards, promote replicas, fill replica slots
+            state_transform=reroute,
+        )
+        self.local_shards: dict[tuple[str, int], IndexShard] = {}
+        self._mapper_services: dict[str, MapperService] = {}
+        self._index_versions: dict[str, int] = {}
+
+        reg = transport.register
+        reg(node_id, "cluster:admin/create_index", self._on_create_index)
+        reg(node_id, "cluster:admin/delete_index", self._on_delete_index)
+        reg(node_id, "cluster:admin/put_mapping", self._on_put_mapping)
+        reg(node_id, "internal:cluster/shard_started", self._on_shard_started)
+        reg(node_id, "indices:data/write[p]", self._on_primary_write)
+        reg(node_id, "indices:data/write[r]", self._on_replica_write)
+        reg(node_id, "indices:data/read/get", self._on_get)
+        reg(node_id, "indices:data/read/search[shard]", self._on_shard_search)
+        reg(node_id, "indices:admin/refresh[shard]", self._on_shard_refresh)
+        reg(node_id, "internal:index/shard/recovery/start", self._on_start_recovery)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.coordinator.start()
+
+    def bootstrap(self, voting_ids: list[str]) -> None:
+        self.coordinator.bootstrap(voting_ids)
+
+    @property
+    def applied_state(self) -> ClusterState:
+        return self.coordinator.applied_state
+
+    @property
+    def is_leader(self) -> bool:
+        return self.coordinator.mode == Mode.LEADER
+
+    # ------------------------------------------------------------------ #
+    # cluster state application (IndicesClusterStateService analog)
+    # ------------------------------------------------------------------ #
+
+    def _mapper_for(self, index: str, state: ClusterState) -> MapperService:
+        meta = state.indices[index]
+        ms = self._mapper_services.get(index)
+        if ms is None or self._index_versions.get(index, -1) < meta.version:
+            ms = MapperService(meta.mappings or None)
+            self._mapper_services[index] = ms
+            self._index_versions[index] = meta.version
+        return ms
+
+    def _apply_cluster_state(self, state: ClusterState) -> None:
+        my_shards = {
+            (r.index, r.shard): r for r in state.shards_for_node(self.node_id)
+        }
+        # remove shards no longer assigned here (or whose index is deleted)
+        for key in list(self.local_shards):
+            if key not in my_shards or key[0] not in state.indices:
+                shard = self.local_shards.pop(key)
+                shard.close()
+        for index_name in list(self._mapper_services):
+            if index_name not in state.indices:
+                self._mapper_services.pop(index_name, None)
+                self._index_versions.pop(index_name, None)
+        # create newly assigned shards
+        for (index_name, shard_num), entry in my_shards.items():
+            if index_name not in state.indices:
+                continue
+            if (index_name, shard_num) not in self.local_shards:
+                ms = self._mapper_for(index_name, state)
+                path = self.data_path / "indices" / index_name / str(shard_num)
+                shard = IndexShard(ShardId(index_name, shard_num), path, ms)
+                shard.primary = entry.primary
+                self.local_shards[(index_name, shard_num)] = shard
+                if entry.state == "INITIALIZING":
+                    if entry.primary:
+                        # local (possibly empty) store is authoritative
+                        self._report_shard_started(index_name, shard_num)
+                    else:
+                        self._start_replica_recovery(index_name, shard_num, state)
+            else:
+                self.local_shards[(index_name, shard_num)].primary = entry.primary
+                if entry.state == "INITIALIZING" and entry.primary:
+                    self._report_shard_started(index_name, shard_num)
+
+    # -- shard started / recovery ------------------------------------------
+
+    def _report_shard_started(self, index: str, shard: int) -> None:
+        leader = self.applied_state.leader_id or self.coordinator.leader_id
+        if leader is None:
+            return
+        self.transport.send(
+            self.node_id, leader, "internal:cluster/shard_started",
+            {"index": index, "shard": shard, "node_id": self.node_id},
+            on_response=None, on_failure=lambda e: None,
+        )
+
+    def _on_shard_started(self, sender: str, payload: dict) -> dict:
+        if not self.is_leader:
+            raise OpenSearchTpuException("not the leader")
+        self.coordinator.submit_state_update(
+            lambda s: mark_shard_started(
+                s, payload["index"], payload["shard"], payload["node_id"]
+            )
+        )
+        return {"ack": True}
+
+    def _start_replica_recovery(self, index: str, shard: int, state: ClusterState) -> None:
+        primary = state.primary(index, shard)
+        if primary is None or primary.node_id is None or primary.state != "STARTED":
+            # retry later — the primary may still be initializing
+            self.scheduler.schedule(
+                500, lambda: self._retry_recovery(index, shard)
+            )
+            return
+
+        def on_response(resp: dict) -> None:
+            local = self.local_shards.get((index, shard))
+            if local is None:
+                return
+            for op in resp["ops"]:
+                if op["op"] == "index":
+                    local.apply_index_on_replica(
+                        op["id"], op["source"], op["seq_no"], op.get("routing")
+                    )
+                else:
+                    local.apply_delete_on_replica(op["id"], op["seq_no"])
+            local.refresh()
+            self._report_shard_started(index, shard)
+
+        self.transport.send(
+            self.node_id, primary.node_id, "internal:index/shard/recovery/start",
+            {"index": index, "shard": shard, "target": self.node_id},
+            on_response=on_response,
+            on_failure=lambda e: self.scheduler.schedule(
+                1000, lambda: self._retry_recovery(index, shard)
+            ),
+        )
+
+    def _retry_recovery(self, index: str, shard: int) -> None:
+        if (index, shard) in self.local_shards and not self.local_shards[(index, shard)].primary:
+            entry = next(
+                (r for r in self.applied_state.shards_for_node(self.node_id)
+                 if r.index == index and r.shard == shard), None
+            )
+            if entry is not None and entry.state == "INITIALIZING":
+                self._start_replica_recovery(index, shard, self.applied_state)
+
+    def _on_start_recovery(self, sender: str, payload: dict) -> dict:
+        """Primary-side recovery source: dump live docs + seq_nos (the
+        logical-ops path of RecoverySourceHandler)."""
+        shard = self._local_shard(payload["index"], payload["shard"])
+        engine = shard.engine
+        ops: list[dict] = []
+        snapshot = engine.acquire_searcher()
+        # buffered (not yet refreshed) docs
+        seen: set[str] = set()
+        for entry in engine._buffer:
+            if entry is None:
+                continue
+            parsed, seq = entry
+            ops.append({"op": "index", "id": parsed.doc_id, "source": parsed.source,
+                        "seq_no": seq, "routing": parsed.routing})
+            seen.add(parsed.doc_id)
+        for host, _dev in snapshot.segments:
+            for d in range(host.n_docs):
+                if not host.live[d]:
+                    continue
+                doc_id = host.doc_ids[d]
+                if doc_id in seen:
+                    continue
+                entry2 = engine.version_map.get(doc_id)
+                ops.append({
+                    "op": "index", "id": doc_id,
+                    "source": json.loads(host.sources[d]),
+                    "seq_no": entry2.seq_no if entry2 else 0,
+                    "routing": None,
+                })
+        return {"ops": ops, "max_seq_no": engine.max_seq_no}
+
+    # ------------------------------------------------------------------ #
+    # metadata APIs (routed to the leader)
+    # ------------------------------------------------------------------ #
+
+    def _leader_or_raise(self) -> str:
+        leader = self.coordinator.leader_id
+        if leader is None:
+            raise OpenSearchTpuException("no elected cluster manager")
+        return leader
+
+    def create_index(self, name: str, body: dict | None,
+                     callback: Callable[[dict], None]) -> None:
+        self.transport.send(
+            self.node_id, self._leader_or_raise(), "cluster:admin/create_index",
+            {"name": name, "body": body or {}},
+            on_response=callback,
+            on_failure=lambda e: callback({"error": str(e)}),
+        )
+
+    def _on_create_index(self, sender: str, payload: dict) -> dict:
+        if not self.is_leader:
+            raise OpenSearchTpuException("not the leader")
+        name = payload["name"]
+        body = payload["body"]
+        settings = body.get("settings") or {}
+        index_settings = settings.get("index", settings)
+
+        def task(state: ClusterState) -> ClusterState:
+            if name in state.indices:
+                return state
+            meta = IndexMeta(
+                name=name,
+                num_shards=int(index_settings.get("number_of_shards", 1)),
+                num_replicas=int(index_settings.get("number_of_replicas", 1)),
+                settings=index_settings,
+                mappings=body.get("mappings") or {},
+            )
+            return reroute(state.with_(indices={**state.indices, name: meta}))
+
+        self.coordinator.submit_state_update(task)
+        return {"acknowledged": True, "index": name}
+
+    def _on_delete_index(self, sender: str, payload: dict) -> dict:
+        if not self.is_leader:
+            raise OpenSearchTpuException("not the leader")
+        name = payload["name"]
+
+        def task(state: ClusterState) -> ClusterState:
+            if name not in state.indices:
+                return state
+            indices = {k: v for k, v in state.indices.items() if k != name}
+            routing = tuple(r for r in state.routing if r.index != name)
+            return state.with_(indices=indices, routing=routing)
+
+        self.coordinator.submit_state_update(task)
+        return {"acknowledged": True}
+
+    def _on_put_mapping(self, sender: str, payload: dict) -> dict:
+        if not self.is_leader:
+            raise OpenSearchTpuException("not the leader")
+        name, mappings = payload["name"], payload["mappings"]
+
+        def task(state: ClusterState) -> ClusterState:
+            meta = state.indices.get(name)
+            if meta is None:
+                return state
+            # validate by merging into a scratch mapper service
+            ms = MapperService(meta.mappings or None)
+            ms.merge(mappings)
+            new_meta = IndexMeta(
+                meta.name, meta.num_shards, meta.num_replicas, meta.settings,
+                ms.to_dict(), meta.version + 1,
+            )
+            return state.with_(indices={**state.indices, name: new_meta})
+
+        self.coordinator.submit_state_update(task)
+        return {"acknowledged": True}
+
+    # ------------------------------------------------------------------ #
+    # write path (TransportReplicationAction analog)
+    # ------------------------------------------------------------------ #
+
+    def _routing_for_doc(self, index: str, doc_id: str, routing: str | None):
+        state = self.applied_state
+        meta = state.indices.get(index)
+        if meta is None:
+            raise IndexNotFoundException(index)
+        shard_num = shard_id_for_routing(routing or doc_id, meta.num_shards)
+        primary = state.primary(index, shard_num)
+        if primary is None or primary.node_id is None:
+            raise ShardNotFoundException(f"no primary for [{index}][{shard_num}]")
+        return shard_num, primary
+
+    def index_doc(self, index: str, doc_id: str, source: dict,
+                  callback: Callable[[dict], None], routing: str | None = None) -> None:
+        shard_num, primary = self._routing_for_doc(index, doc_id, routing)
+        self.transport.send(
+            self.node_id, primary.node_id, "indices:data/write[p]",
+            {"index": index, "shard": shard_num, "op": "index", "id": doc_id,
+             "source": source, "routing": routing},
+            on_response=callback,
+            on_failure=lambda e: callback({"error": str(e)}),
+        )
+
+    def delete_doc(self, index: str, doc_id: str,
+                   callback: Callable[[dict], None], routing: str | None = None) -> None:
+        shard_num, primary = self._routing_for_doc(index, doc_id, routing)
+        self.transport.send(
+            self.node_id, primary.node_id, "indices:data/write[p]",
+            {"index": index, "shard": shard_num, "op": "delete", "id": doc_id,
+             "routing": routing},
+            on_response=callback,
+            on_failure=lambda e: callback({"error": str(e)}),
+        )
+
+    def _local_shard(self, index: str, shard: int) -> IndexShard:
+        local = self.local_shards.get((index, shard))
+        if local is None:
+            raise ShardNotFoundException(f"[{index}][{shard}] not on node {self.node_id}")
+        return local
+
+    def _on_primary_write(self, sender: str, payload: dict) -> dict:
+        index, shard_num = payload["index"], payload["shard"]
+        shard = self._local_shard(index, shard_num)
+        if payload["op"] == "index":
+            result = shard.apply_index_on_primary(
+                payload["id"], payload["source"], payload.get("routing")
+            )
+        else:
+            result = shard.apply_delete_on_primary(payload["id"])
+        # fan out to all STARTED replicas (ReplicationOperation.performOnReplicas)
+        state = self.applied_state
+        replicas = [
+            r for r in state.shards_for_index(index)
+            if r.shard == shard_num and not r.primary
+            and r.state == "STARTED" and r.node_id is not None
+        ]
+        replica_payload = dict(payload, seq_no=result.seq_no, version=result.version)
+        for r in replicas:
+            self.transport.send(
+                self.node_id, r.node_id, "indices:data/write[r]", replica_payload,
+                on_response=None,
+                on_failure=lambda e: None,  # failed-replica eviction: TODO
+            )
+        return {
+            "_index": index, "_id": payload["id"], "_version": result.version,
+            "_seq_no": result.seq_no, "result": result.result,
+            "_shards": {"total": 1 + len(replicas), "successful": 1 + len(replicas),
+                        "failed": 0},
+        }
+
+    def _on_replica_write(self, sender: str, payload: dict) -> dict:
+        shard = self._local_shard(payload["index"], payload["shard"])
+        if payload["op"] == "index":
+            shard.apply_index_on_replica(
+                payload["id"], payload["source"], payload["seq_no"],
+                payload.get("routing"),
+            )
+        else:
+            shard.apply_delete_on_replica(payload["id"], payload["seq_no"])
+        return {"ack": True}
+
+    # ------------------------------------------------------------------ #
+    # read path
+    # ------------------------------------------------------------------ #
+
+    def get_doc(self, index: str, doc_id: str,
+                callback: Callable[[dict], None], routing: str | None = None) -> None:
+        shard_num, primary = self._routing_for_doc(index, doc_id, routing)
+        self.transport.send(
+            self.node_id, primary.node_id, "indices:data/read/get",
+            {"index": index, "shard": shard_num, "id": doc_id},
+            on_response=callback,
+            on_failure=lambda e: callback({"error": str(e)}),
+        )
+
+    def _on_get(self, sender: str, payload: dict) -> dict:
+        shard = self._local_shard(payload["index"], payload["shard"])
+        got = shard.get(payload["id"])
+        if got is None:
+            return {"_index": payload["index"], "_id": payload["id"], "found": False}
+        return {"_index": payload["index"], "_id": payload["id"], "found": True,
+                "_source": got["_source"], "_seq_no": got["_seq_no"],
+                "_version": got["_version"]}
+
+    def refresh(self, index: str, callback: Callable[[dict], None]) -> None:
+        """Broadcast refresh to every shard copy (BroadcastReplicationAction)."""
+        state = self.applied_state
+        targets = [
+            r for r in state.shards_for_index(index)
+            if r.node_id is not None and r.state == "STARTED"
+        ]
+        if not targets:
+            callback({"_shards": {"total": 0, "successful": 0, "failed": 0}})
+            return
+        remaining = [len(targets)]
+
+        def one_done(_resp: Any) -> None:
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                callback({"_shards": {"total": len(targets),
+                                      "successful": len(targets), "failed": 0}})
+
+        for r in targets:
+            self.transport.send(
+                self.node_id, r.node_id, "indices:admin/refresh[shard]",
+                {"index": index, "shard": r.shard},
+                on_response=one_done, on_failure=one_done,
+            )
+
+    def _on_shard_refresh(self, sender: str, payload: dict) -> dict:
+        self._local_shard(payload["index"], payload["shard"]).refresh()
+        return {"ack": True}
+
+    # -- distributed search (scatter-gather, SURVEY §3.2) -------------------
+
+    def search(self, index: str, body: dict | None,
+               callback: Callable[[dict], None]) -> None:
+        state = self.applied_state
+        meta = state.indices.get(index)
+        if meta is None:
+            callback({"error": f"no such index [{index}]"})
+            return
+        body = body or {}
+        size = int(body.get("size", 10))
+        # pick one STARTED copy per shard (prefer primary; adaptive replica
+        # selection is a later refinement)
+        targets: dict[int, ShardRoutingEntry] = {}
+        for r in state.shards_for_index(index):
+            if r.state != "STARTED" or r.node_id is None:
+                continue
+            if r.shard not in targets or r.primary:
+                targets[r.shard] = r
+        if len(targets) < meta.num_shards:
+            callback({"error": "not all shards available"})
+            return
+        results: dict[int, dict] = {}
+        remaining = [len(targets)]
+
+        def one_result(shard_num: int):
+            def handle(resp: dict) -> None:
+                results[shard_num] = resp
+                remaining[0] -= 1
+                if remaining[0] == 0:
+                    callback(self._merge_search_results(results, size))
+            return handle
+
+        for shard_num, r in sorted(targets.items()):
+            self.transport.send(
+                self.node_id, r.node_id, "indices:data/read/search[shard]",
+                {"index": index, "shard": shard_num, "body": body},
+                on_response=one_result(shard_num),
+                on_failure=one_result(shard_num),  # surfaces as missing shard
+            )
+
+    def _on_shard_search(self, sender: str, payload: dict) -> dict:
+        """Per-shard query+fetch (the combined phase; split q/f is the
+        optimization path). Returns hits with _id/_score/_source."""
+        shard = self._local_shard(payload["index"], payload["shard"])
+        body = payload.get("body") or {}
+        node = query_dsl.parse_query(body.get("query"))
+        size = int(body.get("size", 10)) + int(body.get("from", 0))
+        snapshot = shard.acquire_searcher()
+        result = execute_query_phase(
+            snapshot, shard.mapper_service, node, size=size,
+            sort=body.get("sort"),
+        )
+        src_filter = _source_filter(body.get("_source", True))
+        hits = []
+        for h in result.hits:
+            host = snapshot.segments[h.segment][0]
+            hit = {"_id": host.doc_ids[h.doc], "_score": h.score,
+                   "_index": payload["index"]}
+            src = src_filter(json.loads(host.sources[h.doc]))
+            if src is not None:
+                hit["_source"] = src
+            if h.sort_values:
+                hit["sort"] = h.sort_values
+            hits.append(hit)
+        return {"total": result.total, "hits": hits,
+                "max_score": result.max_score}
+
+    def _merge_search_results(self, results: dict[int, dict], size: int) -> dict:
+        total = 0
+        max_score = None
+        merged = []
+        failed = 0
+        for shard_num in sorted(results):
+            resp = results[shard_num]
+            if not isinstance(resp, dict) or "hits" not in resp:
+                failed += 1
+                continue
+            total += resp["total"]
+            if resp["max_score"] is not None and (
+                max_score is None or resp["max_score"] > max_score
+            ):
+                max_score = resp["max_score"]
+            for h in resp["hits"]:
+                merged.append((shard_num, h))
+        merged.sort(key=lambda sh: (-(sh[1]["_score"] or 0.0), sh[0], sh[1]["_id"]))
+        return {
+            "took": 0,
+            "timed_out": False,
+            "_shards": {"total": len(results), "successful": len(results) - failed,
+                        "skipped": 0, "failed": failed},
+            "hits": {
+                "total": {"value": total, "relation": "eq"},
+                "max_score": max_score,
+                "hits": [h for _, h in merged[:size]],
+            },
+        }
+
+    def close(self) -> None:
+        for shard in self.local_shards.values():
+            shard.close()

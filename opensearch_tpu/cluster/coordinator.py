@@ -62,6 +62,7 @@ class Coordinator:
         follower_check_retries: int = 3,
         leader_check_retries: int = 3,
         on_state_applied: Callable[[ClusterState], None] | None = None,
+        state_transform: Callable[[ClusterState], ClusterState] | None = None,
     ):
         self.node = node
         self.node_id = node.node_id
@@ -73,6 +74,10 @@ class Coordinator:
         self.leader_id: str | None = None
         self.applied_state: ClusterState = self.coord.last_accepted_state
         self.on_state_applied = on_state_applied
+        # applied to every computed state before publication — the node
+        # layer hooks allocation (AllocationService.reroute on node
+        # join/leave) here
+        self.state_transform = state_transform
         self.election_attempts = 0
         self._election_timer = None
         self._heartbeat_timer = None
@@ -337,6 +342,11 @@ class Coordinator:
                 state = task(state)
             except Exception:  # noqa: BLE001 - a bad task must not kill the loop
                 continue
+        if self.state_transform is not None:
+            try:
+                state = self.state_transform(state)
+            except Exception:  # noqa: BLE001
+                pass
         new_state = state.with_(
             term=self.coord.current_term,
             version=max(state.version, self.applied_state.version,
