@@ -20,6 +20,7 @@ ending in lax.top_k.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field as dc_field
 from typing import Any
 
@@ -63,6 +64,40 @@ class ShardContext:
         # per-query cache: knn nodes select k docs PER SHARD (k-NN plugin
         # semantics), so the top-k cut must span all segments of the shard
         self._knn_cache: dict[int, list] = {}
+        # query_string trees are parsed once per shard, not per segment
+        self._qs_cache: dict[int, Any] = {}
+
+    def rewritten_query_string(self, node) -> Any:
+        """Parse a query_string/simple_query_string node's text once per
+        shard (the two-phase-rewrite analog: QueryStringQueryBuilder rewrites
+        to a concrete query before per-segment execution)."""
+        cached = self._qs_cache.get(id(node))
+        if cached is not None:
+            return cached
+        from opensearch_tpu.search import query_dsl as qd
+        from opensearch_tpu.search.query_string import (
+            parse_query_string,
+            parse_simple_query_string,
+        )
+
+        fields = node.fields or self.default_text_fields()
+        if isinstance(node, qd.SimpleQueryStringQuery):
+            tree = parse_simple_query_string(node.query, fields, node.default_operator)
+        else:
+            tree = parse_query_string(node.query, fields, node.default_operator)
+        self._qs_cache[id(node)] = tree
+        return tree
+
+    def default_text_fields(self) -> list[str]:
+        fields = [
+            name for name, m in self.mapper_service.mappers.items()
+            if m.type in ("text", "keyword")
+        ]
+        for host, _dev in self.snapshot.segments:
+            for name in host.text_fields:
+                if name not in fields:
+                    fields.append(name)
+        return fields or ["_all_absent_"]
 
     def shard_knn_selection(self, node) -> list:
         """Per-segment (sel_mask bool[n_pad], scores f32[n_pad]) numpy pairs
@@ -507,6 +542,361 @@ class SegmentExecutor:
                 raw = jnp.maximum(-raw, 0.0)  # l2Squared returns the distance
             scores = jnp.where(valid, raw + node.add_constant, 0.0)
         return NodeResult(scores=scores * node.boost, mask=valid, scoring=True)
+
+    # -- multi-term (term-enumeration) queries -----------------------------
+    # The reference rewrites these to constant-score over the matching term
+    # set (MultiTermQuery CONSTANT_SCORE_REWRITE); here the term dictionary
+    # walk happens host-side (same place Lucene's FST walk runs) and only
+    # the final doc mask touches the device.
+
+    def _host_mask_for_terms(self, field: str, match_fn) -> np.ndarray:
+        mask = np.zeros(self.dev.n_pad, bool)
+        host_tf = self.host.text_fields.get(field)
+        if host_tf is not None:
+            for tid, term in enumerate(host_tf.terms):
+                if match_fn(term):
+                    off = int(host_tf.term_offsets[tid])
+                    end = int(host_tf.term_offsets[tid + 1])
+                    mask[host_tf.postings_docs[off:end]] = True
+        kf = self.host.keyword_fields.get(field)
+        if kf is not None:
+            ords = [o for o, v in enumerate(kf.ord_values) if match_fn(v)]
+            if ords:
+                sel = np.isin(kf.mv_ords, np.asarray(ords, kf.mv_ords.dtype))
+                mask[kf.mv_docs[sel]] = True
+        return mask
+
+    def _multi_term_result(self, field: str, match_fn, boost: float) -> NodeResult:
+        mask = jnp.asarray(self._host_mask_for_terms(field, match_fn)) & self.dev.live
+        return _const_result(mask, boost, scoring=True)
+
+    def _exec_PrefixQuery(self, node: q.PrefixQuery) -> NodeResult:
+        prefix = node.value.lower() if node.case_insensitive else node.value
+        if node.case_insensitive:
+            return self._multi_term_result(
+                node.field, lambda t: t.lower().startswith(prefix), node.boost
+            )
+        return self._multi_term_result(
+            node.field, lambda t: t.startswith(prefix), node.boost
+        )
+
+    def _exec_WildcardQuery(self, node: q.WildcardQuery) -> NodeResult:
+        rx = _wildcard_to_regex(node.value, node.case_insensitive)
+        return self._multi_term_result(
+            node.field, lambda t: rx.match(t) is not None, node.boost
+        )
+
+    def _exec_RegexpQuery(self, node: q.RegexpQuery) -> NodeResult:
+        try:
+            rx = re.compile(
+                node.value, re.IGNORECASE if node.case_insensitive else 0
+            )
+        except re.error as e:
+            raise IllegalArgumentException(f"invalid regexp [{node.value}]: {e}")
+        return self._multi_term_result(
+            node.field, lambda t: rx.fullmatch(t) is not None, node.boost
+        )
+
+    def _exec_FuzzyQuery(self, node: q.FuzzyQuery) -> NodeResult:
+        value = node.value
+        max_d = _fuzziness_distance(node.fuzziness, value)
+        plen = node.prefix_length
+
+        def match(t: str) -> bool:
+            if plen and t[:plen] != value[:plen]:
+                return False
+            if abs(len(t) - len(value)) > max_d:
+                return False
+            return _edit_distance_at_most(value, t, max_d)
+
+        return self._multi_term_result(node.field, match, node.boost)
+
+    def _exec_MatchPhrasePrefixQuery(self, node: q.MatchPhrasePrefixQuery) -> NodeResult:
+        terms = self.ctx.mapper_service.analyze_query_text(node.field, node.query)
+        if not terms:
+            return _empty(self.dev)
+        *body_terms, last = terms
+        result = None
+        if body_terms:
+            r, counts = self._bm25(node.field, body_terms, node.boost)
+            result = NodeResult(r.scores, counts >= len(body_terms), True)
+        # expand the final term as a prefix (bounded by max_expansions, like
+        # MatchPhrasePrefixQuery's MultiPhrasePrefixQuery expansion)
+        expansions = 0
+
+        def match(t: str) -> bool:
+            nonlocal expansions
+            if expansions >= node.max_expansions:
+                return False
+            if t.startswith(last):
+                expansions += 1
+                return True
+            return False
+
+        prefix_mask = jnp.asarray(self._host_mask_for_terms(node.field, match))
+        if result is None:
+            return _const_result(prefix_mask & self.dev.live, node.boost, True)
+        mask = result.mask & prefix_mask & self.dev.live
+        return NodeResult(jnp.where(mask, result.scores, 0.0), mask, True)
+
+    def _exec_MatchBoolPrefixQuery(self, node: q.MatchBoolPrefixQuery) -> NodeResult:
+        terms = self.ctx.mapper_service.analyze_query_text(node.field, node.query)
+        if not terms:
+            return _empty(self.dev)
+        *body_terms, last = terms
+        subs: list[q.QueryNode] = [
+            q.TermQuery(field=node.field, value=t) for t in body_terms
+        ]
+        subs.append(q.PrefixQuery(field=node.field, value=last))
+        return self._exec_BoolQuery(q.BoolQuery(should=subs, boost=node.boost))
+
+    # -- query-string family ----------------------------------------------
+
+    def _exec_QueryStringQuery(self, node: q.QueryStringQuery) -> NodeResult:
+        r = self.execute(self.ctx.rewritten_query_string(node))
+        return NodeResult(r.scores * node.boost, r.mask, r.scoring)
+
+    def _exec_SimpleQueryStringQuery(self, node: q.SimpleQueryStringQuery) -> NodeResult:
+        r = self.execute(self.ctx.rewritten_query_string(node))
+        return NodeResult(r.scores * node.boost, r.mask, r.scoring)
+
+    # -- compound scoring queries ------------------------------------------
+
+    def _exec_BoostingQuery(self, node: q.BoostingQuery) -> NodeResult:
+        pos = self.execute(node.positive)
+        neg = self.execute(node.negative)
+        scores = jnp.where(
+            neg.mask, pos.scores * jnp.float32(node.negative_boost), pos.scores
+        )
+        return NodeResult(scores * node.boost, pos.mask, True)
+
+    def _exec_DisMaxQuery(self, node: q.DisMaxQuery) -> NodeResult:
+        if not node.queries:
+            return _empty(self.dev)
+        subs = [self.execute(sq) for sq in node.queries]
+        mask = subs[0].mask
+        best = subs[0].scores
+        total = subs[0].scores
+        for s in subs[1:]:
+            mask = mask | s.mask
+            best = jnp.maximum(best, s.scores)
+            total = total + s.scores
+        scores = best + jnp.float32(node.tie_breaker) * (total - best)
+        return NodeResult(jnp.where(mask, scores, 0.0) * node.boost, mask, True)
+
+    def _exec_NestedQuery(self, node: q.NestedQuery) -> NodeResult:
+        # Flattened semantics: arrays of objects were indexed as multi-valued
+        # dotted columns, so the inner query already addresses path.field.
+        r = self.execute(node.query)
+        return NodeResult(r.scores * node.boost, r.mask, r.scoring)
+
+    def _exec_HybridQuery(self, node: q.HybridQuery) -> NodeResult:
+        # Executor-level fallback (no search pipeline): max combination.
+        # The service runs sub-queries separately when a normalization
+        # pipeline is active (see search/pipeline.py).
+        return self._exec_DisMaxQuery(
+            q.DisMaxQuery(queries=node.queries, tie_breaker=0.0, boost=node.boost)
+        )
+
+    def _exec_FunctionScoreQuery(self, node: q.FunctionScoreQuery) -> NodeResult:
+        base = self.execute(node.query)
+        n_pad = self.dev.n_pad
+        fvals: list[tuple[jnp.ndarray, jnp.ndarray]] = []  # (value, applies-mask)
+        for fn in node.functions:
+            applies = base.mask
+            if fn.filter is not None:
+                applies = applies & self.execute(fn.filter).mask
+            val = self._function_value(fn)
+            if fn.weight is not None:
+                val = val * jnp.float32(fn.weight)
+            fvals.append((val, applies))
+
+        if not fvals:
+            factor = jnp.ones(n_pad, jnp.float32)
+        else:
+            mode = node.score_mode
+            if mode == "first":
+                factor = jnp.ones(n_pad, jnp.float32)
+                assigned = jnp.zeros(n_pad, bool)
+                for val, applies in fvals:
+                    take = applies & ~assigned
+                    factor = jnp.where(take, val, factor)
+                    assigned = assigned | applies
+            elif mode in ("sum", "avg"):
+                total = jnp.zeros(n_pad, jnp.float32)
+                cnt = jnp.zeros(n_pad, jnp.float32)
+                for val, applies in fvals:
+                    total = total + jnp.where(applies, val, 0.0)
+                    cnt = cnt + applies.astype(jnp.float32)
+                factor = jnp.where(cnt > 0, total, 1.0)
+                if mode == "avg":
+                    factor = jnp.where(cnt > 0, total / jnp.maximum(cnt, 1.0), 1.0)
+            elif mode in ("max", "min"):
+                init = jnp.full(n_pad, -jnp.inf if mode == "max" else jnp.inf, jnp.float32)
+                acc = init
+                for val, applies in fvals:
+                    pick = jnp.maximum if mode == "max" else jnp.minimum
+                    acc = jnp.where(applies, pick(acc, val), acc)
+                factor = jnp.where(jnp.isfinite(acc), acc, 1.0)
+            else:  # multiply (default)
+                factor = jnp.ones(n_pad, jnp.float32)
+                for val, applies in fvals:
+                    factor = factor * jnp.where(applies, val, 1.0)
+        if np.isfinite(node.max_boost):
+            factor = jnp.minimum(factor, jnp.float32(node.max_boost))
+
+        qs = base.scores
+        bm = node.boost_mode
+        if bm == "replace":
+            scores = factor
+        elif bm == "sum":
+            scores = qs + factor
+        elif bm == "avg":
+            scores = (qs + factor) / 2.0
+        elif bm == "max":
+            scores = jnp.maximum(qs, factor)
+        elif bm == "min":
+            scores = jnp.minimum(qs, factor)
+        else:  # multiply
+            scores = qs * factor
+        mask = base.mask
+        if node.min_score is not None:
+            mask = mask & (scores >= jnp.float32(node.min_score))
+        scores = jnp.where(mask, scores, 0.0) * node.boost
+        return NodeResult(scores, mask, True)
+
+    def _function_value(self, fn: q.ScoreFunction) -> jnp.ndarray:
+        n_pad = self.dev.n_pad
+        if fn.kind == "weight":
+            return jnp.ones(n_pad, jnp.float32)
+        if fn.kind == "random_score":
+            # deterministic per-doc hash (reference: seeded random_score)
+            idx = jnp.arange(n_pad, dtype=jnp.uint32)
+            h = (idx * jnp.uint32(2654435761) + jnp.uint32(fn.seed * 40503 + 1)) & jnp.uint32(0x7FFFFFFF)
+            return h.astype(jnp.float32) / jnp.float32(0x7FFFFFFF)
+        if fn.kind == "field_value_factor":
+            vals, present = self._numeric_doc_values(fn.field)
+            if fn.missing is not None:
+                vals = jnp.where(present, vals, jnp.float32(fn.missing))
+            else:
+                vals = jnp.where(present, vals, 1.0)
+            v = vals * jnp.float32(fn.factor)
+            m = fn.modifier
+            if m == "log":
+                v = jnp.log10(jnp.maximum(v, 1e-9))
+            elif m == "log1p":
+                v = jnp.log10(v + 1.0)
+            elif m == "log2p":
+                v = jnp.log10(v + 2.0)
+            elif m == "ln":
+                v = jnp.log(jnp.maximum(v, 1e-9))
+            elif m == "ln1p":
+                v = jnp.log1p(v)
+            elif m == "ln2p":
+                v = jnp.log(v + 2.0)
+            elif m == "square":
+                v = v * v
+            elif m == "sqrt":
+                v = jnp.sqrt(jnp.maximum(v, 0.0))
+            elif m == "reciprocal":
+                v = 1.0 / jnp.maximum(v, 1e-9)
+            return v
+        if fn.kind == "decay":
+            mapper = self.ctx.mapper_service.field_mapper(fn.field)
+            is_date = mapper is not None and mapper.type == "date"
+            if is_date:
+                origin = float(parse_date_millis(fn.origin)) if fn.origin is not None else 0.0
+                scale = float(_duration_millis(fn.scale))
+                offset = float(_duration_millis(fn.offset)) if fn.offset else 0.0
+            else:
+                origin = float(fn.origin if fn.origin is not None else 0.0)
+                scale = float(fn.scale)
+                offset = float(fn.offset or 0.0)
+            vals, present = self._numeric_doc_values(fn.field)
+            dist = jnp.maximum(jnp.abs(vals - jnp.float32(origin)) - jnp.float32(offset), 0.0)
+            if fn.decay_type == "gauss":
+                sigma2 = -(scale**2) / (2.0 * np.log(fn.decay))
+                out = jnp.exp(-(dist**2) / jnp.float32(2 * sigma2))
+            elif fn.decay_type == "exp":
+                lam = np.log(fn.decay) / scale
+                out = jnp.exp(jnp.float32(lam) * dist)
+            else:  # linear
+                s = scale / (1.0 - fn.decay)
+                out = jnp.maximum(
+                    (jnp.float32(s) - dist) / jnp.float32(s), 0.0
+                )
+            return jnp.where(present, out, 1.0)
+        raise IllegalArgumentException(f"unknown score function [{fn.kind}]")
+
+    def _numeric_doc_values(self, field: str) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """(float32 values, present) for a numeric/date field on this segment."""
+        nf_dev = self.dev.numeric_fields.get(field)
+        nf_host = self.host.numeric_fields.get(field)
+        if nf_dev is None or nf_host is None:
+            z = jnp.zeros(self.dev.n_pad, jnp.float32)
+            return z, jnp.zeros(self.dev.n_pad, bool)
+        if nf_host.kind == "int":
+            vals = np.zeros(self.dev.n_pad, np.float32)
+            vals[: self.host.n_docs] = nf_host.values_i64.astype(np.float64)[: self.host.n_docs]
+        else:
+            vals = np.zeros(self.dev.n_pad, np.float32)
+            vals[: self.host.n_docs] = nf_host.values_f64[: self.host.n_docs]
+        return jnp.asarray(vals), nf_dev.present
+
+
+def _wildcard_to_regex(pattern: str, case_insensitive: bool) -> "re.Pattern":
+    out = []
+    for ch in pattern:
+        if ch == "*":
+            out.append(".*")
+        elif ch == "?":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("".join(out) + r"\Z", re.IGNORECASE if case_insensitive else 0)
+
+
+def _fuzziness_distance(fuzziness: str, term: str) -> int:
+    f = str(fuzziness).upper()
+    if f == "AUTO":
+        n = len(term)
+        return 0 if n < 3 else (1 if n <= 5 else 2)
+    try:
+        return int(f)
+    except ValueError:
+        raise IllegalArgumentException(f"invalid fuzziness [{fuzziness}]")
+
+
+def _edit_distance_at_most(a: str, b: str, max_d: int) -> bool:
+    """Banded Levenshtein with early exit (Lucene automaton-equivalent check)."""
+    if max_d == 0:
+        return a == b
+    la, lb = len(a), len(b)
+    prev = list(range(lb + 1))
+    for i in range(1, la + 1):
+        cur = [i] + [0] * lb
+        row_min = i
+        for j in range(1, lb + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+            row_min = min(row_min, cur[j])
+        if row_min > max_d:
+            return False
+        prev = cur
+    return prev[lb] <= max_d
+
+
+def _duration_millis(v: Any) -> int:
+    """Parse a date-math duration like "10d", "2h", "30m" to milliseconds."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h|d|w)", str(v).strip())
+    if not m:
+        raise IllegalArgumentException(f"invalid duration [{v}]")
+    n = float(m.group(1))
+    mult = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+            "d": 86_400_000, "w": 604_800_000}[m.group(2)]
+    return int(n * mult)
 
 
 # --------------------------------------------------------------------------

@@ -110,6 +110,127 @@ class KnnQuery(QueryNode):
 
 
 @dataclass
+class PrefixQuery(QueryNode):
+    field: str = ""
+    value: str = ""
+    case_insensitive: bool = False
+
+
+@dataclass
+class WildcardQuery(QueryNode):
+    field: str = ""
+    value: str = ""
+    case_insensitive: bool = False
+
+
+@dataclass
+class RegexpQuery(QueryNode):
+    field: str = ""
+    value: str = ""
+    case_insensitive: bool = False
+
+
+@dataclass
+class FuzzyQuery(QueryNode):
+    field: str = ""
+    value: str = ""
+    fuzziness: str = "AUTO"
+    prefix_length: int = 0
+
+
+@dataclass
+class MatchPhrasePrefixQuery(QueryNode):
+    field: str = ""
+    query: str = ""
+    max_expansions: int = 50
+
+
+@dataclass
+class MatchBoolPrefixQuery(QueryNode):
+    field: str = ""
+    query: str = ""
+
+
+@dataclass
+class QueryStringQuery(QueryNode):
+    query: str = ""
+    fields: list[str] = dc_field(default_factory=list)
+    default_operator: str = "or"
+
+
+@dataclass
+class SimpleQueryStringQuery(QueryNode):
+    query: str = ""
+    fields: list[str] = dc_field(default_factory=list)
+    default_operator: str = "or"
+
+
+@dataclass
+class BoostingQuery(QueryNode):
+    positive: QueryNode | None = None
+    negative: QueryNode | None = None
+    negative_boost: float = 0.5
+
+
+@dataclass
+class DisMaxQuery(QueryNode):
+    queries: list[QueryNode] = dc_field(default_factory=list)
+    tie_breaker: float = 0.0
+
+
+@dataclass
+class ScoreFunction:
+    """One entry of function_score.functions (FunctionScoreQueryBuilder)."""
+
+    kind: str = "weight"          # weight | field_value_factor | random_score | decay
+    filter: QueryNode | None = None
+    weight: float | None = None
+    # field_value_factor
+    field: str = ""
+    factor: float = 1.0
+    modifier: str = "none"
+    missing: float | None = None
+    # random_score
+    seed: int = 0
+    # decay (gauss | exp | linear over numeric/date field)
+    decay_type: str = ""
+    origin: Any = None
+    scale: Any = None
+    offset: Any = None
+    decay: float = 0.5
+
+
+@dataclass
+class FunctionScoreQuery(QueryNode):
+    query: QueryNode | None = None
+    functions: list[ScoreFunction] = dc_field(default_factory=list)
+    score_mode: str = "multiply"  # multiply | sum | avg | first | max | min
+    boost_mode: str = "multiply"  # multiply | replace | sum | avg | max | min
+    max_boost: float = float("inf")
+    min_score: float | None = None
+
+
+@dataclass
+class NestedQuery(QueryNode):
+    """Flattened-semantics nested: delegates to the inner query over the
+    dotted subfields (arrays are multi-valued columns in our layout)."""
+
+    path: str = ""
+    query: QueryNode | None = None
+    score_mode: str = "avg"
+
+
+@dataclass
+class HybridQuery(QueryNode):
+    """OpenSearch neural-search hybrid query: sub-query scores are kept
+    separate through the query phase so a search-pipeline normalization
+    processor can combine them (reference: neural-search plugin's
+    HybridQuery + NormalizationProcessor)."""
+
+    queries: list[QueryNode] = dc_field(default_factory=list)
+
+
+@dataclass
 class ScriptScoreQuery(QueryNode):
     query: QueryNode | None = None
     # recognized vector scoring functions (the k-NN plugin script patterns)
@@ -282,6 +403,176 @@ def _parse_knn(body: dict) -> QueryNode:
     )
 
 
+def _parse_term_level(cls, name: str, value_key: str = "value"):
+    def parse(body: dict) -> QueryNode:
+        fname, conf = _single_kv(body, name)
+        if isinstance(conf, dict):
+            kwargs = dict(
+                field=fname,
+                value=str(conf.get(value_key, conf.get("value", ""))),
+                boost=float(conf.get("boost", 1.0)),
+            )
+            if cls is FuzzyQuery:
+                kwargs["fuzziness"] = str(conf.get("fuzziness", "AUTO"))
+                kwargs["prefix_length"] = int(conf.get("prefix_length", 0))
+            else:
+                kwargs["case_insensitive"] = bool(conf.get("case_insensitive", False))
+            return cls(**kwargs)
+        return cls(field=fname, value=str(conf))
+
+    return parse
+
+
+def _parse_match_phrase_prefix(body: dict) -> QueryNode:
+    fname, conf = _single_kv(body, "match_phrase_prefix")
+    if isinstance(conf, dict):
+        return MatchPhrasePrefixQuery(
+            field=fname, query=str(conf.get("query", "")),
+            max_expansions=int(conf.get("max_expansions", 50)),
+            boost=float(conf.get("boost", 1.0)),
+        )
+    return MatchPhrasePrefixQuery(field=fname, query=str(conf))
+
+
+def _parse_match_bool_prefix(body: dict) -> QueryNode:
+    fname, conf = _single_kv(body, "match_bool_prefix")
+    if isinstance(conf, dict):
+        return MatchBoolPrefixQuery(
+            field=fname, query=str(conf.get("query", "")),
+            boost=float(conf.get("boost", 1.0)),
+        )
+    return MatchBoolPrefixQuery(field=fname, query=str(conf))
+
+
+def _parse_query_string(body: dict) -> QueryNode:
+    fields = [f.split("^")[0] for f in body.get("fields", [])]
+    if body.get("default_field"):
+        fields = [str(body["default_field"]).split("^")[0]]
+    return QueryStringQuery(
+        query=str(body.get("query", "")),
+        fields=fields,
+        default_operator=str(body.get("default_operator", "or")).lower(),
+        boost=float(body.get("boost", 1.0)),
+    )
+
+
+def _parse_simple_query_string(body: dict) -> QueryNode:
+    return SimpleQueryStringQuery(
+        query=str(body.get("query", "")),
+        fields=[f.split("^")[0] for f in body.get("fields", [])],
+        default_operator=str(body.get("default_operator", "or")).lower(),
+        boost=float(body.get("boost", 1.0)),
+    )
+
+
+def _parse_boosting(body: dict) -> QueryNode:
+    if "positive" not in body or "negative" not in body:
+        raise ParsingException("[boosting] requires [positive] and [negative]")
+    return BoostingQuery(
+        positive=parse_query(body["positive"]),
+        negative=parse_query(body["negative"]),
+        negative_boost=float(body.get("negative_boost", 0.5)),
+        boost=float(body.get("boost", 1.0)),
+    )
+
+
+def _parse_dis_max(body: dict) -> QueryNode:
+    return DisMaxQuery(
+        queries=[parse_query(q) for q in body.get("queries", [])],
+        tie_breaker=float(body.get("tie_breaker", 0.0)),
+        boost=float(body.get("boost", 1.0)),
+    )
+
+
+_FVF_MODIFIERS = {
+    "none", "log", "log1p", "log2p", "ln", "ln1p", "ln2p",
+    "square", "sqrt", "reciprocal",
+}
+
+
+def _parse_one_function(conf: dict) -> ScoreFunction:
+    fn = ScoreFunction()
+    if conf.get("filter") is not None:
+        fn.filter = parse_query(conf["filter"])
+    if "weight" in conf:
+        fn.weight = float(conf["weight"])
+    if "field_value_factor" in conf:
+        fvf = conf["field_value_factor"]
+        fn.kind = "field_value_factor"
+        fn.field = str(fvf.get("field", ""))
+        fn.factor = float(fvf.get("factor", 1.0))
+        fn.modifier = str(fvf.get("modifier", "none")).lower()
+        if fn.modifier not in _FVF_MODIFIERS:
+            raise ParsingException(f"unknown field_value_factor modifier [{fn.modifier}]")
+        fn.missing = float(fvf["missing"]) if "missing" in fvf else None
+    elif "random_score" in conf:
+        fn.kind = "random_score"
+        fn.seed = int((conf["random_score"] or {}).get("seed", 0))
+    elif any(d in conf for d in ("gauss", "exp", "linear")):
+        fn.kind = "decay"
+        fn.decay_type = next(d for d in ("gauss", "exp", "linear") if d in conf)
+        spec = conf[fn.decay_type]
+        fname, dconf = _single_kv(spec, fn.decay_type)
+        fn.field = fname
+        fn.origin = dconf.get("origin")
+        fn.scale = dconf.get("scale")
+        fn.offset = dconf.get("offset", 0)
+        fn.decay = float(dconf.get("decay", 0.5))
+        if fn.scale is None:
+            raise ParsingException(f"[{fn.decay_type}] requires [scale]")
+    elif "weight" in conf:
+        fn.kind = "weight"
+    elif "script_score" in conf:
+        raise ParsingException(
+            "script_score inside function_score is not supported; use the "
+            "top-level script_score query"
+        )
+    else:
+        fn.kind = "weight"
+        if fn.weight is None:
+            raise ParsingException(f"unknown function in function_score: {sorted(conf)}")
+    return fn
+
+
+def _parse_function_score(body: dict) -> QueryNode:
+    functions = [_parse_one_function(f) for f in body.get("functions", [])]
+    # shorthand single-function form
+    if not functions:
+        single = {
+            k: v for k, v in body.items()
+            if k in ("field_value_factor", "random_score", "gauss", "exp", "linear", "weight")
+        }
+        if single:
+            functions = [_parse_one_function(single)]
+    return FunctionScoreQuery(
+        query=parse_query(body.get("query")) if body.get("query") else MatchAllQuery(),
+        functions=functions,
+        score_mode=str(body.get("score_mode", "multiply")).lower(),
+        boost_mode=str(body.get("boost_mode", "multiply")).lower(),
+        max_boost=float(body.get("max_boost", float("inf"))),
+        min_score=float(body["min_score"]) if "min_score" in body else None,
+        boost=float(body.get("boost", 1.0)),
+    )
+
+
+def _parse_nested(body: dict) -> QueryNode:
+    if "path" not in body or "query" not in body:
+        raise ParsingException("[nested] requires [path] and [query]")
+    return NestedQuery(
+        path=str(body["path"]),
+        query=parse_query(body["query"]),
+        score_mode=str(body.get("score_mode", "avg")),
+        boost=float(body.get("boost", 1.0)),
+    )
+
+
+def _parse_hybrid(body: dict) -> QueryNode:
+    return HybridQuery(
+        queries=[parse_query(q) for q in body.get("queries", [])],
+        boost=float(body.get("boost", 1.0)),
+    )
+
+
 _VECTOR_FUNCS = ("cosineSimilarity", "dotProduct", "l2Squared", "knn_score")
 
 
@@ -346,4 +637,17 @@ _PARSERS = {
     "constant_score": _parse_constant_score,
     "knn": _parse_knn,
     "script_score": _parse_script_score,
+    "prefix": _parse_term_level(PrefixQuery, "prefix"),
+    "wildcard": _parse_term_level(WildcardQuery, "wildcard", "wildcard"),
+    "regexp": _parse_term_level(RegexpQuery, "regexp"),
+    "fuzzy": _parse_term_level(FuzzyQuery, "fuzzy"),
+    "match_phrase_prefix": _parse_match_phrase_prefix,
+    "match_bool_prefix": _parse_match_bool_prefix,
+    "query_string": _parse_query_string,
+    "simple_query_string": _parse_simple_query_string,
+    "boosting": _parse_boosting,
+    "dis_max": _parse_dis_max,
+    "function_score": _parse_function_score,
+    "nested": _parse_nested,
+    "hybrid": _parse_hybrid,
 }

@@ -1,0 +1,243 @@
+"""Extended query DSL: multi-term, query-string family, compound scoring.
+
+Mirrors the reference's AbstractQueryTestCase approach (SURVEY.md §4):
+parse → execute → assert hit sets against hand-computed expectations.
+"""
+
+import pytest
+
+from opensearch_tpu.common.errors import ParsingException
+from opensearch_tpu.node import TpuNode
+
+DOCS = [
+    {"id": "1", "title": "the quick brown fox", "tag": "animal", "price": 10,
+     "views": 100, "created": "2024-01-05T00:00:00Z"},
+    {"id": "2", "title": "the lazy brown dog sleeps", "tag": "animal", "price": 25,
+     "views": 10, "created": "2024-02-10T00:00:00Z"},
+    {"id": "3", "title": "quick quick quick fox jumps", "tag": "speed", "price": 30,
+     "views": 1000, "created": "2024-02-20T00:00:00Z"},
+    {"id": "4", "title": "an unrelated essay", "tag": "other", "price": 7,
+     "views": 1, "created": "2024-03-01T12:30:00Z"},
+    {"id": "5", "title": "brown bears eat fish", "tag": "animols", "price": 50,
+     "views": 50, "created": "2023-12-25T00:00:00Z"},
+]
+
+MAPPINGS = {
+    "properties": {
+        "title": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "price": {"type": "long"},
+        "views": {"type": "long"},
+        "created": {"type": "date"},
+    }
+}
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = TpuNode(tmp_path_factory.mktemp("qdsl"))
+    n.create_index("items", {"settings": {"number_of_shards": 2}, "mappings": MAPPINGS})
+    for d in DOCS:
+        doc = dict(d)
+        n.index_doc("items", doc.pop("id"), doc)
+    n.refresh("items")
+    yield n
+    n.close()
+
+
+def _search(node, query, **kw):
+    return node.search("items", {"query": query, **kw})
+
+
+def _ids(resp):
+    return sorted(h["_id"] for h in resp["hits"]["hits"])
+
+
+# -- multi-term queries ----------------------------------------------------
+
+
+def test_prefix_text(node):
+    assert _ids(_search(node, {"prefix": {"title": {"value": "qui"}}})) == ["1", "3"]
+
+
+def test_prefix_keyword(node):
+    assert _ids(_search(node, {"prefix": {"tag": "anim"}})) == ["1", "2", "5"]
+
+
+def test_prefix_shorthand_and_case(node):
+    r = _search(node, {"prefix": {"tag": {"value": "ANIM", "case_insensitive": True}}})
+    assert _ids(r) == ["1", "2", "5"]
+    assert _ids(_search(node, {"prefix": {"tag": {"value": "ANIM"}}})) == []
+
+
+def test_wildcard(node):
+    assert _ids(_search(node, {"wildcard": {"title": "qu*k"}})) == ["1", "3"]
+    assert _ids(_search(node, {"wildcard": {"tag": {"value": "anim?l"}}})) == ["1", "2"]
+    assert _ids(_search(node, {"wildcard": {"tag": {"value": "anim*"}}})) == ["1", "2", "5"]
+
+
+def test_regexp(node):
+    assert _ids(_search(node, {"regexp": {"tag": "anim[ao]ls?"}})) == ["1", "2", "5"]
+
+
+def test_fuzzy(node):
+    # "animols" is 1 edit from "animals"
+    assert _ids(_search(node, {"fuzzy": {"tag": {"value": "animals"}}})) == ["1", "2", "5"]
+    assert _ids(_search(node, {"fuzzy": {"tag": {"value": "animal", "fuzziness": "0"}}})) == ["1", "2"]
+    assert _ids(_search(node, {"fuzzy": {"title": "fix"}})) == ["1", "3"]  # fox~1
+
+
+def test_match_phrase_prefix(node):
+    assert _ids(_search(node, {"match_phrase_prefix": {"title": "brown d"}})) == ["2"]
+    assert _ids(_search(node, {"match_phrase_prefix": {"title": "qui"}})) == ["1", "3"]
+
+
+def test_match_bool_prefix(node):
+    assert "3" in _ids(_search(node, {"match_bool_prefix": {"title": "jumps qu"}}))
+
+
+# -- query_string / simple_query_string ------------------------------------
+
+
+def test_query_string_basic(node):
+    r = _search(node, {"query_string": {"query": "quick AND fox", "fields": ["title"]}})
+    assert _ids(r) == ["1", "3"]
+
+
+def test_query_string_or_not(node):
+    r = _search(node, {"query_string": {"query": "fox OR bears", "fields": ["title"]}})
+    assert _ids(r) == ["1", "3", "5"]
+    r = _search(node, {"query_string": {"query": "brown NOT dog", "fields": ["title"]}})
+    assert _ids(r) == ["1", "5"]
+
+
+def test_query_string_field_syntax(node):
+    r = _search(node, {"query_string": {"query": "tag:speed OR title:essay"}})
+    assert _ids(r) == ["3", "4"]
+
+
+def test_query_string_group_rescope(node):
+    r = _search(node, {"query_string": {"query": "title:(dog OR essay)"}})
+    assert _ids(r) == ["2", "4"]
+
+
+def test_query_string_phrase_and_wildcard(node):
+    r = _search(node, {"query_string": {"query": '"brown fox"', "fields": ["title"]}})
+    assert _ids(r) == ["1"]
+    r = _search(node, {"query_string": {"query": "qu*ck", "fields": ["title"]}})
+    assert _ids(r) == ["1", "3"]
+
+
+def test_query_string_negated_field(node):
+    r = _search(node, {"query_string": {"query": "brown -title:dog", "fields": ["title"]}})
+    assert _ids(r) == ["1", "5"]
+    r = _search(node, {"query_string": {"query": "-tag:animal"}})
+    assert _ids(r) == ["3", "4", "5"]
+
+
+def test_query_string_default_all_fields(node):
+    r = _search(node, {"query_string": {"query": "speed"}})
+    assert _ids(r) == ["3"]
+
+
+def test_simple_query_string(node):
+    r = _search(node, {"simple_query_string": {"query": "quick +fox", "fields": ["title"]}})
+    assert _ids(r) == ["1", "3"]
+    r = _search(node, {"simple_query_string": {"query": "brown -dog", "fields": ["title"]}})
+    assert _ids(r) == ["1", "5"]
+    r = _search(node, {"simple_query_string": {"query": "fox | bears", "fields": ["title"]}})
+    assert _ids(r) == ["1", "3", "5"]
+
+
+def test_simple_query_string_never_throws(node):
+    r = _search(node, {"simple_query_string": {"query": "fox (((", "fields": ["title"]}})
+    assert "1" in _ids(r)
+
+
+# -- compound scoring ------------------------------------------------------
+
+
+def test_dis_max(node):
+    r = _search(node, {"dis_max": {"queries": [
+        {"term": {"tag": "speed"}}, {"match": {"title": "essay"}},
+    ]}})
+    assert _ids(r) == ["3", "4"]
+
+
+def test_boosting(node):
+    r = _search(node, {"boosting": {
+        "positive": {"match": {"title": "brown"}},
+        "negative": {"match": {"title": "dog"}},
+        "negative_boost": 0.1,
+    }})
+    ids = [h["_id"] for h in r["hits"]["hits"]]
+    assert sorted(ids) == ["1", "2", "5"]
+    assert ids[-1] == "2"  # demoted, not removed
+
+
+def test_function_score_weight_filter(node):
+    r = _search(node, {"function_score": {
+        "query": {"match": {"title": "brown"}},
+        "functions": [
+            {"filter": {"term": {"tag": "animal"}}, "weight": 10},
+        ],
+        "boost_mode": "replace",
+    }})
+    ids = [h["_id"] for h in r["hits"]["hits"]]
+    assert set(ids[:2]) == {"1", "2"}
+    scores = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+    assert scores["1"] == pytest.approx(10.0)
+    assert scores["5"] == pytest.approx(1.0)
+
+
+def test_function_score_field_value_factor(node):
+    r = _search(node, {"function_score": {
+        "query": {"match_all": {}},
+        "field_value_factor": {"field": "views", "modifier": "log1p", "factor": 1.0},
+        "boost_mode": "replace",
+    }})
+    ids = [h["_id"] for h in r["hits"]["hits"]]
+    assert ids[0] == "3" and ids[1] == "1"  # views desc: 1000, 100, 50, 10, 1
+
+
+def test_function_score_decay_gauss(node):
+    r = _search(node, {"function_score": {
+        "query": {"match_all": {}},
+        "gauss": {"price": {"origin": 10, "scale": 20}},
+        "boost_mode": "replace",
+    }})
+    scores = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+    assert scores["1"] == pytest.approx(1.0)          # at origin
+    assert scores["5"] < scores["2"] < scores["1"]    # farther -> lower
+
+
+def test_function_score_random_deterministic(node):
+    body = {"function_score": {
+        "query": {"match_all": {}},
+        "random_score": {"seed": 7},
+        "boost_mode": "replace",
+    }}
+    a = _search(node, body)
+    b = _search(node, body)
+    assert [h["_score"] for h in a["hits"]["hits"]] == [h["_score"] for h in b["hits"]["hits"]]
+
+
+def test_nested_flattened(node):
+    # flattened semantics: nested delegates to dotted-field inner query
+    r = _search(node, {"nested": {"path": "meta", "query": {"term": {"tag": "speed"}}}})
+    assert _ids(r) == ["3"]
+
+
+def test_hybrid_fallback(node):
+    r = _search(node, {"hybrid": {"queries": [
+        {"term": {"tag": "speed"}}, {"match": {"title": "essay"}},
+    ]}})
+    assert _ids(r) == ["3", "4"]
+
+
+def test_unknown_function_rejected(node):
+    with pytest.raises(ParsingException):
+        _search(node, {"function_score": {
+            "query": {"match_all": {}},
+            "functions": [{"script_score": {"script": "1"}}],
+        }})
