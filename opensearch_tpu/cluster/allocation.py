@@ -73,10 +73,6 @@ def reroute(state: ClusterState, settings: AllocationSettings | None = None) -> 
     """Compute a new routing table: build desired shard copies from index
     metadata, keep valid existing assignments, allocate the rest."""
     settings = settings or AllocationSettings()
-    existing: dict[tuple[str, int, bool, str | None], ShardRoutingEntry] = {}
-    for r in state.routing:
-        existing[(r.index, r.shard, r.primary, r.node_id)] = r
-
     new_routing: list[ShardRoutingEntry] = []
     data_nodes = [n.node_id for n in state.nodes.values() if n.is_data]
 
@@ -98,6 +94,7 @@ def reroute(state: ClusterState, settings: AllocationSettings | None = None) -> 
 
             if current_primary is not None:
                 new_routing.append(current_primary)
+                kept = current_replicas[: meta.num_replicas]
             else:
                 # promote a started replica to primary (failover) before
                 # allocating a fresh one (the in-sync promotion path)
@@ -106,16 +103,21 @@ def reroute(state: ClusterState, settings: AllocationSettings | None = None) -> 
                 )
                 if promoted is not None:
                     current_replicas.remove(promoted)
+                    kept = current_replicas[: meta.num_replicas]
                     new_routing.append(
                         ShardRoutingEntry(index_name, shard, promoted.node_id,
                                           primary=True, state=promoted.state)
                     )
                 else:
-                    # fresh primary allocation
+                    # fresh primary allocation; the deciders must also see
+                    # the replicas we are about to keep, or the primary can
+                    # land on a node already holding a copy of this shard
+                    # (SameShardAllocationDecider violation)
+                    kept = current_replicas[: meta.num_replicas]
                     candidates = sorted(
                         (nid for nid in data_nodes
                          if _decide(state, ShardRoutingEntry(index_name, shard, None, True),
-                                    nid, new_routing, settings)),
+                                    nid, new_routing + kept, settings)),
                         key=lambda nid: (node_load(nid), nid),
                     )
                     if candidates:
@@ -129,7 +131,6 @@ def reroute(state: ClusterState, settings: AllocationSettings | None = None) -> 
                                               primary=True, state="UNASSIGNED")
                         )
 
-            kept = current_replicas[: meta.num_replicas]
             new_routing.extend(kept)
             for _ in range(meta.num_replicas - len(kept)):
                 entry = ShardRoutingEntry(index_name, shard, None, primary=False)

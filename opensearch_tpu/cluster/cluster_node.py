@@ -78,6 +78,11 @@ class ClusterNode:
         self.local_shards: dict[tuple[str, int], IndexShard] = {}
         self._mapper_services: dict[str, MapperService] = {}
         self._index_versions: dict[str, int] = {}
+        # primary-side recovery tracking (ReplicationTracker.initiateTracking
+        # analog): targets that requested recovery receive concurrent writes
+        # even before the routing table shows them STARTED — otherwise ops
+        # arriving between the recovery dump and shard-started are lost
+        self._tracked_targets: dict[tuple[str, int], set[str]] = {}
 
         reg = transport.register
         reg(node_id, "cluster:admin/create_index", self._on_create_index)
@@ -128,7 +133,17 @@ class ClusterNode:
         for key in list(self.local_shards):
             if key not in my_shards or key[0] not in state.indices:
                 shard = self.local_shards.pop(key)
+                self._tracked_targets.pop(key, None)
                 shard.close()
+        # drop tracked recovery targets that are no longer assigned copies
+        for key, targets in list(self._tracked_targets.items()):
+            assigned = {
+                r.node_id for r in state.routing
+                if (r.index, r.shard) == key and r.node_id is not None
+            }
+            targets &= assigned
+            if not targets:
+                self._tracked_targets.pop(key, None)
         for index_name in list(self._mapper_services):
             if index_name not in state.indices:
                 self._mapper_services.pop(index_name, None)
@@ -150,9 +165,15 @@ class ClusterNode:
                     else:
                         self._start_replica_recovery(index_name, shard_num, state)
             else:
-                self.local_shards[(index_name, shard_num)].primary = entry.primary
-                if entry.state == "INITIALIZING" and entry.primary:
-                    self._report_shard_started(index_name, shard_num)
+                shard = self.local_shards[(index_name, shard_num)]
+                shard.primary = entry.primary
+                if entry.state == "INITIALIZING":
+                    # re-report on every publication until the leader records
+                    # STARTED — a lost shard-started message (timeout, old
+                    # leader died) must not leave the copy INITIALIZING
+                    # forever (ShardStateAction resend semantics)
+                    if entry.primary or getattr(shard, "recovery_done", False):
+                        self._report_shard_started(index_name, shard_num)
 
     # -- shard started / recovery ------------------------------------------
 
@@ -197,6 +218,7 @@ class ClusterNode:
                 else:
                     local.apply_delete_on_replica(op["id"], op["seq_no"])
             local.refresh()
+            local.recovery_done = True
             self._report_shard_started(index, shard)
 
         self.transport.send(
@@ -221,6 +243,12 @@ class ClusterNode:
         """Primary-side recovery source: dump live docs + seq_nos (the
         logical-ops path of RecoverySourceHandler)."""
         shard = self._local_shard(payload["index"], payload["shard"])
+        # track the target BEFORE snapshotting: every write from here on is
+        # fanned out to it, and the seq_no stale-op check on the target makes
+        # the dump/fan-out overlap idempotent in either arrival order
+        self._tracked_targets.setdefault(
+            (payload["index"], payload["shard"]), set()
+        ).add(payload["target"])
         engine = shard.engine
         ops: list[dict] = []
         snapshot = engine.acquire_searcher()
@@ -379,25 +407,29 @@ class ClusterNode:
             )
         else:
             result = shard.apply_delete_on_primary(payload["id"])
-        # fan out to all STARTED replicas (ReplicationOperation.performOnReplicas)
+        # fan out to every assigned replica copy — STARTED and recovering
+        # alike (ReplicationOperation.performOnReplicas sends to all in-sync
+        # + tracked copies; a recovering replica dedups via seq_no)
         state = self.applied_state
-        replicas = [
-            r for r in state.shards_for_index(index)
+        target_nodes = {
+            r.node_id for r in state.shards_for_index(index)
             if r.shard == shard_num and not r.primary
-            and r.state == "STARTED" and r.node_id is not None
-        ]
+            and r.state in ("STARTED", "INITIALIZING") and r.node_id is not None
+        }
+        target_nodes |= self._tracked_targets.get((index, shard_num), set())
+        target_nodes.discard(self.node_id)
         replica_payload = dict(payload, seq_no=result.seq_no, version=result.version)
-        for r in replicas:
+        for nid in sorted(target_nodes):
             self.transport.send(
-                self.node_id, r.node_id, "indices:data/write[r]", replica_payload,
+                self.node_id, nid, "indices:data/write[r]", replica_payload,
                 on_response=None,
                 on_failure=lambda e: None,  # failed-replica eviction: TODO
             )
         return {
             "_index": index, "_id": payload["id"], "_version": result.version,
             "_seq_no": result.seq_no, "result": result.result,
-            "_shards": {"total": 1 + len(replicas), "successful": 1 + len(replicas),
-                        "failed": 0},
+            "_shards": {"total": 1 + len(target_nodes),
+                        "successful": 1 + len(target_nodes), "failed": 0},
         }
 
     def _on_replica_write(self, sender: str, payload: dict) -> dict:
@@ -474,6 +506,10 @@ class ClusterNode:
             return
         body = body or {}
         size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        sort = body.get("sort")
+        if isinstance(sort, (str, dict)):
+            sort = [sort]
         # pick one STARTED copy per shard (prefer primary; adaptive replica
         # selection is a later refinement)
         targets: dict[int, ShardRoutingEntry] = {}
@@ -493,7 +529,7 @@ class ClusterNode:
                 results[shard_num] = resp
                 remaining[0] -= 1
                 if remaining[0] == 0:
-                    callback(self._merge_search_results(results, size))
+                    callback(self._merge_search_results(results, size, from_, sort))
             return handle
 
         for shard_num, r in sorted(targets.items()):
@@ -531,7 +567,10 @@ class ClusterNode:
         return {"total": result.total, "hits": hits,
                 "max_score": result.max_score}
 
-    def _merge_search_results(self, results: dict[int, dict], size: int) -> dict:
+    def _merge_search_results(
+        self, results: dict[int, dict], size: int,
+        from_: int = 0, sort: list | None = None,
+    ) -> dict:
         total = 0
         max_score = None
         merged = []
@@ -548,7 +587,17 @@ class ClusterNode:
                 max_score = resp["max_score"]
             for h in resp["hits"]:
                 merged.append((shard_num, h))
-        merged.sort(key=lambda sh: (-(sh[1]["_score"] or 0.0), sh[0], sh[1]["_id"]))
+        if sort:
+            # k-way merge on per-hit sort values (SearchPhaseController
+            # mergeTopDocs for field sorts), shard index as tie-break
+            from opensearch_tpu.search.service import _values_key
+
+            merged.sort(
+                key=lambda sh: (_values_key(sort, sh[1].get("sort", [])),
+                                sh[0], sh[1]["_id"])
+            )
+        else:
+            merged.sort(key=lambda sh: (-(sh[1]["_score"] or 0.0), sh[0], sh[1]["_id"]))
         return {
             "took": 0,
             "timed_out": False,
@@ -557,7 +606,7 @@ class ClusterNode:
             "hits": {
                 "total": {"value": total, "relation": "eq"},
                 "max_score": max_score,
-                "hits": [h for _, h in merged[:size]],
+                "hits": [h for _, h in merged[from_: from_ + size]],
             },
         }
 

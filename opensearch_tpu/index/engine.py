@@ -129,6 +129,16 @@ class Engine:
                     f"[{doc_id}]: version conflict, required seqNo [{if_seq_no}], "
                     f"current document has seqNo [{current_seq}]"
                 )
+        if seq_no is not None and entry is not None and entry.seq_no >= seq_no:
+            # stale op on the replica/replay path: a newer op for this doc
+            # already applied (reference: per-doc seq_no check in
+            # InternalEngine.planIndexingAsNonPrimary — ops may arrive both
+            # via recovery dump and concurrent replication fan-out, in
+            # either order)
+            self._seq_no = max(self._seq_no, seq_no)
+            self.local_checkpoint = self._seq_no
+            return OpResult(doc_id, seq_no, entry.version, created=False,
+                            result="noop")
         parsed = self.mapper_service.parse_document(doc_id, source, routing)
         op_seq = seq_no if seq_no is not None else self._next_seq_no()
         if seq_no is not None:
@@ -152,6 +162,12 @@ class Engine:
     def delete(self, doc_id: str, seq_no: int | None = None) -> OpResult:
         entry = self.version_map.get(doc_id)
         found = (entry is not None and not entry.deleted) or doc_id in self._buffer_pos
+        if seq_no is not None and entry is not None and entry.seq_no >= seq_no:
+            # stale op (see index()): ignore, a newer op already applied
+            self._seq_no = max(self._seq_no, seq_no)
+            self.local_checkpoint = self._seq_no
+            return OpResult(doc_id, seq_no, entry.version, found=False,
+                            result="noop")
         op_seq = seq_no if seq_no is not None else self._next_seq_no()
         if seq_no is not None:
             self._seq_no = max(self._seq_no, seq_no)

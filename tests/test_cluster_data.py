@@ -217,3 +217,73 @@ def test_primary_failover_preserves_data(sim):
     # writes continue to work through the new primary
     resp = sim.call(sim.nodes[new_primary.node_id].index_doc, "ha", "9", {"n": 9})
     assert resp["result"] == "created"
+
+
+def test_reroute_no_fresh_primary_on_replica_node():
+    """SameShardAllocationDecider must also see kept replicas when placing a
+    fresh primary (regression: primary landed on the replica's node)."""
+    from opensearch_tpu.cluster.allocation import mark_shard_started
+
+    state = _cluster_state(2, {"idx": IndexMeta("idx", 1, 1)})
+    state = reroute(state)
+    primary = next(r for r in state.routing if r.primary)
+    state = mark_shard_started(state, "idx", 0, primary.node_id)
+    # primary node leaves while the replica is still INITIALIZING (not
+    # promotable): the shard must go UNASSIGNED, not become a fresh empty
+    # primary on the node that already holds the recovering copy
+    nodes = {k: v for k, v in state.nodes.items() if k != primary.node_id}
+    state = reroute(state.with_(nodes=nodes))
+    new_primary = next(r for r in state.routing if r.primary)
+    replica = next(r for r in state.routing if not r.primary)
+    assert replica.node_id is not None
+    assert new_primary.node_id != replica.node_id
+    assert new_primary.state == "UNASSIGNED"
+
+
+def test_distributed_search_sort_and_from(sim):
+    sim.call(sim.nodes["n0"].create_index, "pg",
+             {"settings": {"index": {"number_of_shards": 2,
+                                     "number_of_replicas": 0}},
+              "mappings": {"properties": {"n": {"type": "long"}}}})
+    sim.run(5_000)
+    for i in range(10):
+        sim.call(sim.nodes["n0"].index_doc, "pg", str(i), {"n": i})
+    sim.call(sim.nodes["n0"].refresh, "pg")
+    sim.run(1_000)
+    # global order across shards must follow the sort field, not shard index
+    resp = sim.call(sim.nodes["n1"].search, "pg",
+                    {"sort": [{"n": "asc"}], "size": 4})
+    assert [h["_source"]["n"] for h in resp["hits"]["hits"]] == [0, 1, 2, 3]
+    # pagination: from skips into the globally sorted stream
+    resp = sim.call(sim.nodes["n1"].search, "pg",
+                    {"sort": [{"n": "asc"}], "size": 4, "from": 4})
+    assert [h["_source"]["n"] for h in resp["hits"]["hits"]] == [4, 5, 6, 7]
+
+
+def test_writes_during_replica_recovery_not_lost(sim):
+    """Ops arriving between the recovery dump and shard-started must reach
+    the recovering replica (tracked-target fan-out + seq_no dedup)."""
+    sim.call(sim.nodes["n0"].create_index, "wr",
+             {"settings": {"index": {"number_of_shards": 1,
+                                     "number_of_replicas": 1}}})
+    # wait only until the PRIMARY is routable on n0 (replica may still be
+    # INITIALIZING), so writes land mid-recovery
+    for _ in range(2000):
+        state = sim.nodes["n0"].applied_state
+        p = state.primary("wr", 0)
+        if p is not None and p.node_id is not None:
+            break
+        sim.queue.run_one()
+    # interleave writes with tiny scheduler steps so some land mid-recovery
+    for i in range(10):
+        sim.call(sim.nodes["n0"].index_doc, "wr", str(i), {"n": i})
+        sim.run(30)
+    sim.run(10_000)
+    state = sim.leader().applied_state
+    copies = list(state.shards_for_index("wr"))
+    assert len(copies) == 2 and all(r.state == "STARTED" for r in copies)
+    for r in copies:
+        shard = sim.nodes[r.node_id].local_shards[("wr", 0)]
+        assert shard.num_docs == 10, f"{r.node_id} has {shard.num_docs}"
+        for i in range(10):
+            assert shard.get(str(i)) is not None, (r.node_id, i)
