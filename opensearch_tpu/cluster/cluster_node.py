@@ -504,12 +504,15 @@ class ClusterNode:
         if meta is None:
             callback({"error": f"no such index [{index}]"})
             return
-        body = body or {}
+        body = dict(body or {})
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         sort = body.get("sort")
         if isinstance(sort, (str, dict)):
+            # normalize once and forward the normalized form — shards and
+            # coordinator must agree on the sort spec
             sort = [sort]
+            body["sort"] = sort
         # pick one STARTED copy per shard (prefer primary; adaptive replica
         # selection is a later refinement)
         targets: dict[int, ShardRoutingEntry] = {}
@@ -547,10 +550,13 @@ class ClusterNode:
         body = payload.get("body") or {}
         node = query_dsl.parse_query(body.get("query"))
         size = int(body.get("size", 10)) + int(body.get("from", 0))
+        sort = body.get("sort")
+        if isinstance(sort, (str, dict)):
+            sort = [sort]
         snapshot = shard.acquire_searcher()
         result = execute_query_phase(
             snapshot, shard.mapper_service, node, size=size,
-            sort=body.get("sort"),
+            sort=sort,
         )
         src_filter = _source_filter(body.get("_source", True))
         hits = []

@@ -81,6 +81,13 @@ class SearchPhaseExecutionException(OpenSearchTpuException):
     error_type = "search_phase_execution_exception"
 
 
+class SearchContextMissingException(OpenSearchTpuException):
+    """Expired/unknown scroll or PIT id (search/SearchContextMissingException)."""
+
+    status = 404
+    error_type = "search_context_missing_exception"
+
+
 class TaskCancelledException(OpenSearchTpuException):
     status = 400
     error_type = "task_cancelled_exception"

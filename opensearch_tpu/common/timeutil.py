@@ -1,0 +1,41 @@
+"""Time-value parsing (the reference's TimeValue.parseTimeValue analog,
+libs/core/src/main/java/org/opensearch/core/common/unit/TimeValue.java)."""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from opensearch_tpu.common.errors import IllegalArgumentException
+
+_UNITS_MS = {
+    "nanos": 1e-6, "micros": 1e-3, "ms": 1, "s": 1000, "m": 60_000,
+    "h": 3_600_000, "d": 86_400_000, "w": 604_800_000,
+}
+
+
+def parse_time_value_millis(
+    value: Any, name: str = "time", positive: bool = False
+) -> int:
+    """'30s' / '1m' / '100ms' / bare int (millis) -> milliseconds."""
+    if isinstance(value, (int, float)):
+        out = int(value)
+    else:
+        s = str(value).strip()
+        m = re.fullmatch(r"(-?\d+(?:\.\d+)?)\s*(nanos|micros|ms|s|m|h|d|w)", s)
+        if not m:
+            raise IllegalArgumentException(
+                f"failed to parse setting [{name}] with value [{value}] as a time value"
+            )
+        out = int(float(m.group(1)) * _UNITS_MS[m.group(2)])
+    if positive and out <= 0:
+        raise IllegalArgumentException(
+            f"[{name}] must be positive, got [{value}]"
+        )
+    return out
+
+
+def now_millis() -> int:
+    import time
+
+    return int(time.monotonic() * 1000)

@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import re
 import time
+import uuid
 from pathlib import Path
 from typing import Any
 
@@ -24,7 +25,12 @@ from opensearch_tpu.common.errors import (
     IndexNotFoundException,
     OpenSearchTpuException,
     ResourceAlreadyExistsException,
+    SearchContextMissingException,
     VersionConflictException,
+)
+from opensearch_tpu.common.timeutil import (
+    now_millis as _now_ms,
+    parse_time_value_millis,
 )
 from opensearch_tpu.common.hashing import shard_id_for_routing
 from opensearch_tpu.common.settings import Settings
@@ -70,6 +76,8 @@ class TpuNode:
         self.data_path = Path(data_path)
         self.node_name = node_name
         self.indices: dict[str, IndexService] = {}
+        # scroll/PIT reader contexts (SearchService's ReaderContext registry)
+        self._reader_contexts: dict[str, dict] = {}
         self._state_file = self.data_path / "indices.json"
         self._recover_indices()
 
@@ -347,13 +355,136 @@ class TpuNode:
                 count += 1
         return {"_shards": {"total": count, "successful": count, "failed": 0}}
 
-    def search(self, index: str, body: dict | None = None) -> dict:
+    def search(self, index: str | None = None, body: dict | None = None,
+               scroll: str | None = None) -> dict:
+        body = dict(body or {})
+        pit = body.pop("pit", None)
+        if pit is not None:
+            if scroll is not None:
+                raise IllegalArgumentException(
+                    "[scroll] cannot be used with a point-in-time"
+                )
+            if index is not None:
+                raise IllegalArgumentException(
+                    "[pit] cannot be used with an index in the request path"
+                )
+            ctx = self._resolve_reader_context(str(pit.get("id", "")), "pit")
+            if pit.get("keep_alive"):
+                ctx["expires_at"] = _now_ms() + parse_time_value_millis(
+                    pit["keep_alive"], "keep_alive", positive=True
+                )
+            resp = search_service.search(
+                ctx["shards"], body, acquired=ctx["snapshots"]
+            )
+            resp["pit_id"] = ctx["id"]
+            return resp
+        names = self.resolve_indices(index if index is not None else "_all")
+        shards: list = []
+        for name in names:
+            shards.extend(self._get_index(name).shards.values())
+        if scroll is not None:
+            if int(body.get("from", 0)) > 0:
+                raise IllegalArgumentException("[from] is not supported with scroll")
+            if body.get("search_after") is not None:
+                raise IllegalArgumentException(
+                    "[search_after] is not supported with scroll"
+                )
+            return self._start_scroll(shards, body, scroll)
+        # per-hit _index comes from each shard's ShardId inside the service
+        return search_service.search(shards, body)
+
+    # -- reader contexts: scroll + point-in-time (ReaderContext registry) --
+
+    def _reap_expired_contexts(self) -> None:
+        now = _now_ms()
+        for cid in [c for c, ctx in self._reader_contexts.items()
+                    if ctx["expires_at"] < now]:
+            del self._reader_contexts[cid]
+
+    def _resolve_reader_context(self, cid: str, kind: str) -> dict:
+        self._reap_expired_contexts()
+        ctx = self._reader_contexts.get(cid)
+        if ctx is None or ctx["kind"] != kind:
+            raise SearchContextMissingException(cid)
+        return ctx
+
+    def _start_scroll(self, shards: list, body: dict, scroll: str) -> dict:
+        self._reap_expired_contexts()
+        keep_ms = parse_time_value_millis(scroll, "scroll", positive=True)
+        cid = f"scroll_{uuid.uuid4().hex}"
+        snapshots = [s.acquire_searcher() for s in shards]
+        size = int(body.get("size", search_service.DEFAULT_SIZE))
+        ctx = {
+            "id": cid, "kind": "scroll", "shards": shards,
+            "snapshots": snapshots, "body": body, "seen": size,
+            "size": size, "keep_alive_ms": keep_ms,
+            "expires_at": _now_ms() + keep_ms,
+        }
+        resp = search_service.search(shards, body, acquired=snapshots)
+        self._reader_contexts[cid] = ctx
+        resp["_scroll_id"] = cid
+        return resp
+
+    def scroll(self, scroll_id: str, scroll: str | None = None) -> dict:
+        """Next scroll page. Pages deepen from+size against the PINNED
+        snapshots (deterministic order on an immutable view — the reference
+        instead persists per-shard collector state; deepening trades compute
+        for simplicity and is exact)."""
+        ctx = self._resolve_reader_context(scroll_id, "scroll")
+        if scroll is not None:
+            ctx["keep_alive_ms"] = parse_time_value_millis(scroll, "scroll", positive=True)
+        ctx["expires_at"] = _now_ms() + ctx["keep_alive_ms"]
+        page_body = {k: v for k, v in ctx["body"].items()
+                     if k not in ("aggs", "aggregations")}
+        page_body["from"] = ctx["seen"]
+        page_body["size"] = ctx["size"]
+        resp = search_service.search(
+            ctx["shards"], page_body, acquired=ctx["snapshots"]
+        )
+        ctx["seen"] += len(resp["hits"]["hits"])
+        resp["_scroll_id"] = scroll_id
+        return resp
+
+    def clear_scroll(self, scroll_ids: list[str] | None) -> dict:
+        self._reap_expired_contexts()
+        freed = 0
+        ids = scroll_ids or [c for c, x in self._reader_contexts.items()
+                             if x["kind"] == "scroll"]
+        for cid in list(ids):
+            if cid in self._reader_contexts:
+                del self._reader_contexts[cid]
+                freed += 1
+        return {"succeeded": True, "num_freed": freed}
+
+    def open_pit(self, index: str, keep_alive: str) -> dict:
+        self._reap_expired_contexts()
+        keep_ms = parse_time_value_millis(keep_alive, "keep_alive", positive=True)
         names = self.resolve_indices(index)
         shards: list = []
         for name in names:
             shards.extend(self._get_index(name).shards.values())
-        # per-hit _index comes from each shard's ShardId inside the service
-        return search_service.search(shards, body)
+        cid = f"pit_{uuid.uuid4().hex}"
+        self._reader_contexts[cid] = {
+            "id": cid, "kind": "pit", "shards": shards,
+            "snapshots": [s.acquire_searcher() for s in shards],
+            "keep_alive_ms": keep_ms, "expires_at": _now_ms() + keep_ms,
+        }
+        return {"pit_id": cid, "_shards": {"total": len(shards),
+                                           "successful": len(shards),
+                                           "skipped": 0, "failed": 0},
+                "creation_time": int(time.time() * 1000)}
+
+    def close_pit(self, pit_ids: list[str] | None) -> dict:
+        self._reap_expired_contexts()
+        ids = pit_ids or [c for c, x in self._reader_contexts.items()
+                          if x["kind"] == "pit"]
+        pits = []
+        for cid in list(ids):
+            ok = cid in self._reader_contexts
+            if ok:
+                del self._reader_contexts[cid]
+            pits.append({"pit_id": cid, "successful": ok})
+        return {"pits": pits}
 
     def msearch(self, searches: list[tuple[dict, dict]]) -> dict:
         responses = []

@@ -54,6 +54,14 @@ def build_router() -> Router:
     reg("POST", "/{index}/_search", search)
     reg("GET", "/_search", search_all)
     reg("POST", "/_search", search_all)
+    reg("GET", "/_search/scroll", scroll)
+    reg("POST", "/_search/scroll", scroll)
+    reg("POST", "/_search/scroll/{scroll_id}", scroll)
+    reg("DELETE", "/_search/scroll", clear_scroll)
+    reg("DELETE", "/_search/scroll/{scroll_id}", clear_scroll)
+    reg("POST", "/{index}/_search/point_in_time", open_pit)
+    reg("DELETE", "/_search/point_in_time", close_pit)
+    reg("DELETE", "/_search/point_in_time/_all", close_all_pits)
     reg("GET", "/_msearch", msearch)
     reg("POST", "/_msearch", msearch)
     reg("POST", "/{index}/_msearch", msearch)
@@ -238,14 +246,9 @@ def bulk(node: TpuNode, params, query, body):
 def _body_with_query_params(query, body):
     body = dict(body or {})
     if "q" in query:
-        # Lucene-lite query string: fall back to a match on _all-style text —
-        # support field:value and bare terms via simple translation
-        qs = query["q"]
-        if ":" in qs:
-            fname, value = qs.split(":", 1)
-            body.setdefault("query", {"match": {fname: value}})
-        else:
-            body.setdefault("query", {"multi_match": {"query": qs, "fields": ["*"]}})
+        # URI search: full Lucene-style mini-language via the query_string
+        # parser (RestSearchAction's q= handling)
+        body.setdefault("query", {"query_string": {"query": query["q"]}})
     for key in ("size", "from"):
         if key in query:
             body.setdefault(key, int(query[key]))
@@ -253,11 +256,57 @@ def _body_with_query_params(query, body):
 
 
 def search(node: TpuNode, params, query, body):
-    return 200, node.search(params["index"], _body_with_query_params(query, body))
+    return 200, node.search(params["index"], _body_with_query_params(query, body),
+                            scroll=query.get("scroll"))
 
 
 def search_all(node: TpuNode, params, query, body):
-    return 200, node.search("_all", _body_with_query_params(query, body))
+    # index=None (not "_all"): a PIT body carries its own shard set and is
+    # only legal without an index in the path
+    return 200, node.search(None, _body_with_query_params(query, body),
+                            scroll=query.get("scroll"))
+
+
+def scroll(node: TpuNode, params, query, body):
+    body = body or {}
+    scroll_id = params.get("scroll_id") or body.get("scroll_id") or query.get("scroll_id")
+    if not scroll_id:
+        raise IllegalArgumentException("scroll_id is required")
+    keep = body.get("scroll") or query.get("scroll")
+    return 200, node.scroll(str(scroll_id), keep)
+
+
+def clear_scroll(node: TpuNode, params, query, body):
+    body = body or {}
+    ids = params.get("scroll_id") or body.get("scroll_id") or query.get("scroll_id")
+    if not ids:
+        raise IllegalArgumentException("scroll_id is required (use _all to clear every scroll)")
+    if isinstance(ids, str):
+        ids = None if ids == "_all" else ids.split(",")
+    return 200, node.clear_scroll(ids)
+
+
+def open_pit(node: TpuNode, params, query, body):
+    keep_alive = query.get("keep_alive")
+    if not keep_alive:
+        raise IllegalArgumentException("[keep_alive] is required to open a PIT")
+    return 200, node.open_pit(params["index"], keep_alive)
+
+
+def close_pit(node: TpuNode, params, query, body):
+    body = body or {}
+    ids = body.get("pit_id")
+    if not ids:
+        raise IllegalArgumentException(
+            "pit_id is required (DELETE /_search/point_in_time/_all closes all)"
+        )
+    if isinstance(ids, str):
+        ids = [ids]
+    return 200, node.close_pit(ids)
+
+
+def close_all_pits(node: TpuNode, params, query, body):
+    return 200, node.close_pit(None)
 
 
 def msearch(node: TpuNode, params, query, body):

@@ -40,7 +40,12 @@ DEFAULT_SIZE = 10
 def search(
     shards: list[IndexShard],
     body: dict | None,
+    acquired: list | None = None,
 ) -> dict[str, Any]:
+    """Run one search over `shards`. `acquired` optionally pins the searcher
+    snapshots to use, one per shard in order — the scroll/PIT path
+    (ReaderContext.java:64 analog: the context owns the snapshots, so pages
+    see one immutable point-in-time view regardless of refreshes)."""
     t0 = time.monotonic()
     body = body or {}
     known_keys = {
@@ -71,8 +76,8 @@ def search(
 
     fetch_k = from_ + size
     per_shard_results = []
-    for shard in shards:
-        snapshot = shard.acquire_searcher()
+    for shard_i, shard in enumerate(shards):
+        snapshot = acquired[shard_i] if acquired is not None else shard.acquire_searcher()
         per_shard_results.append(
             (
                 shard,

@@ -230,3 +230,37 @@ def test_oversized_body_rejected_413(server):
     resp = s.recv(65536).decode()
     s.close()
     assert resp.startswith("HTTP/1.1 413")
+
+
+def test_scroll_over_rest(server):
+    _req("PUT", "/scr", {"mappings": {"properties": {"n": {"type": "long"}}}})
+    for i in range(12):
+        _req("PUT", f"/scr/_doc/{i}", {"n": i})
+    _req("POST", "/scr/_refresh")
+    st, r = _req("POST", "/scr/_search?scroll=1m", {"sort": [{"n": "asc"}], "size": 5})
+    assert st == 200 and "_scroll_id" in r
+    sid = r["_scroll_id"]
+    ns = [h["_source"]["n"] for h in r["hits"]["hits"]]
+    while True:
+        st, r = _req("POST", "/_search/scroll", {"scroll_id": sid, "scroll": "1m"})
+        assert st == 200
+        if not r["hits"]["hits"]:
+            break
+        ns.extend(h["_source"]["n"] for h in r["hits"]["hits"])
+    assert ns == list(range(12))
+    st, r = _req("DELETE", "/_search/scroll", {"scroll_id": sid})
+    assert st == 200 and r["num_freed"] == 1
+
+
+def test_pit_over_rest(server):
+    _req("PUT", "/pidx", {"mappings": {"properties": {"n": {"type": "long"}}}})
+    for i in range(3):
+        _req("PUT", f"/pidx/_doc/{i}", {"n": i})
+    _req("POST", "/pidx/_refresh")
+    st, r = _req("POST", "/pidx/_search/point_in_time?keep_alive=1m")
+    assert st == 200 and "pit_id" in r
+    pid = r["pit_id"]
+    st, r = _req("POST", "/_search", {"pit": {"id": pid}, "sort": [{"n": "asc"}]})
+    assert st == 200 and len(r["hits"]["hits"]) == 3
+    st, r = _req("DELETE", "/_search/point_in_time", {"pit_id": pid})
+    assert st == 200 and r["pits"][0]["successful"]

@@ -1,0 +1,143 @@
+"""Scroll + point-in-time reader contexts (ReaderContext registry analog)."""
+
+import pytest
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentException,
+    SearchContextMissingException,
+)
+from opensearch_tpu.node import TpuNode
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = TpuNode(tmp_path)
+    n.create_index("logs", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {"n": {"type": "long"},
+                                    "msg": {"type": "text"}}},
+    })
+    for i in range(25):
+        n.index_doc("logs", str(i), {"n": i, "msg": f"event number {i}"})
+    n.refresh("logs")
+    yield n
+    n.close()
+
+
+def _ns(resp):
+    return [h["_source"]["n"] for h in resp["hits"]["hits"]]
+
+
+def test_scroll_iterates_everything_in_order(node):
+    resp = node.search("logs", {"sort": [{"n": "asc"}], "size": 10}, scroll="1m")
+    sid = resp["_scroll_id"]
+    collected = _ns(resp)
+    while True:
+        resp = node.scroll(sid)
+        if not resp["hits"]["hits"]:
+            break
+        collected.extend(_ns(resp))
+    assert collected == list(range(25))
+    node.clear_scroll([sid])
+
+
+def test_scroll_sees_point_in_time_view(node):
+    resp = node.search("logs", {"sort": [{"n": "asc"}], "size": 5}, scroll="1m")
+    sid = resp["_scroll_id"]
+    # concurrent writes + refresh must NOT appear in the scroll
+    for i in range(100, 110):
+        node.index_doc("logs", str(i), {"n": i, "msg": "late"})
+    node.refresh("logs")
+    collected = _ns(resp)
+    while True:
+        resp = node.scroll(sid)
+        if not resp["hits"]["hits"]:
+            break
+        collected.extend(_ns(resp))
+    assert collected == list(range(25))
+
+
+def test_scroll_score_order_without_sort(node):
+    resp = node.search("logs", {"query": {"match": {"msg": "event"}}, "size": 7},
+                       scroll="1m")
+    sid = resp["_scroll_id"]
+    seen = [h["_id"] for h in resp["hits"]["hits"]]
+    while True:
+        resp = node.scroll(sid)
+        if not resp["hits"]["hits"]:
+            break
+        seen.extend(h["_id"] for h in resp["hits"]["hits"])
+    assert sorted(seen, key=int) == [str(i) for i in range(25)]
+    assert len(set(seen)) == 25  # no duplicates across pages
+
+
+def test_scroll_expiry_and_missing(node):
+    resp = node.search("logs", {"size": 5}, scroll="1ms")
+    sid = resp["_scroll_id"]
+    import time
+
+    time.sleep(0.05)
+    with pytest.raises(SearchContextMissingException):
+        node.scroll(sid)
+    with pytest.raises(SearchContextMissingException):
+        node.scroll("scroll_nonexistent")
+
+
+def test_scroll_rejects_from(node):
+    with pytest.raises(IllegalArgumentException):
+        node.search("logs", {"from": 5}, scroll="1m")
+
+
+def test_clear_scroll(node):
+    resp = node.search("logs", {"size": 5}, scroll="1m")
+    out = node.clear_scroll([resp["_scroll_id"]])
+    assert out == {"succeeded": True, "num_freed": 1}
+    with pytest.raises(SearchContextMissingException):
+        node.scroll(resp["_scroll_id"])
+
+
+def test_pit_search_and_search_after(node):
+    pit = node.open_pit("logs", "1m")
+    pid = pit["pit_id"]
+    # writes after PIT creation are invisible to it
+    node.index_doc("logs", "999", {"n": 999, "msg": "nope"})
+    node.refresh("logs")
+    collected = []
+    after = None
+    while True:
+        body = {"pit": {"id": pid}, "sort": [{"n": "asc"}], "size": 10}
+        if after is not None:
+            body["search_after"] = after
+        resp = node.search(None, body)
+        hits = resp["hits"]["hits"]
+        if not hits:
+            break
+        collected.extend(h["_source"]["n"] for h in hits)
+        after = hits[-1]["sort"]
+        assert resp["pit_id"] == pid
+    assert collected == list(range(25))
+    out = node.close_pit([pid])
+    assert out["pits"][0]["successful"] is True
+    # live search DOES see the new doc
+    resp = node.search("logs", {"query": {"term": {"n": 999}}})
+    assert resp["hits"]["total"]["value"] == 1
+
+
+def test_pit_rejections(node):
+    pit = node.open_pit("logs", "1m")
+    with pytest.raises(IllegalArgumentException):
+        node.search("logs", {"pit": {"id": pit["pit_id"]}})  # index + pit
+    with pytest.raises(IllegalArgumentException):
+        node.search(None, {"pit": {"id": pit["pit_id"]}}, scroll="1m")  # scroll + pit
+    with pytest.raises(IllegalArgumentException):
+        node.search("logs", {"search_after": [1], "sort": [{"n": "asc"}]}, scroll="1m")
+    with pytest.raises(IllegalArgumentException):
+        node.search("logs", {"size": 5}, scroll="-1m")  # non-positive keep-alive
+    node.close_pit([pit["pit_id"]])
+
+
+def test_close_all_pits(node):
+    node.open_pit("logs", "1m")
+    node.open_pit("logs", "1m")
+    out = node.close_pit(None)
+    assert len(out["pits"]) == 2
