@@ -236,6 +236,13 @@ class Engine:
             for parsed, seq in live_buffer:
                 builder.add(parsed, seq)
             host = builder.build()
+            # stamp per-doc versions at seal time (version doc-values)
+            import numpy as _np
+
+            host.doc_versions = _np.asarray(
+                [self.version_map[d].version if d in self.version_map else 1
+                 for d in host.doc_ids], _np.int64,
+            )
             dev = to_device(host)
             self._segments.append((host, dev))
             self._buffer = []

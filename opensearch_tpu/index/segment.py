@@ -139,10 +139,19 @@ class HostSegment:
     live: np.ndarray = dc_field(default_factory=lambda: np.zeros(0, bool))
     min_seq_no: int = -1
     max_seq_no: int = -1
+    # per-doc seq_no/version captured at seal time: fetch under a pinned
+    # snapshot must report the version of the doc it returns, not the live
+    # version_map's (the reference stores these as doc-values)
+    doc_seq_nos: np.ndarray = dc_field(default_factory=lambda: np.zeros(0, np.int64))
+    doc_versions: np.ndarray = dc_field(default_factory=lambda: np.zeros(0, np.int64))
 
     def __post_init__(self) -> None:
         if self.live.size == 0:
             self.live = np.ones(self.n_docs, dtype=bool)
+        if self.doc_seq_nos.size == 0:
+            self.doc_seq_nos = np.zeros(self.n_docs, np.int64)
+        if self.doc_versions.size == 0:
+            self.doc_versions = np.ones(self.n_docs, np.int64)
         self._id_to_doc = {id_: i for i, id_ in enumerate(self.doc_ids)}
 
     def local_doc(self, doc_id: str) -> int | None:
@@ -150,6 +159,13 @@ class HostSegment:
         if d is None or not self.live[d]:
             return None
         return d
+
+    def doc_index(self, doc_id: str) -> int | None:
+        """Id -> local doc WITHOUT the live check. Query execution must use
+        this + the snapshot's device live mask: host `live` is mutated in
+        place by deletes, so checking it here would leak post-snapshot
+        deletes into pinned scroll/PIT readers."""
+        return self._id_to_doc.get(doc_id)
 
     def delete_doc(self, doc_id: str) -> bool:
         d = self._id_to_doc.get(doc_id)
@@ -200,6 +216,7 @@ class SegmentBuilder:
             sources=[json.dumps(d.source).encode() for d in self.docs],
             min_seq_no=min(self.seq_nos),
             max_seq_no=max(self.seq_nos),
+            doc_seq_nos=np.asarray(self.seq_nos, np.int64),
         )
         mappers = self.mapper_service.mappers
         for fname, mapper in mappers.items():
@@ -357,7 +374,11 @@ class SegmentBuilder:
 
 def save_segment(seg: HostSegment, directory: Path) -> None:
     directory.mkdir(parents=True, exist_ok=True)
-    arrays: dict[str, np.ndarray] = {"live": seg.live}
+    arrays: dict[str, np.ndarray] = {
+        "live": seg.live,
+        "doc_seq_nos": seg.doc_seq_nos,
+        "doc_versions": seg.doc_versions,
+    }
     meta: dict[str, Any] = {
         "name": seg.name,
         "n_docs": seg.n_docs,
@@ -425,6 +446,10 @@ def load_segment(directory: Path, name: str) -> HostSegment:
         live=arrays["live"].copy(),
         min_seq_no=meta["min_seq_no"],
         max_seq_no=meta["max_seq_no"],
+        doc_seq_nos=(arrays["doc_seq_nos"].copy() if "doc_seq_nos" in arrays
+                     else np.zeros(0, np.int64)),
+        doc_versions=(arrays["doc_versions"].copy() if "doc_versions" in arrays
+                      else np.zeros(0, np.int64)),
     )
     for fname, m in meta["text_fields"].items():
         key = f"text:{fname}"

@@ -270,11 +270,47 @@ class TpuNode:
 
     def update_doc(self, index: str, doc_id: str, body: dict,
                    routing: str | None = None, refresh: bool = False) -> dict:
-        """Partial update via doc merge (the scripted path is TODO —
-        reference: action/update/UpdateHelper.java)."""
+        """Partial update via doc merge or script
+        (action/update/UpdateHelper.java: prepareUpdateScriptRequest)."""
         svc = self._get_index(index)
         shard = svc.shard_for(doc_id, routing)
         current = shard.get(doc_id)
+        if "script" in body:
+            from opensearch_tpu.script import default_script_service
+
+            if current is None:
+                if "upsert" in body:
+                    if body.get("scripted_upsert"):
+                        ctx = {"_source": dict(body["upsert"]), "op": "create",
+                               "_index": index, "_id": doc_id}
+                        ast, params = default_script_service.compile(body["script"])
+                        default_script_service.execute_update(ast, params, ctx)
+                        if ctx.get("op") in ("none", "noop"):
+                            return {"_index": index, "_id": doc_id,
+                                    "result": "noop", "_shards":
+                                    {"total": 0, "successful": 0, "failed": 0}}
+                        return self.index_doc(index, doc_id, ctx["_source"],
+                                              routing, refresh=refresh)
+                    return self.index_doc(index, doc_id, body["upsert"],
+                                          routing, refresh=refresh)
+                from opensearch_tpu.common.errors import DocumentMissingException
+
+                raise DocumentMissingException(f"[{doc_id}]: document missing")
+            ctx = {"_source": dict(current["_source"]), "op": "index",
+                   "_index": index, "_id": doc_id,
+                   "_version": current["_version"], "_seq_no": current["_seq_no"]}
+            ast, params = default_script_service.compile(body["script"])
+            default_script_service.execute_update(ast, params, ctx)
+            op = ctx.get("op", "index")
+            if op in ("none", "noop"):
+                return {"_index": index, "_id": doc_id, "result": "noop",
+                        "_shards": {"total": 0, "successful": 0, "failed": 0}}
+            if op == "delete":
+                return self.delete_doc(index, doc_id, routing, refresh=refresh)
+            out = self.index_doc(index, doc_id, ctx["_source"], routing,
+                                 refresh=refresh)
+            out["result"] = "updated"
+            return out
         if "doc" in body:
             if current is None:
                 if body.get("doc_as_upsert"):
@@ -389,6 +425,10 @@ class TpuNode:
                 raise IllegalArgumentException(
                     "[search_after] is not supported with scroll"
                 )
+            if int(body.get("size", search_service.DEFAULT_SIZE)) <= 0:
+                raise IllegalArgumentException(
+                    "[size] must be positive in a scroll context"
+                )
             return self._start_scroll(shards, body, scroll)
         # per-hit _index comes from each shard's ShardId inside the service
         return search_service.search(shards, body)
@@ -489,7 +529,8 @@ class TpuNode:
     def msearch(self, searches: list[tuple[dict, dict]]) -> dict:
         responses = []
         for header, body in searches:
-            index = header.get("index", "_all")
+            # None (no index) keeps the PIT path legal in msearch
+            index = header.get("index")
             try:
                 responses.append(self.search(index, body))
             except OpenSearchTpuException as e:

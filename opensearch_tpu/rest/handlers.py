@@ -312,11 +312,12 @@ def close_all_pits(node: TpuNode, params, query, body):
 def msearch(node: TpuNode, params, query, body):
     if not isinstance(body, list):
         raise IllegalArgumentException("msearch body must be NDJSON lines")
-    default_index = params.get("index", "_all")
+    default_index = params.get("index")  # None: keeps PIT bodies legal
     searches = []
     for i in range(0, len(body) - 1, 2):
         header = body[i] or {}
-        header.setdefault("index", default_index)
+        if default_index is not None:
+            header.setdefault("index", default_index)
         searches.append((header, body[i + 1]))
     return 200, node.msearch(searches)
 

@@ -1,0 +1,3 @@
+from opensearch_tpu.script.service import ScriptService, default_script_service
+
+__all__ = ["ScriptService", "default_script_service"]

@@ -464,7 +464,9 @@ class SegmentExecutor:
     def _exec_IdsQuery(self, node: q.IdsQuery) -> NodeResult:
         mask_host = np.zeros(self.dev.n_pad, dtype=bool)
         for doc_id in node.values:
-            d = self.host.local_doc(doc_id)
+            # doc_index (not local_doc): liveness comes from the snapshot's
+            # device mask, so pinned PIT/scroll readers stay point-in-time
+            d = self.host.doc_index(doc_id)
             if d is not None:
                 mask_host[d] = True
         return _const_result(jnp.asarray(mask_host) & self.dev.live, node.boost, True)
@@ -542,6 +544,41 @@ class SegmentExecutor:
                 raw = jnp.maximum(-raw, 0.0)  # l2Squared returns the distance
             scores = jnp.where(valid, raw + node.add_constant, 0.0)
         return NodeResult(scores=scores * node.boost, mask=valid, scoring=True)
+
+    def _exec_GenericScriptScoreQuery(self, node: q.GenericScriptScoreQuery) -> NodeResult:
+        """Per-doc host evaluation (the reference's ScriptScoreFunction runs
+        a compiled script per collected doc — same cost model; the vector
+        patterns take the fused device path instead)."""
+        from opensearch_tpu.script import default_script_service
+
+        inner = self.execute(node.query) if node.query else self._exec_MatchAllQuery(
+            q.MatchAllQuery()
+        )
+        ast, params = default_script_service.compile(node.script)
+        mask_host = np.asarray(inner.mask)[: self.host.n_docs]
+        base_scores = np.asarray(inner.scores)[: self.host.n_docs]
+        scores = np.zeros(self.dev.n_pad, np.float32)
+        ms = self.ctx.mapper_service
+        for d in np.nonzero(mask_host)[0]:
+            scores[d] = default_script_service.score(
+                ast, params, self.host, int(d), ms, score=float(base_scores[d])
+            )
+        return NodeResult(
+            scores=jnp.asarray(scores) * node.boost, mask=inner.mask, scoring=True
+        )
+
+    def _exec_ScriptQuery(self, node: q.ScriptQuery) -> NodeResult:
+        from opensearch_tpu.script import default_script_service
+
+        ast, params = default_script_service.compile(node.script)
+        live_host = np.asarray(self.dev.live)[: self.host.n_docs]
+        mask = np.zeros(self.dev.n_pad, bool)
+        ms = self.ctx.mapper_service
+        for d in np.nonzero(live_host)[0]:
+            out = default_script_service.field(ast, params, self.host, int(d), ms)
+            if out:
+                mask[d] = True
+        return _const_result(jnp.asarray(mask), node.boost, scoring=True)
 
     # -- multi-term (term-enumeration) queries -----------------------------
     # The reference rewrites these to constant-score over the matching term

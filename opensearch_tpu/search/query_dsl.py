@@ -231,6 +231,24 @@ class HybridQuery(QueryNode):
 
 
 @dataclass
+class GenericScriptScoreQuery(QueryNode):
+    """script_score with an arbitrary painless script (per-doc host eval);
+    the recognized vector-function patterns compile to the fused device
+    path (ScriptScoreQuery) instead."""
+
+    query: QueryNode | None = None
+    script: dict = dc_field(default_factory=dict)
+
+
+@dataclass
+class ScriptQuery(QueryNode):
+    """script filter query: {"script": {"script": {...}}} — keep docs where
+    the script returns true."""
+
+    script: dict = dc_field(default_factory=dict)
+
+
+@dataclass
 class ScriptScoreQuery(QueryNode):
     query: QueryNode | None = None
     # recognized vector scoring functions (the k-NN plugin script patterns)
@@ -617,9 +635,16 @@ def _parse_script_score(body: dict) -> QueryNode:
                 add_constant=float(const) if const else 0.0,
                 boost=float(body.get("boost", 1.0)),
             )
-    raise ParsingException(
-        f"script_score supports vector functions {_VECTOR_FUNCS}, got [{source}]"
+    # arbitrary painless script: per-doc host evaluation path
+    return GenericScriptScoreQuery(
+        query=inner, script=script, boost=float(body.get("boost", 1.0))
     )
+
+
+def _parse_script_query(body: dict) -> QueryNode:
+    if "script" not in body:
+        raise ParsingException("[script] query requires [script]")
+    return ScriptQuery(script=body["script"], boost=float(body.get("boost", 1.0)))
 
 
 _PARSERS = {
@@ -637,6 +662,7 @@ _PARSERS = {
     "constant_score": _parse_constant_score,
     "knn": _parse_knn,
     "script_score": _parse_script_score,
+    "script": _parse_script_query,
     "prefix": _parse_term_level(PrefixQuery, "prefix"),
     "wildcard": _parse_term_level(WildcardQuery, "wildcard", "wildcard"),
     "regexp": _parse_term_level(RegexpQuery, "regexp"),

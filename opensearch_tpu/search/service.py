@@ -23,7 +23,7 @@ import numpy as np
 
 from opensearch_tpu.common.errors import ParsingException
 from opensearch_tpu.index.shard import IndexShard
-from opensearch_tpu.search import query_dsl
+from opensearch_tpu.search import fetch, query_dsl
 from opensearch_tpu.search.aggs import compute_aggs
 from opensearch_tpu.search.executor import (
     SegmentExecutor,
@@ -52,6 +52,7 @@ def search(
         "query", "size", "from", "sort", "_source", "aggs", "aggregations",
         "track_total_hits", "min_score", "search_after", "timeout", "version",
         "seq_no_primary_term", "stored_fields", "explain", "highlight",
+        "docvalue_fields", "fields", "script_fields",
     }
     unknown = set(body) - known_keys
     if unknown:
@@ -120,22 +121,77 @@ def search(
             ]
     page = merged[from_ : from_ + size]
 
-    # ---- fetch phase (only winning docs) ----
+    # ---- fetch phase (only winning docs; sub-phase chain in fetch.py) ----
     source_filter = _source_filter(body.get("_source", True))
+    highlight_conf = body.get("highlight")
+    docvalue_specs = body.get("docvalue_fields")
+    fields_specs = body.get("fields")
+    want_explain = bool(body.get("explain"))
+    want_version = bool(body.get("version"))
+    want_seqno = bool(body.get("seq_no_primary_term"))
+    script_fields = body.get("script_fields") or {}
+    compiled_scripts = {}
+    if script_fields:
+        from opensearch_tpu.script import default_script_service
+
+        for sf_name, sf_conf in script_fields.items():
+            compiled_scripts[sf_name] = default_script_service.compile(
+                (sf_conf or {}).get("script") or {}
+            )
+    preds_by_field: dict = {}
+    if highlight_conf:
+        ms_for_hl = _MultiMapperView([s.mapper_service for s in shards])
+        preds_by_field = fetch.field_term_predicates(node, ms_for_hl)
     hits_json = []
     for shard_idx, h in page:
         shard, snapshot, _ = per_shard_results[shard_idx]
         host = snapshot.segments[h.segment][0]
+        ms = shard.mapper_service
+        doc_id = host.doc_ids[h.doc]
         hit: dict[str, Any] = {
             "_index": shard.shard_id.index,
-            "_id": host.doc_ids[h.doc],
+            "_id": doc_id,
             "_score": None if sort else h.score,
         }
-        src = source_filter(json.loads(host.sources[h.doc]))
+        raw_source = json.loads(host.sources[h.doc])
+        src = source_filter(raw_source)
         if src is not None:
             hit["_source"] = src
         if sort:
             hit["sort"] = h.sort_values
+        if docvalue_specs:
+            dv = fetch.docvalue_fields_for_doc(docvalue_specs, host, h.doc, ms)
+            if dv:
+                hit.setdefault("fields", {}).update(dv)
+        if fields_specs:
+            fv = fetch.fields_option_for_doc(fields_specs, raw_source, host, h.doc, ms)
+            if fv:
+                hit.setdefault("fields", {}).update(fv)
+        if highlight_conf:
+            hl = fetch.compute_highlight(highlight_conf, preds_by_field, raw_source, ms)
+            if hl:
+                hit["highlight"] = hl
+        if script_fields:
+            from opensearch_tpu.script import default_script_service
+
+            for sf_name, (ast, sf_params) in compiled_scripts.items():
+                val = default_script_service.field(
+                    ast, sf_params, host, h.doc, ms, source=raw_source
+                )
+                hit.setdefault("fields", {})[sf_name] = (
+                    val if isinstance(val, list) else [val]
+                )
+        if want_explain:
+            hit["_explanation"] = fetch.explain_for_hit(h.score, node)
+        if want_version or want_seqno:
+            # read from the pinned snapshot's seal-time doc-values, not the
+            # live version_map — scroll/PIT hits must report the version of
+            # the _source they carry
+            if want_version:
+                hit["_version"] = int(host.doc_versions[h.doc])
+            if want_seqno:
+                hit["_seq_no"] = int(host.doc_seq_nos[h.doc])
+                hit["_primary_term"] = 1
         hits_json.append(hit)
 
     hits_obj: dict[str, Any] = {
@@ -209,6 +265,21 @@ class _MultiMapperView:
             if m is not None:
                 return m
         return None
+
+    @property
+    def mappers(self) -> dict:
+        merged: dict = {}
+        for s in reversed(self.services):
+            merged.update(s.mappers)
+        return merged
+
+    def analyze_query_text(self, field: str, text: str) -> list[str]:
+        for s in self.services:
+            if s.field_mapper(field) is not None:
+                return s.analyze_query_text(field, text)
+        if self.services:
+            return self.services[0].analyze_query_text(field, text)
+        return [text]
 
 
 def _values_key(sort: list, values: list) -> tuple:

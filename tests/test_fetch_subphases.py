@@ -1,0 +1,104 @@
+"""Fetch sub-phases: highlight, docvalue_fields, fields, explain, versions."""
+
+import pytest
+
+from opensearch_tpu.node import TpuNode
+
+DOCS = [
+    {"id": "1", "title": "The quick brown fox jumps over the lazy dog near the river bank",
+     "tag": ["animal", "classic"], "price": 10, "created": "2024-01-05T00:00:00Z"},
+    {"id": "2", "title": "Quick thinking saves the day; the fox was quick indeed",
+     "tag": "speed", "price": 25, "created": "2024-02-10T12:30:45Z"},
+    {"id": "3", "title": "An essay about rivers", "tag": "nature", "price": 7,
+     "created": "2024-03-01T00:00:00Z"},
+]
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = TpuNode(tmp_path_factory.mktemp("fetch"))
+    n.create_index("docs", {"mappings": {"properties": {
+        "title": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "price": {"type": "long"},
+        "created": {"type": "date"},
+    }}})
+    for d in DOCS:
+        doc = dict(d)
+        n.index_doc("docs", doc.pop("id"), doc)
+    n.refresh("docs")
+    yield n
+    n.close()
+
+
+def test_highlight_basic(node):
+    r = node.search("docs", {
+        "query": {"match": {"title": "quick fox"}},
+        "highlight": {"fields": {"title": {}}},
+    })
+    by_id = {h["_id"]: h for h in r["hits"]["hits"]}
+    assert "<em>quick</em>" in by_id["1"]["highlight"]["title"][0]
+    assert "<em>fox</em>" in by_id["1"]["highlight"]["title"][0]
+    # doc 2 has "Quick" capitalized — analysis lowercases, original casing kept
+    assert any("<em>Quick</em>" in f or "<em>quick</em>" in f
+               for f in by_id["2"]["highlight"]["title"])
+
+
+def test_highlight_custom_tags_and_no_match(node):
+    r = node.search("docs", {
+        "query": {"match": {"title": "rivers"}},
+        "highlight": {"pre_tags": ["<b>"], "post_tags": ["</b>"],
+                      "fields": {"title": {}}},
+    })
+    by_id = {h["_id"]: h for h in r["hits"]["hits"]}
+    assert "<b>rivers</b>" in by_id["3"]["highlight"]["title"][0]
+
+
+def test_highlight_term_and_prefix(node):
+    r = node.search("docs", {
+        "query": {"prefix": {"title": "riv"}},
+        "highlight": {"fields": {"title": {"number_of_fragments": 0}}},
+    })
+    hits = {h["_id"]: h.get("highlight", {}) for h in r["hits"]["hits"]}
+    assert any("<em>river" in f for f in hits.get("1", {}).get("title", [])) or \
+           any("<em>rivers</em>" in f for f in hits.get("3", {}).get("title", []))
+
+
+def test_docvalue_fields(node):
+    r = node.search("docs", {
+        "query": {"ids": {"values": ["1"]}},
+        "docvalue_fields": ["price", "tag", {"field": "created", "format": "epoch_millis"}],
+    })
+    f = r["hits"]["hits"][0]["fields"]
+    assert f["price"] == [10]
+    assert sorted(f["tag"]) == ["animal", "classic"]
+    assert f["created"] == ["1704412800000"]
+
+
+def test_fields_option_with_wildcard(node):
+    r = node.search("docs", {
+        "query": {"ids": {"values": ["2"]}},
+        "fields": ["pri*", "tag"],
+    })
+    f = r["hits"]["hits"][0]["fields"]
+    assert f["price"] == [25]
+    assert f["tag"] == ["speed"]
+
+
+def test_explain_and_version_flags(node):
+    r = node.search("docs", {
+        "query": {"match": {"title": "fox"}},
+        "explain": True, "version": True, "seq_no_primary_term": True,
+    })
+    h = r["hits"]["hits"][0]
+    assert h["_explanation"]["value"] == h["_score"]
+    assert h["_version"] >= 1
+    assert "_seq_no" in h and h["_primary_term"] == 1
+
+
+def test_fields_overlapping_patterns_no_duplicates(node):
+    r = node.search("docs", {
+        "query": {"ids": {"values": ["2"]}},
+        "fields": ["price", "pri*"],
+    })
+    assert r["hits"]["hits"][0]["fields"]["price"] == [25]

@@ -1,0 +1,179 @@
+"""Painless-subset interpreter + script contexts (ScriptService analog)."""
+
+import pytest
+
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.script import ScriptService, default_script_service
+from opensearch_tpu.script.painless import (
+    Evaluator,
+    ScriptException,
+    compile_script,
+)
+
+
+def run(src, **env):
+    return Evaluator(env).run(compile_script(src))
+
+
+# -- language core ---------------------------------------------------------
+
+
+def test_arithmetic_and_precedence():
+    assert run("1 + 2 * 3") == 7
+    assert run("(1 + 2) * 3") == 9
+    assert run("10 / 4.0") == 2.5
+    assert run("10 / 3") == 3          # integer division (Java semantics)
+    assert run("10 % 3") == 1
+    assert run("2 - -3") == 5
+
+
+def test_comparison_logic_ternary():
+    assert run("1 < 2 && 2 <= 2") is True
+    assert run("1 > 2 || 3 != 4") is True
+    assert run("!(1 == 1)") is False
+    assert run("5 > 3 ? 'big' : 'small'") == "big"
+
+
+def test_strings():
+    assert run("'a' + 'b'") == "ab"
+    assert run("'count: ' + 3") == "count: 3"
+    assert run("'Hello'.toLowerCase()") == "hello"
+    assert run("'hello world'.contains('wor')") is True
+    assert run("'abc'.substring(1)") == "bc"
+    assert run("'a,b,c'.split(',')") == ["a", "b", "c"]
+    assert run("'abc'.length()") == 3
+
+
+def test_math_namespace():
+    assert run("Math.max(3, 7)") == 7
+    assert run("Math.log(Math.E)") == pytest.approx(1.0)
+    assert run("Math.sqrt(16)") == 4.0
+    assert run("Math.pow(2, 10)") == 1024
+
+
+def test_params_and_locals():
+    assert run("params.a * 2", params={"a": 21}) == 42
+    assert run("def x = 5; x * x") == 25
+    assert run("double y = 1.5; y + 1") == 2.5
+
+
+def test_if_else_and_return():
+    src = "if (params.n > 10) { return 'big' } else { return 'small' }"
+    assert run(src, params={"n": 11}) == "big"
+    assert run(src, params={"n": 2}) == "small"
+
+
+def test_lists_and_maps():
+    assert run("[1, 2, 3].size()") == 3
+    assert run("params.m.containsKey('k')", params={"m": {"k": 1}}) is True
+    assert run("params.m.get('k') + 1", params={"m": {"k": 1}}) == 2
+    assert run("params.xs.contains(2)", params={"xs": [1, 2]}) is True
+
+
+def test_sandbox_rejections():
+    with pytest.raises(ScriptException):
+        run("__import__('os')")
+    with pytest.raises(ScriptException):
+        run("open('/etc/passwd')")
+    with pytest.raises(ScriptException):
+        run("params.__class__", params={})
+    with pytest.raises(ScriptException):
+        run("1 / 0")
+
+
+def test_compile_cache():
+    svc = ScriptService()
+    svc.compile({"source": "1 + 1"})
+    svc.compile({"source": "1 + 1"})
+    assert svc.stats["compilations"] == 1
+
+
+# -- engine integration ----------------------------------------------------
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = TpuNode(tmp_path)
+    n.create_index("items", {"mappings": {"properties": {
+        "name": {"type": "keyword"},
+        "price": {"type": "long"},
+        "rating": {"type": "float"},
+    }}})
+    for i, (name, price, rating) in enumerate(
+        [("a", 10, 4.0), ("b", 20, 3.0), ("c", 30, 5.0)]
+    ):
+        n.index_doc("items", str(i + 1), {"name": name, "price": price,
+                                          "rating": rating})
+    n.refresh("items")
+    yield n
+    n.close()
+
+
+def test_script_fields(node):
+    r = node.search("items", {
+        "query": {"match_all": {}},
+        "script_fields": {
+            "double_price": {"script": {"source": "doc['price'].value * 2"}},
+            "label": {"script": {
+                "source": "doc['name'].value + ':' + params.tag",
+                "params": {"tag": "x"},
+            }},
+        },
+    })
+    by_id = {h["_id"]: h["fields"] for h in r["hits"]["hits"]}
+    assert by_id["1"]["double_price"] == [20]
+    assert by_id["2"]["label"] == ["b:x"]
+
+
+def test_generic_script_score(node):
+    r = node.search("items", {
+        "query": {"script_score": {
+            "query": {"match_all": {}},
+            "script": {"source": "doc['price'].value * 0.1 + doc['rating'].value"},
+        }},
+    })
+    scores = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+    assert scores["3"] == pytest.approx(8.0)   # 3.0 + 5.0
+    assert scores["1"] == pytest.approx(5.0)   # 1.0 + 4.0
+    assert [h["_id"] for h in r["hits"]["hits"]][0] == "3"
+
+
+def test_script_filter_query(node):
+    r = node.search("items", {
+        "query": {"bool": {"filter": [{"script": {"script": {
+            "source": "doc['price'].value >= params.min",
+            "params": {"min": 20},
+        }}}]}},
+    })
+    assert sorted(h["_id"] for h in r["hits"]["hits"]) == ["2", "3"]
+
+
+def test_scripted_update(node):
+    node.update_doc("items", "1", {"script": {
+        "source": "ctx._source.price += params.d", "params": {"d": 5}}})
+    assert node.get_doc("items", "1")["_source"]["price"] == 15
+    # noop path
+    out = node.update_doc("items", "1", {"script": {
+        "source": "if (ctx._source.price > 10) { ctx.op = 'none' }"}})
+    assert out["result"] == "noop"
+    # delete path
+    node.update_doc("items", "2", {"script": {"source": "ctx.op = 'delete'"}})
+    assert node.get_doc("items", "2")["found"] is False
+
+
+def test_scripted_upsert(node):
+    node.update_doc("items", "9", {
+        "scripted_upsert": True,
+        "upsert": {"price": 1},
+        "script": {"source": "ctx._source.price += 100"},
+    })
+    assert node.get_doc("items", "9")["_source"]["price"] == 101
+
+
+def test_script_runtime_errors_are_script_exceptions():
+    with pytest.raises(ScriptException):
+        run("Math.pi")
+    with pytest.raises(ScriptException):
+        run("Math.sqrt(0 - 1)")
+    with pytest.raises(ScriptException):
+        run("'abc'.charAt(10)")

@@ -141,3 +141,29 @@ def test_close_all_pits(node):
     node.open_pit("logs", "1m")
     out = node.close_pit(None)
     assert len(out["pits"]) == 2
+
+
+def test_pit_version_is_snapshot_consistent(node):
+    node.index_doc("logs", "v1", {"n": 500, "msg": "first"})
+    node.refresh("logs")
+    pit = node.open_pit("logs", "1m")
+    node.index_doc("logs", "v1", {"n": 501, "msg": "second"})
+    node.refresh("logs")
+    r = node.search(None, {"pit": {"id": pit["pit_id"]},
+                           "query": {"ids": {"values": ["v1"]}},
+                           "version": True})
+    h = r["hits"]["hits"][0]
+    assert h["_source"]["n"] == 500 and h["_version"] == 1
+    node.close_pit([pit["pit_id"]])
+
+
+def test_pit_via_msearch(node):
+    pit = node.open_pit("logs", "1m")
+    out = node.msearch([({}, {"pit": {"id": pit["pit_id"]}, "size": 1})])
+    assert "error" not in out["responses"][0]
+    node.close_pit([pit["pit_id"]])
+
+
+def test_scroll_rejects_size_zero(node):
+    with pytest.raises(IllegalArgumentException):
+        node.search("logs", {"size": 0}, scroll="1m")
