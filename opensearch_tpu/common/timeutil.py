@@ -39,3 +39,98 @@ def now_millis() -> int:
     import time
 
     return int(time.monotonic() * 1000)
+
+
+# --------------------------------------------------------------------------
+# Date math ("now-1d/d", "2024-01-01||+1M/d") — the analog of the
+# reference's JavaDateMathParser (server/.../common/time/DateMathParser).
+# --------------------------------------------------------------------------
+
+_MATH_TOKEN = re.compile(r"([+\-/])(\d*)([yMwdhHms])?")
+
+
+def _apply_unit(dt, n: int, unit: str):
+    import datetime as _dt
+
+    if unit == "y":
+        import calendar
+
+        year = dt.year + n
+        day = min(dt.day, calendar.monthrange(year, dt.month)[1])
+        return dt.replace(year=year, day=day)
+    if unit == "M":
+        month0 = dt.month - 1 + n
+        year = dt.year + month0 // 12
+        month = month0 % 12 + 1
+        import calendar
+
+        day = min(dt.day, calendar.monthrange(year, month)[1])
+        return dt.replace(year=year, month=month, day=day)
+    secs = {"w": 604800, "d": 86400, "h": 3600, "H": 3600, "m": 60, "s": 1}[unit]
+    return dt + _dt.timedelta(seconds=n * secs)
+
+
+def _round_down(dt, unit: str):
+    if unit == "y":
+        return dt.replace(month=1, day=1, hour=0, minute=0, second=0, microsecond=0)
+    if unit == "M":
+        return dt.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+    if unit == "w":
+        import datetime as _dt
+
+        start = dt - _dt.timedelta(days=dt.weekday())
+        return start.replace(hour=0, minute=0, second=0, microsecond=0)
+    if unit == "d":
+        return dt.replace(hour=0, minute=0, second=0, microsecond=0)
+    if unit in ("h", "H"):
+        return dt.replace(minute=0, second=0, microsecond=0)
+    if unit == "m":
+        return dt.replace(second=0, microsecond=0)
+    return dt.replace(microsecond=0)
+
+
+def parse_date_math(expr: Any, now_ms: int | None = None, round_up: bool = False) -> int:
+    """Resolve a date-math expression to epoch millis.
+
+    Anchors: ``now`` or ``<date>||``; ops: ``+N<unit>``, ``-N<unit>``,
+    ``/<unit>`` (round down; round *up* to the last millisecond of the unit
+    when `round_up` — the reference uses round_up for range upper bounds).
+    """
+    import datetime as _dt
+    import time
+
+    if isinstance(expr, (int, float)) and not isinstance(expr, bool):
+        return int(expr)
+    s = str(expr).strip()
+    if s.startswith("now"):
+        base_ms = int(time.time() * 1000) if now_ms is None else now_ms
+        math = s[3:]
+    elif "||" in s:
+        anchor, _, math = s.partition("||")
+        from opensearch_tpu.index.mapper import parse_date_millis
+
+        base_ms = parse_date_millis(anchor)
+    else:
+        from opensearch_tpu.index.mapper import parse_date_millis
+
+        return parse_date_millis(s)
+    dt = _dt.datetime.fromtimestamp(base_ms / 1000, _dt.timezone.utc)
+    pos = 0
+    while pos < len(math):
+        m = _MATH_TOKEN.match(math, pos)
+        if not m:
+            raise IllegalArgumentException(f"invalid date math [{expr}]")
+        op, num, unit = m.group(1), m.group(2), m.group(3)
+        if op == "/":
+            if unit is None:
+                raise IllegalArgumentException(f"invalid date math [{expr}]")
+            dt = _round_down(dt, unit)
+            if round_up:
+                dt = _apply_unit(dt, 1, unit) - _dt.timedelta(milliseconds=1)
+        else:
+            if unit is None:
+                raise IllegalArgumentException(f"invalid date math [{expr}]")
+            n = int(num) if num else 1
+            dt = _apply_unit(dt, n if op == "+" else -n, unit)
+        pos = m.end()
+    return int(dt.timestamp() * 1000)

@@ -129,6 +129,47 @@ class Parser:
                 self.next()
                 other = self.parse_block()
             return ("if", cond, then, other)
+        if v == "while":
+            self.next()
+            self.expect("(")
+            cond = self.parse_expr()
+            self.expect(")")
+            body = self.parse_block()
+            return ("while", cond, body)
+        if v == "for":
+            self.next()
+            self.expect("(")
+            # enhanced for: `for (def x : iter)` / `for (x in iter)`
+            probe = 0
+            if self.peek()[1] in _TYPE_NAMES:
+                probe = 1
+            if (self.peek(probe)[0] == "name"
+                    and self.peek(probe + 1)[1] in (":", "in")):
+                for _ in range(probe):
+                    self.next()
+                var = self.next()[1]
+                self.next()  # ':' or 'in'
+                it = self.parse_expr()
+                self.expect(")")
+                body = self.parse_block()
+                return ("foreach", var, it, body)
+            init = self.parse_statement()
+            cond = self.parse_expr()
+            self.expect(";")
+            update = self.parse_statement()
+            self.expect(")")
+            body = self.parse_block()
+            return ("cfor", init, cond, update, body)
+        if v == "break":
+            self.next()
+            if self.peek()[1] == ";":
+                self.next()
+            return ("break",)
+        if v == "continue":
+            self.next()
+            if self.peek()[1] == ";":
+                self.next()
+            return ("continue",)
         # typed local declaration: `def x = expr` / `double y = expr`
         if v in _TYPE_NAMES and self.peek(1)[0] == "name" and self.peek(2)[1] == "=":
             self.next()
@@ -140,6 +181,11 @@ class Parser:
             return ("assign", ("name", name), expr)
         expr = self.parse_expr()
         nk, nv = self.peek()
+        if nv in ("++", "--"):
+            self.next()
+            if self.peek()[1] == ";":
+                self.next()
+            return ("augassign", expr, nv[0], ("lit", 1))
         if nv in ("=", "+=", "-=", "*=", "/=", "%="):
             self.next()
             rhs = self.parse_expr()
@@ -357,6 +403,14 @@ _MATH = {
 }
 
 
+class _Break(Exception):
+    pass
+
+
+class _Continue(Exception):
+    pass
+
+
 class _Return(Exception):
     def __init__(self, value):
         self.value = value
@@ -365,6 +419,7 @@ class _Return(Exception):
 class Evaluator:
     def __init__(self, env: dict[str, Any]):
         self.env = dict(env)
+        self._loop_iters = 0
 
     # -- statements --------------------------------------------------------
 
@@ -373,6 +428,8 @@ class Evaluator:
             last = self._stmt(node)
         except _Return as r:
             return r.value
+        except (_Break, _Continue):
+            raise ScriptException("break/continue outside of a loop")
         except ScriptException:
             raise
         except (KeyError, ValueError, IndexError, TypeError, AttributeError,
@@ -410,7 +467,60 @@ class Evaluator:
             return None
         if kind == "expr":
             return self.eval(node[1])
+        if kind == "while":
+            n = 0
+            while _truthy(self.eval(node[1])):
+                self._bump_loop(n)
+                n += 1
+                try:
+                    self._stmt(node[2])
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+            return None
+        if kind == "foreach":
+            it = self.eval(node[2])
+            if it is None:
+                raise ScriptException("cannot iterate over null")
+            if isinstance(it, dict):
+                it = list(it.keys())
+            for n, item in enumerate(it):
+                self._bump_loop(n)
+                self.env[node[1]] = item
+                try:
+                    self._stmt(node[3])
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+            return None
+        if kind == "cfor":
+            self._stmt(node[1])
+            n = 0
+            while _truthy(self.eval(node[2])):
+                self._bump_loop(n)
+                n += 1
+                try:
+                    self._stmt(node[4])
+                except _Break:
+                    break
+                except _Continue:
+                    pass
+                self._stmt(node[3])
+            return None
+        if kind == "break":
+            raise _Break()
+        if kind == "continue":
+            raise _Continue()
         raise ScriptException(f"unknown statement [{kind}]")
+
+    def _bump_loop(self, _n: int) -> None:
+        # the reference compiles in a loop counter that throws after too many
+        # iterations (CompilerSettings MAX_LOOP_COUNTER); same guard here
+        self._loop_iters += 1
+        if self._loop_iters > 1_000_000:
+            raise ScriptException("loop limit exceeded [1000000]")
 
     def _store(self, target, value) -> None:
         kind = target[0]
@@ -501,7 +611,7 @@ class Evaluator:
         )
 
     def _method(self, obj, name: str, args: list):
-        if isinstance(obj, (FieldValues, DocView)):
+        if hasattr(obj, "methods"):
             return obj.methods(name, args)
         if obj is _MATH or (isinstance(obj, dict) and obj is _MATH):
             fn = _MATH.get(name)

@@ -36,6 +36,23 @@ AGG_TYPES = {
     "histogram", "date_histogram", "range", "filter", "filters", "missing", "global",
 }
 
+# extension registry populated by aggs_ext (extended metric/bucket families);
+# fn(conf, sub, segments, ms, masks, filter_fn, ext)
+EXTENSION_AGGS: dict[str, Callable] = {}
+
+# the reference's search.max_buckets MultiBucketConsumerService limit
+MAX_BUCKETS = 65_536
+
+
+class TooManyBucketsException(IllegalArgumentException):
+    error_type = "too_many_buckets_exception"
+
+    def __init__(self, limit: int):
+        super().__init__(
+            f"Trying to create too many buckets. Must be less than or equal "
+            f"to: [{limit}]."
+        )
+
 # executor callback: (query_node_body, segment_index) -> bool mask [n_docs]
 FilterFn = Callable[[dict, int], np.ndarray]
 
@@ -46,16 +63,25 @@ def compute_aggs(
     aggs_body: dict,
     masks: list[np.ndarray],
     filter_fn: FilterFn | None = None,
+    ext: dict | None = None,
 ) -> dict:
+    from opensearch_tpu.search.aggs_pipeline import PIPELINE_TYPES
+
     out = {}
     for name, body in aggs_body.items():
-        out[name] = _compute_one(name, body, segments, mapper_service, masks, filter_fn)
+        # pipeline aggs run at final reduce (aggs_pipeline.apply_pipeline_aggs),
+        # mirroring the reference where they reduce coordinator-side
+        if any(k in PIPELINE_TYPES for k in body):
+            continue
+        out[name] = _compute_one(
+            name, body, segments, mapper_service, masks, filter_fn, ext
+        )
     return out
 
 
 def _split_body(body: dict) -> tuple[str, dict, dict | None]:
     sub = body.get("aggs") or body.get("aggregations")
-    agg_keys = [k for k in body if k in AGG_TYPES]
+    agg_keys = [k for k in body if k in AGG_TYPES or k in EXTENSION_AGGS]
     if len(agg_keys) != 1:
         raise ParsingException(
             f"aggregation must have exactly one known type, got {sorted(body)}"
@@ -82,6 +108,7 @@ def _compute_one(
     ms: MapperService,
     masks: list[np.ndarray],
     filter_fn: FilterFn | None,
+    ext: dict | None = None,
 ) -> dict:
     typ, conf, sub = _split_body(body)
 
@@ -90,25 +117,28 @@ def _compute_one(
     if typ == "cardinality":
         return _cardinality(conf, segments, ms, masks)
     if typ == "terms":
-        return _terms(conf, sub, segments, ms, masks, filter_fn)
+        return _terms(conf, sub, segments, ms, masks, filter_fn, ext)
     if typ == "histogram":
-        return _histogram(conf, sub, segments, ms, masks, filter_fn, date=False)
+        return _histogram(conf, sub, segments, ms, masks, filter_fn, ext, date=False)
     if typ == "date_histogram":
-        return _histogram(conf, sub, segments, ms, masks, filter_fn, date=True)
+        return _histogram(conf, sub, segments, ms, masks, filter_fn, ext, date=True)
     if typ == "range":
-        return _range_agg(conf, sub, segments, ms, masks, filter_fn)
+        return _range_agg(conf, sub, segments, ms, masks, filter_fn, ext)
     if typ == "filter":
-        return _filter_agg(conf, sub, segments, ms, masks, filter_fn)
+        return _filter_agg(conf, sub, segments, ms, masks, filter_fn, ext)
     if typ == "filters":
-        return _filters_agg(conf, sub, segments, ms, masks, filter_fn)
+        return _filters_agg(conf, sub, segments, ms, masks, filter_fn, ext)
     if typ == "missing":
-        return _missing_agg(conf, sub, segments, ms, masks, filter_fn)
+        return _missing_agg(conf, sub, segments, ms, masks, filter_fn, ext)
     if typ == "global":
         g_masks = [s.live.copy() for s in segments]
         out = {"doc_count": int(sum(m.sum() for m in g_masks))}
         if sub:
-            out.update(compute_aggs(segments, ms, sub, g_masks, filter_fn))
+            out.update(compute_aggs(segments, ms, sub, g_masks, filter_fn, ext))
         return out
+    fn = EXTENSION_AGGS.get(typ)
+    if fn is not None:
+        return fn(conf, sub, segments, ms, masks, filter_fn, ext or {})
     raise ParsingException(f"unknown aggregation type [{typ}]")
 
 
@@ -118,10 +148,11 @@ def _sub_aggs(
     ms: MapperService,
     bucket_masks: list[np.ndarray],
     filter_fn: FilterFn | None,
+    ext: dict | None = None,
 ) -> dict:
     if not sub:
         return {}
-    return compute_aggs(segments, ms, sub, bucket_masks, filter_fn)
+    return compute_aggs(segments, ms, sub, bucket_masks, filter_fn, ext)
 
 
 # -- metrics ----------------------------------------------------------------
@@ -187,7 +218,7 @@ def _cardinality(conf, segments, ms, masks) -> dict:
 # -- terms ------------------------------------------------------------------
 
 
-def _terms(conf, sub, segments, ms, masks, filter_fn) -> dict:
+def _terms(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
     field = conf["field"]
     size = int(conf.get("size", 10))
     # merge per-segment counts keyed by value
@@ -225,7 +256,7 @@ def _terms(conf, sub, segments, ms, masks, filter_fn) -> dict:
     if sub and needs_sub_order:
         for key in counts:
             bucket_masks = _value_masks(segments, field, key, masks)
-            sub_results[key] = _sub_aggs(sub, segments, ms, bucket_masks, filter_fn)
+            sub_results[key] = _sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext)
 
     def _agg_path_value(key: Any, path: str) -> Any:
         name, _, prop = path.partition(".")
@@ -272,7 +303,7 @@ def _terms(conf, sub, segments, ms, masks, filter_fn) -> dict:
                 bucket.update(sub_results[key])
             else:
                 bucket_masks = _value_masks(segments, field, key, masks)
-                bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn))
+                bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
         buckets.append(bucket)
     return {
         "doc_count_error_upper_bound": 0,
@@ -323,7 +354,7 @@ def _value_masks(segments, field, key, masks) -> list[np.ndarray]:
 _CALENDAR_UNITS = {"month", "1M", "quarter", "1q", "year", "1y"}
 
 
-def _histogram(conf, sub, segments, ms, masks, filter_fn, date: bool) -> dict:
+def _histogram(conf, sub, segments, ms, masks, filter_fn, ext=None, date: bool = False) -> dict:
     field = conf["field"]
     if date:
         interval_conf = (
@@ -341,6 +372,9 @@ def _histogram(conf, sub, segments, ms, masks, filter_fn, date: bool) -> dict:
     # take plain numbers
     offset = float(parse_time_millis(raw_offset)) if date else float(raw_offset)
     min_doc_count = int(conf.get("min_doc_count", 1 if not date else 0))
+    interval = None
+    if not calendar:
+        interval = parse_time_millis(interval_conf) if date else float(interval_conf)
 
     # collect (key -> count) and per-key masks lazily for sub-aggs
     key_counts: dict[float, int] = {}
@@ -358,15 +392,57 @@ def _histogram(conf, sub, segments, ms, masks, filter_fn, date: bool) -> dict:
         if calendar:
             keys = _calendar_keys(vals, str(interval_conf))
         else:
-            interval = (
-                parse_time_millis(interval_conf) if date else float(interval_conf)
-            )
             keys = np.floor((vals.astype(np.float64) - offset) / interval) * interval + offset
         per_seg_keys.append(keys)
         per_seg_docs.append(docs)
         uniq, c = np.unique(keys, return_counts=True)
         for k_, n_ in zip(uniq.tolist(), c.tolist()):
             key_counts[k_] = key_counts.get(k_, 0) + n_
+
+    # empty-bucket fill: min_doc_count=0 emits every bucket between the
+    # observed (or extended_bounds) min and max key, like the reference's
+    # InternalHistogram.addEmptyBuckets at reduce time
+    if min_doc_count == 0:
+        eb = conf.get("extended_bounds") or {}
+        eb_min = eb.get("min")
+        eb_max = eb.get("max")
+        if date:
+            eb_min = parse_date_millis(eb_min) if eb_min is not None else None
+            eb_max = parse_date_millis(eb_max) if eb_max is not None else None
+
+        def _floor_key(v: float) -> float:
+            if calendar:
+                return float(_calendar_keys(np.asarray([v]), str(interval_conf))[0])
+            return float(np.floor((v - offset) / interval) * interval + offset)
+
+        lo = min(key_counts) if key_counts else None
+        hi = max(key_counts) if key_counts else None
+        if eb_min is not None:
+            lo = _floor_key(eb_min) if lo is None else min(lo, _floor_key(eb_min))
+        if eb_max is not None:
+            hi = _floor_key(eb_max) if hi is None else max(hi, _floor_key(eb_max))
+        if lo is not None and hi is not None:
+            if calendar:
+                unit = str(interval_conf)
+                k = lo
+                n_fill = 0
+                while k <= hi:
+                    key_counts.setdefault(k, 0)
+                    k = _calendar_next(k, unit)
+                    n_fill += 1
+                    if n_fill > MAX_BUCKETS:
+                        raise TooManyBucketsException(MAX_BUCKETS)
+            else:
+                # integer bucket ordinals so fill keys are bit-identical to
+                # the floor-computed doc keys (no arange accumulation drift)
+                n0 = int(round((lo - offset) / interval))
+                n1 = int(round((hi - offset) / interval))
+                if n1 - n0 + 1 > MAX_BUCKETS:
+                    raise TooManyBucketsException(MAX_BUCKETS)
+                for k in (np.arange(n0, n1 + 1) * interval + offset).tolist():
+                    key_counts.setdefault(k, 0)
+    if len(key_counts) > MAX_BUCKETS:
+        raise TooManyBucketsException(MAX_BUCKETS)
 
     buckets = []
     for key in sorted(key_counts):
@@ -387,9 +463,17 @@ def _histogram(conf, sub, segments, ms, masks, filter_fn, date: bool) -> dict:
                 sel = per_seg_docs[i][per_seg_keys[i] == key]
                 bm[sel] = True
                 bucket_masks.append(bm)
-            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn))
+            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
         buckets.append(bucket)
     return {"buckets": buckets}
+
+
+def _calendar_next(key_ms: float, unit: str) -> float:
+    dt = _dt.datetime.fromtimestamp(key_ms / 1000, _dt.timezone.utc)
+    months = {"month": 1, "1M": 1, "quarter": 3, "1q": 3}.get(unit, 12)
+    month0 = dt.month - 1 + months
+    nxt = dt.replace(year=dt.year + month0 // 12, month=month0 % 12 + 1)
+    return nxt.timestamp() * 1000
 
 
 def _calendar_keys(vals_ms: np.ndarray, unit: str) -> np.ndarray:
@@ -412,7 +496,7 @@ def _calendar_keys(vals_ms: np.ndarray, unit: str) -> np.ndarray:
 # -- range / filter family --------------------------------------------------
 
 
-def _range_agg(conf, sub, segments, ms, masks, filter_fn) -> dict:
+def _range_agg(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
     field = conf["field"]
     ranges = conf["ranges"]
     mapper = ms.field_mapper(field)
@@ -448,7 +532,7 @@ def _range_agg(conf, sub, segments, ms, masks, filter_fn) -> dict:
         if to is not None:
             bucket["to"] = float(to)
         if sub:
-            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn))
+            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
         buckets.append(bucket)
     return {"buckets": buckets}
 
@@ -461,25 +545,25 @@ def _run_filter(filter_fn, body, segments, masks) -> list[np.ndarray]:
     ]
 
 
-def _filter_agg(conf, sub, segments, ms, masks, filter_fn) -> dict:
+def _filter_agg(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
     f_masks = _run_filter(filter_fn, conf, segments, masks)
     out = {"doc_count": int(sum(m.sum() for m in f_masks))}
-    out.update(_sub_aggs(sub, segments, ms, f_masks, filter_fn))
+    out.update(_sub_aggs(sub, segments, ms, f_masks, filter_fn, ext))
     return out
 
 
-def _filters_agg(conf, sub, segments, ms, masks, filter_fn) -> dict:
+def _filters_agg(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
     named = conf.get("filters")
     buckets: dict[str, Any] = {}
     for fname, body in named.items():
         f_masks = _run_filter(filter_fn, body, segments, masks)
         bucket = {"doc_count": int(sum(m.sum() for m in f_masks))}
-        bucket.update(_sub_aggs(sub, segments, ms, f_masks, filter_fn))
+        bucket.update(_sub_aggs(sub, segments, ms, f_masks, filter_fn, ext))
         buckets[fname] = bucket
     return {"buckets": buckets}
 
 
-def _missing_agg(conf, sub, segments, ms, masks, filter_fn) -> dict:
+def _missing_agg(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
     field = conf["field"]
     m_masks = []
     for i, seg in enumerate(segments):
@@ -498,5 +582,9 @@ def _missing_agg(conf, sub, segments, ms, masks, filter_fn) -> dict:
             present |= vf.present
         m_masks.append(masks[i] & ~present)
     out = {"doc_count": int(sum(m.sum() for m in m_masks))}
-    out.update(_sub_aggs(sub, segments, ms, m_masks, filter_fn))
+    out.update(_sub_aggs(sub, segments, ms, m_masks, filter_fn, ext))
     return out
+
+
+# register extended aggregation families (populates EXTENSION_AGGS)
+from opensearch_tpu.search import aggs_ext as _aggs_ext  # noqa: E402,F401

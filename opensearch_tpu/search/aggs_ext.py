@@ -1,0 +1,822 @@
+"""Extended aggregations: the remaining metric & bucket families.
+
+Completes the inventory of search/aggregations/ in the reference
+(SURVEY.md §2.2 — bucket/ ~35 types, metrics/ ~25): extended_stats,
+percentiles / percentile_ranks (exact — strict-quality superset of the
+reference's TDigest/HDR approximations), median_absolute_deviation,
+weighted_avg, top_hits, scripted_metric, matrix_stats
+(modules/aggs-matrix-stats), multi_terms, rare_terms, significant_terms
+(JLH heuristic, search/aggregations/bucket/terms/SignificantTermsAggregator),
+sampler / diversified_sampler, adjacency_matrix, date_range (with date
+math), composite (after-key pagination,
+search/aggregations/bucket/composite/), auto_date_histogram.
+
+All register into aggs.EXTENSION_AGGS with signature
+(conf, sub, segments, ms, masks, filter_fn, ext) where ext carries
+optional per-segment score arrays and segment metadata (owning index).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import math
+from typing import Any
+
+import numpy as np
+
+from opensearch_tpu.common.errors import IllegalArgumentException, ParsingException
+from opensearch_tpu.common.settings import parse_time_millis
+from opensearch_tpu.common.timeutil import parse_date_math
+from opensearch_tpu.index.mapper import parse_date_millis
+from opensearch_tpu.search.aggs import (
+    _CALENDAR_UNITS,
+    EXTENSION_AGGS,
+    _calendar_keys,
+    _field_values,
+    _run_filter,
+    _sub_aggs,
+    _value_masks,
+)
+
+
+def _collect(segments, ms, masks, field) -> np.ndarray:
+    chunks = [_field_values(seg, field, masks[i], ms) for i, seg in enumerate(segments)]
+    return np.concatenate(chunks) if chunks else np.zeros(0)
+
+
+def _seg_numeric(seg, field):
+    nf = seg.numeric_fields.get(field)
+    if nf is None:
+        return None, None
+    return (nf.values_i64 if nf.kind == "int" else nf.values_f64), nf.present
+
+
+def _iso(ms_val: float) -> str:
+    return (
+        _dt.datetime.fromtimestamp(ms_val / 1000, _dt.timezone.utc)
+        .isoformat()
+        .replace("+00:00", "Z")
+    )
+
+
+# -- metrics ----------------------------------------------------------------
+
+
+def _extended_stats(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    vals = _collect(segments, ms, masks, conf["field"])
+    sigma = float(conf.get("sigma", 2.0))
+    n = len(vals)
+    if n == 0:
+        return {
+            "count": 0, "min": None, "max": None, "avg": None, "sum": 0.0,
+            "sum_of_squares": None, "variance": None,
+            "variance_population": None, "variance_sampling": None,
+            "std_deviation": None, "std_deviation_population": None,
+            "std_deviation_sampling": None,
+            "std_deviation_bounds": {
+                "upper": None, "lower": None,
+                "upper_population": None, "lower_population": None,
+                "upper_sampling": None, "lower_sampling": None,
+            },
+        }
+    v = vals.astype(np.float64)
+    s = float(v.sum())
+    avg = s / n
+    sos = float((v * v).sum())
+    var_pop = max(sos / n - avg * avg, 0.0)
+    var_samp = var_pop * n / (n - 1) if n > 1 else float("nan")
+    std_pop = math.sqrt(var_pop)
+    std_samp = math.sqrt(var_samp) if n > 1 else float("nan")
+
+    def _clean(x):
+        return None if isinstance(x, float) and math.isnan(x) else x
+
+    return {
+        "count": n,
+        "min": float(v.min()),
+        "max": float(v.max()),
+        "avg": avg,
+        "sum": s,
+        "sum_of_squares": sos,
+        "variance": var_pop,
+        "variance_population": var_pop,
+        "variance_sampling": _clean(var_samp),
+        "std_deviation": std_pop,
+        "std_deviation_population": std_pop,
+        "std_deviation_sampling": _clean(std_samp),
+        "std_deviation_bounds": {
+            "upper": avg + sigma * std_pop,
+            "lower": avg - sigma * std_pop,
+            "upper_population": avg + sigma * std_pop,
+            "lower_population": avg - sigma * std_pop,
+            "upper_sampling": _clean(avg + sigma * std_samp) if n > 1 else None,
+            "lower_sampling": _clean(avg - sigma * std_samp) if n > 1 else None,
+        },
+    }
+
+
+_DEFAULT_PERCENTS = [1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0]
+
+
+def _percentiles(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    vals = _collect(segments, ms, masks, conf["field"])
+    percents = [float(p) for p in conf.get("percents", _DEFAULT_PERCENTS)]
+    keyed = bool(conf.get("keyed", True))
+    if len(vals) == 0:
+        results = [(p, None) for p in percents]
+    else:
+        qs = np.percentile(vals.astype(np.float64), percents)
+        results = [(p, float(q)) for p, q in zip(percents, qs)]
+    if keyed:
+        return {"values": {str(float(p)): v for p, v in results}}
+    return {"values": [{"key": p, "value": v} for p, v in results]}
+
+
+def _percentile_ranks(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    vals = _collect(segments, ms, masks, conf["field"]).astype(np.float64)
+    targets = [float(x) for x in conf["values"]]
+    keyed = bool(conf.get("keyed", True))
+    n = len(vals)
+    results = []
+    for t in targets:
+        rank = float((vals <= t).sum()) * 100.0 / n if n else None
+        results.append((t, rank))
+    if keyed:
+        return {"values": {f"{t}": r for t, r in results}}
+    return {"values": [{"key": t, "value": r} for t, r in results]}
+
+
+def _median_absolute_deviation(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    vals = _collect(segments, ms, masks, conf["field"]).astype(np.float64)
+    if len(vals) == 0:
+        return {"value": None}
+    med = float(np.median(vals))
+    return {"value": float(np.median(np.abs(vals - med)))}
+
+
+def _weighted_avg(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    v_conf = conf.get("value") or {}
+    w_conf = conf.get("weight") or {}
+    v_field, w_field = v_conf.get("field"), w_conf.get("field")
+    if not v_field or not w_field:
+        raise ParsingException("weighted_avg requires value.field and weight.field")
+    v_missing = v_conf.get("missing")
+    num = 0.0
+    den = 0.0
+    for i, seg in enumerate(segments):
+        vv, vp = _seg_numeric(seg, v_field)
+        wv, wp = _seg_numeric(seg, w_field)
+        if wv is None:
+            continue
+        base = masks[i] & wp
+        if vv is not None:
+            both = base & vp
+            num += float((vv[both].astype(np.float64) * wv[both]).sum())
+            den += float(wv[both].astype(np.float64).sum())
+            if v_missing is not None:
+                only_w = base & ~vp
+                num += float(v_missing) * float(wv[only_w].astype(np.float64).sum())
+                den += float(wv[only_w].astype(np.float64).sum())
+        elif v_missing is not None:
+            num += float(v_missing) * float(wv[base].astype(np.float64).sum())
+            den += float(wv[base].astype(np.float64).sum())
+    return {"value": num / den if den else None}
+
+
+def _top_hits(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    size = int(conf.get("size", 3))
+    from_ = int(conf.get("from", 0))
+    sort = conf.get("sort")
+    if isinstance(sort, (str, dict)):
+        sort = [sort]
+    scores = ext.get("scores")
+    seg_meta = ext.get("seg_meta")
+
+    rows = []  # (sort_key_tuple, flat_idx, doc)
+    total = 0
+    for i, seg in enumerate(segments):
+        docs = np.nonzero(masks[i])[0]
+        total += len(docs)
+        seg_scores = scores[i] if scores is not None and i < len(scores) else None
+        for d in docs.tolist():
+            sc = float(seg_scores[d]) if seg_scores is not None else 0.0
+            if sort:
+                key = _hit_sort_key(sort, seg, d, sc, ms) + (i, d)
+            else:
+                key = (-sc, i, d)
+            rows.append((key, i, d, sc))
+    rows.sort(key=lambda r: r[0])
+    page = rows[from_: from_ + size]
+    hits = []
+    max_score = None
+    for _, i, d, sc in page:
+        seg = segments[i]
+        hit = {
+            "_index": (seg_meta[i].get("index") if seg_meta else "_na_"),
+            "_id": seg.doc_ids[d],
+            "_score": sc if not sort else None,
+            "_source": json.loads(seg.sources[d]),
+        }
+        if sort:
+            hit["sort"] = list(_hit_sort_values(sort, seg, d, sc, ms))
+        if not sort and (max_score is None or sc > max_score):
+            max_score = sc
+        hits.append(hit)
+    return {
+        "hits": {
+            "total": {"value": total, "relation": "eq"},
+            "max_score": max_score,
+            "hits": hits,
+        }
+    }
+
+
+def _hit_sort_values(sort, seg, doc, score, ms) -> tuple:
+    out = []
+    for spec in sort:
+        if isinstance(spec, str):
+            fname = spec
+        else:
+            fname = next(iter(spec))
+        if fname == "_score":
+            out.append(score)
+            continue
+        if fname == "_doc":
+            out.append(doc)
+            continue
+        vals, present = _seg_numeric(seg, fname)
+        if vals is not None and present[doc]:
+            v = vals[doc]
+            out.append(int(v) if float(v).is_integer() else float(v))
+            continue
+        kf = seg.keyword_fields.get(fname)
+        if kf is not None and kf.first_ord[doc] >= 0:
+            out.append(kf.ord_values[int(kf.first_ord[doc])])
+            continue
+        out.append(None)
+    return tuple(out)
+
+
+class _RevStr:
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return self.v > other.v
+
+    def __eq__(self, other):
+        return isinstance(other, _RevStr) and self.v == other.v
+
+
+def _hit_sort_key(sort, seg, doc, score, ms) -> tuple:
+    vals = _hit_sort_values(sort, seg, doc, score, ms)
+    key = []
+    for spec, v in zip(sort, vals):
+        if isinstance(spec, str):
+            order = "desc" if spec == "_score" else "asc"
+        else:
+            body = next(iter(spec.values()))
+            order = body.get("order", "asc") if isinstance(body, dict) else body
+        desc = order == "desc"
+        if v is None:
+            key.append((1, 0))
+        elif isinstance(v, str):
+            key.append((0, _RevStr(v) if desc else v))
+        else:
+            key.append((0, -v if desc else v))
+    return tuple(key)
+
+
+def _scripted_metric(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    from opensearch_tpu.script.painless import DocView, Evaluator
+    from opensearch_tpu.script.service import default_script_service as svc
+
+    params = conf.get("params") or {}
+    init_s = conf.get("init_script")
+    map_s = conf.get("map_script")
+    combine_s = conf.get("combine_script")
+    reduce_s = conf.get("reduce_script")
+    if map_s is None:
+        raise ParsingException("scripted_metric requires map_script")
+    scores = ext.get("scores")
+    states = []
+    for i, seg in enumerate(segments):
+        state: dict = {}
+        if init_s:
+            ast, p = svc.compile(init_s)
+            Evaluator({"params": {**params, **p}, "state": state}).run(ast)
+        map_ast, map_p = svc.compile(map_s)
+        seg_scores = scores[i] if scores is not None and i < len(scores) else None
+        for d in np.nonzero(masks[i])[0].tolist():
+            env = {
+                "params": {**params, **map_p},
+                "state": state,
+                "doc": DocView(seg, d, ms),
+                "_score": float(seg_scores[d]) if seg_scores is not None else 0.0,
+            }
+            Evaluator(env).run(map_ast)
+        if combine_s:
+            ast, p = svc.compile(combine_s)
+            state = Evaluator({"params": {**params, **p}, "state": state}).run(ast)
+        states.append(state)
+    if reduce_s:
+        ast, p = svc.compile(reduce_s)
+        value = Evaluator({"params": {**params, **p}, "states": states}).run(ast)
+    else:
+        value = states
+    return {"value": value}
+
+
+def _matrix_stats(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    fields = conf.get("fields") or []
+    if not fields:
+        raise ParsingException("matrix_stats requires fields")
+    cols = {}
+    present_cols = {}
+    for f in fields:
+        vals_parts, pres_parts = [], []
+        for i, seg in enumerate(segments):
+            vv, vp = _seg_numeric(seg, f)
+            n = seg.n_docs
+            if vv is None:
+                vals_parts.append(np.zeros(n))
+                pres_parts.append(np.zeros(n, bool))
+            else:
+                vals_parts.append(vv.astype(np.float64))
+                pres_parts.append(masks[i] & vp)
+        cols[f] = np.concatenate(vals_parts) if vals_parts else np.zeros(0)
+        present_cols[f] = (
+            np.concatenate(pres_parts) if pres_parts else np.zeros(0, bool)
+        )
+    out_fields = []
+    doc_count = 0
+    for f in fields:
+        m = present_cols[f]
+        v = cols[f][m]
+        n = len(v)
+        doc_count = max(doc_count, n)
+        if n == 0:
+            continue
+        mean = float(v.mean())
+        var = float(v.var(ddof=1)) if n > 1 else 0.0
+        std = math.sqrt(var)
+        centered = v - mean
+        skew = (
+            float((centered**3).mean()) / (std**3) if n > 2 and std > 0 else 0.0
+        )
+        kurt = (
+            float((centered**4).mean()) / (var**2) if n > 3 and var > 0 else 0.0
+        )
+        cov_row, corr_row = {}, {}
+        for g in fields:
+            both = present_cols[f] & present_cols[g]
+            nb = int(both.sum())
+            if nb < 2:
+                cov_row[g] = 0.0
+                corr_row[g] = 0.0
+                continue
+            a = cols[f][both]
+            b = cols[g][both]
+            cov = float(np.cov(a, b, ddof=1)[0, 1])
+            cov_row[g] = cov
+            sa, sb = a.std(ddof=1), b.std(ddof=1)
+            corr_row[g] = cov / (sa * sb) if sa > 0 and sb > 0 else 0.0
+        out_fields.append({
+            "name": f,
+            "count": n,
+            "mean": mean,
+            "variance": var,
+            "skewness": skew,
+            "kurtosis": kurt,
+            "covariance": cov_row,
+            "correlation": corr_row,
+        })
+    return {"doc_count": doc_count, "fields": out_fields}
+
+
+# -- buckets ----------------------------------------------------------------
+
+
+def _seg_key_values(seg, field, ms):
+    """Per-doc scalar key (first value) + presence for terms-like bucketing."""
+    kf = seg.keyword_fields.get(field)
+    if kf is not None:
+        present = kf.first_ord >= 0
+        return kf, present, "keyword"
+    vals, pres = _seg_numeric(seg, field)
+    if vals is not None:
+        return vals, pres, "numeric"
+    return None, np.zeros(seg.n_docs, bool), "none"
+
+
+def _multi_terms(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    terms_conf = conf.get("terms") or []
+    fields = [t["field"] for t in terms_conf]
+    if len(fields) < 2:
+        raise ParsingException("multi_terms requires at least 2 terms sources")
+    size = int(conf.get("size", 10))
+    counts: dict[tuple, int] = {}
+    doc_lists: dict[tuple, list] = {}
+    for i, seg in enumerate(segments):
+        per_field = [_seg_key_values(seg, f, ms) for f in fields]
+        docs = np.nonzero(masks[i])[0]
+        for d in docs.tolist():
+            key_parts = []
+            ok = True
+            for src, present, kind in per_field:
+                if not present[d]:
+                    ok = False
+                    break
+                if kind == "keyword":
+                    key_parts.append(src.ord_values[int(src.first_ord[d])])
+                else:
+                    v = src[d]
+                    key_parts.append(int(v) if float(v).is_integer() else float(v))
+            if not ok:
+                continue
+            key = tuple(key_parts)
+            counts[key] = counts.get(key, 0) + 1
+            doc_lists.setdefault(key, []).append((i, d))
+    items = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+    top = items[:size]
+    other = sum(c for _, c in items[size:])
+    buckets = []
+    for key, count in top:
+        bucket = {
+            "key": list(key),
+            "key_as_string": "|".join(str(k) for k in key),
+            "doc_count": count,
+        }
+        if sub:
+            bucket_masks = [np.zeros(s.n_docs, bool) for s in segments]
+            for i, d in doc_lists[key]:
+                bucket_masks[i][d] = True
+            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
+        buckets.append(bucket)
+    return {
+        "doc_count_error_upper_bound": 0,
+        "sum_other_doc_count": other,
+        "buckets": buckets,
+    }
+
+
+def _rare_terms(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    field = conf["field"]
+    max_doc_count = int(conf.get("max_doc_count", 1))
+    counts: dict[Any, int] = {}
+    for i, seg in enumerate(segments):
+        kf = seg.keyword_fields.get(field)
+        if kf is not None:
+            entry_mask = masks[i][kf.mv_docs]
+            seg_counts = np.bincount(kf.mv_ords[entry_mask], minlength=len(kf.ord_values))
+            for o in np.nonzero(seg_counts)[0]:
+                key = kf.ord_values[int(o)]
+                counts[key] = counts.get(key, 0) + int(seg_counts[o])
+        else:
+            vals = _field_values(seg, field, masks[i], ms)
+            uniq, c = np.unique(vals, return_counts=True)
+            for v, n in zip(uniq.tolist(), c.tolist()):
+                counts[v] = counts.get(v, 0) + n
+    rare = [(k, c) for k, c in counts.items() if c <= max_doc_count]
+    rare.sort(key=lambda kv: (kv[1], str(kv[0])))
+    buckets = []
+    for key, count in rare:
+        bucket = {"key": key, "doc_count": count}
+        if sub:
+            bucket_masks = _value_masks(segments, field, key, masks)
+            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
+        buckets.append(bucket)
+    return {"buckets": buckets}
+
+
+def _significant_terms(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    field = conf["field"]
+    size = int(conf.get("size", 10))
+    min_doc_count = int(conf.get("min_doc_count", 3))
+    fg_counts: dict[Any, int] = {}
+    bg_counts: dict[Any, int] = {}
+    fg_total = 0
+    bg_total = 0
+    for i, seg in enumerate(segments):
+        fg_total += int(masks[i].sum())
+        bg_total += int(seg.live.sum())
+        kf = seg.keyword_fields.get(field)
+        if kf is None:
+            continue
+        fg_entry = masks[i][kf.mv_docs]
+        bg_entry = seg.live[kf.mv_docs]
+        fg_c = np.bincount(kf.mv_ords[fg_entry], minlength=len(kf.ord_values))
+        bg_c = np.bincount(kf.mv_ords[bg_entry], minlength=len(kf.ord_values))
+        for o in np.nonzero(bg_c)[0]:
+            key = kf.ord_values[int(o)]
+            bg_counts[key] = bg_counts.get(key, 0) + int(bg_c[o])
+            if fg_c[o]:
+                fg_counts[key] = fg_counts.get(key, 0) + int(fg_c[o])
+    scored = []
+    for key, fg in fg_counts.items():
+        if fg < min_doc_count or fg_total == 0:
+            continue
+        bg = bg_counts.get(key, fg)
+        fg_pct = fg / fg_total
+        bg_pct = bg / bg_total if bg_total else 0.0
+        if fg_pct <= bg_pct or bg_pct == 0:
+            continue
+        # JLH: (fg% - bg%) * (fg% / bg%)
+        score = (fg_pct - bg_pct) * (fg_pct / bg_pct)
+        scored.append((score, key, fg, bg))
+    scored.sort(key=lambda t: (-t[0], str(t[1])))
+    buckets = []
+    for score, key, fg, bg in scored[:size]:
+        bucket = {"key": key, "doc_count": fg, "score": score, "bg_count": bg}
+        if sub:
+            bucket_masks = _value_masks(segments, field, key, masks)
+            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
+        buckets.append(bucket)
+    return {"doc_count": fg_total, "bg_count": bg_total, "buckets": buckets}
+
+
+def _sampler(conf, sub, segments, ms, masks, filter_fn, ext, diversify=False) -> dict:
+    shard_size = int(conf.get("shard_size", 100))
+    scores = ext.get("scores")
+    rows = []
+    for i, seg in enumerate(segments):
+        seg_scores = scores[i] if scores is not None and i < len(scores) else None
+        for d in np.nonzero(masks[i])[0].tolist():
+            sc = float(seg_scores[d]) if seg_scores is not None else 0.0
+            rows.append((-sc, i, d))
+    rows.sort()
+    sel_masks = [np.zeros(s.n_docs, bool) for s in segments]
+    taken = 0
+    seen_values: dict[Any, int] = {}
+    max_per_value = int(conf.get("max_docs_per_value", 1)) if diversify else None
+    div_field = conf.get("field") if diversify else None
+    for _, i, d in rows:
+        if taken >= shard_size:
+            break
+        if diversify and div_field:
+            seg = segments[i]
+            key = None
+            kf = seg.keyword_fields.get(div_field)
+            if kf is not None and kf.first_ord[d] >= 0:
+                key = kf.ord_values[int(kf.first_ord[d])]
+            else:
+                vals, pres = _seg_numeric(seg, div_field)
+                if vals is not None and pres[d]:
+                    key = float(vals[d])
+            if key is not None:
+                if seen_values.get(key, 0) >= max_per_value:
+                    continue
+                seen_values[key] = seen_values.get(key, 0) + 1
+        sel_masks[i][d] = True
+        taken += 1
+    out = {"doc_count": taken}
+    out.update(_sub_aggs(sub, segments, ms, sel_masks, filter_fn, ext))
+    return out
+
+
+def _diversified_sampler(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    return _sampler(conf, sub, segments, ms, masks, filter_fn, ext, diversify=True)
+
+
+def _adjacency_matrix(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    named = conf.get("filters") or {}
+    sep = conf.get("separator", "&")
+    names = sorted(named)
+    f_masks = {
+        name: _run_filter(filter_fn, named[name], segments, masks) for name in names
+    }
+    buckets = []
+    for idx, name in enumerate(names):
+        count = int(sum(m.sum() for m in f_masks[name]))
+        if count > 0:
+            bucket = {"key": name, "doc_count": count}
+            if sub:
+                bucket.update(
+                    _sub_aggs(sub, segments, ms, f_masks[name], filter_fn, ext)
+                )
+            buckets.append(bucket)
+        for name2 in names[idx + 1:]:
+            inter = [a & b for a, b in zip(f_masks[name], f_masks[name2])]
+            count2 = int(sum(m.sum() for m in inter))
+            if count2 > 0:
+                bucket = {"key": f"{name}{sep}{name2}", "doc_count": count2}
+                if sub:
+                    bucket.update(_sub_aggs(sub, segments, ms, inter, filter_fn, ext))
+                buckets.append(bucket)
+    buckets.sort(key=lambda b: b["key"])
+    return {"buckets": buckets}
+
+
+def _date_range(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    field = conf["field"]
+    ranges = conf["ranges"]
+    keyed = bool(conf.get("keyed", False))
+    buckets = []
+    for r in ranges:
+        frm = parse_date_math(r["from"]) if r.get("from") is not None else None
+        to = parse_date_math(r["to"]) if r.get("to") is not None else None
+        count = 0
+        bucket_masks = []
+        for i, seg in enumerate(segments):
+            vals, pres = _seg_numeric(seg, field)
+            if vals is None:
+                bucket_masks.append(np.zeros(seg.n_docs, bool))
+                continue
+            m = masks[i] & pres
+            if frm is not None:
+                m = m & (vals >= frm)
+            if to is not None:
+                m = m & (vals < to)
+            bucket_masks.append(m)
+            count += int(m.sum())
+        key = r.get("key")
+        if key is None:
+            key = f"{_iso(frm) if frm is not None else '*'}-{_iso(to) if to is not None else '*'}"
+        bucket: dict[str, Any] = {"key": key, "doc_count": count}
+        if frm is not None:
+            bucket["from"] = float(frm)
+            bucket["from_as_string"] = _iso(frm)
+        if to is not None:
+            bucket["to"] = float(to)
+            bucket["to_as_string"] = _iso(to)
+        if sub:
+            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
+        buckets.append(bucket)
+    if keyed:
+        return {"buckets": {b["key"]: {k: v for k, v in b.items() if k != "key"}
+                            for b in buckets}}
+    return {"buckets": buckets}
+
+
+# -- composite --------------------------------------------------------------
+
+
+def _composite(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    sources = conf.get("sources") or []
+    if not sources:
+        raise ParsingException("composite requires sources")
+    size = int(conf.get("size", 10))
+    after = conf.get("after")
+    specs = []  # (name, type, conf)
+    for s in sources:
+        if len(s) != 1:
+            raise ParsingException("each composite source must have one name")
+        name = next(iter(s))
+        body = s[name]
+        typ = next(iter(body))
+        if typ not in ("terms", "histogram", "date_histogram"):
+            raise ParsingException(f"unsupported composite source type [{typ}]")
+        specs.append((name, typ, body[typ]))
+
+    counts: dict[tuple, int] = {}
+    doc_lists: dict[tuple, list] = {}
+    for i, seg in enumerate(segments):
+        per_src = []
+        for name, typ, sconf in specs:
+            per_src.append((_seg_key_values(seg, sconf["field"], ms), typ, sconf))
+        for d in np.nonzero(masks[i])[0].tolist():
+            key_parts = []
+            ok = True
+            for (src, present, kind), typ, sconf in per_src:
+                if not present[d]:
+                    ok = False
+                    break
+                if kind == "keyword":
+                    v: Any = src.ord_values[int(src.first_ord[d])]
+                else:
+                    v = float(src[d])
+                if typ == "histogram":
+                    interval = float(sconf["interval"])
+                    v = math.floor(v / interval) * interval
+                elif typ == "date_histogram":
+                    iv = str(sconf.get("fixed_interval") or sconf.get("calendar_interval") or sconf.get("interval"))
+                    if iv in _CALENDAR_UNITS:
+                        v = int(_calendar_keys(np.asarray([v]), iv)[0])
+                    else:
+                        interval = float(parse_time_millis(iv))
+                        v = int(math.floor(v / interval) * interval)
+                elif kind == "numeric" and float(v).is_integer():
+                    v = int(v)
+                key_parts.append(v)
+            if not ok:
+                continue
+            key = tuple(key_parts)
+            counts[key] = counts.get(key, 0) + 1
+            doc_lists.setdefault(key, []).append((i, d))
+
+    orders = [
+        -1 if (spec[2].get("order", "asc") == "desc") else 1 for spec in specs
+    ]
+
+    def key_sortable(key: tuple) -> tuple:
+        parts = []
+        for v, o in zip(key, orders):
+            if isinstance(v, str):
+                parts.append((0, _RevStr(v) if o < 0 else v))
+            else:
+                parts.append((1, -v if o < 0 else v))
+        return tuple(parts)
+
+    ordered = sorted(counts, key=key_sortable)
+    if after is not None:
+        after_key = tuple(after[name] for name, _, _ in specs)
+        cutoff = key_sortable(after_key)
+        ordered = [k for k in ordered if key_sortable(k) > cutoff]
+    page = ordered[:size]
+    buckets = []
+    for key in page:
+        bucket = {
+            "key": {name: v for (name, _, _), v in zip(specs, key)},
+            "doc_count": counts[key],
+        }
+        if sub:
+            bucket_masks = [np.zeros(s.n_docs, bool) for s in segments]
+            for i, d in doc_lists[key]:
+                bucket_masks[i][d] = True
+            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
+        buckets.append(bucket)
+    out: dict[str, Any] = {"buckets": buckets}
+    if page:
+        out["after_key"] = {name: v for (name, _, _), v in zip(specs, page[-1])}
+    return out
+
+
+# -- auto_date_histogram ----------------------------------------------------
+
+_AUTO_LADDER_MS = [
+    ("1s", 1000), ("5s", 5000), ("10s", 10_000), ("30s", 30_000),
+    ("1m", 60_000), ("5m", 300_000), ("10m", 600_000), ("30m", 1_800_000),
+    ("1h", 3_600_000), ("3h", 10_800_000), ("12h", 43_200_000),
+    ("1d", 86_400_000), ("7d", 604_800_000), ("30d", 2_592_000_000),
+    ("90d", 7_776_000_000), ("365d", 31_536_000_000),
+]
+
+
+def _auto_date_histogram(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    field = conf["field"]
+    target = int(conf.get("buckets", 10))
+    all_vals = _collect(segments, ms, masks, field)
+    if len(all_vals) == 0:
+        return {"buckets": [], "interval": "1s"}
+    lo, hi = float(all_vals.min()), float(all_vals.max())
+    chosen, interval = _AUTO_LADDER_MS[-1]
+    for name, iv in _AUTO_LADDER_MS:
+        if (math.floor(hi / iv) - math.floor(lo / iv) + 1) <= target:
+            chosen, interval = name, iv
+            break
+    key_counts: dict[float, int] = {}
+    per_seg_keys, per_seg_docs = [], []
+    for i, seg in enumerate(segments):
+        vals, pres = _seg_numeric(seg, field)
+        if vals is None:
+            per_seg_keys.append(np.zeros(0))
+            per_seg_docs.append(np.zeros(0, np.int64))
+            continue
+        m = masks[i] & pres
+        docs = np.nonzero(m)[0]
+        keys = np.floor(vals[docs].astype(np.float64) / interval) * interval
+        per_seg_keys.append(keys)
+        per_seg_docs.append(docs)
+        uniq, c = np.unique(keys, return_counts=True)
+        for k_, n_ in zip(uniq.tolist(), c.tolist()):
+            key_counts[k_] = key_counts.get(k_, 0) + n_
+    buckets = []
+    for key in sorted(key_counts):
+        bucket: dict[str, Any] = {
+            "key": int(key),
+            "key_as_string": _iso(key),
+            "doc_count": key_counts[key],
+        }
+        if sub:
+            bucket_masks = []
+            for i, seg in enumerate(segments):
+                bm = np.zeros(seg.n_docs, bool)
+                bm[per_seg_docs[i][per_seg_keys[i] == key]] = True
+                bucket_masks.append(bm)
+            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
+        buckets.append(bucket)
+    return {"buckets": buckets, "interval": chosen}
+
+
+EXTENSION_AGGS.update({
+    "extended_stats": _extended_stats,
+    "percentiles": _percentiles,
+    "percentile_ranks": _percentile_ranks,
+    "median_absolute_deviation": _median_absolute_deviation,
+    "weighted_avg": _weighted_avg,
+    "top_hits": _top_hits,
+    "scripted_metric": _scripted_metric,
+    "matrix_stats": _matrix_stats,
+    "multi_terms": _multi_terms,
+    "rare_terms": _rare_terms,
+    "significant_terms": _significant_terms,
+    "sampler": _sampler,
+    "diversified_sampler": _diversified_sampler,
+    "adjacency_matrix": _adjacency_matrix,
+    "date_range": _date_range,
+    "composite": _composite,
+    "auto_date_histogram": _auto_date_histogram,
+})

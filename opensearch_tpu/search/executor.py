@@ -956,6 +956,10 @@ class ShardQueryResult:
     max_score: float | None
     # per-segment match masks (host bool arrays) for the aggs phase
     masks: list[np.ndarray] = dc_field(default_factory=list)
+    # per-segment score arrays (host f32, n_docs) — kept alongside the masks
+    # so score-dependent aggregations (top_hits, sampler, scripted_metric)
+    # see the query-phase scores
+    score_arrays: list[np.ndarray] = dc_field(default_factory=list)
 
 
 def execute_query_phase(
@@ -969,6 +973,7 @@ def execute_query_phase(
 ) -> ShardQueryResult:
     ctx = ShardContext(snapshot, mapper_service)
     masks: list[np.ndarray] = []
+    score_arrays: list[np.ndarray] = []
     total = 0
     max_score: float | None = None
     all_hits: list[ShardHit] = []
@@ -984,6 +989,7 @@ def execute_query_phase(
         mask_host = np.asarray(mask)[: host.n_docs]
         if need_masks:
             masks.append(mask_host)
+            score_arrays.append(np.asarray(result.scores)[: host.n_docs])
         total += int(mask_host.sum())
         if size > 0:
             if not sort:
@@ -1010,7 +1016,10 @@ def execute_query_phase(
     else:
         all_hits.sort(key=_sort_key_fn(sort))
         all_hits = all_hits[:size]
-    return ShardQueryResult(hits=all_hits, total=total, max_score=max_score, masks=masks)
+    return ShardQueryResult(
+        hits=all_hits, total=total, max_score=max_score, masks=masks,
+        score_arrays=score_arrays,
+    )
 
 
 def _field_sort_values(

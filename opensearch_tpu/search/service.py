@@ -224,12 +224,19 @@ def search(
     if aggs_body:
         all_segments = []
         all_masks = []
+        all_scores = []
+        seg_meta = []
         seg_ctx: list[tuple[ShardContext, int]] = []  # (shard ctx, seg idx in shard)
         for shard_idx, (shard, snapshot, result) in enumerate(per_shard_results):
             ctx = ShardContext(snapshot, shard.mapper_service)
             for seg_i, (host, dev) in enumerate(snapshot.segments):
                 all_segments.append(host)
                 all_masks.append(result.masks[seg_i])
+                all_scores.append(
+                    result.score_arrays[seg_i]
+                    if seg_i < len(result.score_arrays) else None
+                )
+                seg_meta.append({"index": shard.shard_id.index})
                 seg_ctx.append((ctx, seg_i))
 
         def filter_fn(filter_body: dict, flat_idx: int) -> np.ndarray:
@@ -244,8 +251,13 @@ def search(
         # field-caps conflict handling)
         mapper_service = _MultiMapperView([s.mapper_service for s in shards])
         response["aggregations"] = compute_aggs(
-            all_segments, mapper_service, aggs_body, all_masks, filter_fn
+            all_segments, mapper_service, aggs_body, all_masks, filter_fn,
+            ext={"scores": all_scores, "seg_meta": seg_meta},
         )
+        # pipeline aggregations run once, at final reduce
+        from opensearch_tpu.search.aggs_pipeline import apply_pipeline_aggs
+
+        apply_pipeline_aggs(aggs_body, response["aggregations"])
     return response
 
 
