@@ -56,6 +56,11 @@ class IndexNotFoundException(OpenSearchTpuException):
         self.index = index
 
 
+class ResourceNotFoundException(OpenSearchTpuException):
+    status = 404
+    error_type = "resource_not_found_exception"
+
+
 class ResourceAlreadyExistsException(OpenSearchTpuException):
     status = 400
     error_type = "resource_already_exists_exception"

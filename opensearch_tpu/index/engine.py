@@ -258,16 +258,37 @@ class Engine:
         self.stats["refresh_total"] += 1
         return self._searcher
 
+    def _commit_signature(self) -> tuple:
+        import hashlib
+
+        return (
+            self._seq_no,
+            tuple(
+                (h.name, hashlib.sha1(h.live.tobytes()).hexdigest())
+                for h, _ in self._segments
+            ),
+        )
+
     def flush(self) -> None:
-        """Commit: refresh, persist segments + commit point, roll translog."""
+        """Commit: refresh, persist segments + commit point, roll translog.
+        A no-change flush is skipped entirely (Lucene's IndexWriter.commit
+        no-op) so repeated snapshots of an idle shard produce byte-identical
+        files for the repository's content-addressed dedup."""
         self.refresh()
+        sig = self._commit_signature()
+        if sig == getattr(self, "_last_flush_sig", None) and (
+            self.path / "commit.json"
+        ).exists():
+            return
         seg_dir = self.path / "segments"
+        prev_seg_lives = dict(getattr(self, "_last_flush_sig", (None, ()))[1])
+        cur_seg_lives = dict(sig[1])  # (name, live-digest) pairs from sig
         for host, _dev in self._segments:
-            if not (seg_dir / f"{host.name}.json").exists():
-                save_segment(host, seg_dir)
-            else:
-                # live bitmap may have changed since last commit
-                save_segment(host, seg_dir)
+            if (seg_dir / f"{host.name}.json").exists() and (
+                prev_seg_lives.get(host.name) == cur_seg_lives[host.name]
+            ):
+                continue  # unchanged since last commit
+            save_segment(host, seg_dir)
         commit = {
             "segments": [h.name for h, _ in self._segments],
             "max_seq_no": self._seq_no,
@@ -287,6 +308,7 @@ class Engine:
         os.replace(tmp, self.path / "commit.json")
         self.translog.roll_generation()
         self.translog.trim_below(self.translog.current_generation)
+        self._last_flush_sig = sig
         self.stats["flush_total"] += 1
 
     # -- recovery ----------------------------------------------------------
@@ -332,6 +354,11 @@ class Engine:
             replayed += 1
         if self._segments or replayed:
             self.refresh()
+        if commit_path.exists() and replayed == 0:
+            # recovered state matches the on-disk commit exactly: remember
+            # its signature so the next no-change flush skips file rewrites
+            # (keeps snapshot dedup byte-stable across restarts)
+            self._last_flush_sig = self._commit_signature()
 
     # -- stats / lifecycle -------------------------------------------------
 

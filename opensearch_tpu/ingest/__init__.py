@@ -1,0 +1,11 @@
+"""Ingest pipelines: node-side document transforms before indexing.
+
+The analog of the reference's ingest/ package (IngestService.java:118,
+Pipeline/Processor SPI, ~35 processors in modules/ingest-common) plus the
+grok/dissect parsing libraries (libs/grok, libs/dissect).
+"""
+
+from opensearch_tpu.ingest.document import IngestDocument
+from opensearch_tpu.ingest.service import IngestService, Pipeline
+
+__all__ = ["IngestDocument", "IngestService", "Pipeline"]

@@ -80,6 +80,12 @@ class TpuNode:
         self._reader_contexts: dict[str, dict] = {}
         self._state_file = self.data_path / "indices.json"
         self._recover_indices()
+        from opensearch_tpu.ingest import IngestService
+
+        self.ingest = IngestService(self.data_path / "ingest_pipelines.json")
+        from opensearch_tpu.snapshots import SnapshotsService
+
+        self.snapshots = SnapshotsService(self)
 
     # -- index lifecycle ---------------------------------------------------
 
@@ -122,6 +128,18 @@ class TpuNode:
         )
         self._persist_index_registry()
         return {"acknowledged": True, "shards_acknowledged": True, "index": name}
+
+    def attach_index(self, name: str, settings: dict, mappings: dict | None) -> "IndexService":
+        """Register an index whose shard files already exist on disk (the
+        restore path: RestoreService writes files, then the shards recover
+        from their commit points)."""
+        if name in self.indices:
+            raise ResourceAlreadyExistsException(f"index [{name}] already exists")
+        self.indices[name] = IndexService(
+            name, self._index_path(name), settings, mappings
+        )
+        self._persist_index_registry()
+        return self.indices[name]
 
     def delete_index(self, name: str) -> dict:
         svc = self._get_index(name)
@@ -203,7 +221,44 @@ class TpuNode:
         if_seq_no: int | None = None,
         refresh: bool = False,
         op_type: str = "index",
+        pipeline: str | None = None,
     ) -> dict:
+        # ingest pipelines resolve BEFORE any index auto-creation (the
+        # reference resolves pipelines first, so a drop or _index reroute
+        # never leaves a stray empty index behind): request param >
+        # index.default_pipeline, then the LANDING index's final_pipeline
+        def _settings_of(name: str) -> dict:
+            existing = self.indices.get(name)
+            return existing.settings if existing is not None else {}
+
+        resolved = pipeline
+        if resolved is None:
+            resolved = _index_setting(_settings_of(index), "default_pipeline")
+        if resolved == "_none":
+            resolved = None
+        pipeline_chain = [resolved] if resolved else []
+        ran_final = False
+        while pipeline_chain or not ran_final:
+            if pipeline_chain:
+                pipe_id = pipeline_chain.pop(0)
+            else:
+                # final_pipeline of the index the doc actually lands in
+                ran_final = True
+                pipe_id = _index_setting(_settings_of(index), "final_pipeline")
+                if not pipe_id or pipe_id == "_none":
+                    break
+            out = self.ingest.execute(pipe_id, index, doc_id, source, routing)
+            if out is None:
+                return {
+                    "_index": index, "_id": doc_id, "_version": -3,
+                    "result": "noop",
+                    "_shards": {"total": 0, "successful": 0, "failed": 0},
+                    "_seq_no": 0, "_primary_term": 0,
+                }
+            source = out.source
+            index = out.meta["_index"]
+            doc_id = out.meta["_id"]
+            routing = out.meta["_routing"]
         svc = self._get_or_autocreate(index)
         if doc_id is None:
             import uuid
@@ -327,7 +382,7 @@ class TpuNode:
         raise IllegalArgumentException("update requires [doc] or [upsert]")
 
     def bulk(self, operations: list[tuple[str, dict, dict | None]],
-             refresh: bool = False) -> dict:
+             refresh: bool = False, pipeline: str | None = None) -> dict:
         """operations: [(action, metadata, source)]; action in
         index|create|update|delete."""
         t0 = time.monotonic()
@@ -341,7 +396,8 @@ class TpuNode:
             try:
                 if action in ("index", "create"):
                     resp = self.index_doc(index, doc_id, source, routing,
-                                          op_type=action)
+                                          op_type=action,
+                                          pipeline=meta.get("pipeline", pipeline))
                     status = 201 if resp["result"] == "created" else 200
                 elif action == "update":
                     resp = self.update_doc(index, doc_id, source, routing)
@@ -591,6 +647,19 @@ class TpuNode:
     def close(self) -> None:
         for svc in self.indices.values():
             svc.close()
+
+
+def _index_setting(settings: dict, name: str):
+    """Read an index-scoped setting from either flat ("index.default_pipeline")
+    or nested ({"index": {"default_pipeline": ...}}) / top-level shapes."""
+    v = settings.get(name)
+    if v is None:
+        v = settings.get(f"index.{name}")
+    if v is None:
+        nested = settings.get("index")
+        if isinstance(nested, dict):
+            v = nested.get(name)
+    return v
 
 
 def _deep_merge(base: dict, update: dict) -> dict:

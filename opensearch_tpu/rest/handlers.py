@@ -71,6 +71,27 @@ def build_router() -> Router:
     reg("POST", "/_refresh", refresh_all)
     reg("POST", "/{index}/_flush", flush)
     reg("POST", "/_flush", flush_all)
+    # ingest pipelines
+    reg("PUT", "/_ingest/pipeline/{id}", put_pipeline)
+    reg("GET", "/_ingest/pipeline", get_pipelines)
+    reg("GET", "/_ingest/pipeline/{id}", get_pipeline)
+    reg("DELETE", "/_ingest/pipeline/{id}", delete_pipeline)
+    reg("POST", "/_ingest/pipeline/{id}/_simulate", simulate_pipeline)
+    reg("GET", "/_ingest/pipeline/{id}/_simulate", simulate_pipeline)
+    reg("POST", "/_ingest/pipeline/_simulate", simulate_inline)
+    reg("GET", "/_ingest/pipeline/_simulate", simulate_inline)
+    # snapshots / repositories
+    reg("PUT", "/_snapshot/{repo}", put_repository)
+    reg("POST", "/_snapshot/{repo}", put_repository)
+    reg("GET", "/_snapshot", get_repositories)
+    reg("GET", "/_snapshot/{repo}", get_repository)
+    reg("DELETE", "/_snapshot/{repo}", delete_repository)
+    reg("PUT", "/_snapshot/{repo}/{snapshot}", create_snapshot)
+    reg("POST", "/_snapshot/{repo}/{snapshot}", create_snapshot)
+    reg("GET", "/_snapshot/{repo}/{snapshot}", get_snapshot)
+    reg("DELETE", "/_snapshot/{repo}/{snapshot}", delete_snapshot)
+    reg("POST", "/_snapshot/{repo}/{snapshot}/_restore", restore_snapshot)
+    reg("GET", "/_snapshot/{repo}/{snapshot}/_status", snapshot_status)
     # cluster / stats
     reg("GET", "/_cluster/health", cluster_health)
     reg("GET", "/_cluster/stats", cluster_stats)
@@ -153,6 +174,7 @@ def index_doc(node: TpuNode, params, query, body):
         routing=query.get("routing"),
         if_seq_no=int(if_seq_no) if if_seq_no is not None else None,
         refresh=_refresh_param(query),
+        pipeline=query.get("pipeline"),
     )
     return (201 if resp["result"] == "created" else 200), resp
 
@@ -163,6 +185,7 @@ def index_doc_auto_id(node: TpuNode, params, query, body):
     resp = node.index_doc(
         params["index"], None, body,
         routing=query.get("routing"), refresh=_refresh_param(query),
+        pipeline=query.get("pipeline"),
     )
     return 201, resp
 
@@ -173,7 +196,7 @@ def create_doc(node: TpuNode, params, query, body):
     resp = node.index_doc(
         params["index"], params["id"], body,
         routing=query.get("routing"), refresh=_refresh_param(query),
-        op_type="create",
+        op_type="create", pipeline=query.get("pipeline"),
     )
     return 201, resp
 
@@ -237,7 +260,77 @@ def bulk(node: TpuNode, params, query, body):
             source = body[i]
             i += 1
         ops.append((action, meta, source))
-    return 200, node.bulk(ops, refresh=_refresh_param(query))
+    return 200, node.bulk(ops, refresh=_refresh_param(query),
+                          pipeline=query.get("pipeline"))
+
+
+def put_pipeline(node: TpuNode, params, query, body):
+    if not isinstance(body, dict):
+        raise IllegalArgumentException("request body is required")
+    return 200, node.ingest.put_pipeline(params["id"], body)
+
+
+def get_pipelines(node: TpuNode, params, query, body):
+    return 200, node.ingest.get_pipeline(None)
+
+
+def get_pipeline(node: TpuNode, params, query, body):
+    return 200, node.ingest.get_pipeline(params["id"])
+
+
+def delete_pipeline(node: TpuNode, params, query, body):
+    return 200, node.ingest.delete_pipeline(params["id"])
+
+
+def simulate_pipeline(node: TpuNode, params, query, body):
+    verbose = str(query.get("verbose", "false")) in ("true", "")
+    return 200, node.ingest.simulate(body or {}, pipeline_id=params["id"],
+                                     verbose=verbose)
+
+
+def simulate_inline(node: TpuNode, params, query, body):
+    verbose = str(query.get("verbose", "false")) in ("true", "")
+    return 200, node.ingest.simulate(body or {}, verbose=verbose)
+
+
+def put_repository(node: TpuNode, params, query, body):
+    return 200, node.snapshots.put_repository(params["repo"], body or {})
+
+
+def get_repositories(node: TpuNode, params, query, body):
+    return 200, node.snapshots.get_repository(None)
+
+
+def get_repository(node: TpuNode, params, query, body):
+    return 200, node.snapshots.get_repository(params["repo"])
+
+
+def delete_repository(node: TpuNode, params, query, body):
+    return 200, node.snapshots.delete_repository(params["repo"])
+
+
+def create_snapshot(node: TpuNode, params, query, body):
+    return 200, node.snapshots.create_snapshot(
+        params["repo"], params["snapshot"], body
+    )
+
+
+def get_snapshot(node: TpuNode, params, query, body):
+    return 200, node.snapshots.get_snapshot(params["repo"], params["snapshot"])
+
+
+def delete_snapshot(node: TpuNode, params, query, body):
+    return 200, node.snapshots.delete_snapshot(params["repo"], params["snapshot"])
+
+
+def restore_snapshot(node: TpuNode, params, query, body):
+    return 200, node.snapshots.restore_snapshot(
+        params["repo"], params["snapshot"], body
+    )
+
+
+def snapshot_status(node: TpuNode, params, query, body):
+    return 200, node.snapshots.snapshot_status(params["repo"], params["snapshot"])
 
 
 # -- search ------------------------------------------------------------------

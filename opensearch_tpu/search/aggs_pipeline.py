@@ -75,13 +75,23 @@ def _agg_type(body: dict) -> str | None:
 
 
 def _bucket_value(bucket: dict, path: str) -> Any:
-    """Resolve "metric", "metric.prop", "_count" within one bucket."""
+    """Resolve "metric", "metric.prop", "agg>agg.metric", "_count" within
+    one bucket (AggregationPath semantics: '>' descends into single-bucket
+    sub-aggregations)."""
     if path == "_count":
         return bucket.get("doc_count")
     if path == "_key":
         return bucket.get("key")
-    name, _, prop = path.partition(".")
-    node = bucket.get(name)
+    node: Any = bucket
+    segments = path.split(">")
+    for seg in segments[:-1]:
+        node = node.get(seg.strip()) if isinstance(node, dict) else None
+        if node is None:
+            raise IllegalArgumentException(
+                f"no aggregation found for path [{path}]"
+            )
+    name, _, prop = segments[-1].strip().partition(".")
+    node = node.get(name) if isinstance(node, dict) else None
     if node is None:
         raise IllegalArgumentException(f"no aggregation found for path [{path}]")
     return node.get(prop or "value")

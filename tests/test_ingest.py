@@ -1,0 +1,277 @@
+"""Ingest pipelines: processors, pipeline execution, simulate, node wiring."""
+
+import pytest
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceNotFoundException,
+)
+from opensearch_tpu.ingest import IngestDocument, IngestService
+from opensearch_tpu.ingest.processors import build_processor
+from opensearch_tpu.node import TpuNode
+
+
+def run_proc(definition, source, index="idx", doc_id="1"):
+    doc = IngestDocument(index, doc_id, source)
+    build_processor(definition).run(doc)
+    return doc
+
+
+# -- individual processors --------------------------------------------------
+
+
+def test_set_append_remove_rename():
+    doc = run_proc({"set": {"field": "a.b", "value": 5}}, {})
+    assert doc.source == {"a": {"b": 5}}
+    doc = run_proc({"set": {"field": "greet", "value": "hi {{name}}"}},
+                   {"name": "bob"})
+    assert doc.source["greet"] == "hi bob"
+    doc = run_proc({"append": {"field": "tags", "value": ["x", "y"]}},
+                   {"tags": "a"})
+    assert doc.source["tags"] == ["a", "x", "y"]
+    doc = run_proc({"remove": {"field": "a"}}, {"a": 1, "b": 2})
+    assert doc.source == {"b": 2}
+    doc = run_proc({"rename": {"field": "a", "target_field": "z.w"}}, {"a": 1})
+    assert doc.source == {"z": {"w": 1}}
+
+
+def test_convert_and_auto():
+    doc = run_proc({"convert": {"field": "n", "type": "integer"}}, {"n": "42"})
+    assert doc.source["n"] == 42
+    doc = run_proc({"convert": {"field": "b", "type": "boolean"}}, {"b": "true"})
+    assert doc.source["b"] is True
+    doc = run_proc({"convert": {"field": "x", "type": "auto"}}, {"x": "3.5"})
+    assert doc.source["x"] == 3.5
+
+
+def test_string_processors():
+    doc = run_proc({"lowercase": {"field": "s"}}, {"s": "ABC"})
+    assert doc.source["s"] == "abc"
+    doc = run_proc({"trim": {"field": "s"}}, {"s": "  x  "})
+    assert doc.source["s"] == "x"
+    doc = run_proc({"gsub": {"field": "s", "pattern": r"\.", "replacement": "-"}},
+                   {"s": "1.2.3"})
+    assert doc.source["s"] == "1-2-3"
+    doc = run_proc({"split": {"field": "s", "separator": ","}}, {"s": "a,b,c"})
+    assert doc.source["s"] == ["a", "b", "c"]
+    doc = run_proc({"join": {"field": "s", "separator": "-"}},
+                   {"s": ["a", "b"]})
+    assert doc.source["s"] == "a-b"
+    doc = run_proc({"html_strip": {"field": "s"}}, {"s": "<b>hi</b> &amp; bye"})
+    assert doc.source["s"] == "hi & bye"
+    doc = run_proc({"bytes": {"field": "s"}}, {"s": "2kb"})
+    assert doc.source["s"] == 2048
+    doc = run_proc({"urldecode": {"field": "s"}}, {"s": "a%20b"})
+    assert doc.source["s"] == "a b"
+
+
+def test_kv_json_csv():
+    doc = run_proc({"kv": {"field": "msg", "field_split": " ",
+                           "value_split": "="}},
+                   {"msg": "ip=1.2.3.4 error=REFUSED"})
+    assert doc.source["ip"] == "1.2.3.4"
+    assert doc.source["error"] == "REFUSED"
+    doc = run_proc({"json": {"field": "raw", "target_field": "parsed"}},
+                   {"raw": '{"a": 1}'})
+    assert doc.source["parsed"] == {"a": 1}
+    doc = run_proc({"csv": {"field": "row",
+                            "target_fields": ["a", "b", "c"]}},
+                   {"row": 'x,"y,z",w'})
+    assert doc.source["a"] == "x" and doc.source["b"] == "y,z"
+
+
+def test_date_processor():
+    doc = run_proc({"date": {"field": "t", "formats": ["UNIX_MS"]}},
+                   {"t": "1704067200000"})
+    assert doc.source["@timestamp"].startswith("2024-01-01T00:00:00")
+    doc = run_proc({"date": {"field": "t", "formats": ["yyyy/MM/dd"]}},
+                   {"t": "2024/02/03"})
+    assert doc.source["@timestamp"].startswith("2024-02-03")
+
+
+def test_date_index_name():
+    doc = run_proc({"date_index_name": {
+        "field": "t", "index_name_prefix": "logs-", "date_rounding": "M",
+        "date_formats": ["ISO8601"]}},
+        {"t": "2024-03-15T10:00:00Z"})
+    assert doc.meta["_index"] == "logs-2024-03"
+
+
+def test_grok():
+    doc = run_proc({"grok": {
+        "field": "message",
+        "patterns": ["%{IP:client} %{WORD:method} %{URIPATH:path} "
+                     "%{NUMBER:bytes:int}"],
+    }}, {"message": "55.3.244.1 GET /index.html 15824"})
+    assert doc.source["client"] == "55.3.244.1"
+    assert doc.source["method"] == "GET"
+    assert doc.source["bytes"] == 15824
+
+
+def test_grok_custom_pattern_and_no_match():
+    doc = run_proc({"grok": {
+        "field": "m", "patterns": ["%{ID:id}"],
+        "pattern_definitions": {"ID": r"[A-Z]{2}\d{4}"}}},
+        {"m": "ref AB1234 done"})
+    assert doc.source["id"] == "AB1234"
+    with pytest.raises(IllegalArgumentException):
+        run_proc({"grok": {"field": "m", "patterns": ["%{IP:ip}"]}},
+                 {"m": "no ip here"})
+
+
+def test_dissect():
+    doc = run_proc({"dissect": {
+        "field": "message",
+        "pattern": "%{clientip} %{ident} %{auth} [%{timestamp}]"}},
+        {"message": "1.2.3.4 - admin [30/Apr/1998:22:00:52 +0000]"})
+    assert doc.source["clientip"] == "1.2.3.4"
+    assert doc.source["auth"] == "admin"
+    assert doc.source["timestamp"] == "30/Apr/1998:22:00:52 +0000"
+
+
+def test_uri_parts_and_user_agent():
+    doc = run_proc({"uri_parts": {"field": "u"}},
+                   {"u": "https://user:pw@example.com:8080/a/b.txt?q=1#frag"})
+    u = doc.source["url"]
+    assert u["scheme"] == "https"
+    assert u["domain"] == "example.com"
+    assert u["port"] == 8080
+    assert u["extension"] == "txt"
+    doc = run_proc({"user_agent": {"field": "ua"}},
+                   {"ua": "Mozilla/5.0 (Windows NT 10.0) Chrome/120.0.0.0 Safari/537.36"})
+    assert doc.source["user_agent"]["name"] == "Chrome"
+    assert doc.source["user_agent"]["os"]["name"] == "Windows"
+
+
+def test_foreach_and_sort():
+    doc = run_proc({"foreach": {
+        "field": "vals",
+        "processor": {"uppercase": {"field": "_ingest._value"}}}},
+        {"vals": ["a", "b"]})
+    assert doc.source["vals"] == ["A", "B"]
+    doc = run_proc({"sort": {"field": "v", "order": "desc"}}, {"v": [1, 3, 2]})
+    assert doc.source["v"] == [3, 2, 1]
+
+
+def test_script_processor():
+    doc = run_proc({"script": {
+        "source": "ctx.total = ctx.a + ctx.b"}}, {"a": 2, "b": 3})
+    assert doc.source["total"] == 5
+
+
+def test_fingerprint_and_dot_expander():
+    d1 = run_proc({"fingerprint": {"fields": ["a", "b"]}}, {"a": 1, "b": 2})
+    d2 = run_proc({"fingerprint": {"fields": ["b", "a"]}}, {"b": 2, "a": 1})
+    assert d1.source["fingerprint"] == d2.source["fingerprint"]
+    doc = run_proc({"dot_expander": {"field": "a.b"}}, {"a.b": 5})
+    assert doc.source == {"a": {"b": 5}}
+
+
+def test_conditional_and_on_failure():
+    doc = run_proc({"set": {"field": "x", "value": 1,
+                            "if": "ctx.kind == 'a'"}}, {"kind": "b"})
+    assert "x" not in doc.source
+    doc = run_proc({"fail": {
+        "message": "boom",
+        "on_failure": [{"set": {"field": "err", "value": "handled"}}],
+    }}, {})
+    assert doc.source["err"] == "handled"
+    doc = run_proc({"fail": {"message": "boom", "ignore_failure": True}}, {})
+    assert doc.source == {}
+
+
+# -- service + node wiring --------------------------------------------------
+
+
+def test_pipeline_crud_and_execute(tmp_path):
+    svc = IngestService(tmp_path / "pipes.json")
+    svc.put_pipeline("p1", {"processors": [
+        {"set": {"field": "via", "value": "p1"}},
+    ]})
+    assert "p1" in svc.get_pipeline("p1")
+    # persistence round-trip
+    svc2 = IngestService(tmp_path / "pipes.json")
+    out = svc2.execute("p1", "idx", "1", {"a": 1})
+    assert out.source == {"a": 1, "via": "p1"}
+    svc2.delete_pipeline("p1")
+    with pytest.raises(ResourceNotFoundException):
+        svc2.get_pipeline("p1")
+
+
+def test_nested_pipeline_and_drop(tmp_path):
+    svc = IngestService(tmp_path / "pipes.json")
+    svc.put_pipeline("inner", {"processors": [
+        {"set": {"field": "inner", "value": True}}]})
+    svc.put_pipeline("outer", {"processors": [
+        {"pipeline": {"name": "inner"}},
+        {"drop": {"if": "ctx.skip == true"}},
+    ]})
+    out = svc.execute("outer", "idx", "1", {"skip": False})
+    assert out.source["inner"] is True
+    assert svc.execute("outer", "idx", "2", {"skip": True}) is None
+
+
+def test_simulate(tmp_path):
+    svc = IngestService(tmp_path / "pipes.json")
+    body = {
+        "pipeline": {"processors": [
+            {"set": {"field": "x", "value": 1}},
+            {"fail": {"message": "stop", "if": "ctx.bad == true"}},
+        ]},
+        "docs": [
+            {"_index": "i", "_id": "1", "_source": {"bad": False}},
+            {"_index": "i", "_id": "2", "_source": {"bad": True}},
+        ],
+    }
+    out = svc.simulate(body)
+    assert out["docs"][0]["doc"]["_source"]["x"] == 1
+    assert "error" in out["docs"][1]
+    verbose = svc.simulate(body, verbose=True)
+    steps = verbose["docs"][1]["processor_results"]
+    assert steps[0]["status"] == "success"
+    assert steps[1]["status"] == "error"
+
+
+def test_node_default_pipeline_and_redirect(tmp_path):
+    node = TpuNode(tmp_path)
+    node.ingest.put_pipeline("stamp", {"processors": [
+        {"set": {"field": "stamped", "value": True}}]})
+    node.create_index("logs", {"settings": {
+        "number_of_shards": 1, "index": {"default_pipeline": "stamp"}}})
+    node.index_doc("logs", "1", {"m": "hello"})
+    node.refresh("logs")
+    got = node.get_doc("logs", "1")
+    assert got["_source"]["stamped"] is True
+    # request pipeline=_none bypasses the default
+    node.index_doc("logs", "2", {"m": "raw"}, pipeline="_none")
+    node.refresh("logs")
+    assert "stamped" not in node.get_doc("logs", "2")["_source"]
+    # a pipeline that rewrites _index redirects the document
+    node.ingest.put_pipeline("redirect", {"processors": [
+        {"date_index_name": {"field": "t", "index_name_prefix": "logs-",
+                             "date_rounding": "M",
+                             "date_formats": ["ISO8601"]}}]})
+    resp = node.index_doc("logs", "3", {"t": "2024-03-15T10:00:00Z"},
+                          pipeline="redirect")
+    assert resp["_index"] == "logs-2024-03"
+    node.refresh("logs-2024-03")
+    assert node.get_doc("logs-2024-03", "3")["found"]
+    # drop in pipeline -> noop result
+    node.ingest.put_pipeline("dropper", {"processors": [{"drop": {}}]})
+    resp = node.index_doc("logs", "4", {"m": "x"}, pipeline="dropper")
+    assert resp["result"] == "noop"
+    node.close()
+
+
+def test_bulk_with_pipeline(tmp_path):
+    node = TpuNode(tmp_path)
+    node.ingest.put_pipeline("tagit", {"processors": [
+        {"set": {"field": "tagged", "value": True}}]})
+    out = node.bulk([
+        ("index", {"_index": "b", "_id": "1"}, {"v": 1}),
+        ("index", {"_index": "b", "_id": "2", "pipeline": "_none"}, {"v": 2}),
+    ], refresh=True, pipeline="tagit")
+    assert not out["errors"]
+    assert node.get_doc("b", "1")["_source"]["tagged"] is True
+    assert "tagged" not in node.get_doc("b", "2")["_source"]
+    node.close()

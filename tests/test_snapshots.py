@@ -1,0 +1,119 @@
+"""Snapshot/restore: repository CRUD, incremental blobs, restore, GC."""
+
+import pytest
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceAlreadyExistsException,
+    ResourceNotFoundException,
+)
+from opensearch_tpu.node import TpuNode
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = TpuNode(tmp_path / "data")
+    n.create_index("books", {"settings": {"number_of_shards": 2}, "mappings": {
+        "properties": {"title": {"type": "text"}, "year": {"type": "long"}}}})
+    for i, (title, year) in enumerate([
+        ("the old man and the sea", 1952),
+        ("brave new world", 1932),
+        ("dune", 1965),
+    ]):
+        n.index_doc("books", str(i + 1), {"title": title, "year": year})
+    n.refresh("books")
+    n.snapshots.put_repository("backup", {
+        "type": "fs", "settings": {"location": str(tmp_path / "repo")}})
+    yield n
+    n.close()
+
+
+def test_repository_crud(node, tmp_path):
+    assert "backup" in node.snapshots.get_repository(None)
+    with pytest.raises(IllegalArgumentException):
+        node.snapshots.put_repository("bad", {"type": "s3", "settings": {}})
+    with pytest.raises(IllegalArgumentException):
+        node.snapshots.put_repository("bad", {"type": "fs", "settings": {}})
+    node.snapshots.put_repository("other", {
+        "type": "fs", "settings": {"location": str(tmp_path / "repo2")}})
+    node.snapshots.delete_repository("other")
+    with pytest.raises(ResourceNotFoundException):
+        node.snapshots.get_repository("other")
+
+
+def test_snapshot_create_get_status(node):
+    out = node.snapshots.create_snapshot("backup", "snap1")
+    assert out["snapshot"]["state"] == "SUCCESS"
+    assert out["snapshot"]["indices"] == ["books"]
+    got = node.snapshots.get_snapshot("backup", "snap1")
+    assert got["snapshots"][0]["snapshot"] == "snap1"
+    status = node.snapshots.snapshot_status("backup", "snap1")
+    shards = status["snapshots"][0]["indices"]["books"]["shards"]
+    assert len(shards) == 2
+    assert all(s["stage"] == "DONE" for s in shards.values())
+    with pytest.raises(ResourceAlreadyExistsException):
+        node.snapshots.create_snapshot("backup", "snap1")
+
+
+def test_restore_roundtrip(node):
+    node.snapshots.create_snapshot("backup", "snap1")
+    # mutate after the snapshot: restore must NOT see this doc
+    node.index_doc("books", "4", {"title": "later book", "year": 2020})
+    node.refresh("books")
+    out = node.snapshots.restore_snapshot("backup", "snap1", {
+        "indices": "books", "rename_pattern": "books",
+        "rename_replacement": "books_restored"})
+    assert out["snapshot"]["indices"] == ["books_restored"]
+    resp = node.search("books_restored", {"query": {"match_all": {}}})
+    assert resp["hits"]["total"]["value"] == 3  # not 4
+    resp = node.search("books_restored", {"query": {"match": {"title": "dune"}}})
+    assert resp["hits"]["hits"][0]["_id"] == "3"
+    # restoring over an existing index is rejected
+    with pytest.raises(ResourceAlreadyExistsException):
+        node.snapshots.restore_snapshot("backup", "snap1")
+
+
+def test_restore_after_delete(node):
+    node.snapshots.create_snapshot("backup", "snap1")
+    node.delete_index("books")
+    node.snapshots.restore_snapshot("backup", "snap1")
+    resp = node.search("books", {"query": {"match_all": {}}})
+    assert resp["hits"]["total"]["value"] == 3
+
+
+def test_incremental_dedup(node):
+    store = node.snapshots._store("backup")
+    node.snapshots.create_snapshot("backup", "snap1")
+    n1 = len(store.list_blobs())
+    # identical second snapshot: no new blobs
+    node.snapshots.create_snapshot("backup", "snap2")
+    assert len(store.list_blobs()) == n1
+    # new doc -> only the changed shard's files add blobs
+    node.index_doc("books", "4", {"title": "new", "year": 2021})
+    node.refresh("books")
+    node.snapshots.create_snapshot("backup", "snap3")
+    assert len(store.list_blobs()) > n1
+
+
+def test_delete_snapshot_gc(node):
+    node.snapshots.create_snapshot("backup", "snap1")
+    store = node.snapshots._store("backup")
+    assert len(store.list_blobs()) > 0
+    node.snapshots.delete_snapshot("backup", "snap1")
+    assert node.snapshots.get_snapshot("backup")["snapshots"] == []
+    assert store.list_blobs() == []  # all blobs unreferenced -> GC'd
+    with pytest.raises(ResourceNotFoundException):
+        node.snapshots.delete_snapshot("backup", "snap1")
+
+
+def test_snapshot_survives_node_restart(node, tmp_path):
+    node.snapshots.create_snapshot("backup", "snap1")
+    node.delete_index("books")
+    node.close()
+    n2 = TpuNode(tmp_path / "data")
+    # repo registry persisted
+    assert "backup" in n2.snapshots.get_repository(None)
+    n2.snapshots.restore_snapshot("backup", "snap1")
+    resp = n2.search("books", {"query": {"match_all": {}}})
+    assert resp["hits"]["total"]["value"] == 3
+    n2.close()
