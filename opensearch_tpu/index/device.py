@@ -66,6 +66,11 @@ class DeviceVectorField:
     present: jnp.ndarray          # bool [n_pad]
     dims: int
     similarity: str
+    # IVF-PQ ANN structure (ops/ivfpq.IVFPQIndex) built at publish time when
+    # the mapper asked for method ivf_pq and the segment is big enough — the
+    # per-segment index-structure model of the k-NN plugin's codecs.
+    ann: object | None = None
+    nprobe_default: int = 8
 
 
 @dataclass
@@ -93,6 +98,43 @@ class DeviceSegment:
             numeric_fields=self.numeric_fields,
             vector_fields=self.vector_fields,
         )
+
+
+def _maybe_build_ann(vf, device):
+    """Build an IVF-PQ index for a sealed vector column when asked for.
+
+    Returns (ann_or_None, nprobe_default). ANN serves l2/cosine; dot_product
+    stays exact (IVF residual geometry doesn't carry MIPS) — matching the
+    k-NN plugin, where engine support varies per space type.
+    """
+    method = vf.method or {}
+    name = str(method.get("name", "")).lower().replace("-", "_")
+    if name not in ("ivf_pq", "ivfpq", "ivf"):
+        return None, 8
+    if vf.similarity not in ("l2_norm", "l2", "cosine", "cosinesimil"):
+        return None, 8
+    params = method.get("parameters") or {}
+    n_present = int(vf.present.sum())
+    from opensearch_tpu.ops import ivfpq
+
+    min_train = int(params.get("min_train", ivfpq.MIN_TRAIN_DOCS))
+    if n_present < min_train:
+        return None, 8
+    dims = vf.dims
+    m = int(params.get("m", params.get("code_size", ivfpq.DEFAULT_M)))
+    while dims % m != 0 and m > 1:
+        m -= 1
+    doc_ids = np.nonzero(vf.present)[0].astype(np.int32)
+    ann = ivfpq.build(
+        vf.vectors[doc_ids],
+        doc_ids,
+        nlist=int(params.get("nlist", ivfpq.DEFAULT_NLIST)),
+        m=m,
+        iters=int(params.get("iters", 10)),
+        normalized=vf.similarity in ("cosine", "cosinesimil"),
+        device=device,
+    )
+    return ann, int(params.get("nprobe", ivfpq.DEFAULT_NPROBE))
 
 
 def to_device(seg: HostSegment, device=None) -> DeviceSegment:
@@ -144,12 +186,15 @@ def to_device(seg: HostSegment, device=None) -> DeviceSegment:
     vector_fields: dict[str, DeviceVectorField] = {}
     for fname, vf in seg.vector_fields.items():
         vecs = _pad1(vf.vectors, n_pad)
+        ann, nprobe_default = _maybe_build_ann(vf, device)
         vector_fields[fname] = DeviceVectorField(
             vectors=put(vecs),
             norms_sq=put((vecs.astype(np.float64) ** 2).sum(axis=1).astype(np.float32)),
             present=put(_pad1(vf.present, n_pad, fill=False)),
             dims=vf.dims,
             similarity=vf.similarity,
+            ann=ann,
+            nprobe_default=nprobe_default,
         )
 
     return DeviceSegment(

@@ -56,6 +56,9 @@ class FieldMapper:
     # dense_vector
     dims: int = 0
     similarity: str = "l2_norm"  # l2_norm | cosine | dot_product
+    # ANN method config (k-NN plugin style): {"name": "ivf_pq",
+    # "parameters": {"nlist": .., "m": .., "nprobe": ..}}; None = exact
+    method: dict | None = None
     # date
     format: str = "strict_date_optional_time||epoch_millis"
     # extra sub-fields ("fields": {"raw": {"type": "keyword"}})
@@ -70,6 +73,8 @@ class FieldMapper:
         if self.type == "dense_vector" or self.type == "knn_vector":
             out["dims"] = self.dims
             out["similarity"] = self.similarity
+            if self.method:
+                out["method"] = self.method
         if not self.index:
             out["index"] = False
         if self.fields:
@@ -196,6 +201,7 @@ class MapperService:
             store=conf.get("store", False),
             dims=int(conf.get("dims", conf.get("dimension", 0))),
             similarity=conf.get("similarity", conf.get("space_type", "l2_norm")),
+            method=conf.get("method") if isinstance(conf.get("method"), dict) else None,
             format=conf.get("format", "strict_date_optional_time||epoch_millis"),
         )
         if ftype == "dense_vector" and mapper.dims <= 0:

@@ -121,6 +121,7 @@ class HostVectorField:
     present: np.ndarray              # bool [n_docs]
     dims: int
     similarity: str
+    method: dict | None = None       # ANN method config from the mapper
 
 
 @dataclass
@@ -233,7 +234,9 @@ class SegmentBuilder:
                 if nf is not None:
                     seg.numeric_fields[fname] = nf
             elif mapper.type == "dense_vector":
-                vf = self._build_vector(fname, n, mapper.dims, mapper.similarity)
+                vf = self._build_vector(
+                    fname, n, mapper.dims, mapper.similarity, mapper.method
+                )
                 if vf is not None:
                     seg.vector_fields[fname] = vf
             else:  # float family
@@ -350,7 +353,8 @@ class SegmentBuilder:
         )
 
     def _build_vector(
-        self, fname: str, n: int, dims: int, similarity: str
+        self, fname: str, n: int, dims: int, similarity: str,
+        method: dict | None = None,
     ) -> HostVectorField | None:
         present = np.zeros(n, dtype=bool)
         mat = np.zeros((n, dims), dtype=np.float32)
@@ -364,7 +368,10 @@ class SegmentBuilder:
             mat[d] = np.asarray(pf.vector, dtype=np.float32)
         if not any_field:
             return None
-        return HostVectorField(vectors=mat, present=present, dims=dims, similarity=similarity)
+        return HostVectorField(
+            vectors=mat, present=present, dims=dims, similarity=similarity,
+            method=method,
+        )
 
 
 # --------------------------------------------------------------------------
@@ -419,7 +426,9 @@ def save_segment(seg: HostSegment, directory: Path) -> None:
         key = f"vec:{fname}"
         arrays[f"{key}:vectors"] = vf.vectors
         arrays[f"{key}:present"] = vf.present
-        meta["vector_fields"][fname] = {"dims": vf.dims, "similarity": vf.similarity}
+        meta["vector_fields"][fname] = {
+            "dims": vf.dims, "similarity": vf.similarity, "method": vf.method,
+        }
     np.savez_compressed(directory / f"{seg.name}.npz", **arrays)
     (directory / f"{seg.name}.json").write_text(json.dumps(meta))
     with open(directory / f"{seg.name}.sources", "wb") as f:
@@ -491,5 +500,6 @@ def load_segment(directory: Path, name: str) -> HostSegment:
             present=arrays[f"{key}:present"],
             dims=m["dims"],
             similarity=m["similarity"],
+            method=m.get("method"),
         )
     return seg

@@ -1,0 +1,404 @@
+"""IVF-PQ approximate nearest neighbor: TPU-native build + search.
+
+The ANN slot the reference reserves for the k-NN plugin's FAISS engines
+(SURVEY.md §0: EnginePlugin / the separate opensearch-project/k-NN repo's
+IVF-PQ path; BASELINE configs #2/#3). Everything heavy runs on device:
+
+- k-means (Lloyd's) as a jitted fori_loop — assignment is a [n, k] matmul
+  (MXU), centroid update is segment_sum (VPU). Training uses a host-chosen
+  subsample; full-corpus encode streams in fixed chunks via lax.map so the
+  [chunk, nlist] distance matrix stays HBM-friendly at 1M+ docs.
+- PQ codebooks are trained per subspace on coarse residuals with a single
+  vmapped k-means (all m subspaces in one program).
+- The built index is a padded, static-shape layout: codes [nlist, L_pad, m]
+  uint8 + ids/mask — the TPU analog of FAISS's inverted lists.
+- Search is one fused program per (k, nprobe) shape: coarse top-nprobe,
+  per-probe LUT build ([B, nprobe, m, ks] einsum), ADC gather-accumulate,
+  candidate top-R, then an exact fp32 rescore pass over gathered full
+  vectors (the FusionANNS-style rerank SURVEY.md §7 calls for) ending in
+  jax.lax.top_k. Scores land in the k-NN plugin's score space so ANN and
+  exact hits merge comparably.
+
+Only l2 and cosine are served by ANN (cosine = l2 on unit-normalized
+vectors); inner-product falls back to the exact scan upstream.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opensearch_tpu.ops import knn as knn_ops
+
+DEFAULT_NLIST = 128
+DEFAULT_M = 8
+DEFAULT_KS = 256
+DEFAULT_NPROBE = 8
+# below this many docs a flat scan beats list overhead; stay exact
+MIN_TRAIN_DOCS = 512
+
+
+# --------------------------------------------------------------------------
+# k-means (device)
+# --------------------------------------------------------------------------
+
+
+def _assign(data: jnp.ndarray, centroids: jnp.ndarray) -> jnp.ndarray:
+    """[n] int32 nearest-centroid ids (l2). One matmul on the MXU."""
+    dots = jnp.einsum(
+        "nd,kd->nk", data, centroids, preferred_element_type=jnp.float32
+    )
+    c_sq = jnp.sum(centroids * centroids, axis=-1)
+    # ||x-c||^2 = ||x||^2 - 2 x.c + ||c||^2; ||x||^2 constant per row
+    return jnp.argmin(c_sq[None, :] - 2.0 * dots, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "iters"))
+def kmeans(data: jnp.ndarray, init: jnp.ndarray, *, k: int, iters: int = 10):
+    """Lloyd's iterations; returns centroids [k, d].
+
+    Empty clusters keep their previous centroid (no re-seeding inside jit —
+    callers seed with distinct points, which keeps collapse rare).
+    """
+
+    def step(_, centroids):
+        assign = _assign(data, centroids)
+        sums = jax.ops.segment_sum(data, assign, num_segments=k)
+        counts = jax.ops.segment_sum(
+            jnp.ones(data.shape[0], jnp.float32), assign, num_segments=k
+        )
+        fresh = sums / jnp.maximum(counts[:, None], 1.0)
+        return jnp.where(counts[:, None] > 0, fresh, centroids)
+
+    return jax.lax.fori_loop(0, iters, step, init)
+
+
+def _seed_points(rng: np.random.Generator, n: int, k: int) -> np.ndarray:
+    return rng.choice(n, size=k, replace=n < k)
+
+
+# --------------------------------------------------------------------------
+# training + encoding
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class IVFPQParams:
+    coarse: jnp.ndarray      # [nlist, d] f32
+    codebooks: jnp.ndarray   # [m, ks, dsub] f32 (trained on residuals)
+    nlist: int
+    m: int
+    ks: int
+    d: int
+
+    @property
+    def dsub(self) -> int:
+        return self.d // self.m
+
+
+@functools.partial(jax.jit, static_argnames=("ks", "iters"))
+def _train_pq(residuals_sub: jnp.ndarray, init: jnp.ndarray, *, ks: int, iters: int):
+    """vmapped k-means over the m subspaces: [m, n, dsub] -> [m, ks, dsub]."""
+    return jax.vmap(lambda data, ini: kmeans(data, ini, k=ks, iters=iters))(
+        residuals_sub, init
+    )
+
+
+def train(
+    vectors: np.ndarray,
+    *,
+    nlist: int = DEFAULT_NLIST,
+    m: int = DEFAULT_M,
+    ks: int = DEFAULT_KS,
+    iters: int = 10,
+    train_sample: int = 65_536,
+    seed: int = 0,
+) -> IVFPQParams:
+    """Train coarse + PQ codebooks on a subsample (device compute)."""
+    n, d = vectors.shape
+    if d % m != 0:
+        raise ValueError(f"dims [{d}] not divisible by pq m [{m}]")
+    ks = min(ks, 256)
+    rng = np.random.default_rng(seed)
+    sample_idx = (
+        rng.choice(n, size=train_sample, replace=False) if n > train_sample
+        else np.arange(n)
+    )
+    sample = jnp.asarray(vectors[sample_idx], jnp.float32)
+
+    coarse_init = jnp.asarray(
+        vectors[_seed_points(rng, n, nlist)], jnp.float32
+    )
+    coarse = kmeans(sample, coarse_init, k=nlist, iters=iters)
+
+    assign = _assign(sample, coarse)
+    residuals = sample - coarse[assign]
+    dsub = d // m
+    res_sub = jnp.transpose(
+        residuals.reshape(sample.shape[0], m, dsub), (1, 0, 2)
+    )  # [m, n_s, dsub]
+    pq_seed = _seed_points(rng, int(sample.shape[0]), ks)
+    pq_init = res_sub[:, pq_seed, :]  # [m, ks, dsub]
+    codebooks = _train_pq(res_sub, pq_init, ks=ks, iters=iters)
+    return IVFPQParams(
+        coarse=coarse, codebooks=codebooks, nlist=nlist, m=m, ks=ks, d=d
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("m",))
+def _encode_chunk(chunk: jnp.ndarray, coarse: jnp.ndarray, codebooks: jnp.ndarray, *, m: int):
+    """(list_ids [c], codes [c, m] uint8) for one chunk of vectors."""
+    lists = _assign(chunk, coarse)
+    residuals = chunk - coarse[lists]
+    dsub = chunk.shape[1] // m
+    res_sub = jnp.transpose(residuals.reshape(-1, m, dsub), (1, 0, 2))
+    codes = jax.vmap(_assign)(res_sub, codebooks)        # [m, c]
+    return lists, jnp.transpose(codes).astype(jnp.uint8)  # [c, m]
+
+
+def encode(vectors: np.ndarray, params: IVFPQParams, *, chunk: int = 65_536):
+    """Stream-encode the full corpus: (list_ids [n], codes [n, m]) on host."""
+    n = vectors.shape[0]
+    lists_out = np.empty(n, np.int32)
+    codes_out = np.empty((n, params.m), np.uint8)
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        l, c = _encode_chunk(
+            jnp.asarray(vectors[lo:hi], jnp.float32),
+            params.coarse,
+            params.codebooks,
+            m=params.m,
+        )
+        lists_out[lo:hi] = np.asarray(l)
+        codes_out[lo:hi] = np.asarray(c)
+    return lists_out, codes_out
+
+
+# --------------------------------------------------------------------------
+# index layout (padded inverted lists)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class IVFPQIndex:
+    params: IVFPQParams
+    codes: jnp.ndarray     # uint8 [nlist, L_pad, m]
+    ids: jnp.ndarray       # int32 [nlist, L_pad]  (-1 = padding)
+    mask: jnp.ndarray      # bool  [nlist, L_pad]
+    l_pad: int
+    n: int
+    normalized: bool       # True when built for cosine (unit vectors)
+
+
+def build(
+    vectors: np.ndarray,
+    doc_ids: np.ndarray | None = None,
+    *,
+    nlist: int = DEFAULT_NLIST,
+    m: int = DEFAULT_M,
+    ks: int = DEFAULT_KS,
+    nprobe_default: int = DEFAULT_NPROBE,  # noqa: ARG001 (recorded by caller)
+    iters: int = 10,
+    normalized: bool = False,
+    seed: int = 0,
+    device=None,
+) -> IVFPQIndex:
+    """Train + encode + pack padded lists, publish arrays to `device`."""
+    n, d = vectors.shape
+    vecs = vectors.astype(np.float32, copy=False)
+    if normalized:
+        norms = np.linalg.norm(vecs, axis=1, keepdims=True)
+        vecs = vecs / np.maximum(norms, 1e-12)
+    nlist = max(1, min(nlist, n // 4 if n >= 8 else 1))
+    params = train(vecs, nlist=nlist, m=m, ks=ks, iters=iters, seed=seed)
+    lists, codes = encode(vecs, params)
+    if doc_ids is None:
+        doc_ids = np.arange(n, dtype=np.int32)
+
+    counts = np.bincount(lists, minlength=nlist)
+    l_pad = max(8, int(counts.max()))
+    l_pad = 1 << (l_pad - 1).bit_length()  # next pow2 for shape bucketing
+
+    packed_codes = np.zeros((nlist, l_pad, params.m), np.uint8)
+    packed_ids = np.full((nlist, l_pad), -1, np.int32)
+    packed_mask = np.zeros((nlist, l_pad), bool)
+    order = np.argsort(lists, kind="stable")
+    offs = np.zeros(nlist + 1, np.int64)
+    np.cumsum(counts, out=offs[1:])
+    for li in range(nlist):
+        rows = order[offs[li]: offs[li + 1]]
+        packed_codes[li, : len(rows)] = codes[rows]
+        packed_ids[li, : len(rows)] = doc_ids[rows]
+        packed_mask[li, : len(rows)] = True
+
+    put = lambda a: jax.device_put(jnp.asarray(a), device)
+    return IVFPQIndex(
+        params=IVFPQParams(
+            coarse=put(np.asarray(params.coarse)),
+            codebooks=put(np.asarray(params.codebooks)),
+            nlist=nlist, m=params.m, ks=params.ks, d=d,
+        ),
+        codes=put(packed_codes),
+        ids=put(packed_ids),
+        mask=put(packed_mask),
+        l_pad=l_pad,
+        n=n,
+        normalized=normalized,
+    )
+
+
+# --------------------------------------------------------------------------
+# search
+# --------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "nprobe", "rerank", "similarity", "chunk"),
+)
+def search(
+    coarse: jnp.ndarray,       # [nlist, d]
+    codebooks: jnp.ndarray,    # [m, ks, dsub]
+    codes: jnp.ndarray,        # uint8 [nlist, L_pad, m]
+    ids: jnp.ndarray,          # int32 [nlist, L_pad]
+    mask: jnp.ndarray,         # bool [nlist, L_pad]
+    vectors: jnp.ndarray,      # f32 [n_pad, d] full-precision (rescore)
+    norms_sq: jnp.ndarray,     # f32 [n_pad]
+    valid: jnp.ndarray,        # bool [n_pad] live & present
+    queries: jnp.ndarray,      # f32 [B, d]
+    *,
+    k: int,
+    nprobe: int,
+    rerank: int,
+    similarity: str = "l2_norm",
+    chunk: int = 8,
+):
+    """Fused IVF-PQ ADC search + exact fp32 rescore.
+
+    Returns (scores [B, k] in k-NN score space, doc_ids [B, k], -1 pads).
+    lax.map over query chunks bounds the [chunk, nprobe, L_pad, m] ADC
+    working set regardless of request batch size.
+    """
+    nlist, l_pad, m = codes.shape
+    ks = codebooks.shape[1]
+    d = coarse.shape[1]
+    dsub = d // m
+    similarity = knn_ops.canonical_similarity(similarity)
+    nprobe = min(nprobe, nlist)
+    # at most nprobe * l_pad candidates exist; clamp both cut points so
+    # top_k never asks for more than the axis holds (k > candidates pads)
+    k_eff = min(k, nprobe * l_pad)
+    rerank = max(k_eff, min(rerank, nprobe * l_pad))
+    B = queries.shape[0]
+
+    c_sq = jnp.sum(coarse * coarse, axis=-1)
+    cb_sq = jnp.sum(codebooks * codebooks, axis=-1)  # [m, ks]
+
+    def one_chunk(q):  # q: [chunk, d]
+        qdots = jnp.einsum(
+            "bd,ld->bl", q, coarse, preferred_element_type=jnp.float32
+        )
+        # negative l2^2 up to the constant ||q||^2
+        _, probe = jax.lax.top_k(2.0 * qdots - c_sq[None, :], nprobe)  # [c, P]
+
+        resid = q[:, None, :] - coarse[probe]                 # [c, P, d]
+        r_sub = resid.reshape(q.shape[0], nprobe, m, dsub)
+        r_dot = jnp.einsum(
+            "bpms,mks->bpmk", r_sub, codebooks,
+            preferred_element_type=jnp.float32,
+        )
+        r_sq = jnp.sum(r_sub * r_sub, axis=-1)                # [c, P, m]
+        lut = r_sq[..., None] - 2.0 * r_dot + cb_sq[None, None]  # [c,P,m,ks]
+
+        pcodes = codes[probe].astype(jnp.int32)               # [c, P, L, m]
+        pids = ids[probe]                                     # [c, P, L]
+        pmask = mask[probe]
+        # ADC: sum_m lut[c,p,m,code]
+        gathered = jnp.take_along_axis(
+            lut[:, :, None, :, :],                            # [c,P,1,m,ks]
+            pcodes[..., None],                                # [c,P,L,m,1]
+            axis=-1,
+        )[..., 0]                                             # [c,P,L,m]
+        adc = jnp.sum(gathered, axis=-1)                      # [c,P,L] ~ d^2
+        adc = jnp.where(pmask, adc, jnp.inf)
+
+        flat_adc = adc.reshape(q.shape[0], nprobe * l_pad)
+        flat_ids = pids.reshape(q.shape[0], nprobe * l_pad)
+        _, cand_pos = jax.lax.top_k(-flat_adc, rerank)
+        cand = jnp.take_along_axis(flat_ids, cand_pos, axis=1)  # [c, R]
+        cand_safe = jnp.maximum(cand, 0)
+
+        # exact fp32 rescore over the candidates
+        cvecs = vectors[cand_safe]                            # [c, R, d]
+        cdots = jnp.einsum(
+            "bd,brd->br", q, cvecs, preferred_element_type=jnp.float32
+        )
+        if similarity == knn_ops.COSINE:
+            q_norm = jnp.sqrt(jnp.sum(q * q, axis=-1, keepdims=True))
+            v_norm = jnp.sqrt(jnp.maximum(norms_sq[cand_safe], 1e-24))
+            raw = cdots / jnp.maximum(q_norm * v_norm, 1e-12)
+            score = (1.0 + raw) / 2.0
+        else:
+            q_sq = jnp.sum(q * q, axis=-1, keepdims=True)
+            d_sq = jnp.maximum(q_sq - 2.0 * cdots + norms_sq[cand_safe], 0.0)
+            score = 1.0 / (1.0 + d_sq)
+        ok = (cand >= 0) & valid[cand_safe]
+        score = jnp.where(ok, score, -jnp.inf)
+        best, best_pos = jax.lax.top_k(score, k_eff)
+        best_ids = jnp.take_along_axis(cand, best_pos, axis=1)
+        best_ids = jnp.where(jnp.isfinite(best), best_ids, -1)
+        if k_eff < k:  # fewer candidates than asked for: pad to [*, k]
+            pad = ((0, 0), (0, k - k_eff))
+            best = jnp.pad(best, pad, constant_values=-jnp.inf)
+            best_ids = jnp.pad(best_ids, pad, constant_values=-1)
+        return best, best_ids
+
+    b_pad = -(-B // chunk) * chunk
+    qp = jnp.pad(queries, ((0, b_pad - B), (0, 0)))
+    vals, out_ids = jax.lax.map(
+        one_chunk, qp.reshape(b_pad // chunk, chunk, d)
+    )
+    return (
+        vals.reshape(b_pad, k)[:B],
+        out_ids.reshape(b_pad, k)[:B],
+    )
+
+
+def search_index(
+    index: IVFPQIndex,
+    vectors: jnp.ndarray,
+    norms_sq: jnp.ndarray,
+    valid: jnp.ndarray,
+    queries: jnp.ndarray,
+    *,
+    k: int,
+    nprobe: int | None = None,
+    rerank: int | None = None,
+    similarity: str = "l2_norm",
+):
+    """Convenience wrapper binding an IVFPQIndex's arrays to `search`."""
+    nprobe = nprobe or DEFAULT_NPROBE
+    rerank = rerank or max(4 * k, 64)
+    similarity = knn_ops.canonical_similarity(similarity)
+    if index.normalized:
+        q_norm = jnp.linalg.norm(queries, axis=-1, keepdims=True)
+        queries = queries / jnp.maximum(q_norm, 1e-12)
+    return search(
+        index.params.coarse,
+        index.params.codebooks,
+        index.codes,
+        index.ids,
+        index.mask,
+        vectors,
+        norms_sq,
+        valid,
+        queries,
+        k=k,
+        nprobe=min(nprobe, index.params.nlist),
+        rerank=rerank,
+        similarity=similarity,
+    )

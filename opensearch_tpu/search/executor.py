@@ -119,9 +119,37 @@ class ShardContext:
                 ex = SegmentExecutor(self, host, dev)
                 valid = valid & ex.execute(node.filter).mask
             qv = jnp.asarray([node.vector], jnp.float32)
-            scores = np.asarray(
-                knn_ops.exact_knn_scores(qv, vf.vectors, vf.norms_sq, valid, vf.similarity)[0]
-            )
+            if vf.ann is not None and node.filter is None:
+                # ANN path: IVF-PQ ADC + exact rescore gives candidate-only
+                # scores; non-candidates stay -inf (they can never win)
+                from opensearch_tpu.ops import ivfpq
+
+                nprobe = int(
+                    (node.method_parameters or {}).get(
+                        "nprobe", vf.nprobe_default
+                    )
+                )
+                # bucket k to the next power of two: k/rerank are static jit
+                # args, so raw k values would compile a fresh program per
+                # distinct request k (the query-shape cache concern,
+                # SURVEY.md §7 hard part #3). Extra candidates are harmless —
+                # the shard-level cut below still takes exactly node.k.
+                k_req = max(1, min(node.k, host.n_docs))
+                k_bucket = 1 << (k_req - 1).bit_length()
+                a_vals, a_ids = ivfpq.search_index(
+                    vf.ann, vf.vectors, vf.norms_sq, valid, qv,
+                    k=k_bucket,
+                    nprobe=nprobe,
+                    similarity=vf.similarity,
+                )
+                a_vals, a_ids = np.asarray(a_vals[0]), np.asarray(a_ids[0])
+                scores = np.full(dev.n_pad, -np.inf, np.float32)
+                hit = a_ids >= 0
+                scores[a_ids[hit]] = a_vals[hit]
+            else:
+                scores = np.asarray(
+                    knn_ops.exact_knn_scores(qv, vf.vectors, vf.norms_sq, valid, vf.similarity)[0]
+                )
             per_seg_scores.append(scores)
             n_take = min(node.k, host.n_docs)
             top = np.argpartition(-scores[: host.n_docs], min(n_take, host.n_docs - 1))[:n_take]

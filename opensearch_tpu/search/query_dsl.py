@@ -107,6 +107,8 @@ class KnnQuery(QueryNode):
     vector: list[float] = dc_field(default_factory=list)
     k: int = 10
     filter: QueryNode | None = None
+    # per-query ANN knobs ({"nprobe": N}, k-NN plugin method_parameters)
+    method_parameters: dict | None = None
 
 
 @dataclass
@@ -417,6 +419,10 @@ def _parse_knn(body: dict) -> QueryNode:
         vector=[float(x) for x in conf["vector"]],
         k=int(conf.get("k", 10)),
         filter=parse_query(filt) if filt else None,
+        method_parameters=(
+            conf["method_parameters"]
+            if isinstance(conf.get("method_parameters"), dict) else None
+        ),
         boost=float(conf.get("boost", 1.0)),
     )
 
