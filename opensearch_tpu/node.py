@@ -86,6 +86,11 @@ class TpuNode:
         from opensearch_tpu.snapshots import SnapshotsService
 
         self.snapshots = SnapshotsService(self)
+        from opensearch_tpu.search.pipeline import SearchPipelineService
+
+        self.search_pipelines = SearchPipelineService(
+            self.data_path / "search_pipelines.json"
+        )
 
     # -- index lifecycle ---------------------------------------------------
 
@@ -448,8 +453,12 @@ class TpuNode:
         return {"_shards": {"total": count, "successful": count, "failed": 0}}
 
     def search(self, index: str | None = None, body: dict | None = None,
-               scroll: str | None = None) -> dict:
+               scroll: str | None = None,
+               search_pipeline: str | None = None) -> dict:
         body = dict(body or {})
+        # body key is always consumed; an explicit param takes precedence
+        body_pipeline = body.pop("search_pipeline", None)
+        pipeline_id = search_pipeline or body_pipeline
         pit = body.pop("pit", None)
         if pit is not None:
             if scroll is not None:
@@ -465,8 +474,10 @@ class TpuNode:
                 ctx["expires_at"] = _now_ms() + parse_time_value_millis(
                     pit["keep_alive"], "keep_alive", positive=True
                 )
-            resp = search_service.search(
-                ctx["shards"], body, acquired=ctx["snapshots"]
+            pit_names = sorted({s.shard_id.index for s in ctx["shards"]})
+            resp = self._search_with_pipeline(
+                pipeline_id, pit_names, ctx["shards"], body,
+                acquired=ctx["snapshots"],
             )
             resp["pit_id"] = ctx["id"]
             return resp
@@ -485,9 +496,56 @@ class TpuNode:
                 raise IllegalArgumentException(
                     "[size] must be positive in a scroll context"
                 )
-            return self._start_scroll(shards, body, scroll)
+            return self._start_scroll(shards, body, scroll,
+                                      pipeline_id=pipeline_id, names=names)
         # per-hit _index comes from each shard's ShardId inside the service
-        return search_service.search(shards, body)
+        return self._search_with_pipeline(pipeline_id, names, shards, body)
+
+    def _search_with_pipeline(
+        self,
+        pipeline_id: str | None,
+        index_names: list[str],
+        shards: list,
+        body: dict,
+        acquired: list | None = None,
+    ) -> dict:
+        """search_service.search wrapped in the pipeline pre/post steps."""
+        pl, pr_config = self._resolve_search_pipeline(pipeline_id, index_names)
+        pl_ctx = {}
+        if pl is not None:
+            body = self.search_pipelines.transform_request(pl, body)
+            if "_original_size" in body:
+                pl_ctx["_original_size"] = body.pop("_original_size")
+        resp = search_service.search(
+            shards, body, acquired=acquired, phase_results_config=pr_config
+        )
+        if pl is not None:
+            resp = self.search_pipelines.transform_response(
+                pl, {**body, **pl_ctx}, resp
+            )
+        return resp
+
+    def _resolve_search_pipeline(
+        self, pipeline_id: str | None, index_names: list[str]
+    ) -> tuple[dict | None, dict | None]:
+        """Explicit search_pipeline param > index.search.default_pipeline.
+        Returns (pipeline, phase_results_config)."""
+        if pipeline_id == "_none":
+            return None, None
+        if pipeline_id is None:
+            for name in index_names:
+                svc = self.indices.get(name)
+                default = (
+                    (svc.settings.get("search") or {}).get("default_pipeline")
+                    if svc else None
+                )
+                if default and default != "_none":
+                    pipeline_id = default
+                    break
+        if pipeline_id is None:
+            return None, None
+        pl = self.search_pipelines.get(pipeline_id)
+        return pl, self.search_pipelines.phase_results_config(pl)
 
     # -- reader contexts: scroll + point-in-time (ReaderContext registry) --
 
@@ -504,7 +562,9 @@ class TpuNode:
             raise SearchContextMissingException(cid)
         return ctx
 
-    def _start_scroll(self, shards: list, body: dict, scroll: str) -> dict:
+    def _start_scroll(self, shards: list, body: dict, scroll: str,
+                      pipeline_id: str | None = None,
+                      names: list[str] | None = None) -> dict:
         self._reap_expired_contexts()
         keep_ms = parse_time_value_millis(scroll, "scroll", positive=True)
         cid = f"scroll_{uuid.uuid4().hex}"
@@ -515,8 +575,11 @@ class TpuNode:
             "snapshots": snapshots, "body": body, "seen": size,
             "size": size, "keep_alive_ms": keep_ms,
             "expires_at": _now_ms() + keep_ms,
+            "pipeline_id": pipeline_id, "names": names or [],
         }
-        resp = search_service.search(shards, body, acquired=snapshots)
+        resp = self._search_with_pipeline(
+            pipeline_id, names or [], shards, body, acquired=snapshots
+        )
         self._reader_contexts[cid] = ctx
         resp["_scroll_id"] = cid
         return resp
@@ -534,8 +597,9 @@ class TpuNode:
                      if k not in ("aggs", "aggregations")}
         page_body["from"] = ctx["seen"]
         page_body["size"] = ctx["size"]
-        resp = search_service.search(
-            ctx["shards"], page_body, acquired=ctx["snapshots"]
+        resp = self._search_with_pipeline(
+            ctx.get("pipeline_id"), ctx.get("names", []), ctx["shards"],
+            page_body, acquired=ctx["snapshots"],
         )
         ctx["seen"] += len(resp["hits"]["hits"])
         resp["_scroll_id"] = scroll_id

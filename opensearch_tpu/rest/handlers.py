@@ -80,6 +80,11 @@ def build_router() -> Router:
     reg("GET", "/_ingest/pipeline/{id}/_simulate", simulate_pipeline)
     reg("POST", "/_ingest/pipeline/_simulate", simulate_inline)
     reg("GET", "/_ingest/pipeline/_simulate", simulate_inline)
+    # search pipelines
+    reg("PUT", "/_search/pipeline/{id}", put_search_pipeline)
+    reg("GET", "/_search/pipeline", get_search_pipelines)
+    reg("GET", "/_search/pipeline/{id}", get_search_pipeline)
+    reg("DELETE", "/_search/pipeline/{id}", delete_search_pipeline)
     # snapshots / repositories
     reg("PUT", "/_snapshot/{repo}", put_repository)
     reg("POST", "/_snapshot/{repo}", put_repository)
@@ -350,14 +355,34 @@ def _body_with_query_params(query, body):
 
 def search(node: TpuNode, params, query, body):
     return 200, node.search(params["index"], _body_with_query_params(query, body),
-                            scroll=query.get("scroll"))
+                            scroll=query.get("scroll"),
+                            search_pipeline=query.get("search_pipeline"))
 
 
 def search_all(node: TpuNode, params, query, body):
     # index=None (not "_all"): a PIT body carries its own shard set and is
     # only legal without an index in the path
     return 200, node.search(None, _body_with_query_params(query, body),
-                            scroll=query.get("scroll"))
+                            scroll=query.get("scroll"),
+                            search_pipeline=query.get("search_pipeline"))
+
+
+def put_search_pipeline(node: TpuNode, params, query, body):
+    node.search_pipelines.put(params["id"], body or {})
+    return 200, {"acknowledged": True}
+
+
+def get_search_pipelines(node: TpuNode, params, query, body):
+    return 200, dict(node.search_pipelines.pipelines)
+
+
+def get_search_pipeline(node: TpuNode, params, query, body):
+    return 200, {params["id"]: node.search_pipelines.get(params["id"])}
+
+
+def delete_search_pipeline(node: TpuNode, params, query, body):
+    node.search_pipelines.delete(params["id"])
+    return 200, {"acknowledged": True}
 
 
 def scroll(node: TpuNode, params, query, body):

@@ -590,11 +590,15 @@ def _parse_nested(body: dict) -> QueryNode:
     )
 
 
-def _parse_hybrid(body: dict) -> QueryNode:
-    return HybridQuery(
-        queries=[parse_query(q) for q in body.get("queries", [])],
-        boost=float(body.get("boost", 1.0)),
-    )
+def _parse_hybrid(conf: dict) -> QueryNode:
+    if not isinstance(conf, dict) or not isinstance(conf.get("queries"), list):
+        raise ParsingException("[hybrid] requires a [queries] array")
+    queries = [parse_query(q) for q in conf["queries"]]
+    if not queries:
+        raise ParsingException("[hybrid] requires at least one sub-query")
+    if len(queries) > 5:
+        raise ParsingException("[hybrid] supports at most 5 sub-queries")
+    return HybridQuery(queries=queries, boost=float(conf.get("boost", 1.0)))
 
 
 _VECTOR_FUNCS = ("cosineSimilarity", "dotProduct", "l2Squared", "knn_score")

@@ -41,6 +41,7 @@ def search(
     shards: list[IndexShard],
     body: dict | None,
     acquired: list | None = None,
+    phase_results_config: dict | None = None,
 ) -> dict[str, Any]:
     """Run one search over `shards`. `acquired` optionally pins the searcher
     snapshots to use, one per shard in order — the scroll/PIT path
@@ -76,26 +77,67 @@ def search(
     track_total = body.get("track_total_hits", True)
 
     fetch_k = from_ + size
-    per_shard_results = []
-    for shard_i, shard in enumerate(shards):
-        snapshot = acquired[shard_i] if acquired is not None else shard.acquire_searcher()
-        per_shard_results.append(
-            (
-                shard,
-                snapshot,
+    if isinstance(node, query_dsl.HybridQuery):
+        # hybrid query phase: one pass per sub-query, then the phase-results
+        # processor fuses scores GLOBALLY across shards before fetch (the
+        # SearchPhaseResultsProcessor slot, search/pipeline/)
+        if sort:
+            raise ParsingException("[sort] is not supported with [hybrid] query")
+        if search_after is not None:
+            raise ParsingException(
+                "[search_after] is not supported with [hybrid] query"
+            )
+        from opensearch_tpu.search import pipeline as pipeline_mod
+
+        shard_snaps = []
+        per_shard_subs = []
+        for shard_i, shard in enumerate(shards):
+            snapshot = (
+                acquired[shard_i] if acquired is not None
+                else shard.acquire_searcher()
+            )
+            per_shard_subs.append([
                 execute_query_phase(
                     snapshot,
                     shard.mapper_service,
-                    node,
-                    # search_after cursors can reach arbitrarily deep into a
-                    # shard; fall back to all matching docs per shard
-                    size=snapshot.max_doc if search_after is not None else fetch_k,
-                    sort=sort,
+                    sub,
+                    size=fetch_k,
                     need_masks=aggs_body is not None,
-                    min_score=float(min_score) if min_score is not None else None,
-                ),
-            )
+                    min_score=(
+                        float(min_score) if min_score is not None else None
+                    ),
+                )
+                for sub in node.queries
+            ])
+            shard_snaps.append((shard, snapshot))
+        fused = pipeline_mod.fuse_hybrid_results(
+            per_shard_subs, phase_results_config, fetch_k
         )
+        per_shard_results = [
+            (shard, snap, res)
+            for (shard, snap), res in zip(shard_snaps, fused)
+        ]
+    else:
+        per_shard_results = []
+        for shard_i, shard in enumerate(shards):
+            snapshot = acquired[shard_i] if acquired is not None else shard.acquire_searcher()
+            per_shard_results.append(
+                (
+                    shard,
+                    snapshot,
+                    execute_query_phase(
+                        snapshot,
+                        shard.mapper_service,
+                        node,
+                        # search_after cursors can reach arbitrarily deep into a
+                        # shard; fall back to all matching docs per shard
+                        size=snapshot.max_doc if search_after is not None else fetch_k,
+                        sort=sort,
+                        need_masks=aggs_body is not None,
+                        min_score=float(min_score) if min_score is not None else None,
+                    ),
+                )
+            )
 
     # ---- reduce phase (SearchPhaseController analog) ----
     merged = []

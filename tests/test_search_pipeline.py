@@ -1,0 +1,270 @@
+"""Search pipelines + hybrid query fusion.
+
+Reference surface: search/pipeline/SearchPipelineService.java +
+modules/search-pipeline-common (SURVEY.md §2.2 "Search pipelines"); the
+normalization processor mirrors the neural-search plugin's hybrid scoring
+contract (BASELINE config #4 hybrid BM25+kNN).
+"""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ParsingException,
+    ResourceNotFoundException,
+)
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.search.pipeline import _combine, _normalize
+
+
+@pytest.fixture()
+def node(tmp_path):
+    return TpuNode(tmp_path / "node")
+
+
+def _hybrid_corpus(node, index="hyb", shards=1):
+    node.create_index(index, {
+        "settings": {"index": {"number_of_shards": shards}},
+        "mappings": {"properties": {
+            "title": {"type": "text"},
+            "vec": {"type": "dense_vector", "dims": 4, "similarity": "l2_norm"},
+        }},
+    })
+    docs = [
+        ("1", "red apple pie", [1.0, 0.0, 0.0, 0.0]),
+        ("2", "green apple tart", [0.9, 0.1, 0.0, 0.0]),
+        ("3", "red fire truck", [0.0, 1.0, 0.0, 0.0]),
+        ("4", "blue ocean water", [0.0, 0.0, 1.0, 0.0]),
+        ("5", "apple orchard visit", [0.8, 0.2, 0.1, 0.0]),
+    ]
+    for _id, title, vec in docs:
+        node.index_doc(index, _id, {"title": title, "vec": vec})
+    node.refresh(index)
+    return index
+
+
+class TestNormalizeCombine:
+    def test_min_max(self):
+        out = _normalize([1.0, 3.0, 5.0], [1.0, 3.0, 5.0], "min_max")
+        assert out[2] == 1.0 and out[1] == pytest.approx(0.5)
+        assert out[0] == pytest.approx(0.001)  # floor, not 0
+
+    def test_min_max_degenerate(self):
+        assert _normalize([2.0], [2.0], "min_max") == [1.0]
+
+    def test_l2(self):
+        out = _normalize([3.0, 4.0], [3.0, 4.0], "l2")
+        assert out == [pytest.approx(0.6), pytest.approx(0.8)]
+
+    def test_arithmetic_mean_missing_counts_as_zero(self):
+        assert _combine([0.8, None], "arithmetic_mean", []) == pytest.approx(0.4)
+
+    def test_weights(self):
+        assert _combine([1.0, 0.5], "arithmetic_mean", [3.0, 1.0]) == (
+            pytest.approx((3.0 + 0.5) / 4.0)
+        )
+
+    def test_harmonic_skips_missing(self):
+        assert _combine([0.5, None], "harmonic_mean", []) == pytest.approx(0.5)
+
+    def test_geometric(self):
+        assert _combine([0.25, 1.0], "geometric_mean", []) == pytest.approx(0.5)
+
+
+class TestPipelineCrud:
+    def test_put_get_delete(self, node):
+        node.search_pipelines.put("p1", {
+            "request_processors": [{"filter_query": {"query": {"match_all": {}}}}],
+        })
+        assert "request_processors" in node.search_pipelines.get("p1")
+        node.search_pipelines.delete("p1")
+        with pytest.raises(ResourceNotFoundException):
+            node.search_pipelines.get("p1")
+
+    def test_unknown_processor_rejected(self, node):
+        with pytest.raises(IllegalArgumentException):
+            node.search_pipelines.put("bad", {
+                "request_processors": [{"nope": {}}],
+            })
+
+    def test_persistence(self, tmp_path):
+        n1 = TpuNode(tmp_path / "n")
+        n1.search_pipelines.put("keep", {"response_processors": [
+            {"truncate_hits": {"target_size": 1}}]})
+        n2 = TpuNode(tmp_path / "n")
+        assert "keep" in n2.search_pipelines.pipelines
+
+
+class TestRequestResponseProcessors:
+    def test_filter_query(self, node):
+        _hybrid_corpus(node)
+        node.search_pipelines.put("only_red", {
+            "request_processors": [{"filter_query": {
+                "query": {"match": {"title": "red"}}}}],
+        })
+        res = node.search("hyb", {"query": {"match_all": {}}},
+                          search_pipeline="only_red")
+        ids = {h["_id"] for h in res["hits"]["hits"]}
+        assert ids == {"1", "3"}
+
+    def test_oversample_truncate(self, node):
+        _hybrid_corpus(node)
+        node.search_pipelines.put("os", {
+            "request_processors": [{"oversample": {"sample_factor": 2.0}}],
+            "response_processors": [{"truncate_hits": {}}],
+        })
+        res = node.search("hyb", {"size": 2, "query": {"match_all": {}}},
+                          search_pipeline="os")
+        assert len(res["hits"]["hits"]) == 2  # truncated back to original
+
+    def test_rename_field(self, node):
+        _hybrid_corpus(node)
+        node.search_pipelines.put("rn", {
+            "response_processors": [{"rename_field": {
+                "field": "title", "target_field": "name"}}],
+        })
+        res = node.search("hyb", {"query": {"ids": {"values": ["1"]}}},
+                          search_pipeline="rn")
+        src = res["hits"]["hits"][0]["_source"]
+        assert "name" in src and "title" not in src
+
+
+class TestHybridQuery:
+    def test_hybrid_default_fusion(self, node):
+        _hybrid_corpus(node)
+        res = node.search("hyb", {
+            "query": {"hybrid": {"queries": [
+                {"match": {"title": "apple"}},
+                {"knn": {"vec": {"vector": [1.0, 0.0, 0.0, 0.0], "k": 3}}},
+            ]}},
+        })
+        hits = res["hits"]["hits"]
+        assert hits
+        # doc 1 matches both sub-queries strongly -> must rank first
+        assert hits[0]["_id"] == "1"
+        # scores are normalized-combined: within (0, 1]
+        assert 0.0 < hits[0]["_score"] <= 1.0
+
+    def test_hybrid_with_normalization_pipeline(self, node):
+        _hybrid_corpus(node)
+        node.search_pipelines.put("norm", {
+            "phase_results_processors": [{"normalization-processor": {
+                "normalization": {"technique": "l2"},
+                "combination": {"technique": "arithmetic_mean",
+                                "parameters": {"weights": [0.3, 0.7]}},
+            }}],
+        })
+        res = node.search("hyb", {
+            "query": {"hybrid": {"queries": [
+                {"match": {"title": "apple"}},
+                {"knn": {"vec": {"vector": [1.0, 0.0, 0.0, 0.0], "k": 3}}},
+            ]}},
+        }, search_pipeline="norm")
+        assert res["hits"]["hits"][0]["_id"] == "1"
+
+    def test_hybrid_rrf(self, node):
+        _hybrid_corpus(node)
+        node.search_pipelines.put("rrf", {
+            "phase_results_processors": [{"score-ranker-processor": {
+                "combination": {"technique": "rrf", "rank_constant": 60},
+            }}],
+        })
+        res = node.search("hyb", {
+            "query": {"hybrid": {"queries": [
+                {"match": {"title": "apple"}},
+                {"knn": {"vec": {"vector": [1.0, 0.0, 0.0, 0.0], "k": 3}}},
+            ]}},
+        }, search_pipeline="rrf")
+        hits = res["hits"]["hits"]
+        assert hits[0]["_id"] == "1"
+        # RRF score for rank-1 in both lists: 2/61
+        assert hits[0]["_score"] == pytest.approx(2.0 / 61.0, rel=1e-3)
+
+    def test_hybrid_multi_shard(self, node):
+        _hybrid_corpus(node, index="hyb2", shards=3)
+        res = node.search("hyb2", {
+            "query": {"hybrid": {"queries": [
+                {"match": {"title": "apple"}},
+                {"knn": {"vec": {"vector": [1.0, 0.0, 0.0, 0.0], "k": 5}}},
+            ]}},
+        })
+        assert res["hits"]["hits"][0]["_id"] == "1"
+
+    def test_hybrid_rejects_sort(self, node):
+        _hybrid_corpus(node)
+        with pytest.raises(ParsingException):
+            node.search("hyb", {
+                "sort": [{"_id": "asc"}],
+                "query": {"hybrid": {"queries": [{"match_all": {}}]}},
+            })
+
+    def test_nested_hybrid_falls_back_to_dismax(self, node):
+        # nested hybrid can't reach the phase-results processor; the
+        # executor degrades it to dis_max scoring rather than erroring
+        _hybrid_corpus(node)
+        res = node.search("hyb", {"query": {"bool": {"must": [
+            {"hybrid": {"queries": [{"match": {"title": "apple"}}]}}]}}})
+        assert {h["_id"] for h in res["hits"]["hits"]} == {"1", "2", "5"}
+
+    def test_default_pipeline_setting(self, node):
+        node.search_pipelines.put("dflt", {
+            "response_processors": [{"truncate_hits": {"target_size": 1}}],
+        })
+        node.create_index("auto", {
+            "settings": {"index": {"search": {"default_pipeline": "dflt"}}},
+            "mappings": {"properties": {"t": {"type": "keyword"}}},
+        })
+        for i in range(4):
+            node.index_doc("auto", str(i), {"t": "x"})
+        node.refresh("auto")
+        res = node.search("auto", {"query": {"match_all": {}}})
+        assert len(res["hits"]["hits"]) == 1
+        # explicit _none disables the default
+        res = node.search("auto", {"query": {"match_all": {}}},
+                          search_pipeline="_none")
+        assert len(res["hits"]["hits"]) == 4
+
+    def test_scroll_respects_pipeline(self, node):
+        _hybrid_corpus(node)
+        node.search_pipelines.put("rn2", {
+            "response_processors": [{"rename_field": {
+                "field": "title", "target_field": "name"}}],
+        })
+        res = node.search("hyb", {"size": 2, "query": {"match_all": {}}},
+                          scroll="1m", search_pipeline="rn2")
+        assert all("name" in h["_source"] for h in res["hits"]["hits"])
+        page2 = node.scroll(res["_scroll_id"])
+        assert page2["hits"]["hits"]
+        assert all("name" in h["_source"] for h in page2["hits"]["hits"])
+
+    def test_pipeline_param_overrides_body_key(self, node):
+        _hybrid_corpus(node)
+        node.search_pipelines.put("t1", {
+            "response_processors": [{"truncate_hits": {"target_size": 1}}],
+        })
+        node.search_pipelines.put("t3", {
+            "response_processors": [{"truncate_hits": {"target_size": 3}}],
+        })
+        # both set: the param wins, the body key must not leak into service
+        res = node.search("hyb", {
+            "query": {"match_all": {}}, "search_pipeline": "t3",
+        }, search_pipeline="t1")
+        assert len(res["hits"]["hits"]) == 1
+        # body-only form works too
+        res = node.search("hyb", {
+            "query": {"match_all": {}}, "search_pipeline": "t3",
+        })
+        assert len(res["hits"]["hits"]) == 3
+
+    def test_hybrid_with_aggs(self, node):
+        _hybrid_corpus(node)
+        res = node.search("hyb", {
+            "query": {"hybrid": {"queries": [
+                {"match": {"title": "apple"}},
+                {"match": {"title": "red"}},
+            ]}},
+            "aggs": {"n": {"value_count": {"field": "title"}}},
+        })
+        # union of matches: apple -> {1,2,5}, red -> {1,3} => 4 docs
+        assert res["hits"]["total"]["value"] == 4
