@@ -56,6 +56,15 @@ class IndexNotFoundException(OpenSearchTpuException):
         self.index = index
 
 
+class IndexClosedException(OpenSearchTpuException):
+    status = 400
+    error_type = "index_closed_exception"
+
+    def __init__(self, index: str):
+        super().__init__(f"closed index [{index}]", index=index)
+        self.index = index
+
+
 class ResourceNotFoundException(OpenSearchTpuException):
     status = 404
     error_type = "resource_not_found_exception"

@@ -22,9 +22,11 @@ from typing import Any
 
 from opensearch_tpu.common.errors import (
     IllegalArgumentException,
+    IndexClosedException,
     IndexNotFoundException,
     OpenSearchTpuException,
     ResourceAlreadyExistsException,
+    ResourceNotFoundException,
     SearchContextMissingException,
     VersionConflictException,
 )
@@ -42,6 +44,17 @@ from opensearch_tpu.search import service as search_service
 _VALID_INDEX_NAME = re.compile(r"^[a-z0-9][a-z0-9_\-.]*$")
 
 
+def _deep_merge(base: dict, overlay: dict) -> dict:
+    """Recursive dict merge, overlay wins (template composition order)."""
+    out = dict(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
 class IndexService:
     """Per-index container (index module + its shards)."""
 
@@ -56,6 +69,10 @@ class IndexService:
         self.num_shards = int(settings.get("number_of_shards", 1))
         self.num_replicas = int(settings.get("number_of_replicas", 1))
         self.creation_date = int(time.time() * 1000)
+        # alias name -> config ({"filter":..., "routing":...,
+        # "is_write_index":...}); the per-index slice of AliasMetadata
+        self.aliases: dict[str, dict] = {}
+        self.closed = False
         self.shards: dict[int, IndexShard] = {}
         for s in range(self.num_shards):
             self.shards[s] = IndexShard(
@@ -100,7 +117,12 @@ class TpuNode:
     def _persist_index_registry(self) -> None:
         self.data_path.mkdir(parents=True, exist_ok=True)
         registry = {
-            name: {"settings": svc.settings, "mappings": svc.mapper_service.to_dict()}
+            name: {
+                "settings": svc.settings,
+                "mappings": svc.mapper_service.to_dict(),
+                "aliases": svc.aliases,
+                "closed": svc.closed,
+            }
             for name, svc in self.indices.items()
         }
         self._state_file.write_text(json.dumps(registry))
@@ -110,9 +132,12 @@ class TpuNode:
             return
         registry = json.loads(self._state_file.read_text())
         for name, meta in registry.items():
-            self.indices[name] = IndexService(
+            svc = IndexService(
                 name, self._index_path(name), meta["settings"], meta["mappings"]
             )
+            svc.aliases = meta.get("aliases", {})
+            svc.closed = meta.get("closed", False)
+            self.indices[name] = svc
 
     def create_index(self, name: str, body: dict | None = None) -> dict:
         if not _VALID_INDEX_NAME.match(name) or name.startswith(("_", "-")):
@@ -121,6 +146,14 @@ class TpuNode:
             raise ResourceAlreadyExistsException(f"index [{name}] already exists")
         body = body or {}
         settings = body.get("settings") or {}
+        mappings = body.get("mappings")
+        aliases = dict(body.get("aliases") or {})
+        # composable index templates: template layers under the request body
+        tmpl = self._template_for_index(name)
+        if tmpl is not None:
+            settings = _deep_merge(tmpl["settings"], settings)
+            mappings = _deep_merge(tmpl["mappings"], mappings or {}) or None
+            aliases = {**tmpl["aliases"], **aliases}
         # accept both flat ("index.number_of_shards") and nested forms
         flat = Settings.from_nested(settings).as_dict()
         norm = {}
@@ -128,9 +161,16 @@ class TpuNode:
             norm[k[len("index."):] if k.startswith("index.") else k] = v
         # analysis config must stay nested
         nested = Settings.from_flat(norm).as_nested()
-        self.indices[name] = IndexService(
-            name, self._index_path(name), nested, body.get("mappings")
+        svc = IndexService(
+            name, self._index_path(name), nested, mappings
         )
+        for alias, conf in aliases.items():
+            if alias in self.indices:
+                raise IllegalArgumentException(
+                    f"alias [{alias}] clashes with an index name"
+                )
+            svc.aliases[alias] = dict(conf or {})
+        self.indices[name] = svc
         self._persist_index_registry()
         return {"acknowledged": True, "shards_acknowledged": True, "index": name}
 
@@ -168,22 +208,501 @@ class TpuNode:
         return self.indices[name]
 
     def resolve_indices(self, expr: str) -> list[str]:
-        """Index name/pattern resolution (comma lists, wildcards, _all)."""
+        """Index name/pattern/alias resolution (comma lists, wildcards,
+        _all). Wildcards match concrete index names AND alias names, like
+        the reference's IndexNameExpressionResolver; aliases expand to
+        their member indices."""
+        alias_map = self._alias_map()
         if expr in ("_all", "*", ""):
             return sorted(self.indices)
         names: list[str] = []
         import fnmatch
 
+        candidates = sorted(set(self.indices) | set(alias_map))
         for part in expr.split(","):
             part = part.strip()
             if "*" in part or "?" in part:
-                names.extend(n for n in sorted(self.indices) if fnmatch.fnmatch(n, part))
+                for n in candidates:
+                    if fnmatch.fnmatch(n, part):
+                        names.extend(alias_map.get(n, [n]))
+            elif part in alias_map:
+                names.extend(alias_map[part])
             else:
                 if part not in self.indices:
                     raise IndexNotFoundException(part)
                 names.append(part)
         seen = set()
         return [n for n in names if not (n in seen or seen.add(n))]
+
+    # -- aliases (cluster/metadata/AliasMetadata + TransportIndicesAliasesAction
+    # analog) ---------------------------------------------------------------
+
+    def _alias_map(self) -> dict[str, list[str]]:
+        """alias name -> sorted member index names."""
+        out: dict[str, list[str]] = {}
+        for name, svc in self.indices.items():
+            for alias in svc.aliases:
+                out.setdefault(alias, []).append(name)
+        return {a: sorted(ns) for a, ns in out.items()}
+
+    def update_aliases(self, body: dict) -> dict:
+        actions = (body or {}).get("actions")
+        if not isinstance(actions, list) or not actions:
+            raise IllegalArgumentException("[aliases] requires [actions]")
+        # validate + stage first: the reference applies the action list
+        # atomically in one cluster-state update
+        staged: list[tuple[str, str, str, dict | None]] = []
+        for action in actions:
+            if not isinstance(action, dict) or len(action) != 1:
+                raise IllegalArgumentException(
+                    "each alias action must be a single-key object"
+                )
+            kind, conf = next(iter(action.items()))
+            if kind not in ("add", "remove", "remove_index"):
+                raise IllegalArgumentException(f"unknown alias action [{kind}]")
+            if not isinstance(conf, dict):
+                raise IllegalArgumentException(
+                    f"[aliases] action [{kind}] requires an object body"
+                )
+            indices = conf.get("indices") or (
+                [conf["index"]] if conf.get("index") else []
+            )
+            aliases = conf.get("aliases") or (
+                [conf["alias"]] if conf.get("alias") else []
+            )
+            resolved: list[str] = []
+            for iexpr in indices:
+                resolved.extend(self.resolve_indices(iexpr))
+            if not resolved:
+                raise IllegalArgumentException(
+                    f"[aliases] action [{kind}] requires an index"
+                )
+            if kind == "remove_index":
+                staged.extend((kind, name, "", None) for name in resolved)
+                continue
+            if not aliases:
+                raise IllegalArgumentException(
+                    f"[aliases] action [{kind}] requires an alias"
+                )
+            for name in resolved:
+                for alias in aliases:
+                    if kind == "add" and alias in self.indices:
+                        raise IllegalArgumentException(
+                            f"alias [{alias}] clashes with an index name"
+                        )
+                    staged.append((kind, name, alias, conf))
+        # alias mutations first, index deletions last: a remove_index in
+        # the middle of the list must not invalidate later staged actions
+        to_delete = [n for k, n, _, _ in staged if k == "remove_index"]
+        for kind, name, alias, conf in staged:
+            if kind == "remove_index":
+                continue
+            svc = self._get_index(name)
+            if kind == "add":
+                entry: dict = {}
+                for key in ("filter", "routing", "index_routing",
+                            "search_routing", "is_write_index"):
+                    if conf.get(key) is not None:
+                        entry[key] = conf[key]
+                svc.aliases[alias] = entry
+            elif alias in svc.aliases:
+                del svc.aliases[alias]
+        for name in to_delete:
+            if name in self.indices:
+                self.delete_index(name)
+        self._persist_index_registry()
+        return {"acknowledged": True}
+
+    def put_alias(self, index_expr: str, alias: str, body: dict | None = None) -> dict:
+        conf = dict(body or {})
+        conf["alias"] = alias
+        conf["indices"] = self.resolve_indices(index_expr)
+        return self.update_aliases({"actions": [{"add": conf}]})
+
+    def delete_alias(self, index_expr: str, alias_expr: str) -> dict:
+        import fnmatch
+
+        names = self.resolve_indices(index_expr)
+        removed = False
+        for name in names:
+            svc = self._get_index(name)
+            for alias in list(svc.aliases):
+                if alias_expr in ("_all", "*") or fnmatch.fnmatch(alias, alias_expr):
+                    del svc.aliases[alias]
+                    removed = True
+        if not removed:
+            raise ResourceNotFoundException(
+                f"aliases [{alias_expr}] missing on indices {names}"
+            )
+        self._persist_index_registry()
+        return {"acknowledged": True}
+
+    def get_alias(self, index_expr: str | None = None,
+                  alias_expr: str | None = None) -> dict:
+        import fnmatch
+
+        names = (
+            self.resolve_indices(index_expr) if index_expr else sorted(self.indices)
+        )
+        out: dict[str, dict] = {}
+        for name in names:
+            svc = self._get_index(name)
+            matched = {
+                a: c for a, c in svc.aliases.items()
+                if alias_expr is None or alias_expr in ("_all", "*")
+                or fnmatch.fnmatch(a, alias_expr)
+            }
+            if matched or alias_expr is None:
+                out[name] = {"aliases": matched}
+        return out
+
+    def resolve_write_target(self, name: str) -> str:
+        """Alias -> its write index (TransportBulkAction's write-alias
+        resolution); concrete names pass through (may autocreate later)."""
+        targets = self._alias_targets(name)
+        if not targets:
+            return name
+        if len(targets) == 1:
+            return targets[0][0]
+        writes = [n for n, c in targets if c.get("is_write_index")]
+        if len(writes) != 1:
+            raise IllegalArgumentException(
+                f"no write index is defined for alias [{name}]: the alias "
+                f"points to multiple indices without an explicit write index"
+            )
+        return writes[0]
+
+    def _resolve_write_alias(
+        self, index: str, routing: str | None
+    ) -> tuple[str, str | None]:
+        """(concrete index, effective routing) for a write/read-by-id op:
+        alias write-index resolution + alias-level routing defaulting."""
+        concrete = self.resolve_write_target(index)
+        if concrete != index and routing is None:
+            conf = self.indices[concrete].aliases.get(index) or {}
+            routing = conf.get("index_routing", conf.get("routing"))
+        if concrete in self.indices and self.indices[concrete].closed:
+            raise IndexClosedException(concrete)
+        return concrete, routing
+
+    def _alias_targets(self, alias: str) -> list[tuple[str, dict]]:
+        return [
+            (name, svc.aliases[alias])
+            for name, svc in sorted(self.indices.items())
+            if alias in svc.aliases
+        ]
+
+    def resolve_search_shards(self, expr: str) -> tuple[list, list]:
+        """(shards, per-shard alias filter bodies, index names) for a
+        search expression.
+        Filtered aliases contribute their filter to exactly their member
+        shards (the per-shard aliasFilter of ShardSearchRequest); closed
+        indices are skipped by wildcards but rejected by explicit names."""
+        alias_map = self._alias_map()
+        import fnmatch
+
+        per_index_filters: dict[str, list] = {}
+        names: list[str] = []
+
+        def add_index(name: str, filt: dict | None, explicit: bool) -> None:
+            svc = self._get_index(name)
+            if svc.closed:
+                if explicit:
+                    raise IndexClosedException(name)
+                return
+            if name not in per_index_filters:
+                names.append(name)
+                per_index_filters[name] = []
+            if filt is not None:
+                per_index_filters[name].append(filt)
+            else:
+                # unfiltered route to this index: filters don't restrict
+                per_index_filters[name] = [None]
+
+        def add_alias(alias: str, explicit: bool) -> None:
+            for name, conf in self._alias_targets(alias):
+                add_index(name, conf.get("filter"), explicit=False)
+                if self._get_index(name).closed and explicit:
+                    raise IndexClosedException(name)
+
+        if expr in ("_all", "*", ""):
+            for name in sorted(self.indices):
+                add_index(name, None, explicit=False)
+        else:
+            candidates = sorted(set(self.indices) | set(alias_map))
+            for part in expr.split(","):
+                part = part.strip()
+                if "*" in part or "?" in part:
+                    for n in candidates:
+                        if fnmatch.fnmatch(n, part):
+                            if n in alias_map:
+                                add_alias(n, explicit=False)
+                            else:
+                                add_index(n, None, explicit=False)
+                elif part in alias_map:
+                    add_alias(part, explicit=True)
+                elif part in self.indices:
+                    add_index(part, None, explicit=True)
+                else:
+                    raise IndexNotFoundException(part)
+
+        shards: list = []
+        filters: list = []
+        for name in names:
+            flist = per_index_filters[name]
+            if None in flist or not flist:
+                filt = None
+            elif len(flist) == 1:
+                filt = flist[0]
+            else:
+                filt = {"bool": {"should": flist, "minimum_should_match": 1}}
+            for shard in self._get_index(name).shards.values():
+                shards.append(shard)
+                filters.append(filt)
+        return shards, filters, names
+
+    # -- index templates (MetadataIndexTemplateService analog: composable
+    # V2 templates + component templates) ----------------------------------
+
+    def _templates_file(self) -> Path:
+        return self.data_path / "templates.json"
+
+    def _load_templates(self) -> dict:
+        if self._templates_file().exists():
+            return json.loads(self._templates_file().read_text())
+        return {"index_templates": {}, "component_templates": {}}
+
+    def _save_templates(self, data: dict) -> None:
+        self.data_path.mkdir(parents=True, exist_ok=True)
+        self._templates_file().write_text(json.dumps(data))
+
+    def put_index_template(self, name: str, body: dict) -> dict:
+        body = body or {}
+        patterns = body.get("index_patterns")
+        if not isinstance(patterns, list) or not patterns:
+            raise IllegalArgumentException(
+                "index template requires [index_patterns]"
+            )
+        data = self._load_templates()
+        for comp in body.get("composed_of") or []:
+            if comp not in data["component_templates"]:
+                raise IllegalArgumentException(
+                    f"component template [{comp}] not found"
+                )
+        data["index_templates"][name] = body
+        self._save_templates(data)
+        return {"acknowledged": True}
+
+    def get_index_template(self, name: str | None = None) -> dict:
+        data = self._load_templates()
+        if name is None:
+            items = data["index_templates"]
+        else:
+            import fnmatch
+
+            items = {
+                n: t for n, t in data["index_templates"].items()
+                if fnmatch.fnmatch(n, name)
+            }
+            if not items and "*" not in name:
+                raise ResourceNotFoundException(
+                    f"index template matching [{name}] not found"
+                )
+        return {"index_templates": [
+            {"name": n, "index_template": t} for n, t in sorted(items.items())
+        ]}
+
+    def delete_index_template(self, name: str) -> dict:
+        data = self._load_templates()
+        if name not in data["index_templates"]:
+            raise ResourceNotFoundException(
+                f"index template matching [{name}] not found"
+            )
+        del data["index_templates"][name]
+        self._save_templates(data)
+        return {"acknowledged": True}
+
+    def put_component_template(self, name: str, body: dict) -> dict:
+        if not isinstance((body or {}).get("template"), dict):
+            raise IllegalArgumentException(
+                "component template requires [template]"
+            )
+        data = self._load_templates()
+        data["component_templates"][name] = body
+        self._save_templates(data)
+        return {"acknowledged": True}
+
+    def get_component_template(self, name: str | None = None) -> dict:
+        data = self._load_templates()
+        items = data["component_templates"]
+        if name is not None:
+            if name not in items:
+                raise ResourceNotFoundException(
+                    f"component template matching [{name}] not found"
+                )
+            items = {name: items[name]}
+        return {"component_templates": [
+            {"name": n, "component_template": t} for n, t in sorted(items.items())
+        ]}
+
+    def delete_component_template(self, name: str) -> dict:
+        data = self._load_templates()
+        if name not in data["component_templates"]:
+            raise ResourceNotFoundException(
+                f"component template matching [{name}] not found"
+            )
+        del data["component_templates"][name]
+        self._save_templates(data)
+        return {"acknowledged": True}
+
+    def _template_for_index(self, name: str) -> dict | None:
+        """Composed {settings, mappings, aliases} of the highest-priority
+        matching template (components first, template's own last)."""
+        import fnmatch
+
+        data = self._load_templates()
+        best = None
+        best_prio = -1
+        for tmpl in data["index_templates"].values():
+            if any(fnmatch.fnmatch(name, p) for p in tmpl["index_patterns"]):
+                prio = int(tmpl.get("priority", 0))
+                if prio > best_prio:
+                    best, best_prio = tmpl, prio
+        if best is None:
+            return None
+        merged: dict = {"settings": {}, "mappings": {}, "aliases": {}}
+        layers = [
+            data["component_templates"].get(c, {}).get("template", {})
+            for c in best.get("composed_of") or []
+        ]
+        layers.append(best.get("template") or {})
+        for layer in layers:
+            merged["settings"] = _deep_merge(
+                merged["settings"], layer.get("settings") or {}
+            )
+            merged["mappings"] = _deep_merge(
+                merged["mappings"], layer.get("mappings") or {}
+            )
+            merged["aliases"].update(layer.get("aliases") or {})
+        return merged
+
+    # -- rollover / open / close (MetadataRolloverService,
+    # TransportCloseIndexAction analogs) -----------------------------------
+
+    def rollover(self, alias: str, body: dict | None = None) -> dict:
+        body = body or {}
+        old_index = self.resolve_write_target(alias)
+        if old_index == alias:
+            raise IllegalArgumentException(
+                f"rollover target [{alias}] is not an alias"
+            )
+        new_index = body.get("new_index")
+        if not new_index:
+            m = re.match(r"^(.*?)-?(\d+)$", old_index)
+            if not m:
+                raise IllegalArgumentException(
+                    f"index name [{old_index}] does not end with a number; "
+                    "specify [new_index] explicitly"
+                )
+            new_index = f"{m.group(1)}-{int(m.group(2)) + 1:06d}"
+        conditions = body.get("conditions") or {}
+        svc = self._get_index(old_index)
+        doc_count = sum(s.num_docs for s in svc.shards.values())
+        age_ms = int(time.time() * 1000) - svc.creation_date
+        met: dict[str, bool] = {}
+        if "max_docs" in conditions:
+            met[f"[max_docs: {conditions['max_docs']}]"] = (
+                doc_count >= int(conditions["max_docs"])
+            )
+        if "max_age" in conditions:
+            max_age_ms = parse_time_value_millis(
+                conditions["max_age"], "max_age"
+            )
+            met[f"[max_age: {conditions['max_age']}]"] = age_ms >= max_age_ms
+        rolled = (not conditions) or any(met.values())
+        dry_run = bool(body.get("dry_run"))
+        if rolled and not dry_run:
+            create_body = {k: v for k, v in body.items()
+                           if k in ("settings", "mappings", "aliases")}
+            self.create_index(new_index, create_body)
+            old_svc = self._get_index(old_index)
+            alias_conf = dict(old_svc.aliases.get(alias) or {})
+            if alias_conf.get("is_write_index"):
+                # explicit write alias: stays on the old index for reads,
+                # write flag moves (MetadataRolloverService semantics)
+                old_svc.aliases[alias] = {**alias_conf, "is_write_index": False}
+            else:
+                del old_svc.aliases[alias]
+            self._get_index(new_index).aliases[alias] = {
+                **alias_conf, "is_write_index": True,
+            }
+            self._persist_index_registry()
+        return {
+            "acknowledged": rolled and not dry_run,
+            "shards_acknowledged": rolled and not dry_run,
+            "old_index": old_index,
+            "new_index": new_index,
+            "rolled_over": rolled and not dry_run,
+            "dry_run": dry_run,
+            "conditions": met,
+        }
+
+    def close_index(self, expr: str) -> dict:
+        for name in self.resolve_indices(expr):
+            self._get_index(name).closed = True
+        self._persist_index_registry()
+        return {"acknowledged": True, "shards_acknowledged": True}
+
+    def open_index(self, expr: str) -> dict:
+        for name in self.resolve_indices(expr):
+            self._get_index(name).closed = False
+        self._persist_index_registry()
+        return {"acknowledged": True, "shards_acknowledged": True}
+
+    def _get_open_index(self, name: str) -> IndexService:
+        svc = self._get_index(name)
+        if svc.closed:
+            raise IndexClosedException(name)
+        return svc
+
+    # -- analyze API (TransportAnalyzeAction analog) -----------------------
+
+    def analyze(self, index: str | None, body: dict) -> dict:
+        body = body or {}
+        text = body.get("text")
+        if text is None:
+            raise IllegalArgumentException("[_analyze] requires [text]")
+        texts = text if isinstance(text, list) else [text]
+        if index is not None:
+            svc = self._get_index(index)
+            registry = svc.mapper_service.analysis
+            field = body.get("field")
+            if field and not body.get("analyzer"):
+                mapper = svc.mapper_service.field_mapper(field)
+                analyzer_name = (
+                    mapper.analyzer if mapper is not None
+                    and mapper.type == "text" else "keyword"
+                )
+            else:
+                analyzer_name = body.get("analyzer", "standard")
+        else:
+            registry = AnalysisRegistry.from_index_settings(None)
+            analyzer_name = body.get("analyzer", "standard")
+        analyzer = registry.get(analyzer_name)
+        tokens = []
+        pos = 0
+        for t in texts:
+            for term in analyzer.analyze(str(t)):
+                tokens.append({
+                    "token": term,
+                    "start_offset": 0,
+                    "end_offset": 0,
+                    "type": "<ALPHANUM>",
+                    "position": pos,
+                })
+                pos += 1
+            pos += 100  # position gap between texts array entries
+        return {"tokens": tokens}
 
     def put_mapping(self, index: str, body: dict) -> dict:
         for name in self.resolve_indices(index):
@@ -228,6 +747,7 @@ class TpuNode:
         op_type: str = "index",
         pipeline: str | None = None,
     ) -> dict:
+        index, routing = self._resolve_write_alias(index, routing)
         # ingest pipelines resolve BEFORE any index auto-creation (the
         # reference resolves pipelines first, so a drop or _index reroute
         # never leaves a stray empty index behind): request param >
@@ -296,7 +816,8 @@ class TpuNode:
         }
 
     def get_doc(self, index: str, doc_id: str, routing: str | None = None) -> dict:
-        svc = self._get_index(index)
+        index, routing = self._resolve_write_alias(index, routing)
+        svc = self._get_open_index(index)
         shard = svc.shard_for(doc_id, routing)
         got = shard.get(doc_id)
         if got is None:
@@ -313,7 +834,8 @@ class TpuNode:
 
     def delete_doc(self, index: str, doc_id: str, routing: str | None = None,
                    refresh: bool = False) -> dict:
-        svc = self._get_index(index)
+        index, routing = self._resolve_write_alias(index, routing)
+        svc = self._get_open_index(index)
         shard = svc.shard_for(doc_id, routing)
         result = shard.apply_delete_on_primary(doc_id)
         if refresh:
@@ -332,7 +854,8 @@ class TpuNode:
                    routing: str | None = None, refresh: bool = False) -> dict:
         """Partial update via doc merge or script
         (action/update/UpdateHelper.java: prepareUpdateScriptRequest)."""
-        svc = self._get_index(index)
+        index, routing = self._resolve_write_alias(index, routing)
+        svc = self._get_open_index(index)
         shard = svc.shard_for(doc_id, routing)
         current = shard.get(doc_id)
         if "script" in body:
@@ -412,10 +935,14 @@ class TpuNode:
                     status = 200 if resp["result"] == "deleted" else 404
                 else:
                     raise IllegalArgumentException(f"unknown bulk action [{action}]")
-                svc = self.indices.get(index)
+                landed = resp.get("_index", index)
+                svc = self.indices.get(landed)
                 if svc is not None:
-                    sid = shard_id_for_routing(routing or resp["_id"], svc.num_shards)
-                    touched.add((index, sid))
+                    _, eff_routing = self._resolve_write_alias(index, routing)
+                    sid = shard_id_for_routing(
+                        eff_routing or resp["_id"], svc.num_shards
+                    )
+                    touched.add((landed, sid))
                 items.append({action: {**resp, "status": status}})
             except OpenSearchTpuException as e:
                 errors = True
@@ -478,13 +1005,12 @@ class TpuNode:
             resp = self._search_with_pipeline(
                 pipeline_id, pit_names, ctx["shards"], body,
                 acquired=ctx["snapshots"],
+                shard_filters=ctx.get("shard_filters"),
             )
             resp["pit_id"] = ctx["id"]
             return resp
-        names = self.resolve_indices(index if index is not None else "_all")
-        shards: list = []
-        for name in names:
-            shards.extend(self._get_index(name).shards.values())
+        expr = index if index is not None else "_all"
+        shards, shard_filters, names = self.resolve_search_shards(expr)
         if scroll is not None:
             if int(body.get("from", 0)) > 0:
                 raise IllegalArgumentException("[from] is not supported with scroll")
@@ -497,9 +1023,11 @@ class TpuNode:
                     "[size] must be positive in a scroll context"
                 )
             return self._start_scroll(shards, body, scroll,
-                                      pipeline_id=pipeline_id, names=names)
+                                      pipeline_id=pipeline_id, names=names,
+                                      shard_filters=shard_filters)
         # per-hit _index comes from each shard's ShardId inside the service
-        return self._search_with_pipeline(pipeline_id, names, shards, body)
+        return self._search_with_pipeline(pipeline_id, names, shards, body,
+                                          shard_filters=shard_filters)
 
     def _search_with_pipeline(
         self,
@@ -508,6 +1036,7 @@ class TpuNode:
         shards: list,
         body: dict,
         acquired: list | None = None,
+        shard_filters: list | None = None,
     ) -> dict:
         """search_service.search wrapped in the pipeline pre/post steps."""
         pl, pr_config = self._resolve_search_pipeline(pipeline_id, index_names)
@@ -517,7 +1046,8 @@ class TpuNode:
             if "_original_size" in body:
                 pl_ctx["_original_size"] = body.pop("_original_size")
         resp = search_service.search(
-            shards, body, acquired=acquired, phase_results_config=pr_config
+            shards, body, acquired=acquired, phase_results_config=pr_config,
+            shard_filters=shard_filters,
         )
         if pl is not None:
             resp = self.search_pipelines.transform_response(
@@ -564,7 +1094,8 @@ class TpuNode:
 
     def _start_scroll(self, shards: list, body: dict, scroll: str,
                       pipeline_id: str | None = None,
-                      names: list[str] | None = None) -> dict:
+                      names: list[str] | None = None,
+                      shard_filters: list | None = None) -> dict:
         self._reap_expired_contexts()
         keep_ms = parse_time_value_millis(scroll, "scroll", positive=True)
         cid = f"scroll_{uuid.uuid4().hex}"
@@ -576,9 +1107,11 @@ class TpuNode:
             "size": size, "keep_alive_ms": keep_ms,
             "expires_at": _now_ms() + keep_ms,
             "pipeline_id": pipeline_id, "names": names or [],
+            "shard_filters": shard_filters,
         }
         resp = self._search_with_pipeline(
-            pipeline_id, names or [], shards, body, acquired=snapshots
+            pipeline_id, names or [], shards, body, acquired=snapshots,
+            shard_filters=shard_filters,
         )
         self._reader_contexts[cid] = ctx
         resp["_scroll_id"] = cid
@@ -600,6 +1133,7 @@ class TpuNode:
         resp = self._search_with_pipeline(
             ctx.get("pipeline_id"), ctx.get("names", []), ctx["shards"],
             page_body, acquired=ctx["snapshots"],
+            shard_filters=ctx.get("shard_filters"),
         )
         ctx["seen"] += len(resp["hits"]["hits"])
         resp["_scroll_id"] = scroll_id
@@ -619,14 +1153,12 @@ class TpuNode:
     def open_pit(self, index: str, keep_alive: str) -> dict:
         self._reap_expired_contexts()
         keep_ms = parse_time_value_millis(keep_alive, "keep_alive", positive=True)
-        names = self.resolve_indices(index)
-        shards: list = []
-        for name in names:
-            shards.extend(self._get_index(name).shards.values())
+        shards, shard_filters, _ = self.resolve_search_shards(index)
         cid = f"pit_{uuid.uuid4().hex}"
         self._reader_contexts[cid] = {
             "id": cid, "kind": "pit", "shards": shards,
             "snapshots": [s.acquire_searcher() for s in shards],
+            "shard_filters": shard_filters,
             "keep_alive_ms": keep_ms, "expires_at": _now_ms() + keep_ms,
         }
         return {"pit_id": cid, "_shards": {"total": len(shards),

@@ -80,6 +80,37 @@ def build_router() -> Router:
     reg("GET", "/_ingest/pipeline/{id}/_simulate", simulate_pipeline)
     reg("POST", "/_ingest/pipeline/_simulate", simulate_inline)
     reg("GET", "/_ingest/pipeline/_simulate", simulate_inline)
+    # aliases
+    reg("POST", "/_aliases", update_aliases)
+    reg("PUT", "/{index}/_alias/{name}", put_alias)
+    reg("POST", "/{index}/_alias/{name}", put_alias)
+    reg("PUT", "/{index}/_aliases/{name}", put_alias)
+    reg("DELETE", "/{index}/_alias/{name}", delete_alias)
+    reg("DELETE", "/{index}/_aliases/{name}", delete_alias)
+    reg("GET", "/_alias", get_alias_all)
+    reg("GET", "/_alias/{name}", get_alias_by_name)
+    reg("GET", "/{index}/_alias", get_alias_index)
+    reg("GET", "/{index}/_alias/{name}", get_alias_index_name)
+    # index templates
+    reg("PUT", "/_index_template/{name}", put_index_template)
+    reg("POST", "/_index_template/{name}", put_index_template)
+    reg("GET", "/_index_template", get_index_templates)
+    reg("GET", "/_index_template/{name}", get_index_template)
+    reg("DELETE", "/_index_template/{name}", delete_index_template)
+    reg("PUT", "/_component_template/{name}", put_component_template)
+    reg("POST", "/_component_template/{name}", put_component_template)
+    reg("GET", "/_component_template", get_component_templates)
+    reg("GET", "/_component_template/{name}", get_component_template)
+    reg("DELETE", "/_component_template/{name}", delete_component_template)
+    # rollover / open / close / analyze
+    reg("POST", "/{index}/_rollover", rollover)
+    reg("POST", "/{index}/_rollover/{new_index}", rollover_named)
+    reg("POST", "/{index}/_close", close_index)
+    reg("POST", "/{index}/_open", open_index)
+    reg("GET", "/{index}/_analyze", analyze_index)
+    reg("POST", "/{index}/_analyze", analyze_index)
+    reg("GET", "/_analyze", analyze_global)
+    reg("POST", "/_analyze", analyze_global)
     # search pipelines
     reg("PUT", "/_search/pipeline/{id}", put_search_pipeline)
     reg("GET", "/_search/pipeline", get_search_pipelines)
@@ -365,6 +396,98 @@ def search_all(node: TpuNode, params, query, body):
     return 200, node.search(None, _body_with_query_params(query, body),
                             scroll=query.get("scroll"),
                             search_pipeline=query.get("search_pipeline"))
+
+
+def update_aliases(node: TpuNode, params, query, body):
+    return 200, node.update_aliases(body or {})
+
+
+def put_alias(node: TpuNode, params, query, body):
+    return 200, node.put_alias(params["index"], params["name"], body)
+
+
+def delete_alias(node: TpuNode, params, query, body):
+    return 200, node.delete_alias(params["index"], params["name"])
+
+
+def get_alias_all(node: TpuNode, params, query, body):
+    return 200, node.get_alias()
+
+
+def get_alias_by_name(node: TpuNode, params, query, body):
+    return 200, node.get_alias(alias_expr=params["name"])
+
+
+def get_alias_index(node: TpuNode, params, query, body):
+    return 200, node.get_alias(index_expr=params["index"])
+
+
+def get_alias_index_name(node: TpuNode, params, query, body):
+    return 200, node.get_alias(index_expr=params["index"],
+                               alias_expr=params["name"])
+
+
+def put_index_template(node: TpuNode, params, query, body):
+    return 200, node.put_index_template(params["name"], body or {})
+
+
+def get_index_templates(node: TpuNode, params, query, body):
+    return 200, node.get_index_template()
+
+
+def get_index_template(node: TpuNode, params, query, body):
+    return 200, node.get_index_template(params["name"])
+
+
+def delete_index_template(node: TpuNode, params, query, body):
+    return 200, node.delete_index_template(params["name"])
+
+
+def put_component_template(node: TpuNode, params, query, body):
+    return 200, node.put_component_template(params["name"], body or {})
+
+
+def get_component_templates(node: TpuNode, params, query, body):
+    return 200, node.get_component_template()
+
+
+def get_component_template(node: TpuNode, params, query, body):
+    return 200, node.get_component_template(params["name"])
+
+
+def delete_component_template(node: TpuNode, params, query, body):
+    return 200, node.delete_component_template(params["name"])
+
+
+def rollover(node: TpuNode, params, query, body):
+    body = dict(body or {})
+    if query.get("dry_run") in ("", "true", True):
+        body["dry_run"] = True
+    return 200, node.rollover(params["index"], body)
+
+
+def rollover_named(node: TpuNode, params, query, body):
+    body = dict(body or {})
+    body["new_index"] = params["new_index"]
+    if query.get("dry_run") in ("", "true", True):
+        body["dry_run"] = True
+    return 200, node.rollover(params["index"], body)
+
+
+def close_index(node: TpuNode, params, query, body):
+    return 200, node.close_index(params["index"])
+
+
+def open_index(node: TpuNode, params, query, body):
+    return 200, node.open_index(params["index"])
+
+
+def analyze_index(node: TpuNode, params, query, body):
+    return 200, node.analyze(params["index"], body or {})
+
+
+def analyze_global(node: TpuNode, params, query, body):
+    return 200, node.analyze(None, body or {})
 
 
 def put_search_pipeline(node: TpuNode, params, query, body):

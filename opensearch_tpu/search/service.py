@@ -42,6 +42,7 @@ def search(
     body: dict | None,
     acquired: list | None = None,
     phase_results_config: dict | None = None,
+    shard_filters: list | None = None,
 ) -> dict[str, Any]:
     """Run one search over `shards`. `acquired` optionally pins the searcher
     snapshots to use, one per shard in order — the scroll/PIT path
@@ -76,6 +77,24 @@ def search(
         )
     track_total = body.get("track_total_hits", True)
 
+    # per-shard alias filters (the aliasFilter of ShardSearchRequest):
+    # parse each distinct filter body once, AND it into that shard's query
+    filter_nodes: list = [None] * len(shards)
+    if shard_filters:
+        parsed_cache: dict[int, Any] = {}
+        for i, f in enumerate(shard_filters[: len(shards)]):
+            if f is not None:
+                key = id(f)
+                if key not in parsed_cache:
+                    parsed_cache[key] = query_dsl.parse_query(f)
+                filter_nodes[i] = parsed_cache[key]
+
+    def _shard_node(base: Any, shard_i: int) -> Any:
+        f = filter_nodes[shard_i]
+        if f is None:
+            return base
+        return query_dsl.BoolQuery(must=[base], filter=[f])
+
     fetch_k = from_ + size
     if isinstance(node, query_dsl.HybridQuery):
         # hybrid query phase: one pass per sub-query, then the phase-results
@@ -100,7 +119,7 @@ def search(
                 execute_query_phase(
                     snapshot,
                     shard.mapper_service,
-                    sub,
+                    _shard_node(sub, shard_i),
                     size=fetch_k,
                     need_masks=aggs_body is not None,
                     min_score=(
@@ -128,7 +147,7 @@ def search(
                     execute_query_phase(
                         snapshot,
                         shard.mapper_service,
-                        node,
+                        _shard_node(node, shard_i),
                         # search_after cursors can reach arbitrarily deep into a
                         # shard; fall back to all matching docs per shard
                         size=snapshot.max_doc if search_after is not None else fetch_k,

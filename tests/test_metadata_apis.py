@@ -1,0 +1,346 @@
+"""Aliases, index templates, rollover, open/close, _analyze.
+
+Reference surface: cluster/metadata/{AliasMetadata, MetadataIndexTemplate
+Service, MetadataRolloverService}, TransportIndicesAliasesAction,
+TransportCloseIndexAction, TransportAnalyzeAction (SURVEY.md §2.2
+"Cluster state & metadata" / "Action layer" admin/indices domain).
+"""
+
+import pytest
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentException,
+    IndexClosedException,
+    IndexNotFoundException,
+    ResourceNotFoundException,
+)
+from opensearch_tpu.node import TpuNode
+
+
+@pytest.fixture()
+def node(tmp_path):
+    return TpuNode(tmp_path / "node")
+
+
+def _seed(node, name, docs=None, **create_kw):
+    node.create_index(name, {
+        "mappings": {"properties": {
+            "tag": {"type": "keyword"}, "n": {"type": "long"}}},
+        **create_kw,
+    })
+    for i, d in enumerate(docs or []):
+        node.index_doc(name, str(i), d)
+    node.refresh(name)
+
+
+class TestAliases:
+    def test_add_remove_get(self, node):
+        _seed(node, "logs-1", [{"tag": "a", "n": 1}])
+        node.update_aliases({"actions": [
+            {"add": {"index": "logs-1", "alias": "logs"}}]})
+        assert node.get_alias(alias_expr="logs") == {
+            "logs-1": {"aliases": {"logs": {}}}}
+        res = node.search("logs", {"query": {"match_all": {}}})
+        assert res["hits"]["total"]["value"] == 1
+        node.update_aliases({"actions": [
+            {"remove": {"index": "logs-1", "alias": "logs"}}]})
+        with pytest.raises(IndexNotFoundException):
+            node.search("logs", {"query": {"match_all": {}}})
+
+    def test_write_through_alias(self, node):
+        _seed(node, "w-1")
+        node.put_alias("w-1", "w")
+        node.index_doc("w", "x", {"tag": "via-alias", "n": 9})
+        node.refresh("w")
+        got = node.get_doc("w", "x")
+        assert got["found"] and got["_index"] == "w-1"
+
+    def test_write_index_selection(self, node):
+        _seed(node, "r-1")
+        _seed(node, "r-2")
+        node.update_aliases({"actions": [
+            {"add": {"index": "r-1", "alias": "r"}},
+            {"add": {"index": "r-2", "alias": "r", "is_write_index": True}},
+        ]})
+        node.index_doc("r", "d", {"tag": "t", "n": 1})
+        node.refresh("_all")
+        assert node.get_doc("r-2", "d")["found"]
+        # search through the alias hits both members
+        res = node.search("r", {"query": {"match_all": {}}})
+        assert {h["_index"] for h in res["hits"]["hits"]} == {"r-2"}
+
+    def test_multi_target_alias_without_write_index_rejects_writes(self, node):
+        _seed(node, "m-1")
+        _seed(node, "m-2")
+        node.update_aliases({"actions": [
+            {"add": {"indices": ["m-1", "m-2"], "alias": "m"}}]})
+        with pytest.raises(IllegalArgumentException):
+            node.index_doc("m", "d", {"n": 1})
+
+    def test_filtered_alias_search(self, node):
+        _seed(node, "ev", [
+            {"tag": "err", "n": 1}, {"tag": "ok", "n": 2},
+            {"tag": "err", "n": 3},
+        ])
+        node.put_alias("ev", "errors", {"filter": {"term": {"tag": "err"}}})
+        res = node.search("errors", {"query": {"match_all": {}}})
+        assert res["hits"]["total"]["value"] == 2
+        assert all(h["_source"]["tag"] == "err" for h in res["hits"]["hits"])
+        # aggs also see only the filtered subset
+        res = node.search("errors", {
+            "size": 0, "query": {"match_all": {}},
+            "aggs": {"s": {"sum": {"field": "n"}}},
+        })
+        assert res["aggregations"]["s"]["value"] == 4.0
+
+    def test_alias_clash_with_index_name(self, node):
+        _seed(node, "a-1")
+        _seed(node, "a-2")
+        with pytest.raises(IllegalArgumentException):
+            node.put_alias("a-1", "a-2")
+
+    def test_alias_routing_applies(self, node):
+        node.create_index("rt", {
+            "settings": {"index": {"number_of_shards": 4}},
+            "mappings": {"properties": {"n": {"type": "long"}}},
+        })
+        node.put_alias("rt", "rt-a", {"routing": "fixed"})
+        node.index_doc("rt-a", "k", {"n": 1})
+        svc = node.indices["rt"]
+        expected = svc.shard_for("ignored-id", "fixed")
+        assert expected.get("k") is not None
+
+    def test_atomic_swap(self, node):
+        _seed(node, "v1", [{"n": 1}])
+        _seed(node, "v2", [{"n": 2}])
+        node.put_alias("v1", "current")
+        node.update_aliases({"actions": [
+            {"remove": {"index": "v1", "alias": "current"}},
+            {"add": {"index": "v2", "alias": "current"}},
+        ]})
+        res = node.search("current", {"query": {"match_all": {}}})
+        assert {h["_index"] for h in res["hits"]["hits"]} == {"v2"}
+
+    def test_persistence(self, tmp_path):
+        n1 = TpuNode(tmp_path / "n")
+        n1.create_index("p-1", {})
+        n1.put_alias("p-1", "p")
+        n2 = TpuNode(tmp_path / "n")
+        assert n2.get_alias(alias_expr="p")["p-1"]["aliases"] == {"p": {}}
+
+
+class TestAliasRegressions:
+    def test_bulk_refresh_through_alias(self, node):
+        _seed(node, "br-1")
+        node.put_alias("br-1", "br")
+        resp = node.bulk(
+            [("index", {"_index": "br", "_id": "1"}, {"n": 1})], refresh=True
+        )
+        assert not resp["errors"]
+        res = node.search("br", {"query": {"match_all": {}}})
+        assert res["hits"]["total"]["value"] == 1
+
+    def test_pit_respects_alias_filter(self, node):
+        _seed(node, "pf", [{"tag": "x", "n": 1}, {"tag": "y", "n": 2}])
+        node.put_alias("pf", "pf-x", {"filter": {"term": {"tag": "x"}}})
+        pit = node.open_pit("pf-x", "1m")
+        res = node.search(None, {
+            "pit": {"id": pit["pit_id"]},
+            "query": {"match_all": {}},
+        })
+        assert res["hits"]["total"]["value"] == 1
+        assert res["hits"]["hits"][0]["_source"]["tag"] == "x"
+
+    def test_remove_index_applies_last(self, node):
+        _seed(node, "ra")
+        _seed(node, "rb")
+        node.update_aliases({"actions": [
+            {"add": {"index": "ra", "alias": "x"}},
+            {"remove_index": {"index": "rb"}},
+            {"add": {"index": "rb", "alias": "y"}},
+        ]})
+        assert "rb" not in node.indices
+        assert node.get_alias(alias_expr="x")["ra"]["aliases"] == {"x": {}}
+
+    def test_malformed_action_body_rejected(self, node):
+        with pytest.raises(IllegalArgumentException):
+            node.update_aliases({"actions": [{"add": None}]})
+
+    def test_rollover_max_age(self, node):
+        _seed(node, "age-000001", [{"n": 1}])
+        node.put_alias("age-000001", "age")
+        # index was created milliseconds ago: 0ms threshold met, 1d not
+        res = node.rollover("age", {"conditions": {"max_age": "0s"},
+                                    "dry_run": True})
+        assert any(res["conditions"].values())
+        res = node.rollover("age", {"conditions": {"max_age": "1d"},
+                                    "dry_run": True})
+        assert not any(res["conditions"].values())
+
+
+class TestTemplates:
+    def test_template_applies_on_create(self, node):
+        node.put_index_template("logs", {
+            "index_patterns": ["logs-*"],
+            "template": {
+                "settings": {"index": {"number_of_shards": 2}},
+                "mappings": {"properties": {"level": {"type": "keyword"}}},
+                "aliases": {"all-logs": {}},
+            },
+        })
+        node.create_index("logs-app", {})
+        svc = node.indices["logs-app"]
+        assert svc.num_shards == 2
+        assert svc.mapper_service.field_mapper("level").type == "keyword"
+        assert "all-logs" in svc.aliases
+        # non-matching name unaffected
+        node.create_index("metrics-app", {})
+        assert node.indices["metrics-app"].num_shards == 1
+
+    def test_priority_and_body_override(self, node):
+        node.put_index_template("low", {
+            "index_patterns": ["x-*"], "priority": 1,
+            "template": {"settings": {"index": {"number_of_shards": 2}}},
+        })
+        node.put_index_template("high", {
+            "index_patterns": ["x-*"], "priority": 10,
+            "template": {"settings": {"index": {"number_of_shards": 3}}},
+        })
+        node.create_index("x-1", {})
+        assert node.indices["x-1"].num_shards == 3
+        node.create_index("x-2", {
+            "settings": {"index": {"number_of_shards": 5}}})
+        assert node.indices["x-2"].num_shards == 5
+
+    def test_component_composition(self, node):
+        node.put_component_template("base-map", {
+            "template": {"mappings": {"properties": {
+                "host": {"type": "keyword"}}}},
+        })
+        node.put_index_template("svc", {
+            "index_patterns": ["svc-*"],
+            "composed_of": ["base-map"],
+            "template": {"mappings": {"properties": {
+                "msg": {"type": "text"}}}},
+        })
+        node.create_index("svc-a", {})
+        ms = node.indices["svc-a"].mapper_service
+        assert ms.field_mapper("host").type == "keyword"
+        assert ms.field_mapper("msg").type == "text"
+
+    def test_missing_component_rejected(self, node):
+        with pytest.raises(IllegalArgumentException):
+            node.put_index_template("bad", {
+                "index_patterns": ["b-*"], "composed_of": ["nope"],
+            })
+
+    def test_crud(self, node):
+        node.put_index_template("t", {"index_patterns": ["t-*"]})
+        assert node.get_index_template("t")["index_templates"][0]["name"] == "t"
+        node.delete_index_template("t")
+        with pytest.raises(ResourceNotFoundException):
+            node.delete_index_template("t")
+
+    def test_auto_create_applies_template(self, node):
+        node.put_index_template("auto", {
+            "index_patterns": ["auto-*"],
+            "template": {"mappings": {"properties": {
+                "k": {"type": "keyword"}}}},
+        })
+        node.index_doc("auto-x", "1", {"k": "v"})
+        assert node.indices["auto-x"].mapper_service.field_mapper("k").type == "keyword"
+
+
+class TestRollover:
+    def test_rollover_unconditional(self, node):
+        _seed(node, "roll-000001", [{"n": 1}])
+        node.put_alias("roll-000001", "roll", {"is_write_index": True})
+        res = node.rollover("roll")
+        assert res["rolled_over"] and res["new_index"] == "roll-000002"
+        # write alias moved
+        node.index_doc("roll", "new", {"n": 2})
+        node.refresh("_all")
+        assert node.get_doc("roll-000002", "new")["found"]
+        # search alias covers both
+        out = node.search("roll", {"query": {"match_all": {}}})
+        assert out["hits"]["total"]["value"] == 2
+
+    def test_conditions_not_met(self, node):
+        _seed(node, "c-000001", [{"n": 1}])
+        node.put_alias("c-000001", "c")
+        res = node.rollover("c", {"conditions": {"max_docs": 100}})
+        assert not res["rolled_over"]
+        assert "c-000002" not in node.indices
+
+    def test_conditions_met(self, node):
+        _seed(node, "d-000001", [{"n": i} for i in range(5)])
+        node.put_alias("d-000001", "d")
+        res = node.rollover("d", {"conditions": {"max_docs": 3}})
+        assert res["rolled_over"]
+
+    def test_dry_run(self, node):
+        _seed(node, "e-000001", [{"n": 1}])
+        node.put_alias("e-000001", "e")
+        res = node.rollover("e", {"dry_run": True})
+        assert res["dry_run"] and not res["rolled_over"]
+        assert "e-000002" not in node.indices
+
+    def test_non_alias_rejected(self, node):
+        _seed(node, "plain-1")
+        with pytest.raises(IllegalArgumentException):
+            node.rollover("plain-1")
+
+
+class TestOpenClose:
+    def test_closed_index_rejects_ops(self, node):
+        _seed(node, "cl", [{"n": 1}])
+        node.close_index("cl")
+        with pytest.raises(IndexClosedException):
+            node.search("cl", {"query": {"match_all": {}}})
+        with pytest.raises(IndexClosedException):
+            node.index_doc("cl", "2", {"n": 2})
+        with pytest.raises(IndexClosedException):
+            node.get_doc("cl", "0")
+        node.open_index("cl")
+        assert node.search("cl", {"query": {"match_all": {}}})[
+            "hits"]["total"]["value"] == 1
+
+    def test_wildcard_search_skips_closed(self, node):
+        _seed(node, "sk-1", [{"n": 1}])
+        _seed(node, "sk-2", [{"n": 2}])
+        node.close_index("sk-2")
+        res = node.search("sk-*", {"query": {"match_all": {}}})
+        assert {h["_index"] for h in res["hits"]["hits"]} == {"sk-1"}
+
+    def test_closed_survives_restart(self, tmp_path):
+        n1 = TpuNode(tmp_path / "n")
+        n1.create_index("z", {})
+        n1.close_index("z")
+        n2 = TpuNode(tmp_path / "n")
+        assert n2.indices["z"].closed
+
+
+class TestAnalyze:
+    def test_global_standard(self, node):
+        res = node.analyze(None, {"text": "The QUICK brown-Fox"})
+        assert [t["token"] for t in res["tokens"]] == [
+            "the", "quick", "brown", "fox"]
+        assert [t["position"] for t in res["tokens"]] == [0, 1, 2, 3]
+
+    def test_field_analyzer(self, node):
+        node.create_index("an", {"mappings": {"properties": {
+            "t": {"type": "text"}, "k": {"type": "keyword"}}}})
+        res = node.analyze("an", {"field": "t", "text": "Hello World"})
+        assert [t["token"] for t in res["tokens"]] == ["hello", "world"]
+        res = node.analyze("an", {"field": "k", "text": "Hello World"})
+        assert [t["token"] for t in res["tokens"]] == ["Hello World"]
+
+    def test_text_array_position_gap(self, node):
+        res = node.analyze(None, {"text": ["one two", "three"]})
+        positions = [t["position"] for t in res["tokens"]]
+        assert positions[0] == 0 and positions[1] == 1
+        assert positions[2] > 100
+
+    def test_missing_text_rejected(self, node):
+        with pytest.raises(IllegalArgumentException):
+            node.analyze(None, {})
