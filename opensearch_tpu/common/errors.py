@@ -112,6 +112,11 @@ class CircuitBreakingException(OpenSearchTpuException):
     error_type = "circuit_breaking_exception"
 
 
+class RejectedExecutionException(OpenSearchTpuException):
+    status = 429
+    error_type = "rejected_execution_exception"
+
+
 class ClusterBlockException(OpenSearchTpuException):
     status = 503
     error_type = "cluster_block_exception"

@@ -1,0 +1,78 @@
+"""Indexing pressure: byte budgets that reject writes under overload.
+
+The analog of IndexingPressure / ShardIndexingPressure (SURVEY.md §2.2
+"Backpressure & admission control": index/IndexingPressure.java — writes
+account coordinating/primary/replica bytes against a budget; crossing it
+throws OpenSearchRejectedExecutionException -> HTTP 429, shedding load
+before the node falls over). One budget here (single node = coordinating
+== primary); the cluster data plane splits the same accounting across the
+coordinating and primary roles.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from opensearch_tpu.common.errors import RejectedExecutionException
+
+DEFAULT_LIMIT_BYTES = 512 << 20  # 10% of a 5G budget, reference default style
+
+
+class IndexingPressure:
+    def __init__(self, limit_bytes: int = DEFAULT_LIMIT_BYTES):
+        self.limit = int(limit_bytes)
+        self.current_bytes = 0
+        self.total_bytes = 0
+        self.rejections = 0
+        self._lock = threading.Lock()
+
+    def acquire(self, bytes_: int, operation: str = "indexing") -> "_Release":
+        bytes_ = int(bytes_)
+        with self._lock:
+            if self.current_bytes + bytes_ > self.limit:
+                self.rejections += 1
+                raise RejectedExecutionException(
+                    f"rejected execution of {operation} operation "
+                    f"[coordinating_and_primary_bytes="
+                    f"{self.current_bytes + bytes_}, "
+                    f"max_coordinating_and_primary_bytes={self.limit}]"
+                )
+            self.current_bytes += bytes_
+            self.total_bytes += bytes_
+        return _Release(self, bytes_)
+
+    def _release(self, bytes_: int) -> None:
+        with self._lock:
+            self.current_bytes = max(0, self.current_bytes - bytes_)
+
+    def stats(self) -> dict:
+        return {
+            "memory": {
+                "current": {
+                    "combined_coordinating_and_primary_in_bytes": self.current_bytes,
+                },
+                "total": {
+                    "combined_coordinating_and_primary_in_bytes": self.total_bytes,
+                    "coordinating_rejections": self.rejections,
+                },
+                "limit_in_bytes": self.limit,
+            }
+        }
+
+
+class _Release:
+    def __init__(self, pressure: IndexingPressure, bytes_: int):
+        self._pressure = pressure
+        self._bytes = bytes_
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    def close(self) -> None:
+        if self._pressure is not None:
+            self._pressure._release(self._bytes)
+            self._pressure = None

@@ -108,6 +108,16 @@ class TpuNode:
         self.search_pipelines = SearchPipelineService(
             self.data_path / "search_pipelines.json"
         )
+        from opensearch_tpu.common.breaker import HierarchyBreakerService
+        from opensearch_tpu.index.pressure import IndexingPressure
+        from opensearch_tpu.tasks import TaskManager
+
+        self.task_manager = TaskManager(node_name)
+        self.breakers = HierarchyBreakerService()
+        self.indexing_pressure = IndexingPressure()
+        from opensearch_tpu.search.backpressure import SearchBackpressureService
+
+        self.search_backpressure = SearchBackpressureService(self.task_manager)
 
     # -- index lifecycle ---------------------------------------------------
 
@@ -910,10 +920,32 @@ class TpuNode:
         raise IllegalArgumentException("update requires [doc] or [upsert]")
 
     def bulk(self, operations: list[tuple[str, dict, dict | None]],
-             refresh: bool = False, pipeline: str | None = None) -> dict:
+             refresh: bool = False, pipeline: str | None = None,
+             payload_bytes: int | None = None) -> dict:
         """operations: [(action, metadata, source)]; action in
-        index|create|update|delete."""
+        index|create|update|delete. `payload_bytes` lets the transport
+        layer pass the already-known request size so the pressure estimate
+        doesn't re-serialize every document."""
         t0 = time.monotonic()
+        if payload_bytes is not None:
+            payload_bytes = int(payload_bytes)
+        if payload_bytes is None:
+            payload_bytes = sum(
+                len(json.dumps(source)) for _, _, source in operations
+                if source is not None
+            )
+        release = self.indexing_pressure.acquire(payload_bytes, "bulk")
+        try:
+            with self.task_manager.task_scope(
+                "indices:data/write/bulk",
+                description=f"requests[{len(operations)}]",
+                cancellable=False,
+            ):
+                return self._bulk_inner(operations, refresh, pipeline, t0)
+        finally:
+            release.close()
+
+    def _bulk_inner(self, operations, refresh, pipeline, t0) -> dict:
         items = []
         errors = False
         touched: set[tuple[str, int]] = set()
@@ -1002,11 +1034,16 @@ class TpuNode:
                     pit["keep_alive"], "keep_alive", positive=True
                 )
             pit_names = sorted({s.shard_id.index for s in ctx["shards"]})
-            resp = self._search_with_pipeline(
-                pipeline_id, pit_names, ctx["shards"], body,
-                acquired=ctx["snapshots"],
-                shard_filters=ctx.get("shard_filters"),
-            )
+            self.search_backpressure.admit()
+            with self.task_manager.task_scope(
+                "indices:data/read/search", description=f"pit[{ctx['id']}]"
+            ) as task:
+                resp = self._search_with_pipeline(
+                    pipeline_id, pit_names, ctx["shards"], body,
+                    acquired=ctx["snapshots"],
+                    shard_filters=ctx.get("shard_filters"),
+                    task=task,
+                )
             resp["pit_id"] = ctx["id"]
             return resp
         expr = index if index is not None else "_all"
@@ -1026,8 +1063,13 @@ class TpuNode:
                                       pipeline_id=pipeline_id, names=names,
                                       shard_filters=shard_filters)
         # per-hit _index comes from each shard's ShardId inside the service
-        return self._search_with_pipeline(pipeline_id, names, shards, body,
-                                          shard_filters=shard_filters)
+        self.search_backpressure.admit()
+        with self.task_manager.task_scope(
+            "indices:data/read/search", description=f"indices[{expr}]"
+        ) as task:
+            return self._search_with_pipeline(pipeline_id, names, shards, body,
+                                              shard_filters=shard_filters,
+                                              task=task)
 
     def _search_with_pipeline(
         self,
@@ -1037,6 +1079,7 @@ class TpuNode:
         body: dict,
         acquired: list | None = None,
         shard_filters: list | None = None,
+        task=None,
     ) -> dict:
         """search_service.search wrapped in the pipeline pre/post steps."""
         pl, pr_config = self._resolve_search_pipeline(pipeline_id, index_names)
@@ -1047,7 +1090,7 @@ class TpuNode:
                 pl_ctx["_original_size"] = body.pop("_original_size")
         resp = search_service.search(
             shards, body, acquired=acquired, phase_results_config=pr_config,
-            shard_filters=shard_filters,
+            shard_filters=shard_filters, task=task,
         )
         if pl is not None:
             resp = self.search_pipelines.transform_response(
@@ -1109,10 +1152,14 @@ class TpuNode:
             "pipeline_id": pipeline_id, "names": names or [],
             "shard_filters": shard_filters,
         }
-        resp = self._search_with_pipeline(
-            pipeline_id, names or [], shards, body, acquired=snapshots,
-            shard_filters=shard_filters,
-        )
+        self.search_backpressure.admit()
+        with self.task_manager.task_scope(
+            "indices:data/read/search", description=f"scroll[{cid}]"
+        ) as task:
+            resp = self._search_with_pipeline(
+                pipeline_id, names or [], shards, body, acquired=snapshots,
+                shard_filters=shard_filters, task=task,
+            )
         self._reader_contexts[cid] = ctx
         resp["_scroll_id"] = cid
         return resp
@@ -1130,11 +1177,15 @@ class TpuNode:
                      if k not in ("aggs", "aggregations")}
         page_body["from"] = ctx["seen"]
         page_body["size"] = ctx["size"]
-        resp = self._search_with_pipeline(
-            ctx.get("pipeline_id"), ctx.get("names", []), ctx["shards"],
-            page_body, acquired=ctx["snapshots"],
-            shard_filters=ctx.get("shard_filters"),
-        )
+        self.search_backpressure.admit()
+        with self.task_manager.task_scope(
+            "indices:data/read/search", description=f"scroll[{scroll_id}]"
+        ) as task:
+            resp = self._search_with_pipeline(
+                ctx.get("pipeline_id"), ctx.get("names", []), ctx["shards"],
+                page_body, acquired=ctx["snapshots"],
+                shard_filters=ctx.get("shard_filters"), task=task,
+            )
         ctx["seen"] += len(resp["hits"]["hits"])
         resp["_scroll_id"] = scroll_id
         return resp

@@ -128,6 +128,11 @@ def build_router() -> Router:
     reg("DELETE", "/_snapshot/{repo}/{snapshot}", delete_snapshot)
     reg("POST", "/_snapshot/{repo}/{snapshot}/_restore", restore_snapshot)
     reg("GET", "/_snapshot/{repo}/{snapshot}/_status", snapshot_status)
+    # tasks
+    reg("GET", "/_tasks", list_tasks)
+    reg("GET", "/_tasks/{task_id}", get_task)
+    reg("POST", "/_tasks/_cancel", cancel_tasks)
+    reg("POST", "/_tasks/{task_id}/_cancel", cancel_task)
     # cluster / stats
     reg("GET", "/_cluster/health", cluster_health)
     reg("GET", "/_cluster/stats", cluster_stats)
@@ -297,7 +302,8 @@ def bulk(node: TpuNode, params, query, body):
             i += 1
         ops.append((action, meta, source))
     return 200, node.bulk(ops, refresh=_refresh_param(query),
-                          pipeline=query.get("pipeline"))
+                          pipeline=query.get("pipeline"),
+                          payload_bytes=query.get("_payload_bytes"))
 
 
 def put_pipeline(node: TpuNode, params, query, body):
@@ -396,6 +402,39 @@ def search_all(node: TpuNode, params, query, body):
     return 200, node.search(None, _body_with_query_params(query, body),
                             scroll=query.get("scroll"),
                             search_pipeline=query.get("search_pipeline"))
+
+
+def _parse_task_id(raw: str) -> int:
+    # accepts both "<id>" and "<node>:<id>" forms
+    try:
+        return int(raw.rsplit(":", 1)[-1])
+    except ValueError:
+        raise IllegalArgumentException(f"malformed task id [{raw}]") from None
+
+
+def list_tasks(node: TpuNode, params, query, body):
+    tasks = node.task_manager.list_tasks(query.get("actions"))
+    return 200, {"nodes": {node.node_name: {
+        "name": node.node_name,
+        "tasks": {f"{t.node}:{t.id}": t.to_dict() for t in tasks},
+    }}}
+
+
+def get_task(node: TpuNode, params, query, body):
+    task = node.task_manager.get(_parse_task_id(params["task_id"]))
+    return 200, {"completed": False, "task": task.to_dict()}
+
+
+def cancel_tasks(node: TpuNode, params, query, body):
+    cancelled = node.task_manager.cancel_matching(query.get("actions"))
+    return 200, {"nodes": {node.node_name: {"cancelled_task_ids": cancelled}},
+                 "node_failures": [], "task_failures": []}
+
+
+def cancel_task(node: TpuNode, params, query, body):
+    cancelled = node.task_manager.cancel(_parse_task_id(params["task_id"]))
+    return 200, {"nodes": {node.node_name: {"cancelled_task_ids": cancelled}},
+                 "node_failures": [], "task_failures": []}
 
 
 def update_aliases(node: TpuNode, params, query, body):
@@ -637,6 +676,14 @@ def nodes_stats(node: TpuNode, params, query, body):
                     "docs": {"count": stats["_all"]["primaries"]["docs"]["count"]},
                 },
                 "process": {"max_rss_bytes": usage.ru_maxrss * 1024},
+                "breakers": node.breakers.stats(),
+                "indexing_pressure": node.indexing_pressure.stats(),
+                "search_backpressure": node.search_backpressure.stats(),
+                "tasks": {
+                    "running": len(node.task_manager.list_tasks()),
+                    "completed": node.task_manager.completed,
+                    "cancelled": node.task_manager.cancelled_count,
+                },
             }
         },
     }

@@ -41,11 +41,14 @@ class HttpServer:
         self.port = port
         self.router = build_router()
         self._server: asyncio.AbstractServer | None = None
-        # single worker: TpuNode/IndexShard mutation paths are not
-        # thread-safe; the engine is single-writer (like the reference's
-        # per-shard write semantics). Read/write concurrency is a later
-        # refinement (per-shard executors).
+        # data ops run on a single worker: TpuNode/IndexShard mutation paths
+        # are not thread-safe; the engine is single-writer (like the
+        # reference's per-shard write semantics). Management APIs (_tasks,
+        # stats, cat) get their OWN worker — the reference's dedicated
+        # `management` threadpool — so task cancellation and health checks
+        # stay responsive while a slow search occupies the data worker.
         self._executor = ThreadPoolExecutor(max_workers=1)
+        self._mgmt_executor = ThreadPoolExecutor(max_workers=1)
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -148,11 +151,29 @@ class HttpServer:
         try:
             handler, params = self.router.resolve(method, path)
             body = _parse_body(path, raw_body)
-            # handlers are synchronous work; run them off the event loop so
-            # slow searches don't stall socket IO (single worker — see ctor)
-            status, payload = await asyncio.get_running_loop().run_in_executor(
-                self._executor, handler, self.node, params, query, body
-            )
+            # transport knows the payload size; hand it to bulk so the
+            # pressure estimate doesn't re-serialize every document
+            if path.endswith("/_bulk") or path == "/_bulk":
+                query["_payload_bytes"] = len(raw_body)
+            # in-flight request bytes against the breaker (the reference's
+            # in_flight_requests child tracks transport payload bytes)
+            breakers = getattr(self.node, "breakers", None)
+            if breakers is not None and raw_body:
+                breakers.in_flight_requests.add_estimate_and_maybe_break(
+                    len(raw_body), "<http_request>"
+                )
+            mgmt = path.startswith(("/_tasks", "/_nodes", "/_cat",
+                                    "/_cluster"))
+            executor = self._mgmt_executor if mgmt else self._executor
+            try:
+                # handlers are synchronous work; run them off the event loop
+                # so slow searches don't stall socket IO
+                status, payload = await asyncio.get_running_loop().run_in_executor(
+                    executor, handler, self.node, params, query, body
+                )
+            finally:
+                if breakers is not None and raw_body:
+                    breakers.in_flight_requests.release(len(raw_body))
             content_type = (
                 "text/plain" if isinstance(payload, str) else "application/json"
             )

@@ -43,6 +43,7 @@ def search(
     acquired: list | None = None,
     phase_results_config: dict | None = None,
     shard_filters: list | None = None,
+    task=None,
 ) -> dict[str, Any]:
     """Run one search over `shards`. `acquired` optionally pins the searcher
     snapshots to use, one per shard in order — the scroll/PIT path
@@ -111,6 +112,8 @@ def search(
         shard_snaps = []
         per_shard_subs = []
         for shard_i, shard in enumerate(shards):
+            if task is not None:
+                task.ensure_not_cancelled()
             snapshot = (
                 acquired[shard_i] if acquired is not None
                 else shard.acquire_searcher()
@@ -139,6 +142,10 @@ def search(
     else:
         per_shard_results = []
         for shard_i, shard in enumerate(shards):
+            # cooperative cancellation at the phase boundary — between
+            # device program launches (TaskCancellationService model)
+            if task is not None:
+                task.ensure_not_cancelled()
             snapshot = acquired[shard_i] if acquired is not None else shard.acquire_searcher()
             per_shard_results.append(
                 (
@@ -319,7 +326,49 @@ def search(
         from opensearch_tpu.search.aggs_pipeline import apply_pipeline_aggs
 
         apply_pipeline_aggs(aggs_body, response["aggregations"])
+        # search.max_buckets guard (MultiBucketConsumerService analog):
+        # bound coordinator memory for deeply-bucketed aggs
+        n_buckets = _count_buckets(response["aggregations"])
+        if n_buckets > MAX_BUCKETS:
+            raise TooManyBucketsException(n_buckets)
     return response
+
+
+MAX_BUCKETS = 65_536
+
+
+class TooManyBucketsException(ParsingException):
+    status = 503
+    error_type = "too_many_buckets_exception"
+
+    def __init__(self, count: int):
+        super().__init__(
+            f"Trying to create too many buckets. Must be less than or equal "
+            f"to: [{MAX_BUCKETS}] but was [{count}]. This limit can be set "
+            f"by changing the [search.max_buckets] cluster level setting."
+        )
+
+
+def _count_buckets(aggs: dict) -> int:
+    total = 0
+    stack = [aggs]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, dict):
+            buckets = cur.get("buckets")
+            if isinstance(buckets, list):
+                total += len(buckets)
+                stack.extend(buckets)
+            elif isinstance(buckets, dict):
+                total += len(buckets)
+                stack.extend(buckets.values())
+            else:
+                stack.extend(
+                    v for v in cur.values() if isinstance(v, (dict, list))
+                )
+        elif isinstance(cur, list):
+            stack.extend(cur)
+    return total
 
 
 class _MultiMapperView:

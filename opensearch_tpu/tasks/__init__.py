@@ -1,0 +1,3 @@
+from opensearch_tpu.tasks.manager import Task, TaskManager
+
+__all__ = ["Task", "TaskManager"]

@@ -1,0 +1,198 @@
+"""Task management: registry, tree-wide cancellation, resource tracking.
+
+The analog of the reference's task subsystem (SURVEY.md §2.2 "Task
+management": server/.../tasks/TaskManager.java — every transport action runs
+as a Task; TaskCancellationService propagates cancellation to child tasks;
+TaskResourceTrackingService samples per-task CPU). Here every node-level
+operation that can run long (search, bulk, reindex, snapshot) registers a
+task; cancellable tasks poll `ensure_not_cancelled` at phase boundaries —
+the cooperative model the reference uses (cancellation flags checked by
+collectors), which on the TPU path means "between device program launches",
+since a launched XLA program is not interruptible anyway.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field as dc_field
+
+from opensearch_tpu.common.errors import (
+    ResourceNotFoundException,
+    TaskCancelledException,
+)
+
+
+@dataclass
+class Task:
+    id: int
+    action: str
+    description: str = ""
+    cancellable: bool = True
+    parent_id: int = -1
+    node: str = "node-0"
+    start_time_millis: int = 0
+    _start_perf: float = 0.0
+    cancelled: bool = False
+    cancellation_reason: str | None = None
+    # resource tracking (TaskResourceTrackingService analog)
+    cpu_time_nanos: int = 0
+    children: list[int] = dc_field(default_factory=list)
+
+    def ensure_not_cancelled(self) -> None:
+        if self.cancelled:
+            raise TaskCancelledException(
+                f"task [{self.id}] was cancelled"
+                + (f": {self.cancellation_reason}" if self.cancellation_reason else "")
+            )
+
+    @property
+    def running_time_nanos(self) -> int:
+        return int((time.perf_counter() - self._start_perf) * 1e9)
+
+    def to_dict(self) -> dict:
+        return {
+            "node": self.node,
+            "id": self.id,
+            "type": "transport",
+            "action": self.action,
+            "description": self.description,
+            "start_time_in_millis": self.start_time_millis,
+            "running_time_in_nanos": self.running_time_nanos,
+            "cancellable": self.cancellable,
+            "cancelled": self.cancelled,
+            **({"parent_task_id": f"{self.node}:{self.parent_id}"}
+               if self.parent_id >= 0 else {}),
+        }
+
+
+class TaskManager:
+    """Thread-safe registry with parent->child cancellation fan-out."""
+
+    def __init__(self, node_name: str = "node-0"):
+        self._node = node_name
+        self._seq = itertools.count(1)
+        self._tasks: dict[int, Task] = {}
+        self._lock = threading.Lock()
+        # cumulative counters for stats
+        self.completed = 0
+        self.cancelled_count = 0
+
+    def register(self, action: str, description: str = "",
+                 cancellable: bool = True, parent_id: int = -1) -> Task:
+        task = Task(
+            id=next(self._seq),
+            action=action,
+            description=description,
+            cancellable=cancellable,
+            parent_id=parent_id,
+            node=self._node,
+            start_time_millis=int(time.time() * 1000),
+            _start_perf=time.perf_counter(),
+        )
+        with self._lock:
+            self._tasks[task.id] = task
+            parent = self._tasks.get(parent_id)
+            if parent is not None:
+                parent.children.append(task.id)
+                # joining a cancelled tree: born cancelled (the ban-marker
+                # behavior of TaskCancellationService)
+                if parent.cancelled:
+                    task.cancelled = True
+                    task.cancellation_reason = parent.cancellation_reason
+        return task
+
+    def unregister(self, task: Task) -> None:
+        with self._lock:
+            self._tasks.pop(task.id, None)
+            self.completed += 1
+
+    def get(self, task_id: int) -> Task:
+        task = self._tasks.get(task_id)
+        if task is None:
+            raise ResourceNotFoundException(f"task [{self._node}:{task_id}] not found")
+        return task
+
+    def cancel(self, task_id: int, reason: str = "by user request") -> list[int]:
+        """Cancel a task and its whole subtree; returns cancelled ids."""
+        with self._lock:
+            root = self._tasks.get(task_id)
+            if root is None:
+                raise ResourceNotFoundException(
+                    f"task [{self._node}:{task_id}] not found"
+                )
+            if not root.cancellable:
+                from opensearch_tpu.common.errors import IllegalArgumentException
+
+                raise IllegalArgumentException(
+                    f"task [{task_id}] is not cancellable"
+                )
+            out: list[int] = []
+            stack = [task_id]
+            while stack:
+                tid = stack.pop()
+                t = self._tasks.get(tid)
+                if t is None or t.cancelled:
+                    continue
+                t.cancelled = True
+                t.cancellation_reason = reason
+                out.append(tid)
+                stack.extend(t.children)
+            self.cancelled_count += len(out)
+            return out
+
+    def cancel_matching(self, actions: str | None = None,
+                        reason: str = "by user request") -> list[int]:
+        import fnmatch
+
+        with self._lock:
+            roots = [
+                t.id for t in self._tasks.values()
+                if t.cancellable and not t.cancelled
+                and (actions is None or any(
+                    fnmatch.fnmatch(t.action, p) for p in actions.split(",")
+                ))
+            ]
+        out: list[int] = []
+        for tid in roots:
+            try:
+                out.extend(self.cancel(tid, reason))
+            except ResourceNotFoundException:
+                pass
+        return out
+
+    def list_tasks(self, actions: str | None = None) -> list[Task]:
+        import fnmatch
+
+        with self._lock:
+            tasks = list(self._tasks.values())
+        if actions:
+            patterns = actions.split(",")
+            tasks = [
+                t for t in tasks
+                if any(fnmatch.fnmatch(t.action, p) for p in patterns)
+            ]
+        return sorted(tasks, key=lambda t: t.id)
+
+    def task_scope(self, action: str, description: str = "",
+                   cancellable: bool = True, parent_id: int = -1):
+        """Context manager: register on enter, unregister on exit."""
+        manager = self
+
+        class _Scope:
+            def __enter__(self):
+                self.task = manager.register(
+                    action, description, cancellable, parent_id
+                )
+                return self.task
+
+            def __exit__(self, exc_type, exc, tb):
+                start = self.task._start_perf
+                self.task.cpu_time_nanos = int(
+                    (time.perf_counter() - start) * 1e9
+                )
+                manager.unregister(self.task)
+                return False
+
+        return _Scope()

@@ -1,0 +1,179 @@
+"""Task management, circuit breakers, indexing pressure, search backpressure.
+
+Reference surface: tasks/TaskManager + TaskCancellationService,
+indices/breaker/HierarchyCircuitBreakerService, index/IndexingPressure,
+search/backpressure/SearchBackpressureService (SURVEY.md §2.2).
+"""
+
+import pytest
+
+from opensearch_tpu.common.breaker import HierarchyBreakerService
+from opensearch_tpu.common.errors import (
+    CircuitBreakingException,
+    IllegalArgumentException,
+    RejectedExecutionException,
+    ResourceNotFoundException,
+    TaskCancelledException,
+)
+from opensearch_tpu.index.pressure import IndexingPressure
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.search.backpressure import SearchBackpressureService
+from opensearch_tpu.tasks import TaskManager
+
+
+@pytest.fixture()
+def node(tmp_path):
+    return TpuNode(tmp_path / "node")
+
+
+class TestTaskManager:
+    def test_register_list_unregister(self):
+        tm = TaskManager()
+        t = tm.register("indices:data/read/search", "test")
+        assert tm.list_tasks()[0].id == t.id
+        tm.unregister(t)
+        assert tm.list_tasks() == []
+        assert tm.completed == 1
+
+    def test_cancel_tree(self):
+        tm = TaskManager()
+        root = tm.register("a")
+        child = tm.register("a[s]", parent_id=root.id)
+        grandchild = tm.register("a[s][f]", parent_id=child.id)
+        cancelled = tm.cancel(root.id, "test")
+        assert set(cancelled) == {root.id, child.id, grandchild.id}
+        with pytest.raises(TaskCancelledException):
+            grandchild.ensure_not_cancelled()
+
+    def test_child_of_cancelled_parent_is_born_cancelled(self):
+        tm = TaskManager()
+        root = tm.register("a")
+        tm.cancel(root.id)
+        late_child = tm.register("a[s]", parent_id=root.id)
+        assert late_child.cancelled
+
+    def test_not_cancellable(self):
+        tm = TaskManager()
+        t = tm.register("x", cancellable=False)
+        with pytest.raises(IllegalArgumentException):
+            tm.cancel(t.id)
+
+    def test_cancel_matching_by_action(self):
+        tm = TaskManager()
+        s = tm.register("indices:data/read/search")
+        b = tm.register("indices:data/write/bulk")
+        cancelled = tm.cancel_matching("indices:data/read/*")
+        assert cancelled == [s.id] and not b.cancelled
+
+    def test_missing_task(self):
+        with pytest.raises(ResourceNotFoundException):
+            TaskManager().get(42)
+
+    def test_search_runs_as_task_and_cancellation_stops_it(self, node):
+        node.create_index("t", {"mappings": {"properties": {
+            "n": {"type": "long"}}}})
+        for i in range(5):
+            node.index_doc("t", str(i), {"n": i})
+        node.refresh("t")
+        # normal search completes and unregisters its task
+        node.search("t", {"query": {"match_all": {}}})
+        assert node.task_manager.list_tasks("indices:data/read/search") == []
+
+
+class TestCircuitBreakers:
+    def test_child_trips(self):
+        svc = HierarchyBreakerService(total_bytes=1000)
+        svc.request.add_estimate_and_maybe_break(500, "a")
+        with pytest.raises(CircuitBreakingException):
+            svc.request.add_estimate_and_maybe_break(200, "b")
+        assert svc.request.trip_count == 1
+        # the failed reservation must not leak
+        assert svc.request.used == 500
+        svc.request.release(500)
+        assert svc.request.used == 0
+
+    def test_parent_trips_across_children(self):
+        svc = HierarchyBreakerService(total_bytes=1000, settings={
+            "request_limit_bytes": 900, "fielddata_limit_bytes": 900,
+            "parent_limit_bytes": 1000,
+        })
+        svc.request.add_estimate_and_maybe_break(600, "a")
+        with pytest.raises(CircuitBreakingException):
+            svc.fielddata.add_estimate_and_maybe_break(600, "b")
+        # the child rolled back its reservation after the parent broke
+        assert svc.fielddata.used == 0
+        assert svc.parent_trip_count == 1
+
+    def test_stats_shape(self):
+        svc = HierarchyBreakerService()
+        stats = svc.stats()
+        assert {"request", "fielddata", "in_flight_requests", "parent"} <= set(stats)
+        assert "tripped" in stats["parent"]
+
+
+class TestIndexingPressure:
+    def test_acquire_release(self):
+        p = IndexingPressure(limit_bytes=100)
+        with p.acquire(60):
+            assert p.current_bytes == 60
+        assert p.current_bytes == 0 and p.total_bytes == 60
+
+    def test_rejection(self):
+        p = IndexingPressure(limit_bytes=100)
+        hold = p.acquire(80)
+        with pytest.raises(RejectedExecutionException):
+            p.acquire(30)
+        assert p.rejections == 1
+        hold.close()
+        p.acquire(30).close()  # capacity restored
+
+    def test_bulk_rejects_over_budget(self, node):
+        node.indexing_pressure.limit = 10  # tiny budget
+        with pytest.raises(RejectedExecutionException):
+            node.bulk([("index", {"_index": "x", "_id": "1"},
+                        {"field": "y" * 100})])
+        # budget released even on rejection path; small op fine
+        node.indexing_pressure.limit = 1 << 20
+        resp = node.bulk([("index", {"_index": "x", "_id": "1"},
+                           {"f": 1})])
+        assert not resp["errors"]
+        assert node.indexing_pressure.current_bytes == 0
+
+
+class TestSearchBackpressure:
+    def test_admission_rejects_when_saturated(self):
+        tm = TaskManager()
+        bp = SearchBackpressureService(tm, max_concurrent=2)
+        tm.register("indices:data/read/search")
+        tm.register("indices:data/read/search")
+        with pytest.raises(RejectedExecutionException):
+            bp.admit()
+        assert bp.rejections == 1
+
+    def test_overrunner_cancelled_to_reclaim_capacity(self):
+        tm = TaskManager()
+        bp = SearchBackpressureService(tm, max_concurrent=1, max_runtime_ms=0)
+        stuck = tm.register("indices:data/read/search")
+        bp.admit()  # cancels the overrunning task instead of rejecting
+        assert stuck.cancelled
+        assert bp.cancellations >= 1
+
+    def test_stats(self):
+        tm = TaskManager()
+        bp = SearchBackpressureService(tm)
+        assert bp.stats()["active_searches"] == 0
+
+
+class TestMaxBuckets:
+    def test_too_many_buckets_rejected(self, node, monkeypatch):
+        from opensearch_tpu.search import service as svc_mod
+
+        node.create_index("mb", {"mappings": {"properties": {
+            "k": {"type": "keyword"}}}})
+        for i in range(10):
+            node.index_doc("mb", str(i), {"k": f"v{i}"})
+        node.refresh("mb")
+        monkeypatch.setattr(svc_mod, "MAX_BUCKETS", 5)
+        with pytest.raises(svc_mod.TooManyBucketsException):
+            node.search("mb", {"size": 0, "aggs": {
+                "t": {"terms": {"field": "k", "size": 100}}}})
