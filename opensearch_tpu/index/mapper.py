@@ -59,13 +59,18 @@ class FieldMapper:
     # ANN method config (k-NN plugin style): {"name": "ivf_pq",
     # "parameters": {"nlist": .., "m": .., "nprobe": ..}}; None = exact
     method: dict | None = None
+    # original type was "completion" (stored keyword-style; the suggester
+    # prefix-matches its values and object-form {input, weight} is accepted)
+    completion: bool = False
     # date
     format: str = "strict_date_optional_time||epoch_millis"
     # extra sub-fields ("fields": {"raw": {"type": "keyword"}})
     fields: dict[str, "FieldMapper"] = dc_field(default_factory=dict)
 
     def to_dict(self) -> dict:
-        out: dict[str, Any] = {"type": self.type}
+        out: dict[str, Any] = {
+            "type": "completion" if self.completion else self.type
+        }
         if self.type == "text" and self.analyzer != "standard":
             out["analyzer"] = self.analyzer
         if self.search_analyzer and self.search_analyzer != self.analyzer:
@@ -182,18 +187,25 @@ class MapperService:
         if ftype == "knn_vector":  # k-NN plugin compat alias
             ftype = "dense_vector"
         known = (
-            {"text", "keyword", "date", "boolean", "dense_vector", "match_only_text"}
+            {"text", "keyword", "date", "boolean", "dense_vector",
+             "match_only_text", "completion", "search_as_you_type"}
             | NUMERIC_TYPES
         )
         if ftype not in known:
             raise MapperParsingException(
                 f"No handler for type [{ftype}] declared on field [{full}]"
             )
-        if ftype == "match_only_text":
+        if ftype in ("match_only_text", "search_as_you_type"):
             ftype = "text"
+        is_completion = ftype == "completion"
+        if is_completion:
+            # completion inputs are stored whole like keywords; the suggester
+            # prefix-matches over the keyword ordinals (the FST analog)
+            ftype = "keyword"
         mapper = FieldMapper(
             name=full,
             type=ftype,
+            completion=is_completion,
             analyzer=conf.get("analyzer", "standard"),
             search_analyzer=conf.get("search_analyzer"),
             index=conf.get("index", True),
@@ -260,6 +272,17 @@ class MapperService:
                     raise MapperParsingException(
                         f"dense_vector field [{full}] must be an array of numbers"
                     )
+                if mapper is not None and mapper.completion:
+                    # completion object form: {"input": str|[str], "weight": N}
+                    inputs = value.get("input")
+                    if inputs is None:
+                        raise MapperParsingException(
+                            f"completion field [{full}] object form requires [input]"
+                        )
+                    if isinstance(inputs, str):
+                        inputs = [inputs]
+                    self._parse_value(mapper, full, inputs, out)
+                    continue
                 self._parse_object(value, f"{full}.", out)
                 continue
             mapper = self.mappers.get(full)

@@ -21,6 +21,7 @@ from pathlib import Path
 from typing import Any
 
 from opensearch_tpu.common.errors import (
+    DocumentMissingException,
     IllegalArgumentException,
     IndexClosedException,
     IndexNotFoundException,
@@ -42,6 +43,23 @@ from opensearch_tpu.index.shard import IndexShard, ShardId
 from opensearch_tpu.search import service as search_service
 
 _VALID_INDEX_NAME = re.compile(r"^[a-z0-9][a-z0-9_\-.]*$")
+
+
+def _flatten_source_fields(obj: dict, prefix: str = "") -> dict:
+    out: dict = {}
+    for k, v in obj.items():
+        full = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten_source_fields(v, f"{full}."))
+        else:
+            out[full] = v
+    return out
+
+
+def fnmatch_one(name: str, pattern: str) -> bool:
+    import fnmatch
+
+    return fnmatch.fnmatch(name, pattern.strip())
 
 
 def _deep_merge(base: dict, overlay: dict) -> dict:
@@ -991,6 +1009,185 @@ class TpuNode:
             "took": int((time.monotonic() - t0) * 1000),
             "errors": errors,
             "items": items,
+        }
+
+    # -- mget / explain / field_caps / termvectors -------------------------
+
+    def mget(self, index: str | None, body: dict) -> dict:
+        """TransportMultiGetAction analog: batched realtime gets."""
+        body = body or {}
+        if "docs" in body:
+            specs = body["docs"]
+            if not isinstance(specs, list):
+                raise IllegalArgumentException("[docs] must be an array")
+        elif "ids" in body:
+            if index is None:
+                raise IllegalArgumentException(
+                    "[ids] requires an index in the request path"
+                )
+            specs = [{"_id": i} for i in body["ids"]]
+        else:
+            raise IllegalArgumentException("[mget] requires [docs] or [ids]")
+        docs = []
+        for spec in specs:
+            target = spec.get("_index", index)
+            doc_id = spec.get("_id")
+            if target is None or doc_id is None:
+                raise IllegalArgumentException(
+                    "each mget doc requires [_index] and [_id]"
+                )
+            try:
+                got = self.get_doc(target, str(doc_id),
+                                   routing=spec.get("routing"))
+            except OpenSearchTpuException as e:
+                # per-doc failures (missing index, closed, bad alias) are
+                # reported in the doc's error slot, not as a request failure
+                docs.append({"_index": target, "_id": str(doc_id),
+                             "error": e.to_dict()})
+                continue
+            if "_source" in spec and got.get("found"):
+                from opensearch_tpu.search.service import _source_filter
+
+                filtered = _source_filter(spec["_source"])(got["_source"])
+                if filtered is None:
+                    got.pop("_source", None)
+                else:
+                    got["_source"] = filtered
+            docs.append(got)
+        return {"docs": docs}
+
+    def explain(self, index: str, doc_id: str, body: dict,
+                routing: str | None = None) -> dict:
+        """TransportExplainAction analog: why does (or doesn't) this doc
+        match — runs the query on the owning shard restricted to the doc."""
+        body = body or {}
+        concrete, routing = self._resolve_write_alias(index, routing)
+        svc = self._get_open_index(concrete)
+        shard = svc.shard_for(doc_id, routing)
+        if shard.get(doc_id) is None:
+            raise DocumentMissingException(f"[{concrete}]: document missing [{doc_id}]")
+        from opensearch_tpu.search import query_dsl
+        from opensearch_tpu.search.executor import execute_query_phase
+        from opensearch_tpu.search.fetch import explain_for_hit
+
+        node_q = query_dsl.parse_query(body.get("query"))
+        restricted = query_dsl.BoolQuery(
+            must=[node_q], filter=[query_dsl.IdsQuery(values=[doc_id])]
+        )
+        snapshot = shard.acquire_searcher()
+        result = execute_query_phase(
+            snapshot, svc.mapper_service, restricted, size=1
+        )
+        matched = bool(result.hits)
+        out = {
+            "_index": concrete,
+            "_id": doc_id,
+            "matched": matched,
+        }
+        if matched:
+            h = result.hits[0]
+            out["explanation"] = explain_for_hit(h.score, node_q)
+        else:
+            out["explanation"] = {
+                "value": 0.0, "description": "no matching term",
+                "details": [],
+            }
+        return out
+
+    def field_caps(self, index: str | None, fields: str) -> dict:
+        """TransportFieldCapabilitiesAction analog."""
+        import fnmatch
+
+        names = self.resolve_indices(index if index is not None else "_all")
+        patterns = [p.strip() for p in fields.split(",") if p.strip()]
+        if not patterns:
+            raise IllegalArgumentException("[field_caps] requires [fields]")
+        # first pass: field -> type -> (mapper, member indices)
+        by_field: dict[str, dict[str, dict]] = {}
+        for name in names:
+            ms = self._get_index(name).mapper_service
+            for fname, mapper in ms.mappers.items():
+                if not any(fnmatch.fnmatch(fname, p) for p in patterns):
+                    continue
+                slot = by_field.setdefault(fname, {}).setdefault(
+                    mapper.type, {"mapper": mapper, "indices": []}
+                )
+                slot["indices"].append(name)
+        caps: dict[str, dict[str, dict]] = {}
+        for fname, types in by_field.items():
+            conflicted = len(types) > 1
+            caps[fname] = {}
+            for ftype, slot in types.items():
+                mapper = slot["mapper"]
+                entry = {
+                    "type": ftype,
+                    "searchable": mapper.index,
+                    "aggregatable": mapper.doc_values and ftype != "text",
+                }
+                if conflicted:
+                    # every conflicting type lists its member indices
+                    entry["indices"] = sorted(slot["indices"])
+                caps[fname][ftype] = entry
+        return {
+            "indices": names,
+            "fields": caps,
+        }
+
+    def termvectors(self, index: str, doc_id: str, body: dict | None = None,
+                    fields: str | None = None) -> dict:
+        """TransportTermVectorsAction analog: re-analyzes the live doc
+        (the realtime path the reference takes when vectors aren't stored)."""
+        body = body or {}
+        concrete, routing = self._resolve_write_alias(index, None)
+        svc = self._get_open_index(concrete)
+        shard = svc.shard_for(doc_id, routing)
+        got = shard.get(doc_id)
+        if got is None:
+            return {"_index": concrete, "_id": doc_id, "found": False}
+        want = fields.split(",") if fields else body.get("fields")
+        if isinstance(want, str):
+            want = [want]
+        want_stats = bool(body.get("term_statistics"))
+        source = got["_source"]
+        ms = svc.mapper_service
+        tv: dict[str, Any] = {}
+        flat = _flatten_source_fields(source)
+        snapshot = shard.acquire_searcher()
+        for fname, value in flat.items():
+            mapper = ms.field_mapper(fname)
+            if mapper is None or mapper.type != "text":
+                continue
+            if want and not any(fnmatch_one(fname, w) for w in want):
+                continue
+            texts = value if isinstance(value, list) else [value]
+            counts: dict[str, int] = {}
+            for t in texts:
+                for term in ms.analyze_query_text(fname, str(t)):
+                    counts[term] = counts.get(term, 0) + 1
+            seg_fields = [
+                host.text_fields[fname]
+                for host, _dev in snapshot.segments
+                if fname in host.text_fields
+            ]
+            terms_out = {}
+            for term, freq in sorted(counts.items()):
+                entry: dict[str, Any] = {"term_freq": freq}
+                if want_stats:
+                    entry["doc_freq"] = sum(
+                        tf_field.doc_freq(term) for tf_field in seg_fields
+                    )
+                terms_out[term] = entry
+            tv[fname] = {
+                "field_statistics": {
+                    "sum_ttf": sum(int(f.total_terms) for f in seg_fields),
+                    "doc_count": sum(f.docs_with_field for f in seg_fields),
+                    "sum_doc_freq": -1,
+                },
+                "terms": terms_out,
+            }
+        return {
+            "_index": concrete, "_id": doc_id, "found": True,
+            "took": 0, "term_vectors": tv,
         }
 
     # -- search / refresh --------------------------------------------------

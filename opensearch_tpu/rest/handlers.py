@@ -42,6 +42,18 @@ def build_router() -> Router:
     reg("GET", "/{index}/_source/{id}", get_source)
     reg("DELETE", "/{index}/_doc/{id}", delete_doc)
     reg("POST", "/{index}/_update/{id}", update_doc)
+    reg("GET", "/_mget", mget_all)
+    reg("POST", "/_mget", mget_all)
+    reg("GET", "/{index}/_mget", mget)
+    reg("POST", "/{index}/_mget", mget)
+    reg("GET", "/{index}/_explain/{id}", explain_doc)
+    reg("POST", "/{index}/_explain/{id}", explain_doc)
+    reg("GET", "/_field_caps", field_caps_all)
+    reg("POST", "/_field_caps", field_caps_all)
+    reg("GET", "/{index}/_field_caps", field_caps)
+    reg("POST", "/{index}/_field_caps", field_caps)
+    reg("GET", "/{index}/_termvectors/{id}", termvectors)
+    reg("POST", "/{index}/_termvectors/{id}", termvectors)
     reg("POST", "/_bulk", bulk)
     reg("PUT", "/_bulk", bulk)
     reg("POST", "/{index}/_bulk", bulk)
@@ -304,6 +316,41 @@ def bulk(node: TpuNode, params, query, body):
     return 200, node.bulk(ops, refresh=_refresh_param(query),
                           pipeline=query.get("pipeline"),
                           payload_bytes=query.get("_payload_bytes"))
+
+
+def mget(node: TpuNode, params, query, body):
+    return 200, node.mget(params["index"], body or {})
+
+
+def mget_all(node: TpuNode, params, query, body):
+    return 200, node.mget(None, body or {})
+
+
+def explain_doc(node: TpuNode, params, query, body):
+    return 200, node.explain(params["index"], params["id"], body or {},
+                             routing=query.get("routing"))
+
+
+def field_caps(node: TpuNode, params, query, body):
+    fields = query.get("fields") or (body or {}).get("fields", "")
+    if isinstance(fields, list):
+        fields = ",".join(fields)
+    return 200, node.field_caps(params["index"], fields)
+
+
+def field_caps_all(node: TpuNode, params, query, body):
+    fields = query.get("fields") or (body or {}).get("fields", "")
+    if isinstance(fields, list):
+        fields = ",".join(fields)
+    return 200, node.field_caps(None, fields)
+
+
+def termvectors(node: TpuNode, params, query, body):
+    b = dict(body or {})
+    if query.get("term_statistics") in ("", "true", True):
+        b["term_statistics"] = True
+    return 200, node.termvectors(params["index"], params["id"], b,
+                                 fields=query.get("fields"))
 
 
 def put_pipeline(node: TpuNode, params, query, body):

@@ -43,10 +43,10 @@ class HttpServer:
         self._server: asyncio.AbstractServer | None = None
         # data ops run on a single worker: TpuNode/IndexShard mutation paths
         # are not thread-safe; the engine is single-writer (like the
-        # reference's per-shard write semantics). Management APIs (_tasks,
-        # stats, cat) get their OWN worker — the reference's dedicated
-        # `management` threadpool — so task cancellation and health checks
-        # stay responsive while a slow search occupies the data worker.
+        # reference's per-shard write semantics). The _tasks APIs get their
+        # OWN worker — the reference's dedicated `management` threadpool —
+        # so task listing/cancellation stays responsive while a slow search
+        # occupies the data worker (TaskManager is internally locked).
         self._executor = ThreadPoolExecutor(max_workers=1)
         self._mgmt_executor = ThreadPoolExecutor(max_workers=1)
 
@@ -162,8 +162,10 @@ class HttpServer:
                 breakers.in_flight_requests.add_estimate_and_maybe_break(
                     len(raw_body), "<http_request>"
                 )
-            mgmt = path.startswith(("/_tasks", "/_nodes", "/_cat",
-                                    "/_cluster"))
+            # only the lock-protected TaskManager endpoints may run
+            # concurrently with the data worker; stats/cat iterate engine
+            # structures that are single-writer
+            mgmt = path.startswith("/_tasks")
             executor = self._mgmt_executor if mgmt else self._executor
             try:
                 # handlers are synchronous work; run them off the event loop

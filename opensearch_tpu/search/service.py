@@ -55,7 +55,7 @@ def search(
         "query", "size", "from", "sort", "_source", "aggs", "aggregations",
         "track_total_hits", "min_score", "search_after", "timeout", "version",
         "seq_no_primary_term", "stored_fields", "explain", "highlight",
-        "docvalue_fields", "fields", "script_fields",
+        "docvalue_fields", "fields", "script_fields", "suggest",
     }
     unknown = set(body) - known_keys
     if unknown:
@@ -331,6 +331,15 @@ def search(
         n_buckets = _count_buckets(response["aggregations"])
         if n_buckets > MAX_BUCKETS:
             raise TooManyBucketsException(n_buckets)
+
+    if body.get("suggest"):
+        from opensearch_tpu.search.suggest import compute_suggest
+
+        response["suggest"] = compute_suggest(
+            body["suggest"],
+            [snap.segments for _, snap, _ in per_shard_results],
+            [s.mapper_service for s in shards],
+        )
     return response
 
 

@@ -1,0 +1,174 @@
+"""mget, _explain, _field_caps, _termvectors, suggesters.
+
+Reference surface: action/get/TransportMultiGetAction,
+action/explain/TransportExplainAction, action/fieldcaps/,
+action/termvectors/, search/suggest/ (SURVEY.md §2.2).
+"""
+
+import pytest
+
+from opensearch_tpu.common.errors import (
+    DocumentMissingException,
+    IllegalArgumentException,
+    ParsingException,
+)
+from opensearch_tpu.node import TpuNode
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = TpuNode(tmp_path / "node")
+    n.create_index("lib", {"mappings": {"properties": {
+        "title": {"type": "text"},
+        "genre": {"type": "keyword"},
+        "year": {"type": "long"},
+        "sugg": {"type": "completion"},
+    }}})
+    docs = [
+        ("1", "the quick brown fox", "animal", 2001, "quick fox"),
+        ("2", "quality quartz quarry", "mineral", 2005, "quality stone"),
+        ("3", "quiet quill writing", "craft", 2010, "quill pen"),
+    ]
+    for _id, title, genre, year, sugg in docs:
+        n.index_doc("lib", _id, {"title": title, "genre": genre,
+                                 "year": year, "sugg": sugg})
+    n.refresh("lib")
+    return n
+
+
+class TestMget:
+    def test_ids_form(self, node):
+        res = node.mget("lib", {"ids": ["1", "3", "missing"]})
+        assert [d.get("found") for d in res["docs"]] == [True, True, False]
+        assert res["docs"][0]["_source"]["genre"] == "animal"
+
+    def test_docs_form_cross_index(self, node):
+        node.create_index("other", {})
+        node.index_doc("other", "x", {"v": 1})
+        res = node.mget(None, {"docs": [
+            {"_index": "lib", "_id": "2"},
+            {"_index": "other", "_id": "x"},
+            {"_index": "nope", "_id": "y"},
+        ]})
+        assert res["docs"][0]["found"] and res["docs"][1]["found"]
+        assert res["docs"][2]["error"]["type"] == "index_not_found_exception"
+
+    def test_source_filtering(self, node):
+        res = node.mget("lib", {"docs": [
+            {"_id": "1", "_source": ["genre"]}]})
+        assert res["docs"][0]["_source"] == {"genre": "animal"}
+
+    def test_requires_body(self, node):
+        with pytest.raises(IllegalArgumentException):
+            node.mget("lib", {})
+
+
+class TestExplain:
+    def test_matching(self, node):
+        res = node.explain("lib", "1", {"query": {"match": {"title": "fox"}}})
+        assert res["matched"] is True
+        assert res["explanation"]["value"] > 0
+
+    def test_not_matching(self, node):
+        res = node.explain("lib", "2", {"query": {"match": {"title": "fox"}}})
+        assert res["matched"] is False
+        assert res["explanation"]["value"] == 0.0
+
+    def test_missing_doc(self, node):
+        with pytest.raises(DocumentMissingException):
+            node.explain("lib", "999", {"query": {"match_all": {}}})
+
+
+class TestFieldCaps:
+    def test_wildcard(self, node):
+        res = node.field_caps("lib", "t*,year")
+        assert "title" in res["fields"] and "year" in res["fields"]
+        assert res["fields"]["title"]["text"]["searchable"] is True
+        assert res["fields"]["title"]["text"]["aggregatable"] is False
+        assert res["fields"]["year"]["long"]["aggregatable"] is True
+
+    def test_conflicting_types_across_indices(self, node):
+        node.create_index("conf", {"mappings": {"properties": {
+            "year": {"type": "keyword"}}}})
+        res = node.field_caps("lib,conf", "year")
+        assert set(res["fields"]["year"]) == {"long", "keyword"}
+
+    def test_requires_fields(self, node):
+        with pytest.raises(IllegalArgumentException):
+            node.field_caps("lib", "")
+
+
+class TestTermvectors:
+    def test_basic(self, node):
+        res = node.termvectors("lib", "1")
+        assert res["found"]
+        terms = res["term_vectors"]["title"]["terms"]
+        assert terms["quick"]["term_freq"] == 1
+        assert set(terms) == {"the", "quick", "brown", "fox"}
+
+    def test_term_statistics(self, node):
+        res = node.termvectors("lib", "1", {"term_statistics": True})
+        assert res["term_vectors"]["title"]["terms"]["quick"]["doc_freq"] == 1
+
+    def test_missing(self, node):
+        assert node.termvectors("lib", "999")["found"] is False
+
+    def test_field_filter(self, node):
+        res = node.termvectors("lib", "1", fields="nope")
+        assert res["term_vectors"] == {}
+
+
+class TestSuggesters:
+    def test_term_suggester_typo(self, node):
+        res = node.search("lib", {"suggest": {
+            "fix": {"text": "quick", "term": {"field": "title"}}}})
+        # "quick" exists -> suggest_mode=missing returns no options
+        assert res["suggest"]["fix"][0]["options"] == []
+        res = node.search("lib", {"suggest": {
+            "fix": {"text": "quik", "term": {"field": "title"}}}})
+        opts = [o["text"] for o in res["suggest"]["fix"][0]["options"]]
+        assert "quick" in opts
+
+    def test_term_suggester_always_mode(self, node):
+        res = node.search("lib", {"suggest": {
+            "fix": {"text": "quick", "term": {
+                "field": "title", "suggest_mode": "always"}}}})
+        assert res["suggest"]["fix"][0]["options"]  # quill/quiet candidates
+
+    def test_phrase_suggester(self, node):
+        res = node.search("lib", {"suggest": {
+            "ph": {"text": "quik fox", "phrase": {"field": "title"}}}})
+        opts = [o["text"] for o in res["suggest"]["ph"][0]["options"]]
+        assert "quick fox" in opts
+
+    def test_completion_suggester(self, node):
+        res = node.search("lib", {"suggest": {
+            "c": {"prefix": "qu", "completion": {"field": "sugg"}}}})
+        opts = [o["text"] for o in res["suggest"]["c"][0]["options"]]
+        assert set(opts) == {"quick fox", "quality stone", "quill pen"}
+
+    def test_global_text(self, node):
+        res = node.search("lib", {"suggest": {
+            "text": "quarz",
+            "a": {"term": {"field": "title"}},
+        }})
+        opts = [o["text"] for o in res["suggest"]["a"][0]["options"]]
+        assert "quartz" in opts
+
+    def test_completion_object_input_form(self, node):
+        # the documented payload form {"input": [...], "weight": N}
+        node.index_doc("lib", "4", {"title": "x", "genre": "g", "year": 1,
+                                    "sugg": {"input": ["quince jam"],
+                                             "weight": 3}})
+        node.refresh("lib")
+        res = node.search("lib", {"suggest": {
+            "c": {"prefix": "quin", "completion": {"field": "sugg"}}}})
+        opts = [o["text"] for o in res["suggest"]["c"][0]["options"]]
+        assert opts == ["quince jam"]
+        # mapping round-trips as completion, not keyword
+        mapping = node.indices["lib"].mapper_service.to_dict()
+        assert mapping["properties"]["sugg"]["type"] == "completion"
+
+    def test_invalid_suggest_rejected(self, node):
+        with pytest.raises(ParsingException):
+            node.search("lib", {"suggest": {"bad": {"term": {}}}})
