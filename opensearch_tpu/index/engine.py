@@ -159,9 +159,17 @@ class Engine:
         return OpResult(doc_id, op_seq, version, created=created,
                         result="created" if created else "updated")
 
-    def delete(self, doc_id: str, seq_no: int | None = None) -> OpResult:
+    def delete(self, doc_id: str, seq_no: int | None = None,
+               if_seq_no: int | None = None) -> OpResult:
         entry = self.version_map.get(doc_id)
         found = (entry is not None and not entry.deleted) or doc_id in self._buffer_pos
+        if if_seq_no is not None:
+            current_seq = entry.seq_no if entry and not entry.deleted else -1
+            if current_seq != if_seq_no:
+                raise VersionConflictException(
+                    f"[{doc_id}]: version conflict, required seqNo "
+                    f"[{if_seq_no}], current document has seqNo [{current_seq}]"
+                )
         if seq_no is not None and entry is not None and entry.seq_no >= seq_no:
             # stale op (see index()): ignore, a newer op already applied
             self._seq_no = max(self._seq_no, seq_no)

@@ -46,8 +46,9 @@ class IndexShard:
     ) -> OpResult:
         return self.engine.index(doc_id, source, routing, seq_no=seq_no)
 
-    def apply_delete_on_primary(self, doc_id: str) -> OpResult:
-        return self.engine.delete(doc_id)
+    def apply_delete_on_primary(self, doc_id: str,
+                                if_seq_no: int | None = None) -> OpResult:
+        return self.engine.delete(doc_id, if_seq_no=if_seq_no)
 
     def apply_delete_on_replica(self, doc_id: str, seq_no: int) -> OpResult:
         return self.engine.delete(doc_id, seq_no=seq_no)

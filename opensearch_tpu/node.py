@@ -861,11 +861,12 @@ class TpuNode:
         }
 
     def delete_doc(self, index: str, doc_id: str, routing: str | None = None,
-                   refresh: bool = False) -> dict:
+                   refresh: bool = False,
+                   if_seq_no: int | None = None) -> dict:
         index, routing = self._resolve_write_alias(index, routing)
         svc = self._get_open_index(index)
         shard = svc.shard_for(doc_id, routing)
-        result = shard.apply_delete_on_primary(doc_id)
+        result = shard.apply_delete_on_primary(doc_id, if_seq_no=if_seq_no)
         if refresh:
             shard.refresh()
         return {

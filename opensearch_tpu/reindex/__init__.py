@@ -1,0 +1,7 @@
+from opensearch_tpu.reindex.service import (
+    delete_by_query,
+    reindex,
+    update_by_query,
+)
+
+__all__ = ["reindex", "update_by_query", "delete_by_query"]

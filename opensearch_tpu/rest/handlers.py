@@ -140,6 +140,10 @@ def build_router() -> Router:
     reg("DELETE", "/_snapshot/{repo}/{snapshot}", delete_snapshot)
     reg("POST", "/_snapshot/{repo}/{snapshot}/_restore", restore_snapshot)
     reg("GET", "/_snapshot/{repo}/{snapshot}/_status", snapshot_status)
+    # reindex family
+    reg("POST", "/_reindex", reindex_handler)
+    reg("POST", "/{index}/_update_by_query", update_by_query_handler)
+    reg("POST", "/{index}/_delete_by_query", delete_by_query_handler)
     # tasks
     reg("GET", "/_tasks", list_tasks)
     reg("GET", "/_tasks/{task_id}", get_task)
@@ -449,6 +453,32 @@ def search_all(node: TpuNode, params, query, body):
     return 200, node.search(None, _body_with_query_params(query, body),
                             scroll=query.get("scroll"),
                             search_pipeline=query.get("search_pipeline"))
+
+
+def reindex_handler(node: TpuNode, params, query, body):
+    from opensearch_tpu.reindex import reindex as do_reindex
+
+    return 200, do_reindex(node, body or {}, refresh=_refresh_param(query))
+
+
+def update_by_query_handler(node: TpuNode, params, query, body):
+    from opensearch_tpu.reindex import update_by_query
+
+    return 200, update_by_query(
+        node, params["index"], body or {},
+        conflicts=query.get("conflicts"),
+        refresh=_refresh_param(query),
+    )
+
+
+def delete_by_query_handler(node: TpuNode, params, query, body):
+    from opensearch_tpu.reindex import delete_by_query
+
+    return 200, delete_by_query(
+        node, params["index"], body or {},
+        conflicts=query.get("conflicts"),
+        refresh=_refresh_param(query),
+    )
 
 
 def _parse_task_id(raw: str) -> int:
