@@ -400,7 +400,14 @@ def save_segment(seg: HostSegment, directory: Path) -> None:
     for fname, tf in seg.text_fields.items():
         key = f"text:{fname}"
         arrays[f"{key}:offsets"] = tf.term_offsets
-        arrays[f"{key}:docs"] = tf.postings_docs
+        # postings doc ids are stored zigzag-delta varint encoded (the
+        # native codec, ~1 byte/doc on ascending runs — Lucene's varint
+        # postings analog); ":docs_vint" presence selects the format
+        from opensearch_tpu import native as _native
+
+        arrays[f"{key}:docs_vint"] = np.frombuffer(
+            _native.varint_encode(tf.postings_docs), dtype=np.uint8
+        )
         arrays[f"{key}:tfs"] = tf.postings_tfs
         arrays[f"{key}:doc_len"] = tf.doc_len
         meta["text_fields"][fname] = {
@@ -437,6 +444,14 @@ def save_segment(seg: HostSegment, directory: Path) -> None:
             f.write(src)
 
 
+def _load_postings_docs(arrays, key: str):
+    if f"{key}:docs_vint" in arrays:
+        from opensearch_tpu import native as _native
+
+        return _native.varint_decode(arrays[f"{key}:docs_vint"].tobytes())
+    return arrays[f"{key}:docs"]  # legacy raw-int32 format
+
+
 def load_segment(directory: Path, name: str) -> HostSegment:
     meta = json.loads((directory / f"{name}.json").read_text())
     arrays = np.load(directory / f"{name}.npz", allow_pickle=False)
@@ -467,7 +482,7 @@ def load_segment(directory: Path, name: str) -> HostSegment:
             terms=terms,
             term_dict={t: i for i, t in enumerate(terms)},
             term_offsets=arrays[f"{key}:offsets"],
-            postings_docs=arrays[f"{key}:docs"],
+            postings_docs=_load_postings_docs(arrays, key),
             postings_tfs=arrays[f"{key}:tfs"],
             doc_len=arrays[f"{key}:doc_len"],
             total_terms=m["total_terms"],

@@ -63,10 +63,24 @@ class Translog:
                 generation=1, offset=0, num_ops=0, max_seq_no=-1, min_generation=1
             )
             self._write_checkpoint()
-        self._file = open(self._gen_path(self.checkpoint.generation), "ab")
-        # a crash may have left unsynced garbage past the checkpoint offset
-        self._file.truncate(self.checkpoint.offset)
-        self._file.seek(self.checkpoint.offset)
+        self._open_writer()
+
+    def _open_writer(self) -> None:
+        """Native C++ buffered writer when available (the reference's WAL
+        append runs on the JVM's intrinsified channel path; ours is
+        native/tlog_codec.cpp), else a Python file. Both truncate to the
+        checkpoint offset — a crash may have left unsynced garbage."""
+        from opensearch_tpu import native
+
+        path = self._gen_path(self.checkpoint.generation)
+        if native.native_available():
+            self._native = native.NativeTlogWriter(path, self.checkpoint.offset)
+            self._file = None
+        else:
+            self._native = None
+            self._file = open(path, "ab")
+            self._file.truncate(self.checkpoint.offset)
+            self._file.seek(self.checkpoint.offset)
 
     def _gen_path(self, gen: int) -> Path:
         return self.dir / f"translog-{gen}.tlog"
@@ -85,9 +99,12 @@ class Translog:
         """Append one op; returns its byte location. Caller syncs (per
         request by default, like index.translog.durability=REQUEST)."""
         payload = json.dumps(op).encode()
-        record = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
-        location = self._file.tell()
-        self._file.write(record)
+        if self._native is not None:
+            location = self._native.append(payload)
+        else:
+            record = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+            location = self._file.tell()
+            self._file.write(record)
         self.checkpoint.num_ops += 1
         seq_no = int(op.get("seq_no", -1))
         if seq_no > self.checkpoint.max_seq_no:
@@ -95,15 +112,19 @@ class Translog:
         return location
 
     def sync(self) -> None:
-        self._file.flush()
-        os.fsync(self._file.fileno())
-        self.checkpoint.offset = self._file.tell()
+        if self._native is not None:
+            self._native.sync()
+            self.checkpoint.offset = self._native.tell()
+        else:
+            self._file.flush()
+            os.fsync(self._file.fileno())
+            self.checkpoint.offset = self._file.tell()
         self._write_checkpoint()
 
     def roll_generation(self) -> None:
         """Seal the current generation and start a new one (flush path)."""
         self.sync()
-        self._file.close()
+        self._close_writer()
         self.checkpoint = Checkpoint(
             generation=self.checkpoint.generation + 1,
             offset=0,
@@ -111,8 +132,16 @@ class Translog:
             max_seq_no=self.checkpoint.max_seq_no,
             min_generation=self.checkpoint.min_generation,
         )
-        self._file = open(self._gen_path(self.checkpoint.generation), "ab")
+        self._open_writer()
         self._write_checkpoint()
+
+    def _close_writer(self) -> None:
+        if self._native is not None:
+            self._native.close()
+            self._native = None
+        if self._file is not None:
+            self._file.close()
+            self._file = None
 
     def trim_below(self, generation: int) -> None:
         """Delete generations < generation (their ops are in committed
@@ -171,4 +200,4 @@ class Translog:
 
     def close(self) -> None:
         self.sync()
-        self._file.close()
+        self._close_writer()
