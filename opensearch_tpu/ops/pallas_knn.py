@@ -18,7 +18,9 @@ Top-k selection avoids lax.top_k/sort (not Mosaic-lowerable) by K rounds
 of max/argmax with iota-equality one-hot gathers — K is small (<= 64).
 
 CPU fallback runs the same kernel under interpret=True (used by tests);
-the shape/dtype contract matches fused.knn_topk (padding ids = -1).
+the shape/dtype contract matches fused.knn_topk, except that slots past
+the valid-doc count carry id -1 (explicit, vs fused's arbitrary masked
+indices) — see pallas_knn_topk's docstring.
 
 Measured on v5e-1 (1M x 128d, B=104, k=10, through the axon tunnel whose
 fixed round-trip is ~72ms): XLA fused path ~2ms on-device, this kernel
@@ -97,8 +99,11 @@ def _knn_block_kernel(
 
     @pl.when(improves)
     def _merge():
-        ext_vals = jnp.concatenate([scores, vals_scr[:]], axis=1)
-        ext_ids = jnp.concatenate([block_ids, ids_scr[:]], axis=1)
+        # carried entries FIRST: argmax takes the first maximum, so on
+        # score ties the earlier (lower doc id) entry wins — the
+        # lax.top_k / Lucene doc-id-ascending tie-break the reduce relies on
+        ext_vals = jnp.concatenate([vals_scr[:], scores], axis=1)
+        ext_ids = jnp.concatenate([ids_scr[:], block_ids], axis=1)
         width = BLOCK + k
         col = jax.lax.broadcasted_iota(jnp.int32, (B, width), 1)
         colk = jax.lax.broadcasted_iota(jnp.int32, (B, k), 1)
@@ -150,10 +155,14 @@ def pallas_knn_topk(
     similarity: str = "l2_norm",
     interpret: bool = False,
 ):
-    """Returns (scores [B, k], ids [B, k]); ids == -1 past the valid count.
+    """Returns (scores [B, k], ids [B, k]).
 
-    Callers pad n to a BLOCK multiple (pad rows valid=False) and B to a
-    sublane multiple; `knn_topk_auto` below does both.
+    When fewer than k docs are valid, trailing entries are (-inf, -1) —
+    NOTE this differs from fused.knn_topk, which returns arbitrary masked
+    indices with -inf scores: callers must drop entries with id < 0 (or
+    non-finite score) BEFORE gathering, since -1 wraps to the last row in
+    jnp/numpy indexing. Callers pad n to a BLOCK multiple (pad rows
+    valid=False) and B to a sublane multiple; `knn_topk_auto` does both.
     """
     n, d = vectors.shape
     B = queries.shape[0]
@@ -205,9 +214,7 @@ def pallas_knn_topk(
 def knn_topk_auto(vectors, norms_sq, valid, queries, *, k: int,
                   similarity: str = "l2_norm"):
     """Pad-and-dispatch wrapper: pallas on TPU, interpret-mode elsewhere."""
-    import numpy as np
-
-    n, d = vectors.shape
+    n = vectors.shape[0]
     B = queries.shape[0]
     n_pad = -(-n // BLOCK) * BLOCK
     b_pad = max(8, -(-B // 8) * 8)

@@ -14,7 +14,7 @@ from opensearch_tpu.ops import fused
 from opensearch_tpu.ops.pallas_knn import BLOCK, knn_topk_auto
 
 
-def _setup(rng, n, d, similarity="l2_norm"):
+def _setup(rng, n, d):
     data = rng.standard_normal((n, d)).astype(np.float32)
     vecs = jnp.asarray(data)
     norms = jnp.sum(vecs * vecs, -1)
@@ -51,6 +51,20 @@ class TestPallasKnn:
         assert set(ids[0, :3]) == {0, 1, 2}
         assert np.all(ids[:, 3:] == -1)
         assert np.all(np.isinf(np.asarray(vals)[:, 3:]))
+
+    def test_tie_break_prefers_lower_doc_id(self):
+        # duplicate vectors straddling a tile boundary: lower id first
+        rng = np.random.default_rng(3)
+        n, d, k = BLOCK + 64, 8, 4
+        data, vecs, norms = _setup(rng, n, d)
+        dup = data[3]
+        data[BLOCK + 5] = dup
+        vecs = jnp.asarray(data)
+        norms = jnp.sum(vecs * vecs, -1)
+        q = jnp.asarray(dup[None, :])
+        _, ids = knn_topk_auto(vecs, norms, jnp.ones(n, bool), q, k=k)
+        ids = np.asarray(ids)[0]
+        assert ids[0] == 3 and ids[1] == BLOCK + 5
 
     def test_exact_block_multiple(self):
         rng = np.random.default_rng(2)
