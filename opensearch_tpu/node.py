@@ -1280,6 +1280,7 @@ class TpuNode:
         task=None,
     ) -> dict:
         """search_service.search wrapped in the pipeline pre/post steps."""
+        body = self._resolve_mlt_doc_refs(body, index_names)
         pl, pr_config = self._resolve_search_pipeline(pipeline_id, index_names)
         pl_ctx = {}
         if pl is not None:
@@ -1295,6 +1296,72 @@ class TpuNode:
                 pl, {**body, **pl_ctx}, resp
             )
         return resp
+
+    def _resolve_mlt_doc_refs(self, body: dict,
+                              index_names: list[str] | None = None) -> dict:
+        """Resolve more_like_this {_index,_id} doc refs to their field
+        texts BEFORE shard execution (the two-phase rewrite of
+        MoreLikeThisQueryBuilder, which multi-gets the like-docs)."""
+        found_refs = False
+
+        def scan(obj):
+            nonlocal found_refs
+            if isinstance(obj, dict):
+                mlt = obj.get("more_like_this")
+                if isinstance(mlt, dict):
+                    like = mlt.get("like")
+                    likes = (like if isinstance(like, list)
+                             else [like] if like is not None else [])
+                    if any(isinstance(x, dict) for x in likes):
+                        found_refs = True
+                for v in obj.values():
+                    scan(v)
+            elif isinstance(obj, list):
+                for x in obj:
+                    scan(x)
+
+        scan(body)
+        if not found_refs:
+            return body
+        import copy
+
+        body = copy.deepcopy(body)
+
+        def resolve(obj):
+            if isinstance(obj, dict):
+                mlt = obj.get("more_like_this")
+                if isinstance(mlt, dict):
+                    like = mlt.get("like")
+                    likes = (like if isinstance(like, list)
+                             else [like] if like is not None else [])
+                    texts = [x for x in likes if isinstance(x, str)]
+                    fields = mlt.get("fields")
+                    default_index = (index_names or [""])[0]
+                    for ref in (x for x in likes if isinstance(x, dict)):
+                        try:
+                            got = self.get_doc(
+                                str(ref.get("_index", default_index)),
+                                str(ref.get("_id", "")),
+                            )
+                        except OpenSearchTpuException:
+                            continue
+                        if not got.get("found"):
+                            continue
+                        flat = _flatten_source_fields(got["_source"])
+                        for fname, val in flat.items():
+                            if fields and fname not in fields:
+                                continue
+                            vals = val if isinstance(val, list) else [val]
+                            texts.extend(str(v) for v in vals)
+                    mlt["like"] = texts
+                for v in obj.values():
+                    resolve(v)
+            elif isinstance(obj, list):
+                for x in obj:
+                    resolve(x)
+
+        resolve(body)
+        return body
 
     def _resolve_search_pipeline(
         self, pipeline_id: str | None, index_names: list[str]

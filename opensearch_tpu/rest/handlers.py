@@ -140,6 +140,11 @@ def build_router() -> Router:
     reg("DELETE", "/_snapshot/{repo}/{snapshot}", delete_snapshot)
     reg("POST", "/_snapshot/{repo}/{snapshot}/_restore", restore_snapshot)
     reg("GET", "/_snapshot/{repo}/{snapshot}/_status", snapshot_status)
+    # rank eval
+    reg("GET", "/{index}/_rank_eval", rank_eval_handler)
+    reg("POST", "/{index}/_rank_eval", rank_eval_handler)
+    reg("GET", "/_rank_eval", rank_eval_all)
+    reg("POST", "/_rank_eval", rank_eval_all)
     # reindex family
     reg("POST", "/_reindex", reindex_handler)
     reg("POST", "/{index}/_update_by_query", update_by_query_handler)
@@ -453,6 +458,18 @@ def search_all(node: TpuNode, params, query, body):
     return 200, node.search(None, _body_with_query_params(query, body),
                             scroll=query.get("scroll"),
                             search_pipeline=query.get("search_pipeline"))
+
+
+def rank_eval_handler(node: TpuNode, params, query, body):
+    from opensearch_tpu.search.rank_eval import rank_eval
+
+    return 200, rank_eval(node, params["index"], body or {})
+
+
+def rank_eval_all(node: TpuNode, params, query, body):
+    from opensearch_tpu.search.rank_eval import rank_eval
+
+    return 200, rank_eval(node, None, body or {})
 
 
 def reindex_handler(node: TpuNode, params, query, body):

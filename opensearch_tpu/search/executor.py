@@ -170,6 +170,228 @@ class ShardContext:
         self._knn_cache[id(node)] = out
         return out
 
+    def mlt_rewrite(self, node) -> Any:
+        """MoreLikeThisQuery -> bool-should of term queries, selected by
+        TF-IDF over the shard's stats (MoreLikeThisQueryBuilder's term
+        selection). Cached per shard."""
+        cached = self._qs_cache.get(("mlt", id(node)))
+        if cached is not None:
+            return cached
+        import math
+
+        from opensearch_tpu.search import query_dsl as qd
+
+        fields = node.fields or [
+            f for f, m in self.mapper_service.mappers.items()
+            if m.type == "text"
+        ]
+        total_docs = max(self.snapshot.num_docs, 1)
+
+        def shard_doc_freq(field, term):
+            return sum(
+                host.text_fields[field].doc_freq(term)
+                for host, _ in self.snapshot.segments
+                if field in host.text_fields
+            )
+
+        scored: list[tuple[float, str, str]] = []
+        for field in fields:
+            tf_counts: dict[str, int] = {}
+            for text in node.like_texts:
+                for term in self.mapper_service.analyze_query_text(field, text):
+                    tf_counts[term] = tf_counts.get(term, 0) + 1
+            for term, tf in tf_counts.items():
+                if tf < node.min_term_freq:
+                    continue
+                df = shard_doc_freq(field, term)
+                if df < node.min_doc_freq or df == 0:
+                    continue  # absent terms can never match this shard
+                idf = math.log(1.0 + total_docs / df)
+                scored.append((tf * idf, field, term))
+        scored.sort(key=lambda s: (-s[0], s[1], s[2]))
+        top = scored[: node.max_query_terms]
+        should = [
+            qd.TermQuery(field=f, value=t, boost=w) for w, f, t in top
+        ]
+        msm = node.minimum_should_match
+        try:
+            if isinstance(msm, str) and msm.endswith("%"):
+                msm_n = int(len(should) * int(msm[:-1]) / 100)
+            else:
+                msm_n = int(msm)
+        except ValueError:
+            raise ParsingException(
+                f"unsupported [minimum_should_match] value [{msm}] for "
+                "[more_like_this] (use an integer or \"N%\")"
+            ) from None
+        tree = qd.BoolQuery(
+            should=should, minimum_should_match=max(msm_n, 1) if should else None,
+            boost=node.boost,
+        ) if should else qd.MatchNoneQuery()
+        self._qs_cache[("mlt", id(node))] = tree
+        return tree
+
+    def percolate_masks(self, node) -> list:
+        """Per-segment bool masks for a PercolateQuery: each live doc whose
+        stored query (at node.field in _source) matches ANY of the provided
+        documents. The documents build one tiny in-memory index; each
+        stored query executes against it (the percolator module's memory-
+        index approach)."""
+        cached = self._qs_cache.get(("perc", id(node)))
+        if cached is not None:
+            return cached
+        import json as _json
+
+        import numpy as np
+
+        from opensearch_tpu.index.device import to_device
+        from opensearch_tpu.index.engine import SearcherSnapshot
+        from opensearch_tpu.index.segment import SegmentBuilder
+        from opensearch_tpu.search import query_dsl as qd
+
+        # a search must never mutate index schema: percolated documents are
+        # parsed against a CLONE of the mapper service so dynamic mappings
+        # introduced by the candidate doc stay local to this query
+        import copy as _copy
+
+        tmp_ms = _copy.copy(self.mapper_service)
+        tmp_ms.mappers = dict(self.mapper_service.mappers)
+        builder = SegmentBuilder(tmp_ms, "_percolate_tmp")
+        for i, doc in enumerate(node.documents):
+            builder.add(
+                tmp_ms.parse_document(f"_tmp_{i}", doc), seq_no=i
+            )
+        tmp_host = builder.build()
+        tmp_dev = to_device(tmp_host)
+        tmp_snap = SearcherSnapshot(segments=[(tmp_host, tmp_dev)], generation=0)
+        tmp_ctx = ShardContext(tmp_snap, tmp_ms)
+        tmp_ex = SegmentExecutor(tmp_ctx, tmp_host, tmp_dev)
+
+        masks = []
+        for host, dev in self.snapshot.segments:
+            mask = np.zeros(dev.n_pad, bool)
+            for d in range(host.n_docs):
+                if not host.live[d]:
+                    continue
+                source = _json.loads(host.sources[d])
+                stored = source.get(node.field)
+                if not isinstance(stored, dict):
+                    continue
+                try:
+                    parsed = qd.parse_query(stored)
+                    r = tmp_ex.execute(parsed)
+                    if bool(np.asarray(r.mask)[: tmp_host.n_docs].any()):
+                        mask[d] = True
+                except Exception:
+                    continue  # malformed stored query never matches
+            masks.append(mask)
+        self._qs_cache[("perc", id(node))] = masks
+        return masks
+
+    def join_masks(self, node) -> list:
+        """Per-segment masks for has_child / has_parent / parent_id.
+
+        Children are routed to the parent's shard (callers index with
+        routing=parent id), so the join closes over this shard's segments
+        (parent-join module invariant)."""
+        cached = self._qs_cache.get(("join", id(node)))
+        if cached is not None:
+            return cached
+        import json as _json
+
+        import numpy as np
+
+        from opensearch_tpu.search import query_dsl as qd
+
+        join_field = None
+        for f, m in self.mapper_service.mappers.items():
+            if m.type == "join":
+                join_field = f
+                break
+        name_col = f"{join_field}#name" if join_field else None
+
+        def names_of(host):
+            kf = host.keyword_fields.get(name_col) if name_col else None
+            return kf
+
+        def doc_relation(host, d):
+            kf = names_of(host)
+            if kf is None:
+                return None
+            o = kf.first_ord[d]
+            return kf.ord_values[o] if o >= 0 else None
+
+        def doc_parent(host, d):
+            kf = host.keyword_fields.get(f"{join_field}#parent")
+            if kf is None:
+                return None
+            o = kf.first_ord[d]
+            return kf.ord_values[o] if o >= 0 else None
+
+        masks = []
+        if isinstance(node, qd.ParentIdQuery):
+            for host, dev in self.snapshot.segments:
+                mask = np.zeros(dev.n_pad, bool)
+                for d in range(host.n_docs):
+                    if (host.live[d] and doc_relation(host, d) == node.type
+                            and doc_parent(host, d) == node.id):
+                        mask[d] = True
+                masks.append(mask)
+        elif isinstance(node, qd.HasChildQuery):
+            # which relation is the parent of node.type? (multi-level joins:
+            # a mid-level relation is both a child and a parent)
+            join_mapper = self.mapper_service.mappers.get(join_field)
+            parent_names = {
+                p for p, children in (
+                    (join_mapper.relations or {}) if join_mapper else {}
+                ).items()
+                if node.type in children
+            }
+            # pass 1: matching children -> parent ids (across segments)
+            parent_counts: dict[str, int] = {}
+            for host, dev in self.snapshot.segments:
+                ex = SegmentExecutor(self, host, dev)
+                child_mask = np.asarray(ex.execute(node.query).mask)
+                for d in range(host.n_docs):
+                    if (host.live[d] and child_mask[d]
+                            and doc_relation(host, d) == node.type):
+                        p = doc_parent(host, d)
+                        if p is not None:
+                            parent_counts[p] = parent_counts.get(p, 0) + 1
+            wanted = {
+                p for p, c in parent_counts.items()
+                if node.min_children <= c <= node.max_children
+            }
+            # pass 2: docs of the parent relation whose _id is in the set
+            for host, dev in self.snapshot.segments:
+                mask = np.zeros(dev.n_pad, bool)
+                for d in range(host.n_docs):
+                    if (host.live[d] and host.doc_ids[d] in wanted
+                            and doc_relation(host, d) in parent_names):
+                        mask[d] = True
+                masks.append(mask)
+        elif isinstance(node, qd.HasParentQuery):
+            # pass 1: matching parents -> their _ids
+            parent_ids: set[str] = set()
+            for host, dev in self.snapshot.segments:
+                ex = SegmentExecutor(self, host, dev)
+                pmask = np.asarray(ex.execute(node.query).mask)
+                for d in range(host.n_docs):
+                    if (host.live[d] and pmask[d]
+                            and doc_relation(host, d) == node.parent_type):
+                        parent_ids.add(host.doc_ids[d])
+            # pass 2: children pointing at those parents
+            masks = []
+            for host, dev in self.snapshot.segments:
+                mask = np.zeros(dev.n_pad, bool)
+                for d in range(host.n_docs):
+                    if (host.live[d]
+                            and doc_parent(host, d) in parent_ids):
+                        mask[d] = True
+                masks.append(mask)
+        self._qs_cache[("join", id(node))] = masks
+        return masks
+
     def text_stats(self, field: str) -> tuple[int, float]:
         """(doc_count, avgdl) across all segments of the shard."""
         doc_count = 0
@@ -754,6 +976,35 @@ class SegmentExecutor:
         # dotted columns, so the inner query already addresses path.field.
         r = self.execute(node.query)
         return NodeResult(r.scores * node.boost, r.mask, r.scoring)
+
+    def _exec_MoreLikeThisQuery(self, node: q.MoreLikeThisQuery) -> NodeResult:
+        return self.execute(self.ctx.mlt_rewrite(node))
+
+    def _seg_index(self) -> int:
+        for i, (host, _dev) in enumerate(self.ctx.snapshot.segments):
+            if host is self.host:
+                return i
+        return 0
+
+    def _exec_PercolateQuery(self, node: q.PercolateQuery) -> NodeResult:
+        mask_host = self.ctx.percolate_masks(node)[self._seg_index()]
+        mask = jnp.asarray(mask_host) & self.dev.live
+        return _const_result(mask, node.boost, scoring=True)
+
+    def _exec_HasChildQuery(self, node: q.HasChildQuery) -> NodeResult:
+        mask_host = self.ctx.join_masks(node)[self._seg_index()]
+        mask = jnp.asarray(mask_host) & self.dev.live
+        return _const_result(mask, node.boost, scoring=True)
+
+    def _exec_HasParentQuery(self, node: q.HasParentQuery) -> NodeResult:
+        mask_host = self.ctx.join_masks(node)[self._seg_index()]
+        mask = jnp.asarray(mask_host) & self.dev.live
+        return _const_result(mask, node.boost, scoring=True)
+
+    def _exec_ParentIdQuery(self, node: q.ParentIdQuery) -> NodeResult:
+        mask_host = self.ctx.join_masks(node)[self._seg_index()]
+        mask = jnp.asarray(mask_host) & self.dev.live
+        return _const_result(mask, node.boost, scoring=True)
 
     def _exec_HybridQuery(self, node: q.HybridQuery) -> NodeResult:
         # Executor-level fallback (no search pipeline): max combination.

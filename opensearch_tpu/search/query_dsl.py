@@ -233,6 +233,52 @@ class HybridQuery(QueryNode):
 
 
 @dataclass
+class MoreLikeThisQuery(QueryNode):
+    """TF-IDF representative-term selection over like-texts (reference:
+    index/query/MoreLikeThisQueryBuilder; doc refs are resolved to texts
+    before shard execution, like the two-phase rewrite)."""
+
+    fields: list[str] = dc_field(default_factory=list)
+    like_texts: list[str] = dc_field(default_factory=list)
+    like_docs: list[dict] = dc_field(default_factory=list)  # {_index, _id}
+    min_term_freq: int = 2
+    min_doc_freq: int = 5
+    max_query_terms: int = 25
+    minimum_should_match: str = "30%"
+
+
+@dataclass
+class PercolateQuery(QueryNode):
+    """Reverse search: match stored queries against provided documents
+    (reference: modules/percolator PercolateQueryBuilder)."""
+
+    field: str = ""
+    documents: list[dict] = dc_field(default_factory=list)
+
+
+@dataclass
+class HasChildQuery(QueryNode):
+    type: str = ""
+    query: QueryNode | None = None
+    score_mode: str = "none"     # none | sum | max | avg
+    min_children: int = 1
+    max_children: int = 2**31 - 1
+
+
+@dataclass
+class HasParentQuery(QueryNode):
+    parent_type: str = ""
+    query: QueryNode | None = None
+    score: bool = False
+
+
+@dataclass
+class ParentIdQuery(QueryNode):
+    type: str = ""
+    id: str = ""
+
+
+@dataclass
 class GenericScriptScoreQuery(QueryNode):
     """script_score with an arbitrary painless script (per-doc host eval);
     the recognized vector-function patterns compile to the fused device
@@ -657,7 +703,80 @@ def _parse_script_query(body: dict) -> QueryNode:
     return ScriptQuery(script=body["script"], boost=float(body.get("boost", 1.0)))
 
 
+def _parse_more_like_this(conf: dict) -> QueryNode:
+    like = conf.get("like")
+    if like is None:
+        raise ParsingException("[more_like_this] requires [like]")
+    likes = like if isinstance(like, list) else [like]
+    texts = [x for x in likes if isinstance(x, str)]
+    docs = [x for x in likes if isinstance(x, dict)]
+    fields = conf.get("fields") or []
+    return MoreLikeThisQuery(
+        fields=list(fields),
+        like_texts=texts,
+        like_docs=docs,
+        min_term_freq=int(conf.get("min_term_freq", 2)),
+        min_doc_freq=int(conf.get("min_doc_freq", 5)),
+        max_query_terms=int(conf.get("max_query_terms", 25)),
+        minimum_should_match=str(conf.get("minimum_should_match", "30%")),
+        boost=float(conf.get("boost", 1.0)),
+    )
+
+
+def _parse_percolate(conf: dict) -> QueryNode:
+    if not isinstance(conf, dict) or not conf.get("field"):
+        raise ParsingException("[percolate] requires [field]")
+    if "document" in conf:
+        documents = [conf["document"]]
+    elif "documents" in conf:
+        documents = list(conf["documents"])
+    else:
+        raise ParsingException("[percolate] requires [document] or [documents]")
+    return PercolateQuery(
+        field=conf["field"], documents=documents,
+        boost=float(conf.get("boost", 1.0)),
+    )
+
+
+def _parse_has_child(conf: dict) -> QueryNode:
+    if not conf.get("type") or "query" not in conf:
+        raise ParsingException("[has_child] requires [type] and [query]")
+    return HasChildQuery(
+        type=conf["type"],
+        query=parse_query(conf["query"]),
+        score_mode=conf.get("score_mode", "none"),
+        min_children=int(conf.get("min_children", 1)),
+        max_children=int(conf.get("max_children", 2**31 - 1)),
+        boost=float(conf.get("boost", 1.0)),
+    )
+
+
+def _parse_has_parent(conf: dict) -> QueryNode:
+    if not conf.get("parent_type") or "query" not in conf:
+        raise ParsingException("[has_parent] requires [parent_type] and [query]")
+    return HasParentQuery(
+        parent_type=conf["parent_type"],
+        query=parse_query(conf["query"]),
+        score=bool(conf.get("score", False)),
+        boost=float(conf.get("boost", 1.0)),
+    )
+
+
+def _parse_parent_id(conf: dict) -> QueryNode:
+    if not conf.get("type") or conf.get("id") is None:
+        raise ParsingException("[parent_id] requires [type] and [id]")
+    return ParentIdQuery(
+        type=conf["type"], id=str(conf["id"]),
+        boost=float(conf.get("boost", 1.0)),
+    )
+
+
 _PARSERS = {
+    "more_like_this": _parse_more_like_this,
+    "percolate": _parse_percolate,
+    "has_child": _parse_has_child,
+    "has_parent": _parse_has_parent,
+    "parent_id": _parse_parent_id,
     "match_all": _parse_match_all,
     "match_none": _parse_match_none,
     "match": _parse_match,

@@ -1,0 +1,303 @@
+"""Module parity: more_like_this, percolator, parent-join, rank-eval.
+
+Reference surface: index/query/MoreLikeThisQueryBuilder, modules/percolator,
+modules/parent-join, modules/rank-eval (SURVEY.md §2.3).
+"""
+
+import pytest
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentException,
+    MapperParsingException,
+    ParsingException,
+)
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.search.rank_eval import rank_eval
+
+
+@pytest.fixture()
+def node(tmp_path):
+    return TpuNode(tmp_path / "node")
+
+
+class TestMoreLikeThis:
+    @pytest.fixture()
+    def corpus(self, node):
+        node.create_index("art", {"mappings": {"properties": {
+            "body": {"type": "text"}}}})
+        docs = [
+            ("1", "machine learning models learn patterns from data"),
+            ("2", "deep learning models use neural networks and data"),
+            ("3", "gardening tips for growing tomato plants at home"),
+            ("4", "neural networks learn hierarchical data patterns"),
+            ("5", "tomato plants need water sunlight and patience"),
+        ]
+        for _id, body in docs:
+            node.index_doc("art", _id, {"body": body})
+        node.refresh("art")
+        return node
+
+    def test_like_text(self, corpus):
+        res = corpus.search("art", {"query": {"more_like_this": {
+            "fields": ["body"],
+            "like": "learning models data neural patterns",
+            "min_term_freq": 1, "min_doc_freq": 1,
+        }}})
+        ids = [h["_id"] for h in res["hits"]["hits"]]
+        assert set(ids[:3]) == {"1", "2", "4"}
+        assert "3" not in ids and "5" not in ids or ids.index("3") > 2
+
+    def test_like_doc_reference(self, corpus):
+        res = corpus.search("art", {"query": {"more_like_this": {
+            "fields": ["body"],
+            "like": [{"_index": "art", "_id": "1"}],
+            "min_term_freq": 1, "min_doc_freq": 1,
+        }}})
+        ids = [h["_id"] for h in res["hits"]["hits"]]
+        # similar ML docs rank above gardening docs
+        assert "2" in ids or "4" in ids
+        assert ids[0] != "3"
+
+    def test_requires_like(self, corpus):
+        with pytest.raises(ParsingException):
+            corpus.search("art", {"query": {"more_like_this": {
+                "fields": ["body"]}}})
+
+
+class TestPercolator:
+    @pytest.fixture()
+    def queries_index(self, node):
+        node.create_index("alerts", {"mappings": {"properties": {
+            "q": {"type": "percolator"},
+            "msg": {"type": "text"},
+            "level": {"type": "keyword"},
+        }}})
+        node.index_doc("alerts", "err", {
+            "q": {"match": {"msg": "error"}}})
+        node.index_doc("alerts", "crit", {
+            "q": {"bool": {"must": [
+                {"match": {"msg": "error"}},
+                {"term": {"level": "critical"}}]}}})
+        node.index_doc("alerts", "disk", {
+            "q": {"match": {"msg": "disk full"}}})
+        node.refresh("alerts")
+        return node
+
+    def test_percolate_single_doc(self, queries_index):
+        res = queries_index.search("alerts", {"query": {"percolate": {
+            "field": "q",
+            "document": {"msg": "an error occurred", "level": "warn"},
+        }}})
+        assert {h["_id"] for h in res["hits"]["hits"]} == {"err"}
+
+    def test_percolate_matches_multiple_queries(self, queries_index):
+        res = queries_index.search("alerts", {"query": {"percolate": {
+            "field": "q",
+            "document": {"msg": "disk full error", "level": "critical"},
+        }}})
+        assert {h["_id"] for h in res["hits"]["hits"]} == {"err", "crit", "disk"}
+
+    def test_percolate_documents_any_match(self, queries_index):
+        res = queries_index.search("alerts", {"query": {"percolate": {
+            "field": "q",
+            "documents": [{"msg": "all fine"}, {"msg": "disk full"}],
+        }}})
+        assert {h["_id"] for h in res["hits"]["hits"]} == {"disk"}
+
+    def test_requires_document(self, queries_index):
+        with pytest.raises(ParsingException):
+            queries_index.search("alerts", {"query": {"percolate": {
+                "field": "q"}}})
+
+
+class TestParentJoin:
+    @pytest.fixture()
+    def store(self, node):
+        node.create_index("qa", {
+            "settings": {"index": {"number_of_shards": 2}},
+            "mappings": {"properties": {
+                "rel": {"type": "join",
+                        "relations": {"question": "answer"}},
+                "text": {"type": "text"},
+                "votes": {"type": "long"},
+            }},
+        })
+        node.index_doc("qa", "q1", {"rel": "question",
+                                    "text": "how do tpus work"})
+        node.index_doc("qa", "q2", {"rel": "question",
+                                    "text": "what is jax"})
+        # children routed to the parent (parent-join shard invariant)
+        node.index_doc("qa", "a1", {
+            "rel": {"name": "answer", "parent": "q1"},
+            "text": "systolic arrays multiply matrices", "votes": 10,
+        }, routing="q1")
+        node.index_doc("qa", "a2", {
+            "rel": {"name": "answer", "parent": "q1"},
+            "text": "they use matrix units", "votes": 2,
+        }, routing="q1")
+        node.index_doc("qa", "a3", {
+            "rel": {"name": "answer", "parent": "q2"},
+            "text": "jax is a numerical library", "votes": 5,
+        }, routing="q2")
+        node.refresh("qa")
+        return node
+
+    def test_has_child(self, store):
+        res = store.search("qa", {"query": {"has_child": {
+            "type": "answer",
+            "query": {"match": {"text": "matrix"}},
+        }}})
+        assert {h["_id"] for h in res["hits"]["hits"]} == {"q1"}
+
+    def test_has_child_min_children(self, store):
+        res = store.search("qa", {"query": {"has_child": {
+            "type": "answer", "query": {"match_all": {}},
+            "min_children": 2,
+        }}})
+        assert {h["_id"] for h in res["hits"]["hits"]} == {"q1"}
+
+    def test_has_parent(self, store):
+        res = store.search("qa", {"query": {"has_parent": {
+            "parent_type": "question",
+            "query": {"match": {"text": "jax"}},
+        }}})
+        assert {h["_id"] for h in res["hits"]["hits"]} == {"a3"}
+
+    def test_parent_id(self, store):
+        res = store.search("qa", {"query": {"parent_id": {
+            "type": "answer", "id": "q1"}}})
+        assert {h["_id"] for h in res["hits"]["hits"]} == {"a1", "a2"}
+
+    def test_multi_level_join(self, node):
+        # a -> b -> c: has_child over the grandchild level must find the
+        # MID-LEVEL parents (which themselves carry a parent pointer)
+        node.create_index("ml", {"mappings": {"properties": {
+            "rel": {"type": "join", "relations": {"a": "b", "b": "c"}},
+            "t": {"type": "keyword"},
+        }}})
+        node.index_doc("ml", "A", {"rel": "a", "t": "top"})
+        node.index_doc("ml", "B", {"rel": {"name": "b", "parent": "A"},
+                                   "t": "mid"}, routing="A")
+        node.index_doc("ml", "C", {"rel": {"name": "c", "parent": "B"},
+                                   "t": "leaf"}, routing="A")
+        node.refresh("ml")
+        res = node.search("ml", {"query": {"has_child": {
+            "type": "c", "query": {"term": {"t": "leaf"}}}}})
+        assert {h["_id"] for h in res["hits"]["hits"]} == {"B"}
+
+    def test_percolate_does_not_mutate_mapping(self, node):
+        node.create_index("pm", {"mappings": {"properties": {
+            "q": {"type": "percolator"}, "msg": {"type": "text"}}}})
+        node.index_doc("pm", "1", {"q": {"match_all": {}}})
+        node.refresh("pm")
+        node.search("pm", {"query": {"percolate": {
+            "field": "q", "document": {"brand_new_field": "x"}}}})
+        mapping = node.indices["pm"].mapper_service.to_dict()["properties"]
+        assert "brand_new_field" not in mapping
+
+    def test_mlt_doc_ref_without_index(self, node):
+        node.create_index("mi", {"mappings": {"properties": {
+            "t": {"type": "text"}}}})
+        node.index_doc("mi", "1", {"t": "shared words here"})
+        node.index_doc("mi", "2", {"t": "shared words appear again"})
+        node.refresh("mi")
+        res = node.search("mi", {"query": {"more_like_this": {
+            "fields": ["t"], "like": [{"_id": "1"}],
+            "min_term_freq": 1, "min_doc_freq": 1,
+        }}})
+        assert any(h["_id"] == "2" for h in res["hits"]["hits"])
+
+    def test_join_validation(self, node):
+        node.create_index("j", {"mappings": {"properties": {
+            "rel": {"type": "join", "relations": {"p": "c"}}}}})
+        with pytest.raises(MapperParsingException):
+            node.index_doc("j", "bad", {"rel": "nope"})
+        with pytest.raises(MapperParsingException):
+            node.index_doc("j", "orphan", {"rel": {"name": "c"}})
+
+    def test_relations_mapping_roundtrip(self, node):
+        node.create_index("j2", {"mappings": {"properties": {
+            "rel": {"type": "join", "relations": {"p": ["c1", "c2"]}}}}})
+        out = node.indices["j2"].mapper_service.to_dict()
+        assert out["properties"]["rel"]["relations"] == {"p": ["c1", "c2"]}
+
+
+class TestRankEval:
+    @pytest.fixture()
+    def corpus(self, node):
+        node.create_index("docs", {"mappings": {"properties": {
+            "t": {"type": "text"}}}})
+        for i, text in enumerate([
+            "alpha beta", "alpha gamma", "beta gamma", "delta epsilon",
+        ]):
+            node.index_doc("docs", str(i), {"t": text})
+        node.refresh("docs")
+        return node
+
+    def test_precision_at_k(self, corpus):
+        res = rank_eval(corpus, "docs", {
+            "requests": [{
+                "id": "q1",
+                "request": {"query": {"match": {"t": "alpha"}}},
+                "ratings": [
+                    {"_index": "docs", "_id": "0", "rating": 1},
+                    {"_index": "docs", "_id": "1", "rating": 0},
+                ],
+            }],
+            "metric": {"precision": {"k": 2}},
+        })
+        # 2 hits (docs 0,1), one rated relevant -> P@2 = 0.5
+        assert res["metric_score"] == pytest.approx(0.5)
+        assert res["details"]["q1"]["metric_score"] == pytest.approx(0.5)
+
+    def test_mrr(self, corpus):
+        res = rank_eval(corpus, "docs", {
+            "requests": [{
+                "id": "q",
+                "request": {"query": {"match": {"t": "gamma"}}},
+                "ratings": [{"_index": "docs", "_id": "2", "rating": 1}],
+            }],
+            "metric": {"mean_reciprocal_rank": {"k": 5}},
+        })
+        assert 0 < res["metric_score"] <= 1.0
+
+    def test_dcg_normalized(self, corpus):
+        res = rank_eval(corpus, "docs", {
+            "requests": [{
+                "id": "q",
+                "request": {"query": {"match": {"t": "alpha"}}},
+                "ratings": [
+                    {"_index": "docs", "_id": "0", "rating": 3},
+                    {"_index": "docs", "_id": "1", "rating": 2},
+                ],
+            }],
+            "metric": {"dcg": {"k": 5, "normalize": True}},
+        })
+        assert 0 < res["metric_score"] <= 1.0
+
+    def test_err(self, corpus):
+        res = rank_eval(corpus, "docs", {
+            "requests": [{
+                "id": "q",
+                "request": {"query": {"match": {"t": "beta"}}},
+                "ratings": [{"_index": "docs", "_id": "0", "rating": 3}],
+            }],
+            "metric": {"expected_reciprocal_rank": {"maximum_relevance": 3}},
+        })
+        assert res["metric_score"] > 0
+
+    def test_unrated_docs_reported(self, corpus):
+        res = rank_eval(corpus, "docs", {
+            "requests": [{
+                "id": "q",
+                "request": {"query": {"match": {"t": "alpha"}}},
+                "ratings": [{"_index": "docs", "_id": "0", "rating": 1}],
+            }],
+            "metric": {"precision": {"k": 5}},
+        })
+        unrated = res["details"]["q"]["unrated_docs"]
+        assert {u["_id"] for u in unrated} == {"1"}
+
+    def test_requires_requests(self, corpus):
+        with pytest.raises(IllegalArgumentException):
+            rank_eval(corpus, "docs", {})
