@@ -136,6 +136,34 @@ class TpuNode:
         from opensearch_tpu.search.backpressure import SearchBackpressureService
 
         self.search_backpressure = SearchBackpressureService(self.task_manager)
+        from opensearch_tpu.telemetry.slowlog import SlowLog
+
+        from opensearch_tpu.telemetry.tracing import Telemetry
+
+        self.telemetry = Telemetry()  # per-node: metrics must not leak
+        self.search_slowlog = SlowLog("search")
+        self.indexing_slowlog = SlowLog("indexing")
+        self._configure_slowlogs()
+
+    def _configure_slowlogs(self) -> None:
+        """Pick up index.search.slowlog.threshold.query.* /
+        index.indexing.slowlog.threshold.index.* from any index's settings
+        (node-wide loggers; the reference scopes per index). Thresholds
+        reset first so deleted/changed indices don't leave stale levels."""
+        from opensearch_tpu.telemetry.slowlog import LEVELS
+
+        for sl in (self.search_slowlog, self.indexing_slowlog):
+            sl.thresholds = {lvl: -1 for lvl in LEVELS}
+        for svc in self.indices.values():
+            s = svc.settings
+            q = (((s.get("search") or {}).get("slowlog") or {})
+                 .get("threshold") or {}).get("query") or {}
+            if q:
+                self.search_slowlog.configure(q)
+            i = (((s.get("indexing") or {}).get("slowlog") or {})
+                 .get("threshold") or {}).get("index") or {}
+            if i:
+                self.indexing_slowlog.configure(i)
 
     # -- index lifecycle ---------------------------------------------------
 
@@ -200,6 +228,7 @@ class TpuNode:
             svc.aliases[alias] = dict(conf or {})
         self.indices[name] = svc
         self._persist_index_registry()
+        self._configure_slowlogs()
         return {"acknowledged": True, "shards_acknowledged": True, "index": name}
 
     def attach_index(self, name: str, settings: dict, mappings: dict | None) -> "IndexService":
@@ -212,6 +241,7 @@ class TpuNode:
             name, self._index_path(name), settings, mappings
         )
         self._persist_index_registry()
+        self._configure_slowlogs()
         return self.indices[name]
 
     def delete_index(self, name: str) -> dict:
@@ -219,6 +249,7 @@ class TpuNode:
         svc.close()
         del self.indices[name]
         self._persist_index_registry()
+        self._configure_slowlogs()
         import shutil
 
         shutil.rmtree(self._index_path(name), ignore_errors=True)
@@ -775,6 +806,7 @@ class TpuNode:
         op_type: str = "index",
         pipeline: str | None = None,
     ) -> dict:
+        _t_index0 = time.monotonic()
         index, routing = self._resolve_write_alias(index, routing)
         # ingest pipelines resolve BEFORE any index auto-creation (the
         # reference resolves pipelines first, so a drop or _index reroute
@@ -833,6 +865,9 @@ class TpuNode:
             # dynamic mapping introduced new fields — persist the registry
             # (the cluster-state "mapping update" publication analog)
             self._persist_index_registry()
+        self.indexing_slowlog.maybe_log(
+            (time.monotonic() - _t_index0) * 1000, index, f"id[{doc_id}]"
+        )
         return {
             "_index": index,
             "_id": doc_id,
@@ -1279,7 +1314,10 @@ class TpuNode:
         shard_filters: list | None = None,
         task=None,
     ) -> dict:
-        """search_service.search wrapped in the pipeline pre/post steps."""
+        """search_service.search wrapped in the pipeline pre/post steps.
+        Telemetry (span, metrics, slowlog) lives HERE so PIT and scroll
+        searches are covered too, not just the plain path."""
+        expr = ",".join(index_names) or "_pit"
         body = self._resolve_mlt_doc_refs(body, index_names)
         pl, pr_config = self._resolve_search_pipeline(pipeline_id, index_names)
         pl_ctx = {}
@@ -1287,9 +1325,20 @@ class TpuNode:
             body = self.search_pipelines.transform_request(pl, body)
             if "_original_size" in body:
                 pl_ctx["_original_size"] = body.pop("_original_size")
-        resp = search_service.search(
-            shards, body, acquired=acquired, phase_results_config=pr_config,
-            shard_filters=shard_filters, task=task,
+        with self.telemetry.tracer.start_span(
+            "search", {"indices": expr}
+        ) as span:
+            resp = search_service.search(
+                shards, body, acquired=acquired,
+                phase_results_config=pr_config,
+                shard_filters=shard_filters, task=task,
+            )
+        took = resp.get("took", 0)
+        span.set_attribute("took_ms", took)
+        self.telemetry.metrics.counter("search.total").add(1)
+        self.telemetry.metrics.histogram("search.took_ms").record(took)
+        self.search_slowlog.maybe_log(
+            took, expr, json.dumps(body.get("query") or {})
         )
         if pl is not None:
             resp = self.search_pipelines.transform_response(

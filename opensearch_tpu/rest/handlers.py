@@ -773,6 +773,11 @@ def nodes_stats(node: TpuNode, params, query, body):
                 "breakers": node.breakers.stats(),
                 "indexing_pressure": node.indexing_pressure.stats(),
                 "search_backpressure": node.search_backpressure.stats(),
+                "telemetry": node.telemetry.metrics.stats(),
+                "slowlog": {
+                    "search": node.search_slowlog.entries()[-10:],
+                    "indexing": node.indexing_slowlog.entries()[-10:],
+                },
                 "tasks": {
                     "running": len(node.task_manager.list_tasks()),
                     "completed": node.task_manager.completed,

@@ -167,12 +167,26 @@ class HttpServer:
             # structures that are single-writer
             mgmt = path.startswith("/_tasks")
             executor = self._mgmt_executor if mgmt else self._executor
+            from opensearch_tpu.telemetry import default_telemetry
+
+            telemetry = getattr(self.node, "telemetry", default_telemetry)
+            span_cm = telemetry.tracer.start_span(
+                "http_request", {"method": method, "path": path}
+            )
             try:
-                # handlers are synchronous work; run them off the event loop
-                # so slow searches don't stall socket IO
-                status, payload = await asyncio.get_running_loop().run_in_executor(
-                    executor, handler, self.node, params, query, body
-                )
+                with span_cm as span:
+                    # handlers are synchronous work; run them off the event
+                    # loop so slow searches don't stall socket IO. The
+                    # contextvars context is copied into the worker thread so
+                    # handler spans parent under this http_request span.
+                    import contextvars as _cv
+
+                    ctx = _cv.copy_context()
+                    status, payload = await asyncio.get_running_loop().run_in_executor(
+                        executor, ctx.run, handler, self.node, params, query,
+                        body,
+                    )
+                    span.set_attribute("status", status)
             finally:
                 if breakers is not None and raw_body:
                     breakers.in_flight_requests.release(len(raw_body))

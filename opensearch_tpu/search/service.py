@@ -55,7 +55,7 @@ def search(
         "query", "size", "from", "sort", "_source", "aggs", "aggregations",
         "track_total_hits", "min_score", "search_after", "timeout", "version",
         "seq_no_primary_term", "stored_fields", "explain", "highlight",
-        "docvalue_fields", "fields", "script_fields", "suggest",
+        "docvalue_fields", "fields", "script_fields", "suggest", "profile",
     }
     unknown = set(body) - known_keys
     if unknown:
@@ -96,6 +96,9 @@ def search(
             return base
         return query_dsl.BoolQuery(must=[base], filter=[f])
 
+    want_profile = bool(body.get("profile"))
+    shard_query_ns: list[int] = []
+
     fetch_k = from_ + size
     if isinstance(node, query_dsl.HybridQuery):
         # hybrid query phase: one pass per sub-query, then the phase-results
@@ -118,6 +121,7 @@ def search(
                 acquired[shard_i] if acquired is not None
                 else shard.acquire_searcher()
             )
+            t_q = time.perf_counter_ns()
             per_shard_subs.append([
                 execute_query_phase(
                     snapshot,
@@ -131,6 +135,8 @@ def search(
                 )
                 for sub in node.queries
             ])
+            if want_profile:
+                shard_query_ns.append(time.perf_counter_ns() - t_q)
             shard_snaps.append((shard, snapshot))
         fused = pipeline_mod.fuse_hybrid_results(
             per_shard_subs, phase_results_config, fetch_k
@@ -147,23 +153,21 @@ def search(
             if task is not None:
                 task.ensure_not_cancelled()
             snapshot = acquired[shard_i] if acquired is not None else shard.acquire_searcher()
-            per_shard_results.append(
-                (
-                    shard,
-                    snapshot,
-                    execute_query_phase(
-                        snapshot,
-                        shard.mapper_service,
-                        _shard_node(node, shard_i),
-                        # search_after cursors can reach arbitrarily deep into a
-                        # shard; fall back to all matching docs per shard
-                        size=snapshot.max_doc if search_after is not None else fetch_k,
-                        sort=sort,
-                        need_masks=aggs_body is not None,
-                        min_score=float(min_score) if min_score is not None else None,
-                    ),
-                )
+            t_q = time.perf_counter_ns()
+            result = execute_query_phase(
+                snapshot,
+                shard.mapper_service,
+                _shard_node(node, shard_i),
+                # search_after cursors can reach arbitrarily deep into a
+                # shard; fall back to all matching docs per shard
+                size=snapshot.max_doc if search_after is not None else fetch_k,
+                sort=sort,
+                need_masks=aggs_body is not None,
+                min_score=float(min_score) if min_score is not None else None,
             )
+            if want_profile:
+                shard_query_ns.append(time.perf_counter_ns() - t_q)
+            per_shard_results.append((shard, snapshot, result))
 
     # ---- reduce phase (SearchPhaseController analog) ----
     merged = []
@@ -340,6 +344,40 @@ def search(
             [snap.segments for _, snap, _ in per_shard_results],
             [s.mapper_service for s in shards],
         )
+
+    if want_profile:
+        # per-shard query-phase timing trees (search/profile/ Profilers:
+        # AbstractProfileBreakdown) — one entry per shard like the
+        # reference's "_search?profile=true" response
+        response["profile"] = {"shards": [
+            {
+                "id": f"[{shard.shard_id.index}][{shard.shard_id.shard}]",
+                "searches": [{
+                    "query": [{
+                        "type": type(node).__name__,
+                        "description": json.dumps(body.get("query") or {}),
+                        "time_in_nanos": t_ns,
+                        "breakdown": {
+                            "score": t_ns,
+                            "build_scorer": 0,
+                            "create_weight": 0,
+                            "next_doc": 0,
+                        },
+                    }],
+                    "rewrite_time": 0,
+                    "collector": [{
+                        "name": "SimpleTopDocsCollector",
+                        "reason": "search_top_hits",
+                        "time_in_nanos": t_ns,
+                    }],
+                }],
+                "aggregations": [],
+            }
+            for (shard, _snap, _r), t_ns in zip(
+                per_shard_results,
+                shard_query_ns or [0] * len(per_shard_results),
+            )
+        ]}
     return response
 
 

@@ -1,0 +1,8 @@
+from opensearch_tpu.telemetry.tracing import (
+    MetricsRegistry,
+    Span,
+    Tracer,
+    default_telemetry,
+)
+
+__all__ = ["MetricsRegistry", "Span", "Tracer", "default_telemetry"]

@@ -1,0 +1,185 @@
+"""Telemetry: tracing spans + metrics registry.
+
+The analog of the reference's vendor-neutral telemetry SPI (SURVEY.md §5
+"Tracing / profiling": libs/telemetry Telemetry.java / tracing/Tracer /
+metrics/MetricsRegistry, wired by server TelemetryModule; context
+propagation rides ThreadContext). Here:
+
+- Tracer.start_span is a context manager; the current span propagates via
+  contextvars (the asyncio-native ThreadContext), so child spans parent
+  automatically across the executor boundaries the HTTP server uses.
+- Spans collect into a bounded in-memory ring (exporter SPI slot) — the
+  OTel plugin equivalent would ship them out; tests and the _nodes/stats
+  surface read the ring.
+- MetricsRegistry: counters + histograms with label support.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field as dc_field
+from typing import Any
+
+_current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "opensearch_tpu_current_span", default=None
+)
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    name: str
+    attributes: dict[str, Any] = dc_field(default_factory=dict)
+    start_ns: int = 0
+    end_ns: int = 0
+
+    @property
+    def duration_ns(self) -> int:
+        return max(self.end_ns - self.start_ns, 0)
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "attributes": dict(self.attributes),
+            "duration_ns": self.duration_ns,
+        }
+
+
+class _SpanScope:
+    __slots__ = ("_tracer", "_name", "_attributes", "span", "_token")
+
+    def __init__(self, tracer: "Tracer", name: str, attributes: dict | None):
+        self._tracer = tracer
+        self._name = name
+        self._attributes = attributes
+
+    def __enter__(self) -> Span:
+        parent = _current_span.get()
+        sid = f"s{next(self._tracer._ids):08x}"
+        self.span = Span(
+            trace_id=parent.trace_id if parent else f"t{sid}",
+            span_id=sid,
+            parent_id=parent.span_id if parent else None,
+            name=self._name,
+            attributes=dict(self._attributes or {}),
+            start_ns=time.perf_counter_ns(),
+        )
+        self._token = _current_span.set(self.span)
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb):
+        self.span.end_ns = time.perf_counter_ns()
+        if exc_type is not None:
+            self.span.attributes["error"] = str(exc)
+        _current_span.reset(self._token)
+        if self._tracer.enabled:
+            with self._tracer._lock:
+                self._tracer._finished.append(self.span)
+        return False
+
+
+class Tracer:
+    """Span factory with contextvar propagation and a bounded ring of
+    finished spans (the exporter slot)."""
+
+    def __init__(self, max_finished: int = 2048, enabled: bool = True):
+        self.enabled = enabled
+        self._ids = itertools.count(1)
+        self._finished: deque[Span] = deque(maxlen=max_finished)
+        self._lock = threading.Lock()
+
+    def start_span(self, name: str, attributes: dict | None = None):
+        return _SpanScope(self, name, attributes)
+
+    def current_span(self) -> Span | None:
+        return _current_span.get()
+
+    def finished_spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._finished)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._finished.clear()
+
+
+class _Counter:
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+
+class _Histogram:
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self._lock = threading.Lock()
+
+    def record(self, value: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total += value
+            self.min = min(self.min, value)
+            self.max = max(self.max, value)
+
+    def stats(self) -> dict:
+        with self._lock:  # consistent snapshot: record() holds this too
+            if self.count == 0:
+                return {"count": 0, "sum": 0.0, "avg": 0.0,
+                        "min": 0.0, "max": 0.0}
+            return {
+                "count": self.count, "sum": self.total,
+                "avg": self.total / self.count,
+                "min": self.min, "max": self.max,
+            }
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._counters: dict[str, _Counter] = {}
+        self._histograms: dict[str, _Histogram] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> _Counter:
+        with self._lock:
+            return self._counters.setdefault(name, _Counter())
+
+    def histogram(self, name: str) -> _Histogram:
+        with self._lock:
+            return self._histograms.setdefault(name, _Histogram())
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "counters": {n: c.value for n, c in self._counters.items()},
+                "histograms": {
+                    n: h.stats() for n, h in self._histograms.items()
+                },
+            }
+
+
+class Telemetry:
+    def __init__(self):
+        self.tracer = Tracer()
+        self.metrics = MetricsRegistry()
+
+
+default_telemetry = Telemetry()

@@ -1,0 +1,115 @@
+"""Telemetry: tracer spans, metrics, slow logs, _search profile.
+
+Reference surface: libs/telemetry (Tracer/MetricsRegistry SPI),
+index/SearchSlowLog + IndexingSlowLog, search/profile/ (SURVEY.md §5).
+"""
+
+import pytest
+
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.telemetry.slowlog import SlowLog
+from opensearch_tpu.telemetry.tracing import MetricsRegistry, Tracer
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = TpuNode(tmp_path / "node")
+    n.create_index("t", {"mappings": {"properties": {
+        "msg": {"type": "text"}}}})
+    for i in range(5):
+        n.index_doc("t", str(i), {"msg": f"message number {i}"})
+    n.refresh("t")
+    return n
+
+
+class TestTracer:
+    def test_span_nesting_and_attributes(self):
+        tracer = Tracer()
+        with tracer.start_span("outer", {"a": 1}) as outer:
+            assert tracer.current_span() is outer
+            with tracer.start_span("inner") as inner:
+                assert inner.parent_id == outer.span_id
+                assert inner.trace_id == outer.trace_id
+                inner.set_attribute("k", "v")
+        assert tracer.current_span() is None
+        finished = tracer.finished_spans()
+        assert [s.name for s in finished] == ["inner", "outer"]
+        assert finished[0].attributes["k"] == "v"
+        assert all(s.duration_ns >= 0 for s in finished)
+
+    def test_error_recorded(self):
+        tracer = Tracer()
+        with pytest.raises(ValueError):
+            with tracer.start_span("boom"):
+                raise ValueError("kaput")
+        assert tracer.finished_spans()[0].attributes["error"] == "kaput"
+
+    def test_search_emits_span_and_metrics(self, node):
+        node.telemetry.tracer.clear()
+        before = node.telemetry.metrics.counter("search.total").value
+        node.search("t", {"query": {"match": {"msg": "message"}}})
+        names = [s.name for s in node.telemetry.tracer.finished_spans()]
+        assert "search" in names
+        assert node.telemetry.metrics.counter("search.total").value == before + 1
+        assert node.telemetry.metrics.histogram("search.took_ms").count >= 1
+
+
+class TestMetrics:
+    def test_counter_histogram(self):
+        m = MetricsRegistry()
+        m.counter("c").add(2)
+        m.counter("c").add(3)
+        m.histogram("h").record(10)
+        m.histogram("h").record(20)
+        stats = m.stats()
+        assert stats["counters"]["c"] == 5
+        assert stats["histograms"]["h"]["avg"] == 15
+
+
+class TestSlowLog:
+    def test_threshold_levels(self):
+        sl = SlowLog("search")
+        sl.configure({"warn": 100, "info": 10})
+        assert sl.maybe_log(5, "i", "fast") is None
+        assert sl.maybe_log(50, "i", "medium") == "info"
+        assert sl.maybe_log(500, "i", "slow") == "warn"
+        entries = sl.entries()
+        assert [e["level"] for e in entries] == ["info", "warn"]
+
+    def test_time_value_strings(self):
+        sl = SlowLog("search")
+        sl.configure({"warn": "1s"})
+        assert sl.thresholds["warn"] == 1000
+
+    def test_disabled_by_default(self):
+        sl = SlowLog("search")
+        assert sl.maybe_log(10_000, "i", "x") is None
+
+    def test_index_settings_configure_node_slowlog(self, tmp_path):
+        n = TpuNode(tmp_path / "n")
+        n.create_index("sl", {"settings": {"index": {"search": {"slowlog": {
+            "threshold": {"query": {"warn": "0ms"}}}}}},
+            "mappings": {"properties": {"x": {"type": "keyword"}}}})
+        n.index_doc("sl", "1", {"x": "y"})
+        n.refresh("sl")
+        n.search("sl", {"query": {"match_all": {}}})
+        assert n.search_slowlog.entries(), "0ms warn threshold must log"
+
+
+class TestProfile:
+    def test_profile_shape(self, node):
+        res = node.search("t", {
+            "profile": True,
+            "query": {"match": {"msg": "message"}},
+        })
+        prof = res["profile"]["shards"]
+        assert len(prof) == len(node.indices["t"].shards)
+        q = prof[0]["searches"][0]["query"][0]
+        assert q["type"] == "MatchQuery"
+        assert q["time_in_nanos"] >= 0
+        assert "breakdown" in q
+        assert prof[0]["searches"][0]["collector"][0]["name"]
+
+    def test_no_profile_by_default(self, node):
+        res = node.search("t", {"query": {"match_all": {}}})
+        assert "profile" not in res
