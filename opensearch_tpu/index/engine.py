@@ -221,13 +221,15 @@ class Engine:
         if pos is not None and self._buffer[pos] is not None:
             parsed, seq = self._buffer[pos]
             return {"_source": parsed.source, "_seq_no": seq,
-                    "_version": entry.version if entry else 1}
+                    "_version": entry.version if entry else 1,
+                    "_routing": parsed.routing}
         for host, _dev in self._segments:
             d = host.local_doc(doc_id)
             if d is not None:
                 return {"_source": json.loads(host.sources[d]),
                         "_seq_no": entry.seq_no if entry else -1,
-                        "_version": entry.version if entry else 1}
+                        "_version": entry.version if entry else 1,
+                        "_routing": host.doc_routings[d]}
         return None
 
     def acquire_searcher(self) -> SearcherSnapshot:

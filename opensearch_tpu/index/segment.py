@@ -145,6 +145,12 @@ class HostSegment:
     # version_map's (the reference stores these as doc-values)
     doc_seq_nos: np.ndarray = dc_field(default_factory=lambda: np.zeros(0, np.int64))
     doc_versions: np.ndarray = dc_field(default_factory=lambda: np.zeros(0, np.int64))
+    # local docid -> custom _routing (None when routed by _id); the _routing
+    # metadata field — hits must expose it so reindex/update_by_query can
+    # address the owning shard (reference: RoutingFieldMapper stored field)
+    doc_routings: list = dc_field(default_factory=list)
+    # completion field -> {input value -> weight} (FST weight analog)
+    completion_weights: dict = dc_field(default_factory=dict)
 
     def __post_init__(self) -> None:
         if self.live.size == 0:
@@ -153,6 +159,8 @@ class HostSegment:
             self.doc_seq_nos = np.zeros(self.n_docs, np.int64)
         if self.doc_versions.size == 0:
             self.doc_versions = np.ones(self.n_docs, np.int64)
+        if not self.doc_routings:
+            self.doc_routings = [None] * self.n_docs
         self._id_to_doc = {id_: i for i, id_ in enumerate(self.doc_ids)}
 
     def local_doc(self, doc_id: str) -> int | None:
@@ -218,7 +226,13 @@ class SegmentBuilder:
             min_seq_no=min(self.seq_nos),
             max_seq_no=max(self.seq_nos),
             doc_seq_nos=np.asarray(self.seq_nos, np.int64),
+            doc_routings=[d.routing for d in self.docs],
         )
+        for d in self.docs:
+            for cf, weights in d.completion_weights.items():
+                slot = seg.completion_weights.setdefault(cf, {})
+                for val, w in weights.items():
+                    slot[val] = max(slot.get(val, 0), w)
         mappers = self.mapper_service.mappers
         for fname, mapper in mappers.items():
             if mapper.type == "text":
@@ -390,6 +404,8 @@ def save_segment(seg: HostSegment, directory: Path) -> None:
         "name": seg.name,
         "n_docs": seg.n_docs,
         "doc_ids": seg.doc_ids,
+        "doc_routings": seg.doc_routings,
+        "completion_weights": seg.completion_weights,
         "min_seq_no": seg.min_seq_no,
         "max_seq_no": seg.max_seq_no,
         "text_fields": {},
@@ -474,6 +490,8 @@ def load_segment(directory: Path, name: str) -> HostSegment:
                      else np.zeros(0, np.int64)),
         doc_versions=(arrays["doc_versions"].copy() if "doc_versions" in arrays
                       else np.zeros(0, np.int64)),
+        doc_routings=meta.get("doc_routings") or [],
+        completion_weights=meta.get("completion_weights") or {},
     )
     for fname, m in meta["text_fields"].items():
         key = f"text:{fname}"

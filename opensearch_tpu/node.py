@@ -13,6 +13,7 @@ shards the cluster state assigns to it.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import re
 import time
@@ -133,6 +134,10 @@ class TpuNode:
         self.task_manager = TaskManager(node_name)
         self.breakers = HierarchyBreakerService()
         self.indexing_pressure = IndexingPressure()
+        self._pressure_depth = 0
+        # (index, shard_id) of the most recent write, set by the inner write
+        # path AFTER pipeline rerouting — see _write_pressure docstring
+        self._last_write_shard: tuple[str, int] | None = None
         from opensearch_tpu.search.backpressure import SearchBackpressureService
 
         self.search_backpressure = SearchBackpressureService(self.task_manager)
@@ -350,6 +355,32 @@ class TpuNode:
                             f"alias [{alias}] clashes with an index name"
                         )
                     staged.append((kind, name, alias, conf))
+        # removes must name an alias that actually exists somewhere in the
+        # action's scope — the reference fails the whole request with
+        # aliases_not_found (404) before mutating anything (must_exist=false
+        # opts out). Validated pre-apply to keep the update atomic.
+        import fnmatch as _fn
+
+        remove_matched: dict[str, bool] = {}
+        remove_opt_out: set[str] = set()
+        for kind, name, alias, conf in staged:
+            if kind != "remove":
+                continue
+            if (conf or {}).get("must_exist") is False:
+                remove_opt_out.add(alias)
+            svc = self._get_index(name)
+            hit = alias in svc.aliases or any(
+                _fn.fnmatch(a, alias) for a in svc.aliases
+            )
+            remove_matched[alias] = remove_matched.get(alias, False) or hit
+        missing = sorted(
+            a for a, hit in remove_matched.items()
+            if not hit and a not in remove_opt_out
+        )
+        if missing:
+            raise ResourceNotFoundException(
+                f"aliases [{','.join(missing)}] missing"
+            )
         # alias mutations first, index deletions last: a remove_index in
         # the middle of the list must not invalidate later staged actions
         to_delete = [n for k, n, _, _ in staged if k == "remove_index"]
@@ -364,8 +395,10 @@ class TpuNode:
                     if conf.get(key) is not None:
                         entry[key] = conf[key]
                 svc.aliases[alias] = entry
-            elif alias in svc.aliases:
-                del svc.aliases[alias]
+            else:
+                for a in list(svc.aliases):
+                    if a == alias or _fn.fnmatch(a, alias):
+                        del svc.aliases[a]
         for name in to_delete:
             if name in self.indices:
                 self.delete_index(name)
@@ -795,6 +828,24 @@ class TpuNode:
 
     # -- document APIs -----------------------------------------------------
 
+    @contextlib.contextmanager
+    def _write_pressure(self, nbytes: int, operation: str):
+        """Reentrant IndexingPressure guard: the outermost write entry point
+        (bulk, single index/delete/update) accounts the bytes; nested calls
+        (bulk item -> index_doc, update -> index_doc) are already covered.
+        Reference: IndexingPressure.markCoordinatingOperationStarted — all
+        write operations pass through admission control, not only _bulk."""
+        if self._pressure_depth:
+            yield
+            return
+        release = self.indexing_pressure.acquire(nbytes, operation)
+        self._pressure_depth += 1
+        try:
+            yield
+        finally:
+            self._pressure_depth -= 1
+            release.close()
+
     def index_doc(
         self,
         index: str,
@@ -806,6 +857,17 @@ class TpuNode:
         op_type: str = "index",
         pipeline: str | None = None,
     ) -> dict:
+        # single-doc writes go through the same admission control as _bulk
+        # (the reference accounts ALL write operations in IndexingPressure);
+        # the guard is reentrant so bulk/update entry points account once
+        with self._write_pressure(
+            len(json.dumps(source)) if source is not None else 0, "index"
+        ):
+            return self._index_doc_inner(index, doc_id, source, routing,
+                                         if_seq_no, refresh, op_type, pipeline)
+
+    def _index_doc_inner(self, index, doc_id, source, routing,
+                         if_seq_no, refresh, op_type, pipeline) -> dict:
         _t_index0 = time.monotonic()
         index, routing = self._resolve_write_alias(index, routing)
         # ingest pipelines resolve BEFORE any index auto-creation (the
@@ -850,6 +912,11 @@ class TpuNode:
 
             doc_id = uuid.uuid4().hex[:20]
         shard = svc.shard_for(doc_id, routing)
+        # record where this write actually landed (post-pipeline index AND
+        # post-pipeline routing) so _bulk's refresh=true touches the right
+        # shard even after an ingest _index/_routing reroute (ADVICE r1);
+        # safe: all doc mutations are serialized through the single writer
+        self._last_write_shard = (index, shard.shard_id.shard)
         if op_type == "create" and shard.get(doc_id) is not None:
             # atomic here: all doc mutations are serialized through the
             # node's single writer (see rest/http.py executor)
@@ -885,7 +952,7 @@ class TpuNode:
         got = shard.get(doc_id)
         if got is None:
             return {"_index": index, "_id": doc_id, "found": False}
-        return {
+        out = {
             "_index": index,
             "_id": doc_id,
             "_version": got["_version"],
@@ -894,13 +961,24 @@ class TpuNode:
             "found": True,
             "_source": got["_source"],
         }
+        if got.get("_routing") is not None:
+            out["_routing"] = got["_routing"]
+        return out
 
     def delete_doc(self, index: str, doc_id: str, routing: str | None = None,
                    refresh: bool = False,
                    if_seq_no: int | None = None) -> dict:
+        # deletes carry no source; account a small fixed op cost
+        with self._write_pressure(64, "delete"):
+            return self._delete_doc_inner(index, doc_id, routing, refresh,
+                                          if_seq_no)
+
+    def _delete_doc_inner(self, index, doc_id, routing, refresh,
+                          if_seq_no) -> dict:
         index, routing = self._resolve_write_alias(index, routing)
         svc = self._get_open_index(index)
         shard = svc.shard_for(doc_id, routing)
+        self._last_write_shard = (index, shard.shard_id.shard)
         result = shard.apply_delete_on_primary(doc_id, if_seq_no=if_seq_no)
         if refresh:
             shard.refresh()
@@ -918,6 +996,10 @@ class TpuNode:
                    routing: str | None = None, refresh: bool = False) -> dict:
         """Partial update via doc merge or script
         (action/update/UpdateHelper.java: prepareUpdateScriptRequest)."""
+        with self._write_pressure(len(json.dumps(body)), "update"):
+            return self._update_doc_inner(index, doc_id, body, routing, refresh)
+
+    def _update_doc_inner(self, index, doc_id, body, routing, refresh) -> dict:
         index, routing = self._resolve_write_alias(index, routing)
         svc = self._get_open_index(index)
         shard = svc.shard_for(doc_id, routing)
@@ -988,16 +1070,13 @@ class TpuNode:
                 len(json.dumps(source)) for _, _, source in operations
                 if source is not None
             )
-        release = self.indexing_pressure.acquire(payload_bytes, "bulk")
-        try:
+        with self._write_pressure(payload_bytes, "bulk"):
             with self.task_manager.task_scope(
                 "indices:data/write/bulk",
                 description=f"requests[{len(operations)}]",
                 cancellable=False,
             ):
                 return self._bulk_inner(operations, refresh, pipeline, t0)
-        finally:
-            release.close()
 
     def _bulk_inner(self, operations, refresh, pipeline, t0) -> dict:
         items = []
@@ -1021,14 +1100,13 @@ class TpuNode:
                     status = 200 if resp["result"] == "deleted" else 404
                 else:
                     raise IllegalArgumentException(f"unknown bulk action [{action}]")
-                landed = resp.get("_index", index)
-                svc = self.indices.get(landed)
-                if svc is not None:
-                    _, eff_routing = self._resolve_write_alias(index, routing)
-                    sid = shard_id_for_routing(
-                        eff_routing or resp["_id"], svc.num_shards
-                    )
-                    touched.add((landed, sid))
+                # the inner write path records (landed index, shard) AFTER
+                # ingest-pipeline rerouting, so refresh=true touches the
+                # shard the doc actually landed on (ADVICE r1: resolving the
+                # original target's alias routing against the landed index's
+                # shard count picked the wrong shard after an _index reroute)
+                if resp.get("result") != "noop" and self._last_write_shard:
+                    touched.add(self._last_write_shard)
                 items.append({action: {**resp, "status": status}})
             except OpenSearchTpuException as e:
                 errors = True

@@ -122,11 +122,19 @@ def reindex(node, body: dict, refresh: bool = False) -> dict:
                 if op == "noop":
                     stats["noops"] += 1
                     continue
+                # preserve custom _routing through the copy (the reference
+                # carries routing on every scroll hit into the bulk op;
+                # dropping it would land routed docs on the _id-hashed shard)
+                hit_routing = hit.get("_routing")
                 if op == "delete":
-                    ops.append(("delete", {"_index": dest["index"],
-                                           "_id": hit["_id"]}, None))
+                    dmeta = {"_index": dest["index"], "_id": hit["_id"]}
+                    if hit_routing is not None:
+                        dmeta["routing"] = hit_routing
+                    ops.append(("delete", dmeta, None))
                     continue
                 meta = {"_index": dest["index"], "_id": hit["_id"]}
+                if hit_routing is not None:
+                    meta["routing"] = hit_routing
                 if pipeline:
                     meta["pipeline"] = pipeline
                 ops.append((op_type if op == "index" else op, meta, new_source))
@@ -177,11 +185,13 @@ def update_by_query(node, index: str, body: dict | None = None,
                     # modified since then is a version conflict
                     if op == "delete":
                         node.delete_doc(hit["_index"], hit["_id"],
+                                        routing=hit.get("_routing"),
                                         if_seq_no=hit["_seq_no"])
                         stats["deleted"] += 1
                     else:
                         node.index_doc(
                             hit["_index"], hit["_id"], new_source,
+                            routing=hit.get("_routing"),
                             if_seq_no=hit["_seq_no"],
                         )
                         stats["updated"] += 1
@@ -230,6 +240,7 @@ def delete_by_query(node, index: str, body: dict | None = None,
                 stats["total"] += 1
                 try:
                     resp = node.delete_doc(hit["_index"], hit["_id"],
+                                           routing=hit.get("_routing"),
                                            if_seq_no=hit["_seq_no"])
                     if resp["result"] == "deleted":
                         stats["deleted"] += 1

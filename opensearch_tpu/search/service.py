@@ -225,6 +225,9 @@ def search(
             "_id": doc_id,
             "_score": None if sort else h.score,
         }
+        doc_routing = host.doc_routings[h.doc] if host.doc_routings else None
+        if doc_routing is not None:
+            hit["_routing"] = doc_routing
         raw_source = json.loads(host.sources[h.doc])
         src = source_filter(raw_source)
         if src is not None:

@@ -214,6 +214,8 @@ def _phrase_suggest(text, field, sconf, size, segments,
 
 
 def _completion_suggest(prefix, field, size, segments) -> list[dict]:
+    """Prefix match over completion inputs, ranked by (-weight, text) like
+    the reference FST suggester (weight defaults to 1 when unset)."""
     prefix_l = str(prefix).lower()
     matches: dict[str, int] = {}
     for host, _dev in segments:
@@ -225,14 +227,16 @@ def _completion_suggest(prefix, field, size, segments) -> list[dict]:
             tf = host.text_fields.get(field)
             if tf is not None:
                 values = tf.terms
+        weights = host.completion_weights.get(field, {})
         for v in values:
             if v.lower().startswith(prefix_l):
-                matches[v] = matches.get(v, 0) + 1
-    ranked = sorted(matches.items(), key=lambda kv: (kv[0].lower(), kv[0]))
+                w = int(weights.get(v, 1))
+                matches[v] = max(matches.get(v, 0), w)
+    ranked = sorted(matches.items(), key=lambda kv: (-kv[1], kv[0]))
     return [{
         "text": prefix, "offset": 0, "length": len(str(prefix)),
         "options": [
-            {"text": v, "_id": None, "_index": None, "score": 1.0}
-            for v, _ in ranked[:size]
+            {"text": v, "_id": None, "_index": None, "score": float(w)}
+            for v, w in ranked[:size]
         ],
     }]

@@ -172,3 +172,19 @@ class TestSuggesters:
     def test_invalid_suggest_rejected(self, node):
         with pytest.raises(ParsingException):
             node.search("lib", {"suggest": {"bad": {"term": {}}}})
+
+    def test_completion_weight_ranks_options(self, node):
+        # ADVICE r1: weight must rank options (-weight, then text), like the
+        # reference FST suggester; unweighted inputs default to weight 1
+        node.index_doc("lib", "w1", {"title": "x", "genre": "g", "year": 1,
+                                     "sugg": {"input": ["quant low"],
+                                              "weight": 2}})
+        node.index_doc("lib", "w2", {"title": "y", "genre": "g", "year": 1,
+                                     "sugg": {"input": ["quant high"],
+                                              "weight": 9}})
+        node.refresh("lib")
+        res = node.search("lib", {"suggest": {
+            "c": {"prefix": "quant", "completion": {"field": "sugg"}}}})
+        opts = res["suggest"]["c"][0]["options"]
+        assert [o["text"] for o in opts] == ["quant high", "quant low"]
+        assert opts[0]["score"] == 9.0

@@ -344,3 +344,52 @@ class TestAnalyze:
     def test_missing_text_rejected(self, node):
         with pytest.raises(IllegalArgumentException):
             node.analyze(None, {})
+
+
+class TestAliasRemoveMustExist:
+    """ADVICE r1: removing a non-existent alias fails with 404 (the
+    reference's aliases_not_found) unless must_exist is explicitly false."""
+
+    def test_remove_missing_alias_404(self, node):
+        _seed(node, "ar-1")
+        with pytest.raises(ResourceNotFoundException):
+            node.update_aliases({"actions": [
+                {"remove": {"index": "ar-1", "alias": "nope"}}]})
+
+    def test_remove_missing_alias_must_exist_false_ok(self, node):
+        _seed(node, "ar-2")
+        res = node.update_aliases({"actions": [
+            {"remove": {"index": "ar-2", "alias": "nope",
+                        "must_exist": False}}]})
+        assert res == {"acknowledged": True}
+
+    def test_atomic_no_partial_apply(self, node):
+        _seed(node, "ar-3")
+        with pytest.raises(ResourceNotFoundException):
+            node.update_aliases({"actions": [
+                {"add": {"index": "ar-3", "alias": "ok"}},
+                {"remove": {"index": "ar-3", "alias": "nope"}},
+            ]})
+        # the add in the same request must not have been applied
+        assert node.get_alias(alias_expr="ok") == {}
+
+
+class TestSingleDocPressure:
+    """ADVICE r1: single-doc writes pass through IndexingPressure too."""
+
+    def test_index_doc_accounts_pressure(self, node):
+        _seed(node, "p-1")
+        before = node.indexing_pressure.total_bytes
+        node.index_doc("p-1", "z", {"tag": "t", "n": 1})
+        assert node.indexing_pressure.total_bytes > before
+        assert node.indexing_pressure.current_bytes == 0  # released
+
+    def test_single_doc_rejected_over_limit(self, node):
+        from opensearch_tpu.common.errors import RejectedExecutionException
+        _seed(node, "p-2")
+        node.indexing_pressure.limit = 8
+        try:
+            with pytest.raises(RejectedExecutionException):
+                node.index_doc("p-2", "big", {"tag": "x" * 100, "n": 1})
+        finally:
+            node.indexing_pressure.limit = 10 * 1024 * 1024

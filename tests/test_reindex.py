@@ -170,3 +170,57 @@ class TestDeleteByQuery:
             "query": {"match_all": {}}, "max_docs": 7}, refresh=True)
         assert res["deleted"] == 7
         assert node.count("src")["count"] == 18
+
+
+class TestRoutingPreserved:
+    """ADVICE r1 (medium): the reindex family must carry _routing so routed
+    docs are CAS-checked and re-written on their owning shard."""
+
+    @pytest.fixture()
+    def routed(self, tmp_path):
+        n = TpuNode(tmp_path / "routed")
+        n.create_index("r_src", {"settings": {"number_of_shards": 4},
+                                 "mappings": {"properties": {
+                                     "n": {"type": "long"}}}})
+        for i in range(12):
+            n.index_doc("r_src", f"d{i}", {"n": i}, routing="rk")
+        n.refresh("r_src")
+        return n
+
+    def test_search_hits_expose_routing(self, routed):
+        resp = routed.search("r_src", {"query": {"match_all": {}}, "size": 5})
+        for hit in resp["hits"]["hits"]:
+            assert hit["_routing"] == "rk"
+
+    def test_get_exposes_routing(self, routed):
+        got = routed.get_doc("r_src", "d0", routing="rk")
+        assert got["found"] and got["_routing"] == "rk"
+
+    def test_delete_by_query_routed(self, routed):
+        res = delete_by_query(routed, "r_src",
+                              {"query": {"range": {"n": {"lt": 6}}}},
+                              refresh=True)
+        assert res["deleted"] == 6 and not res["failures"]
+        assert res["version_conflicts"] == 0
+        assert routed.count("r_src")["count"] == 6
+
+    def test_update_by_query_routed(self, routed):
+        res = update_by_query(
+            routed, "r_src",
+            {"script": {"source": "ctx._source.n = ctx._source.n + 100"}},
+            refresh=True,
+        )
+        assert res["updated"] == 12 and not res["failures"]
+        # no duplicate copies on the _id-hashed shard: count is unchanged
+        assert routed.count("r_src")["count"] == 12
+        got = routed.get_doc("r_src", "d3", routing="rk")
+        assert got["_source"]["n"] == 103
+
+    def test_reindex_routed(self, routed):
+        res = reindex(routed, {"source": {"index": "r_src"},
+                               "dest": {"index": "r_dst"}}, refresh=True)
+        assert res["created"] == 12
+        # the copy is addressable with the original routing key
+        got = routed.get_doc("r_dst", "d1", routing="rk")
+        assert got["found"] and got["_source"]["n"] == 1
+        assert got["_routing"] == "rk"
