@@ -407,6 +407,7 @@ class ClusterNode:
             )
         else:
             result = shard.apply_delete_on_primary(payload["id"])
+        shard.maybe_sync_translog()
         # fan out to every assigned replica copy — STARTED and recovering
         # alike (ReplicationOperation.performOnReplicas sends to all in-sync
         # + tracked copies; a recovering replica dedups via seq_no)
@@ -441,6 +442,9 @@ class ClusterNode:
             )
         else:
             shard.apply_delete_on_replica(payload["id"], payload["seq_no"])
+        # replica acks are durability promises too (the primary counts this
+        # copy in-sync based on them): fsync before responding
+        shard.maybe_sync_translog()
         return {"ack": True}
 
     # ------------------------------------------------------------------ #

@@ -39,6 +39,7 @@ from opensearch_tpu.index.segment import (
     load_segment,
     save_segment,
 )
+from opensearch_tpu.index.seqno import LocalCheckpointTracker
 from opensearch_tpu.index.translog import Translog
 
 
@@ -76,13 +77,18 @@ class SearcherSnapshot:
 
 
 class Engine:
-    def __init__(self, path: str | Path, mapper_service: MapperService):
+    def __init__(self, path: str | Path, mapper_service: MapperService,
+                 durability: str = "request"):
         self.path = Path(path)
         self.path.mkdir(parents=True, exist_ok=True)
         self.mapper_service = mapper_service
         self.translog = Translog(self.path / "translog")
+        # "request" = fsync once per request before ack (the reference's
+        # index.translog.durability=REQUEST — TransportWriteAction syncs at
+        # the end of the shard bulk, NOT per op); "async" = fsync only on
+        # refresh/flush (the sync_interval timer analog)
+        self.durability = durability
         self.version_map: dict[str, VersionEntry] = {}
-        self._seq_no = -1
         self._segment_counter = 0
         self._segments: list[tuple[HostSegment, DeviceSegment]] = []
         self._buffer: list[tuple[ParsedDocument, int] | None] = []
@@ -90,22 +96,35 @@ class Engine:
         self._refresh_generation = 0
         self._searcher = SearcherSnapshot([], 0)
         self._dirty_live: set[str] = set()  # segment names needing live republish
-        self.local_checkpoint = -1
+        # gap-tracking checkpoint machinery (LocalCheckpointTracker.java):
+        # on the primary ops issue+process in order; on a replica fed by a
+        # real transport they arrive out of order and the checkpoint must
+        # hold at the first unprocessed seq_no
+        self.tracker = LocalCheckpointTracker()
+        self._sync_needed = False
         self.stats = {"index_total": 0, "delete_total": 0, "refresh_total": 0,
                       "flush_total": 0, "index_time_ms": 0.0}
         self._recover()
 
     # -- sequence numbers --------------------------------------------------
 
-    def _next_seq_no(self) -> int:
-        self._seq_no += 1
-        # single-writer engine: checkpoint advances with every issued seq_no
-        self.local_checkpoint = self._seq_no
-        return self._seq_no
-
     @property
     def max_seq_no(self) -> int:
-        return self._seq_no
+        return self.tracker.max_seq_no
+
+    @property
+    def local_checkpoint(self) -> int:
+        return self.tracker.checkpoint
+
+    # -- durability --------------------------------------------------------
+
+    def ensure_synced(self) -> None:
+        """Fsync the translog once per REQUEST (possibly covering many ops
+        — Translog.java:606 + TransportWriteAction's AsyncAfterWriteAction).
+        No-op when nothing was appended since the last sync."""
+        if self._sync_needed:
+            self.translog.sync()
+            self._sync_needed = False
 
     # -- write path --------------------------------------------------------
 
@@ -134,16 +153,13 @@ class Engine:
             # already applied (reference: per-doc seq_no check in
             # InternalEngine.planIndexingAsNonPrimary — ops may arrive both
             # via recovery dump and concurrent replication fan-out, in
-            # either order)
-            self._seq_no = max(self._seq_no, seq_no)
-            self.local_checkpoint = self._seq_no
+            # either order). Still marked processed: the checkpoint counts
+            # seq_nos this copy has ACCOUNTED FOR, including superseded ones
+            self.tracker.mark_seq_no_as_processed(seq_no)
             return OpResult(doc_id, seq_no, entry.version, created=False,
                             result="noop")
         parsed = self.mapper_service.parse_document(doc_id, source, routing)
-        op_seq = seq_no if seq_no is not None else self._next_seq_no()
-        if seq_no is not None:
-            self._seq_no = max(self._seq_no, seq_no)
-            self.local_checkpoint = self._seq_no
+        op_seq = seq_no if seq_no is not None else self.tracker.generate_seq_no()
         created = entry is None or entry.deleted
         version = 1 if created else entry.version + 1
         self._delete_from_live_segments(doc_id)
@@ -153,7 +169,8 @@ class Engine:
             {"op": "index", "id": doc_id, "seq_no": op_seq, "version": version,
              "source": source, "routing": routing}
         )
-        self.translog.sync()
+        self._sync_needed = True
+        self.tracker.mark_seq_no_as_processed(op_seq)
         self.stats["index_total"] += 1
         self.stats["index_time_ms"] += (time.monotonic() - t0) * 1e3
         return OpResult(doc_id, op_seq, version, created=created,
@@ -172,14 +189,10 @@ class Engine:
                 )
         if seq_no is not None and entry is not None and entry.seq_no >= seq_no:
             # stale op (see index()): ignore, a newer op already applied
-            self._seq_no = max(self._seq_no, seq_no)
-            self.local_checkpoint = self._seq_no
+            self.tracker.mark_seq_no_as_processed(seq_no)
             return OpResult(doc_id, seq_no, entry.version, found=False,
                             result="noop")
-        op_seq = seq_no if seq_no is not None else self._next_seq_no()
-        if seq_no is not None:
-            self._seq_no = max(self._seq_no, seq_no)
-            self.local_checkpoint = self._seq_no
+        op_seq = seq_no if seq_no is not None else self.tracker.generate_seq_no()
         version = (entry.version + 1) if entry else 1
         self._buffer_remove(doc_id)
         self._delete_from_live_segments(doc_id)
@@ -187,7 +200,8 @@ class Engine:
         self.translog.add(
             {"op": "delete", "id": doc_id, "seq_no": op_seq, "version": version}
         )
-        self.translog.sync()
+        self._sync_needed = True
+        self.tracker.mark_seq_no_as_processed(op_seq)
         self.stats["delete_total"] += 1
         return OpResult(doc_id, op_seq, version, found=found,
                         result="deleted" if found else "not_found")
@@ -239,6 +253,10 @@ class Engine:
 
     def refresh(self) -> SearcherSnapshot:
         """Seal the RAM buffer into a new segment + republish live masks."""
+        # async durability: the refresh cadence doubles as the fsync timer
+        # (index.translog.sync_interval analog); no-op under request
+        # durability where every ack already synced
+        self.ensure_synced()
         live_buffer = [e for e in self._buffer if e is not None]
         if live_buffer:
             self._segment_counter += 1
@@ -272,7 +290,7 @@ class Engine:
         import hashlib
 
         return (
-            self._seq_no,
+            self.tracker.max_seq_no,
             tuple(
                 (h.name, hashlib.sha1(h.live.tobytes()).hexdigest())
                 for h, _ in self._segments
@@ -301,7 +319,7 @@ class Engine:
             save_segment(host, seg_dir)
         commit = {
             "segments": [h.name for h, _ in self._segments],
-            "max_seq_no": self._seq_no,
+            "max_seq_no": self.tracker.max_seq_no,
             "local_checkpoint": self.local_checkpoint,
             "segment_counter": self._segment_counter,
             "translog_generation": self.translog.current_generation + 1,
@@ -332,8 +350,10 @@ class Engine:
             for name in commit["segments"]:
                 host = load_segment(seg_dir, name)
                 self._segments.append((host, to_device(host)))
-            self._seq_no = commit["max_seq_no"]
-            self.local_checkpoint = commit["local_checkpoint"]
+            self.tracker = LocalCheckpointTracker(
+                max_seq_no=commit["max_seq_no"],
+                local_checkpoint=commit["local_checkpoint"],
+            )
             self._segment_counter = commit["segment_counter"]
             self.version_map = {
                 doc_id: VersionEntry(seq, ver, deleted)
@@ -348,14 +368,12 @@ class Engine:
                 parsed = self.mapper_service.parse_document(
                     op["id"], op["source"], op.get("routing")
                 )
-                self._seq_no = max(self._seq_no, op["seq_no"])
-                self.local_checkpoint = self._seq_no
+                self.tracker.mark_seq_no_as_processed(op["seq_no"])
                 self._delete_from_live_segments(op["id"])
                 self._buffer_put(parsed, op["seq_no"])
                 self.version_map[op["id"]] = VersionEntry(op["seq_no"], op["version"])
             else:
-                self._seq_no = max(self._seq_no, op["seq_no"])
-                self.local_checkpoint = self._seq_no
+                self.tracker.mark_seq_no_as_processed(op["seq_no"])
                 self._buffer_remove(op["id"])
                 self._delete_from_live_segments(op["id"])
                 self.version_map[op["id"]] = VersionEntry(

@@ -27,10 +27,11 @@ class ShardId:
 
 
 class IndexShard:
-    def __init__(self, shard_id: ShardId, path: Path, mapper_service: MapperService):
+    def __init__(self, shard_id: ShardId, path: Path, mapper_service: MapperService,
+                 durability: str = "request"):
         self.shard_id = shard_id
         self.mapper_service = mapper_service
-        self.engine = Engine(path, mapper_service)
+        self.engine = Engine(path, mapper_service, durability=durability)
         self.primary = True
 
     # -- write ops ---------------------------------------------------------
@@ -60,6 +61,13 @@ class IndexShard:
 
     def acquire_searcher(self) -> SearcherSnapshot:
         return self.engine.acquire_searcher()
+
+    def maybe_sync_translog(self) -> None:
+        """Fsync once per request before the ack when durability=request
+        (IndexShard.maybeSyncTranslog / TransportWriteAction's async-after
+        action); async durability defers to the refresh-interval timer."""
+        if self.engine.durability == "request":
+            self.engine.ensure_synced()
 
     def refresh(self) -> None:
         self.engine.refresh()

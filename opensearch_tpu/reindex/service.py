@@ -10,6 +10,7 @@ and either abort (default) or are counted and skipped (conflicts=proceed).
 
 from __future__ import annotations
 
+import json
 import time
 from typing import Any
 
@@ -171,41 +172,48 @@ def update_by_query(node, index: str, body: dict | None = None,
                                   int(body.get("size", DEFAULT_BATCH)),
                                   task=task):
             stats["batches"] += 1
-            for hit in hits:
-                if max_docs is not None and stats["total"] >= int(max_docs):
-                    done = True
-                    break
-                stats["total"] += 1
-                op, new_source = _run_script(compiled, hit, "index")
-                if op == "noop":
-                    stats["noops"] += 1
-                    continue
-                try:
-                    # CAS on the seq-no observed at scan time: a doc
-                    # modified since then is a version conflict
-                    if op == "delete":
-                        node.delete_doc(hit["_index"], hit["_id"],
-                                        routing=hit.get("_routing"),
-                                        if_seq_no=hit["_seq_no"])
-                        stats["deleted"] += 1
-                    else:
-                        node.index_doc(
-                            hit["_index"], hit["_id"], new_source,
-                            routing=hit.get("_routing"),
-                            if_seq_no=hit["_seq_no"],
-                        )
-                        stats["updated"] += 1
-                except OpenSearchTpuException as e:
-                    if isinstance(e, VersionConflictException):
-                        stats["version_conflicts"] += 1
-                        if conflicts_proceed:
-                            continue
-                    failures.append({
-                        "index": hit["_index"], "id": hit["_id"],
-                        "cause": e.to_dict(), "status": e.status,
-                    })
-                    done = True
-                    break
+            # one write-request scope per scan batch: pressure accounted and
+            # translog fsynced ONCE per batch, not per doc (the reference's
+            # by-query workers write through bulk for the same reason)
+            with node._write_pressure(
+                sum(len(json.dumps(h.get("_source") or {})) for h in hits),
+                "update_by_query",
+            ):
+                for hit in hits:
+                    if max_docs is not None and stats["total"] >= int(max_docs):
+                        done = True
+                        break
+                    stats["total"] += 1
+                    op, new_source = _run_script(compiled, hit, "index")
+                    if op == "noop":
+                        stats["noops"] += 1
+                        continue
+                    try:
+                        # CAS on the seq-no observed at scan time: a doc
+                        # modified since then is a version conflict
+                        if op == "delete":
+                            node.delete_doc(hit["_index"], hit["_id"],
+                                            routing=hit.get("_routing"),
+                                            if_seq_no=hit["_seq_no"])
+                            stats["deleted"] += 1
+                        else:
+                            node.index_doc(
+                                hit["_index"], hit["_id"], new_source,
+                                routing=hit.get("_routing"),
+                                if_seq_no=hit["_seq_no"],
+                            )
+                            stats["updated"] += 1
+                    except OpenSearchTpuException as e:
+                        if isinstance(e, VersionConflictException):
+                            stats["version_conflicts"] += 1
+                            if conflicts_proceed:
+                                continue
+                        failures.append({
+                            "index": hit["_index"], "id": hit["_id"],
+                            "cause": e.to_dict(), "status": e.status,
+                        })
+                        done = True
+                        break
             if done:
                 break
         if refresh:
@@ -233,28 +241,30 @@ def delete_by_query(node, index: str, body: dict | None = None,
                                   int(body.get("size", DEFAULT_BATCH)),
                                   source_filter=False, task=task):
             stats["batches"] += 1
-            for hit in hits:
-                if max_docs is not None and stats["total"] >= int(max_docs):
-                    done = True
-                    break
-                stats["total"] += 1
-                try:
-                    resp = node.delete_doc(hit["_index"], hit["_id"],
-                                           routing=hit.get("_routing"),
-                                           if_seq_no=hit["_seq_no"])
-                    if resp["result"] == "deleted":
-                        stats["deleted"] += 1
-                except OpenSearchTpuException as e:
-                    if isinstance(e, VersionConflictException):
-                        stats["version_conflicts"] += 1
-                        if conflicts_proceed:
-                            continue
-                    failures.append({
-                        "index": hit["_index"], "id": hit["_id"],
-                        "cause": e.to_dict(), "status": e.status,
-                    })
-                    done = True
-                    break
+            # batch-level write scope: one fsync per batch (see update_by_query)
+            with node._write_pressure(64 * len(hits), "delete_by_query"):
+                for hit in hits:
+                    if max_docs is not None and stats["total"] >= int(max_docs):
+                        done = True
+                        break
+                    stats["total"] += 1
+                    try:
+                        resp = node.delete_doc(hit["_index"], hit["_id"],
+                                               routing=hit.get("_routing"),
+                                               if_seq_no=hit["_seq_no"])
+                        if resp["result"] == "deleted":
+                            stats["deleted"] += 1
+                    except OpenSearchTpuException as e:
+                        if isinstance(e, VersionConflictException):
+                            stats["version_conflicts"] += 1
+                            if conflicts_proceed:
+                                continue
+                        failures.append({
+                            "index": hit["_index"], "id": hit["_id"],
+                            "cause": e.to_dict(), "status": e.status,
+                        })
+                        done = True
+                        break
             if done:
                 break
         if refresh:

@@ -1,0 +1,154 @@
+"""LocalCheckpointTracker gap tracking + request-level translog durability.
+
+Reference surface: index/seqno/LocalCheckpointTracker.java (checkpoint
+holds at the first unprocessed seq_no), ReplicationTracker.java:104
+(global checkpoint = min over in-sync copies), Translog.java:606 +
+TransportWriteAction (fsync once per request, not per op).
+"""
+
+import pytest
+
+from opensearch_tpu.index.engine import Engine
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.seqno import (
+    LocalCheckpointTracker,
+    ReplicationTracker,
+)
+
+MAPPINGS = {"properties": {"n": {"type": "long"}}}
+
+
+class TestLocalCheckpointTracker:
+    def test_in_order(self):
+        t = LocalCheckpointTracker()
+        for i in range(5):
+            assert t.generate_seq_no() == i
+            t.mark_seq_no_as_processed(i)
+        assert t.checkpoint == 4 and t.max_seq_no == 4
+
+    def test_gap_holds_checkpoint(self):
+        t = LocalCheckpointTracker()
+        t.mark_seq_no_as_processed(0)
+        t.mark_seq_no_as_processed(2)  # gap at 1
+        t.mark_seq_no_as_processed(3)
+        assert t.checkpoint == 0 and t.max_seq_no == 3
+        assert t.pending_count == 2
+        t.mark_seq_no_as_processed(1)  # gap fills -> contiguous run
+        assert t.checkpoint == 3 and t.pending_count == 0
+
+    def test_has_processed(self):
+        t = LocalCheckpointTracker()
+        t.mark_seq_no_as_processed(0)
+        t.mark_seq_no_as_processed(5)
+        assert t.has_processed(0) and t.has_processed(5)
+        assert not t.has_processed(3)
+
+
+class TestReplicationTracker:
+    def test_global_checkpoint_min_over_in_sync(self):
+        rt = ReplicationTracker("p")
+        rt.update_local_checkpoint("p", 10)
+        assert rt.global_checkpoint == 10
+        rt.mark_in_sync("r1", 7)
+        assert rt.global_checkpoint == 10  # monotonic: never moves back
+        rt.update_local_checkpoint("r1", 12)
+        rt.update_local_checkpoint("p", 15)
+        assert rt.global_checkpoint == 12
+
+    def test_tracked_but_not_in_sync_does_not_hold_back(self):
+        rt = ReplicationTracker("p")
+        rt.update_local_checkpoint("p", 5)
+        rt.initiate_tracking("recovering")
+        assert rt.global_checkpoint == 5
+
+    def test_remove_tracking(self):
+        rt = ReplicationTracker("p")
+        rt.update_local_checkpoint("p", 9)
+        rt.mark_in_sync("r1", 9)
+        rt.update_local_checkpoint("p", 20)
+        assert rt.global_checkpoint == 9
+        rt.remove_tracking("r1")
+        assert rt.global_checkpoint == 20
+
+
+class TestEngineOutOfOrderReplica:
+    """A replica fed by a real transport sees reordered ops; the local
+    checkpoint must hold at the gap and recovery must not claim unseen ops."""
+
+    def test_reordered_ops_checkpoint(self, tmp_path):
+        e = Engine(tmp_path / "replica", MapperService(MAPPINGS))
+        e.index("a", {"n": 0}, seq_no=0)
+        e.index("c", {"n": 2}, seq_no=2)  # seq 1 not yet delivered
+        assert e.local_checkpoint == 0 and e.max_seq_no == 2
+        e.index("b", {"n": 1}, seq_no=1)
+        assert e.local_checkpoint == 2
+        e.close()
+
+    def test_stale_op_marks_processed(self, tmp_path):
+        e = Engine(tmp_path / "replica", MapperService(MAPPINGS))
+        e.index("a", {"n": 5}, seq_no=5)
+        r = e.index("a", {"n": 3}, seq_no=3)  # superseded update, late arrival
+        assert r.result == "noop"
+        # 3 is accounted for even though its write was superseded
+        assert e.tracker.has_processed(3)
+        e.close()
+
+
+class TestRequestDurability:
+    def test_no_per_op_fsync(self, tmp_path, monkeypatch):
+        e = Engine(tmp_path / "s", MapperService(MAPPINGS))
+        syncs = []
+        orig = e.translog.sync
+        monkeypatch.setattr(e.translog, "sync", lambda: syncs.append(1) or orig())
+        for i in range(50):
+            e.index(str(i), {"n": i})
+        assert syncs == []           # nothing synced until the request asks
+        e.ensure_synced()
+        assert len(syncs) == 1       # one fsync for 50 ops
+        e.ensure_synced()
+        assert len(syncs) == 1       # clean engine -> no-op
+        e.close()
+
+    def test_bulk_single_fsync_through_node(self, tmp_path, monkeypatch):
+        from opensearch_tpu.node import TpuNode
+
+        node = TpuNode(tmp_path / "n")
+        node.create_index("idx", {"settings": {"number_of_shards": 1}})
+        sh = node.indices["idx"].shards[0]
+        syncs = []
+        orig = sh.engine.translog.sync
+        monkeypatch.setattr(sh.engine.translog, "sync",
+                            lambda: syncs.append(1) or orig())
+        node.bulk([("index", {"_index": "idx", "_id": str(i)}, {"n": i})
+                   for i in range(100)])
+        assert len(syncs) == 1
+
+    def test_async_durability_syncs_on_refresh(self, tmp_path, monkeypatch):
+        from opensearch_tpu.node import TpuNode
+
+        node = TpuNode(tmp_path / "n")
+        node.create_index("idx", {"settings": {
+            "number_of_shards": 1, "translog.durability": "async"}})
+        sh = node.indices["idx"].shards[0]
+        syncs = []
+        orig = sh.engine.translog.sync
+        monkeypatch.setattr(sh.engine.translog, "sync",
+                            lambda: syncs.append(1) or orig())
+        node.index_doc("idx", "1", {"n": 1})
+        assert syncs == []           # async: the ack does not wait for fsync
+        node.refresh("idx")
+        assert len(syncs) == 1       # refresh cadence doubles as sync timer
+
+    def test_acked_write_survives_crash(self, tmp_path):
+        """Request-level sync still means an acknowledged single-doc write
+        is durable: reopen from disk without a clean close."""
+        from opensearch_tpu.node import TpuNode
+
+        node = TpuNode(tmp_path / "n")
+        node.create_index("idx", {"settings": {"number_of_shards": 1}})
+        node.index_doc("idx", "1", {"n": 41})
+        node.bulk([("index", {"_index": "idx", "_id": "2"}, {"n": 42})])
+        # simulate crash: NO close()/flush(); reopen from the same dir
+        node2 = TpuNode(tmp_path / "n")
+        assert node2.get_doc("idx", "1")["_source"]["n"] == 41
+        assert node2.get_doc("idx", "2")["_source"]["n"] == 42
