@@ -155,7 +155,14 @@ class ClusterNode:
             if (index_name, shard_num) not in self.local_shards:
                 ms = self._mapper_for(index_name, state)
                 path = self.data_path / "indices" / index_name / str(shard_num)
-                shard = IndexShard(ShardId(index_name, shard_num), path, ms)
+                from opensearch_tpu.index.shard import translog_durability
+
+                shard = IndexShard(
+                    ShardId(index_name, shard_num), path, ms,
+                    durability=translog_durability(
+                        state.indices[index_name].settings
+                    ),
+                )
                 shard.primary = entry.primary
                 self.local_shards[(index_name, shard_num)] = shard
                 if entry.state == "INITIALIZING":

@@ -281,10 +281,98 @@ class Engine:
                 for h, d in self._segments
             ]
             self._dirty_live.clear()
+        self._maybe_merge()
         self._refresh_generation += 1
         self._searcher = SearcherSnapshot(list(self._segments), self._refresh_generation)
         self.stats["refresh_total"] += 1
         return self._searcher
+
+    # -- merging -----------------------------------------------------------
+    #
+    # The OpenSearchConcurrentMergeScheduler + TieredMergePolicy analog
+    # (InternalEngine.java:152, CombinedDeletionPolicy). Without merging
+    # every refresh adds a segment forever: per-segment device dispatch
+    # overhead grows without bound and deleted docs are never reclaimed.
+    # The TPU model: merges happen on HOST (rebuild packed arrays from the
+    # live docs of the source segments), then the merged segment is
+    # republished to device HBM and the next searcher snapshot swaps it in.
+    # Old snapshots (scroll/PIT) keep their references to the merged-away
+    # segments — immutability gives the IndexReader refcount semantics for
+    # free; the arrays are dropped when the last snapshot dies.
+
+    MAX_SEGMENTS_BEFORE_MERGE = 10  # segments_per_tier analog
+    MERGE_FACTOR = 8                # how many smallest segments fuse per pass
+
+    def _maybe_merge(self) -> None:
+        """Background-merge policy, run synchronously at refresh time (the
+        single-writer engine's scheduler): when the tier overflows, fuse the
+        MERGE_FACTOR smallest segments into one."""
+        if len(self._segments) <= self.MAX_SEGMENTS_BEFORE_MERGE:
+            return
+        by_size = sorted(self._segments, key=lambda hd: int(hd[0].live.sum()))
+        self._merge_segments([h.name for h, _ in by_size[: self.MERGE_FACTOR]])
+
+    def force_merge(self, max_num_segments: int = 1,
+                    only_expunge_deletes: bool = False) -> dict:
+        """POST /{index}/_forcemerge — fuse down to max_num_segments (or
+        just rewrite segments carrying tombstones)."""
+        self.refresh()
+        if not only_expunge_deletes:
+            while len(self._segments) > max(1, int(max_num_segments)):
+                n_fuse = len(self._segments) - max(1, int(max_num_segments)) + 1
+                by_size = sorted(self._segments,
+                                 key=lambda hd: int(hd[0].live.sum()))
+                self._merge_segments([h.name for h, _ in by_size[:n_fuse]])
+        # a force merge always rewrites tombstone-carrying segments, even
+        # at/below the target count (Lucene's forceMerge drops deletes in
+        # every segment it touches)
+        victims = [h.name for h, _ in self._segments
+                   if int(h.live.sum()) < h.n_docs]
+        if victims:
+            self._merge_segments(victims)
+        self._refresh_generation += 1
+        self._searcher = SearcherSnapshot(list(self._segments),
+                                          self._refresh_generation)
+        return {"segments": len(self._segments)}
+
+    def _merge_segments(self, names: list[str]) -> None:
+        """Fuse the named segments into one new segment holding only their
+        live docs. Docs are re-packed via the mapper (host-side rebuild —
+        the analyze cost is the merge cost, paid off the query path);
+        seal-time seq_nos/versions/routings carry over from the sources."""
+        names_set = set(names)
+        chosen = [(h, d) for h, d in self._segments if h.name in names_set]
+        keep = [(h, d) for h, d in self._segments if h.name not in names_set]
+        live_total = sum(int(h.live.sum()) for h, _ in chosen)
+        if not chosen:
+            return
+        if live_total == 0:
+            # pure-tombstone segments simply drop
+            self._segments = keep
+            self._dirty_live -= {h.name for h, _ in chosen}
+            self.stats["merge_total"] = self.stats.get("merge_total", 0) + 1
+            return
+        self._segment_counter += 1
+        builder = SegmentBuilder(self.mapper_service,
+                                 f"_{self._segment_counter}")
+        versions: list[int] = []
+        for host, _dev in chosen:
+            for d in range(host.n_docs):
+                if not host.live[d]:
+                    continue  # tombstone reclaim
+                parsed = self.mapper_service.parse_document(
+                    host.doc_ids[d], json.loads(host.sources[d]),
+                    host.doc_routings[d] if host.doc_routings else None,
+                )
+                builder.add(parsed, int(host.doc_seq_nos[d]))
+                versions.append(int(host.doc_versions[d]))
+        merged = builder.build()
+        import numpy as _np
+
+        merged.doc_versions = _np.asarray(versions, _np.int64)
+        self._segments = keep + [(merged, to_device(merged))]
+        self._dirty_live -= {h.name for h, _ in chosen}
+        self.stats["merge_total"] = self.stats.get("merge_total", 0) + 1
 
     def _commit_signature(self) -> tuple:
         import hashlib
@@ -334,6 +422,14 @@ class Engine:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path / "commit.json")
+        # merged-away segments are no longer referenced by any commit:
+        # delete their files (CombinedDeletionPolicy keeping only commits
+        # the translog/snapshots still need — here: just the latest)
+        current = {h.name for h, _ in self._segments}
+        for f in seg_dir.glob("_*"):
+            stem = f.name.split(".")[0]
+            if stem not in current:
+                f.unlink(missing_ok=True)
         self.translog.roll_generation()
         self.translog.trim_below(self.translog.current_generation)
         self._last_flush_sig = sig

@@ -17,6 +17,29 @@ from opensearch_tpu.index.engine import Engine, OpResult, SearcherSnapshot
 from opensearch_tpu.index.mapper import MapperService
 
 
+def translog_durability(settings: dict) -> str:
+    """Resolve + validate index.translog.durability from index settings
+    (flat `translog.durability` or nested `translog: {durability}` forms).
+    Raises on unknown values — a typo must not silently downgrade acked
+    writes to no-fsync (Translog.Durability enum validation)."""
+    from opensearch_tpu.common.errors import IllegalArgumentException
+
+    settings = settings or {}
+    tl = settings.get("translog")
+    value = str(
+        settings.get("translog.durability")
+        or settings.get("index.translog.durability")
+        or (tl.get("durability") if isinstance(tl, dict) else None)
+        or "request"
+    ).lower()
+    if value not in ("request", "async"):
+        raise IllegalArgumentException(
+            f"unknown value [{value}] for [index.translog.durability], "
+            "must be one of [request, async]"
+        )
+    return value
+
+
 @dataclass(frozen=True)
 class ShardId:
     index: str

@@ -40,7 +40,7 @@ from opensearch_tpu.common.hashing import shard_id_for_routing
 from opensearch_tpu.common.settings import Settings
 from opensearch_tpu.index.analysis import AnalysisRegistry
 from opensearch_tpu.index.mapper import MapperService
-from opensearch_tpu.index.shard import IndexShard, ShardId
+from opensearch_tpu.index.shard import IndexShard, ShardId, translog_durability
 from opensearch_tpu.search import service as search_service
 
 _VALID_INDEX_NAME = re.compile(r"^[a-z0-9][a-z0-9_\-.]*$")
@@ -111,19 +111,7 @@ class IndexService:
         self.aliases: dict[str, dict] = {}
         self.closed = False
         self.shards: dict[int, IndexShard] = {}
-        tl = settings.get("translog")
-        durability = str(
-            settings.get("translog.durability")
-            or (tl.get("durability") if isinstance(tl, dict) else None)
-            or "request"
-        ).lower()
-        if durability not in ("request", "async"):
-            # reject at creation time — a typo must not silently downgrade
-            # acked writes to no-fsync (Translog.Durability enum validation)
-            raise IllegalArgumentException(
-                f"unknown value [{durability}] for [index.translog.durability]"
-                ", must be one of [request, async]"
-            )
+        durability = translog_durability(settings)
         for s in range(self.num_shards):
             self.shards[s] = IndexShard(
                 ShardId(name, s), path / str(s), self.mapper_service,
@@ -1363,6 +1351,25 @@ class TpuNode:
         for name in self.resolve_indices(index):
             for shard in self._get_index(name).shards.values():
                 shard.flush()
+                count += 1
+        return {"_shards": {"total": count, "successful": count, "failed": 0}}
+
+    def force_merge(self, index: str = "_all",
+                    max_num_segments: int = 1,
+                    only_expunge_deletes: bool = False,
+                    flush: bool = True) -> dict:
+        """POST /{index}/_forcemerge (TransportForceMergeAction →
+        InternalEngine merges via OpenSearchConcurrentMergeScheduler,
+        InternalEngine.java:152)."""
+        count = 0
+        for name in self.resolve_indices(index):
+            for shard in self._get_open_index(name).shards.values():
+                shard.engine.force_merge(
+                    max_num_segments=max_num_segments,
+                    only_expunge_deletes=only_expunge_deletes,
+                )
+                if flush:
+                    shard.flush()
                 count += 1
         return {"_shards": {"total": count, "successful": count, "failed": 0}}
 

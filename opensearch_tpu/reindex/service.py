@@ -10,7 +10,6 @@ and either abort (default) or are counted and skipped (conflicts=proceed).
 
 from __future__ import annotations
 
-import json
 import time
 from typing import Any
 
@@ -176,7 +175,7 @@ def update_by_query(node, index: str, body: dict | None = None,
             # translog fsynced ONCE per batch, not per doc (the reference's
             # by-query workers write through bulk for the same reason)
             with node._write_pressure(
-                sum(len(json.dumps(h.get("_source") or {})) for h in hits),
+                sum(len(str(h.get("_source") or "")) for h in hits),
                 "update_by_query",
             ):
                 for hit in hits:

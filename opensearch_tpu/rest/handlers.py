@@ -83,6 +83,8 @@ def build_router() -> Router:
     reg("POST", "/_refresh", refresh_all)
     reg("POST", "/{index}/_flush", flush)
     reg("POST", "/_flush", flush_all)
+    reg("POST", "/{index}/_forcemerge", forcemerge)
+    reg("POST", "/_forcemerge", forcemerge)
     # ingest pipelines
     reg("PUT", "/_ingest/pipeline/{id}", put_pipeline)
     reg("GET", "/_ingest/pipeline", get_pipelines)
@@ -721,6 +723,17 @@ def flush(node: TpuNode, params, query, body):
 
 def flush_all(node: TpuNode, params, query, body):
     return 200, node.flush("_all")
+
+
+def forcemerge(node: TpuNode, params, query, body):
+    return 200, node.force_merge(
+        params.get("index", "_all"),
+        max_num_segments=int(query.get("max_num_segments", 1)),
+        only_expunge_deletes=(
+            str(query.get("only_expunge_deletes", "false")).lower() == "true"
+        ),
+        flush=str(query.get("flush", "true")).lower() != "false",
+    )
 
 
 # -- cluster / stats ---------------------------------------------------------
