@@ -1,18 +1,26 @@
 """Round benchmark: exact k-NN QPS on one chip vs numpy-CPU baseline.
 
 BASELINE config #1 shape (SIFT-1M-class: 1M x 128-d, L2, script-score exact
-k-NN, single shard): the fused matmul+top_k program (ops/fused.knn_topk)
-against a corpus resident in HBM, batched queries.
+k-NN, single shard): the fused matmul + blockwise-top-k program
+(ops/fused.knn_topk -> ops/topk.blockwise_topk) against a corpus resident
+in HBM, batched queries.
+
+Roofline note (VERDICT r1 #3): the r1 path spent ~70 ms/batch inside the
+sort-based lax.top_k lowering over a [100, 1M] row. The r2 path replaces it
+with exact block-max pruning (one fused block-max pass + k argmax passes),
+measured ~10 ms exec for a 100-query batch and ~25-30 ms for 500. Remaining
+fixed cost on this harness is the ~65 ms tunnel round-trip per dispatch
+(measured with a null program), so throughput is measured with ONE dispatch
+processing many query chunks on device (lax.map) and one result fetch.
 
 Measurement notes:
-- the corpus is generated ON DEVICE with jax.random (no giant host->device
-  transfer over the tunnel);
-- every timed iteration materializes the [batch, k] result to host
-  (np.asarray), so the clock covers real execution + result readback even
-  where block_until_ready is unreliable;
-- the CPU baseline is a BLAS exact scan over a subsample pulled from the
-  device (stand-in for FAISS-CPU flat until the full harness exists), and
-  doubles as the recall@10 reference (both exact -> recall must be ~1.0).
+- corpus generated ON device, padded to 2^20 rows so power-of-two block
+  sizes divide it exactly (no pad copy of the score matrix);
+- every timed wall includes result materialization to host (np.asarray) —
+  block_until_ready does not block on this tunnel backend;
+- the CPU baseline is a BLAS exact scan over a device-pulled subsample
+  (stand-in for FAISS-CPU flat), which also provides the recall reference;
+  blockwise top-k is exact incl. doc-id tie-break, so recall must be 1.0.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
@@ -30,42 +38,64 @@ def main() -> None:
 
     from opensearch_tpu.ops.fused import jit_knn
 
-    d, batch, k = 128, 100, 10
+    d, k = 128, 10
+    chunk = 500          # queries per on-device chunk
+    n_chunks = 4         # 2000 queries per dispatch
     rng = np.random.default_rng(7)
 
     platform = jax.devices()[0].platform
     n = 1_000_000 if platform != "cpu" else 200_000
+    n_pad = 1 << (n - 1).bit_length()  # next power of two
 
-    # corpus lives its whole life in HBM
+    # corpus lives its whole life in HBM; padding rows are zero vectors and
+    # are excluded ONLY by the valid mask (their L2 score 1/(1+||q||^2) is
+    # not self-suppressing — do not weaken the mask)
     key = jax.random.PRNGKey(7)
     vectors = jax.random.normal(key, (n, d), dtype=jnp.float32)
+    vectors = jnp.pad(vectors, ((0, n_pad - n), (0, 0)))
     norms = jnp.sum(vectors * vectors, axis=-1)
-    valid = jnp.ones(n, bool)
+    valid = jnp.arange(n_pad) < n
 
     fn = jit_knn(k=k, similarity="l2_norm")
-    queries0 = jnp.asarray(rng.standard_normal((batch, d)).astype(np.float32))
-    # warmup: compile + one materialized round trip
-    np.asarray(fn(vectors, norms, valid, queries0)[0])
 
-    n_iters = 10
-    qs = [
-        jnp.asarray(rng.standard_normal((batch, d)).astype(np.float32))
-        for _ in range(n_iters)
-    ]
-    times = []
-    for q in qs:
+    # ---- single-batch latency (includes one tunnel round-trip) ----
+    queries0 = jnp.asarray(rng.standard_normal((100, d)).astype(np.float32))
+    np.asarray(fn(vectors, norms, valid, queries0)[0])  # warmup/compile
+    lat = []
+    for _ in range(8):
         t0 = time.perf_counter()
-        vals, ids = fn(vectors, norms, valid, q)
-        _ = np.asarray(vals)  # forces execution + readback
-        times.append(time.perf_counter() - t0)
-    p50 = float(np.median(times))
-    qps = batch / p50
+        np.asarray(fn(vectors, norms, valid, queries0)[0])
+        lat.append(time.perf_counter() - t0)
+    p50_batch = float(np.median(lat))
+
+    # ---- throughput: many chunks in ONE dispatch, one fetch ----
+    import functools
+
+    from opensearch_tpu.ops.fused import knn_topk
+
+    def knn_many(v, nrm, ok, qs):  # qs [n_chunks, chunk, d]
+        f = functools.partial(knn_topk, k=k, similarity="l2_norm")
+        return jax.lax.map(lambda q: f(v, nrm, ok, q), qs)
+
+    jmany = jax.jit(knn_many)
+    qs = jnp.asarray(
+        rng.standard_normal((n_chunks, chunk, d)).astype(np.float32)
+    )
+    np.asarray(jmany(vectors, norms, valid, qs)[0])  # warmup/compile
+    walls = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(jmany(vectors, norms, valid, qs)[0])
+        walls.append(time.perf_counter() - t0)
+    wall = float(np.median(walls))
+    total_q = n_chunks * chunk
+    qps = total_q / wall
 
     # ---- CPU baseline + recall reference over a device-pulled subsample ----
     sub = min(n, 100_000)
     sub_vec = np.asarray(vectors[:sub])
     sub_norms = np.asarray(norms[:sub])
-    q_host = np.asarray(qs[0])
+    q_host = np.asarray(queries0)
     t0 = time.perf_counter()
     reps = 3
     for _ in range(reps):
@@ -74,23 +104,27 @@ def main() -> None:
         cpu_scores = 1.0 / (1.0 + np.maximum(d_sq, 0.0))
         _ = np.argpartition(-cpu_scores, k, axis=1)[:, :k]
     cpu_dt = (time.perf_counter() - t0) / reps
-    cpu_qps = batch / (cpu_dt * (n / sub))  # extrapolated to full corpus
+    cpu_qps = 100 / (cpu_dt * (n / sub))  # extrapolated to full corpus
 
+    sub_pad = 1 << (sub - 1).bit_length()
+    sub_vecs_dev = jnp.pad(vectors[:sub], ((0, sub_pad - sub), (0, 0)))
     sub_ids = np.asarray(
-        fn(vectors[:sub], norms[:sub], jnp.ones(sub, bool), qs[0])[1]
+        fn(sub_vecs_dev, jnp.sum(sub_vecs_dev * sub_vecs_dev, -1),
+           jnp.arange(sub_pad) < sub, queries0)[1]
     )
     recall_hits = 0
-    for i in range(batch):
-        exact = set(np.argsort(-cpu_scores[i], kind="stable")[:k].tolist())
+    for i in range(100):
+        exact = set(np.lexsort((np.arange(sub), -cpu_scores[i]))[:k].tolist())
         recall_hits += len(exact & set(sub_ids[i].tolist()))
-    recall = recall_hits / (batch * k)
+    recall = recall_hits / (100 * k)
 
     print(json.dumps({
         "metric": f"exact_knn_qps_{n // 1000}k_{d}d_top{k}",
         "value": round(qps, 1),
         "unit": "queries/s",
         "vs_baseline": round(qps / cpu_qps, 2),
-        "p50_batch_ms": round(p50 * 1000, 2),
+        "p50_batch100_ms": round(p50_batch * 1000, 2),
+        "dispatch_wall_ms_2000q": round(wall * 1000, 2),
         "recall_at_10": round(recall, 4),
         "platform": platform,
     }))

@@ -125,7 +125,10 @@ def _shard_query_phase(
         q.vector_weight * vec_scores + q.lexical_weight * lex_scores[None, :]
     )
     scores = jnp.where(valid[None, :], scores, -jnp.inf)
-    top_vals, top_ids = jax.lax.top_k(scores, k)     # [B, k]
+    from opensearch_tpu.ops.topk import blockwise_topk
+
+    # blockwise_topk self-gates: small shards fall back to lax.top_k
+    top_vals, top_ids = blockwise_topk(scores, k)       # [B, k]
     shard_idx = jax.lax.axis_index(DATA_AXIS)
     global_ids = top_ids + shard_idx * n_pad
     return top_vals, global_ids

@@ -1274,7 +1274,9 @@ def execute_query_phase(
             if not sort:
                 k = min(size, dev.n_pad)
                 masked = jnp.where(mask, result.scores, -jnp.inf)
-                vals, ids = jax.lax.top_k(masked, k)
+                from opensearch_tpu.ops.topk import segment_top_k
+
+                vals, ids = segment_top_k(masked, k)
                 vals_h, ids_h = np.asarray(vals), np.asarray(ids)
                 for v, d in zip(vals_h, ids_h):
                     if np.isfinite(v):
