@@ -89,6 +89,7 @@ class ClusterNode:
         reg(node_id, "cluster:admin/delete_index", self._on_delete_index)
         reg(node_id, "cluster:admin/put_mapping", self._on_put_mapping)
         reg(node_id, "internal:cluster/shard_started", self._on_shard_started)
+        reg(node_id, "internal:cluster/shard_failed", self._on_shard_failed)
         reg(node_id, "indices:data/write[p]", self._on_primary_write)
         reg(node_id, "indices:data/write[r]", self._on_replica_write)
         reg(node_id, "indices:data/read/get", self._on_get)
@@ -303,6 +304,23 @@ class ClusterNode:
             on_failure=lambda e: callback({"error": str(e)}),
         )
 
+    def delete_index(self, name: str, callback: Callable[[dict], None]) -> None:
+        self.transport.send(
+            self.node_id, self._leader_or_raise(), "cluster:admin/delete_index",
+            {"name": name},
+            on_response=callback,
+            on_failure=lambda e: callback({"error": str(e)}),
+        )
+
+    def put_mapping(self, name: str, mappings: dict,
+                    callback: Callable[[dict], None]) -> None:
+        self.transport.send(
+            self.node_id, self._leader_or_raise(), "cluster:admin/put_mapping",
+            {"name": name, "mappings": mappings},
+            on_response=callback,
+            on_failure=lambda e: callback({"error": str(e)}),
+        )
+
     def _on_create_index(self, sender: str, payload: dict) -> dict:
         if not self.is_leader:
             raise OpenSearchTpuException("not the leader")
@@ -399,13 +417,97 @@ class ClusterNode:
             on_failure=lambda e: callback({"error": str(e)}),
         )
 
+    def bulk(self, operations: list[tuple[str, dict, dict | None]],
+             callback: Callable[[dict], None]) -> None:
+        """TransportBulkAction analog: group per item, dispatch each to its
+        primary, answer when every item answered. Item order is preserved
+        in the response regardless of completion order."""
+        import time as _time
+
+        t0 = _time.monotonic()
+        n = len(operations)
+        if n == 0:
+            callback({"took": 0, "errors": False, "items": []})
+            return
+        items: list[dict | None] = [None] * n
+        pending = {"n": n, "errors": False}
+
+        def finish_one(i: int, action: str, resp: dict) -> None:
+            if "error" in resp:
+                pending["errors"] = True
+                items[i] = {action: {"error": resp["error"], "status": 500}}
+            else:
+                status = 201 if resp.get("result") == "created" else 200
+                items[i] = {action: {**resp, "status": status}}
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                callback({
+                    "took": int((_time.monotonic() - t0) * 1000),
+                    "errors": pending["errors"], "items": items,
+                })
+
+        for i, (action, meta, source) in enumerate(operations):
+            index = meta.get("_index")
+            doc_id = meta.get("_id")
+            routing = meta.get("routing") or meta.get("_routing")
+            cb = (lambda j, a: lambda resp: finish_one(j, a, resp))(i, action)
+            try:
+                if action in ("index", "create"):
+                    self.index_doc(index, doc_id, source, cb, routing)
+                elif action == "delete":
+                    self.delete_doc(index, doc_id, cb, routing)
+                else:
+                    cb({"error": f"unsupported bulk action [{action}]"})
+            except OpenSearchTpuException as e:
+                cb({"error": str(e)})
+
+    def cluster_health(self) -> dict:
+        """Computed from the applied state on ANY node (ClusterStateHealth
+        analog) — no leader round-trip needed for a health read."""
+        state = self.applied_state
+        total = len(state.routing)
+        active = sum(1 for r in state.routing if r.state == "STARTED")
+        active_primaries = sum(
+            1 for r in state.routing if r.primary and r.state == "STARTED"
+        )
+        unassigned = sum(1 for r in state.routing if r.state == "UNASSIGNED")
+        initializing = sum(1 for r in state.routing if r.state == "INITIALIZING")
+        primaries_down = any(
+            r.primary and r.state != "STARTED" for r in state.routing
+        )
+        status = ("red" if primaries_down
+                  else "yellow" if unassigned or initializing else "green")
+        return {
+            "cluster_name": "opensearch-tpu",
+            "status": status,
+            "number_of_nodes": len(state.nodes),
+            "number_of_data_nodes": sum(
+                1 for nd in state.nodes.values() if nd.is_data
+            ),
+            "active_primary_shards": active_primaries,
+            "active_shards": active,
+            "initializing_shards": initializing,
+            "unassigned_shards": unassigned,
+            "cluster_manager_node": state.leader_id,
+            "active_shards_percent_as_number": (
+                100.0 * active / total if total else 100.0
+            ),
+        }
+
     def _local_shard(self, index: str, shard: int) -> IndexShard:
         local = self.local_shards.get((index, shard))
         if local is None:
             raise ShardNotFoundException(f"[{index}][{shard}] not on node {self.node_id}")
         return local
 
-    def _on_primary_write(self, sender: str, payload: dict) -> dict:
+    def _on_primary_write(self, sender: str, payload: dict):
+        """Primary write: apply + fsync locally, fan out to every assigned
+        replica copy, and — crucially — ACK ONLY AFTER EVERY COPY ANSWERED
+        (ReplicationOperation.java:77: the response waits for all in-sync
+        copies; a replica that fails is evicted via a shard-failed leader
+        task before the ack, so an acknowledged write can never be lost by
+        promoting that stale copy). Returns a DeferredResponse when there
+        are replicas."""
         index, shard_num = payload["index"], payload["shard"]
         shard = self._local_shard(index, shard_num)
         if payload["op"] == "index":
@@ -416,8 +518,8 @@ class ClusterNode:
             result = shard.apply_delete_on_primary(payload["id"])
         shard.maybe_sync_translog()
         # fan out to every assigned replica copy — STARTED and recovering
-        # alike (ReplicationOperation.performOnReplicas sends to all in-sync
-        # + tracked copies; a recovering replica dedups via seq_no)
+        # alike (performOnReplicas sends to all in-sync + tracked copies; a
+        # recovering replica dedups via seq_no)
         state = self.applied_state
         target_nodes = {
             r.node_id for r in state.shards_for_index(index)
@@ -426,19 +528,75 @@ class ClusterNode:
         }
         target_nodes |= self._tracked_targets.get((index, shard_num), set())
         target_nodes.discard(self.node_id)
+
+        def response(failed: int) -> dict:
+            return {
+                "_index": index, "_id": payload["id"],
+                "_version": result.version, "_seq_no": result.seq_no,
+                "result": result.result,
+                "_shards": {"total": 1 + len(target_nodes),
+                            "successful": 1 + len(target_nodes) - failed,
+                            "failed": failed},
+            }
+
+        if not target_nodes:
+            return response(0)
+
+        from opensearch_tpu.transport.base import DeferredResponse
+
+        deferred = DeferredResponse()
+        pending = {"n": len(target_nodes), "failed": 0}
         replica_payload = dict(payload, seq_no=result.seq_no, version=result.version)
+
+        def one_done() -> None:
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                deferred.set_result(response(pending["failed"]))
+
+        def on_ack(_resp: Any) -> None:
+            one_done()
+
+        def make_on_fail(nid: str):
+            def on_fail(_e: Exception) -> None:
+                # evict the unreachable copy BEFORE acking (ShardStateAction
+                # shard-failed; the leader reroutes and the copy must
+                # re-recover). If the leader is unreachable too the ack
+                # still proceeds — the election path removes dead nodes.
+                pending["failed"] += 1
+                self._report_shard_failed(index, shard_num, nid, one_done)
+            return on_fail
+
         for nid in sorted(target_nodes):
             self.transport.send(
                 self.node_id, nid, "indices:data/write[r]", replica_payload,
-                on_response=None,
-                on_failure=lambda e: None,  # failed-replica eviction: TODO
+                on_response=on_ack, on_failure=make_on_fail(nid),
             )
-        return {
-            "_index": index, "_id": payload["id"], "_version": result.version,
-            "_seq_no": result.seq_no, "result": result.result,
-            "_shards": {"total": 1 + len(target_nodes),
-                        "successful": 1 + len(target_nodes), "failed": 0},
-        }
+        return deferred
+
+    def _report_shard_failed(self, index: str, shard: int, node_id: str,
+                             done: Callable[[], None]) -> None:
+        leader = self.coordinator.leader_id
+        if leader is None:
+            done()
+            return
+        self.transport.send(
+            self.node_id, leader, "internal:cluster/shard_failed",
+            {"index": index, "shard": shard, "node_id": node_id},
+            on_response=lambda _r: done(),
+            on_failure=lambda _e: done(),
+        )
+
+    def _on_shard_failed(self, sender: str, payload: dict) -> dict:
+        if not self.is_leader:
+            raise OpenSearchTpuException("not the leader")
+        from opensearch_tpu.cluster.allocation import mark_shard_failed
+
+        self.coordinator.submit_state_update(
+            lambda s: mark_shard_failed(
+                s, payload["index"], payload["shard"], payload["node_id"]
+            )
+        )
+        return {"ack": True}
 
     def _on_replica_write(self, sender: str, payload: dict) -> dict:
         shard = self._local_shard(payload["index"], payload["shard"])

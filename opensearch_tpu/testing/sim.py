@@ -186,15 +186,38 @@ class MockTransport:
                     # block exits
                     self.queue.schedule(back, lambda err=e: on_failure(err))
                 return
-            if on_response is not None:
-                back = self.queue.random.randint(self.min_delay_ms, self.max_delay_ms)
+
+            def ship(result: Any, error: Exception | None) -> None:
+                # draw the return delay ONLY when a message actually travels
+                # back — unconditional draws would shift the seeded RNG
+                # sequence and perturb every replayable scenario
+                if error is not None:
+                    if on_failure is not None:
+                        back = self.queue.random.randint(
+                            self.min_delay_ms, self.max_delay_ms
+                        )
+                        self.queue.schedule(back, lambda: on_failure(error))
+                    return
+                if on_response is None:
+                    return
+                back = self.queue.random.randint(
+                    self.min_delay_ms, self.max_delay_ms
+                )
 
                 def respond() -> None:
                     if self._link_ok(target, sender):
-                        on_response(response)
+                        on_response(result)
                     elif on_failure is not None:
                         on_failure(TimeoutError(f"response from {target} lost"))
 
                 self.queue.schedule(back, respond)
+
+            from opensearch_tpu.transport.base import DeferredResponse
+
+            if isinstance(response, DeferredResponse):
+                # handler answers later (replicated write waiting for acks)
+                response.on_done(lambda d: ship(d.result, d.error))
+            else:
+                ship(response, None)
 
         self.queue.schedule(delay, deliver)

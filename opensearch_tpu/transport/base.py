@@ -1,0 +1,65 @@
+"""Transport contracts shared by the real TCP transport and the sim.
+
+The interface both implement (established by testing/sim.MockTransport so
+Coordinator/ClusterNode run unchanged over either):
+
+    register(node_id, action, handler)   handler(sender, payload) -> result
+    send(sender, target, action, payload, on_response=None, on_failure=None)
+
+plus the scheduler contract (schedule(delay_ms, fn) -> cancellable with
+.cancel(), and .random: random.Random) established by
+testing/sim.DeterministicTaskQueue.
+
+`DeferredResponse` extends the handler contract for operations that cannot
+answer synchronously — the primary of a replicated write must wait for
+replica acks before acknowledging (the reference's ReplicationOperation:
+respond only when all in-sync copies answered, TransportReplicationAction
+.java:111). A handler returns a DeferredResponse instead of a dict; the
+transport ships the response frame when set_result fires.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+class DeferredResponse:
+    """A response the handler will produce later (on the same event loop /
+    task queue — no cross-thread use)."""
+
+    def __init__(self) -> None:
+        self._done = False
+        self._result: Any = None
+        self._error: Exception | None = None
+        self._listener: Callable[["DeferredResponse"], None] | None = None
+
+    def set_result(self, result: Any) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._result = result
+        if self._listener is not None:
+            self._listener(self)
+
+    def set_exception(self, error: Exception) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._error = error
+        if self._listener is not None:
+            self._listener(self)
+
+    # -- transport side ----------------------------------------------------
+
+    def on_done(self, listener: Callable[["DeferredResponse"], None]) -> None:
+        self._listener = listener
+        if self._done:
+            listener(self)
+
+    @property
+    def error(self) -> Exception | None:
+        return self._error
+
+    @property
+    def result(self) -> Any:
+        return self._result
