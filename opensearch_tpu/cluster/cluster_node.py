@@ -786,5 +786,6 @@ class ClusterNode:
         }
 
     def close(self) -> None:
+        self.coordinator.stop()
         for shard in self.local_shards.values():
             shard.close()

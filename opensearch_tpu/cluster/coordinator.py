@@ -79,6 +79,7 @@ class Coordinator:
         # join/leave) here
         self.state_transform = state_transform
         self.election_attempts = 0
+        self._stopped = False
         self._election_timer = None
         self._heartbeat_timer = None
         self._leader_check_timer = None
@@ -112,6 +113,15 @@ class Coordinator:
     def start(self) -> None:
         self._become_candidate("started")
 
+    def stop(self) -> None:
+        """Node shutdown: cancel every timer so a closed node stops
+        heartbeating/electing (its transport is closed too — see
+        TcpTransport.send's closed guard)."""
+        self._stopped = True
+        self._cancel_timers()
+        self.mode = Mode.CANDIDATE
+        self.leader_id = None
+
     def bootstrap(self, voting_node_ids: list[str]) -> None:
         """Set the initial voting configuration (ClusterBootstrapService
         analog) — call on ONE node of a fresh cluster."""
@@ -134,6 +144,8 @@ class Coordinator:
         self._election_timer = self._heartbeat_timer = self._leader_check_timer = None
 
     def _become_candidate(self, reason: str) -> None:
+        if self._stopped:
+            return
         self._cancel_timers()
         self.mode = Mode.CANDIDATE
         self.leader_id = None
@@ -141,6 +153,8 @@ class Coordinator:
         self._schedule_election()
 
     def _become_leader(self) -> None:
+        if self._stopped:
+            return
         self._cancel_timers()
         self.mode = Mode.LEADER
         self.leader_id = self.node_id
@@ -152,6 +166,8 @@ class Coordinator:
         self._submit_reroute_publication()
 
     def _become_follower(self, leader_id: str) -> None:
+        if self._stopped:
+            return
         if self.mode == Mode.FOLLOWER and self.leader_id == leader_id:
             return
         self._cancel_timers()

@@ -178,6 +178,15 @@ class TcpTransport:
         on_response: Callable[[Any], None] | None = None,
         on_failure: Callable[[Exception], None] | None = None,
     ) -> None:
+        if self._closed:
+            # a closed transport must behave like a dead process: nothing
+            # leaves the node (otherwise a shut-down leader keeps
+            # heartbeating over fresh dials and drags followers back)
+            if on_failure is not None:
+                self.loop.call_soon(
+                    on_failure, ConnectionError("transport closed")
+                )
+            return
         self.stats["sent"] += 1
         if target == self.node_id:
             # loopback: dispatch on the loop without a socket (the
@@ -226,6 +235,8 @@ class TcpTransport:
         return await asyncio.shield(fut)
 
     async def _dial(self, target: str) -> _Connection:
+        if self._closed:
+            raise ConnectionError("transport closed")
         addr = self.seeds.get(target)
         if addr is None:
             raise ConnectionError(f"no address for node [{target}]")

@@ -92,7 +92,7 @@ class TcpCluster:
             except Exception:  # noqa: BLE001 - test teardown
                 pass
 
-    async def wait_leader(self, timeout_s: float = 15.0) -> str:
+    async def wait_leader(self, timeout_s: float = 30.0) -> str:
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout_s
         while loop.time() < deadline:
@@ -247,6 +247,76 @@ def test_leader_kill_no_acked_write_loss(tcp_cluster):
         for i in (0, 7, 19):
             status, resp = await http(p0, "GET", f"/killtest/_doc/k{i}")
             assert status == 200 and resp["_source"]["n"] == i
+
+    asyncio.run(run(scenario))
+
+
+def test_leader_kill_mid_bulk(tcp_cluster):
+    """Kill the leader WHILE a bulk stream is in flight: every write the
+    client saw acked (with zero failed shard copies) must survive failover;
+    unacked writes may be lost but must not corrupt the index."""
+    cluster, run = tcp_cluster
+
+    async def scenario():
+        leader = await cluster.wait_leader()
+        survivors = [n for n in cluster.node_ids if n != leader]
+        p0 = cluster.http_ports[survivors[0]]
+
+        status, resp = await http(p0, "PUT", "/midbulk", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 2},
+        })
+        assert status == 200, resp
+        await cluster.wait_health(p0, "green")
+
+        acked: set[str] = set()
+        stop = asyncio.Event()
+
+        async def writer_task():
+            i = 0
+            while not stop.is_set():
+                doc_id = f"m{i}"
+                try:
+                    status, resp = await http(
+                        p0, "PUT", f"/midbulk/_doc/{doc_id}", {"n": i},
+                        timeout=5.0,
+                    )
+                    if (status in (200, 201) and resp
+                            and "error" not in resp
+                            and resp.get("_shards", {}).get("failed") == 0):
+                        acked.add(doc_id)
+                except (ConnectionError, OSError, asyncio.TimeoutError,
+                        asyncio.IncompleteReadError):
+                    pass  # in-flight write during failover: no ack, no claim
+                i += 1
+
+        writers = asyncio.create_task(writer_task())
+        await asyncio.sleep(0.5)            # let some writes ack
+        await cluster.servers[leader].aclose()   # kill mid-stream
+        del cluster.servers[leader]
+        await asyncio.sleep(3.0)            # keep writing through failover
+        stop.set()
+        await writers
+
+        # survivors re-elect
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 30.0
+        while loop.time() < deadline:
+            if any(s.node.is_leader for s in cluster.servers.values()):
+                break
+            await asyncio.sleep(0.1)
+        assert any(s.node.is_leader for s in cluster.servers.values()), \
+            "no re-election after mid-bulk leader kill"
+        assert len(acked) > 0, "no writes were acked before/after the kill"
+
+        # every acked doc must be readable after failover
+        await http(p0, "POST", "/midbulk/_refresh")
+        missing = []
+        for doc_id in sorted(acked):
+            status, resp = await http(p0, "GET", f"/midbulk/_doc/{doc_id}")
+            if status != 200:
+                missing.append(doc_id)
+        assert not missing, f"acked writes lost: {missing[:10]} " \
+                            f"({len(missing)}/{len(acked)})"
 
     asyncio.run(run(scenario))
 
