@@ -76,6 +76,9 @@ class SearcherSnapshot:
         return sum(h.n_docs for h, _ in self.segments)
 
 
+_ENGINE_SEQ = 0
+
+
 class Engine:
     def __init__(self, path: str | Path, mapper_service: MapperService,
                  durability: str = "request"):
@@ -89,6 +92,12 @@ class Engine:
         # refresh/flush (the sync_interval timer analog)
         self.durability = durability
         self.version_map: dict[str, VersionEntry] = {}
+        # process-unique engine identity: cache layers (e.g. the distributed
+        # serving bundles) key on it so a deleted+recreated index can never
+        # alias a stale cache entry
+        global _ENGINE_SEQ
+        _ENGINE_SEQ += 1
+        self.instance_id = _ENGINE_SEQ
         self._segment_counter = 0
         self._segments: list[tuple[HostSegment, DeviceSegment]] = []
         self._buffer: list[tuple[ParsedDocument, int] | None] = []

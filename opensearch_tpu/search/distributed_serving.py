@@ -1,0 +1,272 @@
+"""Distributed exact-kNN serving: the on-device cross-shard merge in _search.
+
+This wires parallel/distributed.build_knn_serving_step into the serving
+path (VERDICT r2 missing #1): a multi-shard knn query executes ONE
+shard_map program over the device mesh — per-shard scoring + top-k on each
+device, then all_gather + top_k over ICI — replacing the host-side k-way
+merge of the reference's SearchPhaseController.mergeTopDocs
+(server/src/main/java/org/opensearch/action/search/SearchPhaseController.java:224)
+and its per-shard fan-out (AbstractSearchAsyncAction.java:281).
+
+Layout: at first use after a refresh, each shard's segment vector columns
+are flattened into one [n_flat, d] slab (segment-ascending, doc-ascending —
+the host merge's tie-break order), stacked to [S, n_flat, d] and device_put
+with the shard axis over the mesh's data axis. The slabs are cached per
+(index, field, per-shard segment generations); a refresh invalidates only
+that index's entry.
+
+Fallback contract: any shape this path cannot serve identically to the host
+merge (filters, ANN-indexed segments, mixed similarities) returns None and
+the caller keeps the host path — the can-serve gate mirrors how the
+reference keeps BKD/points fast paths behind eligibility checks.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from opensearch_tpu.parallel.distributed import build_knn_serving_step
+from opensearch_tpu.parallel.mesh import DATA_AXIS
+from opensearch_tpu.search.executor import ShardHit, ShardQueryResult
+
+# observability: tests and the multichip dryrun assert the serving path ran
+stats = {"distributed_searches": 0, "fallbacks": 0}
+
+# kill switch (tests compare against the host merge; ops can disable)
+enabled = True
+
+_BUNDLE_CACHE: dict[tuple, "_IndexBundle"] = {}
+_PROGRAM_CACHE: dict[tuple, Any] = {}
+_MESH_CACHE: dict[int, Mesh] = {}
+_MAX_BUNDLES = 8
+
+
+class _IndexBundle:
+    """[S, n_flat, d] mesh-sharded slabs + host-side flat->segment maps."""
+
+    def __init__(self, vectors, norms_sq, valid, n_flat: int,
+                 seg_offsets: list[list[tuple[int, int, int]]]):
+        self.vectors = vectors          # jnp [S, n_flat, d] on mesh
+        self.norms_sq = norms_sq        # jnp [S, n_flat]
+        self.valid = valid              # jnp [S, n_flat]
+        self.n_flat = n_flat
+        # per shard: [(flat_start, seg_idx, n_docs)] in segment order
+        self.seg_offsets = seg_offsets
+
+    def locate(self, shard_idx: int, flat: int) -> tuple[int, int]:
+        for start, seg_idx, n_docs in self.seg_offsets[shard_idx]:
+            if start <= flat < start + n_docs:
+                return seg_idx, flat - start
+        raise IndexError(f"flat doc {flat} out of range for shard {shard_idx}")
+
+
+def _serving_mesh(n_devices: int) -> Mesh:
+    mesh = _MESH_CACHE.get(n_devices)
+    if mesh is None:
+        grid = np.asarray(jax.devices()[:n_devices]).reshape(n_devices)
+        mesh = Mesh(grid, (DATA_AXIS,))
+        _MESH_CACHE[n_devices] = mesh
+    return mesh
+
+
+def _largest_divisor_at_most(s: int, cap: int) -> int:
+    for d in range(min(s, cap), 0, -1):
+        if s % d == 0:
+            return d
+    return 1
+
+
+def _can_serve(snaps: list, field: str) -> tuple[str, int] | None:
+    """Returns (similarity, dims) if every shard can be served exactly,
+    else None. ANN-indexed segments fall back: the host path would answer
+    them with IVF-PQ, and this path must stay bit-identical to the host."""
+    from opensearch_tpu.ops.knn import canonical_similarity
+
+    similarity = None
+    dims = None
+    any_field = False
+    for snap in snaps:
+        for host, dev in snap.segments:
+            vf = dev.vector_fields.get(field)
+            if vf is None:
+                continue
+            any_field = True
+            if vf.ann is not None:
+                return None
+            sim = canonical_similarity(vf.similarity)
+            if similarity is None:
+                similarity, dims = sim, vf.dims
+            elif sim != similarity or vf.dims != dims:
+                return None
+    if not any_field:
+        return None
+    return similarity, dims
+
+
+def _build_bundle(snaps: list, field: str, dims: int, mesh: Mesh) -> _IndexBundle:
+    per_shard_vecs: list[np.ndarray] = []
+    per_shard_norms: list[np.ndarray] = []
+    per_shard_valid: list[np.ndarray] = []
+    seg_offsets: list[list[tuple[int, int, int]]] = []
+    for snap in snaps:
+        chunks_v, chunks_n, chunks_ok = [], [], []
+        offsets: list[tuple[int, int, int]] = []
+        pos = 0
+        for seg_idx, (host, dev) in enumerate(snap.segments):
+            n = host.n_docs
+            hvf = host.vector_fields.get(field)
+            if hvf is None:
+                chunks_v.append(np.zeros((n, dims), np.float32))
+                chunks_n.append(np.zeros(n, np.float32))
+                chunks_ok.append(np.zeros(n, bool))
+            else:
+                v = np.asarray(hvf.vectors[:n], np.float32)
+                chunks_v.append(v)
+                # identical norm formula to index/device.to_device so scores
+                # match the host path bit-for-bit
+                chunks_n.append(
+                    (v.astype(np.float64) ** 2).sum(axis=1).astype(np.float32)
+                )
+                # dev.live, not host.live: deletes flip host.live in place
+                # before refresh, but the host query path masks with the
+                # PUBLISHED live bitmap (executor.py uses dev.live) — the
+                # bundle must see exactly what the host path sees
+                chunks_ok.append(
+                    np.asarray(hvf.present[:n], bool)
+                    & np.asarray(dev.live)[:n]
+                )
+            offsets.append((pos, seg_idx, n))
+            pos += n
+        seg_offsets.append(offsets)
+        per_shard_vecs.append(
+            np.concatenate(chunks_v) if chunks_v else np.zeros((0, dims), np.float32)
+        )
+        per_shard_norms.append(
+            np.concatenate(chunks_n) if chunks_n else np.zeros(0, np.float32)
+        )
+        per_shard_valid.append(
+            np.concatenate(chunks_ok) if chunks_ok else np.zeros(0, bool)
+        )
+
+    max_docs = max((v.shape[0] for v in per_shard_vecs), default=1)
+    # bucket to the next power of two: keeps the compiled program stable
+    # across refreshes that grow a shard slightly (query-shape cache,
+    # SURVEY.md §7 hard part #3)
+    n_flat = 1 << max(int(max_docs - 1).bit_length(), 3)
+
+    def pad(a: np.ndarray, fill=0) -> np.ndarray:
+        out = np.full((n_flat, *a.shape[1:]), fill, dtype=a.dtype)
+        out[: a.shape[0]] = a
+        return out
+
+    vecs = np.stack([pad(v) for v in per_shard_vecs])
+    norms = np.stack([pad(n) for n in per_shard_norms])
+    valid = np.stack([pad(v, fill=False) for v in per_shard_valid])
+
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    return _IndexBundle(
+        vectors=jax.device_put(jnp.asarray(vecs), NamedSharding(mesh, P(DATA_AXIS, None, None))),
+        norms_sq=jax.device_put(jnp.asarray(norms), sharding),
+        valid=jax.device_put(jnp.asarray(valid), sharding),
+        n_flat=n_flat,
+        seg_offsets=seg_offsets,
+    )
+
+
+def try_distributed_knn(
+    shards: list,
+    snaps: list,
+    node,
+    fetch_k: int,
+) -> list[ShardQueryResult] | None:
+    """Execute a multi-shard KnnQuery through the on-device merge program.
+    Returns per-shard ShardQueryResults shaped exactly like the host path's
+    (winning hits attributed to their shards, per-shard matched counts), or
+    None when this path cannot reproduce the host result."""
+    if node.filter is not None or not shards or len(shards) != len(snaps):
+        return None
+    s = len(shards)
+    if s < 2:
+        return None
+    served = _can_serve(snaps, node.field)
+    if served is None:
+        stats["fallbacks"] += 1
+        return None
+    similarity, dims = served
+    if len(node.vector) != dims:
+        return None
+
+    n_devices = _largest_divisor_at_most(s, len(jax.devices()))
+    mesh = _serving_mesh(n_devices)
+
+    index_name = shards[0].shard_id.index
+    cache_key = (
+        index_name, node.field, s,
+        # engine instance ids make the key immune to delete+recreate cycles
+        # (generations restart at 0 on a fresh engine)
+        tuple(sh.engine.instance_id for sh in shards),
+        tuple(snap.generation for snap in snaps),
+        tuple(len(snap.segments) for snap in snaps),
+    )
+    bundle = _BUNDLE_CACHE.get(cache_key)
+    if bundle is None:
+        # one live bundle per (index, field): refreshes replace it
+        for key in [k for k in _BUNDLE_CACHE if k[:2] == cache_key[:2]]:
+            del _BUNDLE_CACHE[key]
+        while len(_BUNDLE_CACHE) >= _MAX_BUNDLES:
+            del _BUNDLE_CACHE[next(iter(_BUNDLE_CACHE))]
+        bundle = _build_bundle(snaps, node.field, dims, mesh)
+        _BUNDLE_CACHE[cache_key] = bundle
+
+    k_shard = max(1, min(int(node.k), bundle.n_flat))
+    k_final = min(max(k_shard, int(fetch_k)), s * k_shard)
+    prog_key = (n_devices, s, bundle.n_flat, dims, k_shard, k_final, similarity)
+    program = _PROGRAM_CACHE.get(prog_key)
+    if program is None:
+        program = build_knn_serving_step(
+            mesh, k_shard=k_shard, k_final=k_final, similarity=similarity
+        )
+        _PROGRAM_CACHE[prog_key] = program
+
+    queries = jnp.asarray([node.vector], jnp.float32)
+    with mesh:
+        vals, gids, counts = program(
+            bundle.vectors, bundle.norms_sq, bundle.valid, queries
+        )
+    vals = np.asarray(vals)[0]
+    gids = np.asarray(gids)[0]
+    counts = np.asarray(counts)[:, 0]
+    stats["distributed_searches"] += 1
+
+    boost = np.float32(getattr(node, "boost", 1.0))
+    per_shard_hits: list[list[ShardHit]] = [[] for _ in range(s)]
+    for v, g in zip(vals, gids):
+        if not np.isfinite(v):
+            continue
+        shard_idx, flat = int(g) // bundle.n_flat, int(g) % bundle.n_flat
+        seg_idx, doc = bundle.locate(shard_idx, flat)
+        per_shard_hits[shard_idx].append(
+            ShardHit(float(np.float32(v) * boost), seg_idx, doc)
+        )
+
+    results = []
+    for shard_idx in range(s):
+        hits = per_shard_hits[shard_idx]
+        results.append(ShardQueryResult(
+            hits=hits,
+            total=int(counts[shard_idx]),
+            max_score=max((h.score for h in hits), default=None),
+        ))
+    return results
+
+
+def clear_caches() -> None:
+    _BUNDLE_CACHE.clear()
+    _PROGRAM_CACHE.clear()
+    _MESH_CACHE.clear()

@@ -146,28 +146,35 @@ def search(
             for (shard, snap), res in zip(shard_snaps, fused)
         ]
     else:
-        per_shard_results = []
-        for shard_i, shard in enumerate(shards):
-            # cooperative cancellation at the phase boundary — between
-            # device program launches (TaskCancellationService model)
-            if task is not None:
-                task.ensure_not_cancelled()
-            snapshot = acquired[shard_i] if acquired is not None else shard.acquire_searcher()
-            t_q = time.perf_counter_ns()
-            result = execute_query_phase(
-                snapshot,
-                shard.mapper_service,
-                _shard_node(node, shard_i),
-                # search_after cursors can reach arbitrarily deep into a
-                # shard; fall back to all matching docs per shard
-                size=snapshot.max_doc if search_after is not None else fetch_k,
-                sort=sort,
-                need_masks=aggs_body is not None,
-                min_score=float(min_score) if min_score is not None else None,
-            )
-            if want_profile:
-                shard_query_ns.append(time.perf_counter_ns() - t_q)
-            per_shard_results.append((shard, snapshot, result))
+        per_shard_results = _try_distributed_query_phase(
+            shards, acquired, node,
+            sort=sort, search_after=search_after, aggs_body=aggs_body,
+            min_score=min_score, filter_nodes=filter_nodes,
+            want_profile=want_profile, fetch_k=fetch_k, task=task,
+        )
+        if per_shard_results is None:
+            per_shard_results = []
+            for shard_i, shard in enumerate(shards):
+                # cooperative cancellation at the phase boundary — between
+                # device program launches (TaskCancellationService model)
+                if task is not None:
+                    task.ensure_not_cancelled()
+                snapshot = acquired[shard_i] if acquired is not None else shard.acquire_searcher()
+                t_q = time.perf_counter_ns()
+                result = execute_query_phase(
+                    snapshot,
+                    shard.mapper_service,
+                    _shard_node(node, shard_i),
+                    # search_after cursors can reach arbitrarily deep into a
+                    # shard; fall back to all matching docs per shard
+                    size=snapshot.max_doc if search_after is not None else fetch_k,
+                    sort=sort,
+                    need_masks=aggs_body is not None,
+                    min_score=float(min_score) if min_score is not None else None,
+                )
+                if want_profile:
+                    shard_query_ns.append(time.perf_counter_ns() - t_q)
+                per_shard_results.append((shard, snapshot, result))
 
     # ---- reduce phase (SearchPhaseController analog) ----
     merged = []
@@ -382,6 +389,52 @@ def search(
             )
         ]}
     return response
+
+
+def _try_distributed_query_phase(
+    shards: list,
+    acquired: list | None,
+    node: Any,
+    *,
+    sort,
+    search_after,
+    aggs_body,
+    min_score,
+    filter_nodes,
+    want_profile: bool,
+    fetch_k: int,
+    task=None,
+) -> list | None:
+    """Route eligible multi-shard knn queries through the on-device
+    all_gather + top_k merge (parallel/distributed.build_knn_serving_step).
+    Returns the per-shard results list shaped exactly like the host path's,
+    or None when the host merge must run (every other query shape)."""
+    if not isinstance(node, query_dsl.KnnQuery):
+        return None
+    if (len(shards) < 2 or sort or search_after is not None
+            or aggs_body is not None or min_score is not None
+            or want_profile or any(f is not None for f in filter_nodes)):
+        return None
+    from opensearch_tpu.search import distributed_serving
+
+    if not distributed_serving.enabled:
+        return None
+    # same cooperative cancellation point the host loop honors per shard
+    if task is not None:
+        task.ensure_not_cancelled()
+    snaps = (
+        list(acquired) if acquired is not None
+        else [s.acquire_searcher() for s in shards]
+    )
+    results = distributed_serving.try_distributed_knn(
+        shards, snaps, node, fetch_k
+    )
+    if results is None:
+        return None
+    return [
+        (shard, snap, res)
+        for shard, snap, res in zip(shards, snaps, results)
+    ]
 
 
 MAX_BUCKETS = 65_536
