@@ -1,0 +1,775 @@
+"""ClusterFacade: the TpuNode API surface served by a cluster.
+
+The reference funnels every request through ONE RestController + NodeClient
+in front of one action registry regardless of cluster size
+(rest/RestController.java:285, action/ActionModule.java:527). This module
+is that unification for the TPU build: rest/handlers.py's 128 routes run
+unchanged against this object — its methods carry TpuNode's signatures but
+execute with cluster semantics:
+
+- metadata ops route to the elected leader and ride cluster-state
+  publication;
+- document ops route to primaries by murmur3(_routing) % shards and ack
+  after full replication (TransportReplicationAction semantics);
+- searches fan out ONE request per data node holding shards of the target
+  index (search[node] returns a wire partial over all its local shards)
+  and reduce on the coordinator (search/reduce.py:
+  SearchPhaseController.mergeTopDocs + InternalAggregations.reduce);
+- scroll/PIT pin per-node reader contexts; the cluster scroll id encodes
+  {node -> ctx} so ANY node can continue a scroll.
+
+Threading: facade methods are called from the HTTP executor thread and
+bridge onto the transport event loop (call_soon_threadsafe + futures); the
+loop thread never blocks in here.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import uuid
+from concurrent.futures import Future
+from typing import Any, Callable
+
+from opensearch_tpu import __version__
+from opensearch_tpu.common.errors import (
+    DocumentMissingException,
+    IllegalArgumentException,
+    IndexNotFoundException,
+    OpenSearchTpuException,
+    ResourceAlreadyExistsException,
+    SearchContextMissingException,
+    VersionConflictException,
+)
+from opensearch_tpu.index.mapper import MapperService
+
+RPC_TIMEOUT_S = 30.0
+
+# transport errors arrive as "ExceptionName: reason" strings; map the names
+# back to typed exceptions so REST status codes survive the wire
+_ERROR_TYPES = {}
+
+
+def _register_error_types() -> None:
+    import opensearch_tpu.common.errors as err_mod
+
+    for name in dir(err_mod):
+        obj = getattr(err_mod, name)
+        if isinstance(obj, type) and issubclass(obj, OpenSearchTpuException):
+            _ERROR_TYPES[name] = obj
+
+
+_register_error_types()
+
+
+def rehydrate_error(message) -> OpenSearchTpuException:
+    # loopback sends deliver the exception object itself — keep its type
+    if isinstance(message, OpenSearchTpuException):
+        return message
+    if isinstance(message, Exception):
+        message = str(message)
+    name, _, reason = str(message).partition(":")
+    cls = _ERROR_TYPES.get(name.strip())
+    if cls is not None:
+        try:
+            return cls(reason.strip())
+        except TypeError:
+            pass
+    return OpenSearchTpuException(str(message))
+
+
+class _IndexView:
+    """Read-only IndexService stand-in built from cluster state."""
+
+    def __init__(self, meta, mapper_service: MapperService):
+        self.name = meta.name
+        self.num_shards = meta.num_shards
+        self.num_replicas = meta.num_replicas
+        self.settings = dict(meta.settings or {})
+        self.mapper_service = mapper_service
+        self.aliases: dict[str, dict] = dict(
+            (meta.settings or {}).get("_aliases", {})
+        )
+        self.shards: dict[int, Any] = {}
+
+
+class ClusterFacade:
+    def __init__(self, cluster_node, loop):
+        self.node = cluster_node
+        self.loop = loop
+        self.node_name = cluster_node.node_id
+        self._mapper_cache: dict[tuple[str, int], MapperService] = {}
+        # node-local services (the reference's are node-local too)
+        from opensearch_tpu.tasks.manager import TaskManager
+
+        self.task_manager = TaskManager(cluster_node.node_id)
+
+    # ------------------------------------------------------------------ #
+    # loop bridging
+    # ------------------------------------------------------------------ #
+
+    def _on_loop(self, fn: Callable[[Callable[[dict], None]], None]) -> dict:
+        """Run callback-style `fn(callback)` on the transport loop; block
+        this (executor) thread for the response."""
+        fut: Future = Future()
+
+        def run() -> None:
+            try:
+                fn(lambda resp: fut.done() or fut.set_result(resp))
+            except Exception as e:  # noqa: BLE001
+                if not fut.done():
+                    fut.set_exception(e)
+
+        self.loop.call_soon_threadsafe(run)
+        resp = fut.result(timeout=RPC_TIMEOUT_S)
+        if isinstance(resp, dict) and "error" in resp and set(resp) <= {
+            "error", "status"
+        }:
+            raise rehydrate_error(resp["error"])
+        return resp
+
+    def _rpc(self, target: str, action: str, payload: dict) -> dict:
+        """One transport round-trip from the executor thread."""
+        def fn(callback):
+            self.node.transport.send(
+                self.node.node_id, target, action, payload,
+                on_response=callback,
+                on_failure=lambda e: callback(
+                    {"error": e if isinstance(e, OpenSearchTpuException)
+                     else str(e), "status": 500}
+                ),
+            )
+        return self._on_loop(fn)
+
+    def _rpc_many(self, calls: list[tuple[str, str, dict]]) -> list[dict]:
+        """Concurrent fan-out; preserves call order in the result list."""
+        fut: Future = Future()
+        results: list = [None] * len(calls)
+        remaining = [len(calls)]
+
+        def run() -> None:
+            def one(i: int):
+                def ok(resp) -> None:
+                    results[i] = resp
+                    remaining[0] -= 1
+                    if remaining[0] == 0 and not fut.done():
+                        fut.set_result(results)
+
+                def fail(e: Exception) -> None:
+                    ok({"error": e if isinstance(e, OpenSearchTpuException)
+                        else str(e), "status": 500})
+
+                return ok, fail
+
+            for i, (target, action, payload) in enumerate(calls):
+                ok, fail = one(i)
+                self.node.transport.send(
+                    self.node.node_id, target, action, payload,
+                    on_response=ok, on_failure=fail,
+                )
+
+        if not calls:
+            return []
+        self.loop.call_soon_threadsafe(run)
+        return fut.result(timeout=RPC_TIMEOUT_S)
+
+    # ------------------------------------------------------------------ #
+    # state views
+    # ------------------------------------------------------------------ #
+
+    @property
+    def state(self):
+        return self.node.applied_state
+
+    def _meta(self, index: str):
+        meta = self.state.indices.get(index)
+        if meta is None:
+            raise IndexNotFoundException(index)
+        return meta
+
+    def _mapper_for(self, index: str) -> MapperService:
+        meta = self._meta(index)
+        key = (index, meta.version)
+        ms = self._mapper_cache.get(key)
+        if ms is None:
+            ms = MapperService(meta.mappings or None)
+            self._mapper_cache[key] = ms
+            for k in [k for k in self._mapper_cache
+                      if k[0] == index and k[1] != meta.version]:
+                del self._mapper_cache[k]
+        return ms
+
+    @property
+    def indices(self) -> dict[str, _IndexView]:
+        return {
+            name: _IndexView(meta, self._mapper_for(name))
+            for name, meta in self.state.indices.items()
+        }
+
+    def resolve_indices(self, expr: str) -> list[str]:
+        import fnmatch as _fn
+
+        names = sorted(self.state.indices)
+        if expr in ("_all", "*", "", None):
+            return names
+        out: list[str] = []
+        for part in str(expr).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "*" in part or "?" in part:
+                out.extend(n for n in names
+                           if _fn.fnmatch(n, part) and n not in out)
+            else:
+                if part not in self.state.indices:
+                    raise IndexNotFoundException(part)
+                if part not in out:
+                    out.append(part)
+        return out
+
+    # ------------------------------------------------------------------ #
+    # index lifecycle (leader-routed)
+    # ------------------------------------------------------------------ #
+
+    def create_index(self, name: str, body: dict | None = None) -> dict:
+        if name in self.state.indices:
+            raise ResourceAlreadyExistsException(
+                f"index [{name}] already exists"
+            )
+        leader = self._leader()
+        resp = self._rpc(leader, "cluster:admin/create_index",
+                         {"name": name, "body": body or {}})
+        self._wait_active_primaries(name)
+        return resp
+
+    def delete_index(self, name: str) -> dict:
+        for n in self.resolve_indices(name):
+            self._rpc(self._leader(), "cluster:admin/delete_index",
+                      {"name": n})
+        return {"acknowledged": True}
+
+    def put_mapping(self, index: str, body: dict) -> dict:
+        return self._rpc(self._leader(), "cluster:admin/put_mapping",
+                         {"name": index, "mappings": body or {}})
+
+    def get_mapping(self, index: str) -> dict:
+        return {
+            name: {"mappings": self._mapper_for(name).to_dict()}
+            for name in self.resolve_indices(index)
+        }
+
+    def get_settings(self, index: str) -> dict:
+        out = {}
+        for name in self.resolve_indices(index):
+            meta = self._meta(name)
+            settings = {
+                "number_of_shards": str(meta.num_shards),
+                "number_of_replicas": str(meta.num_replicas),
+                **{k: v for k, v in (meta.settings or {}).items()
+                   if not k.startswith("_")},
+            }
+            out[name] = {"settings": {"index": settings}}
+        return out
+
+    def _leader(self) -> str:
+        leader = self.node.coordinator.leader_id
+        if leader is None:
+            raise OpenSearchTpuException("no elected cluster manager")
+        return leader
+
+    def _wait_active_primaries(self, index: str, timeout_s: float = 10.0) -> None:
+        import time as _t
+
+        deadline = _t.monotonic() + timeout_s
+        while _t.monotonic() < deadline:
+            entries = [r for r in self.state.routing
+                       if r.index == index and r.primary]
+            if entries and all(r.state == "STARTED" for r in entries):
+                return
+            _t.sleep(0.05)
+
+    # ------------------------------------------------------------------ #
+    # documents
+    # ------------------------------------------------------------------ #
+
+    def index_doc(self, index: str, doc_id: str | None, source: dict,
+                  routing: str | None = None, if_seq_no: int | None = None,
+                  refresh: bool = False, op_type: str | None = None,
+                  pipeline: str | None = None) -> dict:
+        if pipeline is not None:
+            self._unsupported("ingest pipelines")
+        if doc_id is None:
+            doc_id = uuid.uuid4().hex[:20]
+        resp = self._on_loop(lambda cb: self.node.index_doc(
+            index, doc_id, source, cb, routing=routing,
+            if_seq_no=if_seq_no, op_type=op_type,
+        ))
+        if refresh:
+            self.refresh(index)
+        return resp
+
+    def get_doc(self, index: str, doc_id: str,
+                routing: str | None = None) -> dict:
+        return self._on_loop(lambda cb: self.node.get_doc(
+            index, doc_id, cb, routing=routing
+        ))
+
+    def delete_doc(self, index: str, doc_id: str, routing: str | None = None,
+                   refresh: bool = False) -> dict:
+        resp = self._on_loop(lambda cb: self.node.delete_doc(
+            index, doc_id, cb, routing=routing
+        ))
+        if refresh:
+            self.refresh(index)
+        return resp
+
+    def update_doc(self, index: str, doc_id: str, body: dict,
+                   routing: str | None = None, refresh: bool = False) -> dict:
+        """Coordinator-side read-modify-write with optimistic concurrency
+        (UpdateHelper semantics over the cluster write path)."""
+        current = self.get_doc(index, doc_id, routing=routing)
+        exists = current.get("found")
+        if "script" in body:
+            from opensearch_tpu.script import default_script_service
+
+            if not exists:
+                if "upsert" in body:
+                    return self.index_doc(index, doc_id, body["upsert"],
+                                          routing=routing, refresh=refresh)
+                raise DocumentMissingException(f"[{doc_id}]: document missing")
+            ctx = {"_source": dict(current["_source"]), "op": "index",
+                   "_index": index, "_id": doc_id}
+            ast, params = default_script_service.compile(body["script"])
+            default_script_service.execute_update(ast, params, ctx)
+            if ctx.get("op") in ("none", "noop"):
+                return {"_index": index, "_id": doc_id, "result": "noop",
+                        "_shards": {"total": 0, "successful": 0, "failed": 0}}
+            if ctx.get("op") == "delete":
+                return self.delete_doc(index, doc_id, routing=routing,
+                                       refresh=refresh)
+            out = self.index_doc(index, doc_id, ctx["_source"],
+                                 routing=routing, refresh=refresh,
+                                 if_seq_no=current.get("_seq_no"))
+            out["result"] = "updated"
+            return out
+        if "doc" in body:
+            if not exists:
+                if body.get("doc_as_upsert"):
+                    return self.index_doc(index, doc_id, body["doc"],
+                                          routing=routing, refresh=refresh)
+                raise DocumentMissingException(f"[{doc_id}]: document missing")
+            merged = _deep_merge(dict(current["_source"]), body["doc"])
+            out = self.index_doc(index, doc_id, merged, routing=routing,
+                                 refresh=refresh,
+                                 if_seq_no=current.get("_seq_no"))
+            out["result"] = "updated"
+            return out
+        if "upsert" in body and not exists:
+            return self.index_doc(index, doc_id, body["upsert"],
+                                  routing=routing, refresh=refresh)
+        raise IllegalArgumentException("update requires [doc] or [upsert]")
+
+    def bulk(self, operations, refresh: bool = False,
+             pipeline: str | None = None,
+             payload_bytes: int | None = None) -> dict:
+        if pipeline is not None:
+            self._unsupported("ingest pipelines")
+        ops = []
+        for action, meta, source in operations:
+            meta = dict(meta)
+            if action in ("index", "create") and not meta.get("_id"):
+                meta["_id"] = uuid.uuid4().hex[:20]
+            ops.append((action, meta, source))
+        resp = self._on_loop(lambda cb: self.node.bulk(ops, cb))
+        if refresh:
+            touched = {m.get("_index") for _a, m, _s in ops if m.get("_index")}
+            for idx in touched:
+                try:
+                    self.refresh(idx)
+                except OpenSearchTpuException:
+                    pass
+        return resp
+
+    def mget(self, index: str | None, body: dict) -> dict:
+        docs_spec = body.get("docs")
+        if docs_spec is None and "ids" in body:
+            docs_spec = [{"_id": i} for i in body["ids"]]
+        if docs_spec is None:
+            raise IllegalArgumentException("mget requires docs or ids")
+        docs = []
+        for spec in docs_spec:
+            idx = spec.get("_index", index)
+            try:
+                docs.append(self.get_doc(idx, spec["_id"],
+                                         routing=spec.get("routing")))
+            except OpenSearchTpuException as e:
+                docs.append({"_index": idx, "_id": spec.get("_id"),
+                             "error": e.to_dict()})
+        return {"docs": docs}
+
+    # ------------------------------------------------------------------ #
+    # search (per-node fan-out + coordinator reduce)
+    # ------------------------------------------------------------------ #
+
+    def _node_assignments(self, names: list[str]) -> list[tuple[str, str, list[int]]]:
+        """[(node_id, index, [shard_nums])] — one entry per (node, index),
+        preferring primaries (ARS is a later refinement)."""
+        state = self.state
+        out: dict[tuple[str, str], list[int]] = {}
+        for name in names:
+            meta = self._meta(name)
+            targets: dict[int, Any] = {}
+            for r in state.shards_for_index(name):
+                if r.state != "STARTED" or r.node_id is None:
+                    continue
+                if r.shard not in targets or r.primary:
+                    targets[r.shard] = r
+            if len(targets) < meta.num_shards:
+                raise OpenSearchTpuException(
+                    f"not all shards of [{name}] are available"
+                )
+            for num, r in targets.items():
+                out.setdefault((r.node_id, name), []).append(num)
+        return [(nid, idx, sorted(nums)) for (nid, idx), nums in
+                sorted(out.items())]
+
+    def search(self, index: str | None = None, body: dict | None = None,
+               scroll: str | None = None,
+               search_pipeline: str | None = None) -> dict:
+        from opensearch_tpu.search.reduce import (
+            check_cluster_aggs_supported,
+            reduce_search_responses,
+        )
+
+        body = dict(body or {})
+        if "pit" in body:
+            return self._pit_search(body)
+        if search_pipeline is not None:
+            raise IllegalArgumentException(
+                "search pipelines are not yet supported in cluster mode"
+            )
+        if "suggest" in body:
+            raise IllegalArgumentException(
+                "suggest is not yet supported in cluster mode"
+            )
+        query = body.get("query") or {}
+        if "hybrid" in query:
+            raise IllegalArgumentException(
+                "hybrid queries are not yet supported in cluster mode"
+            )
+        aggs_body = body.get("aggs") or body.get("aggregations")
+        check_cluster_aggs_supported(aggs_body)
+
+        names = self.resolve_indices(index if index is not None else "_all")
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        track_total = body.get("track_total_hits", True)
+        keep = scroll is not None
+        if keep and from_ > 0:
+            raise IllegalArgumentException(
+                "[from] is not allowed in a scroll context"
+            )
+        keep_alive_ms = (
+            _parse_keep_alive_ms(scroll) if keep else None
+        )
+
+        node_body = dict(body)
+        node_body["from"] = 0
+        node_body["size"] = from_ + size
+        node_body["track_total_hits"] = True  # coordinator applies the cap
+        assignments = self._node_assignments(names)
+        partials = self._rpc_many([
+            (nid, "indices:data/read/search[node]",
+             {"index": idx, "shards": nums, "body": node_body,
+              "keep_context": keep, "keep_alive_ms": keep_alive_ms})
+            for nid, idx, nums in assignments
+        ])
+        self._raise_partial_errors(partials)
+        resp = reduce_search_responses(
+            body, partials, size=size, from_=from_, track_total=track_total
+        )
+        if keep:
+            contexts = {
+                f"{nid}|{idx}": p["_ctx_id"]
+                for (nid, idx, _nums), p in zip(assignments, partials)
+            }
+            seen = len(resp["hits"]["hits"])
+            resp["_scroll_id"] = _encode_scroll_id({
+                "ctx": contexts, "seen": seen, "size": size,
+                "sort": body.get("sort"),
+            })
+        return resp
+
+    @staticmethod
+    def _raise_partial_errors(partials: list[dict]) -> None:
+        for p in partials:
+            if isinstance(p, dict) and "error" in p and "hits" not in p:
+                raise rehydrate_error(p["error"])
+
+    def scroll(self, scroll_id: str, scroll: str | None = None) -> dict:
+        from opensearch_tpu.search.reduce import reduce_hits
+
+        state = _decode_scroll_id(scroll_id)
+        seen, size = state["seen"], state["size"]
+        calls = []
+        for key, ctx_id in state["ctx"].items():
+            nid, _, idx = key.partition("|")
+            calls.append((nid, "indices:data/read/search[ctx]",
+                          {"ctx_id": ctx_id, "from": 0,
+                           "size": seen + size}))
+        partials = self._rpc_many(calls)
+        self._raise_partial_errors(partials)
+        sort = state.get("sort")
+        if isinstance(sort, (str, dict)):
+            sort = [sort]
+        hits_obj = reduce_hits(partials, size=size, from_=seen, sort=sort,
+                               track_total=True)
+        state["seen"] = seen + len(hits_obj["hits"])
+        shards_total = sum(
+            (p.get("_shards") or {}).get("total", 0) for p in partials
+        )
+        return {
+            "took": 0, "timed_out": False,
+            "_shards": {"total": shards_total, "successful": shards_total,
+                        "skipped": 0, "failed": 0},
+            "hits": hits_obj,
+            "_scroll_id": _encode_scroll_id(state),
+        }
+
+    def clear_scroll(self, scroll_ids: list[str] | None) -> dict:
+        freed = 0
+        for sid in scroll_ids or []:
+            try:
+                state = _decode_scroll_id(sid)
+            except Exception:  # noqa: BLE001 - malformed id: skip
+                continue
+            by_node: dict[str, list[str]] = {}
+            for key, ctx_id in state["ctx"].items():
+                nid = key.partition("|")[0]
+                by_node.setdefault(nid, []).append(ctx_id)
+            results = self._rpc_many([
+                (nid, "indices:data/read/ctx_close", {"ctx_ids": ids})
+                for nid, ids in by_node.items()
+            ])
+            freed += sum(r.get("freed", 0) for r in results
+                         if isinstance(r, dict))
+        return {"succeeded": True, "num_freed": freed}
+
+    def open_pit(self, index: str, keep_alive: str) -> dict:
+        names = self.resolve_indices(index)
+        assignments = self._node_assignments(names)
+        partials = self._rpc_many([
+            (nid, "indices:data/read/search[node]",
+             {"index": idx, "shards": nums,
+              "body": {"query": {"match_all": {}}, "size": 0},
+              "keep_context": True,
+              "keep_alive_ms": _parse_keep_alive_ms(keep_alive)})
+            for nid, idx, nums in assignments
+        ])
+        self._raise_partial_errors(partials)
+        contexts = {
+            f"{nid}|{idx}": p["_ctx_id"]
+            for (nid, idx, _nums), p in zip(assignments, partials)
+        }
+        total = sum((p.get("_shards") or {}).get("total", 0)
+                    for p in partials)
+        pit_id = "cpit_" + _encode_scroll_id({"ctx": contexts})
+        import time as _t
+
+        return {"pit_id": pit_id,
+                "_shards": {"total": total, "successful": total,
+                            "skipped": 0, "failed": 0},
+                "creation_time": int(_t.time() * 1000)}
+
+    def close_pit(self, pit_ids: list[str] | None) -> dict:
+        pits = []
+        for pid in pit_ids or []:
+            ok = True
+            try:
+                state = _decode_scroll_id(pid.removeprefix("cpit_"))
+                by_node: dict[str, list[str]] = {}
+                for key, ctx_id in state["ctx"].items():
+                    by_node.setdefault(key.partition("|")[0], []).append(ctx_id)
+                self._rpc_many([
+                    (nid, "indices:data/read/ctx_close", {"ctx_ids": ids})
+                    for nid, ids in by_node.items()
+                ])
+            except Exception:  # noqa: BLE001
+                ok = False
+            pits.append({"pit_id": pid, "successful": ok})
+        return {"pits": pits}
+
+    def _pit_search(self, body: dict) -> dict:
+        from opensearch_tpu.search.reduce import reduce_search_responses
+
+        pit = body.pop("pit")
+        pit_id = pit["id"] if isinstance(pit, dict) else pit
+        state = _decode_scroll_id(str(pit_id).removeprefix("cpit_"))
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        track_total = body.get("track_total_hits", True)
+        node_body = dict(body)
+        node_body["from"] = 0
+        node_body["size"] = from_ + size
+        node_body["track_total_hits"] = True
+        calls = [
+            (key.partition("|")[0], "indices:data/read/search[ctx]",
+             {"ctx_id": ctx_id, "body": node_body})
+            for key, ctx_id in state["ctx"].items()
+        ]
+        partials = self._rpc_many(calls)
+        self._raise_partial_errors(partials)
+        resp = reduce_search_responses(
+            body, partials, size=size, from_=from_, track_total=track_total
+        )
+        resp["pit_id"] = pit_id
+        return resp
+
+    def msearch(self, searches: list[tuple[dict, dict]]) -> dict:
+        responses = []
+        for header, sbody in searches:
+            try:
+                responses.append(self.search(header.get("index"), sbody))
+            except OpenSearchTpuException as e:
+                responses.append({"error": e.to_dict(), "status": e.status})
+        return {"took": 0, "responses": responses}
+
+    def count(self, index: str, body: dict | None = None) -> dict:
+        body = dict(body or {})
+        body["size"] = 0
+        resp = self.search(index, body)
+        return {"count": resp["hits"]["total"]["value"],
+                "_shards": resp["_shards"]}
+
+    # ------------------------------------------------------------------ #
+    # maintenance
+    # ------------------------------------------------------------------ #
+
+    def refresh(self, index: str = "_all") -> dict:
+        total = {"total": 0, "successful": 0, "failed": 0}
+        for name in self.resolve_indices(index):
+            resp = self._on_loop(lambda cb, n=name: self.node.refresh(n, cb))
+            for k in total:
+                total[k] += resp.get("_shards", {}).get(k, 0)
+        return {"_shards": total}
+
+    def flush(self, index: str = "_all") -> dict:
+        names = self.resolve_indices(index)  # raises on missing indices
+        nodes = sorted(self.state.nodes)
+        results = self._rpc_many([
+            (nid, "indices:admin/flush[node]", {"indices": names})
+            for nid in nodes
+        ])
+        ok = sum(1 for r in results
+                 if isinstance(r, dict) and r.get("ack"))
+        return {"_shards": {"total": len(nodes), "successful": ok,
+                            "failed": len(nodes) - ok}}
+
+    def force_merge(self, index: str = "_all", max_num_segments: int = 1,
+                    only_expunge_deletes: bool = False,
+                    flush: bool = True) -> dict:
+        names = self.resolve_indices(index)
+        nodes = sorted(self.state.nodes)
+        results = self._rpc_many([
+            (nid, "indices:admin/forcemerge[node]",
+             {"indices": names, "max_num_segments": max_num_segments})
+            for nid in nodes
+        ])
+        ok = sum(1 for r in results
+                 if isinstance(r, dict) and r.get("ack"))
+        return {"_shards": {"total": len(nodes), "successful": ok,
+                            "failed": len(nodes) - ok}}
+
+    # ------------------------------------------------------------------ #
+    # cluster / stats
+    # ------------------------------------------------------------------ #
+
+    def cluster_health(self) -> dict:
+        return self.node.cluster_health()
+
+    def _all_shard_stats(self) -> dict[str, dict]:
+        nodes = sorted(self.state.nodes)
+        results = self._rpc_many([
+            (nid, "indices:monitor/stats[node]", {}) for nid in nodes
+        ])
+        out: dict[str, dict] = {}
+        for r in results:
+            if isinstance(r, dict):
+                for key, s in (r.get("shards") or {}).items():
+                    if s.get("primary") or key not in out:
+                        out[key] = s
+        return out
+
+    def index_stats(self, index: str = "_all") -> dict:
+        names = self.resolve_indices(index)
+        shard_stats = self._all_shard_stats()
+        per_index: dict[str, int] = {}
+        for s in shard_stats.values():
+            if s.get("primary"):
+                per_index[s["index"]] = per_index.get(s["index"], 0) + s["docs"]
+        total = sum(per_index.get(n, 0) for n in names)
+        out = {
+            "_all": {"primaries": {"docs": {"count": total}},
+                     "total": {"docs": {"count": total}}},
+            "indices": {
+                n: {"primaries": {"docs": {"count": per_index.get(n, 0)}}}
+                for n in names
+            },
+        }
+        return out
+
+    # unsupported-surface markers (clear 400s beat silent wrong answers)
+
+    _UNSUPPORTED_SERVICES = {
+        "ingest", "snapshots", "search_pipelines", "script",
+        "indexing_pressure", "search_backpressure", "search_slowlog",
+        "indexing_slowlog", "reindex",
+    }
+
+    def _unsupported(self, what: str):
+        raise IllegalArgumentException(
+            f"{what} is not yet supported in cluster mode"
+        )
+
+    def __getattr__(self, name: str):
+        if name in self._UNSUPPORTED_SERVICES:
+            # handlers dereference these services directly; a clear 400
+            # beats an opaque AttributeError 500
+            self._unsupported(f"[{name}]")
+        # attributes probed via getattr(..., default) (breakers, telemetry)
+        # must keep AttributeError semantics
+        raise AttributeError(name)
+
+
+def _encode_scroll_id(state: dict) -> str:
+    return base64.urlsafe_b64encode(
+        json.dumps(state, separators=(",", ":")).encode()
+    ).decode()
+
+
+def _decode_scroll_id(scroll_id: str) -> dict:
+    try:
+        return json.loads(base64.urlsafe_b64decode(scroll_id.encode()))
+    except Exception as e:  # noqa: BLE001
+        raise SearchContextMissingException(
+            f"malformed scroll id [{scroll_id[:32]}...]"
+        ) from e
+
+
+def _parse_keep_alive_ms(value: str | None) -> int:
+    from opensearch_tpu.common.settings import parse_time_millis
+
+    if value is None:
+        return 60_000
+    return int(parse_time_millis(value))
+
+
+def _deep_merge(base: dict, overlay: dict) -> dict:
+    out = dict(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out

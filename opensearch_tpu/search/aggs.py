@@ -43,6 +43,11 @@ EXTENSION_AGGS: dict[str, Callable] = {}
 # the reference's search.max_buckets MultiBucketConsumerService limit
 MAX_BUCKETS = 65_536
 
+# cross-node exact-merge cap for value-shipping partials (cardinality,
+# percentiles) — beyond this the wire cost of exactness is unreasonable and
+# a sketch (HLL++/TDigest) is the right tool
+MAX_PARTIAL_VALUES = 100_000
+
 
 class TooManyBucketsException(IllegalArgumentException):
     error_type = "too_many_buckets_exception"
@@ -113,9 +118,9 @@ def _compute_one(
     typ, conf, sub = _split_body(body)
 
     if typ in ("min", "max", "sum", "avg", "value_count", "stats"):
-        return _metric(typ, conf, segments, ms, masks)
+        return _metric(typ, conf, segments, ms, masks, ext)
     if typ == "cardinality":
-        return _cardinality(conf, segments, ms, masks)
+        return _cardinality(conf, segments, ms, masks, ext)
     if typ == "terms":
         return _terms(conf, sub, segments, ms, masks, filter_fn, ext)
     if typ == "histogram":
@@ -158,7 +163,7 @@ def _sub_aggs(
 # -- metrics ----------------------------------------------------------------
 
 
-def _metric(typ, conf, segments, ms, masks) -> dict:
+def _metric(typ, conf, segments, ms, masks, ext=None) -> dict:
     field = conf["field"]
     chunks = [
         _field_values(seg, field, masks[i], ms) for i, seg in enumerate(segments)
@@ -167,6 +172,9 @@ def _metric(typ, conf, segments, ms, masks) -> dict:
     n = len(vals)
     mapper = ms.field_mapper(field)
     is_date = mapper is not None and mapper.type == "date"
+    # cross-node partial mode (InternalAvg carries sum+count on the wire;
+    # the coordinator reduce divides — search/reduce.py strips the key)
+    partial = bool(ext and ext.get("partial"))
 
     def fmt(v):
         if v is None:
@@ -178,7 +186,11 @@ def _metric(typ, conf, segments, ms, masks) -> dict:
     if n == 0:
         if typ == "stats":
             return {"count": 0, "min": None, "max": None, "avg": None, "sum": 0.0}
-        return {"value": None if typ != "sum" else 0.0}
+        out = {"value": None if typ != "sum" else 0.0}
+        if typ == "avg" and partial:
+            out["_p_count"] = 0
+            out["_p_sum"] = 0.0
+        return out
     s = float(vals.sum(dtype=np.float64))
     if typ == "min":
         return {"value": fmt(vals.min())}
@@ -187,7 +199,11 @@ def _metric(typ, conf, segments, ms, masks) -> dict:
     if typ == "sum":
         return {"value": s}
     if typ == "avg":
-        return {"value": s / n}
+        out = {"value": s / n}
+        if partial:
+            out["_p_count"] = n
+            out["_p_sum"] = s
+        return out
     return {
         "count": n,
         "min": fmt(vals.min()),
@@ -197,7 +213,7 @@ def _metric(typ, conf, segments, ms, masks) -> dict:
     }
 
 
-def _cardinality(conf, segments, ms, masks) -> dict:
+def _cardinality(conf, segments, ms, masks, ext=None) -> dict:
     field = conf["field"]
     # exact distinct count (the reference uses HLL++ with precision_threshold;
     # HLL sketch merge is the planned device path for large corpora)
@@ -212,7 +228,17 @@ def _cardinality(conf, segments, ms, masks) -> dict:
             continue
         vals = _field_values(seg, field, masks[i], ms)
         seen.update(vals.tolist())
-    return {"value": len(seen)}
+    out: dict[str, Any] = {"value": len(seen)}
+    if ext and ext.get("partial"):
+        # wire partial: the distinct-value set itself (exact; the reference
+        # ships HLL++ sketches — sketch merge is the large-corpus path)
+        if len(seen) > MAX_PARTIAL_VALUES:
+            raise IllegalArgumentException(
+                f"cardinality over [{len(seen)}] distinct values exceeds the "
+                f"cross-node exact-merge cap [{MAX_PARTIAL_VALUES}]"
+            )
+        out["_p_values"] = sorted(seen, key=lambda v: (str(type(v)), v))
+    return out
 
 
 # -- terms ------------------------------------------------------------------
@@ -221,6 +247,10 @@ def _cardinality(conf, segments, ms, masks) -> dict:
 def _terms(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
     field = conf["field"]
     size = int(conf.get("size", 10))
+    if ext and ext.get("partial"):
+        # per-node over-fetch so the coordinator cut is accurate — the
+        # reference's shard_size default (size * 1.5 + 10)
+        size = int(conf.get("shard_size", size + (size >> 1) + 10))
     # merge per-segment counts keyed by value
     counts: dict[Any, int] = {}
     is_keyword = any(field in seg.keyword_fields for seg in segments)

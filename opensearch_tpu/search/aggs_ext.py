@@ -129,8 +129,11 @@ def _percentiles(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
         qs = np.percentile(vals.astype(np.float64), percents)
         results = [(p, float(q)) for p, q in zip(percents, qs)]
     if keyed:
-        return {"values": {str(float(p)): v for p, v in results}}
-    return {"values": [{"key": p, "value": v} for p, v in results]}
+        out = {"values": {str(float(p)): v for p, v in results}}
+    else:
+        out = {"values": [{"key": p, "value": v} for p, v in results]}
+    _attach_value_partial(out, vals, ext)
+    return out
 
 
 def _percentile_ranks(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
@@ -143,16 +146,38 @@ def _percentile_ranks(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
         rank = float((vals <= t).sum()) * 100.0 / n if n else None
         results.append((t, rank))
     if keyed:
-        return {"values": {f"{t}": r for t, r in results}}
-    return {"values": [{"key": t, "value": r} for t, r in results]}
+        out = {"values": {f"{t}": r for t, r in results}}
+    else:
+        out = {"values": [{"key": t, "value": r} for t, r in results]}
+    _attach_value_partial(out, vals, ext)
+    return out
 
 
 def _median_absolute_deviation(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
     vals = _collect(segments, ms, masks, conf["field"]).astype(np.float64)
     if len(vals) == 0:
-        return {"value": None}
+        out = {"value": None}
+        _attach_value_partial(out, vals, ext)
+        return out
     med = float(np.median(vals))
-    return {"value": float(np.median(np.abs(vals - med)))}
+    out = {"value": float(np.median(np.abs(vals - med)))}
+    _attach_value_partial(out, vals, ext)
+    return out
+
+
+def _attach_value_partial(out: dict, vals, ext) -> None:
+    """Cross-node partial: ship the raw masked values (exact merge; capped —
+    the reference ships TDigest/HDR sketches for this class of metric)."""
+    if not (ext and ext.get("partial")):
+        return
+    from opensearch_tpu.search.aggs import MAX_PARTIAL_VALUES
+
+    if len(vals) > MAX_PARTIAL_VALUES:
+        raise IllegalArgumentException(
+            f"metric over [{len(vals)}] values exceeds the cross-node "
+            f"exact-merge cap [{MAX_PARTIAL_VALUES}]"
+        )
+    out["_p_values"] = np.asarray(vals, np.float64).tolist()
 
 
 def _weighted_avg(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
@@ -181,7 +206,11 @@ def _weighted_avg(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
         elif v_missing is not None:
             num += float(v_missing) * float(wv[base].astype(np.float64).sum())
             den += float(wv[base].astype(np.float64).sum())
-    return {"value": num / den if den else None}
+    out = {"value": num / den if den else None}
+    if ext and ext.get("partial"):
+        out["_p_num"] = num
+        out["_p_den"] = den
+    return out
 
 
 def _top_hits(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
@@ -418,6 +447,8 @@ def _multi_terms(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
     if len(fields) < 2:
         raise ParsingException("multi_terms requires at least 2 terms sources")
     size = int(conf.get("size", 10))
+    if ext and ext.get("partial"):
+        size = int(conf.get("shard_size", size + (size >> 1) + 10))
     counts: dict[tuple, int] = {}
     doc_lists: dict[tuple, list] = {}
     for i, seg in enumerate(segments):
@@ -489,7 +520,19 @@ def _rare_terms(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
             bucket_masks = _value_masks(segments, field, key, masks)
             bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
         buckets.append(bucket)
-    return {"buckets": buckets}
+    out = {"buckets": buckets}
+    if ext and ext.get("partial"):
+        # a term rare here may be common on another node: ship the FULL
+        # local counts so the coordinator filter sees global totals
+        from opensearch_tpu.search.aggs import MAX_PARTIAL_VALUES
+
+        if len(counts) > MAX_PARTIAL_VALUES:
+            raise IllegalArgumentException(
+                f"rare_terms over [{len(counts)}] terms exceeds the "
+                f"cross-node exact-merge cap [{MAX_PARTIAL_VALUES}]"
+            )
+        out["_p_counts"] = [[k, c] for k, c in counts.items()]
+    return out
 
 
 def _significant_terms(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:

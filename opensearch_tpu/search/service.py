@@ -44,11 +44,20 @@ def search(
     phase_results_config: dict | None = None,
     shard_filters: list | None = None,
     task=None,
+    partial: bool = False,
+    shard_numbers: list[int] | None = None,
 ) -> dict[str, Any]:
     """Run one search over `shards`. `acquired` optionally pins the searcher
     snapshots to use, one per shard in order — the scroll/PIT path
     (ReaderContext.java:64 analog: the context owns the snapshots, so pages
-    see one immutable point-in-time view regardless of refreshes)."""
+    see one immutable point-in-time view regardless of refreshes).
+
+    `partial=True` produces a per-NODE wire partial for the cluster
+    coordinator (QuerySearchResult analog): hits carry a `_tb` tie-break
+    triple [global_shard, segment, doc] (global shard numbers supplied via
+    `shard_numbers`), aggregations carry `_p_*` reduce extras, and pipeline
+    aggregations are deferred to the coordinator's final reduce
+    (search/reduce.py — InternalAggregations.reduce:162 semantics)."""
     t0 = time.monotonic()
     body = body or {}
     known_keys = {
@@ -274,6 +283,12 @@ def search(
             if want_seqno:
                 hit["_seq_no"] = int(host.doc_seq_nos[h.doc])
                 hit["_primary_term"] = 1
+        if partial:
+            gshard = (
+                shard_numbers[shard_idx] if shard_numbers is not None
+                else shard.shard_id.shard
+            )
+            hit["_tb"] = [gshard, h.segment, h.doc]
         hits_json.append(hit)
 
     hits_obj: dict[str, Any] = {
@@ -334,12 +349,15 @@ def search(
         mapper_service = _MultiMapperView([s.mapper_service for s in shards])
         response["aggregations"] = compute_aggs(
             all_segments, mapper_service, aggs_body, all_masks, filter_fn,
-            ext={"scores": all_scores, "seg_meta": seg_meta},
+            ext={"scores": all_scores, "seg_meta": seg_meta,
+                 "partial": partial},
         )
-        # pipeline aggregations run once, at final reduce
-        from opensearch_tpu.search.aggs_pipeline import apply_pipeline_aggs
+        # pipeline aggregations run once, at final reduce — for a cluster
+        # partial that reduce happens on the coordinator, not here
+        if not partial:
+            from opensearch_tpu.search.aggs_pipeline import apply_pipeline_aggs
 
-        apply_pipeline_aggs(aggs_body, response["aggregations"])
+            apply_pipeline_aggs(aggs_body, response["aggregations"])
         # search.max_buckets guard (MultiBucketConsumerService analog):
         # bound coordinator memory for deeply-bucketed aggs
         n_buckets = _count_buckets(response["aggregations"])

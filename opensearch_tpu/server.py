@@ -1,31 +1,31 @@
 """ClusterServer: one bootable process = transport + coordinator + data + REST.
 
-The Node.java:494 analog — the wiring that fuses the previously separate
-silos (VERDICT r1 #1/#2): a TcpTransport (L2), a ClusterNode (coordinator +
-shards + action handlers), a LoopScheduler (timers), and an HTTP front end
-serving the cluster through ANY node. Start three of these on localhost and
-you have a real cluster over real sockets:
+The Node.java:494 analog. One ClusterServer = a TcpTransport (L2), a
+ClusterNode (coordinator + shards + action handlers), a LoopScheduler
+(timers), and — the round-3 unification (VERDICT r2 missing #4) — the SAME
+128-route trie router the single-node server uses (rest/handlers.py),
+served over a ClusterFacade that gives every handler the TpuNode API with
+cluster semantics (one RestController + NodeClient in front of one action
+registry, rest/RestController.java:285 + action/ActionModule.java:527).
 
     python -m opensearch_tpu.server --node-id n1 --port 9301 --http-port 9211 \
         --seeds n1=127.0.0.1:9301,n2=127.0.0.1:9302,n3=127.0.0.1:9303 \
         --data /tmp/c/n1 --bootstrap n1,n2,n3
 
-Every REST handler bridges the ClusterNode's continuation-passing API onto
-an asyncio future resolved on the SAME event loop the transport runs on —
-no threads touch cluster state (the single-threaded applier model of
-ClusterApplierService).
+HTTP handlers run on the HttpServer's executor thread and bridge onto the
+transport loop through the facade; the loop itself never blocks on data
+work (ClusterNode offloads engine ops to its data worker).
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
-import json
-import re
 from pathlib import Path
-from typing import Any, Callable
 
 from opensearch_tpu.cluster.cluster_node import ClusterNode
+from opensearch_tpu.cluster.facade import ClusterFacade
+from opensearch_tpu.rest.http import HttpServer
 from opensearch_tpu.transport.tcp import LoopScheduler, TcpTransport
 
 REQUEST_TIMEOUT_S = 30.0
@@ -66,237 +66,22 @@ class ClusterServer:
             node_id, data_path, self.transport, self.scheduler,
             peers=[p for p in seeds if p != node_id], roles=roles,
         )
+        self.facade = ClusterFacade(self.node, self.loop)
+        self.http = HttpServer(self.facade, transport_host, http_port)
         self.http_host = transport_host
         self.http_port = http_port
-        self._http_server: asyncio.AbstractServer | None = None
 
     async def start(self, bootstrap: list[str] | None = None) -> None:
         await self.transport.start()
         self.node.start()
         if bootstrap:
             self.node.bootstrap(bootstrap)
-        self._http_server = await asyncio.start_server(
-            self._handle_http, self.http_host, self.http_port
-        )
+        await self.http.start()
 
     async def aclose(self) -> None:
-        if self._http_server is not None:
-            self._http_server.close()
-            await self._http_server.wait_closed()
+        await self.http.stop()
         self.node.close()
         await self.transport.aclose()
-
-    # -- callback -> future bridge ----------------------------------------
-
-    def _call(self, fn: Callable, *args, **kwargs) -> "asyncio.Future[dict]":
-        fut: asyncio.Future = self.loop.create_future()
-
-        def cb(resp: Any) -> None:
-            if not fut.done():
-                fut.set_result(resp)
-
-        try:
-            fn(*args, cb, **kwargs)
-        except Exception as e:  # noqa: BLE001 - surface as the response
-            if not fut.done():
-                fut.set_result({"error": str(e)})
-        return fut
-
-    async def _await(self, fut: "asyncio.Future[dict]") -> dict:
-        try:
-            return await asyncio.wait_for(fut, REQUEST_TIMEOUT_S)
-        except asyncio.TimeoutError:
-            return {"error": "request timed out inside the cluster"}
-
-    # -- HTTP front end ----------------------------------------------------
-
-    async def _handle_http(self, reader: asyncio.StreamReader,
-                           writer: asyncio.StreamWriter) -> None:
-        try:
-            while True:
-                line = await reader.readline()
-                if not line:
-                    break
-                try:
-                    method, target, _ = line.decode("latin1").split(" ", 2)
-                except ValueError:
-                    break
-                headers: dict[str, str] = {}
-                while True:
-                    h = await reader.readline()
-                    if h in (b"\r\n", b"\n", b""):
-                        break
-                    k, _, v = h.decode("latin1").partition(":")
-                    headers[k.strip().lower()] = v.strip()
-                length = int(headers.get("content-length", 0))
-                body = await reader.readexactly(length) if length else b""
-                from urllib.parse import parse_qsl, unquote, urlsplit
-
-                split = urlsplit(target)
-                query = dict(parse_qsl(split.query, keep_blank_values=True))
-                status, payload = await self._route(
-                    method, unquote(split.path), query, body
-                )
-                data = json.dumps(payload).encode()
-                writer.write(
-                    (f"HTTP/1.1 {status} X\r\ncontent-type: application/json"
-                     f"\r\ncontent-length: {len(data)}\r\n\r\n").encode() + data
-                )
-                await writer.drain()
-        except (ConnectionResetError, asyncio.IncompleteReadError, OSError):
-            pass
-        finally:
-            try:
-                writer.close()
-            except Exception:  # noqa: BLE001
-                pass
-
-    @staticmethod
-    def _status_of(resp: dict, ok: int = 200) -> int:
-        if isinstance(resp, dict) and "error" in resp:
-            msg = str(resp["error"])
-            if "no such index" in msg or "not found" in msg.lower():
-                return 404
-            return 500
-        return ok
-
-    async def _route(self, method: str, path: str, query: dict,
-                     raw: bytes) -> tuple[int, Any]:
-        node = self.node
-        body = None
-        if raw:
-            if path.rstrip("/").rsplit("/", 1)[-1] == "_bulk":
-                body = [json.loads(ln) for ln in raw.split(b"\n") if ln.strip()]
-            else:
-                try:
-                    body = json.loads(raw)
-                except json.JSONDecodeError as e:
-                    return 400, {"error": {"type": "parse_exception",
-                                           "reason": str(e)}, "status": 400}
-
-        try:
-            # -- cluster APIs --
-            if path == "/_cluster/health":
-                return 200, node.cluster_health()
-            if path == "/_cluster/state":
-                return 200, node.applied_state.to_dict()
-            if path in ("/", ""):
-                return 200, {"name": node.node_id,
-                             "cluster_name": "opensearch-tpu",
-                             "leader": node.coordinator.leader_id}
-
-            # -- bulk --
-            if path.rstrip("/").endswith("_bulk"):
-                default_index = None
-                m = re.fullmatch(r"/([^/_][^/]*)/_bulk/?", path)
-                if m:
-                    default_index = m.group(1)
-                ops = _parse_bulk_ndjson(body or [], default_index)
-                resp = await self._await(self._call(node.bulk, ops))
-                if query.get("refresh") == "true":
-                    touched = {
-                        o[1]["_index"] for o in ops if o[1].get("_index")
-                    }
-                    for idx in touched:
-                        await self._await(self._call(node.refresh, idx))
-                return self._status_of(resp), resp
-
-            # -- index-level --
-            m = re.fullmatch(r"/([^/_][^/]*)/?", path)
-            if m:
-                name = m.group(1)
-                if method == "PUT":
-                    resp = await self._await(
-                        self._call(node.create_index, name, body)
-                    )
-                    await self._wait_for_active_shards(name)
-                    return self._status_of(resp), resp
-                if method == "DELETE":
-                    resp = await self._await(self._call(node.delete_index, name))
-                    return self._status_of(resp), resp
-
-            m = re.fullmatch(r"/([^/]+)/_mapping/?", path)
-            if m and method == "PUT":
-                resp = await self._await(
-                    self._call(node.put_mapping, m.group(1), body or {})
-                )
-                return self._status_of(resp), resp
-
-            m = re.fullmatch(r"/([^/]+)/_refresh/?", path)
-            if m:
-                resp = await self._await(self._call(node.refresh, m.group(1)))
-                return self._status_of(resp), resp
-
-            m = re.fullmatch(r"/([^/]+)/_search/?", path)
-            if m:
-                resp = await self._await(
-                    self._call(node.search, m.group(1), body)
-                )
-                return self._status_of(resp), resp
-
-            # -- documents --
-            m = re.fullmatch(r"/([^/]+)/_doc/([^/]+)/?", path)
-            if m:
-                index, doc_id = m.group(1), m.group(2)
-                routing = query.get("routing")
-                if method in ("PUT", "POST"):
-                    resp = await self._await(self._call(
-                        node.index_doc, index, doc_id, body, routing=routing
-                    ))
-                    if query.get("refresh") == "true":
-                        await self._await(self._call(node.refresh, index))
-                    return self._status_of(resp, 201), resp
-                if method == "GET":
-                    resp = await self._await(self._call(
-                        node.get_doc, index, doc_id, routing=routing
-                    ))
-                    if resp.get("found") is False:
-                        return 404, resp
-                    return self._status_of(resp), resp
-                if method == "DELETE":
-                    resp = await self._await(self._call(
-                        node.delete_doc, index, doc_id, routing=routing
-                    ))
-                    return self._status_of(resp), resp
-
-            return 400, {"error": {"type": "illegal_argument_exception",
-                                   "reason": f"no route for {method} {path}"},
-                         "status": 400}
-        except Exception as e:  # noqa: BLE001 - top-level 500 guard
-            return 500, {"error": {"type": "exception", "reason": str(e)},
-                         "status": 500}
-
-    async def _wait_for_active_shards(self, index: str,
-                                      timeout_s: float = 10.0) -> None:
-        """Block the create-index response until primaries are STARTED
-        (the reference's wait_for_active_shards=1 default)."""
-        deadline = self.loop.time() + timeout_s
-        while self.loop.time() < deadline:
-            state = self.node.applied_state
-            entries = [r for r in state.routing
-                       if r.index == index and r.primary]
-            if entries and all(r.state == "STARTED" for r in entries):
-                return
-            await asyncio.sleep(0.05)
-
-
-def _parse_bulk_ndjson(lines: list[dict], default_index: str | None
-                       ) -> list[tuple[str, dict, dict | None]]:
-    ops: list[tuple[str, dict, dict | None]] = []
-    i = 0
-    while i < len(lines):
-        action_line = lines[i]
-        action, meta = next(iter(action_line.items()))
-        meta = dict(meta or {})
-        if default_index and not meta.get("_index"):
-            meta["_index"] = default_index
-        i += 1
-        source = None
-        if action in ("index", "create", "update"):
-            source = lines[i]
-            i += 1
-        ops.append((action, meta, source))
-    return ops
 
 
 async def amain(args: argparse.Namespace) -> None:
