@@ -62,6 +62,7 @@ class ClusterNode:
         scheduler,
         peers: list[str],
         roles: tuple[str, ...] = ("cluster_manager", "data"),
+        persisted=None,
     ):
         self.node_id = node_id
         self.data_path = Path(data_path)
@@ -70,6 +71,7 @@ class ClusterNode:
         self.node = DiscoveryNode(node_id=node_id, name=node_id, roles=roles)
         self.coordinator = Coordinator(
             self.node, peers, transport, scheduler,
+            persisted=persisted,
             on_state_applied=self._apply_cluster_state,
             # every publication passes through allocation: node joins/leaves
             # re-assign shards, promote replicas, fill replica slots
@@ -115,6 +117,12 @@ class ClusterNode:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
+        # recovered durable state: recreate local shards BEFORE elections so
+        # a restarted node serves its recovered data (GatewayService state
+        # recovery; shard data itself replays from translog/commits in the
+        # Engine constructor)
+        if self.applied_state.indices:
+            self._apply_cluster_state(self.applied_state)
         self.coordinator.start()
 
     def bootstrap(self, voting_ids: list[str]) -> None:

@@ -60,21 +60,48 @@ class ApplyCommitRequest:
     version: int
 
 
-@dataclass
 class PersistedState:
-    """What must survive restart (gateway/PersistedClusterStateService
-    analog — serialized by the node layer)."""
+    """What must survive restart (gateway/PersistedClusterStateService:137
+    analog). With a `store` attached, every term bump and state acceptance
+    is WRITE-AHEAD persisted (disk first, then memory) — a node that
+    crashes mid-vote can never double-vote in its old term, and a
+    full-cluster restart recovers the last accepted metadata. Without a
+    store (sim tests) it is memory-only."""
 
-    current_term: int = 0
-    accepted_state: ClusterState = field(default_factory=ClusterState)
+    def __init__(self, current_term: int = 0,
+                 accepted_state: ClusterState | None = None,
+                 store=None):
+        self._term = current_term
+        self._accepted = accepted_state or ClusterState()
+        self.store = store
+
+    @property
+    def current_term(self) -> int:
+        return self._term
+
+    @current_term.setter
+    def current_term(self, term: int) -> None:
+        if self.store is not None:
+            self.store.save(term, self._accepted)
+        self._term = term
+
+    @property
+    def accepted_state(self) -> ClusterState:
+        return self._accepted
+
+    @accepted_state.setter
+    def accepted_state(self, state: ClusterState) -> None:
+        if self.store is not None:
+            self.store.save(self._term, state)
+        self._accepted = state
 
     @property
     def last_accepted_term(self) -> int:
-        return self.accepted_state.term
+        return self._accepted.term
 
     @property
     def last_accepted_version(self) -> int:
-        return self.accepted_state.version
+        return self._accepted.version
 
 
 class CoordinationState:

@@ -124,7 +124,11 @@ class Coordinator:
 
     def bootstrap(self, voting_node_ids: list[str]) -> None:
         """Set the initial voting configuration (ClusterBootstrapService
-        analog) — call on ONE node of a fresh cluster."""
+        analog). No-op on an already-bootstrapped node (e.g. restart with
+        recovered durable state — re-bootstrapping would wipe metadata)."""
+        if (self.coord.persisted.accepted_state.last_committed_config.node_ids
+                or self.coord.persisted.last_accepted_version > 0):
+            return
         config = VotingConfiguration(frozenset(voting_node_ids))
         state = self.coord.last_accepted_state.with_(
             last_committed_config=config, last_accepted_config=config,

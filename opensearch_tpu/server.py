@@ -62,9 +62,23 @@ class ClusterServer:
             node_id, transport_host, transport_port, seeds, loop=self.loop
         )
         self.scheduler = LoopScheduler(self.loop)
+        # durable cluster state (gateway/PersistedClusterStateService:137):
+        # term + accepted state survive restart; recovery happens before
+        # elections so a rebooted node cannot double-vote in its old term
+        from opensearch_tpu.cluster.coordination import PersistedState
+        from opensearch_tpu.gateway import GatewayStore
+
+        self.gateway = GatewayStore(Path(data_path) / "_state")
+        recovered = self.gateway.load()
+        persisted = (
+            PersistedState(recovered[0], recovered[1], store=self.gateway)
+            if recovered is not None
+            else PersistedState(store=self.gateway)
+        )
         self.node = ClusterNode(
             node_id, data_path, self.transport, self.scheduler,
             peers=[p for p in seeds if p != node_id], roles=roles,
+            persisted=persisted,
         )
         self.facade = ClusterFacade(self.node, self.loop)
         self.http = HttpServer(self.facade, transport_host, http_port)
