@@ -94,6 +94,8 @@ class ClusterNode:
         reg(node_id, "internal:cluster/shard_failed", self._on_shard_failed)
         reg(node_id, "indices:data/write[p]", self._on_primary_write)
         reg(node_id, "indices:data/write[r]", self._on_replica_write)
+        reg(node_id, "indices:data/write[p][bulk]", self._on_primary_bulk)
+        reg(node_id, "indices:data/write[r][bulk]", self._on_replica_bulk)
         reg(node_id, "indices:data/read/get", self._on_get)
         reg(node_id, "indices:data/read/search[shard]", self._on_shard_search)
         reg(node_id, "indices:data/read/search[node]", self._on_node_search)
@@ -103,6 +105,8 @@ class ClusterNode:
         reg(node_id, "indices:admin/flush[node]", self._on_node_flush)
         reg(node_id, "indices:admin/forcemerge[node]", self._on_node_forcemerge)
         reg(node_id, "indices:monitor/stats[node]", self._on_node_stats)
+        reg(node_id, "indices:replication/checkpoint", self._on_replication_checkpoint)
+        reg(node_id, "indices:replication/get_segments", self._on_get_segments)
         reg(node_id, "internal:index/shard/recovery/start", self._on_start_recovery)
         # per-node reader contexts (scroll/PIT pin snapshots node-side; the
         # coordinator's scroll id maps node -> local ctx — ReaderContext
@@ -179,11 +183,17 @@ class ClusterNode:
             if (index_name, shard_num) not in self.local_shards:
                 ms = self._mapper_for(index_name, state)
                 path = self.data_path / "indices" / index_name / str(shard_num)
-                from opensearch_tpu.index.shard import translog_durability
+                from opensearch_tpu.index.shard import (
+                    replication_type,
+                    translog_durability,
+                )
 
                 shard = IndexShard(
                     ShardId(index_name, shard_num), path, ms,
                     durability=translog_durability(
+                        state.indices[index_name].settings
+                    ),
+                    replication=replication_type(
                         state.indices[index_name].settings
                     ),
                 )
@@ -195,9 +205,26 @@ class ClusterNode:
                         self._report_shard_started(index_name, shard_num)
                     else:
                         self._start_replica_recovery(index_name, shard_num, state)
+                elif not entry.primary:
+                    # entry says STARTED but we just CREATED this shard
+                    # object (e.g. a wiped node rejoined under its old id
+                    # while never evicted): local content is unknown —
+                    # re-sync from the primary before trusting it
+                    self._start_replica_recovery(index_name, shard_num, state)
             else:
                 shard = self.local_shards[(index_name, shard_num)]
+                was_primary = shard.primary
                 shard.primary = entry.primary
+                if (entry.primary and not was_primary
+                        and shard.replication == "SEGMENT"):
+                    # promotion of a segrep replica: translog ops not yet
+                    # covered by replicated segments must become searchable
+                    # (the reference's NRT replica -> InternalEngine swap)
+                    def promote(s=shard):
+                        s.engine.replay_translog_tail()
+                        s.refresh()
+
+                    self._offload(promote)
                 if entry.state == "INITIALIZING":
                     # re-report on every publication until the leader records
                     # STARTED — a lost shard-started message (timeout, old
@@ -205,6 +232,15 @@ class ClusterNode:
                     # forever (ShardStateAction resend semantics)
                     if entry.primary or getattr(shard, "recovery_done", False):
                         self._report_shard_started(index_name, shard_num)
+                    elif not getattr(shard, "recovery_inflight", False):
+                        # a pre-existing local copy (e.g. recreated from
+                        # persisted state after a restart) assigned
+                        # INITIALIZING must still re-sync from the primary —
+                        # its local data may be arbitrarily stale
+                        shard.recovery_inflight = True
+                        self._start_replica_recovery(
+                            index_name, shard_num, state
+                        )
 
     # -- shard started / recovery ------------------------------------------
 
@@ -229,6 +265,9 @@ class ClusterNode:
         return {"ack": True}
 
     def _start_replica_recovery(self, index: str, shard: int, state: ClusterState) -> None:
+        local = self.local_shards.get((index, shard))
+        if local is not None:
+            local.recovery_inflight = True
         primary = state.primary(index, shard)
         if primary is None or primary.node_id is None or primary.state != "STARTED":
             # retry later — the primary may still be initializing
@@ -238,6 +277,10 @@ class ClusterNode:
             return
 
         def on_response(resp: dict) -> None:
+            if isinstance(resp, dict) and resp.get("mode") == "segment":
+                self._finish_segment_recovery(index, shard, state, resp)
+                return
+
             def apply() -> bool:
                 local = self.local_shards.get((index, shard))
                 if local is None:
@@ -252,6 +295,7 @@ class ClusterNode:
                         local.apply_delete_on_replica(op["id"], op["seq_no"])
                 local.refresh()
                 local.recovery_done = True
+                local.recovery_inflight = False
                 return True
 
             done = self._offload(apply)
@@ -272,6 +316,65 @@ class ClusterNode:
             on_failure=lambda e: self.scheduler.schedule(
                 1000, lambda: self._retry_recovery(index, shard)
             ),
+            # a full-shard segment dump can be large (phase1 file copy)
+            timeout_ms=180_000,
+        )
+
+    def _finish_segment_recovery(self, index: str, shard: int,
+                                 state: ClusterState, resp: dict) -> None:
+        """File-based recovery target: pull the primary's segments (one per
+        request), install them verbatim (no re-analysis), append the
+        translog tail, then FLUSH — the recovered state must survive a
+        crash of this node (segments + commit + translog on disk)."""
+        primary = state.primary(index, shard)
+        local = self.local_shards.get((index, shard))
+        if primary is None or primary.node_id is None or local is None:
+            self.scheduler.schedule(
+                1000, lambda: self._retry_recovery(index, shard)
+            )
+            return
+        have = local.engine.segment_sigs()
+        want_sigs = resp.get("sigs") or {}
+        need = [n for n in resp["order"] if have.get(n) != want_sigs.get(n)]
+
+        def after_install(ok: bool) -> None:
+            if not ok:
+                self.scheduler.schedule(
+                    1000, lambda: self._retry_recovery(index, shard)
+                )
+                return
+
+            def finalize() -> bool:
+                lcl = self.local_shards.get((index, shard))
+                if lcl is None:
+                    return False
+                for op in resp["ops"]:
+                    entry = lcl.engine.version_map.get(op["id"])
+                    if entry is not None and entry.seq_no >= op["seq_no"]:
+                        continue  # covered by an installed segment
+                    lcl.engine.append_translog_op(op)
+                # durability: the recovered copy must survive a crash
+                # BEFORE its first local flush (installed segments existed
+                # only in memory until here)
+                lcl.engine.flush()
+                lcl.recovery_done = True
+                lcl.recovery_inflight = False
+                return True
+
+            deferred = self._offload(finalize)
+            from opensearch_tpu.transport.base import DeferredResponse
+
+            if isinstance(deferred, DeferredResponse):
+                deferred.on_done(lambda d: (
+                    self._report_shard_started(index, shard)
+                    if d.error is None and d.result else None
+                ))
+            elif deferred:
+                self._report_shard_started(index, shard)
+
+        self._fetch_and_install(
+            index, shard, primary.node_id, resp["order"], need,
+            done=after_install,
         )
 
     def _retry_recovery(self, index: str, shard: int) -> None:
@@ -287,9 +390,24 @@ class ClusterNode:
         return self._offload(lambda: self._start_recovery_local(payload))
 
     def _start_recovery_local(self, payload: dict) -> dict:
-        """Primary-side recovery source: dump live docs + seq_nos (the
-        logical-ops path of RecoverySourceHandler)."""
+        """Primary-side recovery source. SEGMENT replication: phase1 ships
+        the sealed segment files as one binary blob + the translog tail
+        (RecoverySourceHandler.recoverToTarget:171 phase1/phase2); DOCUMENT
+        replication: the logical live-doc dump."""
         shard = self._local_shard(payload["index"], payload["shard"])
+        if shard.replication == "SEGMENT":
+            self._tracked_targets.setdefault(
+                (payload["index"], payload["shard"]), set()
+            ).add(payload["target"])
+            # phase1 manifest only — the target pulls each segment in its
+            # own request (bounded frame sizes); phase2 = the translog tail
+            return {
+                "mode": "segment",
+                "order": shard.engine.segment_names(),
+                "sigs": shard.engine.segment_sigs(),
+                "ops": shard.engine.translog_tail_ops(),
+                "max_seq_no": shard.engine.max_seq_no,
+            }
         # track the target BEFORE snapshotting: every write from here on is
         # fanned out to it, and the seq_no stale-op check on the target makes
         # the dump/fan-out overlap idempotent in either arrival order
@@ -461,9 +579,10 @@ class ClusterNode:
 
     def bulk(self, operations: list[tuple[str, dict, dict | None]],
              callback: Callable[[dict], None]) -> None:
-        """TransportBulkAction analog: group per item, dispatch each to its
-        primary, answer when every item answered. Item order is preserved
-        in the response regardless of completion order."""
+        """TransportBulkAction analog: group items by owning SHARD and send
+        ONE shard-bulk RPC per (shard, primary) — TransportShardBulkAction's
+        batching (one replication round per shard, not per document). Item
+        order is preserved in the response regardless of completion order."""
         import time as _time
 
         t0 = _time.monotonic()
@@ -472,36 +591,85 @@ class ClusterNode:
             callback({"took": 0, "errors": False, "items": []})
             return
         items: list[dict | None] = [None] * n
-        pending = {"n": n, "errors": False}
+        state = {"errors": False}
 
-        def finish_one(i: int, action: str, resp: dict) -> None:
-            if "error" in resp:
-                pending["errors"] = True
-                items[i] = {action: {"error": resp["error"], "status": 500}}
-            else:
-                status = 201 if resp.get("result") == "created" else 200
-                items[i] = {action: {**resp, "status": status}}
-            pending["n"] -= 1
-            if pending["n"] == 0:
-                callback({
-                    "took": int((_time.monotonic() - t0) * 1000),
-                    "errors": pending["errors"], "items": items,
-                })
-
+        # group by (index, shard): [(item_idx, action, op_payload)]
+        groups: dict[tuple[str, int], list] = {}
+        group_primary: dict[tuple[str, int], str] = {}
         for i, (action, meta, source) in enumerate(operations):
             index = meta.get("_index")
             doc_id = meta.get("_id")
             routing = meta.get("routing") or meta.get("_routing")
-            cb = (lambda j, a: lambda resp: finish_one(j, a, resp))(i, action)
             try:
-                if action in ("index", "create"):
-                    self.index_doc(index, doc_id, source, cb, routing)
-                elif action == "delete":
-                    self.delete_doc(index, doc_id, cb, routing)
-                else:
-                    cb({"error": f"unsupported bulk action [{action}]"})
+                if action not in ("index", "create", "delete"):
+                    raise OpenSearchTpuException(
+                        f"unsupported bulk action [{action}]"
+                    )
+                shard_num, primary = self._routing_for_doc(
+                    index, doc_id, routing
+                )
             except OpenSearchTpuException as e:
-                cb({"error": str(e)})
+                state["errors"] = True
+                items[i] = {action: {"error": str(e), "status": 500}}
+                continue
+            key = (index, shard_num)
+            group_primary[key] = primary.node_id
+            op = {"op": "index" if action in ("index", "create") else "delete",
+                  "id": doc_id, "routing": routing}
+            if action in ("index", "create"):
+                op["source"] = source
+                if action == "create":
+                    op["op_type"] = "create"
+            groups.setdefault(key, []).append((i, action, op))
+
+        pending = {"n": len(groups)}
+
+        def done_if_last() -> None:
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                callback({
+                    "took": int((_time.monotonic() - t0) * 1000),
+                    "errors": state["errors"],
+                    "items": items,
+                })
+
+        if not groups:
+            callback({"took": int((_time.monotonic() - t0) * 1000),
+                      "errors": state["errors"], "items": items})
+            return
+
+        for key, group in groups.items():
+            index, shard_num = key
+
+            def on_response(g=group):
+                def handle(resp: dict) -> None:
+                    results = (resp or {}).get("items", [])
+                    for (i, action, _op), r in zip(g, results):
+                        if "error" in r:
+                            state["errors"] = True
+                            items[i] = {action: {"error": r["error"],
+                                                 "status": r.get("status", 500)}}
+                        else:
+                            status = (201 if r.get("result") == "created"
+                                      else 200)
+                            items[i] = {action: {**r, "status": status}}
+                    done_if_last()
+                return handle
+
+            def on_failure(g=group):
+                def handle(e: Exception) -> None:
+                    state["errors"] = True
+                    for (i, action, _op) in g:
+                        items[i] = {action: {"error": str(e), "status": 500}}
+                    done_if_last()
+                return handle
+
+            self.transport.send(
+                self.node_id, group_primary[key], "indices:data/write[p][bulk]",
+                {"index": index, "shard": shard_num,
+                 "ops": [op for _i, _a, op in group]},
+                on_response=on_response(), on_failure=on_failure(),
+            )
 
     def cluster_health(self) -> dict:
         """Computed from the applied state on ANY node (ClusterStateHealth
@@ -656,6 +824,140 @@ class ClusterNode:
             )
         return deferred
 
+    # -- shard-level bulk (TransportShardBulkAction.performOnPrimary) -------
+
+    def _on_primary_bulk(self, sender: str, payload: dict):
+        """Apply a batch of ops on the primary, then ONE batched replica
+        round per copy; ack after every copy answered."""
+        applied = self._offload(lambda: self._apply_primary_bulk_local(payload))
+        from opensearch_tpu.transport.base import DeferredResponse
+
+        if not isinstance(applied, DeferredResponse):
+            return self._continue_primary_bulk(payload, applied)
+        final = DeferredResponse()
+
+        def after(d: DeferredResponse) -> None:
+            if d.error is not None:
+                final.set_exception(d.error)
+                return
+            cont = self._continue_primary_bulk(payload, d.result)
+            if isinstance(cont, DeferredResponse):
+                cont.on_done(lambda c: (
+                    final.set_exception(c.error) if c.error is not None
+                    else final.set_result(c.result)
+                ))
+            else:
+                final.set_result(cont)
+
+        applied.on_done(after)
+        return final
+
+    def _apply_primary_bulk_local(self, payload: dict) -> list[dict]:
+        shard = self._local_shard(payload["index"], payload["shard"])
+        results: list[dict] = []
+        for op in payload["ops"]:
+            try:
+                r = self._apply_primary_local(
+                    {"index": payload["index"], "shard": payload["shard"],
+                     **op}
+                )
+                results.append({
+                    "_index": payload["index"], "_id": op["id"],
+                    "_version": r.version, "_seq_no": r.seq_no,
+                    "result": r.result, "seq_no": r.seq_no,
+                    "version": r.version,
+                })
+            except OpenSearchTpuException as e:
+                results.append({"error": str(e), "_id": op["id"],
+                                "status": getattr(e, "status", 500)})
+        shard.maybe_sync_translog()
+        return results
+
+    def _continue_primary_bulk(self, payload: dict, results: list[dict]):
+        index, shard_num = payload["index"], payload["shard"]
+        state = self.applied_state
+        target_nodes = {
+            r.node_id for r in state.shards_for_index(index)
+            if r.shard == shard_num and not r.primary
+            and r.state in ("STARTED", "INITIALIZING")
+            and r.node_id is not None
+        }
+        target_nodes |= self._tracked_targets.get((index, shard_num), set())
+        target_nodes.discard(self.node_id)
+
+        def response(failed: int) -> dict:
+            n_copies = 1 + len(target_nodes)
+            items = []
+            for r in results:
+                if "error" in r:
+                    items.append(r)
+                else:
+                    items.append({
+                        "_index": r["_index"], "_id": r["_id"],
+                        "_version": r["_version"], "_seq_no": r["_seq_no"],
+                        "result": r["result"],
+                        "_shards": {"total": n_copies,
+                                    "successful": n_copies - failed,
+                                    "failed": failed},
+                    })
+            return {"items": items}
+
+        if not target_nodes:
+            return response(0)
+        from opensearch_tpu.transport.base import DeferredResponse
+
+        deferred = DeferredResponse()
+        pending = {"n": len(target_nodes), "failed": 0}
+        # replicate only the ops that applied (with their seq_nos)
+        rep_ops = [
+            {**op, "seq_no": r["seq_no"], "version": r["version"]}
+            for op, r in zip(payload["ops"], results) if "error" not in r
+        ]
+        rep_payload = {"index": index, "shard": shard_num, "ops": rep_ops}
+
+        def one_done() -> None:
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                deferred.set_result(response(pending["failed"]))
+
+        def make_on_fail(nid: str):
+            def on_fail(_e: Exception) -> None:
+                pending["failed"] += 1
+                self._report_shard_failed(index, shard_num, nid, one_done)
+            return on_fail
+
+        for nid in sorted(target_nodes):
+            self.transport.send(
+                self.node_id, nid, "indices:data/write[r][bulk]", rep_payload,
+                on_response=lambda _r: one_done(),
+                on_failure=make_on_fail(nid),
+            )
+        return deferred
+
+    def _on_replica_bulk(self, sender: str, payload: dict):
+        def run() -> dict:
+            shard = self._local_shard(payload["index"], payload["shard"])
+            for op in payload["ops"]:
+                if shard.replication == "SEGMENT":
+                    top = {"op": op["op"], "id": op["id"],
+                           "seq_no": op["seq_no"],
+                           "version": op.get("version", 1)}
+                    if op["op"] == "index":
+                        top["source"] = op["source"]
+                        top["routing"] = op.get("routing")
+                    shard.engine.append_translog_op(top)
+                elif op["op"] == "index":
+                    shard.apply_index_on_replica(
+                        op["id"], op["source"], op["seq_no"],
+                        op.get("routing"),
+                    )
+                else:
+                    shard.apply_delete_on_replica(op["id"], op["seq_no"])
+            shard.maybe_sync_translog()
+            return {"ack": True}
+
+        return self._offload(run)
+
     def _report_shard_failed(self, index: str, shard: int, node_id: str,
                              done: Callable[[], None]) -> None:
         leader = self.coordinator.leader_id
@@ -684,7 +986,17 @@ class ClusterNode:
     def _on_replica_write(self, sender: str, payload: dict):
         def run() -> dict:
             shard = self._local_shard(payload["index"], payload["shard"])
-            if payload["op"] == "index":
+            if shard.replication == "SEGMENT":
+                # segrep replica: durability only — the op reaches the
+                # searchable set via the primary's segment checkpoints
+                op = {"op": payload["op"], "id": payload["id"],
+                      "seq_no": payload["seq_no"],
+                      "version": payload.get("version", 1)}
+                if payload["op"] == "index":
+                    op["source"] = payload["source"]
+                    op["routing"] = payload.get("routing")
+                shard.engine.append_translog_op(op)
+            elif payload["op"] == "index":
                 shard.apply_index_on_replica(
                     payload["id"], payload["source"], payload["seq_no"],
                     payload.get("routing"),
@@ -751,10 +1063,140 @@ class ClusterNode:
             )
 
     def _on_shard_refresh(self, sender: str, payload: dict):
-        return self._offload(lambda: (
-            self._local_shard(payload["index"], payload["shard"]).refresh(),
-            {"ack": True},
-        )[1])
+        shard = self._local_shard(payload["index"], payload["shard"])
+        deferred = self._offload(lambda: (shard.refresh(), {"ack": True})[1])
+        if shard.primary and shard.replication == "SEGMENT":
+            from opensearch_tpu.transport.base import DeferredResponse
+
+            if isinstance(deferred, DeferredResponse):
+                deferred.on_done(lambda d: (
+                    self._publish_checkpoint(payload["index"], payload["shard"])
+                    if d.error is None else None
+                ))
+            else:
+                self._publish_checkpoint(payload["index"], payload["shard"])
+        return deferred
+
+    # -- segment replication (indices/replication/ analog) ------------------
+
+    def _publish_checkpoint(self, index: str, shard_num: int) -> None:
+        """Primary: after refresh, tell every replica copy which segments
+        now exist (checkpoint/PublishCheckpointAction)."""
+        shard = self.local_shards.get((index, shard_num))
+        if shard is None:
+            return
+        checkpoint = {
+            "index": index, "shard": shard_num,
+            "segments": shard.engine.segment_names(),
+            "sigs": shard.engine.segment_sigs(),
+            "generation": shard.engine._refresh_generation,
+            "max_seq_no": shard.engine.max_seq_no,
+            "primary": self.node_id,
+        }
+        state = self.applied_state
+        for r in state.shards_for_index(index):
+            if (r.shard == shard_num and not r.primary
+                    and r.node_id not in (None, self.node_id)
+                    and r.state == "STARTED"):
+                self.transport.send(
+                    self.node_id, r.node_id,
+                    "indices:replication/checkpoint", checkpoint,
+                    on_response=None, on_failure=lambda e: None,
+                )
+
+    def _on_replication_checkpoint(self, sender: str, payload: dict) -> dict:
+        """Replica: diff the checkpoint against local segments, fetch the
+        missing ones (SegmentReplicationTargetService.onNewCheckpoint:298)."""
+        shard = self.local_shards.get((payload["index"], payload["shard"]))
+        if shard is None or shard.primary:
+            return {"ack": False}
+        have = shard.engine.segment_sigs()
+        want = list(payload["segments"])
+        want_sigs = payload.get("sigs") or {}
+        # a same-name segment with a different signature is stale (e.g. a
+        # crash-restarted replica's locally rebuilt bootstrap segment)
+        missing = [n for n in want
+                   if have.get(n) != want_sigs.get(n)]
+        if not missing and set(want) == set(have):
+            return {"ack": True, "fetched": 0}
+        self._fetch_and_install(
+            payload["index"], payload["shard"], payload["primary"],
+            want, missing, done=None,
+        )
+        return {"ack": True, "fetched": len(missing)}
+
+    def _fetch_and_install(self, index: str, shard_num: int,
+                           primary_id: str, order: list[str],
+                           names: list[str], done) -> None:
+        """Fetch the named segments from the primary ONE per request (the
+        MultiChunkTransfer idea at segment granularity — a whole-shard
+        bundle could exceed the transport's frame cap), then install the
+        set on the data worker. `done(ok: bool)` fires on the loop."""
+        blobs: list[bytes] = []
+
+        def finish_install() -> None:
+            def run() -> bool:
+                from opensearch_tpu.index.segment import unpack_segment
+
+                hosts = [unpack_segment(b) for b in blobs]
+                shard = self.local_shards.get((index, shard_num))
+                if shard is None:
+                    return False
+                shard.engine.install_replicated_segments(hosts, order)
+                return True
+
+            deferred = self._offload(run)
+            from opensearch_tpu.transport.base import DeferredResponse
+
+            if done is None:
+                return
+            if isinstance(deferred, DeferredResponse):
+                deferred.on_done(lambda d: done(
+                    d.error is None and bool(d.result)
+                ))
+            else:
+                done(bool(deferred))
+
+        def fetch(i: int) -> None:
+            if i >= len(names):
+                finish_install()
+                return
+            self.transport.send(
+                self.node_id, primary_id,
+                "indices:replication/get_segments",
+                {"index": index, "shard": shard_num, "names": [names[i]]},
+                on_response=lambda resp: (
+                    blobs.append(resp["_binary"]), fetch(i + 1)
+                ) if isinstance(resp, dict) and resp.get("_binary")
+                else (done(False) if done else None),
+                on_failure=lambda e: done(False) if done else None,
+                # large bundles take longer than control messages
+                # (RecoverySettings' dedicated recovery timeouts)
+                timeout_ms=180_000,
+            )
+
+        fetch(0)
+
+    def _on_get_segments(self, sender: str, payload: dict):
+        """Primary: serve sealed segment bundles as binary blobs
+        (RecoverySourceHandler phase1's file chunks over binary frames;
+        callers request one segment per round to stay under MAX_FRAME)."""
+        shard = self._local_shard(payload["index"], payload["shard"])
+
+        def run() -> dict:
+            from opensearch_tpu.index.segment import pack_segment
+
+            names = set(payload["names"])
+            blobs: list[tuple[str, bytes]] = []
+            for host, _dev in shard.engine._segments:
+                if host.name in names:
+                    blobs.append((host.name, pack_segment(host)))
+            manifest = [[n, len(b)] for n, b in blobs]
+            return {"manifest": manifest,
+                    "segments": shard.engine.segment_names(),
+                    "_binary": b"".join(b for _n, b in blobs)}
+
+        return self._offload(run)
 
     # -- distributed search (scatter-gather, SURVEY §3.2) -------------------
 
@@ -946,16 +1388,32 @@ class ClusterNode:
         names = payload.get("indices")
 
         def run() -> dict:
+            merged = []
             for (index, num), shard in list(self.local_shards.items()):
-                if names is None or index in names:
-                    shard.engine.force_merge(
-                        max_num_segments=int(
-                            payload.get("max_num_segments", 1)
-                        ),
-                    )
-            return {"ack": True}
+                if names is not None and index not in names:
+                    continue
+                if shard.replication == "SEGMENT" and not shard.primary:
+                    # segrep replicas never merge locally — the primary's
+                    # merged segment arrives via the next checkpoint
+                    continue
+                shard.engine.force_merge(
+                    max_num_segments=int(payload.get("max_num_segments", 1)),
+                )
+                if shard.primary and shard.replication == "SEGMENT":
+                    merged.append((index, num))
+            return {"ack": True, "_publish": merged}
 
-        return self._offload(run)
+        deferred = self._offload(run)
+        from opensearch_tpu.transport.base import DeferredResponse
+
+        def publish_after(d):
+            if d.error is None and isinstance(d.result, dict):
+                for index, num in d.result.get("_publish", []):
+                    self._publish_checkpoint(index, num)
+
+        if isinstance(deferred, DeferredResponse):
+            deferred.on_done(publish_after)
+        return deferred
 
     def _on_node_stats(self, sender: str, payload: dict) -> dict:
         out = {}

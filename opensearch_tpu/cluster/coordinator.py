@@ -85,6 +85,7 @@ class Coordinator:
         self._leader_check_timer = None
         self._leader_check_failures = 0
         self._follower_failures: dict[str, int] = {}
+        self._catchup_inflight: set[str] = set()
         self._pending_tasks: list[Callable[[ClusterState], ClusterState]] = []
         self._publishing = False
         self._publication_seq = 0
@@ -510,13 +511,28 @@ class Coordinator:
         def handle(resp: dict) -> None:
             if resp.get("ack"):
                 self._follower_failures[peer] = 0
+                # lag repair (LagDetector + publication fallback): a
+                # follower that acked but has not applied our committed
+                # version (e.g. a wiped node that rejoined while still in
+                # state.nodes — no state CHANGE, so no publication would
+                # ever reach it) gets a direct full-state catch-up
+                applied_v = resp.get("applied_version")
+                if (applied_v is not None
+                        and applied_v < self.applied_state.version
+                        and peer not in self._catchup_inflight):
+                    self._send_catchup(peer)
                 return
-            # the peer rejected us; if it sits on a HIGHER term we must step
-            # down and re-elect above it (the reference's leader learns of
-            # higher terms via check/join responses and bails to candidate)
+            # the peer rejected us; if it sits on a HIGHER term — or flags
+            # an equal-term dual-leader split — we must step down and
+            # re-elect (the reference's leader learns of higher terms via
+            # check/join responses and bails to candidate)
             peer_term = resp.get("term", 0)
-            if peer_term > self.coord.current_term and self.mode == Mode.LEADER:
-                self._become_candidate(f"peer {peer} has higher term {peer_term}")
+            if self.mode == Mode.LEADER and (
+                peer_term > self.coord.current_term or resp.get("dual_leader")
+            ):
+                self._become_candidate(
+                    f"peer {peer} rejected leadership (term {peer_term})"
+                )
             else:
                 self._follower_failed(peer)(RuntimeError("check rejected"))
         return handle
@@ -539,6 +555,35 @@ class Coordinator:
         except CoordinationError:
             pass
 
+    def _send_catchup(self, peer: str) -> None:
+        """Push the current committed state to one lagging follower:
+        publish (it accepts — its version is behind) then commit. Safe:
+        the state is already quorum-committed."""
+        state = self.applied_state
+        if state.term != self.coord.current_term:
+            return
+        self._catchup_inflight.add(peer)
+
+        def done(_=None) -> None:
+            self._catchup_inflight.discard(peer)
+
+        def after_publish(resp: dict) -> None:
+            # commit unconditionally: a rejected publish usually means the
+            # follower already ACCEPTED this exact version and missed only
+            # the commit — handle_commit's (term, version) match keeps a
+            # truly mismatched follower safe
+            self.transport.send(
+                self.node_id, peer, "coordination/commit",
+                {"term": state.term, "version": state.version},
+                on_response=done, on_failure=done,
+            )
+
+        self.transport.send(
+            self.node_id, peer, "coordination/publish",
+            {"state": state.to_dict()},
+            on_response=after_publish, on_failure=done,
+        )
+
     def _on_follower_check(self, sender: str, payload: dict) -> dict:
         if payload["term"] < self.coord.current_term:
             # stale leader: report our term so it can step down and re-elect
@@ -546,7 +591,11 @@ class Coordinator:
         if payload["term"] > self.coord.current_term:
             # we lag behind the checking leader's term: adopt it by voting
             # for that leader in its term (synthetic start-join, like the
-            # lagging-node path in _on_publish)
+            # lagging-node path in _on_publish). This DEMOTES us if we were
+            # leader — the higher-term leader wins (the reference's
+            # ensureTermAtLeast + becomeFollower("onFollowerCheckRequest");
+            # adopting the term while staying LEADER would leave two
+            # leaders sharing the adopted term)
             try:
                 join = self.coord.handle_start_join(
                     StartJoinRequest(source_id=payload["leader_id"], term=payload["term"])
@@ -557,6 +606,15 @@ class Coordinator:
                 )
             except CoordinationError:
                 pass
+            if payload["leader_id"] != self.node_id:
+                self._become_follower(payload["leader_id"])
+                self._leader_check_failures = 0
+        if self.mode == Mode.LEADER and payload["leader_id"] != self.node_id:
+            # an EQUAL-term check from another self-styled leader: two
+            # leaders cannot share a term — reject, flagged so the sender's
+            # _follower_ok steps ITS leadership down too (both re-elect)
+            return {"ack": False, "term": self.coord.current_term,
+                    "dual_leader": True}
         if self.mode != Mode.LEADER and payload["leader_id"] != self.node_id:
             self._become_follower(payload["leader_id"])
             self._leader_check_failures = 0
@@ -564,7 +622,8 @@ class Coordinator:
             # a stale follower still checks us as its leader — reject so it
             # goes looking for the real one
             return {"ack": False, "term": self.coord.current_term}
-        return {"ack": True, "term": self.coord.current_term}
+        return {"ack": True, "term": self.coord.current_term,
+                "applied_version": self.applied_state.version}
 
     def _schedule_leader_check(self) -> None:
         self._leader_check_timer = self.scheduler.schedule(

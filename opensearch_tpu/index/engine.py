@@ -269,6 +269,7 @@ class Engine:
         live_buffer = [e for e in self._buffer if e is not None]
         if live_buffer:
             self._segment_counter += 1
+            self.stats["segments_built"] = self.stats.get("segments_built", 0) + 1
             builder = SegmentBuilder(self.mapper_service, f"_{self._segment_counter}")
             for parsed, seq in live_buffer:
                 builder.add(parsed, seq)
@@ -362,6 +363,7 @@ class Engine:
             self.stats["merge_total"] = self.stats.get("merge_total", 0) + 1
             return
         self._segment_counter += 1
+        self.stats["segments_built"] = self.stats.get("segments_built", 0) + 1
         builder = SegmentBuilder(self.mapper_service,
                                  f"_{self._segment_counter}")
         versions: list[int] = []
@@ -443,6 +445,121 @@ class Engine:
         self.translog.trim_below(self.translog.current_generation)
         self._last_flush_sig = sig
         self.stats["flush_total"] += 1
+
+    # -- segment replication (NRTReplicationEngine analog) ------------------
+    #
+    # In SEGMENT replication mode a replica never indexes documents: writes
+    # only append to its translog (durability + promotion source), and
+    # searchable state arrives as sealed immutable segment bundles published
+    # by the primary after refresh (indices/replication/
+    # SegmentReplicationTargetService.java:66, onNewCheckpoint:298; the
+    # replica engine swap is NRTReplicationEngine's updateSegments).
+
+    def segment_names(self) -> list[str]:
+        return [h.name for h, _ in self._segments]
+
+    def segment_sigs(self) -> dict[str, list[int]]:
+        """Cheap per-segment content signature for checkpoint diffs: two
+        copies may hold same-NAME segments with different content (a
+        crash-restarted replica rebuilds a bootstrap segment from its
+        translog); the signature distinguishes them. Equal signatures mean
+        the segments cover the same ops — equivalent for serving."""
+        return {
+            h.name: [h.n_docs, int(h.min_seq_no), int(h.max_seq_no),
+                     int(h.live.sum())]
+            for h, _ in self._segments
+        }
+
+    def append_translog_op(self, op: dict) -> None:
+        """Replica-side durability for a replicated write without indexing
+        (segment-replication replicas)."""
+        self.translog.add(op)
+        self._sync_needed = True
+        self.tracker.mark_seq_no_as_processed(int(op["seq_no"]))
+        if op.get("op") == "index":
+            self.stats["index_total"] += 1
+        else:
+            self.stats["delete_total"] += 1
+
+    def install_replicated_segments(
+        self, new_hosts: list, order: list[str]
+    ) -> None:
+        """Swap in the primary's segment set: keep local copies of
+        unchanged segments, adopt the new ones, drop segments the primary
+        no longer has (merged away). `order` is the primary's full segment
+        name list — the replica mirrors it exactly so doc-id tie-breaks and
+        segment ordering match across copies."""
+        existing = {h.name: (h, d) for h, d in self._segments}
+        for host in new_hosts:
+            existing[host.name] = (host, to_device(host))
+        self._segments = [existing[n] for n in order if n in existing]
+        # seal-time doc columns refresh the version map so realtime GET and
+        # seq-no stale checks see replicated docs — only the NEWLY adopted
+        # hosts need scanning (kept segments were processed on first install)
+        for host in new_hosts:
+            for d in range(host.n_docs):
+                if not host.live[d]:
+                    continue
+                doc_id = host.doc_ids[d]
+                seq = int(host.doc_seq_nos[d])
+                cur = self.version_map.get(doc_id)
+                if cur is None or cur.seq_no < seq:
+                    self.version_map[doc_id] = VersionEntry(
+                        seq, int(host.doc_versions[d])
+                    )
+                self.tracker.mark_seq_no_as_processed(seq)
+        # buffered ops now covered by an installed segment must not build a
+        # duplicate local segment at the next refresh
+        for doc_id, pos in list(self._buffer_pos.items()):
+            entry = self._buffer[pos]
+            if entry is None:
+                self._buffer_pos.pop(doc_id, None)
+                continue
+            vm = self.version_map.get(doc_id)
+            if vm is not None and vm.seq_no >= entry[1]:
+                self._buffer[pos] = None
+                self._buffer_pos.pop(doc_id, None)
+        if not self._buffer_pos:
+            self._buffer = []
+        # keep the segment counter ahead of adopted names so a promoted
+        # replica never reuses a replicated segment's name
+        for name in order:
+            try:
+                self._segment_counter = max(
+                    self._segment_counter, int(name.lstrip("_").split(".")[0])
+                )
+            except ValueError:
+                pass
+        self._refresh_generation += 1
+        self._searcher = SearcherSnapshot(
+            list(self._segments), self._refresh_generation
+        )
+        self.stats["refresh_total"] += 1
+
+    def translog_tail_ops(self) -> list[dict]:
+        """Ops since the last flush (the translog tail a recovering segrep
+        replica needs for durability/promotion completeness). Syncs first:
+        under async durability recently acked ops may still be unsynced,
+        and read_ops truncates at the fsynced checkpoint — a recovery dump
+        must never miss acked ops."""
+        self.translog.sync()
+        self._sync_needed = False
+        return list(self.translog.read_ops())
+
+    def replay_translog_tail(self) -> int:
+        """Promotion of a segment-replication replica: index any translog
+        ops not yet reflected in the engine (the per-doc seq_no stale check
+        dedups ops already covered by replicated segments)."""
+        replayed = 0
+        for op in self.translog.read_ops():
+            if op["op"] == "index":
+                r = self.index(op["id"], op["source"], op.get("routing"),
+                               seq_no=op["seq_no"])
+            else:
+                r = self.delete(op["id"], seq_no=op["seq_no"])
+            if r.result != "noop":
+                replayed += 1
+        return replayed
 
     # -- recovery ----------------------------------------------------------
 

@@ -40,6 +40,28 @@ def translog_durability(settings: dict) -> str:
     return value
 
 
+def replication_type(settings: dict) -> str:
+    """index.replication.type: DOCUMENT (logical re-execution on replicas,
+    the default) or SEGMENT (replicas consume sealed segment bundles
+    published by the primary — indices/replication/ in the reference)."""
+    from opensearch_tpu.common.errors import IllegalArgumentException
+
+    settings = settings or {}
+    rep = settings.get("replication")
+    value = str(
+        settings.get("replication.type")
+        or settings.get("index.replication.type")
+        or (rep.get("type") if isinstance(rep, dict) else None)
+        or "DOCUMENT"
+    ).upper()
+    if value not in ("DOCUMENT", "SEGMENT"):
+        raise IllegalArgumentException(
+            f"unknown value [{value}] for [index.replication.type], "
+            "must be one of [DOCUMENT, SEGMENT]"
+        )
+    return value
+
+
 @dataclass(frozen=True)
 class ShardId:
     index: str
@@ -51,11 +73,12 @@ class ShardId:
 
 class IndexShard:
     def __init__(self, shard_id: ShardId, path: Path, mapper_service: MapperService,
-                 durability: str = "request"):
+                 durability: str = "request", replication: str = "DOCUMENT"):
         self.shard_id = shard_id
         self.mapper_service = mapper_service
         self.engine = Engine(path, mapper_service, durability=durability)
         self.primary = True
+        self.replication = replication
 
     # -- write ops ---------------------------------------------------------
 

@@ -58,10 +58,12 @@ def hybrid_score_topk(
     docs = jnp.where(tvalid, docs, 0)
     lex = jnp.zeros(n_pad, jnp.float32).at[docs.reshape(-1)].add(contrib.reshape(-1))
 
-    # vector: one [B,d]x[d,n] matmul (MXU) + score-space transform
+    # vector: one [B,d]x[d,n] matmul (MXU) + score-space transform; HIGHEST
+    # precision keeps the exact path exact (see knn_topk)
     dots = jnp.einsum(
         "bd,nd->bn", queries, vectors.astype(queries.dtype),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )
     if similarity == "l2_norm":
         q_sq = jnp.sum(queries * queries, axis=-1, keepdims=True)
@@ -87,10 +89,17 @@ def knn_topk(
     k: int,
     similarity: str = "l2_norm",
 ):
-    """Pure exact-kNN fused path (the BASELINE config #1 program)."""
+    """Pure exact-kNN fused path (the BASELINE config #1 program).
+
+    HIGHEST matmul precision: the default TPU lowering runs fp32 einsum as
+    bf16 MXU passes, which flips near-tie neighbors vs an fp32 host
+    reference (VERDICT r2 weak #2 measured recall 0.993 on the "exact"
+    path). The exact path must be exact — recall 1.0; bf16 speed belongs
+    to an explicitly approximate path, not a silent downgrade."""
     dots = jnp.einsum(
         "bd,nd->bn", queries, vectors.astype(queries.dtype),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )
     if similarity == "l2_norm":
         q_sq = jnp.sum(queries * queries, axis=-1, keepdims=True)

@@ -41,10 +41,18 @@ def raw_similarity(
     norms_sq: jnp.ndarray,     # [n_pad] float32 precomputed ||v||^2
     similarity: str,
 ) -> jnp.ndarray:
-    """[B, n_pad] raw similarity, higher = closer, before score-space map."""
+    """[B, n_pad] raw similarity, higher = closer, before score-space map.
+
+    HIGHEST matmul precision: exact-path scores must match an fp32 host
+    reference bit-for-bit (and the distributed serving program, which also
+    runs HIGHEST) — the default TPU bf16 lowering flips near-tie
+    neighbors (VERDICT r2 weak #2)."""
     sim = canonical_similarity(similarity)
+    import jax as _jax
+
     dots = jnp.einsum(
-        "bd,nd->bn", queries, vectors, preferred_element_type=jnp.float32
+        "bd,nd->bn", queries, vectors, preferred_element_type=jnp.float32,
+        precision=_jax.lax.Precision.HIGHEST,
     )
     if sim == L2:
         q_sq = jnp.sum(queries * queries, axis=-1, keepdims=True)      # [B,1]

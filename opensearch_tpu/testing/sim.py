@@ -148,6 +148,7 @@ class MockTransport:
         payload: Any,
         on_response: Callable[[Any], None] | None = None,
         on_failure: Callable[[Exception], None] | None = None,
+        timeout_ms: int | None = None,  # accepted for interface parity
     ) -> None:
         self.stats["sent"] += 1
         delay = self.queue.random.randint(self.min_delay_ms, self.max_delay_ms)

@@ -31,28 +31,30 @@ class DeferredResponse:
         self._done = False
         self._result: Any = None
         self._error: Exception | None = None
-        self._listener: Callable[["DeferredResponse"], None] | None = None
+        self._listeners: list[Callable[["DeferredResponse"], None]] = []
 
     def set_result(self, result: Any) -> None:
         if self._done:
             return
         self._done = True
         self._result = result
-        if self._listener is not None:
-            self._listener(self)
+        for listener in self._listeners:
+            listener(self)
 
     def set_exception(self, error: Exception) -> None:
         if self._done:
             return
         self._done = True
         self._error = error
-        if self._listener is not None:
-            self._listener(self)
+        for listener in self._listeners:
+            listener(self)
 
     # -- transport side ----------------------------------------------------
 
     def on_done(self, listener: Callable[["DeferredResponse"], None]) -> None:
-        self._listener = listener
+        """Register a completion listener (multiple allowed: the transport
+        ships the response AND the handler may chain follow-up work)."""
+        self._listeners.append(listener)
         if self._done:
             listener(self)
 

@@ -92,7 +92,7 @@ class TcpCluster:
             except Exception:  # noqa: BLE001 - test teardown
                 pass
 
-    async def wait_leader(self, timeout_s: float = 30.0) -> str:
+    async def wait_leader(self, timeout_s: float = 60.0) -> str:
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout_s
         while loop.time() < deadline:
@@ -110,7 +110,7 @@ class TcpCluster:
         raise TimeoutError("no stable leader elected")
 
     async def wait_health(self, port: int, want: str = "green",
-                          timeout_s: float = 15.0) -> dict:
+                          timeout_s: float = 30.0) -> dict:
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout_s
         last = None
@@ -216,7 +216,7 @@ def test_leader_kill_no_acked_write_loss(tcp_cluster):
 
         # survivors re-elect and the cluster serves again
         loop = asyncio.get_running_loop()
-        deadline = loop.time() + 30.0
+        deadline = loop.time() + 60.0
         new_leader = None
         while loop.time() < deadline:
             leaders = {n for n, s in cluster.servers.items()
@@ -299,7 +299,7 @@ def test_leader_kill_mid_bulk(tcp_cluster):
 
         # survivors re-elect
         loop = asyncio.get_running_loop()
-        deadline = loop.time() + 30.0
+        deadline = loop.time() + 60.0
         while loop.time() < deadline:
             if any(s.node.is_leader for s in cluster.servers.values()):
                 break
