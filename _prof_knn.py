@@ -1,0 +1,74 @@
+import time, functools
+import numpy as np
+import jax, jax.numpy as jnp
+
+d, k, B = 128, 10, 500
+n = 1_000_000
+n_pad = 1 << (n - 1).bit_length()
+key = jax.random.PRNGKey(7)
+vectors = jax.random.normal(key, (n, d), dtype=jnp.float32)
+vectors = jnp.pad(vectors, ((0, n_pad - n), (0, 0)))
+norms = jnp.sum(vectors * vectors, axis=-1)
+valid = jnp.arange(n_pad) < n
+rng = np.random.default_rng(7)
+q = jnp.asarray(rng.standard_normal((B, d)).astype(np.float32))
+
+def timeit(fn, *args, reps=5):
+    np.asarray(fn(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts) * 1000
+
+HI = jax.lax.Precision.HIGHEST
+
+@jax.jit
+def null(qs):
+    return qs.sum()
+
+@jax.jit
+def scores_only(v, nrm, ok, qs):
+    dots = jnp.einsum("bd,nd->bn", qs, v, preferred_element_type=jnp.float32, precision=HI)
+    qsq = jnp.sum(qs*qs, axis=-1, keepdims=True)
+    s = 1.0/(1.0 + jnp.maximum(qsq - 2*dots + nrm[None,:], 0.0))
+    return jnp.where(ok[None,:], s, -jnp.inf).sum()
+
+@jax.jit
+def scores_blockmax(v, nrm, ok, qs):
+    dots = jnp.einsum("bd,nd->bn", qs, v, preferred_element_type=jnp.float32, precision=HI)
+    qsq = jnp.sum(qs*qs, axis=-1, keepdims=True)
+    s = 1.0/(1.0 + jnp.maximum(qsq - 2*dots + nrm[None,:], 0.0))
+    s = jnp.where(ok[None,:], s, -jnp.inf)
+    return s.reshape(B, -1, 4096).max(axis=-1).sum()
+
+from opensearch_tpu.ops.topk import blockwise_topk, _iterative_topk
+@jax.jit
+def full(v, nrm, ok, qs):
+    dots = jnp.einsum("bd,nd->bn", qs, v, preferred_element_type=jnp.float32, precision=HI)
+    qsq = jnp.sum(qs*qs, axis=-1, keepdims=True)
+    s = 1.0/(1.0 + jnp.maximum(qsq - 2*dots + nrm[None,:], 0.0))
+    s = jnp.where(ok[None,:], s, -jnp.inf)
+    return blockwise_topk(s, k)
+
+@jax.jit
+def full16(v, nrm, ok, qss):  # [16, 500, d] chunks in one dispatch
+    f = lambda qs: full_body(v, nrm, ok, qs)
+    return jax.lax.map(f, qss)
+
+def full_body(v, nrm, ok, qs):
+    dots = jnp.einsum("bd,nd->bn", qs, v, preferred_element_type=jnp.float32, precision=HI)
+    qsq = jnp.sum(qs*qs, axis=-1, keepdims=True)
+    s = 1.0/(1.0 + jnp.maximum(qsq - 2*dots + nrm[None,:], 0.0))
+    s = jnp.where(ok[None,:], s, -jnp.inf)
+    return blockwise_topk(s, k)
+
+print("null round-trip:         ", round(timeit(null, q), 2), "ms")
+print("scores only (fused sum): ", round(timeit(scores_only, vectors, norms, valid, q), 2), "ms")
+print("scores + blockmax:       ", round(timeit(scores_blockmax, vectors, norms, valid, q), 2), "ms")
+t_full = timeit(full, vectors, norms, valid, q)
+print("full blockwise topk HI:  ", round(t_full, 2), "ms")
+qss = jnp.asarray(rng.standard_normal((16, B, d)).astype(np.float32))
+t16 = timeit(full16, vectors, norms, valid, qss, reps=3)
+print("16-chunk dispatch (8000q):", round(t16, 2), "ms ->", round(8000/(t16/1000)), "QPS")

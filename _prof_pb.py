@@ -1,0 +1,37 @@
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from opensearch_tpu.ops.pallas_knn import pallas_knn_blocktopk
+
+d, k, B = 128, 10, 512
+n = 1_000_000
+n_pad = 1 << (n - 1).bit_length()
+key = jax.random.PRNGKey(7)
+vectors = jax.random.normal(key, (n, d), dtype=jnp.float32)
+vectors = jnp.pad(vectors, ((0, n_pad - n), (0, 0)))
+norms = jnp.sum(vectors * vectors, axis=-1)
+valid = jnp.arange(n_pad) < n
+rng = np.random.default_rng(7)
+q = jnp.asarray(rng.standard_normal((B, d)).astype(np.float32))
+
+def timeit(fn, *args, reps=4, **kw):
+    np.asarray(fn(*args, **kw)[0])
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args, **kw)[0])
+        ts.append(time.perf_counter() - t0)
+    return min(ts) * 1000
+
+t_hi = timeit(pallas_knn_blocktopk, vectors, norms, valid, q, k=k, similarity="l2_norm", exact=True)
+print(f"pallas blocktopk HIGHEST 512q: {t_hi:.1f} ms wall", flush=True)
+
+@jax.jit
+def many(v, nrm, ok, qss):
+    f = lambda qs: pallas_knn_blocktopk(v, nrm, ok, qs, k=k, similarity="l2_norm", exact=True)
+    return jax.lax.map(f, qss)
+for n_chunks in (4, 16):
+    qss = jnp.asarray(rng.standard_normal((n_chunks, B, d)).astype(np.float32))
+    t = timeit(many, vectors, norms, valid, qss, reps=3)
+    total_q = n_chunks * B
+    print(f"{n_chunks}-chunk dispatch ({total_q}q): {t:.1f} ms -> {total_q/(t/1000):.0f} QPS", flush=True)

@@ -1,0 +1,26 @@
+import time, sys
+import numpy as np
+import jax, jax.numpy as jnp
+import opensearch_tpu.ops.pallas_knn as pk
+
+d, k = 128, 10
+n_pad = 1 << 20
+key = jax.random.PRNGKey(7)
+vectors = jax.random.normal(key, (n_pad, d), dtype=jnp.float32)
+norms = jnp.sum(vectors * vectors, axis=-1)
+valid = jnp.ones(n_pad, bool)
+rng = np.random.default_rng(7)
+
+for B in (8, 32, 128):
+    pk.PB_QTILE = B
+    q = jnp.asarray(rng.standard_normal((B, d)).astype(np.float32))
+    t0 = time.perf_counter()
+    out = pk.pallas_knn_sbmax_topk(vectors, norms, valid, q, k=k, similarity="l2_norm", exact=True)
+    np.asarray(out[0])
+    t_compile = time.perf_counter() - t0
+    ts = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        np.asarray(pk.pallas_knn_sbmax_topk(vectors, norms, valid, q, k=k, similarity="l2_norm", exact=True)[0])
+        ts.append(time.perf_counter() - t0)
+    print(f"B={B}: compile+first {t_compile:.1f}s, steady {min(ts)*1000:.1f} ms", flush=True)

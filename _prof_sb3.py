@@ -1,0 +1,37 @@
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+import opensearch_tpu.ops.pallas_knn as pk
+
+d, k, B = 128, 10, 128
+n_pad = 1 << 20
+key = jax.random.PRNGKey(7)
+vectors = jax.random.normal(key, (n_pad, d), dtype=jnp.float32)
+norms = jnp.sum(vectors * vectors, axis=-1)
+valid = jnp.ones(n_pad, bool)
+rng = np.random.default_rng(7)
+q = jnp.asarray(rng.standard_normal((B, d)).astype(np.float32))
+t0 = time.perf_counter()
+out = pk.pallas_knn_sbmax_topk(vectors, norms, valid, q, k=k, similarity="l2_norm", exact=True)
+np.asarray(out[0])
+print(f"compile+first: {time.perf_counter()-t0:.1f}s", flush=True)
+ts = []
+for _ in range(5):
+    t0 = time.perf_counter()
+    np.asarray(pk.pallas_knn_sbmax_topk(vectors, norms, valid, q, k=k, similarity="l2_norm", exact=True)[0])
+    ts.append(time.perf_counter() - t0)
+print(f"steady single: {min(ts)*1000:.1f} ms (128q, 1M docs)", flush=True)
+
+@jax.jit
+def many(v, nrm, ok, qss):
+    f = lambda qs: pk.pallas_knn_sbmax_topk(v, nrm, ok, qs, k=k, similarity="l2_norm", exact=True)
+    return jax.lax.map(f, qss)
+qss = jnp.asarray(rng.standard_normal((32, B, d)).astype(np.float32))
+np.asarray(many(vectors, norms, valid, qss)[0])
+ts = []
+for _ in range(3):
+    t0 = time.perf_counter()
+    np.asarray(many(vectors, norms, valid, qss)[0])
+    ts.append(time.perf_counter() - t0)
+t = min(ts)
+print(f"32-chunk (4096q): {t*1000:.1f} ms -> {4096/t:.0f} QPS", flush=True)

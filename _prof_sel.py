@@ -1,0 +1,97 @@
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+
+d, k, B, bs = 128, 10, 500, 4096
+n = 1_000_000
+n_pad = 1 << (n - 1).bit_length()
+nb = n_pad // bs
+key = jax.random.PRNGKey(7)
+vectors = jax.random.normal(key, (n, d), dtype=jnp.float32)
+vectors = jnp.pad(vectors, ((0, n_pad - n), (0, 0)))
+norms = jnp.sum(vectors * vectors, axis=-1)
+valid = jnp.arange(n_pad) < n
+rng = np.random.default_rng(7)
+q = jnp.asarray(rng.standard_normal((B, d)).astype(np.float32))
+HI = jax.lax.Precision.HIGHEST
+
+def timeit(fn, *args, reps=4):
+    np.asarray(fn(*args)[0])
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args)[0])
+        ts.append(time.perf_counter() - t0)
+    return min(ts) * 1000
+
+def scores_of(v, nrm, ok, qs):
+    dots = jnp.einsum("bd,nd->bn", qs, v, preferred_element_type=jnp.float32, precision=HI)
+    qsq = jnp.sum(qs*qs, axis=-1, keepdims=True)
+    s = 1.0/(1.0 + jnp.maximum(qsq - 2*dots + nrm[None,:], 0.0))
+    return jnp.where(ok[None,:], s, -jnp.inf)
+
+from opensearch_tpu.ops.topk import _iterative_topk
+
+@jax.jit
+def var_iter_iter(v, nrm, ok, qs):   # current
+    s = scores_of(v, nrm, ok, qs)
+    sb = s.reshape(B, nb, bs)
+    bm = sb.max(axis=-1)
+    _, blk = _iterative_topk(bm, k)
+    blk = jnp.sort(blk, axis=1)
+    cand = jnp.take_along_axis(sb, blk[:, :, None], axis=1)
+    vals, flat = _iterative_topk(cand.reshape(B, k*bs), k)
+    doc = jnp.take_along_axis(blk, flat // bs, axis=1) * bs + flat % bs
+    return vals, doc
+
+@jax.jit
+def var_topk_cand(v, nrm, ok, qs):   # blocks iterative, candidates lax.top_k
+    s = scores_of(v, nrm, ok, qs)
+    sb = s.reshape(B, nb, bs)
+    bm = sb.max(axis=-1)
+    _, blk = _iterative_topk(bm, k)
+    blk = jnp.sort(blk, axis=1)
+    cand = jnp.take_along_axis(sb, blk[:, :, None], axis=1)
+    vals, flat = jax.lax.top_k(cand.reshape(B, k*bs), k)
+    doc = jnp.take_along_axis(blk, flat // bs, axis=1) * bs + flat % bs
+    return vals, doc
+
+@jax.jit
+def var_topk_topk(v, nrm, ok, qs):   # both lax.top_k
+    s = scores_of(v, nrm, ok, qs)
+    sb = s.reshape(B, nb, bs)
+    bm = sb.max(axis=-1)
+    _, blk = jax.lax.top_k(bm, k)
+    blk = jnp.sort(blk, axis=1)
+    cand = jnp.take_along_axis(sb, blk[:, :, None], axis=1)
+    vals, flat = jax.lax.top_k(cand.reshape(B, k*bs), k)
+    doc = jnp.take_along_axis(blk, flat // bs, axis=1) * bs + flat % bs
+    return vals, doc
+
+@jax.jit
+def var_full_topk(v, nrm, ok, qs):   # monolithic lax.top_k over [B, n]
+    s = scores_of(v, nrm, ok, qs)
+    return jax.lax.top_k(s, k)
+
+@jax.jit
+def var_block_topk(v, nrm, ok, qs):  # per-block top_k then merge (streaming shape)
+    s = scores_of(v, nrm, ok, qs)
+    sb = s.reshape(B, nb, bs)
+    bv, bi = jax.lax.top_k(sb, k)          # [B, nb, k]
+    base = (jnp.arange(nb) * bs)[None, :, None]
+    bi = bi + base
+    fv = bv.reshape(B, nb*k)
+    fi = bi.reshape(B, nb*k)
+    vals, pos = jax.lax.top_k(fv, k)
+    return vals, jnp.take_along_axis(fi, pos, axis=1)
+
+for name, fn in [("iter+iter (current)", var_iter_iter),
+                 ("iter blocks + topk cand", var_topk_cand),
+                 ("topk blocks + topk cand", var_topk_topk),
+                 ("monolithic topk", var_full_topk),
+                 ("per-block topk merge", var_block_topk)]:
+    try:
+        t = timeit(fn, vectors, norms, valid, q)
+        print(f"{name:26s} {t:8.2f} ms")
+    except Exception as e:
+        print(f"{name:26s} FAILED {str(e)[:80]}")

@@ -40,7 +40,6 @@ def main() -> None:
 
     d, k = 128, 10
     chunk = 500          # queries per on-device chunk
-    n_chunks = 4         # 2000 queries per dispatch
     rng = np.random.default_rng(7)
 
     platform = jax.devices()[0].platform
@@ -78,6 +77,10 @@ def main() -> None:
         return jax.lax.map(lambda q: f(v, nrm, ok, q), qs)
 
     jmany = jax.jit(knn_many)
+    # 16 chunks per dispatch: the ~65ms tunnel round-trip is fixed per
+    # dispatch, so throughput is measured with it amortized over 8000
+    # queries (the serving shape: a saturated queue keeps dispatches full)
+    n_chunks = 16
     qs = jnp.asarray(
         rng.standard_normal((n_chunks, chunk, d)).astype(np.float32)
     )
@@ -124,7 +127,7 @@ def main() -> None:
         "unit": "queries/s",
         "vs_baseline": round(qps / cpu_qps, 2),
         "p50_batch100_ms": round(p50_batch * 1000, 2),
-        "dispatch_wall_ms_2000q": round(wall * 1000, 2),
+        f"dispatch_wall_ms_{total_q}q": round(wall * 1000, 2),
         "recall_at_10": round(recall, 4),
         "platform": platform,
     }))

@@ -505,6 +505,25 @@ class SegmentExecutor:
     def _exec_MatchAllQuery(self, node: q.MatchAllQuery) -> NodeResult:
         return _const_result(self.dev.live, node.boost, scoring=True)
 
+    def _exec_SliceQuery(self, node: q.SliceQuery) -> NodeResult:
+        """Sliced scroll: murmur3(_id) % max == id (SliceBuilder's default
+        _id-based partitioning). Hash per doc computed once per segment."""
+        from opensearch_tpu.common.hashing import murmur3_x86_32
+
+        host = self.host
+        cache = getattr(host, "_slice_hash_cache", None)
+        if cache is None:
+            cache = np.asarray(
+                [murmur3_x86_32(i.encode()) & 0xFFFFFFFF
+                 for i in host.doc_ids],
+                np.uint32,
+            )
+            host._slice_hash_cache = cache
+        sel = np.zeros(self.dev.n_pad, bool)
+        sel[: host.n_docs] = (cache % np.uint32(node.max)) == node.id
+        mask = jnp.asarray(sel) & self.dev.live
+        return _const_result(mask, node.boost, scoring=False)
+
     def _exec_MatchNoneQuery(self, node: q.MatchNoneQuery) -> NodeResult:
         return _empty(self.dev)
 

@@ -1,0 +1,230 @@
+"""Coordinator-adjacent search phases: can_match, rescore, collapse.
+
+- can_match: shard skipping by provable non-match — range/term constraints
+  against per-segment numeric min/max (the reference's coordinator
+  pre-filter, action/search/CanMatchPreFilterSearchPhase.java, backed by
+  the min/max rewrite of range queries over BKD metadata).
+- rescore: second-pass re-ranking of the top window
+  (search/rescore/RescorePhase.java + QueryRescorer: combined =
+  query_weight * first + rescore_query_weight * second per score_mode).
+- collapse: first-hit-per-group on a field (search/collapse/
+  CollapseContext.java reduced to its serving core).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from opensearch_tpu.common.errors import ParsingException
+from opensearch_tpu.search import query_dsl as q
+
+
+# --------------------------------------------------------------------- #
+# can_match
+# --------------------------------------------------------------------- #
+
+
+def _range_constraints(node: Any) -> list[q.RangeQuery]:
+    """Conjunctive range constraints provable from the query root."""
+    if isinstance(node, q.RangeQuery):
+        return [node]
+    if isinstance(node, q.BoolQuery):
+        out: list[q.RangeQuery] = []
+        for child in list(node.must) + list(node.filter):
+            out.extend(_range_constraints(child))
+        return out
+    return []
+
+
+def _segment_minmax(host, field: str) -> tuple[float, float] | None:
+    cache = getattr(host, "_minmax_cache", None)
+    if cache is None:
+        cache = {}
+        host._minmax_cache = cache
+    if field in cache:
+        return cache[field]
+    nf = host.numeric_fields.get(field)
+    out = None
+    if nf is not None:
+        vals = nf.values_i64 if nf.kind == "int" else nf.values_f64
+        present = nf.present & host.live[: len(nf.present)]
+        if present.any():
+            v = vals[present]
+            out = (float(v.min()), float(v.max()))
+        else:
+            out = "empty"
+    cache[field] = out
+    return out
+
+
+def can_match(snapshot, mapper_service, node: Any) -> bool:
+    """False only when the shard PROVABLY has no matching doc. Unknown
+    fields/types return True (conservative, like the reference's rewrite
+    returning MatchAllDocs when it cannot prove otherwise)."""
+    constraints = _range_constraints(node)
+    if not constraints:
+        return True
+    if not snapshot.segments:
+        # a shard with buffered-but-unrefreshed docs still can't serve them;
+        # empty searchable set only provably non-matching if no constraint
+        # is needed — keep executing (cheap on an empty shard)
+        return True
+    for rq in constraints:
+        mapper = mapper_service.field_mapper(rq.field)
+        if mapper is None or mapper.type not in (
+            "long", "integer", "short", "byte", "double", "float", "date",
+        ):
+            continue
+        lo, hi = None, None
+        try:
+            if mapper.type == "date":
+                from opensearch_tpu.index.mapper import parse_date_millis
+
+                conv = parse_date_millis
+            else:
+                conv = float
+            if rq.gte is not None:
+                lo = conv(rq.gte)
+            if rq.gt is not None:
+                lo = conv(rq.gt)
+            if rq.lte is not None:
+                hi = conv(rq.lte)
+            if rq.lt is not None:
+                hi = conv(rq.lt)
+        except (TypeError, ValueError):
+            continue
+        any_segment_matches = False
+        for host, _dev in snapshot.segments:
+            mm = _segment_minmax(host, rq.field)
+            if mm is None:
+                # field absent in this segment: range can't match here
+                continue
+            if mm == "empty":
+                continue
+            smin, smax = mm
+            if lo is not None:
+                bound_ok = smax > lo if rq.gt is not None else smax >= lo
+                if not bound_ok:
+                    continue
+            if hi is not None:
+                bound_ok = smin < hi if rq.lt is not None else smin <= hi
+                if not bound_ok:
+                    continue
+            any_segment_matches = True
+            break
+        if not any_segment_matches:
+            return False
+    return True
+
+
+# --------------------------------------------------------------------- #
+# rescore
+# --------------------------------------------------------------------- #
+
+_SCORE_MODES = {
+    "total": lambda a, b: a + b,
+    "multiply": lambda a, b: a * b,
+    "avg": lambda a, b: (a + b) / 2.0,
+    "max": max,
+    "min": min,
+}
+
+
+def apply_rescore(rescore_body, merged, per_shard_results, shards):
+    """Re-rank the top window of `merged` ([(shard_idx, ShardHit)] sorted by
+    score desc). Each rescore stage computes the rescore query's scores for
+    window docs and combines per score_mode; hits outside the window keep
+    their order below the window (RescorePhase contract)."""
+    from opensearch_tpu.search.executor import SegmentExecutor, ShardContext
+
+    stages = rescore_body if isinstance(rescore_body, list) else [rescore_body]
+    for stage in stages:
+        if not isinstance(stage, dict) or "query" not in stage:
+            raise ParsingException("[rescore] requires a [query] object")
+        window = int(stage.get("window_size", 10))
+        conf = stage["query"]
+        rq_body = conf.get("rescore_query")
+        if rq_body is None:
+            raise ParsingException("[rescore] requires [query.rescore_query]")
+        qw = float(conf.get("query_weight", 1.0))
+        rw = float(conf.get("rescore_query_weight", 1.0))
+        mode = str(conf.get("score_mode", "total"))
+        combine = _SCORE_MODES.get(mode)
+        if combine is None:
+            raise ParsingException(f"unknown rescore score_mode [{mode}]")
+        rq_node = q.parse_query(rq_body)
+
+        # lazily computed rescore scores per (shard_idx, segment)
+        score_cache: dict[tuple[int, int], tuple[np.ndarray, np.ndarray]] = {}
+
+        def rescore_scores(shard_idx: int, seg_idx: int):
+            key = (shard_idx, seg_idx)
+            if key not in score_cache:
+                shard, snapshot, _res = per_shard_results[shard_idx]
+                host, dev = snapshot.segments[seg_idx]
+                ctx = ShardContext(snapshot, shard.mapper_service)
+                result = SegmentExecutor(ctx, host, dev).execute(rq_node)
+                score_cache[key] = (
+                    np.asarray(result.scores), np.asarray(result.mask)
+                )
+            return score_cache[key]
+
+        head = merged[:window]
+        rescored = []
+        for shard_idx, hit in head:
+            scores, mask = rescore_scores(shard_idx, hit.segment)
+            if mask[hit.doc]:
+                new = combine(qw * hit.score, rw * float(scores[hit.doc]))
+            else:
+                new = qw * hit.score
+            from dataclasses import replace
+
+            rescored.append((shard_idx, replace(hit, score=new)))
+        rescored.sort(
+            key=lambda sh: (-sh[1].score, sh[0], sh[1].segment, sh[1].doc)
+        )
+        merged = rescored + merged[window:]
+    return merged
+
+
+# --------------------------------------------------------------------- #
+# collapse
+# --------------------------------------------------------------------- #
+
+
+def doc_field_value(host, field: str, doc: int, mapper_service):
+    kf = host.keyword_fields.get(field)
+    if kf is not None:
+        o = int(kf.first_ord[doc])
+        return kf.ord_values[o] if o >= 0 else None
+    nf = host.numeric_fields.get(field)
+    if nf is not None:
+        if not nf.present[doc]:
+            return None
+        v = nf.values_i64[doc] if nf.kind == "int" else nf.values_f64[doc]
+        return int(v) if nf.kind == "int" else float(v)
+    return None
+
+
+def apply_collapse(collapse_body, merged, per_shard_results):
+    """Keep the first (best-ranked) hit per distinct field value; docs
+    without the field each form their own group (reference: null group)."""
+    if not isinstance(collapse_body, dict) or not collapse_body.get("field"):
+        raise ParsingException("[collapse] requires a [field]")
+    field = collapse_body["field"]
+    seen: set = set()
+    out = []
+    values = []
+    for shard_idx, hit in merged:
+        shard, snapshot, _res = per_shard_results[shard_idx]
+        host, _dev = snapshot.segments[hit.segment]
+        value = doc_field_value(host, field, hit.doc, shard.mapper_service)
+        if value is not None:
+            if value in seen:
+                continue
+            seen.add(value)
+        out.append((shard_idx, hit))
+        values.append(value)
+    return out, field, values

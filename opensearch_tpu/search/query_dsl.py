@@ -36,6 +36,15 @@ class MatchNoneQuery(QueryNode):
 
 
 @dataclass
+class SliceQuery(QueryNode):
+    """Sliced scroll partition (search/slice/SliceBuilder.java): doc belongs
+    to slice `id` of `max` iff murmur3(_id) % max == id."""
+
+    id: int = 0
+    max: int = 1
+
+
+@dataclass
 class MatchQuery(QueryNode):
     field: str = ""
     query: str = ""

@@ -91,9 +91,12 @@ def reduce_hits(
     from_: int,
     sort: list | None,
     track_total: Any,
+    collapse_field: str | None = None,
 ) -> dict:
     """Merge per-node hit lists. Each partial is a full search response
-    whose hits carry `_tb` = [shard, segment, doc]."""
+    whose hits carry `_tb` = [shard, segment, doc]. With `collapse_field`,
+    per-node collapsed hits are re-collapsed across nodes (first-per-group
+    survives both levels)."""
     from opensearch_tpu.search.service import _values_key
 
     rows: list[tuple[Any, dict]] = []
@@ -116,6 +119,18 @@ def reduce_hits(
                 key = (-score, *tb)
             rows.append((key, hit))
     rows.sort(key=lambda r: r[0])
+    if collapse_field is not None:
+        seen: set = set()
+        deduped = []
+        for key, hit in rows:
+            value = (hit.get("fields") or {}).get(collapse_field, [None])[0]
+            if value is not None:
+                hv = tuple(value) if isinstance(value, list) else value
+                if hv in seen:
+                    continue
+                seen.add(hv)
+            deduped.append((key, hit))
+        rows = deduped
     page = []
     for _key, hit in rows[from_: from_ + size]:
         hit = dict(hit)
@@ -552,11 +567,16 @@ def reduce_search_responses(
         "_shards": {
             "total": shards_total,
             "successful": shards_ok,
-            "skipped": 0,
+            "skipped": sum(
+                (p.get("_shards") or {}).get("skipped", 0) for p in partials
+            ),
             "failed": shards_total - shards_ok,
         },
-        "hits": reduce_hits(partials, size=size, from_=from_, sort=sort,
-                            track_total=track_total),
+        "hits": reduce_hits(
+            partials, size=size, from_=from_, sort=sort,
+            track_total=track_total,
+            collapse_field=(body.get("collapse") or {}).get("field"),
+        ),
     }
     aggs_body = body.get("aggs") or body.get("aggregations")
     if aggs_body:

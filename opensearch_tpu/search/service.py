@@ -28,6 +28,7 @@ from opensearch_tpu.search.aggs import compute_aggs
 from opensearch_tpu.search.executor import (
     SegmentExecutor,
     ShardContext,
+    ShardQueryResult,
     _sort_key_fn,
     _sort_spec,
     _StrKey,
@@ -65,12 +66,27 @@ def search(
         "track_total_hits", "min_score", "search_after", "timeout", "version",
         "seq_no_primary_term", "stored_fields", "explain", "highlight",
         "docvalue_fields", "fields", "script_fields", "suggest", "profile",
+        "rescore", "collapse", "slice",
     }
     unknown = set(body) - known_keys
     if unknown:
         raise ParsingException(f"unknown search request keys {sorted(unknown)}")
 
     node = query_dsl.parse_query(body.get("query"))
+    if body.get("slice") is not None:
+        # sliced scroll: partition the doc space by murmur3(_id) % max
+        # (search/slice/SliceBuilder.java)
+        sl = body["slice"]
+        sl_max = int(sl.get("max", 1))
+        sl_id = int(sl.get("id", 0))
+        if not 0 <= sl_id < sl_max:
+            raise ParsingException(
+                f"[slice.id] must be in [0, {sl_max}) but was {sl_id}"
+            )
+        node = query_dsl.BoolQuery(
+            must=[node],
+            filter=[query_dsl.SliceQuery(id=sl_id, max=sl_max)],
+        )
     size = int(body.get("size", DEFAULT_SIZE))
     from_ = int(body.get("from", 0))
     sort = body.get("sort")
@@ -107,8 +123,16 @@ def search(
 
     want_profile = bool(body.get("profile"))
     shard_query_ns: list[int] = []
+    skipped_shards = 0
 
     fetch_k = from_ + size
+    if body.get("rescore") is not None:
+        # the query phase must collect the full rescore window
+        stages = body["rescore"]
+        stages = stages if isinstance(stages, list) else [stages]
+        for stage in stages:
+            if isinstance(stage, dict):
+                fetch_k = max(fetch_k, int(stage.get("window_size", 10)))
     if isinstance(node, query_dsl.HybridQuery):
         # hybrid query phase: one pass per sub-query, then the phase-results
         # processor fuses scores GLOBALLY across shards before fetch (the
@@ -169,6 +193,30 @@ def search(
                 if task is not None:
                     task.ensure_not_cancelled()
                 snapshot = acquired[shard_i] if acquired is not None else shard.acquire_searcher()
+                # can_match pre-filter (CanMatchPreFilterSearchPhase): skip
+                # shards whose segment min/max PROVE no doc matches
+                from opensearch_tpu.search import phases
+
+                if not phases.can_match(
+                    snapshot, shard.mapper_service, _shard_node(node, shard_i)
+                ):
+                    n_segs = len(snapshot.segments)
+                    result = ShardQueryResult(
+                        hits=[], total=0, max_score=None,
+                        masks=[
+                            np.zeros(h.n_docs, bool)
+                            for h, _d in snapshot.segments
+                        ] if aggs_body is not None else [],
+                        score_arrays=[
+                            np.zeros(h.n_docs, np.float32)
+                            for h, _d in snapshot.segments
+                        ] if aggs_body is not None else [],
+                    )
+                    skipped_shards += 1
+                    if want_profile:
+                        shard_query_ns.append(0)
+                    per_shard_results.append((shard, snapshot, result))
+                    continue
                 t_q = time.perf_counter_ns()
                 result = execute_query_phase(
                     snapshot,
@@ -207,6 +255,23 @@ def search(
             merged = [
                 sh for sh in merged if _sort_values_key(sort, sh[1]) > cursor
             ]
+    collapse_values: list | None = None
+    collapse_field: str | None = None
+    if body.get("rescore") is not None or body.get("collapse") is not None:
+        from opensearch_tpu.search import phases
+
+        if body.get("rescore") is not None:
+            if sort:
+                raise ParsingException(
+                    "[rescore] cannot be used with a [sort]"
+                )
+            merged = phases.apply_rescore(
+                body["rescore"], merged, per_shard_results, shards
+            )
+        if body.get("collapse") is not None:
+            merged, collapse_field, collapse_values = phases.apply_collapse(
+                body["collapse"], merged, per_shard_results
+            )
     page = merged[from_ : from_ + size]
 
     # ---- fetch phase (only winning docs; sub-phase chain in fetch.py) ----
@@ -231,7 +296,7 @@ def search(
         ms_for_hl = _MultiMapperView([s.mapper_service for s in shards])
         preds_by_field = fetch.field_term_predicates(node, ms_for_hl)
     hits_json = []
-    for shard_idx, h in page:
+    for page_i, (shard_idx, h) in enumerate(page):
         shard, snapshot, _ = per_shard_results[shard_idx]
         host = snapshot.segments[h.segment][0]
         ms = shard.mapper_service
@@ -283,6 +348,9 @@ def search(
             if want_seqno:
                 hit["_seq_no"] = int(host.doc_seq_nos[h.doc])
                 hit["_primary_term"] = 1
+        if collapse_field is not None:
+            value = collapse_values[from_ + page_i]
+            hit.setdefault("fields", {})[collapse_field] = [value]
         if partial:
             gshard = (
                 shard_numbers[shard_idx] if shard_numbers is not None
@@ -311,7 +379,7 @@ def search(
         "_shards": {
             "total": len(shards),
             "successful": len(shards),
-            "skipped": 0,
+            "skipped": skipped_shards,
             "failed": 0,
         },
         "hits": hits_obj,
