@@ -18,7 +18,8 @@ full weight function with index-level balance is a later refinement).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import math
+from dataclasses import dataclass, field
 
 from opensearch_tpu.cluster.state import (
     ClusterState,
@@ -26,9 +27,50 @@ from opensearch_tpu.cluster.state import (
 )
 
 
+def _parse_pct(v, default: float) -> float:
+    if v is None:
+        return default
+    return float(str(v).rstrip("%"))
+
+
 @dataclass
 class AllocationSettings:
     max_concurrent_recoveries_per_node: int = 4
+    # DiskThresholdDecider: no NEW shard above low; shards DRAIN above high
+    disk_low_watermark_pct: float = 85.0
+    disk_high_watermark_pct: float = 90.0
+    # AwarenessAllocationDecider: spread copies across these node attrs
+    awareness_attributes: tuple[str, ...] = ()
+    # BalancedShardsAllocator: move replicas until spread <= threshold
+    rebalance_enabled: bool = True
+    rebalance_threshold: int = 1
+    # per-node observed disk usage pct (fs stats fed by heartbeats)
+    disk_usage: dict[str, float] = field(default_factory=dict)
+
+    @staticmethod
+    def from_cluster(state: ClusterState,
+                     disk_usage: dict[str, float] | None = None
+                     ) -> "AllocationSettings":
+        """Resolve from the dynamic cluster settings (transient over
+        persistent over default — ClusterSettings.java:205)."""
+        eff = {**state.settings, **state.transient_settings}
+        aw = eff.get("cluster.routing.allocation.awareness.attributes")
+        return AllocationSettings(
+            max_concurrent_recoveries_per_node=int(eff.get(
+                "cluster.routing.allocation.node_concurrent_recoveries", 4
+            )),
+            disk_low_watermark_pct=_parse_pct(eff.get(
+                "cluster.routing.allocation.disk.watermark.low"), 85.0),
+            disk_high_watermark_pct=_parse_pct(eff.get(
+                "cluster.routing.allocation.disk.watermark.high"), 90.0),
+            awareness_attributes=tuple(
+                a.strip() for a in str(aw).split(",") if a.strip()
+            ) if aw else (),
+            rebalance_enabled=str(eff.get(
+                "cluster.routing.rebalance.enable", "all"
+            )).lower() != "none",
+            disk_usage=dict(disk_usage or {}),
+        )
 
 
 def _decide(
@@ -59,6 +101,32 @@ def _decide(
         exclude = meta.settings.get("routing.allocation.exclude._name")
         if exclude is not None and node.name in str(exclude).split(","):
             return False
+    # DiskThresholdDecider (low watermark): no NEW shard on a filling node
+    usage = settings.disk_usage.get(node_id)
+    if usage is not None and usage >= settings.disk_low_watermark_pct:
+        return False
+    # AwarenessAllocationDecider: copies of one shard spread across the
+    # configured attribute's values (at most ceil(copies / n_values) per
+    # value)
+    for attr in settings.awareness_attributes:
+        values = {
+            n.attr_map.get(attr) for n in state.nodes.values()
+            if n.is_data and n.attr_map.get(attr) is not None
+        }
+        if len(values) < 2:
+            continue
+        my_value = node.attr_map.get(attr)
+        same_value = sum(
+            1 for r in assignments
+            if r.index == entry.index and r.shard == entry.shard
+            and r.node_id is not None and r.state != "UNASSIGNED"
+            and state.nodes.get(r.node_id) is not None
+            and state.nodes[r.node_id].attr_map.get(attr) == my_value
+        )
+        meta = state.indices.get(entry.index)
+        copies = 1 + (meta.num_replicas if meta else 0)
+        if same_value + 1 > math.ceil(copies / len(values)):
+            return False
     # ThrottlingAllocationDecider: cap INITIALIZING shards per node
     initializing = sum(
         1 for r in assignments
@@ -75,6 +143,19 @@ def reroute(state: ClusterState, settings: AllocationSettings | None = None) -> 
     settings = settings or AllocationSettings()
     new_routing: list[ShardRoutingEntry] = []
     data_nodes = [n.node_id for n in state.nodes.values() if n.is_data]
+    # DiskThresholdDecider high watermark: REPLICAS on nodes above high
+    # drain away (drop the assignment; the allocator below re-places them
+    # on nodes the deciders approve). Primaries stay put — moving the only
+    # authoritative copy on a full disk trades availability for space.
+    drain = {
+        nid for nid, pct in settings.disk_usage.items()
+        if pct >= settings.disk_high_watermark_pct
+    }
+    if drain:
+        state = state.with_(routing=tuple(
+            r for r in state.routing
+            if not (not r.primary and r.node_id in drain)
+        ))
 
     def node_load(node_id: str) -> int:
         return sum(1 for r in new_routing if r.node_id == node_id)
@@ -147,7 +228,61 @@ def reroute(state: ClusterState, settings: AllocationSettings | None = None) -> 
                 else:
                     new_routing.append(entry)  # UNASSIGNED
 
+    if settings.rebalance_enabled:
+        new_routing = _rebalance(state, new_routing, data_nodes, settings)
     return state.with_(routing=tuple(new_routing))
+
+
+def _rebalance(state: ClusterState, routing: list[ShardRoutingEntry],
+               data_nodes: list[str],
+               settings: AllocationSettings) -> list[ShardRoutingEntry]:
+    """BalancedShardsAllocator's rebalance pass, reduced to the shard-count
+    weight: move ONE started replica per round from the most- to the
+    least-loaded node when the spread exceeds the threshold; successive
+    publications (each shard-started triggers one) converge the layout."""
+    if len(data_nodes) < 2:
+        return routing
+
+    def load(nid: str) -> int:
+        return sum(1 for r in routing if r.node_id == nid)
+
+    by_load = sorted(data_nodes, key=lambda nid: (load(nid), nid))
+    light, heavy = by_load[0], by_load[-1]
+    if load(heavy) - load(light) <= settings.rebalance_threshold:
+        return routing
+    for i, r in enumerate(routing):
+        if (r.node_id == heavy and not r.primary and r.state == "STARTED"
+                and _decide(state, r, light,
+                            [x for j, x in enumerate(routing) if j != i],
+                            settings)):
+            routing = list(routing)
+            routing[i] = ShardRoutingEntry(
+                r.index, r.shard, light, primary=False, state="INITIALIZING"
+            )
+            return routing
+    # no movable replica on the heavy node (all primaries): swap the
+    # primary ROLE with a started replica on a lighter node (flag-only —
+    # both copies hold the data and stay STARTED), which turns the heavy
+    # node's copy into a replica a later round CAN move
+    for i, r in enumerate(routing):
+        if not (r.node_id == heavy and r.primary and r.state == "STARTED"):
+            continue
+        for j, other in enumerate(routing):
+            if (other.index == r.index and other.shard == r.shard
+                    and not other.primary and other.state == "STARTED"
+                    and other.node_id is not None
+                    and load(other.node_id) < load(heavy)):
+                routing = list(routing)
+                routing[i] = ShardRoutingEntry(
+                    r.index, r.shard, r.node_id, primary=False,
+                    state="STARTED",
+                )
+                routing[j] = ShardRoutingEntry(
+                    other.index, other.shard, other.node_id, primary=True,
+                    state="STARTED",
+                )
+                return routing
+    return routing
 
 
 def mark_shard_started(

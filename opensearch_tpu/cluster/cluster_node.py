@@ -69,14 +69,45 @@ class ClusterNode:
         self.transport = transport
         self.scheduler = scheduler
         self.node = DiscoveryNode(node_id=node_id, name=node_id, roles=roles)
+        # fs stats feeding the disk-threshold decider; tests override
+        # disk_usage_pct directly (the FsHealthService probe analog)
+        self.disk_usage_pct: float | None = None
+        self._node_disk: dict[str, float] = {}
+        from opensearch_tpu.cluster.allocation import AllocationSettings
+
+        def transform(state: ClusterState) -> ClusterState:
+            disk = dict(self._node_disk)
+            own = self._disk_usage()
+            if own is not None:
+                disk[node_id] = own
+            return reroute(state, AllocationSettings.from_cluster(state, disk))
+
         self.coordinator = Coordinator(
             self.node, peers, transport, scheduler,
             persisted=persisted,
             on_state_applied=self._apply_cluster_state,
             # every publication passes through allocation: node joins/leaves
-            # re-assign shards, promote replicas, fill replica slots
-            state_transform=reroute,
+            # re-assign shards, promote replicas, fill replica slots;
+            # allocation settings resolve from the DYNAMIC cluster settings
+            # in the state being published
+            state_transform=transform,
         )
+        self.coordinator.check_extras = lambda: {
+            "disk_used_pct": self._disk_usage()
+        }
+
+        def on_extras(peer: str, extras: dict) -> None:
+            pct = extras.get("disk_used_pct")
+            if pct is not None:
+                self._node_disk[peer] = float(pct)
+
+        self.coordinator.on_follower_extras = on_extras
+        # addSettingsUpdateConsumer registry, notified at state application
+        from opensearch_tpu.cluster.cluster_settings import (
+            SettingsUpdateConsumers,
+        )
+
+        self.settings_consumers = SettingsUpdateConsumers()
         self.local_shards: dict[tuple[str, int], IndexShard] = {}
         self._mapper_services: dict[str, MapperService] = {}
         self._index_versions: dict[str, int] = {}
@@ -88,6 +119,7 @@ class ClusterNode:
 
         reg = transport.register
         reg(node_id, "cluster:admin/create_index", self._on_create_index)
+        reg(node_id, "cluster:admin/settings/update", self._on_update_settings)
         reg(node_id, "cluster:admin/delete_index", self._on_delete_index)
         reg(node_id, "cluster:admin/put_mapping", self._on_put_mapping)
         reg(node_id, "internal:cluster/shard_started", self._on_shard_started)
@@ -154,6 +186,11 @@ class ClusterNode:
         return ms
 
     def _apply_cluster_state(self, state: ClusterState) -> None:
+        from opensearch_tpu.cluster.cluster_settings import effective
+
+        self.settings_consumers.apply(
+            effective(state.settings, state.transient_settings)
+        )
         my_shards = {
             (r.index, r.shard): r for r in state.shards_for_node(self.node_id)
         }
@@ -477,6 +514,47 @@ class ClusterNode:
             on_response=callback,
             on_failure=lambda e: callback({"error": str(e)}),
         )
+
+    def _disk_usage(self) -> float | None:
+        if self.disk_usage_pct is not None:
+            return self.disk_usage_pct
+        try:
+            import shutil
+
+            du = shutil.disk_usage(self.data_path)
+            return 100.0 * (du.total - du.free) / du.total
+        except OSError:
+            return None
+
+    def _on_update_settings(self, sender: str, payload: dict) -> dict:
+        """PUT /_cluster/settings routed to the leader: validate, then a
+        cluster-state task merges persistent/transient (null deletes) —
+        the two-phase apply of ClusterSettings.java:205."""
+        if not self.is_leader:
+            raise OpenSearchTpuException("not the leader")
+        from opensearch_tpu.cluster.cluster_settings import (
+            flatten,
+            merge,
+            validate_settings,
+        )
+
+        persistent = flatten(payload.get("persistent") or {})
+        transient = flatten(payload.get("transient") or {})
+        validate_settings(persistent)
+        validate_settings(transient)
+
+        def task(state: ClusterState) -> ClusterState:
+            return state.with_(
+                settings=merge(state.settings, persistent),
+                transient_settings=merge(state.transient_settings, transient),
+            )
+
+        self.coordinator.submit_state_update(task)
+        return {
+            "acknowledged": True,
+            "persistent": persistent,
+            "transient": transient,
+        }
 
     def _on_create_index(self, sender: str, payload: dict) -> dict:
         if not self.is_leader:

@@ -1,0 +1,114 @@
+"""Dynamic cluster settings registry (ClusterSettings.java:205).
+
+The two-phase persistent/transient model: `PUT /_cluster/settings` carries
+{"persistent": {...}, "transient": {...}}; values validate BEFORE the
+cluster-state task applies them; null deletes a key. Effective value =
+transient over persistent over default. Persistent settings ride the
+durable cluster state (gateway) and survive full-cluster restart;
+transient settings are stripped at recovery.
+
+Update consumers (ClusterSettings.addSettingsUpdateConsumer): components
+register a callback per key prefix; every state application diffs the
+effective settings and notifies the consumers whose keys changed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from opensearch_tpu.common.errors import IllegalArgumentException
+
+
+def _validate_pct(v: Any) -> None:
+    pct = float(str(v).rstrip("%"))
+    if not 0 <= pct <= 100:
+        raise IllegalArgumentException(f"watermark [{v}] must be 0-100%")
+
+
+def _validate_pos_int(v: Any) -> None:
+    if int(v) < 1:
+        raise IllegalArgumentException(f"[{v}] must be >= 1")
+
+
+def _validate_enable(v: Any) -> None:
+    if str(v).lower() not in ("all", "none", "primaries", "replicas"):
+        raise IllegalArgumentException(
+            f"[{v}] must be one of [all, none, primaries, replicas]"
+        )
+
+
+# registered dynamic cluster settings: key -> validator (None = any value)
+DYNAMIC_CLUSTER_SETTINGS: dict[str, Callable[[Any], None] | None] = {
+    "cluster.routing.allocation.node_concurrent_recoveries": _validate_pos_int,
+    "cluster.routing.allocation.disk.watermark.low": _validate_pct,
+    "cluster.routing.allocation.disk.watermark.high": _validate_pct,
+    "cluster.routing.allocation.awareness.attributes": None,
+    "cluster.routing.allocation.enable": _validate_enable,
+    "cluster.routing.rebalance.enable": _validate_enable,
+    "search.max_buckets": _validate_pos_int,
+    "action.auto_create_index": None,
+    "cluster.blocks.read_only": None,
+}
+
+
+def validate_settings(flat: dict[str, Any]) -> None:
+    for key, value in flat.items():
+        validator = DYNAMIC_CLUSTER_SETTINGS.get(key, "__missing__")
+        if validator == "__missing__":
+            raise IllegalArgumentException(
+                f"unknown cluster setting [{key}] — not registered as a "
+                f"dynamic setting"
+            )
+        if validator is not None and value is not None:
+            validator(value)
+
+
+def flatten(obj: dict, prefix: str = "") -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for k, v in (obj or {}).items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(flatten(v, f"{key}."))
+        else:
+            out[key] = v
+    return out
+
+
+def merge(current: dict, updates: dict) -> dict:
+    """Apply a flat update map: null values delete keys."""
+    out = dict(current)
+    for k, v in updates.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = v
+    return out
+
+
+def effective(persistent: dict, transient: dict) -> dict:
+    return {**persistent, **transient}
+
+
+class SettingsUpdateConsumers:
+    """addSettingsUpdateConsumer registry: notified on effective-value
+    changes at state application."""
+
+    def __init__(self) -> None:
+        self._consumers: list[tuple[str, Callable[[dict], None]]] = []
+        self._last: dict[str, Any] = {}
+
+    def register(self, key_prefix: str,
+                 consumer: Callable[[dict], None]) -> None:
+        self._consumers.append((key_prefix, consumer))
+
+    def apply(self, eff: dict) -> None:
+        changed = {
+            k for k in set(eff) | set(self._last)
+            if eff.get(k) != self._last.get(k)
+        }
+        if not changed:
+            return
+        self._last = dict(eff)
+        for prefix, consumer in self._consumers:
+            if any(k.startswith(prefix) for k in changed):
+                consumer(eff)

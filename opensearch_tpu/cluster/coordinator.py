@@ -86,6 +86,11 @@ class Coordinator:
         self._leader_check_failures = 0
         self._follower_failures: dict[str, int] = {}
         self._catchup_inflight: set[str] = set()
+        # node-stats piggyback on the check channel (FsHealthService /
+        # monitor feeding allocation): followers attach check_extras() to
+        # their acks; the leader consumes via on_follower_extras
+        self.check_extras: Callable[[], dict] | None = None
+        self.on_follower_extras: Callable[[str, dict], None] | None = None
         self._pending_tasks: list[Callable[[ClusterState], ClusterState]] = []
         self._publishing = False
         self._publication_seq = 0
@@ -511,6 +516,8 @@ class Coordinator:
         def handle(resp: dict) -> None:
             if resp.get("ack"):
                 self._follower_failures[peer] = 0
+                if self.on_follower_extras is not None and "extras" in resp:
+                    self.on_follower_extras(peer, resp["extras"])
                 # lag repair (LagDetector + publication fallback): a
                 # follower that acked but has not applied our committed
                 # version (e.g. a wiped node that rejoined while still in
@@ -622,8 +629,14 @@ class Coordinator:
             # a stale follower still checks us as its leader — reject so it
             # goes looking for the real one
             return {"ack": False, "term": self.coord.current_term}
-        return {"ack": True, "term": self.coord.current_term,
-                "applied_version": self.applied_state.version}
+        out = {"ack": True, "term": self.coord.current_term,
+               "applied_version": self.applied_state.version}
+        if self.check_extras is not None:
+            try:
+                out["extras"] = self.check_extras()
+            except Exception:  # noqa: BLE001 - stats must not fail checks
+                pass
+        return out
 
     def _schedule_leader_check(self) -> None:
         self._leader_check_timer = self.scheduler.schedule(

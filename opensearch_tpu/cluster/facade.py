@@ -688,6 +688,15 @@ class ClusterFacade:
     def cluster_health(self) -> dict:
         return self.node.cluster_health()
 
+    def put_cluster_settings(self, body: dict) -> dict:
+        return self._rpc(self._leader(), "cluster:admin/settings/update",
+                         body or {})
+
+    def get_cluster_settings(self) -> dict:
+        state = self.state
+        return {"persistent": dict(state.settings),
+                "transient": dict(state.transient_settings)}
+
     def _all_shard_stats(self) -> dict[str, dict]:
         nodes = sorted(self.state.nodes)
         results = self._rpc_many([

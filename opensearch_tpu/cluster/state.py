@@ -24,6 +24,9 @@ class DiscoveryNode:
     name: str = ""
     address: str = ""
     roles: tuple[str, ...] = ("cluster_manager", "data")
+    # node attributes for awareness allocation (node.attr.* in the
+    # reference, e.g. {"zone": "us-east-1a"})
+    attrs: tuple = ()
 
     @property
     def is_cluster_manager_eligible(self) -> bool:
@@ -33,14 +36,20 @@ class DiscoveryNode:
     def is_data(self) -> bool:
         return "data" in self.roles
 
+    @property
+    def attr_map(self) -> dict:
+        return dict(self.attrs)
+
     def to_dict(self) -> dict:
         return {"node_id": self.node_id, "name": self.name,
-                "address": self.address, "roles": list(self.roles)}
+                "address": self.address, "roles": list(self.roles),
+                "attrs": [list(kv) for kv in self.attrs]}
 
     @staticmethod
     def from_dict(d: dict) -> "DiscoveryNode":
         return DiscoveryNode(d["node_id"], d.get("name", ""), d.get("address", ""),
-                             tuple(d.get("roles", ("cluster_manager", "data"))))
+                             tuple(d.get("roles", ("cluster_manager", "data"))),
+                             tuple(tuple(kv) for kv in d.get("attrs", [])))
 
 
 @dataclass(frozen=True)
@@ -114,6 +123,12 @@ class ClusterState:
     routing: tuple[ShardRoutingEntry, ...] = ()
     last_committed_config: VotingConfiguration = field(default_factory=VotingConfiguration)
     last_accepted_config: VotingConfiguration = field(default_factory=VotingConfiguration)
+    # dynamic cluster settings (ClusterSettings.java:205): persistent
+    # survives full-cluster restart; transient is dropped on restart
+    # (stripped by the gateway at recovery). Effective = transient over
+    # persistent over default.
+    settings: dict = field(default_factory=dict)
+    transient_settings: dict = field(default_factory=dict)
 
     # -- builders ---------------------------------------------------------
 
@@ -150,6 +165,8 @@ class ClusterState:
             "routing": [r.to_dict() for r in self.routing],
             "last_committed_config": self.last_committed_config.to_dict(),
             "last_accepted_config": self.last_accepted_config.to_dict(),
+            "settings": self.settings,
+            "transient_settings": self.transient_settings,
         }
 
     @staticmethod
@@ -164,6 +181,8 @@ class ClusterState:
             routing=tuple(ShardRoutingEntry.from_dict(r) for r in d["routing"]),
             last_committed_config=VotingConfiguration(frozenset(d["last_committed_config"])),
             last_accepted_config=VotingConfiguration(frozenset(d["last_accepted_config"])),
+            settings=d.get("settings", {}),
+            transient_settings=d.get("transient_settings", {}),
         )
 
 
@@ -190,6 +209,10 @@ def diff_states(prev: ClusterState, new: ClusterState) -> dict:
     d["indices_removed"] = [n for n in prev.indices if n not in new.indices]
     if new.routing != prev.routing:
         d["routing"] = [r.to_dict() for r in new.routing]
+    if new.settings != prev.settings:
+        d["settings"] = new.settings
+    if new.transient_settings != prev.transient_settings:
+        d["transient_settings"] = new.transient_settings
     return d
 
 
@@ -223,4 +246,6 @@ def apply_diff(prev: ClusterState, diff: dict) -> ClusterState:
         routing=routing,
         last_committed_config=VotingConfiguration(frozenset(diff["last_committed_config"])),
         last_accepted_config=VotingConfiguration(frozenset(diff["last_accepted_config"])),
+        settings=diff.get("settings", prev.settings),
+        transient_settings=diff.get("transient_settings", prev.transient_settings),
     )

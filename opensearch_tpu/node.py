@@ -1694,6 +1694,48 @@ class TpuNode:
 
     # -- cluster/stats APIs ------------------------------------------------
 
+    def put_cluster_settings(self, body: dict) -> dict:
+        """Single-node /_cluster/settings: same validation + persistent/
+        transient model, persisted to disk (persistent only)."""
+        from opensearch_tpu.cluster.cluster_settings import (
+            flatten,
+            merge,
+            validate_settings,
+        )
+
+        persistent = flatten((body or {}).get("persistent") or {})
+        transient = flatten((body or {}).get("transient") or {})
+        validate_settings(persistent)
+        validate_settings(transient)
+        self._cluster_settings = merge(
+            getattr(self, "_cluster_settings", {}), persistent
+        )
+        self._transient_cluster_settings = merge(
+            getattr(self, "_transient_cluster_settings", {}), transient
+        )
+        import json as _json
+
+        (self.data_path / "cluster_settings.json").write_text(
+            _json.dumps(self._cluster_settings)
+        )
+        return {"acknowledged": True, "persistent": persistent,
+                "transient": transient}
+
+    def get_cluster_settings(self) -> dict:
+        import json as _json
+
+        if not hasattr(self, "_cluster_settings"):
+            path = self.data_path / "cluster_settings.json"
+            self._cluster_settings = (
+                _json.loads(path.read_text()) if path.exists() else {}
+            )
+        return {
+            "persistent": dict(self._cluster_settings),
+            "transient": dict(
+                getattr(self, "_transient_cluster_settings", {})
+            ),
+        }
+
     def cluster_health(self) -> dict:
         total_shards = sum(svc.num_shards for svc in self.indices.values())
         return {

@@ -158,6 +158,8 @@ def build_router() -> Router:
     reg("POST", "/_tasks/{task_id}/_cancel", cancel_task)
     # cluster / stats
     reg("GET", "/_cluster/health", cluster_health)
+    reg("GET", "/_cluster/settings", get_cluster_settings)
+    reg("PUT", "/_cluster/settings", put_cluster_settings)
     reg("GET", "/_cluster/stats", cluster_stats)
     reg("GET", "/_stats", all_stats)
     reg("GET", "/{index}/_stats", index_stats)
@@ -741,6 +743,14 @@ def forcemerge(node: TpuNode, params, query, body):
 
 def cluster_health(node: TpuNode, params, query, body):
     return 200, node.cluster_health()
+
+
+def get_cluster_settings(node: TpuNode, params, query, body):
+    return 200, node.get_cluster_settings()
+
+
+def put_cluster_settings(node: TpuNode, params, query, body):
+    return 200, node.put_cluster_settings(body or {})
 
 
 def cluster_stats(node: TpuNode, params, query, body):

@@ -70,11 +70,15 @@ class ClusterServer:
 
         self.gateway = GatewayStore(Path(data_path) / "_state")
         recovered = self.gateway.load()
-        persisted = (
-            PersistedState(recovered[0], recovered[1], store=self.gateway)
-            if recovered is not None
-            else PersistedState(store=self.gateway)
-        )
+        if recovered is not None:
+            # transient cluster settings do NOT survive a restart (the
+            # persistent/transient contract of ClusterSettings.java:205)
+            term, state = recovered
+            persisted = PersistedState(
+                term, state.with_(transient_settings={}), store=self.gateway
+            )
+        else:
+            persisted = PersistedState(store=self.gateway)
         self.node = ClusterNode(
             node_id, data_path, self.transport, self.scheduler,
             peers=[p for p in seeds if p != node_id], roles=roles,
