@@ -46,8 +46,14 @@ DYNAMIC_CLUSTER_SETTINGS: dict[str, Callable[[Any], None] | None] = {
     "cluster.routing.allocation.enable": _validate_enable,
     "cluster.routing.rebalance.enable": _validate_enable,
     "search.max_buckets": _validate_pos_int,
+    "search.max_keep_alive": None,
+    "search.default_keep_alive": None,
+    "search.default_search_timeout": None,
+    "cluster.max_shards_per_node": _validate_pos_int,
     "action.auto_create_index": None,
+    "action.destructive_requires_name": None,
     "cluster.blocks.read_only": None,
+    "indices.recovery.max_bytes_per_sec": None,
 }
 
 

@@ -63,6 +63,7 @@ def routing_hash(routing: str) -> int:
     return murmur3_x86_32(routing.encode("utf-16-le"), 0)
 
 
-def shard_id_for_routing(routing: str, num_shards: int) -> int:
+def shard_id_for_routing(routing, num_shards: int) -> int:
     """OperationRouting: floorMod(hash(routing), num_shards)."""
-    return routing_hash(routing) % num_shards
+    # numeric routing values arrive as ints via JSON
+    return routing_hash(str(routing)) % num_shards

@@ -1118,7 +1118,22 @@ class TpuNode:
             index = meta.get("_index")
             doc_id = meta.get("_id")
             routing = meta.get("routing") or meta.get("_routing")
+            if routing is not None:
+                routing = str(routing)
             try:
+                if doc_id == "":
+                    raise IllegalArgumentException(
+                        "if _id is specified it must not be empty"
+                    )
+                if meta.get("require_alias") in (True, "true") and \
+                        index not in self._alias_map():
+                    from opensearch_tpu.common.errors import (
+                        IndexNotFoundException,
+                    )
+
+                    raise IndexNotFoundException(
+                        f"[{index}] is not an alias and require_alias is set"
+                    )
                 if action in ("index", "create"):
                     resp = self.index_doc(index, doc_id, source, routing,
                                           op_type=action,
@@ -1693,6 +1708,29 @@ class TpuNode:
         }
 
     # -- cluster/stats APIs ------------------------------------------------
+
+    def put_index_settings(self, index_expr: str, body: dict) -> dict:
+        """PUT /{index}/_settings: merge DYNAMIC index settings (the
+        IndexScopedSettings update path). Static settings
+        (number_of_shards) reject on open indices like the reference."""
+        settings = body.get("settings", body) or {}
+        flat = Settings.from_nested(settings).as_dict()
+        norm = {}
+        for k, v in flat.items():
+            norm[k[len("index."):] if k.startswith("index.") else k] = v
+        if "number_of_shards" in norm:
+            raise IllegalArgumentException(
+                "final index setting [index.number_of_shards], not updateable"
+            )
+        for name in self.resolve_indices(index_expr):
+            svc = self._get_index(name)
+            nested = Settings.from_flat(norm).as_nested()
+            svc.settings = _deep_merge(svc.settings, nested)
+            if "number_of_replicas" in norm:
+                svc.num_replicas = int(norm["number_of_replicas"])
+        self._persist_index_registry()
+        self._configure_slowlogs()
+        return {"acknowledged": True}
 
     def put_cluster_settings(self, body: dict) -> dict:
         """Single-node /_cluster/settings: same validation + persistent/
