@@ -31,6 +31,7 @@ import numpy as np
 from opensearch_tpu.common.errors import IllegalArgumentException
 from opensearch_tpu.index.mapper import (
     INT_TYPES,
+    RANGE_TYPES,
     MapperService,
     ParsedDocument,
 )
@@ -89,12 +90,41 @@ class HostTextField:
     doc_len: np.ndarray              # float32 [n_docs] (0 = field absent)
     total_terms: float               # sum(doc_len) — feeds shard-level avgdl
     docs_with_field: int
+    # position postings: for postings entry p (one (term, doc) pair),
+    # positions[pos_offsets[p]:pos_offsets[p+1]] are that term's token
+    # positions in that doc, ascending (Lucene .prx analog; host-side —
+    # phrase/interval verification is candidate-bounded host work)
+    pos_offsets: np.ndarray = None   # int64 [P+1]
+    positions: np.ndarray = None     # int32 [Q]
+
+    def __post_init__(self) -> None:
+        if self.pos_offsets is None:
+            self.pos_offsets = np.zeros(len(self.postings_docs) + 1, np.int64)
+        if self.positions is None:
+            self.positions = np.zeros(0, np.int32)
 
     def doc_freq(self, term: str) -> int:
         tid = self.term_dict.get(term)
         if tid is None:
             return 0
         return int(self.term_offsets[tid + 1] - self.term_offsets[tid])
+
+    def term_positions(self, term: str, doc: int) -> np.ndarray:
+        """Token positions of `term` in local doc `doc` (empty if absent or
+        the segment predates position postings)."""
+        tid = self.term_dict.get(term)
+        if tid is None or self.positions.size == 0:
+            return np.zeros(0, np.int32)
+        off = int(self.term_offsets[tid])
+        end = int(self.term_offsets[tid + 1])
+        p = off + int(np.searchsorted(self.postings_docs[off:end], doc))
+        if p >= end or self.postings_docs[p] != doc:
+            return np.zeros(0, np.int32)
+        return self.positions[int(self.pos_offsets[p]): int(self.pos_offsets[p + 1])]
+
+    @property
+    def has_positions(self) -> bool:
+        return self.positions.size > 0
 
 
 @dataclass
@@ -239,11 +269,12 @@ class SegmentBuilder:
                 tf = self._build_text(fname, n)
                 if tf is not None:
                     seg.text_fields[fname] = tf
-            elif mapper.type == "keyword":
+            elif mapper.type in ("keyword", "flat_object"):
                 kf = self._build_keyword(fname, n)
                 if kf is not None:
                     seg.keyword_fields[fname] = kf
-            elif mapper.type in ("date", "boolean") or mapper.type in INT_TYPES:
+            elif (mapper.type in ("date", "boolean", "token_count")
+                  or mapper.type in INT_TYPES):
                 nf = self._build_numeric(fname, n, "int")
                 if nf is not None:
                     seg.numeric_fields[fname] = nf
@@ -253,44 +284,61 @@ class SegmentBuilder:
                 )
                 if vf is not None:
                     seg.vector_fields[fname] = vf
+            elif mapper.type in ("alias", "geo_point", "percolator", "join") \
+                    or mapper.type in RANGE_TYPES:
+                continue  # no direct column (aliases resolve below)
             else:  # float family
                 nf = self._build_numeric(fname, n, "float")
                 if nf is not None:
                     seg.numeric_fields[fname] = nf
+        # field aliases share the target's columns by reference — queries,
+        # sorts, and aggs then address the alias with zero executor changes
+        for fname, mapper in mappers.items():
+            if mapper.type != "alias" or not mapper.path:
+                continue
+            for store in (seg.text_fields, seg.keyword_fields,
+                          seg.numeric_fields, seg.vector_fields):
+                if mapper.path in store:
+                    store[fname] = store[mapper.path]
         return seg
 
     def _build_text(self, fname: str, n: int) -> HostTextField | None:
-        # per-doc term frequency maps
-        doc_tfs: list[dict[str, int] | None] = []
+        # per-doc term -> position-list maps (tf = len(positions))
+        doc_pos: list[dict[str, list[int]] | None] = []
         any_field = False
         for doc in self.docs:
             pf = doc.fields.get(fname)
             if pf is None or pf.terms is None:
-                doc_tfs.append(None)
+                doc_pos.append(None)
                 continue
             any_field = True
-            tf: dict[str, int] = {}
-            for t in pf.terms:
-                tf[t] = tf.get(t, 0) + 1
-            doc_tfs.append(tf)
+            tp: dict[str, list[int]] = {}
+            poss = (pf.positions if pf.positions is not None
+                    and len(pf.positions) == len(pf.terms)
+                    else range(len(pf.terms)))
+            for t, p in zip(pf.terms, poss):
+                tp.setdefault(t, []).append(p)
+            doc_pos.append(tp)
         if not any_field:
             return None
-        terms = sorted({t for tf in doc_tfs if tf for t in tf})
+        terms = sorted({t for tp in doc_pos if tp for t in tp})
         term_dict = {t: i for i, t in enumerate(terms)}
         # postings sorted by (term_id, doc_id): walk terms, then docs in order
         per_term_docs: list[list[int]] = [[] for _ in terms]
         per_term_tfs: list[list[float]] = [[] for _ in terms]
+        per_term_pos: list[list[list[int]]] = [[] for _ in terms]
         doc_len = np.zeros(n, dtype=np.float32)
         docs_with_field = 0
-        for d, tf in enumerate(doc_tfs):
-            if tf is None:
+        for d, tp in enumerate(doc_pos):
+            if tp is None:
                 continue
             docs_with_field += 1
-            doc_len[d] = sum(tf.values())
-            for t, c in tf.items():
+            doc_len[d] = sum(len(p) for p in tp.values())
+            for t, plist in tp.items():
                 tid = term_dict[t]
                 per_term_docs[tid].append(d)
-                per_term_tfs[tid].append(float(c))
+                per_term_tfs[tid].append(float(len(plist)))
+                per_term_pos[tid].append(sorted(plist))
         offsets = np.zeros(len(terms) + 1, dtype=np.int64)
         for i, docs in enumerate(per_term_docs):
             offsets[i + 1] = offsets[i] + len(docs)
@@ -300,6 +348,14 @@ class SegmentBuilder:
         postings_tfs = np.concatenate(
             [np.asarray(t, dtype=np.float32) for t in per_term_tfs]
         ) if terms else np.zeros(0, np.float32)
+        flat_pos: list[int] = []
+        pos_offsets = np.zeros(len(postings_docs) + 1, np.int64)
+        p = 0
+        for plists in per_term_pos:
+            for plist in plists:
+                flat_pos.extend(plist)
+                pos_offsets[p + 1] = pos_offsets[p] + len(plist)
+                p += 1
         return HostTextField(
             terms=terms,
             term_dict=term_dict,
@@ -309,6 +365,8 @@ class SegmentBuilder:
             doc_len=doc_len,
             total_terms=float(doc_len.sum()),
             docs_with_field=docs_with_field,
+            pos_offsets=pos_offsets,
+            positions=np.asarray(flat_pos, np.int32),
         )
 
     def _build_keyword(self, fname: str, n: int) -> HostKeywordField | None:
@@ -428,8 +486,23 @@ def segment_payload(
         "keyword_fields": {},
         "numeric_fields": {},
         "vector_fields": {},
+        # alias columns (shared by reference, see SegmentBuilder.build) are
+        # serialized once under the canonical name; load re-links them
+        "field_links": {},
     }
+    seen_objs: dict[int, str] = {}
+
+    def _link(fname: str, obj: Any) -> bool:
+        canonical = seen_objs.get(id(obj))
+        if canonical is not None:
+            meta["field_links"][fname] = canonical
+            return True
+        seen_objs[id(obj)] = fname
+        return False
+
     for fname, tf in seg.text_fields.items():
+        if _link(fname, tf):
+            continue
         key = f"text:{fname}"
         arrays[f"{key}:offsets"] = tf.term_offsets
         # postings doc ids are stored zigzag-delta varint encoded (the
@@ -442,12 +515,16 @@ def segment_payload(
         )
         arrays[f"{key}:tfs"] = tf.postings_tfs
         arrays[f"{key}:doc_len"] = tf.doc_len
+        arrays[f"{key}:pos_offsets"] = tf.pos_offsets
+        arrays[f"{key}:positions"] = tf.positions
         meta["text_fields"][fname] = {
             "terms": tf.terms,
             "total_terms": tf.total_terms,
             "docs_with_field": tf.docs_with_field,
         }
     for fname, kf in seg.keyword_fields.items():
+        if _link(fname, kf):
+            continue
         key = f"kw:{fname}"
         arrays[f"{key}:first_ord"] = kf.first_ord
         arrays[f"{key}:mv_offsets"] = kf.mv_offsets
@@ -455,6 +532,8 @@ def segment_payload(
         arrays[f"{key}:mv_docs"] = kf.mv_docs
         meta["keyword_fields"][fname] = {"ord_values": kf.ord_values}
     for fname, nf in seg.numeric_fields.items():
+        if _link(fname, nf):
+            continue
         key = f"num:{fname}"
         arrays[f"{key}:values"] = (
             nf.values_i64 if nf.kind == "int" else nf.values_f64
@@ -462,6 +541,8 @@ def segment_payload(
         arrays[f"{key}:present"] = nf.present
         meta["numeric_fields"][fname] = {"kind": nf.kind}
     for fname, vf in seg.vector_fields.items():
+        if _link(fname, vf):
+            continue
         key = f"vec:{fname}"
         arrays[f"{key}:vectors"] = vf.vectors
         arrays[f"{key}:present"] = vf.present
@@ -532,6 +613,10 @@ def segment_from_payload(meta: dict, arrays, sources: list[bytes]) -> HostSegmen
             doc_len=arrays[f"{key}:doc_len"],
             total_terms=m["total_terms"],
             docs_with_field=m["docs_with_field"],
+            pos_offsets=(arrays[f"{key}:pos_offsets"]
+                         if f"{key}:pos_offsets" in arrays else None),
+            positions=(arrays[f"{key}:positions"]
+                       if f"{key}:positions" in arrays else None),
         )
     for fname, m in meta["keyword_fields"].items():
         key = f"kw:{fname}"
@@ -562,6 +647,13 @@ def segment_from_payload(meta: dict, arrays, sources: list[bytes]) -> HostSegmen
             similarity=m["similarity"],
             method=m.get("method"),
         )
+    # re-link alias columns (serialized once under the canonical name)
+    for fname, target in (meta.get("field_links") or {}).items():
+        for store in (seg.text_fields, seg.keyword_fields,
+                      seg.numeric_fields, seg.vector_fields):
+            if target in store:
+                store[fname] = store[target]
+                break
     return seg
 
 

@@ -436,6 +436,50 @@ class ShardContext:
 # --------------------------------------------------------------------------
 
 
+def _phrase_match(lists: list, slop: int, terms: list | None = None) -> bool:
+    """True iff one position per term can be chosen with total displacement
+    cost Σ|p_i - p_{i-1} - 1| ≤ slop (slop 0 = exact adjacency; adjacent
+    swaps cost 2, matching Lucene's sloppy-phrase distance). Repeated query
+    terms must land on distinct positions (SloppyPhraseScorer repeats)."""
+    if any(len(lst) == 0 for lst in lists):
+        return False
+    if terms is not None and len(set(terms)) < len(terms):
+        # exhaustive search with the distinct-position constraint for
+        # repeated terms; per-doc tf keeps the space tiny, but cap it
+        def rec(i: int, prev_p: int | None, cost: int,
+                used: dict[str, set], budget: list[int]) -> bool:
+            if budget[0] <= 0:
+                return False
+            if cost > slop:
+                return False
+            if i == len(lists):
+                return True
+            t = terms[i]
+            for p in lists[i]:
+                p = int(p)
+                if p in used.get(t, ()):
+                    continue
+                budget[0] -= 1
+                step = 0 if prev_p is None else abs(p - prev_p - 1)
+                used.setdefault(t, set()).add(p)
+                if rec(i + 1, p, cost + step, used, budget):
+                    return True
+                used[t].discard(p)
+            return False
+
+        return rec(0, None, 0, {}, [200_000])
+    prev = {int(p): 0 for p in lists[0]}
+    for lst in lists[1:]:
+        cur: dict[int, int] = {}
+        for p in lst:
+            p = int(p)
+            cur[p] = min(c + abs(p - pq - 1) for pq, c in prev.items())
+        prev = cur
+        if min(prev.values()) > slop:
+            return False  # costs only grow downstream
+    return min(prev.values()) <= slop
+
+
 @dataclass
 class NodeResult:
     scores: jnp.ndarray            # f32 [n_pad], 0 where not matching
@@ -529,6 +573,11 @@ class SegmentExecutor:
 
     def _exec_MatchQuery(self, node: q.MatchQuery) -> NodeResult:
         mapper = self.ctx.mapper_service.field_mapper(node.field)
+        if mapper is None and \
+                self.ctx.mapper_service.flat_object_parent(node.field):
+            return self._exec_TermQuery(
+                q.TermQuery(field=node.field, value=node.query, boost=node.boost)
+            )
         if mapper is not None and mapper.type != "text":
             # match on non-text behaves like a term query (no analysis)
             return self._exec_TermQuery(
@@ -553,18 +602,112 @@ class SegmentExecutor:
         return NodeResult(result.scores, result.mask & self.dev.live, True)
 
     def _exec_MatchPhraseQuery(self, node: q.MatchPhraseQuery) -> NodeResult:
-        # Position-less approximation: conjunction of all terms (real phrase
-        # matching needs position postings — planned; reference:
-        # MatchPhraseQueryBuilder -> Lucene PhraseQuery).
+        # Device conjunction narrows candidates; position postings
+        # (HostTextField positions CSR) verify adjacency host-side
+        # (MatchPhraseQueryBuilder -> Lucene PhraseQuery semantics).
         terms = self.ctx.mapper_service.analyze_query_text(node.field, node.query)
         if not terms:
             return _empty(self.dev)
         result, counts = self._bm25(node.field, terms, node.boost)
-        return NodeResult(result.scores, (counts >= len(terms)) & self.dev.live, True)
+        conj = (counts >= len(terms)) & self.dev.live
+        host_tf = self.host.text_fields.get(node.field)
+        if len(terms) <= 1 or host_tf is None or not host_tf.has_positions:
+            # single term, or a legacy segment without position postings:
+            # conjunction is the best available answer
+            return NodeResult(result.scores, conj, True)
+        cand = np.nonzero(np.asarray(conj)[: self.host.n_docs])[0]
+        verified = np.zeros(self.dev.n_pad, bool)
+        for d in cand:
+            lists = [host_tf.term_positions(t, int(d)) for t in terms]
+            if _phrase_match(lists, node.slop, terms):
+                verified[d] = True
+        mask = jnp.asarray(verified)
+        return NodeResult(jnp.where(mask, result.scores, 0.0), mask, True)
+
+    def _exec_IntervalsQuery(self, node: q.IntervalsQuery) -> NodeResult:
+        from opensearch_tpu.search import intervals as iv
+
+        host_tf = self.host.text_fields.get(node.field)
+        if host_tf is None or not host_tf.has_positions:
+            return _empty(self.dev)
+        ms = self.ctx.mapper_service
+
+        def analyze(text: str, analyzer: str | None) -> list[str]:
+            if analyzer:
+                return ms.analysis.get(analyzer).analyze(text)
+            return ms.analyze_query_text(node.field, text)
+
+        ctx = iv.IntervalContext(
+            analyze=analyze,
+            vocab=host_tf.terms,
+            positions=lambda t, d: host_tf.term_positions(t, d),
+            edit_distance_at_most=_edit_distance_at_most,
+            fuzziness_distance=_fuzziness_distance,
+        )
+        # candidate docs: union of posting lists of every involved term
+        cand: set[int] = set()
+        for t in ctx.leaf_terms(node.source):
+            tid = host_tf.term_dict.get(t)
+            if tid is None:
+                continue
+            off = int(host_tf.term_offsets[tid])
+            end = int(host_tf.term_offsets[tid + 1])
+            cand.update(int(d) for d in host_tf.postings_docs[off:end])
+        live = np.asarray(self.dev.live)
+        mask = np.zeros(self.dev.n_pad, bool)
+        for d in sorted(cand):
+            if live[d] and iv.evaluate(node.source, ctx, d):
+                mask[d] = True
+        return _const_result(jnp.asarray(mask), node.boost, scoring=True)
 
     def _exec_MultiMatchQuery(self, node: q.MultiMatchQuery) -> NodeResult:
+        msm = node.minimum_should_match
+
+        def fboost(f: str) -> float:
+            return node.boost * node.field_boosts.get(f, 1.0)
+
+        if node.type == "bool_prefix":
+            per_field = [
+                self._exec_MatchBoolPrefixQuery(q.MatchBoolPrefixQuery(
+                    field=f, query=node.query, operator=node.operator,
+                    minimum_should_match=msm, fuzziness=node.fuzziness,
+                    analyzer=node.analyzer, boost=fboost(f),
+                ))
+                for f in node.fields
+            ]
+        elif node.type == "phrase":
+            per_field = [
+                self._exec_MatchPhraseQuery(q.MatchPhraseQuery(
+                    field=f, query=node.query, slop=node.slop,
+                    boost=fboost(f)))
+                for f in node.fields
+            ]
+        elif node.type == "phrase_prefix":
+            per_field = [
+                self._exec_MatchPhrasePrefixQuery(q.MatchPhrasePrefixQuery(
+                    field=f, query=node.query, boost=fboost(f)))
+                for f in node.fields
+            ]
+        else:
+            per_field = None
+        if per_field is not None:
+            if not per_field:
+                return _empty(self.dev)
+            mask = per_field[0].mask
+            scores = per_field[0].scores
+            for s in per_field[1:]:
+                mask = mask | s.mask
+                scores = jnp.maximum(scores, s.scores)
+            return NodeResult(scores=scores, mask=mask, scoring=True)
         subs = [
-            self._exec_MatchQuery(q.MatchQuery(field=f, query=node.query, boost=node.boost))
+            self._exec_MatchQuery(q.MatchQuery(
+                field=f, query=node.query, boost=fboost(f),
+                operator=node.operator,
+                minimum_should_match=(
+                    int(msm) if isinstance(msm, int) or
+                    (isinstance(msm, str) and msm.lstrip("-").isdigit())
+                    else None),
+            ))
             for f in node.fields
         ]
         if not subs:
@@ -580,10 +723,31 @@ class SegmentExecutor:
                 scores = jnp.maximum(scores, s.scores)
         return NodeResult(scores=scores, mask=mask, scoring=True)
 
+    def _normalize_kw(self, field: str, value: str) -> str:
+        mapper = self.ctx.mapper_service.field_mapper(field)
+        if mapper is not None and mapper.normalizer == "lowercase":
+            return value.lower()
+        return value
+
     def _exec_TermQuery(self, node: q.TermQuery) -> NodeResult:
         field, value = node.field, node.value
         mapper = self.ctx.mapper_service.field_mapper(field)
+        if mapper is None:
+            # sub-path of a flat_object field -> term on the shared
+            # "{root}#paths" column with a "sub.path=value" entry
+            flat = self.ctx.mapper_service.flat_object_parent(field)
+            if flat is not None:
+                root, subpath = flat
+                return self._exec_TermQuery(q.TermQuery(
+                    field=f"{root}#paths", value=f"{subpath}={value}",
+                    boost=node.boost,
+                ))
         ftype = mapper.type if mapper else None
+        if ftype == "flat_object":
+            ftype = "keyword"
+        if mapper is not None and mapper.normalizer == "lowercase" \
+                and isinstance(value, str):
+            value = value.lower()
         if ftype == "text":
             result, _counts = self._bm25(field, [str(value)], node.boost)
             return NodeResult(result.scores, result.mask & self.dev.live, True)
@@ -613,13 +777,25 @@ class SegmentExecutor:
 
     def _exec_TermsQuery(self, node: q.TermsQuery) -> NodeResult:
         mapper = self.ctx.mapper_service.field_mapper(node.field)
+        if mapper is None:
+            flat = self.ctx.mapper_service.flat_object_parent(node.field)
+            if flat is not None:
+                root, subpath = flat
+                return self._exec_TermsQuery(q.TermsQuery(
+                    field=f"{root}#paths",
+                    values=[f"{subpath}={v}" for v in node.values],
+                    boost=node.boost,
+                ))
         ftype = mapper.type if mapper else None
-        if ftype == "keyword":
+        if ftype in ("keyword", "flat_object"):
             kf_dev = self.dev.keyword_fields.get(node.field)
             kf_host = self.host.keyword_fields.get(node.field)
             if kf_dev is None:
                 return _empty(self.dev)
-            ords = [kf_host.ord_dict.get(str(v), -3) for v in node.values]
+            ords = [
+                kf_host.ord_dict.get(self._normalize_kw(node.field, str(v)), -3)
+                for v in node.values
+            ]
             t_pad = max(pad_window(len(ords)), 8)
             ords_arr = np.full(t_pad, -3, np.int32)
             ords_arr[: len(ords)] = ords
@@ -877,7 +1053,8 @@ class SegmentExecutor:
         return _const_result(mask, boost, scoring=True)
 
     def _exec_PrefixQuery(self, node: q.PrefixQuery) -> NodeResult:
-        prefix = node.value.lower() if node.case_insensitive else node.value
+        prefix = self._normalize_kw(node.field, node.value)
+        prefix = prefix.lower() if node.case_insensitive else prefix
         if node.case_insensitive:
             return self._multi_term_result(
                 node.field, lambda t: t.lower().startswith(prefix), node.boost
@@ -887,7 +1064,9 @@ class SegmentExecutor:
         )
 
     def _exec_WildcardQuery(self, node: q.WildcardQuery) -> NodeResult:
-        rx = _wildcard_to_regex(node.value, node.case_insensitive)
+        rx = _wildcard_to_regex(
+            self._normalize_kw(node.field, node.value), node.case_insensitive
+        )
         return self._multi_term_result(
             node.field, lambda t: rx.match(t) is not None, node.boost
         )
@@ -946,15 +1125,37 @@ class SegmentExecutor:
         return NodeResult(jnp.where(mask, result.scores, 0.0), mask, True)
 
     def _exec_MatchBoolPrefixQuery(self, node: q.MatchBoolPrefixQuery) -> NodeResult:
-        terms = self.ctx.mapper_service.analyze_query_text(node.field, node.query)
+        if node.analyzer:
+            terms = self.ctx.mapper_service.analysis.get(node.analyzer).analyze(
+                node.query
+            )
+        else:
+            terms = self.ctx.mapper_service.analyze_query_text(node.field, node.query)
         if not terms:
             return _empty(self.dev)
         *body_terms, last = terms
-        subs: list[q.QueryNode] = [
-            q.TermQuery(field=node.field, value=t) for t in body_terms
-        ]
+
+        def term_clause(t: str) -> q.QueryNode:
+            if node.fuzziness is not None:
+                return q.FuzzyQuery(field=node.field, value=t,
+                                    fuzziness=node.fuzziness)
+            return q.TermQuery(field=node.field, value=t)
+
+        subs: list[q.QueryNode] = [term_clause(t) for t in body_terms]
         subs.append(q.PrefixQuery(field=node.field, value=last))
-        return self._exec_BoolQuery(q.BoolQuery(should=subs, boost=node.boost))
+        if node.operator == "and":
+            return self._exec_BoolQuery(q.BoolQuery(must=subs, boost=node.boost))
+        msm = node.minimum_should_match
+        if msm is not None:
+            try:
+                msm = int(str(msm).rstrip("%"))
+                if str(node.minimum_should_match).endswith("%"):
+                    msm = max(1, (len(subs) * msm) // 100)
+            except ValueError:
+                msm = None
+        return self._exec_BoolQuery(
+            q.BoolQuery(should=subs, minimum_should_match=msm, boost=node.boost)
+        )
 
     # -- query-string family ----------------------------------------------
 
@@ -1203,10 +1404,13 @@ def _fuzziness_distance(fuzziness: str, term: str) -> int:
 
 
 def _edit_distance_at_most(a: str, b: str, max_d: int) -> bool:
-    """Banded Levenshtein with early exit (Lucene automaton-equivalent check)."""
+    """OSA (Damerau-Levenshtein with adjacent transpositions = 1 edit) with
+    early exit — fuzzy queries default to transpositions=true like Lucene's
+    LevenshteinAutomata(..., transpositions)."""
     if max_d == 0:
         return a == b
     la, lb = len(a), len(b)
+    prev2: list[int] | None = None
     prev = list(range(lb + 1))
     for i in range(1, la + 1):
         cur = [i] + [0] * lb
@@ -1214,10 +1418,13 @@ def _edit_distance_at_most(a: str, b: str, max_d: int) -> bool:
         for j in range(1, lb + 1):
             cost = 0 if a[i - 1] == b[j - 1] else 1
             cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+            if (prev2 is not None and i > 1 and j > 1
+                    and a[i - 1] == b[j - 2] and a[i - 2] == b[j - 1]):
+                cur[j] = min(cur[j], prev2[j - 2] + 1)
             row_min = min(row_min, cur[j])
         if row_min > max_d:
             return False
-        prev = cur
+        prev2, prev = prev, cur
     return prev[lb] <= max_d
 
 

@@ -56,13 +56,29 @@ class MatchQuery(QueryNode):
 class MatchPhraseQuery(QueryNode):
     field: str = ""
     query: str = ""
+    slop: int = 0
+
+
+@dataclass
+class IntervalsQuery(QueryNode):
+    """intervals query (IntervalQueryBuilder) — source tree parsed by
+    opensearch_tpu/search/intervals.py, verified against position postings."""
+
+    field: str = ""
+    source: Any = None            # intervals.IntervalSource
 
 
 @dataclass
 class MultiMatchQuery(QueryNode):
     fields: list[str] = dc_field(default_factory=list)
     query: str = ""
-    type: str = "best_fields"     # best_fields | most_fields
+    type: str = "best_fields"     # best_fields | most_fields | bool_prefix | phrase | phrase_prefix | cross_fields
+    operator: str = "or"
+    minimum_should_match: Any = None
+    fuzziness: Any = None
+    analyzer: str | None = None
+    slop: int = 0                 # phrase/phrase_prefix types
+    field_boosts: dict = dc_field(default_factory=dict)  # "f^2" per-field boost
 
 
 @dataclass
@@ -160,6 +176,10 @@ class MatchPhrasePrefixQuery(QueryNode):
 class MatchBoolPrefixQuery(QueryNode):
     field: str = ""
     query: str = ""
+    operator: str = "or"
+    minimum_should_match: Any = None
+    fuzziness: Any = None
+    analyzer: str | None = None
 
 
 @dataclass
@@ -367,15 +387,60 @@ def _parse_match_phrase(body: dict) -> QueryNode:
     fname, conf = _single_kv(body, "match_phrase")
     if isinstance(conf, dict):
         return MatchPhraseQuery(field=fname, query=str(conf.get("query", "")),
+                                slop=int(conf.get("slop", 0)),
                                 boost=float(conf.get("boost", 1.0)))
     return MatchPhraseQuery(field=fname, query=str(conf))
 
 
+def _parse_intervals(body: dict) -> QueryNode:
+    from opensearch_tpu.search import intervals as iv
+
+    fname, conf = _single_kv(body, "intervals")
+    if not isinstance(conf, dict):
+        raise ParsingException("[intervals] query body must be an object")
+    conf = dict(conf)
+    boost = float(conf.pop("boost", 1.0))
+    return IntervalsQuery(
+        field=fname, source=iv.parse_intervals_source(conf), boost=boost
+    )
+
+
 def _parse_multi_match(body: dict) -> QueryNode:
+    mm_type = body.get("type", "best_fields")
+    known = {"best_fields", "most_fields", "cross_fields", "phrase",
+             "phrase_prefix", "bool_prefix"}
+    if mm_type not in known:
+        raise ParsingException(f"[multi_match] unknown type [{mm_type}]")
+    # parameter/type validation (MultiMatchQueryBuilder.doToQuery rejects
+    # positional params for term-centric types)
+    if mm_type == "bool_prefix":
+        for bad in ("slop", "cutoff_frequency"):
+            if bad in body:
+                raise ParsingException(
+                    f"[{bad}] not allowed for type [{mm_type}]"
+                )
+    raw_fields = body.get("fields", [])
+    field_boosts = {}
+    for f in raw_fields:
+        if "^" not in f:
+            continue
+        name, _, suffix = f.partition("^")
+        try:
+            field_boosts[name] = float(suffix)
+        except ValueError:
+            raise ParsingException(
+                f"[multi_match] invalid field boost [{f}]"
+            ) from None
     return MultiMatchQuery(
-        fields=[f.split("^")[0] for f in body.get("fields", [])],
+        fields=[f.split("^")[0] for f in raw_fields],
         query=str(body.get("query", "")),
-        type=body.get("type", "best_fields"),
+        type=mm_type,
+        field_boosts=field_boosts,
+        operator=str(body.get("operator", "or")).lower(),
+        minimum_should_match=body.get("minimum_should_match"),
+        fuzziness=body.get("fuzziness"),
+        analyzer=body.get("analyzer"),
+        slop=int(body.get("slop", 0)),
         boost=float(body.get("boost", 1.0)),
     )
 
@@ -518,6 +583,10 @@ def _parse_match_bool_prefix(body: dict) -> QueryNode:
     if isinstance(conf, dict):
         return MatchBoolPrefixQuery(
             field=fname, query=str(conf.get("query", "")),
+            operator=str(conf.get("operator", "or")).lower(),
+            minimum_should_match=conf.get("minimum_should_match"),
+            fuzziness=conf.get("fuzziness"),
+            analyzer=conf.get("analyzer"),
             boost=float(conf.get("boost", 1.0)),
         )
     return MatchBoolPrefixQuery(field=fname, query=str(conf))
@@ -790,6 +859,7 @@ _PARSERS = {
     "match_none": _parse_match_none,
     "match": _parse_match,
     "match_phrase": _parse_match_phrase,
+    "intervals": _parse_intervals,
     "multi_match": _parse_multi_match,
     "term": _parse_term,
     "terms": _parse_terms,
