@@ -253,10 +253,13 @@ class ClusterFacade:
         return self._rpc(self._leader(), "cluster:admin/put_mapping",
                          {"name": index, "mappings": body or {}})
 
-    def get_mapping(self, index: str) -> dict:
+    def get_mapping(self, index: str, *, ignore_unavailable: bool = False,
+                    allow_no_indices: bool = True,
+                    expand_wildcards: str = "open") -> dict:
+        names = self.resolve_indices(index)
         return {
             name: {"mappings": self._mapper_for(name).to_dict()}
-            for name in self.resolve_indices(index)
+            for name in names
         }
 
     def get_settings(self, index: str) -> dict:
@@ -296,9 +299,12 @@ class ClusterFacade:
     def index_doc(self, index: str, doc_id: str | None, source: dict,
                   routing: str | None = None, if_seq_no: int | None = None,
                   refresh: bool = False, op_type: str | None = None,
-                  pipeline: str | None = None) -> dict:
+                  pipeline: str | None = None, version: int | None = None,
+                  version_type: str = "internal") -> dict:
         if pipeline is not None:
             self._unsupported("ingest pipelines")
+        if version is not None:
+            self._unsupported("explicit document versions in cluster mode")
         if doc_id is None:
             doc_id = uuid.uuid4().hex[:20]
         resp = self._on_loop(lambda cb: self.node.index_doc(
@@ -310,13 +316,28 @@ class ClusterFacade:
         return resp
 
     def get_doc(self, index: str, doc_id: str,
-                routing: str | None = None) -> dict:
-        return self._on_loop(lambda cb: self.node.get_doc(
+                routing: str | None = None, realtime: bool = True,
+                version: int | None = None) -> dict:
+        got = self._on_loop(lambda cb: self.node.get_doc(
             index, doc_id, cb, routing=routing
         ))
+        if version is not None and got.get("found") \
+                and got.get("_version") != version:
+            from opensearch_tpu.common.errors import VersionConflictException
+
+            raise VersionConflictException(
+                f"[{doc_id}]: version conflict, current version "
+                f"[{got.get('_version')}] is different than the one "
+                f"provided [{version}]"
+            )
+        return got
 
     def delete_doc(self, index: str, doc_id: str, routing: str | None = None,
-                   refresh: bool = False) -> dict:
+                   refresh: bool = False, if_seq_no: int | None = None,
+                   version: int | None = None,
+                   version_type: str = "internal") -> dict:
+        if version is not None or if_seq_no is not None:
+            self._unsupported("versioned deletes in cluster mode")
         resp = self._on_loop(lambda cb: self.node.delete_doc(
             index, doc_id, cb, routing=routing
         ))
@@ -325,10 +346,23 @@ class ClusterFacade:
         return resp
 
     def update_doc(self, index: str, doc_id: str, body: dict,
-                   routing: str | None = None, refresh: bool = False) -> dict:
+                   routing: str | None = None, refresh: bool = False,
+                   if_seq_no: int | None = None) -> dict:
         """Coordinator-side read-modify-write with optimistic concurrency
         (UpdateHelper semantics over the cluster write path)."""
         current = self.get_doc(index, doc_id, routing=routing)
+        if if_seq_no is not None:
+            current_seq = current.get("_seq_no") if current.get("found") else -1
+            if current_seq != if_seq_no:
+                from opensearch_tpu.common.errors import (
+                    VersionConflictException,
+                )
+
+                raise VersionConflictException(
+                    f"[{doc_id}]: version conflict, required seqNo "
+                    f"[{if_seq_no}], current document has seqNo "
+                    f"[{current_seq}]"
+                )
         exists = current.get("found")
         if "script" in body:
             from opensearch_tpu.script import default_script_service

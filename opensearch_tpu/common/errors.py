@@ -25,6 +25,14 @@ class OpenSearchTpuException(Exception):
         return body
 
 
+class InputCoercionException(OpenSearchTpuException):
+    """Jackson's InputCoercionException surface: numeric JSON values that
+    overflow the declared java type (e.g. size: 2^31)."""
+
+    status = 400
+    error_type = "input_coercion_exception"
+
+
 class ParsingException(OpenSearchTpuException):
     status = 400
     error_type = "parsing_exception"

@@ -137,6 +137,35 @@ class Engine:
 
     # -- write path --------------------------------------------------------
 
+    def _check_version(self, doc_id: str, entry, version: int | None,
+                       version_type: str) -> None:
+        """VersionType.isVersionConflictForWrites semantics."""
+        if version is None:
+            return
+        current = entry.version if entry is not None and not entry.deleted \
+            else None
+        if version_type == "external":
+            if current is not None and version <= current:
+                raise VersionConflictException(
+                    f"[{doc_id}]: version conflict, current version "
+                    f"[{current}] is higher or equal to the one provided "
+                    f"[{version}]"
+                )
+        elif version_type == "external_gte":
+            if current is not None and version < current:
+                raise VersionConflictException(
+                    f"[{doc_id}]: version conflict, current version "
+                    f"[{current}] is higher than the one provided "
+                    f"[{version}]"
+                )
+        else:  # internal CAS
+            if current is None or current != version:
+                raise VersionConflictException(
+                    f"[{doc_id}]: version conflict, current version "
+                    f"[{current if current is not None else -1}] is "
+                    f"different than the one provided [{version}]"
+                )
+
     def index(
         self,
         doc_id: str,
@@ -145,6 +174,8 @@ class Engine:
         if_seq_no: int | None = None,
         if_primary_term: int | None = None,
         seq_no: int | None = None,
+        version: int | None = None,
+        version_type: str = "internal",
     ) -> OpResult:
         """Index one document (InternalEngine.index:863). `seq_no` is set
         only on the replica/recovery replay path."""
@@ -157,6 +188,7 @@ class Engine:
                     f"[{doc_id}]: version conflict, required seqNo [{if_seq_no}], "
                     f"current document has seqNo [{current_seq}]"
                 )
+        self._check_version(doc_id, entry, version, version_type)
         if seq_no is not None and entry is not None and entry.seq_no >= seq_no:
             # stale op on the replica/replay path: a newer op for this doc
             # already applied (reference: per-doc seq_no check in
@@ -170,7 +202,10 @@ class Engine:
         parsed = self.mapper_service.parse_document(doc_id, source, routing)
         op_seq = seq_no if seq_no is not None else self.tracker.generate_seq_no()
         created = entry is None or entry.deleted
-        version = 1 if created else entry.version + 1
+        if version is not None and version_type in ("external", "external_gte"):
+            pass  # external versions are caller-assigned verbatim
+        else:
+            version = 1 if created else entry.version + 1
         self._delete_from_live_segments(doc_id)
         self._buffer_put(parsed, op_seq)
         self.version_map[doc_id] = VersionEntry(op_seq, version)
@@ -186,7 +221,9 @@ class Engine:
                         result="created" if created else "updated")
 
     def delete(self, doc_id: str, seq_no: int | None = None,
-               if_seq_no: int | None = None) -> OpResult:
+               if_seq_no: int | None = None,
+               version: int | None = None,
+               version_type: str = "internal") -> OpResult:
         entry = self.version_map.get(doc_id)
         found = (entry is not None and not entry.deleted) or doc_id in self._buffer_pos
         if if_seq_no is not None:
@@ -196,13 +233,17 @@ class Engine:
                     f"[{doc_id}]: version conflict, required seqNo "
                     f"[{if_seq_no}], current document has seqNo [{current_seq}]"
                 )
+        self._check_version(doc_id, entry, version, version_type)
         if seq_no is not None and entry is not None and entry.seq_no >= seq_no:
             # stale op (see index()): ignore, a newer op already applied
             self.tracker.mark_seq_no_as_processed(seq_no)
             return OpResult(doc_id, seq_no, entry.version, found=False,
                             result="noop")
         op_seq = seq_no if seq_no is not None else self.tracker.generate_seq_no()
-        version = (entry.version + 1) if entry else 1
+        if version is not None and version_type in ("external", "external_gte"):
+            pass  # caller-assigned external version
+        else:
+            version = (entry.version + 1) if entry else 1
         self._buffer_remove(doc_id)
         self._delete_from_live_segments(doc_id)
         self.version_map[doc_id] = VersionEntry(op_seq, version, deleted=True)
@@ -234,13 +275,14 @@ class Engine:
 
     # -- read path ---------------------------------------------------------
 
-    def get(self, doc_id: str) -> dict | None:
+    def get(self, doc_id: str, realtime: bool = True) -> dict | None:
         """Realtime GET (index/get in the reference: reads through the
-        version map + buffer without waiting for refresh)."""
+        version map + buffer without waiting for refresh). realtime=False
+        reads only what the last refresh made searchable."""
         entry = self.version_map.get(doc_id)
-        if entry is not None and entry.deleted:
+        if realtime and entry is not None and entry.deleted:
             return None
-        pos = self._buffer_pos.get(doc_id)
+        pos = self._buffer_pos.get(doc_id) if realtime else None
         if pos is not None and self._buffer[pos] is not None:
             parsed, seq = self._buffer[pos]
             return {"_source": parsed.source, "_seq_no": seq,

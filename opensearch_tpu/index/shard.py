@@ -84,9 +84,11 @@ class IndexShard:
 
     def apply_index_on_primary(
         self, doc_id: str, source: dict, routing: str | None = None,
-        if_seq_no: int | None = None,
+        if_seq_no: int | None = None, version: int | None = None,
+        version_type: str = "internal",
     ) -> OpResult:
-        return self.engine.index(doc_id, source, routing, if_seq_no=if_seq_no)
+        return self.engine.index(doc_id, source, routing, if_seq_no=if_seq_no,
+                                 version=version, version_type=version_type)
 
     def apply_index_on_replica(
         self, doc_id: str, source: dict, seq_no: int, routing: str | None = None
@@ -94,16 +96,19 @@ class IndexShard:
         return self.engine.index(doc_id, source, routing, seq_no=seq_no)
 
     def apply_delete_on_primary(self, doc_id: str,
-                                if_seq_no: int | None = None) -> OpResult:
-        return self.engine.delete(doc_id, if_seq_no=if_seq_no)
+                                if_seq_no: int | None = None,
+                                version: int | None = None,
+                                version_type: str = "internal") -> OpResult:
+        return self.engine.delete(doc_id, if_seq_no=if_seq_no,
+                                  version=version, version_type=version_type)
 
     def apply_delete_on_replica(self, doc_id: str, seq_no: int) -> OpResult:
         return self.engine.delete(doc_id, seq_no=seq_no)
 
     # -- read ops ----------------------------------------------------------
 
-    def get(self, doc_id: str) -> dict | None:
-        return self.engine.get(doc_id)
+    def get(self, doc_id: str, realtime: bool = True) -> dict | None:
+        return self.engine.get(doc_id, realtime=realtime)
 
     def acquire_searcher(self) -> SearcherSnapshot:
         return self.engine.acquire_searcher()

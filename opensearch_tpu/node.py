@@ -24,6 +24,7 @@ from typing import Any
 from opensearch_tpu.common.errors import (
     DocumentMissingException,
     IllegalArgumentException,
+    InputCoercionException,
     IndexClosedException,
     IndexNotFoundException,
     OpenSearchTpuException,
@@ -293,14 +294,23 @@ class TpuNode:
             self.create_index(name, {})
         return self.indices[name]
 
-    def resolve_indices(self, expr: str) -> list[str]:
+    def resolve_indices(self, expr: str, *, ignore_unavailable: bool = False,
+                        allow_no_indices: bool = True,
+                        expand_wildcards: str = "open") -> list[str]:
         """Index name/pattern/alias resolution (comma lists, wildcards,
         _all). Wildcards match concrete index names AND alias names, like
         the reference's IndexNameExpressionResolver; aliases expand to
-        their member indices."""
+        their member indices. `ignore_unavailable` drops missing concrete
+        names instead of 404ing; `expand_wildcards=none` disables pattern
+        expansion; empty expansion 404s when `allow_no_indices` is false
+        (IndicesOptions semantics)."""
         alias_map = self._alias_map()
+        wildcards_on = expand_wildcards != "none"
         if expr in ("_all", "*", ""):
-            return sorted(self.indices)
+            names = sorted(self.indices) if wildcards_on else []
+            if not names and not allow_no_indices:
+                raise IndexNotFoundException(expr or "_all")
+            return names
         names: list[str] = []
         import fnmatch
 
@@ -308,15 +318,25 @@ class TpuNode:
         for part in expr.split(","):
             part = part.strip()
             if "*" in part or "?" in part:
+                if not wildcards_on:
+                    continue
+                matched = False
                 for n in candidates:
                     if fnmatch.fnmatch(n, part):
                         names.extend(alias_map.get(n, [n]))
+                        matched = True
+                if not matched and not allow_no_indices:
+                    raise IndexNotFoundException(part)
             elif part in alias_map:
                 names.extend(alias_map[part])
             else:
                 if part not in self.indices:
+                    if ignore_unavailable:
+                        continue
                     raise IndexNotFoundException(part)
                 names.append(part)
+        if not names and not allow_no_indices:
+            raise IndexNotFoundException(expr)
         seen = set()
         return [n for n in names if not (n in seen or seen.add(n))]
 
@@ -822,10 +842,16 @@ class TpuNode:
         self._persist_index_registry()
         return {"acknowledged": True}
 
-    def get_mapping(self, index: str) -> dict:
+    def get_mapping(self, index: str, *, ignore_unavailable: bool = False,
+                    allow_no_indices: bool = True,
+                    expand_wildcards: str = "open") -> dict:
         return {
             name: {"mappings": self._get_index(name).mapper_service.to_dict()}
-            for name in self.resolve_indices(index)
+            for name in self.resolve_indices(
+                index, ignore_unavailable=ignore_unavailable,
+                allow_no_indices=allow_no_indices,
+                expand_wildcards=expand_wildcards,
+            )
         }
 
     def get_settings(self, index: str) -> dict:
@@ -886,6 +912,8 @@ class TpuNode:
         refresh: bool = False,
         op_type: str = "index",
         pipeline: str | None = None,
+        version: int | None = None,
+        version_type: str = "internal",
     ) -> dict:
         # single-doc writes go through the same admission control as _bulk
         # (the reference accounts ALL write operations in IndexingPressure);
@@ -894,10 +922,18 @@ class TpuNode:
             len(json.dumps(source)) if source is not None else 0, "index"
         ):
             return self._index_doc_inner(index, doc_id, source, routing,
-                                         if_seq_no, refresh, op_type, pipeline)
+                                         if_seq_no, refresh, op_type, pipeline,
+                                         version, version_type)
 
     def _index_doc_inner(self, index, doc_id, source, routing,
-                         if_seq_no, refresh, op_type, pipeline) -> dict:
+                         if_seq_no, refresh, op_type, pipeline,
+                         version=None, version_type="internal") -> dict:
+        if version is not None and op_type == "create" and \
+                version_type != "internal":
+            raise IllegalArgumentException(
+                "create operations only support internal versioning. "
+                f"use index instead"
+            )
         _t_index0 = time.monotonic()
         index, routing = self._resolve_write_alias(index, routing)
         # ingest pipelines resolve BEFORE any index auto-creation (the
@@ -955,7 +991,10 @@ class TpuNode:
                 "(current version [1])"
             )
         mappers_before = len(svc.mapper_service.mappers)
-        result = shard.apply_index_on_primary(doc_id, source, routing, if_seq_no=if_seq_no)
+        result = shard.apply_index_on_primary(
+            doc_id, source, routing, if_seq_no=if_seq_no,
+            version=version, version_type=version_type,
+        )
         self._dirty_translog_shards.add(shard)
         if refresh:
             shard.refresh()
@@ -976,13 +1015,20 @@ class TpuNode:
             "_primary_term": 1,
         }
 
-    def get_doc(self, index: str, doc_id: str, routing: str | None = None) -> dict:
+    def get_doc(self, index: str, doc_id: str, routing: str | None = None,
+                realtime: bool = True, version: int | None = None) -> dict:
         index, routing = self._resolve_write_alias(index, routing)
         svc = self._get_open_index(index)
         shard = svc.shard_for(doc_id, routing)
-        got = shard.get(doc_id)
+        got = shard.get(doc_id, realtime=realtime)
         if got is None:
             return {"_index": index, "_id": doc_id, "found": False}
+        if version is not None and got["_version"] != version:
+            raise VersionConflictException(
+                f"[{doc_id}]: version conflict, current version "
+                f"[{got['_version']}] is different than the one provided "
+                f"[{version}]"
+            )
         out = {
             "_index": index,
             "_id": doc_id,
@@ -998,19 +1044,25 @@ class TpuNode:
 
     def delete_doc(self, index: str, doc_id: str, routing: str | None = None,
                    refresh: bool = False,
-                   if_seq_no: int | None = None) -> dict:
+                   if_seq_no: int | None = None,
+                   version: int | None = None,
+                   version_type: str = "internal") -> dict:
         # deletes carry no source; account a small fixed op cost
         with self._write_pressure(64, "delete"):
             return self._delete_doc_inner(index, doc_id, routing, refresh,
-                                          if_seq_no)
+                                          if_seq_no, version, version_type)
 
     def _delete_doc_inner(self, index, doc_id, routing, refresh,
-                          if_seq_no) -> dict:
+                          if_seq_no, version=None,
+                          version_type="internal") -> dict:
         index, routing = self._resolve_write_alias(index, routing)
         svc = self._get_open_index(index)
         shard = svc.shard_for(doc_id, routing)
         self._last_write_shard = (index, shard.shard_id.shard)
-        result = shard.apply_delete_on_primary(doc_id, if_seq_no=if_seq_no)
+        result = shard.apply_delete_on_primary(
+            doc_id, if_seq_no=if_seq_no, version=version,
+            version_type=version_type,
+        )
         self._dirty_translog_shards.add(shard)
         if refresh:
             shard.refresh()
@@ -1025,17 +1077,30 @@ class TpuNode:
         }
 
     def update_doc(self, index: str, doc_id: str, body: dict,
-                   routing: str | None = None, refresh: bool = False) -> dict:
+                   routing: str | None = None, refresh: bool = False,
+                   if_seq_no: int | None = None) -> dict:
         """Partial update via doc merge or script
         (action/update/UpdateHelper.java: prepareUpdateScriptRequest)."""
         with self._write_pressure(len(json.dumps(body)), "update"):
-            return self._update_doc_inner(index, doc_id, body, routing, refresh)
+            return self._update_doc_inner(index, doc_id, body, routing,
+                                          refresh, if_seq_no)
 
-    def _update_doc_inner(self, index, doc_id, body, routing, refresh) -> dict:
+    def _update_doc_inner(self, index, doc_id, body, routing, refresh,
+                          if_seq_no=None) -> dict:
         index, routing = self._resolve_write_alias(index, routing)
-        svc = self._get_open_index(index)
+        # updates auto-create the target index like index ops do
+        # (TransportUpdateAction routes through the bulk auto-create path)
+        svc = self._get_or_autocreate(index)
         shard = svc.shard_for(doc_id, routing)
         current = shard.get(doc_id)
+        if if_seq_no is not None:
+            current_seq = current["_seq_no"] if current is not None else -1
+            if current_seq != if_seq_no:
+                raise VersionConflictException(
+                    f"[{doc_id}]: version conflict, required seqNo "
+                    f"[{if_seq_no}], current document has seqNo "
+                    f"[{current_seq}]"
+                )
         if "script" in body:
             from opensearch_tpu.script import default_script_service
 
@@ -1065,6 +1130,8 @@ class TpuNode:
             op = ctx.get("op", "index")
             if op in ("none", "noop"):
                 return {"_index": index, "_id": doc_id, "result": "noop",
+                        "_version": current["_version"],
+                        "_seq_no": current["_seq_no"], "_primary_term": 1,
                         "_shards": {"total": 0, "successful": 0, "failed": 0}}
             if op == "delete":
                 return self.delete_doc(index, doc_id, routing, refresh=refresh)
@@ -1076,10 +1143,18 @@ class TpuNode:
             if current is None:
                 if body.get("doc_as_upsert"):
                     return self.index_doc(index, doc_id, body["doc"], routing, refresh=refresh)
+                if "upsert" in body:
+                    return self.index_doc(index, doc_id, body["upsert"],
+                                          routing, refresh=refresh)
                 from opensearch_tpu.common.errors import DocumentMissingException
 
                 raise DocumentMissingException(f"[{doc_id}]: document missing")
             merged = _deep_merge(current["_source"], body["doc"])
+            if merged == current["_source"] and not body.get("detect_noop") is False:
+                return {"_index": index, "_id": doc_id, "result": "noop",
+                        "_version": current["_version"],
+                        "_seq_no": current["_seq_no"], "_primary_term": 1,
+                        "_shards": {"total": 0, "successful": 0, "failed": 0}}
             out = self.index_doc(index, doc_id, merged, routing, refresh=refresh)
             out["result"] = "updated"
             return out
@@ -1425,6 +1500,7 @@ class TpuNode:
             return resp
         expr = index if index is not None else "_all"
         shards, shard_filters, names = self.resolve_search_shards(expr)
+        self._validate_search_request(names, body, scroll=scroll is not None)
         if scroll is not None:
             if int(body.get("from", 0)) > 0:
                 raise IllegalArgumentException("[from] is not supported with scroll")
@@ -1432,9 +1508,9 @@ class TpuNode:
                 raise IllegalArgumentException(
                     "[search_after] is not supported with scroll"
                 )
-            if int(body.get("size", search_service.DEFAULT_SIZE)) <= 0:
+            if int(body.get("size", search_service.DEFAULT_SIZE)) == 0:
                 raise IllegalArgumentException(
-                    "[size] must be positive in a scroll context"
+                    "[size] cannot be [0] in a scroll context"
                 )
             return self._start_scroll(shards, body, scroll,
                                       pipeline_id=pipeline_id, names=names,
@@ -1447,6 +1523,151 @@ class TpuNode:
             return self._search_with_pipeline(pipeline_id, names, shards, body,
                                               shard_filters=shard_filters,
                                               task=task)
+
+    def _check_keep_alive(self, keep_ms: int, raw: str) -> None:
+        """search.max_keep_alive cap (SearchService.validateKeepAlives)."""
+        max_raw = self.effective_cluster_setting("search.max_keep_alive", "24h")
+        max_ms = parse_time_value_millis(str(max_raw), "search.max_keep_alive",
+                                         positive=True)
+        if keep_ms > max_ms:
+            raise IllegalArgumentException(
+                f"Keep alive for request ({raw}) is too large. It must be "
+                f"less than ({max_raw}). This limit can be set by changing "
+                f"the [search.max_keep_alive] cluster level setting."
+            )
+
+    def effective_cluster_setting(self, key: str, default=None):
+        """transient over persistent over default (ClusterSettings.get)."""
+        t = getattr(self, "_transient_cluster_settings", {}) or {}
+        p = getattr(self, "_cluster_settings", {}) or {}
+        return t.get(key, p.get(key, default))
+
+    def _index_int_setting(self, name: str, key: str, default: int) -> int:
+        svc = self.indices.get(name)
+        if svc is None:
+            return default
+        s = svc.settings or {}
+        v = s.get(key, s.get(f"index.{key}", default))
+        if isinstance(s.get("index"), dict) and key in s["index"]:
+            v = s["index"][key]
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return default
+
+    def _validate_search_request(self, names: list, body: dict,
+                                 scroll: bool = False) -> None:
+        """Request-level limits the reference enforces in
+        SearchService.validateSearchContext / SearchRequest.validate:
+        result windows, rescore windows, field-count caps, collapse
+        combination rules."""
+        int_max = 2**31 - 1
+        for key in ("from", "size"):
+            v = body.get(key)
+            if v is None:
+                continue
+            v = int(v)
+            if v > int_max or v < -(2**31):
+                raise InputCoercionException(
+                    f"Numeric value ({v}) out of range of int "
+                    f"(-2147483648 - 2147483647)"
+                )
+        from_ = int(body.get("from") or 0)
+        size_raw = body.get("size")
+        size = int(size_raw) if size_raw is not None else search_service.DEFAULT_SIZE
+        if from_ < 0:
+            raise IllegalArgumentException(
+                f"[from] parameter cannot be negative, found [{from_}]"
+            )
+        if size_raw is not None and size < 0:
+            raise IllegalArgumentException(
+                f"[size] parameter cannot be negative, found [{size}]"
+            )
+        rescore = body.get("rescore")
+        rescore_stages = (rescore if isinstance(rescore, list)
+                          else [rescore] if rescore is not None else [])
+        dv_count = len(body.get("docvalue_fields") or [])
+        sf_count = len(body.get("script_fields") or {})
+        for n in names:
+            if n not in self.indices:
+                continue
+            mrw = self._index_int_setting(n, "max_result_window", 10000)
+            if scroll:
+                if size > mrw:
+                    raise IllegalArgumentException(
+                        f"Batch size is too large, size must be less than "
+                        f"or equal to: [{mrw}] but was [{size}]. Scroll "
+                        f"batch sizes cost as much memory as result windows "
+                        f"so they are controlled by the "
+                        f"[index.max_result_window] index level setting."
+                    )
+            elif from_ + size > mrw and body.get("search_after") is None:
+                raise IllegalArgumentException(
+                    f"Result window is too large, from + size must be less "
+                    f"than or equal to: [{mrw}] but was [{from_ + size}]. "
+                    f"See the scroll api for a more efficient way to "
+                    f"request large data sets. This limit can be set by "
+                    f"changing the [index.max_result_window] index level "
+                    f"setting."
+                )
+            max_rescore = self._index_int_setting(n, "max_rescore_window", 10000)
+            for stage in rescore_stages:
+                if not isinstance(stage, dict):
+                    continue
+                w = int(stage.get("window_size", 10))
+                if w > max_rescore:
+                    raise IllegalArgumentException(
+                        f"Rescore window [{w}] is too large. It must be "
+                        f"less than [{max_rescore}]. This prevents "
+                        f"allocating massive heaps for storing the results "
+                        f"to be rescored. This limit can be set by changing "
+                        f"the [index.max_rescore_window] index level "
+                        f"setting."
+                    )
+            max_dv = self._index_int_setting(
+                n, "max_docvalue_fields_search", 100)
+            if dv_count > max_dv:
+                raise IllegalArgumentException(
+                    f"Trying to retrieve too many docvalue_fields. Must be "
+                    f"less than or equal to: [{max_dv}] but was "
+                    f"[{dv_count}]. This limit can be set by changing the "
+                    f"[index.max_docvalue_fields_search] index level "
+                    f"setting."
+                )
+            max_sf = self._index_int_setting(n, "max_script_fields", 32)
+            if sf_count > max_sf:
+                raise IllegalArgumentException(
+                    f"Trying to retrieve too many script_fields. Must be "
+                    f"less than or equal to: [{max_sf}] but was "
+                    f"[{sf_count}]. This limit can be set by changing the "
+                    f"[index.max_script_fields] index level setting."
+                )
+        if body.get("collapse") is not None:
+            if scroll:
+                raise IllegalArgumentException(
+                    "cannot use `collapse` in a scroll context"
+                )
+            if rescore_stages:
+                raise IllegalArgumentException(
+                    "cannot use `collapse` in conjunction with `rescore`"
+                )
+            if body.get("search_after") is not None:
+                cfield = (body["collapse"] or {}).get("field")
+                sort = body.get("sort")
+                if isinstance(sort, (str, dict)):
+                    sort = [sort]
+                sort_fields = []
+                for s in sort or []:
+                    if isinstance(s, str):
+                        sort_fields.append(s)
+                    elif isinstance(s, dict) and s:
+                        sort_fields.append(next(iter(s)))
+                if sort_fields != [cfield]:
+                    raise IllegalArgumentException(
+                        "collapse field and sort field must be the same "
+                        "when use `collapse` in conjunction with "
+                        "`search_after`"
+                    )
 
     def _search_with_pipeline(
         self,
@@ -1599,6 +1820,7 @@ class TpuNode:
                       shard_filters: list | None = None) -> dict:
         self._reap_expired_contexts()
         keep_ms = parse_time_value_millis(scroll, "scroll", positive=True)
+        self._check_keep_alive(keep_ms, scroll)
         cid = f"scroll_{uuid.uuid4().hex}"
         snapshots = [s.acquire_searcher() for s in shards]
         size = int(body.get("size", search_service.DEFAULT_SIZE))
@@ -1629,7 +1851,9 @@ class TpuNode:
         for simplicity and is exact)."""
         ctx = self._resolve_reader_context(scroll_id, "scroll")
         if scroll is not None:
-            ctx["keep_alive_ms"] = parse_time_value_millis(scroll, "scroll", positive=True)
+            keep_ms = parse_time_value_millis(scroll, "scroll", positive=True)
+            self._check_keep_alive(keep_ms, scroll)
+            ctx["keep_alive_ms"] = keep_ms
         ctx["expires_at"] = _now_ms() + ctx["keep_alive_ms"]
         page_body = {k: v for k, v in ctx["body"].items()
                      if k not in ("aggs", "aggregations")}

@@ -29,6 +29,7 @@ def build_router() -> Router:
     reg("PUT", "/{index}", create_index)
     reg("DELETE", "/{index}", delete_index)
     reg("GET", "/{index}", get_index)
+    reg("GET", "/_mapping", get_mapping)
     reg("GET", "/{index}/_mapping", get_mapping)
     reg("PUT", "/{index}/_mapping", put_mapping)
     reg("POST", "/{index}/_mapping", put_mapping)
@@ -219,7 +220,12 @@ def get_index(node: TpuNode, params, query, body):
 
 
 def get_mapping(node: TpuNode, params, query, body):
-    return 200, node.get_mapping(params["index"])
+    return 200, node.get_mapping(
+        params.get("index", "_all"),
+        ignore_unavailable=str(query.get("ignore_unavailable", "false")) in ("true", ""),
+        allow_no_indices=str(query.get("allow_no_indices", "true")) != "false",
+        expand_wildcards=str(query.get("expand_wildcards", "open")),
+    )
 
 
 def put_mapping(node: TpuNode, params, query, body):
@@ -268,6 +274,20 @@ def _forced_refresh(resp: dict, query) -> dict:
     return resp
 
 
+def _version_params(query) -> dict:
+    out = {}
+    if "version" in query:
+        out["version"] = int(query["version"])
+    if "version_type" in query:
+        vt = str(query["version_type"])
+        if vt not in ("internal", "external", "external_gte", "force"):
+            raise IllegalArgumentException(f"No version type match [{vt}]")
+        out["version_type"] = vt
+    elif "version" in query:
+        out["version_type"] = "internal"
+    return out
+
+
 def index_doc(node: TpuNode, params, query, body):
     if body is None:
         raise IllegalArgumentException("request body is required")
@@ -280,6 +300,7 @@ def index_doc(node: TpuNode, params, query, body):
         refresh=_refresh_param(query),
         op_type="create" if query.get("op_type") == "create" else None,
         pipeline=query.get("pipeline"),
+        **_version_params(query),
     )
     resp = _forced_refresh(resp, query)
     return (201 if resp["result"] == "created" else 200), resp
@@ -304,19 +325,29 @@ def create_doc(node: TpuNode, params, query, body):
         params["index"], params["id"], body,
         routing=_routing_param(query), refresh=_refresh_param(query),
         op_type="create", pipeline=query.get("pipeline"),
+        **_version_params(query),
     )
-    return 201, resp
+    return 201, _forced_refresh(resp, query)
+
+
+def _realtime_param(query) -> bool:
+    return str(query.get("realtime", "true")) != "false"
 
 
 def get_doc(node: TpuNode, params, query, body):
-    resp = node.get_doc(params["index"], params["id"], routing=_routing_param(query))
+    resp = node.get_doc(params["index"], params["id"],
+                        routing=_routing_param(query),
+                        realtime=_realtime_param(query),
+                        version=(int(query["version"])
+                                 if "version" in query else None))
     return (200 if resp.get("found") else 404), resp
 
 
 def doc_exists(node: TpuNode, params, query, body):
     try:
         resp = node.get_doc(params["index"], params["id"],
-                            routing=_routing_param(query))
+                            routing=_routing_param(query),
+                            realtime=_realtime_param(query))
     except OpenSearchTpuException:
         return 404, ""
     return (200 if resp.get("found") else 404), ""
@@ -333,33 +364,42 @@ def index_exists(node: TpuNode, params, query, body):
 def source_exists(node: TpuNode, params, query, body):
     try:
         resp = node.get_doc(params["index"], params["id"],
-                            routing=_routing_param(query))
+                            routing=_routing_param(query),
+                            realtime=_realtime_param(query))
     except OpenSearchTpuException:
         return 404, ""
     return (200 if resp.get("found") and "_source" in resp else 404), ""
 
 
 def get_source(node: TpuNode, params, query, body):
-    resp = node.get_doc(params["index"], params["id"], routing=_routing_param(query))
+    resp = node.get_doc(params["index"], params["id"],
+                        routing=_routing_param(query),
+                        realtime=_realtime_param(query))
     if not resp.get("found"):
         return 404, {"error": f"document [{params['id']}] not found"}
     return 200, resp["_source"]
 
 
 def delete_doc(node: TpuNode, params, query, body):
+    if_seq_no = query.get("if_seq_no")
     resp = node.delete_doc(
         params["index"], params["id"],
         routing=_routing_param(query), refresh=_refresh_param(query),
+        if_seq_no=int(if_seq_no) if if_seq_no is not None else None,
+        **_version_params(query),
     )
+    resp = _forced_refresh(resp, query)
     return (200 if resp["result"] == "deleted" else 404), resp
 
 
 def update_doc(node: TpuNode, params, query, body):
+    if_seq_no = query.get("if_seq_no")
     resp = node.update_doc(
         params["index"], params["id"], body or {},
         routing=_routing_param(query), refresh=_refresh_param(query),
+        if_seq_no=int(if_seq_no) if if_seq_no is not None else None,
     )
-    return 200, resp
+    return 200, _forced_refresh(resp, query)
 
 
 def bulk(node: TpuNode, params, query, body):
@@ -566,7 +606,20 @@ def _totals_as_int(resp: dict, query) -> dict:
     return resp
 
 
+def _validate_search_params(query):
+    """Request-param validation (SearchRequest.validate analogs)."""
+    if "batched_reduce_size" in query:
+        if int(query["batched_reduce_size"]) < 2:
+            raise IllegalArgumentException("batchedReduceSize must be >= 2")
+    if query.get("scroll") is not None and \
+            str(query.get("request_cache", "")).lower() == "true":
+        raise IllegalArgumentException(
+            "[request_cache] cannot be used in a scroll context"
+        )
+
+
 def search(node: TpuNode, params, query, body):
+    _validate_search_params(query)
     resp = node.search(params["index"], _body_with_query_params(query, body),
                        scroll=query.get("scroll"),
                        search_pipeline=query.get("search_pipeline"))
@@ -576,6 +629,7 @@ def search(node: TpuNode, params, query, body):
 def search_all(node: TpuNode, params, query, body):
     # index=None (not "_all"): a PIT body carries its own shard set and is
     # only legal without an index in the path
+    _validate_search_params(query)
     resp = node.search(None, _body_with_query_params(query, body),
                        scroll=query.get("scroll"),
                        search_pipeline=query.get("search_pipeline"))

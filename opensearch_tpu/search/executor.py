@@ -731,6 +731,9 @@ class SegmentExecutor:
 
     def _exec_TermQuery(self, node: q.TermQuery) -> NodeResult:
         field, value = node.field, node.value
+        if field == "_id":
+            return self._exec_IdsQuery(q.IdsQuery(values=[str(value)],
+                                                  boost=node.boost))
         mapper = self.ctx.mapper_service.field_mapper(field)
         if mapper is None:
             # sub-path of a flat_object field -> term on the shared
@@ -776,6 +779,9 @@ class SegmentExecutor:
         raise IllegalArgumentException(f"term query on unsupported field [{field}]")
 
     def _exec_TermsQuery(self, node: q.TermsQuery) -> NodeResult:
+        if node.field == "_id":
+            return self._exec_IdsQuery(q.IdsQuery(
+                values=[str(v) for v in node.values], boost=node.boost))
         mapper = self.ctx.mapper_service.field_mapper(node.field)
         if mapper is None:
             flat = self.ctx.mapper_service.flat_object_parent(node.field)

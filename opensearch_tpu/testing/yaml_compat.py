@@ -309,10 +309,15 @@ class YamlTestRunner:
             raise StepFailure(f"do with {len(payload)} apis")
         (api, args), = payload.items()
         args = stash.resolve(args or {})
+        ignore = args.pop("ignore", None) if isinstance(args, dict) else None
+        ignored = ({int(v) for v in (ignore if isinstance(ignore, list) else [ignore])}
+                   if ignore is not None else set())
         method, path, query, body = self.specs.resolve(api, args)
         status, response = dispatch(method, path, query, body)
         self.last_response = response
         if catch is None:
+            if status in ignored:
+                return
             if status >= 400:
                 raise StepFailure(
                     f"do {api}: HTTP {status} {str(response)[:160]}"

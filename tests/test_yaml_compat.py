@@ -26,7 +26,7 @@ SUITES = [
     "indices.put_mapping",
 ]
 
-FLOOR = 0.45
+FLOOR = 0.50
 
 
 @pytest.mark.skipif(not REFERENCE_SPEC.exists(),
