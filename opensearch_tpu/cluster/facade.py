@@ -470,7 +470,8 @@ class ClusterFacade:
 
     def search(self, index: str | None = None, body: dict | None = None,
                scroll: str | None = None,
-               search_pipeline: str | None = None) -> dict:
+               search_pipeline: str | None = None,
+               ignore_unavailable: bool = False) -> dict:
         from opensearch_tpu.search.reduce import (
             check_cluster_aggs_supported,
             reduce_search_responses,

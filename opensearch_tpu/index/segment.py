@@ -140,9 +140,23 @@ class HostKeywordField:
 @dataclass
 class HostNumericField:
     kind: str                        # "int" | "float"
-    values_i64: np.ndarray | None    # int64 [n_docs] (int kind)
-    values_f64: np.ndarray | None    # float64 [n_docs] (float kind)
+    values_i64: np.ndarray | None    # int64 [n_docs] first value (sort key)
+    values_f64: np.ndarray | None    # float64 [n_docs] first value (sort key)
     present: np.ndarray              # bool [n_docs]
+    # multi-valued storage (SortedNumericDocValues analog): CSR over ALL
+    # values per doc; None when every doc holds at most one value
+    mv_offsets: np.ndarray | None = None   # int64 [n_docs+1]
+    mv_values: np.ndarray | None = None    # int64/float64 [E]
+
+    def doc_values(self, doc: int) -> np.ndarray:
+        if self.mv_offsets is not None:
+            return self.mv_values[
+                int(self.mv_offsets[doc]): int(self.mv_offsets[doc + 1])
+            ]
+        if not self.present[doc]:
+            return np.zeros(0, np.int64 if self.kind == "int" else np.float64)
+        col = self.values_i64 if self.kind == "int" else self.values_f64
+        return col[doc: doc + 1]
 
 
 @dataclass
@@ -404,17 +418,25 @@ class SegmentBuilder:
 
     def _build_numeric(self, fname: str, n: int, kind: str) -> HostNumericField | None:
         present = np.zeros(n, dtype=bool)
-        vals = np.zeros(n, dtype=np.int64 if kind == "int" else np.float64)
+        dtype = np.int64 if kind == "int" else np.float64
+        vals = np.zeros(n, dtype=dtype)
+        mv_offsets = np.zeros(n + 1, dtype=np.int64)
+        flat: list = []
         any_field = False
+        any_multi = False
         for d, doc in enumerate(self.docs):
             pf = doc.fields.get(fname)
-            if pf is None or not pf.numeric:
-                continue
-            any_field = True
-            present[d] = True
-            # multi-valued numerics: store the first value for now (CSR TODO,
-            # the reference keeps all via SortedNumericDocValues)
-            vals[d] = int(pf.numeric[0]) if kind == "int" else pf.numeric[0]
+            nums = pf.numeric if pf is not None and pf.numeric else []
+            if nums:
+                any_field = True
+                present[d] = True
+                # first value is the sort key (SortedNumericDocValues MIN
+                # mode analog); the CSR keeps every value for matching
+                vals[d] = int(nums[0]) if kind == "int" else nums[0]
+                if len(nums) > 1:
+                    any_multi = True
+                flat.extend(int(v) if kind == "int" else v for v in nums)
+            mv_offsets[d + 1] = mv_offsets[d] + len(nums)
         if not any_field:
             return None
         return HostNumericField(
@@ -422,6 +444,8 @@ class SegmentBuilder:
             values_i64=vals if kind == "int" else None,
             values_f64=vals if kind == "float" else None,
             present=present,
+            mv_offsets=mv_offsets if any_multi else None,
+            mv_values=np.asarray(flat, dtype=dtype) if any_multi else None,
         )
 
     def _build_vector(
@@ -539,6 +563,9 @@ def segment_payload(
             nf.values_i64 if nf.kind == "int" else nf.values_f64
         )
         arrays[f"{key}:present"] = nf.present
+        if nf.mv_offsets is not None:
+            arrays[f"{key}:mv_offsets"] = nf.mv_offsets
+            arrays[f"{key}:mv_values"] = nf.mv_values
         meta["numeric_fields"][fname] = {"kind": nf.kind}
     for fname, vf in seg.vector_fields.items():
         if _link(fname, vf):
@@ -637,6 +664,10 @@ def segment_from_payload(meta: dict, arrays, sources: list[bytes]) -> HostSegmen
             values_i64=vals if m["kind"] == "int" else None,
             values_f64=vals if m["kind"] == "float" else None,
             present=arrays[f"{key}:present"],
+            mv_offsets=(arrays[f"{key}:mv_offsets"]
+                        if f"{key}:mv_offsets" in arrays else None),
+            mv_values=(arrays[f"{key}:mv_values"]
+                       if f"{key}:mv_values" in arrays else None),
         )
     for fname, m in meta["vector_fields"].items():
         key = f"vec:{fname}"

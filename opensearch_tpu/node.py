@@ -104,6 +104,11 @@ class IndexService:
             (settings.get("analysis") if isinstance(settings.get("analysis"), dict) else None)
         )
         self.mapper_service = MapperService(mappings, analysis)
+        self.mapper_service.ignore_malformed_default = str(
+            settings.get("mapping.ignore_malformed",
+                         settings.get("index.mapping.ignore_malformed",
+                                      False))
+        ).lower() == "true"
         self.num_shards = int(settings.get("number_of_shards", 1))
         self.num_replicas = int(settings.get("number_of_replicas", 1))
         self.creation_date = int(time.time() * 1000)
@@ -524,7 +529,8 @@ class TpuNode:
             if alias in svc.aliases
         ]
 
-    def resolve_search_shards(self, expr: str) -> tuple[list, list]:
+    def resolve_search_shards(self, expr: str,
+                              ignore_unavailable: bool = False) -> tuple[list, list]:
         """(shards, per-shard alias filter bodies, index names) for a
         search expression.
         Filtered aliases contribute their filter to exactly their member
@@ -575,6 +581,8 @@ class TpuNode:
                     add_alias(part, explicit=True)
                 elif part in self.indices:
                     add_index(part, None, explicit=True)
+                elif ignore_unavailable:
+                    continue
                 else:
                     raise IndexNotFoundException(part)
 
@@ -1465,7 +1473,8 @@ class TpuNode:
 
     def search(self, index: str | None = None, body: dict | None = None,
                scroll: str | None = None,
-               search_pipeline: str | None = None) -> dict:
+               search_pipeline: str | None = None,
+               ignore_unavailable: bool = False) -> dict:
         body = dict(body or {})
         # body key is always consumed; an explicit param takes precedence
         body_pipeline = body.pop("search_pipeline", None)
@@ -1499,8 +1508,14 @@ class TpuNode:
             resp["pit_id"] = ctx["id"]
             return resp
         expr = index if index is not None else "_all"
-        shards, shard_filters, names = self.resolve_search_shards(expr)
+        shards, shard_filters, names = self.resolve_search_shards(
+            expr, ignore_unavailable=ignore_unavailable)
         self._validate_search_request(names, body, scroll=scroll is not None)
+        if body.get("indices_boost") is not None:
+            body = dict(body)
+            body["indices_boost"] = self._resolve_indices_boost(
+                body["indices_boost"], ignore_unavailable=ignore_unavailable
+            )
         if scroll is not None:
             if int(body.get("from", 0)) > 0:
                 raise IllegalArgumentException("[from] is not supported with scroll")
@@ -1523,6 +1538,34 @@ class TpuNode:
             return self._search_with_pipeline(pipeline_id, names, shards, body,
                                               shard_filters=shard_filters,
                                               task=task)
+
+    def _resolve_indices_boost(self, spec,
+                               ignore_unavailable: bool = False) -> dict:
+        """indices_boost: {index: boost} or [{index-or-pattern: boost}, ...]
+        resolved to concrete index names; unknown names 404 like the
+        reference (SearchService.resolveIndexBoosts)."""
+        entries: list[tuple[str, float]] = []
+        if isinstance(spec, dict):
+            entries = [(k, float(v)) for k, v in spec.items()]
+        elif isinstance(spec, list):
+            for item in spec:
+                if not isinstance(item, dict) or len(item) != 1:
+                    raise IllegalArgumentException(
+                        "[indices_boost] must contain one entry per object"
+                    )
+                k, v = next(iter(item.items()))
+                entries.append((k, float(v)))
+        else:
+            raise IllegalArgumentException(
+                "[indices_boost] must be an object or an array"
+            )
+        out: dict[str, float] = {}
+        for name, boost in entries:
+            for concrete in self.resolve_indices(
+                name, ignore_unavailable=ignore_unavailable
+            ):
+                out.setdefault(concrete, boost)  # first match wins
+        return out
 
     def _check_keep_alive(self, keep_ms: int, raw: str) -> None:
         """search.max_keep_alive cap (SearchService.validateKeepAlives)."""

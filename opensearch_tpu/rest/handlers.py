@@ -583,6 +583,9 @@ def _body_with_query_params(query, body):
         body.setdefault(
             "docvalue_fields", str(query["docvalue_fields"]).split(",")
         )
+    if "include_named_queries_score" in query:
+        body.setdefault("include_named_queries_score",
+                        str(query["include_named_queries_score"]))
     if "track_total_hits" in query:
         v = str(query["track_total_hits"])
         body.setdefault(
@@ -622,7 +625,10 @@ def search(node: TpuNode, params, query, body):
     _validate_search_params(query)
     resp = node.search(params["index"], _body_with_query_params(query, body),
                        scroll=query.get("scroll"),
-                       search_pipeline=query.get("search_pipeline"))
+                       search_pipeline=query.get("search_pipeline"),
+                       ignore_unavailable=str(
+                           query.get("ignore_unavailable", "false")
+                       ) in ("true", ""))
     return 200, _totals_as_int(resp, query)
 
 

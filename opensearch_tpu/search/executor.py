@@ -834,6 +834,33 @@ class SegmentExecutor:
             return parse_date_millis(v) if is_date else v
 
         gte, gt, lte, lt = conv(gte), conv(gt), conv(lte), conv(lt)
+        if nf_host is not None and nf_host.mv_offsets is not None:
+            # multi-valued docs: a doc matches if ANY value is in range
+            # (SortedNumericDocValues semantics) — vectorized host CSR scan
+            mv = nf_host.mv_values
+            if nf_host.kind == "int":
+                lo_b = I64_MIN if gte is None and gt is None else (
+                    int(gte) if gte is not None else int(gt) + 1)
+                hi_b = I64_MAX if lte is None and lt is None else (
+                    int(lte) if lte is not None else int(lt) - 1)
+                sel = (mv >= lo_b) & (mv <= hi_b)
+            else:
+                lo_v = float(gte) if gte is not None else (
+                    float(gt) if gt is not None else -np.inf)
+                hi_v = float(lte) if lte is not None else (
+                    float(lt) if lt is not None else np.inf)
+                sel = np.ones(len(mv), bool)
+                sel &= (mv > lo_v) if gt is not None else (mv >= lo_v)
+                sel &= (mv < hi_v) if lt is not None else (mv <= hi_v)
+            mask_host = np.zeros(self.dev.n_pad, bool)
+            idx = np.nonzero(sel)[0]
+            if len(idx):
+                # entry index -> owning doc via the CSR offsets
+                doc_of = np.searchsorted(nf_host.mv_offsets, idx, side="right") - 1
+                mask_host[np.unique(doc_of)] = True
+            return _const_result(
+                jnp.asarray(mask_host) & self.dev.live, boost, scoring=True
+            )
         if nf_dev.kind == "int":
             lo_bound = I64_MIN if gte is None and gt is None else (
                 int(gte) if gte is not None else int(gt) + 1

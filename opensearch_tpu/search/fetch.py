@@ -287,14 +287,14 @@ def _doc_column_values(host, doc: int, fname: str, ms: MapperService,
     mapper = ms.field_mapper(fname)
     nf = host.numeric_fields.get(fname)
     if nf is not None and nf.present[doc]:
+        vals = nf.doc_values(doc)
         if nf.kind == "int":
-            v = int(nf.values_i64[doc])
             if mapper is not None and mapper.type == "date":
-                return [_format_date_ms(v, fmt)]
+                return [_format_date_ms(int(v), fmt) for v in vals]
             if mapper is not None and mapper.type == "boolean":
-                return [bool(v)]
-            return [v]
-        return [float(nf.values_f64[doc])]
+                return [bool(v) for v in vals]
+            return [int(v) for v in vals]
+        return [float(v) for v in vals]
     kf = host.keyword_fields.get(fname)
     if kf is not None:
         s, e = int(kf.mv_offsets[doc]), int(kf.mv_offsets[doc + 1])
@@ -302,18 +302,29 @@ def _doc_column_values(host, doc: int, fname: str, ms: MapperService,
     return []
 
 
-def _format_date_ms(ms_value: int, fmt: str | None) -> Any:
-    if fmt in ("epoch_millis", None):
-        from datetime import datetime, timezone
+# joda-time pattern letters -> strftime (the common subset; DateFormatter)
+_JODA_MAP = [
+    ("yyyy", "%Y"), ("yy", "%y"), ("MM", "%m"), ("dd", "%d"),
+    ("HH", "%H"), ("mm", "%M"), ("ss", "%S"),
+]
 
-        if fmt == "epoch_millis":
-            return str(ms_value)
-        dt = datetime.fromtimestamp(ms_value / 1000.0, tz=timezone.utc)
-        return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{ms_value % 1000:03d}Z"
-    # explicit joda-ish formats degrade to ISO
+
+def _format_date_ms(ms_value: int, fmt: str | None) -> Any:
     from datetime import datetime, timezone
 
+    if fmt == "epoch_millis":
+        return str(ms_value)
     dt = datetime.fromtimestamp(ms_value / 1000.0, tz=timezone.utc)
+    if fmt is None or fmt.startswith("strict_date") or fmt == "date_optional_time":
+        return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{ms_value % 1000:03d}Z"
+    # joda-style custom pattern
+    out = fmt
+    if "SSS" in out:
+        out = out.replace("SSS", f"{ms_value % 1000:03d}")
+    for joda, strf in _JODA_MAP:
+        out = out.replace(joda, strf)
+    if "%" in out:
+        return dt.strftime(out)
     return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{ms_value % 1000:03d}Z"
 
 
@@ -345,6 +356,9 @@ def fields_option_for_doc(
                     from opensearch_tpu.index.mapper import parse_date_millis
 
                     vals = [_format_date_ms(parse_date_millis(v), fmt) for v in vals]
+                elif mapper is not None and mapper.type == "token_count":
+                    # derived fields read from doc-values, not _source
+                    vals = _doc_column_values(host, doc, key, ms, fmt) or vals
                 out[key] = list(vals)
         if not matched and "*" not in pattern:
             vals = _doc_column_values(host, doc, pattern, ms, fmt)

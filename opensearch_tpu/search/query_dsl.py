@@ -23,6 +23,9 @@ from opensearch_tpu.common.errors import ParsingException
 @dataclass
 class QueryNode:
     boost: float = 1.0
+    # `_name` (named queries): hits report which named clauses matched
+    # (matched_queries; AbstractQueryBuilder#queryName)
+    name: str | None = None
 
 
 @dataclass
@@ -336,6 +339,22 @@ class ScriptScoreQuery(QueryNode):
     add_constant: float = 0.0     # e.g. "cosineSimilarity(...) + 1.0"
 
 
+def iter_query_nodes(node: QueryNode):
+    """Depth-first walk over a query node tree (all QueryNode-typed fields
+    and lists thereof)."""
+    import dataclasses as _dc
+
+    yield node
+    for f in _dc.fields(node):
+        v = getattr(node, f.name, None)
+        if isinstance(v, QueryNode):
+            yield from iter_query_nodes(v)
+        elif isinstance(v, list):
+            for item in v:
+                if isinstance(item, QueryNode):
+                    yield from iter_query_nodes(item)
+
+
 def _single_kv(body: dict, name: str) -> tuple[str, Any]:
     if not isinstance(body, dict) or len(body) != 1:
         raise ParsingException(f"[{name}] query must have a single field")
@@ -354,12 +373,30 @@ def parse_query(body: dict | None) -> QueryNode:
     parser = _PARSERS.get(qtype)
     if parser is None:
         raise ParsingException(f"unknown query [{qtype}]")
+    # `_name` may sit at the query-body level ({"bool": {..., "_name": x}})
+    # or inside the single-field conf ({"term": {"f": {.., "_name": x}}})
+    qname = None
+    if isinstance(qbody, dict):
+        if "_name" in qbody:
+            qbody = {k: v for k, v in qbody.items() if k != "_name"}
+            qname = body[qtype]["_name"]
+        elif len(qbody) == 1:
+            inner = next(iter(qbody.values()))
+            if isinstance(inner, dict) and "_name" in inner:
+                qname = inner["_name"]
+                qbody = {next(iter(qbody)): {
+                    k: v for k, v in inner.items() if k != "_name"
+                }}
+        body = {qtype: qbody}
     if not isinstance(qbody, dict):
         raise ParsingException(
             f"[{qtype}] query malformed, expected an object but got "
             f"[{type(qbody).__name__}]"
         )
-    return parser(qbody)
+    node = parser(qbody)
+    if qname is not None:
+        node.name = str(qname)
+    return node
 
 
 def _parse_match_all(body: dict) -> QueryNode:

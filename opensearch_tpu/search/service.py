@@ -38,6 +38,13 @@ from opensearch_tpu.search.executor import (
 DEFAULT_SIZE = 10
 
 
+def _sort_has_score(sort) -> bool:
+    return any(
+        (spec if isinstance(spec, str) else next(iter(spec), None)) == "_score"
+        for spec in (sort or [])
+    )
+
+
 def search(
     shards: list[IndexShard],
     body: dict | None,
@@ -47,6 +54,7 @@ def search(
     task=None,
     partial: bool = False,
     shard_numbers: list[int] | None = None,
+    index_boosts: dict | None = None,
 ) -> dict[str, Any]:
     """Run one search over `shards`. `acquired` optionally pins the searcher
     snapshots to use, one per shard in order — the scroll/PIT path
@@ -66,7 +74,8 @@ def search(
         "track_total_hits", "min_score", "search_after", "timeout", "version",
         "seq_no_primary_term", "stored_fields", "explain", "highlight",
         "docvalue_fields", "fields", "script_fields", "suggest", "profile",
-        "rescore", "collapse", "slice",
+        "rescore", "collapse", "slice", "indices_boost",
+        "include_named_queries_score",
     }
     unknown = set(body) - known_keys
     if unknown:
@@ -234,6 +243,20 @@ def search(
                 per_shard_results.append((shard, snapshot, result))
 
     # ---- reduce phase (SearchPhaseController analog) ----
+    if index_boosts is None and isinstance(body.get("indices_boost"), dict):
+        index_boosts = body["indices_boost"]
+    if index_boosts:
+        # indices_boost: per-index score multiplier applied before the
+        # cross-shard merge (SearchService applies it as a query-level
+        # boost on each shard)
+        for shard, _snapshot, result in per_shard_results:
+            factor = index_boosts.get(shard.shard_id.index)
+            if factor is None or factor == 1.0:
+                continue
+            for h in result.hits:
+                h.score *= factor
+            if result.max_score is not None:
+                result.max_score *= factor
     merged = []
     total = 0
     max_score = None
@@ -295,6 +318,24 @@ def search(
     if highlight_conf:
         ms_for_hl = _MultiMapperView([s.mapper_service for s in shards])
         preds_by_field = fetch.field_term_predicates(node, ms_for_hl)
+    # named queries (matched_queries): collect from the main tree and any
+    # rescore stages; evaluated per (shard, segment) lazily below
+    named_nodes = [n for n in query_dsl.iter_query_nodes(node) if n.name]
+    for stage in (body.get("rescore") if isinstance(body.get("rescore"), list)
+                  else [body["rescore"]] if body.get("rescore") else []):
+        rq = ((stage or {}).get("query") or {}).get("rescore_query")
+        if rq is not None:
+            try:
+                rnode = query_dsl.parse_query(rq)
+            except ParsingException:
+                continue
+            named_nodes.extend(
+                n for n in query_dsl.iter_query_nodes(rnode) if n.name
+            )
+    include_nq_scores = str(
+        body.get("include_named_queries_score", "false")
+    ).lower() in ("true", "")
+    named_cache: dict = {}
     hits_json = []
     for page_i, (shard_idx, h) in enumerate(page):
         shard, snapshot, _ = per_shard_results[shard_idx]
@@ -304,11 +345,18 @@ def search(
         hit: dict[str, Any] = {
             "_index": shard.shard_id.index,
             "_id": doc_id,
-            "_score": None if sort else h.score,
+            "_score": h.score if (not sort or _sort_has_score(sort)) else None,
         }
         doc_routing = host.doc_routings[h.doc] if host.doc_routings else None
         if doc_routing is not None:
             hit["_routing"] = doc_routing
+        ig = host.keyword_fields.get("_ignored")
+        if ig is not None:
+            s_, e_ = int(ig.mv_offsets[h.doc]), int(ig.mv_offsets[h.doc + 1])
+            if e_ > s_:
+                hit["_ignored"] = sorted(
+                    ig.ord_values[int(o)] for o in ig.mv_ords[s_:e_]
+                )
         raw_source = json.loads(host.sources[h.doc])
         src = source_filter(raw_source)
         if src is not None:
@@ -348,6 +396,24 @@ def search(
             if want_seqno:
                 hit["_seq_no"] = int(host.doc_seq_nos[h.doc])
                 hit["_primary_term"] = 1
+        if named_nodes:
+            mq: dict[str, float] = {}
+            for nn in named_nodes:
+                key = (shard_idx, h.segment, id(nn))
+                if key not in named_cache:
+                    ctx_n = ShardContext(snapshot, ms)
+                    dev = snapshot.segments[h.segment][1]
+                    r = SegmentExecutor(ctx_n, host, dev).execute(nn)
+                    named_cache[key] = (
+                        np.asarray(r.mask), np.asarray(r.scores)
+                    )
+                n_mask, n_scores = named_cache[key]
+                if h.doc < len(n_mask) and n_mask[h.doc]:
+                    mq[nn.name] = float(n_scores[h.doc])
+            if mq or named_nodes:
+                hit["matched_queries"] = (
+                    mq if include_nq_scores else sorted(mq)
+                )
         if collapse_field is not None:
             value = collapse_values[from_ + page_i]
             hit.setdefault("fields", {})[collapse_field] = [value]
@@ -359,8 +425,14 @@ def search(
             hit["_tb"] = [gshard, h.segment, h.doc]
         hits_json.append(hit)
 
+    sort_by_score = bool(sort) and any(
+        (spec if isinstance(spec, str) else next(iter(spec), None)) == "_score"
+        for spec in (sort or [])
+    )
+    if sort_by_score and max_score is None and merged:
+        max_score = max(h.score for _i, h in merged)
     hits_obj: dict[str, Any] = {
-        "max_score": max_score if not sort else None,
+        "max_score": max_score if (not sort or sort_by_score) else None,
         "hits": hits_json,
     }
     # track_total_hits: True -> exact; int N -> capped with relation gte;
