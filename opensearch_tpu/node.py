@@ -277,15 +277,48 @@ class TpuNode:
         self._configure_slowlogs()
         return self.indices[name]
 
-    def delete_index(self, name: str) -> dict:
-        svc = self._get_index(name)
-        svc.close()
-        del self.indices[name]
-        self._persist_index_registry()
-        self._configure_slowlogs()
+    def delete_index(self, expr: str, *, ignore_unavailable: bool = False,
+                     allow_no_indices: bool = True) -> dict:
+        """DELETE /{index}. Wildcards expand over concrete indices only;
+        explicit alias names are rejected (TransportDeleteIndexAction uses
+        strict concrete-index resolution) unless ignore_unavailable."""
+        import fnmatch
+
+        alias_map = self._alias_map()
+        targets: list[str] = []
+        matched_any = False
+        for part in expr.split(","):
+            part = part.strip()
+            if part in ("_all", "*"):
+                targets.extend(self.indices)
+                matched_any = True
+            elif "*" in part or "?" in part:
+                hits = [n for n in self.indices if fnmatch.fnmatch(n, part)]
+                targets.extend(hits)
+                matched_any = matched_any or bool(hits)
+            elif part in alias_map:
+                if ignore_unavailable:
+                    continue
+                raise IllegalArgumentException(
+                    f"The provided expression [{part}] matches an alias, "
+                    f"specify the corresponding concrete indices instead."
+                )
+            elif part in self.indices:
+                targets.append(part)
+                matched_any = True
+            elif not ignore_unavailable:
+                raise IndexNotFoundException(part)
+        if not matched_any and not allow_no_indices:
+            raise IndexNotFoundException(expr)
         import shutil
 
-        shutil.rmtree(self._index_path(name), ignore_errors=True)
+        for name in dict.fromkeys(targets):
+            svc = self._get_index(name)
+            svc.close()
+            del self.indices[name]
+            shutil.rmtree(self._index_path(name), ignore_errors=True)
+        self._persist_index_registry()
+        self._configure_slowlogs()
         return {"acknowledged": True}
 
     def _get_index(self, name: str) -> IndexService:

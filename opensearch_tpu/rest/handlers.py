@@ -205,7 +205,12 @@ def create_index(node: TpuNode, params, query, body):
 
 
 def delete_index(node: TpuNode, params, query, body):
-    return 200, node.delete_index(params["index"])
+    return 200, node.delete_index(
+        params["index"],
+        ignore_unavailable=str(query.get("ignore_unavailable", "false"))
+        in ("true", ""),
+        allow_no_indices=str(query.get("allow_no_indices", "true")) != "false",
+    )
 
 
 def get_index(node: TpuNode, params, query, body):
