@@ -25,6 +25,11 @@ class OpenSearchTpuException(Exception):
         return body
 
 
+class ActionRequestValidationException(OpenSearchTpuException):
+    status = 400
+    error_type = "action_request_validation_exception"
+
+
 class InputCoercionException(OpenSearchTpuException):
     """Jackson's InputCoercionException surface: numeric JSON values that
     overflow the declared java type (e.g. size: 2^31)."""

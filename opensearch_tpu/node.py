@@ -1541,6 +1541,19 @@ class TpuNode:
             resp["pit_id"] = ctx["id"]
             return resp
         expr = index if index is not None else "_all"
+        sort_spec = body.get("sort")
+        sort_list = [sort_spec] if isinstance(sort_spec, (str, dict)) else (sort_spec or [])
+        for s_ in sort_list:
+            fname = s_ if isinstance(s_, str) else next(iter(s_), None)
+            if fname == "_shard_doc":
+                from opensearch_tpu.common.errors import (
+                    ActionRequestValidationException,
+                )
+
+                raise ActionRequestValidationException(
+                    "Validation Failed: 1: [_shard_doc] sort field is only "
+                    "supported with point-in-time (PIT) searches;"
+                )
         shards, shard_filters, names = self.resolve_search_shards(
             expr, ignore_unavailable=ignore_unavailable)
         self._validate_search_request(names, body, scroll=scroll is not None)
@@ -2053,6 +2066,7 @@ class TpuNode:
         )
         import json as _json
 
+        self.data_path.mkdir(parents=True, exist_ok=True)
         (self.data_path / "cluster_settings.json").write_text(
             _json.dumps(self._cluster_settings)
         )

@@ -602,16 +602,24 @@ def _body_with_query_params(query, body):
 
 def _totals_as_int(resp: dict, query) -> dict:
     """?rest_total_hits_as_int=true: hits.total as a plain integer (the
-    pre-7.0 shape many YAML suites assert)."""
+    pre-7.0 shape many YAML suites assert); applies to inner_hits too."""
     if str(query.get("rest_total_hits_as_int", "false")) not in ("true", ""):
         return resp
-    hits = resp.get("hits")
-    if isinstance(hits, dict) and isinstance(hits.get("total"), dict):
-        hits = dict(hits)
-        hits["total"] = hits["total"].get("value", 0)
-        resp = dict(resp)
-        resp["hits"] = hits
-    return resp
+
+    def convert(obj):
+        if isinstance(obj, dict):
+            out = {}
+            for k, v in obj.items():
+                if k == "hits" and isinstance(v, dict) \
+                        and isinstance(v.get("total"), dict):
+                    v = {**v, "total": v["total"].get("value", 0)}
+                out[k] = convert(v)
+            return out
+        if isinstance(obj, list):
+            return [convert(x) for x in obj]
+        return obj
+
+    return convert(resp)
 
 
 def _validate_search_params(query):
@@ -830,7 +838,8 @@ def delete_search_pipeline(node: TpuNode, params, query, body):
 
 def scroll(node: TpuNode, params, query, body):
     body = body or {}
-    scroll_id = params.get("scroll_id") or body.get("scroll_id") or query.get("scroll_id")
+    # body params override path/query (RestSearchScrollAction)
+    scroll_id = body.get("scroll_id") or params.get("scroll_id") or query.get("scroll_id")
     if not scroll_id:
         raise IllegalArgumentException("scroll_id is required")
     keep = body.get("scroll") or query.get("scroll")
@@ -839,12 +848,15 @@ def scroll(node: TpuNode, params, query, body):
 
 def clear_scroll(node: TpuNode, params, query, body):
     body = body or {}
-    ids = params.get("scroll_id") or body.get("scroll_id") or query.get("scroll_id")
+    ids = body.get("scroll_id") or params.get("scroll_id") or query.get("scroll_id")
     if not ids:
         raise IllegalArgumentException("scroll_id is required (use _all to clear every scroll)")
     if isinstance(ids, str):
         ids = None if ids == "_all" else ids.split(",")
-    return 200, node.clear_scroll(ids)
+    resp = node.clear_scroll(ids)
+    # explicit ids that freed nothing -> 404 (RestClearScrollAction status)
+    status = 404 if ids and resp.get("num_freed", 0) == 0 else 200
+    return status, resp
 
 
 def open_pit(node: TpuNode, params, query, body):

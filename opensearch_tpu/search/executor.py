@@ -1599,7 +1599,7 @@ def _sorted_segment_hits(
         fname, order, _missing = _sort_spec(spec)
         if fname == "_score":
             sort_cols.append((scores[docs], np.ones(len(docs), bool), order, None))
-        elif fname == "_doc":
+        elif fname in ("_doc", "_shard_doc"):
             sort_cols.append((docs.astype(np.float64), np.ones(len(docs), bool), order, None))
         else:
             vals, present = _field_sort_values(host, fname, docs, mapper_service)

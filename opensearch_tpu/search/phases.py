@@ -210,21 +210,81 @@ def doc_field_value(host, field: str, doc: int, mapper_service):
 
 def apply_collapse(collapse_body, merged, per_shard_results):
     """Keep the first (best-ranked) hit per distinct field value; docs
-    without the field each form their own group (reference: null group)."""
+    without the field each form their own group (reference: null group).
+    `inner_hits` specs expand each kept hit's group (CollapseContext +
+    ExpandSearchPhase — here the group members are already in hand, so the
+    expansion is a sort+slice instead of a follow-up msearch)."""
     if not isinstance(collapse_body, dict) or not collapse_body.get("field"):
         raise ParsingException("[collapse] requires a [field]")
     field = collapse_body["field"]
-    seen: set = set()
-    out = []
-    values = []
+    inner_specs = collapse_body.get("inner_hits") or []
+    if isinstance(inner_specs, dict):
+        inner_specs = [inner_specs]
+    groups: dict = {}
+    hit_values = []
     for shard_idx, hit in merged:
         shard, snapshot, _res = per_shard_results[shard_idx]
         host, _dev = snapshot.segments[hit.segment]
         value = doc_field_value(host, field, hit.doc, shard.mapper_service)
+        hit_values.append(value)
+        if value is not None:
+            groups.setdefault(value, []).append((shard_idx, hit))
+    seen: set = set()
+    out = []
+    values = []
+    inner = []
+    for (shard_idx, hit), value in zip(merged, hit_values):
         if value is not None:
             if value in seen:
                 continue
             seen.add(value)
         out.append((shard_idx, hit))
         values.append(value)
-    return out, field, values
+        if not inner_specs:
+            inner.append(None)
+            continue
+        members = groups.get(value, [(shard_idx, hit)])
+        per_name = {}
+        for spec in inner_specs:
+            name = spec.get("name") or field
+            cand = list(members)
+            sort = spec.get("sort")
+            if sort:
+                sort_l = [sort] if isinstance(sort, (str, dict)) else list(sort)
+                cand.sort(key=_inner_sort_key(sort_l, per_shard_results))
+            else:
+                cand.sort(key=lambda sh: (-sh[1].score, sh[0],
+                                          sh[1].segment, sh[1].doc))
+            frm = int(spec.get("from", 0))
+            sel = cand[frm: frm + int(spec.get("size", 3))]
+            per_name[name] = {"total": len(members), "hits": sel,
+                              "spec": spec}
+        inner.append(per_name)
+    return out, field, values, inner
+
+
+def _inner_sort_key(sort_l, per_shard_results):
+    from opensearch_tpu.search.executor import _sort_spec, _StrKey
+
+    specs = [_sort_spec(sp) for sp in sort_l]
+
+    def key(sh):
+        s_i, h_ = sh
+        shard, snapshot, _res = per_shard_results[s_i]
+        host, _dev = snapshot.segments[h_.segment]
+        parts = []
+        for fname, order, missing in specs:
+            if fname == "_score":
+                parts.append(-h_.score if order == "desc" else h_.score)
+                continue
+            v = doc_field_value(host, fname, h_.doc, shard.mapper_service)
+            if v is None:
+                parts.append((-1, 0) if missing == "_first" else (1, 0))
+            elif isinstance(v, str):
+                parts.append((0, _StrKey(v, order == "desc")))
+            else:
+                parts.append((0, -v if order == "desc" else v))
+        parts.append((s_i, h_.segment, h_.doc))
+        return tuple(parts)
+
+    return key

@@ -268,18 +268,37 @@ def search(
             max_score = result.max_score
         for h in result.hits:
             merged.append((shard_idx, h))
+    if sort:
+        # _shard_doc: the global PIT tiebreak value (shard, segment, doc)
+        # packed into one int so cursors round-trip through search_after
+        for i, spec in enumerate(sort):
+            fname = spec if isinstance(spec, str) else next(iter(spec), None)
+            if fname != "_shard_doc":
+                continue
+            for shard_idx, h in merged:
+                packed = (shard_idx << 42) | (h.segment << 21) | h.doc
+                while len(h.sort_values) <= i:
+                    h.sort_values.append(None)
+                h.sort_values[i] = packed
     if not sort:
         merged.sort(key=lambda sh: (-sh[1].score, sh[0], sh[1].segment, sh[1].doc))
     else:
         key_fn = _sort_key_fn(sort)
         merged.sort(key=lambda sh: key_fn(sh[1]))
         if search_after is not None:
-            cursor = _search_after_key(sort, search_after)
+            ms_view = _MultiMapperView([s.mapper_service for s in shards]) \
+                if shards else None
+            cursor = _search_after_key(
+                sort,
+                _coerce_search_after(sort, search_after, ms_view)
+                if ms_view is not None else search_after,
+            )
             merged = [
                 sh for sh in merged if _sort_values_key(sort, sh[1]) > cursor
             ]
     collapse_values: list | None = None
     collapse_field: str | None = None
+    collapse_inner: list | None = None
     if body.get("rescore") is not None or body.get("collapse") is not None:
         from opensearch_tpu.search import phases
 
@@ -292,7 +311,8 @@ def search(
                 body["rescore"], merged, per_shard_results, shards
             )
         if body.get("collapse") is not None:
-            merged, collapse_field, collapse_values = phases.apply_collapse(
+            (merged, collapse_field, collapse_values,
+             collapse_inner) = phases.apply_collapse(
                 body["collapse"], merged, per_shard_results
             )
     page = merged[from_ : from_ + size]
@@ -417,6 +437,37 @@ def search(
         if collapse_field is not None:
             value = collapse_values[from_ + page_i]
             hit.setdefault("fields", {})[collapse_field] = [value]
+            inner_map = (collapse_inner[from_ + page_i]
+                         if collapse_inner else None)
+            if inner_map:
+                ih_json: dict[str, Any] = {}
+                for name, g in inner_map.items():
+                    sub_hits = []
+                    best = None
+                    for s_i, h_ in g["hits"]:
+                        sh_shard, sh_snap, _ = per_shard_results[s_i]
+                        sh_host = sh_snap.segments[h_.segment][0]
+                        spec = g["spec"]
+                        sub: dict[str, Any] = {
+                            "_index": sh_shard.shard_id.index,
+                            "_id": sh_host.doc_ids[h_.doc],
+                            "_score": h_.score,
+                            "_source": json.loads(sh_host.sources[h_.doc]),
+                        }
+                        if spec.get("version"):
+                            sub["_version"] = int(sh_host.doc_versions[h_.doc])
+                        if spec.get("seq_no_primary_term"):
+                            sub["_seq_no"] = int(sh_host.doc_seq_nos[h_.doc])
+                            sub["_primary_term"] = 1
+                        if best is None or (h_.score or 0) > best:
+                            best = h_.score
+                        sub_hits.append(sub)
+                    ih_json[name] = {"hits": {
+                        "total": {"value": g["total"], "relation": "eq"},
+                        "max_score": best,
+                        "hits": sub_hits,
+                    }}
+                hit["inner_hits"] = ih_json
         if partial:
             gshard = (
                 shard_numbers[shard_idx] if shard_numbers is not None
@@ -692,6 +743,38 @@ def _search_after_key(sort: list, search_after: list) -> tuple:
             f"search_after must have {len(sort)} value(s) matching sort"
         )
     return _values_key(sort, search_after)
+
+
+def _coerce_search_after(sort: list, search_after: list, ms) -> list:
+    """Cursor values arrive as JSON (dates as strings, numbers as ints);
+    coerce each to the sort column's native type so the cursor compares
+    against sort_values without type mismatches."""
+    from opensearch_tpu.index.mapper import (
+        FLOAT_TYPES,
+        INT_TYPES,
+        parse_date_millis,
+    )
+
+    out = []
+    for spec, v in zip([_sort_spec(s) for s in sort], search_after):
+        fname = spec[0]
+        mapper = ms.field_mapper(fname) if hasattr(ms, "field_mapper") else None
+        if v is None or fname == "_score":
+            out.append(v)
+        elif mapper is not None and mapper.type == "date" \
+                and isinstance(v, str):
+            out.append(float(parse_date_millis(v)))
+        elif mapper is not None and (
+            mapper.type in INT_TYPES or mapper.type in FLOAT_TYPES
+            or mapper.type == "boolean"
+        ) and isinstance(v, str):
+            try:
+                out.append(float(v))
+            except ValueError:
+                out.append(v)
+        else:
+            out.append(v)
+    return out
 
 
 def _source_filter(spec: Any):
