@@ -1,0 +1,34 @@
+import time, functools
+import numpy as np
+import jax, jax.numpy as jnp
+
+from opensearch_tpu.ops.fused import knn_topk
+from opensearch_tpu.ops.pallas_knn import pallas_knn_blocktopk, pallas_knn_sbmax_topk
+
+d, k = 128, 10
+n = 1_000_000
+n_pad = 1 << 20
+key = jax.random.PRNGKey(7)
+vectors = jax.random.normal(key, (n, d), dtype=jnp.float32)
+vectors = jnp.pad(vectors, ((0, n_pad - n), (0, 0)))
+norms = jnp.sum(vectors * vectors, axis=-1)
+valid = jnp.arange(n_pad) < n
+rng = np.random.default_rng(7)
+
+def bench(name, call, n_chunks, chunk):
+    qs = jnp.asarray(rng.standard_normal((n_chunks, chunk, d)).astype(np.float32))
+    f = jax.jit(lambda qs: jax.lax.map(lambda q: call(q), qs))
+    np.asarray(f(qs)[0])
+    walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(f(qs)[0])
+        walls.append(time.perf_counter() - t0)
+    wall = min(walls)
+    total = n_chunks * chunk
+    print(f"{name}: {total} q in {wall*1000:.1f} ms -> {total/wall:.0f} QPS", flush=True)
+
+bench("xla_fused c500", lambda q: knn_topk(vectors, norms, valid, q, k=k, similarity="l2_norm"), 16, 500)
+bench("pb_blocktopk c128", lambda q: pallas_knn_blocktopk(vectors, norms, valid, q, k=k, similarity="l2_norm", exact=True), 16, 128)
+bench("sbmax c128", lambda q: pallas_knn_sbmax_topk(vectors, norms, valid, q, k=k, similarity="l2_norm", exact=True), 16, 128)
+bench("sbmax c512", lambda q: pallas_knn_sbmax_topk(vectors, norms, valid, q, k=k, similarity="l2_norm", exact=True), 16, 512)

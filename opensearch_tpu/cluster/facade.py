@@ -720,7 +720,8 @@ class ClusterFacade:
     # cluster / stats
     # ------------------------------------------------------------------ #
 
-    def cluster_health(self) -> dict:
+    def cluster_health(self, index: str | None = None,
+                       level: str = "cluster") -> dict:
         return self.node.cluster_health()
 
     def put_cluster_settings(self, body: dict) -> dict:

@@ -526,28 +526,38 @@ class TpuNode:
                 out[name] = {"aliases": matched}
         return out
 
-    def resolve_write_target(self, name: str) -> str:
+    def resolve_write_target(self, name: str, for_write: bool = True) -> str:
         """Alias -> its write index (TransportBulkAction's write-alias
-        resolution); concrete names pass through (may autocreate later)."""
+        resolution); concrete names pass through (may autocreate later).
+        Reads (`for_write=False`) ignore write-index designations."""
         targets = self._alias_targets(name)
         if not targets:
             return name
         if len(targets) == 1:
+            if for_write and targets[0][1].get("is_write_index") is False:
+                raise IllegalArgumentException(
+                    f"no write index is defined for alias [{name}]. The "
+                    f"write index may be explicitly disabled using "
+                    f"is_write_index=false or the alias points to multiple "
+                    f"indices without one being designated as a write index"
+                )
             return targets[0][0]
         writes = [n for n, c in targets if c.get("is_write_index")]
         if len(writes) != 1:
             raise IllegalArgumentException(
-                f"no write index is defined for alias [{name}]: the alias "
-                f"points to multiple indices without an explicit write index"
+                f"no write index is defined for alias [{name}]. The write "
+                f"index may be explicitly disabled using is_write_index="
+                f"false or the alias points to multiple indices without one "
+                f"being designated as a write index"
             )
         return writes[0]
 
     def _resolve_write_alias(
-        self, index: str, routing: str | None
+        self, index: str, routing: str | None, for_write: bool = True
     ) -> tuple[str, str | None]:
         """(concrete index, effective routing) for a write/read-by-id op:
         alias write-index resolution + alias-level routing defaulting."""
-        concrete = self.resolve_write_target(index)
+        concrete = self.resolve_write_target(index, for_write=for_write)
         if concrete != index and routing is None:
             conf = self.indices[concrete].aliases.get(index) or {}
             routing = conf.get("index_routing", conf.get("routing"))
@@ -1058,7 +1068,8 @@ class TpuNode:
 
     def get_doc(self, index: str, doc_id: str, routing: str | None = None,
                 realtime: bool = True, version: int | None = None) -> dict:
-        index, routing = self._resolve_write_alias(index, routing)
+        index, routing = self._resolve_write_alias(index, routing,
+                                                   for_write=False)
         svc = self._get_open_index(index)
         shard = svc.shard_for(doc_id, routing)
         got = shard.get(doc_id, realtime=realtime)
@@ -1248,8 +1259,12 @@ class TpuNode:
                     )
 
                     raise IndexNotFoundException(
-                        f"[{index}] is not an alias and require_alias is set"
+                        f"no such index [{index}] and [require_alias] "
+                        f"request flag is [true] and [{index}] is not an "
+                        f"alias"
                     )
+                if action == "index" and meta.get("op_type") == "create":
+                    action = "create"
                 if action in ("index", "create"):
                     resp = self.index_doc(index, doc_id, source, routing,
                                           op_type=action,
@@ -1773,6 +1788,7 @@ class TpuNode:
         searches are covered too, not just the plain path."""
         expr = ",".join(index_names) or "_pit"
         body = self._resolve_mlt_doc_refs(body, index_names)
+        body = self._resolve_terms_lookup(body)
         pl, pr_config = self._resolve_search_pipeline(pipeline_id, index_names)
         pl_ctx = {}
         if pl is not None:
@@ -1799,6 +1815,76 @@ class TpuNode:
                 pl, {**body, **pl_ctx}, resp
             )
         return resp
+
+    def _resolve_terms_lookup(self, body: dict) -> dict:
+        """Terms lookup ({"terms": {"f": {"index","id","path"}}}) resolved
+        coordinator-side to a concrete values array BEFORE shard execution
+        (TermsQueryBuilder's fetch in the rewrite phase)."""
+        import copy as _copy
+
+        found = False
+
+        def scan(obj):
+            nonlocal found
+            if isinstance(obj, dict):
+                t = obj.get("terms")
+                if isinstance(t, dict) and any(
+                    isinstance(v, dict) and "index" in v and "id" in v
+                    for v in t.values()
+                ):
+                    found = True
+                for v in obj.values():
+                    scan(v)
+            elif isinstance(obj, list):
+                for v in obj:
+                    scan(v)
+
+        scan(body)
+        if not found:
+            return body
+        body = _copy.deepcopy(body)
+
+        def resolve(obj):
+            if isinstance(obj, dict):
+                t = obj.get("terms")
+                if isinstance(t, dict):
+                    for fname, spec in list(t.items()):
+                        if not (isinstance(spec, dict) and "index" in spec
+                                and "id" in spec):
+                            continue
+                        path = str(spec.get("path", ""))
+                        got = self.get_doc(str(spec["index"]),
+                                           str(spec["id"]),
+                                           routing=spec.get("routing"))
+                        values: list = []
+                        if got.get("found"):
+                            nodes = [got.get("_source", {})]
+                            for part in path.split("."):
+                                nxt = []
+                                for nd in nodes:
+                                    if isinstance(nd, list):
+                                        nd2 = [x.get(part) for x in nd
+                                               if isinstance(x, dict)]
+                                        nxt.extend(x for x in nd2
+                                                   if x is not None)
+                                    elif isinstance(nd, dict) \
+                                            and part in nd:
+                                        nxt.append(nd[part])
+                                nodes = nxt
+                            for nd in nodes:
+                                if isinstance(nd, list):
+                                    values.extend(nd)
+                                else:
+                                    values.append(nd)
+                        t[fname] = values
+                for v in obj.values():
+                    resolve(v)
+            elif isinstance(obj, list):
+                for v in obj:
+                    resolve(v)
+
+        resolve(body)
+        return body
 
     def _resolve_mlt_doc_refs(self, body: dict,
                               index_names: list[str] | None = None) -> dict:
@@ -2088,25 +2174,74 @@ class TpuNode:
             ),
         }
 
-    def cluster_health(self) -> dict:
-        total_shards = sum(svc.num_shards for svc in self.indices.values())
-        return {
+    def cluster_health(self, index: str | None = None,
+                       level: str = "cluster") -> dict:
+        """GET _cluster/health. Single-node truth: every primary is active
+        on this node, every configured replica is unassigned (no peer to
+        hold it) — so any index with replicas > 0 reports yellow, like the
+        reference's single-node default."""
+        names = (sorted(self.indices) if index in (None, "", "_all")
+                 else self.resolve_indices(index))
+        active = 0
+        unassigned = 0
+        per_index: dict[str, Any] = {}
+        worst = "green"
+        for name in names:
+            svc = self.indices[name]
+            idx_active = svc.num_shards
+            idx_unassigned = svc.num_shards * svc.num_replicas
+            active += idx_active
+            unassigned += idx_unassigned
+            status = "yellow" if idx_unassigned else "green"
+            if status == "yellow":
+                worst = "yellow"
+            entry: dict[str, Any] = {
+                "status": status,
+                "number_of_shards": svc.num_shards,
+                "number_of_replicas": svc.num_replicas,
+                "active_primary_shards": idx_active,
+                "active_shards": idx_active,
+                "relocating_shards": 0,
+                "initializing_shards": 0,
+                "unassigned_shards": idx_unassigned,
+            }
+            if level == "shards":
+                entry["shards"] = {
+                    str(s): {
+                        "status": status,
+                        "primary_active": True,
+                        "active_shards": 1,
+                        "relocating_shards": 0,
+                        "initializing_shards": 0,
+                        "unassigned_shards": svc.num_replicas,
+                    }
+                    for s in range(svc.num_shards)
+                }
+            per_index[name] = entry
+        total = active + unassigned
+        out = {
             "cluster_name": "opensearch-tpu",
-            "status": "green" if self.indices else "green",
+            "status": worst,
             "timed_out": False,
             "number_of_nodes": 1,
             "number_of_data_nodes": 1,
-            "active_primary_shards": total_shards,
-            "active_shards": total_shards,
+            "discovered_master": True,
+            "discovered_cluster_manager": True,
+            "active_primary_shards": active,
+            "active_shards": active,
             "relocating_shards": 0,
             "initializing_shards": 0,
-            "unassigned_shards": 0,
+            "unassigned_shards": unassigned,
             "delayed_unassigned_shards": 0,
             "number_of_pending_tasks": 0,
             "number_of_in_flight_fetch": 0,
             "task_max_waiting_in_queue_millis": 0,
-            "active_shards_percent_as_number": 100.0,
+            "active_shards_percent_as_number":
+                (100.0 * active / total) if total else 100.0,
         }
+        if level in ("indices", "shards"):
+            out["indices"] = per_index
+        return out
 
     def index_stats(self, index: str = "_all") -> dict:
         out: dict[str, Any] = {"indices": {}}

@@ -936,8 +936,20 @@ def forcemerge(node: TpuNode, params, query, body):
 # -- cluster / stats ---------------------------------------------------------
 
 
+_HEALTH_RANK = {"green": 0, "yellow": 1, "red": 2}
+
+
 def cluster_health(node: TpuNode, params, query, body):
-    return 200, node.cluster_health()
+    resp = node.cluster_health(params.get("index"),
+                               level=str(query.get("level", "cluster")))
+    want = query.get("wait_for_status")
+    if want in _HEALTH_RANK and \
+            _HEALTH_RANK[resp["status"]] > _HEALTH_RANK[want]:
+        # the single-node state is static: an unreachable status times out
+        # immediately (RestClusterHealthAction returns 408 + timed_out)
+        resp = {**resp, "timed_out": True}
+        return 408, resp
+    return 200, resp
 
 
 def get_cluster_settings(node: TpuNode, params, query, body):

@@ -921,8 +921,117 @@ class SegmentExecutor:
     def _exec_range_numeric(self, node: q.RangeQuery) -> NodeResult:
         return self._numeric_range(node.field, node.gte, node.gt, node.lte, node.lt, node.boost)
 
+    def _exec_TermsSetQuery(self, node: q.TermsSetQuery) -> NodeResult:
+        """Per-doc msm: count matching terms against the msm field's value
+        (TermsSetQueryBuilder -> CoveringQuery)."""
+        field = node.field
+        mapper = self.ctx.mapper_service.field_mapper(field)
+        kf_host = self.host.keyword_fields.get(field)
+        counts = np.zeros(self.host.n_docs, np.int64)
+        if kf_host is not None:
+            for v in node.terms:
+                val = self._normalize_kw(field, str(v))
+                o = kf_host.ord_dict.get(val)
+                if o is None:
+                    continue
+                sel = kf_host.mv_ords == o
+                np.add.at(counts, kf_host.mv_docs[sel], 1)
+        elif mapper is not None and mapper.type == "text":
+            tf_host = self.host.text_fields.get(field)
+            if tf_host is not None:
+                for v in node.terms:
+                    tid = tf_host.term_dict.get(str(v))
+                    if tid is None:
+                        continue
+                    off = int(tf_host.term_offsets[tid])
+                    end = int(tf_host.term_offsets[tid + 1])
+                    counts[tf_host.postings_docs[off:end]] += 1
+        if node.minimum_should_match_field:
+            nf = self.host.numeric_fields.get(node.minimum_should_match_field)
+            if nf is None:
+                return _empty(self.dev)
+            msm = np.where(
+                nf.present[: self.host.n_docs],
+                (nf.values_i64 if nf.kind == "int" else nf.values_f64)[
+                    : self.host.n_docs],
+                np.iinfo(np.int32).max,
+            )
+        elif node.minimum_should_match_script:
+            from opensearch_tpu.script import default_script_service
+
+            src = str(node.minimum_should_match_script.get("source", ""))
+            # common pattern: params.num_terms or a constant
+            if "num_terms" in src:
+                msm = np.full(self.host.n_docs, len(node.terms))
+            else:
+                try:
+                    msm = np.full(self.host.n_docs, int(float(src)))
+                except ValueError:
+                    msm = np.full(self.host.n_docs, 1)
+        else:
+            raise IllegalArgumentException(
+                "[terms_set] requires [minimum_should_match_field] or "
+                "[minimum_should_match_script]"
+            )
+        mask_host = np.zeros(self.dev.n_pad, bool)
+        mask_host[: self.host.n_docs] = (counts >= msm) & (counts > 0)
+        return _const_result(
+            jnp.asarray(mask_host) & self.dev.live, node.boost, scoring=True
+        )
+
+    def _exec_DistanceFeatureQuery(self, node: q.DistanceFeatureQuery) -> NodeResult:
+        """score = boost * pivot / (pivot + distance(origin, value))."""
+        field = node.field
+        mapper = self.ctx.mapper_service.field_mapper(field)
+        n = self.host.n_docs
+        lat_f = self.host.numeric_fields.get(f"{field}#lat")
+        if mapper is not None and mapper.type == "geo_point" \
+                or lat_f is not None:
+            lon_f = self.host.numeric_fields.get(f"{field}#lon")
+            if lat_f is None or lon_f is None:
+                return _empty(self.dev)
+            o_lat, o_lon = _parse_geo_origin(node.origin)
+            pivot_m = _parse_distance_meters(node.pivot)
+            lat = lat_f.values_f64[:n]
+            lon = lon_f.values_f64[:n]
+            dist = _haversine_m(o_lat, o_lon, lat, lon)
+            present = lat_f.present[:n]
+            score = np.where(present, pivot_m / (pivot_m + dist), 0.0)
+        else:
+            nf = self.host.numeric_fields.get(field)
+            if nf is None:
+                return _empty(self.dev)
+            is_date = mapper is not None and mapper.type == "date"
+            if is_date:
+                origin = float(_parse_date_or_now(node.origin))
+                pivot = float(_duration_millis(node.pivot))
+            else:
+                origin = float(node.origin)
+                pivot = float(node.pivot)
+            vals = (nf.values_i64 if nf.kind == "int" else nf.values_f64)[:n]
+            dist = np.abs(vals.astype(np.float64) - origin)
+            score = np.where(nf.present[:n], pivot / (pivot + dist), 0.0)
+        scores = np.zeros(self.dev.n_pad, np.float32)
+        scores[:n] = score * node.boost
+        mask = jnp.asarray(scores > 0) & self.dev.live
+        return NodeResult(
+            scores=jnp.where(mask, jnp.asarray(scores), 0.0), mask=mask,
+            scoring=True,
+        )
+
     def _exec_ExistsQuery(self, node: q.ExistsQuery) -> NodeResult:
         field = node.field
+        flat = self.ctx.mapper_service.flat_object_parent(field)
+        if flat is not None and self.ctx.mapper_service.mappers.get(field) is None:
+            root, subpath = flat
+            # sub-path exists == any "{subpath}=value" entry in #paths, or
+            # any deeper "{subpath}.x=value" entry
+            r1 = self._exec_PrefixQuery(q.PrefixQuery(
+                field=f"{root}#paths", value=f"{subpath}=", boost=node.boost))
+            r2 = self._exec_PrefixQuery(q.PrefixQuery(
+                field=f"{root}#paths", value=f"{subpath}.", boost=node.boost))
+            return NodeResult(jnp.maximum(r1.scores, r2.scores),
+                              r1.mask | r2.mask, True)
         masks = []
         if field in self.dev.numeric_fields:
             masks.append(self.dev.numeric_fields[field].present)
@@ -1459,6 +1568,59 @@ def _edit_distance_at_most(a: str, b: str, max_d: int) -> bool:
             return False
         prev2, prev = prev, cur
     return prev[lb] <= max_d
+
+
+def _parse_geo_origin(origin: Any) -> tuple[float, float]:
+    """(lat, lon) from the geo_point literal forms."""
+    if isinstance(origin, dict) and "lat" in origin and "lon" in origin:
+        return float(origin["lat"]), float(origin["lon"])
+    if isinstance(origin, list) and len(origin) >= 2:
+        return float(origin[1]), float(origin[0])  # [lon, lat]
+    if isinstance(origin, str) and "," in origin:
+        parts = origin.split(",")
+        return float(parts[0]), float(parts[1])
+    raise IllegalArgumentException(f"invalid geo origin [{origin!r}]")
+
+
+def _parse_distance_meters(v: Any) -> float:
+    """"5km" / "500m" / "1mi" ... -> meters (DistanceUnit)."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    m = re.fullmatch(
+        r"\s*(\d+(?:\.\d+)?)\s*(mm|cm|m|km|mi|miles|yd|ft|in|nmi|NM)\s*",
+        str(v),
+    )
+    if not m:
+        raise IllegalArgumentException(f"invalid distance [{v}]")
+    mult = {"mm": 0.001, "cm": 0.01, "m": 1.0, "km": 1000.0,
+            "mi": 1609.344, "miles": 1609.344, "yd": 0.9144,
+            "ft": 0.3048, "in": 0.0254, "nmi": 1852.0, "NM": 1852.0}
+    return float(m.group(1)) * mult[m.group(2)]
+
+
+def _haversine_m(lat1: float, lon1: float, lat2, lon2):
+    """Great-circle distance in meters (GeoUtils.arcDistance)."""
+    r = 6371008.8
+    p1, p2 = np.radians(lat1), np.radians(lat2)
+    dp = p2 - p1
+    dl = np.radians(lon2) - np.radians(lon1)
+    a = np.sin(dp / 2.0) ** 2 + np.cos(p1) * np.cos(p2) * np.sin(dl / 2.0) ** 2
+    return 2.0 * r * np.arcsin(np.sqrt(np.clip(a, 0.0, 1.0)))
+
+
+def _parse_date_or_now(v: Any) -> int:
+    """Date literal or date-math anchored at now ("now", "now-7d")."""
+    import time as _time
+
+    s = str(v).strip()
+    if s.startswith("now"):
+        base = int(_time.time() * 1000)
+        rest = s[3:]
+        if not rest:
+            return base
+        sign = 1 if rest[0] == "+" else -1
+        return base + sign * _duration_millis(rest[1:].split("/")[0])
+    return parse_date_millis(v)
 
 
 def _duration_millis(v: Any) -> int:

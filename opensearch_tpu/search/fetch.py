@@ -336,6 +336,21 @@ def fields_option_for_doc(
     import fnmatch
 
     flat = _flatten_source(source)
+    # fields mapped as ranges keep their object shape ({gte, lte}) instead
+    # of flattening, and malformed-ignored values are omitted
+    from opensearch_tpu.index.mapper import RANGE_TYPES
+
+    for fname in list(source):
+        m = ms.field_mapper(fname)
+        if m is not None and m.type in RANGE_TYPES:
+            flat = {k: v for k, v in flat.items()
+                    if not k.startswith(f"{fname}.")}
+            flat[fname] = source[fname]
+    ig = host.keyword_fields.get("_ignored")
+    ignored: set = set()
+    if ig is not None:
+        s_, e_ = int(ig.mv_offsets[doc]), int(ig.mv_offsets[doc + 1])
+        ignored = {ig.ord_values[int(o)] for o in ig.mv_ords[s_:e_]}
     out: dict[str, list] = {}
     for spec in specs:
         if isinstance(spec, str):
@@ -348,8 +363,8 @@ def fields_option_for_doc(
         for key, val in flat.items():
             if fnmatch.fnmatch(key, pattern):
                 matched = True
-                if key in out:
-                    continue  # overlapping request patterns: first spec wins
+                if key in out or key in ignored:
+                    continue  # first spec wins; _ignored values are absent
                 vals = val if isinstance(val, list) else [val]
                 mapper = ms.field_mapper(key)
                 if mapper is not None and mapper.type == "date" and fmt:

@@ -111,6 +111,27 @@ class ExistsQuery(QueryNode):
 
 
 @dataclass
+class TermsSetQuery(QueryNode):
+    """terms_set (TermsSetQueryBuilder): per-doc minimum-should-match from
+    a field or a script."""
+
+    field: str = ""
+    terms: list = dc_field(default_factory=list)
+    minimum_should_match_field: str | None = None
+    minimum_should_match_script: dict | None = None
+
+
+@dataclass
+class DistanceFeatureQuery(QueryNode):
+    """distance_feature (DistanceFeatureQueryBuilder): score decays with
+    distance from origin; boost * pivot / (pivot + distance)."""
+
+    field: str = ""
+    origin: Any = None
+    pivot: Any = None
+
+
+@dataclass
 class IdsQuery(QueryNode):
     values: list[str] = dc_field(default_factory=list)
 
@@ -526,6 +547,32 @@ def _parse_range(body: dict) -> QueryNode:
                       boost=float(conf.get("boost", 1.0)))
 
 
+def _parse_terms_set(body: dict) -> QueryNode:
+    fname, conf = _single_kv(body, "terms_set")
+    if not isinstance(conf, dict) or "terms" not in conf:
+        raise ParsingException("[terms_set] requires [terms]")
+    return TermsSetQuery(
+        field=fname,
+        terms=list(conf["terms"]),
+        minimum_should_match_field=conf.get("minimum_should_match_field"),
+        minimum_should_match_script=conf.get("minimum_should_match_script"),
+        boost=float(conf.get("boost", 1.0)),
+    )
+
+
+def _parse_distance_feature(body: dict) -> QueryNode:
+    if not isinstance(body, dict) or "field" not in body:
+        raise ParsingException("[distance_feature] requires [field]")
+    if "origin" not in body or "pivot" not in body:
+        raise ParsingException(
+            "[distance_feature] requires [origin] and [pivot]"
+        )
+    return DistanceFeatureQuery(
+        field=str(body["field"]), origin=body["origin"],
+        pivot=body["pivot"], boost=float(body.get("boost", 1.0)),
+    )
+
+
 def _parse_exists(body: dict) -> QueryNode:
     return ExistsQuery(field=str(body["field"]), boost=float(body.get("boost", 1.0)))
 
@@ -902,6 +949,8 @@ _PARSERS = {
     "terms": _parse_terms,
     "range": _parse_range,
     "exists": _parse_exists,
+    "terms_set": _parse_terms_set,
+    "distance_feature": _parse_distance_feature,
     "ids": _parse_ids,
     "bool": _parse_bool,
     "constant_score": _parse_constant_score,

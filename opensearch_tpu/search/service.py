@@ -21,7 +21,10 @@ from typing import Any
 
 import numpy as np
 
-from opensearch_tpu.common.errors import ParsingException
+from opensearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ParsingException,
+)
 from opensearch_tpu.index.shard import IndexShard
 from opensearch_tpu.search import fetch, query_dsl
 from opensearch_tpu.search.aggs import compute_aggs
@@ -318,10 +321,38 @@ def search(
     page = merged[from_ : from_ + size]
 
     # ---- fetch phase (only winning docs; sub-phase chain in fetch.py) ----
-    source_filter = _source_filter(body.get("_source", True))
+    fields_specs = body.get("fields")
+    stored_specs = body.get("stored_fields")
+    if isinstance(stored_specs, str):
+        stored_specs = [stored_specs]
+    if stored_specs == ["_none_"]:
+        stored_specs = None
+    if fields_specs:
+        for sh in shards:
+            if not sh.mapper_service._source_enabled:
+                raise IllegalArgumentException(
+                    f"Unable to retrieve the requested [fields] since "
+                    f"_source is disabled in the mappings for index "
+                    f"[{sh.shard_id.index}]"
+                )
+        for spec in fields_specs:
+            if isinstance(spec, dict) and spec.get("format"):
+                fname = spec.get("field", "")
+                for sh in shards:
+                    m = sh.mapper_service.field_mapper(fname)
+                    if m is not None and m.type not in ("date",):
+                        raise IllegalArgumentException(
+                            f"Field [{fname}] of type "
+                            f"[{m.original_type or m.type}] doesn't "
+                            f"support formats."
+                        )
+    # stored_fields without an explicit _source suppresses _source in hits
+    # (RestSearchAction's storedFieldsContext default)
+    _src_spec = body.get("_source", False if stored_specs is not None
+                         else True)
+    source_filter = _source_filter(_src_spec)
     highlight_conf = body.get("highlight")
     docvalue_specs = body.get("docvalue_fields")
-    fields_specs = body.get("fields")
     want_explain = bool(body.get("explain"))
     want_version = bool(body.get("version"))
     want_seqno = bool(body.get("seq_no_primary_term"))
@@ -391,6 +422,18 @@ def search(
             fv = fetch.fields_option_for_doc(fields_specs, raw_source, host, h.doc, ms)
             if fv:
                 hit.setdefault("fields", {}).update(fv)
+        if stored_specs:
+            # explicitly stored fields surface under "fields" (stored-field
+            # loading reads the segment columns in this engine)
+            for sf in stored_specs:
+                if sf in ("_source", "_id", "_routing", "*"):
+                    continue
+                m_sf = ms.field_mapper(sf)
+                if m_sf is None or not m_sf.store:
+                    continue
+                vals = fetch._doc_column_values(host, h.doc, sf, ms, None)
+                if vals:
+                    hit.setdefault("fields", {})[sf] = vals
         if highlight_conf:
             hl = fetch.compute_highlight(highlight_conf, preds_by_field, raw_source, ms)
             if hl:

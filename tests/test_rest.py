@@ -151,7 +151,9 @@ def test_msearch(server):
 
 def test_cluster_and_cat_apis(server):
     status, body = _req("GET", "/_cluster/health")
-    assert status == 200 and body["status"] == "green"
+    # single node: configured replicas are unassigned -> yellow, the
+    # reference's single-node default
+    assert status == 200 and body["status"] in ("green", "yellow")
     status, body = _req("GET", "/_cluster/stats")
     assert body["nodes"]["count"]["total"] == 1
     status, body = _req("GET", "/_cat/indices?format=json")
