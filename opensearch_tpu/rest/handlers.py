@@ -132,6 +132,19 @@ def build_router() -> Router:
     reg("POST", "/{index}/_analyze", analyze_index)
     reg("GET", "/_analyze", analyze_global)
     reg("POST", "/_analyze", analyze_global)
+    # stored scripts + search templates (lang-mustache module analog)
+    reg("PUT", "/_scripts/{id}", put_stored_script)
+    reg("POST", "/_scripts/{id}", put_stored_script)
+    reg("GET", "/_scripts/{id}", get_stored_script)
+    reg("DELETE", "/_scripts/{id}", delete_stored_script)
+    reg("GET", "/_search/template", search_template_all)
+    reg("POST", "/_search/template", search_template_all)
+    reg("GET", "/{index}/_search/template", search_template)
+    reg("POST", "/{index}/_search/template", search_template)
+    reg("GET", "/_render/template", render_template)
+    reg("POST", "/_render/template", render_template)
+    reg("GET", "/_render/template/{id}", render_template)
+    reg("POST", "/_render/template/{id}", render_template)
     # search pipelines
     reg("PUT", "/_search/pipeline/{id}", put_search_pipeline)
     reg("GET", "/_search/pipeline", get_search_pipelines)
@@ -653,6 +666,41 @@ def search_all(node: TpuNode, params, query, body):
                        scroll=query.get("scroll"),
                        search_pipeline=query.get("search_pipeline"))
     return 200, _totals_as_int(resp, query)
+
+
+def put_stored_script(node: TpuNode, params, query, body):
+    return 200, node.put_stored_script(params["id"], body or {})
+
+
+def get_stored_script(node: TpuNode, params, query, body):
+    resp = node.get_stored_script(params["id"])
+    return (200 if resp.get("found") else 404), resp
+
+
+def delete_stored_script(node: TpuNode, params, query, body):
+    return 200, node.delete_stored_script(params["id"])
+
+
+def search_template(node: TpuNode, params, query, body):
+    resp = node.search_template(
+        params["index"], body or {}, scroll=query.get("scroll"),
+        search_pipeline=query.get("search_pipeline"),
+    )
+    return 200, _totals_as_int(resp, query)
+
+
+def search_template_all(node: TpuNode, params, query, body):
+    resp = node.search_template(
+        None, body or {}, scroll=query.get("scroll"),
+        search_pipeline=query.get("search_pipeline"),
+    )
+    return 200, _totals_as_int(resp, query)
+
+
+def render_template(node: TpuNode, params, query, body):
+    return 200, {"template_output": node.render_search_template(
+        body or {}, params.get("id")
+    )}
 
 
 def rank_eval_handler(node: TpuNode, params, query, body):

@@ -1019,6 +1019,45 @@ class SegmentExecutor:
             scoring=True,
         )
 
+    def _geo_columns(self, field: str):
+        lat_f = self.host.numeric_fields.get(f"{field}#lat")
+        lon_f = self.host.numeric_fields.get(f"{field}#lon")
+        if lat_f is None or lon_f is None:
+            return None
+        n = self.host.n_docs
+        return (lat_f.values_f64[:n], lon_f.values_f64[:n],
+                lat_f.present[:n])
+
+    def _exec_GeoDistanceQuery(self, node: q.GeoDistanceQuery) -> NodeResult:
+        cols = self._geo_columns(node.field)
+        if cols is None:
+            return _empty(self.dev)
+        lat, lon, present = cols
+        o_lat, o_lon = _parse_geo_origin(node.point)
+        radius = _parse_distance_meters(node.distance)
+        dist = _haversine_m(o_lat, o_lon, lat, lon)
+        mask_host = np.zeros(self.dev.n_pad, bool)
+        mask_host[: self.host.n_docs] = present & (dist <= radius)
+        return _const_result(jnp.asarray(mask_host) & self.dev.live,
+                             node.boost, scoring=True)
+
+    def _exec_GeoBoundingBoxQuery(self, node: q.GeoBoundingBoxQuery) -> NodeResult:
+        cols = self._geo_columns(node.field)
+        if cols is None:
+            return _empty(self.dev)
+        lat, lon, present = cols
+        tl_lat, tl_lon = _parse_geo_origin(node.top_left)
+        br_lat, br_lon = _parse_geo_origin(node.bottom_right)
+        sel = present & (lat <= tl_lat) & (lat >= br_lat)
+        if tl_lon <= br_lon:
+            sel = sel & (lon >= tl_lon) & (lon <= br_lon)
+        else:  # box crossing the antimeridian
+            sel = sel & ((lon >= tl_lon) | (lon <= br_lon))
+        mask_host = np.zeros(self.dev.n_pad, bool)
+        mask_host[: self.host.n_docs] = sel
+        return _const_result(jnp.asarray(mask_host) & self.dev.live,
+                             node.boost, scoring=True)
+
     def _exec_ExistsQuery(self, node: q.ExistsQuery) -> NodeResult:
         field = node.field
         flat = self.ctx.mapper_service.flat_object_parent(field)

@@ -122,6 +122,25 @@ class TermsSetQuery(QueryNode):
 
 
 @dataclass
+class GeoDistanceQuery(QueryNode):
+    """geo_distance (GeoDistanceQueryBuilder): docs within `distance` of a
+    center point."""
+
+    field: str = ""
+    distance: Any = None
+    point: Any = None             # {lat, lon} | [lon, lat] | "lat,lon"
+
+
+@dataclass
+class GeoBoundingBoxQuery(QueryNode):
+    """geo_bounding_box (GeoBoundingBoxQueryBuilder)."""
+
+    field: str = ""
+    top_left: Any = None
+    bottom_right: Any = None
+
+
+@dataclass
 class DistanceFeatureQuery(QueryNode):
     """distance_feature (DistanceFeatureQueryBuilder): score decays with
     distance from origin; boost * pivot / (pivot + distance)."""
@@ -560,6 +579,55 @@ def _parse_terms_set(body: dict) -> QueryNode:
     )
 
 
+def _parse_geo_distance(body: dict) -> QueryNode:
+    conf = dict(body)
+    distance = conf.pop("distance", None)
+    boost = float(conf.pop("boost", 1.0))
+    conf.pop("distance_type", None)
+    conf.pop("validation_method", None)
+    conf.pop("_name", None)
+    if distance is None or len(conf) != 1:
+        raise ParsingException(
+            "[geo_distance] requires [distance] and exactly one field"
+        )
+    fname, point = next(iter(conf.items()))
+    return GeoDistanceQuery(field=fname, distance=distance, point=point,
+                            boost=boost)
+
+
+def _parse_geo_bounding_box(body: dict) -> QueryNode:
+    conf = dict(body)
+    boost = float(conf.pop("boost", 1.0))
+    conf.pop("validation_method", None)
+    conf.pop("type", None)
+    conf.pop("_name", None)
+    if len(conf) != 1:
+        raise ParsingException(
+            "[geo_bounding_box] requires exactly one field"
+        )
+    fname, box = next(iter(conf.items()))
+    if not isinstance(box, dict):
+        raise ParsingException("[geo_bounding_box] field body must be an object")
+    tl = box.get("top_left")
+    br = box.get("bottom_right")
+    if tl is None or br is None:
+        # corner-list form {"top_right": .., "bottom_left": ..} or wkt
+        tr, bl = box.get("top_right"), box.get("bottom_left")
+        if tr is not None and bl is not None:
+            from opensearch_tpu.search.executor import _parse_geo_origin
+
+            tr_lat, tr_lon = _parse_geo_origin(tr)
+            bl_lat, bl_lon = _parse_geo_origin(bl)
+            tl = {"lat": tr_lat, "lon": bl_lon}
+            br = {"lat": bl_lat, "lon": tr_lon}
+        else:
+            raise ParsingException(
+                "[geo_bounding_box] requires [top_left] and [bottom_right]"
+            )
+    return GeoBoundingBoxQuery(field=fname, top_left=tl, bottom_right=br,
+                               boost=boost)
+
+
 def _parse_distance_feature(body: dict) -> QueryNode:
     if not isinstance(body, dict) or "field" not in body:
         raise ParsingException("[distance_feature] requires [field]")
@@ -951,6 +1019,8 @@ _PARSERS = {
     "exists": _parse_exists,
     "terms_set": _parse_terms_set,
     "distance_feature": _parse_distance_feature,
+    "geo_distance": _parse_geo_distance,
+    "geo_bounding_box": _parse_geo_bounding_box,
     "ids": _parse_ids,
     "bool": _parse_bool,
     "constant_score": _parse_constant_score,

@@ -1,0 +1,82 @@
+"""Search templates (mustache subset), stored scripts, geo queries."""
+
+import pytest
+
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.script.mustache import render, render_search_template
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = TpuNode(tmp_path / "node")
+    yield n
+    n.close()
+
+
+def test_mustache_basics():
+    assert render("{{a}}/{{b.c}}", {"a": 1, "b": {"c": "x"}}) == "1/x"
+    assert render("{{#toJson}}v{{/toJson}}", {"v": [1, 2]}) == "[1, 2]"
+    assert render("{{#join}}v{{/join}}", {"v": ["a", "b"]}) == "a,b"
+    assert render("{{#on}}yes{{/on}}{{^on}}no{{/on}}", {"on": True}) == "yes"
+    assert render("{{#on}}yes{{/on}}{{^on}}no{{/on}}", {"on": False}) == "no"
+
+
+def test_search_template_end_to_end(node):
+    node.create_index("logs", {"mappings": {"properties": {
+        "level": {"type": "keyword"}}}})
+    node.index_doc("logs", "1", {"level": "error"}, refresh=True)
+    node.index_doc("logs", "2", {"level": "info"}, refresh=True)
+
+    body = {
+        "source": {"query": {"term": {"level": "{{lvl}}"}}},
+        "params": {"lvl": "error"},
+    }
+    resp = node.search_template("logs", body)
+    assert resp["hits"]["total"]["value"] == 1
+
+    # stored template
+    node.put_stored_script("by_level", {"script": {
+        "lang": "mustache",
+        "source": '{"query": {"term": {"level": "{{lvl}}"}}}',
+    }})
+    resp = node.search_template("logs", {"id": "by_level",
+                                         "params": {"lvl": "info"}})
+    assert resp["hits"]["total"]["value"] == 1
+    rendered = node.render_search_template(
+        {"id": "by_level", "params": {"lvl": "x"}})
+    assert rendered == {"query": {"term": {"level": "x"}}}
+    assert node.get_stored_script("by_level")["found"]
+    node.delete_stored_script("by_level")
+    assert not node.get_stored_script("by_level")["found"]
+
+
+def test_geo_queries(node):
+    node.create_index("places", {"mappings": {"properties": {
+        "location": {"type": "geo_point"}}}})
+    # Berlin, Paris, Sydney
+    node.index_doc("places", "berlin",
+                   {"location": {"lat": 52.52, "lon": 13.405}}, refresh=True)
+    node.index_doc("places", "paris",
+                   {"location": [2.3522, 48.8566]}, refresh=True)
+    node.index_doc("places", "sydney",
+                   {"location": "-33.8688,151.2093"}, refresh=True)
+
+    # ~880km Berlin-Paris: 1000km radius around Berlin finds both
+    resp = node.search("places", {"query": {"geo_distance": {
+        "distance": "1000km", "location": {"lat": 52.52, "lon": 13.405}}}})
+    ids = {h["_id"] for h in resp["hits"]["hits"]}
+    assert ids == {"berlin", "paris"}
+
+    resp = node.search("places", {"query": {"geo_bounding_box": {
+        "location": {"top_left": {"lat": 55.0, "lon": 0.0},
+                     "bottom_right": {"lat": 45.0, "lon": 20.0}}}}})
+    ids = {h["_id"] for h in resp["hits"]["hits"]}
+    assert ids == {"berlin", "paris"}
+
+    # distance_feature scores closer docs higher
+    resp = node.search("places", {"query": {"distance_feature": {
+        "field": "location", "origin": {"lat": 52.0, "lon": 13.0},
+        "pivot": "500km"}}})
+    hits = resp["hits"]["hits"]
+    assert hits[0]["_id"] == "berlin"
+    assert {h["_id"] for h in hits} == {"berlin", "paris", "sydney"}
