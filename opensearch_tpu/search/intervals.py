@@ -64,6 +64,21 @@ class ExpandSource(IntervalSource):
 
 
 @dataclass
+class TermSource(IntervalSource):
+    """Single un-analyzed term (span_term's literal semantics)."""
+
+    term: str = ""
+
+
+@dataclass
+class FirstSource(IntervalSource):
+    """span_first: intervals ending at position < end."""
+
+    source: IntervalSource | None = None
+    end: int = 0
+
+
+@dataclass
 class AllOfSource(IntervalSource):
     sources: list[IntervalSource] = dc_field(default_factory=list)
     mode: str = "unordered"
@@ -248,6 +263,11 @@ class IntervalContext:
         out: set[str] = set()
         if isinstance(src, MatchSource):
             out.update(self.analyze(src.query, src.analyzer))
+        elif isinstance(src, TermSource):
+            out.add(src.term)
+        elif isinstance(src, FirstSource):
+            if src.source is not None:
+                out.update(self.leaf_terms(src.source))
         elif isinstance(src, ExpandSource):
             out.update(self.expand(src))
         elif isinstance(src, (AllOfSource, AnyOfSource)):
@@ -386,7 +406,12 @@ def evaluate(
     src: IntervalSource, ctx: IntervalContext, doc: int
 ) -> list[Interval]:
     """Minimal intervals of `src` in local doc `doc`."""
-    if isinstance(src, MatchSource):
+    if isinstance(src, TermSource):
+        out = _minimal([(int(p), int(p)) for p in ctx.positions(src.term, doc)])
+    elif isinstance(src, FirstSource):
+        inner = evaluate(src.source, ctx, doc) if src.source else []
+        out = [iv for iv in inner if iv[1] < src.end]
+    elif isinstance(src, MatchSource):
         terms = ctx.analyze(src.query, src.analyzer)
         if not terms:
             out = []

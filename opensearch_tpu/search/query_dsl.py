@@ -469,6 +469,113 @@ def _parse_match_phrase(body: dict) -> QueryNode:
     return MatchPhraseQuery(field=fname, query=str(conf))
 
 
+def _parse_span_source(qtype: str, body: Any) -> tuple[str, Any]:
+    """(field, IntervalSource) for one span_* clause. Span queries are the
+    reference's position-query family (index/query/Span*QueryBuilder);
+    here they lower onto the minimal-interval algebra the intervals query
+    already evaluates against position postings."""
+    from opensearch_tpu.search import intervals as iv
+
+    if qtype == "span_term":
+        fname, conf = _single_kv(body, "span_term")
+        value = conf.get("value") if isinstance(conf, dict) else conf
+        boost = float(conf.get("boost", 1.0)) if isinstance(conf, dict) else 1.0
+        _ = boost
+        return fname, iv.TermSource(term=str(value))
+    if qtype in ("span_near", "span_or"):
+        clauses = body.get("clauses")
+        if not isinstance(clauses, list) or not clauses:
+            raise ParsingException(f"[{qtype}] requires [clauses]")
+        parsed = []
+        field = None
+        for c in clauses:
+            if not isinstance(c, dict) or len(c) != 1:
+                raise ParsingException(f"[{qtype}] clause must be a span query")
+            ctype, cbody = next(iter(c.items()))
+            f, src = _parse_span_source(ctype, cbody)
+            field = field or f
+            if f != field:
+                raise ParsingException(
+                    "span clauses must target the same field"
+                )
+            parsed.append(src)
+        if qtype == "span_or":
+            return field, iv.AnyOfSource(sources=parsed)
+        in_order = bool(body.get("in_order", True))
+        slop = int(body.get("slop", 0))
+        return field, iv.AllOfSource(
+            sources=parsed, mode="ordered" if in_order else "unordered",
+            max_gaps=slop,
+        )
+    if qtype == "span_first":
+        match = body.get("match")
+        if not isinstance(match, dict) or len(match) != 1:
+            raise ParsingException("[span_first] requires [match]")
+        ctype, cbody = next(iter(match.items()))
+        field, src = _parse_span_source(ctype, cbody)
+        return field, iv.FirstSource(source=src, end=int(body.get("end", 0)))
+    if qtype in ("span_containing", "span_within"):
+        big = body.get("big")
+        little = body.get("little")
+        if not isinstance(big, dict) or not isinstance(little, dict):
+            raise ParsingException(f"[{qtype}] requires [big] and [little]")
+        bf, bsrc = _parse_span_source(*next(iter(big.items())))
+        lf, lsrc = _parse_span_source(*next(iter(little.items())))
+        if bf != lf:
+            raise ParsingException("span clauses must target the same field")
+        if qtype == "span_containing":
+            bsrc.filter = iv.IntervalFilter("containing", lsrc)
+            return bf, bsrc
+        lsrc.filter = iv.IntervalFilter("contained_by", bsrc)
+        return lf, lsrc
+    if qtype == "span_not":
+        include = body.get("include")
+        exclude = body.get("exclude")
+        if not isinstance(include, dict) or not isinstance(exclude, dict):
+            raise ParsingException(
+                "[span_not] requires [include] and [exclude]"
+            )
+        inf, insrc = _parse_span_source(*next(iter(include.items())))
+        exf, exsrc = _parse_span_source(*next(iter(exclude.items())))
+        if inf != exf:
+            raise ParsingException("span clauses must target the same field")
+        insrc.filter = iv.IntervalFilter("not_overlapping", exsrc)
+        return inf, insrc
+    if qtype == "span_multi":
+        match = body.get("match")
+        if not isinstance(match, dict) or len(match) != 1:
+            raise ParsingException("[span_multi] requires [match]")
+        mtype, mbody = next(iter(match.items()))
+        if mtype not in ("prefix", "wildcard", "fuzzy", "regexp"):
+            raise ParsingException(
+                f"[span_multi] does not support [{mtype}]"
+            )
+        fname, conf = _single_kv(mbody, mtype)
+        if isinstance(conf, dict):
+            value = conf.get("value", conf.get(mtype, conf.get("wildcard")))
+            ci = bool(conf.get("case_insensitive", False))
+            fuzz = conf.get("fuzziness", "AUTO")
+            plen = int(conf.get("prefix_length", 0))
+        else:
+            value, ci, fuzz, plen = conf, False, "AUTO", 0
+        kind = {"prefix": "prefix", "wildcard": "wildcard",
+                "fuzzy": "fuzzy", "regexp": "regexp"}[mtype]
+        return fname, iv.ExpandSource(
+            kind=kind, pattern=str(value), case_insensitive=ci,
+            fuzziness=fuzz, prefix_length=plen,
+        )
+    raise ParsingException(f"unknown span query [{qtype}]")
+
+
+def _parse_span_query(qtype: str):
+    def parse(body: dict) -> QueryNode:
+        field, src = _parse_span_source(qtype, body)
+        boost = float(body.get("boost", 1.0)) if isinstance(body, dict) else 1.0
+        return IntervalsQuery(field=field, source=src, boost=boost)
+
+    return parse
+
+
 def _parse_intervals(body: dict) -> QueryNode:
     from opensearch_tpu.search import intervals as iv
 
@@ -1012,6 +1119,14 @@ _PARSERS = {
     "match": _parse_match,
     "match_phrase": _parse_match_phrase,
     "intervals": _parse_intervals,
+    "span_term": _parse_span_query("span_term"),
+    "span_near": _parse_span_query("span_near"),
+    "span_or": _parse_span_query("span_or"),
+    "span_first": _parse_span_query("span_first"),
+    "span_not": _parse_span_query("span_not"),
+    "span_containing": _parse_span_query("span_containing"),
+    "span_within": _parse_span_query("span_within"),
+    "span_multi": _parse_span_query("span_multi"),
     "multi_match": _parse_multi_match,
     "term": _parse_term,
     "terms": _parse_terms,

@@ -1,0 +1,83 @@
+"""Span query family lowered onto the interval algebra."""
+
+import pytest
+
+from opensearch_tpu.node import TpuNode
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = TpuNode(tmp_path / "node")
+    n.create_index("t", {"mappings": {"properties": {
+        "body": {"type": "text"}}}})
+    docs = {
+        "1": "the quick brown fox jumps over the lazy dog",
+        "2": "quick dogs jump over brown foxes",
+        "3": "the fox is quick and brown",
+    }
+    for did, text in docs.items():
+        n.index_doc("t", did, {"body": text}, refresh=True)
+    yield n
+    n.close()
+
+
+def _ids(resp):
+    return {h["_id"] for h in resp["hits"]["hits"]}
+
+
+def test_span_term(node):
+    resp = node.search("t", {"query": {"span_term": {"body": "fox"}}})
+    assert _ids(resp) == {"1", "3"}
+
+
+def test_span_near_ordered(node):
+    q = {"span_near": {"clauses": [
+        {"span_term": {"body": "quick"}},
+        {"span_term": {"body": "brown"}},
+    ], "slop": 0, "in_order": True}}
+    assert _ids(node.search("t", {"query": q})) == {"1"}
+    # slop 2: doc3 "quick and brown" (1 gap) joins; doc2's 3 gaps stay out
+    q["span_near"]["slop"] = 2
+    assert _ids(node.search("t", {"query": q})) == {"1", "3"}
+    # slop 3 admits doc2's "quick dogs jump over brown"
+    q["span_near"]["slop"] = 3
+    assert _ids(node.search("t", {"query": q})) == {"1", "2", "3"}
+
+
+def test_span_or_and_first(node):
+    q = {"span_or": {"clauses": [
+        {"span_term": {"body": "lazy"}},
+        {"span_term": {"body": "foxes"}},
+    ]}}
+    assert _ids(node.search("t", {"query": q})) == {"1", "2"}
+    # "quick" within the first 2 positions
+    q = {"span_first": {"match": {"span_term": {"body": "quick"}}, "end": 2}}
+    assert _ids(node.search("t", {"query": q})) == {"1", "2"}
+
+
+def test_span_not(node):
+    # fox not near-overlapping with "lazy"-to-"dog" span
+    q = {"span_not": {
+        "include": {"span_term": {"body": "quick"}},
+        "exclude": {"span_near": {"clauses": [
+            {"span_term": {"body": "the"}},
+            {"span_term": {"body": "quick"}},
+        ], "slop": 0, "in_order": True}},
+    }}
+    # doc1: "the quick" overlaps; doc2/3 keep a non-overlapping "quick"
+    assert _ids(node.search("t", {"query": q})) == {"2", "3"}
+
+
+def test_span_multi_and_containing(node):
+    q = {"span_multi": {"match": {"prefix": {"body": "fox"}}}}
+    assert _ids(node.search("t", {"query": q})) == {"1", "2", "3"}
+    q = {"span_containing": {
+        "big": {"span_near": {"clauses": [
+            {"span_term": {"body": "quick"}},
+            {"span_term": {"body": "fox"}},
+        ], "slop": 5, "in_order": False}},
+        "little": {"span_term": {"body": "brown"}},
+    }}
+    # only doc1's minimal quick..fox span (quick brown fox) contains
+    # "brown"; doc3's fox..quick span ends before its "brown"
+    assert _ids(node.search("t", {"query": q})) == {"1"}
