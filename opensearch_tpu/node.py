@@ -174,6 +174,9 @@ class TpuNode:
         from opensearch_tpu.telemetry.tracing import Telemetry
 
         self.telemetry = Telemetry()  # per-node: metrics must not leak
+        from opensearch_tpu.common.monitor import MonitorService
+
+        self.monitor = MonitorService(self.data_path)
         self.search_slowlog = SlowLog("search")
         self.indexing_slowlog = SlowLog("indexing")
         self._configure_slowlogs()

@@ -184,11 +184,33 @@ def build_router() -> Router:
     reg("GET", "/_cluster/stats", cluster_stats)
     reg("GET", "/_stats", all_stats)
     reg("GET", "/{index}/_stats", index_stats)
+    reg("GET", "/_nodes", nodes_info)
     reg("GET", "/_nodes/stats", nodes_stats)
+    reg("GET", "/_nodes/{node_id}/stats", nodes_stats)
+    reg("GET", "/_cat", cat_help)
     reg("GET", "/_cat/indices", cat_indices)
+    reg("GET", "/_cat/indices/{index}", cat_indices)
     reg("GET", "/_cat/health", cat_health)
     reg("GET", "/_cat/shards", cat_shards)
+    reg("GET", "/_cat/shards/{index}", cat_shards)
     reg("GET", "/_cat/count", cat_count)
+    reg("GET", "/_cat/count/{index}", cat_count)
+    reg("GET", "/_cat/aliases", cat_aliases)
+    reg("GET", "/_cat/aliases/{name}", cat_aliases)
+    reg("GET", "/_cat/allocation", cat_allocation)
+    reg("GET", "/_cat/nodes", cat_nodes)
+    reg("GET", "/_cat/master", cat_master)
+    reg("GET", "/_cat/cluster_manager", cat_master)
+    reg("GET", "/_cat/nodeattrs", cat_nodeattrs)
+    reg("GET", "/_cat/plugins", cat_plugins)
+    reg("GET", "/_cat/templates", cat_templates)
+    reg("GET", "/_cat/thread_pool", cat_thread_pool)
+    reg("GET", "/_cat/segments", cat_segments)
+    reg("GET", "/_cat/recovery", cat_recovery)
+    reg("GET", "/_cat/pending_tasks", cat_pending_tasks)
+    reg("GET", "/_cat/repositories", cat_repositories)
+    reg("GET", "/_cat/snapshots/{repo}", cat_snapshots)
+    reg("GET", "/_cat/tasks", cat_tasks)
     return r
 
 
@@ -1032,6 +1054,188 @@ def index_stats(node: TpuNode, params, query, body):
     return 200, node.index_stats(params["index"])
 
 
+_CAT_APIS = [
+    "aliases", "allocation", "cluster_manager", "count", "health",
+    "indices", "master", "nodeattrs", "nodes", "pending_tasks", "plugins",
+    "recovery", "repositories", "segments", "shards", "snapshots",
+    "tasks", "templates", "thread_pool",
+]
+
+
+def cat_help(node: TpuNode, params, query, body):
+    text = "=^.^=\n" + "\n".join(f"/_cat/{a}" for a in _CAT_APIS) + "\n"
+    return 200, text
+
+
+def nodes_info(node: TpuNode, params, query, body):
+    """GET /_nodes (NodesInfoResponse shape, one local node)."""
+    info = node.monitor.info()
+    return 200, {
+        "_nodes": {"total": 1, "successful": 1, "failed": 0},
+        "cluster_name": "opensearch-tpu",
+        "nodes": {
+            "node-0": {
+                "name": node.node_name,
+                "transport_address": "127.0.0.1:9300",
+                "host": "127.0.0.1",
+                "ip": "127.0.0.1",
+                "version": __version__,
+                "build_type": "tpu",
+                "roles": ["cluster_manager", "data", "ingest"],
+                "attributes": {},
+                "os": info["os"],
+                "process": info["process"],
+                "settings": {"node": {"name": node.node_name}},
+                "plugins": [],
+                "modules": [],
+            }
+        },
+    }
+
+
+def cat_aliases(node: TpuNode, params, query, body):
+    rows = []
+    want = params.get("name")
+    for index, svc in sorted(node.indices.items()):
+        for alias, conf in sorted(svc.aliases.items()):
+            if want and alias != want:
+                continue
+            rows.append({
+                "alias": alias,
+                "index": index,
+                "filter": "*" if conf.get("filter") else "-",
+                "routing.index": conf.get("index_routing",
+                                          conf.get("routing", "-")) or "-",
+                "routing.search": conf.get("search_routing",
+                                           conf.get("routing", "-")) or "-",
+                "is_write_index": str(conf.get("is_write_index", "-")).lower(),
+            })
+    return 200, _cat_format(query, rows)
+
+
+def cat_allocation(node: TpuNode, params, query, body):
+    fs = node.monitor.fs_stats()["total"]
+    shards = sum(svc.num_shards for svc in node.indices.values())
+    return 200, _cat_format(query, [{
+        "shards": shards,
+        "disk.total": fs["total_in_bytes"],
+        "disk.avail": fs["available_in_bytes"],
+        "host": "127.0.0.1",
+        "ip": "127.0.0.1",
+        "node": node.node_name,
+    }])
+
+
+def cat_nodes(node: TpuNode, params, query, body):
+    st = node.monitor.stats()
+    return 200, _cat_format(query, [{
+        "ip": "127.0.0.1",
+        "heap.percent": st["os"]["mem"]["used_percent"],
+        "ram.percent": st["os"]["mem"]["used_percent"],
+        "cpu": st["os"]["cpu"]["load_average"]["1m"],
+        "load_1m": st["os"]["cpu"]["load_average"]["1m"],
+        "node.role": "dim",
+        "cluster_manager": "*",
+        "master": "*",
+        "name": node.node_name,
+    }])
+
+
+def cat_master(node: TpuNode, params, query, body):
+    return 200, _cat_format(query, [{
+        "id": "node-0", "host": "127.0.0.1", "ip": "127.0.0.1",
+        "node": node.node_name,
+    }])
+
+
+def cat_nodeattrs(node: TpuNode, params, query, body):
+    return 200, _cat_format(query, [])
+
+
+def cat_plugins(node: TpuNode, params, query, body):
+    return 200, _cat_format(query, [])
+
+
+def cat_templates(node: TpuNode, params, query, body):
+    data = node._load_templates()
+    rows = [
+        {"name": name,
+         "index_patterns": str(t.get("index_patterns", [])),
+         "order": t.get("priority", 0),
+         "version": t.get("version", "-")}
+        for name, t in sorted(data["index_templates"].items())
+    ]
+    return 200, _cat_format(query, rows)
+
+
+def cat_thread_pool(node: TpuNode, params, query, body):
+    rows = [
+        {"node_name": node.node_name, "name": pool, "active": 0,
+         "queue": 0, "rejected": 0}
+        for pool in ("generic", "search", "write", "get", "refresh",
+                     "snapshot")
+    ]
+    return 200, _cat_format(query, rows)
+
+
+def cat_segments(node: TpuNode, params, query, body):
+    rows = []
+    for index, svc in sorted(node.indices.items()):
+        for sid, shard in sorted(svc.shards.items()):
+            for host, _dev in shard.engine._segments:
+                rows.append({
+                    "index": index, "shard": sid, "prirep": "p",
+                    "segment": host.name, "generation": 0,
+                    "docs.count": int(host.live.sum()),
+                    "docs.deleted": host.n_docs - int(host.live.sum()),
+                    "committed": "true", "searchable": "true",
+                })
+    return 200, _cat_format(query, rows)
+
+
+def cat_recovery(node: TpuNode, params, query, body):
+    rows = []
+    for index, svc in sorted(node.indices.items()):
+        for sid in sorted(svc.shards):
+            rows.append({
+                "index": index, "shard": sid, "time": "0s",
+                "type": "empty_store", "stage": "done",
+                "source_node": "-", "target_node": node.node_name,
+            })
+    return 200, _cat_format(query, rows)
+
+
+def cat_pending_tasks(node: TpuNode, params, query, body):
+    return 200, _cat_format(query, [])
+
+
+def cat_repositories(node: TpuNode, params, query, body):
+    rows = [{"id": name, "type": conf.get("type", "fs")}
+            for name, conf in sorted(node.snapshots.repositories.items())]
+    return 200, _cat_format(query, rows)
+
+
+def cat_snapshots(node: TpuNode, params, query, body):
+    snaps = node.snapshots.get_snapshot(params["repo"], "_all")
+    rows = [
+        {"id": sn.get("snapshot"), "status": sn.get("state", "SUCCESS"),
+         "indices": len(sn.get("indices", []))}
+        for sn in snaps.get("snapshots", [])
+    ]
+    return 200, _cat_format(query, rows)
+
+
+def cat_tasks(node: TpuNode, params, query, body):
+    tasks = node.task_manager.list_tasks(None)
+    rows = [
+        {"action": t.action, "task_id": f"{t.node}:{t.id}",
+         "type": "transport", "start_time": t.start_time_millis,
+         "running_time": f"{t.running_time_nanos // 1000000}ms"}
+        for t in tasks
+    ]
+    return 200, _cat_format(query, rows)
+
+
 def nodes_stats(node: TpuNode, params, query, body):
     import resource
 
@@ -1047,7 +1251,10 @@ def nodes_stats(node: TpuNode, params, query, body):
                 "indices": {
                     "docs": {"count": stats["_all"]["primaries"]["docs"]["count"]},
                 },
-                "process": {"max_rss_bytes": usage.ru_maxrss * 1024},
+                "process": {"max_rss_bytes": usage.ru_maxrss * 1024,
+                            **node.monitor.stats()["process"]},
+                "os": node.monitor.stats()["os"],
+                "fs": node.monitor.fs_stats(),
                 "breakers": node.breakers.stats(),
                 "indexing_pressure": node.indexing_pressure.stats(),
                 "search_backpressure": node.search_backpressure.stats(),
