@@ -56,9 +56,19 @@ DYNAMIC_CLUSTER_SETTINGS: dict[str, Callable[[Any], None] | None] = {
     "indices.recovery.max_bytes_per_sec": None,
 }
 
+# prefix-registered settings (affix settings in the reference —
+# Setting.affixKeySetting): any key matching "<prefix>.<name>.<suffix>"
+DYNAMIC_AFFIX_SETTINGS: list[tuple[str, str]] = [
+    ("cluster.remote.", ".seeds"),
+    ("cluster.remote.", ".skip_unavailable"),
+]
+
 
 def validate_settings(flat: dict[str, Any]) -> None:
     for key, value in flat.items():
+        if any(key.startswith(p) and key.endswith(sfx)
+               for p, sfx in DYNAMIC_AFFIX_SETTINGS):
+            continue
         validator = DYNAMIC_CLUSTER_SETTINGS.get(key, "__missing__")
         if validator == "__missing__":
             raise IllegalArgumentException(

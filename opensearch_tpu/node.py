@@ -1627,6 +1627,32 @@ class TpuNode:
             resp["pit_id"] = ctx["id"]
             return resp
         expr = index if index is not None else "_all"
+        # cross-cluster expressions ("alias:pattern") fan out to remote
+        # clusters and merge coordinator-side (TransportSearchAction +
+        # SearchResponseMerger)
+        from opensearch_tpu.cluster.remote import (
+            RemoteClusterService,
+            merge_cross_cluster,
+            split_index_expression,
+        )
+
+        rcs = RemoteClusterService(self)
+        remote_groups, local_parts = split_index_expression(expr)
+        remote_groups = {a: ps for a, ps in remote_groups.items()
+                         if a in rcs.registered()}
+        if remote_groups and scroll is None:
+            remote_resps = {
+                alias: rcs.search_remote(alias, ",".join(patterns), body)
+                for alias, patterns in remote_groups.items()
+            }
+            local_resp = None
+            if local_parts:
+                local_resp = self.search(
+                    ",".join(local_parts), body,
+                    search_pipeline=search_pipeline,
+                    ignore_unavailable=ignore_unavailable,
+                )
+            return merge_cross_cluster(local_resp, remote_resps, body)
         sort_spec = body.get("sort")
         sort_list = [sort_spec] if isinstance(sort_spec, (str, dict)) else (sort_spec or [])
         for s_ in sort_list:

@@ -184,6 +184,7 @@ def build_router() -> Router:
     reg("GET", "/_cluster/stats", cluster_stats)
     reg("GET", "/_stats", all_stats)
     reg("GET", "/{index}/_stats", index_stats)
+    reg("GET", "/_remote/info", remote_info)
     reg("GET", "/_nodes", nodes_info)
     reg("GET", "/_nodes/stats", nodes_stats)
     reg("GET", "/_nodes/{node_id}/stats", nodes_stats)
@@ -1065,6 +1066,12 @@ _CAT_APIS = [
 def cat_help(node: TpuNode, params, query, body):
     text = "=^.^=\n" + "\n".join(f"/_cat/{a}" for a in _CAT_APIS) + "\n"
     return 200, text
+
+
+def remote_info(node: TpuNode, params, query, body):
+    from opensearch_tpu.cluster.remote import RemoteClusterService
+
+    return 200, RemoteClusterService(node).info()
 
 
 def nodes_info(node: TpuNode, params, query, body):

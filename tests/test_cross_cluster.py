@@ -1,0 +1,84 @@
+"""Cross-cluster search: a local node federates a remote node over HTTP."""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.rest.http import HttpServer
+
+REMOTE_PORT = 19277
+
+
+@pytest.fixture()
+def remote(tmp_path):
+    node = TpuNode(tmp_path / "remote")
+    node.create_index("logs", {"mappings": {"properties": {
+        "msg": {"type": "text"}}}})
+    node.index_doc("logs", "r1", {"msg": "remote error event"}, refresh=True)
+    node.index_doc("logs", "r2", {"msg": "remote info event"}, refresh=True)
+    srv = HttpServer(node, "127.0.0.1", REMOTE_PORT)
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        try:
+            loop.run_until_complete(srv.serve_forever())
+        except RuntimeError:
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    for _ in range(100):
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{REMOTE_PORT}/", timeout=1)
+            break
+        except Exception:
+            time.sleep(0.05)
+    yield node
+    loop.call_soon_threadsafe(loop.stop)
+    node.close()
+
+
+@pytest.fixture()
+def local(tmp_path):
+    node = TpuNode(tmp_path / "local")
+    node.create_index("logs", {"mappings": {"properties": {
+        "msg": {"type": "text"}}}})
+    node.index_doc("logs", "l1", {"msg": "local error event"}, refresh=True)
+    yield node
+    node.close()
+
+
+def test_cross_cluster_search(local, remote):
+    local.put_cluster_settings({"persistent": {
+        "cluster": {"remote": {"c2": {
+            "seeds": f"127.0.0.1:{REMOTE_PORT}"}}},
+    }})
+    from opensearch_tpu.cluster.remote import RemoteClusterService
+
+    assert RemoteClusterService(local).registered() == {
+        "c2": [f"127.0.0.1:{REMOTE_PORT}"]}
+
+    # remote-only expression
+    resp = local.search("c2:logs", {"query": {"match": {"msg": "error"}}})
+    assert resp["hits"]["total"]["value"] == 1
+    assert resp["hits"]["hits"][0]["_index"] == "c2:logs"
+    assert resp["_clusters"]["successful"] == 1
+
+    # mixed local + remote
+    resp = local.search("logs,c2:logs",
+                        {"query": {"match": {"msg": "event"}}})
+    assert resp["hits"]["total"]["value"] == 3
+    indices = {h["_index"] for h in resp["hits"]["hits"]}
+    assert indices == {"logs", "c2:logs"}
+    assert resp["_clusters"]["total"] == 2
+
+    # _remote/info surface
+    info = RemoteClusterService(local).info()
+    assert info["c2"]["seeds"] == [f"127.0.0.1:{REMOTE_PORT}"]
