@@ -772,7 +772,12 @@ class SegmentExecutor:
             want = 1 if value in (True, "true", 1) else 0
             return self._numeric_range(field, want, None, want, None, node.boost)
         if ftype == "date":
-            ms = parse_date_millis(value)
+            if mapper.resolution == "nanos":
+                from opensearch_tpu.index.mapper import parse_date_nanos
+
+                ms = parse_date_nanos(value)
+            else:
+                ms = parse_date_millis(value)
             return self._numeric_range(field, ms, None, ms, None, node.boost)
         if ftype in INT_TYPES or ftype in FLOAT_TYPES or ftype is None:
             return self._numeric_range(field, value, None, value, None, node.boost)
@@ -827,10 +832,15 @@ class SegmentExecutor:
             return _empty(self.dev)
         mapper = self.ctx.mapper_service.field_mapper(field)
         is_date = mapper is not None and mapper.type == "date"
+        nanos = is_date and mapper.resolution == "nanos"
 
         def conv(v: Any) -> Any:
             if v is None:
                 return None
+            if nanos:
+                from opensearch_tpu.index.mapper import parse_date_nanos
+
+                return parse_date_nanos(v)
             return parse_date_millis(v) if is_date else v
 
         gte, gt, lte, lt = conv(gte), conv(gt), conv(lte), conv(lt)

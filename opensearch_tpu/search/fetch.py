@@ -290,6 +290,8 @@ def _doc_column_values(host, doc: int, fname: str, ms: MapperService,
         vals = nf.doc_values(doc)
         if nf.kind == "int":
             if mapper is not None and mapper.type == "date":
+                if mapper.resolution == "nanos":
+                    return [_format_date_nanos(int(v), fmt) for v in vals]
                 return [_format_date_ms(int(v), fmt) for v in vals]
             if mapper is not None and mapper.type == "boolean":
                 return [bool(v) for v in vals]
@@ -307,6 +309,18 @@ _JODA_MAP = [
     ("yyyy", "%Y"), ("yy", "%y"), ("MM", "%m"), ("dd", "%d"),
     ("HH", "%H"), ("mm", "%M"), ("ss", "%S"),
 ]
+
+
+def _format_date_nanos(ns_value: int, fmt: str | None) -> Any:
+    """date_nanos doc-value rendering: 9-digit fractional ISO by default
+    (strict_date_optional_time_nanos), epoch_millis as a string."""
+    from datetime import datetime, timezone
+
+    if fmt == "epoch_millis":
+        return str(ns_value // 1_000_000)
+    dt = datetime.fromtimestamp(ns_value // 1_000_000_000, tz=timezone.utc)
+    frac = ns_value % 1_000_000_000
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{frac:09d}".rstrip("0").ljust(3, "0") + "Z"
 
 
 def _format_date_ms(ms_value: int, fmt: str | None) -> Any:

@@ -52,7 +52,12 @@ def _segment_minmax(host, field: str) -> tuple[float, float] | None:
         present = nf.present & host.live[: len(nf.present)]
         if present.any():
             v = vals[present]
-            out = (float(v.min()), float(v.max()))
+            # int columns stay python ints: epoch NANOS overflow float64's
+            # mantissa and a rounded max can wrongly prove "no match"
+            if nf.kind == "int":
+                out = (int(v.min()), int(v.max()))
+            else:
+                out = (float(v.min()), float(v.max()))
         else:
             out = "empty"
     cache[field] = out
@@ -80,9 +85,14 @@ def can_match(snapshot, mapper_service, node: Any) -> bool:
         lo, hi = None, None
         try:
             if mapper.type == "date":
-                from opensearch_tpu.index.mapper import parse_date_millis
+                from opensearch_tpu.index.mapper import (
+                    parse_date_millis,
+                    parse_date_nanos,
+                )
 
-                conv = parse_date_millis
+                conv = (parse_date_nanos
+                        if getattr(mapper, "resolution", "millis") == "nanos"
+                        else parse_date_millis)
             else:
                 conv = float
             if rq.gte is not None:

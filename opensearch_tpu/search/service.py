@@ -806,7 +806,12 @@ def _coerce_search_after(sort: list, search_after: list, ms) -> list:
             out.append(v)
         elif mapper is not None and mapper.type == "date" \
                 and isinstance(v, str):
-            out.append(float(parse_date_millis(v)))
+            if getattr(mapper, "resolution", "millis") == "nanos":
+                from opensearch_tpu.index.mapper import parse_date_nanos
+
+                out.append(parse_date_nanos(v))
+            else:
+                out.append(float(parse_date_millis(v)))
         elif mapper is not None and (
             mapper.type in INT_TYPES or mapper.type in FLOAT_TYPES
             or mapper.type == "boolean"

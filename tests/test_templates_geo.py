@@ -80,3 +80,30 @@ def test_geo_queries(node):
     hits = resp["hits"]["hits"]
     assert hits[0]["_id"] == "berlin"
     assert {h["_id"] for h in hits} == {"berlin", "paris", "sydney"}
+
+
+def test_date_nanos_roundtrip(node):
+    node.create_index("ns", {"mappings": {"properties": {
+        "ts": {"type": "date_nanos"}}}})
+    node.index_doc("ns", "1", {"ts": "2018-10-29T12:12:12.123456789Z"},
+                   refresh=True)
+    node.index_doc("ns", "2", {"ts": "2018-10-29T12:12:12.987654321Z"},
+                   refresh=True)
+    r = node.search("ns", {"sort": [{"ts": "asc"}],
+                           "docvalue_fields": ["ts"]})
+    hits = r["hits"]["hits"]
+    # exact nanosecond sort values and 9-digit doc-value rendering
+    assert hits[0]["sort"] == [1540815132123456789]
+    assert hits[0]["fields"]["ts"] == ["2018-10-29T12:12:12.123456789Z"]
+    # nanosecond-precision range
+    r = node.search("ns", {"query": {"range": {"ts": {
+        "gt": "2018-10-29T12:12:12.123456788Z",
+        "lt": "2018-10-29T12:12:12.123456790Z"}}}})
+    assert r["hits"]["total"]["value"] == 1
+    # out-of-range rejection (before 1970)
+    import pytest as _pytest
+
+    from opensearch_tpu.common.errors import MapperParsingException
+
+    with _pytest.raises(MapperParsingException):
+        node.index_doc("ns", "3", {"ts": "1969-12-31T23:59:59Z"})
