@@ -177,6 +177,11 @@ class TpuNode:
         from opensearch_tpu.common.monitor import MonitorService
 
         self.monitor = MonitorService(self.data_path)
+        from opensearch_tpu.wlm import QueryGroupService
+
+        self.query_groups = QueryGroupService(
+            self.data_path / "query_groups.json"
+        )
         self.search_slowlog = SlowLog("search")
         self.indexing_slowlog = SlowLog("indexing")
         self._configure_slowlogs()
@@ -1593,7 +1598,8 @@ class TpuNode:
     def search(self, index: str | None = None, body: dict | None = None,
                scroll: str | None = None,
                search_pipeline: str | None = None,
-               ignore_unavailable: bool = False) -> dict:
+               ignore_unavailable: bool = False,
+               query_group: str | None = None) -> dict:
         body = dict(body or {})
         # body key is always consumed; an explicit param takes precedence
         body_pipeline = body.pop("search_pipeline", None)
@@ -1690,7 +1696,7 @@ class TpuNode:
                                       shard_filters=shard_filters)
         # per-hit _index comes from each shard's ShardId inside the service
         self.search_backpressure.admit()
-        with self.task_manager.task_scope(
+        with self.query_groups.admit(query_group), self.task_manager.task_scope(
             "indices:data/read/search", description=f"indices[{expr}]"
         ) as task:
             return self._search_with_pipeline(pipeline_id, names, shards, body,

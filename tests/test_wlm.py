@@ -1,0 +1,68 @@
+"""Workload management: query group CRUD + enforced admission."""
+
+import pytest
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentException,
+    RejectedExecutionException,
+    ResourceNotFoundException,
+)
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.wlm import TOTAL_SEARCH_PERMITS
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = TpuNode(tmp_path / "node")
+    yield n
+    n.close()
+
+
+def test_query_group_crud(node):
+    out = node.query_groups.put({
+        "name": "analytics", "resiliency_mode": "enforced",
+        "resource_limits": {"cpu": 0.5},
+    })
+    gid = out["query_group"]["_id"]
+    assert out["query_group"]["name"] == "analytics"
+    got = node.query_groups.get("analytics")
+    assert got["query_groups"][0]["_id"] == gid
+    # update by name keeps the id
+    out2 = node.query_groups.put({
+        "name": "analytics", "resiliency_mode": "soft",
+        "resource_limits": {"cpu": 0.25},
+    })
+    assert out2["query_group"]["_id"] == gid
+    node.query_groups.delete("analytics")
+    with pytest.raises(ResourceNotFoundException):
+        node.query_groups.get("analytics")
+    with pytest.raises(IllegalArgumentException):
+        node.query_groups.put({"name": "x", "resource_limits": {"cpu": 2.0}})
+
+
+def test_enforced_group_rejects_over_limit(node):
+    node.query_groups.put({
+        "name": "tiny", "resiliency_mode": "enforced",
+        "resource_limits": {"cpu": 1.0 / TOTAL_SEARCH_PERMITS},
+    })
+    first = node.query_groups.admit("tiny")
+    first.__enter__()
+    try:
+        with pytest.raises(RejectedExecutionException):
+            with node.query_groups.admit("tiny"):
+                pass
+    finally:
+        first.__exit__(None, None, None)
+    # after release the permit is free again
+    with node.query_groups.admit("tiny"):
+        pass
+
+
+def test_soft_group_and_untagged_run_free(node):
+    node.query_groups.put({"name": "soft-group",
+                           "resource_limits": {"cpu": 0.01}})
+    for _ in range(3):
+        with node.query_groups.admit("soft-group"):
+            pass
+    with node.query_groups.admit(None):
+        pass
