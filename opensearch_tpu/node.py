@@ -182,6 +182,12 @@ class TpuNode:
         self.query_groups = QueryGroupService(
             self.data_path / "query_groups.json"
         )
+        from opensearch_tpu.persistent import PersistentTasksService
+
+        self.persistent_tasks = PersistentTasksService(
+            self.data_path / "persistent_tasks.json"
+        )
+        self.persistent_tasks.resume_incomplete()
         self.search_slowlog = SlowLog("search")
         self.indexing_slowlog = SlowLog("indexing")
         self._configure_slowlogs()

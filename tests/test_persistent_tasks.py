@@ -1,0 +1,35 @@
+"""Persistent tasks: durable registration + restart resume."""
+
+import pytest
+
+from opensearch_tpu.common.errors import IllegalArgumentException
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.persistent import register_executor
+
+
+def test_persistent_task_lifecycle(tmp_path):
+    runs = []
+
+    def executor(params, task):
+        runs.append(params["n"])
+        task.update_state({"seen": params["n"]})
+        if params.get("finish"):
+            task.complete()
+
+    register_executor("test/echo", executor)
+    n = TpuNode(tmp_path / "node")
+    tid = n.persistent_tasks.start("test/echo", {"n": 1, "finish": True})
+    assert runs == [1]
+    assert n.persistent_tasks.get(tid)["status"] == "completed"
+    # incomplete task resumes on restart
+    tid2 = n.persistent_tasks.start("test/echo", {"n": 2})
+    assert n.persistent_tasks.get(tid2)["status"] == "started"
+    n.close()
+
+    n2 = TpuNode(tmp_path / "node")
+    # the restart replayed the incomplete task but not the completed one
+    assert runs == [1, 2, 2]
+    assert n2.persistent_tasks.get(tid)["status"] == "completed"
+    with pytest.raises(IllegalArgumentException):
+        n2.persistent_tasks.start("test/unknown", {})
+    n2.close()
