@@ -26,7 +26,7 @@ SUITES = [
     "indices.put_mapping",
 ]
 
-FLOOR = 0.50
+FLOOR = 0.62
 
 
 @pytest.mark.skipif(not REFERENCE_SPEC.exists(),
