@@ -20,6 +20,81 @@ from opensearch_tpu.node import TpuNode
 from opensearch_tpu.rest.router import Router
 
 
+def apply_filter_path(payload: Any, spec: str) -> Any:
+    """?filter_path=a.b,-c.* response shaping (the reference's
+    XContent filtering layer, common.xcontent.support.filtering): keep
+    only matching paths; leading '-' excludes; '*' matches one key,
+    '**' any depth."""
+    if not isinstance(payload, (dict, list)) or not spec:
+        return payload
+    includes = [p.strip() for p in spec.split(",")
+                if p.strip() and not p.strip().startswith("-")]
+    excludes = [p.strip()[1:] for p in spec.split(",")
+                if p.strip().startswith("-")]
+
+    def match_parts(parts: list[str], pattern: list[str]) -> str:
+        """'full' match, 'prefix' (keep descending), or 'no'."""
+        if not pattern:
+            return "full"
+        if not parts:
+            return "prefix"
+        head, *rest_p = pattern
+        tok, *rest_t = parts
+        if head == "**":
+            for skip in range(len(parts) + 1):
+                r = match_parts(parts[skip:], rest_p)
+                if r != "no":
+                    return r
+            return "prefix"
+        if head == "*" or head == tok or (
+            "*" in head and __import__("fnmatch").fnmatch(tok, head)
+        ):
+            return match_parts(rest_t, rest_p)
+        return "no"
+
+    def filter_obj(obj: Any, path: list[str], patterns: list[list[str]],
+                   exclude: bool) -> Any:
+        if isinstance(obj, dict):
+            out = {}
+            for k, v in obj.items():
+                sub = path + [str(k)]
+                states = [match_parts(sub, pt) for pt in patterns]
+                if exclude:
+                    if any(st == "full" for st in states):
+                        continue
+                    if any(st == "prefix" for st in states):
+                        fv = filter_obj(v, sub, patterns, exclude)
+                        if fv is not None:
+                            out[k] = fv
+                    else:
+                        out[k] = v
+                else:
+                    if any(st == "full" for st in states):
+                        out[k] = v
+                    elif any(st == "prefix" for st in states):
+                        fv = filter_obj(v, sub, patterns, exclude)
+                        if fv not in (None, {}, []):
+                            out[k] = fv
+            return out if (out or exclude) else ({} if exclude else None)
+        if isinstance(obj, list):
+            items = [filter_obj(x, path, patterns, exclude) for x in obj]
+            if exclude:
+                return [x for x in items if x is not None]
+            return [x for x in items if x not in (None, {}, [])]
+        return obj if exclude else None
+
+    result = payload
+    if includes:
+        result = filter_obj(
+            result, [], [p.split(".") for p in includes], exclude=False
+        ) or {}
+    if excludes:
+        result = filter_obj(
+            result, [], [p.split(".") for p in excludes], exclude=True
+        )
+    return result
+
+
 def build_router() -> Router:
     r = Router()
     reg = r.register
@@ -666,6 +741,12 @@ def _totals_as_int(resp: dict, query) -> dict:
 
 def _validate_search_params(query):
     """Request-param validation (SearchRequest.validate analogs)."""
+    if "search_type" in query:
+        st = str(query["search_type"])
+        if st not in ("query_then_fetch", "dfs_query_then_fetch"):
+            raise IllegalArgumentException(
+                f"No search type for [{st}]"
+            )
     if "batched_reduce_size" in query:
         if int(query["batched_reduce_size"]) < 2:
             raise IllegalArgumentException("batchedReduceSize must be >= 2")

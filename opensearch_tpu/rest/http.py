@@ -190,6 +190,10 @@ class HttpServer:
             finally:
                 if breakers is not None and raw_body:
                     breakers.in_flight_requests.release(len(raw_body))
+            if "filter_path" in query and status < 400:
+                from opensearch_tpu.rest.handlers import apply_filter_path
+
+                payload = apply_filter_path(payload, query["filter_path"])
             content_type = (
                 "text/plain" if isinstance(payload, str) else "application/json"
             )

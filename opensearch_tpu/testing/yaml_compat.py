@@ -375,6 +375,12 @@ def make_node_factory(tmp_root: Path):
                         raw = json.dumps(body).encode()
                 parsed = _parse_body(path, raw) if raw else None
                 status, out = handler(node, params, dict(query), parsed)
+                if "filter_path" in query and status < 400:
+                    from opensearch_tpu.rest.handlers import (
+                        apply_filter_path,
+                    )
+
+                    out = apply_filter_path(out, query["filter_path"])
                 return status, out
             except OpenSearchTpuException as e:
                 return e.status, _error_envelope(e)
