@@ -21,6 +21,13 @@ class OpenSearchTpuException(Exception):
 
     def to_dict(self) -> dict:
         body = {"type": self.error_type, "reason": self.reason}
+        cause = self.__cause__
+        if cause is not None:
+            body["caused_by"] = {
+                "type": getattr(cause, "error_type",
+                                type(cause).__name__.lower()),
+                "reason": str(cause),
+            }
         body.update(self.metadata)
         return body
 

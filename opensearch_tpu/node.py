@@ -310,6 +310,9 @@ class TpuNode:
                 hits = [n for n in self.indices if fnmatch.fnmatch(n, part)]
                 targets.extend(hits)
                 matched_any = matched_any or bool(hits)
+                if not hits and not allow_no_indices:
+                    # per-expression: an empty wildcard fails fast
+                    raise IndexNotFoundException(part)
             elif part in alias_map:
                 if ignore_unavailable:
                     continue

@@ -332,7 +332,12 @@ def delete_index(node: TpuNode, params, query, body):
 
 def get_index(node: TpuNode, params, query, body):
     out = {}
-    for name in node.resolve_indices(params["index"]):
+    for name in node.resolve_indices(
+        params["index"],
+        ignore_unavailable=str(query.get("ignore_unavailable", "false"))
+        in ("true", ""),
+        allow_no_indices=str(query.get("allow_no_indices", "true")) != "false",
+    ):
         out[name] = {
             "aliases": {},
             "mappings": node.indices[name].mapper_service.to_dict(),

@@ -419,6 +419,13 @@ def _histogram(conf, sub, segments, ms, masks, filter_fn, ext=None, date: bool =
         m = masks[i] & nf.present
         docs = np.nonzero(m)[0]
         vals = (nf.values_i64 if nf.kind == "int" else nf.values_f64)[docs]
+        if date:
+            mapper = ms.field_mapper(field) if hasattr(ms, "field_mapper") else None
+            if mapper is not None and \
+                    getattr(mapper, "resolution", "millis") == "nanos":
+                # bucket date_nanos in MILLIS space like the reference
+                # (nanos keys would explode the bucket count)
+                vals = vals // 1_000_000
         if calendar:
             keys = _calendar_keys(vals, str(interval_conf))
         else:
@@ -481,10 +488,10 @@ def _histogram(conf, sub, segments, ms, masks, filter_fn, ext=None, date: bool =
             continue
         bucket: dict[str, Any] = {"key": int(key) if date else key, "doc_count": count}
         if date:
+            kdt = _dt.datetime.fromtimestamp(key / 1000, _dt.timezone.utc)
             bucket["key_as_string"] = (
-                _dt.datetime.fromtimestamp(key / 1000, _dt.timezone.utc)
-                .isoformat()
-                .replace("+00:00", "Z")
+                kdt.strftime("%Y-%m-%dT%H:%M:%S.")
+                + f"{int(key) % 1000:03d}Z"
             )
         if sub:
             bucket_masks = []

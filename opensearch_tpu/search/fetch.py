@@ -278,7 +278,9 @@ def docvalue_fields_for_doc(
             continue
         vals = _doc_column_values(host, doc, fname, ms, fmt)
         if vals:
-            out[fname] = vals
+            # repeated specs for one field accumulate (the reference emits
+            # one entry per requested format)
+            out.setdefault(fname, []).extend(vals)
     return out
 
 
@@ -313,12 +315,20 @@ _JODA_MAP = [
 
 def _format_date_nanos(ns_value: int, fmt: str | None) -> Any:
     """date_nanos doc-value rendering: 9-digit fractional ISO by default
-    (strict_date_optional_time_nanos), epoch_millis as a string."""
+    (strict_date_optional_time_nanos); epoch_millis renders fractional
+    millis ("1540815132123.456789"); millis-resolution formats truncate."""
     from datetime import datetime, timezone
 
     if fmt == "epoch_millis":
-        return str(ns_value // 1_000_000)
+        frac_ns = ns_value % 1_000_000
+        ms = ns_value // 1_000_000
+        if frac_ns:
+            return f"{ms}.{frac_ns:06d}".rstrip("0")
+        return str(ms)
     dt = datetime.fromtimestamp(ns_value // 1_000_000_000, tz=timezone.utc)
+    if fmt in ("strict_date_optional_time", "date_optional_time"):
+        ms_part = (ns_value // 1_000_000) % 1000
+        return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{ms_part:03d}Z"
     frac = ns_value % 1_000_000_000
     return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{frac:09d}".rstrip("0").ljust(3, "0") + "Z"
 
