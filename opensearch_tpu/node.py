@@ -182,6 +182,9 @@ class TpuNode:
         self.query_groups = QueryGroupService(
             self.data_path / "query_groups.json"
         )
+        from opensearch_tpu.index.remote_store import RemoteStoreService
+
+        self.remote_store = RemoteStoreService(self)
         from opensearch_tpu.persistent import PersistentTasksService
 
         self.persistent_tasks = PersistentTasksService(

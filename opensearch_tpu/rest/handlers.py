@@ -260,6 +260,10 @@ def build_router() -> Router:
     reg("GET", "/_stats", all_stats)
     reg("GET", "/{index}/_stats", index_stats)
     reg("GET", "/_remote/info", remote_info)
+    # remote segment store (index/remote + RemoteStoreRestoreService)
+    reg("POST", "/_remotestore/_restore", remotestore_restore)
+    reg("POST", "/{index}/_remotestore/_sync", remotestore_sync)
+    reg("GET", "/_remotestore/stats/{index}", remotestore_stats)
     # workload management (wlm / workload-management plugin surface)
     reg("PUT", "/_wlm/query_group", put_query_group)
     reg("GET", "/_wlm/query_group", get_query_groups)
@@ -1178,6 +1182,23 @@ def delete_query_group(node: TpuNode, params, query, body):
 
 def wlm_stats(node: TpuNode, params, query, body):
     return 200, {"query_groups": node.query_groups.stats()}
+
+
+def remotestore_restore(node: TpuNode, params, query, body):
+    indices = (body or {}).get("indices") or []
+    if isinstance(indices, str):
+        indices = indices.split(",")
+    if not indices:
+        raise IllegalArgumentException("[indices] is required for restore")
+    return 200, node.remote_store.restore(indices)
+
+
+def remotestore_sync(node: TpuNode, params, query, body):
+    return 200, {"shards": node.remote_store.sync_index(params["index"])}
+
+
+def remotestore_stats(node: TpuNode, params, query, body):
+    return 200, node.remote_store.stats(params.get("index"))
 
 
 def remote_info(node: TpuNode, params, query, body):
