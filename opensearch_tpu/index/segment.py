@@ -298,7 +298,12 @@ class SegmentBuilder:
                 )
                 if vf is not None:
                     seg.vector_fields[fname] = vf
-            elif mapper.type in ("alias", "geo_point", "percolator", "join") \
+            elif mapper.type == "rank_feature":
+                nf = self._build_numeric(fname, n, "float")
+                if nf is not None:
+                    seg.numeric_fields[fname] = nf
+            elif mapper.type in ("alias", "geo_point", "percolator", "join",
+                                 "rank_features") \
                     or mapper.type in RANGE_TYPES:
                 continue  # no direct column (aliases resolve below)
             else:  # float family

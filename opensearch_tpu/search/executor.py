@@ -1029,6 +1029,52 @@ class SegmentExecutor:
             scoring=True,
         )
 
+    def _exec_RankFeatureQuery(self, node: q.RankFeatureQuery) -> NodeResult:
+        """saturation: v/(v+pivot) (default pivot = field mean); log:
+        ln(sf + v); sigmoid: v^e/(v^e + pivot^e); linear: v."""
+        nf = self.host.numeric_fields.get(node.field)
+        if nf is None:
+            return _empty(self.dev)
+        n = self.host.n_docs
+        vals = (nf.values_i64 if nf.kind == "int" else nf.values_f64)[:n]
+        vals = vals.astype(np.float64)
+        present = nf.present[:n]
+        def default_pivot() -> float:
+            # approximate geometric mean over the WHOLE shard (the
+            # reference computes the pivot from index-level stats; a
+            # per-segment pivot would rank equal-feature docs differently
+            # across segments)
+            total, count = 0.0, 0
+            for h, _d in self.ctx.snapshot.segments:
+                f = h.numeric_fields.get(node.field)
+                if f is None:
+                    continue
+                v = (f.values_i64 if f.kind == "int" else f.values_f64)[
+                    : h.n_docs]
+                p = f.present[: h.n_docs]
+                total += float(v[p].sum())
+                count += int(p.sum())
+            return max(total / count if count else 1.0, 1e-9)
+
+        if node.function == "log":
+            score = np.log(np.maximum(node.scaling_factor + vals, 1e-12))
+        elif node.function == "linear":
+            score = vals
+        elif node.function == "sigmoid":
+            pivot = node.pivot if node.pivot is not None else default_pivot()
+            ve = np.power(vals, node.exponent)
+            score = ve / (ve + pivot ** node.exponent)
+        else:  # saturation
+            pivot = node.pivot if node.pivot is not None else default_pivot()
+            score = vals / (vals + pivot)
+        scores = np.zeros(self.dev.n_pad, np.float32)
+        scores[:n] = np.where(present, score, 0.0) * node.boost
+        mask = jnp.asarray(np.pad(present, (0, self.dev.n_pad - n))) & self.dev.live
+        return NodeResult(
+            scores=jnp.where(mask, jnp.asarray(scores), 0.0), mask=mask,
+            scoring=True,
+        )
+
     def _geo_columns(self, field: str):
         lat_f = self.host.numeric_fields.get(f"{field}#lat")
         lon_f = self.host.numeric_fields.get(f"{field}#lon")

@@ -122,6 +122,18 @@ class TermsSetQuery(QueryNode):
 
 
 @dataclass
+class RankFeatureQuery(QueryNode):
+    """rank_feature (RankFeatureQueryBuilder): score from a positive
+    feature value via saturation/log/sigmoid/linear."""
+
+    field: str = ""
+    function: str = "saturation"  # saturation | log | sigmoid | linear
+    pivot: float | None = None
+    scaling_factor: float = 1.0   # log
+    exponent: float = 1.0         # sigmoid
+
+
+@dataclass
 class GeoDistanceQuery(QueryNode):
     """geo_distance (GeoDistanceQueryBuilder): docs within `distance` of a
     center point."""
@@ -686,6 +698,30 @@ def _parse_terms_set(body: dict) -> QueryNode:
     )
 
 
+def _parse_rank_feature(body: dict) -> QueryNode:
+    if not isinstance(body, dict) or "field" not in body:
+        raise ParsingException("[rank_feature] requires [field]")
+    fn, pivot, sf, exp = "saturation", None, 1.0, 1.0
+    if "saturation" in body:
+        pivot = (body["saturation"] or {}).get("pivot")
+    elif "log" in body:
+        fn = "log"
+        sf = float((body["log"] or {}).get("scaling_factor", 1.0))
+    elif "sigmoid" in body:
+        fn = "sigmoid"
+        conf = body["sigmoid"] or {}
+        pivot = conf.get("pivot")
+        exp = float(conf.get("exponent", 1.0))
+    elif "linear" in body:
+        fn = "linear"
+    return RankFeatureQuery(
+        field=str(body["field"]), function=fn,
+        pivot=float(pivot) if pivot is not None else None,
+        scaling_factor=sf, exponent=exp,
+        boost=float(body.get("boost", 1.0)),
+    )
+
+
 def _parse_geo_distance(body: dict) -> QueryNode:
     conf = dict(body)
     distance = conf.pop("distance", None)
@@ -1135,6 +1171,7 @@ _PARSERS = {
     "terms_set": _parse_terms_set,
     "distance_feature": _parse_distance_feature,
     "geo_distance": _parse_geo_distance,
+    "rank_feature": _parse_rank_feature,
     "geo_bounding_box": _parse_geo_bounding_box,
     "ids": _parse_ids,
     "bool": _parse_bool,

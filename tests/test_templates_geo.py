@@ -107,3 +107,31 @@ def test_date_nanos_roundtrip(node):
 
     with _pytest.raises(MapperParsingException):
         node.index_doc("ns", "3", {"ts": "1969-12-31T23:59:59Z"})
+
+
+def test_rank_feature(node):
+    node.create_index("rf", {"mappings": {"properties": {
+        "pagerank": {"type": "rank_feature"},
+        "features": {"type": "rank_features"},
+        "body": {"type": "text"}}}})
+    node.index_doc("rf", "1", {"pagerank": 10.0, "body": "hello",
+                               "features": {"politics": 5.0}}, refresh=True)
+    node.index_doc("rf", "2", {"pagerank": 100.0, "body": "hello"},
+                   refresh=True)
+    r = node.search("rf", {"query": {"rank_feature": {"field": "pagerank"}}})
+    hits = r["hits"]["hits"]
+    assert [h["_id"] for h in hits] == ["2", "1"]  # higher feature wins
+    r = node.search("rf", {"query": {"rank_feature": {
+        "field": "pagerank", "log": {"scaling_factor": 2}}}})
+    assert r["hits"]["hits"][0]["_id"] == "2"
+    # rank_features sub-key addressable
+    r = node.search("rf", {"query": {"rank_feature": {
+        "field": "features.politics"}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+    # positive-only validation
+    import pytest as _pytest
+
+    from opensearch_tpu.common.errors import MapperParsingException
+
+    with _pytest.raises(MapperParsingException):
+        node.index_doc("rf", "3", {"pagerank": -1.0})
