@@ -717,6 +717,10 @@ def _body_with_query_params(query, body):
     if "include_named_queries_score" in query:
         body.setdefault("include_named_queries_score",
                         str(query["include_named_queries_score"]))
+    if str(query.get("seq_no_primary_term", "false")) in ("true", ""):
+        body.setdefault("seq_no_primary_term", True)
+    if str(query.get("version", "false")) in ("true", ""):
+        body.setdefault("version", True)
     if "track_total_hits" in query:
         v = str(query["track_total_hits"])
         body.setdefault(
@@ -736,9 +740,12 @@ def _totals_as_int(resp: dict, query) -> dict:
         if isinstance(obj, dict):
             out = {}
             for k, v in obj.items():
-                if k == "hits" and isinstance(v, dict) \
-                        and isinstance(v.get("total"), dict):
-                    v = {**v, "total": v["total"].get("value", 0)}
+                if k == "hits" and isinstance(v, dict):
+                    if isinstance(v.get("total"), dict):
+                        v = {**v, "total": v["total"].get("value", 0)}
+                    elif "total" not in v and "hits" in v:
+                        # track_total_hits=false renders total -1 as int
+                        v = {**v, "total": -1}
                 out[k] = convert(v)
             return out
         if isinstance(obj, list):
@@ -748,8 +755,15 @@ def _totals_as_int(resp: dict, query) -> dict:
     return convert(resp)
 
 
-def _validate_search_params(query):
+def _validate_search_params(query, body=None):
     """Request-param validation (SearchRequest.validate analogs)."""
+    if str(query.get("rest_total_hits_as_int", "false")) in ("true", ""):
+        tth = (body or {}).get("track_total_hits", True)
+        if tth not in (True, False):
+            raise IllegalArgumentException(
+                f"[rest_total_hits_as_int] cannot be used if the tracking "
+                f"of total hits is not accurate, got {tth}"
+            )
     if "search_type" in query:
         st = str(query["search_type"])
         if st not in ("query_then_fetch", "dfs_query_then_fetch"):
@@ -767,7 +781,7 @@ def _validate_search_params(query):
 
 
 def search(node: TpuNode, params, query, body):
-    _validate_search_params(query)
+    _validate_search_params(query, body)
     resp = node.search(params["index"], _body_with_query_params(query, body),
                        scroll=query.get("scroll"),
                        search_pipeline=query.get("search_pipeline"),
@@ -780,7 +794,7 @@ def search(node: TpuNode, params, query, body):
 def search_all(node: TpuNode, params, query, body):
     # index=None (not "_all"): a PIT body carries its own shard set and is
     # only legal without an index in the path
-    _validate_search_params(query)
+    _validate_search_params(query, body)
     resp = node.search(None, _body_with_query_params(query, body),
                        scroll=query.get("scroll"),
                        search_pipeline=query.get("search_pipeline"))

@@ -1012,7 +1012,12 @@ class SegmentExecutor:
             if nf is None:
                 return _empty(self.dev)
             is_date = mapper is not None and mapper.type == "date"
-            if is_date:
+            if is_date and getattr(mapper, "resolution", "millis") == "nanos":
+                from opensearch_tpu.index.mapper import parse_date_nanos
+
+                origin = float(parse_date_nanos(str(node.origin)))
+                pivot = float(_duration_millis(node.pivot)) * 1e6
+            elif is_date:
                 origin = float(_parse_date_or_now(node.origin))
                 pivot = float(_duration_millis(node.pivot))
             else:
@@ -1309,6 +1314,13 @@ class SegmentExecutor:
         )
 
     def _exec_RegexpQuery(self, node: q.RegexpQuery) -> NodeResult:
+        if len(node.value) > 1000:
+            raise IllegalArgumentException(
+                f"The length of regex [{len(node.value)}] used in the "
+                f"Regexp Query request has exceeded the allowed maximum "
+                f"of [1000]. This maximum can be set by changing the "
+                f"[index.max_regex_length] index level setting."
+            )
         try:
             rx = re.compile(
                 node.value, re.IGNORECASE if node.case_insensitive else 0
@@ -1707,7 +1719,7 @@ def _parse_date_or_now(v: Any) -> int:
     """Date literal or date-math anchored at now ("now", "now-7d")."""
     import time as _time
 
-    s = str(v).strip()
+    s = str(v).strip() if not hasattr(v, "isoformat") else v.isoformat()
     if s.startswith("now"):
         base = int(_time.time() * 1000)
         rest = s[3:]
@@ -1722,13 +1734,16 @@ def _duration_millis(v: Any) -> int:
     """Parse a date-math duration like "10d", "2h", "30m" to milliseconds."""
     if isinstance(v, (int, float)):
         return int(v)
-    m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h|d|w)", str(v).strip())
+    m = re.fullmatch(
+        r"(\d+(?:\.\d+)?)(nanos|micros|ms|s|m|h|d|w)", str(v).strip()
+    )
     if not m:
         raise IllegalArgumentException(f"invalid duration [{v}]")
     n = float(m.group(1))
-    mult = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
-            "d": 86_400_000, "w": 604_800_000}[m.group(2)]
-    return int(n * mult)
+    mult = {"nanos": 1e-6, "micros": 1e-3, "ms": 1, "s": 1000, "m": 60_000,
+            "h": 3_600_000, "d": 86_400_000, "w": 604_800_000}[m.group(2)]
+    return int(n * mult) if m.group(2) not in ("nanos", "micros") \
+        else n * mult
 
 
 # --------------------------------------------------------------------------

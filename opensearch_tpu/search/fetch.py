@@ -290,6 +290,10 @@ def _doc_column_values(host, doc: int, fname: str, ms: MapperService,
     nf = host.numeric_fields.get(fname)
     if nf is not None and nf.present[doc]:
         vals = nf.doc_values(doc)
+        if fmt and set(fmt) <= set("#,.0"):
+            # decimal pattern (java DecimalFormat subset): '#.0' -> 1 place
+            places = len(fmt.split(".")[1]) if "." in fmt else 0
+            return [f"{float(v):.{places}f}" for v in vals]
         if nf.kind == "int":
             if mapper is not None and mapper.type == "date":
                 if mapper.resolution == "nanos":

@@ -325,7 +325,8 @@ def search(
     stored_specs = body.get("stored_fields")
     if isinstance(stored_specs, str):
         stored_specs = [stored_specs]
-    if stored_specs == ["_none_"]:
+    stored_none = stored_specs == ["_none_"]
+    if stored_none:
         stored_specs = None
     if fields_specs:
         for sh in shards:
@@ -348,8 +349,11 @@ def search(
                         )
     # stored_fields without an explicit _source suppresses _source in hits
     # (RestSearchAction's storedFieldsContext default)
-    _src_spec = body.get("_source", False if stored_specs is not None
-                         else True)
+    _src_spec = body.get(
+        "_source",
+        True if (stored_specs is None and not stored_none)
+        or (stored_specs and "_source" in stored_specs) else False,
+    )
     source_filter = _source_filter(_src_spec)
     highlight_conf = body.get("highlight")
     docvalue_specs = body.get("docvalue_fields")
@@ -398,6 +402,9 @@ def search(
             "_id": doc_id,
             "_score": h.score if (not sort or _sort_has_score(sort)) else None,
         }
+        if stored_none:
+            # stored_fields: _none_ drops per-hit metadata (_id/_source)
+            hit.pop("_id", None)
         doc_routing = host.doc_routings[h.doc] if host.doc_routings else None
         if doc_routing is not None:
             hit["_routing"] = doc_routing

@@ -46,6 +46,17 @@ CATCH_STATUS = {
 }
 
 
+def _jsonable(v):
+    """YAML auto-parses ISO timestamps to datetime; REST bodies are JSON."""
+    import datetime as _dt
+
+    if isinstance(v, _dt.datetime):
+        return v.isoformat().replace("+00:00", "Z")
+    if isinstance(v, _dt.date):
+        return v.isoformat()
+    return str(v)
+
+
 class StepFailure(Exception):
     pass
 
@@ -369,10 +380,11 @@ def make_node_factory(tmp_root: Path):
                         else:
                             raw = "\n".join(
                                 line if isinstance(line, str)
-                                else json.dumps(line) for line in body
+                                else json.dumps(line, default=_jsonable)
+                                for line in body
                             ).encode() + b"\n"
                     else:
-                        raw = json.dumps(body).encode()
+                        raw = json.dumps(body, default=_jsonable).encode()
                 parsed = _parse_body(path, raw) if raw else None
                 status, out = handler(node, params, dict(query), parsed)
                 if "filter_path" in query and status < 400:
