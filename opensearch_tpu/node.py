@@ -44,7 +44,20 @@ from opensearch_tpu.index.mapper import MapperService
 from opensearch_tpu.index.shard import IndexShard, ShardId, translog_durability
 from opensearch_tpu.search import service as search_service
 
-_VALID_INDEX_NAME = re.compile(r"^[a-z0-9][a-z0-9_\-.]*$")
+# index names: anything except the reserved characters, no uppercase
+# ASCII, not starting with _ - + (MetadataCreateIndexService.validateIndexName
+# — non-ASCII like CJK is legal)
+_INVALID_INDEX_CHARS = set(' "*\\<>|,/?#:')
+
+
+def _valid_index_name(name: str) -> bool:
+    if not name or name in (".", ".."):
+        return False
+    if any(c in _INVALID_INDEX_CHARS for c in name):
+        return False
+    if any("A" <= c <= "Z" for c in name):
+        return False
+    return not name.startswith(("_", "-", "+"))
 
 
 def _flatten_source_fields(obj: dict, prefix: str = "") -> dict:
@@ -246,7 +259,7 @@ class TpuNode:
             self.indices[name] = svc
 
     def create_index(self, name: str, body: dict | None = None) -> dict:
-        if not _VALID_INDEX_NAME.match(name) or name.startswith(("_", "-")):
+        if not _valid_index_name(name):
             raise IllegalArgumentException(f"invalid index name [{name}]")
         if name in self.indices:
             raise ResourceAlreadyExistsException(f"index [{name}] already exists")

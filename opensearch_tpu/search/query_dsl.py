@@ -601,6 +601,31 @@ def _parse_intervals(body: dict) -> QueryNode:
     )
 
 
+def _parse_combined_fields(body: dict) -> QueryNode:
+    """combined_fields (CombinedFieldsQueryBuilder): BM25F-style scoring —
+    here lowered onto the weighted most_fields sum, the closest shape in
+    this engine's scoring model."""
+    if "query" not in body or not body.get("fields"):
+        raise ParsingException(
+            "[combined_fields] requires [query] and [fields]"
+        )
+    raw_fields = body["fields"]
+    field_boosts = {}
+    for f in raw_fields:
+        if "^" in f:
+            name, _, sfx = f.partition("^")
+            field_boosts[name] = float(sfx)
+    return MultiMatchQuery(
+        fields=[f.split("^")[0] for f in raw_fields],
+        query=str(body["query"]),
+        type="most_fields",
+        field_boosts=field_boosts,
+        operator=str(body.get("operator", "or")).lower(),
+        minimum_should_match=body.get("minimum_should_match"),
+        boost=float(body.get("boost", 1.0)),
+    )
+
+
 def _parse_multi_match(body: dict) -> QueryNode:
     mm_type = body.get("type", "best_fields")
     known = {"best_fields", "most_fields", "cross_fields", "phrase",
@@ -1164,6 +1189,7 @@ _PARSERS = {
     "span_within": _parse_span_query("span_within"),
     "span_multi": _parse_span_query("span_multi"),
     "multi_match": _parse_multi_match,
+    "combined_fields": _parse_combined_fields,
     "term": _parse_term,
     "terms": _parse_terms,
     "range": _parse_range,
