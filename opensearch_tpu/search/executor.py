@@ -781,6 +781,7 @@ class SegmentExecutor:
             return self._numeric_range(field, ms, None, ms, None, node.boost)
         if ftype in INT_TYPES or ftype in FLOAT_TYPES or ftype is None:
             return self._numeric_range(field, value, None, value, None, node.boost)
+
         raise IllegalArgumentException(f"term query on unsupported field [{field}]")
 
     def _exec_TermsQuery(self, node: q.TermsQuery) -> NodeResult:
@@ -833,6 +834,8 @@ class SegmentExecutor:
         mapper = self.ctx.mapper_service.field_mapper(field)
         is_date = mapper is not None and mapper.type == "date"
         nanos = is_date and mapper.resolution == "nanos"
+        unsigned = mapper is not None and \
+            mapper.original_type == "unsigned_long"
 
         def conv(v: Any) -> Any:
             if v is None:
@@ -841,6 +844,8 @@ class SegmentExecutor:
                 from opensearch_tpu.index.mapper import parse_date_nanos
 
                 return parse_date_nanos(v)
+            if unsigned:
+                return int(str(v), 10) - 2**63  # biased storage
             return parse_date_millis(v) if is_date else v
 
         gte, gt, lte, lt = conv(gte), conv(gt), conv(lte), conv(lt)
@@ -1877,16 +1882,24 @@ def _sorted_segment_hits(
             vals, present = _field_sort_values(host, fname, docs, mapper_service)
             kf = host.keyword_fields.get(fname)
             sort_cols.append((vals, present, order, kf.ord_values if kf is not None else None))
+    unbias = {
+        spec_i for spec_i, spec in enumerate(sort)
+        if (m := mapper_service.field_mapper(_sort_spec(spec)[0])) is not None
+        and getattr(m, "original_type", None) == "unsigned_long"
+    }
     for i, d in enumerate(docs):
         sv = []
-        for vals, present, order, ord_values in sort_cols:
+        for col_i, (vals, present, order, ord_values) in enumerate(sort_cols):
             if not present[i]:
                 sv.append(None)
             elif ord_values is not None:
                 sv.append(ord_values[int(vals[i])])
             else:
                 v = vals[i]
-                sv.append(int(v) if isinstance(v, (np.integer,)) else float(v))
+                out_v = int(v) if isinstance(v, (np.integer,)) else float(v)
+                if col_i in unbias and isinstance(out_v, int):
+                    out_v += 2**63  # biased unsigned_long -> user value
+                sv.append(out_v)
         hits.append(ShardHit(float(scores[d]), seg_idx, int(d), sort_values=sv))
     keys = _sort_key_fn(sort)
     hits.sort(key=keys)

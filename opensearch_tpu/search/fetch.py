@@ -295,6 +295,9 @@ def _doc_column_values(host, doc: int, fname: str, ms: MapperService,
             places = len(fmt.split(".")[1]) if "." in fmt else 0
             return [f"{float(v):.{places}f}" for v in vals]
         if nf.kind == "int":
+            if mapper is not None and \
+                    getattr(mapper, "original_type", None) == "unsigned_long":
+                return [int(v) + 2**63 for v in vals]
             if mapper is not None and mapper.type == "date":
                 if mapper.resolution == "nanos":
                     return [_format_date_nanos(int(v), fmt) for v in vals]

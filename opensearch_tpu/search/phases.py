@@ -93,6 +93,9 @@ def can_match(snapshot, mapper_service, node: Any) -> bool:
                 conv = (parse_date_nanos
                         if getattr(mapper, "resolution", "millis") == "nanos"
                         else parse_date_millis)
+            elif getattr(mapper, "original_type", None) == "unsigned_long":
+                # biased int64 storage (see mapper unsigned_long handling)
+                conv = lambda v: int(str(v), 10) - 2**63  # noqa: E731
             else:
                 conv = float
             if rq.gte is not None:

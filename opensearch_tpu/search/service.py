@@ -811,6 +811,12 @@ def _coerce_search_after(sort: list, search_after: list, ms) -> list:
         mapper = ms.field_mapper(fname) if hasattr(ms, "field_mapper") else None
         if v is None or fname == "_score":
             out.append(v)
+        elif mapper is not None and \
+                getattr(mapper, "original_type", None) == "unsigned_long":
+            try:
+                out.append(int(str(v), 10))
+            except ValueError:
+                out.append(v)
         elif mapper is not None and mapper.type == "date" \
                 and isinstance(v, str):
             if getattr(mapper, "resolution", "millis") == "nanos":
