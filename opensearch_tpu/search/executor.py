@@ -1107,6 +1107,45 @@ class SegmentExecutor:
         return _const_result(jnp.asarray(mask_host) & self.dev.live,
                              node.boost, scoring=True)
 
+    def _exec_GeoShapeQuery(self, node: q.GeoShapeQuery) -> NodeResult:
+        """geo_shape over point columns: the shape's bounding box is the
+        match region (exact for envelope/point; polygon matches by bbox —
+        a documented approximation of the reference's tessellated shapes)."""
+        cols = self._geo_columns(node.field)
+        if cols is None:
+            return _empty(self.dev)
+        lat, lon, present = cols
+        shape = node.shape or {}
+        styp = str(shape.get("type", "")).lower()
+        coords = shape.get("coordinates")
+        if styp == "point":
+            lons = [coords[0]]
+            lats = [coords[1]]
+        elif styp == "envelope":
+            (tl_lon, tl_lat), (br_lon, br_lat) = coords
+            lons = [tl_lon, br_lon]
+            lats = [tl_lat, br_lat]
+        elif styp in ("polygon", "multipoint", "linestring"):
+            flat = coords[0] if styp == "polygon" else coords
+            lons = [c[0] for c in flat]
+            lats = [c[1] for c in flat]
+        else:
+            raise IllegalArgumentException(
+                f"[geo_shape] unsupported shape type [{styp}]"
+            )
+        lat_hi, lat_lo = max(lats), min(lats)
+        lon_hi, lon_lo = max(lons), min(lons)
+        inside = present & (lat >= lat_lo) & (lat <= lat_hi) \
+            & (lon >= lon_lo) & (lon <= lon_hi)
+        if node.relation == "disjoint":
+            sel = present & ~inside
+        else:  # intersects / within / contains on points collapse to inside
+            sel = inside
+        mask_host = np.zeros(self.dev.n_pad, bool)
+        mask_host[: self.host.n_docs] = sel
+        return _const_result(jnp.asarray(mask_host) & self.dev.live,
+                             node.boost, scoring=True)
+
     def _exec_GeoBoundingBoxQuery(self, node: q.GeoBoundingBoxQuery) -> NodeResult:
         cols = self._geo_columns(node.field)
         if cols is None:

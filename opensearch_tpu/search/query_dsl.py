@@ -153,6 +153,16 @@ class GeoBoundingBoxQuery(QueryNode):
 
 
 @dataclass
+class GeoShapeQuery(QueryNode):
+    """geo_shape against geo_point columns (envelope/point/polygon-bbox
+    subset of GeoShapeQueryBuilder)."""
+
+    field: str = ""
+    shape: dict | None = None
+    relation: str = "intersects"
+
+
+@dataclass
 class DistanceFeatureQuery(QueryNode):
     """distance_feature (DistanceFeatureQueryBuilder): score decays with
     distance from origin; boost * pivot / (pivot + distance)."""
@@ -796,6 +806,23 @@ def _parse_geo_bounding_box(body: dict) -> QueryNode:
                                boost=boost)
 
 
+def _parse_geo_shape(body: dict) -> QueryNode:
+    conf = dict(body)
+    boost = float(conf.pop("boost", 1.0))
+    conf.pop("ignore_unmapped", None)
+    conf.pop("_name", None)
+    if len(conf) != 1:
+        raise ParsingException("[geo_shape] requires exactly one field")
+    fname, fconf = next(iter(conf.items()))
+    if not isinstance(fconf, dict) or "shape" not in fconf:
+        raise ParsingException("[geo_shape] requires [shape]")
+    relation = str(fconf.get("relation", "intersects")).lower()
+    if relation not in ("intersects", "disjoint", "within", "contains"):
+        raise ParsingException(f"[geo_shape] unknown relation [{relation}]")
+    return GeoShapeQuery(field=fname, shape=fconf["shape"],
+                         relation=relation, boost=boost)
+
+
 def _parse_distance_feature(body: dict) -> QueryNode:
     if not isinstance(body, dict) or "field" not in body:
         raise ParsingException("[distance_feature] requires [field]")
@@ -1199,6 +1226,7 @@ _PARSERS = {
     "geo_distance": _parse_geo_distance,
     "rank_feature": _parse_rank_feature,
     "geo_bounding_box": _parse_geo_bounding_box,
+    "geo_shape": _parse_geo_shape,
     "ids": _parse_ids,
     "bool": _parse_bool,
     "constant_score": _parse_constant_score,

@@ -49,6 +49,9 @@ def _tokenize(s: str) -> list[str]:
 def _term_node(field: str, text: str) -> q.QueryNode:
     if text.startswith('"') and text.endswith('"') and len(text) >= 2:
         return q.MatchPhraseQuery(field=field, query=text[1:-1].replace('\\"', '"'))
+    if text.startswith("/") and text.endswith("/") and len(text) >= 2:
+        # /regex/ syntax (classic parser's RegexpQuery clause)
+        return q.RegexpQuery(field=field, value=text[1:-1])
     if "*" in text or "?" in text:
         return q.WildcardQuery(field=field, value=text)
     if text.endswith("~"):
