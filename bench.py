@@ -26,13 +26,31 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
+import signal
 import sys
 import time
 
 import numpy as np
 
 
+def _watchdog(sig, frame):  # noqa: ARG001 - signal contract
+    # the axon tunnel's device claim can wedge indefinitely (observed in
+    # round 3); a JSON error line beats a silent driver timeout
+    print(json.dumps({
+        "metric": "bench_error", "value": 0, "unit": "error",
+        "vs_baseline": 0,
+        "detail": "device init/benchmark exceeded 1500s watchdog "
+                  "(axon tunnel wedged?)",
+    }))
+    sys.stdout.flush()
+    import os
+
+    os._exit(2)
+
+
 def main() -> None:
+    signal.signal(signal.SIGALRM, _watchdog)
+    signal.alarm(1500)
     import jax
     import jax.numpy as jnp
 
