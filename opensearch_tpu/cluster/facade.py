@@ -347,7 +347,8 @@ class ClusterFacade:
 
     def update_doc(self, index: str, doc_id: str, body: dict,
                    routing: str | None = None, refresh: bool = False,
-                   if_seq_no: int | None = None) -> dict:
+                   if_seq_no: int | None = None,
+                   require_alias: bool = False) -> dict:
         """Coordinator-side read-modify-write with optimistic concurrency
         (UpdateHelper semantics over the cluster write path)."""
         current = self.get_doc(index, doc_id, routing=routing)

@@ -1082,9 +1082,13 @@ class TpuNode:
                          version=None, version_type="internal") -> dict:
         if version is not None and op_type == "create" and \
                 version_type != "internal":
-            raise IllegalArgumentException(
-                "create operations only support internal versioning. "
-                f"use index instead"
+            from opensearch_tpu.common.errors import (
+                ActionRequestValidationException,
+            )
+
+            raise ActionRequestValidationException(
+                "Validation Failed: 1: create operations only support "
+                "internal versioning. use index instead;"
             )
         _t_index0 = time.monotonic()
         index, routing = self._resolve_write_alias(index, routing)
@@ -1231,15 +1235,49 @@ class TpuNode:
 
     def update_doc(self, index: str, doc_id: str, body: dict,
                    routing: str | None = None, refresh: bool = False,
-                   if_seq_no: int | None = None) -> dict:
+                   if_seq_no: int | None = None,
+                   require_alias: bool = False) -> dict:
         """Partial update via doc merge or script
         (action/update/UpdateHelper.java: prepareUpdateScriptRequest)."""
+        if require_alias and index not in self._alias_map():
+            e = IndexNotFoundException(index)
+            e.reason = (
+                f"no such index [{index}] and [require_alias] request "
+                f"flag is [true] and [{index}] is not an alias"
+            )
+            raise e
         with self._write_pressure(len(json.dumps(body)), "update"):
-            return self._update_doc_inner(index, doc_id, body, routing,
-                                          refresh, if_seq_no)
+            out = self._update_doc_inner(index, doc_id, body, routing,
+                                         refresh, if_seq_no)
+        src_spec = (body or {}).get("_source")
+        if src_spec and out.get("result") != "noop":
+            got = self.get_doc(index, doc_id, routing=routing)
+            if got.get("found"):
+                from opensearch_tpu.search.service import _source_filter
+
+                out["get"] = {
+                    "found": True,
+                    "_source": _source_filter(src_spec)(got["_source"]),
+                    "_seq_no": got.get("_seq_no"),
+                    "_primary_term": got.get("_primary_term", 1),
+                }
+        return out
+
+    _UPDATE_KEYS = {"script", "doc", "upsert", "doc_as_upsert",
+                    "detect_noop", "scripted_upsert", "_source", "fields",
+                    "lang", "params"}
 
     def _update_doc_inner(self, index, doc_id, body, routing, refresh,
                           if_seq_no=None) -> dict:
+        import difflib
+
+        for key in body or {}:
+            if key not in self._UPDATE_KEYS:
+                near = difflib.get_close_matches(key, self._UPDATE_KEYS, 1)
+                hint = f" did you mean [{near[0]}]?" if near else ""
+                raise IllegalArgumentException(
+                    f"[UpdateRequest] unknown field [{key}]{hint}"
+                )
         index, routing = self._resolve_write_alias(index, routing)
         # updates auto-create the target index like index ops do
         # (TransportUpdateAction routes through the bulk auto-create path)
@@ -1247,6 +1285,12 @@ class TpuNode:
         shard = svc.shard_for(doc_id, routing)
         current = shard.get(doc_id)
         if if_seq_no is not None:
+            if current is None and not (
+                body.get("upsert") or body.get("doc_as_upsert")
+            ):
+                raise DocumentMissingException(
+                    f"[{doc_id}]: document missing"
+                )
             current_seq = current["_seq_no"] if current is not None else -1
             if current_seq != if_seq_no:
                 raise VersionConflictException(
@@ -1359,11 +1403,13 @@ class TpuNode:
                         IndexNotFoundException,
                     )
 
-                    raise IndexNotFoundException(
+                    e = IndexNotFoundException(index)
+                    e.reason = (
                         f"no such index [{index}] and [require_alias] "
                         f"request flag is [true] and [{index}] is not an "
                         f"alias"
                     )
+                    raise e
                 if action == "index" and meta.get("op_type") == "create":
                     action = "create"
                 if action in ("index", "create"):

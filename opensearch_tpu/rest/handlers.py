@@ -465,13 +465,49 @@ def _realtime_param(query) -> bool:
     return str(query.get("realtime", "true")) != "false"
 
 
+def _apply_get_params(resp, query):
+    """_source filtering + stored_fields rendering on GET responses
+    (RestGetAction's FetchSourceContext/storedFields handling)."""
+    if not resp.get("found"):
+        return resp
+    from opensearch_tpu.search.service import _source_filter
+
+    src = resp.get("_source")
+    includes = query.get("_source_includes") or query.get("_source_include")
+    excludes = query.get("_source_excludes") or query.get("_source_exclude")
+    if includes or excludes:
+        spec = {
+            **({"includes": str(includes).split(",")} if includes else {}),
+            **({"excludes": str(excludes).split(",")} if excludes else {}),
+        }
+        resp = {**resp, "_source": _source_filter(spec)(src)}
+    elif "_source" in query:
+        v = str(query["_source"])
+        if v == "false":
+            resp = {k: x for k, x in resp.items() if k != "_source"}
+        elif v not in ("true", ""):
+            resp = {**resp, "_source": _source_filter(v.split(","))(src)}
+    if "stored_fields" in query and src is not None:
+        wanted = str(query["stored_fields"]).split(",")
+        fields = {}
+        for f in wanted:
+            if f in src:
+                v = src[f]
+                fields[f] = v if isinstance(v, list) else [v]
+        if fields:
+            resp = {**resp, "fields": fields}
+        if str(query["stored_fields"]) == "_none_" or "_source" not in query:
+            resp = {k: x for k, x in resp.items() if k != "_source"}
+    return resp
+
+
 def get_doc(node: TpuNode, params, query, body):
     resp = node.get_doc(params["index"], params["id"],
                         routing=_routing_param(query),
                         realtime=_realtime_param(query),
                         version=(int(query["version"])
                                  if "version" in query else None))
-    return (200 if resp.get("found") else 404), resp
+    return (200 if resp.get("found") else 404), _apply_get_params(resp, query)
 
 
 def doc_exists(node: TpuNode, params, query, body):
@@ -525,10 +561,16 @@ def delete_doc(node: TpuNode, params, query, body):
 
 def update_doc(node: TpuNode, params, query, body):
     if_seq_no = query.get("if_seq_no")
+    body = dict(body or {})
+    if "_source" in query and "_source" not in body:
+        v = str(query["_source"])
+        body["_source"] = (True if v in ("true", "")
+                           else False if v == "false" else v.split(","))
     resp = node.update_doc(
-        params["index"], params["id"], body or {},
+        params["index"], params["id"], body,
         routing=_routing_param(query), refresh=_refresh_param(query),
         if_seq_no=int(if_seq_no) if if_seq_no is not None else None,
+        require_alias=query.get("require_alias") in ("true", ""),
     )
     return 200, _forced_refresh(resp, query)
 
