@@ -426,7 +426,8 @@ class ClusterFacade:
                     pass
         return resp
 
-    def mget(self, index: str | None, body: dict) -> dict:
+    def mget(self, index: str | None, body: dict,
+             realtime: bool = True) -> dict:
         docs_spec = body.get("docs")
         if docs_spec is None and "ids" in body:
             docs_spec = [{"_id": i} for i in body["ids"]]

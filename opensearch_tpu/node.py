@@ -1452,8 +1452,13 @@ class TpuNode:
 
     # -- mget / explain / field_caps / termvectors -------------------------
 
-    def mget(self, index: str | None, body: dict) -> dict:
+    def mget(self, index: str | None, body: dict,
+             realtime: bool = True) -> dict:
         """TransportMultiGetAction analog: batched realtime gets."""
+        from opensearch_tpu.common.errors import (
+            ActionRequestValidationException,
+        )
+
         body = body or {}
         if "docs" in body:
             specs = body["docs"]
@@ -1461,23 +1466,34 @@ class TpuNode:
                 raise IllegalArgumentException("[docs] must be an array")
         elif "ids" in body:
             if index is None:
-                raise IllegalArgumentException(
-                    "[ids] requires an index in the request path"
+                raise ActionRequestValidationException(
+                    "Validation Failed: 1: index is missing;"
                 )
             specs = [{"_id": i} for i in body["ids"]]
         else:
-            raise IllegalArgumentException("[mget] requires [docs] or [ids]")
+            raise ActionRequestValidationException(
+                "Validation Failed: 1: no documents to get;"
+            )
+        problems = []
+        for i, spec in enumerate(specs):
+            if not isinstance(spec, dict):
+                continue
+            if spec.get("_index", index) is None:
+                problems.append(f"{len(problems) + 1}: index is missing")
+            if spec.get("_id") is None:
+                problems.append(f"{len(problems) + 1}: id is missing")
+        if problems:
+            raise ActionRequestValidationException(
+                "Validation Failed: " + "; ".join(problems) + ";"
+            )
         docs = []
         for spec in specs:
             target = spec.get("_index", index)
             doc_id = spec.get("_id")
-            if target is None or doc_id is None:
-                raise IllegalArgumentException(
-                    "each mget doc requires [_index] and [_id]"
-                )
             try:
                 got = self.get_doc(target, str(doc_id),
-                                   routing=spec.get("routing"))
+                                   routing=spec.get("routing"),
+                                   realtime=realtime)
             except OpenSearchTpuException as e:
                 # per-doc failures (missing index, closed, bad alias) are
                 # reported in the doc's error slot, not as a request failure
@@ -1492,6 +1508,16 @@ class TpuNode:
                     got.pop("_source", None)
                 else:
                     got["_source"] = filtered
+            if spec.get("stored_fields") and got.get("found"):
+                src = got.get("_source") or {}
+                fields = {}
+                for f in spec["stored_fields"]:
+                    if f in src:
+                        v = src[f]
+                        fields[f] = v if isinstance(v, list) else [v]
+                if fields:
+                    got = {**got, "fields": fields}
+                got.pop("_source", None)
             docs.append(got)
         return {"docs": docs}
 
@@ -2356,6 +2382,7 @@ class TpuNode:
         reference's single-node default."""
         names = (sorted(self.indices) if index in (None, "", "_all")
                  else self.resolve_indices(index))
+        names = [n for n in names if not self.indices[n].closed]
         active = 0
         unassigned = 0
         per_index: dict[str, Any] = {}

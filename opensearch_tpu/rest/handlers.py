@@ -613,12 +613,25 @@ def bulk(node: TpuNode, params, query, body):
                           payload_bytes=query.get("_payload_bytes"))
 
 
+def _mget_deprecated_check(body):
+    for spec in (body or {}).get("docs", []) or []:
+        if isinstance(spec, dict) and ("_type" in spec or "fields" in spec):
+            raise IllegalArgumentException(
+                f"Unsupported field [{'_type' if '_type' in spec else 'fields'}] "
+                f"used in multi get request"
+            )
+
+
 def mget(node: TpuNode, params, query, body):
-    return 200, node.mget(params["index"], body or {})
+    _mget_deprecated_check(body)
+    return 200, node.mget(params["index"], body or {},
+                          realtime=_realtime_param(query))
 
 
 def mget_all(node: TpuNode, params, query, body):
-    return 200, node.mget(None, body or {})
+    _mget_deprecated_check(body)
+    return 200, node.mget(None, body or {},
+                          realtime=_realtime_param(query))
 
 
 def explain_doc(node: TpuNode, params, query, body):
@@ -815,11 +828,16 @@ def _validate_search_params(query, body=None):
     if "batched_reduce_size" in query:
         if int(query["batched_reduce_size"]) < 2:
             raise IllegalArgumentException("batchedReduceSize must be >= 2")
-    if query.get("scroll") is not None and \
-            str(query.get("request_cache", "")).lower() == "true":
-        raise IllegalArgumentException(
-            "[request_cache] cannot be used in a scroll context"
-        )
+    if query.get("scroll") is not None:
+        size = (body or {}).get("size", query.get("size"))
+        if size is not None and int(size) == 0:
+            raise IllegalArgumentException(
+                "[size] cannot be [0] in a scroll context"
+            )
+        if str(query.get("request_cache", "")).lower() == "true":
+            raise IllegalArgumentException(
+                "[request_cache] cannot be used in a scroll context"
+            )
 
 
 def search(node: TpuNode, params, query, body):
@@ -1172,6 +1190,22 @@ def cluster_health(node: TpuNode, params, query, body):
         # immediately (RestClusterHealthAction returns 408 + timed_out)
         resp = {**resp, "timed_out": True}
         return 408, resp
+    if "wait_for_nodes" in query:
+        spec = str(query["wait_for_nodes"])
+        n = resp["number_of_nodes"]
+        m = __import__("re").fullmatch(r"(>=|<=|>|<|==)?(\d+)", spec)
+        ok = False
+        if m:
+            op, num = m.group(1) or "==", int(m.group(2))
+            ok = {"==": n == num, ">=": n >= num, "<=": n <= num,
+                  ">": n > num, "<": n < num}[op]
+        if not ok:
+            return 408, {**resp, "timed_out": True}
+    if "wait_for_active_shards" in query:
+        spec = str(query["wait_for_active_shards"])
+        if spec != "all" and spec.isdigit() \
+                and resp["active_shards"] < int(spec):
+            return 408, {**resp, "timed_out": True}
     return 200, resp
 
 

@@ -144,7 +144,7 @@ class Stash(dict):
 
 
 def lookup(response: Any, path: str, stash: Stash) -> Any:
-    if path == "$body":
+    if path in ("$body", ""):
         return response
     current = response
     # split on '.' but keep escaped dots (a\.b)
@@ -306,8 +306,10 @@ class YamlTestRunner:
             v = str(version).strip()
             if v == "all" or v.startswith("all"):
                 raise TestSkipped(payload.get("reason", "skipped for all versions"))
-            # version ranges target OLD reference versions; this engine
-            # reports a current version so ranged skips do not apply
+            # "N - " (no upper bound) covers every later version incl. this
+            # engine's -> skip; " - N" ranges target OLD versions -> run
+            if v.endswith("-") or re.fullmatch(r"[\d.]+\s*-\s*", v):
+                raise TestSkipped(payload.get("reason", v))
 
     def _do(self, payload: dict, dispatch, stash: Stash) -> None:
         payload = dict(payload)
@@ -325,6 +327,15 @@ class YamlTestRunner:
                    if ignore is not None else set())
         method, path, query, body = self.specs.resolve(api, args)
         status, response = dispatch(method, path, query, body)
+        if method == "HEAD":
+            # HEAD-based exists APIs: the client contract is a boolean
+            # (404 is "false", not an error) — ClientYamlTestResponse
+            response = status == 200
+            self.last_response = response
+            if catch is None and status not in (200, 404):
+                raise StepFailure(f"do {api}: HTTP {status}")
+            if catch is None:
+                return
         self.last_response = response
         if catch is None:
             if status in ignored:

@@ -59,7 +59,11 @@ class TestMget:
         assert res["docs"][0]["_source"] == {"genre": "animal"}
 
     def test_requires_body(self, node):
-        with pytest.raises(IllegalArgumentException):
+        from opensearch_tpu.common.errors import (
+            ActionRequestValidationException,
+        )
+
+        with pytest.raises(ActionRequestValidationException):
             node.mget("lib", {})
 
 
