@@ -300,7 +300,13 @@ class ClusterFacade:
                   routing: str | None = None, if_seq_no: int | None = None,
                   refresh: bool = False, op_type: str | None = None,
                   pipeline: str | None = None, version: int | None = None,
-                  version_type: str = "internal") -> dict:
+                  version_type: str = "internal",
+                  if_primary_term: int | None = None) -> dict:
+        if if_primary_term is not None and int(if_primary_term) != 1:
+            raise VersionConflictException(
+                f"[{doc_id}]: version conflict, required primaryTerm "
+                f"[{if_primary_term}], current primaryTerm [1]"
+            )
         if pipeline is not None:
             self._unsupported("ingest pipelines")
         if version is not None:
@@ -317,7 +323,7 @@ class ClusterFacade:
 
     def get_doc(self, index: str, doc_id: str,
                 routing: str | None = None, realtime: bool = True,
-                version: int | None = None) -> dict:
+                version: int | None = None, refresh: bool = False) -> dict:
         got = self._on_loop(lambda cb: self.node.get_doc(
             index, doc_id, cb, routing=routing
         ))
@@ -427,7 +433,7 @@ class ClusterFacade:
         return resp
 
     def mget(self, index: str | None, body: dict,
-             realtime: bool = True) -> dict:
+             realtime: bool = True, refresh: bool = False) -> dict:
         docs_spec = body.get("docs")
         if docs_spec is None and "ids" in body:
             docs_spec = [{"_id": i} for i in body["ids"]]

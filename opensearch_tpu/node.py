@@ -1066,6 +1066,7 @@ class TpuNode:
         pipeline: str | None = None,
         version: int | None = None,
         version_type: str = "internal",
+        if_primary_term: int | None = None,
     ) -> dict:
         # single-doc writes go through the same admission control as _bulk
         # (the reference accounts ALL write operations in IndexingPressure);
@@ -1075,11 +1076,19 @@ class TpuNode:
         ):
             return self._index_doc_inner(index, doc_id, source, routing,
                                          if_seq_no, refresh, op_type, pipeline,
-                                         version, version_type)
+                                         version, version_type,
+                                         if_primary_term)
 
     def _index_doc_inner(self, index, doc_id, source, routing,
                          if_seq_no, refresh, op_type, pipeline,
-                         version=None, version_type="internal") -> dict:
+                         version=None, version_type="internal",
+                         if_primary_term=None) -> dict:
+        if if_primary_term is not None and int(if_primary_term) != 1:
+            # single-term engine: any other required term conflicts
+            raise VersionConflictException(
+                f"[{doc_id}]: version conflict, required primaryTerm "
+                f"[{if_primary_term}], current primaryTerm [1]"
+            )
         if version is not None and op_type == "create" and \
                 version_type != "internal":
             from opensearch_tpu.common.errors import (
@@ -1133,6 +1142,11 @@ class TpuNode:
             import uuid
 
             doc_id = uuid.uuid4().hex[:20]
+        if len(doc_id.encode()) > 512:
+            raise IllegalArgumentException(
+                f"id is too long, must be no longer than 512 bytes but "
+                f"was: {len(doc_id.encode())}"
+            )
         shard = svc.shard_for(doc_id, routing)
         # record where this write actually landed (post-pipeline index AND
         # post-pipeline routing) so _bulk's refresh=true touches the right
@@ -1172,11 +1186,16 @@ class TpuNode:
         }
 
     def get_doc(self, index: str, doc_id: str, routing: str | None = None,
-                realtime: bool = True, version: int | None = None) -> dict:
+                realtime: bool = True, version: int | None = None,
+                refresh: bool = False) -> dict:
         index, routing = self._resolve_write_alias(index, routing,
                                                    for_write=False)
         svc = self._get_open_index(index)
         shard = svc.shard_for(doc_id, routing)
+        if refresh:
+            # GET ?refresh=true forces a refresh before the read
+            # (RealtimeRequest.refresh)
+            shard.refresh()
         got = shard.get(doc_id, realtime=realtime)
         if got is None:
             return {"_index": index, "_id": doc_id, "found": False}
@@ -1453,7 +1472,7 @@ class TpuNode:
     # -- mget / explain / field_caps / termvectors -------------------------
 
     def mget(self, index: str | None, body: dict,
-             realtime: bool = True) -> dict:
+             realtime: bool = True, refresh: bool = False) -> dict:
         """TransportMultiGetAction analog: batched realtime gets."""
         from opensearch_tpu.common.errors import (
             ActionRequestValidationException,
@@ -1493,7 +1512,7 @@ class TpuNode:
             try:
                 got = self.get_doc(target, str(doc_id),
                                    routing=spec.get("routing"),
-                                   realtime=realtime)
+                                   realtime=realtime, refresh=refresh)
             except OpenSearchTpuException as e:
                 # per-doc failures (missing index, closed, bad alias) are
                 # reported in the doc's error slot, not as a request failure

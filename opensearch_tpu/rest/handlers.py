@@ -423,11 +423,13 @@ def index_doc(node: TpuNode, params, query, body):
     if body is None:
         raise IllegalArgumentException("request body is required")
     if_seq_no = query.get("if_seq_no")
+    if_pt = query.get("if_primary_term")
     _check_require_alias(node, params["index"], query)
     resp = node.index_doc(
         params["index"], params["id"], body,
         routing=_routing_param(query),
         if_seq_no=int(if_seq_no) if if_seq_no is not None else None,
+        if_primary_term=int(if_pt) if if_pt is not None else None,
         refresh=_refresh_param(query),
         op_type="create" if query.get("op_type") == "create" else None,
         pipeline=query.get("pipeline"),
@@ -505,6 +507,8 @@ def get_doc(node: TpuNode, params, query, body):
     resp = node.get_doc(params["index"], params["id"],
                         routing=_routing_param(query),
                         realtime=_realtime_param(query),
+                        refresh=str(query.get("refresh", "false"))
+                        in ("true", ""),
                         version=(int(query["version"])
                                  if "version" in query else None))
     return (200 if resp.get("found") else 404), _apply_get_params(resp, query)
@@ -625,13 +629,17 @@ def _mget_deprecated_check(body):
 def mget(node: TpuNode, params, query, body):
     _mget_deprecated_check(body)
     return 200, node.mget(params["index"], body or {},
-                          realtime=_realtime_param(query))
+                          realtime=_realtime_param(query),
+                          refresh=str(query.get("refresh", "false"))
+                          in ("true", ""))
 
 
 def mget_all(node: TpuNode, params, query, body):
     _mget_deprecated_check(body)
     return 200, node.mget(None, body or {},
-                          realtime=_realtime_param(query))
+                          realtime=_realtime_param(query),
+                          refresh=str(query.get("refresh", "false"))
+                          in ("true", ""))
 
 
 def explain_doc(node: TpuNode, params, query, body):
