@@ -941,6 +941,17 @@ class SegmentExecutor:
         (TermsSetQueryBuilder -> CoveringQuery)."""
         field = node.field
         mapper = self.ctx.mapper_service.field_mapper(field)
+        if mapper is None:
+            flat = self.ctx.mapper_service.flat_object_parent(field)
+            if flat is not None:
+                root, subpath = flat
+                return self._exec_TermsSetQuery(q.TermsSetQuery(
+                    field=f"{root}#paths",
+                    terms=[f"{subpath}={t}" for t in node.terms],
+                    minimum_should_match_field=node.minimum_should_match_field,
+                    minimum_should_match_script=node.minimum_should_match_script,
+                    boost=node.boost,
+                ))
         kf_host = self.host.keyword_fields.get(field)
         counts = np.zeros(self.host.n_docs, np.int64)
         if kf_host is not None:
@@ -1177,6 +1188,27 @@ class SegmentExecutor:
             return NodeResult(jnp.maximum(r1.scores, r2.scores),
                               r1.mask | r2.mask, True)
         masks = []
+        if field not in self.dev.numeric_fields \
+                and field not in self.dev.vector_fields \
+                and field not in self.dev.keyword_fields \
+                and field not in self.dev.text_fields:
+            # object prefix: exists == any mapped child exists
+            children = [
+                name for name in self.ctx.mapper_service.mappers
+                if name.startswith(f"{field}.")
+            ]
+            if children:
+                out = None
+                for child in children:
+                    r = self._exec_ExistsQuery(
+                        q.ExistsQuery(field=child, boost=node.boost)
+                    )
+                    out = r if out is None else NodeResult(
+                        jnp.maximum(out.scores, r.scores),
+                        out.mask | r.mask, True,
+                    )
+                if out is not None:
+                    return out
         if field in self.dev.numeric_fields:
             masks.append(self.dev.numeric_fields[field].present)
         if field in self.dev.vector_fields:
