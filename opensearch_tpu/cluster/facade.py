@@ -104,6 +104,9 @@ class ClusterFacade:
         from opensearch_tpu.tasks.manager import TaskManager
 
         self.task_manager = TaskManager(cluster_node.node_id)
+        from opensearch_tpu.index.request_cache import RequestCache
+
+        self.request_cache = RequestCache()
 
     # ------------------------------------------------------------------ #
     # loop bridging
@@ -479,7 +482,8 @@ class ClusterFacade:
     def search(self, index: str | None = None, body: dict | None = None,
                scroll: str | None = None,
                search_pipeline: str | None = None,
-               ignore_unavailable: bool = False) -> dict:
+               ignore_unavailable: bool = False,
+               request_cache: bool | None = None) -> dict:
         from opensearch_tpu.search.reduce import (
             check_cluster_aggs_supported,
             reduce_search_responses,

@@ -1,0 +1,97 @@
+"""Indices request cache: shard-level search-response caching.
+
+The analog of the reference's IndicesRequestCache
+(server/src/main/java/org/opensearch/indices/IndicesRequestCache.java):
+shard-level query results are cached keyed by (reader generation, request
+bytes); a refresh that changes the reader invalidates naturally because
+the generation moves. The reference caches only size=0 requests by default
+(aggregations/counts) — the same policy here — and honors the
+`request_cache` request param plus the `index.requests.cache.enable`
+setting.
+
+Cache scope is the NODE (one LRU across shards, like the reference's
+single node-level cache with per-shard keys); eviction is LRU by entry
+count (the reference evicts by bytes; entry count is the stand-in until
+responses carry a size estimate).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from collections import OrderedDict
+from typing import Any
+
+DEFAULT_MAX_ENTRIES = 1024
+
+
+class RequestCache:
+    def __init__(self, max_entries: int = DEFAULT_MAX_ENTRIES):
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, Any] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def cacheable(body: dict | None, request_cache: bool | None) -> bool:
+        """IndicesService.canCache: only size=0 requests by default; an
+        explicit request_cache=true opts any request in, =false opts out."""
+        body = body or {}
+        if request_cache is False:
+            return False
+        if body.get("profile"):
+            return False
+        # scroll/PIT callers never reach the cache (their pinned snapshots
+        # bypass shard-level caching by construction)
+        if request_cache is True:
+            return True
+        return int(body.get("size", 10)) == 0
+
+    @staticmethod
+    def key(index: str, shard_ids: list, generations: list[int],
+            body: dict | None) -> tuple:
+        blob = json.dumps(body or {}, sort_keys=True, default=str)
+        digest = hashlib.sha1(blob.encode()).hexdigest()
+        return (index, tuple(shard_ids), tuple(generations), digest)
+
+    def get(self, key: tuple):
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return self._entries[key]
+            self.misses += 1
+            return None
+
+    def put(self, key: tuple, value: Any) -> None:
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def clear(self, index: str | None = None) -> int:
+        with self._lock:
+            if index is None:
+                n = len(self._entries)
+                self._entries.clear()
+                return n
+            victims = [k for k in self._entries if k[0] == index]
+            for k in victims:
+                del self._entries[k]
+            return len(victims)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "memory_size_in_bytes": sum(
+                    len(json.dumps(v, default=str))
+                    for v in self._entries.values()
+                ),
+                "evictions": 0,
+                "hit_count": self.hits,
+                "miss_count": self.misses,
+                "entries": len(self._entries),
+            }

@@ -195,6 +195,9 @@ class TpuNode:
         self.query_groups = QueryGroupService(
             self.data_path / "query_groups.json"
         )
+        from opensearch_tpu.index.request_cache import RequestCache
+
+        self.request_cache = RequestCache()
         from opensearch_tpu.index.remote_store import RemoteStoreService
 
         self.remote_store = RemoteStoreService(self)
@@ -1715,7 +1718,8 @@ class TpuNode:
                scroll: str | None = None,
                search_pipeline: str | None = None,
                ignore_unavailable: bool = False,
-               query_group: str | None = None) -> dict:
+               query_group: str | None = None,
+               request_cache: bool | None = None) -> dict:
         body = dict(body or {})
         # body key is always consumed; an explicit param takes precedence
         body_pipeline = body.pop("search_pipeline", None)
@@ -1811,13 +1815,34 @@ class TpuNode:
                                       pipeline_id=pipeline_id, names=names,
                                       shard_filters=shard_filters)
         # per-hit _index comes from each shard's ShardId inside the service
+        from opensearch_tpu.index.request_cache import RequestCache as _RC
+
+        cache_on = request_cache
+        if cache_on is None:
+            for n in names:
+                svc = self.indices.get(n)
+                if svc is not None and str(
+                    (svc.settings or {}).get("requests.cache.enable", True)
+                ).lower() == "false":
+                    cache_on = False
+                    break
+        cache_key = None
+        if _RC.cacheable(body, cache_on):
+            gens = [s.engine._refresh_generation for s in shards]
+            cache_key = _RC.key(expr, [id(s) for s in shards], gens, body)
+            cached = self.request_cache.get(cache_key)
+            if cached is not None:
+                return json.loads(cached)
         self.search_backpressure.admit()
         with self.query_groups.admit(query_group), self.task_manager.task_scope(
             "indices:data/read/search", description=f"indices[{expr}]"
         ) as task:
-            return self._search_with_pipeline(pipeline_id, names, shards, body,
+            resp = self._search_with_pipeline(pipeline_id, names, shards, body,
                                               shard_filters=shard_filters,
                                               task=task)
+        if cache_key is not None:
+            self.request_cache.put(cache_key, json.dumps(resp, default=str))
+        return resp
 
     def _resolve_indices_boost(self, spec,
                                ignore_unavailable: bool = False) -> dict:

@@ -167,6 +167,8 @@ def build_router() -> Router:
     reg("POST", "/_flush", flush_all)
     reg("POST", "/{index}/_forcemerge", forcemerge)
     reg("POST", "/_forcemerge", forcemerge)
+    reg("POST", "/{index}/_cache/clear", clear_cache)
+    reg("POST", "/_cache/clear", clear_cache_all)
     # ingest pipelines
     reg("PUT", "/_ingest/pipeline/{id}", put_pipeline)
     reg("GET", "/_ingest/pipeline", get_pipelines)
@@ -818,6 +820,18 @@ def _totals_as_int(resp: dict, query) -> dict:
     return convert(resp)
 
 
+def clear_cache(node: TpuNode, params, query, body):
+    n = node.request_cache.clear(params.get("index"))
+    return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0},
+                 "cleared": n}
+
+
+def clear_cache_all(node: TpuNode, params, query, body):
+    n = node.request_cache.clear(None)
+    return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0},
+                 "cleared": n}
+
+
 def _validate_search_params(query, body=None):
     """Request-param validation (SearchRequest.validate analogs)."""
     if str(query.get("rest_total_hits_as_int", "false")) in ("true", ""):
@@ -850,12 +864,15 @@ def _validate_search_params(query, body=None):
 
 def search(node: TpuNode, params, query, body):
     _validate_search_params(query, body)
+    rc = query.get("request_cache")
     resp = node.search(params["index"], _body_with_query_params(query, body),
                        scroll=query.get("scroll"),
                        search_pipeline=query.get("search_pipeline"),
                        ignore_unavailable=str(
                            query.get("ignore_unavailable", "false")
-                       ) in ("true", ""))
+                       ) in ("true", ""),
+                       request_cache=(None if rc is None
+                                      else str(rc) in ("true", "")))
     return 200, _totals_as_int(resp, query)
 
 

@@ -1,0 +1,48 @@
+"""Indices request cache: size=0 caching, refresh invalidation, clear."""
+
+import pytest
+
+from opensearch_tpu.node import TpuNode
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = TpuNode(tmp_path / "node")
+    n.create_index("c", {"mappings": {"properties": {
+        "tag": {"type": "keyword"}}}})
+    n.index_doc("c", "1", {"tag": "a"}, refresh=True)
+    yield n
+    n.close()
+
+
+def test_size0_cached_and_invalidated_by_refresh(node):
+    body = {"size": 0, "query": {"term": {"tag": "a"}}}
+    r1 = node.search("c", body)
+    assert r1["hits"]["total"]["value"] == 1
+    h0 = node.request_cache.hits
+    r2 = node.search("c", body)
+    assert node.request_cache.hits == h0 + 1
+    assert r2["hits"]["total"]["value"] == 1
+    # a refresh moves the generation -> stale entry unreachable
+    node.index_doc("c", "2", {"tag": "a"}, refresh=True)
+    r3 = node.search("c", body)
+    assert r3["hits"]["total"]["value"] == 2
+
+
+def test_fetching_requests_not_cached_by_default(node):
+    body = {"query": {"term": {"tag": "a"}}}
+    node.search("c", body)
+    m0 = node.request_cache.misses
+    node.search("c", body)
+    assert node.request_cache.misses == m0  # never consulted
+
+
+def test_explicit_opt_in_and_clear(node):
+    body = {"query": {"term": {"tag": "a"}}}
+    node.search("c", body, request_cache=True)
+    h0 = node.request_cache.hits
+    node.search("c", body, request_cache=True)
+    assert node.request_cache.hits == h0 + 1
+    assert node.request_cache.clear("c") >= 1
+    st = node.request_cache.stats()
+    assert st["entries"] == 0
