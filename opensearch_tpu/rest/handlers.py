@@ -261,6 +261,9 @@ def build_router() -> Router:
     reg("GET", "/_cluster/stats", cluster_stats)
     reg("GET", "/_stats", all_stats)
     reg("GET", "/{index}/_stats", index_stats)
+    reg("GET", "/{index}/_stats/{metric}", index_stats)
+    reg("GET", "/_cluster/state/{metric}", cluster_state_metric)
+    reg("GET", "/_cluster/state/{metric}/{index}", cluster_state_metric)
     reg("GET", "/_remote/info", remote_info)
     # remote segment store (index/remote + RemoteStoreRestoreService)
     reg("POST", "/_remotestore/_restore", remotestore_restore)
@@ -345,7 +348,8 @@ def get_index(node: TpuNode, params, query, body):
         allow_no_indices=str(query.get("allow_no_indices", "true")) != "false",
     ):
         out[name] = {
-            "aliases": {},
+            "aliases": {a: dict(c or {})
+                        for a, c in node.indices[name].aliases.items()},
             "mappings": node.indices[name].mapper_service.to_dict(),
             "settings": node.get_settings(name)[name]["settings"],
         }
@@ -832,8 +836,41 @@ def clear_cache_all(node: TpuNode, params, query, body):
                  "cleared": n}
 
 
+def cluster_state_metric(node: TpuNode, params, query, body):
+    """GET /_cluster/state/{metric}[/{index}] — the metadata projection."""
+    metrics = str(params.get("metric", "_all")).split(",")
+    index = params.get("index")
+    names = (node.resolve_indices(index) if index else sorted(node.indices))
+    out: dict[str, Any] = {"cluster_name": "opensearch-tpu"}
+    if "_all" in metrics or "metadata" in metrics:
+        out["metadata"] = {"indices": {
+            name: {
+                "state": "close" if node.indices[name].closed else "open",
+                "settings": node.get_settings(name)[name]["settings"],
+                "mappings": node.indices[name].mapper_service.to_dict(),
+                "aliases": sorted(node.indices[name].aliases),
+            }
+            for name in names
+        }}
+    if "_all" in metrics or "routing_table" in metrics:
+        out["routing_table"] = {"indices": {
+            name: {"shards": {
+                str(s): [{"state": "STARTED", "primary": True,
+                          "index": name, "shard": s}]
+                for s in range(node.indices[name].num_shards)
+            }}
+            for name in names
+        }}
+    return 200, out
+
+
 def _validate_search_params(query, body=None):
     """Request-param validation (SearchRequest.validate analogs)."""
+    if "pre_filter_shard_size" in query:
+        if int(query["pre_filter_shard_size"]) < 1:
+            raise IllegalArgumentException(
+                "preFilterShardSize must be >= 1"
+            )
     if str(query.get("rest_total_hits_as_int", "false")) in ("true", ""):
         tth = (body or {}).get("track_total_hits", True)
         if tth not in (True, False):

@@ -259,6 +259,14 @@ def apply_collapse(collapse_body, merged, per_shard_results):
         members = groups.get(value, [(shard_idx, hit)])
         per_name = {}
         for spec in inner_specs:
+            if "collapse" in spec:
+                from opensearch_tpu.common.errors import (
+                    IllegalArgumentException,
+                )
+
+                raise IllegalArgumentException(
+                    "cannot use `collapse` inside `inner_hits`"
+                )
             name = spec.get("name") or field
             cand = list(members)
             sort = spec.get("sort")

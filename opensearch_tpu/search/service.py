@@ -509,6 +509,14 @@ def search(
                         if spec.get("seq_no_primary_term"):
                             sub["_seq_no"] = int(sh_host.doc_seq_nos[h_.doc])
                             sub["_primary_term"] = 1
+                        if spec.get("fields") or spec.get("docvalue_fields"):
+                            fv = fetch.docvalue_fields_for_doc(
+                                spec.get("fields")
+                                or spec.get("docvalue_fields"),
+                                sh_host, h_.doc, sh_shard.mapper_service,
+                            )
+                            if fv:
+                                sub["fields"] = fv
                         if best is None or (h_.score or 0) > best:
                             best = h_.score
                         sub_hits.append(sub)
