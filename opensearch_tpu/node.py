@@ -1145,6 +1145,7 @@ class TpuNode:
             import uuid
 
             doc_id = uuid.uuid4().hex[:20]
+        doc_id = str(doc_id)
         if len(doc_id.encode()) > 512:
             raise IllegalArgumentException(
                 f"id is too long, must be no longer than 512 bytes but "

@@ -1105,16 +1105,39 @@ class SegmentExecutor:
         return (lat_f.values_f64[:n], lon_f.values_f64[:n],
                 lat_f.present[:n])
 
+    def _geo_match_docs(self, field: str, point_pred) -> np.ndarray | None:
+        """bool[n_docs] — doc matches if ANY of its points satisfies
+        `point_pred(lat_array, lon_array) -> bool_array` (multi-valued
+        geo_point docs hold parallel lat/lon CSRs)."""
+        lat_f = self.host.numeric_fields.get(f"{field}#lat")
+        lon_f = self.host.numeric_fields.get(f"{field}#lon")
+        if lat_f is None or lon_f is None:
+            return None
+        n = self.host.n_docs
+        out = np.zeros(n, bool)
+        if lat_f.mv_offsets is not None and lon_f.mv_offsets is not None:
+            sel = point_pred(lat_f.mv_values, lon_f.mv_values)
+            idx = np.nonzero(sel)[0]
+            if len(idx):
+                doc_of = np.searchsorted(lat_f.mv_offsets, idx,
+                                         side="right") - 1
+                out[np.unique(doc_of)] = True
+            return out
+        sel = point_pred(lat_f.values_f64[:n], lon_f.values_f64[:n])
+        out[:n] = lat_f.present[:n] & sel
+        return out
+
     def _exec_GeoDistanceQuery(self, node: q.GeoDistanceQuery) -> NodeResult:
-        cols = self._geo_columns(node.field)
-        if cols is None:
-            return _empty(self.dev)
-        lat, lon, present = cols
         o_lat, o_lon = _parse_geo_origin(node.point)
         radius = _parse_distance_meters(node.distance)
-        dist = _haversine_m(o_lat, o_lon, lat, lon)
+        sel = self._geo_match_docs(
+            node.field,
+            lambda la, lo: _haversine_m(o_lat, o_lon, la, lo) <= radius,
+        )
+        if sel is None:
+            return _empty(self.dev)
         mask_host = np.zeros(self.dev.n_pad, bool)
-        mask_host[: self.host.n_docs] = present & (dist <= radius)
+        mask_host[: self.host.n_docs] = sel
         return _const_result(jnp.asarray(mask_host) & self.dev.live,
                              node.boost, scoring=True)
 
@@ -1122,10 +1145,6 @@ class SegmentExecutor:
         """geo_shape over point columns: the shape's bounding box is the
         match region (exact for envelope/point; polygon matches by bbox —
         a documented approximation of the reference's tessellated shapes)."""
-        cols = self._geo_columns(node.field)
-        if cols is None:
-            return _empty(self.dev)
-        lat, lon, present = cols
         shape = node.shape or {}
         styp = str(shape.get("type", "")).lower()
         coords = shape.get("coordinates")
@@ -1146,29 +1165,33 @@ class SegmentExecutor:
             )
         lat_hi, lat_lo = max(lats), min(lats)
         lon_hi, lon_lo = max(lons), min(lons)
-        inside = present & (lat >= lat_lo) & (lat <= lat_hi) \
-            & (lon >= lon_lo) & (lon <= lon_hi)
-        if node.relation == "disjoint":
-            sel = present & ~inside
-        else:  # intersects / within / contains on points collapse to inside
-            sel = inside
+
+        def pred(la, lo):
+            inside = (la >= lat_lo) & (la <= lat_hi) \
+                & (lo >= lon_lo) & (lo <= lon_hi)
+            return ~inside if node.relation == "disjoint" else inside
+
+        sel = self._geo_match_docs(node.field, pred)
+        if sel is None:
+            return _empty(self.dev)
         mask_host = np.zeros(self.dev.n_pad, bool)
         mask_host[: self.host.n_docs] = sel
         return _const_result(jnp.asarray(mask_host) & self.dev.live,
                              node.boost, scoring=True)
 
     def _exec_GeoBoundingBoxQuery(self, node: q.GeoBoundingBoxQuery) -> NodeResult:
-        cols = self._geo_columns(node.field)
-        if cols is None:
-            return _empty(self.dev)
-        lat, lon, present = cols
         tl_lat, tl_lon = _parse_geo_origin(node.top_left)
         br_lat, br_lon = _parse_geo_origin(node.bottom_right)
-        sel = present & (lat <= tl_lat) & (lat >= br_lat)
-        if tl_lon <= br_lon:
-            sel = sel & (lon >= tl_lon) & (lon <= br_lon)
-        else:  # box crossing the antimeridian
-            sel = sel & ((lon >= tl_lon) | (lon <= br_lon))
+
+        def pred(la, lo):
+            box = (la <= tl_lat) & (la >= br_lat)
+            if tl_lon <= br_lon:
+                return box & (lo >= tl_lon) & (lo <= br_lon)
+            return box & ((lo >= tl_lon) | (lo <= br_lon))
+
+        sel = self._geo_match_docs(node.field, pred)
+        if sel is None:
+            return _empty(self.dev)
         mask_host = np.zeros(self.dev.n_pad, bool)
         mask_host[: self.host.n_docs] = sel
         return _const_result(jnp.asarray(mask_host) & self.dev.live,
@@ -1911,14 +1934,25 @@ def execute_query_phase(
 
 
 def _field_sort_values(
-    host: HostSegment, field: str, docs: np.ndarray, mapper_service: MapperService
+    host: HostSegment, field: str, docs: np.ndarray,
+    mapper_service: MapperService, mode: str | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """(values float64/int64, present bool) for the requested docs. A field
     absent from this whole segment means every doc's value is missing (the
-    reference sorts those by the `missing` policy rather than erroring)."""
+    reference sorts those by the `missing` policy rather than erroring).
+    `mode` picks the multi-value reduction (SortedNumericSortField's
+    min/max/sum/avg/median; default min asc / max desc chosen by caller)."""
     nf = host.numeric_fields.get(field)
     if nf is not None:
         vals = nf.values_i64 if nf.kind == "int" else nf.values_f64
+        if mode and nf.mv_offsets is not None:
+            red = {"min": np.min, "max": np.max, "sum": np.sum,
+                   "avg": np.mean, "median": np.median}.get(mode, np.min)
+            out = np.array([
+                red(nf.doc_values(int(d))) if nf.present[d] else 0
+                for d in docs
+            ])
+            return out, nf.present[docs]
         return vals[docs], nf.present[docs]
     kf = host.keyword_fields.get(field)
     if kf is not None:
@@ -1950,7 +1984,11 @@ def _sorted_segment_hits(
         elif fname in ("_doc", "_shard_doc"):
             sort_cols.append((docs.astype(np.float64), np.ones(len(docs), bool), order, None))
         else:
-            vals, present = _field_sort_values(host, fname, docs, mapper_service)
+            spec_conf = spec if isinstance(spec, dict) else {}
+            conf = spec_conf.get(fname) if isinstance(spec_conf.get(fname), dict) else {}
+            mode = conf.get("mode") or ("max" if order == "desc" else "min")
+            vals, present = _field_sort_values(host, fname, docs,
+                                               mapper_service, mode=mode)
             kf = host.keyword_fields.get(fname)
             sort_cols.append((vals, present, order, kf.ord_values if kf is not None else None))
     unbias = {
