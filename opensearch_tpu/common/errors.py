@@ -50,6 +50,14 @@ class ParsingException(OpenSearchTpuException):
     error_type = "parsing_exception"
 
 
+class ParseException(OpenSearchTpuException):
+    """Generic content-parse failure (common.ParsingException vs the
+    x-content ParseException type string)."""
+
+    status = 400
+    error_type = "parse_exception"
+
+
 class IllegalArgumentException(OpenSearchTpuException):
     status = 400
     error_type = "illegal_argument_exception"

@@ -1413,6 +1413,10 @@ class SegmentExecutor:
         )
 
     def _exec_RegexpQuery(self, node: q.RegexpQuery) -> NodeResult:
+        node = q.RegexpQuery(field=node.field,
+                             value=self._normalize_kw(node.field, node.value),
+                             case_insensitive=node.case_insensitive,
+                             boost=node.boost)
         if len(node.value) > 1000:
             raise IllegalArgumentException(
                 f"The length of regex [{len(node.value)}] used in the "
@@ -1944,10 +1948,33 @@ def _field_sort_values(
     min/max/sum/avg/median; default min asc / max desc chosen by caller)."""
     nf = host.numeric_fields.get(field)
     if nf is not None:
+        mapper = mapper_service.field_mapper(field)
+        unsigned = mapper is not None and \
+            getattr(mapper, "original_type", None) == "unsigned_long"
         vals = nf.values_i64 if nf.kind == "int" else nf.values_f64
+        if unsigned:
+            # unbias in exact python-int space (np int64 would overflow)
+            import statistics
+
+            red = {"min": min, "max": max, "sum": sum,
+                   "avg": statistics.mean,
+                   # Lucene's MEDIAN selector takes the upper-middle
+                   # ELEMENT, not an interpolated midpoint
+                   "median": lambda vv: sorted(vv)[len(vv) // 2],
+                   }.get(mode or "min", min)
+            out = np.empty(len(docs), dtype=object)
+            for i, d in enumerate(docs):
+                if nf.present[d]:
+                    vv = [int(x) + 2**63 for x in nf.doc_values(int(d))]
+                    out[i] = red(vv) if vv else 0
+                else:
+                    out[i] = 0
+            return out, nf.present[docs]
         if mode and nf.mv_offsets is not None:
             red = {"min": np.min, "max": np.max, "sum": np.sum,
-                   "avg": np.mean, "median": np.median}.get(mode, np.min)
+                   "avg": np.mean,
+                   "median": lambda a: np.sort(a)[len(a) // 2],
+                   }.get(mode, np.min)
             out = np.array([
                 red(nf.doc_values(int(d))) if nf.present[d] else 0
                 for d in docs
@@ -1991,11 +2018,6 @@ def _sorted_segment_hits(
                                                mapper_service, mode=mode)
             kf = host.keyword_fields.get(fname)
             sort_cols.append((vals, present, order, kf.ord_values if kf is not None else None))
-    unbias = {
-        spec_i for spec_i, spec in enumerate(sort)
-        if (m := mapper_service.field_mapper(_sort_spec(spec)[0])) is not None
-        and getattr(m, "original_type", None) == "unsigned_long"
-    }
     for i, d in enumerate(docs):
         sv = []
         for col_i, (vals, present, order, ord_values) in enumerate(sort_cols):
@@ -2005,9 +2027,8 @@ def _sorted_segment_hits(
                 sv.append(ord_values[int(vals[i])])
             else:
                 v = vals[i]
-                out_v = int(v) if isinstance(v, (np.integer,)) else float(v)
-                if col_i in unbias and isinstance(out_v, int):
-                    out_v += 2**63  # biased unsigned_long -> user value
+                out_v = (int(v) if isinstance(v, (np.integer, int))
+                         else float(v))
                 sv.append(out_v)
         hits.append(ShardHit(float(scores[d]), seg_idx, int(d), sort_values=sv))
     keys = _sort_key_fn(sort)

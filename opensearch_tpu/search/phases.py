@@ -260,11 +260,9 @@ def apply_collapse(collapse_body, merged, per_shard_results):
         per_name = {}
         for spec in inner_specs:
             if "collapse" in spec:
-                from opensearch_tpu.common.errors import (
-                    IllegalArgumentException,
-                )
+                from opensearch_tpu.common.errors import ParseException
 
-                raise IllegalArgumentException(
+                raise ParseException(
                     "cannot use `collapse` inside `inner_hits`"
                 )
             name = spec.get("name") or field
