@@ -1954,13 +1954,27 @@ def _field_sort_values(
         vals = nf.values_i64 if nf.kind == "int" else nf.values_f64
         if unsigned:
             # unbias in exact python-int space (np int64 would overflow)
-            import statistics
+            def _avg_exact(vv):
+                # unsigned_long reduces in BigInteger space: exact
+                # half-up rounding (the reference's unsigned sort values)
+                s_ = sum(vv)
+                n_ = len(vv)
+                return (2 * s_ + n_) // (2 * n_)
 
-            red = {"min": min, "max": max, "sum": sum,
-                   "avg": statistics.mean,
-                   # Lucene's MEDIAN selector takes the upper-middle
-                   # ELEMENT, not an interpolated midpoint
-                   "median": lambda vv: sorted(vv)[len(vv) // 2],
+            def _median_exact(vv):
+                sv = sorted(vv)
+                n_ = len(sv)
+                if n_ % 2:
+                    return sv[n_ // 2]
+                return (sv[n_ // 2 - 1] + sv[n_ // 2] + 1) // 2
+
+            def _sum_wrap(vv):
+                # unsigned sums wrap at 2^64
+                return sum(vv) % 2**64
+
+            red = {"min": min, "max": max, "sum": _sum_wrap,
+                   "avg": _avg_exact,
+                   "median": _median_exact,
                    }.get(mode or "min", min)
             out = np.empty(len(docs), dtype=object)
             for i, d in enumerate(docs):
@@ -1971,10 +1985,32 @@ def _field_sort_values(
                     out[i] = 0
             return out, nf.present[docs]
         if mode and nf.mv_offsets is not None:
-            red = {"min": np.min, "max": np.max, "sum": np.sum,
-                   "avg": np.mean,
-                   "median": lambda a: np.sort(a)[len(a) // 2],
-                   }.get(mode, np.min)
+            is_int = nf.kind == "int"
+
+            def _sum(a):
+                if not is_int:
+                    return np.sum(a)
+                return sum(int(x) for x in a)  # exact python-int sum
+
+            def _avg(a):
+                if not is_int:
+                    return float(np.mean(a))
+                # long avg: exact sum -> double -> truncate back to long
+                # (the reference's double cast)
+                return int(float(_sum(a)) / len(a))
+
+            def _median(a):
+                sa = np.sort(a)
+                n_ = len(sa)
+                if n_ % 2:
+                    return sa[n_ // 2]
+                lo_, hi_ = sa[n_ // 2 - 1], sa[n_ // 2]
+                if not is_int:
+                    return (float(lo_) + float(hi_)) / 2.0
+                return int(float(int(lo_) + int(hi_)) / 2.0)
+
+            red = {"min": np.min, "max": np.max, "sum": _sum,
+                   "avg": _avg, "median": _median}.get(mode, np.min)
             out = np.array([
                 red(nf.doc_values(int(d))) if nf.present[d] else 0
                 for d in docs
