@@ -1991,6 +1991,40 @@ class TpuNode:
                     f"[{sf_count}]. This limit can be set by changing the "
                     f"[index.max_script_fields] index level setting."
                 )
+        # mixed-type sort across indices: unsigned_long cannot sort
+        # against other numeric types (FieldSortBuilder's validation)
+        sort_b = body.get("sort")
+        sort_list_v = ([sort_b] if isinstance(sort_b, (str, dict))
+                       else (sort_b or []))
+        for spec_v in sort_list_v:
+            fname_v = (spec_v if isinstance(spec_v, str)
+                       else next(iter(spec_v), None))
+            if not fname_v or fname_v.startswith("_"):
+                continue
+            kinds = set()
+            for n in names:
+                svc_v = self.indices.get(n)
+                if svc_v is None:
+                    continue
+                m_v = svc_v.mapper_service.field_mapper(fname_v)
+                if m_v is None:
+                    continue
+                kinds.add("unsigned_long"
+                          if m_v.original_type == "unsigned_long"
+                          else m_v.type)
+            if "unsigned_long" in kinds and len(kinds) > 1:
+                from opensearch_tpu.common.errors import (
+                    SearchPhaseExecutionException,
+                )
+
+                e = SearchPhaseExecutionException(
+                    f"Can't do sort across indices, as a field has "
+                    f"[unsigned_long] type in one index, and different "
+                    f"type in another index, so sort values can't be "
+                    f"compared for field [{fname_v}]"
+                )
+                e.status = 400
+                raise e
         if body.get("collapse") is not None:
             if scroll:
                 raise IllegalArgumentException(
