@@ -47,6 +47,7 @@ DYNAMIC_CLUSTER_SETTINGS: dict[str, Callable[[Any], None] | None] = {
     "cluster.routing.rebalance.enable": _validate_enable,
     "search.max_buckets": _validate_pos_int,
     "search.max_keep_alive": None,
+    "search.allow_expensive_queries": None,
     "search.default_keep_alive": None,
     "search.default_search_timeout": None,
     "cluster.max_shards_per_node": _validate_pos_int,

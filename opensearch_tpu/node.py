@@ -1845,6 +1845,31 @@ class TpuNode:
             self.request_cache.put(cache_key, json.dumps(resp, default=str))
         return resp
 
+    @staticmethod
+    def _find_expensive_query(qbody) -> str | None:
+        """First expensive clause in the raw query JSON (the set
+        ALLOW_EXPENSIVE_QUERIES gates in the reference)."""
+        expensive = {"script", "script_score", "fuzzy", "regexp", "prefix",
+                     "wildcard", "percolate", "intervals", "multi_match",
+                     "query_string", "join", "distance_feature"}
+
+        def walk(obj):
+            if isinstance(obj, dict):
+                for k, v in obj.items():
+                    if k in expensive:
+                        return k
+                    found = walk(v)
+                    if found:
+                        return found
+            elif isinstance(obj, list):
+                for v in obj:
+                    found = walk(v)
+                    if found:
+                        return found
+            return None
+
+        return walk(qbody)
+
     def _resolve_indices_boost(self, spec,
                                ignore_unavailable: bool = False) -> dict:
         """indices_boost: {index: boost} or [{index-or-pattern: boost}, ...]
@@ -1990,6 +2015,14 @@ class TpuNode:
                     f"less than or equal to: [{max_sf}] but was "
                     f"[{sf_count}]. This limit can be set by changing the "
                     f"[index.max_script_fields] index level setting."
+                )
+        if str(self.effective_cluster_setting(
+                "search.allow_expensive_queries", True)).lower() == "false":
+            expensive = self._find_expensive_query(body.get("query"))
+            if expensive:
+                raise IllegalArgumentException(
+                    f"[{expensive}] queries cannot be executed when "
+                    f"'search.allow_expensive_queries' is set to false."
                 )
         # mixed-type sort across indices: unsigned_long cannot sort
         # against other numeric types (FieldSortBuilder's validation)

@@ -27,6 +27,7 @@ _TOKEN_RE = re.compile(
     \s*(
         \(|\)                          # parens
         | "(?:[^"\\]|\\.)*"            # quoted phrase
+        | /(?:[^/\\]|\\.)*/            # /regex/ literal
         | (?:[^\s()":]+:)              # field prefix
         | [^\s()"]+                    # bare term
     )
