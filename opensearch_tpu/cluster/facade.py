@@ -107,6 +107,19 @@ class ClusterFacade:
         from opensearch_tpu.index.request_cache import RequestCache
 
         self.request_cache = RequestCache()
+        from opensearch_tpu.common.monitor import MonitorService
+
+        self.monitor = MonitorService(cluster_node.data_path)
+        from opensearch_tpu.wlm import QueryGroupService
+
+        self.query_groups = QueryGroupService(
+            cluster_node.data_path / "query_groups.json"
+        )
+        from opensearch_tpu.persistent import PersistentTasksService
+
+        self.persistent_tasks = PersistentTasksService(
+            cluster_node.data_path / "persistent_tasks.json"
+        )
 
     # ------------------------------------------------------------------ #
     # loop bridging
@@ -783,6 +796,73 @@ class ClusterFacade:
         "indexing_pressure", "search_backpressure", "search_slowlog",
         "indexing_slowlog", "reindex",
     }
+
+    # -- node-local stored scripts + search templates ------------------- #
+
+    def _scripts_file(self):
+        return self.node.data_path / "stored_scripts.json"
+
+    def _load_scripts(self) -> dict:
+        if self._scripts_file().exists():
+            return json.loads(self._scripts_file().read_text())
+        return {}
+
+    def put_stored_script(self, script_id: str, body: dict) -> dict:
+        script = (body or {}).get("script")
+        if not isinstance(script, dict) or "source" not in script:
+            raise IllegalArgumentException(
+                "stored script requires [script] with [source]"
+            )
+        data = self._load_scripts()
+        data[script_id] = {"lang": script.get("lang", "painless"),
+                           "source": script["source"]}
+        self._scripts_file().parent.mkdir(parents=True, exist_ok=True)
+        self._scripts_file().write_text(json.dumps(data))
+        return {"acknowledged": True}
+
+    def get_stored_script(self, script_id: str) -> dict:
+        data = self._load_scripts()
+        if script_id not in data:
+            return {"_id": script_id, "found": False}
+        return {"_id": script_id, "found": True, "script": data[script_id]}
+
+    def delete_stored_script(self, script_id: str) -> dict:
+        from opensearch_tpu.common.errors import ResourceNotFoundException
+
+        data = self._load_scripts()
+        if script_id not in data:
+            raise ResourceNotFoundException(
+                f"stored script [{script_id}] does not exist"
+            )
+        del data[script_id]
+        self._scripts_file().write_text(json.dumps(data))
+        return {"acknowledged": True}
+
+    def render_search_template(self, body: dict,
+                               template_id: str | None = None) -> dict:
+        from opensearch_tpu.common.errors import ResourceNotFoundException
+        from opensearch_tpu.script.mustache import render_search_template
+
+        body = body or {}
+        source = body.get("source")
+        sid = template_id or body.get("id")
+        if source is None and sid is not None:
+            stored = self.get_stored_script(str(sid))
+            if not stored.get("found"):
+                raise ResourceNotFoundException(
+                    f"search template [{sid}] does not exist"
+                )
+            source = stored["script"]["source"]
+        if source is None:
+            raise IllegalArgumentException(
+                "search template requires [source] or [id]"
+            )
+        return render_search_template(source, body.get("params"))
+
+    def search_template(self, index: str | None, body: dict,
+                        template_id: str | None = None, **kwargs) -> dict:
+        rendered = self.render_search_template(body, template_id)
+        return self.search(index, rendered, **kwargs)
 
     def _unsupported(self, what: str):
         raise IllegalArgumentException(
