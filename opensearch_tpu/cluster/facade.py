@@ -496,7 +496,8 @@ class ClusterFacade:
                scroll: str | None = None,
                search_pipeline: str | None = None,
                ignore_unavailable: bool = False,
-               request_cache: bool | None = None) -> dict:
+               request_cache: bool | None = None,
+               query_group: str | None = None) -> dict:
         from opensearch_tpu.search.reduce import (
             check_cluster_aggs_supported,
             reduce_search_responses,

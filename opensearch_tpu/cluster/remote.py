@@ -153,11 +153,33 @@ def merge_cross_cluster(local_resp: dict | None,
             all_hits.append(hit)
 
     if sort:
+        sort_list = [sort] if isinstance(sort, (str, dict)) else list(sort)
+        orders = []
+        for spec in sort_list:
+            if isinstance(spec, str):
+                orders.append("desc" if spec == "_score" else "asc")
+            else:
+                fname = next(iter(spec), None)
+                conf = spec.get(fname)
+                if isinstance(conf, str):
+                    orders.append(conf)
+                elif isinstance(conf, dict):
+                    orders.append(conf.get(
+                        "order", "desc" if fname == "_score" else "asc"))
+                else:
+                    orders.append("desc" if fname == "_score" else "asc")
+
         def key(hit):
-            return tuple(
-                (v is None, v if not isinstance(v, str) else _SortStr(v))
-                for v in hit.get("sort", [])
-            )
+            parts = []
+            for i, v in enumerate(hit.get("sort", [])):
+                desc = i < len(orders) and orders[i] == "desc"
+                if v is None:
+                    parts.append((1, 0, 0))
+                elif isinstance(v, str):
+                    parts.append((0, 1, _Rev(v) if desc else v))
+                else:
+                    parts.append((0, 0, -v if desc else v))
+            return tuple(parts)
 
         all_hits.sort(key=key)
     else:
@@ -178,5 +200,16 @@ def merge_cross_cluster(local_resp: dict | None,
     }
 
 
-class _SortStr(str):
-    __slots__ = ()
+class _Rev:
+    """Reverses string comparison for descending merge keys."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v: str):
+        self.v = v
+
+    def __lt__(self, other: "_Rev") -> bool:
+        return other.v < self.v
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, _Rev) and other.v == self.v

@@ -103,6 +103,11 @@ class Engine:
         self._buffer: list[tuple[ParsedDocument, int] | None] = []
         self._buffer_pos: dict[str, int] = {}
         self._refresh_generation = 0
+        import uuid as _uuid
+
+        # identity that survives neither delete/recreate nor restart —
+        # request-cache keys embed it so recreated indices never collide
+        self.engine_uuid = _uuid.uuid4().hex
         self._searcher = SearcherSnapshot([], 0)
         self._dirty_live: set[str] = set()  # segment names needing live republish
         # gap-tracking checkpoint machinery (LocalCheckpointTracker.java):

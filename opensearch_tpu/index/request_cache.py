@@ -50,11 +50,12 @@ class RequestCache:
         return int(body.get("size", 10)) == 0
 
     @staticmethod
-    def key(index: str, shard_ids: list, generations: list[int],
+    def key(names, shard_keys: list, generations: list[int],
             body: dict | None) -> tuple:
         blob = json.dumps(body or {}, sort_keys=True, default=str)
         digest = hashlib.sha1(blob.encode()).hexdigest()
-        return (index, tuple(shard_ids), tuple(generations), digest)
+        return (tuple(names), tuple(map(tuple, shard_keys)),
+                tuple(generations), digest)
 
     def get(self, key: tuple):
         with self._lock:
@@ -78,7 +79,9 @@ class RequestCache:
                 n = len(self._entries)
                 self._entries.clear()
                 return n
-            victims = [k for k in self._entries if k[0] == index]
+            victims = [k for k in self._entries
+                       if index in k[0]
+                       or any(sk[0] == index for sk in k[1])]
             for k in victims:
                 del self._entries[k]
             return len(victims)

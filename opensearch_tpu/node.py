@@ -1086,6 +1086,15 @@ class TpuNode:
                          if_seq_no, refresh, op_type, pipeline,
                          version=None, version_type="internal",
                          if_primary_term=None) -> dict:
+        if if_primary_term is not None and if_seq_no is None:
+            from opensearch_tpu.common.errors import (
+                ActionRequestValidationException,
+            )
+
+            raise ActionRequestValidationException(
+                "Validation Failed: 1: ifSeqNo is unassigned, but "
+                "primary_term is [%s];" % if_primary_term
+            )
         if if_primary_term is not None and int(if_primary_term) != 1:
             # single-term engine: any other required term conflicts
             raise VersionConflictException(
@@ -1765,8 +1774,20 @@ class TpuNode:
 
         rcs = RemoteClusterService(self)
         remote_groups, local_parts = split_index_expression(expr)
-        remote_groups = {a: ps for a, ps in remote_groups.items()
-                         if a in rcs.registered()}
+        registered = rcs.registered()
+        known_groups = {a: ps for a, ps in remote_groups.items()
+                        if a in registered}
+        if remote_groups and not ignore_unavailable:
+            unknown_remotes = set(remote_groups) - set(registered)
+            # a ":"-bearing part could also be a plain (odd) index name;
+            # only treat it as a remote expression when ANY alias resolves
+            # or the prefix is clearly not a local index
+            if unknown_remotes and known_groups:
+                raise IllegalArgumentException(
+                    f"no such remote cluster: "
+                    f"[{sorted(unknown_remotes)[0]}]"
+                )
+        remote_groups = known_groups
         if remote_groups and scroll is None:
             remote_resps = {
                 alias: rcs.search_remote(alias, ",".join(patterns), body)
@@ -1830,7 +1851,11 @@ class TpuNode:
         cache_key = None
         if _RC.cacheable(body, cache_on):
             gens = [s.engine._refresh_generation for s in shards]
-            cache_key = _RC.key(expr, [id(s) for s in shards], gens, body)
+            shard_keys = [
+                (s.shard_id.index, s.shard_id.shard, s.engine.engine_uuid)
+                for s in shards
+            ]
+            cache_key = _RC.key(tuple(sorted(names)), shard_keys, gens, body)
             cached = self.request_cache.get(cache_key)
             if cached is not None:
                 return json.loads(cached)

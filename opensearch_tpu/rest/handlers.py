@@ -908,6 +908,7 @@ def search(node: TpuNode, params, query, body):
                        ignore_unavailable=str(
                            query.get("ignore_unavailable", "false")
                        ) in ("true", ""),
+                       query_group=query.get("query_group"),
                        request_cache=(None if rc is None
                                       else str(rc) in ("true", "")))
     return 200, _totals_as_int(resp, query)

@@ -480,7 +480,7 @@ def search(
                 n_mask, n_scores = named_cache[key]
                 if h.doc < len(n_mask) and n_mask[h.doc]:
                     mq[nn.name] = float(n_scores[h.doc])
-            if mq or named_nodes:
+            if mq:
                 hit["matched_queries"] = (
                     mq if include_nq_scores else sorted(mq)
                 )
@@ -534,10 +534,7 @@ def search(
             hit["_tb"] = [gshard, h.segment, h.doc]
         hits_json.append(hit)
 
-    sort_by_score = bool(sort) and any(
-        (spec if isinstance(spec, str) else next(iter(spec), None)) == "_score"
-        for spec in (sort or [])
-    )
+    sort_by_score = bool(sort) and _sort_has_score(sort)
     if sort_by_score and max_score is None and merged:
         max_score = max(h.score for _i, h in merged)
     hits_obj: dict[str, Any] = {
