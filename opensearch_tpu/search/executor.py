@@ -755,6 +755,24 @@ class SegmentExecutor:
             result, _counts = self._bm25(field, [str(value)], node.boost)
             return NodeResult(result.scores, result.mask & self.dev.live, True)
         if ftype == "keyword" or (ftype is None and field in self.host.keyword_fields):
+            if mapper is not None and mapper.original_type == "ip" \
+                    and "/" in str(value):
+                # CIDR term: any stored address inside the subnet
+                import ipaddress
+
+                try:
+                    net = ipaddress.ip_network(str(value), strict=False)
+                except ValueError as e:
+                    raise IllegalArgumentException(
+                        f"invalid IP subnet [{value}]: {e}"
+                    ) from None
+                return self._multi_term_result(
+                    field,
+                    lambda t: (lambda a: a is not None and a in net)(
+                        _try_ip(t)
+                    ),
+                    node.boost,
+                )
             kf_dev = self.dev.keyword_fields.get(field)
             kf_host = self.host.keyword_fields.get(field)
             if kf_dev is None:
@@ -1778,6 +1796,15 @@ def _edit_distance_at_most(a: str, b: str, max_d: int) -> bool:
             return False
         prev2, prev = prev, cur
     return prev[lb] <= max_d
+
+
+def _try_ip(value: str):
+    import ipaddress
+
+    try:
+        return ipaddress.ip_address(value)
+    except ValueError:
+        return None
 
 
 def _parse_geo_origin(origin: Any) -> tuple[float, float]:

@@ -26,7 +26,7 @@ SUITES = [
     "indices.put_mapping",
 ]
 
-FLOOR = 0.76
+FLOOR = 0.78
 
 
 @pytest.mark.skipif(not REFERENCE_SPEC.exists(),
