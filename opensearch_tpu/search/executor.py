@@ -1431,8 +1431,13 @@ class SegmentExecutor:
         )
 
     def _exec_RegexpQuery(self, node: q.RegexpQuery) -> NodeResult:
-        node = q.RegexpQuery(field=node.field,
-                             value=self._normalize_kw(node.field, node.value),
+        value = self._normalize_kw(node.field, node.value)
+        m = self.ctx.mapper_service.field_mapper(node.field)
+        if m is not None and m.type == "text":
+            # analyzed text is lowercased; the classic parser normalizes
+            # multi-term patterns through the analyzer chain
+            value = value.lower()
+        node = q.RegexpQuery(field=node.field, value=value,
                              case_insensitive=node.case_insensitive,
                              boost=node.boost)
         if len(node.value) > 1000:

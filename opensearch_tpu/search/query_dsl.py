@@ -651,6 +651,11 @@ def _parse_multi_match(body: dict) -> QueryNode:
                     f"[{bad}] not allowed for type [{mm_type}]"
                 )
     raw_fields = body.get("fields", [])
+    for f in raw_fields:
+        if not isinstance(f, str) or not f:
+            raise ParsingException(
+                "[multi_match] field name is null or empty"
+            )
     field_boosts = {}
     for f in raw_fields:
         if "^" not in f:
