@@ -368,6 +368,61 @@ class TpuNode:
             self.create_index(name, {})
         return self.indices[name]
 
+    @staticmethod
+    def _resolve_date_math_name(name: str) -> str:
+        """"<logstash-{now/M}>" -> "logstash-2026.07.01"
+        (IndexNameExpressionResolver.DateMathExpressionResolver; default
+        format uuuu.MM.dd, rounding per the date-math unit)."""
+        if not (name.startswith("<") and name.endswith(">")):
+            return name
+        import datetime as _dt
+        import re as _re
+
+        inner = name[1:-1]
+
+        def repl(m):
+            expr = m.group(1)
+            fmt = "%Y.%m.%d"
+            if "{" in expr:  # custom format {now/M{yyyy.MM}}
+                expr, _, f = expr.partition("{")
+                f = f.rstrip("}")
+                fmt = (f.replace("yyyy", "%Y").replace("uuuu", "%Y")
+                        .replace("MM", "%m").replace("dd", "%d"))
+            now = _dt.datetime.now(_dt.timezone.utc)
+            rest = expr[3:] if expr.startswith("now") else ""
+            while rest:
+                m2 = _re.match(r"([+-]\d+[yMwdhHms]|/[yMwdhHms])", rest)
+                if not m2:
+                    break
+                op = m2.group(1)
+                rest = rest[len(op):]
+                if op.startswith("/"):
+                    unit = op[1:]
+                    if unit == "M":
+                        now = now.replace(day=1, hour=0, minute=0,
+                                          second=0, microsecond=0)
+                    elif unit in ("d",):
+                        now = now.replace(hour=0, minute=0, second=0,
+                                          microsecond=0)
+                    elif unit == "y":
+                        now = now.replace(month=1, day=1, hour=0,
+                                          minute=0, second=0,
+                                          microsecond=0)
+                else:
+                    sign = 1 if op[0] == "+" else -1
+                    n_, unit = int(op[1:-1]), op[-1]
+                    delta = {"d": _dt.timedelta(days=n_),
+                             "w": _dt.timedelta(weeks=n_),
+                             "h": _dt.timedelta(hours=n_),
+                             "H": _dt.timedelta(hours=n_),
+                             "m": _dt.timedelta(minutes=n_),
+                             "s": _dt.timedelta(seconds=n_)}.get(
+                        unit, _dt.timedelta())
+                    now = now + sign * delta
+            return now.strftime(fmt)
+
+        return _re.sub(r"\{([^}]*(?:\{[^}]*\})?)\}", repl, inner)
+
     def resolve_indices(self, expr: str, *, ignore_unavailable: bool = False,
                         allow_no_indices: bool = True,
                         expand_wildcards: str = "open") -> list[str]:
@@ -390,7 +445,7 @@ class TpuNode:
 
         candidates = sorted(set(self.indices) | set(alias_map))
         for part in expr.split(","):
-            part = part.strip()
+            part = self._resolve_date_math_name(part.strip())
             if "*" in part or "?" in part:
                 if not wildcards_on:
                     continue
@@ -648,7 +703,7 @@ class TpuNode:
         else:
             candidates = sorted(set(self.indices) | set(alias_map))
             for part in expr.split(","):
-                part = part.strip()
+                part = self._resolve_date_math_name(part.strip())
                 if "*" in part or "?" in part:
                     for n in candidates:
                         if fnmatch.fnmatch(n, part):
@@ -2187,15 +2242,13 @@ class TpuNode:
                 if isinstance(t, dict):
                     for fname, spec in list(t.items()):
                         if not (isinstance(spec, dict) and "index" in spec
-                                and "id" in spec):
+                                and ("id" in spec or "query" in spec)):
                             continue
                         path = str(spec.get("path", ""))
-                        got = self.get_doc(str(spec["index"]),
-                                           str(spec["id"]),
-                                           routing=spec.get("routing"))
-                        values: list = []
-                        if got.get("found"):
-                            nodes = [got.get("_source", {})]
+
+                        def extract(source: dict) -> list:
+                            values: list = []
+                            nodes = [source or {}]
                             for part in path.split("."):
                                 nxt = []
                                 for nd in nodes:
@@ -2210,9 +2263,31 @@ class TpuNode:
                                 nodes = nxt
                             for nd in nodes:
                                 if isinstance(nd, list):
-                                    values.extend(nd)
-                                else:
+                                    values.extend(
+                                        v for v in nd if v is not None
+                                    )
+                                elif nd is not None:
                                     values.append(nd)
+                            return values
+
+                        values = []
+                        if "id" in spec:
+                            got = self.get_doc(str(spec["index"]),
+                                               str(spec["id"]),
+                                               routing=spec.get("routing"))
+                            if got.get("found"):
+                                values = extract(got.get("_source", {}))
+                        else:
+                            # lookup by QUERY (3.2.0): every matching doc
+                            # contributes its path values
+                            resp = self.search(str(spec["index"]), {
+                                "query": spec["query"],
+                                "size": int(spec.get("size", 10000)),
+                            })
+                            for hit in resp["hits"]["hits"]:
+                                values.extend(
+                                    extract(hit.get("_source", {}))
+                                )
                         t[fname] = values
                 for v in obj.values():
                     resolve(v)

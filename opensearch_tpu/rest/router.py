@@ -77,8 +77,10 @@ class Router:
             if child is not None:
                 walk(child, idx + 1, params)
             if node.wildcard is not None:
+                from urllib.parse import unquote
+
                 walk(node.wildcard, idx + 1,
-                     {**params, node.wildcard_name: seg})
+                     {**params, node.wildcard_name: unquote(seg)})
 
         walk(self.root, 0, {})
         if not matches:

@@ -105,11 +105,16 @@ class ApiSpecs:
             raise StepFailure(f"no matching url for [{api}] args {args}")
         p, parts = best
         path = p["path"]
+        from urllib.parse import quote
+
         for part in parts:
             value = args.pop(part)
             if isinstance(value, list):
                 value = ",".join(str(v) for v in value)
-            path = path.replace("{" + part + "}", str(value))
+            # clients URL-encode path parts (date-math "<x-{now/M}>" has a
+            # slash); the router unquotes bound params
+            path = path.replace("{" + part + "}",
+                                quote(str(value), safe=",*"))
         method = p["methods"][0]
         if "POST" in p["methods"] and body is not None:
             method = "POST"
