@@ -2221,7 +2221,8 @@ class TpuNode:
             if isinstance(obj, dict):
                 t = obj.get("terms")
                 if isinstance(t, dict) and any(
-                    isinstance(v, dict) and "index" in v and "id" in v
+                    isinstance(v, dict) and "index" in v
+                    and ("id" in v or "query" in v)
                     for v in t.values()
                 ):
                     found = True
