@@ -26,7 +26,7 @@ SUITES = [
     "indices.put_mapping",
 ]
 
-FLOOR = 0.78
+FLOOR = 0.80
 
 
 @pytest.mark.skipif(not REFERENCE_SPEC.exists(),
