@@ -864,6 +864,20 @@ def cluster_state_metric(node: TpuNode, params, query, body):
     return 200, out
 
 
+def _with_reduce_phases(resp, query):
+    """num_reduce_phases when a batched reduce was requested
+    (QueryPhaseResultConsumer: one merge per (batch-1) results)."""
+    if "batched_reduce_size" not in query or "_shards" not in resp:
+        return resp
+    b = int(query["batched_reduce_size"])
+    n = int(resp["_shards"].get("total", 1))
+    if b >= n or b < 2:
+        phases = 1
+    else:
+        phases = -(-(n - 1) // (b - 1))
+    return {**resp, "num_reduce_phases": phases}
+
+
 def _validate_search_params(query, body=None):
     """Request-param validation (SearchRequest.validate analogs)."""
     if "pre_filter_shard_size" in query:
@@ -911,6 +925,7 @@ def search(node: TpuNode, params, query, body):
                        query_group=query.get("query_group"),
                        request_cache=(None if rc is None
                                       else str(rc) in ("true", "")))
+    resp = _with_reduce_phases(resp, query)
     return 200, _totals_as_int(resp, query)
 
 
@@ -921,6 +936,7 @@ def search_all(node: TpuNode, params, query, body):
     resp = node.search(None, _body_with_query_params(query, body),
                        scroll=query.get("scroll"),
                        search_pipeline=query.get("search_pipeline"))
+    resp = _with_reduce_phases(resp, query)
     return 200, _totals_as_int(resp, query)
 
 
