@@ -1403,8 +1403,6 @@ class TpuNode:
                                               routing, refresh=refresh)
                     return self.index_doc(index, doc_id, body["upsert"],
                                           routing, refresh=refresh)
-                from opensearch_tpu.common.errors import DocumentMissingException
-
                 raise DocumentMissingException(f"[{doc_id}]: document missing")
             ctx = {"_source": dict(current["_source"]), "op": "index",
                    "_index": index, "_id": doc_id,
@@ -1430,8 +1428,6 @@ class TpuNode:
                 if "upsert" in body:
                     return self.index_doc(index, doc_id, body["upsert"],
                                           routing, refresh=refresh)
-                from opensearch_tpu.common.errors import DocumentMissingException
-
                 raise DocumentMissingException(f"[{doc_id}]: document missing")
             merged = _deep_merge(current["_source"], body["doc"])
             if merged == current["_source"] and not body.get("detect_noop") is False:
