@@ -605,11 +605,21 @@ class TpuNode:
         names = (
             self.resolve_indices(index_expr) if index_expr else sorted(self.indices)
         )
+        def echo(conf: dict) -> dict:
+            # "routing" renders as index_routing + search_routing
+            # (AliasMetadata's response shape)
+            conf = dict(conf or {})
+            if "routing" in conf:
+                conf.setdefault("index_routing", conf["routing"])
+                conf.setdefault("search_routing", conf["routing"])
+                del conf["routing"]
+            return conf
+
         out: dict[str, dict] = {}
         for name in names:
             svc = self._get_index(name)
             matched = {
-                a: c for a, c in svc.aliases.items()
+                a: echo(c) for a, c in svc.aliases.items()
                 if alias_expr is None or alias_expr in ("_all", "*")
                 or fnmatch.fnmatch(a, alias_expr)
             }
@@ -1472,6 +1482,8 @@ class TpuNode:
         for action, meta, source in operations:
             index = meta.get("_index")
             doc_id = meta.get("_id")
+            if doc_id is not None and not isinstance(doc_id, str):
+                doc_id = str(doc_id)
             routing = meta.get("routing") or meta.get("_routing")
             if routing is not None:
                 routing = str(routing)
@@ -1501,7 +1513,24 @@ class TpuNode:
                                           pipeline=meta.get("pipeline", pipeline))
                     status = 201 if resp["result"] == "created" else 200
                 elif action == "update":
-                    resp = self.update_doc(index, doc_id, source, routing)
+                    m_seq = meta.get("if_seq_no")
+                    if m_seq is not None and \
+                            self.indices.get(index) is not None:
+                        svc_u = self.indices[index]
+                        cur_u = svc_u.shard_for(str(doc_id), routing).get(
+                            str(doc_id))
+                        if cur_u is None:
+                            # bulk CAS on a missing doc conflicts (the
+                            # item-level contract differs from the single
+                            # update API's 404)
+                            raise VersionConflictException(
+                                f"[{doc_id}]: version conflict, required "
+                                f"seqNo [{m_seq}], but no document was found"
+                            )
+                    resp = self.update_doc(
+                        index, doc_id, source, routing,
+                        if_seq_no=int(m_seq) if m_seq is not None else None,
+                    )
                     status = 200
                 elif action == "delete":
                     resp = self.delete_doc(index, doc_id, routing)

@@ -347,8 +347,16 @@ def get_index(node: TpuNode, params, query, body):
         in ("true", ""),
         allow_no_indices=str(query.get("allow_no_indices", "true")) != "false",
     ):
+        def _alias_echo(c):
+            c = dict(c or {})
+            if "routing" in c:
+                c.setdefault("index_routing", c["routing"])
+                c.setdefault("search_routing", c["routing"])
+                del c["routing"]
+            return c
+
         out[name] = {
-            "aliases": {a: dict(c or {})
+            "aliases": {a: _alias_echo(c)
                         for a, c in node.indices[name].aliases.items()},
             "mappings": node.indices[name].mapper_service.to_dict(),
             "settings": node.get_settings(name)[name]["settings"],
