@@ -1508,9 +1508,15 @@ class TpuNode:
                 if action == "index" and meta.get("op_type") == "create":
                     action = "create"
                 if action in ("index", "create"):
-                    resp = self.index_doc(index, doc_id, source, routing,
-                                          op_type=action,
-                                          pipeline=meta.get("pipeline", pipeline))
+                    m_seq = meta.get("if_seq_no")
+                    m_pt = meta.get("if_primary_term")
+                    resp = self.index_doc(
+                        index, doc_id, source, routing,
+                        op_type=action,
+                        if_seq_no=int(m_seq) if m_seq is not None else None,
+                        if_primary_term=(int(m_pt) if m_pt is not None
+                                         else None),
+                        pipeline=meta.get("pipeline", pipeline))
                     status = 201 if resp["result"] == "created" else 200
                 elif action == "update":
                     m_seq = meta.get("if_seq_no")
@@ -1581,8 +1587,14 @@ class TpuNode:
                 raise ActionRequestValidationException(
                     "Validation Failed: 1: index is missing;"
                 )
+            if not isinstance(body["ids"], list):
+                raise IllegalArgumentException("[ids] must be an array")
             specs = [{"_id": i} for i in body["ids"]]
         else:
+            raise ActionRequestValidationException(
+                "Validation Failed: 1: no documents to get;"
+            )
+        if not specs:
             raise ActionRequestValidationException(
                 "Validation Failed: 1: no documents to get;"
             )
@@ -1608,9 +1620,11 @@ class TpuNode:
                                    realtime=realtime, refresh=refresh)
             except OpenSearchTpuException as e:
                 # per-doc failures (missing index, closed, bad alias) are
-                # reported in the doc's error slot, not as a request failure
+                # reported in the doc's error slot, not as a request
+                # failure; the slot carries the full error envelope shape
                 docs.append({"_index": target, "_id": str(doc_id),
-                             "error": e.to_dict()})
+                             "error": {"root_cause": [e.to_dict()],
+                                       **e.to_dict()}})
                 continue
             if "_source" in spec and got.get("found"):
                 from opensearch_tpu.search.service import _source_filter

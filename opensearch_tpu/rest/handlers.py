@@ -512,7 +512,9 @@ def _apply_get_params(resp, query):
                 fields[f] = v if isinstance(v, list) else [v]
         if fields:
             resp = {**resp, "fields": fields}
-        if str(query["stored_fields"]) == "_none_" or "_source" not in query:
+        keep_source = "_source" in wanted or \
+            str(query.get("_source", "")) in ("true", "")
+        if not keep_source:
             resp = {k: x for k, x in resp.items() if k != "_source"}
     return resp
 
