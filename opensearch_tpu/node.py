@@ -1519,6 +1519,10 @@ class TpuNode:
                         pipeline=meta.get("pipeline", pipeline))
                     status = 201 if resp["result"] == "created" else 200
                 elif action == "update":
+                    if meta.get("_source") is not None and \
+                            isinstance(source, dict) \
+                            and "_source" not in source:
+                        source = {**source, "_source": meta["_source"]}
                     m_seq = meta.get("if_seq_no")
                     if m_seq is not None and \
                             self.indices.get(index) is not None:

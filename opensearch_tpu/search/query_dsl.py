@@ -710,14 +710,20 @@ def _parse_range(body: dict) -> QueryNode:
     if unknown:
         raise ParsingException(f"[range] unknown options {sorted(unknown)}")
     gte, gt, lte, lt = conf.get("gte"), conf.get("gt"), conf.get("lte"), conf.get("lt")
+
+    def _flag(v, default=True):
+        if isinstance(v, str):
+            return v.lower() != "false"
+        return default if v is None else bool(v)
+
     # legacy from/to form
     if "from" in conf:
-        if conf.get("include_lower", True):
+        if _flag(conf.get("include_lower")):
             gte = conf["from"]
         else:
             gt = conf["from"]
     if "to" in conf:
-        if conf.get("include_upper", True):
+        if _flag(conf.get("include_upper")):
             lte = conf["to"]
         else:
             lt = conf["to"]
