@@ -743,6 +743,7 @@ class SegmentExecutor:
                 root, subpath = flat
                 return self._exec_TermQuery(q.TermQuery(
                     field=f"{root}#paths", value=f"{subpath}={value}",
+                    case_insensitive=node.case_insensitive,
                     boost=node.boost,
                 ))
         ftype = mapper.type if mapper else None
@@ -755,6 +756,11 @@ class SegmentExecutor:
             result, _counts = self._bm25(field, [str(value)], node.boost)
             return NodeResult(result.scores, result.mask & self.dev.live, True)
         if ftype == "keyword" or (ftype is None and field in self.host.keyword_fields):
+            if node.case_insensitive:
+                want = str(value).lower()
+                return self._multi_term_result(
+                    field, lambda t: t.lower() == want, node.boost
+                )
             if mapper is not None and mapper.original_type == "ip" \
                     and "/" in str(value):
                 # CIDR term: any stored address inside the subnet

@@ -88,6 +88,7 @@ class MultiMatchQuery(QueryNode):
 class TermQuery(QueryNode):
     field: str = ""
     value: Any = None
+    case_insensitive: bool = False
 
 
 @dataclass
@@ -685,6 +686,8 @@ def _parse_term(body: dict) -> QueryNode:
     fname, conf = _single_kv(body, "term")
     if isinstance(conf, dict):
         return TermQuery(field=fname, value=conf.get("value"),
+                         case_insensitive=bool(
+                             conf.get("case_insensitive", False)),
                          boost=float(conf.get("boost", 1.0)))
     return TermQuery(field=fname, value=conf)
 
