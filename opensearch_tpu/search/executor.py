@@ -925,6 +925,22 @@ class SegmentExecutor:
 
     def _exec_RangeQuery(self, node: q.RangeQuery) -> NodeResult:
         mapper = self.ctx.mapper_service.field_mapper(node.field)
+        if mapper is None:
+            flat = self.ctx.mapper_service.flat_object_parent(node.field)
+            if flat is not None:
+                root, sub = flat
+                # lexicographic range inside the "sub=value" entries; the
+                # constant "sub=" prefix keeps bounds within this sub-path
+                return self._exec_RangeQuery(q.RangeQuery(
+                    field=f"{root}#paths",
+                    gte=(f"{sub}={node.gte}" if node.gte is not None
+                         else f"{sub}="),
+                    gt=f"{sub}={node.gt}" if node.gt is not None else None,
+                    lte=(f"{sub}={node.lte}" if node.lte is not None
+                         else f"{sub}=\uffff"),
+                    lt=f"{sub}={node.lt}" if node.lt is not None else None,
+                    boost=node.boost,
+                ))
         if mapper is not None and mapper.type == "keyword":
             # lexicographic range over ordinals (ordinals are sorted)
             kf_host = self.host.keyword_fields.get(node.field)
@@ -1418,6 +1434,15 @@ class SegmentExecutor:
         return _const_result(mask, boost, scoring=True)
 
     def _exec_PrefixQuery(self, node: q.PrefixQuery) -> NodeResult:
+        if self.ctx.mapper_service.field_mapper(node.field) is None:
+            flat = self.ctx.mapper_service.flat_object_parent(node.field)
+            if flat is not None:
+                root, subpath = flat
+                return self._exec_PrefixQuery(q.PrefixQuery(
+                    field=f"{root}#paths", value=f"{subpath}={node.value}",
+                    case_insensitive=node.case_insensitive,
+                    boost=node.boost,
+                ))
         prefix = self._normalize_kw(node.field, node.value)
         prefix = prefix.lower() if node.case_insensitive else prefix
         if node.case_insensitive:
@@ -1429,6 +1454,15 @@ class SegmentExecutor:
         )
 
     def _exec_WildcardQuery(self, node: q.WildcardQuery) -> NodeResult:
+        if self.ctx.mapper_service.field_mapper(node.field) is None:
+            flat = self.ctx.mapper_service.flat_object_parent(node.field)
+            if flat is not None:
+                root, subpath = flat
+                return self._exec_WildcardQuery(q.WildcardQuery(
+                    field=f"{root}#paths", value=f"{subpath}={node.value}",
+                    case_insensitive=node.case_insensitive,
+                    boost=node.boost,
+                ))
         rx = _wildcard_to_regex(
             self._normalize_kw(node.field, node.value), node.case_insensitive
         )
