@@ -925,6 +925,11 @@ class SegmentExecutor:
 
     def _exec_RangeQuery(self, node: q.RangeQuery) -> NodeResult:
         mapper = self.ctx.mapper_service.field_mapper(node.field)
+        if mapper is not None and mapper.type == "flat_object":
+            # the root column is keyword-shaped: lexicographic range
+            from opensearch_tpu.index.mapper import FieldMapper as _FM
+
+            mapper = _FM(node.field, "keyword")
         if mapper is None:
             flat = self.ctx.mapper_service.flat_object_parent(node.field)
             if flat is not None:
