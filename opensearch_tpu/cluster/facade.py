@@ -449,7 +449,8 @@ class ClusterFacade:
         return resp
 
     def mget(self, index: str | None, body: dict,
-             realtime: bool = True, refresh: bool = False) -> dict:
+             realtime: bool = True, refresh: bool = False,
+             stored_fields: list | None = None) -> dict:
         docs_spec = body.get("docs")
         if docs_spec is None and "ids" in body:
             docs_spec = [{"_id": i} for i in body["ids"]]

@@ -1575,7 +1575,8 @@ class TpuNode:
     # -- mget / explain / field_caps / termvectors -------------------------
 
     def mget(self, index: str | None, body: dict,
-             realtime: bool = True, refresh: bool = False) -> dict:
+             realtime: bool = True, refresh: bool = False,
+             stored_fields: list | None = None) -> dict:
         """TransportMultiGetAction analog: batched realtime gets."""
         from opensearch_tpu.common.errors import (
             ActionRequestValidationException,
@@ -1638,16 +1639,20 @@ class TpuNode:
                     got.pop("_source", None)
                 else:
                     got["_source"] = filtered
-            if spec.get("stored_fields") and got.get("found"):
+            sf = spec.get("stored_fields", stored_fields)
+            if sf and got.get("found"):
+                if isinstance(sf, str):
+                    sf = sf.split(",")
                 src = got.get("_source") or {}
                 fields = {}
-                for f in spec["stored_fields"]:
+                for f in sf:
                     if f in src:
                         v = src[f]
                         fields[f] = v if isinstance(v, list) else [v]
                 if fields:
                     got = {**got, "fields": fields}
-                got.pop("_source", None)
+                if "_source" not in sf:
+                    got.pop("_source", None)
             docs.append(got)
         return {"docs": docs}
 

@@ -644,10 +644,12 @@ def _mget_deprecated_check(body):
 
 def mget(node: TpuNode, params, query, body):
     _mget_deprecated_check(body)
+    sf = query.get("stored_fields")
     return 200, node.mget(params["index"], body or {},
                           realtime=_realtime_param(query),
                           refresh=str(query.get("refresh", "false"))
-                          in ("true", ""))
+                          in ("true", ""),
+                          stored_fields=sf.split(",") if sf else None)
 
 
 def mget_all(node: TpuNode, params, query, body):
@@ -761,8 +763,16 @@ def _body_with_query_params(query, body):
     body = dict(body or {})
     if "q" in query:
         # URI search: full Lucene-style mini-language via the query_string
-        # parser (RestSearchAction's q= handling)
-        body.setdefault("query", {"query_string": {"query": query["q"]}})
+        # parser (RestSearchAction's q= handling, with df/default_operator)
+        qs: dict = {"query": query["q"]}
+        if "default_operator" in query:
+            qs["default_operator"] = str(query["default_operator"]).lower()
+        if "df" in query:
+            qs["default_field"] = query["df"]
+        if "analyze_wildcard" in query:
+            qs["analyze_wildcard"] = str(query["analyze_wildcard"]) in (
+                "true", "")
+        body.setdefault("query", {"query_string": qs})
     for key in ("size", "from"):
         if key in query:
             body.setdefault(key, int(query[key]))
