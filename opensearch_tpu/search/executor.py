@@ -1468,9 +1468,13 @@ class SegmentExecutor:
                     case_insensitive=node.case_insensitive,
                     boost=node.boost,
                 ))
-        rx = _wildcard_to_regex(
-            self._normalize_kw(node.field, node.value), node.case_insensitive
-        )
+        wc_value = self._normalize_kw(node.field, node.value)
+        m_wc = self.ctx.mapper_service.field_mapper(node.field)
+        if m_wc is not None and m_wc.type == "text":
+            # wildcard patterns normalize through the analyzer chain
+            # (lowercase) like the classic parser's multi-term handling
+            wc_value = wc_value.lower()
+        rx = _wildcard_to_regex(wc_value, node.case_insensitive)
         return self._multi_term_result(
             node.field, lambda t: rx.match(t) is not None, node.boost
         )
