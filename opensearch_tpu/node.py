@@ -2178,14 +2178,15 @@ class TpuNode:
                     SearchPhaseExecutionException,
                 )
 
-                e = SearchPhaseExecutionException(
+                msg = (
                     f"Can't do sort across indices, as a field has "
                     f"[unsigned_long] type in one index, and different "
                     f"type in another index, so sort values can't be "
                     f"compared for field [{fname_v}]"
                 )
+                e = SearchPhaseExecutionException(msg)
                 e.status = 400
-                raise e
+                raise e from IllegalArgumentException(msg)
         if body.get("collapse") is not None:
             if scroll:
                 raise IllegalArgumentException(

@@ -313,10 +313,10 @@ def _doc_column_values(host, doc: int, fname: str, ms: MapperService,
     return []
 
 
-# joda-time pattern letters -> strftime (the common subset; DateFormatter)
+# joda/java-time pattern letters -> strftime (the common subset)
 _JODA_MAP = [
-    ("yyyy", "%Y"), ("yy", "%y"), ("MM", "%m"), ("dd", "%d"),
-    ("HH", "%H"), ("mm", "%M"), ("ss", "%S"),
+    ("uuuu", "%Y"), ("yyyy", "%Y"), ("yy", "%y"), ("MM", "%m"),
+    ("dd", "%d"), ("HH", "%H"), ("mm", "%M"), ("ss", "%S"),
 ]
 
 
@@ -336,6 +336,20 @@ def _format_date_nanos(ns_value: int, fmt: str | None) -> Any:
     if fmt in ("strict_date_optional_time", "date_optional_time"):
         ms_part = (ns_value // 1_000_000) % 1000
         return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{ms_part:03d}Z"
+    if fmt and fmt not in ("strict_date_optional_time_nanos",):
+        # custom java-time pattern with nanosecond fraction support
+        out = fmt.replace("'", "")
+        out = out.replace("XXX", "Z").replace("XX", "Z").replace("X", "Z")
+        if "SSSSSSSSS" in out:
+            out = out.replace("SSSSSSSSS", f"{ns_value % 1_000_000_000:09d}")
+        elif "SSSSSS" in out:
+            out = out.replace("SSSSSS", f"{ns_value % 1_000_000:06d}")
+        elif "SSS" in out:
+            out = out.replace("SSS", f"{(ns_value // 1_000_000) % 1000:03d}")
+        for joda, strf in _JODA_MAP:
+            out = out.replace(joda, strf)
+        if "%" in out:
+            return dt.strftime(out)
     frac = ns_value % 1_000_000_000
     return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{frac:09d}".rstrip("0").ljust(3, "0") + "Z"
 
@@ -349,7 +363,8 @@ def _format_date_ms(ms_value: int, fmt: str | None) -> Any:
     if fmt is None or fmt.startswith("strict_date") or fmt == "date_optional_time":
         return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{ms_value % 1000:03d}Z"
     # joda-style custom pattern
-    out = fmt
+    out = fmt.replace("'", "")
+    out = out.replace("XXX", "Z").replace("XX", "Z").replace("X", "Z")
     if "SSS" in out:
         out = out.replace("SSS", f"{ms_value % 1000:03d}")
     for joda, strf in _JODA_MAP:

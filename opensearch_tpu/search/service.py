@@ -557,7 +557,10 @@ def search(
         "_shards": {
             "total": len(shards),
             "successful": len(shards),
-            "skipped": skipped_shards,
+            # the reference only PRE-filters (and reports skips) beyond
+            # pre_filter_shard_size (default 128); below it can_match runs
+            # inside the query phase and skipped stays 0
+            "skipped": skipped_shards if len(shards) >= 128 else 0,
             "failed": 0,
         },
         "hits": hits_obj,
