@@ -2178,15 +2178,16 @@ class TpuNode:
                     SearchPhaseExecutionException,
                 )
 
-                msg = (
-                    f"Can't do sort across indices, as a field has "
-                    f"[unsigned_long] type in one index, and different "
-                    f"type in another index, so sort values can't be "
-                    f"compared for field [{fname_v}]"
+                cause_msg = (
+                    "Can't do sort across indices, as a field has "
+                    "[unsigned_long] type in one index, and different "
+                    "type in another index!"
                 )
-                e = SearchPhaseExecutionException(msg)
+                e = SearchPhaseExecutionException(
+                    f"{cause_msg} (field [{fname_v}])"
+                )
                 e.status = 400
-                raise e from IllegalArgumentException(msg)
+                raise e from IllegalArgumentException(cause_msg)
         if body.get("collapse") is not None:
             if scroll:
                 raise IllegalArgumentException(

@@ -810,6 +810,9 @@ def _body_with_query_params(query, body):
         body.setdefault("seq_no_primary_term", True)
     if str(query.get("version", "false")) in ("true", ""):
         body.setdefault("version", True)
+    if "pre_filter_shard_size" in query:
+        body.setdefault("pre_filter_shard_size",
+                        int(query["pre_filter_shard_size"]))
     if "track_total_hits" in query:
         v = str(query["track_total_hits"])
         body.setdefault(

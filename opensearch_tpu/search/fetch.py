@@ -365,7 +365,11 @@ def _format_date_ms(ms_value: int, fmt: str | None) -> Any:
     # joda-style custom pattern
     out = fmt.replace("'", "")
     out = out.replace("XXX", "Z").replace("XX", "Z").replace("X", "Z")
-    if "SSS" in out:
+    if "SSSSSSSSS" in out:
+        out = out.replace("SSSSSSSSS", f"{ms_value % 1000:03d}000000")
+    elif "SSSSSS" in out:
+        out = out.replace("SSSSSS", f"{ms_value % 1000:03d}000")
+    elif "SSS" in out:
         out = out.replace("SSS", f"{ms_value % 1000:03d}")
     for joda, strf in _JODA_MAP:
         out = out.replace(joda, strf)

@@ -78,7 +78,7 @@ def search(
         "seq_no_primary_term", "stored_fields", "explain", "highlight",
         "docvalue_fields", "fields", "script_fields", "suggest", "profile",
         "rescore", "collapse", "slice", "indices_boost",
-        "include_named_queries_score",
+        "include_named_queries_score", "pre_filter_shard_size",
     }
     unknown = set(body) - known_keys
     if unknown:
@@ -560,7 +560,10 @@ def search(
             # the reference only PRE-filters (and reports skips) beyond
             # pre_filter_shard_size (default 128); below it can_match runs
             # inside the query phase and skipped stays 0
-            "skipped": skipped_shards if len(shards) >= 128 else 0,
+            "skipped": (skipped_shards
+                        if len(shards) >= int(
+                            body.get("pre_filter_shard_size", 128) or 128)
+                        else 0),
             "failed": 0,
         },
         "hits": hits_obj,
