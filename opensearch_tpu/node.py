@@ -644,6 +644,12 @@ class TpuNode:
                 )
             return targets[0][0]
         writes = [n for n, c in targets if c.get("is_write_index")]
+        if not for_write and len(writes) != 1:
+            names_l = ", ".join(sorted(n for n, _c in targets))
+            raise IllegalArgumentException(
+                f"alias [{name}] has more than one index associated with "
+                f"it [{names_l}], can't execute a single index op"
+            )
         if len(writes) != 1:
             raise IllegalArgumentException(
                 f"no write index is defined for alias [{name}]. The write "
@@ -1238,6 +1244,7 @@ class TpuNode:
                 f"[{doc_id}]: version conflict, document already exists "
                 "(current version [1])"
             )
+        self._check_nested_limit(svc, source)
         mappers_before = len(svc.mapper_service.mappers)
         result = shard.apply_index_on_primary(
             doc_id, source, routing, if_seq_no=if_seq_no,
@@ -2025,6 +2032,40 @@ class TpuNode:
             ):
                 out.setdefault(concrete, boost)  # first match wins
         return out
+
+    @staticmethod
+    def _check_nested_limit(svc, source: dict) -> None:
+        """index.mapping.nested_objects.limit: cap the number of nested
+        documents one doc may expand to (MapperService.checkNestedDocsLimit
+        analog; this engine flattens nested docs but keeps the cap)."""
+        paths = getattr(svc.mapper_service, "nested_paths", None)
+        if not paths:
+            return
+        s = svc.settings or {}
+        limit = int(s.get("mapping.nested_objects.limit",
+                          s.get("index.mapping.nested_objects.limit", 10000)))
+
+        def count(obj, prefix=""):
+            total = 0
+            if isinstance(obj, dict):
+                for k, v in obj.items():
+                    full = f"{prefix}{k}"
+                    if isinstance(v, list) and full in paths:
+                        total += sum(1 for x in v if isinstance(x, dict))
+                        for x in v:
+                            total += count(x, f"{full}.")
+                    elif isinstance(v, dict):
+                        total += count(v, f"{full}.")
+            return total
+
+        n = count(source)
+        if n > limit:
+            raise IllegalArgumentException(
+                f"The number of nested documents has exceeded the allowed "
+                f"limit of [{limit}]. This limit can be set by changing "
+                f"the [index.mapping.nested_objects.limit] index level "
+                f"setting."
+            )
 
     def _check_keep_alive(self, keep_ms: int, raw: str) -> None:
         """search.max_keep_alive cap (SearchService.validateKeepAlives)."""

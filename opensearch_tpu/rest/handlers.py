@@ -512,8 +512,10 @@ def _apply_get_params(resp, query):
                 fields[f] = v if isinstance(v, list) else [v]
         if fields:
             resp = {**resp, "fields": fields}
-        keep_source = "_source" in wanted or \
-            str(query.get("_source", "")) in ("true", "")
+        keep_source = "_source" in wanted or (
+            "_source" in query
+            and str(query["_source"]) in ("true", "")
+        )
         if not keep_source:
             resp = {k: x for k, x in resp.items() if k != "_source"}
     return resp
