@@ -1992,7 +1992,9 @@ class TpuNode:
             if isinstance(obj, dict):
                 for k, v in obj.items():
                     if k in expensive:
-                        return k
+                        field = (next(iter(v), None)
+                                 if isinstance(v, dict) else None)
+                        return (k, field)
                     found = walk(v)
                     if found:
                         return found
@@ -2189,10 +2191,19 @@ class TpuNode:
                 "search.allow_expensive_queries", True)).lower() == "false":
             expensive = self._find_expensive_query(body.get("query"))
             if expensive:
-                raise IllegalArgumentException(
-                    f"[{expensive}] queries cannot be executed when "
-                    f"'search.allow_expensive_queries' is set to false."
-                )
+                kind, qfield = expensive
+                msg = (f"[{kind}] queries cannot be executed when "
+                       f"'search.allow_expensive_queries' is set to false.")
+                if kind == "prefix" and qfield:
+                    for n in names:
+                        svc_q = self.indices.get(n)
+                        m_q = (svc_q.mapper_service.field_mapper(qfield)
+                               if svc_q else None)
+                        if m_q is not None and m_q.type == "text":
+                            msg += (" For optimised prefix queries on text "
+                                    "fields please enable [index_prefixes].")
+                            break
+                raise IllegalArgumentException(msg)
         # mixed-type sort across indices: unsigned_long cannot sort
         # against other numeric types (FieldSortBuilder's validation)
         sort_b = body.get("sort")
