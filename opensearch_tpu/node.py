@@ -1986,11 +1986,14 @@ class TpuNode:
         ALLOW_EXPENSIVE_QUERIES gates in the reference)."""
         expensive = {"script", "script_score", "fuzzy", "regexp", "prefix",
                      "wildcard", "percolate", "intervals", "multi_match",
-                     "query_string", "join", "distance_feature"}
+                     "query_string", "join", "distance_feature", "nested",
+                     "has_child", "has_parent", "parent_id"}
 
-        def walk(obj):
+        def walk(obj, ms=None):
             if isinstance(obj, dict):
                 for k, v in obj.items():
+                    if k == "range" and isinstance(v, dict):
+                        return ("range", next(iter(v), None))
                     if k in expensive:
                         field = (next(iter(v), None)
                                  if isinstance(v, dict) else None)
@@ -2190,19 +2193,43 @@ class TpuNode:
         if str(self.effective_cluster_setting(
                 "search.allow_expensive_queries", True)).lower() == "false":
             expensive = self._find_expensive_query(body.get("query"))
+            if expensive and expensive[0] == "range":
+                # ranges are expensive only over text/keyword columns
+                ftypes = set()
+                for n in names:
+                    svc_q = self.indices.get(n)
+                    m_q = (svc_q.mapper_service.field_mapper(expensive[1])
+                           if svc_q and expensive[1] else None)
+                    if m_q is not None:
+                        ftypes.add(m_q.type)
+                if not ftypes & {"text", "keyword", "flat_object"}:
+                    expensive = None
             if expensive:
                 kind, qfield = expensive
                 msg = (f"[{kind}] queries cannot be executed when "
                        f"'search.allow_expensive_queries' is set to false.")
-                if kind == "prefix" and qfield:
+                def _field_type(fld):
                     for n in names:
                         svc_q = self.indices.get(n)
-                        m_q = (svc_q.mapper_service.field_mapper(qfield)
-                               if svc_q else None)
-                        if m_q is not None and m_q.type == "text":
-                            msg += (" For optimised prefix queries on text "
-                                    "fields please enable [index_prefixes].")
-                            break
+                        m_q = (svc_q.mapper_service.field_mapper(fld)
+                               if svc_q and fld else None)
+                        if m_q is not None:
+                            return m_q.type
+                    return None
+
+                if kind == "prefix" and _field_type(qfield) == "text":
+                    msg += (" For optimised prefix queries on text "
+                            "fields please enable [index_prefixes].")
+                elif kind == "range":
+                    msg = ("[range] queries on [text] or [keyword] fields "
+                           "cannot be executed when "
+                           "'search.allow_expensive_queries' is set to "
+                           "false.")
+                elif kind in ("nested", "has_child", "has_parent",
+                              "parent_id"):
+                    msg = ("[joining] queries cannot be executed when "
+                           "'search.allow_expensive_queries' is set to "
+                           "false.")
                 raise IllegalArgumentException(msg)
         # mixed-type sort across indices: unsigned_long cannot sort
         # against other numeric types (FieldSortBuilder's validation)
