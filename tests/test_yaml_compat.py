@@ -26,7 +26,7 @@ SUITES = [
     "indices.put_mapping",
 ]
 
-FLOOR = 0.84
+FLOOR = 0.85
 
 
 @pytest.mark.skipif(not REFERENCE_SPEC.exists(),
