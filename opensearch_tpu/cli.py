@@ -15,8 +15,26 @@ Config keys (the reference's names where they exist):
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from pathlib import Path
+
+
+def _apply_platform_override() -> None:
+    """Honor JAX_PLATFORMS at launch even when sitecustomize already
+    imported jax (which freezes the env-var reading): the accelerator
+    plugin's device claim can block indefinitely when its tunnel is
+    wedged, so `JAX_PLATFORMS=cpu opensearch-tpu ...` must reliably pin
+    the live config too (same recipe as tests/conftest.py)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    except Exception:
+        pass  # jax absent or config locked: env var alone has to do
 
 
 def load_config(path: str | None) -> dict:
@@ -52,6 +70,7 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--bootstrap", default=None,
                         help="comma-separated initial voting node ids")
     args = parser.parse_args(argv)
+    _apply_platform_override()
 
     conf = load_config(args.config)
     node_name = args.node_name or conf.get("node.name", "node-0")

@@ -118,9 +118,7 @@ class IndexService:
         )
         self.mapper_service = MapperService(mappings, analysis)
         self.mapper_service.ignore_malformed_default = str(
-            settings.get("mapping.ignore_malformed",
-                         settings.get("index.mapping.ignore_malformed",
-                                      False))
+            self.setting("mapping.ignore_malformed", False)
         ).lower() == "true"
         self.num_shards = int(settings.get("number_of_shards", 1))
         self.num_replicas = int(settings.get("number_of_replicas", 1))
@@ -136,6 +134,26 @@ class IndexService:
                 ShardId(name, s), path / str(s), self.mapper_service,
                 durability=durability,
             )
+
+    def setting(self, key: str, default=None):
+        """Look up an index setting by dotted key regardless of storage
+        shape. `self.settings` holds the NESTED form (create_index re-nests),
+        so a plain .get("mapping.nested_objects.limit") always misses;
+        flatten first and accept both bare and "index."-prefixed keys
+        (IndexSettings.getValue analog). The flat view is cached — this
+        sits on the per-document and per-search hot paths — and
+        invalidated by put_index_settings via settings_changed()."""
+        flat = getattr(self, "_flat_settings", None)
+        if flat is None:
+            flat = self._flat_settings = \
+                Settings.from_nested(self.settings or {}).as_dict()
+        if key in flat:
+            return flat[key]
+        return flat.get(f"index.{key}", default)
+
+    def settings_changed(self) -> None:
+        """Drop the cached flat-settings view after a settings update."""
+        self._flat_settings = None
 
     def shard_for(self, doc_id: str, routing: str | None) -> IndexShard:
         sid = shard_id_for_routing(routing or doc_id, self.num_shards)
@@ -1954,7 +1972,7 @@ class TpuNode:
             for n in names:
                 svc = self.indices.get(n)
                 if svc is not None and str(
-                    (svc.settings or {}).get("requests.cache.enable", True)
+                    svc.setting("requests.cache.enable", True)
                 ).lower() == "false":
                     cache_on = False
                     break
@@ -1985,9 +2003,27 @@ class TpuNode:
         """First expensive clause in the raw query JSON (the set
         ALLOW_EXPENSIVE_QUERIES gates in the reference)."""
         expensive = {"script", "script_score", "fuzzy", "regexp", "prefix",
-                     "wildcard", "percolate", "intervals", "multi_match",
-                     "query_string", "join", "distance_feature", "nested",
-                     "has_child", "has_parent", "parent_id"}
+                     "wildcard", "percolate", "join", "distance_feature",
+                     "nested", "has_child", "has_parent", "parent_id"}
+        # multi_match/query_string/intervals are NOT categorically expensive
+        # in the reference — only the expensive clause kinds they may expand
+        # to (fuzzy/prefix/wildcard/regexp) are gated
+        multi_term_markers = {"fuzzy", "prefix", "wildcard", "regexp"}
+
+        def contains_marker(obj) -> str | None:
+            if isinstance(obj, dict):
+                for k, v in obj.items():
+                    if k in multi_term_markers:
+                        return k
+                    found = contains_marker(v)
+                    if found:
+                        return found
+            elif isinstance(obj, list):
+                for v in obj:
+                    found = contains_marker(v)
+                    if found:
+                        return found
+            return None
 
         def walk(obj, ms=None):
             if isinstance(obj, dict):
@@ -1998,6 +2034,35 @@ class TpuNode:
                         field = (next(iter(v), None)
                                  if isinstance(v, dict) else None)
                         return (k, field)
+                    if k == "intervals" and isinstance(v, dict):
+                        marker = contains_marker(v)
+                        if marker:
+                            return (marker, next(iter(v), None))
+                        continue
+                    if k == "multi_match" and isinstance(v, dict):
+                        if v.get("fuzziness") is not None:
+                            return ("fuzzy", None)
+                        # phrase_prefix AND bool_prefix expand to prefix
+                        # queries on the last term
+                        if str(v.get("type", "")) in ("phrase_prefix",
+                                                      "bool_prefix"):
+                            return ("prefix", None)
+                        continue
+                    if k == "query_string" and isinstance(v, dict):
+                        qs = str(v.get("query", ""))
+                        # escaped chars are literal; quoted phrases (incl.
+                        # "…"~N proximity) compile to PhraseQuery, not a
+                        # gated multi-term query — strip both before
+                        # looking for wildcard/fuzzy/regex syntax. The
+                        # fuzziness PARAM alone gates nothing: it is only
+                        # a default for terms that use the ~ operator.
+                        stripped = re.sub(r"\\.", "", qs)
+                        stripped = re.sub(r'"[^"]*"(~\d+)?', "", stripped)
+                        if any(c in stripped for c in "*?~") or re.search(
+                            r"/[^/]*/", stripped
+                        ):
+                            return ("query_string", None)
+                        continue
                     found = walk(v)
                     if found:
                         return found
@@ -2046,9 +2111,7 @@ class TpuNode:
         paths = getattr(svc.mapper_service, "nested_paths", None)
         if not paths:
             return
-        s = svc.settings or {}
-        limit = int(s.get("mapping.nested_objects.limit",
-                          s.get("index.mapping.nested_objects.limit", 10000)))
+        limit = int(svc.setting("mapping.nested_objects.limit", 10000))
 
         def count(obj, prefix=""):
             total = 0
@@ -2094,12 +2157,8 @@ class TpuNode:
         svc = self.indices.get(name)
         if svc is None:
             return default
-        s = svc.settings or {}
-        v = s.get(key, s.get(f"index.{key}", default))
-        if isinstance(s.get("index"), dict) and key in s["index"]:
-            v = s["index"][key]
         try:
-            return int(v)
+            return int(svc.setting(key, default))
         except (TypeError, ValueError):
             return default
 
@@ -2667,6 +2726,7 @@ class TpuNode:
             svc = self._get_index(name)
             nested = Settings.from_flat(norm).as_nested()
             svc.settings = _deep_merge(svc.settings, nested)
+            svc.settings_changed()
             if "number_of_replicas" in norm:
                 svc.num_replicas = int(norm["number_of_replicas"])
         self._persist_index_registry()

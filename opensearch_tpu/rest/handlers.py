@@ -425,7 +425,11 @@ def _version_params(query) -> dict:
         out["version"] = int(query["version"])
     if "version_type" in query:
         vt = str(query["version_type"])
-        if vt not in ("internal", "external", "external_gte", "force"):
+        # the reference's VersionType.fromString knows internal/external/
+        # external_gt/external_gte only — "force" was removed and must 400
+        if vt == "external_gt":
+            vt = "external"
+        if vt not in ("internal", "external", "external_gte"):
             raise IllegalArgumentException(f"No version type match [{vt}]")
         out["version_type"] = vt
     elif "version" in query:

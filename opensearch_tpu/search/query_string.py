@@ -26,7 +26,7 @@ _TOKEN_RE = re.compile(
     r"""
     \s*(
         \(|\)                          # parens
-        | "(?:[^"\\]|\\.)*"            # quoted phrase
+        | "(?:[^"\\]|\\.)*"(?:~\d+)?   # quoted phrase (+ optional ~N slop)
         | /(?:[^/\\]|\\.)*/            # /regex/ literal
         | (?:[^\s()":]+:)              # field prefix
         | [^\s()"]+                    # bare term
@@ -48,15 +48,36 @@ def _tokenize(s: str) -> list[str]:
 
 
 def _term_node(field: str, text: str) -> q.QueryNode:
-    if text.startswith('"') and text.endswith('"') and len(text) >= 2:
-        return q.MatchPhraseQuery(field=field, query=text[1:-1].replace('\\"', '"'))
+    if text.startswith('"'):
+        # "..." or "..."~N (sloppy phrase, classic parser's proximity)
+        m = re.fullmatch(r'("(?:[^"\\]|\\.)*")(?:~(\d+))?', text)
+        if m is not None:
+            return q.MatchPhraseQuery(
+                field=field,
+                query=m.group(1)[1:-1].replace('\\"', '"'),
+                slop=int(m.group(2)) if m.group(2) else 0,
+            )
     if text.startswith("/") and text.endswith("/") and len(text) >= 2:
         # /regex/ syntax (classic parser's RegexpQuery clause)
         return q.RegexpQuery(field=field, value=text[1:-1])
     if "*" in text or "?" in text:
         return q.WildcardQuery(field=field, value=text)
-    if text.endswith("~"):
-        return q.FuzzyQuery(field=field, value=text[:-1])
+    m = re.fullmatch(r"(.+)~(\d+(?:\.\d+)?)?", text)
+    if m is not None:
+        # term~ (AUTO) or term~N; N goes through Lucene's
+        # FuzzyQuery.floatToEdits: >=1 caps at 2 edits, a fraction is a
+        # legacy minimum-similarity converted to edits by term length
+        fuzz = "AUTO"
+        if m.group(2):
+            f = float(m.group(2))
+            if f >= 1.0:
+                edits = int(min(f, 2))
+            elif f == 0.0:
+                edits = 0
+            else:
+                edits = min(int((1.0 - f) * len(m.group(1))), 2)
+            fuzz = str(edits)
+        return q.FuzzyQuery(field=field, value=m.group(1), fuzziness=fuzz)
     return q.MatchQuery(field=field, query=text)
 
 

@@ -41,6 +41,18 @@ from opensearch_tpu.search.executor import (
 DEFAULT_SIZE = 10
 
 
+def pack_shard_doc(shard_idx: int, segment: int, doc: int) -> int:
+    """_shard_doc PIT tiebreak value: (shard, segment, doc) packed into one
+    orderable int that round-trips through search_after cursors.
+
+    Bit layout 13/13/27 (shard/segment/doc): doc must clear 2^21 (~2.1M
+    docs/segment corpora are in BASELINE scope) and the TOTAL must stay
+    under 2^53 so float64 JSON clients echo the cursor exactly — 32 shards
+    at a 48-bit shard shift would already cross 2^53.
+    """
+    return (shard_idx << 40) | (segment << 27) | doc
+
+
 def _sort_has_score(sort) -> bool:
     return any(
         (spec if isinstance(spec, str) else next(iter(spec), None)) == "_score"
@@ -279,7 +291,7 @@ def search(
             if fname != "_shard_doc":
                 continue
             for shard_idx, h in merged:
-                packed = (shard_idx << 42) | (h.segment << 21) | h.doc
+                packed = pack_shard_doc(shard_idx, h.segment, h.doc)
                 while len(h.sort_values) <= i:
                     h.sort_values.append(None)
                 h.sort_values[i] = packed

@@ -1,0 +1,148 @@
+"""Regression tests for the round-3 advisor findings (ADVICE.md).
+
+1. Index settings stored nested must still satisfy dotted-key lookups
+   (mapping.nested_objects.limit / mapping.ignore_malformed /
+   requests.cache.enable) — IndexSettings.getValue analog.
+2. _shard_doc packing must not overflow the doc field at 2^21 docs.
+3. multi_match/query_string are not categorically expensive queries
+   (reference gates only the expensive clause kinds they expand to).
+4. version_type=force is not a valid version type (reference
+   VersionType.fromString knows internal/external/external_gt/external_gte).
+"""
+
+import pytest
+
+from opensearch_tpu.common.errors import IllegalArgumentException
+from opensearch_tpu.node import TpuNode
+
+
+@pytest.fixture()
+def node(tmp_path):
+    return TpuNode(tmp_path / "node")
+
+
+class TestNestedSettingsLookup:
+    def test_nested_objects_limit_enforced(self, node):
+        node.create_index("i", {
+            "settings": {"index": {"mapping": {"nested_objects": {"limit": 2}}}},
+            "mappings": {"properties": {
+                "kids": {"type": "nested",
+                         "properties": {"n": {"type": "long"}}}}},
+        })
+        with pytest.raises(IllegalArgumentException, match="nested documents"):
+            node.index_doc("i", "1", {
+                "kids": [{"n": 1}, {"n": 2}, {"n": 3}]})
+        # at the limit is fine
+        node.index_doc("i", "2", {"kids": [{"n": 1}, {"n": 2}]})
+
+    def test_ignore_malformed_from_nested_settings(self, node):
+        node.create_index("i", {
+            "settings": {"index": {"mapping": {"ignore_malformed": True}}},
+            "mappings": {"properties": {"n": {"type": "long"}}},
+        })
+        # malformed long is dropped, not rejected
+        node.index_doc("i", "1", {"n": "not-a-number"}, refresh=True)
+        res = node.search("i", {"query": {"match_all": {}}})
+        assert res["hits"]["total"]["value"] == 1
+
+    def test_request_cache_disable_from_nested_settings(self, node):
+        node.create_index("i", {
+            "settings": {"index": {"requests": {"cache": {"enable": False}}}},
+        })
+        svc = node.indices["i"]
+        assert str(svc.setting("requests.cache.enable", True)).lower() == "false"
+
+
+class TestShardDocPacking:
+    def test_packing_monotonic_past_2m_docs(self):
+        # doc ids beyond 2^21 must not overflow into the segment bits
+        from opensearch_tpu.search.service import pack_shard_doc as pack
+
+        lo = pack(0, 1, (1 << 21) + 5)
+        hi = pack(0, 2, 0)
+        assert lo < hi  # order preserved: segment dominates doc
+        assert pack(1, 0, 0) > pack(0, 5, (1 << 27) - 1)
+
+    def test_packing_float64_safe(self):
+        # JSON clients parse numbers as float64; the cursor must survive
+        from opensearch_tpu.search.service import pack_shard_doc as pack
+
+        v = pack(8191, 8191, (1 << 27) - 1)  # max of every field
+        assert v < (1 << 53)
+        assert int(float(v)) == v
+
+
+class TestExpensiveQueryGate:
+    def _forbid(self, node):
+        node.put_cluster_settings({
+            "transient": {"search": {"allow_expensive_queries": False}}})
+
+    def test_plain_multi_match_allowed(self, node):
+        node.create_index("i", {"mappings": {"properties": {
+            "a": {"type": "text"}, "b": {"type": "text"}}}})
+        node.index_doc("i", "1", {"a": "hello world"}, refresh=True)
+        self._forbid(node)
+        res = node.search("i", {"query": {
+            "multi_match": {"query": "hello", "fields": ["a", "b"]}}})
+        assert res["hits"]["total"]["value"] == 1
+
+    def test_plain_query_string_allowed(self, node):
+        node.create_index("i", {"mappings": {"properties": {
+            "a": {"type": "text"}}}})
+        node.index_doc("i", "1", {"a": "hello world"}, refresh=True)
+        self._forbid(node)
+        res = node.search("i", {"query": {
+            "query_string": {"query": "hello", "default_field": "a"}}})
+        assert res["hits"]["total"]["value"] == 1
+
+    def test_fuzzy_multi_match_rejected(self, node):
+        node.create_index("i", {"mappings": {"properties": {
+            "a": {"type": "text"}}}})
+        node.index_doc("i", "1", {"a": "hello"}, refresh=True)
+        self._forbid(node)
+        with pytest.raises(IllegalArgumentException, match="expensive"):
+            node.search("i", {"query": {"multi_match": {
+                "query": "helo", "fields": ["a"], "fuzziness": "AUTO"}}})
+
+    def test_proximity_query_string_allowed(self, node):
+        # "..."~N is a sloppy PhraseQuery — not a gated multi-term query
+        node.create_index("i", {"mappings": {"properties": {
+            "a": {"type": "text"}}}})
+        node.index_doc("i", "1", {"a": "hello big world"}, refresh=True)
+        self._forbid(node)
+        res = node.search("i", {"query": {"query_string": {
+            "query": '"hello world"~2', "default_field": "a"}}})
+        assert res["hits"]["total"]["value"] == 1
+
+    def test_bool_prefix_multi_match_rejected(self, node):
+        node.create_index("i", {"mappings": {"properties": {
+            "a": {"type": "text"}}}})
+        node.index_doc("i", "1", {"a": "hello"}, refresh=True)
+        self._forbid(node)
+        with pytest.raises(IllegalArgumentException, match="expensive"):
+            node.search("i", {"query": {"multi_match": {
+                "query": "he", "fields": ["a"], "type": "bool_prefix"}}})
+
+    def test_wildcard_query_string_rejected(self, node):
+        node.create_index("i", {"mappings": {"properties": {
+            "a": {"type": "text"}}}})
+        node.index_doc("i", "1", {"a": "hello"}, refresh=True)
+        self._forbid(node)
+        with pytest.raises(IllegalArgumentException, match="expensive"):
+            node.search("i", {"query": {"query_string": {
+                "query": "hel*", "default_field": "a"}}})
+
+
+class TestVersionTypeForce:
+    def test_force_rejected_at_rest_param_layer(self):
+        from opensearch_tpu.rest.handlers import _version_params
+
+        with pytest.raises(IllegalArgumentException,
+                           match=r"No version type match \[force\]"):
+            _version_params({"version": "5", "version_type": "force"})
+
+    def test_external_gt_aliases_external(self):
+        from opensearch_tpu.rest.handlers import _version_params
+
+        out = _version_params({"version": "5", "version_type": "external_gt"})
+        assert out["version_type"] == "external"
