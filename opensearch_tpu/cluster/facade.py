@@ -773,7 +773,9 @@ class ClusterFacade:
                         out[key] = s
         return out
 
-    def index_stats(self, index: str = "_all") -> dict:
+    def index_stats(self, index: str = "_all", **_kw) -> dict:
+        # the cluster facade reports the docs core; metric filtering and
+        # per-section detail are the single-node TpuNode.index_stats's
         names = self.resolve_indices(index)
         shard_stats = self._all_shard_stats()
         per_index: dict[str, int] = {}

@@ -192,10 +192,24 @@ class Translog:
         return self.checkpoint.generation
 
     def stats(self) -> dict:
+        size = 0
+        for g in range(self.checkpoint.min_generation,
+                       self.checkpoint.generation + 1):
+            p = self._gen_path(g)
+            if p.exists():
+                size += p.stat().st_size
+        # the checkpoint file counts toward translog size like the
+        # reference's Translog.sizeInBytes (header + ckp accounting)
+        ckp = self.dir / CHECKPOINT_FILE
+        if ckp.exists():
+            size += ckp.stat().st_size
         return {
             "operations": self.checkpoint.num_ops,
             "generation": self.checkpoint.generation,
+            "size_in_bytes": size,
             "uncommitted_operations": self.checkpoint.num_ops,
+            "uncommitted_size_in_bytes": size,
+            "earliest_last_modified_age": 0,
         }
 
     def close(self) -> None:

@@ -1355,6 +1355,15 @@ class TpuNode:
             "_primary_term": 1,
         }
 
+    def _note_noop(self, index: str, doc_id: str, routing) -> None:
+        """indexing.noop_update_total (reference: InternalIndexingStats
+        noticed via TransportUpdateAction noop results)."""
+        svc = self.indices.get(index)
+        if svc is not None:
+            eng = svc.shard_for(doc_id, routing).engine
+            eng.stats["noop_update_total"] = \
+                eng.stats.get("noop_update_total", 0) + 1
+
     def update_doc(self, index: str, doc_id: str, body: dict,
                    routing: str | None = None, refresh: bool = False,
                    if_seq_no: int | None = None,
@@ -1446,6 +1455,7 @@ class TpuNode:
             default_script_service.execute_update(ast, params, ctx)
             op = ctx.get("op", "index")
             if op in ("none", "noop"):
+                self._note_noop(index, doc_id, routing)
                 return {"_index": index, "_id": doc_id, "result": "noop",
                         "_version": current["_version"],
                         "_seq_no": current["_seq_no"], "_primary_term": 1,
@@ -1466,6 +1476,7 @@ class TpuNode:
                 raise DocumentMissingException(f"[{doc_id}]: document missing")
             merged = _deep_merge(current["_source"], body["doc"])
             if merged == current["_source"] and not body.get("detect_noop") is False:
+                self._note_noop(index, doc_id, routing)
                 return {"_index": index, "_id": doc_id, "result": "noop",
                         "_version": current["_version"],
                         "_seq_no": current["_seq_no"], "_primary_term": 1,
@@ -1859,6 +1870,39 @@ class TpuNode:
                query_group: str | None = None,
                request_cache: bool | None = None) -> dict:
         body = dict(body or {})
+        # per-request stat groups ("stats": [..]) feed indices.stats
+        # search.groups counters (reference: SearchRequest.stats ->
+        # ShardSearchStats.groupStats)
+        stat_groups = body.get("stats")
+        if stat_groups is not None and not isinstance(stat_groups, list):
+            raise ParsingException("[stats] must be an array of group names")
+        try:
+            for cname in self.resolve_indices(
+                    index if index is not None else "_all",
+                    ignore_unavailable=True):
+                svc_g = self.indices.get(cname)
+                if svc_g is None:
+                    continue
+                totals = getattr(svc_g, "_search_stats", None)
+                if totals is None:
+                    totals = svc_g._search_stats = {
+                        "query_total": 0, "fetch_total": 0}
+                totals["query_total"] += 1
+                totals["fetch_total"] += 1
+                if not stat_groups:
+                    continue
+                reg = getattr(svc_g, "_search_group_stats", None)
+                if reg is None:
+                    reg = svc_g._search_group_stats = {}
+                for g in stat_groups:
+                    e = reg.setdefault(str(g), {
+                        "query_total": 0, "query_time_in_millis": 0,
+                        "query_current": 0, "fetch_total": 0,
+                        "fetch_time_in_millis": 0, "fetch_current": 0})
+                    e["query_total"] += 1
+                    e["fetch_total"] += 1
+        except Exception:
+            pass  # stats accounting must never fail a search
         # body key is always consumed; an explicit param takes precedence
         body_pipeline = body.pop("search_pipeline", None)
         pipeline_id = search_pipeline or body_pipeline
@@ -2846,24 +2890,265 @@ class TpuNode:
             out["indices"] = per_index
         return out
 
-    def index_stats(self, index: str = "_all") -> dict:
-        out: dict[str, Any] = {"indices": {}}
-        total_docs = 0
+    _STATS_SECTIONS = (
+        "docs", "store", "indexing", "get", "search", "merges", "refresh",
+        "flush", "warmer", "query_cache", "fielddata", "completion",
+        "segments", "translog", "request_cache", "recovery",
+    )
+    # REST metric name -> response section (IndicesStatsRequest flags)
+    _METRIC_ALIASES = {"merge": "merges"}
+
+    @staticmethod
+    def _field_bytes(shard, field: str) -> int:
+        """Estimated columnar (fielddata-class) bytes for one field across
+        a shard's sealed segments."""
+        total = 0
+        for host, _dev in shard.engine._segments:
+            kf = host.keyword_fields.get(field)
+            if kf is not None:
+                total += int(kf.mv_ords.nbytes + kf.first_ord.nbytes)
+            nf = host.numeric_fields.get(field)
+            if nf is not None:
+                total += 8 * host.n_docs
+            tf = host.text_fields.get(field)
+            if tf is not None:
+                total += int(tf.doc_len.nbytes)
+        return total
+
+    def _completion_fields_of(self, svc) -> list[str]:
+        # completion fields store keyword-style with mapper.completion=True
+        return [n for n, m in svc.mapper_service.mappers.items()
+                if m.type == "completion" or getattr(m, "completion", False)]
+
+    def _full_shard_stats(self, svc, shard, *, f_pats, c_pats,
+                          groups, file_sizes, human) -> dict:
+        import fnmatch as _fn
+
+        eng = shard.engine
+        seg = eng.segment_stats()
+        tlog = eng.translog.stats()
+        store_bytes = tlog["size_in_bytes"]
+        for host, _dev in eng._segments:
+            store_bytes += sum(len(s) for s in host.sources)
+        st: dict[str, Any] = {
+            "docs": {"count": eng.num_docs,
+                     "deleted": max(seg["docs"] - seg["live_docs"], 0)},
+            "store": {"size_in_bytes": store_bytes, "reserved_in_bytes": 0},
+            "indexing": {
+                "index_total": eng.stats["index_total"],
+                "index_time_in_millis": int(eng.stats["index_time_ms"]),
+                "index_current": 0, "index_failed": 0,
+                "delete_total": eng.stats["delete_total"],
+                "delete_time_in_millis": 0, "delete_current": 0,
+                "noop_update_total": eng.stats.get("noop_update_total", 0),
+                "is_throttled": False, "throttle_time_in_millis": 0,
+            },
+            "get": {"total": 0, "time_in_millis": 0, "exists_total": 0,
+                    "exists_time_in_millis": 0, "missing_total": 0,
+                    "missing_time_in_millis": 0, "current": 0},
+            "search": {"open_contexts": 0, "query_total": 0,
+                       "query_time_in_millis": 0, "query_current": 0,
+                       "fetch_total": 0, "fetch_time_in_millis": 0,
+                       "fetch_current": 0, "scroll_total": 0,
+                       "scroll_time_in_millis": 0, "scroll_current": 0},
+            "merges": {"current": 0, "current_docs": 0,
+                       "current_size_in_bytes": 0, "total": 0,
+                       "total_time_in_millis": 0, "total_docs": 0,
+                       "total_size_in_bytes": 0},
+            "refresh": {"total": eng.stats["refresh_total"],
+                        "total_time_in_millis": 0,
+                        "external_total": eng.stats["refresh_total"],
+                        "external_total_time_in_millis": 0, "listeners": 0},
+            "flush": {"total": eng.stats["flush_total"], "periodic": 0,
+                      "total_time_in_millis": 0},
+            "warmer": {"current": 0, "total": 0, "total_time_in_millis": 0},
+            "query_cache": {"memory_size_in_bytes": 0, "total_count": 0,
+                            "hit_count": 0, "miss_count": 0,
+                            "cache_size": 0, "cache_count": 0,
+                            "evictions": 0},
+            "fielddata": {
+                # resident column bytes across this shard's fields — the
+                # engine's analog of loaded fielddata (always resident here)
+                "memory_size_in_bytes": sum(
+                    self._field_bytes(shard, fname)
+                    for fname, m in svc.mapper_service.mappers.items()
+                    if not getattr(m, "completion", False)),
+                "evictions": 0,
+            },
+            "completion": {"size_in_bytes": 0},
+            "segments": {
+                "count": seg["count"],
+                "memory_in_bytes": 0, "terms_memory_in_bytes": 0,
+                "stored_fields_memory_in_bytes": 0,
+                "term_vectors_memory_in_bytes": 0,
+                "norms_memory_in_bytes": 0, "points_memory_in_bytes": 0,
+                "doc_values_memory_in_bytes": 0,
+                "index_writer_memory_in_bytes": 0,
+                "version_map_memory_in_bytes": 0,
+                "fixed_bit_set_memory_in_bytes": 0,
+                "max_unsafe_auto_id_timestamp": -1,
+                "file_sizes": {},
+            },
+            "translog": tlog,
+            "request_cache": {"memory_size_in_bytes": 0, "evictions": 0,
+                              "hit_count": 0, "miss_count": 0},
+            "recovery": {"current_as_source": 0, "current_as_target": 0,
+                         "throttle_time_in_millis": 0},
+        }
+        if human:
+            st["get"]["time"] = "0s"
+            st["get"]["getTime"] = "0s"
+        if file_sizes:
+            st["segments"]["file_sizes"] = {
+                "src": {"size_in_bytes": store_bytes,
+                        "description": "source documents"},
+            }
+        # per-field fielddata/completion breakdowns (?fields= patterns)
+        if f_pats:
+            fields = {}
+            for fname in sorted(svc.mapper_service.mappers):
+                m = svc.mapper_service.mappers[fname]
+                if getattr(m, "completion", False):
+                    continue
+                if any(_fn.fnmatch(fname, p) for p in f_pats):
+                    b = self._field_bytes(shard, fname)
+                    fields[fname] = {"memory_size_in_bytes": max(b, 1)}
+            if fields:
+                st["fielddata"]["fields"] = fields
+                st["fielddata"]["memory_size_in_bytes"] = sum(
+                    f["memory_size_in_bytes"] for f in fields.values())
+        comp_total = 0
+        comp_fields = {}
+        for fname in self._completion_fields_of(svc):
+            size = 0
+            for host, _dev in shard.engine._segments:
+                w = host.completion_weights.get(fname)
+                if w:
+                    size += sum(len(k) + 8 for k in w)
+            if size == 0:
+                # no explicit inputs: the FST size scales with the
+                # completion column's stored values
+                size = self._field_bytes(shard, fname)
+            comp_total += size
+            if c_pats and any(_fn.fnmatch(fname, p) for p in c_pats):
+                comp_fields[fname] = {"size_in_bytes": max(size, 1)}
+        st["completion"]["size_in_bytes"] = comp_total
+        if comp_fields:
+            st["completion"]["fields"] = comp_fields
+        return st
+
+    @staticmethod
+    def _merge_stats(a: dict, b: dict) -> dict:
+        out = dict(a)
+        for k, v in b.items():
+            cur = out.get(k)
+            if isinstance(v, dict):
+                out[k] = TpuNode._merge_stats(cur or {}, v)
+            elif isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and isinstance(cur, (int, float)):
+                out[k] = cur + v
+            elif cur is None:
+                out[k] = v
+        return out
+
+    def index_stats(self, index: str = "_all", *, metrics=None, fields=None,
+                    completion_fields=None, fielddata_fields=None,
+                    groups=None, level: str = "indices",
+                    include_segment_file_sizes: bool = False,
+                    human: bool = False) -> dict:
+        """GET [/{index}]/_stats[/{metric}] (IndicesStatsAction /
+        CommonStats; reference rest-api-spec indices.stats)."""
+        sections = set(self._STATS_SECTIONS)
+        if metrics:
+            want = set()
+            for m in metrics:
+                m = self._METRIC_ALIASES.get(m, m)
+                if m == "_all":
+                    want = set(self._STATS_SECTIONS)
+                    break
+                if m not in self._STATS_SECTIONS:
+                    import difflib
+
+                    msg = (f"request [/_stats/{','.join(metrics)}] contains "
+                           f"unrecognized metric: [{m}]")
+                    close = difflib.get_close_matches(
+                        m, self._STATS_SECTIONS, n=3)
+                    if close:
+                        msg += " -> did you mean " + (
+                            f"[{close[0]}]?" if len(close) == 1
+                            else f"any of {sorted(close)}?")
+                    raise IllegalArgumentException(msg)
+                want.add(m)
+            sections = want
+        f_pats = [p for p in (fields or "").split(",") if p] or \
+            [p for p in (fielddata_fields or "").split(",") if p]
+        c_pats = [p for p in (fields or "").split(",") if p] or \
+            [p for p in (completion_fields or "").split(",") if p]
+        group_list = [g for g in (groups or "").split(",") if g]
+
+        out: dict[str, Any] = {
+            "_shards": {"total": 0, "successful": 0, "failed": 0},
+            "_all": {"primaries": {}, "total": {}},
+            "indices": {},
+        }
+        all_prim: dict = {}
         for name in self.resolve_indices(index):
             svc = self._get_index(name)
-            shard_stats = [s.stats() for s in svc.shards.values()]
-            docs = sum(s["docs"]["count"] for s in shard_stats)
-            total_docs += docs
-            out["indices"][name] = {
-                "primaries": {
-                    "docs": {"count": docs},
-                    "indexing": {
-                        "index_total": sum(s["indexing"]["index_total"] for s in shard_stats)
-                    },
-                },
-                "total": {"docs": {"count": docs}},
+            prim: dict = {}
+            shards_out: dict = {}
+            for sid, shard in sorted(svc.shards.items()):
+                sstats = self._full_shard_stats(
+                    svc, shard, f_pats=f_pats, c_pats=c_pats,
+                    groups=group_list,
+                    file_sizes=include_segment_file_sizes, human=human)
+                sstats = {k: v for k, v in sstats.items() if k in sections}
+                prim = self._merge_stats(prim, sstats)
+                # total counts every targeted copy (primaries + replicas);
+                # successful counts the copies that reported (primaries on
+                # this single node)
+                out["_shards"]["total"] += 1 + svc.num_replicas
+                out["_shards"]["successful"] += 1
+                if level == "shards":
+                    entry = dict(sstats)
+                    entry["routing"] = {
+                        "state": "STARTED", "primary": True,
+                        "node": self.node_name,
+                    }
+                    entry["commit"] = {
+                        "id": shard.engine.engine_uuid,
+                        "generation": shard.engine.translog.checkpoint.generation,
+                        "num_docs": shard.engine.num_docs,
+                        "user_data": {},
+                    }
+                    shards_out[str(sid)] = [entry]
+            # search totals and stat-group counters are INDEX-level (the
+            # per-shard merge would multiply them by shard count)
+            if "search" in sections and "search" in prim:
+                import fnmatch as _fn
+
+                totals = getattr(svc, "_search_stats", {})
+                prim["search"]["query_total"] = totals.get("query_total", 0)
+                prim["search"]["fetch_total"] = totals.get("fetch_total", 0)
+                if group_list:
+                    tracked = getattr(svc, "_search_group_stats", {})
+                    matched = {
+                        g: dict(c) for g, c in tracked.items()
+                        if any(_fn.fnmatch(g, p) for p in group_list)
+                    }
+                    if matched:
+                        prim["search"]["groups"] = matched
+            idx_entry: dict[str, Any] = {
+                "uuid": getattr(svc, "uuid", name),
+                "primaries": prim,
+                "total": prim,
             }
-        out["_all"] = {"primaries": {"docs": {"count": total_docs}}}
+            if level == "shards":
+                idx_entry["shards"] = shards_out
+            out["indices"][name] = idx_entry
+            all_prim = self._merge_stats(all_prim, prim)
+        out["_all"] = {"primaries": all_prim, "total": all_prim}
+        if level == "cluster":
+            out.pop("indices")
         return out
 
     def close(self) -> None:

@@ -117,6 +117,91 @@ def knn_topk(
     return topk_ops.blockwise_topk(scores, k)
 
 
+def _vector_scores(queries, vectors, norms_sq, similarity):
+    """Exact similarity scores [B, m] for one corpus block (fp32-HIGHEST,
+    see knn_topk's precision note)."""
+    dots = jnp.einsum(
+        "bd,nd->bn", queries, vectors.astype(queries.dtype),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    if similarity == "l2_norm":
+        q_sq = jnp.sum(queries * queries, axis=-1, keepdims=True)
+        d_sq = jnp.maximum(q_sq - 2.0 * dots + norms_sq[None, :], 0.0)
+        return 1.0 / (1.0 + d_sq)
+    if similarity == "cosine":
+        q_norm = jnp.sqrt(jnp.sum(queries * queries, axis=-1, keepdims=True))
+        return (1.0 + dots / jnp.maximum(
+            q_norm * jnp.sqrt(norms_sq)[None, :], 1e-12)) / 2.0
+    return jnp.where(dots >= 0, dots + 1.0, 1.0 / (1.0 - dots))
+
+
+def knn_topk_streaming(
+    vectors: jnp.ndarray,
+    norms_sq: jnp.ndarray,
+    valid: jnp.ndarray,
+    queries: jnp.ndarray,
+    *,
+    k: int,
+    similarity: str = "l2_norm",
+    chunk: int = 32_768,
+):
+    """Exact kNN that never materializes the [B, n] score matrix.
+
+    The VERDICT r3 roofline gap: knn_topk's einsum writes the full [B, n]
+    fp32 scores to HBM (2 GB per 500-query chunk at 1M docs) and
+    blockwise_topk re-reads them — ~3x the streaming floor. This variant
+    scans the corpus in [chunk]-doc blocks (lax.scan), reduces each
+    [B, chunk] tile to a per-block top-k immediately, and folds it into a
+    running [B, k] state with one [B, 2k] top_k — so score traffic is one
+    write + one read of [B, chunk] per step instead of the whole matrix,
+    and XLA can overlap the next block's matmul with the current top-k.
+
+    Exactness/tie-break: per-block reductions are exact with doc-id-asc
+    ties (blockwise_topk/argmax-first contract); the cross-block merge
+    concatenates running state (earlier = lower doc ids) before the new
+    block, and lax.top_k takes the first of equal values, preserving
+    doc-id-asc ties globally. n_pad must be a multiple of `chunk`.
+    """
+    n_pad, d = vectors.shape
+    B = queries.shape[0]
+    assert n_pad % chunk == 0, (n_pad, chunk)
+    nc = n_pad // chunk
+
+    vec_blocks = vectors.reshape(nc, chunk, d)
+    norm_blocks = norms_sq.reshape(nc, chunk)
+    valid_blocks = valid.reshape(nc, chunk)
+    bases = (jnp.arange(nc, dtype=jnp.int32) * chunk)
+
+    def body(carry, xs):
+        best_v, best_i = carry
+        vec, ns, vd, base = xs
+        s = _vector_scores(queries, vec, ns, similarity)
+        s = jnp.where(vd[None, :], s, -jnp.inf)
+        cv, ci = topk_ops.blockwise_topk(s, min(k, chunk))
+        ci = ci.astype(jnp.int32) + base
+        allv = jnp.concatenate([best_v, cv], axis=1)
+        alli = jnp.concatenate([best_i, ci], axis=1)
+        nv, sel = jax.lax.top_k(allv, k)
+        ni = jnp.take_along_axis(alli, sel, axis=1)
+        return (nv, ni), None
+
+    init = (
+        jnp.full((B, k), -jnp.inf, jnp.float32),
+        jnp.zeros((B, k), jnp.int32),
+    )
+    (vals, ids), _ = jax.lax.scan(
+        body, init, (vec_blocks, norm_blocks, valid_blocks, bases)
+    )
+    return vals, ids
+
+
+def jit_knn_streaming(k: int, similarity: str = "l2_norm",
+                      chunk: int = 32_768):
+    return jax.jit(functools.partial(
+        knn_topk_streaming, k=k, similarity=similarity, chunk=chunk))
+
+
 def jit_hybrid(k: int, window: int, similarity: str = "l2_norm"):
     return jax.jit(
         functools.partial(hybrid_score_topk, k=k, window=window, similarity=similarity)

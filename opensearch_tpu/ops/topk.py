@@ -82,6 +82,12 @@ def blockwise_topk(
     layout reproduce doc-id-ascending ties end to end (tested).
     """
     B, n = scores.shape
+    if k > n:
+        # top-k deeper than the corpus: pad with -inf (id 0) rather than
+        # erroring — callers drop non-finite rows at merge time
+        scores = jnp.pad(scores, ((0, 0), (0, k - n)),
+                         constant_values=-jnp.inf)
+        n = k
     nb = -(-n // block_size)
     # the k-argmax strategy only wins for small k over large n; outside
     # that regime (small arrays, deep pages, k covering most blocks) the

