@@ -123,6 +123,11 @@ class IndexService:
         self.num_shards = int(settings.get("number_of_shards", 1))
         self.num_replicas = int(settings.get("number_of_replicas", 1))
         self.creation_date = int(time.time() * 1000)
+        # index UUID (IndexMetadata.INDEX_UUID): 22-char url-safe base64
+        import base64 as _b64
+        import os as _os
+
+        self.uuid = _b64.urlsafe_b64encode(_os.urandom(16)).decode()[:22]
         # alias name -> config ({"filter":..., "routing":...,
         # "is_write_index":...}); the per-index slice of AliasMetadata
         self.aliases: dict[str, dict] = {}
@@ -931,9 +936,113 @@ class TpuNode:
         self._save_templates(data)
         return {"acknowledged": True}
 
+    # -- legacy (v1) templates: /_template (MetadataIndexTemplateService
+    # legacy API; composable /_index_template templates shadow these) ------
+
+    def put_legacy_template(self, name: str, body: dict,
+                            create: bool = False) -> dict:
+        body = body or {}
+        patterns = body.get("index_patterns")
+        if isinstance(patterns, str):
+            patterns = [patterns]
+        if not patterns:
+            raise IllegalArgumentException(
+                f"index_template [{name}] index patterns are missing"
+            )
+        data = self._load_templates()
+        legacy = data.setdefault("legacy_templates", {})
+        if create and name in legacy:
+            raise IllegalArgumentException(
+                f"index_template [{name}] already exists"
+            )
+        # settings persist FLAT with the index. prefix and string values
+        # (IndexTemplateMetadata stores Settings; GET re-nests by default)
+        flat_settings = {}
+        for k, v in Settings.from_nested(
+                body.get("settings") or {}).as_dict().items():
+            if not k.startswith("index."):
+                k = f"index.{k}"
+            flat_settings[k] = str(v) if not isinstance(v, (dict, list)) \
+                else v
+        aliases = {}
+        for aname, conf in (body.get("aliases") or {}).items():
+            conf = dict(conf or {})
+            routing = conf.pop("routing", None)
+            if routing is not None:
+                conf.setdefault("index_routing", str(routing))
+                conf.setdefault("search_routing", str(routing))
+            aliases[aname] = conf
+        entry: dict[str, Any] = {
+            "order": int(body.get("order", 0)),
+            "index_patterns": list(patterns),
+            "settings": flat_settings,
+            "mappings": body.get("mappings") or {},
+            "aliases": aliases,
+        }
+        if body.get("version") is not None:
+            entry["version"] = int(body["version"])
+        legacy[name] = entry
+        self._save_templates(data)
+        return {"acknowledged": True}
+
+    def get_legacy_templates(self, name: str | None = None) -> dict:
+        import fnmatch
+
+        legacy = self._load_templates().get("legacy_templates", {})
+        if name is None:
+            return dict(sorted(legacy.items()))
+        out = {}
+        for pat in str(name).split(","):
+            for n, t in legacy.items():
+                if fnmatch.fnmatch(n, pat):
+                    out[n] = t
+        if not out and not any(c in str(name) for c in "*,?"):
+            raise ResourceNotFoundException(
+                f"index_template [{name}] missing"
+            )
+        return dict(sorted(out.items()))
+
+    def delete_legacy_template(self, name: str) -> dict:
+        import fnmatch
+
+        data = self._load_templates()
+        legacy = data.setdefault("legacy_templates", {})
+        victims = [n for n in legacy if fnmatch.fnmatch(n, name)]
+        if not victims and not any(c in name for c in "*?"):
+            raise ResourceNotFoundException(
+                f"index_template [{name}] missing"
+            )
+        for n in victims:
+            del legacy[n]
+        self._save_templates(data)
+        return {"acknowledged": True}
+
+    def _legacy_template_for_index(self, name: str) -> dict | None:
+        """Merged {settings, mappings, aliases} of matching v1 templates,
+        ascending order (higher order overrides)."""
+        import fnmatch
+
+        legacy = self._load_templates().get("legacy_templates", {})
+        matching = sorted(
+            (t for t in legacy.values()
+             if any(fnmatch.fnmatch(name, p) for p in t["index_patterns"])),
+            key=lambda t: int(t.get("order", 0)),
+        )
+        if not matching:
+            return None
+        merged: dict = {"settings": {}, "mappings": {}, "aliases": {}}
+        for t in matching:
+            merged["settings"] = _deep_merge(
+                merged["settings"], t.get("settings") or {})
+            merged["mappings"] = _deep_merge(
+                merged["mappings"], t.get("mappings") or {})
+            merged["aliases"].update(t.get("aliases") or {})
+        return merged
+
     def _template_for_index(self, name: str) -> dict | None:
         """Composed {settings, mappings, aliases} of the highest-priority
-        matching template (components first, template's own last)."""
+        matching template (components first, template's own last).
+        Composable templates shadow legacy /_template ones entirely."""
         import fnmatch
 
         data = self._load_templates()
@@ -945,7 +1054,7 @@ class TpuNode:
                 if prio > best_prio:
                     best, best_prio = tmpl, prio
         if best is None:
-            return None
+            return self._legacy_template_for_index(name)
         merged: dict = {"settings": {}, "mappings": {}, "aliases": {}}
         layers = [
             data["component_templates"].get(c, {}).get("template", {})

