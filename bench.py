@@ -8,13 +8,22 @@ k-NN, single shard), autotuned across the two exact fused programs:
    running [B, k] state; never materializes [B, n] — the VERDICT r3
    streaming-floor work)
 
-Wedge-proofing (VERDICT r3 weak #1): the axon tunnel's device claim can
-block INSIDE a C call, where an in-process SIGALRM handler never runs
-(observed: a 120 s alarm never fired over 25 minutes). So this file is a
-PARENT that never imports jax: the measurement runs in a child process
-under a hard subprocess timeout (SIGKILL), the last good result is
-persisted to BENCH_CACHE.json, and on any child failure the cached result
-is re-emitted with a staleness marker instead of an error line.
+Wedge-proofing (VERDICT r3 weak #1 / r4 weak #3): the axon tunnel's device
+claim can block INSIDE a C call, where an in-process SIGALRM handler never
+runs (observed: a 120 s alarm never fired over 25 minutes). So this file
+is a PARENT that never imports jax; all jax work runs in child processes
+under hard subprocess timeouts (SIGKILL). The parent:
+
+ 1. PROBES the accelerator first with a short (90 s) watchdog — a tiny
+    claim + matmul — before committing the full measurement budget, so a
+    wedged tunnel costs 90 s, not the whole budget.
+ 2. Keys BENCH_CACHE.json BY PLATFORM ({"tpu": {...}, "cpu": {...}}).
+    A CPU run can never overwrite the TPU headline (r4 poisoned the
+    single-slot cache with a CPU fallback, hiding round 2's verified
+    hardware number).
+ 3. Emits, in preference order: fresh TPU > cached TPU (stale-labeled,
+    with any fresh CPU point attached as `fresh_cpu_qps`) > fresh CPU >
+    cached CPU. The headline JSON line is the last line printed.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
@@ -40,59 +49,126 @@ from pathlib import Path
 
 CACHE = Path(__file__).resolve().parent / "BENCH_CACHE.json"
 BUDGET_S = int(os.environ.get("BENCH_BUDGET_S", "1100"))
+PROBE_S = int(os.environ.get("BENCH_PROBE_S", "90"))
+
+
+def _load_cache() -> dict:
+    if not CACHE.exists():
+        return {}
+    try:
+        data = json.loads(CACHE.read_text())
+    except Exception:  # noqa: BLE001 - corrupt cache == empty cache
+        return {}
+    if "metric" in data:  # legacy single-slot format (pre round 5)
+        return {data.get("platform", "cpu"): data}
+    return data
+
+
+def _save_cache(cache: dict) -> None:
+    try:
+        CACHE.write_text(json.dumps(cache, indent=1) + "\n")
+    except Exception:  # noqa: BLE001 - cache write must never kill the bench
+        pass
+
+
+def _run(args: list, timeout_s: int, platform_env=None):
+    """Run a child mode; return (last JSON dict or None, failure reason)."""
+    env = os.environ.copy()
+    if platform_env:
+        env["JAX_PLATFORMS"] = platform_env
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__] + args,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"child exceeded {timeout_s}s watchdog and was killed"
+    except Exception as e:  # noqa: BLE001
+        return None, str(e)[:200]
+    line = None
+    for cand in reversed(proc.stdout.decode().splitlines()):
+        cand = cand.strip()
+        if cand.startswith("{"):
+            line = cand
+            break
+    if line is None:
+        return None, f"child exited {proc.returncode} without a result"
+    try:
+        parsed = json.loads(line)
+    except Exception:  # noqa: BLE001
+        return None, "child emitted unparseable output"
+    if parsed.get("metric") == "bench_error":
+        return None, str(parsed.get("detail", "child error"))[:200]
+    if proc.returncode != 0:
+        return None, f"child exited {proc.returncode}"
+    return parsed, None
 
 
 def parent() -> int:
+    t_start = time.monotonic()
+    cache = _load_cache()
+    forced_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+
+    fresh = None
     reason = None
-    line = None
-    try:
-        proc = subprocess.run(
-            [sys.executable, __file__, "--child"],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL,
-            timeout=BUDGET_S,
-        )
-        for cand in reversed(proc.stdout.decode().splitlines()):
-            cand = cand.strip()
-            if cand.startswith("{"):
-                line = cand
-                break
-        if proc.returncode != 0 or line is None:
-            reason = f"child exited {proc.returncode} without a result"
-            line = None
+    cpu_fresh = None
+    if forced_cpu:
+        fresh, reason = _run(["--child"], BUDGET_S)
+    else:
+        probe, probe_err = _run(["--probe"], PROBE_S)
+        if probe is not None and probe.get("platform") not in (None, "cpu"):
+            remaining = max(60, BUDGET_S - int(time.monotonic() - t_start))
+            fresh, reason = _run(["--child"], remaining)
         else:
-            parsed = json.loads(line)
-            if parsed.get("metric") == "bench_error":
-                reason = str(parsed.get("detail", "child error"))
-                line = None
-    except subprocess.TimeoutExpired:
-        reason = (f"child exceeded {BUDGET_S}s watchdog and was killed "
-                  f"(axon tunnel wedged?)")
-    except Exception as e:  # noqa: BLE001 - never leave driver w/o JSON
-        reason = str(e)[:200]
+            reason = f"accelerator probe failed: {probe_err or probe}"
+            # the chip is gone for this round — still land a FRESH CPU
+            # point for the cpu cache slot (and as headline if no TPU
+            # history exists)
+            remaining = max(60, min(700, BUDGET_S - int(time.monotonic() - t_start)))
+            cpu_fresh, cpu_err = _run(["--child"], remaining, platform_env="cpu")
+            if cpu_fresh is None:
+                reason += f"; cpu fallback also failed: {cpu_err}"
 
-    if line is not None:
-        CACHE.write_text(line + "\n")
-        print(line)
-        return 0
-    if CACHE.exists():
-        try:
-            cached = json.loads(CACHE.read_text())
-            cached["stale"] = True
-            cached["detail"] = (
-                f"re-emitting last good result; fresh run failed: {reason}")
-            print(json.dumps(cached))
-            return 0
-        except Exception:  # noqa: BLE001 - corrupt cache: report the error
-            pass
-    print(json.dumps({
-        "metric": "bench_error", "value": 0, "unit": "error",
-        "vs_baseline": 0, "detail": reason or "unknown failure",
-    }))
-    return 1
+    out = None
+    if fresh is not None:
+        cache[fresh.get("platform", "cpu")] = fresh
+        out = fresh
+    else:
+        if cpu_fresh is not None:
+            cache["cpu"] = cpu_fresh
+        tpu_cached = cache.get("tpu")
+        if tpu_cached is not None:
+            out = dict(tpu_cached)
+            out["stale"] = True
+            out["detail"] = (
+                "re-emitting last TPU-verified result; fresh run failed: "
+                f"{reason}")
+            if cpu_fresh is not None:
+                out["fresh_cpu_qps"] = cpu_fresh.get("value")
+                out["fresh_cpu_metric"] = cpu_fresh.get("metric")
+        elif cpu_fresh is not None:
+            out = cpu_fresh
+        elif cache.get("cpu") is not None:
+            out = dict(cache["cpu"])
+            out["stale"] = True
+            out["detail"] = (
+                f"re-emitting last cpu result; fresh run failed: {reason}")
+
+    _save_cache(cache)
+    if out is None:
+        print(json.dumps({
+            "metric": "bench_error", "value": 0, "unit": "error",
+            "vs_baseline": 0, "detail": reason or "unknown failure",
+        }))
+        return 1
+    print(json.dumps(out))
+    return 0
 
 
-def child() -> None:
+def _pin_platform():
     import jax
 
     # pin an explicit JAX_PLATFORMS choice into the live config too —
@@ -101,6 +177,23 @@ def child() -> None:
     # recipe as tests/conftest.py / cli.py)
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    return jax
+
+
+def probe() -> None:
+    """Tiny device claim + matmul; prints {"platform": ...}."""
+    jax = _pin_platform()
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    x = jnp.ones((128, 128), dtype=jnp.float32)
+    np.asarray(x @ x)
+    print(json.dumps({"platform": dev.platform}))
+
+
+def child() -> None:
+    jax = _pin_platform()
     import jax.numpy as jnp
     import numpy as np
 
@@ -227,6 +320,16 @@ def child() -> None:
 
 
 if __name__ == "__main__":
+    if "--probe" in sys.argv:
+        try:
+            probe()
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "metric": "bench_error", "value": 0, "unit": "error",
+                "vs_baseline": 0, "detail": str(e)[:200],
+            }))
+            sys.exit(1)
+        sys.exit(0)
     if "--child" in sys.argv:
         try:
             child()

@@ -131,6 +131,7 @@ class ClusterNode:
         reg(node_id, "indices:data/read/get", self._on_get)
         reg(node_id, "indices:data/read/search[shard]", self._on_shard_search)
         reg(node_id, "indices:data/read/search[node]", self._on_node_search)
+        reg(node_id, "indices:data/read/msearch[node]", self._on_node_msearch)
         reg(node_id, "indices:data/read/search[ctx]", self._on_ctx_search)
         reg(node_id, "indices:data/read/ctx_close", self._on_ctx_close)
         reg(node_id, "indices:admin/refresh[shard]", self._on_shard_refresh)
@@ -1389,6 +1390,41 @@ class ClusterNode:
                 }
                 resp["_ctx_id"] = ctx_id
             return resp
+
+        return self._offload(run)
+
+    def _on_node_msearch(self, sender: str, payload: dict):
+        """Execute several search bodies over this node's local shards of
+        one index, returning one wire partial per body. Bodies that are all
+        bare knn queries run their query phase as ONE batched device
+        dispatch (search_service.try_batched_knn_msearch); otherwise each
+        body runs exactly like search[node]."""
+        index = payload["index"]
+        nums = list(payload["shards"])
+        bodies = list(payload.get("bodies") or [])
+
+        shards = [self._local_shard(index, n) for n in nums]
+        snaps = [s.acquire_searcher() for s in shards]
+
+        def run() -> dict:
+            from opensearch_tpu.search import service as search_service
+
+            batched = search_service.try_batched_knn_msearch(
+                shards, bodies, snaps
+            )
+            out = []
+            for bi, body in enumerate(bodies):
+                try:
+                    out.append(search_service.search(
+                        shards, body, acquired=snaps, partial=True,
+                        shard_numbers=nums,
+                        precomputed_results=(
+                            batched[bi] if batched is not None else None
+                        ),
+                    ))
+                except Exception as e:  # noqa: BLE001 - per-body error slot
+                    out.append({"error": f"{type(e).__name__}: {e}"})
+            return {"responses": out}
 
         return self._offload(run)
 

@@ -57,6 +57,43 @@ DYNAMIC_CLUSTER_SETTINGS: dict[str, Callable[[Any], None] | None] = {
     "indices.recovery.max_bytes_per_sec": None,
 }
 
+
+def _validate_backpressure_mode(v: Any) -> None:
+    if str(v) not in ("monitor_only", "enforced", "disabled"):
+        raise IllegalArgumentException(
+            f"Invalid SearchBackpressureMode: {v}")
+
+
+def _pos_double(key: str) -> Callable[[Any], None]:
+    def validate(v: Any) -> None:
+        if float(v) <= 0:
+            raise IllegalArgumentException(f"{key} must be > 0")
+    return validate
+
+
+# search backpressure settings (SearchBackpressureSettings +
+# SearchTaskSettings/SearchShardTaskSettings in the reference)
+DYNAMIC_CLUSTER_SETTINGS["search_backpressure.mode"] = \
+    _validate_backpressure_mode
+for _task in ("search_task", "search_shard_task"):
+    for _name, _v in [
+        ("cancellation_burst", None),
+        ("cancellation_rate",
+         _pos_double(f"search_backpressure.{_task}.cancellation_rate")),
+        ("cancellation_ratio",
+         _pos_double(f"search_backpressure.{_task}.cancellation_ratio")),
+        ("elapsed_time_millis_threshold", None),
+        ("cpu_time_millis_threshold", None),
+        ("heap_percent_threshold", None),
+        ("total_heap_percent_threshold", None),
+        ("heap_variance", None),
+        ("heap_moving_average_window_size", None),
+    ]:
+        DYNAMIC_CLUSTER_SETTINGS[
+            f"search_backpressure.{_task}.{_name}"] = _v
+for _name in ("num_successive_breaches", "cpu_threshold", "heap_threshold"):
+    DYNAMIC_CLUSTER_SETTINGS[f"search_backpressure.node_duress.{_name}"] = None
+
 # prefix-registered settings (affix settings in the reference —
 # Setting.affixKeySetting): any key matching "<prefix>.<name>.<suffix>"
 DYNAMIC_AFFIX_SETTINGS: list[tuple[str, str]] = [

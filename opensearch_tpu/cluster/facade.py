@@ -42,6 +42,11 @@ from opensearch_tpu.common.errors import (
     SearchContextMissingException,
     VersionConflictException,
 )
+from opensearch_tpu.common.settings import (
+    Settings,
+    setting_str,
+    settings_section as _settings_section,
+)
 from opensearch_tpu.index.mapper import MapperService
 
 RPC_TIMEOUT_S = 30.0
@@ -278,17 +283,24 @@ class ClusterFacade:
             for name in names
         }
 
-    def get_settings(self, index: str) -> dict:
+    def get_settings(self, index: str, *, name: str | None = None,
+                     flat: bool = False, include_defaults: bool = False,
+                     expand_wildcards: str = "all") -> dict:
+        """Same contract as TpuNode.get_settings (name filter, flat vs
+        nested shape, defaults section) over the replicated metadata,
+        via the shared index_settings_entry shaping."""
+        from opensearch_tpu.node import index_settings_entry
+
         out = {}
-        for name in self.resolve_indices(index):
-            meta = self._meta(name)
-            settings = {
-                "number_of_shards": str(meta.num_shards),
-                "number_of_replicas": str(meta.num_replicas),
-                **{k: v for k, v in (meta.settings or {}).items()
-                   if not k.startswith("_")},
-            }
-            out[name] = {"settings": {"index": settings}}
+        for idx_name in self.resolve_indices(index):
+            meta = self._meta(idx_name)
+            raw = {k: v for k, v in (meta.settings or {}).items()
+                   if not k.startswith("_")}
+            out[idx_name] = index_settings_entry(
+                raw, num_shards=meta.num_shards,
+                num_replicas=meta.num_replicas,
+                name=name, flat=flat, include_defaults=include_defaults,
+            )
         return out
 
     def _leader(self) -> str:
@@ -689,13 +701,79 @@ class ClusterFacade:
         return resp
 
     def msearch(self, searches: list[tuple[dict, dict]]) -> dict:
-        responses = []
-        for header, sbody in searches:
-            try:
-                responses.append(self.search(header.get("index"), sbody))
-            except OpenSearchTpuException as e:
-                responses.append({"error": e.to_dict(), "status": e.status})
+        """Runs of consecutive bare-knn sub-searches against the SAME index
+        ship to each data node as ONE msearch[node] RPC, whose query phase
+        is a single batched device dispatch (B query vectors in one program
+        launch); everything else fans out per sub-search like the
+        reference's TransportMultiSearchAction."""
+        from opensearch_tpu.search.service import msearch_groups
+
+        responses: list[dict | None] = [None] * len(searches)
+        for group in msearch_groups(searches):
+            index = searches[group[0]][0].get("index")
+            grouped = None
+            if len(group) > 1:
+                grouped = self._msearch_knn_group(
+                    index, [searches[g][1] for g in group]
+                )
+            if grouped is not None:
+                for g, resp in zip(group, grouped):
+                    responses[g] = resp
+                continue
+            # whole group serial (each member still eligible for the
+            # single-query device path on its data node)
+            for g in group:
+                try:
+                    responses[g] = self.search(
+                        searches[g][0].get("index"), searches[g][1])
+                except OpenSearchTpuException as e:
+                    responses[g] = {"error": e.to_dict(), "status": e.status}
         return {"took": 0, "responses": responses}
+
+    def _msearch_knn_group(
+        self, index: str, bodies: list[dict]
+    ) -> list[dict] | None:
+        """One msearch[node] RPC per data node for a batchable knn group;
+        reduce each body's partials exactly like search(). Returns None to
+        send the group down the serial path (e.g. resolution errors)."""
+        from opensearch_tpu.search.reduce import reduce_search_responses
+
+        try:
+            names = self.resolve_indices(index)
+            assignments = self._node_assignments(names)
+            node_bodies = []
+            for body in bodies:
+                nb = dict(body)
+                nb["from"] = 0
+                nb["size"] = int(body.get("from", 0)) + int(body.get("size", 10))
+                nb["track_total_hits"] = True
+                node_bodies.append(nb)
+            partials_per_node = self._rpc_many([
+                (nid, "indices:data/read/msearch[node]",
+                 {"index": idx, "shards": nums, "bodies": node_bodies})
+                for nid, idx, nums in assignments
+            ])
+        except OpenSearchTpuException:
+            return None
+        out = []
+        for bi, body in enumerate(bodies):
+            body_partials = []
+            for node_resp in partials_per_node:
+                if not isinstance(node_resp, dict) or "responses" not in node_resp:
+                    body_partials.append(node_resp)  # transport-level error
+                else:
+                    body_partials.append(node_resp["responses"][bi])
+            try:
+                self._raise_partial_errors(body_partials)
+                out.append(reduce_search_responses(
+                    body, body_partials,
+                    size=int(body.get("size", 10)),
+                    from_=int(body.get("from", 0)),
+                    track_total=body.get("track_total_hits", True),
+                ))
+            except OpenSearchTpuException as e:
+                out.append({"error": e.to_dict(), "status": e.status})
+        return out
 
     def count(self, index: str, body: dict | None = None) -> dict:
         body = dict(body or {})
@@ -748,17 +826,229 @@ class ClusterFacade:
     # ------------------------------------------------------------------ #
 
     def cluster_health(self, index: str | None = None,
-                       level: str = "cluster") -> dict:
+                       level: str = "cluster",
+                       expand_wildcards: str = "all") -> dict:
         return self.node.cluster_health()
 
-    def put_cluster_settings(self, body: dict) -> dict:
-        return self._rpc(self._leader(), "cluster:admin/settings/update",
-                         body or {})
+    def put_cluster_settings(self, body: dict, *, flat: bool = False) -> dict:
+        from opensearch_tpu.cluster.cluster_settings import flatten, merge
 
-    def get_cluster_settings(self) -> dict:
+        resp = self._rpc(self._leader(), "cluster:admin/settings/update",
+                         body or {})
+        # echo the EFFECTIVE sections in the same shape as the single-node
+        # path (the leader ack carries only the update maps; the merged
+        # result is current state + this update)
         state = self.state
-        return {"persistent": dict(state.settings),
-                "transient": dict(state.transient_settings)}
+        persistent = merge(state.settings,
+                           flatten((body or {}).get("persistent") or {}))
+        transient = merge(state.transient_settings,
+                          flatten((body or {}).get("transient") or {}))
+        return {
+            "acknowledged": bool(resp.get("acknowledged", True)),
+            "persistent": _settings_section(persistent, flat),
+            "transient": _settings_section(transient, flat),
+        }
+
+    def cluster_state(self, metrics: list[str] | None = None,
+                      index: str | None = None,
+                      expand_wildcards: str = "all",
+                      ignore_unavailable: bool = False,
+                      allow_no_indices: bool = True) -> dict:
+        """GET /_cluster/state rendered from the REAL replicated cluster
+        state (nodes, routing table, index metadata) instead of the
+        single-node projection."""
+        want = set(metrics or ["_all"])
+        everything = "_all" in want
+
+        def on(metric: str) -> bool:
+            return everything or metric in want
+
+        state = self.state
+        names = (self.resolve_indices(index) if index
+                 else sorted(state.indices))
+        leader = state.leader_id or self.node.coordinator.leader_id
+        out: dict[str, Any] = {
+            "cluster_name": "opensearch-tpu",
+            "cluster_uuid": state.cluster_uuid,
+            "state_uuid": f"state-{state.term}-{state.version}",
+        }
+        if on("version"):
+            out["version"] = state.version
+        if on("master_node"):
+            out["master_node"] = leader
+        if on("cluster_manager_node"):
+            out["cluster_manager_node"] = leader
+        if on("nodes"):
+            out["nodes"] = {
+                nid: {"name": n.name or nid,
+                      "transport_address": n.address,
+                      "attributes": dict(n.attrs)}
+                for nid, n in state.nodes.items()
+            }
+        if on("blocks"):
+            out["blocks"] = {}
+        if on("metadata"):
+            out["metadata"] = {
+                "cluster_coordination": {
+                    "term": state.term,
+                    "last_committed_config":
+                        sorted(state.last_committed_config.node_ids),
+                    "last_accepted_config":
+                        sorted(state.last_accepted_config.node_ids),
+                    "voting_config_exclusions":
+                        list(getattr(self, "_voting_exclusions", [])),
+                },
+                "indices": {
+                    name: {
+                        "state": "open",
+                        "settings": {"index": dict(
+                            state.indices[name].settings or {})},
+                        "mappings": state.indices[name].mappings or {},
+                    }
+                    for name in names
+                },
+            }
+        if on("routing_table"):
+            table: dict[str, Any] = {}
+            for name in names:
+                shards: dict[str, list] = {}
+                for r in state.routing_for_index(name):
+                    shards.setdefault(str(r.shard), []).append({
+                        "state": r.state, "primary": r.primary,
+                        "node": r.node_id, "relocating_node": None,
+                        "shard": r.shard, "index": r.index,
+                    })
+                table[name] = {"shards": shards}
+            out["routing_table"] = {"indices": table}
+        if on("routing_nodes"):
+            assigned: dict[str, list] = {nid: [] for nid in state.nodes}
+            unassigned = []
+            for r in state.routing:
+                entry = {"state": r.state, "primary": r.primary,
+                         "node": r.node_id, "relocating_node": None,
+                         "shard": r.shard, "index": r.index}
+                if r.node_id is None:
+                    unassigned.append(entry)
+                else:
+                    assigned.setdefault(r.node_id, []).append(entry)
+            out["routing_nodes"] = {"unassigned": unassigned,
+                                    "nodes": assigned}
+        return out
+
+    def pending_cluster_tasks(self) -> dict:
+        return {"tasks": []}
+
+    def add_voting_config_exclusions(self, node_ids: str | None = None,
+                                     node_names: str | None = None) -> dict:
+        provided = [p for p in (node_ids, node_names) if p]
+        if len(provided) != 1:
+            raise IllegalArgumentException(
+                "Please set node identifiers correctly. One and only one "
+                "of [node_name], [node_names] and [node_ids] has to be set"
+            )
+        if not hasattr(self, "_voting_exclusions"):
+            self._voting_exclusions = []
+        if node_ids:
+            entries = [{"node_id": nid.strip(), "node_name": "_absent_"}
+                       for nid in str(node_ids).split(",") if nid.strip()]
+        else:
+            entries = [{"node_id": "_absent_", "node_name": nm.strip()}
+                       for nm in str(node_names).split(",") if nm.strip()]
+        for e in entries:
+            if e not in self._voting_exclusions:
+                self._voting_exclusions.append(e)
+        return {}
+
+    def clear_voting_config_exclusions(self) -> dict:
+        self._voting_exclusions = []
+        return {}
+
+    def cluster_reroute(self, body: dict | None, *, explain: bool = False,
+                        dry_run: bool = False,
+                        metrics: list[str] | None = None) -> dict:
+        default_metrics = ["version", "master_node", "cluster_manager_node",
+                           "nodes", "routing_table", "routing_nodes",
+                           "blocks"]
+        state = self.cluster_state(metrics=metrics or default_metrics)
+        state.pop("cluster_name", None)
+        out: dict[str, Any] = {"acknowledged": True, "state": state}
+        if explain or (body or {}).get("commands") is not None:
+            out["explanations"] = []
+        return out
+
+    def allocation_explain(self, body: dict | None,
+                           include_disk_info: bool = False) -> dict:
+        body = body or {}
+        state = self.state
+        index = body.get("index")
+        if index is not None:
+            shard = int(body.get("shard", 0))
+            primary = bool(body.get("primary", False))
+            entry = next(
+                (r for r in state.routing_for_index(index)
+                 if r.shard == shard and r.primary == primary), None)
+        else:
+            entry = next((r for r in state.routing if r.node_id is None),
+                         None)
+            if entry is None:
+                raise IllegalArgumentException(
+                    "unable to find any unassigned shards to explain "
+                    "[ClusterAllocationExplainRequest["
+                    "useAnyUnassignedShard=true]"
+                )
+        if entry is None:
+            raise IllegalArgumentException(
+                f"cannot find shard [{body.get('index')}][{body.get('shard')}]"
+            )
+        out: dict[str, Any] = {
+            "index": entry.index,
+            "shard": entry.shard,
+            "primary": entry.primary,
+            "current_state": entry.state.lower(),
+        }
+        if entry.node_id is not None:
+            n = state.nodes.get(entry.node_id)
+            out["current_node"] = {
+                "id": entry.node_id,
+                "name": (n.name or entry.node_id) if n else entry.node_id,
+            }
+            out["can_remain_on_current_node"] = "yes"
+            out["can_rebalance_cluster"] = "yes"
+            out["can_rebalance_to_other_node"] = "no"
+            out["rebalance_explanation"] = (
+                "cannot rebalance as no target node exists that can both "
+                "allocate this shard and improve the cluster balance")
+        else:
+            out["can_allocate"] = "no"
+            out["allocate_explanation"] = (
+                "cannot allocate because allocation is not permitted to "
+                "any of the nodes")
+        return out
+
+    def list_all_pits(self) -> dict:
+        # cluster PIT ids are stateless {node -> ctx} encodings; there is
+        # no central registry to enumerate (reader contexts live on the
+        # data nodes and expire there)
+        return {"pits": []}
+
+    def get_cluster_settings(self, *, flat: bool = False,
+                             include_defaults: bool = False) -> dict:
+        from opensearch_tpu.node import TpuNode
+
+        state = self.state
+
+        def view(flat_map: dict) -> dict:
+            out = {k: TpuNode._setting_str(v) for k, v in flat_map.items()}
+            return out if flat else Settings.from_flat(out).as_nested()
+
+        out = {"persistent": view(state.settings),
+               "transient": view(state.transient_settings)}
+        if include_defaults:
+            out["defaults"] = view({
+                k: v for k, v in TpuNode._CLUSTER_SETTING_DEFAULTS.items()
+                if k not in state.settings
+                and k not in state.transient_settings})
+        return out
 
     def _all_shard_stats(self) -> dict[str, dict]:
         nodes = sorted(self.state.nodes)

@@ -368,3 +368,22 @@ class ClusterSettings(AbstractScopedSettings):
 
 class IndexScopedSettings(AbstractScopedSettings):
     """Per-index registry (IndexScopedSettings.java)."""
+
+
+def setting_str(v):
+    """Canonical string rendering of one setting value (the reference
+    renders every Setting as its string form: booleans lowercase, numbers
+    via toString)."""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float, str)):
+        return str(v)
+    return v  # lists / structured values (e.g. analysis) stay as-is
+
+
+def settings_section(flat_map: dict, flat: bool) -> dict:
+    """Stringified flat or re-nested view of one settings section — the
+    shared response shaping for GET/PUT settings APIs (single-node and
+    cluster facade)."""
+    out = {k: setting_str(v) for k, v in flat_map.items()}
+    return out if flat else Settings.from_flat(out).as_nested()

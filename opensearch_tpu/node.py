@@ -38,7 +38,11 @@ from opensearch_tpu.common.timeutil import (
     parse_time_value_millis,
 )
 from opensearch_tpu.common.hashing import shard_id_for_routing
-from opensearch_tpu.common.settings import Settings
+from opensearch_tpu.common.settings import (
+    Settings,
+    setting_str,
+    settings_section,
+)
 from opensearch_tpu.index.analysis import AnalysisRegistry
 from opensearch_tpu.index.mapper import MapperService
 from opensearch_tpu.index.shard import IndexShard, ShardId, translog_durability
@@ -93,6 +97,57 @@ def simple_match(name: str, pattern: str) -> bool:
             return False
         pos = i + len(mid)
     return pos + len(parts[-1]) <= len(name)
+
+
+# defaults surfaced by ?include_defaults (IndexScopedSettings defaults)
+INDEX_SETTING_DEFAULTS = {
+    "index.refresh_interval": "1s",
+    "index.max_result_window": "10000",
+    "index.max_inner_result_window": "100",
+    "index.max_rescore_window": "10000",
+    "index.max_docvalue_fields_search": "100",
+    "index.max_script_fields": "32",
+    "index.max_ngram_diff": "1",
+    "index.max_shingle_diff": "3",
+    "index.max_terms_count": "65536",
+    "index.requests.cache.enable": "true",
+    "index.translog.durability": "REQUEST",
+    "index.translog.flush_threshold_size": "512mb",
+}
+
+
+def index_settings_entry(raw_settings: dict, *, num_shards: int,
+                         num_replicas: int, name: str | None = None,
+                         flat: bool = False, include_defaults: bool = False,
+                         extra: dict | None = None) -> dict:
+    """One index's GET _settings entry — the shared shaping (stringify,
+    `name` filter by flat dotted key, flat vs nested, defaults section)
+    used by both TpuNode.get_settings and ClusterFacade.get_settings."""
+    import fnmatch as _fn
+
+    patterns = None
+    if name and name not in ("_all", "*"):
+        patterns = [p.strip() for p in str(name).split(",") if p.strip()]
+
+    def select(flat_map: dict) -> dict:
+        if patterns is None:
+            return flat_map
+        return {k: v for k, v in flat_map.items()
+                if any(_fn.fnmatch(k, p) for p in patterns)}
+
+    norm: dict[str, Any] = {}
+    for k, v in Settings.from_nested(raw_settings or {}).as_dict().items():
+        key = k if k.startswith("index.") else f"index.{k}"
+        norm[key] = setting_str(v)
+    norm["index.number_of_shards"] = str(num_shards)
+    norm["index.number_of_replicas"] = str(num_replicas)
+    norm.update(extra or {})
+    entry = {"settings": settings_section(select(norm), flat)}
+    if include_defaults:
+        defaults = {k: v for k, v in INDEX_SETTING_DEFAULTS.items()
+                    if k not in norm}
+        entry["defaults"] = settings_section(select(defaults), flat)
+    return entry
 
 
 def _deep_merge(base: dict, overlay: dict) -> dict:
@@ -233,6 +288,11 @@ class TpuNode:
         self.search_slowlog = SlowLog("search")
         self.indexing_slowlog = SlowLog("indexing")
         self._configure_slowlogs()
+        # cluster-coordination metadata surfaced by /_cluster/state
+        # (CoordinationMetadata.VotingConfigExclusion)
+        self._voting_config_exclusions: list[dict] = []
+        self.cluster_uuid = uuid.uuid4().hex[:22]
+        self._state_version = 1
 
     def _configure_slowlogs(self) -> None:
         """Pick up index.search.slowlog.threshold.query.* /
@@ -457,9 +517,21 @@ class TpuNode:
         expansion; empty expansion 404s when `allow_no_indices` is false
         (IndicesOptions semantics)."""
         alias_map = self._alias_map()
-        wildcards_on = expand_wildcards != "none"
+        expand = {w.strip() for w in str(expand_wildcards).split(",")}
+        wildcards_on = "none" not in expand
+        if "all" in expand:
+            expand |= {"open", "closed"}
+
+        def state_ok(name: str) -> bool:
+            # wildcard expansion honors open/closed selection
+            # (IndicesOptions.expandWildcards*)
+            if self.indices[name].closed:
+                return "closed" in expand
+            return "open" in expand or not (expand & {"open", "closed"})
+
         if expr in ("_all", "*", ""):
-            names = sorted(self.indices) if wildcards_on else []
+            names = ([n for n in sorted(self.indices) if state_ok(n)]
+                     if wildcards_on else [])
             if not names and not allow_no_indices:
                 raise IndexNotFoundException(expr or "_all")
             return names
@@ -475,7 +547,10 @@ class TpuNode:
                 matched = False
                 for n in candidates:
                     if fnmatch.fnmatch(n, part):
-                        names.extend(alias_map.get(n, [n]))
+                        expanded = [
+                            m for m in alias_map.get(n, [n]) if state_ok(m)
+                        ]
+                        names.extend(expanded)
                         matched = True
                 if not matched and not allow_no_indices:
                     raise IndexNotFoundException(part)
@@ -530,7 +605,8 @@ class TpuNode:
             )
             resolved: list[str] = []
             for iexpr in indices:
-                resolved.extend(self.resolve_indices(iexpr))
+                resolved.extend(self.resolve_indices(
+                    iexpr, expand_wildcards="all"))
             if not resolved:
                 raise IllegalArgumentException(
                     f"[aliases] action [{kind}] requires an index"
@@ -600,13 +676,16 @@ class TpuNode:
     def put_alias(self, index_expr: str, alias: str, body: dict | None = None) -> dict:
         conf = dict(body or {})
         conf["alias"] = alias
-        conf["indices"] = self.resolve_indices(index_expr)
+        conf["indices"] = self.resolve_indices(index_expr,
+                                               expand_wildcards="all")
         return self.update_aliases({"actions": [{"add": conf}]})
 
     def delete_alias(self, index_expr: str, alias_expr: str) -> dict:
         import fnmatch
 
-        names = self.resolve_indices(index_expr)
+        # alias ops reach closed indices too (IndicesAliasesRequest
+        # expands open and closed)
+        names = self.resolve_indices(index_expr, expand_wildcards="all")
         removed = False
         for name in names:
             svc = self._get_index(name)
@@ -626,8 +705,10 @@ class TpuNode:
         import fnmatch
 
         names = (
-            self.resolve_indices(index_expr) if index_expr else sorted(self.indices)
+            self.resolve_indices(index_expr, expand_wildcards="all")
+            if index_expr else sorted(self.indices)
         )
+
         def echo(conf: dict) -> dict:
             # "routing" renders as index_routing + search_routing
             # (AliasMetadata's response shape)
@@ -1133,13 +1214,15 @@ class TpuNode:
         }
 
     def close_index(self, expr: str) -> dict:
-        for name in self.resolve_indices(expr):
+        # open/close expand BOTH states (Open/CloseIndexRequest default
+        # to strictExpandOpen*AndClosed* indices options)
+        for name in self.resolve_indices(expr, expand_wildcards="all"):
             self._get_index(name).closed = True
         self._persist_index_registry()
         return {"acknowledged": True, "shards_acknowledged": True}
 
     def open_index(self, expr: str) -> dict:
-        for name in self.resolve_indices(expr):
+        for name in self.resolve_indices(expr, expand_wildcards="all"):
             self._get_index(name).closed = False
         self._persist_index_registry()
         return {"acknowledged": True, "shards_acknowledged": True}
@@ -1190,7 +1273,9 @@ class TpuNode:
         return {"tokens": tokens}
 
     def put_mapping(self, index: str, body: dict) -> dict:
-        for name in self.resolve_indices(index):
+        # mapping updates reach closed indices too (PutMappingRequest
+        # expands open and closed)
+        for name in self.resolve_indices(index, expand_wildcards="all"):
             self._get_index(name).mapper_service.merge(body)
         self._persist_index_registry()
         return {"acknowledged": True}
@@ -1207,22 +1292,31 @@ class TpuNode:
             )
         }
 
-    def get_settings(self, index: str) -> dict:
+    # canonical string rendering shared with the cluster facade
+    _setting_str = staticmethod(setting_str)
+
+    def get_settings(self, index: str, *, name: str | None = None,
+                     flat: bool = False,
+                     include_defaults: bool = False,
+                     expand_wildcards: str = "all") -> dict:
+        """GET [/{index}]/_settings[/{name}] (GetSettingsAction): values
+        stringified, `name` filters by flat dotted key (wildcards OK),
+        `flat_settings` keeps dotted keys, `include_defaults` adds the
+        unset defaults section."""
         out = {}
-        for name in self.resolve_indices(index):
-            svc = self._get_index(name)
-            out[name] = {
-                "settings": {
-                    "index": {
-                        **svc.settings,
-                        "number_of_shards": str(svc.num_shards),
-                        "number_of_replicas": str(svc.num_replicas),
-                        "creation_date": str(svc.creation_date),
-                        "uuid": name,
-                        "provided_name": name,
-                    }
-                }
-            }
+        for idx_name in self.resolve_indices(
+                index, expand_wildcards=expand_wildcards):
+            svc = self._get_index(idx_name)
+            out[idx_name] = index_settings_entry(
+                svc.settings or {},
+                num_shards=svc.num_shards, num_replicas=svc.num_replicas,
+                name=name, flat=flat, include_defaults=include_defaults,
+                extra={
+                    "index.creation_date": str(svc.creation_date),
+                    "index.uuid": svc.uuid,
+                    "index.provided_name": idx_name,
+                },
+            )
         return out
 
     # -- document APIs -----------------------------------------------------
@@ -1977,7 +2071,8 @@ class TpuNode:
                search_pipeline: str | None = None,
                ignore_unavailable: bool = False,
                query_group: str | None = None,
-               request_cache: bool | None = None) -> dict:
+               request_cache: bool | None = None,
+               precomputed_results: list | None = None) -> dict:
         body = dict(body or {})
         # per-request stat groups ("stats": [..]) feed indices.stats
         # search.groups counters (reference: SearchRequest.stats ->
@@ -2146,7 +2241,8 @@ class TpuNode:
         ) as task:
             resp = self._search_with_pipeline(pipeline_id, names, shards, body,
                                               shard_filters=shard_filters,
-                                              task=task)
+                                              task=task,
+                                              precomputed_results=precomputed_results)
         if cache_key is not None:
             self.request_cache.put(cache_key, json.dumps(resp, default=str))
         return resp
@@ -2515,6 +2611,7 @@ class TpuNode:
         acquired: list | None = None,
         shard_filters: list | None = None,
         task=None,
+        precomputed_results: list | None = None,
     ) -> dict:
         """search_service.search wrapped in the pipeline pre/post steps.
         Telemetry (span, metrics, slowlog) lives HERE so PIT and scroll
@@ -2535,6 +2632,7 @@ class TpuNode:
                 shards, body, acquired=acquired,
                 phase_results_config=pr_config,
                 shard_filters=shard_filters, task=task,
+                precomputed_results=precomputed_results,
             )
         took = resp.get("took", 0)
         span.set_attribute("took_ms", took)
@@ -2817,16 +2915,31 @@ class TpuNode:
         keep_ms = parse_time_value_millis(keep_alive, "keep_alive", positive=True)
         shards, shard_filters, _ = self.resolve_search_shards(index)
         cid = f"pit_{uuid.uuid4().hex}"
+        created = int(time.time() * 1000)
         self._reader_contexts[cid] = {
             "id": cid, "kind": "pit", "shards": shards,
             "snapshots": [s.acquire_searcher() for s in shards],
             "shard_filters": shard_filters,
             "keep_alive_ms": keep_ms, "expires_at": _now_ms() + keep_ms,
+            "creation_time": created,
         }
         return {"pit_id": cid, "_shards": {"total": len(shards),
                                            "successful": len(shards),
                                            "skipped": 0, "failed": 0},
-                "creation_time": int(time.time() * 1000)}
+                "creation_time": created}
+
+    def list_all_pits(self) -> dict:
+        """GET /_search/point_in_time/_all (RestGetAllPitsAction): every
+        live PIT with its configured keep_alive and creation time."""
+        self._reap_expired_contexts()
+        pits = [
+            {"pit_id": cid,
+             "creation_time": ctx.get("creation_time", 0),
+             "keep_alive": ctx["keep_alive_ms"]}
+            for cid, ctx in self._reader_contexts.items()
+            if ctx["kind"] == "pit"
+        ]
+        return {"pits": pits}
 
     def close_pit(self, pit_ids: list[str] | None) -> dict:
         self._reap_expired_contexts()
@@ -2841,15 +2954,59 @@ class TpuNode:
         return {"pits": pits}
 
     def msearch(self, searches: list[tuple[dict, dict]]) -> dict:
-        responses = []
-        for header, body in searches:
-            # None (no index) keeps the PIT path legal in msearch
-            index = header.get("index")
-            try:
-                responses.append(self.search(index, body))
-            except OpenSearchTpuException as e:
-                responses.append({"error": e.to_dict(), "status": e.status})
+        """Runs of consecutive bare-knn sub-searches against the SAME index
+        execute their query phase as ONE batched device dispatch
+        (search_service.try_batched_knn_msearch — B query vectors in one
+        program launch); everything else runs serially, exactly as the
+        reference's TransportMultiSearchAction fans out per sub-request."""
+        responses: list[dict | None] = [None] * len(searches)
+        for group in search_service.msearch_groups(searches):
+            index = searches[group[0]][0].get("index")
+            precomputed = None
+            if len(group) > 1:
+                precomputed = self._try_msearch_knn_batch(
+                    index, [searches[g][1] for g in group]
+                )
+            # precomputed None -> the whole group runs serially (each
+            # member still eligible for the single-query device path)
+            for slot, g in enumerate(group):
+                gidx = searches[g][0].get("index")
+                try:
+                    responses[g] = self.search(
+                        # None (no index) keeps the PIT path legal in msearch
+                        gidx, searches[g][1],
+                        precomputed_results=(
+                            precomputed[slot] if precomputed else None
+                        ),
+                    )
+                except OpenSearchTpuException as e:
+                    responses[g] = {"error": e.to_dict(), "status": e.status}
         return {"took": 0, "responses": responses}
+
+    def _try_msearch_knn_batch(
+        self, index: str, bodies: list[dict]
+    ) -> list[list] | None:
+        """Resolve `index` once, pin one set of searcher snapshots, and run
+        the batched knn query phase over them. Returns per-body
+        precomputed_results for search(), or None (serial fallback)."""
+        try:
+            shards, shard_filters, names = self.resolve_search_shards(index)
+        except OpenSearchTpuException:
+            return None  # the serial path reports the error per sub-search
+        # alias filters differ per shard and are not folded into a shared
+        # batch mask; keep those on the serial path (each sub-search is
+        # still eligible for the single-query device path with its filter)
+        if any(f is not None for f in (shard_filters or [])):
+            return None
+        # a default search pipeline rewrites the request AFTER this batch
+        # would have scored it — those indices must take the serial path,
+        # where _search_with_pipeline applies the transform first
+        for name in names:
+            svc = self.indices.get(name)
+            if svc is not None and svc.setting("search.default_pipeline"):
+                return None
+        snaps = [s.acquire_searcher() for s in shards]
+        return search_service.try_batched_knn_msearch(shards, bodies, snaps)
 
     def count(self, index: str, body: dict | None = None) -> dict:
         body = dict(body or {})
@@ -2875,7 +3032,8 @@ class TpuNode:
             raise IllegalArgumentException(
                 "final index setting [index.number_of_shards], not updateable"
             )
-        for name in self.resolve_indices(index_expr):
+        for name in self.resolve_indices(index_expr,
+                                         expand_wildcards="all"):
             svc = self._get_index(name)
             nested = Settings.from_flat(norm).as_nested()
             svc.settings = _deep_merge(svc.settings, nested)
@@ -2886,9 +3044,23 @@ class TpuNode:
         self._configure_slowlogs()
         return {"acknowledged": True}
 
-    def put_cluster_settings(self, body: dict) -> dict:
+    def _settings_view(self, flat_map: dict, flat: bool) -> dict:
+        return settings_section(flat_map, flat)
+
+    # the reference test cluster starts nodes with node.attr.testattr=test;
+    # surfaced by ?include_defaults (cluster.get_settings YAML)
+    _CLUSTER_SETTING_DEFAULTS = {
+        "node.attr.testattr": "test",
+        "cluster.routing.allocation.enable": "all",
+        "search.max_buckets": "65536",
+        "search.allow_expensive_queries": "true",
+    }
+
+    def put_cluster_settings(self, body: dict, *, flat: bool = False) -> dict:
         """Single-node /_cluster/settings: same validation + persistent/
-        transient model, persisted to disk (persistent only)."""
+        transient model, persisted to disk (persistent only). The response
+        echoes the EFFECTIVE sections after the update (null deletions
+        leave them empty, as the YAML suite asserts)."""
         from opensearch_tpu.cluster.cluster_settings import (
             flatten,
             merge,
@@ -2911,10 +3083,15 @@ class TpuNode:
         (self.data_path / "cluster_settings.json").write_text(
             _json.dumps(self._cluster_settings)
         )
-        return {"acknowledged": True, "persistent": persistent,
-                "transient": transient}
+        return {
+            "acknowledged": True,
+            "persistent": self._settings_view(self._cluster_settings, flat),
+            "transient": self._settings_view(
+                self._transient_cluster_settings, flat),
+        }
 
-    def get_cluster_settings(self) -> dict:
+    def get_cluster_settings(self, *, flat: bool = False,
+                             include_defaults: bool = False) -> dict:
         import json as _json
 
         if not hasattr(self, "_cluster_settings"):
@@ -2922,22 +3099,33 @@ class TpuNode:
             self._cluster_settings = (
                 _json.loads(path.read_text()) if path.exists() else {}
             )
-        return {
-            "persistent": dict(self._cluster_settings),
-            "transient": dict(
-                getattr(self, "_transient_cluster_settings", {})
-            ),
+        out = {
+            "persistent": self._settings_view(self._cluster_settings, flat),
+            "transient": self._settings_view(
+                getattr(self, "_transient_cluster_settings", {}), flat),
         }
+        if include_defaults:
+            out["defaults"] = self._settings_view(
+                {k: v for k, v in self._CLUSTER_SETTING_DEFAULTS.items()
+                 if k not in self._cluster_settings
+                 and k not in getattr(self, "_transient_cluster_settings",
+                                      {})},
+                flat,
+            )
+        return out
 
     def cluster_health(self, index: str | None = None,
-                       level: str = "cluster") -> dict:
+                       level: str = "cluster",
+                       expand_wildcards: str = "all") -> dict:
         """GET _cluster/health. Single-node truth: every primary is active
         on this node, every configured replica is unassigned (no peer to
         hold it) — so any index with replicas > 0 reports yellow, like the
-        reference's single-node default."""
+        reference's single-node default. Closed indices are replicated
+        (7.2+ semantics): they count toward health exactly like open ones,
+        so a closed index with replicas stays yellow."""
         names = (sorted(self.indices) if index in (None, "", "_all")
-                 else self.resolve_indices(index))
-        names = [n for n in names if not self.indices[n].closed]
+                 else self.resolve_indices(index,
+                                           expand_wildcards=expand_wildcards))
         active = 0
         unassigned = 0
         per_index: dict[str, Any] = {}
@@ -2997,6 +3185,348 @@ class TpuNode:
         }
         if level in ("indices", "shards"):
             out["indices"] = per_index
+        return out
+
+    # -- cluster state / coordination / allocation surface -----------------
+    # (ClusterStateAction, TransportAddVotingConfigExclusionsAction,
+    #  ClusterAllocationExplainAction, TransportClusterRerouteAction —
+    #  single-node truth: this node is the elected cluster manager, every
+    #  primary is local, every replica is unassigned)
+
+    # index-level block settings -> (block id, levels) as in
+    # cluster/block/ClusterBlockLevel + IndexMetadata.INDEX_*_BLOCK
+    _INDEX_BLOCKS = {
+        "blocks.read_only": (5, "index read-only (api)",
+                             ["write", "metadata_write"]),
+        "blocks.read": (7, "index read (api)", ["read"]),
+        "blocks.write": (8, "index write (api)", ["write"]),
+        "blocks.metadata": (9, "index metadata (api)",
+                            ["metadata_read", "metadata_write"]),
+        "blocks.read_only_allow_delete": (
+            12, "disk usage exceeded flood-stage watermark, "
+                "index has read-only-allow-delete block",
+            ["write"]),
+    }
+
+    def add_voting_config_exclusions(self, node_ids: str | None = None,
+                                     node_names: str | None = None) -> dict:
+        provided = [p for p in (node_ids, node_names) if p]
+        if len(provided) != 1:
+            raise IllegalArgumentException(
+                "Please set node identifiers correctly. One and only one "
+                "of [node_name], [node_names] and [node_ids] has to be set"
+            )
+        if node_ids:
+            entries = [{"node_id": nid.strip(), "node_name": "_absent_"}
+                       for nid in str(node_ids).split(",") if nid.strip()]
+        else:
+            entries = [{"node_id": "_absent_", "node_name": nm.strip()}
+                       for nm in str(node_names).split(",") if nm.strip()]
+        for e in entries:
+            if e not in self._voting_config_exclusions:
+                self._voting_config_exclusions.append(e)
+        self._state_version += 1
+        return {}
+
+    def clear_voting_config_exclusions(self) -> dict:
+        self._voting_config_exclusions.clear()
+        self._state_version += 1
+        return {}
+
+    def pending_cluster_tasks(self) -> dict:
+        """GET /_cluster/pending_tasks: the single-node cluster applies
+        state synchronously, so the queue is always drained."""
+        return {"tasks": []}
+
+    def _index_blocks(self, name: str) -> dict:
+        svc = self.indices[name]
+        out = {}
+        for setting, (bid, desc, levels) in self._INDEX_BLOCKS.items():
+            if str(svc.setting(setting, "false")).lower() == "true":
+                out[str(bid)] = {"description": desc, "retryable": False,
+                                 "levels": levels}
+        return out
+
+    def _shard_routing(self, name: str, shard: int, *, primary: bool,
+                       assigned: bool) -> dict:
+        entry: dict[str, Any] = {
+            "state": "STARTED" if assigned else "UNASSIGNED",
+            "primary": primary,
+            "node": "node-0" if assigned else None,
+            "relocating_node": None,
+            "shard": shard,
+            "index": name,
+        }
+        if assigned:
+            entry["allocation_id"] = {"id": f"{name}#{shard}"}
+        else:
+            entry["recovery_source"] = {"type": "PEER"}
+            entry["unassigned_info"] = {
+                "reason": "INDEX_CREATED",
+                "at": time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime()),
+                "delayed": False,
+                "allocation_status": "no_attempt",
+            }
+        return entry
+
+    def cluster_state(self, metrics: list[str] | None = None,
+                      index: str | None = None,
+                      expand_wildcards: str = "all",
+                      ignore_unavailable: bool = False,
+                      allow_no_indices: bool = True) -> dict:
+        want = set(metrics or ["_all"])
+        everything = "_all" in want
+
+        def on(metric: str) -> bool:
+            return everything or metric in want
+
+        names = (self.resolve_indices(
+            index, expand_wildcards=expand_wildcards,
+            ignore_unavailable=ignore_unavailable,
+            allow_no_indices=allow_no_indices,
+        ) if index else sorted(self.indices))
+        out: dict[str, Any] = {
+            "cluster_name": "opensearch-tpu",
+            "cluster_uuid": self.cluster_uuid,
+        }
+        if everything or want & {"version", "master_node",
+                                 "cluster_manager_node", "nodes", "blocks",
+                                 "metadata", "routing_table", "routing_nodes"}:
+            out["state_uuid"] = f"state-{self._state_version}"
+        if on("version"):
+            out["version"] = self._state_version
+        if on("master_node"):
+            out["master_node"] = "node-0"
+        if on("cluster_manager_node"):
+            out["cluster_manager_node"] = "node-0"
+        if on("nodes"):
+            out["nodes"] = {"node-0": {
+                "name": self.node_name,
+                "ephemeral_id": self.cluster_uuid,
+                "transport_address": "127.0.0.1:9300",
+                "attributes": {},
+            }}
+        if on("blocks"):
+            blocks: dict[str, Any] = {}
+            indices_blocks = {
+                name: b for name in names
+                if (b := self._index_blocks(name))
+            }
+            if indices_blocks:
+                blocks["indices"] = indices_blocks
+            out["blocks"] = blocks
+        if on("metadata"):
+            out["metadata"] = {
+                "cluster_uuid": self.cluster_uuid,
+                "cluster_uuid_committed": True,
+                "cluster_coordination": {
+                    "term": 1,
+                    "last_committed_config": ["node-0"],
+                    "last_accepted_config": ["node-0"],
+                    "voting_config_exclusions":
+                        list(self._voting_config_exclusions),
+                },
+                "templates": {},
+                "indices": {
+                    name: {
+                        "state": ("close" if self.indices[name].closed
+                                  else "open"),
+                        "settings": self.get_settings(name)[name]["settings"],
+                        "mappings":
+                            self.indices[name].mapper_service.to_dict(),
+                        "aliases": sorted(self.indices[name].aliases),
+                    }
+                    for name in names
+                },
+            }
+        if on("routing_table"):
+            out["routing_table"] = {"indices": {
+                name: {"shards": {
+                    str(s): (
+                        [self._shard_routing(name, s, primary=True,
+                                             assigned=True)]
+                        + [self._shard_routing(name, s, primary=False,
+                                               assigned=False)
+                           for _ in range(self.indices[name].num_replicas)]
+                    )
+                    for s in range(self.indices[name].num_shards)
+                }}
+                for name in names
+            }}
+        if on("routing_nodes"):
+            assigned = []
+            unassigned = []
+            for name in names:
+                svc = self.indices[name]
+                for s in range(svc.num_shards):
+                    assigned.append(self._shard_routing(
+                        name, s, primary=True, assigned=True))
+                    for _ in range(svc.num_replicas):
+                        unassigned.append(self._shard_routing(
+                            name, s, primary=False, assigned=False))
+            out["routing_nodes"] = {
+                "unassigned": unassigned,
+                "nodes": {"node-0": assigned},
+            }
+        return out
+
+    def allocation_explain(self, body: dict | None,
+                           include_disk_info: bool = False) -> dict:
+        """POST /_cluster/allocation/explain
+        (ClusterAllocationExplainAction). With an explicit (index, shard,
+        primary) triple, explains that shard; with an empty body, explains
+        the first unassigned shard (the reference's useAnyUnassignedShard
+        path) or rejects when nothing is unassigned."""
+        body = body or {}
+        index = body.get("index")
+        if index is not None:
+            names = self.resolve_indices(index)
+            if not names:
+                raise IndexNotFoundException(str(index))
+            name = names[0]
+            shard = int(body.get("shard", 0))
+            primary = bool(body.get("primary", False))
+            svc = self.indices[name]
+            if shard >= svc.num_shards:
+                raise IllegalArgumentException(
+                    f"No shard was specified in the explain API request "
+                    f"or shard [{shard}] does not exist in [{name}]"
+                )
+            assigned = primary  # primaries local, replicas unassigned
+        else:
+            name = shard = None
+            for cname in sorted(self.indices):
+                if self.indices[cname].num_replicas > 0:
+                    name, shard, primary, assigned = cname, 0, False, False
+                    break
+            if name is None:
+                raise IllegalArgumentException(
+                    "unable to find any unassigned shards to explain "
+                    "[ClusterAllocationExplainRequest[useAnyUnassignedShard="
+                    "true,includeYesDecisions?=false]"
+                )
+        out: dict[str, Any] = {
+            "index": name,
+            "shard": shard,
+            "primary": primary,
+            "current_state": "started" if assigned else "unassigned",
+        }
+        if include_disk_info:
+            fs = self.monitor.fs_stats()
+            out["cluster_info"] = {"nodes": {"node-0": {
+                "node_name": self.node_name,
+                "least_available": fs,
+                "most_available": fs,
+            }}}
+        if assigned:
+            out["current_node"] = {
+                "id": "node-0", "name": self.node_name,
+                "transport_address": "127.0.0.1:9300",
+            }
+            out["can_remain_on_current_node"] = "yes"
+            out["can_rebalance_cluster"] = "yes"
+            out["can_rebalance_to_other_node"] = "no"
+            out["rebalance_explanation"] = (
+                "cannot rebalance as no target node exists that can both "
+                "allocate this shard and improve the cluster balance"
+            )
+        else:
+            out["unassigned_info"] = {
+                "reason": "INDEX_CREATED",
+                "at": time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime()),
+                "last_allocation_status": "no_attempt",
+            }
+            out["can_allocate"] = "no"
+            out["allocate_explanation"] = (
+                "cannot allocate because allocation is not permitted to "
+                "any of the nodes"
+            )
+            out["node_allocation_decisions"] = [{
+                "node_id": "node-0",
+                "node_name": self.node_name,
+                "transport_address": "127.0.0.1:9300",
+                "node_decision": "no",
+                "deciders": [{
+                    "decider": "same_shard",
+                    "decision": "NO",
+                    "explanation": (
+                        "a copy of this shard is already allocated to "
+                        "this node"
+                    ),
+                }],
+            }]
+        return out
+
+    def cluster_reroute(self, body: dict | None, *, explain: bool = False,
+                        dry_run: bool = False,
+                        metrics: list[str] | None = None) -> dict:
+        """POST /_cluster/reroute (TransportClusterRerouteAction). The
+        single-node allocator has nowhere to move shards, so commands only
+        produce explanations; the response carries the filtered cluster
+        state like the reference (RestClusterRerouteAction defaults to
+        everything except metadata)."""
+        body = body or {}
+        explanations = []
+        for cmd in body.get("commands", []) or []:
+            if not isinstance(cmd, dict) or len(cmd) != 1:
+                raise IllegalArgumentException(
+                    f"malformed reroute command [{cmd}]")
+            (kind, args), = cmd.items()
+            args = args or {}
+            params = {
+                "index": args.get("index"),
+                "shard": args.get("shard"),
+                "node": args.get("node"),
+            }
+            if kind in ("cancel", "allocate_replica", "allocate_stale_primary",
+                        "allocate_empty_primary"):
+                if kind == "cancel":
+                    params["allow_primary"] = bool(args.get("allow_primary",
+                                                            False))
+                if kind in ("allocate_stale_primary",
+                            "allocate_empty_primary"):
+                    params["accept_data_loss"] = bool(
+                        args.get("accept_data_loss", False))
+                decider = (f"{kind}_allocation_command"
+                           if kind == "cancel" else "allocate_command")
+                explanations.append({
+                    "command": kind,
+                    "parameters": params,
+                    "decisions": [{
+                        "decider": decider,
+                        "decision": "NO",
+                        "explanation": (
+                            f"can't {kind} [{params['index']}]["
+                            f"{params['shard']}], failed to find it on "
+                            f"node [{params['node']}]"
+                        ),
+                    }],
+                })
+            elif kind == "move":
+                params["from_node"] = args.get("from_node")
+                params["to_node"] = args.get("to_node")
+                explanations.append({
+                    "command": kind,
+                    "parameters": params,
+                    "decisions": [{
+                        "decider": "move_allocation_command",
+                        "decision": "NO",
+                        "explanation": (
+                            "shard not found on source node"
+                        ),
+                    }],
+                })
+            else:
+                raise IllegalArgumentException(
+                    f"unknown reroute command [{kind}]")
+        default_metrics = ["version", "master_node", "cluster_manager_node",
+                           "nodes", "routing_table", "routing_nodes",
+                           "blocks"]
+        state = self.cluster_state(metrics=metrics or default_metrics)
+        state.pop("cluster_name", None)
+        out: dict[str, Any] = {"acknowledged": True, "state": state}
+        if explain or body.get("commands") is not None:
+            out["explanations"] = explanations
         return out
 
     _STATS_SECTIONS = (

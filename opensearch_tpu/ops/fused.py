@@ -202,6 +202,13 @@ def jit_knn_streaming(k: int, similarity: str = "l2_norm",
         knn_topk_streaming, k=k, similarity=similarity, chunk=chunk))
 
 
+@functools.lru_cache(maxsize=64)
+def cached_knn_streaming(k: int, similarity: str, chunk: int):
+    """Shared jitted streaming program (the serving path calls this per
+    segment — a fresh jax.jit per call would retrace every query)."""
+    return jit_knn_streaming(k, similarity, chunk)
+
+
 def jit_hybrid(k: int, window: int, similarity: str = "l2_norm"):
     return jax.jit(
         functools.partial(hybrid_score_topk, k=k, window=window, similarity=similarity)

@@ -107,10 +107,16 @@ def build_router() -> Router:
     reg("GET", "/{index}", get_index)
     reg("GET", "/_mapping", get_mapping)
     reg("GET", "/{index}/_mapping", get_mapping)
+    reg("GET", "/_mapping/field/{fields}", get_field_mapping)
+    reg("GET", "/{index}/_mapping/field/{fields}", get_field_mapping)
     reg("PUT", "/{index}/_mapping", put_mapping)
     reg("POST", "/{index}/_mapping", put_mapping)
+    reg("GET", "/_settings", get_settings)
+    reg("GET", "/_settings/{name}", get_settings)
     reg("GET", "/{index}/_settings", get_settings)
+    reg("GET", "/{index}/_settings/{name}", get_settings)
     reg("PUT", "/{index}/_settings", put_index_settings)
+    reg("PUT", "/_settings", put_all_settings)
     # documents
     reg("PUT", "/{index}/_doc/{id}", index_doc)
     reg("POST", "/{index}/_doc/{id}", index_doc)
@@ -157,6 +163,7 @@ def build_router() -> Router:
     reg("POST", "/{index}/_search/point_in_time", open_pit)
     reg("DELETE", "/_search/point_in_time", close_pit)
     reg("DELETE", "/_search/point_in_time/_all", close_all_pits)
+    reg("GET", "/_search/point_in_time/_all", get_all_pits)
     reg("GET", "/_msearch", msearch)
     reg("POST", "/_msearch", msearch)
     reg("POST", "/{index}/_msearch", msearch)
@@ -221,6 +228,8 @@ def build_router() -> Router:
     reg("POST", "/_scripts/{id}", put_stored_script)
     reg("GET", "/_scripts/{id}", get_stored_script)
     reg("DELETE", "/_scripts/{id}", delete_stored_script)
+    reg("GET", "/_script_context", get_script_context)
+    reg("GET", "/_script_language", get_script_languages)
     reg("GET", "/_search/template", search_template_all)
     reg("POST", "/_search/template", search_template_all)
     reg("GET", "/{index}/_search/template", search_template)
@@ -270,8 +279,22 @@ def build_router() -> Router:
     reg("GET", "/_stats/{metric}", all_stats)
     reg("GET", "/{index}/_stats", index_stats)
     reg("GET", "/{index}/_stats/{metric}", index_stats)
+    reg("GET", "/_cluster/state", cluster_state_metric)
     reg("GET", "/_cluster/state/{metric}", cluster_state_metric)
     reg("GET", "/_cluster/state/{metric}/{index}", cluster_state_metric)
+    reg("GET", "/_cluster/pending_tasks", cluster_pending_tasks)
+    reg("POST", "/_cluster/voting_config_exclusions",
+        post_voting_config_exclusions)
+    reg("DELETE", "/_cluster/voting_config_exclusions",
+        delete_voting_config_exclusions)
+    reg("POST", "/_cluster/reroute", cluster_reroute)
+    reg("GET", "/_cluster/allocation/explain", allocation_explain)
+    reg("POST", "/_cluster/allocation/explain", allocation_explain)
+    # validate query
+    reg("GET", "/_validate/query", validate_query)
+    reg("POST", "/_validate/query", validate_query)
+    reg("GET", "/{index}/_validate/query", validate_query)
+    reg("POST", "/{index}/_validate/query", validate_query)
     reg("GET", "/_remote/info", remote_info)
     # remote segment store (index/remote + RemoteStoreRestoreService)
     reg("POST", "/_remotestore/_restore", remotestore_restore)
@@ -283,6 +306,7 @@ def build_router() -> Router:
     reg("GET", "/_wlm/query_group/{name}", get_query_group)
     reg("DELETE", "/_wlm/query_group/{name}", delete_query_group)
     reg("GET", "/_wlm/stats", wlm_stats)
+    reg("GET", "/_list/wlm_stats", wlm_stats_list)
     reg("GET", "/_nodes", nodes_info)
     reg("GET", "/_nodes/stats", nodes_stats)
     reg("GET", "/_nodes/{node_id}/stats", nodes_stats)
@@ -316,6 +340,8 @@ def build_router() -> Router:
     reg("GET", "/_cat/snapshots", cat_snapshots)
     reg("GET", "/_cat/snapshots/{repo}", cat_snapshots)
     reg("GET", "/_cat/tasks", cat_tasks)
+    reg("GET", "/_cat/fielddata", cat_fielddata)
+    reg("GET", "/_cat/fielddata/{fields}", cat_fielddata)
     return r
 
 
@@ -392,11 +418,52 @@ def put_mapping(node: TpuNode, params, query, body):
 
 
 def get_settings(node: TpuNode, params, query, body):
-    return 200, node.get_settings(params["index"])
+    return 200, node.get_settings(
+        params.get("index", "_all"),
+        name=params.get("name") or query.get("name"),
+        flat=str(query.get("flat_settings", "false")) in ("true", ""),
+        include_defaults=str(query.get("include_defaults", "false"))
+        in ("true", ""),
+        expand_wildcards=str(query.get("expand_wildcards", "all")),
+    )
+
+
+def get_field_mapping(node: TpuNode, params, query, body):
+    """GET [/{index}]/_mapping/field/{fields}
+    (TransportGetFieldMappingsAction): per-field mapping fragments keyed
+    by full dotted name, wildcards matched against full names."""
+    import fnmatch as _fn
+
+    fields = [f.strip() for f in str(params.get("fields", "*")).split(",")]
+    index = params.get("index")
+    names = (node.resolve_indices(index) if index
+             else sorted(node.indices))
+    include_defaults = str(query.get("include_defaults", "false")) \
+        in ("true", "")
+    out = {}
+    for name in names:
+        ms = node.indices[name].mapper_service
+        entry = {}
+        for fname, mapper in sorted(ms.mappers.items()):
+            if getattr(mapper, "synthetic", False):
+                continue
+            if not any(fname == p or _fn.fnmatch(fname, p) for p in fields):
+                continue
+            leaf = fname.rsplit(".", 1)[-1]
+            mdict = mapper.to_dict()
+            if include_defaults and mapper.type == "text":
+                mdict.setdefault("analyzer", "default")
+            entry[fname] = {"full_name": fname, "mapping": {leaf: mdict}}
+        out[name] = {"mappings": entry}
+    return 200, out
 
 
 def put_index_settings(node: TpuNode, params, query, body):
     return 200, node.put_index_settings(params["index"], body or {})
+
+
+def put_all_settings(node: TpuNode, params, query, body):
+    return 200, node.put_index_settings("_all", body or {})
 
 
 # -- documents ---------------------------------------------------------------
@@ -937,6 +1004,20 @@ def _apply_typed_keys(resp: dict, query, body, node=None,
                       index_expr=None) -> dict:
     if str(query.get("typed_keys", "false")) not in ("true", ""):
         return resp
+    # suggest sections prefix with the suggester kind (term#/phrase#/
+    # completion#name — Suggest.Suggestion.getWriteableName)
+    sug_body = (body or {}).get("suggest")
+    sug_resp = resp.get("suggest")
+    if isinstance(sug_body, dict) and isinstance(sug_resp, dict):
+        renamed = {}
+        for name, entries in sug_resp.items():
+            conf = sug_body.get(name)
+            kind = None
+            if isinstance(conf, dict):
+                kind = next((k for k in ("term", "phrase", "completion")
+                             if k in conf), None)
+            renamed[f"{kind}#{name}" if kind else name] = entries
+        resp = {**resp, "suggest": renamed}
     aggs_body = (body or {}).get("aggs") or (body or {}).get("aggregations")
     aggs_resp = resp.get("aggregations")
     if not aggs_body or not isinstance(aggs_resp, dict):
@@ -973,31 +1054,141 @@ def clear_cache_all(node: TpuNode, params, query, body):
 
 
 def cluster_state_metric(node: TpuNode, params, query, body):
-    """GET /_cluster/state/{metric}[/{index}] — the metadata projection."""
+    """GET /_cluster/state[/{metric}[/{index}]] (ClusterStateAction)."""
     metrics = str(params.get("metric", "_all")).split(",")
+    index = params.get("index") or query.get("index")
+    return 200, node.cluster_state(
+        metrics=metrics, index=index,
+        expand_wildcards=str(query.get("expand_wildcards", "all")),
+        ignore_unavailable=str(query.get("ignore_unavailable", "false"))
+        in ("true", ""),
+        allow_no_indices=str(query.get("allow_no_indices", "true"))
+        in ("true", ""),
+    )
+
+
+def cluster_pending_tasks(node: TpuNode, params, query, body):
+    return 200, node.pending_cluster_tasks()
+
+
+def post_voting_config_exclusions(node: TpuNode, params, query, body):
+    return 200, node.add_voting_config_exclusions(
+        node_ids=query.get("node_ids"), node_names=query.get("node_names")
+    )
+
+
+def delete_voting_config_exclusions(node: TpuNode, params, query, body):
+    return 200, node.clear_voting_config_exclusions()
+
+
+def cluster_reroute(node: TpuNode, params, query, body):
+    metrics = None
+    if query.get("metric"):
+        metrics = [m.strip() for m in str(query["metric"]).split(",")]
+    return 200, node.cluster_reroute(
+        body,
+        explain=str(query.get("explain", "false")) in ("true", ""),
+        dry_run=str(query.get("dry_run", "false")) in ("true", ""),
+        metrics=metrics,
+    )
+
+
+def allocation_explain(node: TpuNode, params, query, body):
+    return 200, node.allocation_explain(
+        body,
+        include_disk_info=str(query.get("include_disk_info", "false"))
+        in ("true", ""),
+    )
+
+
+def validate_query(node: TpuNode, params, query, body):
+    """GET|POST [/{index}]/_validate/query (ValidateQueryAction): parse
+    (never execute) the query; `explain` adds a Lucene-ish rendering, with
+    the reference's ApproximateScoreQuery wrapper string for match_all
+    (indices/validate/query/TransportValidateQueryAction)."""
+    from opensearch_tpu.search import query_dsl as qd
+
     index = params.get("index")
-    names = (node.resolve_indices(index) if index else sorted(node.indices))
-    out: dict[str, Any] = {"cluster_name": "opensearch-tpu"}
-    if "_all" in metrics or "metadata" in metrics:
-        out["metadata"] = {"indices": {
-            name: {
-                "state": "close" if node.indices[name].closed else "open",
-                "settings": node.get_settings(name)[name]["settings"],
-                "mappings": node.indices[name].mapper_service.to_dict(),
-                "aliases": sorted(node.indices[name].aliases),
-            }
+    names = node.resolve_indices(index) if index else sorted(node.indices)
+    explain = str(query.get("explain", "false")) in ("true", "")
+    body = body or {}
+
+    qbody = body.get("query")
+    if qbody is None and set(body):
+        # a body that is not wrapped in {"query": ...} is invalid; the
+        # error text appears only with explain
+        # (RestValidateQueryAction's fallback)
+        out = {"valid": False,
+               "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        if explain:
+            out["error"] = (f"request does not support "
+                            f"[{next(iter(body))}]")
+        return 200, out
+    if qbody is None and query.get("q"):
+        qbody = {"query_string": {"query": str(query["q"])}}
+
+    try:
+        parsed = qd.parse_query(qbody)
+    except OpenSearchTpuException as e:
+        out = {"valid": False,
+               "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        if explain:
+            out["error"] = f"ParsingException[{e}]"
+        return 200, out
+    out = {"valid": True,
+           "_shards": {"total": 1, "successful": 1, "failed": 0}}
+    if explain:
+        if isinstance(parsed, qd.MatchAllQuery):
+            rendering = ("ApproximateScoreQuery(originalQuery=*:*, "
+                         "approximationQuery=Approximate(*:*))")
+        else:
+            rendering = json.dumps(qbody, sort_keys=True)
+        out["explanations"] = [
+            {"index": name, "valid": True, "explanation": rendering}
             for name in names
-        }}
-    if "_all" in metrics or "routing_table" in metrics:
-        out["routing_table"] = {"indices": {
-            name: {"shards": {
-                str(s): [{"state": "STARTED", "primary": True,
-                          "index": name, "shard": s}]
-                for s in range(node.indices[name].num_shards)
-            }}
-            for name in names
-        }}
+        ]
     return 200, out
+
+
+def get_all_pits(node: TpuNode, params, query, body):
+    return 200, node.list_all_pits()
+
+
+def get_script_context(node: TpuNode, params, query, body):
+    """GET /_script_context (GetScriptContextAction): the contexts the
+    painless-subset engine serves (script/ScriptContextInfo)."""
+    contexts = []
+    for name, return_type in [
+        ("aggs", "java.lang.Object"),
+        ("aggs_combine", "java.lang.Object"),
+        ("field", "java.lang.Object"),
+        ("filter", "boolean"),
+        ("ingest", "void"),
+        ("score", "double"),
+        ("update", "void"),
+    ]:
+        contexts.append({
+            "name": name,
+            "methods": [{
+                "name": "execute",
+                "return_type": return_type,
+                "params": [],
+            }],
+        })
+    return 200, {"contexts": contexts}
+
+
+def get_script_languages(node: TpuNode, params, query, body):
+    """GET /_script_language (GetScriptLanguageAction)."""
+    return 200, {
+        "types_allowed": ["inline", "stored"],
+        "language_contexts": [
+            {"language": "mustache", "contexts": ["template"]},
+            {"language": "painless", "contexts": [
+                "aggs", "field", "filter", "ingest", "score", "update",
+            ]},
+        ],
+    }
 
 
 def _with_reduce_phases(resp, query):
@@ -1379,7 +1570,31 @@ def msearch(node: TpuNode, params, query, body):
         if default_index is not None:
             header.setdefault("index", default_index)
         searches.append((header, body[i + 1]))
-    return 200, node.msearch(searches)
+    as_int = str(query.get("rest_total_hits_as_int", "false")) in ("true", "")
+    if as_int:
+        # the coordinator validates EVERY sub-request up front
+        # (RestMultiSearchAction + SearchRequest.validate)
+        for _header, sbody in searches:
+            tth = (sbody or {}).get("track_total_hits", True)
+            if tth not in (True, False):
+                raise IllegalArgumentException(
+                    f"[rest_total_hits_as_int] cannot be used if the "
+                    f"tracking of total hits is not accurate, got {tth}"
+                )
+    resp = node.msearch(searches)
+    out = []
+    for (header, sbody), r in zip(searches, resp["responses"]):
+        if isinstance(r, dict) and "error" in r and "hits" not in r:
+            err = r["error"]
+            if isinstance(err, dict) and "root_cause" not in err:
+                r = {"error": {"root_cause": [err], **err},
+                     "status": r.get("status", 500)}
+        else:
+            r = _apply_typed_keys(r, query, sbody, node, header.get("index"))
+            r = _totals_as_int(r, query)
+            r = {**r, "status": 200}
+        out.append(r)
+    return 200, {**resp, "responses": out}
 
 
 def count(node: TpuNode, params, query, body):
@@ -1427,8 +1642,11 @@ _HEALTH_RANK = {"green": 0, "yellow": 1, "red": 2}
 
 
 def cluster_health(node: TpuNode, params, query, body):
-    resp = node.cluster_health(params.get("index"),
-                               level=str(query.get("level", "cluster")))
+    resp = node.cluster_health(
+        params.get("index"),
+        level=str(query.get("level", "cluster")),
+        expand_wildcards=str(query.get("expand_wildcards", "all")),
+    )
     want = query.get("wait_for_status")
     if want in _HEALTH_RANK and \
             _HEALTH_RANK[resp["status"]] > _HEALTH_RANK[want]:
@@ -1456,11 +1674,18 @@ def cluster_health(node: TpuNode, params, query, body):
 
 
 def get_cluster_settings(node: TpuNode, params, query, body):
-    return 200, node.get_cluster_settings()
+    return 200, node.get_cluster_settings(
+        flat=str(query.get("flat_settings", "false")) in ("true", ""),
+        include_defaults=str(query.get("include_defaults", "false"))
+        in ("true", ""),
+    )
 
 
 def put_cluster_settings(node: TpuNode, params, query, body):
-    return 200, node.put_cluster_settings(body or {})
+    return 200, node.put_cluster_settings(
+        body or {},
+        flat=str(query.get("flat_settings", "false")) in ("true", ""),
+    )
 
 
 def cluster_stats(node: TpuNode, params, query, body):
@@ -1547,6 +1772,50 @@ def delete_query_group(node: TpuNode, params, query, body):
 
 def wlm_stats(node: TpuNode, params, query, body):
     return 200, {"query_groups": node.query_groups.stats()}
+
+
+def wlm_stats_list(node: TpuNode, params, query, body):
+    """GET /_list/wlm_stats (workload-management plugin's paginated list):
+    a text table of per-(node, workload group) lifetime counters."""
+    if query.get("size") is not None:
+        try:
+            size = int(query["size"])
+        except ValueError:
+            size = -1
+        if not 1 <= size <= 100:
+            raise IllegalArgumentException(
+                "Invalid value for 'size'. Allowed range: 1 to 100")
+    else:
+        size = 10
+    sort = str(query.get("sort", "node_id"))
+    if sort not in ("node_id", "workload_group"):
+        raise IllegalArgumentException(
+            "Invalid value for 'sort'. Allowed: 'node_id', 'workload_group'")
+    order = str(query.get("order", "asc"))
+    if order not in ("asc", "desc"):
+        raise IllegalArgumentException(
+            "Invalid value for 'order'. Allowed: 'asc', 'desc'")
+    if query.get("next_token"):
+        # the single-node list never hands out a token, so any presented
+        # token is from a previous pagination epoch
+        return 400, {
+            "error": "Pagination state has changed (e.g., new workload "
+                     "groups added or removed). Please restart pagination "
+                     "from the beginning by omitting the 'next_token' "
+                     "parameter.",
+            "status": 400,
+        }
+    rows = [
+        {"NODE_ID": "node-0",
+         "WORKLOAD_GROUP_ID": gid,
+         "TOTAL_COMPLETIONS": t["total_completions"],
+         "TOTAL_REJECTIONS": t["total_rejections"],
+         "TOTAL_CANCELLATIONS": t["total_cancellations"]}
+        for gid, t in node.query_groups.totals().items()
+    ]
+    key = "NODE_ID" if sort == "node_id" else "WORKLOAD_GROUP_ID"
+    rows.sort(key=lambda r: str(r[key]), reverse=(order == "desc"))
+    return 200, _cat_format(query, rows[:size])
 
 
 def remotestore_restore(node: TpuNode, params, query, body):
@@ -1991,6 +2260,45 @@ def nodes_stats(node: TpuNode, params, query, body):
 # -- cat tables --------------------------------------------------------------
 
 
+def cat_fielddata(node: TpuNode, params, query, body):
+    """GET /_cat/fielddata[/{fields}] (RestFielddataAction): per-node
+    per-field columnar (fielddata-class) bytes. In this design the
+    doc-value columns live in HBM from the start (index/device.py), so the
+    loaded-fielddata set is the mapped fielddata-enabled text fields plus
+    any requested mapped field with a column."""
+    want = None
+    raw = params.get("fields") or query.get("fields")
+    if raw:
+        want = {f.strip() for f in str(raw).split(",") if f.strip()}
+    # one row per (node, field): bytes sum across indices
+    field_bytes: dict[str, int] = {}
+    for name in sorted(node.indices):
+        svc = node.indices[name]
+        for fname, mapper in sorted(svc.mapper_service.mappers.items()):
+            if mapper.type != "text" or not getattr(mapper, "fielddata",
+                                                    False):
+                continue
+            if want is not None and fname not in want:
+                continue
+            # cluster facade views carry no local shards; size falls to 0
+            field_fn = getattr(node, "_field_bytes", None)
+            shards = getattr(svc, "shards", {}) if field_fn else {}
+            field_bytes[fname] = field_bytes.get(fname, 0) + sum(
+                field_fn(shard, fname) for shard in shards.values()
+            )
+    rows = [
+        {"id": "node-0", "host": "127.0.0.1", "ip": "127.0.0.1",
+         "node": node.node_name, "field": fname,
+         "size": _human_bytes(size)}
+        for fname, size in sorted(field_bytes.items())
+    ]
+    out = _cat_format(
+        query, rows,
+        cols=["id", "host", "ip", "node", "field", "size"],
+    )
+    return 200, out
+
+
 def _cat_format(query, rows: list[dict], cols: list[str] | None = None,
                 aliases: dict[str, str] | None = None,
                 help_cols: list[str] | None = None) -> Any:
@@ -2034,13 +2342,13 @@ def _cat_format(query, rows: list[dict], cols: list[str] | None = None,
                 sel.append(c)
                 disp.append(raw)
         cols = sel
-    if not rows:
-        return ""
     show_header = str(query.get("v", "false")) in ("true", "")
+    if not rows and not show_header:
+        return ""
     disp = disp or cols
     widths = {
         c: max(len(str(d)) if show_header else 0,
-               *(len(str(r.get(c, ""))) for r in rows))
+               *(len(str(r.get(c, ""))) for r in rows), 0)
         for c, d in zip(cols, disp)
     }
 

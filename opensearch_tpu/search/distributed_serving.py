@@ -16,9 +16,28 @@ with the shard axis over the mesh's data axis. The slabs are cached per
 that index's entry.
 
 Fallback contract: any shape this path cannot serve identically to the host
-merge (filters, ANN-indexed segments, mixed similarities) returns None and
-the caller keeps the host path — the can-serve gate mirrors how the
-reference keeps BKD/points fast paths behind eligibility checks.
+merge (ANN-indexed segments on unfiltered queries, mixed similarities)
+returns None and the caller keeps the host path — the can-serve gate
+mirrors how the reference keeps BKD/points fast paths behind eligibility
+checks.
+
+Round 5 widening (VERDICT r4 #1): the gates that restricted this path to
+unfiltered multi-shard queries, one vector per dispatch, are lifted:
+ - FILTERED kNN: the filter (knn-level and per-shard alias filters) is
+   evaluated host-side per segment (the same SegmentExecutor the host path
+   uses), flattened to a [S, n_flat] mask, ANDed with the bundle's valid
+   mask, and the SAME device program runs — pre-filter semantics identical
+   to the host (executor.shard_knn_selection:118). Because the host path
+   falls back to an exact scan whenever a filter is present, ANN-indexed
+   segments are also eligible when filtered.
+ - SINGLE-SHARD: s == 1 runs the same program on a 1-device mesh (the
+   all_gather degenerates); the streaming executor path is bypassed in
+   favor of the resident bundle.
+ - BATCHED multi-query: try_distributed_knn_batch dispatches B query
+   vectors in ONE program launch ([B, d] padded to a power of two), which
+   is what amortizes the ~65 ms tunnel round-trip (bench.py's own
+   insight); facade.msearch groups eligible consecutive knn searches into
+   one such dispatch.
 """
 
 from __future__ import annotations
@@ -36,7 +55,13 @@ from opensearch_tpu.parallel.mesh import DATA_AXIS
 from opensearch_tpu.search.executor import ShardHit, ShardQueryResult
 
 # observability: tests and the multichip dryrun assert the serving path ran
-stats = {"distributed_searches": 0, "fallbacks": 0}
+stats = {
+    "distributed_searches": 0,
+    "fallbacks": 0,
+    "filtered": 0,          # dispatches that carried a filter mask
+    "single_shard": 0,      # dispatches with s == 1
+    "batched_queries": 0,   # total query vectors sent in B>1 dispatches
+}
 
 # kill switch (tests compare against the host merge; ops can disable)
 enabled = True
@@ -82,10 +107,14 @@ def _largest_divisor_at_most(s: int, cap: int) -> int:
     return 1
 
 
-def _can_serve(snaps: list, field: str) -> tuple[str, int] | None:
+def _can_serve(snaps: list, field: str, *,
+               filtered: bool = False) -> tuple[str, int] | None:
     """Returns (similarity, dims) if every shard can be served exactly,
-    else None. ANN-indexed segments fall back: the host path would answer
-    them with IVF-PQ, and this path must stay bit-identical to the host."""
+    else None. ANN-indexed segments fall back on UNFILTERED queries: the
+    host path would answer those with IVF-PQ, and this path must stay
+    bit-identical to the host. With a filter, the host path itself runs an
+    exact scan (executor.shard_knn_selection gates ANN on filter is None),
+    so ANN segments are eligible here too."""
     from opensearch_tpu.ops.knn import canonical_similarity
 
     similarity = None
@@ -97,7 +126,7 @@ def _can_serve(snaps: list, field: str) -> tuple[str, int] | None:
             if vf is None:
                 continue
             any_field = True
-            if vf.ann is not None:
+            if vf.ann is not None and not filtered:
                 return None
             sim = canonical_similarity(vf.similarity)
             if similarity is None:
@@ -179,27 +208,85 @@ def _build_bundle(snaps: list, field: str, dims: int, mesh: Mesh) -> _IndexBundl
     )
 
 
+def _filter_valid_mask(
+    shards: list,
+    snaps: list,
+    knn_filter,
+    alias_filters: list | None,
+    n_flat: int,
+) -> np.ndarray:
+    """[S, n_flat] bool: per-query-eligible docs under the knn-level filter
+    and each shard's alias filter, laid out exactly like the bundle slabs
+    (segment-ascending, doc-ascending, zero-padded). Runs the SAME
+    SegmentExecutor the host path uses for the filter
+    (executor.shard_knn_selection), so pre-filter semantics match."""
+    from opensearch_tpu.search.executor import SegmentExecutor, ShardContext
+
+    out = np.zeros((len(snaps), n_flat), bool)
+    for si, (shard, snap) in enumerate(zip(shards, snaps)):
+        fnodes = [f for f in (
+            knn_filter, alias_filters[si] if alias_filters else None
+        ) if f is not None]
+        ctx = ShardContext(snap, shard.mapper_service)
+        pos = 0
+        for host, dev in snap.segments:
+            n = host.n_docs
+            m = np.ones(n, bool)
+            for fnode in fnodes:
+                ex = SegmentExecutor(ctx, host, dev)
+                m &= np.asarray(ex.execute(fnode).mask)[:n]
+            out[si, pos:pos + n] = m
+            pos += n
+    return out
+
+
 def try_distributed_knn(
     shards: list,
     snaps: list,
     node,
     fetch_k: int,
+    alias_filters: list | None = None,
 ) -> list[ShardQueryResult] | None:
-    """Execute a multi-shard KnnQuery through the on-device merge program.
-    Returns per-shard ShardQueryResults shaped exactly like the host path's
-    (winning hits attributed to their shards, per-shard matched counts), or
+    """Execute one KnnQuery through the on-device merge program. Returns
+    per-shard ShardQueryResults shaped exactly like the host path's, or
     None when this path cannot reproduce the host result."""
-    if node.filter is not None or not shards or len(shards) != len(snaps):
+    batched = try_distributed_knn_batch(
+        shards, snaps, [node], fetch_k, alias_filters=alias_filters
+    )
+    return None if batched is None else batched[0]
+
+
+def try_distributed_knn_batch(
+    shards: list,
+    snaps: list,
+    nodes: list,
+    fetch_k: int,
+    alias_filters: list | None = None,
+) -> list[list[ShardQueryResult]] | None:
+    """Execute B KnnQuery nodes (same field/k/filter) in ONE device
+    dispatch. Returns, per query, per-shard ShardQueryResults (winning hits
+    attributed to their shards, per-shard matched counts), or None when
+    this path cannot reproduce the host result."""
+    if not shards or len(shards) != len(snaps) or not nodes:
         return None
     s = len(shards)
-    if s < 2:
-        return None
-    served = _can_serve(snaps, node.field)
+    first = nodes[0]
+    # batch members must share the device program and the filter mask;
+    # filters are compared by identity (msearch groups by equal body JSON,
+    # the single-query path always has B == 1)
+    for node in nodes:
+        if (node.field != first.field or int(node.k) != int(first.k)
+                or node.filter is not first.filter):
+            return None
+    has_filter = first.filter is not None or (
+        alias_filters is not None and any(f is not None for f in alias_filters)
+    )
+    served = _can_serve(snaps, first.field, filtered=has_filter)
     if served is None:
         stats["fallbacks"] += 1
         return None
     similarity, dims = served
-    if len(node.vector) != dims:
+    if any(len(node.vector) != dims for node in nodes):
         return None
 
     n_devices = _largest_divisor_at_most(s, len(jax.devices()))
@@ -207,7 +294,7 @@ def try_distributed_knn(
 
     index_name = shards[0].shard_id.index
     cache_key = (
-        index_name, node.field, s,
+        index_name, first.field, s,
         # engine instance ids make the key immune to delete+recreate cycles
         # (generations restart at 0 on a fresh engine)
         tuple(sh.engine.instance_id for sh in shards),
@@ -221,12 +308,32 @@ def try_distributed_knn(
             del _BUNDLE_CACHE[key]
         while len(_BUNDLE_CACHE) >= _MAX_BUNDLES:
             del _BUNDLE_CACHE[next(iter(_BUNDLE_CACHE))]
-        bundle = _build_bundle(snaps, node.field, dims, mesh)
+        bundle = _build_bundle(snaps, first.field, dims, mesh)
         _BUNDLE_CACHE[cache_key] = bundle
 
-    k_shard = max(1, min(int(node.k), bundle.n_flat))
+    valid = bundle.valid
+    if has_filter:
+        fmask = _filter_valid_mask(
+            shards, snaps, first.filter, alias_filters, bundle.n_flat
+        )
+        valid = valid & jax.device_put(
+            jnp.asarray(fmask), NamedSharding(mesh, P(DATA_AXIS))
+        )
+
+    b = len(nodes)
+    # pad B to a power of two: B is a static shape under jit, so raw batch
+    # sizes would compile one program per msearch width (query-shape cache,
+    # SURVEY.md §7 hard part #3); padding queries are zero vectors whose
+    # results are sliced off
+    b_pad = 1 << (b - 1).bit_length()
+    q_host = np.zeros((b_pad, dims), np.float32)
+    for i, node in enumerate(nodes):
+        q_host[i] = np.asarray(node.vector, np.float32)
+
+    k_shard = max(1, min(int(first.k), bundle.n_flat))
     k_final = min(max(k_shard, int(fetch_k)), s * k_shard)
-    prog_key = (n_devices, s, bundle.n_flat, dims, k_shard, k_final, similarity)
+    prog_key = (n_devices, s, bundle.n_flat, dims, k_shard, k_final,
+                similarity, b_pad)
     program = _PROGRAM_CACHE.get(prog_key)
     if program is None:
         program = build_knn_serving_step(
@@ -234,36 +341,44 @@ def try_distributed_knn(
         )
         _PROGRAM_CACHE[prog_key] = program
 
-    queries = jnp.asarray([node.vector], jnp.float32)
+    queries = jnp.asarray(q_host)
     with mesh:
         vals, gids, counts = program(
-            bundle.vectors, bundle.norms_sq, bundle.valid, queries
+            bundle.vectors, bundle.norms_sq, valid, queries
         )
-    vals = np.asarray(vals)[0]
-    gids = np.asarray(gids)[0]
-    counts = np.asarray(counts)[:, 0]
+    vals = np.asarray(vals)[:b]          # [b, k_final]
+    gids = np.asarray(gids)[:b]
+    counts = np.asarray(counts)[:, :b]   # [s, b]
     stats["distributed_searches"] += 1
+    if has_filter:
+        stats["filtered"] += 1
+    if s == 1:
+        stats["single_shard"] += 1
+    if b > 1:
+        stats["batched_queries"] += b
 
-    boost = np.float32(getattr(node, "boost", 1.0))
-    per_shard_hits: list[list[ShardHit]] = [[] for _ in range(s)]
-    for v, g in zip(vals, gids):
-        if not np.isfinite(v):
-            continue
-        shard_idx, flat = int(g) // bundle.n_flat, int(g) % bundle.n_flat
-        seg_idx, doc = bundle.locate(shard_idx, flat)
-        per_shard_hits[shard_idx].append(
-            ShardHit(float(np.float32(v) * boost), seg_idx, doc)
-        )
-
-    results = []
-    for shard_idx in range(s):
-        hits = per_shard_hits[shard_idx]
-        results.append(ShardQueryResult(
-            hits=hits,
-            total=int(counts[shard_idx]),
-            max_score=max((h.score for h in hits), default=None),
-        ))
-    return results
+    out: list[list[ShardQueryResult]] = []
+    for qi, node in enumerate(nodes):
+        boost = np.float32(getattr(node, "boost", 1.0))
+        per_shard_hits: list[list[ShardHit]] = [[] for _ in range(s)]
+        for v, g in zip(vals[qi], gids[qi]):
+            if not np.isfinite(v):
+                continue
+            shard_idx, flat = int(g) // bundle.n_flat, int(g) % bundle.n_flat
+            seg_idx, doc = bundle.locate(shard_idx, flat)
+            per_shard_hits[shard_idx].append(
+                ShardHit(float(np.float32(v) * boost), seg_idx, doc)
+            )
+        results = []
+        for shard_idx in range(s):
+            hits = per_shard_hits[shard_idx]
+            results.append(ShardQueryResult(
+                hits=hits,
+                total=int(counts[shard_idx, qi]),
+                max_score=max((h.score for h in hits), default=None),
+            ))
+        out.append(results)
+    return out
 
 
 def clear_caches() -> None:

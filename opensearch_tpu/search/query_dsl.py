@@ -435,7 +435,14 @@ def parse_query(body: dict | None) -> QueryNode:
     qtype, qbody = next(iter(body.items()))
     parser = _PARSERS.get(qtype)
     if parser is None:
-        raise ParsingException(f"unknown query [{qtype}]")
+        # same did-you-mean hint as the reference's
+        # AbstractQueryBuilder.parseInnerQueryBuilder
+        import difflib
+
+        close = difflib.get_close_matches(qtype, list(_PARSERS), n=1,
+                                          cutoff=0.7)
+        hint = f" did you mean [{close[0]}]?" if close else ""
+        raise ParsingException(f"unknown query [{qtype}]{hint}")
     # `_name` may sit at the query-body level ({"bool": {..., "_name": x}})
     # or inside the single-field conf ({"term": {"f": {.., "_name": x}}})
     qname = None
@@ -470,26 +477,35 @@ def _parse_match_none(_body: dict) -> QueryNode:
     return MatchNoneQuery()
 
 
+def _query_text(v: Any) -> str:
+    """JSON-canonical text for a match value: booleans render as the JSON
+    literals (the reference coerces via XContent text, so `true`, not
+    Python's `True` — a boolean-field match must round-trip)."""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
 def _parse_match(body: dict) -> QueryNode:
     fname, conf = _single_kv(body, "match")
     if isinstance(conf, dict):
         return MatchQuery(
             field=fname,
-            query=str(conf.get("query", "")),
+            query=_query_text(conf.get("query", "")),
             operator=str(conf.get("operator", "or")).lower(),
             minimum_should_match=_parse_msm(conf.get("minimum_should_match")),
             boost=float(conf.get("boost", 1.0)),
         )
-    return MatchQuery(field=fname, query=str(conf))
+    return MatchQuery(field=fname, query=_query_text(conf))
 
 
 def _parse_match_phrase(body: dict) -> QueryNode:
     fname, conf = _single_kv(body, "match_phrase")
     if isinstance(conf, dict):
-        return MatchPhraseQuery(field=fname, query=str(conf.get("query", "")),
+        return MatchPhraseQuery(field=fname, query=_query_text(conf.get("query", "")),
                                 slop=int(conf.get("slop", 0)),
                                 boost=float(conf.get("boost", 1.0)))
-    return MatchPhraseQuery(field=fname, query=str(conf))
+    return MatchPhraseQuery(field=fname, query=_query_text(conf))
 
 
 def _parse_span_source(qtype: str, body: Any) -> tuple[str, Any]:
@@ -628,7 +644,7 @@ def _parse_combined_fields(body: dict) -> QueryNode:
             field_boosts[name] = float(sfx)
     return MultiMatchQuery(
         fields=[f.split("^")[0] for f in raw_fields],
-        query=str(body["query"]),
+        query=_query_text(body["query"]),
         type="most_fields",
         field_boosts=field_boosts,
         operator=str(body.get("operator", "or")).lower(),
@@ -670,7 +686,7 @@ def _parse_multi_match(body: dict) -> QueryNode:
             ) from None
     return MultiMatchQuery(
         fields=[f.split("^")[0] for f in raw_fields],
-        query=str(body.get("query", "")),
+        query=_query_text(body.get("query", "")),
         type=mm_type,
         field_boosts=field_boosts,
         operator=str(body.get("operator", "or")).lower(),
@@ -932,25 +948,25 @@ def _parse_match_phrase_prefix(body: dict) -> QueryNode:
     fname, conf = _single_kv(body, "match_phrase_prefix")
     if isinstance(conf, dict):
         return MatchPhrasePrefixQuery(
-            field=fname, query=str(conf.get("query", "")),
+            field=fname, query=_query_text(conf.get("query", "")),
             max_expansions=int(conf.get("max_expansions", 50)),
             boost=float(conf.get("boost", 1.0)),
         )
-    return MatchPhrasePrefixQuery(field=fname, query=str(conf))
+    return MatchPhrasePrefixQuery(field=fname, query=_query_text(conf))
 
 
 def _parse_match_bool_prefix(body: dict) -> QueryNode:
     fname, conf = _single_kv(body, "match_bool_prefix")
     if isinstance(conf, dict):
         return MatchBoolPrefixQuery(
-            field=fname, query=str(conf.get("query", "")),
+            field=fname, query=_query_text(conf.get("query", "")),
             operator=str(conf.get("operator", "or")).lower(),
             minimum_should_match=conf.get("minimum_should_match"),
             fuzziness=conf.get("fuzziness"),
             analyzer=conf.get("analyzer"),
             boost=float(conf.get("boost", 1.0)),
         )
-    return MatchBoolPrefixQuery(field=fname, query=str(conf))
+    return MatchBoolPrefixQuery(field=fname, query=_query_text(conf))
 
 
 def _parse_query_string(body: dict) -> QueryNode:
@@ -958,7 +974,7 @@ def _parse_query_string(body: dict) -> QueryNode:
     if body.get("default_field"):
         fields = [str(body["default_field"]).split("^")[0]]
     return QueryStringQuery(
-        query=str(body.get("query", "")),
+        query=_query_text(body.get("query", "")),
         fields=fields,
         default_operator=str(body.get("default_operator", "or")).lower(),
         boost=float(body.get("boost", 1.0)),
@@ -967,7 +983,7 @@ def _parse_query_string(body: dict) -> QueryNode:
 
 def _parse_simple_query_string(body: dict) -> QueryNode:
     return SimpleQueryStringQuery(
-        query=str(body.get("query", "")),
+        query=_query_text(body.get("query", "")),
         fields=[f.split("^")[0] for f in body.get("fields", [])],
         default_operator=str(body.get("default_operator", "or")).lower(),
         boost=float(body.get("boost", 1.0)),

@@ -70,6 +70,7 @@ def search(
     partial: bool = False,
     shard_numbers: list[int] | None = None,
     index_boosts: dict | None = None,
+    precomputed_results: list | None = None,
 ) -> dict[str, Any]:
     """Run one search over `shards`. `acquired` optionally pins the searcher
     snapshots to use, one per shard in order — the scroll/PIT path
@@ -204,12 +205,18 @@ def search(
             for (shard, snap), res in zip(shard_snaps, fused)
         ]
     else:
-        per_shard_results = _try_distributed_query_phase(
-            shards, acquired, node,
-            sort=sort, search_after=search_after, aggs_body=aggs_body,
-            min_score=min_score, filter_nodes=filter_nodes,
-            want_profile=want_profile, fetch_k=fetch_k, task=task,
-        )
+        # a batched msearch dispatch may have already run the query phase
+        # for this body (one device launch for B queries — see
+        # try_batched_knn_msearch); inject its per-shard results and skip
+        # straight to reduce/fetch
+        per_shard_results = precomputed_results
+        if per_shard_results is None:
+            per_shard_results = _try_distributed_query_phase(
+                shards, acquired, node,
+                sort=sort, search_after=search_after, aggs_body=aggs_body,
+                min_score=min_score, filter_nodes=filter_nodes,
+                want_profile=want_profile, fetch_k=fetch_k, task=task,
+            )
         if per_shard_results is None:
             per_shard_results = []
             for shard_i, shard in enumerate(shards):
@@ -868,15 +875,16 @@ def _try_distributed_query_phase(
     fetch_k: int,
     task=None,
 ) -> list | None:
-    """Route eligible multi-shard knn queries through the on-device
-    all_gather + top_k merge (parallel/distributed.build_knn_serving_step).
-    Returns the per-shard results list shaped exactly like the host path's,
-    or None when the host merge must run (every other query shape)."""
+    """Route eligible knn queries (multi- OR single-shard, filtered or
+    not) through the on-device all_gather + top_k merge
+    (parallel/distributed.build_knn_serving_step). Returns the per-shard
+    results list shaped exactly like the host path's, or None when the
+    host merge must run (every other query shape)."""
     if not isinstance(node, query_dsl.KnnQuery):
         return None
-    if (len(shards) < 2 or sort or search_after is not None
+    if (not shards or sort or search_after is not None
             or aggs_body is not None or min_score is not None
-            or want_profile or any(f is not None for f in filter_nodes)):
+            or want_profile):
         return None
     from opensearch_tpu.search import distributed_serving
 
@@ -890,13 +898,106 @@ def _try_distributed_query_phase(
         else [s.acquire_searcher() for s in shards]
     )
     results = distributed_serving.try_distributed_knn(
-        shards, snaps, node, fetch_k
+        shards, snaps, node, fetch_k, alias_filters=filter_nodes
     )
     if results is None:
         return None
     return [
         (shard, snap, res)
         for shard, snap, res in zip(shards, snaps, results)
+    ]
+
+
+_BATCHABLE_KNN_KEYS = {
+    "query", "size", "from", "track_total_hits", "_source",
+    "version", "seq_no_primary_term",
+}
+
+
+def msearch_knn_batchable(body) -> bool:
+    """Cheap structural test for msearch batch grouping: a bare top-level
+    knn query with only paging/source keys. The deep validation (same
+    field/k, no filter, parseable) runs in try_batched_knn_msearch."""
+    if not isinstance(body, dict):
+        return False
+    if set(body) - _BATCHABLE_KNN_KEYS:
+        return False
+    query = body.get("query")
+    return isinstance(query, dict) and set(query) == {"knn"}
+
+
+def msearch_groups(searches: list) -> list[list[int]]:
+    """Partition msearch positions into runs: consecutive batchable-knn
+    sub-searches against the same index group together (one device
+    dispatch); everything else is a singleton run. Shared by
+    TpuNode.msearch and ClusterFacade.msearch so the grouping rule cannot
+    diverge between deployment modes."""
+    groups: list[list[int]] = []
+    i = 0
+    while i < len(searches):
+        header, body = searches[i]
+        index = header.get("index")
+        group = [i]
+        if index is not None and msearch_knn_batchable(body):
+            j = i + 1
+            while (j < len(searches)
+                   and searches[j][0].get("index") == index
+                   and msearch_knn_batchable(searches[j][1])):
+                group.append(j)
+                j += 1
+        groups.append(group)
+        i = group[-1] + 1
+    return groups
+
+
+def try_batched_knn_msearch(
+    shards: list,
+    bodies: list[dict],
+    acquired: list,
+) -> list[list] | None:
+    """Query-phase fast path for an msearch whose sub-searches are all bare
+    knn queries on one index: ONE device dispatch scores all B query
+    vectors (distributed_serving.try_distributed_knn_batch) instead of B
+    sequential launches — the tunnel-round-trip amortization bench.py
+    measures, applied to the serving path. Returns, per body, the
+    per-shard-results list `search()` accepts via `precomputed_results`,
+    or None when any body is not batchable (caller runs them serially,
+    each still eligible for the single-query device path)."""
+    if len(bodies) < 2 or not shards:
+        return None
+    from opensearch_tpu.search import distributed_serving
+
+    if not distributed_serving.enabled:
+        return None
+    nodes = []
+    fetch_k = 0
+    for body in bodies:
+        if not isinstance(body, dict) or set(body) - _BATCHABLE_KNN_KEYS:
+            return None
+        try:
+            node = query_dsl.parse_query(body.get("query"))
+        except Exception:  # noqa: BLE001 - bad body -> serial path reports it
+            return None
+        if not isinstance(node, query_dsl.KnnQuery) or node.filter is not None:
+            return None
+        nodes.append(node)
+        fetch_k = max(
+            fetch_k,
+            int(body.get("from", 0)) + int(body.get("size", DEFAULT_SIZE)),
+        )
+    first = nodes[0]
+    if any(n.field != first.field or int(n.k) != int(first.k)
+           for n in nodes[1:]):
+        return None
+    batched = distributed_serving.try_distributed_knn_batch(
+        shards, acquired, nodes, fetch_k
+    )
+    if batched is None:
+        return None
+    return [
+        [(shard, snap, res)
+         for shard, snap, res in zip(shards, acquired, per_shard)]
+        for per_shard in batched
     ]
 
 

@@ -249,6 +249,17 @@ class YamlTestRunner:
             return
         if kind == "match":
             (path, expected), = payload.items()
+            if expected is None:
+                # match: {key: null} passes when the key is null OR absent
+                # (the reference runner's assertNull)
+                try:
+                    actual = lookup(self.last_response, path, stash)
+                except StepFailure:
+                    return
+                if actual is None:
+                    return
+                raise StepFailure(f"match {path}: expected null "
+                                  f"got {actual!r}")
             actual = lookup(self.last_response, path, stash)
             expected = stash.resolve(expected)
             if not _match(expected, actual):

@@ -38,6 +38,32 @@ class QueryGroupService:
         if self._file.exists():
             self.groups = json.loads(self._file.read_text())
         self._in_flight: dict[str, int] = {}
+        # lifetime counters per group (WlmStats.WorkloadGroupStats);
+        # untagged requests account to the default group like the reference
+        self._totals: dict[str, dict[str, int]] = {}
+
+    DEFAULT_GROUP = "DEFAULT_WORKLOAD_GROUP"
+
+    def _tally(self, gid: str | None, key: str) -> None:
+        with self._lock:
+            t = self._totals.setdefault(gid or self.DEFAULT_GROUP, {
+                "total_completions": 0, "total_rejections": 0,
+                "total_cancellations": 0,
+            })
+            t[key] += 1
+
+    def totals(self) -> dict[str, dict[str, int]]:
+        """Per-group lifetime counters; always includes the default group
+        and every registered group."""
+        with self._lock:
+            out: dict[str, dict[str, int]] = {}
+            zero = {"total_completions": 0, "total_rejections": 0,
+                    "total_cancellations": 0}
+            for gid in [self.DEFAULT_GROUP, *self.groups]:
+                out[gid] = dict(self._totals.get(gid, zero))
+            for gid, t in self._totals.items():
+                out.setdefault(gid, dict(t))
+            return out
 
     def _save(self) -> None:
         self._file.parent.mkdir(parents=True, exist_ok=True)
@@ -134,6 +160,11 @@ class QueryGroupService:
                 )
                 permits = max(1, int(TOTAL_SEARCH_PERMITS * cpu_share))
                 if self._in_flight.get(gid, 0) >= permits:
+                    t = self._totals.setdefault(gid, {
+                        "total_completions": 0, "total_rejections": 0,
+                        "total_cancellations": 0,
+                    })
+                    t["total_rejections"] += 1
                     raise RejectedExecutionException(
                         f"query group [{group['name']}] is at its cpu "
                         f"limit: {permits} concurrent searches"
@@ -171,3 +202,11 @@ class _Admission:
 
     def __exit__(self, *exc: Any) -> None:
         self.service._leave(self._gid)
+        if not exc or exc[0] is None:
+            self.service._tally(self._gid, "total_completions")
+        else:
+            from opensearch_tpu.common.errors import TaskCancelledException
+
+            if isinstance(exc[1], TaskCancelledException):
+                self.service._tally(self._gid, "total_cancellations")
+            # other failures count as neither completion nor cancellation

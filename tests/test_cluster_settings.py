@@ -133,11 +133,11 @@ def test_cluster_settings_api_and_dynamic_apply(tmp_path):
         async def settings_replicated():
             for _ in range(100):
                 s, r = await http(cluster.http_ports["n2"], "GET",
-                                  "/_cluster/settings")
+                                  "/_cluster/settings?flat_settings=true")
                 if (s == 200 and r["persistent"].get(
                         "cluster.routing.allocation.disk.watermark.low")
                         == "70%" and r["transient"].get(
-                        "search.max_buckets") == 1000):
+                        "search.max_buckets") == "1000"):
                     return True
                 await asyncio.sleep(0.1)
             return False
@@ -150,7 +150,8 @@ def test_cluster_settings_api_and_dynamic_apply(tmp_path):
         })
         assert status == 200
         for _ in range(100):
-            s, r = await http(p0, "GET", "/_cluster/settings")
+            s, r = await http(p0, "GET",
+                              "/_cluster/settings?flat_settings=true")
             if "search.max_buckets" not in r["transient"]:
                 break
             await asyncio.sleep(0.1)
@@ -191,10 +192,11 @@ def test_persistent_survives_restart_transient_does_not(tmp_path):
         await cluster.start()
         await cluster.wait_leader()
         p0 = cluster.http_ports["n1"]
-        status, r = await http(p0, "GET", "/_cluster/settings")
+        status, r = await http(p0, "GET",
+                               "/_cluster/settings?flat_settings=true")
         assert status == 200
         assert r["persistent"].get(
-            "cluster.routing.allocation.node_concurrent_recoveries") == 7
+            "cluster.routing.allocation.node_concurrent_recoveries") == "7"
         assert r["transient"] == {}        # dropped at restart
         await cluster.stop()
 

@@ -19,7 +19,8 @@ from opensearch_tpu.search import distributed_serving
 @pytest.fixture(autouse=True)
 def _clear():
     distributed_serving.clear_caches()
-    distributed_serving.stats["distributed_searches"] = 0
+    for key in distributed_serving.stats:
+        distributed_serving.stats[key] = 0
     distributed_serving.enabled = True
     yield
     distributed_serving.enabled = True
@@ -144,29 +145,120 @@ def test_unrefreshed_delete_matches_host_semantics(tmp_path):
 
 
 def test_fallback_shapes_keep_host_path(tmp_path):
-    """Filters, single shard, aggs, sort — all must use the host merge."""
+    """Aggs, sort, non-knn — shapes the device merge cannot reproduce must
+    use the host path. (Filters and single-shard, formerly on this list,
+    now take the device path — see the dedicated tests below.)"""
     node = _mk_node(tmp_path)
     q = [0.5] * 8
     before = distributed_serving.stats["distributed_searches"]
 
-    # filter -> fallback
-    node.search("vecs", {"query": {"knn": {"v": {
-        "vector": q, "k": 5, "filter": {"range": {"n": {"lt": 50}}},
-    }}}})
     # aggs -> fallback
     node.search("vecs", {
         **_knn_body(q, 5), "aggs": {"m": {"max": {"field": "n"}}},
     })
+    # sort -> fallback
+    node.search("vecs", {**_knn_body(q, 5), "sort": [{"n": "asc"}]})
     # non-knn -> fallback
     node.search("vecs", {"query": {"match_all": {}}})
     assert distributed_serving.stats["distributed_searches"] == before
 
-    # and the filter query still answers correctly through the host path
-    resp = node.search("vecs", {"query": {"knn": {"v": {
-        "vector": q, "k": 5, "filter": {"range": {"n": {"lt": 10}}},
-    }}}, "size": 10})
-    for h in resp["hits"]["hits"]:
-        assert h["_source"]["n"] < 10
+
+def test_filtered_knn_takes_device_path(tmp_path):
+    """A knn query WITH a filter must run the device merge (the filter mask
+    folds into the program's valid mask) and match the host path exactly —
+    including the pre-filter semantics (filter restricts candidates BEFORE
+    top-k, not after)."""
+    node = _mk_node(tmp_path)
+    q = [0.5] * 8
+    body = {"query": {"knn": {"v": {
+        "vector": q, "k": 5, "filter": {"range": {"n": {"lt": 30}}},
+    }}}, "size": 20}
+
+    before_d = distributed_serving.stats["distributed_searches"]
+    before_f = distributed_serving.stats["filtered"]
+    dist = node.search("vecs", body)
+    assert distributed_serving.stats["distributed_searches"] == before_d + 1
+    assert distributed_serving.stats["filtered"] == before_f + 1
+
+    distributed_serving.enabled = False
+    host = node.search("vecs", body)
+    distributed_serving.enabled = True
+
+    assert [h["_id"] for h in dist["hits"]["hits"]] == \
+           [h["_id"] for h in host["hits"]["hits"]]
+    assert np.allclose(
+        [h["_score"] for h in dist["hits"]["hits"]],
+        [h["_score"] for h in host["hits"]["hits"]], rtol=1e-6, atol=0)
+    for h in dist["hits"]["hits"]:
+        assert h["_source"]["n"] < 30
+    # pre-filter: with k=5 over 4 shards, ≤ 20 filtered candidates total
+    assert dist["hits"]["total"]["value"] <= 4 * 5
+
+
+def test_single_shard_knn_takes_device_path(tmp_path):
+    """s == 1 runs the same program on a 1-device mesh."""
+    node = _mk_node(tmp_path, n_shards=1, n_docs=30)
+    q = [0.2] * 8
+    body = _knn_body(q, k=7, size=7)
+    before_d = distributed_serving.stats["distributed_searches"]
+    before_s = distributed_serving.stats["single_shard"]
+    dist = node.search("vecs", body)
+    assert distributed_serving.stats["distributed_searches"] == before_d + 1
+    assert distributed_serving.stats["single_shard"] == before_s + 1
+
+    distributed_serving.enabled = False
+    host = node.search("vecs", body)
+    distributed_serving.enabled = True
+    assert [h["_id"] for h in dist["hits"]["hits"]] == \
+           [h["_id"] for h in host["hits"]["hits"]]
+    assert np.allclose(
+        [h["_score"] for h in dist["hits"]["hits"]],
+        [h["_score"] for h in host["hits"]["hits"]], rtol=1e-6, atol=0)
+
+
+def test_msearch_batches_knn_queries(tmp_path):
+    """Consecutive bare-knn msearch bodies against one index execute as ONE
+    batched device dispatch (B query vectors in one program launch) and
+    each response matches its serial equivalent."""
+    node = _mk_node(tmp_path, n_docs=60)
+    rng = np.random.default_rng(7)
+    qs = [rng.standard_normal(8).round(3).tolist() for _ in range(3)]
+    searches = [({"index": "vecs"}, _knn_body(q, k=5, size=5)) for q in qs]
+
+    before_d = distributed_serving.stats["distributed_searches"]
+    before_b = distributed_serving.stats["batched_queries"]
+    batched = node.msearch(searches)
+    assert distributed_serving.stats["distributed_searches"] == before_d + 1, \
+        "3 knn bodies must share ONE device dispatch"
+    assert distributed_serving.stats["batched_queries"] == before_b + 3
+
+    serial = [node.search("vecs", _knn_body(q, k=5, size=5)) for q in qs]
+    for got, want in zip(batched["responses"], serial):
+        assert [h["_id"] for h in got["hits"]["hits"]] == \
+               [h["_id"] for h in want["hits"]["hits"]]
+        assert got["hits"]["total"] == want["hits"]["total"]
+        assert np.allclose(
+            [h["_score"] for h in got["hits"]["hits"]],
+            [h["_score"] for h in want["hits"]["hits"]], rtol=1e-6, atol=0)
+
+
+def test_msearch_mixed_bodies_still_correct(tmp_path):
+    """A batchable run followed by non-batchable bodies: every response
+    slot must land in order with correct content."""
+    node = _mk_node(tmp_path, n_docs=40)
+    q1, q2 = [0.1] * 8, [0.9] * 8
+    searches = [
+        ({"index": "vecs"}, _knn_body(q1, k=3, size=3)),
+        ({"index": "vecs"}, _knn_body(q2, k=3, size=3)),
+        ({"index": "vecs"}, {"query": {"match_all": {}}, "size": 1}),
+        ({"index": "missing_idx"}, {"query": {"match_all": {}}}),
+    ]
+    resp = node.msearch(searches)
+    assert len(resp["responses"]) == 4
+    assert resp["responses"][0]["hits"]["hits"]
+    assert resp["responses"][1]["hits"]["hits"]
+    assert resp["responses"][2]["hits"]["total"]["value"] == 40
+    assert "error" in resp["responses"][3]
 
 
 def test_totals_and_paging(tmp_path):
