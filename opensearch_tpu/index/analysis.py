@@ -316,6 +316,52 @@ class Analyzer:
             tokens = f(tokens)
         return tokens
 
+    def analyze_with_offsets(self, text: str) -> list[tuple]:
+        """[(term, start_offset, end_offset, position)] — character spans
+        from the tokenizer, positions counted pre-filter so dropped tokens
+        (stopwords) leave position gaps like Lucene's posInc. Tokenizers
+        without span support fall back to zero offsets.
+
+        The offset stream RECONCILES against analyze(text) (the indexing
+        pipeline): per-token filter application cannot see stream state
+        (e.g. the `unique` filter's seen-set), so any token the full-stream
+        pass drops is dropped here too — term_freq from this path always
+        agrees with the indexed postings."""
+        span_fn = _SPAN_TOKENIZERS.get(self.tokenizer)
+        if span_fn is None:
+            return [(t, 0, 0, i) for i, t in enumerate(self.analyze(text))]
+        per_tok = []
+        for pos, (tok, s, e) in enumerate(span_fn(text)):
+            cur = [tok]
+            for f in self.filters:
+                cur = f(cur)
+                if not cur:
+                    break
+            if cur:
+                per_tok.append((cur[0], s, e, pos))
+        expected = self.analyze(text)
+        out = []
+        j = 0
+        for term, s, e, pos in per_tok:
+            if j < len(expected) and expected[j] == term:
+                out.append((term, s, e, pos))
+                j += 1
+        return out
+
+
+def _spans(regex: "re.Pattern") -> Callable[[str], list[tuple]]:
+    return lambda text: [(m.group(), m.start(), m.end())
+                         for m in regex.finditer(text)]
+
+
+_WS_RE = re.compile(r"\S+")
+_SPAN_TOKENIZERS: dict[Callable, Callable[[str], list[tuple]]] = {
+    standard_tokenizer: _spans(_STANDARD_RE),
+    letter_tokenizer: _spans(_LETTER_RE),
+    whitespace_tokenizer: _spans(_WS_RE),
+    keyword_tokenizer: lambda text: [(text, 0, len(text))] if text else [],
+}
+
 
 def _builtin_analyzers() -> dict[str, Analyzer]:
     return {

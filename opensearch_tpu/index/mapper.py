@@ -33,10 +33,91 @@ from opensearch_tpu.index.analysis import AnalysisRegistry, Analyzer
 INT_TYPES = {"long", "integer", "short", "byte"}
 FLOAT_TYPES = {"double", "float", "half_float"}
 NUMERIC_TYPES = INT_TYPES | FLOAT_TYPES
-# range families: accepted in mappings; values live in _source (range
-# queries with relations are a search-side TODO)
+# range families (RangeFieldMapper.java): each value is an interval stored
+# as TWO synthetic numeric columns `field#lo` / `field#hi`; range queries
+# evaluate intersects/contains/within against the pair
 RANGE_TYPES = {"integer_range", "long_range", "float_range", "double_range",
                "date_range", "ip_range"}
+
+# discrete domains step whole units on gt/lt; floats step one ulp
+_RANGE_DISCRETE = {"integer_range", "long_range", "date_range", "ip_range"}
+
+
+def _ip_ord(value: str) -> int:
+    """Total order over IP addresses in int64. IPv4 maps raw (< 2^32);
+    IPv6 folds its top bits above a 2^62 flag — coarse within v6 (bottom
+    66 bits dropped) but order-preserving, and all v4 sorts below all v6."""
+    import ipaddress
+
+    ip = ipaddress.ip_address(str(value))
+    v = int(ip)
+    if ip.version == 6:
+        return (1 << 62) + (v >> 66)
+    return v
+
+
+def range_value_bounds(rtype: str, value: dict,
+                       fmt: str | None = None) -> tuple:
+    """(lo, hi) numeric bounds for one range VALUE or QUERY body with
+    gte/gt/lte/lt keys; missing sides are unbounded. CIDR strings expand
+    for ip_range."""
+    import math
+
+    def one(raw, round_up: bool):
+        if rtype in ("integer_range", "long_range"):
+            return int(raw)
+        if rtype == "date_range":
+            if isinstance(raw, str):
+                # date-math with per-side rounding (DateMathParser: upper
+                # bounds round to the last ms of the unit)
+                from opensearch_tpu.common.timeutil import parse_date_math
+
+                return parse_date_math(raw, round_up=round_up)
+            return int(raw)
+        if rtype == "ip_range":
+            return _ip_ord(raw)
+        return float(raw)
+
+    lo = hi = None
+    if isinstance(value, str):
+        if rtype != "ip_range":
+            raise ValueError(
+                f"[{rtype}] values must be objects with gte/gt/lte/lt")
+        if "/" in value:
+            import ipaddress
+
+            net = ipaddress.ip_network(value, strict=False)
+            return (_ip_ord(net.network_address),
+                    _ip_ord(net.broadcast_address))
+        v = _ip_ord(value)  # single address == one-point range
+        return v, v
+    if value.get("gte") is not None:
+        lo = one(value["gte"], round_up=False)
+    elif value.get("gt") is not None:
+        v = one(value["gt"], round_up=True)
+        lo = v + 1 if rtype in _RANGE_DISCRETE else math.nextafter(
+            v, math.inf)
+    if value.get("lte") is not None:
+        hi = one(value["lte"], round_up=True)
+    elif value.get("lt") is not None:
+        v = one(value["lt"], round_up=False)
+        hi = v - 1 if rtype in _RANGE_DISCRETE else math.nextafter(
+            v, -math.inf)
+    if rtype in _RANGE_DISCRETE:
+        # open sides sit at the true int64 domain edges — above every
+        # IPv6 ordinal and every storable long
+        if lo is None:
+            lo = -(2**63)
+        if hi is None:
+            hi = 2**63 - 1
+    else:
+        if lo is None:
+            lo = -math.inf
+        if hi is None:
+            hi = math.inf
+    return lo, hi
+
+
 
 _INT_RANGES = {
     "long": (-(2**63), 2**63 - 1),
@@ -602,7 +683,8 @@ class MapperService:
                     pf2.numeric = (pf2.numeric or []) + [x]
                 return
             if mapper is not None and mapper.type in RANGE_TYPES:
-                return  # range values live in _source only
+                self._parse_range(mapper, full, value, out)
+                return
             if mapper is not None and mapper.type == "geo_point":
                 self._parse_geo_point(full, value, out)
                 return
@@ -618,14 +700,44 @@ class MapperService:
             self._parse_join(mapper, full, value, out)
         elif mapper.type == "percolator":
             pass  # query stays in _source only
-        elif mapper.type in RANGE_TYPES or mapper.type == "alias":
-            pass  # ranges live in _source; aliases hold no values
+        elif mapper.type in RANGE_TYPES:
+            self._parse_range(mapper, full, value, out)  # e.g. CIDR string
+        elif mapper.type == "alias":
+            pass  # aliases hold no values
         elif mapper.type == "geo_point":
             self._parse_geo_point(full, value, out)
         elif mapper.type == "flat_object":
             self._parse_flat_object(full, value, out)
         else:
             self._parse_value(mapper, full, value, out)
+
+    def _parse_range(self, mapper: FieldMapper, full: str, value: Any,
+                     out: dict[str, ParsedField]) -> None:
+        """Range value ({gte/gt/lte/lt} object, or a CIDR string for
+        ip_range) -> synthetic `{field}#lo` / `{field}#hi` numeric columns
+        (RangeFieldMapper encodes the same interval into BKD dimensions)."""
+        if value is None:
+            return
+        if not isinstance(value, (dict, str)):
+            raise MapperParsingException(
+                f"range field [{full}] requires an object with "
+                f"gte/gt/lte/lt bounds"
+            )
+        try:
+            lo, hi = range_value_bounds(mapper.type, value, mapper.format)
+        except (ValueError, TypeError) as e:
+            raise MapperParsingException(
+                f"failed to parse range field [{full}]: {e}"
+            ) from None
+        kind = "double" if mapper.type in ("float_range", "double_range") \
+            else "long"
+        for suffix, v in (("#lo", lo), ("#hi", hi)):
+            fname = f"{full}{suffix}"
+            self.mappers.setdefault(
+                fname, FieldMapper(fname, kind, synthetic=True)
+            )
+            pf = out.setdefault(fname, ParsedField())
+            pf.numeric = (pf.numeric or []) + [v]
 
     def _parse_join(self, mapper: FieldMapper, full: str, value: Any,
                     out: dict[str, ParsedField]) -> None:

@@ -109,6 +109,19 @@ class HostTextField:
             return 0
         return int(self.term_offsets[tid + 1] - self.term_offsets[tid])
 
+    def total_term_freq(self, term: str) -> int:
+        """Sum of the term's frequencies across all docs (Lucene ttf)."""
+        tid = self.term_dict.get(term)
+        if tid is None:
+            return 0
+        off, end = int(self.term_offsets[tid]), int(self.term_offsets[tid + 1])
+        return int(self.postings_tfs[off:end].sum())
+
+    @property
+    def sum_doc_freq(self) -> int:
+        """Number of (term, doc) postings pairs (Lucene sumDocFreq)."""
+        return int(len(self.postings_docs))
+
     def term_positions(self, term: str, doc: int) -> np.ndarray:
         """Token positions of `term` in local doc `doc` (empty if absent or
         the segment predates position postings)."""

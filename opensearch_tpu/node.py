@@ -1235,42 +1235,139 @@ class TpuNode:
 
     # -- analyze API (TransportAnalyzeAction analog) -----------------------
 
+    @staticmethod
+    def _analyze_stages(tokenizer_fn, filters, texts) -> list[list[dict]]:
+        """Token stream after the tokenizer and after each filter, with
+        character offsets (AnalyzeAction's detail pipeline). Filters apply
+        per token so offsets/positions survive drops (stopwords leave
+        position gaps, like posInc)."""
+        from opensearch_tpu.index.analysis import _SPAN_TOKENIZERS
+
+        stages: list[list[dict]] = [[] for _ in range(len(filters) + 1)]
+        pos_base = 0
+        char_base = 0
+        for t in texts:
+            t = str(t)
+            span_fn = _SPAN_TOKENIZERS.get(tokenizer_fn)
+            raw = (span_fn(t) if span_fn
+                   else [(tok, 0, 0) for tok in tokenizer_fn(t)])
+            text_final: list[dict] = []
+            for pos, (tok, s, e) in enumerate(raw):
+                def entry(term):
+                    return {
+                        "token": term,
+                        "start_offset": char_base + s,
+                        "end_offset": char_base + e,
+                        "type": "<ALPHANUM>",
+                        "position": pos_base + pos,
+                    }
+                stages[0].append(entry(tok))
+                cur = [tok]
+                for fi, f in enumerate(filters):
+                    cur = f(cur)
+                    if not cur:
+                        break
+                    target = (text_final if fi == len(filters) - 1
+                              else stages[fi + 1])
+                    target.append(entry(cur[0]))
+            if not filters:
+                text_final = []
+            # reconcile the FINAL stage against full-stream application so
+            # stream-stateful filters (unique) drop here too
+            toks = [tok for tok, _s, _e in raw]
+            for f in filters:
+                toks = f(toks)
+            j = 0
+            for d in text_final:
+                if j < len(toks) and toks[j] == d["token"]:
+                    stages[-1].append(d)
+                    j += 1
+            pos_base += len(raw) + 100
+            char_base += len(t) + 1
+        return stages
+
     def analyze(self, index: str | None, body: dict) -> dict:
+        from opensearch_tpu.index.analysis import (
+            TOKENIZERS,
+            build_token_filter,
+        )
+
         body = body or {}
         text = body.get("text")
         if text is None:
             raise IllegalArgumentException("[_analyze] requires [text]")
         texts = text if isinstance(text, list) else [text]
+        explain = bool(body.get("explain"))
+        max_tokens = None
+        registry = AnalysisRegistry.from_index_settings(None)
         if index is not None:
             svc = self._get_index(index)
             registry = svc.mapper_service.analysis
+            max_tokens = int(svc.setting("analyze.max_token_count", 10_000))
+
+        custom = (body.get("tokenizer") is not None
+                  or body.get("filter") is not None)
+        if custom:
+            tok_name = body.get("tokenizer", "standard")
+            tokenizer_fn = TOKENIZERS.get(str(tok_name))
+            if tokenizer_fn is None:
+                raise IllegalArgumentException(
+                    f"unknown tokenizer [{tok_name}]")
+            filters = []
+            filter_names = []
+            for f in body.get("filter") or []:
+                if isinstance(f, dict):
+                    ftype = f.get("type")
+                    if ftype is None:
+                        raise IllegalArgumentException(
+                            "token filter entry must have a type")
+                    filters.append(build_token_filter(str(ftype), f))
+                    filter_names.append(f"__anonymous__{ftype}")
+                else:
+                    filters.append(build_token_filter(str(f)))
+                    filter_names.append(str(f))
+            analyzer_name = None
+        else:
             field = body.get("field")
-            if field and not body.get("analyzer"):
-                mapper = svc.mapper_service.field_mapper(field)
+            if index is not None and field and not body.get("analyzer"):
+                mapper = self._get_index(index).mapper_service.field_mapper(
+                    field)
                 analyzer_name = (
                     mapper.analyzer if mapper is not None
                     and mapper.type == "text" else "keyword"
                 )
             else:
                 analyzer_name = body.get("analyzer", "standard")
-        else:
-            registry = AnalysisRegistry.from_index_settings(None)
-            analyzer_name = body.get("analyzer", "standard")
-        analyzer = registry.get(analyzer_name)
-        tokens = []
-        pos = 0
-        for t in texts:
-            for term in analyzer.analyze(str(t)):
-                tokens.append({
-                    "token": term,
-                    "start_offset": 0,
-                    "end_offset": 0,
-                    "type": "<ALPHANUM>",
-                    "position": pos,
-                })
-                pos += 1
-            pos += 100  # position gap between texts array entries
-        return {"tokens": tokens}
+            analyzer = registry.get(str(analyzer_name))
+            tokenizer_fn = analyzer.tokenizer
+            filters = list(analyzer.filters)
+            filter_names = []
+
+        stages = self._analyze_stages(tokenizer_fn, filters, texts)
+        final = stages[-1]
+        if max_tokens is not None and len(final) > max_tokens:
+            raise IllegalArgumentException(
+                f"The number of tokens produced by calling _analyze has "
+                f"exceeded the allowed maximum of [{max_tokens}]. This "
+                f"limit can be set by changing the "
+                f"[index.analyze.max_token_count] index level setting."
+            )
+        if not explain:
+            return {"tokens": final}
+        if custom:
+            return {"detail": {
+                "custom_analyzer": True,
+                "tokenizer": {"name": str(body.get("tokenizer", "standard")),
+                              "tokens": stages[0]},
+                "tokenfilters": [
+                    {"name": fname, "tokens": stages[i + 1]}
+                    for i, fname in enumerate(filter_names)
+                ],
+            }}
+        return {"detail": {
+            "custom_analyzer": False,
+            "analyzer": {"name": str(analyzer_name), "tokens": final},
+        }}
 
     def put_mapping(self, index: str, body: dict) -> dict:
         # mapping updates reach closed indices too (PutMappingRequest
@@ -1900,10 +1997,14 @@ class TpuNode:
         """TransportExplainAction analog: why does (or doesn't) this doc
         match — runs the query on the owning shard restricted to the doc."""
         body = body or {}
+        if body and "query" not in body:
+            raise IllegalArgumentException(
+                "request body must contain a [query] element")
         concrete, routing = self._resolve_write_alias(index, routing)
         svc = self._get_open_index(concrete)
         shard = svc.shard_for(doc_id, routing)
-        if shard.get(doc_id) is None:
+        got = shard.get(doc_id)
+        if got is None:
             raise DocumentMissingException(f"[{concrete}]: document missing [{doc_id}]")
         from opensearch_tpu.search import query_dsl
         from opensearch_tpu.search.executor import execute_query_phase
@@ -1931,6 +2032,9 @@ class TpuNode:
                 "value": 0.0, "description": "no matching term",
                 "details": [],
             }
+        # GetResult rider (ExplainResponse.getGetResult): the fetched doc
+        # with _source, so ?_source filtering applies to explain too
+        out["get"] = {"found": True, "_source": got.get("_source")}
         return out
 
     def field_caps(self, index: str | None, fields: str) -> dict:
@@ -1973,20 +2077,27 @@ class TpuNode:
         }
 
     def termvectors(self, index: str, doc_id: str, body: dict | None = None,
-                    fields: str | None = None) -> dict:
-        """TransportTermVectorsAction analog: re-analyzes the live doc
-        (the realtime path the reference takes when vectors aren't stored)."""
+                    fields: str | None = None, realtime: bool = True,
+                    routing: str | None = None) -> dict:
+        """TransportTermVectorsAction analog: re-analyzes the doc (the
+        realtime path the reference takes when vectors aren't stored).
+        realtime=False reads through the last refresh only; field and term
+        statistics come from the resident postings
+        (TermVectorsService.java semantics)."""
         body = body or {}
-        concrete, routing = self._resolve_write_alias(index, None)
+        concrete, routing = self._resolve_write_alias(index, routing)
         svc = self._get_open_index(concrete)
         shard = svc.shard_for(doc_id, routing)
-        got = shard.get(doc_id)
+        got = shard.get(doc_id, realtime=realtime)
         if got is None:
             return {"_index": concrete, "_id": doc_id, "found": False}
         want = fields.split(",") if fields else body.get("fields")
         if isinstance(want, str):
             want = [want]
         want_stats = bool(body.get("term_statistics"))
+        want_field_stats = body.get("field_statistics", True) is not False
+        want_offsets = body.get("offsets", True) is not False
+        want_positions = body.get("positions", True) is not False
         source = got["_source"]
         ms = svc.mapper_service
         tv: dict[str, Any] = {}
@@ -1998,36 +2109,109 @@ class TpuNode:
                 continue
             if want and not any(fnmatch_one(fname, w) for w in want):
                 continue
+            analyzer = ms.analysis.get(mapper.analyzer)
             texts = value if isinstance(value, list) else [value]
-            counts: dict[str, int] = {}
+            # per-term occurrence list with character offsets; multi-value
+            # entries continue the offset/position space with the standard
+            # gaps (+1 char, +100 positions — Lucene's offset/posInc gaps)
+            occurrences: dict[str, list[dict]] = {}
+            char_base = 0
+            pos_base = 0
             for t in texts:
-                for term in ms.analyze_query_text(fname, str(t)):
-                    counts[term] = counts.get(term, 0) + 1
+                t = str(t)
+                max_pos = -1
+                for term, s, e, pos in analyzer.analyze_with_offsets(t):
+                    tok: dict[str, Any] = {}
+                    if want_positions:
+                        tok["position"] = pos_base + pos
+                    if want_offsets:
+                        tok["start_offset"] = char_base + s
+                        tok["end_offset"] = char_base + e
+                    occurrences.setdefault(term, []).append(tok)
+                    max_pos = max(max_pos, pos)
+                char_base += len(t) + 1
+                pos_base += max_pos + 1 + 100
             seg_fields = [
                 host.text_fields[fname]
                 for host, _dev in snapshot.segments
                 if fname in host.text_fields
             ]
             terms_out = {}
-            for term, freq in sorted(counts.items()):
-                entry: dict[str, Any] = {"term_freq": freq}
+            for term, tokens in sorted(occurrences.items()):
+                entry: dict[str, Any] = {"term_freq": len(tokens)}
                 if want_stats:
                     entry["doc_freq"] = sum(
-                        tf_field.doc_freq(term) for tf_field in seg_fields
-                    )
+                        f.doc_freq(term) for f in seg_fields)
+                    entry["ttf"] = sum(
+                        f.total_term_freq(term) for f in seg_fields)
+                if tokens and tokens[0]:
+                    entry["tokens"] = tokens
                 terms_out[term] = entry
-            tv[fname] = {
-                "field_statistics": {
-                    "sum_ttf": sum(int(f.total_terms) for f in seg_fields),
+            tv[fname] = {"terms": terms_out}
+            if want_field_stats:
+                tv[fname]["field_statistics"] = {
+                    "sum_doc_freq": sum(f.sum_doc_freq for f in seg_fields),
                     "doc_count": sum(f.docs_with_field for f in seg_fields),
-                    "sum_doc_freq": -1,
-                },
-                "terms": terms_out,
-            }
+                    "sum_ttf": sum(int(f.total_terms) for f in seg_fields),
+                }
         return {
             "_index": concrete, "_id": doc_id, "found": True,
+            "_version": got.get("_version", 1),
             "took": 0, "term_vectors": tv,
         }
+
+    def mtermvectors(self, body: dict | None = None,
+                     index: str | None = None,
+                     ids: str | None = None,
+                     term_statistics: bool = False,
+                     realtime: bool = True) -> dict:
+        """_mtermvectors (TransportMultiTermVectorsAction): docs list with
+        per-doc _index/_id (+ inherited defaults), or index + ids."""
+        body = body or {}
+        specs: list[dict] = []
+        if body.get("docs") is not None:
+            if not isinstance(body["docs"], list):
+                raise IllegalArgumentException("[docs] must be an array")
+            for d in body["docs"]:
+                if not isinstance(d, dict):
+                    raise IllegalArgumentException(
+                        "[docs] entries must be objects")
+                unknown = set(d) - {"_index", "_id", "_routing", "fields",
+                                    "term_statistics", "field_statistics",
+                                    "offsets", "positions", "payloads",
+                                    "version", "version_type"}
+                if unknown:
+                    # camelCase / underscore legacy spellings reject like
+                    # the reference's strict parser
+                    raise IllegalArgumentException(
+                        f"unknown parameter {sorted(unknown)} "
+                        f"in multi term vectors doc")
+                specs.append(d)
+        elif ids is not None or body.get("ids") is not None:
+            raw = ids if ids is not None else body["ids"]
+            id_list = raw.split(",") if isinstance(raw, str) else list(raw)
+            specs.extend({"_id": i} for i in id_list)
+        docs = []
+        for spec in specs:
+            idx = spec.get("_index", index)
+            did = spec.get("_id")
+            if idx is None or did is None:
+                raise IllegalArgumentException(
+                    "multi term vectors docs require [_index] and [_id]")
+            sub_body = {
+                "term_statistics": spec.get("term_statistics",
+                                            term_statistics),
+                "field_statistics": spec.get("field_statistics", True),
+                "offsets": spec.get("offsets", True),
+                "positions": spec.get("positions", True),
+            }
+            if spec.get("fields"):
+                sub_body["fields"] = spec["fields"]
+            docs.append(self.termvectors(
+                idx, str(did), sub_body, realtime=realtime,
+                routing=spec.get("_routing"),
+            ))
+        return {"docs": docs}
 
     # -- search / refresh --------------------------------------------------
 
@@ -3369,6 +3553,124 @@ class TpuNode:
                 "nodes": {"node-0": assigned},
             }
         return out
+
+    def search_shards(self, index: str | None = None,
+                      routing: str | None = None,
+                      body: dict | None = None,
+                      preference: str | None = None) -> dict:
+        """GET [/{index}]/_search_shards (ClusterSearchShardsAction): the
+        shard groups a search would fan out to, plus per-index alias
+        filter rendering; `routing` narrows to the routed shard, a `slice`
+        body narrows to that slice's shards (shard % max == id)."""
+        import fnmatch
+
+        body = body or {}
+        expr = index if index not in (None, "") else "_all"
+        alias_map = self._alias_map()
+        requested_aliases: dict[str, set] = {}
+        filter_routes: dict[str, list] = {}
+        names: list[str] = []
+
+        def add_index(name: str, filt):
+            svc = self._get_index(name)
+            if svc.closed:
+                return
+            if name not in filter_routes:
+                names.append(name)
+                filter_routes[name] = []
+            filter_routes[name].append(filt)
+
+        def add_alias(alias: str):
+            for name, conf in [
+                (n, self.indices[n].aliases[alias])
+                for n in alias_map.get(alias, [])
+            ]:
+                requested_aliases.setdefault(name, set()).add(alias)
+                add_index(name, (conf or {}).get("filter"))
+
+        for part in str(expr).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if part in ("_all", "*"):
+                for n in sorted(self.indices):
+                    add_index(n, None)
+            elif "*" in part or "?" in part:
+                for cand in sorted(set(self.indices) | set(alias_map)):
+                    if fnmatch.fnmatch(cand, part):
+                        if cand in alias_map:
+                            add_alias(cand)
+                        else:
+                            add_index(cand, None)
+            elif part in alias_map:
+                add_alias(part)
+            elif part in self.indices:
+                add_index(part, None)
+            else:
+                raise IndexNotFoundException(part)
+
+        def render_filter(f: dict) -> dict:
+            # QueryBuilder toXContent shape: term filters expand to the
+            # object form with explicit value/boost
+            if isinstance(f, dict) and len(f) == 1 and "term" in f \
+                    and isinstance(f["term"], dict) and len(f["term"]) == 1:
+                fname, v = next(iter(f["term"].items()))
+                if not isinstance(v, dict):
+                    v = {"value": v}
+                return {"term": {fname: {"boost": 1.0, **v}}}
+            return f
+
+        indices_out: dict[str, Any] = {}
+        for name in sorted(names):
+            entry: dict[str, Any] = {}
+            aliases = sorted(requested_aliases.get(name, ()))
+            if aliases:
+                entry["aliases"] = aliases
+            routes = filter_routes[name]
+            if routes and all(f is not None for f in routes):
+                if len(routes) == 1:
+                    entry["filter"] = render_filter(routes[0])
+                else:
+                    entry["filter"] = {"bool": {
+                        "should": [render_filter(f) for f in routes],
+                        "adjust_pure_negative": True,
+                        "boost": 1.0,
+                    }}
+            indices_out[name] = entry
+
+        shard_groups = []
+        sl = body.get("slice")
+        for name in sorted(names):
+            svc = self.indices[name]
+            shard_ids = list(range(svc.num_shards))
+            if routing is not None:
+                shard_ids = [shard_id_for_routing(str(routing),
+                                                  svc.num_shards)]
+            elif str(preference or "").startswith("_shards:"):
+                want = {int(s) for s in preference[len("_shards:"):].split(",")
+                        if s.strip().isdigit()}
+                shard_ids = [s for s in shard_ids if s in want]
+            if isinstance(sl, dict) and routing is None:
+                # the slice selects POSITIONS of the candidate list
+                # (SliceBuilder over the target shards, so it composes
+                # with _shards preference)
+                sl_max = int(sl.get("max", 1))
+                sl_id = int(sl.get("id", 0))
+                shard_ids = [s for i, s in enumerate(shard_ids)
+                             if i % sl_max == sl_id]
+            for s in shard_ids:
+                shard_groups.append([self._shard_routing(
+                    name, s, primary=True, assigned=True)])
+        return {
+            "nodes": {"node-0": {
+                "name": self.node_name,
+                "ephemeral_id": self.cluster_uuid,
+                "transport_address": "127.0.0.1:9300",
+                "attributes": {},
+            }},
+            "indices": indices_out,
+            "shards": shard_groups,
+        }
 
     def allocation_explain(self, body: dict | None,
                            include_disk_info: bool = False) -> dict:

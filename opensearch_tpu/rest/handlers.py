@@ -13,7 +13,9 @@ from typing import Any
 
 from opensearch_tpu import __version__
 from opensearch_tpu.common.errors import (
+    DocumentMissingException,
     IllegalArgumentException,
+    IndexNotFoundException,
     OpenSearchTpuException,
     ResourceNotFoundException,
 )
@@ -142,6 +144,10 @@ def build_router() -> Router:
     reg("POST", "/{index}/_field_caps", field_caps)
     reg("GET", "/{index}/_termvectors/{id}", termvectors)
     reg("POST", "/{index}/_termvectors/{id}", termvectors)
+    reg("GET", "/_mtermvectors", mtermvectors)
+    reg("POST", "/_mtermvectors", mtermvectors)
+    reg("GET", "/{index}/_mtermvectors", mtermvectors)
+    reg("POST", "/{index}/_mtermvectors", mtermvectors)
     reg("POST", "/_bulk", bulk)
     reg("PUT", "/_bulk", bulk)
     reg("POST", "/{index}/_bulk", bulk)
@@ -290,6 +296,10 @@ def build_router() -> Router:
     reg("POST", "/_cluster/reroute", cluster_reroute)
     reg("GET", "/_cluster/allocation/explain", allocation_explain)
     reg("POST", "/_cluster/allocation/explain", allocation_explain)
+    reg("GET", "/_search_shards", search_shards_handler)
+    reg("POST", "/_search_shards", search_shards_handler)
+    reg("GET", "/{index}/_search_shards", search_shards_handler)
+    reg("POST", "/{index}/_search_shards", search_shards_handler)
     # validate query
     reg("GET", "/_validate/query", validate_query)
     reg("POST", "/_validate/query", validate_query)
@@ -648,10 +658,30 @@ def source_exists(node: TpuNode, params, query, body):
 def get_source(node: TpuNode, params, query, body):
     resp = node.get_doc(params["index"], params["id"],
                         routing=_routing_param(query),
-                        realtime=_realtime_param(query))
-    if not resp.get("found"):
+                        realtime=_realtime_param(query),
+                        refresh=str(query.get("refresh", "false"))
+                        in ("true", ""))
+    # a hit without stored _source (mapping `_source.enabled: false`) is a
+    # 404 for this endpoint, like RestGetSourceAction
+    source_enabled = True
+    svc = node.indices.get(resp.get("_index", params["index"]))
+    if svc is not None:
+        source_enabled = getattr(svc.mapper_service, "_source_enabled", True)
+    if not resp.get("found") or resp.get("_source") is None \
+            or not source_enabled:
         return 404, {"error": f"document [{params['id']}] not found"}
-    return 200, resp["_source"]
+    src = resp["_source"]
+    includes = query.get("_source_includes") or query.get("_source_include")
+    excludes = query.get("_source_excludes") or query.get("_source_exclude")
+    if includes or excludes:
+        from opensearch_tpu.search.service import _source_filter
+
+        spec = {
+            **({"includes": str(includes).split(",")} if includes else {}),
+            **({"excludes": str(excludes).split(",")} if excludes else {}),
+        }
+        src = _source_filter(spec)(src)
+    return 200, src
 
 
 def delete_doc(node: TpuNode, params, query, body):
@@ -748,8 +778,48 @@ def mget_all(node: TpuNode, params, query, body):
 
 
 def explain_doc(node: TpuNode, params, query, body):
-    return 200, node.explain(params["index"], params["id"], body or {},
-                             routing=_routing_param(query))
+    b = _body_with_query_params(query, body)
+    lenient = str(query.get("lenient", "false")) in ("true", "")
+    try:
+        resp = node.explain(params["index"], params["id"], b,
+                            routing=_routing_param(query))
+    except (DocumentMissingException, IndexNotFoundException):
+        raise
+    except Exception:  # noqa: BLE001 - ?lenient swallows parse failures
+        if not lenient:
+            raise
+        resp = {"_index": params["index"], "_id": params["id"],
+                "matched": False,
+                "explanation": {"value": 0.0,
+                                "description": "lenient parse failure",
+                                "details": []}}
+    # _source handling on the GetResult rider: false drops it, a pattern
+    # list filters it (?_source=a.b is shorthand for includes)
+    get = resp.get("get")
+    if isinstance(get, dict):
+        src_param = str(query.get("_source", "true"))
+        includes = (query.get("_source_includes")
+                    or query.get("_source_include"))
+        excludes = (query.get("_source_excludes")
+                    or query.get("_source_exclude"))
+        if src_param == "false":
+            get = {k: v for k, v in get.items() if k != "_source"}
+        else:
+            if src_param not in ("true", "") and not includes:
+                includes = src_param
+            if includes or excludes:
+                from opensearch_tpu.search.service import _source_filter
+
+                spec = {
+                    **({"includes": str(includes).split(",")}
+                       if includes else {}),
+                    **({"excludes": str(excludes).split(",")}
+                       if excludes else {}),
+                }
+                get = {**get, "_source": _source_filter(spec)(
+                    get.get("_source"))}
+        resp = {**resp, "get": get}
+    return 200, resp
 
 
 def field_caps(node: TpuNode, params, query, body):
@@ -770,8 +840,26 @@ def termvectors(node: TpuNode, params, query, body):
     b = dict(body or {})
     if query.get("term_statistics") in ("", "true", True):
         b["term_statistics"] = True
-    return 200, node.termvectors(params["index"], params["id"], b,
-                                 fields=query.get("fields"))
+    for flag in ("field_statistics", "offsets", "positions"):
+        if str(query.get(flag, "true")) == "false":
+            b[flag] = False
+    return 200, node.termvectors(
+        params["index"], params["id"], b,
+        fields=query.get("fields"),
+        realtime=str(query.get("realtime", "true")) in ("true", ""),
+        routing=_routing_param(query),
+    )
+
+
+def mtermvectors(node: TpuNode, params, query, body):
+    return 200, node.mtermvectors(
+        body or {},
+        index=params.get("index") or query.get("index"),
+        ids=query.get("ids"),
+        term_statistics=str(query.get("term_statistics", "false"))
+        in ("true", ""),
+        realtime=str(query.get("realtime", "true")) in ("true", ""),
+    )
 
 
 def put_pipeline(node: TpuNode, params, query, body):
@@ -1152,6 +1240,15 @@ def validate_query(node: TpuNode, params, query, body):
 
 def get_all_pits(node: TpuNode, params, query, body):
     return 200, node.list_all_pits()
+
+
+def search_shards_handler(node: TpuNode, params, query, body):
+    return 200, node.search_shards(
+        index=params.get("index") or query.get("index"),
+        routing=query.get("routing"),
+        body=body,
+        preference=query.get("preference"),
+    )
 
 
 def get_script_context(node: TpuNode, params, query, body):

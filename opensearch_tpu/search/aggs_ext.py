@@ -1183,6 +1183,256 @@ def _ip_range(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
     return {"buckets": buckets}
 
 
+# -- geo aggregations (bucket/geogrid + metric geo aggs) ---------------------
+# Cell ids and distances are integer/float array ops over the synthetic
+# {field}#lat/#lon columns — the naturally-vectorizable OLAP shape
+# (GeoHashGridAggregator / GeoTileGridAggregator / GeoDistanceAggregator /
+# GeoBoundsAggregator / GeoCentroidAggregator).
+
+
+def _geo_latlon(segments, field):
+    """Per-segment (lat, lon, present) float arrays, or None entries when
+    the segment lacks the field's columns."""
+    out = []
+    for seg in segments:
+        lat_f = seg.numeric_fields.get(f"{field}#lat")
+        lon_f = seg.numeric_fields.get(f"{field}#lon")
+        if lat_f is None or lon_f is None:
+            out.append(None)
+            continue
+        out.append((
+            lat_f.values_f64[:seg.n_docs],
+            lon_f.values_f64[:seg.n_docs],
+            lat_f.present[:seg.n_docs],
+        ))
+    return out
+
+
+def _geo_distance_agg(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    from opensearch_tpu.search.executor import (
+        _haversine_m,
+        _parse_geo_origin,
+    )
+
+    field = conf["field"]
+    origin = conf.get("origin")
+    if origin is None:
+        raise ParsingException("[geo_distance] requires [origin]")
+    ranges = conf.get("ranges")
+    if not isinstance(ranges, list) or not ranges:
+        raise ParsingException("[geo_distance] requires [ranges]")
+    o_lat, o_lon = _parse_geo_origin(origin)
+    keyed = bool(conf.get("keyed", False))
+    # from/to are in `unit` (default meters); distances compare in meters
+    # (GeoDistanceAggregationBuilder + DistanceUnit)
+    unit_m = {
+        "mm": 0.001, "cm": 0.01, "m": 1.0, "km": 1000.0,
+        "in": 0.0254, "ft": 0.3048, "yd": 0.9144,
+        "mi": 1609.344, "nmi": 1852.0, "NM": 1852.0,
+    }.get(str(conf.get("unit", "m")), 1.0)
+    cols = _geo_latlon(segments, field)
+
+    # per-segment distance array (NaN = absent)
+    dists = []
+    for i, seg in enumerate(segments):
+        if cols[i] is None:
+            dists.append(None)
+            continue
+        lat, lon, present = cols[i]
+        d = _haversine_m(o_lat, o_lon, lat, lon)
+        dists.append(np.where(present, d, np.nan))
+
+    buckets = []
+    for r in ranges:
+        frm = float(r["from"]) if r.get("from") is not None else None
+        to = float(r["to"]) if r.get("to") is not None else None
+        key = r.get("key")
+        if key is None:
+            key = (f"{frm if frm is not None else '*'}-"
+                   f"{to if to is not None else '*'}")
+        bucket_masks = []
+        count = 0
+        for i, seg in enumerate(segments):
+            if dists[i] is None:
+                bucket_masks.append(np.zeros(seg.n_docs, bool))
+                continue
+            d = dists[i]
+            m = masks[i] & ~np.isnan(d)
+            if frm is not None:
+                m = m & (d >= frm * unit_m)
+            if to is not None:
+                m = m & (d < to * unit_m)
+            bucket_masks.append(m)
+            count += int(m.sum())
+        bucket = {"key": key, "doc_count": count}
+        if frm is not None:
+            bucket["from"] = frm
+        if to is not None:
+            bucket["to"] = to
+        bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn,
+                                ext))
+        buckets.append(bucket)
+    if keyed:
+        return {"buckets": {b.pop("key"): b for b in buckets}}
+    return {"buckets": buckets}
+
+
+_GEOHASH32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+
+
+def _geohash_cells(lat: np.ndarray, lon: np.ndarray,
+                   precision: int) -> np.ndarray:
+    """Vectorized geohash encode: 5*precision bisection steps as array ops
+    (the bit-interleave of GeoHashUtils.longEncode), then one decode pass
+    from packed int64 cell ids to strings."""
+    nbits = 5 * precision
+    packed = np.zeros(lat.shape, np.int64)
+    lat_lo = np.full(lat.shape, -90.0)
+    lat_hi = np.full(lat.shape, 90.0)
+    lon_lo = np.full(lat.shape, -180.0)
+    lon_hi = np.full(lat.shape, 180.0)
+    for b in range(nbits):
+        if b % 2 == 0:  # even bit: longitude
+            mid = (lon_lo + lon_hi) / 2
+            hi_half = lon >= mid
+            lon_lo = np.where(hi_half, mid, lon_lo)
+            lon_hi = np.where(hi_half, lon_hi, mid)
+        else:
+            mid = (lat_lo + lat_hi) / 2
+            hi_half = lat >= mid
+            lat_lo = np.where(hi_half, mid, lat_lo)
+            lat_hi = np.where(hi_half, lat_hi, mid)
+        packed = (packed << 1) | hi_half.astype(np.int64)
+    cells = np.empty(lat.shape, object)
+    shifts = [(precision - 1 - i) * 5 for i in range(precision)]
+    for idx in range(lat.size):
+        v = int(packed[idx])
+        cells[idx] = "".join(
+            _GEOHASH32[(v >> s) & 0x1F] for s in shifts)
+    return cells
+
+
+def _geotile_cells(lat: np.ndarray, lon: np.ndarray,
+                   zoom: int) -> np.ndarray:
+    """Vectorized web-mercator tile keys "z/x/y"
+    (GeoTileUtils.longEncode)."""
+    n = 1 << zoom
+    x = np.clip(((lon + 180.0) / 360.0 * n).astype(np.int64), 0, n - 1)
+    lat_r = np.radians(np.clip(lat, -85.05112878, 85.05112878))
+    y_frac = (1.0 - np.log(np.tan(lat_r) + 1.0 / np.cos(lat_r))
+              / np.pi) / 2.0
+    y = np.clip((y_frac * n).astype(np.int64), 0, n - 1)
+    cells = np.empty(lat.shape, object)
+    for idx in range(lat.size):
+        cells[idx] = f"{zoom}/{x[idx]}/{y[idx]}"
+    return cells
+
+
+def _geo_grid_agg(conf, sub, segments, ms, masks, filter_fn, ext,
+                  cells_fn, default_precision) -> dict:
+    field = conf["field"]
+    precision = int(conf.get("precision", default_precision))
+    size = int(conf.get("size", 10_000))
+    cols = _geo_latlon(segments, field)
+
+    # one vectorized cell-id pass per segment, then a bucket per distinct
+    # cell (masks by array equality, no per-doc Python)
+    seg_cells = []
+    counts: dict[str, int] = {}
+    for i, seg in enumerate(segments):
+        if cols[i] is None:
+            seg_cells.append(None)
+            continue
+        lat, lon, present = cols[i]
+        m = masks[i] & present
+        cells = np.empty(seg.n_docs, object)
+        if m.any():
+            cells[m] = cells_fn(lat[m], lon[m], precision)
+        seg_cells.append((cells, m))
+        uniq, cnt = np.unique(cells[m].astype(str), return_counts=True)
+        for k, c in zip(uniq, cnt):
+            counts[str(k)] = counts.get(str(k), 0) + int(c)
+    ordered = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[:size]
+    buckets = []
+    for key, count in ordered:
+        bucket_masks = []
+        for i, seg in enumerate(segments):
+            if seg_cells[i] is None:
+                bucket_masks.append(np.zeros(seg.n_docs, bool))
+                continue
+            cells, m = seg_cells[i]
+            bucket_masks.append(m & (cells == key))
+        bucket = {"key": key, "doc_count": count}
+        bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn,
+                                ext))
+        buckets.append(bucket)
+    return {"buckets": buckets}
+
+
+def _geohash_grid(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    return _geo_grid_agg(conf, sub, segments, ms, masks, filter_fn, ext,
+                         _geohash_cells, default_precision=5)
+
+
+def _geotile_grid(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    return _geo_grid_agg(conf, sub, segments, ms, masks, filter_fn, ext,
+                         _geotile_cells, default_precision=7)
+
+
+def _geo_bounds(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    field = conf["field"]
+    cols = _geo_latlon(segments, field)
+    lats, lons = [], []
+    for i, seg in enumerate(segments):
+        if cols[i] is None:
+            continue
+        lat, lon, present = cols[i]
+        m = masks[i] & present
+        lats.append(lat[m])
+        lons.append(lon[m])
+    lat_all = np.concatenate(lats) if lats else np.zeros(0)
+    lon_all = np.concatenate(lons) if lons else np.zeros(0)
+    if lat_all.size == 0:
+        return {}
+    return {"bounds": {
+        "top_left": {"lat": float(lat_all.max()),
+                     "lon": float(lon_all.min())},
+        "bottom_right": {"lat": float(lat_all.min()),
+                         "lon": float(lon_all.max())},
+    }}
+
+
+def _geo_centroid(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    field = conf["field"]
+    cols = _geo_latlon(segments, field)
+    lats, lons = [], []
+    for i, seg in enumerate(segments):
+        if cols[i] is None:
+            continue
+        lat, lon, present = cols[i]
+        m = masks[i] & present
+        lats.append(lat[m])
+        lons.append(lon[m])
+    lat_all = np.concatenate(lats) if lats else np.zeros(0)
+    lon_all = np.concatenate(lons) if lons else np.zeros(0)
+    if lat_all.size == 0:
+        return {"count": 0}
+    return {
+        "location": {"lat": float(lat_all.mean()),
+                     "lon": float(lon_all.mean())},
+        "count": int(lat_all.size),
+    }
+
+
+EXTENSION_AGGS.update({
+    "geo_distance": _geo_distance_agg,
+    "geohash_grid": _geohash_grid,
+    "geotile_grid": _geotile_grid,
+    "geo_bounds": _geo_bounds,
+    "geo_centroid": _geo_centroid,
+})
+
+
 EXTENSION_AGGS.update({
     "significant_text": _significant_text,
     "ip_range": _ip_range,

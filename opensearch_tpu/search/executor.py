@@ -36,6 +36,7 @@ from opensearch_tpu.index.device import DeviceSegment
 from opensearch_tpu.index.mapper import (
     FLOAT_TYPES,
     INT_TYPES,
+    RANGE_TYPES,
     MapperService,
     parse_date_millis,
 )
@@ -894,6 +895,43 @@ class SegmentExecutor:
             )
         return out if out is not None else _empty(self.dev)
 
+    def _exec_range_field(self, node: q.RangeQuery, mapper) -> NodeResult:
+        """Range query against a RANGE FIELD (doc values are intervals in
+        the `{field}#lo`/`{field}#hi` columns):
+          intersects: doc.lo <= q.hi  AND doc.hi >= q.lo
+          contains:   doc.lo <= q.lo  AND doc.hi >= q.hi
+          within:     doc.lo >= q.lo  AND doc.hi <= q.hi
+        (RangeFieldMapper's BKD relation queries in columnar form)."""
+        from opensearch_tpu.index.mapper import range_value_bounds
+
+        try:
+            q_lo, q_hi = range_value_bounds(
+                mapper.type,
+                {"gte": node.gte, "gt": node.gt,
+                 "lte": node.lte, "lt": node.lt},
+                mapper.format,
+            )
+        except (ValueError, TypeError) as e:
+            raise IllegalArgumentException(
+                f"failed to parse range query on [{node.field}]: {e}"
+            ) from None
+        lo_f, hi_f = f"{node.field}#lo", f"{node.field}#hi"
+        relation = node.relation or "intersects"
+        if relation == "contains":
+            a = self._numeric_range(lo_f, None, None, q_lo, None, 1.0)
+            b = self._numeric_range(hi_f, q_hi, None, None, None, 1.0)
+        elif relation == "within":
+            a = self._numeric_range(lo_f, q_lo, None, None, None, 1.0)
+            b = self._numeric_range(hi_f, None, None, q_hi, None, 1.0)
+        elif relation == "intersects":
+            a = self._numeric_range(lo_f, None, None, q_hi, None, 1.0)
+            b = self._numeric_range(hi_f, q_lo, None, None, None, 1.0)
+        else:
+            raise IllegalArgumentException(
+                f"[range] unknown relation [{relation}]")
+        mask = a.mask & b.mask & self.dev.live
+        return _const_result(mask, node.boost, scoring=True)
+
     def _numeric_range(
         self, field: str, gte: Any, gt: Any, lte: Any, lt: Any, boost: float
     ) -> NodeResult:
@@ -971,6 +1009,8 @@ class SegmentExecutor:
 
     def _exec_RangeQuery(self, node: q.RangeQuery) -> NodeResult:
         mapper = self.ctx.mapper_service.field_mapper(node.field)
+        if mapper is not None and mapper.type in RANGE_TYPES:
+            return self._exec_range_field(node, mapper)
         if mapper is not None and mapper.type == "flat_object":
             # the root column is keyword-shaped: lexicographic range
             from opensearch_tpu.index.mapper import FieldMapper as _FM

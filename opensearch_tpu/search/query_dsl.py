@@ -104,6 +104,9 @@ class RangeQuery(QueryNode):
     gt: Any = None
     lte: Any = None
     lt: Any = None
+    # range-FIELD relation (RangeQueryBuilder.relation, BKD range fields):
+    # intersects (default) | contains | within
+    relation: str = "intersects"
 
 
 @dataclass
@@ -747,6 +750,7 @@ def _parse_range(body: dict) -> QueryNode:
         else:
             lt = conf["to"]
     return RangeQuery(field=fname, gte=gte, gt=gt, lte=lte, lt=lt,
+                      relation=str(conf.get("relation", "intersects")).lower(),
                       boost=float(conf.get("boost", 1.0)))
 
 

@@ -188,8 +188,44 @@ class _QSParser:
                 return sub.parse()
             if nxt is None:
                 raise ParsingException(f"missing value after [{field}:]")
+            if nxt[:1] in "[{":
+                # field:[a TO b] / field:{a TO b} (classic-parser range
+                # syntax; brackets inclusive, braces exclusive, * open)
+                return self._parse_range_syntax(field)
             return _term_node(field, self.next())
         return _multi_field(self.fields, t)
+
+    def _parse_range_syntax(self, field: str) -> q.QueryNode:
+        open_tok = self.next()
+        inc_lo = open_tok[0] == "["
+        parts = [open_tok[1:]] if len(open_tok) > 1 else []
+        close_tok = None
+        while self.peek() is not None:
+            t = self.next()
+            if t.endswith("]") or t.endswith("}"):
+                close_tok = t
+                break
+            parts.append(t)
+        if close_tok is None:
+            raise ParsingException(
+                f"unclosed range syntax after [{field}:]")
+        inc_hi = close_tok.endswith("]")
+        if len(close_tok) > 1:
+            parts.append(close_tok[:-1])
+        vals = [p for p in parts if p and p.upper() != "TO"]
+        if len(vals) != 2:
+            raise ParsingException(
+                f"range syntax after [{field}:] needs [lo TO hi], "
+                f"got {vals}")
+        lo = None if vals[0] == "*" else vals[0]
+        hi = None if vals[1] == "*" else vals[1]
+        return q.RangeQuery(
+            field=field,
+            gte=lo if inc_lo else None,
+            gt=None if inc_lo else lo,
+            lte=hi if inc_hi else None,
+            lt=None if inc_hi else hi,
+        )
 
     def _collect_group(self) -> list[str]:
         depth, out = 1, []

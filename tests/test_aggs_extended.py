@@ -318,3 +318,107 @@ def test_bucket_sort(node):
     }})["tags"]
     sales = [b["sales"]["value"] for b in out["buckets"]]
     assert sales == [70.0, 50.0]
+
+
+# -- geo aggregations (geogrid / geo_distance / bounds / centroid) ----------
+
+
+@pytest.fixture(scope="module")
+def geo_node(tmp_path_factory):
+    from opensearch_tpu.node import TpuNode
+
+    node = TpuNode(tmp_path_factory.mktemp("geo") / "data")
+    node.create_index("cities", {"mappings": {"properties": {
+        "location": {"type": "geo_point"},
+        "population": {"type": "long"},
+    }}})
+    cities = [
+        ("nyc", 40.7128, -74.0060, 8_623_000),
+        ("la", 34.0522, -118.2437, 4_000_000),
+        ("chi", 41.8781, -87.6298, 2_716_000),
+        ("sf", 37.7749, -122.4194, 884_000),
+    ]
+    node.bulk([
+        ("index", {"_index": "cities", "_id": cid},
+         {"location": {"lat": lat, "lon": lon}, "population": pop})
+        for cid, lat, lon, pop in cities
+    ], refresh=True)
+    return node
+
+
+def _geo_agg(geo_node, aggs):
+    return geo_node.search("cities", {"size": 0, "aggs": aggs})["aggregations"]
+
+
+def test_geo_distance_agg(geo_node):
+    out = _geo_agg(geo_node, {"rings": {"geo_distance": {
+        "field": "location", "origin": "35.7796, -78.6382",
+        "ranges": [{"to": 1_000_000}, {"from": 1_000_000, "to": 5_000_000},
+                   {"from": 5_000_000}],
+    }}})["rings"]
+    counts = [b["doc_count"] for b in out["buckets"]]
+    assert counts == [1, 3, 0]
+    assert out["buckets"][0]["key"] == "*-1000000.0"
+
+
+def test_geo_distance_agg_km_unit(geo_node):
+    out = _geo_agg(geo_node, {"rings": {"geo_distance": {
+        "field": "location", "origin": "35.7796, -78.6382", "unit": "km",
+        "ranges": [{"to": 1000}, {"from": 1000}],
+    }}})["rings"]
+    assert [b["doc_count"] for b in out["buckets"]] == [1, 3]
+
+
+def test_geohash_and_geotile_grid(geo_node):
+    out = _geo_agg(geo_node, {"cells": {"geohash_grid": {
+        "field": "location", "precision": 3,
+    }}})["cells"]
+    assert sum(b["doc_count"] for b in out["buckets"]) == 4
+    assert out["buckets"][0]["key"] and len(out["buckets"][0]["key"]) == 3
+    # NYC at precision 3 is "dr5"
+    assert any(b["key"] == "dr5" for b in out["buckets"])
+
+    out = _geo_agg(geo_node, {"cells": {"geotile_grid": {
+        "field": "location", "precision": 6,
+    }}})["cells"]
+    assert sum(b["doc_count"] for b in out["buckets"]) == 4
+    z, x, y = out["buckets"][0]["key"].split("/")
+    assert z == "6" and x.isdigit() and y.isdigit()
+
+
+def test_geo_bounds_and_centroid(geo_node):
+    out = _geo_agg(geo_node, {
+        "box": {"geo_bounds": {"field": "location"}},
+        "mid": {"geo_centroid": {"field": "location"}},
+    })
+    b = out["box"]["bounds"]
+    assert b["top_left"]["lat"] == pytest.approx(41.8781)
+    assert b["top_left"]["lon"] == pytest.approx(-122.4194)
+    assert b["bottom_right"]["lat"] == pytest.approx(34.0522)
+    assert b["bottom_right"]["lon"] == pytest.approx(-74.0060)
+    assert out["mid"]["count"] == 4
+    assert out["mid"]["location"]["lat"] == pytest.approx(38.6045, abs=1e-3)
+
+
+def test_range_field_ipv6_and_open_bounds(geo_node):
+    """VERDICT review: IPv6 ordinals exceed 2^62 — open bounds must sit at
+    the int64 edges, and single-address string values are one-point
+    ranges."""
+    node = geo_node
+    node.create_index("netblocks", {"mappings": {"properties": {
+        "block": {"type": "ip_range"},
+    }}})
+    node.bulk([
+        ("index", {"_index": "netblocks", "_id": "v6"},
+         {"block": {"gte": "2001:db8::1", "lte": "2001:db8::ffff"}}),
+        ("index", {"_index": "netblocks", "_id": "v4single"},
+         {"block": "192.168.0.7"}),
+    ], refresh=True)
+    # unbounded upper side must still intersect the v6 block
+    r = node.search("netblocks", {"query": {"range": {"block": {
+        "gte": "2001:db8::5"}}}})
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"v6"}
+    # the single-address doc behaves as [addr, addr]
+    r = node.search("netblocks", {"query": {"range": {"block": {
+        "gte": "192.168.0.7", "lte": "192.168.0.7"}}}})
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"v4single"}
