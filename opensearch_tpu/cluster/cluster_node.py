@@ -49,6 +49,15 @@ from opensearch_tpu.cluster.state import (
 from opensearch_tpu.index.mapper import MapperService
 from opensearch_tpu.index.shard import IndexShard, ShardId
 from opensearch_tpu.search import query_dsl
+
+
+def _wall_ms() -> int:
+    """Epoch wall-clock ms for retention-lease timestamps — deliberately
+    NOT ClusterNode._now_ms (monotonic): lease timestamps persist in the
+    commit point and must stay comparable across restarts."""
+    import time as _t
+
+    return int(_t.time() * 1000)
 from opensearch_tpu.search.executor import execute_query_phase
 from opensearch_tpu.search.service import _source_filter
 
@@ -116,6 +125,9 @@ class ClusterNode:
         # even before the routing table shows them STARTED — otherwise ops
         # arriving between the recovery dump and shard-started are lost
         self._tracked_targets: dict[tuple[str, int], set[str]] = {}
+        # recovery-source mode counters (tests assert ops-based recovery
+        # ships zero segment bytes when a retention lease holds)
+        self.recovery_stats = {"ops_based": 0, "segment_based": 0}
 
         reg = transport.register
         reg(node_id, "cluster:admin/create_index", self._on_create_index)
@@ -201,12 +213,21 @@ class ClusterNode:
                 shard = self.local_shards.pop(key)
                 self._tracked_targets.pop(key, None)
                 shard.close()
-        # drop tracked recovery targets that are no longer assigned copies
+        # drop tracked recovery targets that are no longer assigned copies,
+        # and release their retention leases — a departed copy must not pin
+        # translog history forever (ReplicationTracker removes peer leases
+        # when the routing table drops the copy)
         for key, targets in list(self._tracked_targets.items()):
             assigned = {
                 r.node_id for r in state.routing
                 if (r.index, r.shard) == key and r.node_id is not None
             }
+            gone = targets - assigned
+            local = self.local_shards.get(key)
+            if gone and local is not None and local.primary:
+                for nid in gone:
+                    local.engine.retention_leases.remove(
+                        f"peer_recovery/{nid}")
             targets &= assigned
             if not targets:
                 self._tracked_targets.pop(key, None)
@@ -323,6 +344,7 @@ class ClusterNode:
                 local = self.local_shards.get((index, shard))
                 if local is None:
                     return False
+                ops_mode = resp.get("mode") == "ops"
                 for op in resp["ops"]:
                     if op["op"] == "index":
                         local.apply_index_on_replica(
@@ -331,6 +353,9 @@ class ClusterNode:
                         )
                     else:
                         local.apply_delete_on_replica(op["id"], op["seq_no"])
+                if ops_mode:
+                    # replayed history must survive a crash of this node
+                    local.engine.translog.sync()
                 local.refresh()
                 local.recovery_done = True
                 local.recovery_inflight = False
@@ -349,7 +374,13 @@ class ClusterNode:
 
         self.transport.send(
             self.node_id, primary.node_id, "internal:index/shard/recovery/start",
-            {"index": index, "shard": shard, "target": self.node_id},
+            {"index": index, "shard": shard, "target": self.node_id,
+             # the target's recovered-from-disk progress: with a valid
+             # retention lease the source answers with an OPS-ONLY replay
+             # from here instead of a segment copy
+             "local_checkpoint": (
+                 local.engine.local_checkpoint if local is not None else -1
+             )},
             on_response=on_response,
             on_failure=lambda e: self.scheduler.schedule(
                 1000, lambda: self._retry_recovery(index, shard)
@@ -428,15 +459,39 @@ class ClusterNode:
         return self._offload(lambda: self._start_recovery_local(payload))
 
     def _start_recovery_local(self, payload: dict) -> dict:
-        """Primary-side recovery source. SEGMENT replication: phase1 ships
-        the sealed segment files as one binary blob + the translog tail
-        (RecoverySourceHandler.recoverToTarget:171 phase1/phase2); DOCUMENT
-        replication: the logical live-doc dump."""
+        """Primary-side recovery source. OPS-BASED fast path first
+        (RecoverySourceHandler.recoverToTarget:171: when a peer-recovery
+        retention lease retains history from the target's checkpoint,
+        phase1 file copy is SKIPPED entirely and phase2 replays the ops);
+        otherwise SEGMENT replication ships the sealed segment files +
+        translog tail, and DOCUMENT replication the logical live-doc dump."""
         shard = self._local_shard(payload["index"], payload["shard"])
+        target = payload["target"]
+        target_ckpt = int(payload.get("local_checkpoint", -1))
+        # ops-based recovery serves DOCUMENT replication; a segrep replica's
+        # searchable state is the primary's segment set, so its recovery
+        # stays the sig-diff file sync (only changed segments transfer)
+        if target_ckpt >= 0 and shard.replication != "SEGMENT":
+            # track BEFORE snapshotting history (same invariant as the
+            # full-dump path below): a write landing in between must reach
+            # the target through the fan-out
+            self._tracked_targets.setdefault(
+                (payload["index"], payload["shard"]), set()
+            ).add(target)
+            ops = shard.engine.history_ops_from(target_ckpt + 1)
+            if ops is not None:
+                shard.engine.retention_leases.add_or_renew(
+                    f"peer_recovery/{target}", target_ckpt + 1,
+                    _wall_ms(),
+                )
+                self.recovery_stats["ops_based"] += 1
+                return {"mode": "ops", "ops": ops,
+                        "max_seq_no": shard.engine.max_seq_no}
         if shard.replication == "SEGMENT":
             self._tracked_targets.setdefault(
                 (payload["index"], payload["shard"]), set()
             ).add(payload["target"])
+            self.recovery_stats["segment_based"] += 1
             # phase1 manifest only — the target pulls each segment in its
             # own request (bounded frame sizes); phase2 = the translog tail
             return {
@@ -452,6 +507,13 @@ class ClusterNode:
         self._tracked_targets.setdefault(
             (payload["index"], payload["shard"]), set()
         ).add(payload["target"])
+        # establish the peer lease NOW: a flush landing between this dump
+        # and the copy's first write-ack must not trim the history its next
+        # ops-based recovery would need
+        shard.engine.retention_leases.add_or_renew(
+            f"peer_recovery/{target}", shard.engine.max_seq_no + 1,
+            _wall_ms(),
+        )
         engine = shard.engine
         ops: list[dict] = []
         snapshot = engine.acquire_searcher()
@@ -883,8 +945,11 @@ class ClusterNode:
             if pending["n"] == 0:
                 deferred.set_result(response(pending["failed"]))
 
-        def on_ack(_resp: Any) -> None:
-            one_done()
+        def make_on_ack(nid: str):
+            def on_ack(resp: Any) -> None:
+                self._renew_peer_lease(index, shard_num, nid, resp)
+                one_done()
+            return on_ack
 
         def make_on_fail(nid: str):
             def on_fail(_e: Exception) -> None:
@@ -899,9 +964,25 @@ class ClusterNode:
         for nid in sorted(target_nodes):
             self.transport.send(
                 self.node_id, nid, "indices:data/write[r]", replica_payload,
-                on_response=on_ack, on_failure=make_on_fail(nid),
+                on_response=make_on_ack(nid), on_failure=make_on_fail(nid),
             )
         return deferred
+
+    def _renew_peer_lease(self, index: str, shard_num: int, nid: str,
+                          resp: Any) -> None:
+        """Advance the replica's peer-recovery retention lease to its acked
+        local checkpoint + 1: everything at or below the checkpoint is
+        durable on that copy, so history above it is all a future ops-based
+        recovery would need (ReplicationTracker.renewRetentionLease)."""
+        if not isinstance(resp, dict) or "local_checkpoint" not in resp:
+            return
+        local = self.local_shards.get((index, shard_num))
+        if local is None or not local.primary:
+            return
+        local.engine.retention_leases.add_or_renew(
+            f"peer_recovery/{nid}", int(resp["local_checkpoint"]) + 1,
+            _wall_ms(),
+        )
 
     # -- shard-level bulk (TransportShardBulkAction.performOnPrimary) -------
 
@@ -1005,10 +1086,16 @@ class ClusterNode:
                 self._report_shard_failed(index, shard_num, nid, one_done)
             return on_fail
 
+        def make_on_ack(nid: str):
+            def on_ack(resp: Any) -> None:
+                self._renew_peer_lease(index, shard_num, nid, resp)
+                one_done()
+            return on_ack
+
         for nid in sorted(target_nodes):
             self.transport.send(
                 self.node_id, nid, "indices:data/write[r][bulk]", rep_payload,
-                on_response=lambda _r: one_done(),
+                on_response=make_on_ack(nid),
                 on_failure=make_on_fail(nid),
             )
         return deferred
@@ -1033,7 +1120,8 @@ class ClusterNode:
                 else:
                     shard.apply_delete_on_replica(op["id"], op["seq_no"])
             shard.maybe_sync_translog()
-            return {"ack": True}
+            return {"ack": True,
+                    "local_checkpoint": shard.engine.local_checkpoint}
 
         return self._offload(run)
 
@@ -1085,7 +1173,11 @@ class ClusterNode:
             # replica acks are durability promises too (the primary counts
             # this copy in-sync based on them): fsync before responding
             shard.maybe_sync_translog()
-            return {"ack": True}
+            # the ack carries the replica's local checkpoint so the primary
+            # can advance this copy's retention lease (the reference
+            # piggybacks it on every ReplicationResponse)
+            return {"ack": True,
+                    "local_checkpoint": shard.engine.local_checkpoint}
 
         return self._offload(run)
 

@@ -115,6 +115,12 @@ class Engine:
         # real transport they arrive out of order and the checkpoint must
         # hold at the first unprocessed seq_no
         self.tracker = LocalCheckpointTracker()
+        # peer-recovery retention leases (ReplicationTracker.java:104):
+        # flush-time translog trimming honors the leased floor so a
+        # returning replica can recover by ops replay, not segment copy
+        from opensearch_tpu.index.seqno import RetentionLeases
+
+        self.retention_leases = RetentionLeases()
         self._sync_needed = False
         self.stats = {"index_total": 0, "delete_total": 0, "refresh_total": 0,
                       "flush_total": 0, "index_time_ms": 0.0}
@@ -469,6 +475,7 @@ class Engine:
             "local_checkpoint": self.local_checkpoint,
             "segment_counter": self._segment_counter,
             "translog_generation": self.translog.current_generation + 1,
+            "retention_leases": self.retention_leases.to_dict(),
             "version_map": {
                 doc_id: [e.seq_no, e.version, e.deleted]
                 for doc_id, e in self.version_map.items()
@@ -489,7 +496,13 @@ class Engine:
             if stem not in current:
                 f.unlink(missing_ok=True)
         self.translog.roll_generation()
-        self.translog.trim_below(self.translog.current_generation)
+        # flush is the periodic hook where stale leases (holder gone >12h
+        # without a renewal) stop pinning history
+        self.retention_leases.expire(int(time.time() * 1000))
+        self.translog.trim_below(
+            self.translog.current_generation,
+            min_retained_seq=self.retention_leases.min_retained_seq_no(),
+        )
         self._last_flush_sig = sig
         self.stats["flush_total"] += 1
 
@@ -593,6 +606,26 @@ class Engine:
         self._sync_needed = False
         return list(self.translog.read_ops())
 
+    def history_ops_from(self, from_seq_no: int) -> list[dict] | None:
+        """Retained history ops with seq_no >= from_seq_no, in order —
+        or None when the translog no longer covers that point (history was
+        trimmed past it; the caller must fall back to a segment copy).
+        The ops-based recovery source (RecoverySourceHandler phase2-only,
+        .../indices/recovery/RecoverySourceHandler.java:171)."""
+        if from_seq_no > self.tracker.max_seq_no:
+            return []
+        if not self.retention_leases.covers(from_seq_no):
+            return None
+        self.translog.sync()
+        ops = [op for op in self.translog.read_ops()
+               if int(op.get("seq_no", -1)) >= from_seq_no]
+        covered = {int(op["seq_no"]) for op in ops}
+        # every needed seq_no must be present (gaps mean trimmed history)
+        if any(s not in covered
+               for s in range(from_seq_no, self.tracker.max_seq_no + 1)):
+            return None
+        return sorted(ops, key=lambda o: int(o["seq_no"]))
+
     def replay_translog_tail(self) -> int:
         """Promotion of a segment-replication replica: index any translog
         ops not yet reflected in the engine (the per-doc seq_no stale check
@@ -628,6 +661,11 @@ class Engine:
                 doc_id: VersionEntry(seq, ver, deleted)
                 for doc_id, (seq, ver, deleted) in commit["version_map"].items()
             }
+            if commit.get("retention_leases"):
+                from opensearch_tpu.index.seqno import RetentionLeases
+
+                self.retention_leases = RetentionLeases.from_dict(
+                    commit["retention_leases"])
             replay_from_seq = commit["max_seq_no"]
         replayed = 0
         for op in self.translog.read_ops():

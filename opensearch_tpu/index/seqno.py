@@ -144,3 +144,101 @@ class ReplicationTracker:
 
     def local_checkpoint_of(self, allocation_id: str) -> int:
         return self._local_checkpoints.get(allocation_id, UNASSIGNED_SEQ_NO)
+
+
+class RetentionLease:
+    """One retained history interval (RetentionLease.java): ops at or above
+    `retaining_seq_no` must stay replayable for the lease holder."""
+
+    __slots__ = ("id", "retaining_seq_no", "timestamp_ms", "source")
+
+    def __init__(self, lease_id: str, retaining_seq_no: int,
+                 timestamp_ms: int, source: str = "peer recovery"):
+        self.id = lease_id
+        self.retaining_seq_no = retaining_seq_no
+        self.timestamp_ms = timestamp_ms
+        self.source = source
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "retaining_seq_no": self.retaining_seq_no,
+                "timestamp": self.timestamp_ms, "source": self.source}
+
+
+class RetentionLeases:
+    """The shard's lease collection (ReplicationTracker.retentionLeases,
+    ReplicationTracker.java:104): peer-recovery leases keep translog
+    history alive so a returning replica can recover by OPS REPLAY instead
+    of a full segment copy. Versioned so copies can reconcile."""
+
+    # leases older than this expire unless renewed (the reference's
+    # index.soft_deletes.retention_lease.period default, 12h)
+    DEFAULT_RETENTION_MS = 12 * 3600 * 1000
+
+    def __init__(self):
+        self._leases: dict[str, RetentionLease] = {}
+        self.version = 0
+        self.primary_term = 1
+
+    def add_or_renew(self, lease_id: str, retaining_seq_no: int,
+                     now_ms: int, source: str = "peer recovery") -> RetentionLease:
+        existing = self._leases.get(lease_id)
+        if existing is not None:
+            # renewal never moves the retained point backwards
+            retaining_seq_no = max(retaining_seq_no,
+                                   existing.retaining_seq_no)
+        lease = RetentionLease(lease_id, retaining_seq_no, now_ms, source)
+        self._leases[lease_id] = lease
+        self.version += 1
+        return lease
+
+    def remove(self, lease_id: str) -> None:
+        if self._leases.pop(lease_id, None) is not None:
+            self.version += 1
+
+    def get(self, lease_id: str) -> RetentionLease | None:
+        return self._leases.get(lease_id)
+
+    def expire(self, now_ms: int,
+               retention_ms: int = DEFAULT_RETENTION_MS) -> list[str]:
+        """Drop leases whose holder has not renewed within the retention
+        period; returns the expired ids."""
+        expired = [lid for lid, l in self._leases.items()
+                   if now_ms - l.timestamp_ms > retention_ms]
+        for lid in expired:
+            del self._leases[lid]
+        if expired:
+            self.version += 1
+        return expired
+
+    def min_retained_seq_no(self) -> int | None:
+        """The lowest seq_no any lease still needs, or None (no leases —
+        history may be trimmed freely)."""
+        if not self._leases:
+            return None
+        return min(l.retaining_seq_no for l in self._leases.values())
+
+    def covers(self, from_seq_no: int) -> bool:
+        """True if retained history includes every op >= from_seq_no."""
+        m = self.min_retained_seq_no()
+        return m is not None and m <= from_seq_no
+
+    def leases(self) -> list[RetentionLease]:
+        return sorted(self._leases.values(), key=lambda l: l.id)
+
+    def to_dict(self) -> dict:
+        return {"version": self.version,
+                "primary_term": self.primary_term,
+                "leases": [l.to_dict() for l in self.leases()]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RetentionLeases":
+        out = cls()
+        out.version = int(d.get("version", 0))
+        out.primary_term = int(d.get("primary_term", 1))
+        for l in d.get("leases", []):
+            out._leases[l["id"]] = RetentionLease(
+                l["id"], int(l["retaining_seq_no"]),
+                int(l.get("timestamp", 0)),
+                l.get("source", "peer recovery"),
+            )
+        return out

@@ -20,7 +20,7 @@ import json
 import os
 import struct
 import zlib
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dc_field
 from pathlib import Path
 from typing import Any, Iterator
 
@@ -42,6 +42,10 @@ class Checkpoint:
     num_ops: int         # ops in the current generation
     max_seq_no: int
     min_generation: int  # oldest generation still needed for recovery
+    # sealed generations' max seq_no ("gen" -> max_seq_no at roll time):
+    # lets retention-lease trimming keep exactly the generations whose ops
+    # a lease may still need (TranslogDeletionPolicy.minTranslogGenRequired)
+    gen_max_seq: dict = dc_field(default_factory=dict)
 
     def to_bytes(self) -> bytes:
         return json.dumps(self.__dict__).encode()
@@ -125,12 +129,15 @@ class Translog:
         """Seal the current generation and start a new one (flush path)."""
         self.sync()
         self._close_writer()
+        sealed = dict(self.checkpoint.gen_max_seq)
+        sealed[str(self.checkpoint.generation)] = self.checkpoint.max_seq_no
         self.checkpoint = Checkpoint(
             generation=self.checkpoint.generation + 1,
             offset=0,
             num_ops=0,
             max_seq_no=self.checkpoint.max_seq_no,
             min_generation=self.checkpoint.min_generation,
+            gen_max_seq=sealed,
         )
         self._open_writer()
         self._write_checkpoint()
@@ -143,13 +150,26 @@ class Translog:
             self._file.close()
             self._file = None
 
-    def trim_below(self, generation: int) -> None:
+    def trim_below(self, generation: int,
+                   min_retained_seq: int | None = None) -> None:
         """Delete generations < generation (their ops are in committed
-        segments). Mirrors TranslogDeletionPolicy."""
+        segments). With `min_retained_seq` (a retention lease's floor),
+        generations that may still hold ops >= that seq_no survive the
+        trim. Mirrors TranslogDeletionPolicy."""
+        if min_retained_seq is not None:
+            # a sealed generation is deletable only when everything in it
+            # is below the retained floor; generations without a recorded
+            # max (pre-upgrade) are conservatively kept
+            for gen in range(self.checkpoint.min_generation, generation):
+                gmax = self.checkpoint.gen_max_seq.get(str(gen))
+                if gmax is None or gmax >= min_retained_seq:
+                    generation = gen
+                    break
         for gen in range(self.checkpoint.min_generation, generation):
             path = self._gen_path(gen)
             if path.exists():
                 path.unlink()
+            self.checkpoint.gen_max_seq.pop(str(gen), None)
         self.checkpoint.min_generation = max(self.checkpoint.min_generation, generation)
         self._write_checkpoint()
 

@@ -287,3 +287,93 @@ def test_writes_during_replica_recovery_not_lost(sim):
         assert shard.num_docs == 10, f"{r.node_id} has {shard.num_docs}"
         for i in range(10):
             assert shard.get(str(i)) is not None, (r.node_id, i)
+
+
+def test_ops_based_recovery_with_retention_lease(sim):
+    """Retention leases (ReplicationTracker.java:104) let a returning
+    replica recover by OPS REPLAY from its checkpoint — zero segment
+    bytes — even after the primary flushed (the lease holds the translog
+    floor; RecoverySourceHandler.java:171 phase2-only)."""
+    sim.call(sim.nodes["n0"].create_index, "ops",
+             {"settings": {"index": {"number_of_shards": 1,
+                                     "number_of_replicas": 1}}})
+    sim.run(5_000)
+    for i in range(5):
+        sim.call(sim.nodes["n0"].index_doc, "ops", str(i), {"n": i})
+    sim.run(2_000)
+    state = sim.leader().applied_state
+    primary = next(r for r in state.shards_for_index("ops") if r.primary)
+    replica = next(r for r in state.shards_for_index("ops") if not r.primary)
+    p_node = sim.nodes[primary.node_id]
+    p_engine = p_node.local_shards[("ops", 0)].engine
+
+    # replica write acks advanced its peer lease on the primary
+    lease = p_engine.retention_leases.get(
+        f"peer_recovery/{replica.node_id}")
+    assert lease is not None and lease.retaining_seq_no >= 1
+
+    # the replica "dies" (acked through seq 4); the primary keeps writing
+    # alone — these ops are exactly what the returning replica will need
+    p_shard = p_node.local_shards[("ops", 0)]
+    p_shard.apply_index_on_primary("5", {"n": 5})
+    p_shard.apply_index_on_primary("6", {"n": 6})
+
+    # primary flushes: without the lease this would trim all history
+    p_engine.flush()
+
+    # the replica returns at its durable checkpoint (4): ops-only replay
+    before = dict(p_node.recovery_stats)
+    resp = p_node._start_recovery_local({
+        "index": "ops", "shard": 0, "target": replica.node_id,
+        "local_checkpoint": 4,
+    })
+    assert resp["mode"] == "ops", resp.get("mode")
+    assert [o["seq_no"] for o in resp["ops"]] == [5, 6]
+    assert "order" not in resp and "sigs" not in resp  # zero segment bytes
+    assert p_node.recovery_stats["ops_based"] == before["ops_based"] + 1
+
+    # a checkpoint BELOW the leased floor cannot take the ops path (that
+    # history is legitimately gone)
+    resp = p_node._start_recovery_local({
+        "index": "ops", "shard": 0, "target": replica.node_id,
+        "local_checkpoint": 1,
+    })
+    assert resp.get("mode") != "ops"
+
+    # a FRESH target (no local state, no lease coverage) cannot take the
+    # ops path
+    resp = p_node._start_recovery_local({
+        "index": "ops", "shard": 0, "target": "n_fresh",
+        "local_checkpoint": -1,
+    })
+    assert resp.get("mode") != "ops"
+
+
+def test_departed_replica_releases_retention_lease(sim):
+    """A copy the routing table dropped must stop pinning translog history
+    (ReplicationTracker removes peer leases with the copy)."""
+    sim.call(sim.nodes["n0"].create_index, "rel",
+             {"settings": {"index": {"number_of_shards": 1,
+                                     "number_of_replicas": 1}}})
+    sim.run(5_000)
+    sim.call(sim.nodes["n0"].index_doc, "rel", "1", {"n": 1})
+    sim.run(2_000)
+    state = sim.leader().applied_state
+    primary = next(r for r in state.shards_for_index("rel") if r.primary)
+    replica = next(r for r in state.shards_for_index("rel") if not r.primary)
+    p_node = sim.nodes[primary.node_id]
+    p_engine = p_node.local_shards[("rel", 0)].engine
+    assert p_engine.retention_leases.get(
+        f"peer_recovery/{replica.node_id}") is not None
+
+    # the replica copy fails; the leader reroutes; the lease must go
+    p_node._report_shard_failed("rel", 0, replica.node_id, lambda: None)
+    sim.run(5_000)
+    state = sim.leader().applied_state
+    still_assigned = {
+        r.node_id for r in state.shards_for_index("rel")
+        if r.node_id is not None and not r.primary
+    }
+    if replica.node_id not in still_assigned:
+        assert p_engine.retention_leases.get(
+            f"peer_recovery/{replica.node_id}") is None

@@ -152,3 +152,80 @@ class TestRequestDurability:
         node2 = TpuNode(tmp_path / "n")
         assert node2.get_doc("idx", "1")["_source"]["n"] == 41
         assert node2.get_doc("idx", "2")["_source"]["n"] == 42
+
+
+class TestRetentionLeases:
+    """Peer-recovery retention leases (ReplicationTracker.java:104) +
+    lease-aware translog trimming + the ops-based recovery source
+    (RecoverySourceHandler.java:171 phase2-only path)."""
+
+    def test_lease_collection_semantics(self):
+        from opensearch_tpu.index.seqno import RetentionLeases
+
+        rl = RetentionLeases()
+        assert rl.min_retained_seq_no() is None
+        rl.add_or_renew("peer_recovery/n1", 5, now_ms=1000)
+        rl.add_or_renew("peer_recovery/n2", 3, now_ms=1000)
+        assert rl.min_retained_seq_no() == 3
+        assert rl.covers(3) and rl.covers(7)
+        assert not rl.covers(2)
+        # renewal never regresses the retained point
+        rl.add_or_renew("peer_recovery/n2", 1, now_ms=2000)
+        assert rl.get("peer_recovery/n2").retaining_seq_no == 3
+        rl.add_or_renew("peer_recovery/n2", 9, now_ms=2000)
+        assert rl.min_retained_seq_no() == 5
+        # expiry drops stale holders
+        expired = rl.expire(now_ms=1000 + rl.DEFAULT_RETENTION_MS + 1)
+        assert expired == ["peer_recovery/n1"]
+        assert rl.min_retained_seq_no() == 9
+        # round trip
+        back = RetentionLeases.from_dict(rl.to_dict())
+        assert back.min_retained_seq_no() == 9
+        assert back.version == rl.version
+
+    def test_flush_trims_history_without_lease(self, tmp_path):
+        e = Engine(tmp_path / "p", MapperService(MAPPINGS))
+        for i in range(4):
+            e.index(f"d{i}", {"n": i}, None)
+        e.flush()
+        assert e.history_ops_from(0) is None  # trimmed
+
+    def test_lease_retains_history_across_flush(self, tmp_path):
+        e = Engine(tmp_path / "p", MapperService(MAPPINGS))
+        for i in range(4):
+            e.index(f"d{i}", {"n": i}, None)
+        import time
+
+        e.retention_leases.add_or_renew("peer_recovery/n2", 2,
+                                        now_ms=int(time.time() * 1000))
+        e.flush()
+        # ops >= 2 must still replay; ops below the floor may be gone
+        ops = e.history_ops_from(2)
+        assert ops is not None
+        assert [op["seq_no"] for op in ops] == [2, 3]
+        assert e.history_ops_from(0) is None or \
+            [op["seq_no"] for op in e.history_ops_from(0)][:1] == [0]
+        # more writes + another flush: lease still holds the floor
+        e.index("d4", {"n": 4}, None)
+        e.flush()
+        ops = e.history_ops_from(2)
+        assert [op["seq_no"] for op in ops] == [2, 3, 4]
+
+    def test_leases_survive_restart(self, tmp_path):
+        e = Engine(tmp_path / "p", MapperService(MAPPINGS))
+        for i in range(3):
+            e.index(f"d{i}", {"n": i}, None)
+        import time
+
+        e.retention_leases.add_or_renew("peer_recovery/n2", 1,
+                                        now_ms=int(time.time() * 1000))
+        e.flush()
+        e2 = Engine(tmp_path / "p", MapperService(MAPPINGS))
+        assert e2.retention_leases.get("peer_recovery/n2") is not None
+        ops = e2.history_ops_from(1)
+        assert ops is not None and [o["seq_no"] for o in ops] == [1, 2]
+
+    def test_history_from_future_seq_is_empty(self, tmp_path):
+        e = Engine(tmp_path / "p", MapperService(MAPPINGS))
+        e.index("d0", {"n": 0}, None)
+        assert e.history_ops_from(1) == []
