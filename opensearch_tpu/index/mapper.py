@@ -918,10 +918,14 @@ class MapperService:
                     return FieldMapper(name, "date")
             except ValueError:
                 pass
-            # dynamic strings get text + .keyword sub-field, like the reference
+            # dynamic strings get text + .keyword sub-field, like the
+            # reference; the sub-field hangs off the parent's `fields` so
+            # document parsing populates its column too
             kw = FieldMapper(f"{name}.keyword", "keyword")
             self.mappers[f"{name}.keyword"] = kw
-            return FieldMapper(name, "text")
+            parent = FieldMapper(name, "text")
+            parent.fields["keyword"] = kw
+            return parent
         if isinstance(value, list):
             if value and all(isinstance(v, (int, float)) and not isinstance(v, bool) for v in value):
                 # plain numeric array -> numeric field (NOT dense_vector: the

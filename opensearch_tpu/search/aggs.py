@@ -206,7 +206,11 @@ def _sub_aggs(
 
 
 def _metric(typ, conf, segments, ms, masks, ext=None) -> dict:
-    field = conf["field"]
+    field = conf.get("field")
+    if field is None:
+        raise IllegalArgumentException(
+            f"Required one of fields [field, script], but none were "
+            f"specified. [{(ext or {}).get('agg_name', typ)}]")
     chunks = [
         _field_values(seg, field, masks[i], ms) for i, seg in enumerate(segments)
     ]
@@ -347,6 +351,7 @@ def _cardinality(conf, segments, ms, masks, ext=None) -> dict:
 def _terms(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
     field = conf["field"]
     size = int(conf.get("size", 10))
+    min_doc_count = int(conf.get("min_doc_count", 1))
     if ext and ext.get("partial"):
         # per-node over-fetch so the coordinator cut is accurate — the
         # reference's shard_size default (size * 1.5 + 10)
@@ -369,6 +374,106 @@ def _terms(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
             uniq, c = np.unique(vals, return_counts=True)
             for v, n in zip(uniq.tolist(), c.tolist()):
                 counts[v] = counts.get(v, 0) + n
+
+    mapper = ms.field_mapper(field)
+    vt = conf.get("value_type")
+    is_bool = (mapper is not None and mapper.type == "boolean") \
+        or vt == "boolean"
+    is_date = (mapper is not None and mapper.type == "date") or vt == "date"
+
+    # `missing`: docs in the bucket without a value count under the
+    # substitute key (also the whole story for unmapped fields, where
+    # `value_type` declares the key's rendering); the per-segment missing
+    # masks stick around so the bucket's sub-aggs run over those docs
+    missing_conf = conf.get("missing")
+    missing_key = None
+    missing_masks: list[np.ndarray] | None = None
+    if missing_conf is not None:
+        missing_masks = []
+        for i, seg in enumerate(segments):
+            kf = seg.keyword_fields.get(field)
+            nf = seg.numeric_fields.get(field)
+            if kf is not None:
+                present = np.zeros(seg.n_docs, bool)
+                present[kf.mv_docs] = True
+            elif nf is not None:
+                present = nf.present[:seg.n_docs]
+            else:
+                present = np.zeros(seg.n_docs, bool)
+            missing_masks.append(masks[i] & ~present)
+        n_missing = int(sum(m.sum() for m in missing_masks))
+        if n_missing:
+            key = missing_conf
+            if is_bool or isinstance(key, bool):
+                key = 1.0 if key in (True, "true", 1) else 0.0
+            elif is_date and isinstance(key, str):
+                key = float(parse_date_millis(key))
+            elif isinstance(key, (int, float)):
+                key = float(key)
+            missing_key = key
+            counts[key] = counts.get(key, 0) + n_missing
+
+    # include/exclude: exact lists, regex strings, or the partition form
+    # (IncludeExclude; partitions hash the term like the reference so a
+    # term lands in exactly one partition)
+
+    def _match_key(k) -> str:
+        # date terms include/exclude by formatted value; compare in ms;
+        # integral doubles canonicalize like the partition hash does
+        if is_date and not isinstance(k, str):
+            return str(int(k))
+        if isinstance(k, float) and k.is_integer():
+            return str(int(k))
+        return str(k)
+
+    def _spec_values(vals) -> set:
+        if is_date:
+            return {str(parse_date_millis(v)) for v in vals}
+        return {str(v) for v in vals}
+
+    include = conf.get("include")
+    exclude = conf.get("exclude")
+    if isinstance(include, dict):
+        num = int(include.get("num_partitions", 1))
+        part = int(include.get("partition", 0))
+        from opensearch_tpu.common.hashing import murmur3_x86_32
+
+        def _pkey(k) -> str:
+            # numeric terms hash their canonical long/double string form
+            if isinstance(k, float) and k.is_integer():
+                return str(int(k))
+            return str(k)
+
+        # seed 31 = IncludeExclude.HASH_PARTITIONING_SEED; floorMod over
+        # the SIGNED 32-bit hash, matching the reference exactly
+        def _part(k) -> int:
+            h = murmur3_x86_32(_pkey(k).encode(), seed=31)
+            if h >= 1 << 31:
+                h -= 1 << 32
+            return h % num
+
+        counts = {k: c for k, c in counts.items() if _part(k) == part}
+    elif isinstance(include, list):
+        want = _spec_values(include)
+        counts = {k: c for k, c in counts.items() if _match_key(k) in want}
+    elif isinstance(include, str):
+        import re as _re
+
+        rx = _re.compile(include)
+        counts = {k: c for k, c in counts.items()
+                  if rx.fullmatch(str(k))}
+    if isinstance(exclude, list):
+        drop = _spec_values(exclude)
+        counts = {k: c for k, c in counts.items()
+                  if _match_key(k) not in drop}
+    elif isinstance(exclude, str):
+        import re as _re
+
+        rx = _re.compile(exclude)
+        counts = {k: c for k, c in counts.items()
+                  if not rx.fullmatch(str(k))}
+    if min_doc_count > 0:
+        counts = {k: c for k, c in counts.items() if c >= min_doc_count}
     # order: {"_count": "desc"} | {"_key": "asc"} | {"<sub-agg-path>": dir},
     # or a list of such single-entry dicts (multi-criteria)
     order_conf = conf.get("order", {"_count": "desc"})
@@ -380,13 +485,20 @@ def _terms(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
         raise ParsingException(f"invalid terms order [{order_conf!r}]")
     needs_sub_order = any(k not in ("_count", "_key") for k, _ in order_specs)
 
+    def _bucket_masks_for(key) -> list[np.ndarray]:
+        bm = _value_masks(segments, field, key, masks, ms)
+        if missing_key is not None and key == missing_key:
+            # the missing bucket's sub-aggs cover the value-less docs too
+            bm = [b | mm for b, mm in zip(bm, missing_masks)]
+        return bm
+
     # compute sub-aggs per bucket up-front when ordering needs them (or
     # lazily after the cut otherwise)
     sub_results: dict[Any, dict] = {}
     if sub and needs_sub_order:
         for key in counts:
-            bucket_masks = _value_masks(segments, field, key, masks, ms)
-            sub_results[key] = _sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext)
+            sub_results[key] = _sub_aggs(
+                sub, segments, ms, _bucket_masks_for(key), filter_fn, ext)
 
     def _agg_path_value(key: Any, path: str) -> Any:
         name, _, prop = path.partition(".")
@@ -415,14 +527,15 @@ def _terms(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
     top = items[:size]
     other = sum(c for _, c in items[size:])
 
-    mapper = ms.field_mapper(field)
-    is_bool = mapper is not None and mapper.type == "boolean"
     buckets = []
     for key, count in top:
         bucket: dict[str, Any] = {}
         if is_bool:
             bucket["key"] = int(key)
             bucket["key_as_string"] = "true" if key else "false"
+        elif is_date and not isinstance(key, str):
+            bucket["key"] = int(key)
+            bucket["key_as_string"] = _iso_ms(int(key))
         elif isinstance(key, str):
             bucket["key"] = key
         else:
@@ -432,14 +545,24 @@ def _terms(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
             if key in sub_results:
                 bucket.update(sub_results[key])
             else:
-                bucket_masks = _value_masks(segments, field, key, masks, ms)
-                bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
+                bucket.update(_sub_aggs(
+                    sub, segments, ms, _bucket_masks_for(key), filter_fn,
+                    ext))
         buckets.append(bucket)
     return {
         "doc_count_error_upper_bound": 0,
         "sum_other_doc_count": other,
         "buckets": buckets,
     }
+
+
+def _iso_ms(ms_val: int) -> str:
+    """Epoch ms -> "2016-05-03T00:00:00.000Z" (the date formatter the
+    reference renders bucket key_as_string with)."""
+    import datetime as _dt
+
+    kdt = _dt.datetime.fromtimestamp(ms_val / 1000, _dt.timezone.utc)
+    return kdt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{ms_val % 1000:03d}Z"
 
 
 class _KeyOrd:
@@ -481,7 +604,10 @@ def _value_masks(segments, field, key, masks,
 
 # -- histogram --------------------------------------------------------------
 
-_CALENDAR_UNITS = {"month", "1M", "quarter", "1q", "year", "1y"}
+_CALENDAR_UNITS = {"month", "1M", "quarter", "1q", "year", "1y",
+                   "week", "1w"}
+# calendar word units with fixed duration: translate to fixed-interval form
+_CALENDAR_FIXED = {"second": "1s", "minute": "1m", "hour": "1h", "day": "1d"}
 
 
 def _histogram(conf, sub, segments, ms, masks, filter_fn, ext=None, date: bool = False) -> dict:
@@ -493,6 +619,10 @@ def _histogram(conf, sub, segments, ms, masks, filter_fn, ext=None, date: bool =
         )
         if interval_conf is None:
             raise ParsingException("date_histogram requires an interval")
+        # calendar word units of fixed duration ("day", "hour", ...)
+        # translate to the fixed-interval form
+        interval_conf = _CALENDAR_FIXED.get(str(interval_conf),
+                                            interval_conf)
         calendar = str(interval_conf) in _CALENDAR_UNITS or conf.get("calendar_interval") in _CALENDAR_UNITS
     else:
         interval_conf = conf["interval"]
@@ -505,6 +635,24 @@ def _histogram(conf, sub, segments, ms, masks, filter_fn, ext=None, date: bool =
     interval = None
     if not calendar:
         interval = parse_time_millis(interval_conf) if date else float(interval_conf)
+
+    hard = conf.get("hard_bounds") or None
+    if hard is not None and date:
+        hard = {k: parse_date_millis(v) for k, v in hard.items()}
+
+    # RANGE-typed fields: each doc's [lo, hi] interval counts in EVERY
+    # bucket it intersects (RangeHistogramAggregator); hard_bounds clamps
+    # the enumerated span
+    field_mapper = ms.field_mapper(field) if hasattr(ms, "field_mapper") \
+        else None
+    from opensearch_tpu.index.mapper import RANGE_TYPES as _RT
+
+    if field_mapper is not None and field_mapper.type in _RT:
+        return _histogram_over_ranges(
+            conf, sub, segments, ms, masks, filter_fn, ext,
+            field=field, date=date, calendar=calendar,
+            interval_conf=interval_conf, interval=interval, offset=offset,
+            hard=hard)
 
     # collect (key -> count) and per-key masks lazily for sub-aggs
     key_counts: dict[float, int] = {}
@@ -606,11 +754,97 @@ def _histogram(conf, sub, segments, ms, masks, filter_fn, ext=None, date: bool =
 
 
 def _calendar_next(key_ms: float, unit: str) -> float:
+    if unit in ("week", "1w"):
+        return key_ms + 7 * 86_400_000
     dt = _dt.datetime.fromtimestamp(key_ms / 1000, _dt.timezone.utc)
     months = {"month": 1, "1M": 1, "quarter": 3, "1q": 3}.get(unit, 12)
     month0 = dt.month - 1 + months
     nxt = dt.replace(year=dt.year + month0 // 12, month=month0 % 12 + 1)
     return nxt.timestamp() * 1000
+
+
+def _histogram_over_ranges(conf, sub, segments, ms, masks, filter_fn, ext,
+                           *, field, date, calendar, interval_conf,
+                           interval, offset, hard) -> dict:
+    """(date_)histogram over a RANGE field: the doc's stored [lo, hi]
+    interval contributes to every bucket it intersects
+    (RangeHistogramAggregator); hard_bounds clamps the span AND enumerates
+    every bucket inside the bounds (empties included)."""
+    lo_f, hi_f = f"{field}#lo", f"{field}#hi"
+    hmin = hard.get("min") if hard else None
+    hmax = hard.get("max") if hard else None
+
+    def bucket_of(v: float) -> float:
+        if calendar:
+            return float(_calendar_keys(np.asarray([v]),
+                                        str(interval_conf))[0])
+        return float(np.floor((v - offset) / interval) * interval + offset)
+
+    def next_key(k: float) -> float:
+        if calendar:
+            return _calendar_next(k, str(interval_conf))
+        return k + interval
+
+    key_counts: dict[float, int] = {}
+    doc_lists: dict[float, list] = {}
+    for i, seg in enumerate(segments):
+        lo_nf = seg.numeric_fields.get(lo_f)
+        hi_nf = seg.numeric_fields.get(hi_f)
+        if lo_nf is None or hi_nf is None:
+            continue
+        lo_vals = lo_nf.values_i64 if lo_nf.kind == "int" else lo_nf.values_f64
+        hi_vals = hi_nf.values_i64 if hi_nf.kind == "int" else hi_nf.values_f64
+        m = masks[i] & lo_nf.present[:seg.n_docs]
+        for d in np.nonzero(m)[0].tolist():
+            lo, hi = float(lo_vals[d]), float(hi_vals[d])
+            if hmin is not None:
+                lo = max(lo, float(hmin))
+            if hmax is not None:
+                hi = min(hi, float(hmax))
+            if hi < lo:
+                continue
+            if hmin is None and hmax is None and (hi - lo) > 1e15:
+                # unbounded side without hard_bounds would enumerate the
+                # whole int64 domain; clamp like the reference refuses
+                raise IllegalArgumentException(
+                    f"[{field}] range is unbounded; set [hard_bounds] on "
+                    f"the histogram")
+            k = bucket_of(lo)
+            n_keys = 0
+            while k <= hi:
+                key_counts[k] = key_counts.get(k, 0) + 1
+                doc_lists.setdefault(k, []).append((i, d))
+                k = next_key(k)
+                n_keys += 1
+                if n_keys > MAX_BUCKETS:
+                    raise TooManyBucketsException(MAX_BUCKETS)
+    # hard bounds enumerate the FULL bounded span, empties included
+    if hmin is not None and hmax is not None:
+        k = bucket_of(float(hmin))
+        n_keys = 0
+        while k <= float(hmax):
+            key_counts.setdefault(k, 0)
+            k = next_key(k)
+            n_keys += 1
+            if n_keys > MAX_BUCKETS:
+                raise TooManyBucketsException(MAX_BUCKETS)
+
+    buckets = []
+    for key in sorted(key_counts):
+        bucket: dict[str, Any] = {
+            "key": int(key) if date else key,
+            "doc_count": key_counts[key],
+        }
+        if date:
+            bucket["key_as_string"] = _iso_ms(int(key))
+        if sub:
+            bucket_masks = [np.zeros(s.n_docs, bool) for s in segments]
+            for i, d in doc_lists.get(key, []):
+                bucket_masks[i][d] = True
+            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks,
+                                    filter_fn, ext))
+        buckets.append(bucket)
+    return {"buckets": buckets}
 
 
 def _calendar_keys(vals_ms: np.ndarray, unit: str) -> np.ndarray:
@@ -619,6 +853,10 @@ def _calendar_keys(vals_ms: np.ndarray, unit: str) -> np.ndarray:
         dt = _dt.datetime.fromtimestamp(float(v) / 1000, _dt.timezone.utc)
         if unit in ("month", "1M"):
             key_dt = dt.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+        elif unit in ("week", "1w"):
+            # ISO weeks snap to Monday (DateHistogramInterval.WEEK)
+            key_dt = (dt - _dt.timedelta(days=dt.weekday())).replace(
+                hour=0, minute=0, second=0, microsecond=0)
         elif unit in ("quarter", "1q"):
             key_dt = dt.replace(
                 month=(dt.month - 1) // 3 * 3 + 1,
@@ -698,13 +936,35 @@ def _filter_agg(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
 
 def _filters_agg(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
     named = conf.get("filters")
+    other = conf.get("other_bucket") or conf.get("other_bucket_key")
+    anonymous = isinstance(named, list)
+    entries = (list(enumerate(named)) if anonymous
+               else list(named.items()))
     buckets: dict[str, Any] = {}
-    for fname, body in named.items():
+    ordered: list[dict] = []
+    matched_any = [np.zeros(seg.n_docs, bool) for seg in segments]
+    for fname, body in entries:
         f_masks = _run_filter(filter_fn, body, segments, masks)
+        for i, m in enumerate(f_masks):
+            matched_any[i] |= m
         bucket = {"doc_count": int(sum(m.sum() for m in f_masks))}
         bucket.update(_sub_aggs(sub, segments, ms, f_masks, filter_fn, ext))
-        buckets[fname] = bucket
-    return {"buckets": buckets}
+        if anonymous:
+            ordered.append(bucket)
+        else:
+            buckets[fname] = bucket
+    if other:
+        rest = [masks[i] & ~matched_any[i] for i in range(len(segments))]
+        bucket = {"doc_count": int(sum(m.sum() for m in rest))}
+        bucket.update(_sub_aggs(sub, segments, ms, rest, filter_fn, ext))
+        key = (conf.get("other_bucket_key")
+               if isinstance(conf.get("other_bucket_key"), str) else "_other_")
+        if anonymous:
+            ordered.append(bucket)
+        else:
+            buckets[key] = bucket
+    # anonymous form renders a bucket ARRAY (FiltersAggregator.Keyed=false)
+    return {"buckets": ordered if anonymous else buckets}
 
 
 def _count_nested_objects(obj, parts: list[str]) -> int:

@@ -207,8 +207,23 @@ def _validate_percentile_params(conf, ext) -> int | None:
     return digits
 
 
+def _require_numeric_field(conf, ms, segments, typ, ext) -> None:
+    """Numeric-only metric aggs 400 on keyword/text fields
+    (ValuesSourceConfig type resolution)."""
+    field = conf.get("field")
+    mapper = ms.field_mapper(field) if field else None
+    if mapper is not None and mapper.type in ("text", "keyword") and \
+            not any(seg.numeric_fields.get(field) is not None
+                    for seg in segments):
+        raise IllegalArgumentException(
+            f"Field [{field}] of type "
+            f"[{mapper.original_type or mapper.type}] is not supported "
+            f"for aggregation [{typ}]")
+
+
 def _percentiles(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
     hdr_digits = _validate_percentile_params(conf, ext)
+    _require_numeric_field(conf, ms, segments, "percentiles", ext)
     vals = _collect(segments, ms, masks, conf["field"], conf.get("missing"))
     raw_percents = conf.get("percents", _DEFAULT_PERCENTS)
     if not isinstance(raw_percents, list) or not raw_percents:
@@ -256,6 +271,13 @@ def _percentile_ranks(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
 
 
 def _median_absolute_deviation(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    comp = conf.get("compression")
+    if comp is not None and float(comp) <= 0:
+        raise IllegalArgumentException(
+            f"[compression] must be greater than 0. "
+            f"Found [{float(comp)}] in [{(ext or {}).get('agg_name', 'mad')}]")
+    _require_numeric_field(conf, ms, segments,
+                           "median_absolute_deviation", ext)
     vals = _collect(segments, ms, masks, conf["field"], conf.get("missing")).astype(np.float64)
     if len(vals) == 0:
         out = {"value": None}
@@ -544,13 +566,43 @@ def _seg_key_values(seg, field, ms):
 
 
 def _multi_terms(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
+    from opensearch_tpu.search.aggs import _KeyOrd, _iso_ms
+
     terms_conf = conf.get("terms") or []
     fields = [t["field"] for t in terms_conf]
+    missings = [t.get("missing") for t in terms_conf]
     if len(fields) < 2:
         raise ParsingException("multi_terms requires at least 2 terms sources")
     size = int(conf.get("size", 10))
+    min_doc_count = int(conf.get("min_doc_count", 1))
     if ext and ext.get("partial"):
         size = int(conf.get("shard_size", size + (size >> 1) + 10))
+
+    # per-component rendering kind (boolean -> JSON true/false,
+    # date -> ISO string, like MultiTermsAggregator's per-source formats)
+    kinds = []
+    for f in fields:
+        mapper = ms.field_mapper(f)
+        if mapper is not None and mapper.type == "boolean":
+            kinds.append("boolean")
+        elif mapper is not None and mapper.type == "date":
+            kinds.append("date")
+        else:
+            kinds.append("value")
+
+    # coerce per-source `missing` values to the source's kind up-front so
+    # key tuples stay type-uniform (mixed str/float slots break the sort)
+    coerced_missing: list = []
+    for m_, kind in zip(missings, kinds):
+        if m_ is None:
+            coerced_missing.append(None)
+        elif kind == "boolean":
+            coerced_missing.append(1 if m_ in (True, "true", 1) else 0)
+        elif kind == "date" and isinstance(m_, str):
+            coerced_missing.append(int(parse_date_millis(m_)))
+        else:
+            coerced_missing.append(m_)
+
     counts: dict[tuple, int] = {}
     doc_lists: dict[tuple, list] = {}
     for i, seg in enumerate(segments):
@@ -559,8 +611,11 @@ def _multi_terms(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
         for d in docs.tolist():
             key_parts = []
             ok = True
-            for src, present, kind in per_field:
-                if not present[d]:
+            for fi, (src, present, kind) in enumerate(per_field):
+                if kind == "none" or not present[d]:
+                    if coerced_missing[fi] is not None:
+                        key_parts.append(coerced_missing[fi])
+                        continue
                     ok = False
                     break
                 if kind == "keyword":
@@ -573,21 +628,74 @@ def _multi_terms(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
             key = tuple(key_parts)
             counts[key] = counts.get(key, 0) + 1
             doc_lists.setdefault(key, []).append((i, d))
-    items = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+
+    if min_doc_count > 0:
+        counts = {k: c for k, c in counts.items() if c >= min_doc_count}
+
+    # order: single dict or list of {"_count"|"_key"|"<agg-path>": dir}
+    order_conf = conf.get("order", {"_count": "desc"})
+    order_specs = (list(order_conf.items()) if isinstance(order_conf, dict)
+                   else [next(iter(o.items())) for o in order_conf])
+    sub_results: dict[tuple, dict] = {}
+
+    def bucket_sub(key) -> dict:
+        if key not in sub_results:
+            bucket_masks = [np.zeros(s.n_docs, bool) for s in segments]
+            for i, d in doc_lists.get(key, []):
+                bucket_masks[i][d] = True
+            sub_results[key] = _sub_aggs(sub, segments, ms, bucket_masks,
+                                         filter_fn, ext)
+        return sub_results[key]
+
+    def sort_key(kv):
+        key, count = kv
+        parts = []
+        for okey, odir in order_specs:
+            desc = odir == "desc"
+            if okey == "_count":
+                parts.append(-count if desc else count)
+            elif okey == "_key":
+                parts.append(tuple(_KeyOrd(k, desc) for k in key))
+            else:
+                name, _, prop = okey.partition(".")
+                result = (bucket_sub(key) if sub else {}).get(name)
+                if result is None:
+                    raise ParsingException(
+                        f"multi_terms order references unknown agg [{okey}]")
+                v = result.get(prop or "value")
+                v = v if v is not None else float("-inf")
+                parts.append(-v if desc else v)
+        parts.append(tuple(_KeyOrd(k, False) for k in key))
+        return tuple(parts)
+
+    items = sorted(counts.items(), key=sort_key)
     top = items[:size]
     other = sum(c for _, c in items[size:])
+
+    def render(k, kind):
+        if kind == "boolean":
+            return bool(k)
+        if kind == "date" and not isinstance(k, str):
+            return _iso_ms(int(k))
+        return k
+
+    def render_str(k, kind):
+        if kind == "boolean":
+            return "true" if k else "false"
+        if kind == "date" and not isinstance(k, str):
+            return _iso_ms(int(k))
+        return str(k)
+
     buckets = []
     for key, count in top:
         bucket = {
-            "key": list(key),
-            "key_as_string": "|".join(str(k) for k in key),
+            "key": [render(k, kind) for k, kind in zip(key, kinds)],
+            "key_as_string": "|".join(
+                render_str(k, kind) for k, kind in zip(key, kinds)),
             "doc_count": count,
         }
         if sub:
-            bucket_masks = [np.zeros(s.n_docs, bool) for s in segments]
-            for i, d in doc_lists[key]:
-                bucket_masks[i][d] = True
-            bucket.update(_sub_aggs(sub, segments, ms, bucket_masks, filter_fn, ext))
+            bucket.update(bucket_sub(key))
         buckets.append(bucket)
     return {
         "doc_count_error_upper_bound": 0,
@@ -853,7 +961,11 @@ def _date_range(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
 def _composite(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
     sources = conf.get("sources") or []
     if not sources:
-        raise ParsingException("composite requires sources")
+        # both message forms appear across reference versions; the suite
+        # greps for either
+        raise ParsingException(
+            "Required [sources]: Composite [sources] cannot be null or "
+            "empty")
     size = int(conf.get("size", 10))
     after = conf.get("after")
     specs = []  # (name, type, conf)
@@ -872,45 +984,87 @@ def _composite(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
     for i, seg in enumerate(segments):
         per_src = []
         for name, typ, sconf in specs:
-            per_src.append((_seg_key_values(seg, sconf["field"], ms), typ, sconf))
+            field = sconf["field"]
+            # composite buckets EVERY value of a multi-valued field
+            # (CompositeValuesSource iterates all ords per doc); the
+            # keyword CSR (mv_offsets into mv_ords) gives per-doc slices
+            kf = seg.keyword_fields.get(field)
+            per_src.append(
+                (_seg_key_values(seg, field, ms), typ, sconf, kf))
+        import itertools as _it
+
         for d in np.nonzero(masks[i])[0].tolist():
-            key_parts = []
+            # list of alternatives per source; None = missing_bucket slot
+            parts_options: list[list] = []
             ok = True
-            for (src, present, kind), typ, sconf in per_src:
+            for (src, present, kind), typ, sconf, kf in per_src:
                 if not present[d]:
+                    if sconf.get("missing_bucket"):
+                        parts_options.append([None])
+                        continue
                     ok = False
                     break
                 if kind == "keyword":
-                    v: Any = src.ord_values[int(src.first_ord[d])]
-                else:
-                    v = float(src[d])
-                if typ == "histogram":
-                    interval = float(sconf["interval"])
-                    v = math.floor(v / interval) * interval
-                elif typ == "date_histogram":
-                    iv = str(sconf.get("fixed_interval") or sconf.get("calendar_interval") or sconf.get("interval"))
-                    if iv in _CALENDAR_UNITS:
-                        v = int(_calendar_keys(np.asarray([v]), iv)[0])
+                    if kf is not None:
+                        s_, e_ = (int(kf.mv_offsets[d]),
+                                  int(kf.mv_offsets[d + 1]))
+                        vals = [kf.ord_values[int(o)]
+                                for o in kf.mv_ords[s_:e_]]
                     else:
-                        interval = float(parse_time_millis(iv))
-                        v = int(math.floor(v / interval) * interval)
-                elif kind == "numeric" and float(v).is_integer():
-                    v = int(v)
-                key_parts.append(v)
+                        vals = []
+                else:
+                    nf = seg.numeric_fields.get(sconf["field"])
+                    if nf is not None and nf.mv_offsets is not None:
+                        # every value of a multi-valued numeric buckets
+                        vals = [float(x) for x in nf.doc_values(d)]
+                    else:
+                        vals = [float(src[d])]
+                opts = []
+                for v in vals:
+                    if typ == "histogram":
+                        interval = float(sconf["interval"])
+                        v = math.floor(v / interval) * interval
+                    elif typ == "date_histogram":
+                        from opensearch_tpu.search.aggs import (
+                            _CALENDAR_FIXED,
+                        )
+
+                        iv = str(sconf.get("fixed_interval") or sconf.get("calendar_interval") or sconf.get("interval"))
+                        iv = _CALENDAR_FIXED.get(iv, iv)
+                        off = float(parse_time_millis(
+                            sconf.get("offset", 0)))
+                        if iv in _CALENDAR_UNITS:
+                            v = int(_calendar_keys(np.asarray([v]), iv)[0])
+                        else:
+                            interval = float(parse_time_millis(iv))
+                            v = int(math.floor((v - off) / interval)
+                                    * interval + off)
+                    elif kind == "numeric" and float(v).is_integer():
+                        v = int(v)
+                    if v not in opts:
+                        opts.append(v)
+                parts_options.append(opts)
             if not ok:
                 continue
-            key = tuple(key_parts)
-            counts[key] = counts.get(key, 0) + 1
-            doc_lists.setdefault(key, []).append((i, d))
+            for combo in _it.product(*parts_options):
+                key = tuple(combo)
+                counts[key] = counts.get(key, 0) + 1
+                doc_lists.setdefault(key, []).append((i, d))
 
     orders = [
         -1 if (spec[2].get("order", "asc") == "desc") else 1 for spec in specs
     ]
 
+    missing_orders = [spec[2].get("missing_order", "first")
+                      for spec in specs]
+
     def key_sortable(key: tuple) -> tuple:
         parts = []
-        for v, o in zip(key, orders):
-            if isinstance(v, str):
+        for v, o, mo in zip(key, orders, missing_orders):
+            if v is None:
+                # missing buckets sort first unless missing_order=last
+                parts.append((2 if mo == "last" else -1, 0))
+            elif isinstance(v, str):
                 parts.append((0, _RevStr(v) if o < 0 else v))
             else:
                 parts.append((1, -v if o < 0 else v))
@@ -918,14 +1072,58 @@ def _composite(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
 
     ordered = sorted(counts, key=key_sortable)
     if after is not None:
-        after_key = tuple(after[name] for name, _, _ in specs)
-        cutoff = key_sortable(after_key)
+        parts = []
+        for name, typ, sconf in specs:
+            v = after.get(name)
+            # a formatted after value round-trips back to epoch ms
+            if typ == "date_histogram" and isinstance(v, str):
+                try:
+                    v = int(parse_date_millis(v))
+                except ValueError:
+                    pass
+            parts.append(v)
+        cutoff = key_sortable(tuple(parts))
         ordered = [k for k in ordered if key_sortable(k) > cutoff]
     page = ordered[:size]
+
+    _NAMED_FORMATS = {
+        "strict_date": "yyyy-MM-dd", "date": "yyyy-MM-dd",
+        "basic_date": "yyyyMMdd",
+        "strict_date_time": "yyyy-MM-dd'T'HH:mm:ss.SSSZ",
+    }
+
+    def render_part(v, spec):
+        _name, typ, sconf = spec
+        if v is None or typ != "date_histogram":
+            return v
+        fmt = sconf.get("format")
+        if not fmt:
+            return v
+        if fmt == "epoch_millis":
+            return str(int(v))
+        from opensearch_tpu.search.aggs import _iso_ms
+
+        if fmt == "iso8601":
+            return _iso_ms(int(v))
+        from opensearch_tpu.search.fetch import _JODA_MAP
+
+        py_fmt = _NAMED_FORMATS.get(str(fmt), str(fmt))
+        for jd, st in _JODA_MAP:
+            py_fmt = py_fmt.replace(jd, st)
+        py_fmt = py_fmt.replace("'T'", "T").replace("SSS", "{ms}") \
+            .replace("Z", "Z")
+        kdt = _dt.datetime.fromtimestamp(v / 1000, _dt.timezone.utc)
+        out_s = kdt.strftime(py_fmt)
+        return out_s.replace("{ms}", f"{int(v) % 1000:03d}")
+
+    def render_key(key) -> dict:
+        return {spec[0]: render_part(v, spec)
+                for spec, v in zip(specs, key)}
+
     buckets = []
     for key in page:
         bucket = {
-            "key": {name: v for (name, _, _), v in zip(specs, key)},
+            "key": render_key(key),
             "doc_count": counts[key],
         }
         if sub:
@@ -936,7 +1134,7 @@ def _composite(conf, sub, segments, ms, masks, filter_fn, ext) -> dict:
         buckets.append(bucket)
     out: dict[str, Any] = {"buckets": buckets}
     if page:
-        out["after_key"] = {name: v for (name, _, _), v in zip(specs, page[-1])}
+        out["after_key"] = render_key(page[-1])
     return out
 
 
@@ -963,6 +1161,14 @@ def _auto_date_histogram(conf, sub, segments, ms, masks, filter_fn, ext) -> dict
         if (math.floor(hi / iv) - math.floor(lo / iv) + 1) <= target:
             chosen, interval = name, iv
             break
+    # multi-day intervals anchor at the first DAY-rounded data point, not
+    # at the epoch (the reference's RoundingInfo innerIntervals: values
+    # round to the base unit, then group into interval-sized runs)
+    day = 86_400_000
+    if interval > day and interval % day == 0:
+        anchor = math.floor(lo / day) * day
+    else:
+        anchor = 0.0
     key_counts: dict[float, int] = {}
     per_seg_keys, per_seg_docs = [], []
     for i, seg in enumerate(segments):
@@ -973,17 +1179,20 @@ def _auto_date_histogram(conf, sub, segments, ms, masks, filter_fn, ext) -> dict
             continue
         m = masks[i] & pres
         docs = np.nonzero(m)[0]
-        keys = np.floor(vals[docs].astype(np.float64) / interval) * interval
+        keys = (np.floor((vals[docs].astype(np.float64) - anchor)
+                         / interval) * interval + anchor)
         per_seg_keys.append(keys)
         per_seg_docs.append(docs)
         uniq, c = np.unique(keys, return_counts=True)
         for k_, n_ in zip(uniq.tolist(), c.tolist()):
             key_counts[k_] = key_counts.get(k_, 0) + n_
+    from opensearch_tpu.search.aggs import _iso_ms
+
     buckets = []
     for key in sorted(key_counts):
         bucket: dict[str, Any] = {
             "key": int(key),
-            "key_as_string": _iso(key),
+            "key_as_string": _iso_ms(int(key)),
             "doc_count": key_counts[key],
         }
         if sub:

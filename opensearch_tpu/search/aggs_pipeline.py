@@ -32,6 +32,33 @@ SIBLING_TYPES = {
 PIPELINE_TYPES = PARENT_TYPES | SIBLING_TYPES
 
 
+def validate_pipeline_aggs(aggs_body: dict, top: bool = True) -> None:
+    """Request-time parameter/placement validation for pipeline aggs
+    (AbstractPipelineAggregationBuilder.validate): parent pipelines cannot
+    sit at the top level, and moving windows must be positive."""
+    if not isinstance(aggs_body, dict):
+        return
+    for name, body in aggs_body.items():
+        if not isinstance(body, dict):
+            continue
+        typ = _agg_type(body)
+        if typ in PARENT_TYPES:
+            conf = body.get(typ) or {}
+            # parameter errors outrank placement errors (the reference
+            # validates the builder before tree placement)
+            if typ in ("moving_fn", "moving_avg") and \
+                    int(conf.get("window", 5)) <= 0:
+                raise IllegalArgumentException(
+                    "[window] must be a positive, non-zero integer.")
+            if top:
+                raise IllegalArgumentException(
+                    f"{typ} aggregation [{name}] must be declared inside "
+                    f"of another aggregation")
+        sub = body.get("aggs") or body.get("aggregations")
+        if sub:
+            validate_pipeline_aggs(sub, top=False)
+
+
 def apply_pipeline_aggs(aggs_body: dict, results: dict) -> None:
     """Walk the request body and materialize pipeline aggs into `results`
     (mutated in place)."""
@@ -121,6 +148,12 @@ def _resolve_sibling_values(path: str, results: dict) -> tuple[list, list]:
     keys, vals = [], []
     for b in buckets:
         keys.append(b.get("key"))
+        # BucketHelpers.resolveBucketValue: an EMPTY bucket resolves to
+        # NaN under the default skip gap policy (doc_count counts as a
+        # value only for the _count metric)
+        if metric != "_count" and b.get("doc_count") == 0:
+            vals.append(None)
+            continue
         vals.append(_bucket_value(b, metric))
     return keys, vals
 

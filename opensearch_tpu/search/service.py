@@ -119,6 +119,12 @@ def search(
     if isinstance(sort, (str, dict)):
         sort = [sort]
     aggs_body = body.get("aggs") or body.get("aggregations")
+    if aggs_body:
+        from opensearch_tpu.search.aggs_pipeline import (
+            validate_pipeline_aggs,
+        )
+
+        validate_pipeline_aggs(aggs_body)
     min_score = body.get("min_score")
     search_after = body.get("search_after")
     if search_after is not None and not sort:
