@@ -145,13 +145,15 @@ def _ivfpq_row(row: int, label: str, n: int, d: int, m: int, nlist: int,
     ]
 
     t0 = time.perf_counter()
+    # indexes carry LOCAL doc ids; the cross-shard merge below adds each
+    # shard's offset exactly once
     indexes = [
         ivfpq.build(
-            sl, np.arange(i * per_shard, (i + 1) * per_shard, dtype=np.int32),
+            sl, np.arange(per_shard, dtype=np.int32),
             nlist=nlist, m=m, iters=10,
             normalized=similarity == "cosine",
         )
-        for i, sl in enumerate(shard_slices)
+        for sl in shard_slices
     ]
     build_s = time.perf_counter() - t0
 

@@ -585,6 +585,21 @@ class TpuNode:
         # validate + stage first: the reference applies the action list
         # atomically in one cluster-state update
         staged: list[tuple[str, str, str, dict | None]] = []
+        # indices removed by THIS request: an added alias may take a name
+        # a remove_index in the same atomic batch is freeing
+        removing_indices: set[str] = set()
+        for action in actions:
+            if isinstance(action, dict) and "remove_index" in action:
+                conf0 = action["remove_index"]
+                if isinstance(conf0, dict):
+                    for iexpr in (conf0.get("indices")
+                                  or ([conf0["index"]]
+                                      if conf0.get("index") else [])):
+                        try:
+                            removing_indices.update(self.resolve_indices(
+                                iexpr, expand_wildcards="all"))
+                        except OpenSearchTpuException:
+                            pass
         for action in actions:
             if not isinstance(action, dict) or len(action) != 1:
                 raise IllegalArgumentException(
@@ -615,12 +630,15 @@ class TpuNode:
                 staged.extend((kind, name, "", None) for name in resolved)
                 continue
             if not aliases:
+                if "aliases" in conf:
+                    raise IllegalArgumentException("[aliases] can't be empty")
                 raise IllegalArgumentException(
                     f"[aliases] action [{kind}] requires an alias"
                 )
             for name in resolved:
                 for alias in aliases:
-                    if kind == "add" and alias in self.indices:
+                    if kind == "add" and alias in self.indices \
+                            and alias not in removing_indices:
                         raise IllegalArgumentException(
                             f"alias [{alias}] clashes with an index name"
                         )
@@ -659,7 +677,7 @@ class TpuNode:
             if kind == "add":
                 entry: dict = {}
                 for key in ("filter", "routing", "index_routing",
-                            "search_routing", "is_write_index"):
+                            "search_routing", "is_write_index", "is_hidden"):
                     if conf.get(key) is not None:
                         entry[key] = conf[key]
                 svc.aliases[alias] = entry
@@ -667,9 +685,18 @@ class TpuNode:
                 for a in list(svc.aliases):
                     if a == alias or simple_match(a, alias):
                         del svc.aliases[a]
+        import shutil
+
         for name in to_delete:
-            if name in self.indices:
-                self.delete_index(name)
+            # delete by CONCRETE name: an add action in this same batch may
+            # have just taken the name as an alias, which would trip
+            # delete_index's alias-ambiguity check
+            svc = self.indices.pop(name, None)
+            if svc is not None:
+                svc.close()
+                shutil.rmtree(self._index_path(name), ignore_errors=True)
+        if to_delete:
+            self._configure_slowlogs()
         self._persist_index_registry()
         return {"acknowledged": True}
 
@@ -701,34 +728,89 @@ class TpuNode:
         return {"acknowledged": True}
 
     def get_alias(self, index_expr: str | None = None,
-                  alias_expr: str | None = None) -> dict:
+                  alias_expr: str | None = None,
+                  expand_wildcards: str = "all") -> dict:
+        """GET [/{index}]/_alias[/{name}] (TransportGetAliasesAction):
+        `name` takes comma lists, wildcards, and "-pattern" exclusions
+        applied in order; a CONCRETE requested alias that resolves to
+        nothing makes the whole response a 404 that still carries the
+        found entries (the handler reads the `status`/`error` riders)."""
         import fnmatch
 
         names = (
-            self.resolve_indices(index_expr, expand_wildcards="all")
-            if index_expr else sorted(self.indices)
+            self.resolve_indices(index_expr,
+                                 expand_wildcards=expand_wildcards)
+            if index_expr else sorted(
+                n for n in self.indices
+                if "closed" in expand_wildcards or "all" in expand_wildcards
+                or not self.indices[n].closed
+            )
         )
 
         def echo(conf: dict) -> dict:
             # "routing" renders as index_routing + search_routing
-            # (AliasMetadata's response shape)
+            # (AliasMetadata's response shape); routing values are strings
             conf = dict(conf or {})
             if "routing" in conf:
-                conf.setdefault("index_routing", conf["routing"])
-                conf.setdefault("search_routing", conf["routing"])
+                conf.setdefault("index_routing", str(conf["routing"]))
+                conf.setdefault("search_routing", str(conf["routing"]))
                 del conf["routing"]
+            for k in ("index_routing", "search_routing"):
+                if k in conf:
+                    conf[k] = str(conf[k])
             return conf
 
-        out: dict[str, dict] = {}
+        all_alias_names = {
+            a for name in names for a in self._get_index(name).aliases
+        }
+        if alias_expr in ("_all", "*"):
+            alias_expr = "*"  # explicit catch-all: alias-less indices drop
+        parts = ([p.strip() for p in str(alias_expr).split(",") if p.strip()]
+                 if alias_expr not in (None, "") else None)
+        missing: list[str] = []
+        selected: set | None = None
+        if parts is not None:
+            selected = set()
+            # a leading "-name" with nothing selected yet is a LITERAL
+            # alias request (dash included) and 404s; once any wildcard or
+            # plain part appeared, "-x" is a plain exclusion
+            active = False
+            for part in parts:
+                wildcard = "*" in part or "?" in part
+                if part.startswith("-"):
+                    pat = part[1:]
+                    hits = {a for a in selected if fnmatch.fnmatch(a, pat)}
+                    if hits:
+                        selected -= hits
+                    elif not wildcard and not active:
+                        missing.append(part)
+                    if wildcard:
+                        active = True
+                elif wildcard:
+                    selected |= {a for a in all_alias_names
+                                 if fnmatch.fnmatch(a, part)}
+                    active = True
+                else:
+                    active = True
+                    if part in all_alias_names:
+                        selected.add(part)
+                    else:
+                        missing.append(part)
+
+        out: dict[str, Any] = {}
         for name in names:
             svc = self._get_index(name)
             matched = {
                 a: echo(c) for a, c in svc.aliases.items()
-                if alias_expr is None or alias_expr in ("_all", "*")
-                or fnmatch.fnmatch(a, alias_expr)
+                if selected is None or a in selected
             }
-            if matched or alias_expr is None:
+            if matched or parts is None:
                 out[name] = {"aliases": matched}
+        if missing:
+            missing.sort()
+            label = "aliases" if len(missing) > 1 else "alias"
+            out["error"] = f"{label} [{','.join(missing)}] missing"
+            out["status"] = 404
         return out
 
     def resolve_write_target(self, name: str, for_write: bool = True) -> str:

@@ -196,6 +196,12 @@ def build_router() -> Router:
     reg("POST", "/_aliases", update_aliases)
     reg("PUT", "/{index}/_alias/{name}", put_alias)
     reg("POST", "/{index}/_alias/{name}", put_alias)
+    reg("PUT", "/{index}/_alias", put_alias)
+    reg("POST", "/{index}/_alias", put_alias)
+    reg("PUT", "/_alias/{name}", put_alias)
+    reg("POST", "/_alias/{name}", put_alias)
+    reg("PUT", "/_alias", put_alias)
+    reg("POST", "/_alias", put_alias)
     reg("PUT", "/{index}/_aliases/{name}", put_alias)
     reg("DELETE", "/{index}/_alias/{name}", delete_alias)
     reg("DELETE", "/{index}/_aliases/{name}", delete_alias)
@@ -203,6 +209,8 @@ def build_router() -> Router:
     reg("GET", "/_alias/{name}", get_alias_by_name)
     reg("GET", "/{index}/_alias", get_alias_index)
     reg("GET", "/{index}/_alias/{name}", get_alias_index_name)
+    reg("HEAD", "/_alias/{name}", exists_alias)
+    reg("HEAD", "/{index}/_alias/{name}", exists_alias)
     # index templates
     reg("PUT", "/_template/{name}", put_legacy_template)
     reg("POST", "/_template/{name}", put_legacy_template)
@@ -1477,28 +1485,76 @@ def update_aliases(node: TpuNode, params, query, body):
 
 
 def put_alias(node: TpuNode, params, query, body):
-    return 200, node.put_alias(params["index"], params["name"], body)
+    # the body's index/alias OVERRIDE the path parts (RestIndexPutAliasAction
+    # reads both forms); one of each must resolve
+    body = body or {}
+    if not isinstance(body, dict):
+        raise IllegalArgumentException(
+            "put alias request body must be an object")
+    index = body.get("index") or params.get("index")
+    name = body.get("alias") or params.get("name")
+    if not index or not name:
+        raise IllegalArgumentException(
+            "put alias requires an index and an alias name")
+    if any(c in str(name) for c in '*?"<>| ,#'):
+        raise IllegalArgumentException(
+            f"invalid alias name [{name}]")
+    conf = {k: v for k, v in body.items() if k not in ("index", "alias")}
+    unknown = set(conf) - {"filter", "routing", "index_routing",
+                           "search_routing", "is_write_index", "is_hidden",
+                           "must_exist"}
+    if unknown:
+        raise IllegalArgumentException(
+            f"unknown field [{sorted(unknown)[0]}]")
+    return 200, node.put_alias(str(index), str(name), conf)
 
 
 def delete_alias(node: TpuNode, params, query, body):
     return 200, node.delete_alias(params["index"], params["name"])
 
 
+def _alias_response(resp: dict):
+    # the 404 body KEEPS the status/error riders (the YAML suite matches
+    # both alongside the found aliases). Type-check the riders: "status"
+    # and "error" are legal INDEX names, whose entries are dicts
+    status = resp.get("status")
+    if isinstance(status, int) and isinstance(resp.get("error"), str):
+        return status, resp
+    return 200, resp
+
+
+def exists_alias(node: TpuNode, params, query, body):
+    resp = node.get_alias(
+        index_expr=params.get("index"), alias_expr=params["name"],
+        expand_wildcards=str(query.get("expand_wildcards", "all")))
+    found = any(v.get("aliases") for v in resp.values()
+                if isinstance(v, dict))
+    missed = isinstance(resp.get("error"), str) and \
+        isinstance(resp.get("status"), int)
+    return (200 if found and not missed else 404), ""
+
+
 def get_alias_all(node: TpuNode, params, query, body):
-    return 200, node.get_alias()
+    return _alias_response(node.get_alias(
+        expand_wildcards=str(query.get("expand_wildcards", "all"))))
 
 
 def get_alias_by_name(node: TpuNode, params, query, body):
-    return 200, node.get_alias(alias_expr=params["name"])
+    return _alias_response(node.get_alias(
+        alias_expr=params["name"],
+        expand_wildcards=str(query.get("expand_wildcards", "all"))))
 
 
 def get_alias_index(node: TpuNode, params, query, body):
-    return 200, node.get_alias(index_expr=params["index"])
+    return _alias_response(node.get_alias(
+        index_expr=params["index"],
+        expand_wildcards=str(query.get("expand_wildcards", "all"))))
 
 
 def get_alias_index_name(node: TpuNode, params, query, body):
-    return 200, node.get_alias(index_expr=params["index"],
-                               alias_expr=params["name"])
+    return _alias_response(node.get_alias(
+        index_expr=params["index"], alias_expr=params["name"],
+        expand_wildcards=str(query.get("expand_wildcards", "all"))))
 
 
 def put_index_template(node: TpuNode, params, query, body):

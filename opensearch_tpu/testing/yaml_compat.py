@@ -109,6 +109,10 @@ class ApiSpecs:
 
         for part in parts:
             value = args.pop(part)
+            if value is None:
+                # an explicit null path part fails java-client validation
+                raise StepFailure(
+                    f"[{api}] path part [{part}] must not be null")
             if isinstance(value, list):
                 value = ",".join(str(v) for v in value)
             # clients URL-encode path parts (date-math "<x-{now/M}>" has a

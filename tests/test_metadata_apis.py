@@ -371,7 +371,11 @@ class TestAliasRemoveMustExist:
                 {"remove": {"index": "ar-3", "alias": "nope"}},
             ]})
         # the add in the same request must not have been applied
-        assert node.get_alias(alias_expr="ok") == {}
+        # (a missing alias now returns the reference's 404 rider body)
+        resp = node.get_alias(alias_expr="ok")
+        assert resp.get("status") == 404
+        assert not any(isinstance(v, dict) and v.get("aliases")
+                       for v in resp.values())
 
 
 class TestSingleDocPressure:
