@@ -72,6 +72,11 @@ class StrictDynamicMappingException(MapperParsingException):
     error_type = "strict_dynamic_mapping_exception"
 
 
+class IllegalStateException(OpenSearchTpuException):
+    status = 500
+    error_type = "illegal_state_exception"
+
+
 class IndexNotFoundException(OpenSearchTpuException):
     status = 404
     error_type = "index_not_found_exception"

@@ -846,7 +846,8 @@ class TpuNode:
         return writes[0]
 
     def _resolve_write_alias(
-        self, index: str, routing: str | None, for_write: bool = True
+        self, index: str, routing: str | None, for_write: bool = True,
+        check_blocks: bool | None = None,
     ) -> tuple[str, str | None]:
         """(concrete index, effective routing) for a write/read-by-id op:
         alias write-index resolution + alias-level routing defaulting."""
@@ -856,6 +857,24 @@ class TpuNode:
             routing = conf.get("index_routing", conf.get("routing"))
         if concrete in self.indices and self.indices[concrete].closed:
             raise IndexClosedException(concrete)
+        if check_blocks is None:
+            check_blocks = for_write
+        if check_blocks and concrete in self.indices:
+            # index-level write blocks (IndexMetadata.INDEX_WRITE_BLOCK /
+            # READ_ONLY_BLOCK enforced at the TransportWriteAction gate);
+            # read APIs that resolve with for_write=True only for alias
+            # write-index semantics pass check_blocks=False
+            svc = self.indices[concrete]
+            for setting in ("blocks.write", "blocks.read_only"):
+                bid, desc, _levels = self._INDEX_BLOCKS[setting]
+                if str(svc.setting(setting, "false")).lower() == "true":
+                    from opensearch_tpu.common.errors import (
+                        ClusterBlockException,
+                    )
+
+                    raise ClusterBlockException(
+                        f"index [{concrete}] blocked by: "
+                        f"[FORBIDDEN/{bid}/{desc}];")
         return concrete, routing
 
     def _alias_targets(self, alias: str) -> list[tuple[str, dict]]:
@@ -1299,7 +1318,12 @@ class TpuNode:
         # open/close expand BOTH states (Open/CloseIndexRequest default
         # to strictExpandOpen*AndClosed* indices options)
         for name in self.resolve_indices(expr, expand_wildcards="all"):
-            self._get_index(name).closed = True
+            svc = self._get_index(name)
+            # closing FLUSHES (the reference's close commits so the shard
+            # recovers from its store on reopen)
+            for shard in svc.shards.values():
+                shard.flush()
+            svc.closed = True
         self._persist_index_registry()
         return {"acknowledged": True, "shards_acknowledged": True}
 
@@ -2082,7 +2106,7 @@ class TpuNode:
         if body and "query" not in body:
             raise IllegalArgumentException(
                 "request body must contain a [query] element")
-        concrete, routing = self._resolve_write_alias(index, routing)
+        concrete, routing = self._resolve_write_alias(index, routing, check_blocks=False)
         svc = self._get_open_index(concrete)
         shard = svc.shard_for(doc_id, routing)
         got = shard.get(doc_id)
@@ -2167,7 +2191,7 @@ class TpuNode:
         statistics come from the resident postings
         (TermVectorsService.java semantics)."""
         body = body or {}
-        concrete, routing = self._resolve_write_alias(index, routing)
+        concrete, routing = self._resolve_write_alias(index, routing, check_blocks=False)
         svc = self._get_open_index(concrete)
         shard = svc.shard_for(doc_id, routing)
         got = shard.get(doc_id, realtime=realtime)
@@ -3635,6 +3659,141 @@ class TpuNode:
                 "nodes": {"node-0": assigned},
             }
         return out
+
+    def resize_index(self, kind: str, source: str, target: str,
+                     body: dict | None = None) -> dict:
+        """_shrink/_split/_clone (TransportResizeAction). In this design a
+        resize is a RE-LAYOUT of the source's immutable docs onto the
+        target's shard ring: same ids, same sources, new murmur3 routing —
+        the columnar rebuild is the same sealed-segment path every write
+        takes, so the result is bit-identical to a fresh index of the same
+        docs. Source must be write-blocked for shrink/split; shard-count
+        factor rules match the reference."""
+        body = body or {}
+        if source not in self.indices:
+            raise IndexNotFoundException(source)
+        if not _valid_index_name(target):
+            raise IllegalArgumentException(f"invalid index name [{target}]")
+        if target in self.indices:
+            raise ResourceAlreadyExistsException(
+                f"index [{target}] already exists")
+        svc = self.indices[source]
+        src_shards = svc.num_shards
+        tgt_settings = dict((body.get("settings") or {}))
+        flat_tgt = Settings.from_nested(tgt_settings).as_dict()
+
+        def tgt_setting(name, default=None):
+            return flat_tgt.get(name, flat_tgt.get(f"index.{name}", default))
+
+        if tgt_setting("number_of_routing_shards") is not None:
+            raise IllegalArgumentException(
+                "cannot provide index.number_of_routing_shards on resize")
+        for blk in ("blocks.metadata", "blocks.read_only"):
+            if str(tgt_setting(blk, "false")).lower() == "true":
+                from opensearch_tpu.common.errors import (
+                    ActionRequestValidationException,
+                )
+
+                raise ActionRequestValidationException(
+                    f"Validation Failed: 1: target index [{target}] will "
+                    f"be blocked by [index.{blk}=true], this will disable "
+                    f"metadata writes and cause the shards to be "
+                    f"unassigned;")
+        defaults = {"shrink": 1, "split": src_shards * 2, "clone": src_shards}
+        tgt_shards = int(tgt_setting("number_of_shards", defaults[kind]))
+        if kind == "shrink" and src_shards % tgt_shards != 0:
+            raise IllegalArgumentException(
+                f"the number of source shards [{src_shards}] must be a "
+                f"multiple of [{tgt_shards}]")
+        if kind == "split" and tgt_shards % src_shards != 0:
+            raise IllegalArgumentException(
+                f"the number of source shards [{src_shards}] must be a "
+                f"factor of [{tgt_shards}]")
+        if kind == "clone" and tgt_shards != src_shards:
+            raise IllegalArgumentException(
+                f"cannot clone from [{src_shards}] shards to "
+                f"[{tgt_shards}] shards")
+        # every resize kind requires a write-blocked source (the copy must
+        # not race live writes); checked AFTER the shard-count argument
+        # validation, matching the reference's error precedence
+        if str(svc.setting("blocks.write", "false")).lower() != "true":
+            from opensearch_tpu.common.errors import IllegalStateException
+
+            raise IllegalStateException(
+                f"index {source} must be read-only to resize index. use "
+                f"\"index.blocks.write=true\"")
+
+        # target settings = source settings COPIED (30_copy_settings)
+        # overridden by the request's; explicit nulls UNSET inherited keys
+        src_settings = Settings.from_nested(svc.settings or {}).as_dict()
+        merged = dict(src_settings)
+        for k, v in flat_tgt.items():
+            key = k[len("index."):] if k.startswith("index.") else k
+            if v is None:
+                merged.pop(key, None)
+            else:
+                merged[key] = v
+        merged["number_of_shards"] = tgt_shards
+        # a read-only/metadata block INHERITED from the source (not set by
+        # this request) also invalidates the target, as a plain 400
+        for blk in ("blocks.metadata", "blocks.read_only"):
+            if str(merged.get(blk, "false")).lower() == "true":
+                raise IllegalArgumentException(
+                    f"target index [{target}] will be blocked by "
+                    f"[index.{blk}=true], this will disable metadata "
+                    f"writes and cause the shards to be unassigned")
+        # the copied write block applies AFTER the re-layout populates the
+        # target, or the copy itself would be rejected
+        deferred_blocks = {k: merged.pop(k) for k in list(merged)
+                          if k.startswith("blocks.")}
+        mappings = svc.mapper_service.to_dict()
+        self.create_index(target, {
+            "settings": Settings.from_flat(merged).as_nested(),
+            "mappings": mappings,
+        })
+        tgt_svc = self.indices[target]
+        for shard in svc.shards.values():
+            snapshot = shard.acquire_searcher()
+            seen: set[str] = set()
+            for entry in shard.engine._buffer:
+                if entry is None:
+                    continue
+                parsed, _seq = entry
+                tgt_svc.shard_for(parsed.doc_id, parsed.routing) \
+                    .apply_index_on_primary(parsed.doc_id, parsed.source,
+                                            parsed.routing)
+                seen.add(parsed.doc_id)
+            for host, _dev in snapshot.segments:
+                for d in range(host.n_docs):
+                    if not host.live[d]:
+                        continue
+                    doc_id = host.doc_ids[d]
+                    if doc_id in seen:
+                        continue
+                    seen.add(doc_id)
+                    # an unrefreshed delete is only visible in the version
+                    # map; the segment's live bitmap still says yes
+                    entry = shard.engine.version_map.get(doc_id)
+                    if entry is not None and entry.deleted:
+                        continue
+                    routing = host.doc_routings[d] \
+                        if d < len(host.doc_routings) else None
+                    tgt_svc.shard_for(doc_id, routing) \
+                        .apply_index_on_primary(
+                            doc_id, json.loads(host.sources[d]), routing)
+        for shard in tgt_svc.shards.values():
+            shard.engine.ensure_synced()
+            # the re-layout hands over a SEARCHABLE index (the reference's
+            # resize target recovers from complete segments)
+            shard.refresh()
+        if deferred_blocks:
+            tgt_svc.settings = _deep_merge(
+                tgt_svc.settings,
+                Settings.from_flat(deferred_blocks).as_nested())
+            tgt_svc.settings_changed()
+        self._persist_index_registry()
+        return {"acknowledged": True, "shards_acknowledged": True,
+                "index": target}
 
     def search_shards(self, index: str | None = None,
                       routing: str | None = None,

@@ -228,6 +228,25 @@ def build_router() -> Router:
     reg("GET", "/_component_template", get_component_templates)
     reg("GET", "/_component_template/{name}", get_component_template)
     reg("DELETE", "/_component_template/{name}", delete_component_template)
+    reg("PUT", "/{index}/_block/{block}", add_index_block)
+    # index admin info/maintenance family
+    reg("GET", "/_segments", indices_segments)
+    reg("GET", "/{index}/_segments", indices_segments)
+    reg("GET", "/_shard_stores", indices_shard_stores)
+    reg("GET", "/{index}/_shard_stores", indices_shard_stores)
+    reg("GET", "/_recovery", indices_recovery)
+    reg("GET", "/{index}/_recovery", indices_recovery)
+    reg("POST", "/_upgrade", indices_upgrade)
+    reg("POST", "/{index}/_upgrade", indices_upgrade)
+    reg("GET", "/_upgrade", indices_upgrade)
+    reg("GET", "/{index}/_upgrade", indices_upgrade)
+    # resize family (TransportResizeAction)
+    reg("PUT", "/{index}/_shrink/{target}", shrink_index)
+    reg("POST", "/{index}/_shrink/{target}", shrink_index)
+    reg("PUT", "/{index}/_split/{target}", split_index)
+    reg("POST", "/{index}/_split/{target}", split_index)
+    reg("PUT", "/{index}/_clone/{target}", clone_index)
+    reg("POST", "/{index}/_clone/{target}", clone_index)
     # rollover / open / close / analyze
     reg("POST", "/{index}/_rollover", rollover)
     reg("POST", "/{index}/_rollover/{new_index}", rollover_named)
@@ -328,6 +347,7 @@ def build_router() -> Router:
     reg("GET", "/_nodes", nodes_info)
     reg("GET", "/_nodes/stats", nodes_stats)
     reg("GET", "/_nodes/{node_id}/stats", nodes_stats)
+    reg("GET", "/_nodes/{node_id}", nodes_info)
     reg("GET", "/_cat", cat_help)
     reg("GET", "/_cat/indices", cat_indices)
     reg("GET", "/_cat/indices/{index}", cat_indices)
@@ -1464,13 +1484,24 @@ def list_tasks(node: TpuNode, params, query, body):
 
 
 def get_task(node: TpuNode, params, query, body):
-    task = node.task_manager.get(_parse_task_id(params["task_id"]))
-    return 200, {"completed": False, "task": task.to_dict()}
+    raw = str(params["task_id"])
+    owner = raw.rsplit(":", 1)[0] if ":" in raw else node.node_name
+    if owner not in (node.node_name, "node-0"):
+        raise ResourceNotFoundException(
+            f"task [{raw}] belongs to the node [{owner}] which isn't part "
+            f"of the cluster and there is no record of the task")
+    task, completed = node.task_manager.get_any(
+        _parse_task_id(params["task_id"]))
+    return 200, {"completed": completed, "task": task.to_dict()}
 
 
 def cancel_tasks(node: TpuNode, params, query, body):
     cancelled = node.task_manager.cancel_matching(query.get("actions"))
-    return 200, {"nodes": {node.node_name: {"cancelled_task_ids": cancelled}},
+    # nodes with nothing cancelled are omitted (TransportTasksAction only
+    # reports nodes that matched)
+    nodes = ({node.node_name: {"cancelled_task_ids": cancelled}}
+             if cancelled else {})
+    return 200, {"nodes": nodes,
                  "node_failures": [], "task_failures": []}
 
 
@@ -1616,6 +1647,209 @@ def get_component_template(node: TpuNode, params, query, body):
 
 def delete_component_template(node: TpuNode, params, query, body):
     return 200, node.delete_component_template(params["name"])
+
+
+def _make_resize(kind: str):
+    def handler(node: TpuNode, params, query, body):
+        if str(query.get("copy_settings", "true")) == "false":
+            raise IllegalArgumentException(
+                "parameter [copy_settings] can only be set to [true]")
+        wait = str(query.get("wait_for_completion", "true")) in ("true", "")
+        description = f"{kind} from [{params['index']}] to [{params['target']}]"
+        with node.task_manager.task_scope(
+            "indices:admin/resize", description=description
+        ) as task:
+            resp = node.resize_index(kind, params["index"],
+                                     params["target"], body)
+            task_id = f"{node.node_name}:{task.id}"
+        if not wait:
+            # the work already completed synchronously; the task id lets
+            # the client poll GET _tasks/{id} like the reference
+            return 200, {"task": task_id}
+        return 200, resp
+    return handler
+
+
+shrink_index = _make_resize("shrink")
+split_index = _make_resize("split")
+clone_index = _make_resize("clone")
+
+
+def add_index_block(node: TpuNode, params, query, body):
+    """PUT /{index}/_block/{block} (AddIndexBlockAction)."""
+    block = str(params["block"])
+    if block not in ("write", "read", "read_only", "metadata",
+                     "read_only_allow_delete"):
+        raise IllegalArgumentException(f"unknown block type [{block}]")
+    names = _admin_indices(node, params, query, expand_default="all")
+    for n in names:
+        node.put_index_settings(
+            n, {"settings": {f"index.blocks.{block}": True}})
+    return 200, {
+        "acknowledged": True,
+        "shards_acknowledged": True,
+        "indices": [{"name": n, "blocked": True} for n in names],
+    }
+
+
+def _admin_indices(node: TpuNode, params, query,
+                   expand_default: str = "open") -> list[str]:
+    return node.resolve_indices(
+        params.get("index", "_all"),
+        ignore_unavailable=str(query.get("ignore_unavailable", "false"))
+        in ("true", ""),
+        allow_no_indices=str(query.get("allow_no_indices", "true"))
+        in ("true", ""),
+        expand_wildcards=str(query.get("expand_wildcards", expand_default)),
+    )
+
+
+def indices_segments(node: TpuNode, params, query, body):
+    """GET [/{index}]/_segments (IndicesSegmentsAction): the sealed
+    segment inventory per shard."""
+    from opensearch_tpu.common.errors import IndexClosedException
+
+    explicit = params.get("index") and not any(
+        c in str(params["index"]) for c in "*?")
+    ignore = str(query.get("ignore_unavailable", "false")) in ("true", "")
+    names = []
+    for n in _admin_indices(node, params, query):
+        if node.indices[n].closed:
+            if explicit and not ignore:
+                raise IndexClosedException(n)
+            continue
+        names.append(n)
+    out_indices = {}
+    n_shards = 0
+    for name in names:
+        svc = node.indices[name]
+        shards_out = {}
+        for sid, shard in sorted(svc.shards.items()):
+            n_shards += 1
+            segments = {}
+            for gen, (host, _dev) in enumerate(shard.engine._segments):
+                live = int(host.live.sum())
+                segments[f"_{gen}"] = {
+                    "generation": gen,
+                    "num_docs": live,
+                    "deleted_docs": host.n_docs - live,
+                    "size_in_bytes": sum(len(s) for s in host.sources),
+                    "committed": True,
+                    "search": True,
+                    "version": "10.3.0",
+                    "compound": True,
+                }
+            shards_out[str(sid)] = [{
+                "routing": {"state": "STARTED", "primary": True,
+                            "node": "node-0"},
+                "num_committed_segments": len(segments),
+                "num_search_segments": len(segments),
+                "segments": segments,
+            }]
+        out_indices[name] = {"shards": shards_out}
+    return 200, {
+        "_shards": {"total": n_shards, "successful": n_shards, "failed": 0},
+        "indices": out_indices,
+    }
+
+
+def indices_shard_stores(node: TpuNode, params, query, body):
+    """GET [/{index}]/_shard_stores (IndicesShardStoresAction)."""
+    names = [n for n in _admin_indices(node, params, query)
+             if not node.indices[n].closed]
+    out_indices = {}
+    for name in names:
+        svc = node.indices[name]
+        shards_out = {}
+        for sid in range(svc.num_shards):
+            shards_out[str(sid)] = {"stores": [{
+                "node-0": {
+                    "name": node.node_name,
+                    "ephemeral_id": node.cluster_uuid,
+                    "transport_address": "127.0.0.1:9300",
+                    "attributes": {},
+                },
+                "allocation_id": f"{name}#{sid}",
+                "allocation": "primary",
+            }]}
+        out_indices[name] = {"shards": shards_out}
+    return 200, {"indices": out_indices}
+
+
+def indices_recovery(node: TpuNode, params, query, body):
+    """GET [/{index}]/_recovery (RecoveryAction): per-shard recovery
+    state; local shards report their store bootstrap as a DONE
+    EMPTY_STORE/EXISTING_STORE recovery."""
+    import time as _time
+
+    names = _admin_indices(node, params, query, expand_default="all")
+    out = {}
+    for name in names:
+        svc = node.indices[name]
+        shards = []
+        for sid, shard in sorted(svc.shards.items()):
+            nfiles = len(shard.engine._segments)
+            nbytes = sum(
+                sum(len(s) for s in h.sources)
+                for h, _d in shard.engine._segments)
+            ops = shard.engine.translog.stats()["operations"] \
+                if hasattr(shard.engine.translog, "stats") else 0
+            existing = (node.data_path / "indices" / name / str(sid) /
+                        "commit.json").exists()
+            shards.append({
+                "id": sid,
+                "type": "EXISTING_STORE" if existing else "EMPTY_STORE",
+                "stage": "DONE",
+                "primary": True,
+                "start_time": _time.strftime(
+                    "%Y-%m-%dT%H:%M:%S.000Z",
+                    _time.gmtime(svc.creation_date / 1000)),
+                "start_time_in_millis": svc.creation_date,
+                "total_time_in_millis": 0,
+                "source": {},
+                "target": {
+                    "id": "node-0", "host": "127.0.0.1",
+                    "transport_address": "127.0.0.1:9300",
+                    "ip": "127.0.0.1", "name": node.node_name,
+                },
+                "index": {
+                    "files": {"total": nfiles, "reused": nfiles,
+                              "recovered": 0, "percent": "100.0%",
+                              **({"details": []} if str(query.get(
+                                  "detailed", "false")) in ("true", "")
+                                 else {})},
+                    "size": {"total_in_bytes": nbytes,
+                             "reused_in_bytes": nbytes,
+                             "recovered_in_bytes": 0,
+                             "percent": "100.0%"},
+                    "source_throttle_time_in_millis": 0,
+                    "target_throttle_time_in_millis": 0,
+                },
+                "translog": {"recovered": ops, "total": ops,
+                             "total_on_start": ops,
+                             "total_time_in_millis": 0, "percent": "100.0%"},
+                "verify_index": {"check_index_time_in_millis": 0,
+                                 "total_time_in_millis": 0},
+            })
+        out[name] = {"shards": shards}
+    return 200, out
+
+
+def indices_upgrade(node: TpuNode, params, query, body):
+    """POST [/{index}]/_upgrade (UpgradeAction): this engine's segments
+    carry no legacy codecs, so the upgrade is an ack with the current
+    segment version per index."""
+    names = [n for n in _admin_indices(node, params, query)
+             if not node.indices[n].closed]
+    n = len(names)
+    return 200, {
+        "_shards": {"total": n, "successful": n, "failed": 0},
+        "upgraded_indices": {
+            name: {"oldest_lucene_segment_version": "10.3.0",
+                   "upgrade_version": "10.3.0"}
+            for name in names
+        },
+    }
 
 
 def rollover(node: TpuNode, params, query, body):

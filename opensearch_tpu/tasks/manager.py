@@ -74,6 +74,7 @@ class TaskManager:
         self._node = node_name
         self._seq = itertools.count(1)
         self._tasks: dict[int, Task] = {}
+        self._completed_tasks: dict[int, Task] = {}
         self._lock = threading.Lock()
         # cumulative counters for stats
         self.completed = 0
@@ -103,10 +104,32 @@ class TaskManager:
                     task.cancellation_reason = parent.cancellation_reason
         return task
 
+    # finished tasks retained for GET _tasks/{id} (the reference persists
+    # results to the .tasks system index); bounded so long-lived nodes
+    # don't accumulate
+    _COMPLETED_CAP = 256
+
     def unregister(self, task: Task) -> None:
         with self._lock:
             self._tasks.pop(task.id, None)
             self.completed += 1
+            self._completed_tasks[task.id] = task
+            while len(self._completed_tasks) > self._COMPLETED_CAP:
+                self._completed_tasks.pop(
+                    next(iter(self._completed_tasks)))
+
+    def get_any(self, task_id: int) -> tuple[Task, bool]:
+        """(task, completed) — running tasks first, then the retained
+        completed set; missing ids raise like get()."""
+        with self._lock:
+            task = self._tasks.get(task_id)
+            if task is not None:
+                return task, False
+            task = self._completed_tasks.get(task_id)
+            if task is not None:
+                return task, True
+        raise ResourceNotFoundException(
+            f"task [{self._node}:{task_id}] not found")
 
     def get(self, task_id: int) -> Task:
         task = self._tasks.get(task_id)

@@ -30,7 +30,7 @@ REFERENCE_SPEC = Path(
 
 # test features we implement; tests demanding others are skipped
 SUPPORTED_FEATURES = {
-    "contains", "allowed_warnings", "warnings",
+    "contains", "allowed_warnings", "warnings", "arbitrary_key",
 }
 
 CATCH_STATUS = {
@@ -160,6 +160,14 @@ def lookup(response: Any, path: str, stash: Stash) -> Any:
     parts = re.split(r"(?<!\\)\.", path)
     for raw in parts:
         key = stash.resolve(raw.replace("\\.", "."))
+        if key == "_arbitrary_key_" and isinstance(current, dict):
+            # the `arbitrary_key` feature: resolves to SOME key of the
+            # object (used to grab a node id from the nodes map)
+            if not current:
+                raise StepFailure(f"path [{path}]: empty object for "
+                                  f"_arbitrary_key_")
+            current = next(iter(current))
+            continue
         if isinstance(current, list):
             current = current[int(key)]
         elif isinstance(current, dict):
