@@ -121,6 +121,8 @@ class MonitorService:
             },
             "data": [{
                 "path": str(self.data_path),
+                "mount": "/",
+                "type": "overlay",
                 "total_in_bytes": total,
                 "free_in_bytes": free,
                 "available_in_bytes": free,

@@ -50,10 +50,20 @@ class IndexingPressure:
             "memory": {
                 "current": {
                     "combined_coordinating_and_primary_in_bytes": self.current_bytes,
+                    "coordinating_in_bytes": self.current_bytes,
+                    "primary_in_bytes": 0,
+                    "replica_in_bytes": 0,
+                    "all_in_bytes": self.current_bytes,
                 },
                 "total": {
                     "combined_coordinating_and_primary_in_bytes": self.total_bytes,
+                    "coordinating_in_bytes": self.total_bytes,
+                    "primary_in_bytes": 0,
+                    "replica_in_bytes": 0,
+                    "all_in_bytes": self.total_bytes,
                     "coordinating_rejections": self.rejections,
+                    "primary_rejections": 0,
+                    "replica_rejections": 0,
                 },
                 "limit_in_bytes": self.limit,
             }

@@ -347,7 +347,13 @@ def build_router() -> Router:
     reg("GET", "/_nodes", nodes_info)
     reg("GET", "/_nodes/stats", nodes_stats)
     reg("GET", "/_nodes/{node_id}/stats", nodes_stats)
+    reg("GET", "/_nodes/stats/{metric}", nodes_stats)
+    reg("GET", "/_nodes/stats/{metric}/{index_metric}", nodes_stats)
+    reg("GET", "/_nodes/{node_id}/stats/{metric}", nodes_stats)
+    reg("GET", "/_nodes/{node_id}/stats/{metric}/{index_metric}",
+        nodes_stats)
     reg("GET", "/_nodes/{node_id}", nodes_info)
+    reg("GET", "/_nodes/{node_id}/{metric}", nodes_info)
     reg("GET", "/_cat", cat_help)
     reg("GET", "/_cat/indices", cat_indices)
     reg("GET", "/_cat/indices/{index}", cat_indices)
@@ -1476,10 +1482,36 @@ def _parse_task_id(raw: str) -> int:
 
 
 def list_tasks(node: TpuNode, params, query, body):
-    tasks = node.task_manager.list_tasks(query.get("actions"))
+    # the listing request itself runs as a task
+    # (TransportListTasksAction registers), so the map is never empty
+    with node.task_manager.task_scope(
+        "cluster:monitor/tasks/lists", description="task list"
+    ):
+        tasks = node.task_manager.list_tasks(query.get("actions"))
+        task_map = {}
+        for t in tasks:
+            d = t.to_dict()
+            rs = {"total": {"cpu_time_in_nanos": max(t.cpu_time_nanos, 1),
+                            "memory_in_bytes": 0}}
+            if str(query.get("detailed", "false")) in ("true", ""):
+                rs["thread_info"] = {"thread_executions": 1,
+                                     "active_threads": 1}
+            d.setdefault("resource_stats", rs)
+            task_map[f"{t.node}:{t.id}"] = d
+    group_by = str(query.get("group_by", "nodes"))
+    if group_by == "none":
+        # ListTasksResponse renders an ARRAY for group_by=none
+        return 200, {"tasks": list(task_map.values())}
+    if group_by == "parents":
+        return 200, {"tasks": task_map}
     return 200, {"nodes": {node.node_name: {
         "name": node.node_name,
-        "tasks": {f"{t.node}:{t.id}": t.to_dict() for t in tasks},
+        "transport_address": "127.0.0.1:9300",
+        "host": "127.0.0.1",
+        "ip": "127.0.0.1:9300",
+        "roles": ["cluster_manager", "data", "ingest",
+                  "remote_cluster_client"],
+        "tasks": task_map,
     }}}
 
 
@@ -2077,17 +2109,26 @@ def put_cluster_settings(node: TpuNode, params, query, body):
 
 def cluster_stats(node: TpuNode, params, query, body):
     stats = node.index_stats("_all")
+    doc_count = (stats["_all"]["primaries"].get("docs") or {}).get("count", 0)
     return 200, {
         "cluster_name": "opensearch-tpu",
         "status": "green",
         "indices": {
             "count": len(node.indices),
-            "docs": {"count": stats["_all"]["primaries"]["docs"]["count"]},
+            "docs": {"count": doc_count},
             "shards": {
                 "total": sum(s.num_shards for s in node.indices.values()),
             },
         },
-        "nodes": {"count": {"total": 1, "data": 1, "cluster_manager": 1}},
+        "nodes": {
+            "count": {"total": 1, "data": 1, "cluster_manager": 1,
+                      "master": 1, "ingest": 1,
+                      "remote_cluster_client": 1, "coordinating_only": 0,
+                      "search": 0, "warm": 0},
+            "versions": [__version__],
+            "discovery_types": {"zen": 1},
+            "packaging_types": [{"type": "tar", "count": 1}],
+        },
     }
 
 
@@ -2229,28 +2270,63 @@ def remote_info(node: TpuNode, params, query, body):
 
 
 def nodes_info(node: TpuNode, params, query, body):
-    """GET /_nodes (NodesInfoResponse shape, one local node)."""
+    """GET /_nodes[/{node_id}[/{metric}]] (NodesInfoResponse shape, one
+    local node)."""
     info = node.monitor.info()
+    from opensearch_tpu.search.aggs import AGG_TYPES, EXTENSION_AGGS
+
+    flat = str(query.get("flat_settings", "false")) in ("true", "")
+    settings = ({"client.type": "node",
+                 "node.name": node.node_name} if flat
+                else {"client": {"type": "node"},
+                      "node": {"name": node.node_name}})
+    buffer_bytes = 512 * 1024 * 1024
+    entry = {
+        "name": node.node_name,
+        "transport_address": "127.0.0.1:9300",
+        "host": "127.0.0.1",
+        "ip": "127.0.0.1",
+        "version": __version__,
+        "build_type": "tpu",
+        "roles": ["cluster_manager", "data", "ingest",
+                  "remote_cluster_client"],
+        "attributes": {},
+        "total_indexing_buffer_in_bytes": buffer_bytes,
+        "os": info["os"],
+        "process": info["process"],
+        "settings": settings,
+        "plugins": [],
+        "modules": [],
+        "aggregations": {
+            name: {"types": ["other"]}
+            for name in sorted(AGG_TYPES | set(EXTENSION_AGGS))
+        },
+    }
+    if str(query.get("human", "false")) in ("true", ""):
+        entry["total_indexing_buffer"] = _human_bytes(buffer_bytes)
+    metric = params.get("metric") or query.get("metric")
+    # /_nodes/{metric} shares a path shape with /_nodes/{node_id}; like
+    # RestNodesInfoAction, a segment made only of known metric names is a
+    # metric list, not a node filter
+    known = {"settings", "os", "process", "jvm", "thread_pool",
+             "transport", "http", "plugins", "ingest", "aggregations",
+             "indices", "_all"}
+    nid = params.get("node_id")
+    if metric is None and nid and all(
+            p.strip() in known for p in str(nid).split(",")):
+        metric = nid
+    if metric:
+        metrics = {m.strip() for m in str(metric).split(",")}
+        base = {"name", "transport_address", "host", "ip", "version",
+                "build_type", "roles", "attributes"}
+        if "_all" not in metrics:
+            entry = {k: v for k, v in entry.items()
+                     if k in base | metrics
+                     or k.startswith("total_indexing_buffer")}
     return 200, {
         "_nodes": {"total": 1, "successful": 1, "failed": 0},
         "cluster_name": "opensearch-tpu",
-        "nodes": {
-            "node-0": {
-                "name": node.node_name,
-                "transport_address": "127.0.0.1:9300",
-                "host": "127.0.0.1",
-                "ip": "127.0.0.1",
-                "version": __version__,
-                "build_type": "tpu",
-                "roles": ["cluster_manager", "data", "ingest"],
-                "attributes": {},
-                "os": info["os"],
-                "process": info["process"],
-                "settings": {"node": {"name": node.node_name}},
-                "plugins": [],
-                "modules": [],
-            }
-        },
+        "nodes": {"node-0": entry},
     }
 
 
@@ -2607,40 +2683,131 @@ def cat_tasks(node: TpuNode, params, query, body):
         "timestamp", "running_time", "ip", "node", "description"])
 
 
+_NODES_STATS_METRICS = {
+    "_all", "indices", "os", "process", "jvm", "thread_pool", "fs",
+    "transport", "http", "breaker", "script", "discovery", "ingest",
+    "adaptive_selection", "indexing_pressure", "search_backpressure",
+    "shard_indexing_pressure", "tasks", "telemetry", "slowlog",
+}
+
+
 def nodes_stats(node: TpuNode, params, query, body):
+    """GET /_nodes[/{node_id}]/stats[/{metric}[/{index_metric}]]
+    (TransportNodesStatsAction): full CommonStats indices section with
+    metric/index_metric filtering."""
+    import difflib
     import resource
+
+    raw_metric = params.get("metric") or query.get("metric")
+    metrics = ([m.strip() for m in str(raw_metric).split(",") if m.strip()]
+               if raw_metric else ["_all"])
+    for m in metrics:
+        if m not in _NODES_STATS_METRICS:
+            close = difflib.get_close_matches(
+                m, sorted(_NODES_STATS_METRICS - {"_all"}), n=1, cutoff=0.6)
+            hint = f" -> did you mean [{close[0]}]?" if close else ""
+            raise IllegalArgumentException(
+                f"request [/_nodes/stats/{raw_metric}] contains "
+                f"unrecognized metric: [{m}]{hint}")
+    raw_im = params.get("index_metric") or query.get("index_metric")
+    index_metrics = ([m.strip() for m in str(raw_im).split(",")
+                      if m.strip()] if raw_im else ["_all"])
 
     usage = resource.getrusage(resource.RUSAGE_SELF)
     stats = node.index_stats("_all")
+    import copy as _copy
+
+    indices_all = _copy.deepcopy(stats["_all"]["total"])
+    # every CommonStats section is present (zeroed) even on an empty node
+    zero = {
+        "docs": {"count": 0, "deleted": 0},
+        "store": {"size_in_bytes": 0, "reserved_in_bytes": 0},
+        "indexing": {"index_total": 0, "doc_status": {}},
+        "get": {"total": 0}, "search": {"query_total": 0},
+        "merges": {"total": 0}, "refresh": {"total": 0},
+        "flush": {"total": 0}, "warmer": {"total": 0},
+        "query_cache": {"memory_size_in_bytes": 0},
+        "fielddata": {"memory_size_in_bytes": 0},
+        "completion": {"size_in_bytes": 0},
+        "segments": {"count": 0}, "translog": {"operations": 0},
+        "request_cache": {"memory_size_in_bytes": 0},
+        "recovery": {"current_as_source": 0, "current_as_target": 0},
+    }
+    for sec, default in zero.items():
+        if not isinstance(indices_all.get(sec), dict):
+            indices_all[sec] = dict(default)
+    indices_all["indexing"].setdefault("doc_status", {})
+    if str(query.get("include_segment_file_sizes", "false")) \
+            in ("true", ""):
+        indices_all["segments"].setdefault("file_sizes", {})
+    if str(query.get("level", "")) == "indices":
+        indices_all["indices"] = stats.get("indices", {})
+    if "_all" not in index_metrics:
+        aliases = {"merge": "merges"}
+        want = {aliases.get(m, m) for m in index_metrics}
+        indices_all = {k: v for k, v in indices_all.items() if k in want}
+    t_stats = getattr(node, "transport_stats", None)
+    entry = {
+        "name": node.node_name,
+        "roles": ["cluster_manager", "data", "ingest"],
+        "timestamp": int(__import__("time").time() * 1000),
+        "indices": indices_all,
+        "process": {"max_rss_bytes": usage.ru_maxrss * 1024,
+                    **node.monitor.stats()["process"]},
+        "os": node.monitor.stats()["os"],
+        "jvm": {"mem": {"heap_used_in_bytes": usage.ru_maxrss * 1024},
+                "threads": {"count": __import__("threading").active_count(),
+                            "peak_count": 0},
+                "buffer_pools": {"direct": {"count": 0,
+                                            "used_in_bytes": 0},
+                                 "mapped": {"count": 0,
+                                            "used_in_bytes": 0}},
+                "gc": {"collectors": {}}},
+        "fs": node.monitor.fs_stats(),
+        "transport": t_stats() if callable(t_stats) else {
+            "server_open": 0, "total_outbound_connections": 0,
+            "rx_count": 0, "tx_count": 0,
+            "rx_size_in_bytes": 0, "tx_size_in_bytes": 0,
+        },
+        "http": {"current_open": 1, "total_opened": 1},
+        "discovery": {"cluster_state_queue": {"total": 0, "pending": 0,
+                                              "committed": 0},
+                      "published_cluster_states": {"full_states": 0,
+                                                   "incompatible_diffs": 0,
+                                                   "compatible_diffs": 0}},
+        "thread_pool": {"search": {"threads": 1, "queue": 0,
+                                   "active": 0, "rejected": 0}},
+        "breaker": node.breakers.stats(),
+        "breakers": node.breakers.stats(),
+        "indexing_pressure": node.indexing_pressure.stats(),
+        "search_backpressure": node.search_backpressure.stats(),
+        "telemetry": node.telemetry.metrics.stats(),
+        "slowlog": {
+            "search": node.search_slowlog.entries()[-10:],
+            "indexing": node.indexing_slowlog.entries()[-10:],
+        },
+        "tasks": {
+            "running": len(node.task_manager.list_tasks()),
+            "completed": node.task_manager.completed,
+            "cancelled": node.task_manager.cancelled_count,
+        },
+        "ingest": {"total": {"count": 0, "failed": 0,
+                             "time_in_millis": 0, "current": 0}},
+        "script": {"compilations": 0, "cache_evictions": 0},
+        "adaptive_selection": {},
+        "shard_indexing_pressure": {"stats": {}, "total_rejections_breakup":
+                                    {}, "enabled": False, "enforced": False},
+    }
+    if "_all" not in metrics:
+        base = {"name", "roles", "timestamp"}
+        keep = set(metrics) | base
+        if "breaker" in metrics:
+            keep.add("breakers")
+        entry = {k: v for k, v in entry.items() if k in keep}
     return 200, {
         "_nodes": {"total": 1, "successful": 1, "failed": 0},
         "cluster_name": "opensearch-tpu",
-        "nodes": {
-            "node-0": {
-                "name": node.node_name,
-                "roles": ["cluster_manager", "data", "ingest"],
-                "indices": {
-                    "docs": {"count": stats["_all"]["primaries"]["docs"]["count"]},
-                },
-                "process": {"max_rss_bytes": usage.ru_maxrss * 1024,
-                            **node.monitor.stats()["process"]},
-                "os": node.monitor.stats()["os"],
-                "fs": node.monitor.fs_stats(),
-                "breakers": node.breakers.stats(),
-                "indexing_pressure": node.indexing_pressure.stats(),
-                "search_backpressure": node.search_backpressure.stats(),
-                "telemetry": node.telemetry.metrics.stats(),
-                "slowlog": {
-                    "search": node.search_slowlog.entries()[-10:],
-                    "indexing": node.indexing_slowlog.entries()[-10:],
-                },
-                "tasks": {
-                    "running": len(node.task_manager.list_tasks()),
-                    "completed": node.task_manager.completed,
-                    "cancelled": node.task_manager.cancelled_count,
-                },
-            }
-        },
+        "nodes": {"node-0": entry},
     }
 
 
