@@ -98,6 +98,14 @@ class IndexClosedException(OpenSearchTpuException):
         self.index = index
 
 
+class SnapshotMissingException(OpenSearchTpuException):
+    status = 404
+    error_type = "snapshot_missing_exception"
+
+    def __init__(self, repo: str, snapshot: str):
+        super().__init__(f"[{repo}:{snapshot}] is missing")
+
+
 class ResourceNotFoundException(OpenSearchTpuException):
     status = 404
     error_type = "resource_not_found_exception"

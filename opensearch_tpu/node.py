@@ -327,6 +327,8 @@ class TpuNode:
                 "mappings": svc.mapper_service.to_dict(),
                 "aliases": svc.aliases,
                 "closed": svc.closed,
+                "restored_from_snapshot": getattr(
+                    svc, "restored_from_snapshot", None),
             }
             for name, svc in self.indices.items()
         }
@@ -342,6 +344,8 @@ class TpuNode:
             )
             svc.aliases = meta.get("aliases", {})
             svc.closed = meta.get("closed", False)
+            if meta.get("restored_from_snapshot"):
+                svc.restored_from_snapshot = meta["restored_from_snapshot"]
             self.indices[name] = svc
 
     def create_index(self, name: str, body: dict | None = None) -> dict:

@@ -282,6 +282,7 @@ def build_router() -> Router:
     reg("GET", "/_snapshot", get_repositories)
     reg("GET", "/_snapshot/{repo}", get_repository)
     reg("DELETE", "/_snapshot/{repo}", delete_repository)
+    reg("POST", "/_snapshot/{repo}/_cleanup", cleanup_repository)
     reg("PUT", "/_snapshot/{repo}/{snapshot}", create_snapshot)
     reg("POST", "/_snapshot/{repo}/{snapshot}", create_snapshot)
     reg("GET", "/_snapshot/{repo}/{snapshot}", get_snapshot)
@@ -948,7 +949,12 @@ def create_snapshot(node: TpuNode, params, query, body):
 
 
 def get_snapshot(node: TpuNode, params, query, body):
-    return 200, node.snapshots.get_snapshot(params["repo"], params["snapshot"])
+    return 200, node.snapshots.get_snapshot(
+        params["repo"], params["snapshot"],
+        verbose=str(query.get("verbose", "true")) in ("true", ""),
+        ignore_unavailable=str(query.get("ignore_unavailable", "false"))
+        in ("true", ""),
+    )
 
 
 def delete_snapshot(node: TpuNode, params, query, body):
@@ -962,7 +968,23 @@ def restore_snapshot(node: TpuNode, params, query, body):
 
 
 def snapshot_status(node: TpuNode, params, query, body):
-    return 200, node.snapshots.snapshot_status(params["repo"], params["snapshot"])
+    from opensearch_tpu.common.errors import SnapshotMissingException
+
+    try:
+        return 200, node.snapshots.snapshot_status(params["repo"],
+                                                   params["snapshot"])
+    except SnapshotMissingException:
+        if str(query.get("ignore_unavailable", "false")) in ("true", ""):
+            return 200, {"snapshots": []}
+        raise
+
+
+def cleanup_repository(node: TpuNode, params, query, body):
+    """POST /_snapshot/{repo}/_cleanup (CleanupRepositoryAction): the
+    content-addressed store garbage-collects on delete, so cleanup finds
+    nothing stale."""
+    node.snapshots.get_repository(params["repo"])  # 404 on missing repo
+    return 200, {"results": {"deleted_bytes": 0, "deleted_blobs": 0}}
 
 
 # -- search ------------------------------------------------------------------
@@ -1828,9 +1850,19 @@ def indices_recovery(node: TpuNode, params, query, body):
                 if hasattr(shard.engine.translog, "stats") else 0
             existing = (node.data_path / "indices" / name / str(sid) /
                         "commit.json").exists()
+            from_snap = getattr(svc, "restored_from_snapshot", None)
+            if from_snap:
+                # SNAPSHOT recovery reports the restored Lucene files —
+                # an empty index still restores its one commit point
+                nfiles = max(nfiles, 1)
+                nbytes = max(nbytes, 1)
+            recovered_files = nfiles if from_snap else 0
+            reused_files = 0 if from_snap else nfiles
             shards.append({
                 "id": sid,
-                "type": "EXISTING_STORE" if existing else "EMPTY_STORE",
+                "type": ("SNAPSHOT" if from_snap
+                         else "EXISTING_STORE" if existing
+                         else "EMPTY_STORE"),
                 "stage": "DONE",
                 "primary": True,
                 "start_time": _time.strftime(
@@ -1845,14 +1877,16 @@ def indices_recovery(node: TpuNode, params, query, body):
                     "ip": "127.0.0.1", "name": node.node_name,
                 },
                 "index": {
-                    "files": {"total": nfiles, "reused": nfiles,
-                              "recovered": 0, "percent": "100.0%",
+                    "files": {"total": nfiles, "reused": reused_files,
+                              "recovered": recovered_files,
+                              "percent": "100.0%",
                               **({"details": []} if str(query.get(
                                   "detailed", "false")) in ("true", "")
                                  else {})},
                     "size": {"total_in_bytes": nbytes,
-                             "reused_in_bytes": nbytes,
-                             "recovered_in_bytes": 0,
+                             "reused_in_bytes": 0 if from_snap else nbytes,
+                             "recovered_in_bytes":
+                                 nbytes if from_snap else 0,
                              "percent": "100.0%"},
                     "source_throttle_time_in_millis": 0,
                     "target_throttle_time_in_millis": 0,
@@ -2591,6 +2625,7 @@ def cat_recovery(node: TpuNode, params, query, body):
     for index, svc in sorted(node.indices.items()):
         if pats is not None and not any(_fn.fnmatch(index, p) for p in pats):
             continue
+        from_snap = getattr(svc, "restored_from_snapshot", None)
         for sid, shard in sorted(svc.shards.items()):
             nfiles = len(shard.engine._segments)
             nbytes = sum(sum(len(x) for x in h.sources)
@@ -2598,11 +2633,14 @@ def cat_recovery(node: TpuNode, params, query, body):
             ops = shard.engine.translog.stats()["operations"]
             rows.append({
                 "index": index, "shard": sid, "time": "1ms",
-                "type": "existing_store" if svc.closed else "empty_store",
+                "type": ("snapshot" if from_snap
+                         else "existing_store" if svc.closed
+                         else "empty_store"),
                 "stage": "done",
                 "source_host": "-", "source_node": "-",
                 "target_host": "127.0.0.1", "target_node": node.node_name,
-                "repository": "n/a", "snapshot": "n/a",
+                "repository": "n/a",
+                "snapshot": from_snap or "n/a",
                 "files": nfiles, "files_recovered": nfiles,
                 "files_percent": "100.0%", "files_total": nfiles,
                 "bytes": _human_bytes(nbytes),
@@ -2634,20 +2672,35 @@ def cat_repositories(node: TpuNode, params, query, body):
 
 
 def cat_snapshots(node: TpuNode, params, query, body):
+    import time as _time
+
     cols = ["id", "status", "start_epoch", "start_time", "end_epoch",
             "end_time", "duration", "indices", "successful_shards",
             "failed_shards", "total_shards"]
+    help_cols = cols + ["reason"]
     repo = params.get("repo")
     if repo is None:
-        return 200, _cat_format(query, [], cols=cols)
+        return 200, _cat_format(query, [], cols=cols, help_cols=help_cols)
     snaps = node.snapshots.get_snapshot(repo, "_all")
-    rows = [
-        {"id": sn.get("snapshot"), "status": sn.get("state", "SUCCESS"),
-         "indices": len(sn.get("indices", []))}
-        for sn in snaps.get("snapshots", [])
-    ]
-    return 200, _cat_format(query, rows, cols=[
-        "id", "status", "indices"], help_cols=cols)
+    rows = []
+    for sn in snaps.get("snapshots", []):
+        start_s = sn.get("start_time_in_millis", 0) // 1000
+        end_s = sn.get("end_time_in_millis", 0) // 1000
+        shards = sn.get("shards") or {}
+        rows.append({
+            "id": sn.get("snapshot"),
+            "status": sn.get("state", "SUCCESS"),
+            "start_epoch": start_s,
+            "start_time": _time.strftime("%H:%M:%S", _time.gmtime(start_s)),
+            "end_epoch": end_s,
+            "end_time": _time.strftime("%H:%M:%S", _time.gmtime(end_s)),
+            "duration": f"{max(end_s - start_s, 0)}s",
+            "indices": len(sn.get("indices", [])),
+            "successful_shards": shards.get("successful", 0),
+            "failed_shards": shards.get("failed", 0),
+            "total_shards": shards.get("total", 0),
+        })
+    return 200, _cat_format(query, rows, cols=cols, help_cols=help_cols)
 
 
 def cat_tasks(node: TpuNode, params, query, body):

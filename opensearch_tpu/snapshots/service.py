@@ -103,7 +103,9 @@ class SnapshotsService:
         indices_expr = body.get("indices", "_all")
         if isinstance(indices_expr, str):
             indices_expr = [s for s in indices_expr.split(",") if s]
-        names = self._resolve_indices(indices_expr)
+        names = self._resolve_indices(
+            indices_expr,
+            ignore_unavailable=bool(body.get("ignore_unavailable", False)))
         start_ms = int(time.time() * 1000)
         indices_meta: dict[str, Any] = {}
         total_files = 0
@@ -130,6 +132,9 @@ class SnapshotsService:
             "uuid": f"{repo}-{snapshot}-{start_ms}",
             "state": "SUCCESS",
             "indices": indices_meta,
+            "include_global_state": bool(
+                body.get("include_global_state", True)),
+            "metadata": body.get("metadata"),
             "start_time_in_millis": start_ms,
             "end_time_in_millis": int(time.time() * 1000),
             "shards": {
@@ -154,13 +159,14 @@ class SnapshotsService:
                 out.append(str(p.relative_to(shard_dir)))
         return sorted(out)
 
-    def _resolve_indices(self, patterns: list[str]) -> list[str]:
+    def _resolve_indices(self, patterns: list[str],
+                         ignore_unavailable: bool = False) -> list[str]:
         if not patterns or patterns == ["_all"]:
             return sorted(self.node.indices)
         out = []
         for pat in patterns:
             matched = [n for n in self.node.indices if fnmatch.fnmatch(n, pat)]
-            if not matched and "*" not in pat:
+            if not matched and "*" not in pat and not ignore_unavailable:
                 from opensearch_tpu.common.errors import IndexNotFoundException
 
                 raise IndexNotFoundException(pat)
@@ -169,12 +175,24 @@ class SnapshotsService:
 
     # -- get / status / delete ---------------------------------------------
 
-    def _public_snapshot(self, doc: dict) -> dict:
-        return {
+    def _public_snapshot(self, doc: dict, verbose: bool = True) -> dict:
+        if not verbose:
+            # non-verbose listings carry the summary only — no shard
+            # counts, failures, or timing detail
+            return {
+                "snapshot": doc["snapshot"],
+                "uuid": doc["uuid"],
+                "state": doc["state"],
+                "indices": sorted(doc["indices"]),
+            }
+        out = {
             "snapshot": doc["snapshot"],
             "uuid": doc["uuid"],
+            "version": "3.3.0",
+            "version_id": 137227827,
             "state": doc["state"],
             "indices": sorted(doc["indices"]),
+            "include_global_state": doc.get("include_global_state", True),
             "start_time_in_millis": doc["start_time_in_millis"],
             "end_time_in_millis": doc["end_time_in_millis"],
             "duration_in_millis": (
@@ -183,8 +201,15 @@ class SnapshotsService:
             "shards": doc["shards"],
             "failures": [],
         }
+        if doc.get("metadata") is not None:
+            out["metadata"] = doc["metadata"]
+        return out
 
-    def get_snapshot(self, repo: str, snapshot: str | None = None) -> dict:
+    def get_snapshot(self, repo: str, snapshot: str | None = None,
+                     verbose: bool = True,
+                     ignore_unavailable: bool = False) -> dict:
+        from opensearch_tpu.common.errors import SnapshotMissingException
+
         store = self._store(repo)
         root = store.get_json("index") or {"snapshots": []}
         if snapshot in (None, "_all", "*"):
@@ -197,46 +222,71 @@ class SnapshotsService:
                                  if fnmatch.fnmatch(n, pat))
                 elif pat in root["snapshots"]:
                     names.append(pat)
-                else:
-                    raise ResourceNotFoundException(
-                        f"snapshot [{repo}:{pat}] is missing"
-                    )
+                elif not ignore_unavailable:
+                    raise SnapshotMissingException(repo, pat)
         out = []
         for name in sorted(set(names)):
             doc = store.get_json(f"snap-{name}")
             if doc is not None:
-                out.append(self._public_snapshot(doc))
+                out.append(self._public_snapshot(doc, verbose=verbose))
         return {"snapshots": out}
 
     def snapshot_status(self, repo: str, snapshot: str) -> dict:
+        from opensearch_tpu.common.errors import SnapshotMissingException
+
         store = self._store(repo)
         doc = store.get_json(f"snap-{snapshot}")
         if doc is None:
-            raise ResourceNotFoundException(f"snapshot [{repo}:{snapshot}] is missing")
+            raise SnapshotMissingException(repo, snapshot)
         indices = {}
+        agg_files = 0
+        agg_bytes = 0
+        n_shards = 0
         for index, meta in doc["indices"].items():
             shard_stats = {}
             for sid, sh in meta["shards"].items():
                 nfiles = len(sh["files"])
                 nbytes = sum(f["size"] for f in sh["files"].values())
+                agg_files += nfiles
+                agg_bytes += nbytes
+                n_shards += 1
                 shard_stats[sid] = {
                     "stage": "DONE",
-                    "stats": {"number_of_files": nfiles,
-                              "total_size_in_bytes": nbytes},
+                    "stats": self._status_stats(nfiles, nbytes, doc),
                 }
             indices[index] = {"shards": shard_stats}
         return {"snapshots": [{
             "snapshot": doc["snapshot"],
             "repository": repo,
+            "uuid": doc["uuid"],
             "state": doc["state"],
+            "include_global_state": doc.get("include_global_state", True),
+            "shards_stats": {"initializing": 0, "started": 0,
+                             "finalizing": 0, "done": n_shards,
+                             "failed": 0, "total": n_shards},
+            "stats": self._status_stats(agg_files, agg_bytes, doc),
             "indices": indices,
         }]}
 
+    @staticmethod
+    def _status_stats(nfiles: int, nbytes: int, doc: dict) -> dict:
+        start = doc.get("start_time_in_millis", 0)
+        return {
+            "incremental": {"file_count": nfiles,
+                            "size_in_bytes": nbytes},
+            "total": {"file_count": nfiles, "size_in_bytes": nbytes},
+            "start_time_in_millis": start,
+            "time_in_millis": max(
+                doc.get("end_time_in_millis", start) - start, 0),
+        }
+
     def delete_snapshot(self, repo: str, snapshot: str) -> dict:
+        from opensearch_tpu.common.errors import SnapshotMissingException
+
         store = self._store(repo)
         doc = store.get_json(f"snap-{snapshot}")
         if doc is None:
-            raise ResourceNotFoundException(f"snapshot [{repo}:{snapshot}] is missing")
+            raise SnapshotMissingException(repo, snapshot)
         store.delete_json(f"snap-{snapshot}")
         root = store.get_json("index") or {"snapshots": []}
         root["snapshots"] = [s for s in root["snapshots"] if s != snapshot]
@@ -260,10 +310,12 @@ class SnapshotsService:
     def restore_snapshot(self, repo: str, snapshot: str,
                          body: dict | None = None) -> dict:
         body = body or {}
+        from opensearch_tpu.common.errors import SnapshotMissingException
+
         store = self._store(repo)
         doc = store.get_json(f"snap-{snapshot}")
         if doc is None:
-            raise ResourceNotFoundException(f"snapshot [{repo}:{snapshot}] is missing")
+            raise SnapshotMissingException(repo, snapshot)
         indices_expr = body.get("indices", "_all")
         if isinstance(indices_expr, str):
             indices_expr = [s for s in indices_expr.split(",") if s]
@@ -287,24 +339,47 @@ class SnapshotsService:
         # all-or-nothing (no partially-registered indices on conflict)
         for index in targets:
             dest = _dest_name(index)
-            if dest in self.node.indices:
+            existing = self.node.indices.get(dest)
+            if existing is not None and not existing.closed:
                 raise ResourceAlreadyExistsException(
                     f"cannot restore index [{dest}] because an open index "
                     "with same name already exists in the cluster"
                 )
+        import shutil as _sh
+
+        # fetch EVERY blob before touching any index: a missing/corrupt
+        # blob must fail the whole restore with nothing destroyed
+        fetched: dict[str, dict[str, dict[str, bytes]]] = {}
+        for index in targets:
+            meta = doc["indices"][index]
+            per_shard: dict[str, dict[str, bytes]] = {}
+            for sid, sh in meta["shards"].items():
+                per_shard[sid] = {
+                    rel: store.get_blob(info["hash"])
+                    for rel, info in sh["files"].items()
+                }
+            fetched[index] = per_shard
         restored = []
         for index in targets:
             dest = _dest_name(index)
             meta = doc["indices"][index]
+            # a CLOSED index of the same name is replaced (the reference
+            # restores into closed indices)
+            existing = self.node.indices.pop(dest, None)
+            if existing is not None:
+                existing.close()
             dest_path = self.node._index_path(dest)
-            for sid, sh in meta["shards"].items():
+            _sh.rmtree(dest_path, ignore_errors=True)
+            for sid, files in fetched[index].items():
                 shard_dir = dest_path / sid
-                for rel, info in sh["files"].items():
+                for rel, data in files.items():
                     out = shard_dir / rel
                     out.parent.mkdir(parents=True, exist_ok=True)
-                    out.write_bytes(store.get_blob(info["hash"]))
+                    out.write_bytes(data)
             self.node.attach_index(dest, meta["settings"], meta["mappings"])
+            self.node.indices[dest].restored_from_snapshot = snapshot
             restored.append(dest)
+        self.node._persist_index_registry()
         return {"snapshot": {
             "snapshot": snapshot,
             "indices": restored,

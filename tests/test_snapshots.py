@@ -102,7 +102,9 @@ def test_delete_snapshot_gc(node):
     node.snapshots.delete_snapshot("backup", "snap1")
     assert node.snapshots.get_snapshot("backup")["snapshots"] == []
     assert store.list_blobs() == []  # all blobs unreferenced -> GC'd
-    with pytest.raises(ResourceNotFoundException):
+    from opensearch_tpu.common.errors import SnapshotMissingException
+
+    with pytest.raises(SnapshotMissingException):
         node.snapshots.delete_snapshot("backup", "snap1")
 
 
