@@ -163,42 +163,103 @@ def reroute(state: ClusterState, settings: AllocationSettings | None = None) -> 
     for index_name in sorted(state.indices):
         meta = state.indices[index_name]
         for shard in range(meta.num_shards):
-            copies_needed = [True] + [False] * meta.num_replicas  # primary first
             # keep currently assigned copies whose node still exists
             current = [
                 r for r in state.routing
                 if r.index == index_name and r.shard == shard
                 and r.node_id in state.nodes and r.state != "UNASSIGNED"
             ]
+            # repair half-dead relocation pairs: a RELOCATING source whose
+            # target died reverts to a plain STARTED copy (relocation
+            # cancelled); a shadow target whose source died continues as a
+            # plain INITIALIZING replica recovering from the primary
+            # (RoutingNodes.cancelRelocation semantics). Mates must be in
+            # the matching STATE, not just point at each other — a stale
+            # entry shape must never leave an unpairable source behind.
+            sources = {
+                (r.node_id, r.relocating_node) for r in current
+                if r.state == "RELOCATING"
+            }
+            targets = {
+                (r.node_id, r.relocating_node) for r in current
+                if r.is_relocation_target
+            }
+            repaired = []
+            for r in current:
+                if r.state == "RELOCATING" and (
+                    r.relocating_node, r.node_id
+                ) not in targets:
+                    r = ShardRoutingEntry(r.index, r.shard, r.node_id,
+                                          r.primary, "STARTED")
+                elif r.is_relocation_target and (
+                    r.relocating_node, r.node_id
+                ) not in sources:
+                    r = ShardRoutingEntry(r.index, r.shard, r.node_id,
+                                          r.primary, "INITIALIZING")
+                repaired.append(r)
+            current = repaired
             current_primary = next((r for r in current if r.primary), None)
-            current_replicas = [r for r in current if not r.primary]
+            # group replicas into UNITS: a RELOCATING source and its shadow
+            # target are ONE logical copy and must be kept (or dropped)
+            # together, or the replica count double-books the pair
+            replicas = [r for r in current if not r.primary]
+            paired: dict[int, int] = {}  # id(target) -> id(source)
+            for r in replicas:
+                if r.state == "RELOCATING":
+                    mate = next(
+                        (x for x in replicas if x.is_relocation_target
+                         and x.node_id == r.relocating_node), None)
+                    if mate is not None:
+                        paired[id(mate)] = id(r)
+            units: list[list[ShardRoutingEntry]] = []
+            for r in replicas:
+                if id(r) in paired:
+                    continue  # emitted with its source below
+                if r.state == "RELOCATING":
+                    mate = next(
+                        x for x in replicas if x.is_relocation_target
+                        and x.node_id == r.relocating_node)
+                    units.append([r, mate])
+                else:
+                    units.append([r])
 
             if current_primary is not None:
                 new_routing.append(current_primary)
-                kept = current_replicas[: meta.num_replicas]
+                kept_units = units[: meta.num_replicas]
             else:
-                # promote a started replica to primary (failover) before
-                # allocating a fresh one (the in-sync promotion path)
-                promoted = next(
-                    (r for r in current_replicas if r.state == "STARTED"), None
+                # promote a started (or relocating — it serves too) replica
+                # to primary (failover) before allocating a fresh one (the
+                # in-sync promotion path)
+                promoted_unit = next(
+                    (u for u in units
+                     if u[0].state in ("STARTED", "RELOCATING")), None
                 )
-                if promoted is not None:
-                    current_replicas.remove(promoted)
-                    kept = current_replicas[: meta.num_replicas]
+                if promoted_unit is not None:
+                    units.remove(promoted_unit)
+                    src = promoted_unit[0]
                     new_routing.append(
-                        ShardRoutingEntry(index_name, shard, promoted.node_id,
-                                          primary=True, state=promoted.state)
+                        ShardRoutingEntry(index_name, shard, src.node_id,
+                                          primary=True, state="STARTED")
                     )
+                    if len(promoted_unit) == 2:
+                        # the promoted copy's in-flight relocation cancels;
+                        # its shadow keeps recovering as a plain replica
+                        t = promoted_unit[1]
+                        units.append([ShardRoutingEntry(
+                            index_name, shard, t.node_id, primary=False,
+                            state="INITIALIZING")])
+                    kept_units = units[: meta.num_replicas]
                 else:
                     # fresh primary allocation; the deciders must also see
                     # the replicas we are about to keep, or the primary can
                     # land on a node already holding a copy of this shard
                     # (SameShardAllocationDecider violation)
-                    kept = current_replicas[: meta.num_replicas]
+                    kept_units = units[: meta.num_replicas]
+                    kept_flat = [r for u in kept_units for r in u]
                     candidates = sorted(
                         (nid for nid in data_nodes
                          if _decide(state, ShardRoutingEntry(index_name, shard, None, True),
-                                    nid, new_routing + kept, settings)),
+                                    nid, new_routing + kept_flat, settings)),
                         key=lambda nid: (node_load(nid), nid),
                     )
                     if candidates:
@@ -212,8 +273,9 @@ def reroute(state: ClusterState, settings: AllocationSettings | None = None) -> 
                                               primary=True, state="UNASSIGNED")
                         )
 
-            new_routing.extend(kept)
-            for _ in range(meta.num_replicas - len(kept)):
+            for u in kept_units:
+                new_routing.extend(u)
+            for _ in range(meta.num_replicas - len(kept_units)):
                 entry = ShardRoutingEntry(index_name, shard, None, primary=False)
                 candidates = sorted(
                     (nid for nid in data_nodes
@@ -237,10 +299,20 @@ def _rebalance(state: ClusterState, routing: list[ShardRoutingEntry],
                data_nodes: list[str],
                settings: AllocationSettings) -> list[ShardRoutingEntry]:
     """BalancedShardsAllocator's rebalance pass, reduced to the shard-count
-    weight: move ONE started replica per round from the most- to the
+    weight: relocate ONE started replica per round from the most- to the
     least-loaded node when the spread exceeds the threshold; successive
-    publications (each shard-started triggers one) converge the layout."""
+    publications (each shard-started triggers one) converge the layout.
+
+    A move is a real RELOCATION: the source copy keeps serving in state
+    RELOCATING (relocating_node = target) while a shadow target copy
+    recovers on the destination; `mark_shard_started` performs the atomic
+    routing swap when the target catches up."""
     if len(data_nodes) < 2:
+        return routing
+    # one relocation at a time: an in-flight pair double-counts node load
+    # and occupies recovery bandwidth — let it finish before planning more
+    if any(r.state == "RELOCATING" or r.is_relocation_target
+           for r in routing):
         return routing
 
     def load(nid: str) -> int:
@@ -257,8 +329,13 @@ def _rebalance(state: ClusterState, routing: list[ShardRoutingEntry],
                             settings)):
             routing = list(routing)
             routing[i] = ShardRoutingEntry(
-                r.index, r.shard, light, primary=False, state="INITIALIZING"
+                r.index, r.shard, heavy, primary=False, state="RELOCATING",
+                relocating_node=light,
             )
+            routing.append(ShardRoutingEntry(
+                r.index, r.shard, light, primary=False,
+                state="INITIALIZING", relocating_node=heavy,
+            ))
             return routing
     # no movable replica on the heavy node (all primaries): swap the
     # primary ROLE with a started replica on a lighter node (flag-only —
@@ -288,7 +365,28 @@ def _rebalance(state: ClusterState, routing: list[ShardRoutingEntry],
 def mark_shard_started(
     state: ClusterState, index: str, shard: int, node_id: str
 ) -> ClusterState:
-    """shard-started master task (ShardStateAction analog)."""
+    """shard-started master task (ShardStateAction analog). When the
+    started copy is a RELOCATION TARGET, this is the atomic routing swap:
+    in ONE published state the source's RELOCATING entry disappears and
+    the target becomes the plain STARTED copy — readers never observe a
+    moment with zero (or two independent) serving copies."""
+    started = next(
+        (r for r in state.routing
+         if r.index == index and r.shard == shard and r.node_id == node_id),
+        None,
+    )
+    if started is not None and started.is_relocation_target:
+        source = started.relocating_node
+        routing = tuple(
+            ShardRoutingEntry(r.index, r.shard, r.node_id, r.primary,
+                              "STARTED")
+            if r is started else r
+            for r in state.routing
+            if not (r.index == index and r.shard == shard
+                    and r.node_id == source and r.state == "RELOCATING"
+                    and r.relocating_node == node_id)
+        )
+        return state.with_(routing=routing)
     routing = tuple(
         r if not (r.index == index and r.shard == shard and r.node_id == node_id)
         else ShardRoutingEntry(r.index, r.shard, r.node_id, r.primary, "STARTED")

@@ -127,7 +127,16 @@ class ClusterNode:
         self._tracked_targets: dict[tuple[str, int], set[str]] = {}
         # recovery-source mode counters (tests assert ops-based recovery
         # ships zero segment bytes when a retention lease holds)
-        self.recovery_stats = {"ops_based": 0, "segment_based": 0}
+        self.recovery_stats = {"ops_based": 0, "segment_based": 0,
+                               "dump_based": 0}
+        # recovery subsystem (indices/recovery/ analog): source-side chunk
+        # sessions + target-side progress records (RecoveryState), exposed
+        # via indices:monitor/recovery[node] for _cat/recovery
+        from opensearch_tpu.index.recovery import RecoverySourceSessions
+
+        self._recovery_sources = RecoverySourceSessions()
+        self._recovery_drivers: dict[tuple[str, int], Any] = {}
+        self.recoveries: dict[tuple[str, int], Any] = {}
 
         reg = transport.register
         reg(node_id, "cluster:admin/create_index", self._on_create_index)
@@ -153,6 +162,13 @@ class ClusterNode:
         reg(node_id, "indices:replication/checkpoint", self._on_replication_checkpoint)
         reg(node_id, "indices:replication/get_segments", self._on_get_segments)
         reg(node_id, "internal:index/shard/recovery/start", self._on_start_recovery)
+        reg(node_id, "internal:index/shard/recovery/file_chunk",
+            self._on_recovery_file_chunk)
+        reg(node_id, "internal:index/shard/recovery/ops_chunk",
+            self._on_recovery_ops_chunk)
+        reg(node_id, "internal:index/shard/recovery/finalize",
+            self._on_recovery_finalize)
+        reg(node_id, "indices:monitor/recovery[node]", self._on_node_recovery)
         # per-node reader contexts (scroll/PIT pin snapshots node-side; the
         # coordinator's scroll id maps node -> local ctx — ReaderContext
         # .java:64 semantics distributed)
@@ -173,6 +189,34 @@ class ClusterNode:
         if self.applied_state.indices:
             self._apply_cluster_state(self.applied_state)
         self.coordinator.start()
+        self._schedule_shard_state_tick()
+
+    # ShardStateAction resend loop: a shard-started message can be LOST
+    # (leader change, half-open link) and with no further publication the
+    # copy would sit INITIALIZING forever. Periodically re-report local
+    # copies that finished recovering until the routing table shows them
+    # STARTED (the reference resends via ShardStateAction retries).
+    _SHARD_STATE_TICK_MS = 2_000
+
+    def _schedule_shard_state_tick(self) -> None:
+        if getattr(self, "_closed", False):
+            return
+        self._shard_tick_timer = self.scheduler.schedule(
+            self._SHARD_STATE_TICK_MS, self._shard_state_tick
+        )
+
+    def _shard_state_tick(self) -> None:
+        if getattr(self, "_closed", False):
+            return
+        for r in self.applied_state.shards_for_node(self.node_id):
+            if r.state != "INITIALIZING":
+                continue
+            shard = self.local_shards.get((r.index, r.shard))
+            if shard is not None and (
+                r.primary or getattr(shard, "recovery_done", False)
+            ):
+                self._report_shard_started(r.index, r.shard)
+        self._schedule_shard_state_tick()
 
     def bootstrap(self, voting_ids: list[str]) -> None:
         self.coordinator.bootstrap(voting_ids)
@@ -212,7 +256,33 @@ class ClusterNode:
             if key not in my_shards or key[0] not in state.indices:
                 shard = self.local_shards.pop(key)
                 self._tracked_targets.pop(key, None)
+                driver = self._recovery_drivers.pop(key, None)
+                if driver is not None:
+                    driver.cancel()
+                # the recovery record leaves the node with its shard, like
+                # the reference's per-shard RecoveryState
+                self.recoveries.pop(key, None)
                 shard.close()
+                # a copy that MOVED AWAY (relocation swap completed, or the
+                # allocator rebalanced it) deletes its local files when the
+                # cluster holds another live copy — IndicesStore
+                # .deleteShardIfExistElseWhere. A plain node-left keeps the
+                # files: a returning node recovers far cheaper from them
+                # (ops-based path off the local checkpoint).
+                if key[0] in state.indices and any(
+                    r.node_id not in (None, self.node_id)
+                    and r.state in ("STARTED", "RELOCATING")
+                    for r in state.routing if (r.index, r.shard) == key
+                ):
+                    import shutil
+
+                    shutil.rmtree(
+                        self.data_path / "indices" / key[0] / str(key[1]),
+                        ignore_errors=True,
+                    )
+        # recovery progress records and source sessions die with their index
+        for key in [k for k in self.recoveries if k[0] not in state.indices]:
+            del self.recoveries[key]
         # drop tracked recovery targets that are no longer assigned copies,
         # and release their retention leases — a departed copy must not pin
         # translog history forever (ReplicationTracker removes peer leases
@@ -228,6 +298,9 @@ class ClusterNode:
                 for nid in gone:
                     local.engine.retention_leases.remove(
                         f"peer_recovery/{nid}")
+            for nid in gone:
+                # a departed target's chunk session stops pinning blobs
+                self._recovery_sources.drop_target(key[0], key[1], nid)
             targets &= assigned
             if not targets:
                 self._tracked_targets.pop(key, None)
@@ -261,6 +334,18 @@ class ClusterNode:
                 if entry.state == "INITIALIZING":
                     if entry.primary:
                         # local (possibly empty) store is authoritative
+                        from opensearch_tpu.index.recovery import (
+                            RecoveryProgress,
+                        )
+
+                        p = RecoveryProgress(
+                            index_name, shard_num, self.node_id,
+                            recovery_type=(
+                                "EXISTING_STORE" if shard.num_docs
+                                else "EMPTY_STORE"),
+                        )
+                        p.done()
+                        self.recoveries[(index_name, shard_num)] = p
                         self._report_shard_started(index_name, shard_num)
                     else:
                         self._start_replica_recovery(index_name, shard_num, state)
@@ -323,7 +408,22 @@ class ClusterNode:
         )
         return {"ack": True}
 
+    def _after_offload(self, fn, cb) -> None:
+        """Run `fn` on the data worker; `cb(ok: bool)` fires back on the
+        transport execution context (synchronously under the sim)."""
+        out = self._offload(fn)
+        from opensearch_tpu.transport.base import DeferredResponse
+
+        if isinstance(out, DeferredResponse):
+            out.on_done(lambda d: cb(d.error is None and bool(d.result)))
+        else:
+            cb(bool(out))
+
     def _start_replica_recovery(self, index: str, shard: int, state: ClusterState) -> None:
+        """Target-side peer recovery (RecoveryTarget analog): request a
+        manifest from the primary, stream what it names in bounded chunks
+        (per-chunk timeout + exponential-backoff retry), catch up live
+        writes via the seqno handoff, then report shard-started."""
         local = self.local_shards.get((index, shard))
         if local is not None:
             local.recovery_inflight = True
@@ -334,43 +434,87 @@ class ClusterNode:
                 500, lambda: self._retry_recovery(index, shard)
             )
             return
+        from opensearch_tpu.index.recovery import (
+            RecoveryProgress,
+            RecoveryTargetDriver,
+        )
 
-        def on_response(resp: dict) -> None:
-            if isinstance(resp, dict) and resp.get("mode") == "segment":
-                self._finish_segment_recovery(index, shard, state, resp)
+        entry = next(
+            (r for r in state.shards_for_node(self.node_id)
+             if r.index == index and r.shard == shard), None
+        )
+        progress = RecoveryProgress(
+            index, shard, self.node_id, primary.node_id,
+            recovery_type=(
+                "RELOCATION" if entry is not None and entry.relocating_node
+                else "PEER"
+            ),
+        )
+        self.recoveries[(index, shard)] = progress
+        old = self._recovery_drivers.pop((index, shard), None)
+        if old is not None:
+            old.cancel()
+        driver = RecoveryTargetDriver(
+            self.transport, self.scheduler, self.node_id, primary.node_id,
+            index, shard, progress,
+        )
+        self._recovery_drivers[(index, shard)] = driver
+
+        def fail_and_retry(_e: Exception | None = None) -> None:
+            if driver.cancelled:
                 return
+            progress.failed()
+            if self._recovery_drivers.get((index, shard)) is driver:
+                self._recovery_drivers.pop((index, shard), None)
+            self.scheduler.schedule(
+                1000, lambda: self._retry_recovery(index, shard)
+            )
 
-            def apply() -> bool:
-                local = self.local_shards.get((index, shard))
-                if local is None:
-                    return False
-                ops_mode = resp.get("mode") == "ops"
-                for op in resp["ops"]:
-                    if op["op"] == "index":
-                        local.apply_index_on_replica(
-                            op["id"], op["source"], op["seq_no"],
-                            op.get("routing"),
-                        )
-                    else:
-                        local.apply_delete_on_replica(op["id"], op["seq_no"])
-                if ops_mode:
-                    # replayed history must survive a crash of this node
-                    local.engine.translog.sync()
-                local.refresh()
-                local.recovery_done = True
-                local.recovery_inflight = False
-                return True
+        def succeed() -> None:
+            if driver.cancelled:
+                # superseded mid-install (shard evicted/recreated): the
+                # fresh driver owns the shard's fate — marking recovery_done
+                # here would report a possibly-empty copy as STARTED
+                return
+            lcl = self.local_shards.get((index, shard))
+            if lcl is not None:
+                lcl.recovery_done = True
+                lcl.recovery_inflight = False
+            progress.done()
+            if self._recovery_drivers.get((index, shard)) is driver:
+                self._recovery_drivers.pop((index, shard), None)
+            self._report_shard_started(index, shard)
 
-            done = self._offload(apply)
-            from opensearch_tpu.transport.base import DeferredResponse
+        def finalize_then(done_fn) -> None:
+            lcl = self.local_shards.get((index, shard))
+            if lcl is None:
+                fail_and_retry()
+                return
+            driver.finalize(
+                lambda: lcl.engine.local_checkpoint,
+                lambda ok: done_fn() if ok else fail_and_retry(),
+            )
 
-            if isinstance(done, DeferredResponse):
-                done.on_done(lambda d: (
-                    self._report_shard_started(index, shard)
-                    if d.error is None and d.result else None
-                ))
-            elif done:
-                self._report_shard_started(index, shard)
+        def on_manifest(resp) -> None:
+            if driver.cancelled or not isinstance(resp, dict):
+                fail_and_retry()
+                return
+            mode = resp.get("mode")
+            if mode == "ops":
+                self._recover_from_ops(index, shard, resp, progress,
+                                       succeed, fail_and_retry)
+            elif mode == "segment":
+                self._recover_from_segments(
+                    index, shard, resp, driver, progress,
+                    lambda: finalize_then(succeed), fail_and_retry,
+                )
+            elif mode == "dump":
+                self._recover_from_dump(
+                    index, shard, resp, driver, progress,
+                    lambda: finalize_then(succeed), fail_and_retry,
+                )
+            else:
+                fail_and_retry()
 
         self.transport.send(
             self.node_id, primary.node_id, "internal:index/shard/recovery/start",
@@ -381,43 +525,71 @@ class ClusterNode:
              "local_checkpoint": (
                  local.engine.local_checkpoint if local is not None else -1
              )},
-            on_response=on_response,
-            on_failure=lambda e: self.scheduler.schedule(
-                1000, lambda: self._retry_recovery(index, shard)
-            ),
-            # a full-shard segment dump can be large (phase1 file copy)
-            timeout_ms=180_000,
+            on_response=on_manifest,
+            on_failure=fail_and_retry,
+            # the manifest itself is small; the bulk ships as chunks
+            timeout_ms=60_000,
         )
 
-    def _finish_segment_recovery(self, index: str, shard: int,
-                                 state: ClusterState, resp: dict) -> None:
-        """File-based recovery target: pull the primary's segments (one per
-        request), install them verbatim (no re-analysis), append the
-        translog tail, then FLUSH — the recovered state must survive a
-        crash of this node (segments + commit + translog on disk)."""
-        primary = state.primary(index, shard)
+    def _recover_from_ops(self, index: str, shard: int, resp: dict,
+                          progress, succeed, fail) -> None:
+        """Ops-only replay (retention-lease fast path): small by
+        construction, applied in one offloaded step."""
+        ops = resp.get("ops") or []
+        progress.stage = "TRANSLOG"
+        progress.ops_total = len(ops)
+
+        def apply() -> bool:
+            local = self.local_shards.get((index, shard))
+            if local is None:
+                return False
+            for op in ops:
+                if op["op"] == "index":
+                    local.apply_index_on_replica(
+                        op["id"], op["source"], op["seq_no"],
+                        op.get("routing"),
+                    )
+                else:
+                    local.apply_delete_on_replica(op["id"], op["seq_no"])
+            # replayed history must survive a crash of this node
+            local.engine.translog.sync()
+            local.refresh()
+            progress.ops_recovered = len(ops)
+            return True
+
+        self._after_offload(apply, lambda ok: succeed() if ok else fail())
+
+    def _recover_from_segments(self, index: str, shard: int, resp: dict,
+                               driver, progress, succeed, fail) -> None:
+        """File-based recovery target: stream the primary's changed
+        segments in byte-range chunks, install them verbatim (no
+        re-analysis), append the translog tail, then FLUSH — the recovered
+        state must survive a crash of this node (segments + commit +
+        translog on disk)."""
         local = self.local_shards.get((index, shard))
-        if primary is None or primary.node_id is None or local is None:
-            self.scheduler.schedule(
-                1000, lambda: self._retry_recovery(index, shard)
-            )
+        if local is None:
+            fail()
             return
         have = local.engine.segment_sigs()
         want_sigs = resp.get("sigs") or {}
-        need = [n for n in resp["order"] if have.get(n) != want_sigs.get(n)]
+        order = list(resp["order"])
+        need = [n for n in order if have.get(n) != want_sigs.get(n)]
+        tail_ops = resp.get("ops") or []
 
-        def after_install(ok: bool) -> None:
+        def after_files(ok: bool, blobs: dict) -> None:
             if not ok:
-                self.scheduler.schedule(
-                    1000, lambda: self._retry_recovery(index, shard)
-                )
+                fail()
                 return
 
-            def finalize() -> bool:
+            def install() -> bool:
+                from opensearch_tpu.index.segment import unpack_segment
+
                 lcl = self.local_shards.get((index, shard))
                 if lcl is None:
                     return False
-                for op in resp["ops"]:
+                hosts = [unpack_segment(blobs[n]) for n in need if n in blobs]
+                lcl.engine.install_replicated_segments(hosts, order)
+                for op in tail_ops:
                     entry = lcl.engine.version_map.get(op["id"])
                     if entry is not None and entry.seq_no >= op["seq_no"]:
                         continue  # covered by an installed segment
@@ -426,25 +598,55 @@ class ClusterNode:
                 # BEFORE its first local flush (installed segments existed
                 # only in memory until here)
                 lcl.engine.flush()
-                lcl.recovery_done = True
-                lcl.recovery_inflight = False
+                progress.ops_recovered = len(tail_ops)
                 return True
 
-            deferred = self._offload(finalize)
-            from opensearch_tpu.transport.base import DeferredResponse
+            self._after_offload(install,
+                                lambda ok2: succeed() if ok2 else fail())
 
-            if isinstance(deferred, DeferredResponse):
-                deferred.on_done(lambda d: (
-                    self._report_shard_started(index, shard)
-                    if d.error is None and d.result else None
-                ))
-            elif deferred:
-                self._report_shard_started(index, shard)
+        progress.ops_total = len(tail_ops)
+        driver.fetch_files(need, resp.get("sizes") or {}, after_files)
 
-        self._fetch_and_install(
-            index, shard, primary.node_id, resp["order"], need,
-            done=after_install,
-        )
+    def _recover_from_dump(self, index: str, shard: int, resp: dict,
+                           driver, progress, succeed, fail) -> None:
+        """Logical live-doc dump, pulled in bounded batches and applied as
+        each lands (document-replication fresh target)."""
+        total = int(resp.get("total_ops", 0))
+
+        def apply_batch(batch: list, cont) -> None:
+            def run() -> bool:
+                lcl = self.local_shards.get((index, shard))
+                if lcl is None:
+                    return False
+                for op in batch:
+                    if op["op"] == "index":
+                        lcl.apply_index_on_replica(
+                            op["id"], op["source"], op["seq_no"],
+                            op.get("routing"),
+                        )
+                    else:
+                        lcl.apply_delete_on_replica(op["id"], op["seq_no"])
+                return True
+
+            self._after_offload(run, cont)
+
+        def after_ops(ok: bool) -> None:
+            if not ok:
+                fail()
+                return
+
+            def finish() -> bool:
+                lcl = self.local_shards.get((index, shard))
+                if lcl is None:
+                    return False
+                lcl.engine.translog.sync()
+                lcl.refresh()
+                return True
+
+            self._after_offload(finish,
+                                lambda ok2: succeed() if ok2 else fail())
+
+        driver.fetch_ops(total, apply_batch, after_ops)
 
     def _retry_recovery(self, index: str, shard: int) -> None:
         if (index, shard) in self.local_shards and not self.local_shards[(index, shard)].primary:
@@ -468,6 +670,9 @@ class ClusterNode:
         shard = self._local_shard(payload["index"], payload["shard"])
         target = payload["target"]
         target_ckpt = int(payload.get("local_checkpoint", -1))
+        # a target that died mid-transfer without being evicted must not
+        # pin packed blobs forever
+        self._recovery_sources.reap()
         # ops-based recovery serves DOCUMENT replication; a segrep replica's
         # searchable state is the primary's segment set, so its recovery
         # stays the sig-diff file sync (only changed segments transfer)
@@ -492,8 +697,18 @@ class ClusterNode:
                 (payload["index"], payload["shard"]), set()
             ).add(payload["target"])
             self.recovery_stats["segment_based"] += 1
-            # phase1 manifest only — the target pulls each segment in its
-            # own request (bounded frame sizes); phase2 = the translog tail
+            # phase1 manifest only — the target pulls each needed segment
+            # as byte-range chunks from the session opened here (bounded
+            # frame sizes); phase2 = the translog tail in the manifest
+            session = self._recovery_sources.open(
+                payload["index"], payload["shard"], target,
+                mode="segment",
+                max_seq_no=shard.engine.max_seq_no,
+            )
+            # immutable host refs captured NOW; chunks pack lazily from them
+            session["hosts"] = {
+                h.name: h for h, _dev in shard.engine._segments
+            }
             return {
                 "mode": "segment",
                 "order": shard.engine.segment_names(),
@@ -540,7 +755,77 @@ class ClusterNode:
                     "seq_no": entry2.seq_no if entry2 else 0,
                     "routing": None,
                 })
-        return {"ops": ops, "max_seq_no": engine.max_seq_no}
+        # the dump stays on the source as a SESSION; the target pulls it in
+        # bounded batches (chunked phase2 instead of one giant frame)
+        self.recovery_stats["dump_based"] += 1
+        self._recovery_sources.open(
+            payload["index"], payload["shard"], target,
+            mode="dump", ops=ops, max_seq_no=engine.max_seq_no,
+        )
+        return {"mode": "dump", "total_ops": len(ops),
+                "max_seq_no": engine.max_seq_no}
+
+    # -- recovery chunk serving (source side) -------------------------------
+
+    def _on_recovery_file_chunk(self, sender: str, payload: dict):
+        def run() -> dict:
+            key = (payload["index"], payload["shard"], payload["target"])
+            session = self._recovery_sources.get(*key)
+            if session is None:
+                raise OpenSearchTpuException(
+                    f"no recovery session for [{payload['index']}]"
+                    f"[{payload['shard']}] -> {payload['target']}"
+                )
+            name = payload["name"]
+            if name not in session["blobs"]:
+                host = (session.get("hosts") or {}).get(name)
+                if host is None:
+                    raise OpenSearchTpuException(
+                        f"segment [{name}] not in recovery session"
+                    )
+                from opensearch_tpu.index.segment import pack_segment
+
+                # pack lazily, once; retried chunks re-read the same bytes
+                session["blobs"][name] = pack_segment(host)
+            from opensearch_tpu.index.recovery import DEFAULT_CHUNK_BYTES
+
+            return self._recovery_sources.file_chunk(
+                payload["index"], payload["shard"], payload["target"],
+                name, int(payload.get("offset", 0)),
+                int(payload.get("length") or 0) or DEFAULT_CHUNK_BYTES,
+            )
+
+        return self._offload(run)
+
+    def _on_recovery_ops_chunk(self, sender: str, payload: dict) -> dict:
+        try:
+            return self._recovery_sources.ops_batch(
+                payload["index"], payload["shard"], payload["target"],
+                int(payload.get("from", 0)),
+                int(payload.get("size", 0) or 500),
+            )
+        except KeyError as e:
+            raise OpenSearchTpuException(str(e)) from e
+
+    def _on_recovery_finalize(self, sender: str, payload: dict) -> dict:
+        """Seqno handoff: report the primary's max_seq_no so the target can
+        verify it caught up before the routing swap; the chunk session is
+        done (fan-out to the tracked target carries everything newer)."""
+        shard = self._local_shard(payload["index"], payload["shard"])
+        self._recovery_sources.close(
+            payload["index"], payload["shard"], payload["target"]
+        )
+        return {"max_seq_no": shard.engine.max_seq_no}
+
+    def _on_node_recovery(self, sender: str, payload: dict) -> dict:
+        """Per-node recovery progress records (RecoveryState collection
+        backing GET [/{index}]/_recovery and _cat/recovery)."""
+        want = payload.get("indices")
+        return {"recoveries": [
+            p.to_dict() for (index, _shard), p in sorted(
+                self.recoveries.items())
+            if want is None or index in want
+        ]}
 
     # ------------------------------------------------------------------ #
     # metadata APIs (routed to the leader)
@@ -817,14 +1102,23 @@ class ClusterNode:
         analog) — no leader round-trip needed for a health read."""
         state = self.applied_state
         total = len(state.routing)
-        active = sum(1 for r in state.routing if r.state == "STARTED")
+        # a RELOCATING copy is a fully started copy that happens to be
+        # moving — it serves reads and counts active (ClusterStateHealth)
+        active = sum(1 for r in state.routing
+                     if r.state in ("STARTED", "RELOCATING"))
         active_primaries = sum(
-            1 for r in state.routing if r.primary and r.state == "STARTED"
+            1 for r in state.routing
+            if r.primary and r.state in ("STARTED", "RELOCATING")
         )
         unassigned = sum(1 for r in state.routing if r.state == "UNASSIGNED")
-        initializing = sum(1 for r in state.routing if r.state == "INITIALIZING")
+        relocating = sum(1 for r in state.routing if r.state == "RELOCATING")
+        initializing = sum(
+            1 for r in state.routing
+            if r.state == "INITIALIZING" and not r.is_relocation_target
+        )
         primaries_down = any(
-            r.primary and r.state != "STARTED" for r in state.routing
+            r.primary and r.state not in ("STARTED", "RELOCATING")
+            for r in state.routing
         )
         status = ("red" if primaries_down
                   else "yellow" if unassigned or initializing else "green")
@@ -837,6 +1131,7 @@ class ClusterNode:
             ),
             "active_primary_shards": active_primaries,
             "active_shards": active,
+            "relocating_shards": relocating,
             "initializing_shards": initializing,
             "unassigned_shards": unassigned,
             "cluster_manager_node": state.leader_id,
@@ -909,14 +1204,15 @@ class ClusterNode:
 
     def _continue_primary_write(self, payload: dict, result):
         index, shard_num = payload["index"], payload["shard"]
-        # fan out to every assigned replica copy — STARTED and recovering
-        # alike (performOnReplicas sends to all in-sync + tracked copies; a
-        # recovering replica dedups via seq_no)
+        # fan out to every assigned replica copy — STARTED, RELOCATING and
+        # recovering alike (performOnReplicas sends to all in-sync + tracked
+        # copies; a recovering replica dedups via seq_no)
         state = self.applied_state
         target_nodes = {
             r.node_id for r in state.shards_for_index(index)
             if r.shard == shard_num and not r.primary
-            and r.state in ("STARTED", "INITIALIZING") and r.node_id is not None
+            and r.state in ("STARTED", "INITIALIZING", "RELOCATING")
+            and r.node_id is not None
         }
         target_nodes |= self._tracked_targets.get((index, shard_num), set())
         target_nodes.discard(self.node_id)
@@ -1039,7 +1335,7 @@ class ClusterNode:
         target_nodes = {
             r.node_id for r in state.shards_for_index(index)
             if r.shard == shard_num and not r.primary
-            and r.state in ("STARTED", "INITIALIZING")
+            and r.state in ("STARTED", "INITIALIZING", "RELOCATING")
             and r.node_id is not None
         }
         target_nodes |= self._tracked_targets.get((index, shard_num), set())
@@ -1213,7 +1509,7 @@ class ClusterNode:
         state = self.applied_state
         targets = [
             r for r in state.shards_for_index(index)
-            if r.node_id is not None and r.state == "STARTED"
+            if r.node_id is not None and r.state in ("STARTED", "RELOCATING")
         ]
         if not targets:
             callback({"_shards": {"total": 0, "successful": 0, "failed": 0}})
@@ -1268,7 +1564,7 @@ class ClusterNode:
         for r in state.shards_for_index(index):
             if (r.shard == shard_num and not r.primary
                     and r.node_id not in (None, self.node_id)
-                    and r.state == "STARTED"):
+                    and r.state in ("STARTED", "RELOCATING")):
                 self.transport.send(
                     self.node_id, r.node_id,
                     "indices:replication/checkpoint", checkpoint,
@@ -1391,7 +1687,8 @@ class ClusterNode:
         # selection is a later refinement)
         targets: dict[int, ShardRoutingEntry] = {}
         for r in state.shards_for_index(index):
-            if r.state != "STARTED" or r.node_id is None:
+            # RELOCATING sources keep serving reads until the routing swap
+            if r.state not in ("STARTED", "RELOCATING") or r.node_id is None:
                 continue
             if r.shard not in targets or r.primary:
                 targets[r.shard] = r
@@ -1708,6 +2005,13 @@ class ClusterNode:
         }
 
     def close(self) -> None:
+        self._closed = True
+        timer = getattr(self, "_shard_tick_timer", None)
+        if timer is not None:
+            timer.cancel()
+        for driver in self._recovery_drivers.values():
+            driver.cancel()
+        self._recovery_drivers.clear()
         self.coordinator.stop()
         if self._data_executor is not None:
             self._data_executor.shutdown(wait=False)

@@ -492,7 +492,8 @@ class ClusterFacade:
             meta = self._meta(name)
             targets: dict[int, Any] = {}
             for r in state.shards_for_index(name):
-                if r.state != "STARTED" or r.node_id is None:
+                # RELOCATING sources still serve until the routing swap
+                if r.state not in ("STARTED", "RELOCATING") or r.node_id is None:
                     continue
                 if r.shard not in targets or r.primary:
                     targets[r.shard] = r
@@ -915,7 +916,8 @@ class ClusterFacade:
                 for r in state.routing_for_index(name):
                     shards.setdefault(str(r.shard), []).append({
                         "state": r.state, "primary": r.primary,
-                        "node": r.node_id, "relocating_node": None,
+                        "node": r.node_id,
+                        "relocating_node": r.relocating_node,
                         "shard": r.shard, "index": r.index,
                     })
                 table[name] = {"shards": shards}
@@ -925,7 +927,8 @@ class ClusterFacade:
             unassigned = []
             for r in state.routing:
                 entry = {"state": r.state, "primary": r.primary,
-                         "node": r.node_id, "relocating_node": None,
+                         "node": r.node_id,
+                         "relocating_node": r.relocating_node,
                          "shard": r.shard, "index": r.index}
                 if r.node_id is None:
                     unassigned.append(entry)
@@ -1082,6 +1085,48 @@ class ClusterFacade:
             },
         }
         return out
+
+    def field_caps(self, index: str | None, fields: str,
+                   include_unmapped: bool = False,
+                   index_filter: dict | None = None) -> dict:
+        """Cluster field_caps over the replicated index metadata (the
+        shared merge in node.build_field_caps — mappings are in the
+        cluster state, so no per-node fan-out is needed; index_filter
+        falls back to a cluster count per index)."""
+        from opensearch_tpu.node import build_field_caps
+
+        names = self.resolve_indices(index if index is not None else "_all")
+        patterns = [p.strip() for p in str(fields or "").split(",")
+                    if p.strip()]
+        if not patterns:
+            raise IllegalArgumentException("[field_caps] requires [fields]")
+        if index_filter:
+            names = [
+                name for name in names
+                if self.count(name, {"query": index_filter}).get("count", 0)
+            ]
+        return build_field_caps(names, self._mapper_for, patterns,
+                                include_unmapped=include_unmapped)
+
+    def recovery_records(self, index: str | None = None) -> list[dict]:
+        """Cluster-wide recovery progress (RecoveryState collection behind
+        GET [/{index}]/_recovery and _cat/recovery): every node reports its
+        target-side records; peer recoveries, relocation transfers and
+        local store bootstraps all appear with live stage/bytes/ops."""
+        names = self.resolve_indices(index) if index else None
+        nodes = sorted(self.state.nodes)
+        results = self._rpc_many([
+            (nid, "indices:monitor/recovery[node]", {"indices": names})
+            for nid in nodes
+        ])
+        out: list[dict] = []
+        for r in results:
+            if isinstance(r, dict):
+                out.extend(r.get("recoveries") or [])
+        return sorted(
+            out, key=lambda p: (p["index"], p["shard"],
+                                str(p.get("target_node")))
+        )
 
     # unsupported-surface markers (clear 400s beat silent wrong answers)
 

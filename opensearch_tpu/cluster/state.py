@@ -73,22 +73,37 @@ class VotingConfiguration:
 
 @dataclass(frozen=True)
 class ShardRoutingEntry:
-    """One shard copy's assignment (ShardRouting)."""
+    """One shard copy's assignment (ShardRouting).
+
+    A relocation is modeled as the reference does: the serving copy moves
+    to state RELOCATING with `relocating_node` = the target node, and a
+    shadow target entry appears on the target node in state INITIALIZING
+    with `relocating_node` = the source node. The pair is ONE logical copy;
+    when the target reports started, the swap drops the source entry and
+    the target becomes a plain STARTED copy (ShardRouting.relocatingNodeId
+    + RoutingNodes.relocateShard semantics)."""
 
     index: str
     shard: int
     node_id: str | None            # None = unassigned
     primary: bool
     state: str = "UNASSIGNED"      # UNASSIGNED | INITIALIZING | STARTED | RELOCATING
+    relocating_node: str | None = None
+
+    @property
+    def is_relocation_target(self) -> bool:
+        return self.state == "INITIALIZING" and self.relocating_node is not None
 
     def to_dict(self) -> dict:
         return {"index": self.index, "shard": self.shard, "node_id": self.node_id,
-                "primary": self.primary, "state": self.state}
+                "primary": self.primary, "state": self.state,
+                "relocating_node": self.relocating_node}
 
     @staticmethod
     def from_dict(d: dict) -> "ShardRoutingEntry":
         return ShardRoutingEntry(d["index"], d["shard"], d.get("node_id"),
-                                 d["primary"], d.get("state", "UNASSIGNED"))
+                                 d["primary"], d.get("state", "UNASSIGNED"),
+                                 d.get("relocating_node"))
 
 
 @dataclass(frozen=True)
@@ -145,6 +160,9 @@ class ClusterState:
 
     def shards_for_index(self, index: str) -> list[ShardRoutingEntry]:
         return [r for r in self.routing if r.index == index]
+
+    # name used by the REST-facing views (RoutingTable.index(...) analog)
+    routing_for_index = shards_for_index
 
     def primary(self, index: str, shard: int) -> ShardRoutingEntry | None:
         for r in self.routing:

@@ -79,6 +79,11 @@ class IndexShard:
         self.engine = Engine(path, mapper_service, durability=durability)
         self.primary = True
         self.replication = replication
+        # peer-recovery bookkeeping (IndexShard.recoveryState analog, read
+        # by the cluster layer): `recovery_done` gates shard-started
+        # re-reports; `recovery_inflight` suppresses duplicate drivers
+        self.recovery_done = False
+        self.recovery_inflight = False
 
     # -- write ops ---------------------------------------------------------
 

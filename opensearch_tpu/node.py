@@ -161,6 +161,124 @@ def _deep_merge(base: dict, overlay: dict) -> dict:
     return out
 
 
+def _object_parents(ms) -> dict[str, str]:
+    """Object/nested paths implied by dotted leaf names: any proper prefix
+    of a mapper name that is not itself a mapper (multi-field parents ARE
+    mappers and are excluded). The reference's ObjectMapper tree, recovered
+    from the flattened registry."""
+    parents: dict[str, str] = {}
+    for fname in ms.mappers:
+        parts = fname.split(".")
+        for i in range(1, len(parts)):
+            prefix = ".".join(parts[:i])
+            if prefix in ms.mappers:
+                continue
+            parents[prefix] = (
+                "nested" if prefix in getattr(ms, "nested_paths", set())
+                else "object"
+            )
+    return parents
+
+
+def build_field_caps(names: list, mapper_for, patterns: list,
+                     include_unmapped: bool = False) -> dict:
+    """Merge per-index field capabilities into the FieldCapabilities wire
+    shape (FieldCapabilities.java): per (field, type) the `indices` list
+    appears when the field is not single-typed across all queried indices
+    (include_unmapped's pseudo-type "unmapped" counts), mixed
+    searchability/aggregatability surfaces as `non_searchable_indices` /
+    `non_aggregatable_indices`, and mapping `meta` merges into
+    key -> sorted list of distinct values. Shared by TpuNode and
+    ClusterFacade."""
+    import fnmatch
+
+    # field -> type -> {"indices": [...], "searchable": {idx: bool},
+    #                   "aggregatable": {idx: bool}, "meta": [dict, ...]}
+    by_field: dict[str, dict[str, dict]] = {}
+
+    def slot_for(fname: str, ftype: str) -> dict:
+        return by_field.setdefault(fname, {}).setdefault(
+            ftype, {"indices": [], "searchable": {}, "aggregatable": {},
+                    "meta": []},
+        )
+
+    for name in names:
+        ms = mapper_for(name)
+        for fname, mapper in ms.mappers.items():
+            if not any(fnmatch.fnmatch(fname, p) for p in patterns):
+                continue
+            if mapper.type == "alias":
+                # aliases report the TARGET's capabilities under the
+                # queried name (QueryShardContext alias resolution)
+                resolved = ms.field_mapper(fname)
+                if resolved is None or resolved.type == "alias":
+                    continue
+                mapper = resolved
+            ftype = mapper.original_type or mapper.type
+            slot = slot_for(fname, ftype)
+            slot["indices"].append(name)
+            slot["searchable"][name] = bool(mapper.index)
+            slot["aggregatable"][name] = bool(
+                mapper.doc_values and mapper.type != "text"
+            )
+            if mapper.meta:
+                slot["meta"].append(mapper.meta)
+        for pname, ptype in _object_parents(ms).items():
+            if not any(fnmatch.fnmatch(pname, p) for p in patterns):
+                continue
+            slot = slot_for(pname, ptype)
+            slot["indices"].append(name)
+            slot["searchable"][name] = False
+            slot["aggregatable"][name] = False
+
+    if include_unmapped:
+        for fname, types in by_field.items():
+            mapped: set = set()
+            for slot in types.values():
+                mapped.update(slot["indices"])
+            missing = [n for n in names if n not in mapped]
+            if missing:
+                un = slot_for(fname, "unmapped")
+                for n in missing:
+                    un["indices"].append(n)
+                    un["searchable"][n] = False
+                    un["aggregatable"][n] = False
+
+    caps: dict[str, dict[str, dict]] = {}
+    for fname, types in sorted(by_field.items()):
+        conflicted = len(types) > 1
+        caps[fname] = {}
+        for ftype, slot in types.items():
+            s_vals = list(slot["searchable"].values())
+            a_vals = list(slot["aggregatable"].values())
+            entry: dict[str, Any] = {
+                "type": ftype,
+                "searchable": bool(s_vals) and all(s_vals),
+                "aggregatable": bool(a_vals) and all(a_vals),
+            }
+            if conflicted:
+                # every type of a multi-typed field lists its members
+                entry["indices"] = sorted(slot["indices"])
+            if any(s_vals) and not all(s_vals):
+                entry["non_searchable_indices"] = sorted(
+                    n for n, v in slot["searchable"].items() if not v
+                )
+            if any(a_vals) and not all(a_vals):
+                entry["non_aggregatable_indices"] = sorted(
+                    n for n, v in slot["aggregatable"].items() if not v
+                )
+            merged_meta: dict[str, set] = {}
+            for m in slot["meta"]:
+                for k, v in m.items():
+                    merged_meta.setdefault(k, set()).add(str(v))
+            if merged_meta:
+                entry["meta"] = {
+                    k: sorted(vs) for k, vs in sorted(merged_meta.items())
+                }
+            caps[fname][ftype] = entry
+    return {"indices": names, "fields": caps}
+
+
 class IndexService:
     """Per-index container (index module + its shards)."""
 
@@ -2147,44 +2265,28 @@ class TpuNode:
         out["get"] = {"found": True, "_source": got.get("_source")}
         return out
 
-    def field_caps(self, index: str | None, fields: str) -> dict:
-        """TransportFieldCapabilitiesAction analog."""
-        import fnmatch
-
+    def field_caps(self, index: str | None, fields: str,
+                   include_unmapped: bool = False,
+                   index_filter: dict | None = None) -> dict:
+        """TransportFieldCapabilitiesAction analog. `index_filter` drops
+        indices where the filter query matches no documents; the merged
+        response carries the reference's per-type provenance keys
+        (`indices`, `non_searchable_indices`, `non_aggregatable_indices`)
+        and cross-index `meta` merging."""
         names = self.resolve_indices(index if index is not None else "_all")
         patterns = [p.strip() for p in fields.split(",") if p.strip()]
         if not patterns:
             raise IllegalArgumentException("[field_caps] requires [fields]")
-        # first pass: field -> type -> (mapper, member indices)
-        by_field: dict[str, dict[str, dict]] = {}
-        for name in names:
-            ms = self._get_index(name).mapper_service
-            for fname, mapper in ms.mappers.items():
-                if not any(fnmatch.fnmatch(fname, p) for p in patterns):
-                    continue
-                slot = by_field.setdefault(fname, {}).setdefault(
-                    mapper.type, {"mapper": mapper, "indices": []}
-                )
-                slot["indices"].append(name)
-        caps: dict[str, dict[str, dict]] = {}
-        for fname, types in by_field.items():
-            conflicted = len(types) > 1
-            caps[fname] = {}
-            for ftype, slot in types.items():
-                mapper = slot["mapper"]
-                entry = {
-                    "type": ftype,
-                    "searchable": mapper.index,
-                    "aggregatable": mapper.doc_values and ftype != "text",
-                }
-                if conflicted:
-                    # every conflicting type lists its member indices
-                    entry["indices"] = sorted(slot["indices"])
-                caps[fname][ftype] = entry
-        return {
-            "indices": names,
-            "fields": caps,
-        }
+        if index_filter:
+            names = [
+                name for name in names
+                if self.count(name, {"query": index_filter}).get("count", 0)
+            ]
+        return build_field_caps(
+            names,
+            lambda n: self._get_index(n).mapper_service,
+            patterns, include_unmapped=include_unmapped,
+        )
 
     def termvectors(self, index: str, doc_id: str, body: dict | None = None,
                     fields: str | None = None, realtime: bool = True,

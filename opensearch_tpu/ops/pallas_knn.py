@@ -40,6 +40,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 names it TPUCompilerParams; same kwargs
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 BLOCK = 1024
 _NEG_INF = float("-inf")
 
@@ -197,7 +201,7 @@ def pallas_knn_topk(
         # the K-round selection keeps several [B, BLOCK+K] temporaries live
         # (Mosaic unrolls short fori_loops); raise the scoped-VMEM cap well
         # past the default 16M — v5e has 128M physical VMEM per core
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
@@ -337,7 +341,7 @@ def pallas_knn_blocktopk(
         scratch_shapes=[
             pltpu.VMEM((b_tile, PB_BLOCK), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
@@ -450,7 +454,7 @@ def pallas_knn_sbmax_topk(
         out_specs=pl.BlockSpec((1, b_tile, subs_per_block),
                                lambda j, i: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((nb, B, subs_per_block), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,

@@ -28,8 +28,20 @@ from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5 ships it under experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+# the replication-check kwarg was renamed check_rep -> check_vma in jax 0.5
+import inspect as _inspect
+
+_SHARD_MAP_NO_CHECK = {
+    ("check_vma" if "check_vma" in _inspect.signature(shard_map).parameters
+     else "check_rep"): False
+}
 
 from opensearch_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from opensearch_tpu.ops import knn as knn_ops
@@ -209,7 +221,7 @@ def build_distributed_search(
         mesh=mesh,
         in_specs=(seg_specs, q_specs),
         out_specs=(P(), P()),
-        check_vma=False,
+        **_SHARD_MAP_NO_CHECK,
     )
     return jax.jit(mapped)
 
@@ -288,7 +300,7 @@ def build_knn_serving_step(
         in_specs=(P(DATA_AXIS, None, None), P(DATA_AXIS, None),
                   P(DATA_AXIS, None), P(None, None)),
         out_specs=(P(), P(), P()),
-        check_vma=False,
+        **_SHARD_MAP_NO_CHECK,
     )
     return jax.jit(mapped)
 

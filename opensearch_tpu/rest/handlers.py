@@ -861,14 +861,24 @@ def field_caps(node: TpuNode, params, query, body):
     fields = query.get("fields") or (body or {}).get("fields", "")
     if isinstance(fields, list):
         fields = ",".join(fields)
-    return 200, node.field_caps(params["index"], fields)
+    return 200, node.field_caps(
+        params["index"], fields,
+        include_unmapped=str(query.get("include_unmapped",
+                                       "false")) in ("true", ""),
+        index_filter=(body or {}).get("index_filter"),
+    )
 
 
 def field_caps_all(node: TpuNode, params, query, body):
     fields = query.get("fields") or (body or {}).get("fields", "")
     if isinstance(fields, list):
         fields = ",".join(fields)
-    return 200, node.field_caps(None, fields)
+    return 200, node.field_caps(
+        None, fields,
+        include_unmapped=str(query.get("include_unmapped",
+                                       "false")) in ("true", ""),
+        index_filter=(body or {}).get("index_filter"),
+    )
 
 
 def termvectors(node: TpuNode, params, query, body):
@@ -1830,11 +1840,82 @@ def indices_shard_stores(node: TpuNode, params, query, body):
     return 200, {"indices": out_indices}
 
 
+def _recovery_record_stats(p: dict) -> tuple[str, str, str]:
+    """(bytes_percent, ops_percent, api_type) for one RecoveryProgress
+    record — the shared shaping for /_recovery and _cat/recovery.
+    Relocation transfers are peer recoveries wearing a different routing
+    hat (the reference reports them as PEER too)."""
+    pct_bytes = (100.0 * p["bytes_recovered"] / p["bytes_total"]
+                 if p["bytes_total"] else 100.0)
+    pct_ops = (100.0 * p["ops_recovered"] / p["ops_total"]
+               if p["ops_total"] else 100.0)
+    api_type = {"RELOCATION": "PEER"}.get(p["type"], p["type"])
+    return f"{pct_bytes:.1f}%", f"{pct_ops:.1f}%", api_type
+
+
+def _cluster_recovery_shards(node, index_expr):
+    """Shape cluster-wide RecoveryProgress records (facade.recovery_records)
+    into the /_recovery per-shard entries."""
+    import time as _time
+
+    out: dict[str, list] = {}
+    for p in node.recovery_records(index_expr):
+        pct_bytes, pct_ops, api_type = _recovery_record_stats(p)
+        out.setdefault(p["index"], []).append({
+            "id": p["shard"],
+            "type": api_type,
+            "stage": p["stage"],
+            "primary": p["type"] in ("EMPTY_STORE", "EXISTING_STORE"),
+            "start_time": _time.strftime(
+                "%Y-%m-%dT%H:%M:%S.000Z",
+                _time.gmtime(p["start_ms"] / 1000)),
+            "start_time_in_millis": p["start_ms"],
+            "total_time_in_millis": p["total_time_ms"],
+            "source": ({"id": p["source_node"], "name": p["source_node"]}
+                       if p.get("source_node") else {}),
+            "target": {"id": p["target_node"], "name": p["target_node"]},
+            "index": {
+                "files": {"total": p["files_total"],
+                          "reused": 0,
+                          "recovered": p["files_recovered"],
+                          "percent": pct_bytes},
+                "size": {"total_in_bytes": p["bytes_total"],
+                         "reused_in_bytes": 0,
+                         "recovered_in_bytes": p["bytes_recovered"],
+                         "percent": pct_bytes},
+                "source_throttle_time_in_millis": 0,
+                "target_throttle_time_in_millis": 0,
+            },
+            "translog": {"recovered": p["ops_recovered"],
+                         "total": p["ops_total"],
+                         "total_on_start": p["ops_total"],
+                         "total_time_in_millis": 0,
+                         "percent": pct_ops},
+            "verify_index": {"check_index_time_in_millis": 0,
+                             "total_time_in_millis": 0},
+            "retries": p.get("retries", 0),
+        })
+    return out
+
+
 def indices_recovery(node: TpuNode, params, query, body):
     """GET [/{index}]/_recovery (RecoveryAction): per-shard recovery
     state; local shards report their store bootstrap as a DONE
-    EMPTY_STORE/EXISTING_STORE recovery."""
+    EMPTY_STORE/EXISTING_STORE recovery. In cluster mode the REAL
+    peer-recovery/relocation progress records are aggregated from every
+    node."""
     import time as _time
+
+    if hasattr(node, "recovery_records"):
+        active_only = str(query.get("active_only", "false")) in ("true", "")
+        shards_by_index = _cluster_recovery_shards(node, params.get("index"))
+        return 200, {
+            name: {"shards": [
+                s for s in shards
+                if not active_only or s["stage"] not in ("DONE", "FAILED")
+            ]}
+            for name, shards in sorted(shards_by_index.items())
+        }
 
     names = _admin_indices(node, params, query, expand_default="all")
     out = {}
@@ -2622,6 +2703,41 @@ def cat_recovery(node: TpuNode, params, query, body):
     want = params.get("index")
     pats = [p for p in str(want).split(",") if p] if want else None
     rows = []
+    if hasattr(node, "recovery_records"):
+        # cluster mode: real recovery/relocation progress from every node
+        for p in node.recovery_records(want):
+            pct_b, pct_o, api_type = _recovery_record_stats(p)
+            rows.append({
+                "index": p["index"], "shard": p["shard"],
+                "time": f"{p['total_time_ms']}ms",
+                "type": api_type.lower(),
+                "stage": p["stage"].lower(),
+                "source_host": p.get("source_node") or "-",
+                "source_node": p.get("source_node") or "-",
+                "target_host": p["target_node"],
+                "target_node": p["target_node"],
+                "repository": "n/a", "snapshot": "n/a",
+                "files": p["files_total"],
+                "files_recovered": p["files_recovered"],
+                "files_percent": pct_b,
+                "files_total": p["files_total"],
+                "bytes": _human_bytes(p["bytes_total"]),
+                "bytes_recovered": _human_bytes(p["bytes_recovered"]),
+                "bytes_percent": pct_b,
+                "bytes_total": _human_bytes(p["bytes_total"]),
+                "translog_ops": p["ops_total"],
+                "translog_ops_recovered": p["ops_recovered"],
+                "translog_ops_percent": pct_o,
+            })
+        return 200, _cat_format(query, rows, aliases={
+            "i": "index", "s": "shard", "t": "time", "ty": "type",
+            "st": "stage", "shost": "source_host", "thost": "target_host",
+            "rep": "repository", "snap": "snapshot", "f": "files",
+            "fr": "files_recovered", "fp": "files_percent",
+            "tf": "files_total", "b": "bytes", "br": "bytes_recovered",
+            "bp": "bytes_percent", "tb": "bytes_total",
+            "to": "translog_ops", "tor": "translog_ops_recovered",
+            "top": "translog_ops_percent"})
     for index, svc in sorted(node.indices.items()):
         if pats is not None and not any(_fn.fnmatch(index, p) for p in pats):
             continue

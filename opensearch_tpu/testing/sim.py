@@ -106,6 +106,9 @@ class MockTransport:
         self.handlers: dict[tuple[str, str], Callable] = {}
         self.blackholed: set[tuple[str, str]] = set()
         self.down: set[str] = set()
+        # per-directed-link extra delivery delay in ms (slow/flaky links);
+        # applies on top of the random base delay, in the direction stored
+        self.latency: dict[tuple[str, str], int] = {}
         self.stats = {"sent": 0, "dropped": 0, "delivered": 0}
 
     # -- disruption schemes (test/framework/.../disruption analog) ---------
@@ -116,8 +119,31 @@ class MockTransport:
                 self.blackholed.add((a, b))
                 self.blackholed.add((b, a))
 
+    def drop_one_way(self, src: str, dst: str) -> None:
+        """Asymmetric blackhole: frames src -> dst vanish while dst -> src
+        still delivers (the one-sided NetworkDisruption variant — models a
+        half-open link where requests arrive but responses are lost, or
+        vice versa)."""
+        self.blackholed.add((src, dst))
+
+    def restore_one_way(self, src: str, dst: str) -> None:
+        self.blackholed.discard((src, dst))
+
+    def set_latency(self, src: str, dst: str, extra_ms: int,
+                    symmetric: bool = True) -> None:
+        """Add `extra_ms` of delivery delay on src -> dst (and, by default,
+        dst -> src) — the NetworkDisruption delay scheme. extra_ms <= 0
+        clears the injection."""
+        for pair in ([(src, dst), (dst, src)] if symmetric else [(src, dst)]):
+            if extra_ms > 0:
+                self.latency[pair] = int(extra_ms)
+            else:
+                self.latency.pop(pair, None)
+
     def heal(self) -> None:
+        """Clear partitions AND latency injections (back to a clean net)."""
         self.blackholed.clear()
+        self.latency.clear()
 
     def isolate(self, node_id: str, others: set[str]) -> None:
         self.partition({node_id}, others - {node_id})
@@ -135,6 +161,9 @@ class MockTransport:
             and b not in self.down
         )
 
+    def _link_delay(self, a: str, b: str, base: int) -> int:
+        return base + self.latency.get((a, b), 0)
+
     # -- messaging ---------------------------------------------------------
 
     def register(self, node_id: str, action: str, handler: Callable) -> None:
@@ -151,7 +180,10 @@ class MockTransport:
         timeout_ms: int | None = None,  # accepted for interface parity
     ) -> None:
         self.stats["sent"] += 1
-        delay = self.queue.random.randint(self.min_delay_ms, self.max_delay_ms)
+        delay = self._link_delay(
+            sender, target,
+            self.queue.random.randint(self.min_delay_ms, self.max_delay_ms),
+        )
 
         if not self._link_ok(sender, target):
             self.stats["dropped"] += 1
@@ -194,15 +226,21 @@ class MockTransport:
                 # sequence and perturb every replayable scenario
                 if error is not None:
                     if on_failure is not None:
-                        back = self.queue.random.randint(
-                            self.min_delay_ms, self.max_delay_ms
+                        back = self._link_delay(
+                            target, sender,
+                            self.queue.random.randint(
+                                self.min_delay_ms, self.max_delay_ms
+                            ),
                         )
                         self.queue.schedule(back, lambda: on_failure(error))
                     return
                 if on_response is None:
                     return
-                back = self.queue.random.randint(
-                    self.min_delay_ms, self.max_delay_ms
+                back = self._link_delay(
+                    target, sender,
+                    self.queue.random.randint(
+                        self.min_delay_ms, self.max_delay_ms
+                    ),
                 )
 
                 def respond() -> None:

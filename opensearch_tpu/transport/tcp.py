@@ -194,7 +194,8 @@ class TcpTransport:
         self._inbound: set[_Connection] = set()
         self._pending: dict[int, tuple[Callable | None, Callable | None, Any]] = {}
         self._req_id = 0
-        self.stats = {"sent": 0, "dropped": 0, "delivered": 0, "rx": 0}
+        self.stats = {"sent": 0, "dropped": 0, "delivered": 0, "rx": 0,
+                      "late_dropped": 0}
         self._closed = False
 
     # -- lifecycle ---------------------------------------------------------
@@ -351,7 +352,10 @@ class TcpTransport:
         rid = frame.get("id")
         entry = self._pending.pop(rid, None)
         if entry is None:
-            return  # timed out earlier; late response is dropped
+            # timed out earlier; the id is tombstoned (popped) so the late
+            # response is dropped instead of firing a recycled callback
+            self.stats["late_dropped"] += 1
+            return
         on_response, on_failure, timer = entry
         timer.cancel()
         if frame.get("t") == "err":

@@ -9,13 +9,25 @@ import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force CPU even if axon/tpu is present
 
+# XLA_FLAGS is read at backend instantiation (not jax import), so setting it
+# here still works when sitecustomize imported jax long ago — and it is the
+# only mechanism on jax < 0.5 where jax_num_cpu_devices doesn't exist.
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
 # jax may already be imported by the environment's sitecustomize (TPU plugin
 # registration), in which case the env var was read long ago — override the
 # live config before any backend initializes.
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # jax < 0.5: the XLA_FLAGS fallback above provides the 8 devices
 
 import numpy as np
 import pytest

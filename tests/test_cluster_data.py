@@ -65,6 +65,90 @@ def test_reroute_promotes_replica_on_node_loss():
     assert new_primary.state == "STARTED"  # promoted in place, no re-init
 
 
+def test_relocation_pair_survives_reroute_and_counts_once():
+    """A RELOCATING source + its shadow target are ONE replica copy: reroute
+    keeps both and must not allocate a third copy."""
+    from opensearch_tpu.cluster.allocation import mark_shard_started
+    from opensearch_tpu.cluster.state import ShardRoutingEntry
+
+    state = _cluster_state(3, {"idx": IndexMeta("idx", 1, 1)})
+    routing = (
+        ShardRoutingEntry("idx", 0, "n0", True, "STARTED"),
+        ShardRoutingEntry("idx", 0, "n1", False, "RELOCATING",
+                          relocating_node="n2"),
+        ShardRoutingEntry("idx", 0, "n2", False, "INITIALIZING",
+                          relocating_node="n1"),
+    )
+    state = state.with_(routing=routing)
+    out = reroute(state)
+    assert len(out.routing) == 3, out.routing
+    assert {(r.node_id, r.state) for r in out.routing} == {
+        ("n0", "STARTED"), ("n1", "RELOCATING"), ("n2", "INITIALIZING")}
+
+    # the target reporting started performs the ATOMIC swap: source entry
+    # gone, target STARTED, relocating_node cleared — in one state
+    swapped = mark_shard_started(state, "idx", 0, "n2")
+    assert len(swapped.routing) == 2
+    replica = next(r for r in swapped.routing if not r.primary)
+    assert replica.node_id == "n2" and replica.state == "STARTED"
+    assert replica.relocating_node is None
+    assert not any(r.node_id == "n1" for r in swapped.routing)
+
+
+def test_relocation_repairs_when_either_side_dies():
+    from opensearch_tpu.cluster.state import ShardRoutingEntry
+
+    base = _cluster_state(3, {"idx": IndexMeta("idx", 1, 1)})
+    routing = (
+        ShardRoutingEntry("idx", 0, "n0", True, "STARTED"),
+        ShardRoutingEntry("idx", 0, "n1", False, "RELOCATING",
+                          relocating_node="n2"),
+        ShardRoutingEntry("idx", 0, "n2", False, "INITIALIZING",
+                          relocating_node="n1"),
+    )
+    state = base.with_(routing=routing)
+
+    # target node dies: the source reverts to a plain STARTED copy
+    nodes = {k: v for k, v in state.nodes.items() if k != "n2"}
+    out = reroute(state.with_(nodes=nodes))
+    replica = next(r for r in out.routing if not r.primary
+                   and r.node_id is not None)
+    assert replica.node_id == "n1" and replica.state == "STARTED"
+    assert replica.relocating_node is None
+
+    # source node dies: the target keeps recovering as a plain replica
+    nodes = {k: v for k, v in state.nodes.items() if k != "n1"}
+    out = reroute(state.with_(nodes=nodes))
+    replica = next(r for r in out.routing if not r.primary
+                   and r.node_id is not None)
+    assert replica.node_id == "n2" and replica.state == "INITIALIZING"
+    assert replica.relocating_node is None
+
+
+def test_rebalance_emits_relocation_pair():
+    """An imbalanced layout produces a RELOCATING source + shadow target
+    pair (not an instant move that would drop the serving copy)."""
+    from opensearch_tpu.cluster.allocation import mark_shard_started
+
+    state = _cluster_state(2, {"idx": IndexMeta("idx", 2, 1)})
+    state = reroute(state)
+    for r in state.routing:
+        state = mark_shard_started(state, r.index, r.shard, r.node_id)
+    # a third empty node joins: spread is 2 vs 0 -> one relocation
+    nodes = dict(state.nodes)
+    nodes["n2"] = DiscoveryNode("n2", "n2")
+    out = reroute(state.with_(nodes=nodes))
+    sources = [r for r in out.routing if r.state == "RELOCATING"]
+    targets = [r for r in out.routing if r.is_relocation_target]
+    assert len(sources) == 1 and len(targets) == 1
+    assert sources[0].relocating_node == targets[0].node_id == "n2"
+    assert targets[0].relocating_node == sources[0].node_id
+    assert not sources[0].primary  # only replicas relocate
+    # at most one relocation in flight: a second reroute plans nothing new
+    again = reroute(out)
+    assert sum(1 for r in again.routing if r.state == "RELOCATING") == 1
+
+
 def test_filter_allocation_decider():
     meta = IndexMeta("idx", 1, 0,
                      settings={"routing.allocation.require._name": "n1"})

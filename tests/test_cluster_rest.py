@@ -262,6 +262,38 @@ def test_stats_and_cat_through_cluster(cluster_ports):
     assert status == 200 and resp["number_of_nodes"] == 3
 
 
+def test_recovery_apis_through_cluster(cluster_ports):
+    """GET /{index}/_recovery and /_cat/recovery render the REAL recovery
+    records aggregated from every node: the 3-shard/1-replica fixture index
+    ran 3 store bootstraps (primaries) + 3 peer recoveries (replicas)."""
+    loop, ports = cluster_ports
+    status, resp = _req(loop, ports["n0"], "GET", "/items/_recovery")
+    assert status == 200, resp
+    shards = resp["items"]["shards"]
+    assert len(shards) >= 6, shards
+    types = {s["type"] for s in shards}
+    assert "PEER" in types, types
+    assert types & {"EMPTY_STORE", "EXISTING_STORE"}, types
+    assert all(s["stage"] == "DONE" for s in shards), shards
+    peer = next(s for s in shards if s["type"] == "PEER")
+    assert peer["source"]["id"] and peer["target"]["id"]
+    assert peer["translog"]["recovered"] == peer["translog"]["total"]
+
+    status, rows = _req(loop, ports["n1"], "GET",
+                        "/_cat/recovery?format=json")
+    assert status == 200, rows
+    assert any(r["type"] == "peer" and r["stage"] == "done" for r in rows), \
+        rows
+    assert all(r["bytes_percent"] == "100.0%" or r["stage"] != "done"
+               for r in rows), rows
+
+    # active_only filters the finished ones away
+    status, resp = _req(loop, ports["n2"], "GET",
+                        "/items/_recovery?active_only=true")
+    assert status == 200
+    assert all(not e["shards"] for e in resp.values()), resp
+
+
 def test_errors_through_cluster(cluster_ports):
     loop, ports = cluster_ports
     status, resp = _req(loop, ports["n0"], "POST", "/missing/_search",

@@ -85,14 +85,16 @@ def test_rebalance_converges_to_even_spread():
         routing.append(ShardRoutingEntry("i", s, "b", False, "STARTED"))
     state = _state(nodes, [IndexMeta("i", 3, 1)], routing)
     settings = AllocationSettings()
-    # each round moves one replica; iterate as successive publications do
-    for _ in range(4):
+    # each round RELOCATES one replica; completing a relocation means the
+    # target reports shard-started (mark_shard_started performs the atomic
+    # routing swap) — iterate as successive publications do
+    from opensearch_tpu.cluster.allocation import mark_shard_started
+
+    for _ in range(6):
         state = reroute(state, settings)
-        state = state.with_(routing=tuple(
-            ShardRoutingEntry(r.index, r.shard, r.node_id, r.primary, "STARTED")
-            if r.state == "INITIALIZING" else r
-            for r in state.routing
-        ))
+        for r in [r for r in state.routing if r.state == "INITIALIZING"]:
+            state = mark_shard_started(state, r.index, r.shard, r.node_id)
+    assert not any(r.state == "RELOCATING" for r in state.routing)
     loads = {n.node_id: 0 for n in nodes}
     for r in state.routing:
         loads[r.node_id] += 1

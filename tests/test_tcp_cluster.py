@@ -375,7 +375,10 @@ def test_handshake_rejects_wrong_cluster(tmp_path):
 
 def test_request_timeout_and_late_response_dropped(tmp_path):
     """Correlation-id timeouts: a slow handler's late response must not fire
-    a recycled callback (TransportService timeout semantics)."""
+    a recycled callback (TransportService timeout semantics). The callback
+    fires EXACTLY once (the failure), and the late frame is counted as
+    tombstone-dropped — while an unrelated in-flight request on the same
+    pipelined connection still resolves normally."""
 
     async def scenario():
         from opensearch_tpu.transport.base import DeferredResponse
@@ -397,16 +400,74 @@ def test_request_timeout_and_late_response_dropped(tmp_path):
             return d
 
         b.register("b", "slow", slow_handler)
+        b.register("b", "fast", lambda s, p: {"ok": True})
         events: list[str] = []
         a.send("a", "b", "slow", {},
                on_response=lambda r: events.append("response"),
                on_failure=lambda e: events.append(type(e).__name__))
+        # a healthy request sharing the connection is unaffected
+        fast_events: list = []
+        a.send("a", "b", "fast", {}, on_response=fast_events.append,
+               on_failure=lambda e: fast_events.append(("fail", e)))
         await asyncio.sleep(0.6)      # past the 300ms timeout
         assert events == ["TimeoutError"]
+        assert fast_events == [{"ok": True}]
         slow[0].set_result({"late": True})   # now answer — must be dropped
         await asyncio.sleep(0.2)
-        assert events == ["TimeoutError"]
+        assert events == ["TimeoutError"]    # exactly once, never twice
+        assert a.stats["late_dropped"] == 1
         await a.aclose()
         await b.aclose()
+
+    asyncio.run(scenario())
+
+
+def test_lazy_connection_reopens_after_peer_restart(tmp_path):
+    """The per-target outbound connection is lazy: when the peer process
+    dies, in-flight requests fail, and a RESTARTED peer on the same address
+    is reachable again through a fresh dial — no manual reconnect step
+    (ClusterConnectionManager re-dial semantics)."""
+
+    async def scenario():
+        from opensearch_tpu.transport.tcp import TcpTransport
+
+        [pa, pb] = free_ports(2)
+        loop = asyncio.get_running_loop()
+        a = TcpTransport("a", "127.0.0.1", pa, {"b": ("127.0.0.1", pb)},
+                         loop=loop, timeout_ms=2000)
+        b1 = TcpTransport("b", "127.0.0.1", pb, {"a": ("127.0.0.1", pa)},
+                          loop=loop)
+        await a.start()
+        await b1.start()
+        b1.register("b", "ping", lambda s, p: {"gen": 1})
+
+        async def rpc():
+            fut = loop.create_future()
+            a.send("a", "b", "ping", {},
+                   on_response=lambda r: fut.done() or fut.set_result(r),
+                   on_failure=lambda e: fut.done() or fut.set_result(e))
+            return await asyncio.wait_for(fut, 5.0)
+
+        assert (await rpc()) == {"gen": 1}
+
+        # peer dies: the next request fails (connection error or timeout)
+        await b1.aclose()
+        failed = await rpc()
+        assert isinstance(failed, Exception), failed
+
+        # peer restarts on the SAME address: the lazy dial reconnects
+        b2 = TcpTransport("b", "127.0.0.1", pb, {"a": ("127.0.0.1", pa)},
+                          loop=loop)
+        await b2.start()
+        b2.register("b", "ping", lambda s, p: {"gen": 2})
+        got = None
+        for _ in range(20):
+            got = await rpc()
+            if got == {"gen": 2}:
+                break
+            await asyncio.sleep(0.1)
+        assert got == {"gen": 2}, got
+        await a.aclose()
+        await b2.aclose()
 
     asyncio.run(scenario())
