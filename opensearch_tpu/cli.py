@@ -15,9 +15,12 @@ Config keys (the reference's names where they exist):
 from __future__ import annotations
 
 import argparse
+import logging
 import os
 import sys
 from pathlib import Path
+
+logger = logging.getLogger(__name__)
 
 
 def _apply_platform_override() -> None:
@@ -33,8 +36,9 @@ def _apply_platform_override() -> None:
         import jax
 
         jax.config.update("jax_platforms", plat)
-    except Exception:
-        pass  # jax absent or config locked: env var alone has to do
+    except Exception as e:  # noqa: BLE001
+        # jax absent or config locked: env var alone has to do
+        logger.debug("jax platform override skipped: %s", e)
 
 
 def load_config(path: str | None) -> dict:

@@ -55,9 +55,9 @@ def _wall_ms() -> int:
     """Epoch wall-clock ms for retention-lease timestamps — deliberately
     NOT ClusterNode._now_ms (monotonic): lease timestamps persist in the
     commit point and must stay comparable across restarts."""
-    import time as _t
+    from opensearch_tpu.common.timeutil import epoch_millis
 
-    return int(_t.time() * 1000)
+    return epoch_millis()
 from opensearch_tpu.search.executor import execute_query_phase
 from opensearch_tpu.search.service import _source_filter
 
@@ -1009,9 +1009,9 @@ class ClusterNode:
         ONE shard-bulk RPC per (shard, primary) — TransportShardBulkAction's
         batching (one replication round per shard, not per document). Item
         order is preserved in the response regardless of completion order."""
-        import time as _time
+        from opensearch_tpu.common.timeutil import monotonic_millis
 
-        t0 = _time.monotonic()
+        t0 = monotonic_millis()
         n = len(operations)
         if n == 0:
             callback({"took": 0, "errors": False, "items": []})
@@ -1054,13 +1054,13 @@ class ClusterNode:
             pending["n"] -= 1
             if pending["n"] == 0:
                 callback({
-                    "took": int((_time.monotonic() - t0) * 1000),
+                    "took": monotonic_millis() - t0,
                     "errors": state["errors"],
                     "items": items,
                 })
 
         if not groups:
-            callback({"took": int((_time.monotonic() - t0) * 1000),
+            callback({"took": monotonic_millis() - t0,
                       "errors": state["errors"], "items": items})
             return
 
@@ -1819,9 +1819,10 @@ class ClusterNode:
 
     @staticmethod
     def _now_ms() -> int:
-        import time as _t
+        # injectable clock: the deterministic sim controls context expiry
+        from opensearch_tpu.common.timeutil import monotonic_millis
 
-        return int(_t.monotonic() * 1000)
+        return monotonic_millis()
 
     def _reap_reader_contexts(self) -> None:
         now = self._now_ms()

@@ -20,6 +20,7 @@ Actions: coordination/pre_vote, /start_join, /join, /publish, /commit,
 from __future__ import annotations
 
 import enum
+import logging
 from typing import Any, Callable
 
 from opensearch_tpu.cluster.coordination import (
@@ -39,6 +40,9 @@ from opensearch_tpu.cluster.state import (
     apply_diff,
     diff_states,
 )
+
+
+logger = logging.getLogger(__name__)
 
 
 class Mode(enum.Enum):
@@ -366,13 +370,16 @@ class Coordinator:
         for task in tasks:
             try:
                 state = task(state)
-            except Exception:  # noqa: BLE001 - a bad task must not kill the loop
+            except Exception as e:  # noqa: BLE001 - a bad task must not kill the loop
+                logger.warning("cluster-state task failed on %s: %s",
+                               self.node_id, e)
                 continue
         if self.state_transform is not None:
             try:
                 state = self.state_transform(state)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                logger.warning("cluster-state transform failed on %s: %s",
+                               self.node_id, e)
         new_state = state.with_(
             term=self.coord.current_term,
             version=max(state.version, self.applied_state.version,
@@ -634,8 +641,8 @@ class Coordinator:
         if self.check_extras is not None:
             try:
                 out["extras"] = self.check_extras()
-            except Exception:  # noqa: BLE001 - stats must not fail checks
-                pass
+            except Exception as e:  # noqa: BLE001 - stats must not fail checks
+                logger.debug("follower-check extras failed: %s", e)
         return out
 
     def _schedule_leader_check(self) -> None:

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import base64
 import json
+import logging
 import threading
 import uuid
 from concurrent.futures import Future
@@ -48,6 +49,8 @@ from opensearch_tpu.common.settings import (
     settings_section as _settings_section,
 )
 from opensearch_tpu.index.mapper import MapperService
+
+logger = logging.getLogger(__name__)
 
 RPC_TIMEOUT_S = 30.0
 
@@ -310,15 +313,19 @@ class ClusterFacade:
         return leader
 
     def _wait_active_primaries(self, index: str, timeout_s: float = 10.0) -> None:
+        # real-thread poll against live TCP nodes; the facade never runs
+        # under the virtual-time sim, and the deadline must track REAL
+        # time here — reading the injectable clock would freeze this loop
+        # if another component installs a VirtualClock process-wide
         import time as _t
 
-        deadline = _t.monotonic() + timeout_s
-        while _t.monotonic() < deadline:
+        deadline = _t.monotonic() + timeout_s  # tpulint: disable=TPU004
+        while _t.monotonic() < deadline:  # tpulint: disable=TPU004
             entries = [r for r in self.state.routing
                        if r.index == index and r.primary]
             if entries and all(r.state == "STARTED" for r in entries):
                 return
-            _t.sleep(0.05)
+            _t.sleep(0.05)  # tpulint: disable=TPU004
 
     # ------------------------------------------------------------------ #
     # documents
@@ -617,7 +624,8 @@ class ClusterFacade:
         for sid in scroll_ids or []:
             try:
                 state = _decode_scroll_id(sid)
-            except Exception:  # noqa: BLE001 - malformed id: skip
+            except Exception as e:  # noqa: BLE001 - malformed id: skip
+                logger.debug("clear_scroll: malformed scroll id: %s", e)
                 continue
             by_node: dict[str, list[str]] = {}
             for key, ctx_id in state["ctx"].items():
@@ -650,12 +658,12 @@ class ClusterFacade:
         total = sum((p.get("_shards") or {}).get("total", 0)
                     for p in partials)
         pit_id = "cpit_" + _encode_scroll_id({"ctx": contexts})
-        import time as _t
+        from opensearch_tpu.common.timeutil import epoch_millis
 
         return {"pit_id": pit_id,
                 "_shards": {"total": total, "successful": total,
                             "skipped": 0, "failed": 0},
-                "creation_time": int(_t.time() * 1000)}
+                "creation_time": epoch_millis()}
 
     def close_pit(self, pit_ids: list[str] | None) -> dict:
         pits = []
@@ -670,7 +678,8 @@ class ClusterFacade:
                     (nid, "indices:data/read/ctx_close", {"ctx_ids": ids})
                     for nid, ids in by_node.items()
                 ])
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
+                logger.debug("delete_pit: context close failed: %s", e)
                 ok = False
             pits.append({"pit_id": pid, "successful": ok})
         return {"pits": pits}

@@ -62,13 +62,15 @@ class CircuitBreaker:
             self.used = max(0, self.used - int(bytes_))
 
     def stats(self) -> dict:
+        with self._lock:
+            used, tripped = self.used, self.trip_count
         return {
             "limit_size_in_bytes": self.limit,
             "limit_size": _human(self.limit),
-            "estimated_size_in_bytes": self.used,
-            "estimated_size": _human(self.used),
+            "estimated_size_in_bytes": used,
+            "estimated_size": _human(used),
             "overhead": self.overhead,
-            "tripped": self.trip_count,
+            "tripped": tripped,
         }
 
 

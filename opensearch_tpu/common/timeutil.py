@@ -1,12 +1,71 @@
 """Time-value parsing (the reference's TimeValue.parseTimeValue analog,
-libs/core/src/main/java/org/opensearch/core/common/unit/TimeValue.java)."""
+libs/core/src/main/java/org/opensearch/core/common/unit/TimeValue.java)
+plus the injectable clock every sim-run module must read time through.
+
+Production code calls :func:`epoch_millis` / :func:`monotonic_millis`
+instead of ``time.time()`` / ``time.monotonic()`` directly; the
+deterministic simulation (testing/sim.py) installs a virtual-time clock
+via :func:`set_clock` / :func:`clock_scope` so replayable scenarios
+control every timestamp. tpulint rule TPU004 enforces this in cluster/,
+transport/, and index/recovery.py.
+"""
 
 from __future__ import annotations
 
+import contextlib
 import re
-from typing import Any
+import time as _time
+from typing import Any, Iterator
 
 from opensearch_tpu.common.errors import IllegalArgumentException
+
+
+class Clock:
+    """Time source. The default reads the host clocks; the sim swaps in a
+    virtual-time implementation (DeterministicTaskQueue.clock())."""
+
+    def epoch_millis(self) -> int:
+        """Wall-clock epoch milliseconds (timestamps in API responses)."""
+        return int(_time.time() * 1000)
+
+    def monotonic_millis(self) -> int:
+        """Monotonic milliseconds (durations, timeouts, "took" timers)."""
+        return int(_time.monotonic() * 1000)
+
+
+_SYSTEM_CLOCK = Clock()
+_clock: Clock = _SYSTEM_CLOCK
+
+
+def get_clock() -> Clock:
+    return _clock
+
+
+def set_clock(clock: Clock | None) -> Clock:
+    """Install `clock` (None restores the system clock); returns the
+    previously active clock so callers can restore it."""
+    global _clock
+    previous = _clock
+    _clock = clock if clock is not None else _SYSTEM_CLOCK
+    return previous
+
+
+@contextlib.contextmanager
+def clock_scope(clock: Clock) -> Iterator[Clock]:
+    """``with clock_scope(queue.clock()):`` — virtual time for a block."""
+    previous = set_clock(clock)
+    try:
+        yield clock
+    finally:
+        set_clock(previous)
+
+
+def epoch_millis() -> int:
+    return _clock.epoch_millis()
+
+
+def monotonic_millis() -> int:
+    return _clock.monotonic_millis()
 
 _UNITS_MS = {
     "nanos": 1e-6, "micros": 1e-3, "ms": 1, "s": 1000, "m": 60_000,
@@ -36,9 +95,7 @@ def parse_time_value_millis(
 
 
 def now_millis() -> int:
-    import time
-
-    return int(time.monotonic() * 1000)
+    return _clock.monotonic_millis()
 
 
 # --------------------------------------------------------------------------
@@ -97,13 +154,12 @@ def parse_date_math(expr: Any, now_ms: int | None = None, round_up: bool = False
     when `round_up` — the reference uses round_up for range upper bounds).
     """
     import datetime as _dt
-    import time
 
     if isinstance(expr, (int, float)) and not isinstance(expr, bool):
         return int(expr)
     s = str(expr).strip()
     if s.startswith("now"):
-        base_ms = int(time.time() * 1000) if now_ms is None else now_ms
+        base_ms = epoch_millis() if now_ms is None else now_ms
         math = s[3:]
     elif "||" in s:
         anchor, _, math = s.partition("||")

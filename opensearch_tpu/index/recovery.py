@@ -31,9 +31,13 @@ GET [/{index}]/_recovery and GET /_cat/recovery.
 
 from __future__ import annotations
 
-import time
+import logging
 from dataclasses import dataclass, field
 from typing import Any, Callable
+
+from opensearch_tpu.common.timeutil import epoch_millis
+
+logger = logging.getLogger(__name__)
 
 # chunk/batch bounds (RecoverySettings.INDICES_RECOVERY_CHUNK_SIZE analog;
 # far under the transport's MAX_FRAME so a chunk can never poison a stream)
@@ -53,7 +57,9 @@ def backoff_delay_ms(attempt: int, base_ms: int = BACKOFF_BASE_MS,
 
 
 def _now_ms() -> int:
-    return int(time.time() * 1000)
+    # routed through the injectable clock so the deterministic sim
+    # controls recovery timestamps (tpulint TPU004)
+    return epoch_millis()
 
 
 @dataclass
@@ -343,7 +349,10 @@ class RecoveryTargetDriver:
 
                 try:
                     apply_batch(batch, applied)
-                except Exception:  # noqa: BLE001 - a bad batch fails recovery
+                except Exception as e:  # noqa: BLE001 - a bad batch fails recovery
+                    logger.warning(
+                        "recovery [%s][%s]: applying ops batch failed: %s",
+                        self.index, self.shard, e)
                     on_done(False)
 
             self._request_with_retry(

@@ -1,0 +1,32 @@
+"""tpulint: AST-based invariant checker for this codebase.
+
+Five project-specific rules guard the invariants that ordinary linters
+cannot see:
+
+- TPU001 jit-purity        — no host syncs / nonlocal mutation /
+                             data-dependent control flow inside traced
+                             (jit / pallas_call / shard_map) functions
+- TPU002 blocking-in-async — no time.sleep / blocking IO / untimed
+                             Lock.acquire inside ``async def`` bodies
+- TPU003 lock-discipline   — attributes written under ``with self._lock``
+                             must not be touched lock-free elsewhere in
+                             the class; lock pairs acquire in one order
+- TPU004 determinism       — modules that run under testing/sim.py must
+                             use the injected clock / seeded RNG, never
+                             time.time() / random.* / datetime.now()
+- TPU005 exception-hygiene — ``except Exception`` bodies must log,
+                             re-raise, or record the error
+
+Run with ``python -m opensearch_tpu.lint [paths]``; violations already
+present in ``lint_baseline.json`` are tolerated (ratchet), new ones fail.
+Suppress a line with ``# tpulint: disable=TPU00N``.
+"""
+
+from opensearch_tpu.lint.core import (  # noqa: F401
+    Checker,
+    FileContext,
+    Violation,
+    lint_paths,
+    lint_source,
+)
+from opensearch_tpu.lint.rules import ALL_CHECKERS, RULES  # noqa: F401

@@ -1,0 +1,7 @@
+"""Entry point for ``python -m opensearch_tpu.lint``."""
+
+import sys
+
+from opensearch_tpu.lint.cli import main
+
+sys.exit(main())

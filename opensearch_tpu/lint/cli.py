@@ -1,0 +1,176 @@
+"""tpulint CLI: ``python -m opensearch_tpu.lint [paths] [--format text|json]``.
+
+Exit codes: 0 clean (all violations covered by the baseline), 1 when new
+violations regress past the baseline (or any file fails to parse), 2 on
+usage errors. Single process, single pass, no imports of checked modules —
+the full tree lints in well under 10s.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from opensearch_tpu.lint import baseline as baseline_mod
+from opensearch_tpu.lint.core import lint_paths
+from opensearch_tpu.lint.rules import ALL_CHECKERS, RULES
+
+# repo root when running from a checkout (cli.py -> lint -> opensearch_tpu -> root)
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _default_baseline() -> str | None:
+    for candidate in (
+        os.path.join(os.getcwd(), baseline_mod.DEFAULT_BASELINE_NAME),
+        os.path.join(_PKG_ROOT, baseline_mod.DEFAULT_BASELINE_NAME),
+    ):
+        if os.path.isfile(candidate):
+            return candidate
+    return None
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m opensearch_tpu.lint",
+        description="AST-based invariant checker (rules TPU001-TPU005)",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=None,
+        help="files or directories to lint (default: the opensearch_tpu package)")
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (default: text)")
+    parser.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="baseline file (default: lint_baseline.json in cwd or repo root)")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline: every violation fails")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="write the current violations as the new baseline and exit 0")
+    parser.add_argument(
+        "--rules", default=None, metavar="IDS",
+        help="comma-separated rule ids to run (default: all)")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, checker in sorted(RULES.items()):
+            print(f"{rule_id} {checker.name}: {checker.description}")
+        return 0
+
+    checkers = ALL_CHECKERS
+    if args.rules:
+        if args.write_baseline:
+            # a partial-rule run must never become the whole baseline —
+            # it would erase every other rule's tolerated entries
+            print("--write-baseline cannot be combined with --rules",
+                  file=sys.stderr)
+            return 2
+        wanted = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        checkers = [RULES[r] for r in sorted(wanted)]
+
+    paths = args.paths or [os.path.join(_PKG_ROOT, "opensearch_tpu")]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        # a typo'd path must not pass green having linted nothing
+        print("no such file or directory: " + ", ".join(missing),
+              file=sys.stderr)
+        return 2
+    t0 = time.monotonic()
+    violations, files_checked = lint_paths(paths, checkers)
+    elapsed = time.monotonic() - t0
+
+    baseline_path = None if args.no_baseline else (
+        args.baseline or _default_baseline())
+
+    if args.write_baseline:
+        target = args.baseline or os.path.join(
+            os.getcwd(), baseline_mod.DEFAULT_BASELINE_NAME)
+        baseline_mod.write_baseline(target, violations)
+        print(f"wrote baseline with {len(violations)} violation(s) "
+              f"across {files_checked} file(s) to {target}")
+        return 0
+
+    baseline = None
+    if baseline_path is not None:
+        try:
+            baseline = baseline_mod.load_baseline(baseline_path)
+        except (OSError, ValueError) as e:
+            print(f"cannot load baseline {baseline_path}: {e}", file=sys.stderr)
+            return 2
+
+    regressions = baseline_mod.compare(violations, baseline)
+    stale = baseline_mod.stale_entries(violations, baseline)
+    # which concrete violations are NEW (not absorbed by the baseline)?
+    # report the trailing N per regressed (path, rule) cell — deterministic
+    # because violations are sorted by (path, line, col).
+    regressed_cells = {(r.path, r.rule): r.count - r.allowed for r in regressions}
+    new_violations = []
+    seen_per_cell: dict[tuple[str, str], int] = {}
+    totals = baseline_mod.violation_counts(violations)
+    for v in violations:
+        cell = (v.path, v.rule)
+        if cell not in regressed_cells:
+            continue
+        seen = seen_per_cell.get(cell, 0) + 1
+        seen_per_cell[cell] = seen
+        if seen > totals[v.path][v.rule] - regressed_cells[cell]:
+            new_violations.append(v)
+
+    if args.format == "json":
+        print(json.dumps({
+            "version": 1,
+            "files_checked": files_checked,
+            "elapsed_seconds": round(elapsed, 3),
+            "baseline": baseline_path,
+            "total_violations": len(violations),
+            "violations": [v.to_dict() for v in violations],
+            "regressions": [r.to_dict() for r in regressions],
+            "new_violations": [v.to_dict() for v in new_violations],
+            "stale_baseline_entries": [s.to_dict() for s in stale],
+        }, indent=2))
+    else:
+        if baseline is None:
+            for v in violations:
+                print(v.render())
+        else:
+            for v in new_violations:
+                print(v.render())
+        if regressions and baseline is not None:
+            print(f"\n{len(regressions)} regression(s) past the baseline:")
+            for r in regressions:
+                print(f"  {r.render()}")
+        if stale:
+            print(f"\n{len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (ratchet down with "
+                  "--write-baseline):")
+            for s in stale:
+                print(f"  {s.render()}")
+        print(f"\nchecked {files_checked} file(s) in {elapsed:.2f}s: "
+              f"{len(violations)} violation(s), "
+              f"{len(regressions)} regression(s)"
+              + (f" [baseline: {baseline_path}]" if baseline_path else ""))
+
+    if baseline is None:
+        return 1 if violations else 0
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

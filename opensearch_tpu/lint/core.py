@@ -1,0 +1,247 @@
+"""tpulint core: file context, suppression parsing, checker protocol, runner.
+
+Design constraints (ISSUE 2): single AST pass per file per checker, no
+imports of the checked modules (pure ``ast`` — linting must stay fast and
+side-effect free), line-level suppression comments, and stable relative
+paths so the baseline file survives being run from the repo root.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Iterable, Iterator
+
+# rule id used for files that fail to parse (always fatal, never baselined)
+PARSE_ERROR_RULE = "TPU000"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*disable(?:\s*=\s*(?P<rules>[A-Z0-9,\s]+))?"
+)
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def sort_key(self) -> tuple:
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+def parse_suppressions(source: str) -> dict[int, set[str] | None]:
+    """line number -> suppressed rule ids (None = all rules on that line)."""
+    out: dict[int, set[str] | None] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = m.group("rules")
+        if rules is None:
+            out[i] = None
+        else:
+            ids = {r.strip() for r in rules.split(",") if r.strip()}
+            prev = out.get(i)
+            out[i] = None if prev is None else (prev or set()) | ids
+    return out
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'a.b.c' for Name/Attribute chains, else None (calls/subscripts break
+    the chain — ``jax.jit(f)(x)`` has no dotted name, by design)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    return dotted_name(node.func)
+
+
+def import_aliases(tree: ast.AST) -> dict[str, str]:
+    """first-segment alias -> canonical dotted prefix, from the file's
+    imports: ``import time as _time`` maps _time -> time, ``from datetime
+    import datetime`` maps datetime -> datetime.datetime."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                if a.name != "*":
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+class FileContext:
+    """Everything a checker needs about one file: tree, source lines,
+    suppression map, and a display path stable across runs."""
+
+    def __init__(self, path: str, source: str, display_path: str | None = None):
+        self.path = path
+        self.display_path = display_path or normalize_path(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressed = parse_suppressions(source)
+        self._aliases: dict[str, str] | None = None
+
+    def canonical(self, name: str | None) -> str | None:
+        """Resolve the first segment of a dotted call name through the
+        file's import aliases (``_time.monotonic`` -> ``time.monotonic``)."""
+        if name is None:
+            return None
+        if self._aliases is None:
+            self._aliases = import_aliases(self.tree)
+        head, sep, rest = name.partition(".")
+        resolved = self._aliases.get(head)
+        if resolved is None:
+            return name
+        return f"{resolved}{sep}{rest}" if sep else resolved
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        ids = self.suppressed.get(line, ())
+        return ids is None or rule in ids
+
+    def violation(self, rule: str, node: ast.AST, message: str) -> Violation:
+        return Violation(
+            rule=rule,
+            path=self.display_path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+        )
+
+
+class Checker:
+    """Base class for a rule. Subclasses set rule_id/name/description and
+    implement check(ctx) -> iterable of Violation."""
+
+    rule_id: str = "TPU999"
+    name: str = "unnamed"
+    description: str = ""
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return True
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:  # pragma: no cover
+        raise NotImplementedError
+
+
+# checkout root (core.py -> lint -> opensearch_tpu -> root): files under it
+# get repo-relative keys so lint_baseline.json works from any cwd
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def normalize_path(path: str) -> str:
+    """Posix-style baseline key: relative to the repo root when the file
+    lives under it, else to cwd, else absolute."""
+    p = os.path.abspath(path)
+    for anchor in (_REPO_ROOT, os.getcwd()):
+        try:
+            rel = os.path.relpath(p, anchor)
+        except ValueError:  # different drive (windows)
+            continue
+        if not rel.startswith(".."):
+            return rel.replace(os.sep, "/")
+    return p.replace(os.sep, "/")
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    seen: set[str] = set()
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", ".venv", "node_modules")
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        full = os.path.join(root, f)
+                        if full not in seen:
+                            seen.add(full)
+                            yield full
+        elif path.endswith(".py") or os.path.isfile(path):
+            if path not in seen:
+                seen.add(path)
+                yield path
+
+
+def lint_source(
+    path: str,
+    source: str,
+    checkers: Iterable[Checker],
+    display_path: str | None = None,
+) -> list[Violation]:
+    display = display_path or normalize_path(path)
+    try:
+        ctx = FileContext(path, source, display_path=display)
+    except SyntaxError as e:
+        return [Violation(
+            rule=PARSE_ERROR_RULE, path=display,
+            line=e.lineno or 1, col=(e.offset or 0) + 1,
+            message=f"syntax error: {e.msg}",
+        )]
+    out: list[Violation] = []
+    for checker in checkers:
+        if not checker.applies_to(display, source):
+            continue
+        for v in checker.check(ctx):
+            if not ctx.is_suppressed(v.rule, v.line):
+                out.append(v)
+    out.sort(key=Violation.sort_key)
+    return out
+
+
+def lint_paths(
+    paths: Iterable[str],
+    checkers: Iterable[Checker] | None = None,
+) -> tuple[list[Violation], int]:
+    """Lint every .py file under `paths`. Returns (violations, files_checked)."""
+    if checkers is None:
+        from opensearch_tpu.lint.rules import ALL_CHECKERS
+
+        checkers = ALL_CHECKERS
+    checkers = list(checkers)
+    violations: list[Violation] = []
+    n = 0
+    for f in iter_py_files(paths):
+        n += 1
+        try:
+            with open(f, encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            violations.append(Violation(
+                rule=PARSE_ERROR_RULE, path=normalize_path(f),
+                line=1, col=1, message=f"cannot read file: {e}",
+            ))
+            continue
+        violations.extend(lint_source(f, source, checkers))
+    violations.sort(key=Violation.sort_key)
+    return violations, n

@@ -1,0 +1,717 @@
+"""The five tpulint rules (TPU001–TPU005).
+
+Each checker is a single AST walk with a small amount of per-file context
+(scope, decorators, held locks). They are deliberately heuristic: the goal
+is catching the invariant breaks that have bitten this codebase (host syncs
+under jit, wall-clock in sim-run modules, swallowed exceptions), not a
+sound type system. False positives are absorbed by the baseline ratchet or
+a ``# tpulint: disable=`` comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from opensearch_tpu.lint.core import (
+    Checker,
+    FileContext,
+    Violation,
+    call_name,
+    dotted_name,
+)
+
+# ---------------------------------------------------------------------------
+# TPU001 — jit purity
+# ---------------------------------------------------------------------------
+
+# call targets whose arguments / decorated functions are traced by JAX
+_TRACE_ENTRIES = ("jit", "pallas_call", "shard_map", "pjit")
+# attribute reads that are static at trace time (no tracer data involved)
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "aval"}
+# module prefixes whose calls produce traced values
+_TRACED_MODULES = ("jnp.", "jax.numpy.", "lax.", "jax.lax.", "jsp.",
+                   "jax.scipy.", "pl.", "pltpu.")
+# host-sync call targets (full dotted names)
+_HOST_SYNC_CALLS = {
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "onp.asarray", "onp.array", "jax.device_get",
+}
+_STATIC_BUILTINS = {"len", "isinstance", "type", "range", "enumerate",
+                    "zip", "hasattr", "getattr", "min", "max"}
+
+
+def _is_trace_entry(name: str | None) -> bool:
+    return name is not None and name.split(".")[-1] in _TRACE_ENTRIES
+
+
+def _static_argnames_from_call(call: ast.Call) -> set[str]:
+    """static_argnames=("k", ...) keyword of a jit/pjit call."""
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    out.add(node.value)
+    return out
+
+
+def _static_argnums_from_call(call: ast.Call) -> set[int]:
+    out: set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, int):
+                    out.add(node.value)
+    return out
+
+
+class _TracedFunctionFinder(ast.NodeVisitor):
+    """Collect (function node, static arg names) for every function that
+    JAX traces: decorated with jit/pallas_call/shard_map (directly or via
+    functools.partial), or passed by name into such a call
+    (``jax.jit(f)``, ``pl.pallas_call(kernel, ...)``)."""
+
+    def __init__(self) -> None:
+        self.defs_by_name: dict[str, list[ast.FunctionDef]] = {}
+        self.traced: dict[ast.AST, set[str]] = {}
+        self._calls: list[ast.Call] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.defs_by_name.setdefault(node.name, []).append(node)
+        for dec in node.decorator_list:
+            if _is_trace_entry(dotted_name(dec)):
+                self.traced.setdefault(node, set())
+            elif isinstance(dec, ast.Call):
+                dec_name = call_name(dec)
+                if _is_trace_entry(dec_name):
+                    self.traced.setdefault(node, set()).update(
+                        _static_argnames_from_call(dec))
+                elif dec_name is not None and dec_name.split(".")[-1] == "partial":
+                    # @functools.partial(jax.jit, static_argnames=...)
+                    if dec.args and _is_trace_entry(dotted_name(dec.args[0])):
+                        statics = self.traced.setdefault(node, set())
+                        statics.update(_static_argnames_from_call(dec))
+                        params = [a.arg for a in node.args.args]
+                        for i in _static_argnums_from_call(dec):
+                            if i < len(params):
+                                statics.add(params[i])
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_trace_entry(call_name(node)):
+            self._calls.append(node)
+        self.generic_visit(node)
+
+    def resolve_wrapped(self) -> None:
+        """jax.jit(f) / pallas_call(kernel, ...): mark the named function."""
+        for call in self._calls:
+            statics = _static_argnames_from_call(call)
+            targets: list[tuple[ast.AST, set[str]]] = [
+                (t, statics) for t in call.args[:1]]
+            # jax.jit(functools.partial(f, k=k, ...)) — look through the
+            # partial; keyword-bound names are fixed at wrap time, so they
+            # are static with respect to the trace
+            for t, st in list(targets):
+                if isinstance(t, ast.Call):
+                    tn = call_name(t)
+                    if tn is not None and tn.split(".")[-1] == "partial" and t.args:
+                        bound = {kw.arg for kw in t.keywords if kw.arg}
+                        targets.append((t.args[0], st | bound))
+            for t, st in targets:
+                if isinstance(t, ast.Name):
+                    for fn in self.defs_by_name.get(t.id, ()):
+                        self.traced.setdefault(fn, set()).update(st)
+                elif isinstance(t, ast.Lambda):
+                    self.traced.setdefault(t, set())
+
+
+def _mentions_traced(node: ast.AST, traced: set[str]) -> bool:
+    """Does this expression carry traced data? Shape/dtype reads and
+    static builtins don't count."""
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return False
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name in _STATIC_BUILTINS:
+            return False
+        if name is not None and name.startswith(_TRACED_MODULES):
+            return True
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    if isinstance(node, ast.Compare):
+        # `x is None` / `x is not None` is resolved at trace time
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False
+    return any(_mentions_traced(c, traced) for c in ast.iter_child_nodes(node))
+
+
+class _PurityVisitor(ast.NodeVisitor):
+    """Walk ONE traced function body, tracking which local names carry
+    traced values, and flag impurities."""
+
+    def __init__(self, ctx: FileContext, fn: ast.AST, statics: set[str]):
+        self.ctx = ctx
+        self.out: list[Violation] = []
+        self.traced: set[str] = set()
+        self.local_names: set[str] = set()
+        args = getattr(fn, "args", None)
+        if args is not None:
+            params = [a.arg for a in
+                      args.posonlyargs + args.args + args.kwonlyargs]
+            if args.vararg:
+                params.append(args.vararg.arg)
+            if args.kwarg:
+                params.append(args.kwarg.arg)
+            self.local_names.update(params)
+            # params with str/bool/None defaults are config, not arrays —
+            # a traced string argument would be a TypeError anyway
+            static_by_default: set[str] = set()
+            pos = args.posonlyargs + args.args
+            for param, default in zip(pos[len(pos) - len(args.defaults):],
+                                      args.defaults):
+                if isinstance(default, ast.Constant) and isinstance(
+                        default.value, (str, bool, type(None))):
+                    static_by_default.add(param.arg)
+            for param, default in zip(args.kwonlyargs, args.kw_defaults):
+                if isinstance(default, ast.Constant) and isinstance(
+                        default.value, (str, bool, type(None))):
+                    static_by_default.add(param.arg)
+            self.traced.update(p for p in params
+                               if p not in statics and p not in static_by_default)
+            self.traced.discard("self")
+
+    def _flag(self, node: ast.AST, message: str) -> None:
+        self.out.append(self.ctx.violation("TPU001", node, message))
+
+    # -- name tracking -----------------------------------------------------
+
+    def _bind(self, target: ast.AST, value_traced: bool) -> None:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                self.local_names.add(node.id)
+                if value_traced:
+                    self.traced.add(node.id)
+                else:
+                    self.traced.discard(node.id)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        traced = _mentions_traced(node.value, self.traced)
+        for t in node.targets:
+            self._check_mutation(t, node)
+            self._bind(t, traced)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self.visit(node.value)
+            self._check_mutation(node.target, node)
+            self._bind(node.target, _mentions_traced(node.value, self.traced))
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        self._check_mutation(node.target, node)
+        if isinstance(node.target, ast.Name):
+            self.local_names.add(node.target.id)
+            if _mentions_traced(node.value, self.traced):
+                self.traced.add(node.target.id)
+
+    def visit_For(self, node: ast.For) -> None:
+        self.visit(node.iter)
+        self._bind(node.target, _mentions_traced(node.iter, self.traced))
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    # -- impurities --------------------------------------------------------
+
+    def _check_mutation(self, target: ast.AST, stmt: ast.AST) -> None:
+        """Assignment through an Attribute/Subscript whose root is not a
+        local: Python-level mutation of nonlocal state under trace."""
+        root = target
+        seen_deref = False
+        while isinstance(root, (ast.Attribute, ast.Subscript)):
+            seen_deref = True
+            root = root.value
+        if not seen_deref:
+            return
+        if isinstance(root, ast.Name):
+            if root.id == "self" or root.id not in self.local_names:
+                self._flag(stmt, (
+                    f"mutation of nonlocal state "
+                    f"[{dotted_name(target) or ast.unparse(target)}] inside a "
+                    "traced function (runs once at trace time, not per call)"
+                ))
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self._flag(node, "global statement inside a traced function "
+                         "(nonlocal mutation is invisible to jit)")
+
+    def visit_Nonlocal(self, node: ast.Nonlocal) -> None:
+        self._flag(node, "nonlocal statement inside a traced function "
+                         "(nonlocal mutation is invisible to jit)")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_name(node)
+        if name == "print":
+            self._flag(node, "print() inside a traced function runs at trace "
+                             "time only; use jax.debug.print")
+        elif name in _HOST_SYNC_CALLS and any(
+                _mentions_traced(a, self.traced) for a in node.args):
+            self._flag(node, f"{name}() on a traced value forces a host sync "
+                             "(device->host copy) inside the traced region")
+        elif name is not None and name.split(".")[-1] == "block_until_ready":
+            self._flag(node, ".block_until_ready() inside a traced function "
+                             "is a host sync")
+        elif name is not None and name.split(".")[-1] == "item" and (
+                _mentions_traced(node.func, self.traced)):
+            self._flag(node, ".item() on a traced value forces a host sync")
+        elif name in ("float", "int", "bool") and node.args and any(
+                _mentions_traced(a, self.traced) for a in node.args):
+            self._flag(node, f"{name}() on a traced value forces concretization "
+                             "(host sync / ConcretizationTypeError)")
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        if _mentions_traced(node.test, self.traced):
+            self._flag(node, "data-dependent `if` on a traced value; use "
+                             "lax.cond / lax.select / jnp.where")
+        self.visit(node.test)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_While(self, node: ast.While) -> None:
+        if _mentions_traced(node.test, self.traced):
+            self._flag(node, "data-dependent `while` on a traced value; use "
+                             "lax.while_loop")
+        self.visit(node.test)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    # nested defs inherit the outer traced scope via the finder (they are
+    # traced too); don't double-walk them here
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.local_names.add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass
+
+
+class JitPurityChecker(Checker):
+    rule_id = "TPU001"
+    name = "jit-purity"
+    description = ("host syncs, nonlocal mutation, and data-dependent "
+                   "control flow inside jit/pallas_call/shard_map-traced "
+                   "functions")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return "jit" in source or "pallas_call" in source or "shard_map" in source
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        finder = _TracedFunctionFinder()
+        finder.visit(ctx.tree)
+        finder.resolve_wrapped()
+        out: list[Violation] = []
+        for fn, statics in finder.traced.items():
+            visitor = _PurityVisitor(ctx, fn, statics)
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                visitor.visit(stmt)
+            out.extend(visitor.out)
+            # nested defs inside a traced function are traced as well
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.FunctionDef) and sub not in finder.traced:
+                        nested = _PurityVisitor(ctx, sub, statics)
+                        for s in sub.body:
+                            nested.visit(s)
+                        out.extend(nested.out)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# TPU002 — blocking calls in async code
+# ---------------------------------------------------------------------------
+
+_BLOCKING_PREFIXES = ("socket.", "requests.", "urllib.request.", "subprocess.")
+_BLOCKING_CALLS = {"time.sleep", "open"}
+
+
+class _AsyncBodyVisitor(ast.NodeVisitor):
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.out: list[Violation] = []
+        self._awaited_calls: set[int] = set()
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if isinstance(node.value, ast.Call):
+            self._awaited_calls.add(id(node.value))
+        self.generic_visit(node)
+
+    # a nested sync def is a callback that may run off-loop; don't flag it.
+    # nested ASYNC defs are skipped too — the outer walk in check() visits
+    # every AsyncFunctionDef separately (descending here double-reports)
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = self.ctx.canonical(call_name(node))
+        if name in _BLOCKING_CALLS:
+            what = ("time.sleep() blocks the event loop; use await "
+                    "asyncio.sleep" if name == "time.sleep"
+                    else "open() is blocking file IO on the event loop")
+            self.out.append(self.ctx.violation("TPU002", node, what))
+        elif name is not None and name.startswith(_BLOCKING_PREFIXES):
+            self.out.append(self.ctx.violation(
+                "TPU002", node,
+                f"{name}() is blocking IO inside an async function"))
+        elif (
+            name is not None
+            and name.split(".")[-1] == "acquire"
+            and id(node) not in self._awaited_calls
+            and not any(kw.arg in ("timeout", "blocking") for kw in node.keywords)
+            and not node.args
+        ):
+            self.out.append(self.ctx.violation(
+                "TPU002", node,
+                f"{name}() without a timeout can deadlock the event loop; "
+                "pass timeout= or use an asyncio primitive"))
+        self.generic_visit(node)
+
+
+class BlockingInAsyncChecker(Checker):
+    rule_id = "TPU002"
+    name = "blocking-in-async"
+    description = ("time.sleep, blocking socket/file IO, and untimed "
+                   "Lock.acquire inside async def bodies")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return "async def" in source
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                v = _AsyncBodyVisitor(ctx)
+                # two passes: collect awaited calls first so `await
+                # lock.acquire()` is not flagged regardless of walk order
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Await) and isinstance(sub.value, ast.Call):
+                        v._awaited_calls.add(id(sub.value))
+                for stmt in node.body:
+                    v.visit(stmt)
+                out.extend(v.out)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# TPU003 — lock discipline
+# ---------------------------------------------------------------------------
+
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore")
+# methods where lock-free access is fine: object is not yet / no longer shared
+_EXEMPT_METHODS = {"__init__", "__new__", "__del__", "__repr__", "__str__",
+                   "__enter__", "__exit__"}
+
+
+class _MethodLockScan(ast.NodeVisitor):
+    """Scan one method, tracking which of the class's locks are held."""
+
+    def __init__(self, lock_attrs: set[str], method: str):
+        self.lock_attrs = lock_attrs
+        self.method = method
+        self.held: list[str] = []
+        # (attr, line, col, is_store, frozenset(held), node)
+        self.accesses: list[tuple] = []
+        # ordered pairs (outer, inner) -> node of the inner acquisition
+        self.pairs: dict[tuple[str, str], ast.AST] = {}
+
+    def _self_attr(self, node: ast.AST) -> str | None:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: list[str] = []
+        for item in node.items:
+            attr = self._self_attr(item.context_expr)
+            if attr is not None and attr in self.lock_attrs:
+                for outer in self.held + acquired:
+                    if outer != attr:
+                        self.pairs.setdefault((outer, attr), item.context_expr)
+                acquired.append(attr)
+            else:
+                self.visit(item.context_expr)
+        self.held.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        if acquired:
+            del self.held[-len(acquired):]
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = self._self_attr(node)
+        if attr is not None and attr not in self.lock_attrs:
+            self.accesses.append((
+                attr, node.lineno, node.col_offset,
+                isinstance(node.ctx, (ast.Store, ast.Del)),
+                frozenset(self.held), node,
+            ))
+        self.generic_visit(node)
+
+    # nested defs (callbacks) run later, possibly without the lock — skip
+    # them for held-lock accounting but still record their accesses as
+    # unlocked? Too noisy: skip entirely.
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+    visit_Lambda = visit_FunctionDef  # type: ignore[assignment]
+
+
+class LockDisciplineChecker(Checker):
+    rule_id = "TPU003"
+    name = "lock-discipline"
+    description = ("attributes written under a lock accessed lock-free "
+                   "elsewhere in the class; inconsistent lock acquisition "
+                   "order")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return "Lock" in source or "_lock" in source or "Semaphore" in source
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(ctx, node))
+        return out
+
+    def _lock_attrs(self, cls: ast.ClassDef) -> set[str]:
+        locks: set[str] = set()
+        for node in ast.walk(cls):
+            # self.X = threading.Lock() (or RLock/Condition/Semaphore)
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                name = call_name(node.value)
+                if name is not None and name.split(".")[-1] in _LOCK_FACTORIES:
+                    for t in node.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            locks.add(t.attr)
+            # `with self.X:` on an attr that looks like a lock
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    e = item.context_expr
+                    if (isinstance(e, ast.Attribute)
+                            and isinstance(e.value, ast.Name)
+                            and e.value.id == "self"
+                            and "lock" in e.attr.lower()):
+                        locks.add(e.attr)
+        return locks
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef) -> list[Violation]:
+        locks = self._lock_attrs(cls)
+        if not locks:
+            return []
+        scans: list[_MethodLockScan] = []
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan = _MethodLockScan(locks, item.name)
+                for stmt in item.body:
+                    scan.visit(stmt)
+                scans.append(scan)
+
+        # which attrs are written under which lock (outside exempt methods)
+        guarded: dict[str, set[str]] = {}
+        writer: dict[str, str] = {}
+        for scan in scans:
+            if scan.method in _EXEMPT_METHODS:
+                continue
+            for attr, _line, _col, is_store, held, _node in scan.accesses:
+                if is_store and held:
+                    guarded.setdefault(attr, set()).update(held)
+                    writer.setdefault(attr, scan.method)
+
+        out: list[Violation] = []
+        for scan in scans:
+            if scan.method in _EXEMPT_METHODS:
+                continue
+            for attr, _line, _col, _is_store, held, node in scan.accesses:
+                need = guarded.get(attr)
+                if need and not (held & need):
+                    lock_names = "/".join(f"self.{n}" for n in sorted(need))
+                    out.append(ctx.violation(
+                        "TPU003", node,
+                        f"self.{attr} is written under {lock_names} "
+                        f"(in {writer[attr]}()) but accessed here in "
+                        f"{scan.method}() without holding it"))
+
+        # inconsistent lock ordering across the whole class
+        all_pairs: dict[tuple[str, str], ast.AST] = {}
+        for scan in scans:
+            for pair, node in scan.pairs.items():
+                all_pairs.setdefault(pair, node)
+        for (a, b) in sorted(all_pairs):
+            if (b, a) in all_pairs and a < b:
+                out.append(ctx.violation(
+                    "TPU003", all_pairs[(b, a)],
+                    f"locks self.{a} and self.{b} are acquired in both "
+                    f"orders in class {cls.name} (deadlock risk)"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# TPU004 — determinism in sim-run modules
+# ---------------------------------------------------------------------------
+
+# module path fragments that run under testing/sim.py's virtual time
+_SIM_MODULE_PATTERNS = (
+    "opensearch_tpu/cluster/",
+    "opensearch_tpu/transport/",
+    "opensearch_tpu/index/recovery.py",
+)
+# a file can opt in explicitly (fixtures, new sim-run modules)
+_SIM_MARKER = "# tpulint: deterministic-module"
+
+_WALLCLOCK_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "time.monotonic_ns", "time.perf_counter_ns", "time.sleep",
+}
+_DATETIME_CALLS = {
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow", "date.today",
+    "datetime.date.today",
+}
+# random.Random(seed) is the FIX (seeded instance), so it is allowed;
+# everything else on the global `random` module is unseeded process state
+_ALLOWED_RANDOM = {"random.Random", "random.SystemRandom"}
+
+
+class DeterminismChecker(Checker):
+    rule_id = "TPU004"
+    name = "determinism"
+    description = ("wall-clock time / global random / datetime.now in "
+                   "modules that run under the deterministic sim")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        if _SIM_MARKER in source:
+            return True
+        return any(p in display_path for p in _SIM_MODULE_PATTERNS)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.canonical(call_name(node))
+            if name is None:
+                continue
+            if name in _WALLCLOCK_CALLS:
+                out.append(ctx.violation(
+                    "TPU004", node,
+                    f"{name}() in a sim-run module defeats virtual time; "
+                    "use the injected clock "
+                    "(opensearch_tpu.common.timeutil.epoch_millis/"
+                    "monotonic_millis) or the scheduler"))
+            elif name in _DATETIME_CALLS:
+                out.append(ctx.violation(
+                    "TPU004", node,
+                    f"{name}() in a sim-run module defeats virtual time; "
+                    "derive timestamps from the injected clock"))
+            elif (name.startswith("random.")
+                  and name not in _ALLOWED_RANDOM):
+                out.append(ctx.violation(
+                    "TPU004", node,
+                    f"{name}() uses the unseeded process-global RNG; use the "
+                    "scheduler's seeded random.Random instance"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# TPU005 — exception hygiene
+# ---------------------------------------------------------------------------
+
+_LOG_LAST_SEGMENTS = {"debug", "info", "warning", "warn", "error",
+                      "exception", "critical", "log", "print_exc",
+                      "format_exc"}
+_LOG_FIRST_SEGMENTS = {"logger", "logging", "log", "warnings", "traceback"}
+_RECORD_SUBSTRINGS = ("err", "fail", "drop", "reject", "miss", "bad",
+                      "invalid", "skip", "exc")
+
+
+def _body_handles_error(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if bound and isinstance(node, ast.Name) and node.id == bound:
+            return True
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name is not None:
+                segs = name.split(".")
+                if segs[-1] in _LOG_LAST_SEGMENTS or segs[0] in _LOG_FIRST_SEGMENTS:
+                    return True
+                if name == "sys.exc_info":
+                    return True
+        # counting the failure (self.stats["dropped"] += 1, errors.append)
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                for part in ast.walk(t):
+                    text = None
+                    if isinstance(part, ast.Name):
+                        text = part.id
+                    elif isinstance(part, ast.Attribute):
+                        text = part.attr
+                    elif isinstance(part, ast.Constant) and isinstance(part.value, str):
+                        text = part.value
+                    if text is not None and any(
+                            s in text.lower() for s in _RECORD_SUBSTRINGS):
+                        return True
+    return False
+
+
+class ExceptionHygieneChecker(Checker):
+    rule_id = "TPU005"
+    name = "exception-hygiene"
+    description = ("except Exception / bare except whose body neither "
+                   "logs, re-raises, nor records the error")
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            type_name = dotted_name(node.type) if node.type is not None else None
+            broad = node.type is None or (
+                type_name is not None
+                and type_name.split(".")[-1] in ("Exception", "BaseException"))
+            if not broad:
+                continue
+            if not _body_handles_error(node):
+                what = type_name or "bare except"
+                out.append(ctx.violation(
+                    "TPU005", node,
+                    f"`except {what}` swallows the error: body neither "
+                    "logs, re-raises, nor records it"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+
+ALL_CHECKERS: list[Checker] = [
+    JitPurityChecker(),
+    BlockingInAsyncChecker(),
+    LockDisciplineChecker(),
+    DeterminismChecker(),
+    ExceptionHygieneChecker(),
+]
+
+RULES: dict[str, Checker] = {c.rule_id: c for c in ALL_CHECKERS}

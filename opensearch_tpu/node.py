@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
 import re
 import time
 import uuid
@@ -47,6 +48,8 @@ from opensearch_tpu.index.analysis import AnalysisRegistry
 from opensearch_tpu.index.mapper import MapperService
 from opensearch_tpu.index.shard import IndexShard, ShardId, translog_durability
 from opensearch_tpu.search import service as search_service
+
+logger = logging.getLogger(__name__)
 
 # index names: anything except the reserved characters, no uppercase
 # ASCII, not starting with _ - + (MetadataCreateIndexService.validateIndexName
@@ -2501,8 +2504,9 @@ class TpuNode:
                         "fetch_time_in_millis": 0, "fetch_current": 0})
                     e["query_total"] += 1
                     e["fetch_total"] += 1
-        except Exception:
-            pass  # stats accounting must never fail a search
+        except Exception as e:  # noqa: BLE001
+            # stats accounting must never fail a search
+            logger.debug("search group-stats accounting failed: %s", e)
         # body key is always consumed; an explicit param takes precedence
         body_pipeline = body.pop("search_pipeline", None)
         pipeline_id = search_pipeline or body_pipeline

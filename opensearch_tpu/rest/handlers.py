@@ -9,6 +9,7 @@ return (status, payload) — the HTTP server is transport-only.
 from __future__ import annotations
 
 import json
+import logging
 from typing import Any
 
 from opensearch_tpu import __version__
@@ -21,6 +22,8 @@ from opensearch_tpu.common.errors import (
 )
 from opensearch_tpu.node import TpuNode
 from opensearch_tpu.rest.router import Router
+
+logger = logging.getLogger(__name__)
 
 
 def apply_filter_path(payload: Any, spec: str) -> Any:
@@ -1187,7 +1190,8 @@ def _apply_typed_keys(resp: dict, query, body, node=None,
                 m = node.indices[n].mapper_service.field_mapper(field)
                 if m is not None:
                     return m.original_type or m.type
-        except Exception:
+        except Exception as e:  # noqa: BLE001
+            logger.debug("typed-keys field-type lookup failed: %s", e)
             return None
         return None
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import logging
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any
@@ -111,8 +112,9 @@ class HttpServer:
             try:
                 writer.close()
                 await writer.wait_closed()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 - best-effort close
+                logging.getLogger(__name__).debug(
+                    "http connection close failed: %s", e)
 
     async def _read_request(self, reader: asyncio.StreamReader):
         try:

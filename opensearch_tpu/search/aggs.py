@@ -22,6 +22,7 @@ device formulations planned in ops/ for the large-corpus path.
 from __future__ import annotations
 
 import datetime as _dt
+import logging
 from typing import Any, Callable
 
 import numpy as np
@@ -30,6 +31,8 @@ from opensearch_tpu.common.errors import IllegalArgumentException, ParsingExcept
 from opensearch_tpu.index.mapper import MapperService, parse_date_millis
 from opensearch_tpu.index.segment import HostSegment
 from opensearch_tpu.common.settings import parse_time_millis
+
+logger = logging.getLogger(__name__)
 
 AGG_TYPES = {
     "terms", "min", "max", "sum", "avg", "value_count", "stats", "cardinality",
@@ -1009,7 +1012,9 @@ def _nested_agg(conf, sub, segments, ms, masks, filter_fn, ext=None) -> dict:
         for d in np.nonzero(masks[i])[0]:
             try:
                 src = _json.loads(seg.sources[int(d)])
-            except Exception:
+            except Exception as e:  # noqa: BLE001 - malformed _source: skip
+                logger.debug(
+                    "nested agg: unparseable _source for doc %d: %s", d, e)
                 continue
             total += _count_nested_objects(src, parts)
     out = {"doc_count": total}

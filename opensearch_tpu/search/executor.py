@@ -20,6 +20,7 @@ ending in lax.top_k.
 
 from __future__ import annotations
 
+import logging
 import re
 from dataclasses import dataclass, field as dc_field
 from typing import Any
@@ -48,6 +49,8 @@ from opensearch_tpu.index.segment import (
 )
 from opensearch_tpu.ops import bm25, filters, knn
 from opensearch_tpu.search import query_dsl as q
+
+logger = logging.getLogger(__name__)
 
 I64_MIN = -(2**63)
 I64_MAX = 2**63 - 1
@@ -329,8 +332,11 @@ class ShardContext:
                     r = tmp_ex.execute(parsed)
                     if bool(np.asarray(r.mask)[: tmp_host.n_docs].any()):
                         mask[d] = True
-                except Exception:
-                    continue  # malformed stored query never matches
+                except Exception as e:  # noqa: BLE001
+                    # malformed stored query never matches
+                    logger.debug(
+                        "percolate: stored query for doc %d unusable: %s", d, e)
+                    continue
             masks.append(mask)
         self._qs_cache[("perc", id(node))] = masks
         return masks

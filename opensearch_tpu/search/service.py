@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import fnmatch
 import json
+import logging
 import time
 from typing import Any
 
@@ -27,6 +28,8 @@ from opensearch_tpu.common.errors import (
 )
 from opensearch_tpu.index.shard import IndexShard
 from opensearch_tpu.search import fetch, query_dsl
+
+logger = logging.getLogger(__name__)
 from opensearch_tpu.search.aggs import compute_aggs
 from opensearch_tpu.search.executor import (
     SegmentExecutor,
@@ -982,7 +985,8 @@ def try_batched_knn_msearch(
             return None
         try:
             node = query_dsl.parse_query(body.get("query"))
-        except Exception:  # noqa: BLE001 - bad body -> serial path reports it
+        except Exception as e:  # noqa: BLE001 - bad body -> serial path reports it
+            logger.debug("msearch batch probe: body not batchable: %s", e)
             return None
         if not isinstance(node, query_dsl.KnnQuery) or node.filter is not None:
             return None

@@ -18,6 +18,8 @@ import threading
 import time
 from dataclasses import dataclass, field as dc_field
 
+from opensearch_tpu.common.timeutil import epoch_millis
+
 from opensearch_tpu.common.errors import (
     ResourceNotFoundException,
     TaskCancelledException,
@@ -89,7 +91,7 @@ class TaskManager:
             cancellable=cancellable,
             parent_id=parent_id,
             node=self._node,
-            start_time_millis=int(time.time() * 1000),
+            start_time_millis=epoch_millis(),
             _start_perf=time.perf_counter(),
         )
         with self._lock:

@@ -16,6 +16,26 @@ import random
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from opensearch_tpu.common.timeutil import Clock
+
+
+class VirtualClock(Clock):
+    """timeutil.Clock that reads a DeterministicTaskQueue's virtual time.
+
+    Install with ``timeutil.set_clock`` / ``timeutil.clock_scope`` so
+    modules that read wall-clock through the injected clock (recovery
+    timestamps, bulk "took", reader-context expiry) advance with the sim
+    instead of the host."""
+
+    def __init__(self, queue: "DeterministicTaskQueue"):
+        self._queue = queue
+
+    def epoch_millis(self) -> int:
+        return self._queue.now_ms
+
+    def monotonic_millis(self) -> int:
+        return self._queue.now_ms
+
 
 @dataclass(order=True)
 class _Task:
@@ -46,6 +66,10 @@ class DeterministicTaskQueue:
         self.random = random.Random(seed)
         self._seq = 0
         self._heap: list[_Task] = []
+
+    def clock(self) -> VirtualClock:
+        """A timeutil.Clock view of this queue's virtual time."""
+        return VirtualClock(self)
 
     def schedule(self, delay_ms: int, fn: Callable[[], None]) -> Cancellable:
         self._seq += 1

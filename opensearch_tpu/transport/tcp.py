@@ -31,11 +31,14 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import random
 import struct
 from typing import Any, Callable
 
 from opensearch_tpu.transport.base import DeferredResponse
+
+logger = logging.getLogger(__name__)
 
 PROTOCOL_VERSION = 2
 _LEN = struct.Struct(">I")
@@ -88,8 +91,8 @@ class _Connection:
             self.closed = True
             try:
                 self.writer.close()
-            except Exception:  # noqa: BLE001 - best-effort close
-                pass
+            except Exception as e:  # noqa: BLE001 - best-effort close
+                logger.debug("connection close failed: %s", e)
 
 
 def _extract_binary(body: dict) -> tuple[dict, bytes | None]:

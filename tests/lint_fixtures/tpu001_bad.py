@@ -1,0 +1,47 @@
+"""TPU001 true positives: impure traced functions.
+
+Never imported — tests/test_lint.py lints this file and asserts the
+EXPECT-annotated lines (and only those) are flagged.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+COUNTER = {"calls": 0}
+
+
+@jax.jit
+def host_sync_scores(x):
+    print("tracing", x)                          # EXPECT: TPU001
+    y = jnp.sum(x)
+    if y > 0:                                    # EXPECT: TPU001
+        y = -y
+    host = np.asarray(y)                         # EXPECT: TPU001
+    return float(y), host                        # EXPECT: TPU001
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def leaky_topk(scores, k):
+    while jnp.any(scores > 0):                   # EXPECT: TPU001
+        scores = scores - 1.0
+    COUNTER["calls"] += 1                        # EXPECT: TPU001
+    return jax.lax.top_k(scores, k)
+
+
+@jax.jit
+def scalarize(x):
+    total = jnp.sum(x)
+    return total.item()                          # EXPECT: TPU001
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+    x_ref.block_until_ready()                    # EXPECT: TPU001
+
+
+def run(x):
+    import jax.experimental.pallas as pl
+
+    return pl.pallas_call(kernel, out_shape=x)(x)

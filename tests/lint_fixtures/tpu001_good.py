@@ -1,0 +1,41 @@
+"""TPU001 false-positive guards: pure traced code that must NOT be flagged.
+
+Static config args (static_argnames / partial-bound kwargs / str
+defaults), shape-based branching, `is None` checks, and host code outside
+traced functions are all legal.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("k", "similarity"))
+def pure_topk(scores, k, similarity="l2_norm"):
+    if similarity == "cosine":      # static arg: resolved at trace time
+        scores = scores * 0.5
+    if scores.shape[0] > 128:       # shape is static under jit
+        scores = scores[:128]
+    return jax.lax.top_k(scores, k)
+
+
+def pure_partial(x, scale=1.0, mode="slow"):
+    if mode == "fast":              # partial-bound kwarg below: static
+        return x * scale
+    return jnp.where(x > 0, x, -x)  # data-dependent SELECT is fine
+
+
+def build():
+    return jax.jit(functools.partial(pure_partial, scale=2.0, mode="fast"))
+
+
+@jax.jit
+def optional_arg(x, mask=None):
+    if mask is None:                # `is None` resolves at trace time
+        mask = jnp.ones_like(x)
+    return x * mask
+
+
+def host_helper(x):
+    print("not traced")             # host code: print is fine
+    return x

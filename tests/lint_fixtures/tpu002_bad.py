@@ -1,0 +1,14 @@
+"""TPU002 true positives: blocking calls on the event loop."""
+import socket
+import threading
+import time
+
+LOCK = threading.Lock()
+
+
+async def handler(reader, writer):
+    time.sleep(0.1)                               # EXPECT: TPU002
+    data = open("/tmp/state.json").read()         # EXPECT: TPU002
+    conn = socket.create_connection(("a", 1))     # EXPECT: TPU002
+    LOCK.acquire()                                # EXPECT: TPU002
+    return data, conn

@@ -1,0 +1,27 @@
+"""TPU002 guards: async-native calls, bounded acquires, and blocking
+calls in SYNC code (fine — only event-loop bodies are checked)."""
+import asyncio
+import threading
+import time
+
+LOCK = threading.Lock()
+
+
+def sync_path():
+    time.sleep(0.1)                  # sync function: fine
+    with open("/tmp/state.json") as fh:
+        return fh.read()
+
+
+async def proper(lock: asyncio.Lock):
+    await asyncio.sleep(0.1)
+    await lock.acquire()             # awaited: asyncio primitive
+    ok = LOCK.acquire(timeout=1.0)   # bounded: cannot deadlock the loop
+    conn = await asyncio.open_connection("a", 1)
+    return ok, conn
+
+
+async def spawns_worker():
+    def worker():
+        time.sleep(1.0)              # runs on an executor thread: fine
+    return worker

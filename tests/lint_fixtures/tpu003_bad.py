@@ -1,0 +1,33 @@
+"""TPU003 true positives: lock-free access to a guarded attribute, and a
+lock-order inversion."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        with self._lock:
+            self.total += n
+
+    def snapshot(self):
+        return self.total                         # EXPECT: TPU003
+
+
+class Transfer:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.pending = 0
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                self.pending += 1
+
+    def backward(self):
+        with self._b:
+            with self._a:                         # EXPECT: TPU003
+                self.pending -= 1

@@ -1,0 +1,42 @@
+"""TPU003 guards: consistent locking must not be flagged.
+
+__init__ writes happen-before sharing; attributes never written under a
+lock are unguarded; nested locks acquired in one global order are safe.
+"""
+import threading
+
+
+class SafeCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self.limit = 100
+
+    def add(self, n):
+        with self._lock:
+            if self.total + n <= self.limit:
+                self.total += n
+
+    def snapshot(self):
+        with self._lock:
+            return self.total
+
+    def config(self):
+        return self.limit    # only written in __init__: unguarded, fine
+
+
+class Ordered:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def one(self):
+        with self._a:
+            with self._b:
+                self.n += 1
+
+    def two(self):
+        with self._a:
+            with self._b:
+                self.n -= 1

@@ -1,0 +1,15 @@
+"""TPU004 true positives: wall clock / global RNG in a sim-run module."""
+# tpulint: deterministic-module
+import datetime
+import random
+import time
+import time as _clock
+
+
+def schedule_retry(attempt):
+    now = time.time()                             # EXPECT: TPU004
+    jitter = random.uniform(0, 1)                 # EXPECT: TPU004
+    stamp = datetime.datetime.now()               # EXPECT: TPU004
+    time.sleep(0.01)                              # EXPECT: TPU004
+    aliased = _clock.monotonic()                  # EXPECT: TPU004
+    return now + jitter, stamp, aliased

@@ -1,0 +1,17 @@
+"""TPU004 guards: injected clock + seeded instance RNG are the fix."""
+# tpulint: deterministic-module
+import random
+
+from opensearch_tpu.common import timeutil
+
+
+class RetryPolicy:
+    def __init__(self, scheduler, seed=0):
+        self.scheduler = scheduler
+        self.random = random.Random(seed)    # seeded instance: fine
+
+    def next_delay(self):
+        started = timeutil.monotonic_millis()
+        jitter = self.random.randint(1, 20)  # instance RNG: fine
+        self.scheduler.schedule(jitter, lambda: None)
+        return started, jitter

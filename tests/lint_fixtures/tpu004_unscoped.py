@@ -1,0 +1,7 @@
+"""No deterministic-module marker and not under a sim-run path: TPU004
+must not apply here at all."""
+import time
+
+
+def stamp():
+    return time.time()

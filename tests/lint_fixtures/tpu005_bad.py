@@ -1,0 +1,26 @@
+"""TPU005 true positives: broad excepts that swallow the error."""
+
+
+def swallow_pass(fn):
+    try:
+        return fn()
+    except Exception:                             # EXPECT: TPU005
+        pass
+
+
+def swallow_continue(items):
+    out = []
+    for item in items:
+        try:
+            out.append(int(item))
+        except:                                   # EXPECT: TPU005
+            continue
+    return out
+
+
+def swallow_named(fn):
+    try:
+        fn()
+    except Exception as exc:                      # EXPECT: TPU005
+        return None
+    return True

@@ -403,3 +403,37 @@ def test_chaos_random_kill_heal_cycles(tmp_path, seed):
     finally:
         for n in sim.nodes.values():
             n.close()
+
+
+# ---------------------------------------------------------------------- #
+# virtual clock: the sim controls time read through the injected clock
+# ---------------------------------------------------------------------- #
+
+def test_virtual_clock_controls_injected_time():
+    """Modules routed through timeutil's clock (recovery timestamps,
+    bulk "took", reader-context expiry) must advance with the sim's
+    virtual time, not the host clock (tpulint TPU004's contract)."""
+    from opensearch_tpu.common import timeutil
+
+    queue = DeterministicTaskQueue(seed=7)
+    with timeutil.clock_scope(queue.clock()):
+        assert timeutil.epoch_millis() == 0
+        assert timeutil.monotonic_millis() == 0
+        queue.schedule(5_000, lambda: None)
+        queue.run_all()
+        assert timeutil.epoch_millis() == 5_000
+        assert timeutil.now_millis() == 5_000
+    # scope exit restores the host clock
+    assert timeutil.epoch_millis() > 1_000_000
+
+
+def test_recovery_progress_timestamps_use_virtual_clock():
+    from opensearch_tpu.common import timeutil
+    from opensearch_tpu.index.recovery import RecoveryProgress
+
+    queue = DeterministicTaskQueue(seed=7)
+    queue.schedule(12_345, lambda: None)
+    queue.run_all()
+    with timeutil.clock_scope(queue.clock()):
+        progress = RecoveryProgress(index="ix", shard=0, target_node="n1")
+        assert progress.start_ms == 12_345

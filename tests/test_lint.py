@@ -1,0 +1,233 @@
+"""tpulint tier-1 tests: fixture semantics per rule, suppression,
+baseline ratchet, CLI round-trip, and the repo-wide clean gate.
+
+Fixture contract: every line in tests/lint_fixtures/*_bad.py carrying a
+``# EXPECT: TPU00N`` comment must be flagged with exactly that rule, and
+nothing else in the file may be flagged. ``*_good.py`` files must produce
+zero violations (false-positive guards).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from opensearch_tpu.lint import baseline as baseline_mod
+from opensearch_tpu.lint.core import lint_paths, lint_source
+from opensearch_tpu.lint.rules import ALL_CHECKERS, RULES
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
+BASELINE = REPO / "lint_baseline.json"
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*(TPU\d{3})")
+
+
+def expected(fixture: Path) -> list[tuple[int, str]]:
+    out = []
+    for lineno, text in enumerate(fixture.read_text().splitlines(), start=1):
+        for m in _EXPECT_RE.finditer(text):
+            out.append((lineno, m.group(1)))
+    return sorted(out)
+
+
+def actual(fixture: Path) -> list[tuple[int, str]]:
+    violations = lint_source(str(fixture), fixture.read_text(), ALL_CHECKERS)
+    return sorted((v.line, v.rule) for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: exact rule ids and line numbers
+# ---------------------------------------------------------------------------
+
+BAD_FIXTURES = sorted(FIXTURES.glob("tpu*_bad.py"))
+GOOD_FIXTURES = sorted(FIXTURES.glob("tpu*_good.py")) + [
+    FIXTURES / "tpu004_unscoped.py"]
+
+
+def test_every_rule_has_fixture_coverage():
+    bad_rules = {r for f in BAD_FIXTURES for _, r in expected(f)}
+    good_names = {f.name.split("_")[0].upper() for f in GOOD_FIXTURES}
+    for rule_id in RULES:
+        assert rule_id in bad_rules, f"{rule_id} has no true-positive fixture"
+        assert rule_id in good_names, f"{rule_id} has no FP-guard fixture"
+
+
+@pytest.mark.parametrize("fixture", BAD_FIXTURES, ids=lambda p: p.name)
+def test_bad_fixture_flags_exact_lines(fixture):
+    want = expected(fixture)
+    assert want, f"{fixture.name} has no EXPECT annotations"
+    assert actual(fixture) == want
+
+
+@pytest.mark.parametrize("fixture", GOOD_FIXTURES, ids=lambda p: p.name)
+def test_good_fixture_is_clean(fixture):
+    assert actual(fixture) == []
+
+
+def test_suppression_comment_silences_the_line():
+    fixture = FIXTURES / "suppressed.py"
+    assert actual(fixture) == []
+    # sanity: without the comment the same code IS a violation
+    stripped = fixture.read_text().replace("# tpulint: disable=TPU005", "")
+    violations = lint_source(str(fixture), stripped, ALL_CHECKERS)
+    assert [(v.rule) for v in violations] == ["TPU005"]
+
+
+def test_syntax_error_reports_tpu000():
+    violations = lint_source("broken.py", "def broken(:\n", ALL_CHECKERS)
+    assert [v.rule for v in violations] == ["TPU000"]
+
+
+def test_nested_async_def_reports_once():
+    src = ("import time\n"
+           "async def outer():\n"
+           "    async def inner():\n"
+           "        time.sleep(1)\n")
+    violations = lint_source("x.py", src, ALL_CHECKERS)
+    assert [(v.line, v.rule) for v in violations] == [(4, "TPU002")]
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet semantics
+# ---------------------------------------------------------------------------
+
+def _fake_violations(n, path="pkg/mod.py", rule="TPU005"):
+    from opensearch_tpu.lint.core import Violation
+
+    return [Violation(rule, path, line, 1, "swallowed") for line in range(1, n + 1)]
+
+
+def test_baseline_allows_existing_blocks_new():
+    baseline = {"pkg/mod.py": {"TPU005": 2}}
+    assert baseline_mod.compare(_fake_violations(2), baseline) == []
+    regressions = baseline_mod.compare(_fake_violations(3), baseline)
+    assert [(r.path, r.rule, r.count, r.allowed) for r in regressions] == [
+        ("pkg/mod.py", "TPU005", 3, 2)]
+
+
+def test_baseline_never_tolerates_parse_errors():
+    baseline = {"pkg/mod.py": {"TPU000": 5}}
+    regressions = baseline_mod.compare(
+        _fake_violations(1, rule="TPU000"), baseline)
+    assert len(regressions) == 1
+
+
+def test_baseline_reports_stale_entries_for_ratcheting():
+    baseline = {"pkg/mod.py": {"TPU005": 4}, "gone.py": {"TPU003": 1}}
+    stale = baseline_mod.stale_entries(_fake_violations(2), baseline)
+    assert {(s.path, s.rule, s.count, s.allowed) for s in stale} == {
+        ("pkg/mod.py", "TPU005", 2, 4), ("gone.py", "TPU003", 0, 1)}
+
+
+def test_baseline_write_load_round_trip(tmp_path):
+    target = tmp_path / "baseline.json"
+    baseline_mod.write_baseline(str(target), _fake_violations(3))
+    assert baseline_mod.load_baseline(str(target)) == {
+        "pkg/mod.py": {"TPU005": 3}}
+
+
+# ---------------------------------------------------------------------------
+# the repo-wide gate: tier-1 fails if the tree regresses past the baseline
+# ---------------------------------------------------------------------------
+
+def test_repo_is_clean_against_committed_baseline(monkeypatch):
+    # baseline keys are repo-root-relative; pin cwd so running pytest from
+    # elsewhere can't skew path normalization
+    monkeypatch.chdir(REPO)
+    t0 = time.monotonic()
+    violations, files_checked = lint_paths([str(REPO / "opensearch_tpu")])
+    elapsed = time.monotonic() - t0
+    assert files_checked > 90
+    baseline = baseline_mod.load_baseline(str(BASELINE))
+    regressions = baseline_mod.compare(violations, baseline)
+    assert regressions == [], (
+        "new lint violations past lint_baseline.json:\n"
+        + "\n".join(r.render() for r in regressions))
+    # ISSUE 2 budget: single pass over the full tree in well under 10s
+    assert elapsed < 10.0, f"lint took {elapsed:.1f}s (budget 10s)"
+
+
+def test_linter_lints_its_own_source_clean():
+    violations, files_checked = lint_paths(
+        [str(REPO / "opensearch_tpu" / "lint")])
+    assert files_checked >= 5
+    assert violations == [], "\n".join(v.render() for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# CLI round-trip
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "opensearch_tpu.lint", *args],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120)
+
+
+def test_cli_json_round_trip_on_bad_fixture():
+    proc = _run_cli(str(FIXTURES / "tpu005_bad.py"),
+                    "--format", "json", "--no-baseline")
+    assert proc.returncode == 1, proc.stderr
+    report = json.loads(proc.stdout)
+    assert set(report) >= {"version", "files_checked", "elapsed_seconds",
+                           "baseline", "total_violations", "violations",
+                           "regressions", "new_violations",
+                           "stale_baseline_entries"}
+    assert report["files_checked"] == 1
+    assert report["baseline"] is None
+    got = sorted((v["line"], v["rule"]) for v in report["violations"])
+    assert got == expected(FIXTURES / "tpu005_bad.py")
+    for v in report["violations"]:
+        assert set(v) == {"rule", "path", "line", "col", "message"}
+
+
+def test_cli_exit_zero_on_clean_fixture():
+    proc = _run_cli(str(FIXTURES / "tpu005_good.py"),
+                    "--format", "json", "--no-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["total_violations"] == 0
+
+
+def test_cli_repo_gate_exits_zero_with_committed_baseline():
+    proc = _run_cli("opensearch_tpu", "--baseline", str(BASELINE))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_repo_gate_passes_from_any_cwd(tmp_path):
+    # baseline keys anchor to the repo root, not cwd
+    proc = subprocess.run(
+        [sys.executable, "-m", "opensearch_tpu.lint",
+         str(REPO / "opensearch_tpu")],
+        capture_output=True, text=True, cwd=str(tmp_path), timeout=120,
+        env={**__import__("os").environ, "PYTHONPATH": str(REPO)})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_rejects_missing_paths_and_partial_baseline_write(tmp_path):
+    proc = _run_cli(str(REPO / "no_such_dir"))
+    assert proc.returncode == 2
+    proc = _run_cli(str(FIXTURES / "tpu005_bad.py"),
+                    "--rules", "TPU005", "--write-baseline",
+                    "--baseline", str(tmp_path / "b.json"))
+    assert proc.returncode == 2
+    assert not (tmp_path / "b.json").exists()
+
+
+def test_cli_rule_filter_and_catalog():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule_id in RULES:
+        assert rule_id in proc.stdout
+    proc = _run_cli(str(FIXTURES / "tpu005_bad.py"),
+                    "--rules", "TPU001", "--no-baseline")
+    assert proc.returncode == 0  # TPU005 findings filtered out
+    proc = _run_cli(str(FIXTURES / "tpu005_bad.py"),
+                    "--rules", "TPU999", "--no-baseline")
+    assert proc.returncode == 2
