@@ -48,8 +48,10 @@ import time
 from pathlib import Path
 
 CACHE = Path(__file__).resolve().parent / "BENCH_CACHE.json"
+PROFILE_OUT = Path(__file__).resolve().parent / "BENCH_PROFILE.json"
 BUDGET_S = int(os.environ.get("BENCH_BUDGET_S", "1100"))
 PROBE_S = int(os.environ.get("BENCH_PROBE_S", "90"))
+PROFILE_BUDGET_S = int(os.environ.get("BENCH_PROFILE_BUDGET_S", "600"))
 
 
 def _load_cache() -> dict:
@@ -166,6 +168,101 @@ def parent() -> int:
         return 1
     print(json.dumps(out))
     return 0
+
+
+def profile_parent() -> int:
+    """`bench.py --profile`: run ONE profiled query per workload in a
+    child (same subprocess watchdog scheme as the QPS bench) and write the
+    kernel-time/transfer-bytes breakdown to BENCH_PROFILE.json next to the
+    BENCH json — future perf PRs diff this file to attribute regressions
+    to a kernel, a transfer, or a retrace."""
+    result, reason = _run(["--profile-child"], PROFILE_BUDGET_S)
+    if result is None:
+        print(json.dumps({
+            "metric": "bench_error", "value": 0, "unit": "error",
+            "vs_baseline": 0, "detail": f"profile child failed: {reason}",
+        }))
+        return 1
+    try:
+        PROFILE_OUT.write_text(json.dumps(result, indent=1) + "\n")
+    except OSError as e:
+        result["write_error"] = str(e)
+    print(json.dumps(result))
+    return 0
+
+
+def profile_child() -> None:
+    """Build a small two-workload corpus (BM25 text + exact kNN vectors)
+    through the real node API and run one `"profile": true` search per
+    workload; emit the per-workload device-time/transfer/retrace rollup."""
+    import tempfile
+
+    _pin_platform()
+    from opensearch_tpu.node import TpuNode
+
+    d, n_docs = 64, 3_000
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    node = TpuNode(Path(tempfile.mkdtemp(prefix="bench_profile_")))
+    node.create_index("bench", {"mappings": {"properties": {
+        "msg": {"type": "text"},
+        "v": {"type": "knn_vector", "dimension": d},
+    }}})
+    words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]
+    for i in range(n_docs):
+        node.index_doc("bench", str(i), {
+            "msg": " ".join(rng.choice(words, 5).tolist()),
+            "v": rng.standard_normal(d).astype(np.float32).tolist(),
+        })
+    node.refresh("bench")
+
+    workloads = {
+        "bm25_match": {"query": {"match": {"msg": "alpha beta"}}},
+        "exact_knn": {"query": {"knn": {"v": {
+            "vector": rng.standard_normal(d).astype(np.float32).tolist(),
+            "k": 10,
+        }}}},
+    }
+    out_workloads = {}
+    for name, body in workloads.items():
+        # warm pass first so the recorded run reflects steady state; the
+        # warm pass's retrace flag is reported separately
+        warm = node.search("bench", {**body, "profile": True})
+        cold_shard = warm["profile"]["shards"][0]
+        resp = node.search("bench", {**body, "profile": True})
+        shard = resp["profile"]["shards"][0]
+        kernels: dict[str, dict] = {}
+
+        def walk(ops):
+            for op in ops:
+                for k in op.get("kernels", []):
+                    cell = kernels.setdefault(k["name"], {
+                        "calls": 0, "time_in_nanos": 0, "transfer_bytes": 0})
+                    cell["calls"] += k["calls"]
+                    cell["time_in_nanos"] += k["time_in_nanos"]
+                    cell["transfer_bytes"] += k["transfer_bytes"]
+                walk(op.get("children", []))
+
+        walk(shard["searches"][0]["query"])
+        out_workloads[name] = {
+            "took_ms": resp["took"],
+            "tpu": shard["tpu"],
+            "cold_tpu": cold_shard["tpu"],
+            "kernels": kernels,
+        }
+    import jax
+
+    print(json.dumps({
+        "metric": "profile_breakdown",
+        "value": sum(w["tpu"]["device_time_in_nanos"]
+                     for w in out_workloads.values()),
+        "unit": "device_nanos_total",
+        "vs_baseline": 1.0,
+        "platform": jax.devices()[0].platform,
+        "corpus": {"docs": n_docs, "dim": d},
+        "workloads": out_workloads,
+    }))
 
 
 def _pin_platform():
@@ -320,6 +417,18 @@ def child() -> None:
 
 
 if __name__ == "__main__":
+    if "--profile-child" in sys.argv:
+        try:
+            profile_child()
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "metric": "bench_error", "value": 0, "unit": "error",
+                "vs_baseline": 0, "detail": str(e)[:200],
+            }))
+            sys.exit(1)
+        sys.exit(0)
+    if "--profile" in sys.argv:
+        sys.exit(profile_parent())
     if "--probe" in sys.argv:
         try:
             probe()

@@ -49,6 +49,7 @@ from opensearch_tpu.cluster.state import (
 from opensearch_tpu.index.mapper import MapperService
 from opensearch_tpu.index.shard import IndexShard, ShardId
 from opensearch_tpu.search import query_dsl
+from opensearch_tpu.telemetry import tracing
 
 
 def _wall_ms() -> int:
@@ -78,6 +79,12 @@ class ClusterNode:
         self.transport = transport
         self.scheduler = scheduler
         self.node = DiscoveryNode(node_id=node_id, name=node_id, roles=roles)
+        # per-node telemetry: spans land in THIS node's ring (the tracer
+        # name prefixes span ids so traces stitched across sim nodes stay
+        # unambiguous); trace ids ride transport headers between nodes
+        from opensearch_tpu.telemetry.tracing import Telemetry
+
+        self.telemetry = Telemetry(name=node_id)
         # fs stats feeding the disk-threshold decider; tests override
         # disk_usage_pct directly (the FsHealthService probe analog)
         self.disk_usage_pct: float | None = None
@@ -101,6 +108,7 @@ class ClusterNode:
             # in the state being published
             state_transform=transform,
         )
+        self.coordinator.tracer = self.telemetry.tracer
         self.coordinator.check_extras = lambda: {
             "disk_used_pct": self._disk_usage()
         }
@@ -454,16 +462,36 @@ class ClusterNode:
         old = self._recovery_drivers.pop((index, shard), None)
         if old is not None:
             old.cancel()
+        # target-side root span for this recovery attempt: every chunk,
+        # retry and finalize request joins its trace (a retried attempt is
+        # a FRESH span/trace — each attempt's tree stays self-consistent)
+        rec_span = self.telemetry.tracer.begin_span(
+            "recovery.target",
+            {"index": index, "shard": shard, "node": self.node_id,
+             "source": primary.node_id, "type": progress.recovery_type},
+        )
+        rec_trace = {"trace_id": rec_span.trace_id,
+                     "span_id": rec_span.span_id}
+        span_open = [True]
+
+        def finish_span(outcome: str) -> None:
+            if span_open[0]:
+                span_open[0] = False
+                rec_span.set_attribute("outcome", outcome)
+                self.telemetry.tracer.end_span(rec_span)
+
         driver = RecoveryTargetDriver(
             self.transport, self.scheduler, self.node_id, primary.node_id,
-            index, shard, progress,
+            index, shard, progress, trace=rec_trace,
         )
         self._recovery_drivers[(index, shard)] = driver
 
         def fail_and_retry(_e: Exception | None = None) -> None:
             if driver.cancelled:
+                finish_span("cancelled")
                 return
             progress.failed()
+            finish_span("failed")
             if self._recovery_drivers.get((index, shard)) is driver:
                 self._recovery_drivers.pop((index, shard), None)
             self.scheduler.schedule(
@@ -475,12 +503,14 @@ class ClusterNode:
                 # superseded mid-install (shard evicted/recreated): the
                 # fresh driver owns the shard's fate — marking recovery_done
                 # here would report a possibly-empty copy as STARTED
+                finish_span("cancelled")
                 return
             lcl = self.local_shards.get((index, shard))
             if lcl is not None:
                 lcl.recovery_done = True
                 lcl.recovery_inflight = False
             progress.done()
+            finish_span("done")
             if self._recovery_drivers.get((index, shard)) is driver:
                 self._recovery_drivers.pop((index, shard), None)
             self._report_shard_started(index, shard)
@@ -516,20 +546,22 @@ class ClusterNode:
             else:
                 fail_and_retry()
 
-        self.transport.send(
-            self.node_id, primary.node_id, "internal:index/shard/recovery/start",
-            {"index": index, "shard": shard, "target": self.node_id,
-             # the target's recovered-from-disk progress: with a valid
-             # retention lease the source answers with an OPS-ONLY replay
-             # from here instead of a segment copy
-             "local_checkpoint": (
-                 local.engine.local_checkpoint if local is not None else -1
-             )},
-            on_response=on_manifest,
-            on_failure=fail_and_retry,
-            # the manifest itself is small; the bulk ships as chunks
-            timeout_ms=60_000,
-        )
+        with tracing.restore_trace_context(rec_trace):
+            self.transport.send(
+                self.node_id, primary.node_id,
+                "internal:index/shard/recovery/start",
+                {"index": index, "shard": shard, "target": self.node_id,
+                 # the target's recovered-from-disk progress: with a valid
+                 # retention lease the source answers with an OPS-ONLY replay
+                 # from here instead of a segment copy
+                 "local_checkpoint": (
+                     local.engine.local_checkpoint if local is not None else -1
+                 )},
+                on_response=on_manifest,
+                on_failure=fail_and_retry,
+                # the manifest itself is small; the bulk ships as chunks
+                timeout_ms=60_000,
+            )
 
     def _recover_from_ops(self, index: str, shard: int, resp: dict,
                           progress, succeed, fail) -> None:
@@ -658,7 +690,16 @@ class ClusterNode:
                 self._start_replica_recovery(index, shard, self.applied_state)
 
     def _on_start_recovery(self, sender: str, payload: dict):
-        return self._offload(lambda: self._start_recovery_local(payload))
+        def run() -> dict:
+            with tracing.activate(self.telemetry.tracer), \
+                    self.telemetry.tracer.start_span("recovery.source_start", {
+                        "index": payload["index"],
+                        "shard": payload["shard"],
+                        "target": payload.get("target"),
+                        "node": self.node_id}):
+                return self._start_recovery_local(payload)
+
+        return self._offload(run)
 
     def _start_recovery_local(self, payload: dict) -> dict:
         """Primary-side recovery source. OPS-BASED fast path first
@@ -769,53 +810,70 @@ class ClusterNode:
 
     def _on_recovery_file_chunk(self, sender: str, payload: dict):
         def run() -> dict:
-            key = (payload["index"], payload["shard"], payload["target"])
-            session = self._recovery_sources.get(*key)
-            if session is None:
-                raise OpenSearchTpuException(
-                    f"no recovery session for [{payload['index']}]"
-                    f"[{payload['shard']}] -> {payload['target']}"
-                )
-            name = payload["name"]
-            if name not in session["blobs"]:
-                host = (session.get("hosts") or {}).get(name)
-                if host is None:
-                    raise OpenSearchTpuException(
-                        f"segment [{name}] not in recovery session"
-                    )
-                from opensearch_tpu.index.segment import pack_segment
-
-                # pack lazily, once; retried chunks re-read the same bytes
-                session["blobs"][name] = pack_segment(host)
-            from opensearch_tpu.index.recovery import DEFAULT_CHUNK_BYTES
-
-            return self._recovery_sources.file_chunk(
-                payload["index"], payload["shard"], payload["target"],
-                name, int(payload.get("offset", 0)),
-                int(payload.get("length") or 0) or DEFAULT_CHUNK_BYTES,
-            )
+            with tracing.activate(self.telemetry.tracer), \
+                    self.telemetry.tracer.start_span("recovery.file_chunk", {
+                        "index": payload["index"],
+                        "shard": payload["shard"],
+                        "name": payload.get("name"),
+                        "offset": payload.get("offset", 0),
+                        "node": self.node_id}):
+                return self._file_chunk_local(payload)
 
         return self._offload(run)
 
-    def _on_recovery_ops_chunk(self, sender: str, payload: dict) -> dict:
-        try:
-            return self._recovery_sources.ops_batch(
-                payload["index"], payload["shard"], payload["target"],
-                int(payload.get("from", 0)),
-                int(payload.get("size", 0) or 500),
+    def _file_chunk_local(self, payload: dict) -> dict:
+        key = (payload["index"], payload["shard"], payload["target"])
+        session = self._recovery_sources.get(*key)
+        if session is None:
+            raise OpenSearchTpuException(
+                f"no recovery session for [{payload['index']}]"
+                f"[{payload['shard']}] -> {payload['target']}"
             )
-        except KeyError as e:
-            raise OpenSearchTpuException(str(e)) from e
+        name = payload["name"]
+        if name not in session["blobs"]:
+            host = (session.get("hosts") or {}).get(name)
+            if host is None:
+                raise OpenSearchTpuException(
+                    f"segment [{name}] not in recovery session"
+                )
+            from opensearch_tpu.index.segment import pack_segment
+
+            # pack lazily, once; retried chunks re-read the same bytes
+            session["blobs"][name] = pack_segment(host)
+        from opensearch_tpu.index.recovery import DEFAULT_CHUNK_BYTES
+
+        return self._recovery_sources.file_chunk(
+            payload["index"], payload["shard"], payload["target"],
+            name, int(payload.get("offset", 0)),
+            int(payload.get("length") or 0) or DEFAULT_CHUNK_BYTES,
+        )
+
+    def _on_recovery_ops_chunk(self, sender: str, payload: dict) -> dict:
+        with tracing.activate(self.telemetry.tracer), \
+                self.telemetry.tracer.start_span("recovery.ops_chunk", {
+                    "index": payload["index"], "shard": payload["shard"],
+                    "from": payload.get("from", 0), "node": self.node_id}):
+            try:
+                return self._recovery_sources.ops_batch(
+                    payload["index"], payload["shard"], payload["target"],
+                    int(payload.get("from", 0)),
+                    int(payload.get("size", 0) or 500),
+                )
+            except KeyError as e:
+                raise OpenSearchTpuException(str(e)) from e
 
     def _on_recovery_finalize(self, sender: str, payload: dict) -> dict:
         """Seqno handoff: report the primary's max_seq_no so the target can
         verify it caught up before the routing swap; the chunk session is
         done (fan-out to the tracked target carries everything newer)."""
-        shard = self._local_shard(payload["index"], payload["shard"])
-        self._recovery_sources.close(
-            payload["index"], payload["shard"], payload["target"]
-        )
-        return {"max_seq_no": shard.engine.max_seq_no}
+        with self.telemetry.tracer.start_span("recovery.finalize", {
+                "index": payload["index"], "shard": payload["shard"],
+                "target": payload.get("target"), "node": self.node_id}):
+            shard = self._local_shard(payload["index"], payload["shard"])
+            self._recovery_sources.close(
+                payload["index"], payload["shard"], payload["target"]
+            )
+            return {"max_seq_no": shard.engine.max_seq_no}
 
     def _on_node_recovery(self, sender: str, payload: dict) -> dict:
         """Per-node recovery progress records (RecoveryState collection
@@ -1697,22 +1755,44 @@ class ClusterNode:
             return
         results: dict[int, dict] = {}
         remaining = [len(targets)]
+        tracer = self.telemetry.tracer
+        # coordinator ROOT span covers the whole distributed operation —
+        # begin_span/end_span because responses arrive in later scheduled
+        # callbacks where the lexical scope is long gone (same recipe as
+        # the recovery.target root)
+        root = tracer.begin_span(
+            "search.coordinator",
+            {"index": index, "node": self.node_id, "shards": len(targets)},
+        )
+        ctx = {"trace_id": root.trace_id, "span_id": root.span_id}
 
         def one_result(shard_num: int):
             def handle(resp: dict) -> None:
                 results[shard_num] = resp
                 remaining[0] -= 1
                 if remaining[0] == 0:
-                    callback(self._merge_search_results(results, size, from_, sort))
+                    # re-enter the trace so coordinator -> shard -> reduce
+                    # share one trace_id
+                    with tracing.restore_trace_context(ctx), \
+                            tracer.start_span("search.reduce", {
+                                "index": index, "node": self.node_id,
+                                "shards": len(results)}):
+                        merged = self._merge_search_results(
+                            results, size, from_, sort)
+                    tracer.end_span(root)
+                    callback(merged)
             return handle
 
-        for shard_num, r in sorted(targets.items()):
-            self.transport.send(
-                self.node_id, r.node_id, "indices:data/read/search[shard]",
-                {"index": index, "shard": shard_num, "body": body},
-                on_response=one_result(shard_num),
-                on_failure=one_result(shard_num),  # surfaces as missing shard
-            )
+        # the fan-out sends capture the root context, so the per-shard
+        # handler spans on remote nodes parent under it
+        with tracing.restore_trace_context(ctx):
+            for shard_num, r in sorted(targets.items()):
+                self.transport.send(
+                    self.node_id, r.node_id, "indices:data/read/search[shard]",
+                    {"index": index, "shard": shard_num, "body": body},
+                    on_response=one_result(shard_num),
+                    on_failure=one_result(shard_num),  # missing shard
+                )
 
     # -- per-node search partials (the QuerySearchResult wire analog) -------
 
@@ -1732,10 +1812,16 @@ class ClusterNode:
                 max_workers=1, thread_name_prefix=f"{self.node_id}-data"
             )
         deferred = DeferredResponse()
+        # carry the contextvars context (restored trace context, active
+        # tracer) onto the worker thread so spans opened by offloaded work
+        # stitch into the caller's trace (same recipe as rest/http.py)
+        import contextvars as _cv
+
+        ctx = _cv.copy_context()
 
         def run() -> None:
             try:
-                result = fn()
+                result = ctx.run(fn)
             except Exception as e:  # noqa: BLE001 - travels back as error
                 loop.call_soon_threadsafe(deferred.set_exception, e)
             else:
@@ -1762,10 +1848,14 @@ class ClusterNode:
         def run() -> dict:
             from opensearch_tpu.search import service as search_service
 
-            resp = search_service.search(
-                shards, body, acquired=snaps, partial=True,
-                shard_numbers=nums,
-            )
+            with tracing.activate(self.telemetry.tracer), \
+                    self.telemetry.tracer.start_span("search.node_partial", {
+                        "index": index, "node": self.node_id,
+                        "shards": len(nums)}):
+                resp = search_service.search(
+                    shards, body, acquired=snaps, partial=True,
+                    shard_numbers=nums,
+                )
             if keep:
                 # register only on success — a failed first search must not
                 # leak a context whose id never reaches the coordinator
@@ -1861,10 +1951,14 @@ class ClusterNode:
         def run() -> dict:
             from opensearch_tpu.search import service as search_service
 
-            return search_service.search(
-                shards, body, acquired=snaps, partial=True,
-                shard_numbers=nums,
-            )
+            with tracing.activate(self.telemetry.tracer), \
+                    self.telemetry.tracer.start_span("search.node_partial", {
+                        "index": ctx["index"], "node": self.node_id,
+                        "shards": len(nums), "pinned": True}):
+                return search_service.search(
+                    shards, body, acquired=snaps, partial=True,
+                    shard_numbers=nums,
+                )
 
         return self._offload(run)
 
@@ -1930,11 +2024,25 @@ class ClusterNode:
         return {"shards": out}
 
     def _on_shard_search(self, sender: str, payload: dict):
-        return self._offload(lambda: self._shard_search_local(payload))
+        def run() -> dict:
+            # shard query-phase span: the transport restored the sender's
+            # trace context, so this parents under the coordinator span
+            with tracing.activate(self.telemetry.tracer), \
+                    self.telemetry.tracer.start_span("search.shard_query", {
+                        "index": payload["index"],
+                        "shard": payload["shard"],
+                        "node": self.node_id}):
+                return self._shard_search_local(payload)
+
+        return self._offload(run)
 
     def _shard_search_local(self, payload: dict) -> dict:
         """Per-shard query+fetch (the combined phase; split q/f is the
-        optimization path). Returns hits with _id/_score/_source."""
+        optimization path). Returns hits with _id/_score/_source; with
+        `"profile": true` a deep per-operator profile entry rides along
+        (device kernel time, transfer bytes, retrace flag)."""
+        from opensearch_tpu.search import profile as search_profile
+
         shard = self._local_shard(payload["index"], payload["shard"])
         body = payload.get("body") or {}
         node = query_dsl.parse_query(body.get("query"))
@@ -1943,10 +2051,13 @@ class ClusterNode:
         if isinstance(sort, (str, dict)):
             sort = [sort]
         snapshot = shard.acquire_searcher()
-        result = execute_query_phase(
-            snapshot, shard.mapper_service, node, size=size,
-            sort=sort,
-        )
+        prof = (search_profile.ShardProfiler()
+                if body.get("profile") else None)
+        with search_profile.profiling(prof):
+            result = execute_query_phase(
+                snapshot, shard.mapper_service, node, size=size,
+                sort=sort,
+            )
         src_filter = _source_filter(body.get("_source", True))
         hits = []
         for h in result.hits:
@@ -1959,8 +2070,24 @@ class ClusterNode:
             if h.sort_values:
                 hit["sort"] = h.sort_values
             hits.append(hit)
-        return {"total": result.total, "hits": hits,
-                "max_score": result.max_score}
+        out = {"total": result.total, "hits": hits,
+               "max_score": result.max_score}
+        if prof is not None:
+            out["profile"] = {
+                "id": f"[{payload['index']}][{payload['shard']}]",
+                "searches": [{
+                    "query": prof.query_entries(),
+                    "rewrite_time": prof.rewrite_ns,
+                    "collector": [{
+                        "name": "SimpleTopDocsCollector",
+                        "reason": "search_top_hits",
+                        "time_in_nanos": prof.collect_ns,
+                    }],
+                }],
+                "tpu": prof.tpu_summary(),
+                "aggregations": [],
+            }
+        return out
 
     def _merge_search_results(
         self, results: dict[int, dict], size: int,
@@ -1970,6 +2097,7 @@ class ClusterNode:
         max_score = None
         merged = []
         failed = 0
+        profile_shards = []
         for shard_num in sorted(results):
             resp = results[shard_num]
             if not isinstance(resp, dict) or "hits" not in resp:
@@ -1980,6 +2108,8 @@ class ClusterNode:
                 max_score is None or resp["max_score"] > max_score
             ):
                 max_score = resp["max_score"]
+            if "profile" in resp:
+                profile_shards.append(resp["profile"])
             for h in resp["hits"]:
                 merged.append((shard_num, h))
         if sort:
@@ -1993,7 +2123,7 @@ class ClusterNode:
             )
         else:
             merged.sort(key=lambda sh: (-(sh[1]["_score"] or 0.0), sh[0], sh[1]["_id"]))
-        return {
+        out = {
             "took": 0,
             "timed_out": False,
             "_shards": {"total": len(results), "successful": len(results) - failed,
@@ -2004,6 +2134,12 @@ class ClusterNode:
                 "hits": [h for _, h in merged[from_: from_ + size]],
             },
         }
+        if profile_shards:
+            # per-shard profiles merge into the standard response shape
+            # (each data node already built its shard entry)
+            out["profile"] = {"shards": sorted(
+                profile_shards, key=lambda s: s.get("id", ""))}
+        return out
 
     def close(self) -> None:
         self._closed = True

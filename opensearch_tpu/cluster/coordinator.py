@@ -73,6 +73,10 @@ class Coordinator:
         self.peers = [p for p in peers if p != node.node_id]
         self.transport = transport
         self.scheduler = scheduler
+        # set by the node layer (ClusterNode) to its per-node tracer;
+        # publication fan-outs open spans on it so state propagation is
+        # traceable like any other distributed operation
+        self.tracer = None
         self.coord = CoordinationState(node.node_id, persisted)
         self.mode = Mode.CANDIDATE
         self.leader_id: str | None = None
@@ -427,11 +431,23 @@ class Coordinator:
                     self._send_commits(commit, state, targets, acked_commit, commit_sent)
             return handle
 
-        for peer in targets:
-            self.transport.send(
-                self.node_id, peer, "coordination/publish", payload,
-                on_response=on_response(peer), on_failure=lambda e: None,
-            )
+        from opensearch_tpu.telemetry.tracing import default_telemetry
+
+        tracer = self.tracer or default_telemetry.tracer
+        # NOTE: this span measures the publish DISPATCH (acceptance and
+        # commit land in later callbacks); its value is the trace id the
+        # follower-side handlers stitch under, not its duration
+        with tracer.start_span("coordination.publish", {
+            "node": self.node_id, "term": state.term,
+            "version": state.version, "targets": len(targets),
+        }):
+            # sends capture this span's context: the publish/commit
+            # handlers' work on followers stitches into one trace
+            for peer in targets:
+                self.transport.send(
+                    self.node_id, peer, "coordination/publish", payload,
+                    on_response=on_response(peer), on_failure=lambda e: None,
+                )
         # publication timeout: give up and allow the next one. The seq guard
         # keeps a stale timer from an earlier publication from aborting a
         # later in-flight one.

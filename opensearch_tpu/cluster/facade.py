@@ -29,7 +29,6 @@ import base64
 import json
 import logging
 import threading
-import uuid
 from concurrent.futures import Future
 from typing import Any, Callable
 
@@ -112,6 +111,10 @@ class ClusterFacade:
         from opensearch_tpu.tasks.manager import TaskManager
 
         self.task_manager = TaskManager(cluster_node.node_id)
+        # one telemetry per node process: facade (coordinator role) spans
+        # and the data-plane handler spans share this node's ring, so
+        # _nodes/stats and /_prometheus/metrics see both
+        self.telemetry = cluster_node.telemetry
         from opensearch_tpu.index.request_cache import RequestCache
 
         self.request_cache = RequestCache()
@@ -331,6 +334,13 @@ class ClusterFacade:
     # documents
     # ------------------------------------------------------------------ #
 
+    def _auto_id(self) -> str:
+        """Auto document ids draw from the node's scheduler RNG — the
+        injectable entropy source (seeded under the deterministic sim,
+        time-seeded by LoopScheduler in production). uuid4/os.urandom
+        would defeat sim replayability (tpulint TPU006)."""
+        return "%020x" % self.node.scheduler.random.getrandbits(80)
+
     def index_doc(self, index: str, doc_id: str | None, source: dict,
                   routing: str | None = None, if_seq_no: int | None = None,
                   refresh: bool = False, op_type: str | None = None,
@@ -347,7 +357,7 @@ class ClusterFacade:
         if version is not None:
             self._unsupported("explicit document versions in cluster mode")
         if doc_id is None:
-            doc_id = uuid.uuid4().hex[:20]
+            doc_id = self._auto_id()
         resp = self._on_loop(lambda cb: self.node.index_doc(
             index, doc_id, source, cb, routing=routing,
             if_seq_no=if_seq_no, op_type=op_type,
@@ -455,7 +465,7 @@ class ClusterFacade:
         for action, meta, source in operations:
             meta = dict(meta)
             if action in ("index", "create") and not meta.get("_id"):
-                meta["_id"] = uuid.uuid4().hex[:20]
+                meta["_id"] = self._auto_id()
             ops.append((action, meta, source))
         resp = self._on_loop(lambda cb: self.node.bulk(ops, cb))
         if refresh:
@@ -561,16 +571,35 @@ class ClusterFacade:
         node_body["size"] = from_ + size
         node_body["track_total_hits"] = True  # coordinator applies the cap
         assignments = self._node_assignments(names)
-        partials = self._rpc_many([
-            (nid, "indices:data/read/search[node]",
-             {"index": idx, "shards": nums, "body": node_body,
-              "keep_context": keep, "keep_alive_ms": keep_alive_ms})
-            for nid, idx, nums in assignments
-        ])
-        self._raise_partial_errors(partials)
-        resp = reduce_search_responses(
-            body, partials, size=size, from_=from_, track_total=track_total
-        )
+        from opensearch_tpu.telemetry import tracing
+
+        tracer = self.telemetry.tracer
+        with tracing.activate(tracer), tracer.start_span(
+            "search.coordinator",
+            {"indices": ",".join(names), "node": self.node_name,
+             "fanout": len(assignments)},
+        ):
+            # the per-node RPCs capture this span's context
+            # (call_soon_threadsafe copies the executor thread's context)
+            partials = self._rpc_many([
+                (nid, "indices:data/read/search[node]",
+                 {"index": idx, "shards": nums, "body": node_body,
+                  "keep_context": keep, "keep_alive_ms": keep_alive_ms})
+                for nid, idx, nums in assignments
+            ])
+            self._raise_partial_errors(partials)
+            with tracer.start_span("search.reduce", {
+                "node": self.node_name, "partials": len(partials),
+            }):
+                resp = reduce_search_responses(
+                    body, partials, size=size, from_=from_,
+                    track_total=track_total
+                )
+        # same request metrics the single-node path records, so
+        # /_prometheus/metrics is useful in cluster mode too
+        self.telemetry.metrics.counter("search.total").add(1)
+        self.telemetry.metrics.histogram("search.took_ms").record(
+            resp.get("took", 0))
         if keep:
             contexts = {
                 f"{nid}|{idx}": p["_ctx_id"]

@@ -46,22 +46,28 @@ class IndexingPressure:
             self.current_bytes = max(0, self.current_bytes - bytes_)
 
     def stats(self) -> dict:
+        # snapshot under the lock acquire()/_release() hold: the three
+        # counters must be mutually consistent in one stats read
+        with self._lock:
+            current = self.current_bytes
+            total = self.total_bytes
+            rejections = self.rejections
         return {
             "memory": {
                 "current": {
-                    "combined_coordinating_and_primary_in_bytes": self.current_bytes,
-                    "coordinating_in_bytes": self.current_bytes,
+                    "combined_coordinating_and_primary_in_bytes": current,
+                    "coordinating_in_bytes": current,
                     "primary_in_bytes": 0,
                     "replica_in_bytes": 0,
-                    "all_in_bytes": self.current_bytes,
+                    "all_in_bytes": current,
                 },
                 "total": {
-                    "combined_coordinating_and_primary_in_bytes": self.total_bytes,
-                    "coordinating_in_bytes": self.total_bytes,
+                    "combined_coordinating_and_primary_in_bytes": total,
+                    "coordinating_in_bytes": total,
                     "primary_in_bytes": 0,
                     "replica_in_bytes": 0,
-                    "all_in_bytes": self.total_bytes,
-                    "coordinating_rejections": self.rejections,
+                    "all_in_bytes": total,
+                    "coordinating_rejections": rejections,
                     "primary_rejections": 0,
                     "replica_rejections": 0,
                 },

@@ -210,7 +210,8 @@ class RecoveryTargetDriver:
                  *, chunk_bytes: int = DEFAULT_CHUNK_BYTES,
                  ops_batch: int = DEFAULT_OPS_BATCH,
                  max_retries: int = MAX_CHUNK_RETRIES,
-                 chunk_timeout_ms: int = 30_000):
+                 chunk_timeout_ms: int = 30_000,
+                 trace: dict | None = None):
         self.transport = transport
         self.scheduler = scheduler
         self.node_id = node_id
@@ -223,6 +224,11 @@ class RecoveryTargetDriver:
         self.max_retries = max_retries
         self.chunk_timeout_ms = chunk_timeout_ms
         self.cancelled = False
+        # the recovery's trace context ({"trace_id", "span_id"} of the
+        # target-side root span): every chunk/finalize request — retries
+        # included — re-enters it, so one recovery is ONE trace tree even
+        # across scheduler callbacks where contextvars don't survive
+        self.trace = trace
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -252,11 +258,14 @@ class RecoveryTargetDriver:
                 ),
             )
 
-        self.transport.send(
-            self.node_id, self.source_id, action, payload,
-            on_response=on_ok, on_failure=fail,
-            timeout_ms=self.chunk_timeout_ms,
-        )
+        from opensearch_tpu.telemetry.tracing import restore_trace_context
+
+        with restore_trace_context(self.trace):
+            self.transport.send(
+                self.node_id, self.source_id, action, payload,
+                on_response=on_ok, on_failure=fail,
+                timeout_ms=self.chunk_timeout_ms,
+            )
 
     # -- segment file streaming --------------------------------------------
 

@@ -634,6 +634,47 @@ class DeterminismChecker(Checker):
 
 
 # ---------------------------------------------------------------------------
+# TPU006 — injectable entropy in sim-run modules
+# ---------------------------------------------------------------------------
+
+# process-entropy id/byte sources: ids minted from these differ run to run,
+# so a replayed sim diverges (and a trace id can never be asserted against)
+_ENTROPY_CALLS = {
+    "uuid.uuid1", "uuid.uuid4", "os.urandom",
+    "secrets.token_bytes", "secrets.token_hex", "secrets.token_urlsafe",
+    "secrets.randbits", "secrets.randbelow", "secrets.choice",
+}
+
+
+class InjectableIdChecker(Checker):
+    rule_id = "TPU006"
+    name = "injectable-ids"
+    description = ("uuid.uuid4/os.urandom/secrets.* in modules that run "
+                   "under the deterministic sim — ids and entropy must come "
+                   "from an injectable source (the scheduler's seeded "
+                   "random.Random, the tracer's counter)")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        if _SIM_MARKER in source:
+            return True
+        return any(p in display_path for p in _SIM_MODULE_PATTERNS)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.canonical(call_name(node))
+            if name in _ENTROPY_CALLS:
+                out.append(ctx.violation(
+                    "TPU006", node,
+                    f"{name}() draws process entropy in a sim-run module; "
+                    "mint ids from an injectable source (scheduler.random, "
+                    "a seeded Random, or a per-node counter)"))
+        return out
+
+
+# ---------------------------------------------------------------------------
 # TPU005 — exception hygiene
 # ---------------------------------------------------------------------------
 
@@ -712,6 +753,7 @@ ALL_CHECKERS: list[Checker] = [
     LockDisciplineChecker(),
     DeterminismChecker(),
     ExceptionHygieneChecker(),
+    InjectableIdChecker(),
 ]
 
 RULES: dict[str, Checker] = {c.rule_id: c for c in ALL_CHECKERS}

@@ -3025,22 +3025,28 @@ class TpuNode:
             body = self.search_pipelines.transform_request(pl, body)
             if "_original_size" in body:
                 pl_ctx["_original_size"] = body.pop("_original_size")
-        with self.telemetry.tracer.start_span(
-            "search", {"indices": expr}
-        ) as span:
+        from opensearch_tpu.telemetry import tracing
+
+        # activate() scopes phase spans (can_match/rescore/collapse) to
+        # THIS node's ring; the slowlog call stays inside the span so its
+        # entry can carry the trace_id
+        with tracing.activate(self.telemetry.tracer), \
+                self.telemetry.tracer.start_span(
+                    "search", {"indices": expr}
+                ) as span:
             resp = search_service.search(
                 shards, body, acquired=acquired,
                 phase_results_config=pr_config,
                 shard_filters=shard_filters, task=task,
                 precomputed_results=precomputed_results,
             )
-        took = resp.get("took", 0)
-        span.set_attribute("took_ms", took)
+            took = resp.get("took", 0)
+            span.set_attribute("took_ms", took)
+            self.search_slowlog.maybe_log(
+                took, expr, json.dumps(body.get("query") or {})
+            )
         self.telemetry.metrics.counter("search.total").add(1)
         self.telemetry.metrics.histogram("search.took_ms").record(took)
-        self.search_slowlog.maybe_log(
-            took, expr, json.dumps(body.get("query") or {})
-        )
         if pl is not None:
             resp = self.search_pipelines.transform_response(
                 pl, {**body, **pl_ctx}, resp

@@ -23,6 +23,8 @@ import math
 
 import jax.numpy as jnp
 
+from opensearch_tpu.search.profile import profiled_kernel
+
 K1_DEFAULT = 1.2
 B_DEFAULT = 0.75
 
@@ -32,6 +34,7 @@ def idf(doc_freq: int, doc_count: int) -> float:
     return math.log(1.0 + (doc_count - doc_freq + 0.5) / (doc_freq + 0.5))
 
 
+@profiled_kernel("bm25_term_scores")
 def bm25_term_scores(
     postings_docs: jnp.ndarray,   # int32 [P_pad] flat CSR postings
     postings_tfs: jnp.ndarray,    # float32 [P_pad]
@@ -74,6 +77,7 @@ def bm25_term_scores(
     return scores, counts
 
 
+@profiled_kernel("constant_term_scores")
 def constant_term_scores(
     postings_docs: jnp.ndarray,
     offsets: jnp.ndarray,

@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from opensearch_tpu.search.profile import profiled_kernel
+
 L2 = "l2_norm"
 COSINE = "cosine"
 DOT = "dot_product"
@@ -35,7 +37,7 @@ def canonical_similarity(name: str) -> str:
     return sim
 
 
-def raw_similarity(
+def _raw_similarity(
     queries: jnp.ndarray,      # [B, d] float32
     vectors: jnp.ndarray,      # [n_pad, d] float32 (bf16 upcast upstream)
     norms_sq: jnp.ndarray,     # [n_pad] float32 precomputed ||v||^2
@@ -65,6 +67,11 @@ def raw_similarity(
     return dots  # DOT
 
 
+# public entry: profiled when called eagerly; exact_knn_scores uses the
+# bare _raw_similarity so its own kernel record doesn't double-count
+raw_similarity = profiled_kernel("knn_raw_similarity")(_raw_similarity)
+
+
 def knn_score(raw: jnp.ndarray, similarity: str) -> jnp.ndarray:
     """Map raw similarity to the OpenSearch k-NN plugin score space."""
     sim = canonical_similarity(similarity)
@@ -76,6 +83,7 @@ def knn_score(raw: jnp.ndarray, similarity: str) -> jnp.ndarray:
     return jnp.where(raw >= 0, raw + 1.0, 1.0 / (1.0 - raw))
 
 
+@profiled_kernel("knn_exact_scores")
 def exact_knn_scores(
     queries: jnp.ndarray,
     vectors: jnp.ndarray,
@@ -84,6 +92,6 @@ def exact_knn_scores(
     similarity: str,
 ) -> jnp.ndarray:
     """[B, n_pad] k-NN scores with invalid docs pushed to -inf."""
-    raw = raw_similarity(queries, vectors, norms_sq, similarity)
+    raw = _raw_similarity(queries, vectors, norms_sq, similarity)
     scores = knn_score(raw, similarity)
     return jnp.where(valid[None, :], scores, -jnp.inf)

@@ -301,6 +301,8 @@ def build_router() -> Router:
     reg("POST", "/_reindex", reindex_handler)
     reg("POST", "/{index}/_update_by_query", update_by_query_handler)
     reg("POST", "/{index}/_delete_by_query", delete_by_query_handler)
+    # metrics exposition (prometheus-exporter plugin surface)
+    reg("GET", "/_prometheus/metrics", prometheus_metrics)
     # tasks
     reg("GET", "/_tasks", list_tasks)
     reg("GET", "/_tasks/{task_id}", get_task)
@@ -1520,6 +1522,7 @@ def _parse_task_id(raw: str) -> int:
 def list_tasks(node: TpuNode, params, query, body):
     # the listing request itself runs as a task
     # (TransportListTasksAction registers), so the map is never empty
+    detailed = str(query.get("detailed", "false")) in ("true", "")
     with node.task_manager.task_scope(
         "cluster:monitor/tasks/lists", description="task list"
     ):
@@ -1527,11 +1530,20 @@ def list_tasks(node: TpuNode, params, query, body):
         task_map = {}
         for t in tasks:
             d = t.to_dict()
-            rs = {"total": {"cpu_time_in_nanos": max(t.cpu_time_nanos, 1),
-                            "memory_in_bytes": 0}}
-            if str(query.get("detailed", "false")) in ("true", ""):
-                rs["thread_info"] = {"thread_executions": 1,
-                                     "active_threads": 1}
+            full = t.resource_stats()
+            rs = {"total": {
+                # a still-running task has accrued no scope CPU yet;
+                # floor at 1ns like the reference's sampled minimum
+                "cpu_time_in_nanos": max(
+                    full["total"]["cpu_time_in_nanos"], 1),
+                "memory_in_bytes": full["total"]["memory_in_bytes"],
+            }}
+            if detailed:
+                rs["thread_info"] = dict(
+                    full["thread_info"],
+                    thread_executions=max(
+                        full["thread_info"]["thread_executions"], 1),
+                )
             d.setdefault("resource_stats", rs)
             task_map[f"{t.node}:{t.id}"] = d
     group_by = str(query.get("group_by", "nodes"))
@@ -1549,6 +1561,49 @@ def list_tasks(node: TpuNode, params, query, body):
                   "remote_cluster_client"],
         "tasks": task_map,
     }}}
+
+
+def prometheus_metrics(node: TpuNode, params, query, body):
+    """GET /_prometheus/metrics — the node's MetricsRegistry rendered in
+    Prometheus text exposition format (the prometheus-exporter plugin
+    surface): counters as `counter` samples, histograms as `summary`
+    `_count`/`_sum` pairs plus `_min`/`_max` gauges."""
+    import re as _re
+
+    def metric_name(name: str) -> str:
+        return "opensearch_tpu_" + _re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+    def fmt(v) -> str:
+        f = float(v)
+        return str(int(f)) if f.is_integer() else repr(f)
+
+    stats = node.telemetry.metrics.stats()
+    lines: list[str] = []
+    for name in sorted(stats["counters"]):
+        m = metric_name(name)
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {fmt(stats['counters'][name])}")
+    for name in sorted(stats["histograms"]):
+        h = stats["histograms"][name]
+        m = metric_name(name)
+        lines.append(f"# TYPE {m} summary")
+        lines.append(f"{m}_count {fmt(h['count'])}")
+        lines.append(f"{m}_sum {fmt(h['sum'])}")
+        for gauge in ("min", "max"):
+            lines.append(f"# TYPE {m}_{gauge} gauge")
+            lines.append(f"{m}_{gauge} {fmt(h[gauge])}")
+    # task-manager liveness gauges ride along (cheap, always useful on a
+    # scrape dashboard)
+    tm = node.task_manager
+    for gname, gval in (
+        ("tasks_running", len(tm.list_tasks())),
+        ("tasks_completed", tm.completed),
+        ("tasks_cancelled", tm.cancelled_count),
+    ):
+        m = f"opensearch_tpu_{gname}"
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {gval}")
+    return 200, "\n".join(lines) + "\n"
 
 
 def get_task(node: TpuNode, params, query, body):
@@ -2954,7 +3009,15 @@ def nodes_stats(node: TpuNode, params, query, body):
         "breakers": node.breakers.stats(),
         "indexing_pressure": node.indexing_pressure.stats(),
         "search_backpressure": node.search_backpressure.stats(),
-        "telemetry": node.telemetry.metrics.stats(),
+        "telemetry": {
+            **node.telemetry.metrics.stats(),
+            # the tail of the spans ring: one stitched trace tree per
+            # recent distributed operation (trace_id groups them)
+            "spans": [
+                s.to_dict()
+                for s in node.telemetry.tracer.finished_spans()[-100:]
+            ],
+        },
         "slowlog": {
             "search": node.search_slowlog.entries()[-10:],
             "indexing": node.indexing_slowlog.entries()[-10:],

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import logging
+import time
 from typing import Any, Callable
 
 import numpy as np
@@ -75,16 +76,23 @@ def compute_aggs(
     ext: dict | None = None,
 ) -> dict:
     from opensearch_tpu.search.aggs_pipeline import PIPELINE_TYPES
+    from opensearch_tpu.search import profile as search_profile
 
+    prof = search_profile.active()
     out = {}
     for name, body in aggs_body.items():
         # pipeline aggs run at final reduce (aggs_pipeline.apply_pipeline_aggs),
         # mirroring the reference where they reduce coordinator-side
         if any(k in PIPELINE_TYPES for k in body):
             continue
+        t0 = time.perf_counter_ns() if prof is not None else 0
         out[name] = _compute_one(
             name, body, segments, mapper_service, masks, filter_fn, ext
         )
+        if prof is not None:
+            # real per-aggregation collector wall time for the profile
+            # response (replaces the emulated constants)
+            prof.record_agg(name, time.perf_counter_ns() - t0)
     return out
 
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import logging
 import re
+import time
 from dataclasses import dataclass, field as dc_field
 from typing import Any
 
@@ -48,6 +49,7 @@ from opensearch_tpu.index.segment import (
     pad_window,
 )
 from opensearch_tpu.ops import bm25, filters, knn
+from opensearch_tpu.search import profile
 from opensearch_tpu.search import query_dsl as q
 
 logger = logging.getLogger(__name__)
@@ -141,7 +143,10 @@ class ShardContext:
             if node.filter is not None:
                 ex = SegmentExecutor(self, host, dev)
                 valid = valid & ex.execute(node.filter).mask
-            qv = jnp.asarray([node.vector], jnp.float32)
+            # host numpy: the query vector is this path's whole per-request
+            # host->device transfer (the profiler counts host-typed args)
+            qv = np.asarray([node.vector], np.float32)
+            prof = profile.active()
             if vf.ann is not None and node.filter is None:
                 # ANN path: IVF-PQ ADC + exact rescore gives candidate-only
                 # scores; non-candidates stay -inf (they can never win)
@@ -159,13 +164,23 @@ class ShardContext:
                 # the shard-level cut below still takes exactly node.k.
                 k_req = max(1, min(node.k, host.n_docs))
                 k_bucket = 1 << (k_req - 1).bit_length()
+                t_k = time.perf_counter_ns()
                 a_vals, a_ids = ivfpq.search_index(
                     vf.ann, vf.vectors, vf.norms_sq, valid, qv,
                     k=k_bucket,
                     nprobe=nprobe,
                     similarity=vf.similarity,
                 )
+                # the host materialization is the fence for this launch
                 a_vals, a_ids = np.asarray(a_vals[0]), np.asarray(a_ids[0])
+                if prof is not None:
+                    prof.record_kernel(
+                        "ivfpq_search", time.perf_counter_ns() - t_k,
+                        int(qv.nbytes),
+                        profile.signature_retraced(
+                            "ivfpq_search", (vf.vectors, qv),
+                            (k_bucket, nprobe)),
+                    )
                 scores = np.full(dev.n_pad, -np.inf, np.float32)
                 hit = a_ids >= 0
                 scores[a_ids[hit]] = a_vals[hit]
@@ -186,9 +201,18 @@ class ShardContext:
                         knn_ops.canonical_similarity(vf.similarity),
                         chunk,
                     )
+                    t_k = time.perf_counter_ns()
                     vals, ids = jfn(vf.vectors, vf.norms_sq, valid, qv)
                     vals = np.asarray(vals[0])
                     ids = np.asarray(ids[0])
+                    if prof is not None:
+                        prof.record_kernel(
+                            "knn_topk_streaming",
+                            time.perf_counter_ns() - t_k, int(qv.nbytes),
+                            profile.signature_retraced(
+                                "knn_topk_streaming", (vf.vectors, qv),
+                                (k_bucket, chunk)),
+                        )
                     scores = np.full(n_pad, -np.inf, np.float32)
                     finite = np.isfinite(vals)
                     scores[ids[finite]] = vals[finite]
@@ -577,14 +601,18 @@ class SegmentExecutor:
                 lens.append(int(host_tf.term_offsets[tid + 1] - host_tf.term_offsets[tid]))
                 idfs.append(bm25.idf(self.ctx.text_df(field, t), doc_count))
         window = pad_window(max(lens) if lens else 1)
+        # per-term metadata stays HOST numpy here: these columns are the
+        # only per-query host->device traffic of the BM25 path (postings
+        # are HBM-resident), and the profiler counts transfer bytes from
+        # host-typed kernel arguments
         scores, counts = bm25.bm25_term_scores(
             dev_tf.postings_docs,
             dev_tf.postings_tfs,
             dev_tf.doc_len,
-            jnp.asarray(offs, jnp.int32),
-            jnp.asarray(lens, jnp.int32),
-            jnp.asarray(idfs, jnp.float32),
-            jnp.float32(avgdl),
+            np.asarray(offs, np.int32),
+            np.asarray(lens, np.int32),
+            np.asarray(idfs, np.float32),
+            np.float32(avgdl),
             n_pad=self.dev.n_pad,
             window=window,
         )
@@ -597,7 +625,14 @@ class SegmentExecutor:
         method = getattr(self, f"_exec_{type(node).__name__}", None)
         if method is None:
             raise ParsingException(f"unexecutable query node [{type(node).__name__}]")
-        return method(node)
+        prof = profile.active()
+        if prof is None:
+            return method(node)
+        # deep profiler: nested execute() calls (bool children, rescore,
+        # function_score inners) build the per-operator tree; same node
+        # across segments accumulates into one entry
+        with prof.operator(type(node).__name__, profile.describe_node(node)):
+            return method(node)
 
     def _exec_MatchAllQuery(self, node: q.MatchAllQuery) -> NodeResult:
         return _const_result(self.dev.live, node.boost, scoring=True)
@@ -1454,7 +1489,8 @@ class SegmentExecutor:
         if vf is None:
             return _empty(self.dev)
         valid = vf.present & inner.mask
-        qv = jnp.asarray([node.query_vector], jnp.float32)
+        # host numpy: counted as this request's host->device transfer
+        qv = np.asarray([node.query_vector], np.float32)
         if node.function == "knn_score":
             scores = knn.exact_knn_scores(qv, vf.vectors, vf.norms_sq, valid, node.space_type)[0]
             scores = jnp.where(valid, scores, 0.0)
@@ -2077,6 +2113,8 @@ def execute_query_phase(
             masks.append(mask_host)
             score_arrays.append(np.asarray(result.scores)[: host.n_docs])
         total += int(mask_host.sum())
+        prof = profile.active()
+        t_collect = time.perf_counter_ns()
         if size > 0:
             if not sort:
                 k = min(size, dev.n_pad)
@@ -2097,13 +2135,20 @@ def execute_query_phase(
                         host, mask_host, scores_h, sort, size, seg_idx, mapper_service
                     )
                 )
+        if prof is not None:
+            # the top-k cut / field sort is this engine's collector
+            prof.collect_ns += time.perf_counter_ns() - t_collect
 
+    t_final = time.perf_counter_ns()
     if not sort:
         all_hits.sort(key=lambda h: (-h.score, h.segment, h.doc))
         all_hits = all_hits[:size]
     else:
         all_hits.sort(key=_sort_key_fn(sort))
         all_hits = all_hits[:size]
+    final_prof = profile.active()
+    if final_prof is not None:
+        final_prof.collect_ns += time.perf_counter_ns() - t_final
     return ShardQueryResult(
         hits=all_hits, total=total, max_score=max_score, masks=masks,
         score_arrays=score_arrays,

@@ -19,6 +19,7 @@ import numpy as np
 
 from opensearch_tpu.common.errors import ParsingException
 from opensearch_tpu.search import query_dsl as q
+from opensearch_tpu.telemetry import tracing
 
 
 # --------------------------------------------------------------------- #
@@ -71,6 +72,14 @@ def can_match(snapshot, mapper_service, node: Any) -> bool:
     constraints = _range_constraints(node)
     if not constraints:
         return True
+    with tracing.span("search.can_match",
+                      {"constraints": len(constraints)}) as span:
+        matched = _can_match_constrained(snapshot, mapper_service, constraints)
+        span.set_attribute("matched", matched)
+    return matched
+
+
+def _can_match_constrained(snapshot, mapper_service, constraints) -> bool:
     if not snapshot.segments:
         # a shard with buffered-but-unrefreshed docs still can't serve them;
         # empty searchable set only provably non-matching if no constraint
@@ -150,9 +159,16 @@ def apply_rescore(rescore_body, merged, per_shard_results, shards):
     score desc). Each rescore stage computes the rescore query's scores for
     window docs and combines per score_mode; hits outside the window keep
     their order below the window (RescorePhase contract)."""
+    stages = rescore_body if isinstance(rescore_body, list) else [rescore_body]
+    with tracing.span("search.rescore", {"stages": len(stages)}):
+        merged = _apply_rescore_stages(
+            stages, merged, per_shard_results, shards)
+    return merged
+
+
+def _apply_rescore_stages(stages, merged, per_shard_results, shards):
     from opensearch_tpu.search.executor import SegmentExecutor, ShardContext
 
-    stages = rescore_body if isinstance(rescore_body, list) else [rescore_body]
     for stage in stages:
         if not isinstance(stage, dict) or "query" not in stage:
             raise ParsingException("[rescore] requires a [query] object")
@@ -229,6 +245,12 @@ def apply_collapse(collapse_body, merged, per_shard_results):
     expansion is a sort+slice instead of a follow-up msearch)."""
     if not isinstance(collapse_body, dict) or not collapse_body.get("field"):
         raise ParsingException("[collapse] requires a [field]")
+    with tracing.span("search.collapse",
+                      {"field": collapse_body["field"]}):
+        return _apply_collapse_inner(collapse_body, merged, per_shard_results)
+
+
+def _apply_collapse_inner(collapse_body, merged, per_shard_results):
     field = collapse_body["field"]
     inner_specs = collapse_body.get("inner_hits") or []
     if isinstance(inner_specs, dict):

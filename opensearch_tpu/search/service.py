@@ -27,7 +27,7 @@ from opensearch_tpu.common.errors import (
     ParsingException,
 )
 from opensearch_tpu.index.shard import IndexShard
-from opensearch_tpu.search import fetch, query_dsl
+from opensearch_tpu.search import fetch, profile as search_profile, query_dsl
 
 logger = logging.getLogger(__name__)
 from opensearch_tpu.search.aggs import compute_aggs
@@ -158,6 +158,9 @@ def search(
 
     want_profile = bool(body.get("profile"))
     shard_query_ns: list[int] = []
+    # one deep profiler per shard (search/profile.ShardProfiler): operator
+    # tree + device kernel time + transfer bytes + retrace flag
+    shard_profilers: list = []
     skipped_shards = 0
 
     fetch_k = from_ + size
@@ -189,22 +192,25 @@ def search(
                 acquired[shard_i] if acquired is not None
                 else shard.acquire_searcher()
             )
+            prof = search_profile.ShardProfiler() if want_profile else None
             t_q = time.perf_counter_ns()
-            per_shard_subs.append([
-                execute_query_phase(
-                    snapshot,
-                    shard.mapper_service,
-                    _shard_node(sub, shard_i),
-                    size=fetch_k,
-                    need_masks=aggs_body is not None,
-                    min_score=(
-                        float(min_score) if min_score is not None else None
-                    ),
-                )
-                for sub in node.queries
-            ])
+            with search_profile.profiling(prof):
+                per_shard_subs.append([
+                    execute_query_phase(
+                        snapshot,
+                        shard.mapper_service,
+                        _shard_node(sub, shard_i),
+                        size=fetch_k,
+                        need_masks=aggs_body is not None,
+                        min_score=(
+                            float(min_score) if min_score is not None else None
+                        ),
+                    )
+                    for sub in node.queries
+                ])
             if want_profile:
                 shard_query_ns.append(time.perf_counter_ns() - t_q)
+                shard_profilers.append(prof)
             shard_snaps.append((shard, snapshot))
         fused = pipeline_mod.fuse_hybrid_results(
             per_shard_subs, phase_results_config, fetch_k
@@ -238,9 +244,16 @@ def search(
                 # shards whose segment min/max PROVE no doc matches
                 from opensearch_tpu.search import phases
 
-                if not phases.can_match(
+                prof = (search_profile.ShardProfiler()
+                        if want_profile else None)
+                t_rw = time.perf_counter_ns()
+                matched = phases.can_match(
                     snapshot, shard.mapper_service, _shard_node(node, shard_i)
-                ):
+                )
+                if prof is not None:
+                    # can_match is this engine's rewrite step
+                    prof.rewrite_ns += time.perf_counter_ns() - t_rw
+                if not matched:
                     n_segs = len(snapshot.segments)
                     result = ShardQueryResult(
                         hits=[], total=0, max_score=None,
@@ -256,22 +269,25 @@ def search(
                     skipped_shards += 1
                     if want_profile:
                         shard_query_ns.append(0)
+                        shard_profilers.append(prof)
                     per_shard_results.append((shard, snapshot, result))
                     continue
                 t_q = time.perf_counter_ns()
-                result = execute_query_phase(
-                    snapshot,
-                    shard.mapper_service,
-                    _shard_node(node, shard_i),
-                    # search_after cursors can reach arbitrarily deep into a
-                    # shard; fall back to all matching docs per shard
-                    size=snapshot.max_doc if search_after is not None else fetch_k,
-                    sort=sort,
-                    need_masks=aggs_body is not None,
-                    min_score=float(min_score) if min_score is not None else None,
-                )
+                with search_profile.profiling(prof):
+                    result = execute_query_phase(
+                        snapshot,
+                        shard.mapper_service,
+                        _shard_node(node, shard_i),
+                        # search_after cursors can reach arbitrarily deep into a
+                        # shard; fall back to all matching docs per shard
+                        size=snapshot.max_doc if search_after is not None else fetch_k,
+                        sort=sort,
+                        need_masks=aggs_body is not None,
+                        min_score=float(min_score) if min_score is not None else None,
+                    )
                 if want_profile:
                     shard_query_ns.append(time.perf_counter_ns() - t_q)
+                    shard_profilers.append(prof)
                 per_shard_results.append((shard, snapshot, result))
 
     # ---- reduce phase (SearchPhaseController analog) ----
@@ -599,6 +615,7 @@ def search(
     }
 
     # ---- aggregations (reduce across every shard's segments) ----
+    agg_profiler = None
     if aggs_body:
         all_segments = []
         all_masks = []
@@ -628,11 +645,17 @@ def search(
         # mappings (first index to map the field wins, like the reference's
         # field-caps conflict handling)
         mapper_service = _MultiMapperView([s.mapper_service for s in shards])
-        response["aggregations"] = compute_aggs(
-            all_segments, mapper_service, aggs_body, all_masks, filter_fn,
-            ext={"scores": all_scores, "seg_meta": seg_meta,
-                 "partial": partial},
-        )
+        # aggregations reduce across every shard's segments in ONE pass, so
+        # their collector timings are request-level: a dedicated profiler
+        # collects real per-agg wall times for the profile response
+        if want_profile:
+            agg_profiler = search_profile.ShardProfiler()
+        with search_profile.profiling(agg_profiler):
+            response["aggregations"] = compute_aggs(
+                all_segments, mapper_service, aggs_body, all_masks, filter_fn,
+                ext={"scores": all_scores, "seg_meta": seg_meta,
+                     "partial": partial},
+            )
         # pipeline aggregations run once, at final reduce — for a cluster
         # partial that reduce happens on the coordinator, not here
         if not partial:
@@ -655,32 +678,55 @@ def search(
         )
 
     if want_profile:
-        # per-shard query-phase timing trees (search/profile/ Profilers:
-        # AbstractProfileBreakdown) — one entry per shard like the
-        # reference's "_search?profile=true" response
+        # per-shard deep profile (search/profile.ShardProfiler): the
+        # per-operator tree with the TPU-specific fields (device kernel
+        # time fenced by block_until_ready, host->device transfer bytes,
+        # jit-retrace flag), in the reference's
+        # profile.shards[*].searches[*].query[*] response shape
         prof_aggs_body = body.get("aggs") or body.get("aggregations") or {}
-        response["profile"] = {"shards": [
-            {
+        agg_prof = agg_profiler
+        profs = shard_profilers or [None] * len(per_shard_results)
+        shards_profile = []
+        for shard_idx, ((shard, _snap, _r), prof) in enumerate(
+            zip(per_shard_results, profs)
+        ):
+            t_ns = (shard_query_ns[shard_idx]
+                    if shard_idx < len(shard_query_ns) else 0)
+            query_entries = prof.query_entries() if prof is not None else []
+            if not query_entries:
+                # can_match-skipped shard (or a precomputed query phase):
+                # one zeroed entry keeps the shape uniform
+                query_entries = [{
+                    "type": type(node).__name__,
+                    "description": json.dumps(body.get("query") or {}),
+                    "time_in_nanos": t_ns,
+                    "breakdown": {
+                        "create_weight": 0, "create_weight_count": 0,
+                        "build_scorer": 0, "build_scorer_count": 0,
+                        "score": t_ns, "score_count": 0,
+                        "next_doc": 0, "next_doc_count": 0,
+                    },
+                    "device_time_in_nanos": 0,
+                    "transfer_bytes": 0,
+                    "retraced": False,
+                }]
+            shards_profile.append({
                 "id": f"[{shard.shard_id.index}][{shard.shard_id.shard}]",
                 "searches": [{
-                    "query": [{
-                        "type": type(node).__name__,
-                        "description": json.dumps(body.get("query") or {}),
-                        "time_in_nanos": t_ns,
-                        "breakdown": {
-                            "score": t_ns,
-                            "build_scorer": 0,
-                            "create_weight": 0,
-                            "next_doc": 0,
-                        },
-                    }],
-                    "rewrite_time": 0,
+                    "query": query_entries,
+                    "rewrite_time": prof.rewrite_ns if prof else 0,
                     "collector": [{
                         "name": "SimpleTopDocsCollector",
                         "reason": "search_top_hits",
-                        "time_in_nanos": t_ns,
+                        "time_in_nanos": (
+                            prof.collect_ns if prof is not None else t_ns
+                        ),
                     }],
                 }],
+                # shard-level TPU rollup (TPU-KNN roofline attribution)
+                "tpu": (prof.tpu_summary() if prof is not None else
+                        {"device_time_in_nanos": 0, "transfer_bytes": 0,
+                         "jit_retrace": False}),
                 "aggregations": _agg_profile_entries(
                     prof_aggs_body, response.get("aggregations"),
                     shard.mapper_service,
@@ -689,25 +735,24 @@ def search(
                     segments=[h for h, _d in _snap.segments],
                     masks=list(_r.masks),
                     query_body=body.get("query"),
+                    agg_times=(agg_prof.agg_times
+                               if agg_prof is not None else None),
                 ),
-            }
-            for (shard, _snap, _r), t_ns in zip(
-                per_shard_results,
-                shard_query_ns or [0] * len(per_shard_results),
-            )
-        ]}
+            })
+        response["profile"] = {"shards": shards_profile}
     return response
 
 
 def _agg_profile_entries(aggs_body, aggs_resp, ms, collect_count: int,
                          n_segments: int, segments=None, masks=None,
-                         query_body=None) -> list:
+                         query_body=None, agg_times=None) -> list:
     """Aggregation profile tree (search/profile/aggregation/
     AggregationProfiler): aggregator class names, breakdowns with REAL
     collect counts (matched docs), and the per-strategy debug section the
-    reference's profiler emits. Times are token positive values — this
-    engine's aggregations are vectorized array passes, so the per-call
-    timing tree is emulated observability, while counts/buckets are real."""
+    reference's profiler emits. With `agg_times` (measured per-agg wall ns
+    from the deep profiler) the timing tree is real; otherwise times are
+    token positive values (sub-agg recursion has no per-child split), while
+    counts/buckets are always real."""
     from opensearch_tpu.search.aggs_pipeline import PIPELINE_TYPES
 
     entries = []
@@ -734,20 +779,37 @@ def _agg_profile_entries(aggs_body, aggs_resp, ms, collect_count: int,
             typ, conf, mapper, is_numeric, n_buckets, n_segments,
             [k for k in (sub or {})], segments=segments, masks=masks,
             query_body=query_body, ms=ms)
-        entry = {
-            "type": agg_class,
-            "description": name,
-            "time_in_nanos": 6000,
-            "breakdown": {
-                "initialize": 1000, "initialize_count": 1,
-                "build_leaf_collector": 1000,
-                "build_leaf_collector_count": n_segments,
-                "collect": 2000, "collect_count": collect_count,
-                "post_collection": 500, "post_collection_count": 1,
-                "build_aggregation": 1000, "build_aggregation_count": 1,
-                "reduce": 0, "reduce_count": 0,
-            },
-        }
+        real_ns = (agg_times or {}).get(name)
+        if real_ns is not None:
+            entry = {
+                "type": agg_class,
+                "description": name,
+                "time_in_nanos": real_ns,
+                "breakdown": {
+                    "initialize": 0, "initialize_count": 1,
+                    "build_leaf_collector": 0,
+                    "build_leaf_collector_count": n_segments,
+                    "collect": real_ns, "collect_count": collect_count,
+                    "post_collection": 0, "post_collection_count": 1,
+                    "build_aggregation": 0, "build_aggregation_count": 1,
+                    "reduce": 0, "reduce_count": 0,
+                },
+            }
+        else:
+            entry = {
+                "type": agg_class,
+                "description": name,
+                "time_in_nanos": 6000,
+                "breakdown": {
+                    "initialize": 1000, "initialize_count": 1,
+                    "build_leaf_collector": 1000,
+                    "build_leaf_collector_count": n_segments,
+                    "collect": 2000, "collect_count": collect_count,
+                    "post_collection": 500, "post_collection_count": 1,
+                    "build_aggregation": 1000, "build_aggregation_count": 1,
+                    "reduce": 0, "reduce_count": 0,
+                },
+            }
         if debug:
             entry["debug"] = debug
         if sub:

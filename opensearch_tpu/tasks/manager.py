@@ -36,10 +36,19 @@ class Task:
     node: str = "node-0"
     start_time_millis: int = 0
     _start_perf: float = 0.0
+    _start_thread_ns: int = 0
+    _start_alloc: int = 0
     cancelled: bool = False
     cancellation_reason: str | None = None
-    # resource tracking (TaskResourceTrackingService analog)
+    # resource tracking (TaskResourceTrackingService analog):
+    # cpu_time_nanos = CPU consumed by the executing thread (thread_time,
+    # not wall — a task blocked on IO accrues none); peak_alloc_bytes =
+    # peak traced allocation delta while the task ran (real only when
+    # tracemalloc is active, the ThreadMXBean-allocated-bytes stand-in);
+    # thread_executions counts distinct enter/exit cycles
     cpu_time_nanos: int = 0
+    peak_alloc_bytes: int = 0
+    thread_executions: int = 0
     children: list[int] = dc_field(default_factory=list)
 
     def ensure_not_cancelled(self) -> None:
@@ -52,6 +61,20 @@ class Task:
     @property
     def running_time_nanos(self) -> int:
         return int((time.perf_counter() - self._start_perf) * 1e9)
+
+    def resource_stats(self) -> dict:
+        """The `resource_stats` section of _tasks?detailed
+        (TaskResourceStats shape: total across executing threads)."""
+        return {
+            "total": {
+                "cpu_time_in_nanos": self.cpu_time_nanos,
+                "memory_in_bytes": self.peak_alloc_bytes,
+            },
+            "thread_info": {
+                "thread_executions": self.thread_executions,
+                "active_threads": 1,
+            },
+        }
 
     def to_dict(self) -> dict:
         return {
@@ -202,7 +225,10 @@ class TaskManager:
 
     def task_scope(self, action: str, description: str = "",
                    cancellable: bool = True, parent_id: int = -1):
-        """Context manager: register on enter, unregister on exit."""
+        """Context manager: register on enter, unregister on exit, with
+        resource tracking (CPU thread-time + peak allocation delta) over
+        the scope — the TaskResourceTrackingService sampling, collapsed to
+        enter/exit because handlers run a task on one worker thread."""
         manager = self
 
         class _Scope:
@@ -210,14 +236,32 @@ class TaskManager:
                 self.task = manager.register(
                     action, description, cancellable, parent_id
                 )
+                self.task._start_thread_ns = time.thread_time_ns()
+                self.task._start_alloc = _traced_alloc()
                 return self.task
 
             def __exit__(self, exc_type, exc, tb):
-                start = self.task._start_perf
-                self.task.cpu_time_nanos = int(
-                    (time.perf_counter() - start) * 1e9
+                self.task.cpu_time_nanos += max(
+                    time.thread_time_ns() - self.task._start_thread_ns, 0
                 )
+                alloc = _traced_alloc()
+                if alloc > self.task._start_alloc:
+                    self.task.peak_alloc_bytes = max(
+                        self.task.peak_alloc_bytes,
+                        alloc - self.task._start_alloc,
+                    )
+                self.task.thread_executions += 1
                 manager.unregister(self.task)
                 return False
 
         return _Scope()
+
+
+def _traced_alloc() -> int:
+    """Peak traced bytes when tracemalloc is active, else 0 — per-task
+    allocation accounting has no cheap always-on source in CPython."""
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        return 0
+    return tracemalloc.get_traced_memory()[1]

@@ -1,8 +1,17 @@
 from opensearch_tpu.telemetry.tracing import (
     MetricsRegistry,
     Span,
+    Telemetry,
     Tracer,
+    activate,
+    current_trace_context,
     default_telemetry,
+    restore_trace_context,
+    span,
 )
 
-__all__ = ["MetricsRegistry", "Span", "Tracer", "default_telemetry"]
+__all__ = [
+    "MetricsRegistry", "Span", "Telemetry", "Tracer", "activate",
+    "current_trace_context", "default_telemetry", "restore_trace_context",
+    "span",
+]

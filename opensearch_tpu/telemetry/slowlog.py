@@ -55,6 +55,16 @@ class SlowLog:
                     "level": lvl, "took_ms": round(took_ms, 2),
                     "index": index, "detail": detail[:1000],
                 }
+                # correlate the slow operation with its distributed trace:
+                # a slowlog line names WHAT was slow, the trace tree (spans
+                # ring / _nodes/stats) shows WHERE the time went
+                from opensearch_tpu.telemetry.tracing import (
+                    current_trace_context,
+                )
+
+                ctx = current_trace_context()
+                if ctx is not None:
+                    entry["trace_id"] = ctx["trace_id"]
                 with self._lock:
                     self._ring.append(entry)
                 _LOG_FN[lvl](

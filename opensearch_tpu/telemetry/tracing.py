@@ -12,6 +12,14 @@ propagation rides ThreadContext). Here:
   OTel plugin equivalent would ship them out; tests and the _nodes/stats
   surface read the ring.
 - MetricsRegistry: counters + histograms with label support.
+- Cross-NODE propagation (PR 3): `current_trace_context()` serializes the
+  active (trace_id, span_id) pair into transport message headers and
+  `restore_trace_context()` re-installs it on the receiving node, so a
+  distributed search or recovery stitches into ONE trace tree across
+  processes (the reference's ThreadContext header relay through
+  TaskTransportChannel). Span ids come from a per-tracer counter prefixed
+  with the tracer name — deterministic under the sim (no uuid/urandom,
+  tpulint TPU006) yet unique across the nodes of one simulated cluster.
 """
 
 from __future__ import annotations
@@ -26,6 +34,9 @@ from typing import Any
 
 _current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
     "opensearch_tpu_current_span", default=None
+)
+_active_tracer: contextvars.ContextVar["Tracer | None"] = contextvars.ContextVar(
+    "opensearch_tpu_active_tracer", default=None
 )
 
 
@@ -66,42 +77,132 @@ class _SpanScope:
         self._attributes = attributes
 
     def __enter__(self) -> Span:
-        parent = _current_span.get()
-        sid = f"s{next(self._tracer._ids):08x}"
-        self.span = Span(
-            trace_id=parent.trace_id if parent else f"t{sid}",
-            span_id=sid,
-            parent_id=parent.span_id if parent else None,
-            name=self._name,
-            attributes=dict(self._attributes or {}),
-            start_ns=time.perf_counter_ns(),
-        )
+        self.span = self._tracer.begin_span(self._name, self._attributes)
         self._token = _current_span.set(self.span)
         return self.span
 
     def __exit__(self, exc_type, exc, tb):
-        self.span.end_ns = time.perf_counter_ns()
         if exc_type is not None:
             self.span.attributes["error"] = str(exc)
         _current_span.reset(self._token)
-        if self._tracer.enabled:
-            with self._tracer._lock:
-                self._tracer._finished.append(self.span)
+        self._tracer.end_span(self.span)
         return False
+
+
+class _RemoteContextScope:
+    """Installs a synthetic parent span restored from transport headers so
+    spans opened on the receiving node stitch into the sender's trace."""
+
+    __slots__ = ("_ctx", "_token")
+
+    def __init__(self, ctx: dict | None):
+        self._ctx = ctx if (
+            isinstance(ctx, dict) and ctx.get("trace_id") and ctx.get("span_id")
+        ) else None
+
+    def __enter__(self):
+        if self._ctx is None:
+            self._token = None
+            return None
+        remote = Span(
+            trace_id=str(self._ctx["trace_id"]),
+            span_id=str(self._ctx["span_id"]),
+            parent_id=None,
+            name="<remote>",
+        )
+        self._token = _current_span.set(remote)
+        return remote
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._token is not None:
+            _current_span.reset(self._token)
+        return False
+
+
+def current_trace_context() -> dict | None:
+    """The active (trace_id, span_id) pair as a wire-ready header dict, or
+    None when no span is open (messages outside any trace stay bare)."""
+    span = _current_span.get()
+    if span is None:
+        return None
+    return {"trace_id": span.trace_id, "span_id": span.span_id}
+
+
+def restore_trace_context(ctx: dict | None) -> _RemoteContextScope:
+    """Context manager re-installing a propagated trace context (receiving
+    node side, or re-entering a stored context across scheduler callbacks).
+    A None/malformed ctx yields a no-op scope."""
+    return _RemoteContextScope(ctx)
+
+
+class _ActivateScope:
+    __slots__ = ("_tracer", "_token")
+
+    def __init__(self, tracer: "Tracer"):
+        self._tracer = tracer
+
+    def __enter__(self) -> "Tracer":
+        self._token = _active_tracer.set(self._tracer)
+        return self._tracer
+
+    def __exit__(self, exc_type, exc, tb):
+        _active_tracer.reset(self._token)
+        return False
+
+
+def activate(tracer: "Tracer") -> _ActivateScope:
+    """Scope the 'active tracer' (the node handling the current request) so
+    library code (search phases, recovery) can open spans into the right
+    node's ring without threading a tracer through every signature."""
+    return _ActivateScope(tracer)
+
+
+def active_tracer() -> "Tracer":
+    return _active_tracer.get() or default_telemetry.tracer
+
+
+def span(name: str, attributes: dict | None = None):
+    """Open a span on the active tracer (see `activate`)."""
+    return active_tracer().start_span(name, attributes)
 
 
 class Tracer:
     """Span factory with contextvar propagation and a bounded ring of
-    finished spans (the exporter slot)."""
+    finished spans (the exporter slot). `name` prefixes span ids so traces
+    stitched across several tracers (sim cluster nodes) stay unambiguous."""
 
-    def __init__(self, max_finished: int = 2048, enabled: bool = True):
+    def __init__(self, max_finished: int = 2048, enabled: bool = True,
+                 name: str = "t0"):
         self.enabled = enabled
+        self.name = name
         self._ids = itertools.count(1)
         self._finished: deque[Span] = deque(maxlen=max_finished)
         self._lock = threading.Lock()
 
     def start_span(self, name: str, attributes: dict | None = None):
         return _SpanScope(self, name, attributes)
+
+    def begin_span(self, name: str, attributes: dict | None = None) -> Span:
+        """Start a span WITHOUT installing it as the current context — for
+        operations that live across scheduler callbacks (a recovery). Pair
+        with end_span; propagate via restore_trace_context({"trace_id":
+        span.trace_id, "span_id": span.span_id})."""
+        parent = _current_span.get()
+        sid = f"{self.name}-s{next(self._ids):06x}"
+        return Span(
+            trace_id=parent.trace_id if parent else f"trace-{sid}",
+            span_id=sid,
+            parent_id=parent.span_id if parent else None,
+            name=name,
+            attributes=dict(attributes or {}),
+            start_ns=time.perf_counter_ns(),
+        )
+
+    def end_span(self, span: Span) -> None:
+        span.end_ns = time.perf_counter_ns()
+        if self.enabled:
+            with self._lock:
+                self._finished.append(span)
 
     def current_span(self) -> Span | None:
         return _current_span.get()
@@ -177,8 +278,8 @@ class MetricsRegistry:
 
 
 class Telemetry:
-    def __init__(self):
-        self.tracer = Tracer()
+    def __init__(self, name: str = "t0"):
+        self.tracer = Tracer(name=name)
         self.metrics = MetricsRegistry()
 
 

@@ -204,6 +204,11 @@ class MockTransport:
         timeout_ms: int | None = None,  # accepted for interface parity
     ) -> None:
         self.stats["sent"] += 1
+        # capture the trace context NOW: delivery happens in a later
+        # scheduled callback where the sender's contextvars are gone
+        from opensearch_tpu.transport.base import trace_header
+
+        trace_ctx = trace_header()
         delay = self._link_delay(
             sender, target,
             self.queue.random.randint(self.min_delay_ms, self.max_delay_ms),
@@ -234,8 +239,13 @@ class MockTransport:
                     on_failure(RuntimeError(f"no handler for {action} on {target}"))
                 return
             self.stats["delivered"] += 1
+            from opensearch_tpu.transport.base import handler_trace_scope
+
             try:
-                response = handler(sender, payload)
+                # the receiving node sees the sender's trace context, same
+                # as TcpTransport's header restore
+                with handler_trace_scope(trace_ctx):
+                    response = handler(sender, payload)
             except Exception as e:  # noqa: BLE001 - remote errors travel back
                 if on_failure is not None:
                     back = self.queue.random.randint(self.min_delay_ms, self.max_delay_ms)

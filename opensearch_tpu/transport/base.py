@@ -22,6 +22,28 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
+# transport message header key carrying the trace context (trace_id +
+# span_id). Both transports inject it at send() and restore it around the
+# receiving handler — the ThreadContext header relay of the reference,
+# reduced to the one header distributed tracing needs.
+TRACE_HEADER = "_trace"
+
+
+def trace_header() -> dict | None:
+    """The sender-side trace context to attach to an outgoing message
+    (None when the send happens outside any span)."""
+    from opensearch_tpu.telemetry.tracing import current_trace_context
+
+    return current_trace_context()
+
+
+def handler_trace_scope(trace_ctx: dict | None):
+    """Receiver-side scope restoring a propagated trace context around the
+    handler invocation; no-op for untraced messages."""
+    from opensearch_tpu.telemetry.tracing import restore_trace_context
+
+    return restore_trace_context(trace_ctx)
+
 
 class DeferredResponse:
     """A response the handler will produce later (on the same event loop /

@@ -36,7 +36,12 @@ import random
 import struct
 from typing import Any, Callable
 
-from opensearch_tpu.transport.base import DeferredResponse
+from opensearch_tpu.transport.base import (
+    TRACE_HEADER,
+    DeferredResponse,
+    handler_trace_scope,
+    trace_header,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -269,10 +274,14 @@ class TcpTransport:
             ),
         )
         self._pending[rid] = (on_response, on_failure, timer)
-        frame = encode_frame({
+        body = {
             "t": "req", "id": rid, "action": action,
             "sender": sender, "payload": payload,
-        })
+        }
+        trace = trace_header()
+        if trace is not None:
+            body[TRACE_HEADER] = trace
+        frame = encode_frame(body)
         self.loop.create_task(self._send_frame(target, rid, frame))
 
     # -- outbound ----------------------------------------------------------
@@ -441,7 +450,10 @@ class TcpTransport:
             return
         self.stats["delivered"] += 1
         try:
-            result = handler(sender, frame.get("payload"))
+            # restore the sender's trace context so spans the handler opens
+            # stitch into the caller's trace tree (cross-node propagation)
+            with handler_trace_scope(frame.get(TRACE_HEADER)):
+                result = handler(sender, frame.get("payload"))
         except Exception as e:  # noqa: BLE001 - remote errors travel back
             respond(None, e)
             return

@@ -1,0 +1,15 @@
+"""TPU006 true positives: process entropy in a sim-run module."""
+# tpulint: deterministic-module
+import os
+import secrets
+import uuid
+import uuid as _uid
+
+
+def mint_ids():
+    span = uuid.uuid4().hex                       # EXPECT: TPU006
+    legacy = uuid.uuid1()                         # EXPECT: TPU006
+    salt = os.urandom(8)                          # EXPECT: TPU006
+    token = secrets.token_hex(10)                 # EXPECT: TPU006
+    aliased = _uid.uuid4()                        # EXPECT: TPU006
+    return span, legacy, salt, token, aliased

@@ -1,0 +1,21 @@
+"""TPU006 false-positive guards: injectable id sources in a sim-run module,
+and uuid namespace helpers that are deterministic."""
+# tpulint: deterministic-module
+import itertools
+import random
+import uuid
+
+_counter = itertools.count(1)
+
+
+def mint_ids(scheduler):
+    # the scheduler's seeded Random is THE injectable entropy source
+    auto = "%020x" % scheduler.random.getrandbits(80)
+    # a locally seeded Random is fine too (replayable)
+    rng = random.Random(7)
+    jitter = rng.random()
+    # per-node counters are deterministic
+    span = f"n0-s{next(_counter):06x}"
+    # uuid5 is a pure hash of its inputs, not process entropy
+    stable = uuid.uuid5(uuid.NAMESPACE_URL, "opensearch-tpu")
+    return auto, jitter, span, stable

@@ -437,3 +437,204 @@ def test_recovery_progress_timestamps_use_virtual_clock():
     with timeutil.clock_scope(queue.clock()):
         progress = RecoveryProgress(index="ix", shard=0, target_node="n1")
         assert progress.start_ms == 12_345
+
+
+# -- trace propagation under fault injection (PR 3 observability) -------------
+
+
+def _all_spans(sim):
+    return [s for n in sim.nodes.values()
+            for s in n.telemetry.tracer.finished_spans()]
+
+
+def _assert_consistent_tree(spans, trace_id):
+    """All spans of one trace form a SINGLE tree: span ids unique across
+    nodes, every parent resolves within the trace, exactly one root."""
+    in_trace = [s for s in spans if s.trace_id == trace_id]
+    assert in_trace, f"no spans for trace {trace_id}"
+    by_id = {s.span_id: s for s in in_trace}
+    assert len(by_id) == len(in_trace), "span id collision across nodes"
+    roots = [s for s in in_trace
+             if s.parent_id is None or s.parent_id not in by_id]
+    assert len(roots) == 1, [(s.name, s.span_id, s.parent_id) for s in roots]
+    return in_trace, roots[0]
+
+
+def _obs_index(sim, name, shards=2, replicas=1):
+    resp = sim.call(sim.nodes["n0"].create_index, name, {
+        "settings": {"index": {"number_of_shards": shards,
+                               "number_of_replicas": replicas}},
+        "mappings": {"properties": {"msg": {"type": "text"}}}})
+    assert resp.get("acknowledged"), resp
+    sim.run(5_000)
+    for i in range(10):
+        r = sim.call(sim.nodes["n0"].index_doc, name, str(i),
+                     {"msg": f"hello world {i}"})
+        assert "error" not in r, r
+    sim.call(sim.nodes["n0"].refresh, name)
+    sim.run(1_000)
+
+
+def test_cluster_profile_and_stitched_trace(tmp_path):
+    """Acceptance: a cluster-mode search with `"profile": true` returns
+    per-shard per-operator breakdowns including device kernel time and
+    transfer bytes, and the spans ring shows coordinator -> shard ->
+    reduce spans sharing ONE trace_id across nodes."""
+    sim = DataSim(3, seed=23, tmp_path=tmp_path)
+    sim.run(5_000)
+    try:
+        _obs_index(sim, "obs")
+        for n in sim.nodes.values():
+            n.telemetry.tracer.clear()
+        resp = sim.call(sim.nodes["n0"].search, "obs",
+                        {"query": {"match": {"msg": "hello"}},
+                         "profile": True})
+        assert resp["hits"]["total"]["value"] == 10
+
+        # per-shard per-operator profile with the TPU fields
+        shards = resp["profile"]["shards"]
+        assert sorted(s["id"] for s in shards) == ["[obs][0]", "[obs][1]"]
+        for sh in shards:
+            (op,) = sh["searches"][0]["query"]
+            assert op["type"] == "MatchQuery"
+            assert op["time_in_nanos"] > 0
+            assert op["device_time_in_nanos"] > 0
+            assert op["transfer_bytes"] > 0
+            assert any(k["name"] == "bm25_term_scores"
+                       for k in op["kernels"])
+            assert sh["tpu"]["device_time_in_nanos"] > 0
+            assert "jit_retrace" in sh["tpu"]
+
+        # one stitched trace across nodes
+        spans = _all_spans(sim)
+        (coord,) = [s for s in spans if s.name == "search.coordinator"]
+        in_trace, root = _assert_consistent_tree(spans, coord.trace_id)
+        assert root is coord
+        shard_spans = [s for s in in_trace if s.name == "search.shard_query"]
+        assert len(shard_spans) == 2
+        assert all(s.parent_id == coord.span_id for s in shard_spans)
+        (reduce_span,) = [s for s in in_trace if s.name == "search.reduce"]
+        assert reduce_span.parent_id == coord.span_id
+        # the shard spans were recorded in the DATA nodes' own rings (the
+        # trace really crossed node boundaries, not just one ring)
+        holders = {nid for nid, n in sim.nodes.items()
+                   if any(s.name == "search.shard_query"
+                          and s.trace_id == coord.trace_id
+                          for s in n.telemetry.tracer.finished_spans())}
+        state = sim.leader().applied_state
+        expected = {state.primary("obs", i).node_id for i in range(2)}
+        assert holders == expected
+    finally:
+        for n in sim.nodes.values():
+            n.close()
+
+
+def test_partitioned_search_still_yields_consistent_trace(tmp_path):
+    """A shard request lost to a partition times out, the search completes
+    degraded — and the trace is still ONE consistent tree (coordinator +
+    reachable shard spans + reduce), not a forest of orphans."""
+    sim = DataSim(3, seed=29, tmp_path=tmp_path)
+    sim.run(5_000)
+    try:
+        _obs_index(sim, "part")
+        state = sim.leader().applied_state
+        # partition the coordinator away from one preferred (primary) copy
+        # that is NOT local to it
+        victim = next(state.primary("part", i).node_id for i in range(2)
+                      if state.primary("part", i).node_id != "n0")
+        sim.transport.partition({"n0"}, {victim})
+        for n in sim.nodes.values():
+            n.telemetry.tracer.clear()
+        resp = sim.call(sim.nodes["n0"].search, "part",
+                        {"query": {"match": {"msg": "hello"}}})
+        assert resp["_shards"]["failed"] >= 1, resp["_shards"]
+
+        spans = _all_spans(sim)
+        (coord,) = [s for s in spans if s.name == "search.coordinator"]
+        in_trace, root = _assert_consistent_tree(spans, coord.trace_id)
+        assert root is coord
+        assert any(s.name == "search.reduce" for s in in_trace)
+        # the partitioned node contributed no shard span to this trace
+        assert not any(
+            s.trace_id == coord.trace_id
+            for s in sim.nodes[victim].telemetry.tracer.finished_spans()
+        )
+    finally:
+        sim.transport.heal()
+        for n in sim.nodes.values():
+            n.close()
+
+
+def test_recovery_trace_survives_partition_and_retry(tmp_path):
+    """Recovery chunk streaming under a mid-transfer partition: the
+    attempt that completes forms one consistent cross-node trace tree —
+    target-side root, source-side manifest/chunk/finalize spans."""
+    sim = DataSim(5, seed=11, tmp_path=tmp_path)
+    sim.run(8_000)
+    try:
+        leader_name = sim.leader().node_id
+        _make_index(sim, "rt", shards=1, replicas=1,
+                    exclude_name=leader_name)
+        _acked_writes(sim, "rt", 12)
+
+        state = sim.leader().applied_state
+        primary = state.primary("rt", 0)
+        replica = next(r for r in state.shards_for_index("rt")
+                       if not r.primary)
+        sim.transport.take_down(replica.node_id)
+        target = None
+        for _ in range(20_000):
+            st = sim.leader().applied_state
+            entry = next(
+                (r for r in st.shards_for_index("rt")
+                 if not r.primary and r.node_id not in (None, replica.node_id)
+                 and r.state == "INITIALIZING"), None)
+            if entry is not None:
+                target = entry.node_id
+                break
+            sim.queue.run_one()
+        assert target is not None
+
+        # partition source <-> target mid-recovery, then heal
+        sim.transport.partition({primary.node_id}, {target})
+        sim.run(8_000)
+        sim.transport.heal()
+        sim.run(40_000)
+        rec = sim.nodes[target].recoveries.get(("rt", 0))
+        assert rec is not None and rec.stage == "DONE", rec
+
+        # the COMPLETED attempt's trace: one consistent tree spanning
+        # target (root) and source (manifest + ops chunks + finalize)
+        done_roots = [
+            s for s in sim.nodes[target].telemetry.tracer.finished_spans()
+            if s.name == "recovery.target"
+            and s.attributes.get("outcome") == "done"
+        ]
+        assert done_roots, "no completed recovery root span"
+        trace_id = done_roots[-1].trace_id
+        spans = _all_spans(sim)
+        in_trace, root = _assert_consistent_tree(spans, trace_id)
+        assert root.name == "recovery.target"
+        names = {s.name for s in in_trace}
+        assert "recovery.source_start" in names
+        assert "recovery.ops_chunk" in names
+        assert "recovery.finalize" in names
+        # source-side spans really live on the source node's ring
+        assert any(
+            s.trace_id == trace_id
+            for s in sim.nodes[primary.node_id]
+            .telemetry.tracer.finished_spans()
+        )
+        # a retried recovery produced earlier FAILED attempts with their
+        # own traces — they must not leak into the completed attempt's tree
+        failed_roots = [
+            s for s in sim.nodes[target].telemetry.tracer.finished_spans()
+            if s.name == "recovery.target"
+            and s.attributes.get("outcome") in ("failed", "cancelled")
+        ]
+        for s in failed_roots:
+            assert s.trace_id != trace_id
+    finally:
+        sim.transport.heal()
+        for n in sim.nodes.values():
+            n.close()

@@ -137,6 +137,13 @@ def test_baseline_write_load_round_trip(tmp_path):
 # the repo-wide gate: tier-1 fails if the tree regresses past the baseline
 # ---------------------------------------------------------------------------
 
+def test_baseline_is_fully_ratcheted():
+    """PR 3 ratcheted lint_baseline.json to EMPTY: the tree is fully clean
+    and the baseline must never grow again — new violations fail the gate
+    directly instead of hiding behind tolerated counts."""
+    assert baseline_mod.load_baseline(str(BASELINE)) == {}
+
+
 def test_repo_is_clean_against_committed_baseline(monkeypatch):
     # baseline keys are repo-root-relative; pin cwd so running pytest from
     # elsewhere can't skew path normalization

@@ -511,3 +511,91 @@ def test_search_after_rejects_from(node):
     with pytest.raises(ParsingException, match="from"):
         node.search("items", {"sort": [{"price": "asc"}], "from": 5,
                               "search_after": [10]})
+
+
+# -- deep profile response shape (PR 3 observability) -------------------------
+
+
+def test_profile_operator_tree_shape(node):
+    """`"profile": true` returns the reference's
+    profile.shards[*].searches[*].query[*] shape with a REAL operator tree:
+    bool children nest, and every operator carries the TPU-specific fields
+    (device kernel time, transfer bytes, retrace flag)."""
+    resp = node.search("items", {
+        "profile": True,
+        "query": {"bool": {
+            "must": [{"match": {"title": "quick fox"}}],
+            "filter": [{"term": {"tag": "animal"}}],
+        }},
+    })
+    shards = resp["profile"]["shards"]
+    assert len(shards) == 2
+    for shard in shards:
+        search = shard["searches"][0]
+        assert "rewrite_time" in search
+        assert search["collector"][0]["name"] == "SimpleTopDocsCollector"
+        (root,) = search["query"]
+        assert root["type"] == "BoolQuery"
+        assert root["time_in_nanos"] >= 0
+        for key in ("create_weight", "create_weight_count", "score",
+                    "score_count", "next_doc", "build_scorer"):
+            assert key in root["breakdown"], key
+        # TPU fields on every operator
+        for field in ("device_time_in_nanos", "transfer_bytes", "retraced"):
+            assert field in root, field
+        child_types = {c["type"] for c in root["children"]}
+        assert {"MatchQuery", "TermQuery"} <= child_types
+        match_op = next(c for c in root["children"]
+                        if c["type"] == "MatchQuery")
+        # BM25 launched a device kernel: fenced time + per-term transfer
+        assert match_op["device_time_in_nanos"] > 0
+        assert match_op["transfer_bytes"] > 0
+        assert any(k["name"] == "bm25_term_scores"
+                   for k in match_op["kernels"])
+        # shard-level rollup covers its operators
+        assert shard["tpu"]["device_time_in_nanos"] >= \
+            match_op["device_time_in_nanos"]
+        assert shard["tpu"]["transfer_bytes"] >= match_op["transfer_bytes"]
+        assert isinstance(shard["tpu"]["jit_retrace"], bool)
+
+
+def test_profile_knn_kernel_and_transfer_bytes(node):
+    resp = node.search("items", {
+        "profile": True,
+        "query": {"knn": {"vec": {"vector": [1.0, 0.0, 0.0, 0.0], "k": 3}}},
+    })
+    ops = [q for shard in resp["profile"]["shards"]
+           for q in shard["searches"][0]["query"]]
+    knn_ops = [q for q in ops if q["type"] == "KnnQuery"]
+    assert knn_ops
+    assert any(q["device_time_in_nanos"] > 0 for q in knn_ops)
+    # the query vector is the whole per-request transfer: 4 x f32 = 16 bytes
+    assert any(q["transfer_bytes"] == 16 for q in knn_ops)
+
+
+def test_profile_agg_timings_are_real(node):
+    resp = node.search("items", {
+        "profile": True, "size": 0,
+        "query": {"match_all": {}},
+        "aggs": {"tags": {"terms": {"field": "tag"}},
+                 "avg_price": {"avg": {"field": "price"}}},
+    })
+    for shard in resp["profile"]["shards"]:
+        aggs = {a["description"]: a for a in shard["aggregations"]}
+        assert set(aggs) == {"tags", "avg_price"}
+        for entry in aggs.values():
+            assert entry["time_in_nanos"] > 0
+            assert entry["breakdown"]["collect"] == entry["time_in_nanos"]
+        # collect_count is the REAL matched-doc count on this shard
+        assert aggs["tags"]["breakdown"]["collect_count"] > 0
+
+
+def test_profile_retrace_flag_settles(node):
+    """First launch of a never-seen kernel signature flags a retrace; an
+    identical repeat request must not."""
+    body = {"profile": True,
+            "query": {"match": {"title": "unrelated essay"}}}
+    node.search("items", body)  # warm: may or may not retrace
+    resp = node.search("items", body)
+    assert all(sh["tpu"]["jit_retrace"] is False
+               for sh in resp["profile"]["shards"])

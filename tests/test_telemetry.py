@@ -113,3 +113,190 @@ class TestProfile:
     def test_no_profile_by_default(self, node):
         res = node.search("t", {"query": {"match_all": {}}})
         assert "profile" not in res
+
+
+class TestTraceContextPropagation:
+    def test_restore_context_stitches_across_tracers(self):
+        from opensearch_tpu.telemetry import tracing
+
+        t_a = Tracer(name="nodeA")
+        t_b = Tracer(name="nodeB")
+        with t_a.start_span("coordinator") as coord:
+            ctx = tracing.current_trace_context()
+        assert ctx == {"trace_id": coord.trace_id, "span_id": coord.span_id}
+        # receiving "node": restore + open a child — one stitched trace
+        with tracing.restore_trace_context(ctx):
+            with t_b.start_span("shard") as shard:
+                assert shard.trace_id == coord.trace_id
+                assert shard.parent_id == coord.span_id
+        # span ids are tracer-name-prefixed: no cross-node collisions
+        assert coord.span_id.startswith("nodeA-")
+        assert shard.span_id.startswith("nodeB-")
+
+    def test_malformed_context_is_noop(self):
+        from opensearch_tpu.telemetry import tracing
+
+        t = Tracer()
+        for bad in (None, {}, {"trace_id": "x"}, "junk"):
+            with tracing.restore_trace_context(bad):
+                with t.start_span("orphan") as span:
+                    assert span.parent_id is None
+
+    def test_begin_end_span_joins_ring(self):
+        tracer = Tracer(name="n1")
+        span = tracer.begin_span("recovery.target", {"index": "i"})
+        assert span.end_ns == 0
+        tracer.end_span(span)
+        assert tracer.finished_spans()[-1] is span
+        assert span.duration_ns >= 0
+
+    def test_transports_propagate_trace(self):
+        """MockTransport captures the sender's context at send() and
+        restores it around the remote handler."""
+        from opensearch_tpu.telemetry import tracing
+        from opensearch_tpu.testing.sim import (
+            DeterministicTaskQueue,
+            MockTransport,
+        )
+
+        queue = DeterministicTaskQueue(5)
+        transport = MockTransport(queue)
+        t_a, t_b = Tracer(name="a"), Tracer(name="b")
+        seen = []
+
+        def handler(sender, payload):
+            with t_b.start_span("handle") as s:
+                seen.append((s.trace_id, s.parent_id))
+            return {"ok": True}
+
+        transport.register("b", "op", handler)
+        with t_a.start_span("send") as root:
+            transport.send("a", "b", "op", {})
+        queue.run_all()
+        assert seen == [(root.trace_id, root.span_id)]
+
+
+class TestSlowLogTraceCorrelation:
+    def test_entry_carries_trace_id(self):
+        sl = SlowLog("search")
+        sl.configure({"warn": 0})
+        tracer = Tracer()
+        with tracer.start_span("search") as span:
+            sl.maybe_log(5, "i", "slow query")
+        assert sl.entries()[-1]["trace_id"] == span.trace_id
+
+    def test_entry_without_active_span_has_no_trace_id(self):
+        sl = SlowLog("search")
+        sl.configure({"warn": 0})
+        sl.maybe_log(5, "i", "slow query")
+        assert "trace_id" not in sl.entries()[-1]
+
+
+class TestPrometheusExposition:
+    def _scrape(self, node):
+        from opensearch_tpu.rest.handlers import prometheus_metrics
+
+        status, text = prometheus_metrics(node, {}, {}, None)
+        assert status == 200
+        assert isinstance(text, str)
+        samples = {}
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            name, _, value = line.rpartition(" ")
+            samples[name] = float(value)
+        return text, samples
+
+    def test_round_trip_against_registry(self, node):
+        node.search("t", {"query": {"match": {"msg": "message"}}})
+        text, samples = self._scrape(node)
+        stats = node.telemetry.metrics.stats()
+        assert samples["opensearch_tpu_search_total"] == \
+            stats["counters"]["search.total"]
+        h = stats["histograms"]["search.took_ms"]
+        assert samples["opensearch_tpu_search_took_ms_count"] == h["count"]
+        assert samples["opensearch_tpu_search_took_ms_sum"] == h["sum"]
+        assert samples["opensearch_tpu_search_took_ms_max"] == h["max"]
+        # exposition declares types
+        assert "# TYPE opensearch_tpu_search_total counter" in text
+        assert "# TYPE opensearch_tpu_search_took_ms summary" in text
+        assert "# TYPE opensearch_tpu_tasks_running gauge" in text
+
+    def test_names_are_prometheus_safe(self, node):
+        node.search("t", {"query": {"match_all": {}}})
+        text, samples = self._scrape(node)
+        import re
+
+        for name in samples:
+            assert re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", name), name
+
+
+class TestTasksDetailed:
+    def test_detailed_lists_resource_stats(self, node):
+        from opensearch_tpu.rest.handlers import list_tasks
+
+        node.search("t", {"query": {"match_all": {}}})
+        status, resp = list_tasks(
+            node, {}, {"detailed": "true", "group_by": "none"}, None)
+        assert status == 200
+        (task,) = [t for t in resp["tasks"]
+                   if t["action"] == "cluster:monitor/tasks/lists"]
+        rs = task["resource_stats"]
+        assert rs["total"]["cpu_time_in_nanos"] >= 1
+        assert "memory_in_bytes" in rs["total"]
+        assert rs["thread_info"]["thread_executions"] >= 1
+
+    def test_completed_task_accumulates_cpu_time(self, node):
+        with node.task_manager.task_scope("indices:data/read/search",
+                                          description="spin") as task:
+            sum(i * i for i in range(200_000))  # burn some CPU
+        assert task.cpu_time_nanos > 0
+        assert task.thread_executions == 1
+        full = task.resource_stats()
+        assert full["total"]["cpu_time_in_nanos"] == task.cpu_time_nanos
+
+
+class TestNodesStatsSpans:
+    def test_spans_ring_in_nodes_stats(self, node):
+        from opensearch_tpu.rest.handlers import nodes_stats
+
+        node.telemetry.tracer.clear()
+        node.search("t", {"query": {"match": {"msg": "message"}}})
+        status, resp = nodes_stats(node, {"metric": "telemetry"}, {}, None)
+        assert status == 200
+        spans = resp["nodes"]["node-0"]["telemetry"]["spans"]
+        assert any(s["name"] == "search" for s in spans)
+        search_span = next(s for s in spans if s["name"] == "search")
+        assert search_span["trace_id"]
+        assert search_span["duration_ns"] >= 0
+
+
+class TestTraceIntegration:
+    """Regression tests: the trace features must fire on the REAL request
+    paths, not just when a test opens its own span."""
+
+    def test_real_search_slowlog_entry_carries_trace_id(self, node):
+        node.search_slowlog.configure({"info": 0})
+        node.telemetry.tracer.clear()
+        node.search("t", {"query": {"match": {"msg": "message"}}})
+        entry = node.search_slowlog.entries()[-1]
+        assert "trace_id" in entry, entry
+        search_span = next(s for s in node.telemetry.tracer.finished_spans()
+                           if s.name == "search")
+        assert entry["trace_id"] == search_span.trace_id
+
+    def test_phase_spans_land_in_node_ring(self, node):
+        from opensearch_tpu.telemetry.tracing import default_telemetry
+
+        node.telemetry.tracer.clear()
+        default_telemetry.tracer.clear()
+        node.search("t", {
+            "query": {"match": {"msg": "message"}},
+            "rescore": {"window_size": 5,
+                        "query": {"rescore_query": {"match_all": {}}}},
+        })
+        names = {s.name for s in node.telemetry.tracer.finished_spans()}
+        assert "search.rescore" in names, names
+        # nothing leaked into the process-global fallback ring
+        assert not any(s.name == "search.rescore"
+                       for s in default_telemetry.tracer.finished_spans())
