@@ -49,9 +49,13 @@ from pathlib import Path
 
 CACHE = Path(__file__).resolve().parent / "BENCH_CACHE.json"
 PROFILE_OUT = Path(__file__).resolve().parent / "BENCH_PROFILE.json"
+CONCURRENCY_OUT = Path(__file__).resolve().parent / "BENCH_CONCURRENCY.json"
 BUDGET_S = int(os.environ.get("BENCH_BUDGET_S", "1100"))
 PROBE_S = int(os.environ.get("BENCH_PROBE_S", "90"))
 PROFILE_BUDGET_S = int(os.environ.get("BENCH_PROFILE_BUDGET_S", "600"))
+CONCURRENCY_BUDGET_S = int(os.environ.get("BENCH_CONC_BUDGET_S", "900"))
+CONC_CLIENTS = int(os.environ.get("BENCH_CONC_CLIENTS", "16"))
+CONC_QUERIES = int(os.environ.get("BENCH_CONC_QUERIES", "125"))
 
 
 def _load_cache() -> dict:
@@ -265,6 +269,141 @@ def profile_child() -> None:
     }))
 
 
+def concurrency_parent() -> int:
+    """`bench.py --concurrency`: the concurrent-clients serving workload
+    (CONC_CLIENTS threads x CONC_QUERIES kNN searches each through the real
+    node API) with the dispatch batcher ON vs OFF, in a watchdogged child.
+    Reports QPS, p50/p99 latency, and mean merged batch size per config;
+    persists BENCH_CONCURRENCY.json alongside the other BENCH_* metrics."""
+    result, reason = _run(["--concurrency-child"], CONCURRENCY_BUDGET_S)
+    if result is None:
+        print(json.dumps({
+            "metric": "bench_error", "value": 0, "unit": "error",
+            "vs_baseline": 0, "detail": f"concurrency child failed: {reason}",
+        }))
+        return 1
+    try:
+        CONCURRENCY_OUT.write_text(json.dumps(result, indent=1) + "\n")
+    except OSError as e:
+        result["write_error"] = str(e)
+    print(json.dumps(result))
+    return 0
+
+
+def concurrency_child() -> None:
+    """Serve CONC_CLIENTS concurrent kNN clients against one node, batcher
+    on vs off, and emit the comparison. The corpus is sized to make the
+    per-dispatch overhead visible (the quantity batching amortizes) while
+    staying inside the CPU-backend budget."""
+    import tempfile
+    import threading
+
+    _pin_platform()
+    import numpy as np
+
+    from opensearch_tpu.node import TpuNode
+    from opensearch_tpu.search import executor
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    d = 64
+    n_docs = 20_000 if platform != "cpu" else 3_000
+    # every segment must take the streaming program (the serving hot path)
+    executor.STREAMING_MIN_DOCS = min(executor.STREAMING_MIN_DOCS, 1_024)
+
+    rng = np.random.default_rng(13)
+    node = TpuNode(Path(tempfile.mkdtemp(prefix="bench_conc_")))
+    node.create_index("bench", {
+        "settings": {"number_of_shards": 1},
+        "mappings": {"properties": {
+            "v": {"type": "knn_vector", "dimension": d, "space_type": "l2"},
+        }},
+    })
+    node.bulk([
+        ("index", {"_index": "bench", "_id": str(i)},
+         {"v": rng.standard_normal(d).astype(np.float32).tolist()})
+        for i in range(n_docs)
+    ], refresh=True)
+
+    queries = [
+        rng.standard_normal(d).astype(np.float32).tolist()
+        for _ in range(CONC_CLIENTS * CONC_QUERIES)
+    ]
+    body = {"size": 10}
+
+    def run_config(enabled: bool) -> dict:
+        node.knn_batcher.configure(
+            enabled=enabled, max_batch_size=CONC_CLIENTS, max_wait_ms=3,
+            max_queue=4 * CONC_CLIENTS * CONC_QUERIES,
+        )
+        node.knn_batcher.reset()
+        # warm: a short concurrent round compiles the batch-width program
+        # shapes this config will use, so the measured run is steady-state
+        warm_barrier = threading.Barrier(CONC_CLIENTS)
+
+        def warm(ci: int) -> None:
+            warm_barrier.wait()
+            for q in queries[ci::CONC_CLIENTS][:4]:
+                node.search("bench", {**body, "query": {
+                    "knn": {"v": {"vector": q, "k": 10}}}})
+
+        warm_threads = [threading.Thread(target=warm, args=(ci,))
+                        for ci in range(CONC_CLIENTS)]
+        for t in warm_threads:
+            t.start()
+        for t in warm_threads:
+            t.join()
+        node.knn_batcher.reset()
+        lat: list[list[float]] = [[] for _ in range(CONC_CLIENTS)]
+        barrier = threading.Barrier(CONC_CLIENTS + 1)
+
+        def client(ci: int) -> None:
+            mine = queries[ci * CONC_QUERIES:(ci + 1) * CONC_QUERIES]
+            barrier.wait()
+            for q in mine:
+                t0 = time.perf_counter()
+                node.search("bench", {**body, "query": {
+                    "knn": {"v": {"vector": q, "k": 10}}}})
+                lat[ci].append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(CONC_CLIENTS)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        flat = sorted(x for chunk in lat for x in chunk)
+        stats = node.knn_batcher.snapshot_stats()
+        return {
+            "batcher_enabled": enabled,
+            "clients": CONC_CLIENTS,
+            "queries_per_client": CONC_QUERIES,
+            "qps": round(len(flat) / wall, 1),
+            "p50_ms": round(1000 * flat[len(flat) // 2], 2),
+            "p99_ms": round(1000 * flat[int(len(flat) * 0.99)], 2),
+            "mean_merged_batch": round(stats["mean_merged_batch"], 2),
+            "dispatches": stats["dispatches"],
+            "rejections": stats["rejections"],
+        }
+
+    off = run_config(False)
+    on = run_config(True)
+    print(json.dumps({
+        "metric": f"concurrent_knn_qps_{CONC_CLIENTS}x{CONC_QUERIES}",
+        "value": on["qps"],
+        "unit": "queries/s",
+        "vs_baseline": round(on["qps"] / max(off["qps"], 1e-9), 2),
+        "platform": platform,
+        "corpus": {"docs": n_docs, "dim": d},
+        "batcher_on": on,
+        "batcher_off": off,
+    }))
+
+
 def _pin_platform():
     import jax
 
@@ -427,6 +566,18 @@ if __name__ == "__main__":
             }))
             sys.exit(1)
         sys.exit(0)
+    if "--concurrency-child" in sys.argv:
+        try:
+            concurrency_child()
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "metric": "bench_error", "value": 0, "unit": "error",
+                "vs_baseline": 0, "detail": str(e)[:200],
+            }))
+            sys.exit(1)
+        sys.exit(0)
+    if "--concurrency" in sys.argv:
+        sys.exit(concurrency_parent())
     if "--profile" in sys.argv:
         sys.exit(profile_parent())
     if "--probe" in sys.argv:

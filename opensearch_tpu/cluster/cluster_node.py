@@ -125,6 +125,17 @@ class ClusterNode:
         )
 
         self.settings_consumers = SettingsUpdateConsumers()
+        # kNN dispatch batcher: process-wide scheduler (one process == one
+        # device); this node wires its metrics sink and subscribes its
+        # settings keys to the cluster-state settings consumer, so dynamic
+        # updates reach the data plane in cluster mode too
+        from opensearch_tpu.search import batcher as _batcher_mod
+
+        self.knn_batcher = _batcher_mod.default_batcher
+        self.knn_batcher.metrics = self.telemetry.metrics
+        self.settings_consumers.register(
+            "search.knn.batch.", self.knn_batcher.apply_settings
+        )
         self.local_shards: dict[tuple[str, int], IndexShard] = {}
         self._mapper_services: dict[str, MapperService] = {}
         self._index_versions: dict[str, int] = {}

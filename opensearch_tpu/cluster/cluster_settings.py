@@ -94,6 +94,37 @@ for _task in ("search_task", "search_shard_task"):
 for _name in ("num_successive_breaches", "cpu_threshold", "heap_threshold"):
     DYNAMIC_CLUSTER_SETTINGS[f"search_backpressure.node_duress.{_name}"] = None
 
+def _validate_with_setting(setting) -> Callable[[Any], None]:
+    """Adapt a common.settings.Setting parser+validator to this registry."""
+    def validate(v: Any) -> None:
+        try:
+            value = setting.parser(v)
+        except (ValueError, TypeError):
+            raise IllegalArgumentException(
+                f"failed to parse value [{v!r}] for setting [{setting.key}]"
+            ) from None
+        if setting.validator is not None:
+            try:
+                setting.validator(value)
+            except Exception as e:  # noqa: BLE001 - surface as 400
+                raise IllegalArgumentException(str(e)) from None
+    return validate
+
+
+def _register_typed_settings() -> None:
+    # kNN dispatch batcher (search/batcher.py) + request-cache budget: the
+    # Setting objects carry parser/validator/default; the registry reuses
+    # them so PUT /_cluster/settings validation cannot drift from the
+    # component's own parsing
+    from opensearch_tpu.index.request_cache import CACHE_SIZE_SETTING
+    from opensearch_tpu.search.batcher import BATCH_SETTINGS
+
+    for s in (*BATCH_SETTINGS, CACHE_SIZE_SETTING):
+        DYNAMIC_CLUSTER_SETTINGS[s.key] = _validate_with_setting(s)
+
+
+_register_typed_settings()
+
 # prefix-registered settings (affix settings in the reference —
 # Setting.affixKeySetting): any key matching "<prefix>.<name>.<suffix>"
 DYNAMIC_AFFIX_SETTINGS: list[tuple[str, str]] = [

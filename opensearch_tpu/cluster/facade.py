@@ -115,9 +115,27 @@ class ClusterFacade:
         # and the data-plane handler spans share this node's ring, so
         # _nodes/stats and /_prometheus/metrics see both
         self.telemetry = cluster_node.telemetry
-        from opensearch_tpu.index.request_cache import RequestCache
+        from opensearch_tpu.index.request_cache import (
+            CACHE_SIZE_SETTING,
+            RequestCache,
+        )
 
         self.request_cache = RequestCache()
+
+        def _apply_cache_size(eff: dict) -> None:
+            from opensearch_tpu.common.settings import Settings
+
+            self.request_cache.set_max_bytes(
+                CACHE_SIZE_SETTING.get(Settings.from_flat(eff)))
+
+        cluster_node.settings_consumers.register(
+            CACHE_SIZE_SETTING.key, _apply_cache_size)
+        # the kNN dispatch batcher is process-wide (one process == one
+        # device); the facade shares it so cluster-mode stats see the same
+        # coalescing the data plane performs
+        from opensearch_tpu.search import batcher as _batcher_mod
+
+        self.knn_batcher = _batcher_mod.default_batcher
         from opensearch_tpu.common.monitor import MonitorService
 
         self.monitor = MonitorService(cluster_node.data_path)

@@ -76,6 +76,55 @@ class IndexingPressure:
         }
 
 
+class QueuePressure:
+    """Bounded-queue admission control: slot budgets that reject instead of
+    letting a queue grow without bound.
+
+    The queue-shaped sibling of :class:`IndexingPressure` (same shedding
+    contract — crossing the budget raises RejectedExecutionException ->
+    HTTP 429): producers acquire one slot per queued item and release it
+    when the item is dequeued, so `current` is the live queue depth and the
+    limit is the hard bound the queue can never exceed. Used by the kNN
+    dispatch batcher (search/batcher.py) for its pending-query queue."""
+
+    def __init__(self, limit: int, operation: str = "queued work"):
+        self.limit = int(limit)
+        self.operation = operation
+        self.current = 0
+        self.total = 0
+        self.rejections = 0
+        self._lock = threading.Lock()
+
+    def acquire(self, n: int = 1) -> None:
+        with self._lock:
+            if self.current + n > self.limit:
+                self.rejections += 1
+                raise RejectedExecutionException(
+                    f"rejected execution of {self.operation}: queue depth "
+                    f"[{self.current + n}] would exceed the bound "
+                    f"[{self.limit}]"
+                )
+            self.current += n
+            self.total += n
+
+    def release(self, n: int = 1) -> None:
+        with self._lock:
+            self.current = max(0, self.current - n)
+
+    def set_limit(self, limit: int) -> None:
+        with self._lock:
+            self.limit = int(limit)
+
+    def stats(self) -> dict:
+        with self._lock:  # the three counters must snapshot consistently
+            return {
+                "current": self.current,
+                "total": self.total,
+                "rejections": self.rejections,
+                "limit": self.limit,
+            }
+
+
 class _Release:
     def __init__(self, pressure: IndexingPressure, bytes_: int):
         self._pressure = pressure

@@ -90,7 +90,15 @@ class Translog:
         return self.dir / f"translog-{gen}.tlog"
 
     def _write_checkpoint(self) -> None:
-        tmp = self.dir / (CHECKPOINT_FILE + ".tmp")
+        # node close() (server loop thread) can race an in-flight write's
+        # per-request sync (data worker): per-thread tmp names keep each
+        # atomic replace self-contained instead of stealing a shared tmp
+        # (observed as FileNotFoundError in os.replace). Either content is
+        # a valid checkpoint; the later replace wins, and crash replay is
+        # seq_no-idempotent past a slightly stale offset.
+        import threading as _threading
+
+        tmp = self.dir / f"{CHECKPOINT_FILE}.{_threading.get_ident()}.tmp"
         with open(tmp, "wb") as f:
             f.write(self.checkpoint.to_bytes())
             f.flush()

@@ -1,4 +1,4 @@
-"""The five tpulint rules (TPU001–TPU005).
+"""The tpulint rules (TPU001–TPU007).
 
 Each checker is a single AST walk with a small amount of per-file context
 (scope, decorators, held locks). They are deliberately heuristic: the goal
@@ -675,6 +675,187 @@ class InjectableIdChecker(Checker):
 
 
 # ---------------------------------------------------------------------------
+# TPU007 — retracing risk
+# ---------------------------------------------------------------------------
+
+_CACHE_DECORATORS = {"lru_cache", "cache", "cached"}
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                     ast.SetComp)
+
+
+def _is_jit_wrapper(name: str | None) -> bool:
+    """jit/pjit only — NOT pallas_call: `pl.pallas_call(...)(...)` inside a
+    traced function is the standard Pallas idiom (the outer jit owns the
+    program's lifetime), so immediate invocation is not a retrace there."""
+    return name is not None and name.split(".")[-1] in ("jit", "pjit")
+
+
+def _is_cached_def(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        name = dotted_name(dec) or (
+            call_name(dec) if isinstance(dec, ast.Call) else None)
+        if name is not None and name.split(".")[-1] in _CACHE_DECORATORS:
+            return True
+    return False
+
+
+class _RetraceVisitor(ast.NodeVisitor):
+    """Walk one function body looking for jit wrappers whose compiled
+    program cannot outlive the call."""
+
+    def __init__(self, ctx: FileContext, fn: ast.AST):
+        self.ctx = ctx
+        self.fn = fn
+        self.out: list[Violation] = []
+        self.loop_depth = 0
+        # local name -> the jit call that produced it (this function's scope)
+        self._jit_locals: dict[str, ast.Call] = {}
+        self._flagged: set[int] = set()
+
+    def _flag(self, node: ast.AST, message: str) -> None:
+        if id(node) not in self._flagged:
+            self._flagged.add(id(node))
+            self.out.append(self.ctx.violation("TPU007", node, message))
+
+    # -- loops -------------------------------------------------------------
+
+    def visit_For(self, node: ast.For) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_While = visit_For  # type: ignore[assignment]
+
+    # nested defs get their own walk from the checker; don't descend
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._jit_locals.pop(node.name, None)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    # -- bindings ----------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        value = node.value
+        if isinstance(value, ast.Call) and _is_jit_wrapper(call_name(value)):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._jit_locals[t.id] = value
+        else:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._jit_locals.pop(t.id, None)
+
+    # -- calls -------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_name(node)
+        if _is_jit_wrapper(name):
+            self._check_static_args(node)
+            if self.loop_depth > 0:
+                self._flag(node, (
+                    f"fresh {name}() inside a loop compiles a new program "
+                    "every iteration (the wrapper, not the function, keys "
+                    "the jit cache); hoist it or use a cached factory"))
+        # jax.jit(f)(x): the wrapper dies with the expression — every call
+        # traces and compiles from scratch
+        if isinstance(node.func, ast.Call) and \
+                _is_jit_wrapper(call_name(node.func)):
+            self._flag(node, (
+                "immediately-invoked jit wrapper retraces on every call; "
+                "bind the jitted function once (module level or an "
+                "lru_cache'd factory) and call that"))
+        # local = jax.jit(...); ... local(x) in the SAME uncached function:
+        # the program is rebuilt on every outer call
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in self._jit_locals and \
+                not _is_cached_def(self.fn):
+            self._flag(node, (
+                f"[{node.func.id}] is a fresh jit wrapper created in this "
+                "function and called here: every outer call recompiles; "
+                "return it, cache the factory (functools.lru_cache), or "
+                "hoist to module scope"))
+        self.generic_visit(node)
+
+    def _check_static_args(self, jit_call: ast.Call) -> None:
+        """static args must be hashable: a list/dict/set bound to a static
+        parameter raises at best and silently retraces at worst."""
+        statics = _static_argnames_from_call(jit_call)
+        # functools.partial(f, kw=[...]) inside the jit call: the bound
+        # kwarg is part of the cache key
+        for arg in jit_call.args[:1]:
+            if isinstance(arg, ast.Call):
+                an = call_name(arg)
+                if an is not None and an.split(".")[-1] == "partial":
+                    for kw in arg.keywords:
+                        if isinstance(kw.value, _MUTABLE_LITERALS):
+                            self._flag(kw.value, (
+                                f"partial binds [{kw.arg}] to a non-hashable "
+                                "literal under jit; jit cache keys must be "
+                                "hashable — use a tuple/frozenset"))
+        if not statics:
+            return
+        target = jit_call.args[0] if jit_call.args else None
+        if isinstance(target, ast.Name):
+            # resolve a same-file def to inspect its static params' defaults
+            for fn_node in ast.walk(self.ctx.tree):
+                if isinstance(fn_node, ast.FunctionDef) and \
+                        fn_node.name == target.id:
+                    self._check_static_defaults(fn_node, statics, jit_call)
+                    break
+
+    def _check_static_defaults(self, fn: ast.FunctionDef, statics: set[str],
+                               jit_call: ast.Call) -> None:
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        pairs = list(zip(pos[len(pos) - len(args.defaults):], args.defaults))
+        pairs += [(p, d) for p, d in zip(args.kwonlyargs, args.kw_defaults)
+                  if d is not None]
+        for param, default in pairs:
+            if param.arg in statics and isinstance(default, _MUTABLE_LITERALS):
+                self._flag(jit_call, (
+                    f"static arg [{param.arg}] of [{fn.name}] defaults to a "
+                    "non-hashable literal; jit cache keys must be hashable "
+                    "— use a tuple/frozenset"))
+
+
+class RetracingRiskChecker(Checker):
+    rule_id = "TPU007"
+    name = "retracing-risk"
+    description = ("fresh jax.jit wrappers created per call (inside loops, "
+                   "immediately invoked, or built-and-called in an uncached "
+                   "function) and non-hashable static args")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return "jit" in source
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        # module level: only loops + immediate invocation + static args are
+        # risks (a module-level jit binding compiles once, which is the fix)
+        module_fn = ast.Module(body=[], type_ignores=[])
+        visitors = [(_RetraceVisitor(ctx, module_fn), ctx.tree, True)]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visitors.append((_RetraceVisitor(ctx, node), node, False))
+        for visitor, root, is_module in visitors:
+            body = root.body if isinstance(root.body, list) else [root.body]
+            for stmt in body:
+                if is_module and isinstance(
+                        stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                visitor.visit(stmt)
+            if is_module:
+                # a module-level `name = jax.jit(...)` binding is the
+                # recommended pattern: drop the built-and-called flags
+                visitor.out = [
+                    v for v in visitor.out if "created in this" not in v.message
+                ]
+            out.extend(visitor.out)
+        return out
+
+
+# ---------------------------------------------------------------------------
 # TPU005 — exception hygiene
 # ---------------------------------------------------------------------------
 
@@ -754,6 +935,7 @@ ALL_CHECKERS: list[Checker] = [
     DeterminismChecker(),
     ExceptionHygieneChecker(),
     InjectableIdChecker(),
+    RetracingRiskChecker(),
 ]
 
 RULES: dict[str, Checker] = {c.rule_id: c for c in ALL_CHECKERS}

@@ -397,6 +397,14 @@ class TpuNode:
         from opensearch_tpu.index.request_cache import RequestCache
 
         self.request_cache = RequestCache()
+        # kNN dispatch batcher (search/batcher.py): the scheduler is
+        # process-wide (one process == one device), the node adopts it for
+        # settings + stats + metrics wiring. Last-constructed node owns the
+        # metrics sink, matching the one-real-node-per-process deployment.
+        from opensearch_tpu.search import batcher as _batcher_mod
+
+        self.knn_batcher = _batcher_mod.default_batcher
+        self.knn_batcher.metrics = self.telemetry.metrics
         from opensearch_tpu.index.remote_store import RemoteStoreService
 
         self.remote_store = RemoteStoreService(self)
@@ -414,6 +422,10 @@ class TpuNode:
         self._voting_config_exclusions: list[dict] = []
         self.cluster_uuid = uuid.uuid4().hex[:22]
         self._state_version = 1
+        # persisted dynamic settings re-apply on boot (batcher config,
+        # request-cache budget survive restart like persistent settings do)
+        self.get_cluster_settings()
+        self._apply_dynamic_node_settings()
 
     def _configure_slowlogs(self) -> None:
         """Pick up index.search.slowlog.threshold.query.* /
@@ -531,10 +543,13 @@ class TpuNode:
         for part in expr.split(","):
             part = part.strip()
             if part in ("_all", "*"):
-                targets.extend(self.indices)
+                # list() snapshots: wildcard resolution runs on the
+                # parallel search pool concurrently with index creation
+                targets.extend(list(self.indices))
                 matched_any = True
             elif "*" in part or "?" in part:
-                hits = [n for n in self.indices if fnmatch.fnmatch(n, part)]
+                hits = [n for n in list(self.indices)
+                        if fnmatch.fnmatch(n, part)]
                 targets.extend(hits)
                 matched_any = matched_any or bool(hits)
                 if not hits and not allow_no_indices:
@@ -696,10 +711,12 @@ class TpuNode:
     # analog) ---------------------------------------------------------------
 
     def _alias_map(self) -> dict[str, list[str]]:
-        """alias name -> sorted member index names."""
+        """alias name -> sorted member index names. Iterates a list()
+        snapshot: searches resolve aliases on the parallel pool while the
+        serial data worker may be inserting/deleting indices."""
         out: dict[str, list[str]] = {}
-        for name, svc in self.indices.items():
-            for alias in svc.aliases:
+        for name, svc in list(self.indices.items()):
+            for alias in list(svc.aliases):
                 out.setdefault(alias, []).append(name)
         return {a: sorted(ns) for a, ns in out.items()}
 
@@ -2625,8 +2642,15 @@ class TpuNode:
                     cache_on = False
                     break
         cache_key = None
-        if _RC.cacheable(body, cache_on):
-            gens = [s.engine._refresh_generation for s in shards]
+        cache_snaps = None
+        if _RC.cacheable(body, cache_on) and precomputed_results is None:
+            # acquire the snapshots FIRST and key by THEIR generations:
+            # searches run on the parallel pool, so reading the engine's
+            # generation counter separately from the snapshot acquire could
+            # cache a pre-refresh response under the post-refresh key (a
+            # refresh bumps the counter before publishing the new searcher)
+            cache_snaps = [s.acquire_searcher() for s in shards]
+            gens = [snap.generation for snap in cache_snaps]
             shard_keys = [
                 (s.shard_id.index, s.shard_id.shard, s.engine.engine_uuid)
                 for s in shards
@@ -2640,6 +2664,7 @@ class TpuNode:
             "indices:data/read/search", description=f"indices[{expr}]"
         ) as task:
             resp = self._search_with_pipeline(pipeline_id, names, shards, body,
+                                              acquired=cache_snaps,
                                               shard_filters=shard_filters,
                                               task=task,
                                               precomputed_results=precomputed_results)
@@ -3236,9 +3261,13 @@ class TpuNode:
 
     def _reap_expired_contexts(self) -> None:
         now = _now_ms()
-        for cid in [c for c, ctx in self._reader_contexts.items()
-                    if ctx["expires_at"] < now]:
-            del self._reader_contexts[cid]
+        # PIT searches run on the parallel search pool: two reaps can race
+        # each other (and the serial worker's inserts), so iterate over an
+        # atomic list() snapshot and pop() — a victim already removed by a
+        # concurrent reap is simply gone, never a KeyError
+        for cid, ctx in list(self._reader_contexts.items()):
+            if ctx["expires_at"] < now:
+                self._reader_contexts.pop(cid, None)
 
     def _resolve_reader_context(self, cid: str, kind: str) -> dict:
         self._reap_expired_contexts()
@@ -3308,11 +3337,11 @@ class TpuNode:
     def clear_scroll(self, scroll_ids: list[str] | None) -> dict:
         self._reap_expired_contexts()
         freed = 0
-        ids = scroll_ids or [c for c, x in self._reader_contexts.items()
+        # list() snapshot: a parallel-pool PIT search may reap concurrently
+        ids = scroll_ids or [c for c, x in list(self._reader_contexts.items())
                              if x["kind"] == "scroll"]
         for cid in list(ids):
-            if cid in self._reader_contexts:
-                del self._reader_contexts[cid]
+            if self._reader_contexts.pop(cid, None) is not None:
                 freed += 1
         return {"succeeded": True, "num_freed": freed}
 
@@ -3342,20 +3371,18 @@ class TpuNode:
             {"pit_id": cid,
              "creation_time": ctx.get("creation_time", 0),
              "keep_alive": ctx["keep_alive_ms"]}
-            for cid, ctx in self._reader_contexts.items()
+            for cid, ctx in list(self._reader_contexts.items())
             if ctx["kind"] == "pit"
         ]
         return {"pits": pits}
 
     def close_pit(self, pit_ids: list[str] | None) -> dict:
         self._reap_expired_contexts()
-        ids = pit_ids or [c for c, x in self._reader_contexts.items()
+        ids = pit_ids or [c for c, x in list(self._reader_contexts.items())
                           if x["kind"] == "pit"]
         pits = []
         for cid in list(ids):
-            ok = cid in self._reader_contexts
-            if ok:
-                del self._reader_contexts[cid]
+            ok = self._reader_contexts.pop(cid, None) is not None
             pits.append({"pit_id": cid, "successful": ok})
         return {"pits": pits}
 
@@ -3462,6 +3489,35 @@ class TpuNode:
         "search.allow_expensive_queries": "true",
     }
 
+    def _apply_dynamic_node_settings(self, changed=()) -> None:
+        """Push the effective dynamic cluster settings into the node
+        components that consume them (the addSettingsUpdateConsumer analog
+        for the single-node deployment): the kNN dispatch batcher and the
+        request-cache byte budget.
+
+        The batcher is PROCESS-wide, so it is only touched when this
+        node's effective settings carry batch keys or this update
+        (`changed` = the keys the caller just PUT, including null
+        deletions) names one — another in-process node updating an
+        unrelated setting (or merely booting) must not clobber live
+        configuration with its own defaults. A null deletion reverts to
+        the Setting default: the deleted key is in `changed`, and
+        apply_settings/get resolve absent keys to defaults. The request
+        cache is per-node and applies unconditionally."""
+        from opensearch_tpu.cluster.cluster_settings import effective
+        from opensearch_tpu.common.settings import Settings
+        from opensearch_tpu.index.request_cache import CACHE_SIZE_SETTING
+        from opensearch_tpu.search.batcher import BATCH_SETTINGS
+
+        eff = effective(
+            getattr(self, "_cluster_settings", {}),
+            getattr(self, "_transient_cluster_settings", {}),
+        )
+        if any(s.key in eff or s.key in changed for s in BATCH_SETTINGS):
+            self.knn_batcher.apply_settings(eff)
+        self.request_cache.set_max_bytes(
+            CACHE_SIZE_SETTING.get(Settings.from_flat(eff)))
+
     def put_cluster_settings(self, body: dict, *, flat: bool = False) -> dict:
         """Single-node /_cluster/settings: same validation + persistent/
         transient model, persisted to disk (persistent only). The response
@@ -3483,6 +3539,8 @@ class TpuNode:
         self._transient_cluster_settings = merge(
             getattr(self, "_transient_cluster_settings", {}), transient
         )
+        self._apply_dynamic_node_settings(
+            changed=set(persistent) | set(transient))
         import json as _json
 
         self.data_path.mkdir(parents=True, exist_ok=True)

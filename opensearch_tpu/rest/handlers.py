@@ -1566,8 +1566,10 @@ def list_tasks(node: TpuNode, params, query, body):
 def prometheus_metrics(node: TpuNode, params, query, body):
     """GET /_prometheus/metrics — the node's MetricsRegistry rendered in
     Prometheus text exposition format (the prometheus-exporter plugin
-    surface): counters as `counter` samples, histograms as `summary`
-    `_count`/`_sum` pairs plus `_min`/`_max` gauges."""
+    surface): counters as `counter` samples, histograms as classic
+    bucketed `histogram` families (`_bucket{le=...}` cumulative series +
+    `_count`/`_sum`) plus `_min`/`_max` gauges. Batch-size and queue-wait
+    of the kNN dispatch batcher are the first bucketed users."""
     import re as _re
 
     def metric_name(name: str) -> str:
@@ -1586,7 +1588,11 @@ def prometheus_metrics(node: TpuNode, params, query, body):
     for name in sorted(stats["histograms"]):
         h = stats["histograms"][name]
         m = metric_name(name)
-        lines.append(f"# TYPE {m} summary")
+        lines.append(f"# TYPE {m} histogram")
+        for b in h.get("buckets", []):
+            lines.append(
+                f'{m}_bucket{{le="{fmt(b["le"])}"}} {fmt(b["count"])}')
+        lines.append(f'{m}_bucket{{le="+Inf"}} {fmt(h["count"])}')
         lines.append(f"{m}_count {fmt(h['count'])}")
         lines.append(f"{m}_sum {fmt(h['sum'])}")
         for gauge in ("min", "max"):
@@ -2915,7 +2921,7 @@ _NODES_STATS_METRICS = {
     "_all", "indices", "os", "process", "jvm", "thread_pool", "fs",
     "transport", "http", "breaker", "script", "discovery", "ingest",
     "adaptive_selection", "indexing_pressure", "search_backpressure",
-    "shard_indexing_pressure", "tasks", "telemetry", "slowlog",
+    "shard_indexing_pressure", "tasks", "telemetry", "slowlog", "knn_batch",
 }
 
 
@@ -2964,6 +2970,9 @@ def nodes_stats(node: TpuNode, params, query, body):
     for sec, default in zero.items():
         if not isinstance(indices_all.get(sec), dict):
             indices_all[sec] = dict(default)
+    # the request cache is NODE-scoped (one LRU across shards): the real
+    # byte-budget/eviction stats live on the node, not the per-shard zeros
+    indices_all["request_cache"] = node.request_cache.stats()
     indices_all["indexing"].setdefault("doc_status", {})
     if str(query.get("include_segment_file_sizes", "false")) \
             in ("true", ""):
@@ -3009,6 +3018,9 @@ def nodes_stats(node: TpuNode, params, query, body):
         "breakers": node.breakers.stats(),
         "indexing_pressure": node.indexing_pressure.stats(),
         "search_backpressure": node.search_backpressure.stats(),
+        # kNN dispatch batcher (search/batcher.py): merged-batch /
+        # queue-depth / shed counters for the cross-request micro-batching
+        "knn_batch": node.knn_batcher.snapshot_stats(),
         "telemetry": {
             **node.telemetry.metrics.stats(),
             # the tail of the spans ring: one stitched trace tree per

@@ -50,6 +50,19 @@ class HttpServer:
         # occupies the data worker (TaskManager is internally locked).
         self._executor = ThreadPoolExecutor(max_workers=1)
         self._mgmt_executor = ThreadPoolExecutor(max_workers=1)
+        # read-only search requests get a PARALLEL pool (the reference's
+        # `search` threadpool): they execute against immutable acquired
+        # snapshots, so N concurrent clients reach the kNN dispatch batcher
+        # concurrently and coalesce into shared device launches — on one
+        # worker they would serialize upstream and never merge. Scroll/PIT
+        # lifecycle requests stay on the serial data worker (they mutate
+        # the reader-context registry).
+        import os as _os
+
+        self._search_executor = ThreadPoolExecutor(
+            max_workers=min(8, (_os.cpu_count() or 2)),
+            thread_name_prefix="search",
+        )
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -66,6 +79,12 @@ class HttpServer:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        # release the worker pools: embedders and tests boot many servers
+        # per process, and idle non-daemon pool threads would otherwise
+        # accumulate for the process lifetime
+        for pool in (self._executor, self._mgmt_executor,
+                     self._search_executor):
+            pool.shutdown(wait=False)
 
     # -- connection handling ----------------------------------------------
 
@@ -147,6 +166,17 @@ class HttpServer:
 
     # -- dispatch ----------------------------------------------------------
 
+    @staticmethod
+    def _is_parallel_search(path: str, query: dict) -> bool:
+        """Read-only search requests eligible for the parallel pool.
+        Scroll START (?scroll=), scroll continuation (/_search/scroll), and
+        PIT lifecycle calls mutate the reader-context registry and stay on
+        the serial data worker."""
+        if "scroll" in query:
+            return False
+        tail = path.rsplit("/", 1)[-1]
+        return tail in ("_search", "_msearch", "_count")
+
     async def _dispatch(
         self, method: str, path: str, query: dict, raw_body: bytes
     ) -> tuple[int, Any, str]:
@@ -166,9 +196,14 @@ class HttpServer:
                 )
             # only the lock-protected TaskManager endpoints may run
             # concurrently with the data worker; stats/cat iterate engine
-            # structures that are single-writer
-            mgmt = path.startswith("/_tasks")
-            executor = self._mgmt_executor if mgmt else self._executor
+            # structures that are single-writer. Read-only searches run on
+            # the parallel search pool (see __init__).
+            if path.startswith("/_tasks"):
+                executor = self._mgmt_executor
+            elif self._is_parallel_search(path, query):
+                executor = self._search_executor
+            else:
+                executor = self._executor
             from opensearch_tpu.telemetry import default_telemetry
 
             telemetry = getattr(self.node, "telemetry", default_telemetry)

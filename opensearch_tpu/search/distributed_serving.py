@@ -42,6 +42,7 @@ unfiltered multi-shard queries, one vector per dispatch, are lifted:
 
 from __future__ import annotations
 
+import threading
 from typing import Any
 
 import numpy as np
@@ -54,7 +55,9 @@ from opensearch_tpu.parallel.distributed import build_knn_serving_step
 from opensearch_tpu.parallel.mesh import DATA_AXIS
 from opensearch_tpu.search.executor import ShardHit, ShardQueryResult
 
-# observability: tests and the multichip dryrun assert the serving path ran
+# observability: tests and the multichip dryrun assert the serving path
+# ran. Increment via _count(): searches run on a parallel pool, and a bare
+# `dict[k] += 1` drops counts under concurrent read-modify-write.
 stats = {
     "distributed_searches": 0,
     "fallbacks": 0,
@@ -62,6 +65,12 @@ stats = {
     "single_shard": 0,      # dispatches with s == 1
     "batched_queries": 0,   # total query vectors sent in B>1 dispatches
 }
+_STATS_LOCK = threading.Lock()
+
+
+def _count(key: str, n: int = 1) -> None:
+    with _STATS_LOCK:
+        stats[key] += n
 
 # kill switch (tests compare against the host merge; ops can disable)
 enabled = True
@@ -70,6 +79,10 @@ _BUNDLE_CACHE: dict[tuple, "_IndexBundle"] = {}
 _PROGRAM_CACHE: dict[tuple, Any] = {}
 _MESH_CACHE: dict[int, Mesh] = {}
 _MAX_BUNDLES = 8
+# searches run on a parallel pool since the kNN batcher PR: concurrent
+# cache misses must not race the evict-stale/insert sequence (a double
+# delete raises, and duplicate bundle builds double-upload the corpus)
+_CACHE_LOCK = threading.Lock()
 
 
 class _IndexBundle:
@@ -283,7 +296,7 @@ def try_distributed_knn_batch(
     )
     served = _can_serve(snaps, first.field, filtered=has_filter)
     if served is None:
-        stats["fallbacks"] += 1
+        _count("fallbacks")
         return None
     similarity, dims = served
     if any(len(node.vector) != dims for node in nodes):
@@ -301,15 +314,27 @@ def try_distributed_knn_batch(
         tuple(snap.generation for snap in snaps),
         tuple(len(snap.segments) for snap in snaps),
     )
-    bundle = _BUNDLE_CACHE.get(cache_key)
+    with _CACHE_LOCK:
+        bundle = _BUNDLE_CACHE.get(cache_key)
     if bundle is None:
-        # one live bundle per (index, field): refreshes replace it
-        for key in [k for k in _BUNDLE_CACHE if k[:2] == cache_key[:2]]:
-            del _BUNDLE_CACHE[key]
-        while len(_BUNDLE_CACHE) >= _MAX_BUNDLES:
-            del _BUNDLE_CACHE[next(iter(_BUNDLE_CACHE))]
+        # build OUTSIDE the lock: the device upload can take seconds for a
+        # large index and must not stall warm-path queries of other
+        # indexes. A same-key race (two cold misses) wastes one duplicate
+        # upload at worst — the re-check under the lock keeps the cache
+        # itself consistent.
         bundle = _build_bundle(snaps, first.field, dims, mesh)
-        _BUNDLE_CACHE[cache_key] = bundle
+        with _CACHE_LOCK:
+            existing = _BUNDLE_CACHE.get(cache_key)
+            if existing is not None:
+                bundle = existing
+            else:
+                # one live bundle per (index, field): refreshes replace it
+                for key in [k for k in _BUNDLE_CACHE
+                            if k[:2] == cache_key[:2]]:
+                    _BUNDLE_CACHE.pop(key, None)
+                while len(_BUNDLE_CACHE) >= _MAX_BUNDLES:
+                    del _BUNDLE_CACHE[next(iter(_BUNDLE_CACHE))]
+                _BUNDLE_CACHE[cache_key] = bundle
 
     valid = bundle.valid
     if has_filter:
@@ -334,12 +359,13 @@ def try_distributed_knn_batch(
     k_final = min(max(k_shard, int(fetch_k)), s * k_shard)
     prog_key = (n_devices, s, bundle.n_flat, dims, k_shard, k_final,
                 similarity, b_pad)
-    program = _PROGRAM_CACHE.get(prog_key)
-    if program is None:
-        program = build_knn_serving_step(
-            mesh, k_shard=k_shard, k_final=k_final, similarity=similarity
-        )
-        _PROGRAM_CACHE[prog_key] = program
+    with _CACHE_LOCK:
+        program = _PROGRAM_CACHE.get(prog_key)
+        if program is None:
+            program = build_knn_serving_step(
+                mesh, k_shard=k_shard, k_final=k_final, similarity=similarity
+            )
+            _PROGRAM_CACHE[prog_key] = program
 
     queries = jnp.asarray(q_host)
     with mesh:
@@ -349,13 +375,13 @@ def try_distributed_knn_batch(
     vals = np.asarray(vals)[:b]          # [b, k_final]
     gids = np.asarray(gids)[:b]
     counts = np.asarray(counts)[:, :b]   # [s, b]
-    stats["distributed_searches"] += 1
+    _count("distributed_searches")
     if has_filter:
-        stats["filtered"] += 1
+        _count("filtered")
     if s == 1:
-        stats["single_shard"] += 1
+        _count("single_shard")
     if b > 1:
-        stats["batched_queries"] += b
+        _count("batched_queries", b)
 
     out: list[list[ShardQueryResult]] = []
     for qi, node in enumerate(nodes):

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import logging
 import re
+import threading
 import time
 from dataclasses import dataclass, field as dc_field
 from typing import Any
@@ -65,8 +66,30 @@ I64_MAX = 2**63 - 1
 STREAMING_MIN_DOCS = 16_384
 STREAMING_CHUNK = 32_768
 
-# observability: which scan strategy served _exec_KnnQuery selections
+# observability: which scan strategy served _exec_KnnQuery selections.
+# Searches run on a parallel pool (rest/http.py), so increments go through
+# _count_knn_path — a bare `dict[k] += 1` is read-modify-write and drops
+# counts under concurrency.
 knn_path_stats = {"streaming": 0, "materializing": 0}
+_knn_path_stats_lock = threading.Lock()
+
+
+def _count_knn_path(kind: str) -> None:
+    with _knn_path_stats_lock:
+        knn_path_stats[kind] += 1
+
+
+def _pad_query_batch(rows: list) -> np.ndarray:
+    """Stack per-request query vectors into a [B_pad, d] batch, B padded to
+    the next power of two (zero rows, results sliced off by the caller) so
+    merged batch widths share compiled programs instead of retracing per
+    distinct concurrency level."""
+    b = len(rows)
+    b_pad = 1 << (b - 1).bit_length()
+    out = np.zeros((b_pad, len(rows[0])), np.float32)
+    for i, row in enumerate(rows):
+        out[i] = row
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -192,38 +215,85 @@ class ShardContext:
                 # as the ANN branch above)
                 k_bucket = 1 << (k_req - 1).bit_length()
                 chunk = min(STREAMING_CHUNK, n_pad)
+                sim = knn_ops.canonical_similarity(vf.similarity)
+                # cross-request micro-batching (search/batcher.py):
+                # concurrent filterless queries over this SAME segment
+                # column + reader generation coalesce into one padded
+                # batch launch. Filtered queries carry a request-private
+                # valid mask, so they never merge (key=None -> solo).
+                # The key's generation term is the snapshot-safety
+                # invariant: a refresh mid-flight is a different key.
+                from opensearch_tpu.search import batcher as batcher_mod
+
                 if (host.n_docs >= STREAMING_MIN_DOCS
                         and n_pad % chunk == 0 and k_bucket <= chunk):
                     from opensearch_tpu.ops import fused
 
-                    jfn = fused.cached_knn_streaming(
-                        k_bucket,
-                        knn_ops.canonical_similarity(vf.similarity),
-                        chunk,
+                    jfn = fused.cached_knn_streaming(k_bucket, sim, chunk)
+                    key = (
+                        ("knn_topk_streaming", id(vf),
+                         self.snapshot.generation, k_bucket, sim, chunk)
+                        if node.filter is None else None
                     )
-                    t_k = time.perf_counter_ns()
-                    vals, ids = jfn(vf.vectors, vf.norms_sq, valid, qv)
-                    vals = np.asarray(vals[0])
-                    ids = np.asarray(ids[0])
+
+                    def launch_streaming(rows):
+                        q_batch = _pad_query_batch(rows)
+                        with profile.profiling(None):
+                            b_vals, b_ids = jfn(
+                                vf.vectors, vf.norms_sq, valid, q_batch
+                            )
+                        # host materialization is the fence for this launch
+                        b_vals = np.asarray(b_vals)
+                        b_ids = np.asarray(b_ids)
+                        retraced = profile.signature_retraced(
+                            "knn_topk_streaming", (vf.vectors, q_batch),
+                            (k_bucket, chunk))
+                        return (
+                            [(b_vals[i], b_ids[i]) for i in range(len(rows))],
+                            retraced,
+                        )
+
+                    out = batcher_mod.dispatch(key, qv[0], launch_streaming)
+                    vals, ids = out.value
                     if prof is not None:
+                        # a batched operator owns its SHARE of the fenced
+                        # kernel wall (merged launches split evenly)
                         prof.record_kernel(
-                            "knn_topk_streaming",
-                            time.perf_counter_ns() - t_k, int(qv.nbytes),
-                            profile.signature_retraced(
-                                "knn_topk_streaming", (vf.vectors, qv),
-                                (k_bucket, chunk)),
+                            "knn_topk_streaming", out.kernel_share_ns,
+                            int(qv.nbytes), out.retraced,
                         )
                     scores = np.full(n_pad, -np.inf, np.float32)
                     finite = np.isfinite(vals)
                     scores[ids[finite]] = vals[finite]
-                    knn_path_stats["streaming"] += 1
+                    _count_knn_path("streaming")
                 else:
-                    scores = np.asarray(
-                        knn_ops.exact_knn_scores(
-                            qv, vf.vectors, vf.norms_sq, valid, vf.similarity
-                        )[0]
+                    key = (
+                        ("knn_exact_scores", id(vf),
+                         self.snapshot.generation, sim)
+                        if node.filter is None else None
                     )
-                    knn_path_stats["materializing"] += 1
+
+                    def launch_exact(rows):
+                        q_batch = _pad_query_batch(rows)
+                        with profile.profiling(None):
+                            b_scores = np.asarray(knn_ops.exact_knn_scores(
+                                q_batch, vf.vectors, vf.norms_sq, valid,
+                                vf.similarity,
+                            ))
+                        retraced = profile.signature_retraced(
+                            "knn_exact_scores", (vf.vectors, q_batch), (sim,))
+                        return (
+                            [b_scores[i] for i in range(len(rows))], retraced,
+                        )
+
+                    out = batcher_mod.dispatch(key, qv[0], launch_exact)
+                    scores = out.value
+                    if prof is not None:
+                        prof.record_kernel(
+                            "knn_exact_scores", out.kernel_share_ns,
+                            int(qv.nbytes), out.retraced,
+                        )
+                    _count_knn_path("materializing")
             per_seg_scores.append(scores)
             n_take = min(node.k, host.n_docs)
             top = np.argpartition(-scores[: host.n_docs], min(n_take, host.n_docs - 1))[:n_take]

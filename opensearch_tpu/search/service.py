@@ -968,9 +968,39 @@ def _try_distributed_query_phase(
         list(acquired) if acquired is not None
         else [s.acquire_searcher() for s in shards]
     )
-    results = distributed_serving.try_distributed_knn(
-        shards, snaps, node, fetch_k, alias_filters=filter_nodes
-    )
+    # cross-request micro-batching (search/batcher.py): concurrent
+    # filterless knn searches against the same (index, field, k,
+    # reader-generations) coalesce into ONE serving-program launch via the
+    # batch entry point the msearch path already uses. The generation tuple
+    # in the key is the snapshot-safety invariant: a refresh mid-flight is
+    # a different key, so no query is ever answered from another request's
+    # (older or newer) snapshot.
+    key = None
+    if node.filter is None and not any(f is not None for f in filter_nodes):
+        key = (
+            "distributed_knn", shards[0].shard_id.index, node.field,
+            int(node.k), int(fetch_k),
+            tuple(sh.engine.instance_id for sh in shards),
+            tuple(snap.generation for snap in snaps),
+            tuple(len(snap.segments) for snap in snaps),
+        )
+
+    if key is None:
+        results = distributed_serving.try_distributed_knn(
+            shards, snaps, node, fetch_k, alias_filters=filter_nodes
+        )
+    else:
+        from opensearch_tpu.search import batcher as batcher_mod
+
+        def launch(nodes_batch):
+            batched = distributed_serving.try_distributed_knn_batch(
+                shards, snaps, list(nodes_batch), fetch_k
+            )
+            if batched is None:  # ineligible: every member falls back
+                return [None] * len(nodes_batch), False
+            return batched, False
+
+        results = batcher_mod.dispatch(key, node, launch).value
     if results is None:
         return None
     return [

@@ -226,12 +226,24 @@ class _Counter:
             self.value += amount
 
 
+# default histogram bucket upper bounds: a 1-2-5 decade ladder wide enough
+# for both millisecond latencies and batch sizes; the terminal +Inf bucket
+# is implicit (Prometheus classic-histogram convention)
+DEFAULT_BUCKETS = (
+    1, 2, 5, 10, 25, 50, 100, 250, 500, 1_000, 2_500, 5_000, 10_000,
+    30_000, 60_000,
+)
+
+
 class _Histogram:
-    def __init__(self):
+    def __init__(self, buckets: tuple = DEFAULT_BUCKETS):
         self.count = 0
         self.total = 0.0
         self.min = float("inf")
         self.max = float("-inf")
+        self.buckets = tuple(sorted(buckets))
+        # cumulative counts per upper bound (le semantics); +Inf == count
+        self.bucket_counts = [0] * len(self.buckets)
         self._lock = threading.Lock()
 
     def record(self, value: float) -> None:
@@ -240,16 +252,26 @@ class _Histogram:
             self.total += value
             self.min = min(self.min, value)
             self.max = max(self.max, value)
+            for i, le in enumerate(self.buckets):
+                if value <= le:
+                    self.bucket_counts[i] += 1
 
     def stats(self) -> dict:
         with self._lock:  # consistent snapshot: record() holds this too
             if self.count == 0:
                 return {"count": 0, "sum": 0.0, "avg": 0.0,
-                        "min": 0.0, "max": 0.0}
+                        "min": 0.0, "max": 0.0,
+                        "buckets": [
+                            {"le": le, "count": 0} for le in self.buckets
+                        ]}
             return {
                 "count": self.count, "sum": self.total,
                 "avg": self.total / self.count,
                 "min": self.min, "max": self.max,
+                "buckets": [
+                    {"le": le, "count": c}
+                    for le, c in zip(self.buckets, self.bucket_counts)
+                ],
             }
 
 

@@ -1,0 +1,33 @@
+"""TPU007 true positives: jit wrappers that cannot outlive the call."""
+
+import functools
+
+import jax
+
+
+def f(x):
+    return x
+
+
+def kernel(x, ks=[1, 2]):  # noqa: B006 - the mutable default IS the bug
+    return x
+
+
+def loops(xs):
+    for x in xs:
+        fn = jax.jit(f)  # EXPECT: TPU007
+        del fn
+
+
+def immediate(x):
+    return jax.jit(f)(x)  # EXPECT: TPU007
+
+
+def built_and_called(x):
+    fn = jax.jit(f)
+    return fn(x)  # EXPECT: TPU007
+
+
+g = jax.jit(kernel, static_argnames=("ks",))  # EXPECT: TPU007
+
+h = jax.jit(functools.partial(f, ks=[1, 2]))  # EXPECT: TPU007

@@ -1,0 +1,34 @@
+"""TPU007 false-positive guards: the patterns the rule must NOT flag."""
+
+import functools
+
+import jax
+
+
+def f(x):
+    return x
+
+
+# module-level binding: compiles once, every caller shares the program
+jit_f = jax.jit(f)
+
+
+@functools.lru_cache(maxsize=8)
+def cached_factory(k: int):
+    # cached factory: one program per distinct k, reused forever
+    return jax.jit(functools.partial(f))
+
+
+def plain_factory():
+    # returns the wrapper without calling it — the CALLER owns its lifetime
+    return jax.jit(f)
+
+
+def serve(x):
+    fn = cached_factory(4)
+    return fn(x)
+
+
+# hashable statics are fine (tuples, ints, strings)
+g = jax.jit(f, static_argnames=("k",))
+h = jax.jit(functools.partial(f, ks=(1, 2)))

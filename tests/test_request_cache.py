@@ -46,3 +46,67 @@ def test_explicit_opt_in_and_clear(node):
     assert node.request_cache.clear("c") >= 1
     st = node.request_cache.stats()
     assert st["entries"] == 0
+
+
+def test_byte_budget_evicts_lru():
+    from opensearch_tpu.index.request_cache import RequestCache
+
+    cache = RequestCache(max_bytes=100)
+    cache.put(("a",), "x" * 40)
+    cache.put(("b",), "y" * 40)
+    assert cache.stats()["memory_size_in_bytes"] == 80
+    cache.get(("a",))                     # a becomes most-recent
+    cache.put(("c",), "z" * 40)           # 120 > 100: LRU (b) goes
+    st = cache.stats()
+    assert st["entries"] == 2 and st["evictions"] == 1
+    assert cache.get(("b",)) is None
+    assert cache.get(("a",)) == "x" * 40
+    assert st["memory_size_in_bytes"] == 80
+
+
+def test_oversized_response_never_cached_and_replace_accounts_bytes():
+    from opensearch_tpu.index.request_cache import RequestCache
+
+    cache = RequestCache(max_bytes=50)
+    cache.put(("big",), "x" * 51)         # larger than the whole budget
+    assert cache.stats()["entries"] == 0
+    cache.put(("k",), "a" * 10)
+    cache.put(("k",), "b" * 30)           # replacement must not double-count
+    assert cache.stats()["memory_size_in_bytes"] == 30
+
+
+def test_cache_size_setting_shrinks_live_cache(node):
+    node.search("c", {"size": 0, "query": {"term": {"tag": "a"}}})
+    assert node.request_cache.stats()["entries"] == 1
+    node.put_cluster_settings({
+        "persistent": {"indices": {"requests": {"cache": {"size": "1b"}}}}
+    })
+    # shrinking the budget evicts immediately and bounds future puts
+    assert node.request_cache.max_bytes == 1
+    assert node.request_cache.stats()["entries"] == 0
+    node.search("c", {"size": 0, "query": {"term": {"tag": "a"}}})
+    node.search("c", {"size": 0, "query": {"term": {"tag": "a"}}})
+    assert node.request_cache.stats()["entries"] == 0
+
+
+def test_cache_size_null_delete_restores_default(node):
+    from opensearch_tpu.index.request_cache import DEFAULT_MAX_BYTES
+
+    node.put_cluster_settings({
+        "persistent": {"indices": {"requests": {"cache": {"size": "1b"}}}}
+    })
+    assert node.request_cache.max_bytes == 1
+    node.put_cluster_settings({
+        "persistent": {"indices": {"requests": {"cache": {"size": None}}}}
+    })
+    assert node.request_cache.max_bytes == DEFAULT_MAX_BYTES
+
+
+def test_cache_size_setting_rejects_garbage(node):
+    from opensearch_tpu.common.errors import IllegalArgumentException
+
+    with pytest.raises(IllegalArgumentException):
+        node.put_cluster_settings({
+            "persistent": {"indices": {"requests": {"cache": {
+                "size": "not-a-size"}}}}
+        })

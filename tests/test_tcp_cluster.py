@@ -32,33 +32,40 @@ def free_ports(n: int) -> list[int]:
 
 async def http(port: int, method: str, path: str, body=None,
                timeout: float = 10.0):
-    reader, writer = await asyncio.open_connection("127.0.0.1", port)
-    try:
-        if isinstance(body, (bytes, str)):
-            data = body.encode() if isinstance(body, str) else body
-        elif body is not None:
-            data = json.dumps(body).encode()
-        else:
-            data = b""
-        writer.write(
-            (f"{method} {path} HTTP/1.1\r\nhost: x\r\n"
-             f"content-length: {len(data)}\r\n\r\n").encode() + data
-        )
-        await writer.drain()
-        status_line = await asyncio.wait_for(reader.readline(), timeout)
-        status = int(status_line.split()[1])
-        length = 0
-        while True:
-            line = await reader.readline()
-            if line in (b"\r\n", b"\n", b""):
-                break
-            k, _, v = line.decode().partition(":")
-            if k.strip().lower() == "content-length":
-                length = int(v)
-        payload = json.loads(await reader.readexactly(length)) if length else None
-        return status, payload
-    finally:
-        writer.close()
+    # the WHOLE exchange is deadline-bounded: a node dying mid-response
+    # used to hang the unguarded header/body reads forever, wedging the
+    # suite past the tier-1 budget instead of failing one request
+    async def _exchange():
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        try:
+            if isinstance(body, (bytes, str)):
+                data = body.encode() if isinstance(body, str) else body
+            elif body is not None:
+                data = json.dumps(body).encode()
+            else:
+                data = b""
+            writer.write(
+                (f"{method} {path} HTTP/1.1\r\nhost: x\r\n"
+                 f"content-length: {len(data)}\r\n\r\n").encode() + data
+            )
+            await writer.drain()
+            status_line = await reader.readline()
+            status = int(status_line.split()[1])
+            length = 0
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode().partition(":")
+                if k.strip().lower() == "content-length":
+                    length = int(v)
+            payload = (json.loads(await reader.readexactly(length))
+                       if length else None)
+            return status, payload
+        finally:
+            writer.close()
+
+    return await asyncio.wait_for(_exchange(), timeout)
 
 
 class TcpCluster:
@@ -92,7 +99,10 @@ class TcpCluster:
             except Exception:  # noqa: BLE001 - test teardown
                 pass
 
-    async def wait_leader(self, timeout_s: float = 60.0) -> str:
+    # 120s: elections under randomized backoff can take several rounds on
+    # a loaded CI box (the 60s budget flaked test_durable_state's phase-1
+    # boot during full-suite runs); an idle box still returns in <2s
+    async def wait_leader(self, timeout_s: float = 120.0) -> str:
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout_s
         while loop.time() < deadline:
@@ -214,30 +224,46 @@ def test_leader_kill_no_acked_write_loss(tcp_cluster):
         await cluster.servers[leader].aclose()
         del cluster.servers[leader]
 
-        # survivors re-elect and the cluster serves again
+        # survivors re-elect and the cluster serves again. The election
+        # under the randomized backoff can take several rounds on a loaded
+        # CI box, and the new leader still has to republish a state that
+        # promotes the dead node's primaries — so the test profile waits
+        # until EVERY survivor agrees on one leader before asserting
+        # anything about data (the 15s post-kill budget used previously
+        # flaked 2/3 runs at seed on this container).
         loop = asyncio.get_running_loop()
-        deadline = loop.time() + 60.0
+        deadline = loop.time() + 120.0
         new_leader = None
         while loop.time() < deadline:
             leaders = {n for n, s in cluster.servers.items()
                        if s.node.is_leader}
-            if len(leaders) == 1:
+            known = {s.node.coordinator.leader_id
+                     for s in cluster.servers.values()}
+            if len(leaders) == 1 and known == {next(iter(leaders))}:
                 new_leader = next(iter(leaders))
                 break
             await asyncio.sleep(0.1)
         assert new_leader is not None, "no re-election after leader kill"
 
         # every acknowledged write must still be readable (promotion kept
-        # the in-sync copy; acks waited for replication)
-        await http(p0, "POST", "/killtest/_refresh")
-        deadline = loop.time() + 15.0
+        # the in-sync copy; acks waited for replication). The refresh and
+        # the search both retry: right after the election the survivor may
+        # still route to the dead copy while promotion publishes.
+        deadline = loop.time() + 90.0
         total = -1
         while loop.time() < deadline:
-            status, resp = await http(
-                p0, "POST", "/killtest/_search",
-                {"query": {"match_all": {}}, "size": 0,
-                 "track_total_hits": True},
-            )
+            try:
+                await http(p0, "POST", "/killtest/_refresh", timeout=5.0)
+                status, resp = await http(
+                    p0, "POST", "/killtest/_search",
+                    {"query": {"match_all": {}}, "size": 0,
+                     "track_total_hits": True},
+                    timeout=5.0,
+                )
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError):
+                await asyncio.sleep(0.2)
+                continue
             if status == 200:
                 total = resp["hits"]["total"]["value"]
                 if total == 20:
@@ -324,17 +350,22 @@ def test_leader_kill_mid_bulk(tcp_cluster):
         # every acked doc must be readable after failover; promotion and
         # replica repair may still be settling, so retry to a deadline
         # (condition-based, r3 VERDICT item #10)
-        deadline = loop.time() + 30.0
+        deadline = loop.time() + 60.0
         missing = sorted(acked)
         while missing and loop.time() < deadline:
-            await http(p0, "POST", "/midbulk/_refresh")
-            still = []
-            for doc_id in missing:
-                status, resp = await http(p0, "GET",
-                                          f"/midbulk/_doc/{doc_id}")
-                if status != 200:
-                    still.append(doc_id)
-            missing = still
+            try:
+                await http(p0, "POST", "/midbulk/_refresh", timeout=5.0)
+                still = []
+                for doc_id in missing:
+                    status, resp = await http(p0, "GET",
+                                              f"/midbulk/_doc/{doc_id}",
+                                              timeout=5.0)
+                    if status != 200:
+                        still.append(doc_id)
+                missing = still
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError):
+                pass  # promotion still settling: retry the whole pass
             if missing:
                 await asyncio.sleep(0.3)
         assert not missing, f"acked writes lost: {missing[:10]} " \

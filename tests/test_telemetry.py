@@ -65,6 +65,22 @@ class TestMetrics:
         assert stats["counters"]["c"] == 5
         assert stats["histograms"]["h"]["avg"] == 15
 
+    def test_histogram_buckets_are_cumulative(self):
+        m = MetricsRegistry()
+        for v in (1, 3, 9, 40, 70_000):
+            m.histogram("h").record(v)
+        h = m.stats()["histograms"]["h"]
+        by_le = {b["le"]: b["count"] for b in h["buckets"]}
+        assert by_le[1] == 1          # just the 1
+        assert by_le[5] == 2          # 1, 3
+        assert by_le[10] == 3         # 1, 3, 9
+        assert by_le[50] == 4         # .. 40
+        assert by_le[60_000] == 4     # 70k only lands in +Inf
+        assert h["count"] == 5        # the implicit +Inf bucket
+        # cumulative monotonicity over the whole ladder
+        counts = [b["count"] for b in h["buckets"]]
+        assert counts == sorted(counts)
+
 
 class TestSlowLog:
     def test_threshold_levels(self):
@@ -217,10 +233,21 @@ class TestPrometheusExposition:
         assert samples["opensearch_tpu_search_took_ms_count"] == h["count"]
         assert samples["opensearch_tpu_search_took_ms_sum"] == h["sum"]
         assert samples["opensearch_tpu_search_took_ms_max"] == h["max"]
-        # exposition declares types
+        # exposition declares types — histograms are BUCKETED families now
         assert "# TYPE opensearch_tpu_search_total counter" in text
-        assert "# TYPE opensearch_tpu_search_took_ms summary" in text
+        assert "# TYPE opensearch_tpu_search_took_ms histogram" in text
         assert "# TYPE opensearch_tpu_tasks_running gauge" in text
+        # classic-histogram shape: cumulative le-labelled series ending in
+        # an +Inf bucket that equals _count
+        assert samples['opensearch_tpu_search_took_ms_bucket{le="+Inf"}'] \
+            == h["count"]
+        bucket_series = [
+            (name, v) for name, v in samples.items()
+            if name.startswith("opensearch_tpu_search_took_ms_bucket")
+        ]
+        assert len(bucket_series) >= 5
+        counts = [v for _n, v in bucket_series]
+        assert counts == sorted(counts)  # cumulative
 
     def test_names_are_prometheus_safe(self, node):
         node.search("t", {"query": {"match_all": {}}})
@@ -228,7 +255,8 @@ class TestPrometheusExposition:
         import re
 
         for name in samples:
-            assert re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", name), name
+            base = name.split("{")[0]  # bucket series carry an {le=} label
+            assert re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", base), name
 
 
 class TestTasksDetailed:
