@@ -267,6 +267,13 @@ class ClusterNode:
         self.settings_consumers.apply(
             effective(state.settings, state.transient_settings)
         )
+        # disk stats ride follower-check acks keyed by node id; departed
+        # nodes must not accrete entries forever (TPU009: every long-lived
+        # map on the sim/serving path needs eviction)
+        self._node_disk = {
+            nid: pct for nid, pct in self._node_disk.items()
+            if nid in state.nodes
+        }
         my_shards = {
             (r.index, r.shard): r for r in state.shards_for_node(self.node_id)
         }
@@ -1234,7 +1241,14 @@ class ClusterNode:
             if d.error is not None:
                 final.set_exception(d.error)
                 return
-            cont = self._continue_primary_write(payload, d.result)
+            try:
+                cont = self._continue_primary_write(payload, d.result)
+            except Exception as e:  # noqa: BLE001 - must fail the listener
+                # a raise here runs on the transport loop's completion
+                # callback: nobody above us would resolve `final`, and the
+                # client's write would wedge until (sim: forever) timeout
+                final.set_exception(e)
+                return
             if isinstance(cont, DeferredResponse):
                 cont.on_done(lambda c: (
                     final.set_exception(c.error) if c.error is not None
@@ -1365,7 +1379,13 @@ class ClusterNode:
             if d.error is not None:
                 final.set_exception(d.error)
                 return
-            cont = self._continue_primary_bulk(payload, d.result)
+            try:
+                cont = self._continue_primary_bulk(payload, d.result)
+            except Exception as e:  # noqa: BLE001 - must fail the listener
+                # same leak class as the single-doc path: an unresolved
+                # `final` never ships a response frame
+                final.set_exception(e)
+                return
             if isinstance(cont, DeferredResponse):
                 cont.on_done(lambda c: (
                     final.set_exception(c.error) if c.error is not None
@@ -1784,12 +1804,19 @@ class ClusterNode:
                 if remaining[0] == 0:
                     # re-enter the trace so coordinator -> shard -> reduce
                     # share one trace_id
-                    with tracing.restore_trace_context(ctx), \
-                            tracer.start_span("search.reduce", {
-                                "index": index, "node": self.node_id,
-                                "shards": len(results)}):
-                        merged = self._merge_search_results(
-                            results, size, from_, sort)
+                    try:
+                        with tracing.restore_trace_context(ctx), \
+                                tracer.start_span("search.reduce", {
+                                    "index": index, "node": self.node_id,
+                                    "shards": len(results)}):
+                            merged = self._merge_search_results(
+                                results, size, from_, sort)
+                    except Exception as e:  # noqa: BLE001
+                        # a reduce failure runs inside a transport
+                        # completion callback — raising here leaks the
+                        # listener and wedges the search forever (TPU008's
+                        # failure class); fail it instead
+                        merged = {"error": f"{type(e).__name__}: {e}"}
                     tracer.end_span(root)
                     callback(merged)
             return handle

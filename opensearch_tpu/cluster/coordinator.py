@@ -71,6 +71,11 @@ class Coordinator:
         self.node = node
         self.node_id = node.node_id
         self.peers = [p for p in peers if p != node.node_id]
+        # the configured seed hosts are kept forever (a wiped cluster can
+        # always be re-discovered from them); everything else in `peers` /
+        # `_known_peer_nodes` is evicted when the node leaves the applied
+        # state — otherwise node churn grows both without bound (TPU009)
+        self._seed_peers: tuple[str, ...] = tuple(self.peers)
         self.transport = transport
         self.scheduler = scheduler
         # set by the node layer (ClusterNode) to its per-node tracer;
@@ -514,8 +519,31 @@ class Coordinator:
             if state.version == self.applied_state.version:
                 return
         self.applied_state = state
+        self._prune_peer_books(state)
         if self.on_state_applied is not None:
             self.on_state_applied(state)
+
+    def _prune_peer_books(self, state: ClusterState) -> None:
+        """Bound the discovery books to live ids: configured seeds, nodes
+        in the applied state, and current-term voters (a joiner mid-flight
+        has voted but may not be published yet). Node ids are minted per
+        process lifetime, so without this a long-lived leader accretes an
+        entry per restart forever."""
+        keep = set(self._seed_peers) | set(state.nodes)
+        keep |= set(self.coord.join_votes)
+        keep.add(self.node_id)
+        self._known_peer_nodes = {
+            nid: n for nid, n in self._known_peer_nodes.items()
+            if nid in keep
+        }
+        kept = [p for p in self.peers if p in keep]
+        # late-joining nodes learned from the state become dial targets on
+        # every node (PeerFinder's last-accepted-state discovery source),
+        # not just on the leader that processed their join
+        for nid in sorted(state.nodes):
+            if nid != self.node_id and nid not in kept:
+                kept.append(nid)
+        self.peers = kept
 
     # ------------------------------------------------------------------ #
     # failure detection (FollowersChecker / LeaderChecker analog)

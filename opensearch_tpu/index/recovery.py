@@ -125,6 +125,13 @@ class RecoverySourceSessions:
     # sessions idle longer than this are reaped (a target that died without
     # finalizing must not pin segment blobs forever)
     SESSION_TTL_MS = 10 * 60 * 1000
+    # hard count bound on concurrently open sessions: each pins packed
+    # segment blobs / op dumps in memory, so a storm of recovery starts
+    # (chaos restarts, flapping targets) must evict the stalest instead of
+    # accreting snapshots until OOM (TPU009's bound-or-evict contract).
+    # An evicted target's next chunk request fails -> its driver retries
+    # the recovery from scratch, which reopens a fresh session.
+    MAX_SESSIONS = 64
 
     def __init__(self):
         self._sessions: dict[tuple[str, int, str], dict] = {}
@@ -139,7 +146,13 @@ class RecoverySourceSessions:
             "max_seq_no": max_seq_no,
             "touched_ms": _now_ms(),
         }
-        self._sessions[(index, shard, target)] = session
+        key = (index, shard, target)
+        while len(self._sessions) >= self.MAX_SESSIONS and \
+                key not in self._sessions:
+            stalest = min(self._sessions,
+                          key=lambda k: self._sessions[k]["touched_ms"])
+            del self._sessions[stalest]
+        self._sessions[key] = session
         return session
 
     def get(self, index: str, shard: int, target: str) -> dict | None:

@@ -1,6 +1,6 @@
-"""tpulint: AST-based invariant checker for this codebase.
+"""tpulint: AST + dataflow invariant checker for this codebase.
 
-Five project-specific rules guard the invariants that ordinary linters
+Ten project-specific rules guard the invariants that ordinary linters
 cannot see:
 
 - TPU001 jit-purity        — no host syncs / nonlocal mutation /
@@ -16,10 +16,27 @@ cannot see:
                              time.time() / random.* / datetime.now()
 - TPU005 exception-hygiene — ``except Exception`` bodies must log,
                              re-raise, or record the error
+- TPU006 injectable-ids    — no uuid4/os.urandom/secrets.* in sim-run
+                             modules; entropy comes from randutil/the
+                             scheduler's seeded Random
+- TPU007 retracing-risk    — no fresh jax.jit wrapper whose compiled
+                             program dies with the call
+- TPU008 callback-leak     — path-sensitive must-call-exactly-once over
+                             the per-function CFG (lint/cfg.py): no path
+                             through a listener handler may drop both
+                             on_response/on_failure or invoke both
+- TPU009 unbounded-growth  — long-lived transport/queue attributes must
+                             have a size bound, shed, or eviction
+- TPU010 lock-order        — TPU003's inversion detection propagated
+                             across method boundaries via acquired-locks
+                             call summaries
 
 Run with ``python -m opensearch_tpu.lint [paths]``; violations already
 present in ``lint_baseline.json`` are tolerated (ratchet), new ones fail.
-Suppress a line with ``# tpulint: disable=TPU00N``.
+``--fix`` applies mechanical rewrites (wallclock -> timeutil, entropy ->
+randutil, swallowed ``except: pass`` -> logged); ``--changed`` lints only
+files differing from git HEAD; ``--jobs N`` parses in parallel. Suppress
+a line with ``# tpulint: disable=TPU00N``.
 """
 
 from opensearch_tpu.lint.core import (  # noqa: F401

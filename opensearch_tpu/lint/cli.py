@@ -1,9 +1,15 @@
 """tpulint CLI: ``python -m opensearch_tpu.lint [paths] [--format text|json]``.
 
 Exit codes: 0 clean (all violations covered by the baseline), 1 when new
-violations regress past the baseline (or any file fails to parse), 2 on
-usage errors. Single process, single pass, no imports of checked modules —
-the full tree lints in well under 10s.
+violations regress past the baseline (or any file fails to parse, or
+``--fix --dry-run`` finds pending rewrites), 2 on usage errors. No imports
+of checked modules — the full tree lints in well under 10s; ``--jobs``
+parses files in a process pool and ``--changed`` restricts the run to
+files differing from ``git HEAD`` so the pre-commit loop stays instant.
+
+``--fix`` applies the mechanical rewrites from lint/fixes.py in place
+(``--fix --dry-run`` only reports them); the lint pass then runs on the
+rewritten tree.
 """
 
 from __future__ import annotations
@@ -11,11 +17,12 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
 from opensearch_tpu.lint import baseline as baseline_mod
-from opensearch_tpu.lint.core import lint_paths
+from opensearch_tpu.lint.core import iter_py_files, lint_paths
 from opensearch_tpu.lint.rules import ALL_CHECKERS, RULES
 
 # repo root when running from a checkout (cli.py -> lint -> opensearch_tpu -> root)
@@ -35,7 +42,7 @@ def _default_baseline() -> str | None:
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="python -m opensearch_tpu.lint",
-        description="AST-based invariant checker (rules TPU001-TPU005)",
+        description="AST+dataflow invariant checker (rules TPU001-TPU010)",
     )
     parser.add_argument(
         "paths", nargs="*", default=None,
@@ -58,7 +65,57 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--list-rules", action="store_true",
         help="print the rule catalog and exit")
+    parser.add_argument(
+        "--fix", action="store_true",
+        help="apply mechanical rewrites (wallclock -> timeutil, entropy "
+             "-> randutil, `except: pass` -> logged) before linting")
+    parser.add_argument(
+        "--dry-run", action="store_true",
+        help="with --fix: report pending rewrites without writing; exits "
+             "1 if any are pending")
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="lint only files differing from git HEAD (plus untracked)")
+    parser.add_argument(
+        "--jobs", type=int, default=None, metavar="N",
+        help="parse/check files in N worker processes "
+             "(default: auto for repo-wide runs, serial for small ones)")
     return parser
+
+
+def _changed_files() -> list[str] | None:
+    """Python files differing from HEAD (modified or untracked). None on
+    git failure (not a repo, no git)."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=30)
+        if top.returncode != 0:
+            return None
+        root = top.stdout.strip()
+        names: list[str] = []
+        for cmd in (["git", "diff", "--name-only", "HEAD"],
+                    ["git", "ls-files", "--others", "--exclude-standard"]):
+            # run from the toplevel: `diff --name-only` is always
+            # root-relative but `ls-files --others` is CWD-relative, and
+            # the two must agree before joining onto `root`
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=30, cwd=root)
+            if proc.returncode != 0:
+                return None
+            names.extend(proc.stdout.splitlines())
+    except (OSError, subprocess.SubprocessError):
+        return None
+    out: list[str] = []
+    seen: set[str] = set()
+    for name in names:
+        if not name.endswith(".py") or name in seen:
+            continue
+        seen.add(name)
+        full = os.path.join(root, name)
+        if os.path.isfile(full):
+            out.append(full)
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -85,6 +142,10 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         checkers = [RULES[r] for r in sorted(wanted)]
 
+    if args.dry_run and not args.fix:
+        print("--dry-run only makes sense with --fix", file=sys.stderr)
+        return 2
+
     paths = args.paths or [os.path.join(_PKG_ROOT, "opensearch_tpu")]
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
@@ -92,8 +153,38 @@ def main(argv: list[str] | None = None) -> int:
         print("no such file or directory: " + ", ".join(missing),
               file=sys.stderr)
         return 2
+    if args.changed:
+        changed = _changed_files()
+        if changed is None:
+            print("--changed requires a git checkout", file=sys.stderr)
+            return 2
+        # restrict to the requested paths (default: the package)
+        roots = [os.path.abspath(p) for p in paths]
+        paths = [
+            f for f in changed
+            if any(os.path.abspath(f) == r
+                   or os.path.abspath(f).startswith(r + os.sep)
+                   for r in roots)
+        ]
+        if not paths:
+            print("no changed python files under "
+                  + ", ".join(os.path.relpath(r) for r in roots))
+            return 0
+
+    fixes_report: list | None = None
+    if args.fix:
+        from opensearch_tpu.lint import fixes as fixes_mod
+
+        files = list(iter_py_files(paths))
+        fixes_report, _changed_count = fixes_mod.fix_paths(
+            files, write=not args.dry_run)
+
     t0 = time.monotonic()
-    violations, files_checked = lint_paths(paths, checkers)
+    jobs = args.jobs
+    if jobs is None:
+        # auto: a repo-wide run amortizes pool startup; tiny runs don't
+        jobs = min(8, os.cpu_count() or 1)
+    violations, files_checked = lint_paths(paths, checkers, jobs=jobs)
     elapsed = time.monotonic() - t0
 
     baseline_path = None if args.no_baseline else (
@@ -134,7 +225,7 @@ def main(argv: list[str] | None = None) -> int:
             new_violations.append(v)
 
     if args.format == "json":
-        print(json.dumps({
+        report = {
             "version": 1,
             "files_checked": files_checked,
             "elapsed_seconds": round(elapsed, 3),
@@ -144,8 +235,16 @@ def main(argv: list[str] | None = None) -> int:
             "regressions": [r.to_dict() for r in regressions],
             "new_violations": [v.to_dict() for v in new_violations],
             "stale_baseline_entries": [s.to_dict() for s in stale],
-        }, indent=2))
+        }
+        if fixes_report is not None:
+            key = "pending_fixes" if args.dry_run else "applied_fixes"
+            report[key] = [f.to_dict() for f in fixes_report]
+        print(json.dumps(report, indent=2))
     else:
+        if fixes_report is not None:
+            verb = "would fix" if args.dry_run else "fixed"
+            for f in fixes_report:
+                print(f"{verb}: {f.render()}")
         if baseline is None:
             for v in violations:
                 print(v.render())
@@ -167,6 +266,8 @@ def main(argv: list[str] | None = None) -> int:
               f"{len(regressions)} regression(s)"
               + (f" [baseline: {baseline_path}]" if baseline_path else ""))
 
+    if args.fix and args.dry_run and fixes_report:
+        return 1  # pending mechanical rewrites fail the gate
     if baseline is None:
         return 1 if violations else 0
     return 1 if regressions else 0

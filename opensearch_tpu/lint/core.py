@@ -219,29 +219,73 @@ def lint_source(
     return out
 
 
+def _lint_file(f: str, checkers: Iterable[Checker]) -> list[Violation]:
+    try:
+        with open(f, encoding="utf-8") as fh:
+            source = fh.read()
+    except OSError as e:
+        return [Violation(
+            rule=PARSE_ERROR_RULE, path=normalize_path(f),
+            line=1, col=1, message=f"cannot read file: {e}",
+        )]
+    return lint_source(f, source, checkers)
+
+
+def _lint_file_by_rules(args: tuple[str, tuple[str, ...]]) -> list[Violation]:
+    """Process-pool worker: files are dispatched with RULE IDS (picklable)
+    and each worker resolves them against its own module-level registry."""
+    f, rule_ids = args
+    from opensearch_tpu.lint.rules import RULES
+
+    return _lint_file(f, [RULES[r] for r in rule_ids])
+
+
 def lint_paths(
     paths: Iterable[str],
     checkers: Iterable[Checker] | None = None,
+    jobs: int | None = None,
 ) -> tuple[list[Violation], int]:
-    """Lint every .py file under `paths`. Returns (violations, files_checked)."""
+    """Lint every .py file under `paths`. Returns (violations, files_checked).
+
+    ``jobs > 1`` parses/checks files in a process pool (per-file work is
+    independent by construction — every checker gets a fresh FileContext).
+    Parallel dispatch requires registry checkers (rule ids are what
+    crosses the process boundary); custom checker instances fall back to
+    serial, as does any pool failure.
+    """
     if checkers is None:
         from opensearch_tpu.lint.rules import ALL_CHECKERS
 
         checkers = ALL_CHECKERS
     checkers = list(checkers)
+    files = list(iter_py_files(paths))
     violations: list[Violation] = []
-    n = 0
-    for f in iter_py_files(paths):
-        n += 1
-        try:
-            with open(f, encoding="utf-8") as fh:
-                source = fh.read()
-        except OSError as e:
-            violations.append(Violation(
-                rule=PARSE_ERROR_RULE, path=normalize_path(f),
-                line=1, col=1, message=f"cannot read file: {e}",
-            ))
-            continue
-        violations.extend(lint_source(f, source, checkers))
+
+    if jobs is not None and jobs > 1 and len(files) >= 2 * jobs:
+        from opensearch_tpu.lint.rules import RULES
+
+        rule_ids = tuple(sorted(
+            c.rule_id for c in checkers
+            if RULES.get(c.rule_id) is c
+        ))
+        if len(rule_ids) == len(checkers):
+            try:
+                import concurrent.futures as _cf
+
+                with _cf.ProcessPoolExecutor(max_workers=jobs) as pool:
+                    for batch in pool.map(
+                        _lint_file_by_rules,
+                        [(f, rule_ids) for f in files],
+                        chunksize=max(1, len(files) // (jobs * 4)),
+                    ):
+                        violations.extend(batch)
+                violations.sort(key=Violation.sort_key)
+                return violations, len(files)
+            except (OSError, RuntimeError,
+                    ImportError):  # pragma: no cover - env-specific
+                violations = []  # pool unavailable: fall through to serial
+
+    for f in files:
+        violations.extend(_lint_file(f, checkers))
     violations.sort(key=Violation.sort_key)
-    return violations, n
+    return violations, len(files)

@@ -1,11 +1,15 @@
-"""The tpulint rules (TPU001–TPU007).
+"""The tpulint rules (TPU001–TPU010).
 
-Each checker is a single AST walk with a small amount of per-file context
-(scope, decorators, held locks). They are deliberately heuristic: the goal
-is catching the invariant breaks that have bitten this codebase (host syncs
-under jit, wall-clock in sim-run modules, swallowed exceptions), not a
-sound type system. False positives are absorbed by the baseline ratchet or
-a ``# tpulint: disable=`` comment.
+TPU001-TPU007 are single AST walks with a small amount of per-file context
+(scope, decorators, held locks). TPU008 and TPU010 sit on the dataflow
+layer in lint/cfg.py: a per-function CFG with path-sensitive walks
+(callback-leak) and a call-graph/summary pass (interprocedural lock
+order). They are deliberately heuristic: the goal is catching the
+invariant breaks that have bitten this codebase (host syncs under jit,
+wall-clock in sim-run modules, swallowed exceptions, dropped transport
+listeners, unbounded serving-path buffers), not a sound type system.
+False positives are absorbed by the baseline ratchet or a
+``# tpulint: disable=`` comment.
 """
 
 from __future__ import annotations
@@ -13,6 +17,7 @@ from __future__ import annotations
 import ast
 from typing import Iterable
 
+from opensearch_tpu.lint import cfg as cfg_mod
 from opensearch_tpu.lint.core import (
     Checker,
     FileContext,
@@ -576,8 +581,25 @@ _SIM_MODULE_PATTERNS = (
     "opensearch_tpu/transport/",
     "opensearch_tpu/index/recovery.py",
 )
-# a file can opt in explicitly (fixtures, new sim-run modules)
+# a file can opt in explicitly (fixtures, new sim-run modules); the marker
+# must START a line so a source file merely MENTIONING it (this one) does
+# not opt itself in
 _SIM_MARKER = "# tpulint: deterministic-module"
+_SIM_MARKER_RE = None  # compiled lazily below
+
+
+def _sim_scoped(display_path: str, source: str) -> bool:
+    global _SIM_MARKER_RE
+    if any(p in display_path for p in _SIM_MODULE_PATTERNS):
+        return True
+    if _SIM_MARKER not in source:
+        return False
+    if _SIM_MARKER_RE is None:
+        import re
+
+        _SIM_MARKER_RE = re.compile(
+            r"(?m)^\s*" + re.escape(_SIM_MARKER))
+    return _SIM_MARKER_RE.search(source) is not None
 
 _WALLCLOCK_CALLS = {
     "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
@@ -600,9 +622,7 @@ class DeterminismChecker(Checker):
                    "modules that run under the deterministic sim")
 
     def applies_to(self, display_path: str, source: str) -> bool:
-        if _SIM_MARKER in source:
-            return True
-        return any(p in display_path for p in _SIM_MODULE_PATTERNS)
+        return _sim_scoped(display_path, source)
 
     def check(self, ctx: FileContext) -> Iterable[Violation]:
         out: list[Violation] = []
@@ -655,9 +675,7 @@ class InjectableIdChecker(Checker):
                    "random.Random, the tracer's counter)")
 
     def applies_to(self, display_path: str, source: str) -> bool:
-        if _SIM_MARKER in source:
-            return True
-        return any(p in display_path for p in _SIM_MODULE_PATTERNS)
+        return _sim_scoped(display_path, source)
 
     def check(self, ctx: FileContext) -> Iterable[Violation]:
         out: list[Violation] = []
@@ -927,6 +945,661 @@ class ExceptionHygieneChecker(Checker):
 
 
 # ---------------------------------------------------------------------------
+# TPU008 — callback-leak (path-sensitive must-call-exactly-once on lint/cfg)
+# ---------------------------------------------------------------------------
+
+# completion-callback pairs (the transport contract: exactly ONE of the
+# pair must fire) and single-listener parameter names (must fire once)
+_CALLBACK_PAIRS = (("on_response", "on_failure"), ("on_ok", "on_give_up"))
+_SINGLE_LISTENERS = ("callback", "listener", "on_done", "done")
+
+
+def _fn_param_names(fn: ast.AST) -> set[str]:
+    args = getattr(fn, "args", None)
+    if args is None:
+        return set()
+    names = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _PathState:
+    """Accumulated resolution facts along one CFG path."""
+
+    __slots__ = ("invokes", "escaped", "events")
+
+    def __init__(self) -> None:
+        self.invokes = 0
+        self.escaped = False
+        self.events: list[tuple[str, ast.AST]] = []  # (kind, node)
+
+
+class _EventWalker:
+    """Extract resolution events from one statement/expression: direct
+    invocations of a tracked callback, delegations to a local helper whose
+    body (transitively) references one, and escapes — the callback stored,
+    returned, or passed onward, i.e. resolved later by someone else."""
+
+    def __init__(self, tracked: set[str], carriers: set[str]):
+        self.tracked = tracked
+        self.carriers = carriers
+
+    def walk(self, node: ast.AST, state: _PathState) -> None:
+        # a carrier CALL only counts as delegation when its result is
+        # discarded (`helper(x)` as a statement, or `return helper(x)`):
+        # a factory call whose result is passed onward
+        # (`send(on_response=make_handler())`) produces the resolver, it
+        # does not resolve — that value escaping is the resolution
+        if isinstance(node, ast.Expr):
+            self._visit(node.value, state, discard=True)
+        elif isinstance(node, ast.Return) and node.value is not None:
+            self._visit(node.value, state, discard=True)
+        elif isinstance(node, ast.expr):
+            # a bare expression in a block is a branch test / with-item /
+            # loop iterable the CFG emitted: truthiness reads of a tracked
+            # name there (`if on_response:`) are feasibility tests — the
+            # same fact branch_infeasible prunes on — not escapes
+            self._visit_test(node, state)
+        else:
+            self._visit(node, state)
+
+    def _visit_test(self, node: ast.AST, state: _PathState) -> None:
+        if isinstance(node, ast.Name) and \
+                node.id in (self.tracked | self.carriers):
+            return
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            self._visit_test(node.operand, state)
+            return
+        if isinstance(node, ast.BoolOp):
+            for value in node.values:
+                self._visit_test(value, state)
+            return
+        self._visit(node, state)
+
+    def _visit(self, node: ast.AST, state: _PathState,
+               discard: bool = False) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # a definition is inert until used
+        if isinstance(node, ast.Lambda):
+            # a lambda in expression position IS being used: if its body
+            # touches a tracked name (or a carrier), the callback escapes
+            # into deferred execution
+            if _names_in(node.body) & (self.tracked | self.carriers):
+                state.escaped = True
+                state.events.append(("escape", node))
+            return
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in self.tracked:
+                state.invokes += 1
+                state.events.append(("invoke", node))
+            elif isinstance(fn, ast.Name) and fn.id in self.carriers:
+                if discard:
+                    # delegation: the helper's own CFG is checked
+                    # separately; this callsite's summary is "resolves once"
+                    state.invokes += 1
+                    state.events.append(("delegate", node))
+                else:
+                    # factory/constructor use — the returned resolver
+                    # escapes into whoever receives it
+                    state.escaped = True
+                    state.events.append(("escape", node))
+            else:
+                self._visit(fn, state)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                self._visit(arg, state)
+            return
+        if isinstance(node, ast.Compare):
+            # `x is None` is a test, not a use — skip tracked names that
+            # are only being compared against None
+            none_cmp = any(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in [node.left, *node.comparators]
+            )
+            for child in [node.left, *node.comparators]:
+                if (none_cmp and isinstance(child, ast.Name)
+                        and child.id in (self.tracked | self.carriers)):
+                    continue
+                self._visit(child, state)
+            return
+        if isinstance(node, ast.IfExp):
+            # conservative join: count the arm with FEWER resolutions
+            self._visit(node.test, state)
+            a, b = _PathState(), _PathState()
+            self._visit(node.body, a)
+            self._visit(node.orelse, b)
+            lo = a if (a.invokes + (1 if a.escaped else 0)) <= \
+                (b.invokes + (1 if b.escaped else 0)) else b
+            state.invokes += lo.invokes
+            state.escaped = state.escaped or lo.escaped
+            state.events.extend(lo.events)
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load) and \
+                    node.id in (self.tracked | self.carriers):
+                state.escaped = True
+                state.events.append(("escape", node))
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, state)
+
+
+def _carrier_names(fn: ast.AST, tracked: set[str]) -> set[str]:
+    """Names of functions defined under `fn` whose bodies (transitively)
+    reference a tracked callback — calling or passing one of these
+    delegates the resolution (the summary layer of the analysis)."""
+    defs: dict[str, set[str]] = {}
+    for node in ast.walk(fn):
+        if node is fn:
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, set()).update(_names_in(node))
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Lambda):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    defs.setdefault(t.id, set()).update(_names_in(node.value))
+    carriers: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, refs in defs.items():
+            if name not in carriers and refs & (tracked | carriers):
+                carriers.add(name)
+                changed = True
+    return carriers
+
+
+class CallbackLeakChecker(Checker):
+    rule_id = "TPU008"
+    name = "callback-leak"
+    description = ("a path through a listener-handling function drops both "
+                   "completion callbacks (on_response/on_failure) or "
+                   "invokes more than one; helper delegation recognized "
+                   "via call summaries on the per-function CFG")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return any(n in source for pair in _CALLBACK_PAIRS for n in pair) \
+            or any(n in source for n in _SINGLE_LISTENERS)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        seen: set[tuple[str, int]] = set()
+        for fn, tracked, strict in self._targets(ctx.tree):
+            for v in self._check_fn(ctx, fn, tracked, strict):
+                key = (v.rule, v.line)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(v)
+        return out
+
+    # -- which functions are listener handlers -----------------------------
+
+    def _targets(self, tree: ast.AST):
+        """Collect (fn, tracked_names, strict). strict=True (callback
+        names are PARAMETERS of fn — the dispatch function itself): every
+        path must resolve. strict=False (a nested closure capturing
+        callbacks bound by an enclosing function): only except-paths and
+        double resolutions are flagged — closures legitimately resolve on
+        a *later* invocation (count-down latches)."""
+        yield_list: list[tuple[ast.AST, set[str], bool]] = []
+
+        def descend(node: ast.AST, env: set[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    handle(child, env)
+                else:
+                    descend(child, env)
+
+        def handle(fn: ast.AST, enclosing_params: set[str]) -> None:
+            params = _fn_param_names(fn)
+            body_names = _names_in(fn)
+            tracked: set[str] | None = None
+            strict = False
+            for pair in _CALLBACK_PAIRS:
+                if set(pair) <= params:
+                    tracked, strict = set(pair), True
+                    break
+                if tracked is None and (set(pair) & body_names) \
+                        and set(pair) <= enclosing_params:
+                    tracked = set(pair)
+            if tracked is None:
+                for single in _SINGLE_LISTENERS:
+                    if single in params and single in body_names:
+                        tracked, strict = {single}, True
+                        break
+                    if single in enclosing_params and any(
+                        isinstance(n, ast.Name) and n.id == single
+                        for n in ast.walk(fn)
+                    ):
+                        tracked = {single}
+                        break
+            if tracked is not None:
+                yield_list.append((fn, tracked, strict))
+            descend(fn, enclosing_params | params)
+
+        descend(tree, set())
+        return yield_list
+
+    # -- per-function path walk --------------------------------------------
+
+    def _check_fn(self, ctx: FileContext, fn: ast.AST, tracked: set[str],
+                  strict: bool) -> Iterable[Violation]:
+        carriers = _carrier_names(fn, tracked)
+        walker = _EventWalker(tracked, carriers)
+        graph = cfg_mod.build_cfg(fn)
+        pair = " / ".join(sorted(tracked))
+        out: list[Violation] = []
+        for path in cfg_mod.enumerate_paths(
+            graph, prune=lambda e: cfg_mod.branch_infeasible(e, tracked)
+        ):
+            if path.raises:
+                # an escaping exception reaches the CALLER (a raising
+                # transport handler produces the error response); paths
+                # ending at raise_exit are the caller's problem
+                continue
+            state = _PathState()
+            for block in path.blocks:
+                for stmt in block.stmts:
+                    walker.walk(stmt, state)
+            if state.escaped:
+                continue  # resolution handed off — exactly-once unknown
+            if state.invokes == 0 and (strict or path.exceptional):
+                anchor = self._leak_anchor(path, fn)
+                kind = ("an except-path" if path.exceptional
+                        else "a code path")
+                out.append(ctx.violation(
+                    "TPU008", anchor,
+                    f"{kind} through this listener handler completes "
+                    f"without resolving {pair} — the caller waits forever"))
+            elif state.invokes >= 2 and not path.exceptional:
+                second = [n for k, n in state.events
+                          if k in ("invoke", "delegate")][1]
+                out.append(ctx.violation(
+                    "TPU008", second,
+                    f"a code path resolves {pair} more than once "
+                    "(double-completion corrupts the caller's state "
+                    "machine)"))
+        return out
+
+    @staticmethod
+    def _leak_anchor(path: "cfg_mod.Path", fn: ast.AST) -> ast.AST:
+        # the return that drops the callbacks, else the handler the path
+        # fell through, else the def line
+        for block in reversed(path.blocks):
+            for stmt in reversed(block.stmts):
+                if isinstance(stmt, ast.Return):
+                    return stmt
+        for block in path.blocks:
+            if block.label.startswith("except:") and block.stmts:
+                return block.stmts[0]
+        return fn
+
+
+# ---------------------------------------------------------------------------
+# TPU009 — unbounded growth on long-lived transport/queue attributes
+# ---------------------------------------------------------------------------
+
+_GROW_METHODS = {"append", "appendleft", "add", "put", "put_nowait",
+                 "push", "setdefault"}
+_SHRINK_METHODS = {"pop", "popleft", "popitem", "remove", "discard",
+                   "clear", "get_nowait"}
+_CONTAINER_CALLS = {"dict", "list", "set", "deque", "defaultdict",
+                    "OrderedDict", "Counter", "Queue", "SimpleQueue",
+                    "LifoQueue", "PriorityQueue"}
+# attrs that are registration REGISTRIES (handlers, settings consumers):
+# bounded by the code that registers into them, not runtime traffic
+_REGISTRY_HINTS = ("handler", "listener", "consumer", "subscriber",
+                   "callback", "hook")
+_REGISTER_METHOD_HINTS = ("register", "subscribe", "install")
+
+
+def _self_attr_of(node: ast.AST) -> str | None:
+    """self.X for Attribute chains rooted at self (through subscripts)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _is_bounded_container_ctor(value: ast.expr) -> bool | None:
+    """True: bounded ctor. False: unbounded container ctor.
+    None: not a recognized container initializer."""
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return False
+    if isinstance(value, ast.Call):
+        name = call_name(value)
+        if name is None:
+            return None
+        last = name.split(".")[-1]
+        if last not in _CONTAINER_CALLS:
+            return None
+        if last == "deque":
+            for kw in value.keywords:
+                if kw.arg == "maxlen" and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None):
+                    return True
+            if len(value.args) >= 2:
+                return True
+            return False
+        if last.endswith("Queue"):
+            for kw in value.keywords:
+                if kw.arg == "maxsize" and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value in (0, None)):
+                    return True
+            if value.args and not (
+                    isinstance(value.args[0], ast.Constant)
+                    and value.args[0].value in (0, None)):
+                return True
+            return False
+        return False
+    return None
+
+
+class UnboundedGrowthChecker(Checker):
+    rule_id = "TPU009"
+    name = "unbounded-growth"
+    description = ("append/put/dict[...]= on a long-lived container "
+                   "attribute of a sim-run (transport/cluster/recovery) "
+                   "class with no size bound, shed, or eviction anywhere "
+                   "in the class")
+
+    # same scope as TPU004/TPU006: the modules on the serving/sim path
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return _sim_scoped(display_path, source)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(ctx, node))
+        return out
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> list[Violation]:
+        containers: set[str] = set()
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and item.name in ("__init__", "__new__"):
+                for sub in ast.walk(item):
+                    if isinstance(sub, ast.Assign):
+                        bounded = _is_bounded_container_ctor(sub.value)
+                        if bounded is not None:
+                            for t in sub.targets:
+                                attr = _self_attr_of(t)
+                                if attr is not None and not bounded:
+                                    containers.add(attr)
+                    elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                        bounded = _is_bounded_container_ctor(sub.value)
+                        if bounded is False:
+                            attr = _self_attr_of(sub.target)
+                            if attr is not None:
+                                containers.add(attr)
+        containers = {
+            a for a in containers
+            if not any(h in a.lower() for h in _REGISTRY_HINTS)
+        }
+        if not containers:
+            return []
+
+        grows: list[tuple[str, ast.AST, str]] = []  # (attr, node, method)
+        evidence: set[str] = set()  # attrs with shrink/bound/reassignment
+
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            ctor = item.name in ("__init__", "__new__")
+            # a nested def inside __init__ is a CALLBACK registered at
+            # construction — its body runs at runtime, not construction
+            runtime_nodes: set[int] = set()
+            if ctor:
+                for fd in ast.walk(item):
+                    if fd is not item and isinstance(
+                            fd, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        runtime_nodes.update(id(n) for n in ast.walk(fd))
+            is_registry_method = any(
+                item.name.startswith(h) for h in _REGISTER_METHOD_HINTS)
+            for sub in ast.walk(item):
+                is_init = ctor and id(sub) not in runtime_nodes
+                # self.X.append(...) / .put(...) / .setdefault(...).add(...)
+                if isinstance(sub, ast.Call) and isinstance(
+                        sub.func, ast.Attribute):
+                    base = sub.func.value
+                    meth = sub.func.attr
+                    # look through one chained call: setdefault(...).add()
+                    if isinstance(base, ast.Call) and isinstance(
+                            base.func, ast.Attribute) and \
+                            base.func.attr == "setdefault":
+                        base = base.func.value
+                    attr = _self_attr_of(base)
+                    if attr in containers:
+                        if meth in _SHRINK_METHODS:
+                            evidence.add(attr)
+                        elif meth in _GROW_METHODS and not is_init \
+                                and not is_registry_method:
+                            grows.append((attr, sub, item.name))
+                # self.X[k] = v
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Subscript):
+                            attr = _self_attr_of(t)
+                            if attr in containers and not is_init \
+                                    and not is_registry_method:
+                                grows.append((attr, sub, item.name))
+                        elif not is_init:
+                            # reassignment (drain/rotate) is eviction
+                            attr = _self_attr_of(t) if isinstance(
+                                t, ast.Attribute) else None
+                            if attr in containers:
+                                evidence.add(attr)
+                            if isinstance(t, ast.Tuple):
+                                for el in t.elts:
+                                    a2 = _self_attr_of(el) if isinstance(
+                                        el, ast.Attribute) else None
+                                    if a2 in containers:
+                                        evidence.add(a2)
+                # del self.X[k]
+                if isinstance(sub, ast.Delete):
+                    for t in sub.targets:
+                        attr = _self_attr_of(t)
+                        if attr in containers:
+                            evidence.add(attr)
+                # len(self.X) under comparison = an explicit bound check
+                if isinstance(sub, ast.Compare):
+                    for part in [sub.left, *sub.comparators]:
+                        if isinstance(part, ast.Call) and \
+                                call_name(part) == "len" and part.args:
+                            attr = _self_attr_of(part.args[0])
+                            if attr in containers:
+                                evidence.add(attr)
+
+        out: list[Violation] = []
+        flagged: set[tuple[str, int]] = set()
+        for attr, node, method in grows:
+            if attr in evidence:
+                continue
+            key = (attr, getattr(node, "lineno", 0))
+            if key in flagged:
+                continue
+            flagged.add(key)
+            out.append(ctx.violation(
+                "TPU009", node,
+                f"self.{attr} grows in {method}() but {cls.name} never "
+                "bounds, sheds, or evicts it — a long-lived queue/buffer "
+                "on the serving path must have a size bound or eviction "
+                "(see QueuePressure)"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# TPU010 — interprocedural lock-order inversion (TPU003 across functions)
+# ---------------------------------------------------------------------------
+
+_SUMMARY_DEPTH = 4  # call-chain depth for acquired-lock summaries
+
+
+class _LockCallScan(ast.NodeVisitor):
+    """One method: locks acquired, plus self-method calls annotated with
+    the locks held at the callsite (the summary TPU010 propagates)."""
+
+    def __init__(self, lock_attrs: set[str]):
+        self.lock_attrs = lock_attrs
+        self.held: list[str] = []
+        self.acquired: set[str] = set()
+        # (callee method name, frozenset(held at callsite), call node)
+        self.calls: list[tuple[str, frozenset, ast.Call]] = []
+        # intra-method ordered pairs (outer, inner) -> acquisition node
+        self.pairs: dict[tuple[str, str], ast.AST] = {}
+
+    def _self_attr(self, node: ast.AST) -> str | None:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: list[str] = []
+        for item in node.items:
+            attr = self._self_attr(item.context_expr)
+            if attr is not None and attr in self.lock_attrs:
+                self.acquired.add(attr)
+                for outer in self.held + acquired:
+                    if outer != attr:
+                        self.pairs.setdefault((outer, attr),
+                                              item.context_expr)
+                acquired.append(attr)
+            else:
+                self.visit(item.context_expr)
+        self.held.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        if acquired:
+            del self.held[-len(acquired):]
+
+    visit_AsyncWith = visit_With  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "self"):
+            self.calls.append((fn.attr, frozenset(self.held), node))
+        self.generic_visit(node)
+
+    # nested defs run later, in an unknown lock context — skip
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+    visit_Lambda = visit_FunctionDef  # type: ignore[assignment]
+
+
+class InterproceduralLockOrderChecker(Checker):
+    rule_id = "TPU010"
+    name = "lock-order-interprocedural"
+    description = ("lock-order inversions ACROSS method boundaries: "
+                   "calling self.m() while holding lock A acquires lock B "
+                   "(via the callee's acquired-locks summary) while another "
+                   "path takes B before A")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return ("Lock" in source or "_lock" in source
+                or "Condition" in source or "Semaphore" in source)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(ctx, node))
+        return out
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> list[Violation]:
+        locks = LockDisciplineChecker()._lock_attrs(cls)
+        if len(locks) < 2:
+            return []  # an inversion needs two locks
+        scans: dict[str, _LockCallScan] = {}
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan = _LockCallScan(locks)
+                for stmt in item.body:
+                    scan.visit(stmt)
+                # latest def wins on duplicate names (matches runtime)
+                scans[item.name] = scan
+
+        # transitive acquired-locks summary per method
+        summary: dict[str, set[str]] = {}
+
+        def acquires(method: str, depth: int, seen: frozenset) -> set[str]:
+            if method in summary:
+                return summary[method]
+            scan = scans.get(method)
+            if scan is None or depth <= 0 or method in seen:
+                return set()
+            acc = set(scan.acquired)
+            for callee, _held, _node in scan.calls:
+                acc |= acquires(callee, depth - 1, seen | {method})
+            if depth == _SUMMARY_DEPTH:
+                summary[method] = acc
+            return acc
+
+        # ordered pairs: intra-method (TPU003 territory, kept for the
+        # inversion join) + interprocedural via callee summaries
+        intra: dict[tuple[str, str], ast.AST] = {}
+        inter: dict[tuple[str, str], tuple[ast.AST, str, str]] = {}
+        for name, scan in scans.items():
+            for pair, node in scan.pairs.items():
+                intra.setdefault(pair, node)
+            for callee, held, node in scan.calls:
+                if not held or callee not in scans:
+                    continue
+                callee_locks = acquires(callee, _SUMMARY_DEPTH, frozenset())
+                for inner in callee_locks - set(held):
+                    for outer in held:
+                        if outer != inner:
+                            inter.setdefault(
+                                (outer, inner), (node, name, callee))
+
+        out: list[Violation] = []
+        reported: set[frozenset] = set()
+        all_pairs = set(intra) | set(inter)
+        for (a, b) in sorted(all_pairs):
+            if (b, a) not in all_pairs:
+                continue
+            key = frozenset((a, b))
+            if key in reported:
+                continue
+            # at least one direction must cross a function boundary —
+            # pure intra-method inversions are TPU003's finding
+            if (a, b) not in inter and (b, a) not in inter:
+                continue
+            reported.add(key)
+            direction = (a, b) if (a, b) in inter else (b, a)
+            node, caller, callee = inter[direction]
+            out.append(ctx.violation(
+                "TPU010", node,
+                f"{caller}() holds self.{direction[0]} while calling "
+                f"self.{callee}(), which acquires self.{direction[1]} — "
+                f"but class {cls.name} also takes these locks in the "
+                "opposite order (cross-function deadlock risk)"))
+        return out
+
+
+# ---------------------------------------------------------------------------
 
 ALL_CHECKERS: list[Checker] = [
     JitPurityChecker(),
@@ -936,6 +1609,9 @@ ALL_CHECKERS: list[Checker] = [
     ExceptionHygieneChecker(),
     InjectableIdChecker(),
     RetracingRiskChecker(),
+    CallbackLeakChecker(),
+    UnboundedGrowthChecker(),
+    InterproceduralLockOrderChecker(),
 ]
 
 RULES: dict[str, Checker] = {c.rule_id: c for c in ALL_CHECKERS}

@@ -49,6 +49,16 @@ PROTOCOL_VERSION = 2
 _LEN = struct.Struct(">I")
 MAX_FRAME = 256 * 1024 * 1024  # hard cap, like the reference's 2GB guard
 
+# pending-reply backlog bound (TPU009: every long-lived transport buffer
+# needs a bound + shed). Each entry holds two callbacks and a timer; a
+# peer that stops answering must shed new requests fast instead of
+# accreting correlation state until the process dies.
+DEFAULT_MAX_PENDING = 10_000
+
+
+class TransportBacklogFull(Exception):
+    """Shed signal: the pending-reply table is at capacity."""
+
 # frame kinds (first byte after the length prefix)
 _KIND_JSON = 0x00    # [len][0x00][json]
 _KIND_BINARY = 0x01  # [len][0x01][u32 json_len][json][raw bytes]
@@ -187,6 +197,7 @@ class TcpTransport:
         loop: asyncio.AbstractEventLoop | None = None,
         timeout_ms: int = 10_000,
         cluster_name: str = "opensearch-tpu",
+        max_pending: int = DEFAULT_MAX_PENDING,
     ):
         self.node_id = node_id
         self.host = host
@@ -194,6 +205,7 @@ class TcpTransport:
         self.seeds = dict(seeds)
         self.timeout_ms = timeout_ms
         self.cluster_name = cluster_name
+        self.max_pending = max_pending
         self.loop = loop or asyncio.get_event_loop()
         self.handlers: dict[str, Callable[[str, Any], Any]] = {}
         self._server: asyncio.base_events.Server | None = None
@@ -203,7 +215,7 @@ class TcpTransport:
         self._pending: dict[int, tuple[Callable | None, Callable | None, Any]] = {}
         self._req_id = 0
         self.stats = {"sent": 0, "dropped": 0, "delivered": 0, "rx": 0,
-                      "late_dropped": 0}
+                      "late_dropped": 0, "shed": 0}
         self._closed = False
 
     # -- lifecycle ---------------------------------------------------------
@@ -265,6 +277,17 @@ class TcpTransport:
             self.loop.call_soon(self._dispatch_local, sender, action, payload,
                                on_response, on_failure)
             return
+        if len(self._pending) >= self.max_pending:
+            # shed instead of accreting correlation state without bound
+            # (the QueuePressure contract at the transport layer): the
+            # caller gets an immediate failure it can retry/degrade on
+            self.stats["shed"] += 1
+            if on_failure is not None:
+                self.loop.call_soon(on_failure, TransportBacklogFull(
+                    f"{len(self._pending)} requests in flight "
+                    f"(max_pending={self.max_pending})"
+                ))
+            return
         self._req_id += 1
         rid = self._req_id
         timer = self.loop.call_later(
@@ -281,7 +304,17 @@ class TcpTransport:
         trace = trace_header()
         if trace is not None:
             body[TRACE_HEADER] = trace
-        frame = encode_frame(body)
+        try:
+            frame = encode_frame(body)
+        except Exception as e:  # noqa: BLE001 - any encode failure
+            # oversized payload (ValueError) or unserializable payload
+            # (TypeError from json.dumps): fail THIS request's listener
+            # now — a raise escaping send() would leave the pending entry
+            # (and the caller's callbacks) dangling until the timeout
+            # timer, then fail the request a second time through it
+            # (the callback-leak class TPU008 hunts)
+            self._fail_pending(rid, e)
+            return
         self.loop.create_task(self._send_frame(target, rid, frame))
 
     # -- outbound ----------------------------------------------------------

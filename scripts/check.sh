@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Repo gate: the tpulint invariant check + the fast tier-1 subset.
+#
+#   scripts/check.sh            # lint gate + lint/transport/cluster tests
+#   scripts/check.sh --lint     # lint gate only (pre-commit speed)
+#
+# The lint gate runs three ways on purpose:
+#   1. repo-wide lint vs the (EMPTY) baseline ratchet (json report),
+#   2. --fix --dry-run, asserting zero pending mechanical rewrites,
+#   3. the tier-1 subset that pins rule/fixture semantics.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== tpulint (repo-wide, baseline must hold) =="
+python -m opensearch_tpu.lint --format json opensearch_tpu \
+  | python -c 'import json,sys; r = json.load(sys.stdin); print(
+    "%(files_checked)s files, %(total_violations)s violations in "
+    "%(elapsed_seconds)ss" % r); sys.exit(1 if r["regressions"] else 0)'
+
+echo "== tpulint --fix --dry-run (zero pending rewrites) =="
+python -m opensearch_tpu.lint --fix --dry-run opensearch_tpu > /dev/null
+echo "ok"
+
+if [[ "${1:-}" == "--lint" ]]; then
+  exit 0
+fi
+
+echo "== tier-1 subset (lint semantics + transport/cluster/fault) =="
+JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+  -p no:cacheprovider \
+  tests/test_lint.py \
+  tests/test_coordination.py \
+  tests/test_cluster_data.py \
+  tests/test_fault_injection.py

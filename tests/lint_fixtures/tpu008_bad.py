@@ -1,0 +1,42 @@
+"""TPU008 true positives: paths through listener-handling functions that
+drop both completion callbacks, or resolve more than once."""
+
+
+def drop_on_error(req, on_response, on_failure):
+    try:
+        result = req.run()
+    except ValueError:
+        req.log_bad_value()
+        return  # EXPECT: TPU008
+    on_response(result)
+
+
+def forgetful_dispatch(req, on_response, on_failure):  # EXPECT: TPU008
+    if req.ok:
+        on_response(req.value)
+    # falling off the end on the not-ok path wedges the caller
+
+
+def double_completion(req, on_response, on_failure):
+    on_response(req.value)
+    on_failure(RuntimeError("already answered"))  # EXPECT: TPU008
+
+
+def coordinator_fanout(transport, on_response, on_failure):
+    def handle(resp):
+        try:
+            value = resp.parse()
+        except KeyError:
+            return  # EXPECT: TPU008
+        on_response(value)
+
+    transport.send("peer", handle, on_failure)
+
+
+def lookup(table, key, callback):
+    try:
+        row = table.fetch(key)
+    except LookupError:
+        table.log_miss(key)
+        return  # EXPECT: TPU008
+    callback(row)

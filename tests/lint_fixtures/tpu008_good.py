@@ -1,0 +1,84 @@
+"""TPU008 false-positive guards: every resolution idiom the rule must
+accept — None-guards, escapes into storage, helper delegation, factories,
+count-down latches, and raising through to the caller."""
+
+
+def guarded_optional(req, on_response, on_failure):
+    try:
+        result = req.run()
+    except ValueError as e:
+        if on_failure is not None:
+            on_failure(e)
+        return
+    if on_response is not None:
+        on_response(result)
+
+
+class PendingTable:
+    def __init__(self):
+        self._pending = {}
+
+    def send(self, req, on_response, on_failure):
+        # storing the pair for a later completion IS the resolution here
+        self._pending[req.rid] = (on_response, on_failure)
+        self._flush(req.rid)
+
+    def _flush(self, rid):
+        entry = self._pending.pop(rid, None)
+        if entry is None:
+            return
+        on_response, on_failure = entry
+        on_response(rid)
+
+
+def delegates_to_helper(req, on_response, on_failure):
+    def finish(result, error):
+        if error is not None:
+            on_failure(error)
+        else:
+            on_response(result)
+
+    try:
+        finish(req.run(), None)
+    except ValueError as e:
+        finish(None, e)
+
+
+def raising_is_the_callers_problem(req, on_response, on_failure):
+    if not req.valid:
+        raise ValueError(req)  # the transport turns this into an error
+    on_response(req.payload)
+
+
+def countdown_latch(targets, send, callback):
+    if not targets:
+        callback([])
+        return
+    results = []
+    remaining = [len(targets)]
+
+    def one_done(resp):
+        results.append(resp)
+        remaining[0] -= 1
+        if remaining[0] == 0:
+            callback(results)
+
+    for target in targets:
+        send(target, one_done)
+
+
+def factory_makes_resolvers(targets, send, on_response, on_failure):
+    def one(target):
+        def handle(resp):
+            on_response((target, resp))
+        return handle
+
+    for target in targets:
+        send(target, one(target), on_failure)
+
+
+def schedules_failure(scheduler, timeout_ms, on_response, on_failure):
+    if timeout_ms <= 0:
+        scheduler.schedule(0, lambda: on_failure(TimeoutError()))
+        return
+    scheduler.schedule(timeout_ms, lambda: on_response(None))

@@ -1,0 +1,32 @@
+"""TPU009 true positives: long-lived buffers that only ever grow."""
+# tpulint: deterministic-module
+
+import queue
+
+
+class ReplyRouter:
+    def __init__(self):
+        self._pending_replies = {}
+        self._backlog = []
+
+    def on_request(self, rid, frame):
+        self._pending_replies[rid] = frame  # EXPECT: TPU009
+
+    def on_gossip(self, frame):
+        self._backlog.append(frame)  # EXPECT: TPU009
+
+
+class WorkFeed:
+    def __init__(self):
+        self._inbox = queue.Queue()
+
+    def offer(self, item):
+        self._inbox.put(item)  # EXPECT: TPU009
+
+
+class TargetTracker:
+    def __init__(self):
+        self._tracked = {}
+
+    def track(self, key, target):
+        self._tracked.setdefault(key, set()).add(target)  # EXPECT: TPU009

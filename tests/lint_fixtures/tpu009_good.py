@@ -1,0 +1,49 @@
+"""TPU009 false-positive guards: bounded constructors, explicit bound
+checks, eviction, drain-by-reassignment, and registration registries."""
+# tpulint: deterministic-module
+
+import collections
+import queue
+
+
+class BoundedEverything:
+    MAX_PENDING = 128
+
+    def __init__(self):
+        self._pending = {}
+        self._events = collections.deque(maxlen=256)
+        self._inbox = queue.Queue(maxsize=64)
+        self._batch = []
+        self._handlers = {}
+        self._seen = set()
+
+    def on_request(self, rid, frame):
+        if len(self._pending) >= self.MAX_PENDING:
+            return False  # shed — the bound check is the evidence
+        self._pending[rid] = frame
+        return True
+
+    def on_reply(self, rid):
+        return self._pending.pop(rid, None)
+
+    def on_event(self, e):
+        self._events.append(e)  # deque(maxlen=...) is self-bounding
+
+    def offer(self, item):
+        self._inbox.put(item)  # Queue(maxsize=...) blocks/sheds itself
+
+    def on_op(self, op):
+        self._batch.append(op)
+
+    def flush(self):
+        batch, self._batch = self._batch, []  # drain by reassignment
+        return batch
+
+    def register(self, action, fn):
+        self._handlers[action] = fn  # registry: bounded by callers
+
+    def mark(self, key):
+        self._seen.add(key)
+
+    def reset(self):
+        self._seen.clear()

@@ -1,0 +1,24 @@
+"""TPU010 true positive: a lock-order inversion that only exists ACROSS
+a method boundary — no single method takes both locks out of order."""
+
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self._alpha = threading.Lock()
+        self._beta = threading.Lock()
+        self._stats = {}
+
+    def record(self, key):
+        with self._alpha:
+            self._refresh(key)  # EXPECT: TPU010
+
+    def _refresh(self, key):
+        with self._beta:
+            self._stats[key] = key
+
+    def snapshot(self):
+        with self._beta:
+            with self._alpha:
+                return dict(self._stats)

@@ -1,0 +1,32 @@
+"""TPU010 false-positive guards: one global lock order, including through
+helper calls; a callee re-acquiring nothing new is fine."""
+
+import threading
+
+
+class Consistent:
+    def __init__(self):
+        self._alpha = threading.Lock()
+        self._beta = threading.Lock()
+        self._items = {}
+
+    def record(self, key):
+        with self._alpha:
+            self._store(key)
+
+    def _store(self, key):
+        with self._beta:
+            self._items[key] = key
+
+    def snapshot(self):
+        with self._alpha:
+            with self._beta:
+                return dict(self._items)
+
+    def flush(self):
+        with self._alpha:
+            self._drain()
+
+    def _drain(self):
+        with self._beta:
+            self._items.clear()

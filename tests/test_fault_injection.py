@@ -638,3 +638,161 @@ def test_recovery_trace_survives_partition_and_retry(tmp_path):
         sim.transport.heal()
         for n in sim.nodes.values():
             n.close()
+
+
+# -- callback-leak regressions (tpulint TPU008's failure class) --------------
+# Each of these wedged forever before the fix: a raise inside a transport
+# completion callback (or a DeferredResponse listener) dropped the request's
+# listener with nothing left to resolve it.
+
+
+def _sim3(tmp_path, seed=7):
+    sim = DataSim(3, seed=seed, tmp_path=tmp_path)
+    sim.run(5_000)
+    return sim
+
+
+def test_search_reduce_failure_fails_the_listener_not_the_loop(tmp_path):
+    """A raise in the coordinator's reduce used to propagate out of the
+    on_response callback: the client's search callback never fired (and
+    under the sim the exception killed the task queue). The reduce now
+    fails the listener with an error response."""
+    sim = _sim3(tmp_path)
+    try:
+        _make_index(sim, "red", shards=1, replicas=1)
+        _acked_writes(sim, "red", 3)
+        n0 = sim.nodes["n0"]
+        sim.call(n0.refresh, "red")
+        original = n0._merge_search_results
+
+        def boom(*_a, **_k):
+            raise RuntimeError("reduce boom")
+
+        n0._merge_search_results = boom
+        try:
+            resp = sim.call(n0.search, "red",
+                            {"query": {"match_all": {}}})
+        finally:
+            n0._merge_search_results = original
+        assert "error" in resp and "reduce boom" in resp["error"]
+        # the node still serves searches afterwards (nothing wedged)
+        resp = sim.call(n0.search, "red", {"query": {"match_all": {}}})
+        assert resp["hits"]["total"]["value"] == 3
+    finally:
+        for n in sim.nodes.values():
+            n.close()
+
+
+def test_primary_write_continuation_failure_resolves_deferred(tmp_path):
+    """The deferred (asyncio) primary-write path: if the post-apply
+    continuation raises, the outer DeferredResponse must resolve with the
+    error — before the fix it stayed pending forever and the client's
+    write wedged with no timeout."""
+    from opensearch_tpu.transport.base import DeferredResponse
+
+    sim = _sim3(tmp_path)
+    try:
+        _make_index(sim, "leak", shards=1, replicas=0)
+        leader = _live_leader(sim)
+        primary = next(
+            r for r in leader.applied_state.shards_for_index("leak")
+            if r.primary
+        )
+        node = sim.nodes[primary.node_id]
+        pending = DeferredResponse()
+        original_offload = node._offload
+        original_cont = node._continue_primary_write
+        node._offload = lambda fn: pending  # force the deferred path
+
+        def boom(payload, result):
+            raise RuntimeError("continuation boom")
+
+        node._continue_primary_write = boom
+        try:
+            final = node._on_primary_write(
+                "n0", {"index": "leak", "shard": primary.shard,
+                       "op": "index", "id": "d1", "source": {"n": 1}})
+            assert isinstance(final, DeferredResponse)
+            outcome = []
+            final.on_done(lambda d: outcome.append(d.error))
+            # the apply completes -> the continuation raises -> the
+            # listener must see the failure (not silence)
+            pending.set_result(object())
+            assert outcome, "write's DeferredResponse leaked (never done)"
+            assert isinstance(outcome[0], RuntimeError)
+        finally:
+            node._offload = original_offload
+            node._continue_primary_write = original_cont
+    finally:
+        for n in sim.nodes.values():
+            n.close()
+
+
+# -- transport backlog bound + oversized-frame shed (TPU009/TPU008 fixes) ----
+
+
+def test_tcp_send_sheds_when_pending_backlog_full():
+    import asyncio
+
+    from opensearch_tpu.transport.tcp import (
+        TcpTransport,
+        TransportBacklogFull,
+    )
+
+    loop = asyncio.new_event_loop()
+    try:
+        t = TcpTransport("a", "127.0.0.1", 0, {"b": ("127.0.0.1", 1)},
+                         loop=loop, max_pending=0)
+        errors = []
+        t.send("a", "b", "act", {"x": 1}, on_failure=errors.append)
+        loop.run_until_complete(asyncio.sleep(0))
+        assert len(errors) == 1
+        assert isinstance(errors[0], TransportBacklogFull)
+        assert t.stats["shed"] == 1
+        assert not t._pending  # shed requests leave no correlation state
+    finally:
+        loop.close()
+
+
+def test_tcp_send_oversized_payload_fails_listener(monkeypatch):
+    """encode_frame raising used to escape send() and leave the pending
+    entry (and the caller's callbacks) dangling until the timeout timer —
+    now the listener fails immediately and nothing lingers."""
+    import asyncio
+
+    from opensearch_tpu.transport import tcp as tcp_mod
+
+    monkeypatch.setattr(tcp_mod, "MAX_FRAME", 64)
+    loop = asyncio.new_event_loop()
+    try:
+        t = tcp_mod.TcpTransport("a", "127.0.0.1", 0,
+                                 {"b": ("127.0.0.1", 1)}, loop=loop)
+        errors = []
+        t.send("a", "b", "act", {"blob": "y" * 1000},
+               on_failure=errors.append)
+        assert len(errors) == 1 and isinstance(errors[0], ValueError)
+        assert not t._pending
+    finally:
+        loop.close()
+
+
+def test_tcp_send_unserializable_payload_fails_listener_once():
+    """json.dumps TypeErrors (not just oversized ValueErrors) must fail
+    the listener through _fail_pending — before the fix the raise escaped
+    send() past the registered pending entry, and the orphaned timeout
+    timer later failed the same request a second time."""
+    import asyncio
+
+    from opensearch_tpu.transport.tcp import TcpTransport
+
+    loop = asyncio.new_event_loop()
+    try:
+        t = TcpTransport("a", "127.0.0.1", 0, {"b": ("127.0.0.1", 1)},
+                         loop=loop)
+        errors = []
+        t.send("a", "b", "act", {"bad": {1, 2, 3}},  # sets aren't JSON
+               on_failure=errors.append)
+        assert len(errors) == 1 and isinstance(errors[0], TypeError)
+        assert not t._pending  # no orphaned timer/callbacks
+    finally:
+        loop.close()

@@ -9,16 +9,21 @@ zero violations (false-positive guards).
 
 from __future__ import annotations
 
+import ast
 import json
+import os
 import re
 import subprocess
 import sys
+import textwrap
 import time
 from pathlib import Path
 
 import pytest
 
 from opensearch_tpu.lint import baseline as baseline_mod
+from opensearch_tpu.lint import cfg as cfg_mod
+from opensearch_tpu.lint import fixes as fixes_mod
 from opensearch_tpu.lint.core import lint_paths, lint_source
 from opensearch_tpu.lint.rules import ALL_CHECKERS, RULES
 
@@ -238,3 +243,413 @@ def test_cli_rule_filter_and_catalog():
     proc = _run_cli(str(FIXTURES / "tpu005_bad.py"),
                     "--rules", "TPU999", "--no-baseline")
     assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# CFG unit tests (lint/cfg.py): the dataflow layer TPU008/TPU010 sit on
+# ---------------------------------------------------------------------------
+
+def _cfg_of(src: str):
+    fn = ast.parse(textwrap.dedent(src)).body[0]
+    return cfg_mod.build_cfg(fn)
+
+
+def _path_stmts(path) -> list[str]:
+    return [ast.unparse(s) for b in path.blocks for s in b.stmts]
+
+
+def test_cfg_enumerates_early_return_paths():
+    graph = _cfg_of("""
+        def f(x):
+            if x:
+                return 1
+            return 2
+    """)
+    exits = [p for p in cfg_mod.enumerate_paths(graph) if not p.raises]
+    assert sorted(_path_stmts(p)[-1] for p in exits) == \
+        ["return 1", "return 2"]
+
+
+def test_cfg_try_finally_runs_on_every_path():
+    graph = _cfg_of("""
+        def g(x):
+            try:
+                if x:
+                    return 1
+                r = work()
+            finally:
+                cleanup()
+            return r
+    """)
+    paths = list(cfg_mod.enumerate_paths(graph))
+    assert len(paths) >= 3  # early return, fall-through, uncaught-exc
+    for p in paths:
+        assert "cleanup()" in _path_stmts(p), p.labels()
+    # the early return ran the finally and ended at the NORMAL exit
+    early = [p for p in paths if "return 1" in _path_stmts(p)]
+    assert early and all(not p.raises for p in early)
+
+
+def test_cfg_except_edges_carry_pre_statement_state():
+    graph = _cfg_of("""
+        def h():
+            try:
+                a()
+                b()
+            except ValueError:
+                fix()
+    """)
+    handler_paths = [
+        p for p in cfg_mod.enumerate_paths(graph)
+        if p.exceptional and not p.raises
+    ]
+    # the exception may hit before a() or between a() and b(): the handler
+    # must see BOTH prefixes (that is where dropped-listener bugs hide)
+    prefixes = {
+        tuple(s for s in _path_stmts(p) if s != "fix()")
+        for p in handler_paths
+    }
+    assert prefixes == {(), ("a()",)}
+
+
+def test_cfg_loops_are_acyclicized():
+    graph = _cfg_of("""
+        def l(xs):
+            for x in xs:
+                use(x)
+            tail()
+    """)
+    paths = list(cfg_mod.enumerate_paths(graph))
+    assert len(paths) == 1  # for-bodies run exactly once per path
+    assert _path_stmts(paths[0]) == ["xs", "use(x)", "tail()"]
+
+    graph = _cfg_of("""
+        def w(q):
+            while q.more():
+                q.step()
+            tail()
+    """)
+    stmt_sets = sorted(
+        _path_stmts(p) for p in cfg_mod.enumerate_paths(graph))
+    assert stmt_sets == [          # zero- and one-iteration variants only
+        ["q.more()", "q.step()", "tail()"],
+        ["q.more()", "tail()"],
+    ]
+
+
+def test_cfg_raise_paths_end_at_raise_exit():
+    graph = _cfg_of("""
+        def r(x):
+            if not x:
+                raise ValueError(x)
+            return x
+    """)
+    kinds = sorted(p.raises for p in cfg_mod.enumerate_paths(graph))
+    assert kinds == [False, True]
+
+
+def test_cfg_branch_pruning_assumes_callbacks_real():
+    graph = _cfg_of("""
+        def s(on_failure):
+            if on_failure is None:
+                return "skipped"
+            on_failure(1)
+    """)
+    pruned = [
+        _path_stmts(p) for p in cfg_mod.enumerate_paths(
+            graph,
+            prune=lambda e: cfg_mod.branch_infeasible(e, {"on_failure"}))
+    ]
+    assert all("return 'skipped'" not in stmts for stmts in pruned)
+    assert any("on_failure(1)" in stmts for stmts in pruned)
+
+
+def test_cfg_path_enumeration_is_bounded():
+    # 2^40 nominal paths must degrade gracefully, not hang
+    body = "\n".join(f"    if x == {i}:\n        t{i} = 1" for i in range(40))
+    graph = _cfg_of(f"def deep(x):\n{body}\n    return x\n")
+    paths = list(cfg_mod.enumerate_paths(graph, max_paths=100))
+    assert len(paths) == 100
+
+
+# ---------------------------------------------------------------------------
+# tpulint --fix: mechanical rewrites (lint/fixes.py)
+# ---------------------------------------------------------------------------
+
+_FIXABLE = '''\
+"""Module under sim scope."""
+# tpulint: deterministic-module
+import os
+import time
+import uuid
+
+
+def stamp():
+    return time.time() * 1000
+
+
+def mint():
+    return str(uuid.uuid4()), os.urandom(8)
+
+
+def guard(fn):
+    try:
+        fn()
+    except Exception:
+        pass
+'''
+
+
+def test_fix_rewrites_and_is_idempotent(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(_FIXABLE)
+    fixes, changed = fixes_mod.fix_paths([str(f)], write=True)
+    assert changed == 1
+    assert sorted({fx.rule for fx in fixes}) == \
+        ["TPU004", "TPU005", "TPU006"]
+    out = f.read_text()
+    assert "(timeutil.epoch_millis() / 1000.0) * 1000" in out
+    assert "randutil.uuid4()" in out and "randutil.urandom(8)" in out
+    assert "swallowed exception: %s" in out
+    assert "from opensearch_tpu.common import timeutil" in out
+    assert "from opensearch_tpu.common import randutil" in out
+    ast.parse(out)  # the rewritten file must still be valid python
+    # the mechanical rules are now clean on the rewritten file
+    violations = lint_source(str(f), out, ALL_CHECKERS)
+    assert [v for v in violations
+            if v.rule in ("TPU004", "TPU005", "TPU006")] == []
+    # idempotent: a second run finds nothing and writes nothing
+    fixes2, changed2 = fixes_mod.fix_paths([str(f)], write=True)
+    assert fixes2 == [] and changed2 == 0
+    assert f.read_text() == out
+
+
+def test_fix_dry_run_reports_without_writing(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(_FIXABLE)
+    fixes, changed = fixes_mod.fix_paths([str(f)], write=False)
+    assert changed == 1 and len(fixes) == 4
+    assert f.read_text() == _FIXABLE  # untouched
+
+
+def test_fix_respects_suppressions_and_scope(tmp_path):
+    suppressed = (
+        "# tpulint: deterministic-module\n"
+        "import time\n"
+        "t = time.time()  # tpulint: disable=TPU004\n"
+    )
+    f = tmp_path / "sup.py"
+    f.write_text(suppressed)
+    fixes, changed = fixes_mod.fix_paths([str(f)], write=True)
+    assert fixes == [] and changed == 0
+    assert f.read_text() == suppressed
+    # outside sim scope the wallclock/entropy fixers must not touch a file
+    unscoped = "import time\nt = time.time()\n"
+    g = tmp_path / "unscoped.py"
+    g.write_text(unscoped)
+    fixes, changed = fixes_mod.fix_paths([str(g)], write=True)
+    assert fixes == [] and g.read_text() == unscoped
+
+
+def test_fix_leaves_good_fixtures_untouched():
+    for fixture in GOOD_FIXTURES:
+        source = fixture.read_text()
+        new_source, fixes = fixes_mod.fix_source(str(fixture), source)
+        assert fixes == [], fixture.name
+        assert new_source == source, fixture.name
+
+
+def test_fix_uses_module_logger_when_present(tmp_path):
+    src = (
+        "import logging\n"
+        "logger = logging.getLogger(__name__)\n"
+        "def f(x):\n"
+        "    try:\n"
+        "        x()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    new_source, fixes = fixes_mod.fix_source("m.py", src)
+    assert [fx.rule for fx in fixes] == ["TPU005"]
+    assert 'logger.debug("swallowed exception: %s", e)' in new_source
+    assert new_source.count("import logging") == 1
+    ast.parse(new_source)
+
+
+# ---------------------------------------------------------------------------
+# parallel per-file parsing + --changed
+# ---------------------------------------------------------------------------
+
+def test_parallel_lint_matches_serial():
+    serial, n1 = lint_paths([str(FIXTURES)])
+    parallel, n2 = lint_paths([str(FIXTURES)], jobs=2)
+    assert n1 == n2 and n1 >= 20
+    assert serial == parallel
+
+
+def _git(cwd, *args):
+    return subprocess.run(
+        ["git", "-c", "user.name=t", "-c", "user.email=t@t", *args],
+        cwd=str(cwd), capture_output=True, text=True, timeout=60)
+
+
+def test_cli_changed_lints_only_files_differing_from_head(tmp_path):
+    repo = tmp_path / "r"
+    repo.mkdir()
+    assert _git(repo, "init", "-q").returncode == 0
+    (repo / "clean.py").write_text("x = 1\n")
+    (repo / "dirty.py").write_text("y = 1\n")
+    _git(repo, "add", "-A")
+    assert _git(repo, "commit", "-qm", "seed").returncode == 0
+    # introduce a violation only in dirty.py
+    (repo / "dirty.py").write_text(
+        "def f(x):\n    try:\n        x()\n    except Exception:\n"
+        "        pass\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "opensearch_tpu.lint", str(repo),
+         "--changed", "--no-baseline", "--format", "json"],
+        capture_output=True, text=True, cwd=str(repo), timeout=120,
+        env={**os.environ, "PYTHONPATH": str(REPO)})
+    report = json.loads(proc.stdout)
+    assert report["files_checked"] == 1
+    assert {v["rule"] for v in report["violations"]} == {"TPU005"}
+    assert proc.returncode == 1
+    # a clean worktree under the target path lints nothing and passes
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "fixups")
+    proc = subprocess.run(
+        [sys.executable, "-m", "opensearch_tpu.lint", str(repo),
+         "--changed", "--no-baseline"],
+        capture_output=True, text=True, cwd=str(repo), timeout=120,
+        env={**os.environ, "PYTHONPATH": str(REPO)})
+    assert proc.returncode == 0
+    assert "no changed python files" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# repo gates: zero pending fixes, and the scripts/check.sh wrapper exists
+# ---------------------------------------------------------------------------
+
+def test_repo_has_zero_pending_fixes():
+    proc = _run_cli("opensearch_tpu", "--fix", "--dry-run",
+                    "--format", "json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["pending_fixes"] == []
+
+
+def test_check_script_exists_and_runs_the_lint_gate():
+    script = REPO / "scripts" / "check.sh"
+    assert script.exists()
+    assert os.access(script, os.X_OK)
+    text = script.read_text()
+    assert "opensearch_tpu.lint" in text and "--fix --dry-run" in text
+
+
+def test_randutil_is_deterministic_under_injected_rng():
+    # the --fix rewrite target: drop-in, type-preserving, and a pure
+    # function of the installed RNG (the sim installs queue.random)
+    import random
+
+    from opensearch_tpu.common import randutil
+
+    def draw():
+        with randutil.rng_scope(random.Random(42)):
+            return (randutil.uuid4(), randutil.urandom(8),
+                    randutil.token_hex(4))
+
+    a, b, h = draw()
+    assert draw() == (a, b, h)
+    assert a.version == 4 and len(b) == 8 and len(h) == 8
+    # and without an injected instance, draws do NOT repeat
+    assert randutil.uuid4() != randutil.uuid4()
+
+
+def test_cli_changed_finds_untracked_files_from_a_subdirectory(tmp_path):
+    # `git ls-files --others` is cwd-relative while `diff --name-only` is
+    # root-relative; both must be anchored at the toplevel or an
+    # untracked file vanishes when the CLI runs from a subdir
+    repo = tmp_path / "r"
+    sub = repo / "sub"
+    sub.mkdir(parents=True)
+    assert _git(repo, "init", "-q").returncode == 0
+    (repo / "seed.py").write_text("x = 1\n")
+    _git(repo, "add", "-A")
+    assert _git(repo, "commit", "-qm", "seed").returncode == 0
+    (sub / "bad.py").write_text(
+        "def f(x):\n    try:\n        x()\n    except Exception:\n"
+        "        pass\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "opensearch_tpu.lint", str(repo),
+         "--changed", "--no-baseline", "--format", "json"],
+        capture_output=True, text=True, cwd=str(sub), timeout=120,
+        env={**os.environ, "PYTHONPATH": str(REPO)})
+    report = json.loads(proc.stdout)
+    assert report["files_checked"] == 1
+    assert {v["rule"] for v in report["violations"]} == {"TPU005"}
+    assert proc.returncode == 1
+
+
+def test_tpu008_truthiness_guard_is_a_test_not_an_escape():
+    # `if on_response:` is the same feasibility fact as `is not None` —
+    # it must neither mask a leak elsewhere (escape) nor flag the guarded
+    # resolution (pruning)
+    leaky = (
+        "def f(req, on_response, on_failure):\n"
+        "    if on_response:\n"
+        "        req.note()\n"
+        "    try:\n"
+        "        r = req.run()\n"
+        "    except ValueError:\n"
+        "        return\n"
+        "    on_response(r)\n"
+    )
+    assert [v.rule for v in lint_source("x.py", leaky, ALL_CHECKERS)] == \
+        ["TPU008"]
+    guarded = (
+        "def g(req, on_response, on_failure):\n"
+        "    try:\n"
+        "        r = req.run()\n"
+        "    except ValueError as e:\n"
+        "        if on_failure:\n"
+        "            on_failure(e)\n"
+        "        return\n"
+        "    if on_response:\n"
+        "        on_response(r)\n"
+    )
+    assert lint_source("y.py", guarded, ALL_CHECKERS) == []
+
+
+def test_fix_import_dedup_is_alias_aware():
+    # `... import timeutil as _tu` does not bind `timeutil`: the plain
+    # import must still be inserted or the rewrite NameErrors at runtime
+    src = (
+        "# tpulint: deterministic-module\n"
+        "import time\n"
+        "from opensearch_tpu.common import timeutil as _tu\n"
+        "t = time.time()\n"
+    )
+    new_source, fixes = fixes_mod.fix_source("m.py", src)
+    assert [fx.rule for fx in fixes] == ["TPU004"]
+    assert "from opensearch_tpu.common import timeutil\n" in new_source
+    ast.parse(new_source)
+    compiled = compile(new_source, "m.py", "exec")
+    namespace: dict = {}
+    exec(compiled, namespace)  # must not NameError
+    assert isinstance(namespace["t"], float)
+
+
+def test_fix_bare_except_keeps_baseexception_breadth():
+    src = (
+        "def drain(job):\n"
+        "    try:\n"
+        "        job()\n"
+        "    except:\n"
+        "        pass\n"
+    )
+    new_source, fixes = fixes_mod.fix_source("m.py", src)
+    assert [fx.rule for fx in fixes] == ["TPU005"]
+    # narrowing a bare except to Exception would change which errors
+    # propagate — a mechanical fixer must only add the logging
+    assert "except BaseException as e:" in new_source
+    ast.parse(new_source)
