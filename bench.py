@@ -174,6 +174,125 @@ def parent() -> int:
     return 0
 
 
+GATE_BUDGET_S = int(os.environ.get("BENCH_GATE_BUDGET_S", "300"))
+# CPU-backend-aware tolerances: shared-container CPU throughput is noisy
+# (co-tenancy, turbo states), so the CPU gate only fails on a clearly real
+# regression; TPU numbers are tighter. Override per-run with
+# BENCH_GATE_TOLERANCE=0.3 etc.
+GATE_TOLERANCE = {"cpu": 0.45, "tpu": 0.25}
+
+
+def gate_parent() -> int:
+    """`bench.py --gate`: the check.sh perf-regression gate. Runs a QUICK
+    same-shape measurement (streaming variant only, reduced reps) in a
+    watchdogged child and compares against the SAME PLATFORM's entry in
+    BENCH_CACHE.json. Exits 1 when fresh QPS falls below
+    cached * (1 - tolerance) — a PR that slows the hot path fails visibly
+    instead of silently. No cached entry for the platform => pass with a
+    note (nothing to ratchet against)."""
+    cache = _load_cache()
+    forced_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    platform = "cpu"
+    if not forced_cpu:
+        probe, _probe_err = _run(["--probe"], PROBE_S)
+        if probe is not None and probe.get("platform") not in (None, "cpu"):
+            platform = "tpu"
+    fresh, reason = _run(
+        ["--gate-child"], GATE_BUDGET_S,
+        platform_env="cpu" if platform == "cpu" else None,
+    )
+    if fresh is None:
+        print(json.dumps({
+            "metric": "bench_gate", "value": 0, "unit": "error",
+            "vs_baseline": 0,
+            "detail": f"gate child failed: {reason}", "ok": False,
+        }))
+        return 1
+    cached = cache.get(platform)
+    tol = float(os.environ.get(
+        "BENCH_GATE_TOLERANCE", GATE_TOLERANCE.get(platform, 0.45)))
+    out = {
+        "metric": "bench_gate", "unit": "queries/s",
+        "platform": platform,
+        "value": fresh.get("value", 0),
+        "vs_baseline": 0,
+        "tolerance": tol,
+    }
+    if cached is None or not cached.get("value"):
+        out.update({"ok": True,
+                    "detail": f"no cached {platform} baseline to gate "
+                              f"against"})
+        print(json.dumps(out))
+        return 0
+    floor = float(cached["value"]) * (1.0 - tol)
+    ok = float(fresh.get("value", 0)) >= floor
+    out.update({
+        "cached": cached["value"], "floor": round(floor, 1), "ok": ok,
+        "vs_baseline": round(float(fresh.get("value", 0))
+                             / float(cached["value"]), 3),
+    })
+    if not ok:
+        out["detail"] = (
+            f"hot-path regression: fresh {fresh.get('value')} qps < floor "
+            f"{round(floor, 1)} (cached {cached['value']} - {tol:.0%})")
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+def gate_child() -> None:
+    """Reduced same-shape measurement for the gate: the streaming fused
+    kNN scan (the cached CPU baseline's winning variant) over the same
+    corpus shape as child(), fewer reps, no recall/baseline section."""
+    jax = _pin_platform()
+    import functools
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from opensearch_tpu.ops.fused import knn_topk_streaming
+
+    d, k = 128, 10
+    chunk_q = 500
+    rng = np.random.default_rng(7)
+    platform = jax.devices()[0].platform
+    on_cpu = platform == "cpu"
+    n = 1_000_000 if not on_cpu else 100_000
+    n_pad = 1 << (n - 1).bit_length()
+
+    key = jax.random.PRNGKey(7)
+    vectors = jax.random.normal(key, (n, d), dtype=jnp.float32)
+    vectors = jnp.pad(vectors, ((0, n_pad - n), (0, 0)))
+    norms = jnp.sum(vectors * vectors, axis=-1)
+    valid = jnp.arange(n_pad) < n
+
+    f = functools.partial(knn_topk_streaming, k=k, similarity="l2_norm",
+                          chunk=32_768)
+
+    def run(v, nrm, ok, qs):
+        return jax.lax.map(lambda q: f(v, nrm, ok, q), qs)
+
+    jfn = jax.jit(run)
+    n_chunks = 16 if not on_cpu else 4
+    qs = jnp.asarray(
+        rng.standard_normal((n_chunks, chunk_q, d)).astype(np.float32))
+    total_q = n_chunks * chunk_q
+    np.asarray(jfn(vectors, norms, valid, qs)[0])  # compile + warm
+    walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(jfn(vectors, norms, valid, qs)[0])
+        walls.append(time.perf_counter() - t0)
+    wall = float(np.median(walls))
+    print(json.dumps({
+        "metric": f"gate_knn_qps_{n // 1000}k_{d}d_top{k}",
+        "value": round(total_q / wall, 1),
+        "unit": "queries/s",
+        "vs_baseline": 0,
+        "platform": platform,
+        "variant": "streaming_32k",
+    }))
+
+
 def profile_parent() -> int:
     """`bench.py --profile`: run ONE profiled query per workload in a
     child (same subprocess watchdog scheme as the QPS bench) and write the
@@ -576,6 +695,18 @@ if __name__ == "__main__":
             }))
             sys.exit(1)
         sys.exit(0)
+    if "--gate-child" in sys.argv:
+        try:
+            gate_child()
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "metric": "bench_error", "value": 0, "unit": "error",
+                "vs_baseline": 0, "detail": str(e)[:200],
+            }))
+            sys.exit(1)
+        sys.exit(0)
+    if "--gate" in sys.argv:
+        sys.exit(gate_parent())
     if "--concurrency" in sys.argv:
         sys.exit(concurrency_parent())
     if "--profile" in sys.argv:

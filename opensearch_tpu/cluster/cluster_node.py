@@ -63,6 +63,16 @@ from opensearch_tpu.search.executor import execute_query_phase
 from opensearch_tpu.search.service import _source_filter
 
 
+def _release_then(release: Callable[[], None],
+                  callback: Callable[[dict], None]) -> Callable[[dict], None]:
+    """Wrap a response callback so an admission slot releases exactly once,
+    right before the caller sees the response."""
+    def wrapped(resp: dict) -> None:
+        release()
+        callback(resp)
+    return wrapped
+
+
 class ClusterNode:
     def __init__(
         self,
@@ -136,6 +146,14 @@ class ClusterNode:
         self.settings_consumers.register(
             "search.knn.batch.", self.knn_batcher.apply_settings
         )
+        # workload-management groups: one registry per node, shared with the
+        # REST facade; bulk admission (wlm.admit_bulk) sheds tagged bulk
+        # traffic past its group's slot share with 429 BEFORE fan-out
+        from opensearch_tpu.wlm import QueryGroupService
+
+        self.query_groups = QueryGroupService(
+            self.data_path / "query_groups.json"
+        )
         self.local_shards: dict[tuple[str, int], IndexShard] = {}
         self._mapper_services: dict[str, MapperService] = {}
         self._index_versions: dict[str, int] = {}
@@ -156,6 +174,11 @@ class ClusterNode:
         self._recovery_sources = RecoverySourceSessions()
         self._recovery_drivers: dict[tuple[str, int], Any] = {}
         self.recoveries: dict[tuple[str, int], Any] = {}
+        # last routing state THIS node observed for its own copies: a
+        # STARTED -> INITIALIZING transition on the same key means the
+        # leader reset the copy while we were dark (see
+        # _apply_cluster_state's assignment-epoch check)
+        self._last_routing_state: dict[tuple[str, int], str] = {}
 
         reg = transport.register
         reg(node_id, "cluster:admin/create_index", self._on_create_index)
@@ -227,6 +250,12 @@ class ClusterNode:
     def _shard_state_tick(self) -> None:
         if getattr(self, "_closed", False):
             return
+        # expired reader contexts reap on a TICK, not only on the next
+        # search[node] arrival: a node whose copies stop being query
+        # targets (all-replica holder, post-relocation) would otherwise
+        # pin expired scroll/PIT snapshots forever (the reference runs a
+        # dedicated keep-alive reaper thread for the same reason)
+        self._reap_reader_contexts()
         for r in self.applied_state.shards_for_node(self.node_id):
             if r.state != "INITIALIZING":
                 continue
@@ -385,6 +414,21 @@ class ClusterNode:
                 shard = self.local_shards[(index_name, shard_num)]
                 was_primary = shard.primary
                 shard.primary = entry.primary
+                prev_state = self._last_routing_state.get(
+                    (index_name, shard_num))
+                if (entry.state == "INITIALIZING" and not entry.primary
+                        and prev_state in ("STARTED", "RELOCATING")
+                        and getattr(shard, "recovery_done", False)):
+                    # the leader RESET this copy: we last saw ourselves
+                    # STARTED, now we are INITIALIZING again — we were
+                    # evicted while dark (kill/partition) and re-assigned
+                    # the same slot. recovery_done belongs to the previous
+                    # assignment epoch; trusting it would report a copy
+                    # that MISSED acked writes as started (permanent
+                    # divergence — the chaos soak's copy-agreement
+                    # invariant caught this). Re-sync from the primary.
+                    shard.recovery_done = False
+                    shard.recovery_inflight = False
                 if (entry.primary and not was_primary
                         and shard.replication == "SEGMENT"):
                     # promotion of a segrep replica: translog ops not yet
@@ -411,6 +455,9 @@ class ClusterNode:
                         self._start_replica_recovery(
                             index_name, shard_num, state
                         )
+        self._last_routing_state = {
+            key: entry.state for key, entry in my_shards.items()
+        }
 
     # -- shard started / recovery ------------------------------------------
 
@@ -644,6 +691,11 @@ class ClusterNode:
                     if entry is not None and entry.seq_no >= op["seq_no"]:
                         continue  # covered by an installed segment
                     lcl.engine.append_translog_op(op)
+                # segments + tail form a point-in-time copy at max_seq_no;
+                # superseded ops' seq-no holes must not pin the checkpoint
+                # below the handoff (same contract as the dump path)
+                lcl.engine.tracker.fast_forward_processed(
+                    int(resp.get("max_seq_no", -1)))
                 # durability: the recovered copy must survive a crash
                 # BEFORE its first local flush (installed segments existed
                 # only in memory until here)
@@ -689,6 +741,12 @@ class ClusterNode:
                 lcl = self.local_shards.get((index, shard))
                 if lcl is None:
                     return False
+                # the dump is a point-in-time snapshot at max_seq_no: ops
+                # superseded before the snapshot (overwritten/deleted docs)
+                # left seq-no holes no future op can fill — jump the local
+                # checkpoint over them or the FINALIZE handoff wedges
+                lcl.engine.tracker.fast_forward_processed(
+                    int(resp.get("max_seq_no", -1)))
                 lcl.engine.translog.sync()
                 lcl.refresh()
                 return True
@@ -1054,38 +1112,107 @@ class ClusterNode:
             raise ShardNotFoundException(f"no primary for [{index}][{shard_num}]")
         return shard_num, primary
 
+    # transient write-routing retry: a relocation swap or primary failover
+    # can make the routed primary reject the write with
+    # ShardNotFoundException ("not on node ..." — the copy moved away) or
+    # leave the routing table momentarily without a primary. Both heal
+    # within one or two cluster-state publications, so the coordinator
+    # retries with RE-RESOLVED routing under exponential backoff instead of
+    # surfacing a 5xx for a perfectly healthy cluster. Only routing-shaped
+    # failures retry — the write provably never applied, so the retry
+    # cannot double-apply.
+    WRITE_RETRY_ATTEMPTS = 5
+    WRITE_RETRY_BASE_MS = 100
+
+    @staticmethod
+    def _is_transient_routing_error(err) -> bool:
+        text = str(err)
+        return ("ShardNotFoundException" in type(err).__name__
+                or "not on node" in text or "no primary for" in text)
+
+    def _write_with_retry(self, build_payload, callback, attempt: int = 0):
+        """`build_payload()` re-resolves routing and returns (primary_node,
+        payload); raises ShardNotFoundException while routing is in flux."""
+        def retry_or_fail(err) -> None:
+            if (attempt + 1 < self.WRITE_RETRY_ATTEMPTS
+                    and self._is_transient_routing_error(err)
+                    and not getattr(self, "_closed", False)):
+                self.scheduler.schedule(
+                    self.WRITE_RETRY_BASE_MS * (2 ** attempt),
+                    lambda: self._write_with_retry(
+                        build_payload, callback, attempt + 1),
+                )
+            else:
+                callback({"error": str(err)})
+
+        try:
+            primary_node, payload = build_payload()
+        except OpenSearchTpuException as e:
+            retry_or_fail(e)
+            return
+
+        def on_response(resp: dict) -> None:
+            # the primary answers routing staleness as an error response
+            # (handler raises travel back through on_failure; loopback
+            # handlers may surface them as {"error"} dicts)
+            if (isinstance(resp, dict) and "error" in resp
+                    and self._is_transient_routing_error(
+                        RuntimeError(resp["error"]))):
+                retry_or_fail(RuntimeError(resp["error"]))
+            else:
+                callback(resp)
+
+        self.transport.send(
+            self.node_id, primary_node, "indices:data/write[p]", payload,
+            on_response=on_response, on_failure=retry_or_fail,
+        )
+
     def index_doc(self, index: str, doc_id: str, source: dict,
                   callback: Callable[[dict], None], routing: str | None = None,
                   if_seq_no: int | None = None,
                   op_type: str | None = None) -> None:
-        shard_num, primary = self._routing_for_doc(index, doc_id, routing)
-        self.transport.send(
-            self.node_id, primary.node_id, "indices:data/write[p]",
-            {"index": index, "shard": shard_num, "op": "index", "id": doc_id,
-             "source": source, "routing": routing, "if_seq_no": if_seq_no,
-             "op_type": op_type},
-            on_response=callback,
-            on_failure=lambda e: callback({"error": str(e)}),
-        )
+        def build():
+            shard_num, primary = self._routing_for_doc(index, doc_id, routing)
+            return primary.node_id, {
+                "index": index, "shard": shard_num, "op": "index",
+                "id": doc_id, "source": source, "routing": routing,
+                "if_seq_no": if_seq_no, "op_type": op_type}
+
+        self._write_with_retry(build, callback)
 
     def delete_doc(self, index: str, doc_id: str,
                    callback: Callable[[dict], None], routing: str | None = None) -> None:
-        shard_num, primary = self._routing_for_doc(index, doc_id, routing)
-        self.transport.send(
-            self.node_id, primary.node_id, "indices:data/write[p]",
-            {"index": index, "shard": shard_num, "op": "delete", "id": doc_id,
-             "routing": routing},
-            on_response=callback,
-            on_failure=lambda e: callback({"error": str(e)}),
-        )
+        def build():
+            shard_num, primary = self._routing_for_doc(index, doc_id, routing)
+            return primary.node_id, {
+                "index": index, "shard": shard_num, "op": "delete",
+                "id": doc_id, "routing": routing}
+
+        self._write_with_retry(build, callback)
 
     def bulk(self, operations: list[tuple[str, dict, dict | None]],
-             callback: Callable[[dict], None]) -> None:
+             callback: Callable[[dict], None],
+             query_group: str | None = None) -> None:
         """TransportBulkAction analog: group items by owning SHARD and send
         ONE shard-bulk RPC per (shard, primary) — TransportShardBulkAction's
         batching (one replication round per shard, not per document). Item
-        order is preserved in the response regardless of completion order."""
+        order is preserved in the response regardless of completion order.
+
+        `query_group` tags the request for wlm admission: an enforced group
+        past its bulk slot share sheds the WHOLE request with a 429-shaped
+        error before any fan-out (no queue slots, no pending callbacks)."""
         from opensearch_tpu.common.timeutil import monotonic_millis
+
+        from opensearch_tpu.common.errors import RejectedExecutionException
+
+        try:
+            release_admission = self.query_groups.admit_bulk(query_group)
+        except RejectedExecutionException as e:
+            # typed-name prefix so facade._on_loop rehydrates the 429
+            callback({"error": f"RejectedExecutionException: {e}",
+                      "status": 429})
+            return
+        callback = _release_then(release_admission, callback)
 
         t0 = monotonic_millis()
         n = len(operations)
@@ -1510,18 +1637,52 @@ class ClusterNode:
 
         return self._offload(run)
 
+    # a lost shard-failed report must be RETRIED: the failing copy missed
+    # a write, and if no leader ever learns, it stays STARTED with stale
+    # data forever — permanent copy divergence (the chaos soak's
+    # copy-agreement invariant caught exactly this under one-way drops
+    # that also severed the primary -> leader path)
+    _SHARD_FAILED_RETRY_MS = 1_000
+    _SHARD_FAILED_MAX_RETRIES = 30
+
     def _report_shard_failed(self, index: str, shard: int, node_id: str,
-                             done: Callable[[], None]) -> None:
+                             done: Callable[[], None],
+                             _attempt: int = 0) -> None:
         leader = self.coordinator.leader_id
-        if leader is None:
+
+        def settle_and_retry(_e: Exception | None = None) -> None:
             done()
+            self._retry_shard_failed(index, shard, node_id, _attempt)
+
+        if leader is None:
+            settle_and_retry()
             return
         self.transport.send(
             self.node_id, leader, "internal:cluster/shard_failed",
             {"index": index, "shard": shard, "node_id": node_id},
             on_response=lambda _r: done(),
-            on_failure=lambda _e: done(),
+            on_failure=settle_and_retry,
         )
+
+    def _retry_shard_failed(self, index: str, shard: int, node_id: str,
+                            attempt: int) -> None:
+        if getattr(self, "_closed", False) or \
+                attempt >= self._SHARD_FAILED_MAX_RETRIES:
+            return
+
+        def tick() -> None:
+            if getattr(self, "_closed", False):
+                return
+            entry = next(
+                (r for r in self.applied_state.shards_for_index(index)
+                 if r.shard == shard and r.node_id == node_id
+                 and r.state in ("STARTED", "RELOCATING")), None)
+            if entry is None:
+                return  # the leader evicted/moved the copy — resolved
+            self._report_shard_failed(index, shard, node_id,
+                                      lambda: None, attempt + 1)
+
+        self.scheduler.schedule(self._SHARD_FAILED_RETRY_MS, tick)
 
     def _on_shard_failed(self, sender: str, payload: dict) -> dict:
         if not self.is_leader:
@@ -1781,9 +1942,14 @@ class ClusterNode:
                 continue
             if r.shard not in targets or r.primary:
                 targets[r.shard] = r
-        if len(targets) < meta.num_shards:
+        missing = meta.num_shards - len(targets)
+        if not targets:
             callback({"error": "not all shards available"})
             return
+        # shards with no serving copy (mid-failover) degrade the response
+        # instead of refusing it: the reachable shards answer and the
+        # missing ones count into _shards.failed
+        # (allow_partial_search_results=true semantics)
         results: dict[int, dict] = {}
         remaining = [len(targets)]
         tracer = self.telemetry.tracer
@@ -1810,7 +1976,8 @@ class ClusterNode:
                                     "index": index, "node": self.node_id,
                                     "shards": len(results)}):
                             merged = self._merge_search_results(
-                                results, size, from_, sort)
+                                results, size, from_, sort,
+                                extra_failed=missing)
                     except Exception as e:  # noqa: BLE001
                         # a reduce failure runs inside a transport
                         # completion callback — raising here leaks the
@@ -2130,6 +2297,7 @@ class ClusterNode:
     def _merge_search_results(
         self, results: dict[int, dict], size: int,
         from_: int = 0, sort: list | None = None,
+        extra_failed: int = 0,
     ) -> dict:
         total = 0
         max_score = None
@@ -2164,8 +2332,9 @@ class ClusterNode:
         out = {
             "took": 0,
             "timed_out": False,
-            "_shards": {"total": len(results), "successful": len(results) - failed,
-                        "skipped": 0, "failed": failed},
+            "_shards": {"total": len(results) + extra_failed,
+                        "successful": len(results) - failed,
+                        "skipped": 0, "failed": failed + extra_failed},
             "hits": {
                 "total": {"value": total, "relation": "eq"},
                 "max_score": max_score,

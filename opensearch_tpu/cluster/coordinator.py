@@ -680,6 +680,19 @@ class Coordinator:
             # a stale follower still checks us as its leader — reject so it
             # goes looking for the real one
             return {"ack": False, "term": self.coord.current_term}
+        if (self.mode == Mode.LEADER
+                and payload["leader_id"] == self.node_id
+                and sender != self.node_id
+                and sender not in self.applied_state.nodes):
+            # we evicted this node while its acks were dark (half-open
+            # link / partition). Acking its leader checks would leave it a
+            # PHANTOM FOLLOWER forever: it gets no publications (not in
+            # the state) and no follower checks (heartbeats iterate
+            # state.nodes), so nothing ever re-adds it. Reject instead —
+            # its leader-check failures send it back to candidate, and the
+            # pre-vote -> request_join path (the same one fresh boots use)
+            # re-admits it.
+            return {"ack": False, "term": self.coord.current_term}
         out = {"ack": True, "term": self.coord.current_term,
                "applied_version": self.applied_state.version}
         if self.check_extras is not None:

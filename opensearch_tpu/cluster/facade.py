@@ -139,11 +139,10 @@ class ClusterFacade:
         from opensearch_tpu.common.monitor import MonitorService
 
         self.monitor = MonitorService(cluster_node.data_path)
-        from opensearch_tpu.wlm import QueryGroupService
-
-        self.query_groups = QueryGroupService(
-            cluster_node.data_path / "query_groups.json"
-        )
+        # one wlm registry per node process: the facade (search admission)
+        # and the cluster node (bulk admission) must see the same groups
+        # and share the same slot budgets
+        self.query_groups = cluster_node.query_groups
         from opensearch_tpu.persistent import PersistentTasksService
 
         self.persistent_tasks = PersistentTasksService(
@@ -476,7 +475,8 @@ class ClusterFacade:
 
     def bulk(self, operations, refresh: bool = False,
              pipeline: str | None = None,
-             payload_bytes: int | None = None) -> dict:
+             payload_bytes: int | None = None,
+             query_group: str | None = None) -> dict:
         if pipeline is not None:
             self._unsupported("ingest pipelines")
         ops = []
@@ -485,7 +485,8 @@ class ClusterFacade:
             if action in ("index", "create") and not meta.get("_id"):
                 meta["_id"] = self._auto_id()
             ops.append((action, meta, source))
-        resp = self._on_loop(lambda cb: self.node.bulk(ops, cb))
+        resp = self._on_loop(
+            lambda cb: self.node.bulk(ops, cb, query_group=query_group))
         if refresh:
             touched = {m.get("_index") for _a, m, _s in ops if m.get("_index")}
             for idx in touched:
@@ -546,7 +547,8 @@ class ClusterFacade:
                search_pipeline: str | None = None,
                ignore_unavailable: bool = False,
                request_cache: bool | None = None,
-               query_group: str | None = None) -> dict:
+               query_group: str | None = None,
+               allow_partial_search_results: bool = True) -> dict:
         from opensearch_tpu.search.reduce import (
             check_cluster_aggs_supported,
             reduce_search_responses,
@@ -605,14 +607,36 @@ class ClusterFacade:
                   "keep_context": keep, "keep_alive_ms": keep_alive_ms})
                 for nid, idx, nums in assignments
             ])
-            self._raise_partial_errors(partials)
+            # a scroll must pin a context on EVERY node, so partial
+            # tolerance only applies to plain searches
+            if keep or not allow_partial_search_results:
+                self._raise_partial_errors(partials)
+            ok, failures = self._split_partials(assignments, partials)
+            if failures and not ok:
+                self._raise_partial_errors(partials)
             with tracer.start_span("search.reduce", {
-                "node": self.node_name, "partials": len(partials),
+                "node": self.node_name, "partials": len(ok),
             }):
                 resp = reduce_search_responses(
-                    body, partials, size=size, from_=from_,
+                    body, [p for _a, p in ok], size=size, from_=from_,
                     track_total=track_total
                 )
+            if failures:
+                # degrade, don't wedge: unreachable nodes' shards count as
+                # failed (allow_partial_search_results=true semantics) and
+                # the per-shard failure reasons ride along
+                failed_shards = sum(len(nums) for (_n, _i, nums), _e
+                                    in failures)
+                resp["_shards"]["total"] += failed_shards
+                resp["_shards"]["failed"] += failed_shards
+                # one failures entry PER SHARD (the reference's shape),
+                # so the list length matches the failed count
+                resp["_shards"]["failures"] = [
+                    {"node": nid, "index": idx, "shard": num,
+                     "reason": {"reason": str(err)}}
+                    for (nid, idx, nums), err in failures
+                    for num in (nums or [-1])
+                ]
         # same request metrics the single-node path records, so
         # /_prometheus/metrics is useful in cluster mode too
         self.telemetry.metrics.counter("search.total").add(1)
@@ -635,6 +659,28 @@ class ClusterFacade:
         for p in partials:
             if isinstance(p, dict) and "error" in p and "hits" not in p:
                 raise rehydrate_error(p["error"])
+
+    @staticmethod
+    def _split_partials(
+        assignments: list[tuple], partials: list[dict],
+    ) -> tuple[list[tuple], list[tuple]]:
+        """Partition per-node partials into (ok, failures): ok entries are
+        (assignment, partial), failures are (assignment, error). Query-shape
+        errors (parse failures — every node rejects identically) are raised
+        immediately: degrading them to partial results would mask a client
+        bug as a transient outage."""
+        ok: list[tuple] = []
+        failures: list[tuple] = []
+        for a, p in zip(assignments, partials):
+            if isinstance(p, dict) and "error" in p and "hits" not in p:
+                err = rehydrate_error(p["error"])
+                if isinstance(err, (IllegalArgumentException,)) or \
+                        "ParsingException" in str(p["error"]):
+                    raise err
+                failures.append((a, err))
+            else:
+                ok.append((a, p))
+        return ok, failures
 
     def scroll(self, scroll_id: str, scroll: str | None = None) -> dict:
         from opensearch_tpu.search.reduce import reduce_hits

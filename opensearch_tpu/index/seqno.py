@@ -62,6 +62,24 @@ class LocalCheckpointTracker:
             self._checkpoint += 1
             self._pending.discard(self._checkpoint)
 
+    def fast_forward_processed(self, seq_no: int) -> None:
+        """Mark EVERYTHING at or below `seq_no` processed. A point-in-time
+        copy (recovery dump / segment snapshot) taken at `seq_no` already
+        incorporates every op at or below it — including ops superseded by
+        later overwrites or deletes, whose individual seq_nos can never be
+        observed again on the copy. Without this jump those holes pin the
+        local checkpoint forever and the recovery seqno handoff can never
+        complete (the reference seeds a recovering copy's local checkpoint
+        from the source commit's maxSeqNo for the same reason)."""
+        self.advance_max_seq_no(seq_no)
+        if seq_no <= self._checkpoint:
+            return
+        self._checkpoint = seq_no
+        self._pending = {s for s in self._pending if s > seq_no}
+        while self._checkpoint + 1 in self._pending:
+            self._checkpoint += 1
+            self._pending.discard(self._checkpoint)
+
     def has_processed(self, seq_no: int) -> bool:
         return seq_no <= self._checkpoint or seq_no in self._pending
 

@@ -1600,6 +1600,149 @@ class InterproceduralLockOrderChecker(Checker):
 
 
 # ---------------------------------------------------------------------------
+# TPU011 — blocking on the serial data worker
+# ---------------------------------------------------------------------------
+
+# call targets that hand a callable to the serial data worker; the first
+# positional argument runs there (`ClusterNode._offload` / `_after_offload`)
+_OFFLOAD_FUNCS = {"_offload", "_after_offload"}
+_DW_BLOCKING_PREFIXES = ("socket.", "requests.", "urllib.request.")
+_DW_BLOCKING_CALLS = {"time.sleep", "input"}
+# zero-arg, untimed forms of these methods block indefinitely: Condition/
+# Event.wait(), Lock.acquire(), Future.result(), Thread.join(). A wedged
+# data worker stalls EVERY search/write on the node (one worker keeps the
+# engine's single-writer discipline), and the soak's quiesce contract
+# (every op completes) depends on the worker never parking forever.
+_DW_UNTIMED_METHODS = {"wait", "acquire", "result", "join"}
+
+
+class _DataWorkerScan(ast.NodeVisitor):
+    """Walk one offloaded callable's body; follow direct delegation to
+    local helper defs and same-class `self.*` methods (bounded depth)."""
+
+    MAX_DEPTH = 3
+
+    def __init__(self, ctx: FileContext, methods: dict, local_defs: dict):
+        self.ctx = ctx
+        self.methods = methods
+        self.local_defs = local_defs
+        self.out: list[Violation] = []
+        self._visited: set[int] = set()
+        self._depth = 0
+
+    # nested defs are usually completion callbacks that run back on the
+    # transport loop, not on the worker — only follow them when CALLED
+    # directly (handled in visit_Call), never by definition
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+    visit_Lambda = visit_FunctionDef  # type: ignore[assignment]
+
+    def _follow(self, fn: ast.FunctionDef) -> None:
+        if id(fn) in self._visited or self._depth >= self.MAX_DEPTH:
+            return
+        self._visited.add(id(fn))
+        self._depth += 1
+        try:
+            for stmt in fn.body:
+                self.visit(stmt)
+        finally:
+            self._depth -= 1
+
+    def visit_Call(self, node: ast.Call) -> None:
+        raw = call_name(node)
+        name = self.ctx.canonical(raw)
+        if name in _DW_BLOCKING_CALLS:
+            self.out.append(self.ctx.violation(
+                "TPU011", node,
+                f"{name}() parks the serial data worker; every search and "
+                f"write on the node stalls behind it"))
+        elif name is not None and name.startswith(_DW_BLOCKING_PREFIXES):
+            self.out.append(self.ctx.violation(
+                "TPU011", node,
+                f"{name}() is blocking network IO on the serial data "
+                f"worker"))
+        elif (
+            name is not None
+            and name.split(".")[-1] in _DW_UNTIMED_METHODS
+            and not node.args
+            and not any(kw.arg in ("timeout", "blocking")
+                        for kw in node.keywords)
+            and "." in name  # bare wait()/result() locals are not waits
+        ):
+            self.out.append(self.ctx.violation(
+                "TPU011", node,
+                f"untimed {name}() can wedge the serial data worker "
+                f"forever; pass a timeout"))
+        # direct delegation: run() -> helper() / self.method()
+        if isinstance(node.func, ast.Name):
+            target = self.local_defs.get(node.func.id)
+            if target is not None:
+                self._follow(target)
+        elif raw is not None and raw.startswith("self."):
+            parts = raw.split(".")
+            if len(parts) == 2:
+                target = self.methods.get(parts[1])
+                if target is not None:
+                    self._follow(target)
+        self.generic_visit(node)
+
+
+class BlockingOnDataWorkerChecker(Checker):
+    rule_id = "TPU011"
+    name = "blocking-on-data-worker"
+    description = ("untimed waits and blocking IO inside callables "
+                   "offloaded to the serial data worker "
+                   "(_offload/_after_offload)")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return "_offload" in source
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for cls in (n for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.ClassDef)):
+            methods = {m.name: m for m in cls.body
+                       if isinstance(m, ast.FunctionDef)}
+            for method in methods.values():
+                local_defs = {
+                    d.name: d for d in ast.walk(method)
+                    if isinstance(d, ast.FunctionDef) and d is not method
+                }
+                for call in ast.walk(method):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    cname = call_name(call)
+                    if (cname is None
+                            or cname.split(".")[-1] not in _OFFLOAD_FUNCS
+                            or not call.args):
+                        continue
+                    target = call.args[0]
+                    scan = _DataWorkerScan(ctx, methods, local_defs)
+                    if isinstance(target, ast.Lambda):
+                        scan.visit(target.body)
+                    elif isinstance(target, ast.Name) and \
+                            target.id in local_defs:
+                        scan._follow(local_defs[target.id])
+                    elif isinstance(target, ast.Attribute) and \
+                            isinstance(target.value, ast.Name) and \
+                            target.value.id == "self" and \
+                            target.attr in methods:
+                        scan._follow(methods[target.attr])
+                    out.extend(scan.out)
+        # one offloaded helper reached from several sites reports once
+        seen: set[tuple] = set()
+        deduped = []
+        for v in out:
+            key = (v.line, v.col, v.message)
+            if key not in seen:
+                seen.add(key)
+                deduped.append(v)
+        return deduped
+
+
+# ---------------------------------------------------------------------------
 
 ALL_CHECKERS: list[Checker] = [
     JitPurityChecker(),
@@ -1612,6 +1755,7 @@ ALL_CHECKERS: list[Checker] = [
     CallbackLeakChecker(),
     UnboundedGrowthChecker(),
     InterproceduralLockOrderChecker(),
+    BlockingOnDataWorkerChecker(),
 ]
 
 RULES: dict[str, Checker] = {c.rule_id: c for c in ALL_CHECKERS}

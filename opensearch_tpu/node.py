@@ -2038,11 +2038,13 @@ class TpuNode:
 
     def bulk(self, operations: list[tuple[str, dict, dict | None]],
              refresh: bool = False, pipeline: str | None = None,
-             payload_bytes: int | None = None) -> dict:
+             payload_bytes: int | None = None,
+             query_group: str | None = None) -> dict:
         """operations: [(action, metadata, source)]; action in
         index|create|update|delete. `payload_bytes` lets the transport
         layer pass the already-known request size so the pressure estimate
-        doesn't re-serialize every document."""
+        doesn't re-serialize every document. `query_group` tags the request
+        for wlm bulk admission (429 shed past the group's slot share)."""
         t0 = time.monotonic()
         if payload_bytes is not None:
             payload_bytes = int(payload_bytes)
@@ -2051,6 +2053,15 @@ class TpuNode:
                 len(json.dumps(source)) for _, _, source in operations
                 if source is not None
             )
+        release_admission = self.query_groups.admit_bulk(query_group)
+        try:
+            return self._bulk_admitted(
+                operations, refresh, pipeline, payload_bytes, t0)
+        finally:
+            release_admission()
+
+    def _bulk_admitted(self, operations, refresh, pipeline,
+                       payload_bytes, t0) -> dict:
         with self._write_pressure(payload_bytes, "bulk"):
             with self.task_manager.task_scope(
                 "indices:data/write/bulk",

@@ -787,7 +787,8 @@ def bulk(node: TpuNode, params, query, body):
         ops.append((action, meta, source))
     return 200, node.bulk(ops, refresh=_refresh_param(query),
                           pipeline=query.get("pipeline"),
-                          payload_bytes=query.get("_payload_bytes"))
+                          payload_bytes=query.get("_payload_bytes"),
+                          query_group=query.get("query_group"))
 
 
 def _mget_deprecated_check(body):

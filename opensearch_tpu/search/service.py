@@ -677,6 +677,19 @@ def search(
             [s.mapper_service for s in shards],
         )
 
+    if partial:
+        # stamp the reader generation each shard's result was computed
+        # from: one snapshot per shard, acquired once for the whole
+        # request. The chaos-soak invariant checker
+        # (testing/soak.py) asserts a response never mixes generations for
+        # one shard and that generations observed through one serving copy
+        # never move backwards.
+        response["_generations"] = {
+            str(shard_numbers[i] if shard_numbers is not None
+                else shard.shard_id.shard): snap.generation
+            for i, (shard, snap, _r) in enumerate(per_shard_results)
+        }
+
     if want_profile:
         # per-shard deep profile (search/profile.ShardProfiler): the
         # per-operator tree with the TPU-specific fields (device kernel

@@ -1,0 +1,1453 @@
+"""Ingest-while-serving chaos soak: seeded scenario replay + invariants.
+
+The scenario-diversity tier the ROADMAP's north star asks for (open item
+4): a deterministic workload generator interleaves heavy indexing / bulk /
+refresh / force-merge (and the relocations and re-recoveries that node
+kill/heal cycles force) against a live mixed query stream — BM25 match,
+kNN through the dispatch batcher, aggregations, hybrid BM25+kNN fusion,
+msearch, scroll and PIT — on a multi-node simulated cluster, while a
+fault scheduler injects node kills, partitions, slow links and one-way
+drops from the MockTransport disruption machinery.
+
+Everything is replayable from ONE seed: virtual time comes from the
+DeterministicTaskQueue (installed via timeutil.clock_scope), entropy from
+the queue's seeded RNG (randutil.rng_scope), and every workload/fault
+decision is drawn at PLAN time from seed-derived `random.Random` streams,
+so op interleavings are a pure function of the seed. On any invariant
+violation the seed is printed with the exact replay command and the
+failure carries the event-log digest, so a bug found at 3am reproduces
+byte-identically on a laptop (`--replay SEED`).
+
+A pluggable invariant checker asserts, at runtime and after each cycle's
+quiesce:
+
+- **no-acked-write-loss** — every acked create is searchable, every acked
+  delete stays gone, all copies of a shard agree on doc counts;
+- **snapshot-isolation** — a search response never returns the same _id
+  twice (a torn snapshot double-serves a doc), never returns phantom ids,
+  and the reader generation stamped per shard partial
+  (search/service.py `_generations`) never falls below the generation the
+  engine had already published when the query was issued;
+- **recovery-monotonicity** — recovery progress records only move
+  forward: stages in order, counters non-decreasing, terminal stages
+  immutable;
+- **shed-correctness** — every issued request completes exactly once
+  (shed 429s included), and shed requests leave no queue slots behind;
+- **bounded-queues** — the kNN batcher queue, wlm bulk slots and reader
+  contexts all return to zero/empty at quiesce;
+- **convergence** — after heal the cluster returns to one agreed leader,
+  all shards STARTED on live nodes, nothing relocating or unassigned;
+- **interactive-under-flood** — with a wlm `enforced` group flooding
+  bulk, the flood sheds 429s at its slot share while every interactive
+  query issued during the flood completes.
+
+Run it::
+
+    python -m opensearch_tpu.testing.soak --seed 7 --cycles 3
+    python -m opensearch_tpu.testing.soak --replay 7   # byte-identical
+
+Add a scenario: extend `_plan_cycle_ops` (one weighted entry + a
+`_issue_*` method). Add an invariant: subclass :class:`Invariant` and pass
+it via ``run_soak(extra_invariants=[...])`` — hooks fire per response
+(`on_response`), per periodic probe (`at_probe`) and per cycle quiesce
+(`at_quiesce`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable
+
+from opensearch_tpu.common import randutil, timeutil
+from opensearch_tpu.testing.sim import DeterministicTaskQueue, MockTransport
+
+# stage order for recovery-progress monotonicity; terminal stages rank top
+_STAGE_RANK = {"INIT": 0, "INDEX": 1, "TRANSLOG": 2, "FINALIZE": 3,
+               "DONE": 4, "FAILED": 4}
+
+_VEC_DIM = 4
+
+
+class SoakFailure(AssertionError):
+    """An invariant violation (or a wedged run). Carries everything needed
+    to reproduce: the seed, the cycle, and the event-log digest up to the
+    failure point."""
+
+    def __init__(self, seed: int, cycle: int, invariant: str, detail: str,
+                 digest: str):
+        self.seed = seed
+        self.cycle = cycle
+        self.invariant = invariant
+        self.detail = detail
+        self.digest = digest
+        super().__init__(
+            f"[{invariant}] cycle {cycle}: {detail}\n"
+            f"  seed={seed} digest={digest}\n"
+            f"  replay: python -m opensearch_tpu.testing.soak --replay {seed}"
+        )
+
+
+@dataclass
+class SoakConfig:
+    seed: int
+    cycles: int = 3
+    nodes: int = 3
+    ops_per_cycle: int = 30
+    cycle_ms: int = 20_000
+    chaos: bool = True
+    # which cycle runs the wlm bulk-flood scenario (-1 disables)
+    flood_cycle: int = 1
+    # test hook: deterministically corrupt one copy mid-run so the
+    # no-acked-write-loss invariant MUST fire (replay regression tests)
+    inject_acked_write_loss: bool = False
+    replica_count: int = 1
+
+
+@dataclass
+class SoakReport:
+    seed: int
+    cycles_completed: int = 0
+    ops_issued: int = 0
+    ops_completed: int = 0
+    ops_degraded: int = 0      # completed with partial failures / errors
+    sheds: int = 0             # 429-shaped completions
+    faults_injected: list = field(default_factory=list)
+    invariants_checked: int = 0
+    flood: dict = field(default_factory=dict)
+    digest: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "seed": self.seed, "cycles_completed": self.cycles_completed,
+            "ops_issued": self.ops_issued,
+            "ops_completed": self.ops_completed,
+            "ops_degraded": self.ops_degraded, "sheds": self.sheds,
+            "faults_injected": self.faults_injected,
+            "invariants_checked": self.invariants_checked,
+            "flood": self.flood,
+            "digest": self.digest,
+        }
+
+
+# --------------------------------------------------------------------- #
+# invariants
+# --------------------------------------------------------------------- #
+
+
+class Invariant:
+    """Base class for pluggable checks. Raise nothing — call
+    ``harness.fail(self, detail)`` so failures carry the replay seed."""
+
+    name = "invariant"
+
+    def on_response(self, harness: "SoakHarness", op: dict,
+                    resp: dict) -> None:
+        pass
+
+    def at_probe(self, harness: "SoakHarness") -> None:
+        pass
+
+    def at_quiesce(self, harness: "SoakHarness") -> None:
+        pass
+
+
+class AckedWritesSurvive(Invariant):
+    """At quiesce: acked creates are searchable, acked deletes are gone,
+    all copies of a shard agree on doc counts."""
+
+    name = "no-acked-write-loss"
+
+    def at_quiesce(self, h: "SoakHarness") -> None:
+        state = h.live_leader().applied_state
+        for index in h.indices:
+            must_have = h.acked_present(index)
+            must_miss = h.acked_deleted(index)
+            attempted = h.attempted_ids(index)
+            found = h.search_all_ids(index)
+            lost = must_have - found
+            if lost:
+                h.fail(self, f"acked docs missing from [{index}]: "
+                             f"{sorted(lost)[:10]} ({len(lost)} total)")
+            risen = must_miss & found
+            if risen:
+                h.fail(self, f"acked-deleted docs resurfaced in [{index}]: "
+                             f"{sorted(risen)[:10]}")
+            phantom = found - attempted
+            if phantom:
+                h.fail(self, f"phantom docs in [{index}]: "
+                             f"{sorted(phantom)[:10]}")
+            # copy agreement (engine-level doc counts, replication check)
+            by_shard: dict[int, dict[str, int]] = {}
+            for r in state.shards_for_index(index):
+                shard = h.nodes[r.node_id].local_shards.get((index, r.shard))
+                if shard is not None:
+                    by_shard.setdefault(r.shard, {})[r.node_id] = \
+                        shard.num_docs
+            for num, counts in by_shard.items():
+                if len(set(counts.values())) > 1:
+                    h.fail(self, f"copies of [{index}][{num}] disagree on "
+                                 f"doc count: {counts}")
+
+
+class SnapshotIsolation(Invariant):
+    """Per search response: no duplicate ids (torn snapshot), no phantom
+    ids, and per-shard generation stamps never below the engine's
+    already-published generation at issue time."""
+
+    name = "snapshot-isolation"
+
+    def on_response(self, h: "SoakHarness", op: dict, resp: dict) -> None:
+        hits = ((resp.get("hits") or {}).get("hits")) or []
+        ids = [hit["_id"] for hit in hits if "_id" in hit]
+        if len(ids) != len(set(ids)):
+            dup = sorted({i for i in ids if ids.count(i) > 1})
+            h.fail(self, f"op#{op['i']} [{op['kind']}] returned duplicate "
+                         f"ids {dup} — a response mixed snapshots")
+        index = op.get("index")
+        if index is not None:
+            unknown = set(ids) - h.attempted_ids(index)
+            if unknown:
+                h.fail(self, f"op#{op['i']} [{op['kind']}] returned phantom "
+                             f"ids {sorted(unknown)[:10]}")
+        # generation floors: each per-node partial stamped {shard: gen};
+        # the engine had already published `floor` when the op was issued,
+        # and snapshots are acquired at handler time (later), so a lower
+        # stamp means a stale/torn snapshot was served
+        for (index, shard_num, nid, engine_id), gen in \
+                (op.get("generations") or {}).items():
+            floor = op.get("floors", {}).get((index, shard_num, nid))
+            if floor is None:
+                continue
+            floor_gen, floor_engine_id = floor
+            if engine_id == floor_engine_id and gen < floor_gen:
+                h.fail(self, f"op#{op['i']} [{op['kind']}] served "
+                             f"[{index}][{shard_num}] on {nid} from "
+                             f"generation {gen} < published {floor_gen}")
+
+
+class RecoveryMonotonicity(Invariant):
+    """Recovery progress only moves forward within one attempt: stage
+    ranks non-decreasing, counters non-decreasing, terminal immutable."""
+
+    name = "recovery-monotonicity"
+
+    _COUNTERS = ("files_recovered", "bytes_recovered", "ops_recovered",
+                 "retries")
+
+    def __init__(self) -> None:
+        # one entry per (node, index, shard), holding a STRONG reference
+        # to the observed record: identity comparison detects a fresh
+        # attempt, and the kept reference stops CPython from reusing the
+        # old record's address (id()-keying raced the allocator and could
+        # fire non-replayable false violations)
+        self._seen: dict[tuple, dict] = {}
+
+    def at_probe(self, h: "SoakHarness") -> None:
+        for nid, node in h.nodes.items():
+            for (index, shard), rec in list(node.recoveries.items()):
+                key = (nid, index, shard)
+                prev = self._seen.get(key)
+                if prev is not None and prev["rec"] is not rec:
+                    prev = None  # a new attempt replaced the record
+                cur = {"rec": rec, "stage": rec.stage,
+                       **{c: getattr(rec, c) for c in self._COUNTERS}}
+                if prev is not None:
+                    p_rank = _STAGE_RANK.get(prev["stage"], 0)
+                    c_rank = _STAGE_RANK.get(cur["stage"], 0)
+                    if c_rank < p_rank:
+                        h.fail(self, f"recovery [{index}][{shard}] on "
+                                     f"{nid} moved backwards: "
+                                     f"{prev['stage']} -> {cur['stage']}")
+                    if prev["stage"] in ("DONE", "FAILED") and \
+                            cur["stage"] != prev["stage"]:
+                        h.fail(self, f"terminal recovery [{index}][{shard}]"
+                                     f" on {nid} mutated: {prev['stage']}"
+                                     f" -> {cur['stage']}")
+                    for c in self._COUNTERS:
+                        if cur[c] < prev[c]:
+                            h.fail(self, f"recovery [{index}][{shard}] on "
+                                         f"{nid}: {c} decreased "
+                                         f"{prev[c]} -> {cur[c]}")
+                self._seen[key] = cur
+
+    def at_quiesce(self, h: "SoakHarness") -> None:
+        self.at_probe(h)
+
+
+class ShedCorrectness(Invariant):
+    """Every issued op completed exactly once; shed (429) requests left no
+    queue slots behind."""
+
+    name = "shed-correctness"
+
+    def at_quiesce(self, h: "SoakHarness") -> None:
+        incomplete = [op["i"] for op in h.ops if op["completions"] == 0]
+        if incomplete:
+            h.fail(self, f"ops never completed (wedged callbacks): "
+                         f"{incomplete[:10]} ({len(incomplete)} total)")
+        doubled = [op["i"] for op in h.ops if op["completions"] > 1]
+        if doubled:
+            h.fail(self, f"ops completed more than once: {doubled[:10]}")
+        for nid, node in h.nodes.items():
+            wlm = node.query_groups.bulk_stats()
+            for gid, stats in wlm.items():
+                if stats["current"] != 0:
+                    h.fail(self, f"wlm bulk slots leaked on {nid} "
+                                 f"group {gid}: {stats}")
+
+
+class BoundedQueues(Invariant):
+    """The kNN batcher's pending queue and in-flight map drain to zero at
+    quiesce; reader contexts hold only what the workload still has open."""
+
+    name = "bounded-queues"
+
+    def at_quiesce(self, h: "SoakHarness") -> None:
+        from opensearch_tpu.search import batcher as batcher_mod
+
+        b = batcher_mod.default_batcher
+        if b.pressure.stats()["current"] != 0:
+            h.fail(self, f"batcher queue slots leaked: "
+                         f"{b.pressure.stats()}")
+        if b._buckets:
+            h.fail(self, f"batcher buckets not drained: "
+                         f"{list(b._buckets)[:5]}")
+        if b._in_flight:
+            h.fail(self, f"batcher in-flight launches leaked: "
+                         f"{dict(b._in_flight)}")
+        open_ctx = h.open_context_ids()
+        for nid, node in h.nodes.items():
+            extra = set(node._reader_contexts) - open_ctx
+            if extra and h.final_quiesce:
+                h.fail(self, f"reader contexts leaked on {nid}: "
+                             f"{sorted(extra)[:5]}")
+
+
+class ClusterConverges(Invariant):
+    """After heal: one agreed leader, everything STARTED on live nodes,
+    nothing relocating/unassigned, routing backed by local shards."""
+
+    name = "convergence"
+
+    def at_quiesce(self, h: "SoakHarness") -> None:
+        leaders = [n for n in h.nodes.values() if n.is_leader]
+        if len(leaders) != 1:
+            h.fail(self, f"expected one leader, got "
+                         f"{[n.node_id for n in leaders]}")
+        leader = leaders[0]
+        for nid, node in h.nodes.items():
+            if node.coordinator.leader_id != leader.node_id:
+                h.fail(self, f"{nid} disagrees on leader: "
+                             f"{node.coordinator.leader_id} != "
+                             f"{leader.node_id}")
+        state = leader.applied_state
+        bad = [r for r in state.routing if r.state != "STARTED"
+               or r.node_id is None or r.relocating_node]
+        if bad:
+            h.fail(self, f"routing not converged: {bad[:5]}")
+        for r in state.routing:
+            if (r.index, r.shard) not in h.nodes[r.node_id].local_shards:
+                h.fail(self, f"routing says [{r.index}][{r.shard}] on "
+                             f"{r.node_id} but no local shard exists")
+
+
+class InteractiveUnderFlood(Invariant):
+    """wlm slice: the flood group's bulks shed 429 at its slot share while
+    every interactive query issued during the flood completes."""
+
+    name = "interactive-under-flood"
+
+    def at_quiesce(self, h: "SoakHarness") -> None:
+        if h.cycle != h.cfg.flood_cycle or not h.flood_stats["bulks"]:
+            return
+        if h.flood_stats["sheds"] == 0:
+            h.fail(self, f"bulk flood past the group share never shed: "
+                         f"{h.flood_stats}")
+        inter = h.flood_stats["interactive"]
+        done = h.flood_stats["interactive_ok"]
+        if done < inter:
+            h.fail(self, f"interactive queries starved under bulk flood: "
+                         f"{done}/{inter} completed")
+
+
+DEFAULT_INVARIANTS: tuple[Callable[[], Invariant], ...] = (
+    AckedWritesSurvive, SnapshotIsolation, RecoveryMonotonicity,
+    ShedCorrectness, BoundedQueues, ClusterConverges, InteractiveUnderFlood,
+)
+
+
+# --------------------------------------------------------------------- #
+# callback-style cluster client (the facade's fan-out without its threads)
+# --------------------------------------------------------------------- #
+
+
+class SoakClient:
+    """Coordinator-side search surface over the sim transport, callback
+    style so it runs inside the deterministic queue: search[node] fan-out
+    + reduce (kNN/aggs/hybrid ride the full per-node search service),
+    msearch[node], scroll and PIT via pinned reader contexts. Per-node
+    failures degrade into `_shards.failed` instead of wedging the op."""
+
+    def __init__(self, harness: "SoakHarness"):
+        self.h = harness
+
+    # -- assignment (one (node, shards) call per data node) ----------------
+
+    def assignments(self, via: str, index: str):
+        state = self.h.nodes[via].applied_state
+        meta = state.indices.get(index)
+        if meta is None:
+            return None, 0
+        targets: dict[int, Any] = {}
+        for r in state.shards_for_index(index):
+            if r.state not in ("STARTED", "RELOCATING") or r.node_id is None:
+                continue
+            if r.shard not in targets or r.primary:
+                targets[r.shard] = r
+        by_node: dict[str, list[int]] = {}
+        for num, r in sorted(targets.items()):
+            by_node.setdefault(r.node_id, []).append(num)
+        missing = meta.num_shards - len(targets)
+        return sorted(by_node.items()), missing
+
+    def _fan_out(self, via: str, index: str, calls: list[tuple[str, str, dict]],
+                 on_done: Callable[[list], None]) -> None:
+        """Send every (target, action, payload); collect responses/errors in
+        order; on_done(list) fires exactly once when all arrived."""
+        results: list[Any] = [None] * len(calls)
+        remaining = [len(calls)]
+
+        def finish(i: int, value: Any) -> None:
+            results[i] = value
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                on_done(results)
+
+        for i, (target, action, payload) in enumerate(calls):
+            self.h.transport.send(
+                via, target, action, payload,
+                on_response=lambda r, i=i: finish(i, r),
+                on_failure=lambda e, i=i: finish(i, {"error": str(e)}),
+            )
+        if not calls:
+            self.h.queue.schedule(0, lambda: on_done([]))
+
+    def search(self, via: str, index: str, body: dict,
+               callback: Callable[[dict], None], *,
+               keep_context: bool = False,
+               keep_alive_ms: int = 60_000) -> None:
+        from opensearch_tpu.search.reduce import reduce_search_responses
+
+        assign, missing = self.assignments(via, index)
+        if assign is None or not assign:
+            callback({"error": f"no serving copy of [{index}]"})
+            return
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        node_body = dict(body)
+        node_body["from"] = 0
+        node_body["size"] = from_ + size
+        node_body["track_total_hits"] = True
+        calls = [(nid, "indices:data/read/search[node]",
+                  {"index": index, "shards": nums, "body": node_body,
+                   "keep_context": keep_context,
+                   "keep_alive_ms": keep_alive_ms})
+                 for nid, nums in assign]
+
+        def on_done(results: list) -> None:
+            ok, failed_shards, stamps, contexts = [], missing, {}, {}
+            for (nid, nums), p in zip(assign, results):
+                if isinstance(p, dict) and "hits" in p:
+                    ok.append(p)
+                    for s, gen in (p.get("_generations") or {}).items():
+                        stamps[(index, int(s), nid)] = gen
+                    if "_ctx_id" in p:
+                        contexts[nid] = p["_ctx_id"]
+                else:
+                    failed_shards += len(nums)
+            if not ok:
+                callback({"error": f"every node failed for [{index}]",
+                          "_soak_failed_shards": failed_shards})
+                return
+            try:
+                resp = reduce_search_responses(
+                    body, ok, size=size, from_=from_,
+                    track_total=body.get("track_total_hits", True))
+            except Exception as e:  # noqa: BLE001 - degrade, never wedge
+                callback({"error": f"reduce failed: {type(e).__name__}: {e}"})
+                return
+            resp["_shards"]["total"] += failed_shards
+            resp["_shards"]["failed"] += failed_shards
+            resp["_soak_generations"] = stamps
+            if contexts:
+                resp["_soak_contexts"] = contexts
+            callback(resp)
+
+        self._fan_out(via, index, calls, on_done)
+
+    def msearch(self, via: str, index: str, bodies: list[dict],
+                callback: Callable[[dict], None]) -> None:
+        from opensearch_tpu.search.reduce import reduce_search_responses
+
+        assign, missing = self.assignments(via, index)
+        if assign is None or not assign:
+            callback({"error": f"no serving copy of [{index}]"})
+            return
+        node_bodies = []
+        for b in bodies:
+            nb = dict(b)
+            nb["from"] = 0
+            nb["size"] = int(b.get("from", 0)) + int(b.get("size", 10))
+            nb["track_total_hits"] = True
+            node_bodies.append(nb)
+        calls = [(nid, "indices:data/read/msearch[node]",
+                  {"index": index, "shards": nums, "bodies": node_bodies})
+                 for nid, nums in assign]
+
+        def on_done(results: list) -> None:
+            responses = []
+            for bi, b in enumerate(bodies):
+                parts = []
+                failed = missing
+                for (nid, nums), node_resp in zip(assign, results):
+                    if isinstance(node_resp, dict) and \
+                            "responses" in node_resp:
+                        p = node_resp["responses"][bi]
+                        if isinstance(p, dict) and "hits" in p:
+                            parts.append(p)
+                        else:
+                            failed += len(nums)
+                    else:
+                        failed += len(nums)
+                if not parts:
+                    responses.append({"error": "all nodes failed"})
+                    continue
+                try:
+                    r = reduce_search_responses(
+                        b, parts, size=int(b.get("size", 10)),
+                        from_=int(b.get("from", 0)), track_total=True)
+                except Exception as e:  # noqa: BLE001
+                    responses.append({"error": str(e)})
+                    continue
+                r["_shards"]["failed"] += failed
+                responses.append(r)
+            callback({"responses": responses})
+
+        self._fan_out(via, index, calls, on_done)
+
+    def ctx_search(self, via: str, contexts: dict[str, str], body: dict | None,
+                   size: int, seen: int,
+                   callback: Callable[[dict], None]) -> None:
+        """One page against pinned reader contexts (scroll page when `body`
+        is None, PIT search otherwise)."""
+        from opensearch_tpu.search.reduce import reduce_hits
+
+        calls = []
+        for nid, ctx_id in sorted(contexts.items()):
+            payload: dict[str, Any] = {"ctx_id": ctx_id}
+            if body is not None:
+                nb = dict(body)
+                nb["from"] = 0
+                nb["size"] = size
+                payload["body"] = nb
+            else:
+                payload["from"] = 0
+                payload["size"] = seen + size
+            calls.append((nid, "indices:data/read/search[ctx]", payload))
+
+        def on_done(results: list) -> None:
+            ok = [p for p in results
+                  if isinstance(p, dict) and "hits" in p]
+            failed = len(results) - len(ok)
+            if not ok:
+                callback({"error": "every pinned context failed"})
+                return
+            hits_obj = reduce_hits(ok, size=size,
+                                   from_=seen if body is None else 0,
+                                   sort=None, track_total=True)
+            callback({"hits": hits_obj,
+                      "_shards": {"failed": failed, "total": len(results)}})
+
+        self._fan_out(via, None, calls, on_done)
+
+    def ctx_close(self, via: str, contexts: dict[str, str],
+                  callback: Callable[[dict], None]) -> None:
+        calls = [(nid, "indices:data/read/ctx_close", {"ctx_ids": [cid]})
+                 for nid, cid in sorted(contexts.items())]
+
+        def on_done(results: list) -> None:
+            callback({"freed": sum(r.get("freed", 0) for r in results
+                                   if isinstance(r, dict))})
+
+        self._fan_out(via, None, calls, on_done)
+
+    def broadcast(self, via: str, action: str, payload: dict,
+                  callback: Callable[[dict], None]) -> None:
+        """One RPC per live node (flush[node] / forcemerge[node])."""
+        live = [nid for nid in self.h.node_ids
+                if nid not in self.h.transport.down]
+        calls = [(nid, action, payload) for nid in live]
+        self._fan_out(via, None, calls,
+                      lambda rs: callback({"responses": rs}))
+
+
+# --------------------------------------------------------------------- #
+# the harness
+# --------------------------------------------------------------------- #
+
+
+class SoakHarness:
+    def __init__(self, cfg: SoakConfig, tmp_path: Path):
+        from opensearch_tpu.cluster.cluster_node import ClusterNode
+
+        self.cfg = cfg
+        self.queue = DeterministicTaskQueue(cfg.seed)
+        self.transport = MockTransport(self.queue, timeout_ms=400)
+        self.node_ids = [f"n{i}" for i in range(cfg.nodes)]
+        self.nodes: dict[str, Any] = {}
+        for nid in self.node_ids:
+            self.nodes[nid] = ClusterNode(
+                nid, Path(tmp_path) / nid, self.transport, self.queue,
+                list(self.node_ids),
+            )
+        for n in self.nodes.values():
+            n.bootstrap(self.node_ids)
+        for n in self.nodes.values():
+            n.start()
+        self.client = SoakClient(self)
+        # seed-derived decision streams, independent of the queue's RNG so
+        # transport-delay draws can't shift workload plans
+        self.wrng = random.Random(cfg.seed * 7_919 + 1)
+        self.frng = random.Random(cfg.seed * 104_729 + 2)
+        self.indices = ["logs", "vec", "hyb"]
+        self.cycle = -1
+        self.final_quiesce = False
+        self.report = SoakReport(seed=cfg.seed)
+        self.invariants: list[Invariant] = [f() for f in DEFAULT_INVARIANTS]
+        self.ops: list[dict] = []
+        self._events: list[str] = []
+        self._doc_seq = 0
+        # doc ledger per index: id -> list of (op_index, kind, acked)
+        self._writes: dict[str, dict[str, list]] = {i: {}
+                                                    for i in self.indices}
+        # scroll/PIT contexts the workload currently holds open
+        self._open_contexts: dict[int, dict[str, str]] = {}
+        self.flood_stats = {"bulks": 0, "sheds": 0, "interactive": 0,
+                            "interactive_ok": 0}
+        self._probe_timer: Any = None
+
+    # -- plumbing ----------------------------------------------------------
+
+    def add_invariant(self, inv: Invariant) -> None:
+        self.invariants.append(inv)
+
+    def log_event(self, event: str, **fields: Any) -> None:
+        self._events.append(json.dumps(
+            [self.queue.now_ms, event, fields], sort_keys=True, default=str))
+
+    def digest(self) -> str:
+        return hashlib.sha256(
+            "\n".join(self._events).encode()).hexdigest()[:16]
+
+    def fail(self, invariant: Invariant | str, detail: str) -> None:
+        name = invariant if isinstance(invariant, str) else invariant.name
+        self.log_event("violation", invariant=name, detail=detail)
+        raise SoakFailure(self.cfg.seed, self.cycle, name, detail,
+                          self.digest())
+
+    def live_leader(self):
+        leaders = [n for nid, n in self.nodes.items()
+                   if nid not in self.transport.down and n.is_leader]
+        if len(leaders) != 1:
+            self.fail("convergence",
+                      f"no single live leader: {[n.node_id for n in leaders]}")
+        return leaders[0]
+
+    def call(self, fn, *args, **kwargs) -> dict:
+        """Setup-phase helper: run a callback API to completion."""
+        out: list = []
+        fn(*args, callback=out.append, **kwargs)
+        for _ in range(200_000):
+            if out:
+                return out[0]
+            if not self.queue.run_one():
+                break
+        raise SoakFailure(self.cfg.seed, self.cycle, "wedge",
+                          f"{getattr(fn, '__name__', fn)} never completed",
+                          self.digest())
+
+    def run_ms(self, ms: int) -> None:
+        self.queue.run_until(self.queue.now_ms + ms)
+
+    # -- doc ledger --------------------------------------------------------
+
+    def _record_write(self, index: str, doc_id: str, op_i: int,
+                      kind: str) -> None:
+        self._writes[index].setdefault(doc_id, []).append(
+            {"op": op_i, "kind": kind, "acked": False})
+
+    def _ack_write(self, index: str, doc_id: str, op_i: int) -> None:
+        for entry in self._writes[index].get(doc_id, ()):
+            if entry["op"] == op_i:
+                entry["acked"] = True
+
+    def attempted_ids(self, index: str) -> set[str]:
+        return set(self._writes[index])
+
+    def acked_present(self, index: str) -> set[str]:
+        """Ids whose LAST attempted op is an acked create/index."""
+        out = set()
+        for doc_id, entries in self._writes[index].items():
+            last = entries[-1]
+            if last["kind"] == "index" and last["acked"]:
+                out.add(doc_id)
+        return out
+
+    def acked_deleted(self, index: str) -> set[str]:
+        out = set()
+        for doc_id, entries in self._writes[index].items():
+            last = entries[-1]
+            if last["kind"] == "delete" and last["acked"]:
+                out.add(doc_id)
+        return out
+
+    def open_context_ids(self) -> set[str]:
+        return {cid for ctxs in self._open_contexts.values()
+                for cid in ctxs.values()}
+
+    # -- generation floors (white-box: engine's published generation) ------
+
+    def generation_floors(self) -> dict[tuple, tuple]:
+        """(index, shard, node) -> (generation, engine identity) for every
+        live local shard. A search issued after this snapshot must be
+        served at >= these generations (by the same engine instance)."""
+        floors: dict[tuple, tuple] = {}
+        for nid, node in self.nodes.items():
+            for (index, num), shard in node.local_shards.items():
+                floors[(index, num, nid)] = (
+                    shard.engine._refresh_generation, id(shard.engine))
+        return floors
+
+    def _stamp_generations(self, op: dict, resp: dict) -> None:
+        stamps = resp.get("_soak_generations") or {}
+        out = {}
+        for (index, num, nid), gen in stamps.items():
+            shard = self.nodes[nid].local_shards.get((index, num))
+            engine_id = id(shard.engine) if shard is not None else None
+            out[(index, num, nid, engine_id)] = gen
+        op["generations"] = out
+
+    # -- quiesce search (invariant support) --------------------------------
+
+    def search_all_ids(self, index: str) -> set[str]:
+        total = len(self._writes[index]) + 10
+        resp = self.call(
+            lambda callback: self.client.search(
+                self.live_leader().node_id, index,
+                {"query": {"match_all": {}}, "size": total}, callback))
+        if "error" in resp:
+            self.fail("no-acked-write-loss",
+                      f"quiesce search of [{index}] failed: {resp['error']}")
+        if resp["_shards"]["failed"]:
+            self.fail("no-acked-write-loss",
+                      f"quiesce search of [{index}] degraded: "
+                      f"{resp['_shards']}")
+        return {h["_id"] for h in resp["hits"]["hits"]}
+
+    # -- op planning -------------------------------------------------------
+
+    def _next_doc(self, index: str) -> tuple[str, dict]:
+        i = self._doc_seq
+        self._doc_seq += 1
+        doc_id = f"d{i}"
+        if index == "logs":
+            src = {"msg": f"hello world {i}", "tag": f"t{i % 5}", "n": i}
+        elif index == "vec":
+            src = {"x": [round(self.wrng.uniform(-1.0, 1.0), 4)
+                         for _ in range(_VEC_DIM)], "tag": f"t{i % 3}"}
+        else:
+            src = {"msg": f"fused hello {i}",
+                   "x": [round(self.wrng.uniform(-1.0, 1.0), 4)
+                         for _ in range(_VEC_DIM)]}
+        return doc_id, src
+
+    def _vec(self) -> list[float]:
+        return [round(self.wrng.uniform(-1.0, 1.0), 4)
+                for _ in range(_VEC_DIM)]
+
+    _OP_WEIGHTS = [
+        ("index", 22), ("bulk", 12), ("delete", 6), ("refresh", 8),
+        ("flush", 3), ("force_merge", 3),
+        ("search_match", 12), ("search_knn", 10), ("search_aggs", 7),
+        ("search_hybrid", 5), ("msearch", 5), ("scroll_chain", 4),
+        ("pit_chain", 3),
+    ]
+
+    def _plan_cycle_ops(self, flood: bool) -> list[dict]:
+        """Draw the cycle's whole op schedule up front — every RNG draw
+        happens here, in a fixed order, so replay is exact."""
+        kinds = [k for k, w in self._OP_WEIGHTS for _ in range(w)]
+        plans: list[dict] = []
+        n_ops = self.cfg.ops_per_cycle
+        for _ in range(n_ops):
+            offset = self.wrng.randint(200, max(self.cfg.cycle_ms - 4_000,
+                                                1_000))
+            kind = self.wrng.choice(kinds)
+            via = self.wrng.choice(self.node_ids)
+            plan = {"kind": kind, "offset": offset, "via": via}
+            if kind == "index":
+                plan["index"] = self.wrng.choice(self.indices)
+                plan["doc"] = self._next_doc(plan["index"])
+            elif kind == "bulk":
+                plan["index"] = self.wrng.choice(["logs", "vec"])
+                plan["docs"] = [self._next_doc(plan["index"])
+                                for _ in range(self.wrng.randint(3, 8))]
+            elif kind == "delete":
+                plan["index"] = self.wrng.choice(self.indices)
+                known = sorted(self._writes[plan["index"]])
+                live = [d for d in known
+                        if self._writes[plan["index"]][d][-1]["kind"]
+                        == "index"]
+                if not live:
+                    plan["kind"] = "index"
+                    plan["doc"] = self._next_doc(plan["index"])
+                else:
+                    plan["doc_id"] = self.wrng.choice(live)
+                    # claim it in the ledger NOW so a later plan in this
+                    # cycle can't race a second delete of the same id
+                    self._writes[plan["index"]][plan["doc_id"]].append(
+                        {"op": None, "kind": "delete", "acked": False})
+            elif kind in ("refresh", "flush", "force_merge"):
+                plan["index"] = self.wrng.choice(self.indices)
+            elif kind == "search_match":
+                plan["index"] = self.wrng.choice(["logs", "hyb"])
+                plan["body"] = {"query": {"match": {"msg": "hello"}},
+                                "size": 5}
+            elif kind == "search_knn":
+                plan["index"] = "vec"
+                plan["body"] = {"query": {"knn": {"x": {
+                    "vector": self._vec(), "k": 5}}}, "size": 5}
+            elif kind == "search_aggs":
+                plan["index"] = "logs"
+                plan["body"] = {
+                    "query": {"match_all": {}}, "size": 3,
+                    "aggs": {"tags": {"terms": {"field": "tag"}},
+                             "mean_n": {"avg": {"field": "n"}}}}
+            elif kind == "search_hybrid":
+                plan["index"] = "hyb"
+                plan["body"] = {"query": {"hybrid": {"queries": [
+                    {"match": {"msg": "hello"}},
+                    {"knn": {"x": {"vector": self._vec(), "k": 5}}},
+                ]}}, "size": 5}
+            elif kind == "msearch":
+                plan["index"] = "vec"
+                plan["bodies"] = [
+                    {"query": {"knn": {"x": {"vector": self._vec(),
+                                             "k": 4}}}, "size": 4}
+                    for _ in range(3)]
+            elif kind == "scroll_chain":
+                plan["index"] = "logs"
+                plan["pages"] = 2
+            elif kind == "pit_chain":
+                plan["index"] = self.wrng.choice(["logs", "vec"])
+            plans.append(plan)
+        if flood:
+            # one burst of bulks tagged to the enforced flood group, all
+            # issued in a single callback so admission sees them together,
+            # plus interactive searches DURING the flood window
+            at = self.cfg.cycle_ms // 3
+            plans.append({"kind": "bulk_flood", "offset": at, "via": "n0",
+                          "bulks": [[self._next_doc("logs")
+                                     for _ in range(3)]
+                                    for _ in range(8)]})
+            for j in range(4):
+                plans.append({
+                    "kind": "search_match", "offset": at + 40 * (j + 1),
+                    "via": self.wrng.choice(self.node_ids),
+                    "index": "logs", "interactive": True,
+                    "body": {"query": {"match": {"msg": "hello"}},
+                             "size": 5}})
+        plans.sort(key=lambda p: p["offset"])
+        return plans
+
+    def _plan_cycle_faults(self) -> list[dict]:
+        """1-2 sequential faults per chaos cycle, all healed well before
+        the cycle ends. The flood cycle runs fault-free: the bulk flood IS
+        its adversarial condition, and the interactive-under-flood
+        invariant needs clean-network determinism (a partitioned search
+        failing is degradation, not starvation)."""
+        if not self.cfg.chaos or self.cycle == self.cfg.flood_cycle:
+            return []
+        out = []
+        t = self.frng.randint(1_500, 3_000)
+        for _ in range(self.frng.randint(1, 2)):
+            kind = self.frng.choice(
+                ["kill", "partition", "slow_link", "one_way"])
+            duration = self.frng.randint(2_500, 6_000)
+            if t + duration > self.cfg.cycle_ms - 5_000:
+                break
+            a, b = self.frng.sample(self.node_ids, 2)
+            out.append({"kind": kind, "at": t, "duration": duration,
+                        "a": a, "b": b})
+            t += duration + self.frng.randint(1_500, 3_000)
+        return out
+
+    # -- op execution ------------------------------------------------------
+
+    def _issue(self, plan: dict) -> None:
+        op = dict(plan)
+        op["i"] = len(self.ops)
+        op["completions"] = 0
+        self.ops.append(op)
+        self.report.ops_issued += 1
+        self.log_event("issue", i=op["i"], kind=op["kind"],
+                       index=op.get("index"), via=op["via"])
+        if op.get("interactive"):
+            self.flood_stats["interactive"] += 1
+        handler = getattr(self, f"_issue_{op['kind']}")
+        try:
+            handler(op)
+        except Exception as e:  # noqa: BLE001 - an op may fail, not wedge
+            self._complete(op, {"error": f"{type(e).__name__}: {e}"})
+
+    def _complete(self, op: dict, resp: dict) -> None:
+        op["completions"] += 1
+        if op["completions"] > 1:
+            self.fail("shed-correctness",
+                      f"op#{op['i']} [{op['kind']}] completed "
+                      f"{op['completions']} times")
+        self.report.ops_completed += 1
+        outcome = self._outcome_digest(op, resp)
+        if outcome.get("error") or outcome.get("failed"):
+            self.report.ops_degraded += 1
+        if outcome.get("shed"):
+            self.report.sheds += 1
+        self.log_event("complete", i=op["i"], kind=op["kind"], **outcome)
+        if "hits" in resp:
+            self._stamp_generations(op, resp)
+            for inv in self.invariants:
+                inv.on_response(self, op, resp)
+        if op.get("interactive") and "hits" in resp and \
+                not resp["_shards"]["failed"]:
+            self.flood_stats["interactive_ok"] += 1
+
+    @staticmethod
+    def _outcome_digest(op: dict, resp: dict) -> dict:
+        """The deterministic projection of a response that enters the event
+        log (wall-time fields like `took` stay out)."""
+        out: dict[str, Any] = {}
+        if "error" in resp:
+            err = str(resp["error"])
+            out["error"] = err[:120]
+            out["shed"] = "RejectedExecutionException" in err or \
+                resp.get("status") == 429
+            return out
+        if "hits" in resp:
+            out["total"] = (resp["hits"].get("total") or {}).get("value")
+            out["ids"] = [h.get("_id") for h in resp["hits"]["hits"]]
+            shards = resp.get("_shards") or {}
+            out["failed"] = shards.get("failed", 0)
+            if "aggregations" in resp:
+                out["aggs"] = json.dumps(resp["aggregations"],
+                                         sort_keys=True, default=str)
+        elif "items" in resp:
+            out["items"] = [
+                {k: (v.get("result"), v.get("_seq_no"), v.get("status"))
+                 for k, v in item.items()}
+                for item in resp["items"] if item]
+            out["errors"] = resp.get("errors")
+        elif "responses" in resp:
+            out["n"] = len(resp["responses"])
+            out["sub"] = [
+                (r.get("hits", {}).get("total", {}).get("value")
+                 if isinstance(r, dict) and "hits" in r
+                 else str(r.get("error"))[:60] if isinstance(r, dict)
+                 else None)
+                for r in resp["responses"]]
+        else:
+            out["keys"] = sorted(resp)
+            if "result" in resp:
+                out["result"] = resp["result"]
+                out["seq_no"] = resp.get("_seq_no")
+        return out
+
+    # individual op issuers -------------------------------------------------
+
+    def _search_op(self, op: dict) -> None:
+        op["floors"] = self.generation_floors()
+        self.client.search(op["via"], op["index"], op["body"],
+                           lambda r: self._complete(op, r))
+
+    _issue_search_match = _search_op
+    _issue_search_knn = _search_op
+    _issue_search_aggs = _search_op
+    _issue_search_hybrid = _search_op
+
+    def _issue_index(self, op: dict) -> None:
+        doc_id, src = op["doc"]
+        self._record_write(op["index"], doc_id, op["i"], "index")
+
+        def done(resp: dict) -> None:
+            if "error" not in resp and \
+                    resp.get("_shards", {}).get("failed", 1) == 0:
+                self._ack_write(op["index"], doc_id, op["i"])
+            self._complete(op, resp)
+
+        self.nodes[op["via"]].index_doc(op["index"], doc_id, src, done)
+
+    def _issue_delete(self, op: dict) -> None:
+        doc_id = op["doc_id"]
+        # adopt the ledger entry claimed at plan time
+        for entry in self._writes[op["index"]].get(doc_id, ()):
+            if entry["kind"] == "delete" and entry["op"] is None:
+                entry["op"] = op["i"]
+
+        def done(resp: dict) -> None:
+            if "error" not in resp and resp.get("result") == "deleted" and \
+                    resp.get("_shards", {}).get("failed", 1) == 0:
+                self._ack_write(op["index"], doc_id, op["i"])
+            self._complete(op, resp)
+
+        self.nodes[op["via"]].delete_doc(op["index"], doc_id, done)
+
+    def _issue_bulk(self, op: dict) -> None:
+        operations = []
+        for doc_id, src in op["docs"]:
+            self._record_write(op["index"], doc_id, op["i"], "index")
+            operations.append(
+                ("index", {"_index": op["index"], "_id": doc_id}, src))
+
+        def done(resp: dict) -> None:
+            for item in resp.get("items") or []:
+                for action, r in (item or {}).items():
+                    if r and "error" not in r and \
+                            r.get("_shards", {}).get("failed", 1) == 0:
+                        self._ack_write(op["index"], r.get("_id"), op["i"])
+            self._complete(op, resp)
+
+        self.nodes[op["via"]].bulk(operations, done)
+
+    def _issue_bulk_flood(self, op: dict) -> None:
+        """The wlm scenario: N bulks tagged to the enforced flood group in
+        one burst — past the slot share they MUST shed 429."""
+        node = self.nodes[op["via"]]
+        pending = [len(op["bulks"])]
+
+        def one_done(resp: dict) -> None:
+            self.flood_stats["bulks"] += 1
+            if resp.get("status") == 429 or (
+                    "error" in resp
+                    and "RejectedExecutionException" in str(resp["error"])):
+                self.flood_stats["sheds"] += 1
+            else:
+                for item in resp.get("items") or []:
+                    for action, r in (item or {}).items():
+                        if r and "error" not in r and \
+                                r.get("_shards", {}).get("failed", 1) == 0:
+                            self._ack_write("logs", r.get("_id"), op["i"])
+            pending[0] -= 1
+            if pending[0] == 0:
+                self._complete(op, {"responses": [],
+                                    "flood": dict(self.flood_stats)})
+
+        for docs in op["bulks"]:
+            operations = []
+            for doc_id, src in docs:
+                self._record_write("logs", doc_id, op["i"], "index")
+                operations.append(
+                    ("index", {"_index": "logs", "_id": doc_id}, src))
+            node.bulk(operations, one_done, query_group="flood")
+
+    def _issue_refresh(self, op: dict) -> None:
+        self.nodes[op["via"]].refresh(op["index"],
+                                      lambda r: self._complete(op, r))
+
+    def _issue_flush(self, op: dict) -> None:
+        self.client.broadcast(op["via"], "indices:admin/flush[node]",
+                              {"indices": [op["index"]]},
+                              lambda r: self._complete(op, r))
+
+    def _issue_force_merge(self, op: dict) -> None:
+        self.client.broadcast(op["via"], "indices:admin/forcemerge[node]",
+                              {"indices": [op["index"]],
+                               "max_num_segments": 1},
+                              lambda r: self._complete(op, r))
+
+    def _issue_msearch(self, op: dict) -> None:
+        op["floors"] = self.generation_floors()
+
+        def done(resp: dict) -> None:
+            # runtime hit checks run per sub-response
+            for sub in resp.get("responses") or []:
+                if isinstance(sub, dict) and "hits" in sub:
+                    sub_op = dict(op, index=op["index"])
+                    for inv in self.invariants:
+                        inv.on_response(self, sub_op, sub)
+            self._complete(op, resp)
+
+        self.client.msearch(op["via"], op["index"], op["bodies"], done)
+
+    def _issue_scroll_chain(self, op: dict) -> None:
+        """open (pinned contexts) -> pages -> close; any step may degrade,
+        the chain always completes exactly once."""
+        state = {"seen": 0, "pages_left": op["pages"], "ids": []}
+
+        def close_and_complete(outcome: dict) -> None:
+            ctxs = self._open_contexts.pop(op["i"], None)
+            if not ctxs:
+                self._complete(op, outcome)
+                return
+            self.client.ctx_close(op["via"], ctxs,
+                                  lambda _r: self._complete(op, outcome))
+
+        def on_page(resp: dict) -> None:
+            if "error" in resp:
+                close_and_complete(resp)
+                return
+            hits = resp["hits"]["hits"]
+            state["ids"].extend(h.get("_id") for h in hits)
+            state["seen"] += len(hits)
+            state["pages_left"] -= 1
+            if state["pages_left"] <= 0 or not hits:
+                close_and_complete(
+                    {"hits": {"total": {"value": state["seen"]},
+                              "hits": []},
+                     "_shards": {"failed": 0},
+                     "scroll_ids": state["ids"]})
+                return
+            ctxs = self._open_contexts.get(op["i"])
+            if not ctxs:
+                close_and_complete({"error": "contexts lost"})
+                return
+            self.queue.schedule(400, lambda: self.client.ctx_search(
+                op["via"], ctxs, None, 3, state["seen"], on_page))
+
+        def on_open(resp: dict) -> None:
+            if "error" in resp or "_soak_contexts" not in resp:
+                close_and_complete(resp if "error" in resp
+                                   else dict(resp, error="no contexts"))
+                return
+            self._open_contexts[op["i"]] = resp["_soak_contexts"]
+            hits = resp["hits"]["hits"]
+            # a scroll must not return duplicate ids ACROSS pages either
+            state["ids"].extend(h.get("_id") for h in hits)
+            state["seen"] += len(hits)
+            on_page_dup_check()
+            if state["pages_left"] <= 0:
+                close_and_complete({"hits": {"total": {"value":
+                                                       state["seen"]},
+                                             "hits": []},
+                                    "_shards": {"failed": 0},
+                                    "scroll_ids": state["ids"]})
+                return
+            self.queue.schedule(400, lambda: self.client.ctx_search(
+                op["via"], self._open_contexts.get(op["i"], {}),
+                None, 3, state["seen"], on_page))
+
+        def on_page_dup_check() -> None:
+            ids = [i for i in state["ids"] if i is not None]
+            if len(ids) != len(set(ids)):
+                self.fail("snapshot-isolation",
+                          f"op#{op['i']} scroll returned duplicate ids "
+                          f"across pages: {sorted(ids)}")
+
+        self.client.search(op["via"], op["index"],
+                           {"query": {"match_all": {}}, "size": 3},
+                           on_open, keep_context=True,
+                           keep_alive_ms=120_000)
+
+    def _issue_pit_chain(self, op: dict) -> None:
+        """open PIT -> one refresh lands in between -> PIT search must see
+        the PINNED view -> close."""
+
+        def close_and_complete(outcome: dict) -> None:
+            ctxs = self._open_contexts.pop(op["i"], None)
+            if not ctxs:
+                self._complete(op, outcome)
+                return
+            self.client.ctx_close(op["via"], ctxs,
+                                  lambda _r: self._complete(op, outcome))
+
+        def on_pit_search(resp: dict) -> None:
+            close_and_complete(resp)
+
+        def on_open(resp: dict) -> None:
+            if "error" in resp or "_soak_contexts" not in resp:
+                close_and_complete(resp if "error" in resp
+                                   else dict(resp, error="no contexts"))
+                return
+            self._open_contexts[op["i"]] = resp["_soak_contexts"]
+            self.queue.schedule(600, lambda: self.client.ctx_search(
+                op["via"], self._open_contexts.get(op["i"], {}),
+                {"query": {"match_all": {}}, "size": 5}, 5, 0,
+                on_pit_search))
+
+        self.client.search(op["via"], op["index"],
+                           {"query": {"match_all": {}}, "size": 0},
+                           on_open, keep_context=True,
+                           keep_alive_ms=120_000)
+
+    # -- faults ------------------------------------------------------------
+
+    def _inject_fault(self, fault: dict) -> None:
+        kind, a, b = fault["kind"], fault["a"], fault["b"]
+        self.log_event("fault", kind=kind, a=a, b=b)
+        self.report.faults_injected.append(kind)
+        if kind == "kill":
+            self.transport.take_down(a)
+        elif kind == "partition":
+            self.transport.partition({a}, {b})
+        elif kind == "slow_link":
+            self.transport.set_latency(a, b, 150)
+        elif kind == "one_way":
+            self.transport.drop_one_way(a, b)
+
+    def _heal_fault(self, fault: dict) -> None:
+        kind, a, b = fault["kind"], fault["a"], fault["b"]
+        self.log_event("heal", kind=kind, a=a, b=b)
+        if kind == "kill":
+            self.transport.bring_up(a)
+        elif kind == "partition":
+            self.transport.blackholed.discard((a, b))
+            self.transport.blackholed.discard((b, a))
+        elif kind == "slow_link":
+            self.transport.set_latency(a, b, 0)
+        elif kind == "one_way":
+            self.transport.restore_one_way(a, b)
+
+    def _corrupt_one_copy(self) -> None:
+        """Failure-injection hook: remove one acked doc from the primary
+        copy, bypassing replication. no-acked-write-loss MUST catch it."""
+        present = sorted(self.acked_present("logs"))
+        if not present:
+            self.queue.schedule(500, self._corrupt_one_copy)
+            return
+        doc_id = present[0]
+        leader_state = self.live_leader().applied_state
+        from opensearch_tpu.common.hashing import shard_id_for_routing
+
+        meta = leader_state.indices["logs"]
+        num = shard_id_for_routing(doc_id, meta.num_shards)
+        primary = leader_state.primary("logs", num)
+        shard = self.nodes[primary.node_id].local_shards.get(("logs", num))
+        if shard is None:
+            self.queue.schedule(500, self._corrupt_one_copy)
+            return
+        self.log_event("inject_corruption", doc=doc_id,
+                       node=primary.node_id, shard=num)
+        shard.apply_delete_on_primary(doc_id)
+        shard.refresh()
+
+    # -- probes ------------------------------------------------------------
+
+    def _probe(self) -> None:
+        for inv in self.invariants:
+            inv.at_probe(self)
+        self._probe_timer = self.queue.schedule(500, self._probe)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def setup(self) -> None:
+        self.run_ms(6_000)
+        self.live_leader()
+        specs = {
+            "logs": ({"number_of_shards": 2,
+                      "number_of_replicas": self.cfg.replica_count},
+                     {"properties": {"msg": {"type": "text"},
+                                     "tag": {"type": "keyword"},
+                                     "n": {"type": "integer"}}}),
+            "vec": ({"number_of_shards": 2,
+                     "number_of_replicas": self.cfg.replica_count},
+                    {"properties": {"x": {"type": "knn_vector",
+                                          "dimension": _VEC_DIM},
+                                    "tag": {"type": "keyword"}}}),
+            # hybrid fusion normalizes per node; one shard keeps the
+            # per-node fusion globally correct in cluster mode
+            "hyb": ({"number_of_shards": 1,
+                     "number_of_replicas": self.cfg.replica_count},
+                    {"properties": {"msg": {"type": "text"},
+                                    "x": {"type": "knn_vector",
+                                          "dimension": _VEC_DIM}}}),
+        }
+        for name, (settings, mappings) in specs.items():
+            resp = self.call(self.nodes["n0"].create_index, name,
+                             {"settings": {"index": settings},
+                              "mappings": mappings})
+            if not resp.get("acknowledged"):
+                self.fail("setup", f"create [{name}] failed: {resp}")
+        self.run_ms(8_000)
+        # a seed corpus so the first cycle's queries have data to hit
+        for _ in range(6):
+            for index in self.indices:
+                doc_id, src = self._next_doc(index)
+                self._writes[index][doc_id] = [
+                    {"op": -1, "kind": "index", "acked": False}]
+                resp = self.call(self.nodes["n0"].index_doc, index,
+                                 doc_id, src)
+                if "error" not in resp and \
+                        resp.get("_shards", {}).get("failed", 1) == 0:
+                    self._writes[index][doc_id][0]["acked"] = True
+        for index in self.indices:
+            self.call(self.nodes["n0"].refresh, index)
+        self.run_ms(2_000)
+        # wlm flood group (enforced, tiny share -> ~3 bulk slots of 64)
+        if self.cfg.flood_cycle >= 0:
+            for node in self.nodes.values():
+                node.query_groups.put({
+                    "name": "flood", "resiliency_mode": "enforced",
+                    "resource_limits": {"memory": 0.05}})
+        self.log_event("setup_done", docs=self._doc_seq)
+
+    def run_cycle(self, cycle: int) -> None:
+        self.cycle = cycle
+        self.log_event("cycle_start", cycle=cycle)
+        flood = cycle == self.cfg.flood_cycle
+        plans = self._plan_cycle_ops(flood)
+        faults = self._plan_cycle_faults()
+        base = self.queue.now_ms
+        for plan in plans:
+            self.queue.schedule(plan["offset"],
+                                lambda p=plan: self._issue(p))
+        for fault in faults:
+            self.queue.schedule(fault["at"],
+                                lambda f=fault: self._inject_fault(f))
+            self.queue.schedule(fault["at"] + fault["duration"],
+                                lambda f=fault: self._heal_fault(f))
+        if self.cfg.inject_acked_write_loss and cycle == 0:
+            self.queue.schedule(self.cfg.cycle_ms // 2,
+                                self._corrupt_one_copy)
+        self._probe()
+        self.queue.run_until(base + self.cfg.cycle_ms)
+        if self._probe_timer is not None:
+            self._probe_timer.cancel()
+        self._quiesce()
+        self.report.cycles_completed += 1
+        self.log_event("cycle_done", cycle=cycle, digest=self.digest())
+
+    def _quiesce(self) -> None:
+        # heal everything and wait for convergence + every op to complete
+        self.transport.heal()
+        for nid in list(self.transport.down):
+            self.transport.bring_up(nid)
+        deadline = self.queue.now_ms + 240_000
+        while self.queue.now_ms < deadline:
+            self.run_ms(2_000)
+            if self._converged() and all(
+                    op["completions"] > 0 for op in self.ops):
+                break
+        else:
+            stuck = [op["i"] for op in self.ops if op["completions"] == 0]
+            self.fail("convergence",
+                      f"cluster/ops did not quiesce in 240s of virtual "
+                      f"time (stuck ops: {stuck[:10]})")
+        for index in self.indices:
+            self.call(self.nodes["n0"].refresh, index)
+        self.run_ms(2_000)
+        for inv in self.invariants:
+            inv.at_quiesce(self)
+            self.report.invariants_checked += 1
+
+    def _converged(self) -> bool:
+        live = [n for nid, n in self.nodes.items()
+                if nid not in self.transport.down]
+        leaders = [n for n in live if n.is_leader]
+        if len(leaders) != 1:
+            return False
+        leader = leaders[0]
+        if any(n.coordinator.leader_id != leader.node_id for n in live):
+            return False
+        state = leader.applied_state
+        if len(state.nodes) != len(self.node_ids):
+            return False
+        return all(r.state == "STARTED" and r.node_id is not None
+                   and not r.relocating_node for r in state.routing)
+
+    def teardown_checks(self) -> None:
+        """Final quiesce: close every held context, advance past keep-alive
+        so expiry reaps strays, then assert zero leftovers."""
+        self.final_quiesce = True
+        for op_i, ctxs in sorted(self._open_contexts.items()):
+            self.call(lambda callback, c=ctxs: self.client.ctx_close(
+                "n0", c, callback))
+        self._open_contexts.clear()
+        self.run_ms(130_000)  # past every keep_alive
+        for index in self.indices:
+            # any search triggers the reap on each node it touches
+            self.call(lambda callback, i=index: self.client.search(
+                "n0", i, {"query": {"match_all": {}}, "size": 1}, callback))
+        for inv in self.invariants:
+            inv.at_quiesce(self)
+        self.report.flood = dict(self.flood_stats)
+        self.report.digest = self.digest()
+
+    def close(self) -> None:
+        for n in self.nodes.values():
+            n.close()
+
+
+def run_soak(seed: int, tmp_path, *, cycles: int = 3, nodes: int = 3,
+             ops_per_cycle: int = 30, cycle_ms: int = 20_000,
+             chaos: bool = True, flood_cycle: int = 1,
+             inject_acked_write_loss: bool = False,
+             extra_invariants: tuple = ()) -> SoakReport:
+    """Run the soak; returns the SoakReport, raises SoakFailure (seed and
+    replay command attached) on any invariant violation."""
+    from opensearch_tpu.search import batcher as batcher_mod
+
+    cfg = SoakConfig(seed=seed, cycles=cycles, nodes=nodes,
+                     ops_per_cycle=ops_per_cycle, cycle_ms=cycle_ms,
+                     chaos=chaos, flood_cycle=flood_cycle,
+                     inject_acked_write_loss=inject_acked_write_loss)
+    harness = SoakHarness(cfg, Path(tmp_path))
+    for inv in extra_invariants:
+        harness.add_invariant(inv)
+    batcher_mod.default_batcher.reset()
+    try:
+        with timeutil.clock_scope(harness.queue.clock()), \
+                randutil.rng_scope(harness.queue.random):
+            harness.setup()
+            for cycle in range(cfg.cycles):
+                harness.run_cycle(cycle)
+            harness.teardown_checks()
+    except SoakFailure as failure:
+        print(f"SOAK FAILURE seed={failure.seed} cycle={failure.cycle} "
+              f"invariant={failure.invariant}\n  replay: python -m "
+              f"opensearch_tpu.testing.soak --replay {failure.seed}")
+        raise
+    finally:
+        harness.close()
+    return harness.report
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import tempfile
+
+    parser = argparse.ArgumentParser(
+        description="ingest-while-serving chaos soak (seeded, replayable)")
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--replay", type=int, default=None,
+                        help="re-run a failing seed byte-identically")
+    parser.add_argument("--cycles", type=int, default=3)
+    parser.add_argument("--ops", type=int, default=30)
+    parser.add_argument("--no-chaos", action="store_true")
+    args = parser.parse_args(argv)
+    seed = args.replay if args.replay is not None else args.seed
+    with tempfile.TemporaryDirectory() as tmp:
+        try:
+            report = run_soak(seed, tmp, cycles=args.cycles,
+                              ops_per_cycle=args.ops,
+                              chaos=not args.no_chaos)
+        except SoakFailure as e:
+            print(str(e))
+            return 1
+    print(json.dumps(report.to_dict(), indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -16,7 +16,7 @@ import json
 import threading
 import uuid
 from pathlib import Path
-from typing import Any
+from typing import Any, Callable
 
 from opensearch_tpu.common.errors import (
     IllegalArgumentException,
@@ -26,6 +26,14 @@ from opensearch_tpu.common.errors import (
 
 # one process-wide concurrency budget the cpu shares divide up
 TOTAL_SEARCH_PERMITS = 64
+
+# bulk admission budget: in-flight bulk REQUESTS a group may hold open at
+# once. Shares of this pool are carved by the group's memory (else cpu)
+# resource limit and enforced through index/pressure.QueuePressure — the
+# same bound-and-shed contract as IndexingPressure (429 instead of an
+# unbounded queue), so a bulk flood tagged to a group sheds at its share
+# before it can starve interactive search traffic.
+TOTAL_BULK_SLOTS = 64
 
 
 class QueryGroupService:
@@ -38,6 +46,9 @@ class QueryGroupService:
         if self._file.exists():
             self.groups = json.loads(self._file.read_text())
         self._in_flight: dict[str, int] = {}
+        # per-group bulk slot budgets (QueuePressure), built lazily for
+        # enforced groups — see admit_bulk
+        self._bulk_pressure: dict[str, Any] = {}
         # lifetime counters per group (WlmStats.WorkloadGroupStats);
         # untagged requests account to the default group like the reference
         self._totals: dict[str, dict[str, int]] = {}
@@ -134,6 +145,10 @@ class QueryGroupService:
                     f"no query group exists with name [{name}]"
                 )
             del self.groups[gid]
+            # the slot budget dies with the group — a re-created group
+            # gets a fresh _id, so a kept entry would be an unbounded
+            # ghost in bulk_stats (TPU009's bound-or-evict contract)
+            self._bulk_pressure.pop(gid, None)
             self._save()
         return {"acknowledged": True}
 
@@ -142,6 +157,67 @@ class QueryGroupService:
     def admit(self, group_id: str | None):
         """Context manager guarding one search on behalf of `group_id`."""
         return _Admission(self, group_id)
+
+    # -- bulk admission (QueuePressure-backed slot budget) ------------------
+
+    def _resolve(self, group_id: str | None) -> dict | None:
+        with self._lock:
+            return self.groups.get(group_id) or next(
+                (g for g in self.groups.values()
+                 if g["name"] == group_id), None
+            )
+
+    def _bulk_pressure_for(self, group: dict):
+        """Lazily build (and resize on limit change) the group's bulk slot
+        budget. Only `enforced` groups shed; soft/monitor run unconstrained
+        (the reference's resiliency-mode contract)."""
+        from opensearch_tpu.index.pressure import QueuePressure
+
+        limits = group.get("resource_limits") or {}
+        share = limits.get("memory", limits.get("cpu"))
+        if group.get("resiliency_mode") != "enforced" or share is None:
+            return None
+        slots = max(1, int(TOTAL_BULK_SLOTS * float(share)))
+        with self._lock:
+            p = self._bulk_pressure.get(group["_id"])
+            if p is None:
+                p = self._bulk_pressure[group["_id"]] = QueuePressure(
+                    slots, operation=f"bulk [{group['name']}]"
+                )
+            elif p.limit != slots:
+                p.set_limit(slots)
+        return p
+
+    def admit_bulk(self, group_id: str | None) -> "Callable[[], None]":
+        """Admit one bulk request for `group_id`; returns the release
+        callable. Raises RejectedExecutionException (HTTP 429) when the
+        group is past its slot share — the caller must shed, not queue."""
+        group = self._resolve(group_id) if group_id else None
+        if group is None:
+            return lambda: None
+        pressure = self._bulk_pressure_for(group)
+        if pressure is None:
+            return lambda: None
+        try:
+            pressure.acquire()
+        except RejectedExecutionException:
+            self._tally(group["_id"], "total_rejections")
+            raise
+        released = [False]
+
+        def release() -> None:
+            if not released[0]:
+                released[0] = True
+                pressure.release()
+
+        return release
+
+    def bulk_stats(self) -> dict:
+        with self._lock:
+            pressures = dict(self._bulk_pressure)
+        return {
+            gid: p.stats() for gid, p in pressures.items()
+        }
 
     def _try_enter(self, group_id: str | None) -> str | None:
         if not group_id:

@@ -3,6 +3,12 @@
 #
 #   scripts/check.sh            # lint gate + lint/transport/cluster tests
 #   scripts/check.sh --lint     # lint gate only (pre-commit speed)
+#   scripts/check.sh --bench    # + the bench-regression gate: a quick
+#                               # bench.py --gate run must stay within a
+#                               # CPU/TPU-aware tolerance of the same
+#                               # platform's BENCH_CACHE.json entry, so a
+#                               # PR that slows the hot path fails HERE,
+#                               # not in the next round's headline number
 #
 # The lint gate runs three ways on purpose:
 #   1. repo-wide lint vs the (EMPTY) baseline ratchet (json report),
@@ -25,10 +31,16 @@ if [[ "${1:-}" == "--lint" ]]; then
   exit 0
 fi
 
-echo "== tier-1 subset (lint semantics + transport/cluster/fault) =="
+echo "== tier-1 subset (lint semantics + transport/cluster/fault/soak) =="
 JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
   -p no:cacheprovider \
   tests/test_lint.py \
   tests/test_coordination.py \
   tests/test_cluster_data.py \
-  tests/test_fault_injection.py
+  tests/test_fault_injection.py \
+  tests/test_soak.py
+
+if [[ "${1:-}" == "--bench" ]]; then
+  echo "== bench-regression gate (quick run vs BENCH_CACHE.json) =="
+  python bench.py --gate
+fi

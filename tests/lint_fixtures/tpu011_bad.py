@@ -1,0 +1,56 @@
+"""TPU011 true positives: blocking calls inside callables handed to the
+serial data worker (_offload / _after_offload)."""
+
+import threading
+import time
+
+
+class Node:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._data_executor = None
+
+    def _offload(self, fn):
+        return fn()
+
+    def _after_offload(self, fn, cb):
+        cb(fn())
+
+    def _on_search(self, payload):
+        def run():
+            time.sleep(0.5)  # EXPECT: TPU011
+            with self._lock:
+                pass
+            self._lock.acquire()  # EXPECT: TPU011
+            return {"ok": True}
+
+        return self._offload(run)
+
+    def _on_get(self, payload, fut):
+        return self._offload(lambda: fut.result())  # EXPECT: TPU011
+
+    def _on_flush(self, payload):
+        def run():
+            self._blocking_helper()
+            return {"ok": True}
+
+        return self._offload(run)
+
+    def _blocking_helper(self):
+        self._cond.wait()  # EXPECT: TPU011
+
+    def _on_merge(self, payload, worker):
+        def run():
+            worker.join()  # EXPECT: TPU011
+            return {}
+
+        self._after_offload(run, lambda ok: None)
+
+    def _on_stats(self, payload):
+        return self._offload(self._fetch_remote)
+
+    def _fetch_remote(self):
+        import requests
+
+        return requests.get("http://example.com")  # EXPECT: TPU011

@@ -1,0 +1,47 @@
+"""TPU011 false-positive guards: timed waits, worker-legitimate disk IO,
+blocking calls OUTSIDE offloaded callables, and completion callbacks that
+run back on the transport loop."""
+
+import threading
+import time
+
+
+class Node:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+
+    def _offload(self, fn):
+        return fn()
+
+    def _on_search(self, payload):
+        def run():
+            # timed waits are bounded — the worker cannot wedge
+            self._cond.wait(0.1)
+            self._lock.acquire(timeout=1.0)
+            acquired = self._lock.acquire(False)
+            # disk IO is the data worker's JOB (engine fsync/commit)
+            with open("/tmp/x", "w") as fh:
+                fh.write(",".join(["a", "b"]))
+            return {"ok": acquired}
+
+        return self._offload(run)
+
+    def _on_refresh(self, payload):
+        def run():
+            return {"ok": True}
+
+        def on_done(resp):
+            # a nested def NOT called inside run() is a completion
+            # callback for the transport loop — out of scope here (and
+            # covered by TPU002 when async)
+            time.sleep(0.0)
+
+        deferred = self._offload(run)
+        return deferred, on_done
+
+    def slow_admin_op(self):
+        # blocking outside any offloaded callable is not this rule's
+        # business
+        time.sleep(0.2)
+        self._cond.wait()

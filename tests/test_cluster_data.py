@@ -461,3 +461,86 @@ def test_departed_replica_releases_retention_lease(sim):
     if replica.node_id not in still_assigned:
         assert p_engine.retention_leases.get(
             f"peer_recovery/{replica.node_id}") is None
+
+
+# -- graceful degradation (PR 6): partial search + write retry ---------------
+
+
+def test_search_degrades_to_partial_when_a_shard_is_dark(tmp_path):
+    """A shard with no reachable copy must DEGRADE the search
+    (_shards.failed > 0, reachable shards answer) instead of refusing
+    with "not all shards available"."""
+    sim = DataSim(3, seed=51, tmp_path=tmp_path)
+    sim.run(5_000)
+    try:
+        sim.call(sim.nodes["n0"].create_index, "pd",
+                 {"settings": {"index": {"number_of_shards": 2,
+                                         "number_of_replicas": 0}}})
+        sim.run(5_000)
+        for i in range(8):
+            r = sim.call(sim.nodes["n0"].index_doc, "pd", str(i), {"n": i})
+            assert "error" not in r, r
+        sim.call(sim.nodes["n0"].refresh, "pd")
+        sim.run(1_000)
+        state = sim.leader().applied_state
+        # keep the coordinator + leader alive: kill a non-leader,
+        # non-coordinator holder of one shard if possible
+        leader_id = sim.leader().node_id
+        victim = next(
+            (r.node_id for r in state.shards_for_index("pd")
+             if r.node_id not in ("n0", leader_id)),
+            next(r.node_id for r in state.shards_for_index("pd")
+                 if r.node_id != "n0"),
+        )
+        dark_shards = [r.shard for r in state.shards_for_index("pd")
+                       if r.node_id == victim]
+        sim.transport.take_down(victim)
+        resp = sim.call(sim.nodes["n0"].search, "pd",
+                        {"query": {"match_all": {}}, "size": 10})
+        assert "error" not in resp, resp
+        assert resp["_shards"]["failed"] >= len(dark_shards)
+        # the reachable shard's docs still come back
+        assert resp["hits"]["hits"], resp
+    finally:
+        for n in sim.nodes.values():
+            n.close()
+
+
+def test_write_retries_through_transient_routing_error(tmp_path):
+    """A ShardNotFoundException from the routed primary (relocation swap
+    in flight: the copy moved off the node between routing resolution and
+    delivery) must be retried with re-resolved routing, not surfaced."""
+    sim = DataSim(3, seed=53, tmp_path=tmp_path)
+    sim.run(5_000)
+    try:
+        sim.call(sim.nodes["n0"].create_index, "wr",
+                 {"settings": {"index": {"number_of_shards": 1,
+                                         "number_of_replicas": 1}}})
+        sim.run(5_000)
+        from opensearch_tpu.common.errors import ShardNotFoundException
+
+        real_send = sim.transport.send
+        failed_once = []
+
+        def flaky_send(sender, target, action, payload, *a, **kw):
+            if action == "indices:data/write[p]" and not failed_once:
+                failed_once.append(action)
+                fail = kw.get("on_failure")
+                sim.queue.schedule(10, lambda: fail(
+                    ShardNotFoundException("[wr][0] not on node n9")))
+                return None
+            return real_send(sender, target, action, payload, *a, **kw)
+
+        sim.transport.send = flaky_send
+        resp = sim.call(sim.nodes["n0"].index_doc, "wr", "a", {"n": 1})
+        sim.transport.send = real_send
+        assert failed_once, "the first write attempt was not intercepted"
+        assert resp.get("result") == "created", resp
+        assert resp["_shards"]["failed"] == 0, resp
+        # non-transient errors still surface immediately (no retry storm)
+        resp = sim.call(sim.nodes["n0"].index_doc, "missing-index",
+                        "a", {"n": 1})
+        assert "error" in resp
+    finally:
+        for n in sim.nodes.values():
+            n.close()

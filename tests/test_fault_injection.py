@@ -405,6 +405,187 @@ def test_chaos_random_kill_heal_cycles(tmp_path, seed):
             n.close()
 
 
+# -- chaos-soak regressions (bugs flushed by testing/soak.py) ----------------
+
+
+def test_replica_recovery_with_superseded_ops_converges(tmp_path):
+    """Soak regression (seqno fast-forward): docs overwritten/deleted
+    BEFORE a recovery leave seq-no holes the point-in-time dump can never
+    fill. The target must jump its local checkpoint over them — before
+    the fix the FINALIZE handoff waited forever and the replica sat
+    INITIALIZING through endless recovery retries."""
+    sim = DataSim(3, seed=31, tmp_path=tmp_path)
+    sim.run(5_000)
+    try:
+        _make_index(sim, "gap", shards=1, replicas=1)
+        # seq 0-2: write a, overwrite a, write b -> live docs carry seq 1
+        # and 2; seq 0 is permanently superseded
+        for doc_id, n in (("a", 1), ("a", 2), ("b", 3)):
+            resp = sim.call(sim.nodes["n0"].index_doc, "gap", doc_id,
+                            {"n": n})
+            assert "error" not in resp, resp
+        resp = sim.call(sim.nodes["n0"].delete_doc, "gap", "b")
+        assert resp["result"] == "deleted", resp  # seq 3; b's seq 2 gone
+        sim.run(1_000)
+        state = sim.leader().applied_state
+        replica = next(r for r in state.shards_for_index("gap")
+                       if not r.primary)
+        sim.transport.take_down(replica.node_id)
+        sim.run(40_000)
+        # a replacement replica must reach STARTED despite holes at 0, 2
+        leader = _live_leader(sim, {replica.node_id})
+        entry = next(r for r in leader.applied_state
+                     .shards_for_index("gap") if not r.primary)
+        assert entry.state == "STARTED", entry
+        shard = sim.nodes[entry.node_id].local_shards[("gap", 0)]
+        assert shard.num_docs == 1
+        assert shard.get("a")["_source"] == {"n": 2}
+    finally:
+        for n in sim.nodes.values():
+            n.close()
+
+
+def test_evicted_follower_rejoins_instead_of_phantom_following(tmp_path):
+    """Soak regression (coordinator): the leader must REJECT follower
+    checks from a node it evicted — acking them left the healed node a
+    phantom follower forever (in no state, receiving no publications,
+    never re-added)."""
+    sim = DataSim(3, seed=37, tmp_path=tmp_path)
+    sim.run(5_000)
+    try:
+        leader = sim.leader()
+        victim = next(nid for nid in sim.node_ids
+                      if nid != leader.node_id)
+        # evict the node directly (the outcome of a half-open link: its
+        # acks were dark long enough for the failure detector)
+        leader.coordinator._remove_node(victim)
+        # step until the removal publication lands (the rejoin is fast —
+        # a fixed-time check would already see the node back)
+        removed = False
+        for _ in range(50_000):
+            if victim not in leader.applied_state.nodes:
+                removed = True
+                break
+            sim.queue.run_one()
+        assert removed, "removal publication never applied"
+        # the victim still believes it follows the leader
+        assert sim.nodes[victim].coordinator.leader_id == leader.node_id
+        # its next leader checks get rejected -> candidate -> rejoin
+        sim.run(60_000)
+        assert victim in sim.leader().applied_state.nodes
+        assert sim.nodes[victim].coordinator.mode is not None
+        # and the routing heals back onto the full node set
+        health = sim.nodes["n0"].cluster_health()
+        assert health["number_of_nodes"] == 3
+    finally:
+        for n in sim.nodes.values():
+            n.close()
+
+
+def test_returning_node_resyncs_reassigned_replica(tmp_path):
+    """Soak regression (assignment-epoch staleness): a node that was
+    evicted while dark and re-assigned the SAME replica slot on rejoin
+    must re-sync from the primary — its recovery_done flag belongs to the
+    previous assignment epoch. Before the fix it reported shard-started
+    with a store missing every write acked during its absence."""
+    sim = DataSim(3, seed=41, tmp_path=tmp_path)
+    sim.run(5_000)
+    try:
+        # keep n2 excluded so the replica slot can only live on the
+        # returning node — forcing the same-slot re-assignment
+        _make_index(sim, "ep", shards=1, replicas=1, exclude_name="n2")
+        _acked_writes(sim, "ep", 4)
+        state = sim.leader().applied_state
+        replica = next(r for r in state.shards_for_index("ep")
+                       if not r.primary)
+        primary = state.primary("ep", 0)
+        sim.transport.take_down(replica.node_id)
+        sim.run(30_000)  # failure detection + eviction
+        # writes the dark node misses entirely (primary-only acks)
+        for i in range(4, 8):
+            resp = sim.call(sim.nodes[primary.node_id].index_doc,
+                            "ep", str(i), {"n": i})
+            assert "error" not in resp, resp
+        sim.transport.bring_up(replica.node_id)
+        sim.run(60_000)
+        st = _live_leader(sim).applied_state
+        copies = st.shards_for_index("ep")
+        assert all(r.state == "STARTED" for r in copies), copies
+        for r in copies:
+            shard = sim.nodes[r.node_id].local_shards[("ep", 0)]
+            assert shard.num_docs == 8, (r.node_id, shard.num_docs)
+            assert shard.get("7") is not None, r.node_id
+    finally:
+        for n in sim.nodes.values():
+            n.close()
+
+
+def test_lost_shard_failed_report_retries_until_leader_applies(tmp_path):
+    """Soak regression (shard-failed retry): a replication failure report
+    that never reaches a leader used to be dropped on the floor — the
+    stale copy stayed STARTED with diverged data forever. The reporter
+    must retry until a leader applies the eviction (or the copy moves)."""
+    sim = DataSim(3, seed=43, tmp_path=tmp_path)
+    sim.run(5_000)
+    try:
+        leader_name = sim.leader().node_id
+        _make_index(sim, "sf", shards=1, replicas=1,
+                    exclude_name=leader_name)
+        _acked_writes(sim, "sf", 3)
+        state = sim.leader().applied_state
+        primary = state.primary("sf", 0)
+        replica = next(r for r in state.shards_for_index("sf")
+                       if not r.primary)
+        # lose exactly the FIRST shard-failed frame (a dropped report,
+        # without tripping the node failure detector like a link drop
+        # would)
+        real_send = sim.transport.send
+        lost = []
+
+        def lossy_send(sender, target, action, payload, *a, **kw):
+            if action == "internal:cluster/shard_failed" and not lost:
+                lost.append((sender, target))
+                fail = kw.get("on_failure")
+                if fail is not None:
+                    sim.queue.schedule(
+                        400, lambda: fail(TimeoutError("report lost")))
+                return None
+            return real_send(sender, target, action, payload, *a, **kw)
+
+        sim.transport.send = lossy_send
+        done = []
+        sim.nodes[primary.node_id]._report_shard_failed(
+            "sf", 0, replica.node_id, lambda: done.append(1))
+        sim.run(500)
+        assert done, "the caller's completion must fire despite the loss"
+        assert lost, "the first report was not intercepted"
+        # still STARTED: nothing reached the leader yet
+        entry = next(r for r in sim.leader().applied_state
+                     .shards_for_index("sf") if not r.primary)
+        assert entry.state == "STARTED"
+        # the background retry lands and the leader EVICTS the copy (the
+        # old fire-and-forget code never got here — the copy stayed
+        # STARTED forever and this loop exhausted)
+        evicted = False
+        for _ in range(100_000):
+            entry = next((r for r in sim.leader().applied_state
+                          .shards_for_index("sf")
+                          if r.node_id == replica.node_id
+                          and not r.primary), None)
+            if entry is None or entry.state != "STARTED":
+                evicted = True
+                break
+            sim.queue.run_one()
+        assert evicted, "retried shard-failed report never reached the leader"
+        # ...and the copy re-recovers: routing converges, no data lost
+        sim.run(60_000)
+        _assert_docs_survive(sim, "sf", 3)
+    finally:
+        sim.transport.heal()
+        for n in sim.nodes.values():
+            n.close()
+
+
 # ---------------------------------------------------------------------- #
 # virtual clock: the sim controls time read through the injected clock
 # ---------------------------------------------------------------------- #

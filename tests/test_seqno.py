@@ -36,6 +36,30 @@ class TestLocalCheckpointTracker:
         t.mark_seq_no_as_processed(1)  # gap fills -> contiguous run
         assert t.checkpoint == 3 and t.pending_count == 0
 
+    def test_fast_forward_jumps_permanent_holes(self):
+        """Chaos-soak regression: a recovery dump/segment snapshot taken
+        at seq N incorporates every op <= N, but ops superseded before
+        the snapshot (overwritten/deleted docs) left seq_nos the copy can
+        never observe individually. fast_forward_processed(N) must jump
+        the checkpoint over those holes — before the fix the FINALIZE
+        seqno handoff waited on them forever and recovery livelocked."""
+        t = LocalCheckpointTracker()
+        # the dump carried live docs at seq 0, 2, 4 (1 and 3 superseded)
+        for s in (0, 2, 4):
+            t.mark_seq_no_as_processed(s)
+        assert t.checkpoint == 0  # holes at 1 and 3 pin it
+        t.fast_forward_processed(4)
+        assert t.checkpoint == 4
+        assert t.pending_count == 0
+        # fast-forward merges with ops processed ABOVE it
+        t.mark_seq_no_as_processed(6)
+        t.fast_forward_processed(5)
+        assert t.checkpoint == 6
+        # never moves backwards
+        t.fast_forward_processed(2)
+        assert t.checkpoint == 6
+        assert t.max_seq_no == 6
+
     def test_has_processed(self):
         t = LocalCheckpointTracker()
         t.mark_seq_no_as_processed(0)

@@ -66,3 +66,102 @@ def test_soft_group_and_untagged_run_free(node):
             pass
     with node.query_groups.admit(None):
         pass
+
+
+# -- bulk admission (QueuePressure-backed slot budgets, PR 6) ----------------
+
+
+def test_bulk_admission_sheds_past_slot_share(node):
+    from opensearch_tpu.wlm import TOTAL_BULK_SLOTS
+
+    node.query_groups.put({
+        "name": "flood", "resiliency_mode": "enforced",
+        "resource_limits": {"memory": 1.5 / TOTAL_BULK_SLOTS},  # 1 slot
+    })
+    release = node.query_groups.admit_bulk("flood")
+    try:
+        with pytest.raises(RejectedExecutionException):
+            node.query_groups.admit_bulk("flood")
+        stats = node.query_groups.bulk_stats()
+        (entry,) = stats.values()
+        assert entry["current"] == 1
+        assert entry["limit"] == 1
+        assert entry["rejections"] == 1
+        totals = node.query_groups.totals()
+        gid = next(g for g in totals if g != "DEFAULT_WORKLOAD_GROUP")
+        assert totals[gid]["total_rejections"] == 1
+    finally:
+        release()
+        release()  # idempotent: a double release must not free twice
+    # slot returned: admission works again
+    node.query_groups.admit_bulk("flood")()
+    (entry,) = node.query_groups.bulk_stats().values()
+    assert entry["current"] == 0
+
+
+def test_bulk_admission_soft_and_untagged_unconstrained(node):
+    node.query_groups.put({
+        "name": "softy", "resiliency_mode": "soft",
+        "resource_limits": {"memory": 0.001},
+    })
+    for _ in range(5):
+        node.query_groups.admit_bulk("softy")()
+    node.query_groups.admit_bulk(None)()
+    node.query_groups.admit_bulk("no-such-group")()
+    assert node.query_groups.bulk_stats() == {}
+
+
+def test_bulk_admission_resizes_on_limit_change(node):
+    from opensearch_tpu.wlm import TOTAL_BULK_SLOTS
+
+    node.query_groups.put({
+        "name": "grow", "resiliency_mode": "enforced",
+        "resource_limits": {"memory": 1.5 / TOTAL_BULK_SLOTS},
+    })
+    r1 = node.query_groups.admit_bulk("grow")
+    with pytest.raises(RejectedExecutionException):
+        node.query_groups.admit_bulk("grow")
+    # widen the share -> the live budget resizes
+    node.query_groups.put({
+        "name": "grow", "resiliency_mode": "enforced",
+        "resource_limits": {"memory": 3.5 / TOTAL_BULK_SLOTS},
+    })
+    r2 = node.query_groups.admit_bulk("grow")
+    r1()
+    r2()
+
+
+def test_rest_bulk_sheds_429_for_enforced_group(node):
+    """End to end through TpuNode.bulk: an enforced group holding its
+    only slot sheds the next tagged bulk with the 429-typed rejection."""
+    from opensearch_tpu.wlm import TOTAL_BULK_SLOTS
+
+    node.query_groups.put({
+        "name": "bflood", "resiliency_mode": "enforced",
+        "resource_limits": {"memory": 1.5 / TOTAL_BULK_SLOTS},
+    })
+    node.create_index("wb", {})
+    held = node.query_groups.admit_bulk("bflood")
+    try:
+        with pytest.raises(RejectedExecutionException):
+            node.bulk([("index", {"_index": "wb", "_id": "1"}, {"n": 1})],
+                      query_group="bflood")
+    finally:
+        held()
+    # with the slot free the same call succeeds (slot released after)
+    resp = node.bulk([("index", {"_index": "wb", "_id": "1"}, {"n": 1})],
+                     query_group="bflood")
+    assert not resp["errors"]
+    (entry,) = node.query_groups.bulk_stats().values()
+    assert entry["current"] == 0
+
+
+def test_delete_group_drops_its_bulk_budget(node):
+    node.query_groups.put({
+        "name": "gone", "resiliency_mode": "enforced",
+        "resource_limits": {"memory": 0.05},
+    })
+    node.query_groups.admit_bulk("gone")()
+    assert node.query_groups.bulk_stats()
+    node.query_groups.delete("gone")
+    assert node.query_groups.bulk_stats() == {}
