@@ -50,6 +50,7 @@ from pathlib import Path
 CACHE = Path(__file__).resolve().parent / "BENCH_CACHE.json"
 PROFILE_OUT = Path(__file__).resolve().parent / "BENCH_PROFILE.json"
 CONCURRENCY_OUT = Path(__file__).resolve().parent / "BENCH_CONCURRENCY.json"
+MESH_OUT = Path(__file__).resolve().parent / "BENCH_MESH.json"
 BUDGET_S = int(os.environ.get("BENCH_BUDGET_S", "1100"))
 PROBE_S = int(os.environ.get("BENCH_PROBE_S", "90"))
 PROFILE_BUDGET_S = int(os.environ.get("BENCH_PROFILE_BUDGET_S", "600"))
@@ -70,6 +71,16 @@ def _load_cache() -> dict:
     return data
 
 
+def _load_book(path: Path) -> dict:
+    """Platform-keyed result book (BENCH_MESH.json); corrupt == fresh."""
+    if not path.exists():
+        return {}
+    try:
+        return json.loads(path.read_text())
+    except Exception:  # noqa: BLE001 - corrupt book == fresh book
+        return {}
+
+
 def _save_cache(cache: dict) -> None:
     try:
         CACHE.write_text(json.dumps(cache, indent=1) + "\n")
@@ -77,11 +88,13 @@ def _save_cache(cache: dict) -> None:
         pass
 
 
-def _run(args: list, timeout_s: int, platform_env=None):
+def _run(args: list, timeout_s: int, platform_env=None, extra_env=None):
     """Run a child mode; return (last JSON dict or None, failure reason)."""
     env = os.environ.copy()
     if platform_env:
         env["JAX_PLATFORMS"] = platform_env
+    if extra_env:
+        env.update(extra_env)
     try:
         proc = subprocess.run(
             [sys.executable, __file__] + args,
@@ -190,13 +203,7 @@ def gate_parent() -> int:
     cached * (1 - tolerance) — a PR that slows the hot path fails visibly
     instead of silently. No cached entry for the platform => pass with a
     note (nothing to ratchet against)."""
-    cache = _load_cache()
-    forced_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
-    platform = "cpu"
-    if not forced_cpu:
-        probe, _probe_err = _run(["--probe"], PROBE_S)
-        if probe is not None and probe.get("platform") not in (None, "cpu"):
-            platform = "tpu"
+    platform = _detect_platform()
     fresh, reason = _run(
         ["--gate-child"], GATE_BUDGET_S,
         platform_env="cpu" if platform == "cpu" else None,
@@ -208,35 +215,52 @@ def gate_parent() -> int:
             "detail": f"gate child failed: {reason}", "ok": False,
         }))
         return 1
-    cached = cache.get(platform)
+    out, ok = _gate_compare(
+        "bench_gate", fresh.get("value", 0), _load_cache().get(platform),
+        platform, "hot-path regression")
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+def _detect_platform() -> str:
+    """cpu unless a probe child sees a real accelerator; JAX_PLATFORMS=cpu
+    short-circuits the probe (the tests/CI configuration)."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return "cpu"
+    probe, _probe_err = _run(["--probe"], PROBE_S)
+    if probe is not None and probe.get("platform") not in (None, "cpu"):
+        return "tpu"
+    return "cpu"
+
+
+def _gate_compare(metric: str, fresh_value, cached: dict | None,
+                  platform: str, what: str) -> tuple[dict, bool]:
+    """Shared floor check for the regression gates: fresh QPS must stay
+    within the platform tolerance of the cached same-platform baseline.
+    No baseline => pass with a note (nothing to ratchet against)."""
     tol = float(os.environ.get(
         "BENCH_GATE_TOLERANCE", GATE_TOLERANCE.get(platform, 0.45)))
     out = {
-        "metric": "bench_gate", "unit": "queries/s",
-        "platform": platform,
-        "value": fresh.get("value", 0),
-        "vs_baseline": 0,
-        "tolerance": tol,
+        "metric": metric, "unit": "queries/s", "platform": platform,
+        "value": fresh_value, "vs_baseline": 0, "tolerance": tol,
     }
     if cached is None or not cached.get("value"):
         out.update({"ok": True,
                     "detail": f"no cached {platform} baseline to gate "
                               f"against"})
-        print(json.dumps(out))
-        return 0
+        return out, True
     floor = float(cached["value"]) * (1.0 - tol)
-    ok = float(fresh.get("value", 0)) >= floor
+    ok = float(fresh_value or 0) >= floor
     out.update({
         "cached": cached["value"], "floor": round(floor, 1), "ok": ok,
-        "vs_baseline": round(float(fresh.get("value", 0))
+        "vs_baseline": round(float(fresh_value or 0)
                              / float(cached["value"]), 3),
     })
     if not ok:
         out["detail"] = (
-            f"hot-path regression: fresh {fresh.get('value')} qps < floor "
+            f"{what}: fresh {fresh_value} qps < floor "
             f"{round(floor, 1)} (cached {cached['value']} - {tol:.0%})")
-    print(json.dumps(out))
-    return 0 if ok else 1
+    return out, ok
 
 
 def gate_child() -> None:
@@ -385,6 +409,235 @@ def profile_child() -> None:
         "platform": jax.devices()[0].platform,
         "corpus": {"docs": n_docs, "dim": d},
         "workloads": out_workloads,
+    }))
+
+
+MESH_BUDGET_S = int(os.environ.get("BENCH_MESH_BUDGET_S", "900"))
+MESH_SHARDS = int(os.environ.get("BENCH_MESH_SHARDS", "8"))
+MESH_CLIENTS = int(os.environ.get("BENCH_MESH_CLIENTS", "8"))
+MESH_QUERIES = int(os.environ.get("BENCH_MESH_QUERIES", "40"))
+
+
+def _mesh_env(platform: str) -> dict:
+    """On the CPU backend, simulate the 8-device node the mesh shards
+    over (the MULTICHIP harness's recipe); a real accelerator keeps its
+    own device set."""
+    if platform != "cpu":
+        return {}
+    flags = os.environ.get("XLA_FLAGS", "")
+    want = f"--xla_force_host_platform_device_count={MESH_SHARDS}"
+    if want in flags:
+        return {}
+    return {"XLA_FLAGS": (flags + " " + want).strip()}
+
+
+def mesh_parent() -> int:
+    """`bench.py --mesh`: multi-shard CLUSTER-MODE kNN bench — one
+    single-node ClusterServer, MESH_SHARDS shards, MESH_CLIENTS concurrent
+    clients, shard-mesh launch ON vs the serialized per-shard baseline
+    (distributed_serving disabled). Writes BENCH_MESH.json keyed by
+    platform; the headline value is mesh-on QPS, vs_baseline the speedup
+    over the per-shard loop at equal (verified 1.0) recall."""
+    platform = _detect_platform()
+    result, reason = _run(["--mesh-child"], MESH_BUDGET_S,
+                          platform_env="cpu" if platform == "cpu" else None,
+                          extra_env=_mesh_env(platform))
+    if result is None:
+        print(json.dumps({
+            "metric": "bench_error", "value": 0, "unit": "error",
+            "vs_baseline": 0, "detail": f"mesh child failed: {reason}",
+        }))
+        return 1
+    book = _load_book(MESH_OUT)
+    book[result.get("platform", "cpu")] = result
+    try:
+        MESH_OUT.write_text(json.dumps(book, indent=1) + "\n")
+    except OSError as e:
+        result["write_error"] = str(e)
+    print(json.dumps(result))
+    return 0
+
+
+def mesh_gate_parent() -> int:
+    """`bench.py --mesh-gate`: the check.sh regression gate for the
+    shard-mesh path — a QUICK mesh run must stay within the platform
+    tolerance of BENCH_MESH.json's entry (same contract as the streaming
+    gate). No recorded baseline => pass with a note."""
+    platform = _detect_platform()
+    result, reason = _run(
+        ["--mesh-child"], MESH_BUDGET_S,
+        platform_env="cpu" if platform == "cpu" else None,
+        extra_env={**_mesh_env(platform), "BENCH_MESH_QUERIES": "12"},
+    )
+    if result is None:
+        print(json.dumps({
+            "metric": "mesh_gate", "value": 0, "unit": "error",
+            "vs_baseline": 0,
+            "detail": f"mesh gate child failed: {reason}", "ok": False,
+        }))
+        return 1
+    out, ok = _gate_compare(
+        "mesh_gate", result.get("value", 0),
+        _load_book(MESH_OUT).get(platform), platform,
+        "shard-mesh regression")
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+def _free_ports(n: int) -> list:
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def mesh_child() -> None:
+    """One single-node cluster server, MESH_SHARDS shards of exact-kNN
+    vectors, MESH_CLIENTS concurrent clients through the facade (the HTTP
+    handlers' API): measure QPS with the shard-mesh launch ON (one
+    search[node] -> one shard_map launch over all shards) vs OFF (the
+    serialized per-shard Python loop + host merge), and verify recall
+    parity (identical top-k ids) between the two paths."""
+    import asyncio
+    import tempfile
+    import threading
+
+    _pin_platform()
+    import numpy as np
+
+    import jax
+
+    from opensearch_tpu.search import distributed_serving
+    from opensearch_tpu.server import ClusterServer
+
+    platform = jax.devices()[0].platform
+    n_devices = len(jax.devices())
+    d = 64
+    docs_per_shard = 1_200 if platform == "cpu" else 16_000
+    n_docs = MESH_SHARDS * docs_per_shard
+    n_queries = int(os.environ.get("BENCH_MESH_QUERIES", MESH_QUERIES))
+
+    tport, hport = _free_ports(2)
+    tmp = tempfile.mkdtemp(prefix="bench_mesh_")
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    server = ClusterServer(
+        "n0", Path(tmp) / "n0", "127.0.0.1", tport, hport,
+        {"n0": ("127.0.0.1", tport)}, loop=loop,
+    )
+    asyncio.run_coroutine_threadsafe(
+        server.start(bootstrap=["n0"]), loop).result(60)
+    deadline = time.monotonic() + 60
+    while not server.node.is_leader:
+        if time.monotonic() > deadline:
+            raise RuntimeError("single-node cluster never elected itself")
+        time.sleep(0.05)
+    facade = server.facade
+
+    facade.create_index("mesh", {
+        "settings": {"number_of_shards": MESH_SHARDS,
+                     "number_of_replicas": 0},
+        "mappings": {"properties": {
+            "v": {"type": "knn_vector", "dimension": d, "space_type": "l2"},
+        }},
+    })
+    rng = np.random.default_rng(23)
+    chunk = 2_000
+    for start in range(0, n_docs, chunk):
+        ops = [
+            ("index", {"_index": "mesh", "_id": str(i)},
+             {"v": rng.standard_normal(d).astype(np.float32).tolist()})
+            for i in range(start, min(start + chunk, n_docs))
+        ]
+        resp = facade.bulk(ops)
+        if resp.get("errors"):
+            raise RuntimeError(f"bulk errors at {start}")
+    facade.refresh("mesh")
+
+    queries = [
+        rng.standard_normal(d).astype(np.float32).tolist()
+        for _ in range(MESH_CLIENTS * n_queries)
+    ]
+
+    def knn_body(q):
+        return {"size": 10,
+                "query": {"knn": {"v": {"vector": q, "k": 10}}}}
+
+    def run_config(mesh_on: bool) -> dict:
+        distributed_serving.enabled = mesh_on
+        before = distributed_serving.stats["distributed_searches"]
+        # warm: compile the program shapes this config uses (and upload
+        # the resident slabs for the mesh config)
+        for q in queries[:2]:
+            facade.search("mesh", knn_body(q))
+        lat: list[list[float]] = [[] for _ in range(MESH_CLIENTS)]
+        barrier = threading.Barrier(MESH_CLIENTS + 1)
+
+        def client(ci: int) -> None:
+            mine = queries[ci * n_queries:(ci + 1) * n_queries]
+            barrier.wait()
+            for q in mine:
+                t0 = time.perf_counter()
+                facade.search("mesh", knn_body(q))
+                lat[ci].append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(MESH_CLIENTS)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        flat = sorted(x for chunk_ in lat for x in chunk_)
+        return {
+            "mesh_enabled": mesh_on,
+            "clients": MESH_CLIENTS,
+            "queries_per_client": n_queries,
+            "qps": round(len(flat) / wall, 1),
+            "p50_ms": round(1000 * flat[len(flat) // 2], 2),
+            "p99_ms": round(1000 * flat[int(len(flat) * 0.99)], 2),
+            "mesh_launches": (
+                distributed_serving.stats["distributed_searches"] - before),
+        }
+
+    # recall parity first (both paths are exact; ids must agree)
+    agree = 0
+    sample = queries[:16]
+    for q in sample:
+        distributed_serving.enabled = True
+        mesh_ids = [h["_id"] for h in
+                    facade.search("mesh", knn_body(q))["hits"]["hits"]]
+        distributed_serving.enabled = False
+        host_ids = [h["_id"] for h in
+                    facade.search("mesh", knn_body(q))["hits"]["hits"]]
+        agree += mesh_ids == host_ids
+    recall = agree / len(sample)
+
+    off = run_config(False)
+    on = run_config(True)
+    distributed_serving.enabled = True
+
+    print(json.dumps({
+        "metric": f"mesh_knn_qps_{MESH_SHARDS}shards_{MESH_CLIENTS}clients",
+        "value": on["qps"],
+        "unit": "queries/s",
+        "vs_baseline": round(on["qps"] / max(off["qps"], 1e-9), 2),
+        "platform": platform,
+        "devices": n_devices,
+        "corpus": {"docs": n_docs, "dim": d, "shards": MESH_SHARDS},
+        "recall_vs_host": recall,
+        "mesh_on": on,
+        "mesh_off": off,
     }))
 
 
@@ -675,6 +928,20 @@ def child() -> None:
 
 
 if __name__ == "__main__":
+    if "--mesh-child" in sys.argv:
+        try:
+            mesh_child()
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "metric": "bench_error", "value": 0, "unit": "error",
+                "vs_baseline": 0, "detail": str(e)[:200],
+            }))
+            sys.exit(1)
+        sys.exit(0)
+    if "--mesh-gate" in sys.argv:
+        sys.exit(mesh_gate_parent())
+    if "--mesh" in sys.argv:
+        sys.exit(mesh_parent())
     if "--profile-child" in sys.argv:
         try:
             profile_child()

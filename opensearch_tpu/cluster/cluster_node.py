@@ -215,11 +215,29 @@ class ClusterNode:
         # coordinator's scroll id maps node -> local ctx — ReaderContext
         # .java:64 semantics distributed)
         self._reader_contexts: dict[str, dict] = {}
-        self._ctx_seq = 0
         # heavy query phases run OFF the transport loop so a slow search
         # cannot stall heartbeats/elections (VERDICT r2 weak #9); one worker
-        # keeps the engine's single-writer discipline
+        # keeps the engine's single-writer discipline for WRITE/engine work
         self._data_executor = None
+        # read-only searches get a PARALLEL pool (the reference's `search`
+        # threadpool; same split rest/http.py uses): they execute against
+        # immutable acquired snapshots, so they need no single-writer
+        # discipline — and serializing them behind the data worker meant
+        # concurrent search[node] requests could never reach the kNN
+        # dispatch batcher together, so cross-request coalescing (and the
+        # shard-mesh launch amortization) never engaged in cluster mode
+        self._search_executor = None
+        # ctx ids mint on the parallel pool: itertools.count is atomic
+        # under the GIL where `self._ctx_seq += 1` is read-modify-write
+        import itertools as _it
+
+        self._ctx_counter = _it.count(1)
+        # device-resident shard bundles for the mesh kNN path, keyed by
+        # reader generation (cluster/shard_mesh.py); process-wide like the
+        # batcher — invalidated when this node's shards leave
+        from opensearch_tpu.cluster.shard_mesh import default_registry
+
+        self.shard_mesh = default_registry
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -310,6 +328,11 @@ class ClusterNode:
         for key in list(self.local_shards):
             if key not in my_shards or key[0] not in state.indices:
                 shard = self.local_shards.pop(key)
+                # a departing shard invalidates the index's device-resident
+                # mesh bundles: the residency key pins engine instance ids,
+                # so a stale bundle could never serve wrong data — this
+                # just releases HBM promptly instead of waiting on LRU
+                self.shard_mesh.invalidate_index(key[0])
                 self._tracked_targets.pop(key, None)
                 driver = self._recovery_drivers.pop(key, None)
                 if driver is not None:
@@ -1946,6 +1969,17 @@ class ClusterNode:
         if not targets:
             callback({"error": "not all shards available"})
             return
+        # device-kNN bodies route through the shard-mesh data plane: ONE
+        # search[node] RPC per node holding target shards — the node runs
+        # a single sharded launch over all of them (cluster/shard_mesh.py)
+        # — instead of one RPC per shard with a host-Python merge; the
+        # coordinator stream-merges the pre-merged node partials
+        # (search/reduce.py). Ineligible bodies keep the per-shard path.
+        if self._mesh_search_eligible(body):
+            self._search_node_grouped(
+                index, body, targets, missing, size, from_, callback
+            )
+            return
         # shards with no serving copy (mid-failover) degrade the response
         # instead of refusing it: the reachable shards answer and the
         # missing ones count into _shards.failed
@@ -1999,23 +2033,232 @@ class ClusterNode:
                     on_failure=one_result(shard_num),  # missing shard
                 )
 
+    # -- shard-mesh search fan-out (one sharded launch per node) ------------
+
+    # body keys the node-grouped device-kNN path accepts: a bare knn query
+    # plus paging/_source/profile — everything else (sort, aggs, rescore,
+    # highlight, ...) keeps the per-shard scatter-gather
+    _MESH_SEARCH_KEYS = frozenset({
+        "query", "size", "from", "_source", "track_total_hits",
+        "version", "seq_no_primary_term", "profile",
+    })
+
+    @classmethod
+    def _mesh_search_eligible(cls, body: dict) -> bool:
+        if not isinstance(body, dict) or set(body) - cls._MESH_SEARCH_KEYS:
+            return False
+        query = body.get("query")
+        return isinstance(query, dict) and set(query) == {"knn"}
+
+    def _search_node_grouped(self, index: str, body: dict, targets: dict,
+                             missing: int, size: int, from_: int,
+                             callback: Callable[[dict], None]) -> None:
+        """Device-kNN fan-out grouped BY NODE: each data node receives one
+        search[node] request covering every target shard it holds, executes
+        them as one shard_map launch (service.search -> shard-mesh path),
+        and the coordinator reduces the pre-merged partials. A node RPC
+        failure — or a shard copy missing on the node — degrades that
+        node's shards to per-shard search[shard] execution against another
+        serving copy (allow_partial_search_results semantics when none
+        exists)."""
+        from opensearch_tpu.search.reduce import reduce_search_responses
+
+        by_node: dict[str, list[int]] = {}
+        for num, r in sorted(targets.items()):
+            by_node.setdefault(r.node_id, []).append(num)
+        track_total = body.get("track_total_hits", True)
+        node_body = dict(body)
+        node_body["from"] = 0
+        node_body["size"] = from_ + size
+        node_body["track_total_hits"] = True
+        tracer = self.telemetry.tracer
+        # coordinator ROOT span: begin/end because partials arrive in later
+        # scheduled callbacks (same recipe as the per-shard coordinator)
+        root = tracer.begin_span(
+            "search.coordinator",
+            {"index": index, "node": self.node_id, "mesh": True,
+             "fanout": len(by_node), "shards": len(targets)},
+        )
+        ctx = {"trace_id": root.trace_id, "span_id": root.span_id}
+        partials: list[dict] = []
+        extra_failed = [missing]
+        pending = [len(by_node)]
+
+        def finish() -> None:
+            try:
+                with tracing.restore_trace_context(ctx), \
+                        tracer.start_span("search.reduce", {
+                            "index": index, "node": self.node_id,
+                            "partials": len(partials)}):
+                    resp = reduce_search_responses(
+                        body, partials, size=size, from_=from_,
+                        track_total=track_total,
+                    )
+                resp["_shards"]["total"] += extra_failed[0]
+                resp["_shards"]["failed"] += extra_failed[0]
+            except Exception as e:  # noqa: BLE001 - a reduce failure inside
+                # a transport completion callback must FAIL the search, not
+                # leak the caller (TPU008's failure class)
+                resp = {"error": f"{type(e).__name__}: {e}"}
+            tracer.end_span(root)
+            callback(resp)
+
+        def one_node_done() -> None:
+            pending[0] -= 1
+            if pending[0] == 0:
+                finish()
+
+        def make_handlers(nid: str, nums: list[int]):
+            def handle(resp: Any) -> None:
+                if not isinstance(resp, dict) or "hits" not in resp:
+                    # whole-node failure: every shard degrades to the
+                    # per-shard path on another copy
+                    self._per_shard_fallback(
+                        index, node_body, nums, nid, partials,
+                        extra_failed, one_node_done)
+                    return
+                failed_nums = resp.pop("_failed_shards", None)
+                if failed_nums:
+                    # hand the missing copies to the fallback instead of
+                    # double-counting them (the partial already bumped its
+                    # _shards for them)
+                    resp["_shards"]["total"] -= len(failed_nums)
+                    resp["_shards"]["failed"] -= len(failed_nums)
+                partials.append(resp)
+                if failed_nums:
+                    self._per_shard_fallback(
+                        index, node_body, failed_nums, nid, partials,
+                        extra_failed, one_node_done)
+                else:
+                    one_node_done()
+
+            def fail(_e: Exception) -> None:
+                self._per_shard_fallback(
+                    index, node_body, nums, nid, partials,
+                    extra_failed, one_node_done)
+
+            return handle, fail
+
+        with tracing.restore_trace_context(ctx):
+            for nid, nums in sorted(by_node.items()):
+                handle, fail = make_handlers(nid, nums)
+                self.transport.send(
+                    self.node_id, nid, "indices:data/read/search[node]",
+                    {"index": index, "shards": nums, "body": node_body},
+                    on_response=handle, on_failure=fail,
+                )
+
+    def _per_shard_fallback(self, index: str, node_body: dict,
+                            nums: list[int], failed_node: str,
+                            partials: list[dict], extra_failed: list[int],
+                            done: Callable[[], None]) -> None:
+        """Mesh-path degrade: re-execute `nums` through per-shard
+        search[shard] against another serving copy (the failed node is
+        excluded); shards with no other copy count into _shards.failed."""
+        state = self.applied_state
+        remaining = [len(nums)]
+
+        def one_done() -> None:
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                done()
+
+        def make_shard_handlers(num: int):
+            def handle(resp: Any) -> None:
+                if isinstance(resp, dict) and "hits" in resp:
+                    partials.append(self._shard_resp_as_partial(num, resp))
+                else:
+                    extra_failed[0] += 1
+                one_done()
+
+            def fail(_e: Exception) -> None:
+                extra_failed[0] += 1
+                one_done()
+
+            return handle, fail
+
+        for num in nums:
+            alt = next(
+                (r for r in state.shards_for_index(index)
+                 if r.shard == num and r.node_id not in (None, failed_node)
+                 and r.state in ("STARTED", "RELOCATING")), None)
+            if alt is None:
+                extra_failed[0] += 1
+                one_done()
+                continue
+            handle, fail = make_shard_handlers(num)
+            self.transport.send(
+                self.node_id, alt.node_id, "indices:data/read/search[shard]",
+                {"index": index, "shard": num, "body": node_body},
+                on_response=handle, on_failure=fail,
+            )
+
+    @staticmethod
+    def _shard_resp_as_partial(shard_num: int, resp: dict) -> dict:
+        """Wrap a per-shard search[shard] response as a reduce-compatible
+        partial. `_tb` = [shard, 0, rank] preserves the merge order exactly:
+        within one shard, rank order IS (segment, doc) order for equal
+        scores, and cross-shard ties compare on the shard number first."""
+        hits = []
+        for i, h in enumerate(resp.get("hits") or []):
+            h = dict(h)
+            h["_tb"] = [shard_num, 0, i]
+            hits.append(h)
+        return {
+            "took": 0, "timed_out": False,
+            "_shards": {"total": 1, "successful": 1, "skipped": 0,
+                        "failed": 0},
+            "hits": {"total": {"value": resp.get("total", 0),
+                               "relation": "eq"},
+                     "max_score": resp.get("max_score"),
+                     "hits": hits},
+        }
+
     # -- per-node search partials (the QuerySearchResult wire analog) -------
 
+    # bounded search pool: enough parallelism for the dispatch batcher to
+    # see concurrent requests, small enough that one node cannot starve
+    # the host (the reference's fixed `search` threadpool sizing)
+    _SEARCH_POOL_WORKERS = 4
+
     def _offload(self, fn):
-        """Run `fn` on the data worker thread, resolving a DeferredResponse
-        on the transport loop. Falls back to synchronous execution under the
-        deterministic sim (no loop, no threads)."""
+        """Run `fn` on the serial data worker thread (engine single-writer
+        discipline), resolving a DeferredResponse on the transport loop.
+        Falls back to synchronous execution under the deterministic sim
+        (no loop, no threads)."""
         loop = getattr(self.scheduler, "loop", None)
         if loop is None:
             return fn()
         from concurrent.futures import ThreadPoolExecutor
 
-        from opensearch_tpu.transport.base import DeferredResponse
-
         if self._data_executor is None:
             self._data_executor = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix=f"{self.node_id}-data"
             )
+        return self._submit_deferred(loop, self._data_executor, fn)
+
+    def _offload_search(self, fn):
+        """Run read-only query work on the BOUNDED PARALLEL search pool:
+        executions touch only immutable acquired snapshots, so concurrent
+        search[node] requests proceed side by side — which is what lets the
+        kNN dispatch batcher coalesce them into one shard-mesh launch (and
+        what parallelizes the non-mesh per-shard fallback path)."""
+        loop = getattr(self.scheduler, "loop", None)
+        if loop is None:
+            return fn()
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self._search_executor is None:
+            self._search_executor = ThreadPoolExecutor(
+                max_workers=self._SEARCH_POOL_WORKERS,
+                thread_name_prefix=f"{self.node_id}-search",
+            )
+        return self._submit_deferred(loop, self._search_executor, fn)
+
+    @staticmethod
+    def _submit_deferred(loop, executor, fn):
+        from opensearch_tpu.transport.base import DeferredResponse
+
         deferred = DeferredResponse()
         # carry the contextvars context (restored trace context, active
         # tracer) onto the worker thread so spans opened by offloaded work
@@ -2032,14 +2275,23 @@ class ClusterNode:
             else:
                 loop.call_soon_threadsafe(deferred.set_result, result)
 
-        self._data_executor.submit(run)
+        executor.submit(run)
         return deferred
 
     def _on_node_search(self, sender: str, payload: dict):
         """Execute the FULL per-shard search service over this node's local
         shards of one index, returning a wire partial
         (search/service.search(partial=True)). Optionally pins the
-        snapshots in a reader context for scroll/PIT."""
+        snapshots in a reader context for scroll/PIT.
+
+        A requested shard whose local copy is MISSING (stale routing: the
+        copy moved/failed while the coordinator's fan-out was in flight)
+        degrades the partial instead of failing the whole node: the present
+        shards answer (mesh launch or per-shard fallback over the present
+        subset) and the missing ones ride back in `_failed_shards` /
+        `_shards.failed` — allow_partial_search_results semantics at the
+        node level. A scroll-pinning request still needs every shard, so
+        `keep_context` keeps the strict behavior."""
         index = payload["index"]
         nums = list(payload["shards"])
         body = payload.get("body") or {}
@@ -2047,7 +2299,18 @@ class ClusterNode:
         keep_alive_ms = int(payload.get("keep_alive_ms") or 60_000)
         self._reap_reader_contexts()
 
-        shards = [self._local_shard(index, n) for n in nums]
+        shards, present, missing = [], [], []
+        for n in nums:
+            local = self.local_shards.get((index, n))
+            if local is None and not keep:
+                missing.append(n)
+                continue
+            shards.append(self._local_shard(index, n))
+            present.append(n)
+        if not shards:
+            raise ShardNotFoundException(
+                f"no copy of [{index}]{nums} on node {self.node_id}"
+            )
         snaps = [s.acquire_searcher() for s in shards]
 
         def run() -> dict:
@@ -2056,18 +2319,21 @@ class ClusterNode:
             with tracing.activate(self.telemetry.tracer), \
                     self.telemetry.tracer.start_span("search.node_partial", {
                         "index": index, "node": self.node_id,
-                        "shards": len(nums)}):
+                        "shards": len(present)}):
                 resp = search_service.search(
                     shards, body, acquired=snaps, partial=True,
-                    shard_numbers=nums,
+                    shard_numbers=present,
                 )
+            if missing:
+                resp["_shards"]["total"] += len(missing)
+                resp["_shards"]["failed"] += len(missing)
+                resp["_failed_shards"] = missing
             if keep:
                 # register only on success — a failed first search must not
                 # leak a context whose id never reaches the coordinator
-                self._ctx_seq += 1
-                ctx_id = f"{self.node_id}#{self._ctx_seq}"
+                ctx_id = f"{self.node_id}#{next(self._ctx_counter)}"
                 self._reader_contexts[ctx_id] = {
-                    "index": index, "nums": nums, "shards": shards,
+                    "index": index, "nums": present, "shards": shards,
                     "snaps": snaps, "body": body,
                     "keep_alive_ms": keep_alive_ms,
                     "expires_at": self._now_ms() + keep_alive_ms,
@@ -2075,7 +2341,7 @@ class ClusterNode:
                 resp["_ctx_id"] = ctx_id
             return resp
 
-        return self._offload(run)
+        return self._offload_search(run)
 
     def _on_node_msearch(self, sender: str, payload: dict):
         """Execute several search bodies over this node's local shards of
@@ -2110,7 +2376,7 @@ class ClusterNode:
                     out.append({"error": f"{type(e).__name__}: {e}"})
             return {"responses": out}
 
-        return self._offload(run)
+        return self._offload_search(run)
 
     @staticmethod
     def _now_ms() -> int:
@@ -2121,9 +2387,12 @@ class ClusterNode:
 
     def _reap_reader_contexts(self) -> None:
         now = self._now_ms()
-        for cid in [c for c, x in self._reader_contexts.items()
-                    if x["expires_at"] < now]:
-            del self._reader_contexts[cid]
+        # snapshot first: registration happens on the search pool while
+        # this runs on the transport loop — iterating the live dict could
+        # see a concurrent insert mid-walk
+        for cid, x in list(self._reader_contexts.items()):
+            if x["expires_at"] < now:
+                self._reader_contexts.pop(cid, None)
 
     def _on_ctx_search(self, sender: str, payload: dict):
         """Search against a pinned reader context (scroll page / PIT
@@ -2165,7 +2434,7 @@ class ClusterNode:
                     shard_numbers=nums,
                 )
 
-        return self._offload(run)
+        return self._offload_search(run)
 
     def _on_ctx_close(self, sender: str, payload: dict) -> dict:
         freed = 0
@@ -2226,7 +2495,8 @@ class ClusterNode:
                 "primary": bool(shard.primary),
                 "docs": shard.num_docs,
             }
-        return {"shards": out}
+        return {"shards": out,
+                "shard_mesh": self.shard_mesh.snapshot_stats()}
 
     def _on_shard_search(self, sender: str, payload: dict):
         def run() -> dict:
@@ -2239,7 +2509,7 @@ class ClusterNode:
                         "node": self.node_id}):
                 return self._shard_search_local(payload)
 
-        return self._offload(run)
+        return self._offload_search(run)
 
     def _shard_search_local(self, payload: dict) -> dict:
         """Per-shard query+fetch (the combined phase; split q/f is the
@@ -2359,6 +2629,8 @@ class ClusterNode:
         self.coordinator.stop()
         if self._data_executor is not None:
             self._data_executor.shutdown(wait=False)
+        if self._search_executor is not None:
+            self._search_executor.shutdown(wait=False)
         self._reader_contexts.clear()
         for shard in self.local_shards.values():
             shard.close()

@@ -1,0 +1,148 @@
+"""Shard-mesh registry: a node's device-resident shards as ONE sharded array.
+
+The data-plane residency layer behind the single-launch-per-node kNN path
+(ROADMAP item 1): every (index, field) whose shards live on this node is
+flattened into one [S, n_flat, d] slab sharded over a `Mesh` data axis
+(parallel/distributed.build_knn_serving_step), so a multi-shard query is a
+single `shard_map` launch — per-shard scoring + top-k on each device slot,
+`all_gather` + top_k across the axis — instead of a serialized per-shard
+Python loop with a host merge (TPU-KNN's roofline argument: the scan AND
+the reduce must stay on device to amortize dispatch overhead).
+
+Residency is keyed by READER GENERATION: the registry key embeds each
+shard's engine instance id, snapshot generation and segment count, so a
+refresh mid-flight can never be answered from another snapshot's slab — a
+bumped generation is a different key, a different bundle, a different
+launch (the same snapshot-safety invariant the kNN micro-batcher's batch
+keys carry). One bundle stays live per (index, field); superseded
+generations are evicted on insert, and `invalidate_index` drops an index's
+bundles when its shards leave the node (cluster-state application).
+
+The registry is process-wide (one process == one device set — the same
+scope as the kNN dispatch batcher); sim nodes sharing an interpreter share
+it safely because engine instance ids keep their keys disjoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+# insertion-ordered dict as LRU: hits re-insert, eviction pops the head
+_DEFAULT_MAX_BUNDLES = 8
+
+
+class ShardMeshRegistry:
+    """Tracks device-resident shard bundles keyed by reader generation."""
+
+    def __init__(self, max_bundles: int = _DEFAULT_MAX_BUNDLES):
+        self.max_bundles = max_bundles
+        self._lock = threading.Lock()
+        self._bundles: dict[tuple, Any] = {}
+        self._launch_seq = 0
+        self.stats = {
+            "builds": 0,          # slabs uploaded (cold generations)
+            "hits": 0,            # launches served by a resident bundle
+            "evictions": 0,       # superseded generations + LRU pressure
+            "invalidations": 0,   # index-level drops (shard left the node)
+            "launches": 0,        # sharded device launches issued
+        }
+
+    # -- keys ---------------------------------------------------------------
+
+    @staticmethod
+    def residency_key(index: str, field: str, shards: list, snaps: list) -> tuple:
+        """Generation-pinned identity of one node's shard set for a field.
+
+        Engine instance ids make the key immune to delete+recreate cycles
+        (generations restart at 0 on a fresh engine); the generation tuple
+        is the refresh-isolation invariant — a refresh never merges across
+        snapshots because it can never share a key."""
+        return (
+            index, field, len(shards),
+            tuple(sh.engine.instance_id for sh in shards),
+            tuple(snap.generation for snap in snaps),
+            tuple(len(snap.segments) for snap in snaps),
+        )
+
+    # -- bundle cache -------------------------------------------------------
+
+    def get(self, key: tuple) -> Any | None:
+        with self._lock:
+            bundle = self._bundles.get(key)
+            if bundle is not None:
+                self.stats["hits"] += 1
+                # LRU touch
+                del self._bundles[key]
+                self._bundles[key] = bundle
+            return bundle
+
+    def put(self, key: tuple, bundle: Any) -> Any:
+        """Insert a freshly built bundle; returns the WINNING bundle (an
+        entry another thread raced in first wins, so callers always launch
+        against the cached slab)."""
+        with self._lock:
+            existing = self._bundles.get(key)
+            if existing is not None:
+                return existing
+            # one live bundle per (index, field): superseded generations
+            # of the same residency slot evict now, not at LRU pressure
+            for stale in [k for k in self._bundles if k[:2] == key[:2]]:
+                del self._bundles[stale]
+                self.stats["evictions"] += 1
+            while len(self._bundles) >= self.max_bundles:
+                del self._bundles[next(iter(self._bundles))]
+                self.stats["evictions"] += 1
+            self._bundles[key] = bundle
+            self.stats["builds"] += 1
+            return bundle
+
+    def invalidate_index(self, index: str) -> int:
+        """Drop every bundle of `index` (its shards left this node or the
+        index was deleted); returns the number of bundles dropped."""
+        with self._lock:
+            stale = [k for k in self._bundles if k[0] == index]
+            for k in stale:
+                del self._bundles[k]
+            if stale:
+                self.stats["invalidations"] += len(stale)
+            return len(stale)
+
+    # -- launch bookkeeping -------------------------------------------------
+
+    def next_launch_id(self) -> int:
+        with self._lock:
+            self._launch_seq += 1
+            self.stats["launches"] += 1
+            return self._launch_seq
+
+    # -- introspection ------------------------------------------------------
+
+    def resident(self) -> list[dict]:
+        """What is device-resident right now (for node stats / debugging)."""
+        with self._lock:
+            return [
+                {"index": k[0], "field": k[1], "shards": k[2],
+                 "generations": list(k[4])}
+                for k in self._bundles
+            ]
+
+    def snapshot_stats(self) -> dict:
+        with self._lock:
+            out = dict(self.stats)
+            out["resident_bundles"] = len(self._bundles)
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._bundles.clear()
+
+    def reset_stats(self) -> None:
+        """Test hook: zero the counters (never the resident bundles)."""
+        with self._lock:
+            self.stats = dict.fromkeys(self.stats, 0)
+
+
+# process-wide default registry: adopted by serving nodes (TpuNode /
+# ClusterNode) the same way the default kNN batcher is
+default_registry = ShardMeshRegistry()

@@ -1,6 +1,6 @@
 """tpulint: AST + dataflow invariant checker for this codebase.
 
-Ten project-specific rules guard the invariants that ordinary linters
+Twelve project-specific rules guard the invariants that ordinary linters
 cannot see:
 
 - TPU001 jit-purity        — no host syncs / nonlocal mutation /
@@ -30,6 +30,12 @@ cannot see:
 - TPU010 lock-order        — TPU003's inversion detection propagated
                              across method boundaries via acquired-locks
                              call summaries
+- TPU011 data-worker-block — untimed waits / blocking IO inside callables
+                             offloaded to the serial data worker
+- TPU012 span-leak         — path-sensitive begin_span/end_span pairing
+                             over the per-function CFG: every non-raising
+                             path must end a manually opened span or hand
+                             it off (closure, store, return, argument)
 
 Run with ``python -m opensearch_tpu.lint [paths]``; violations already
 present in ``lint_baseline.json`` are tolerated (ratchet), new ones fail.

@@ -1743,6 +1743,162 @@ class BlockingOnDataWorkerChecker(Checker):
 
 
 # ---------------------------------------------------------------------------
+# TPU012 — span-leak (begin_span without end_span on some path, on lint/cfg)
+# ---------------------------------------------------------------------------
+
+
+class _SpanScan:
+    """Extract span-resolution events from one statement.
+
+    Resolution model (mirrors TPU008's exactly-once analysis, specialized
+    to manual span pairs): a name bound from `*.begin_span(...)` must, on
+    every non-raising path, either be passed to `*.end_span(name)` or be
+    HANDED OFF — captured by a nested def/lambda (deferred completion
+    callbacks end spans later), stored into a container/attribute,
+    returned, or passed to another call. Attribute access on the span
+    itself (`span.set_attribute(...)`, `span.trace_id`) is neutral: it
+    neither ends the span nor hands it off."""
+
+    def __init__(self, tracked: set[str]):
+        self.tracked = tracked
+
+    def walk(self, stmt: ast.AST, opened: set[str], ended: set[str],
+             escaped: set[str]) -> None:
+        # (re)binding a tracked name from begin_span opens a fresh span
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                stmt.targets[0].id in self.tracked and \
+                self._is_begin_span(stmt.value):
+            name = stmt.targets[0].id
+            opened.add(name)
+            ended.discard(name)
+            escaped.discard(name)
+            self._visit(stmt.value.func, opened, ended, escaped)
+            for arg in list(stmt.value.args) + \
+                    [kw.value for kw in stmt.value.keywords]:
+                self._visit(arg, opened, ended, escaped)
+            return
+        self._visit(stmt, opened, ended, escaped)
+
+    @staticmethod
+    def _is_begin_span(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "begin_span")
+
+    def _visit(self, node: ast.AST, opened: set[str], ended: set[str],
+               escaped: set[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a closure capturing the span owns its completion from here
+            escaped.update(_names_in(node) & self.tracked)
+            return
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "end_span":
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in self.tracked:
+                        ended.add(arg.id)
+                    else:
+                        self._visit(arg, opened, ended, escaped)
+                self._visit(fn.value, opened, ended, escaped)
+                return
+            self._visit(fn, opened, ended, escaped)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in self.tracked:
+                    # handed to another call — resolved by the receiver
+                    escaped.add(arg.id)
+                else:
+                    self._visit(arg, opened, ended, escaped)
+            return
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and \
+                    node.value.id in self.tracked:
+                return  # span.attr / span.method(...): neutral
+            self._visit(node.value, opened, ended, escaped)
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load) and node.id in self.tracked:
+                # stored / returned / yielded — someone else ends it
+                escaped.add(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, opened, ended, escaped)
+
+
+class SpanLeakChecker(Checker):
+    rule_id = "TPU012"
+    name = "span-leak"
+    description = ("a path through a function abandons a span opened with "
+                   "begin_span — neither end_span nor a handoff (closure "
+                   "capture, store, return, argument) resolves it, so the "
+                   "tracing ring holds an open span forever")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return "begin_span" in source
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        seen: set[tuple] = set()
+        for fn in (n for n in ast.walk(ctx.tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))):
+            tracked = {
+                stmt.targets[0].id
+                for stmt in ast.walk(fn)
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and _SpanScan._is_begin_span(stmt.value)
+            }
+            if not tracked:
+                continue
+            for v in self._check_fn(ctx, fn, tracked):
+                key = (v.line, v.message)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(v)
+        return out
+
+    def _check_fn(self, ctx: FileContext, fn: ast.AST,
+                  tracked: set[str]) -> Iterable[Violation]:
+        scan = _SpanScan(tracked)
+        graph = cfg_mod.build_cfg(fn)
+        out: list[Violation] = []
+        for path in cfg_mod.enumerate_paths(graph):
+            if path.raises:
+                # an escaping exception is the CALLER's signal (TPU008's
+                # contract); the abandoned-span cases that matter complete
+                # normally with the span still open
+                continue
+            opened: set[str] = set()
+            ended: set[str] = set()
+            escaped: set[str] = set()
+            for block in path.blocks:
+                for stmt in block.stmts:
+                    scan.walk(stmt, opened, ended, escaped)
+            leaked = opened - ended - escaped
+            if leaked:
+                anchor = self._leak_anchor(path, fn)
+                names = ", ".join(sorted(leaked))
+                out.append(ctx.violation(
+                    "TPU012", anchor,
+                    f"a code path completes without end_span({names}) — "
+                    f"begin_span'd spans must end (or be handed off) on "
+                    f"every path, or the trace tree never closes"))
+        return out
+
+    @staticmethod
+    def _leak_anchor(path: "cfg_mod.Path", fn: ast.AST) -> ast.AST:
+        for block in reversed(path.blocks):
+            for stmt in reversed(block.stmts):
+                if isinstance(stmt, ast.Return):
+                    return stmt
+        for block in path.blocks:
+            if block.label.startswith("except:") and block.stmts:
+                return block.stmts[0]
+        return fn
+
+
+# ---------------------------------------------------------------------------
 
 ALL_CHECKERS: list[Checker] = [
     JitPurityChecker(),
@@ -1756,6 +1912,7 @@ ALL_CHECKERS: list[Checker] = [
     UnboundedGrowthChecker(),
     InterproceduralLockOrderChecker(),
     BlockingOnDataWorkerChecker(),
+    SpanLeakChecker(),
 ]
 
 RULES: dict[str, Checker] = {c.rule_id: c for c in ALL_CHECKERS}

@@ -37,6 +37,14 @@ results are sliced off) so the jit program cache stays warm across batch
 widths — the PR 3 profiler's per-operator `retraced` flag is the
 regression oracle for this.
 
+Since the shard-mesh data plane (ISSUE 7) the batcher coalesces across
+SHARDS as well as requests: the mesh kNN path's batch key spans a whole
+node's shard set (service.py's distributed_knn key), so one launch serves
+many concurrent queries over all resident shards at once. Callers declare
+the span via ``dispatch(..., shards=S)``; `cross_shard_launches` /
+`cross_shard_queries` in the stats (and the `knn.batch.shards` histogram)
+show when that amortization is happening.
+
 Backpressure: the pending-query queue is bounded by a
 :class:`~opensearch_tpu.index.pressure.QueuePressure` budget — crossing it
 sheds the request with RejectedExecutionException (HTTP 429) instead of
@@ -170,6 +178,11 @@ class KnnDispatchBatcher:
             "max_batch": 0,
             "solo_fast_path": 0,    # adaptive immediate launches
             "rejections": 0,        # queue-bound sheds (429)
+            # launches whose key spans a whole shard MESH (shards > 1):
+            # one device program served every shard of the node at once,
+            # so the batcher amortized across shards AND requests
+            "cross_shard_launches": 0,
+            "cross_shard_queries": 0,
         }
 
     # -- config ------------------------------------------------------------
@@ -235,7 +248,8 @@ class KnnDispatchBatcher:
 
     def dispatch(self, key: Any, payload: Any,
                  launch: Callable[[Sequence[Any]],
-                                  tuple[list, bool]]) -> DispatchOutcome:
+                                  tuple[list, bool]],
+                 shards: int = 1) -> DispatchOutcome:
         """Run `payload` through the batch identified by `key`.
 
         `launch(payloads)` performs ONE device launch for the whole batch
@@ -246,9 +260,14 @@ class KnnDispatchBatcher:
         are identical. key=None means "not mergeable" (e.g. a filtered
         query whose valid mask is request-private): the launch runs solo,
         still counted in the stats.
+
+        `shards` declares how many shards the launch covers (the
+        shard-mesh path passes its mesh width): cross-shard launches are
+        tracked separately so the stats show when one launch amortized
+        across the whole node instead of one shard.
         """
         if key is None or not self.enabled or self.max_batch_size <= 1:
-            return self._solo(payload, launch)
+            return self._solo(payload, launch, shards)
         with self._cond:
             self.pressure.acquire()
             entry = _Entry(payload, timeutil.monotonic_millis())
@@ -270,7 +289,8 @@ class KnnDispatchBatcher:
                 batch = None
         while True:
             if batch is not None:
-                out = self._run_batch(key, batch, launch, own=entry)
+                out = self._run_batch(key, batch, launch, own=entry,
+                                      shards=shards)
                 if out is not None:
                     return out
                 # we led a batch that did not include our own entry (the
@@ -289,11 +309,11 @@ class KnnDispatchBatcher:
 
     # -- internals ---------------------------------------------------------
 
-    def _solo(self, payload: Any, launch) -> DispatchOutcome:
+    def _solo(self, payload: Any, launch, shards: int = 1) -> DispatchOutcome:
         t0 = time.perf_counter_ns()
         results, retraced = launch([payload])
         wall = time.perf_counter_ns() - t0
-        self._record_launch(1, wall, 0)
+        self._record_launch(1, wall, 0, shards)
         return DispatchOutcome(results[0], 1, wall, retraced, 0)
 
     def _take_locked(self, key: Any) -> list[_Entry]:
@@ -344,7 +364,7 @@ class KnnDispatchBatcher:
                     deadline = now
 
     def _run_batch(self, key: Any, batch: list[_Entry], launch,
-                   own: _Entry) -> DispatchOutcome | None:
+                   own: _Entry, shards: int = 1) -> DispatchOutcome | None:
         """Launch one batch; returns the outcome for `own`, or None when
         `own` was not part of this batch (its caller keeps waiting)."""
         t0 = time.perf_counter_ns()
@@ -367,7 +387,8 @@ class KnnDispatchBatcher:
                 e.done = True
             self._finish_locked(key, len(batch))
         self._record_launch(len(batch), wall,
-                            max((e.wait_ms for e in batch), default=0))
+                            max((e.wait_ms for e in batch), default=0),
+                            shards)
         if not any(e is own for e in batch):
             return None
         return DispatchOutcome(own.result, len(batch), wall, retraced,
@@ -388,17 +409,21 @@ class KnnDispatchBatcher:
         self._cond.notify_all()
 
     def _record_launch(self, merged: int, wall_ns: int,
-                       max_wait_ms: int) -> None:
+                       max_wait_ms: int, shards: int = 1) -> None:
         with self._cond:
             self.stats["dispatches"] += 1
             self.stats["merged_queries"] += merged
             if merged > 1:
                 self.stats["coalesced_batches"] += 1
             self.stats["max_batch"] = max(self.stats["max_batch"], merged)
+            if shards > 1:
+                self.stats["cross_shard_launches"] += 1
+                self.stats["cross_shard_queries"] += merged
         metrics = self.metrics
         if metrics is not None:
             metrics.histogram("knn.batch.size").record(merged)
             metrics.histogram("knn.batch.queue_wait_ms").record(max_wait_ms)
+            metrics.histogram("knn.batch.shards").record(shards)
             metrics.counter("knn.batch.dispatches").add(1)
 
 
@@ -410,5 +435,6 @@ class KnnDispatchBatcher:
 default_batcher = KnnDispatchBatcher()
 
 
-def dispatch(key: Any, payload: Any, launch) -> DispatchOutcome:
-    return default_batcher.dispatch(key, payload, launch)
+def dispatch(key: Any, payload: Any, launch,
+             shards: int = 1) -> DispatchOutcome:
+    return default_batcher.dispatch(key, payload, launch, shards=shards)

@@ -43,6 +43,7 @@ unfiltered multi-shard queries, one vector per dispatch, are lifted:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any
 
 import numpy as np
@@ -51,6 +52,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from opensearch_tpu.cluster.shard_mesh import default_registry as registry
 from opensearch_tpu.parallel.distributed import build_knn_serving_step
 from opensearch_tpu.parallel.mesh import DATA_AXIS
 from opensearch_tpu.search.executor import ShardHit, ShardQueryResult
@@ -75,14 +77,36 @@ def _count(key: str, n: int = 1) -> None:
 # kill switch (tests compare against the host merge; ops can disable)
 enabled = True
 
-_BUNDLE_CACHE: dict[tuple, "_IndexBundle"] = {}
 _PROGRAM_CACHE: dict[tuple, Any] = {}
 _MESH_CACHE: dict[int, Mesh] = {}
-_MAX_BUNDLES = 8
 # searches run on a parallel pool since the kNN batcher PR: concurrent
-# cache misses must not race the evict-stale/insert sequence (a double
-# delete raises, and duplicate bundle builds double-upload the corpus)
+# cache misses must not race program-cache insertion (bundle residency has
+# its own lock inside the ShardMeshRegistry)
 _CACHE_LOCK = threading.Lock()
+
+
+class MeshLaunchOutcome:
+    """What ONE sharded launch produced, for every query it served.
+
+    `per_query[q]` is the per-shard ShardQueryResult list shaped exactly
+    like the host path's; `premerged[q]` is the same winning hits as a flat
+    [(shard_idx, ShardHit)] list in the DEVICE merge order — which equals
+    the host merge's (-score, shard, segment, doc) ordering exactly, so the
+    caller can skip its host-side re-sort. `launch_id`/`wall_ns`/`retraced`
+    feed per-shard profile attribution (one launch record shared by every
+    shard the program covered)."""
+
+    __slots__ = ("per_query", "premerged", "launch_id", "wall_ns",
+                 "retraced", "shards")
+
+    def __init__(self, per_query, premerged, launch_id, wall_ns, retraced,
+                 shards):
+        self.per_query = per_query
+        self.premerged = premerged
+        self.launch_id = launch_id
+        self.wall_ns = wall_ns
+        self.retraced = retraced
+        self.shards = shards
 
 
 class _IndexBundle:
@@ -253,22 +277,6 @@ def _filter_valid_mask(
     return out
 
 
-def try_distributed_knn(
-    shards: list,
-    snaps: list,
-    node,
-    fetch_k: int,
-    alias_filters: list | None = None,
-) -> list[ShardQueryResult] | None:
-    """Execute one KnnQuery through the on-device merge program. Returns
-    per-shard ShardQueryResults shaped exactly like the host path's, or
-    None when this path cannot reproduce the host result."""
-    batched = try_distributed_knn_batch(
-        shards, snaps, [node], fetch_k, alias_filters=alias_filters
-    )
-    return None if batched is None else batched[0]
-
-
 def try_distributed_knn_batch(
     shards: list,
     snaps: list,
@@ -276,10 +284,25 @@ def try_distributed_knn_batch(
     fetch_k: int,
     alias_filters: list | None = None,
 ) -> list[list[ShardQueryResult]] | None:
+    """Compatibility wrapper over :func:`mesh_knn_batch` returning only the
+    per-query per-shard results (the msearch batching path)."""
+    out = mesh_knn_batch(
+        shards, snaps, nodes, fetch_k, alias_filters=alias_filters
+    )
+    return None if out is None else out.per_query
+
+
+def mesh_knn_batch(
+    shards: list,
+    snaps: list,
+    nodes: list,
+    fetch_k: int,
+    alias_filters: list | None = None,
+) -> MeshLaunchOutcome | None:
     """Execute B KnnQuery nodes (same field/k/filter) in ONE device
-    dispatch. Returns, per query, per-shard ShardQueryResults (winning hits
-    attributed to their shards, per-shard matched counts), or None when
-    this path cannot reproduce the host result."""
+    dispatch. Returns a MeshLaunchOutcome (per-query per-shard results,
+    device-merged row order, launch attribution), or None when this path
+    cannot reproduce the host result."""
     if not shards or len(shards) != len(snaps) or not nodes:
         return None
     s = len(shards)
@@ -306,35 +329,20 @@ def try_distributed_knn_batch(
     mesh = _serving_mesh(n_devices)
 
     index_name = shards[0].shard_id.index
-    cache_key = (
-        index_name, first.field, s,
-        # engine instance ids make the key immune to delete+recreate cycles
-        # (generations restart at 0 on a fresh engine)
-        tuple(sh.engine.instance_id for sh in shards),
-        tuple(snap.generation for snap in snaps),
-        tuple(len(snap.segments) for snap in snaps),
-    )
-    with _CACHE_LOCK:
-        bundle = _BUNDLE_CACHE.get(cache_key)
+    # generation-pinned residency key (ShardMeshRegistry.residency_key):
+    # a refresh mid-flight is a different key, so no query is ever merged
+    # against another snapshot's slab
+    cache_key = registry.residency_key(index_name, first.field, shards, snaps)
+    bundle = registry.get(cache_key)
     if bundle is None:
-        # build OUTSIDE the lock: the device upload can take seconds for a
-        # large index and must not stall warm-path queries of other
-        # indexes. A same-key race (two cold misses) wastes one duplicate
-        # upload at worst — the re-check under the lock keeps the cache
-        # itself consistent.
-        bundle = _build_bundle(snaps, first.field, dims, mesh)
-        with _CACHE_LOCK:
-            existing = _BUNDLE_CACHE.get(cache_key)
-            if existing is not None:
-                bundle = existing
-            else:
-                # one live bundle per (index, field): refreshes replace it
-                for key in [k for k in _BUNDLE_CACHE
-                            if k[:2] == cache_key[:2]]:
-                    _BUNDLE_CACHE.pop(key, None)
-                while len(_BUNDLE_CACHE) >= _MAX_BUNDLES:
-                    del _BUNDLE_CACHE[next(iter(_BUNDLE_CACHE))]
-                _BUNDLE_CACHE[cache_key] = bundle
+        # build OUTSIDE the registry lock: the device upload can take
+        # seconds for a large index and must not stall warm-path queries of
+        # other indexes. A same-key race (two cold misses) wastes one
+        # duplicate upload at worst — registry.put keeps the cache itself
+        # consistent and returns the winning bundle.
+        bundle = registry.put(
+            cache_key, _build_bundle(snaps, first.field, dims, mesh)
+        )
 
     valid = bundle.valid
     if has_filter:
@@ -361,6 +369,7 @@ def try_distributed_knn_batch(
                 similarity, b_pad)
     with _CACHE_LOCK:
         program = _PROGRAM_CACHE.get(prog_key)
+        retraced = program is None
         if program is None:
             program = build_knn_serving_step(
                 mesh, k_shard=k_shard, k_final=k_final, similarity=similarity
@@ -368,13 +377,18 @@ def try_distributed_knn_batch(
             _PROGRAM_CACHE[prog_key] = program
 
     queries = jnp.asarray(q_host)
+    t0 = time.perf_counter_ns()
     with mesh:
         vals, gids, counts = program(
             bundle.vectors, bundle.norms_sq, valid, queries
         )
+    # host materialization is the fence for this launch (block_until_ready
+    # does not block on the tunnel backend — same recipe as bench.py)
     vals = np.asarray(vals)[:b]          # [b, k_final]
     gids = np.asarray(gids)[:b]
     counts = np.asarray(counts)[:, :b]   # [s, b]
+    wall_ns = time.perf_counter_ns() - t0
+    launch_id = registry.next_launch_id()
     _count("distributed_searches")
     if has_filter:
         _count("filtered")
@@ -384,17 +398,21 @@ def try_distributed_knn_batch(
         _count("batched_queries", b)
 
     out: list[list[ShardQueryResult]] = []
+    premerged: list[list[tuple[int, ShardHit]]] = []
     for qi, node in enumerate(nodes):
         boost = np.float32(getattr(node, "boost", 1.0))
         per_shard_hits: list[list[ShardHit]] = [[] for _ in range(s)]
+        # device row order IS the final merged order: (-score, shard asc,
+        # segment asc, doc asc) — see build_knn_serving_step's tie-break
+        rows: list[tuple[int, ShardHit]] = []
         for v, g in zip(vals[qi], gids[qi]):
             if not np.isfinite(v):
                 continue
             shard_idx, flat = int(g) // bundle.n_flat, int(g) % bundle.n_flat
             seg_idx, doc = bundle.locate(shard_idx, flat)
-            per_shard_hits[shard_idx].append(
-                ShardHit(float(np.float32(v) * boost), seg_idx, doc)
-            )
+            hit = ShardHit(float(np.float32(v) * boost), seg_idx, doc)
+            per_shard_hits[shard_idx].append(hit)
+            rows.append((shard_idx, hit))
         results = []
         for shard_idx in range(s):
             hits = per_shard_hits[shard_idx]
@@ -404,10 +422,11 @@ def try_distributed_knn_batch(
                 max_score=max((h.score for h in hits), default=None),
             ))
         out.append(results)
-    return out
+        premerged.append(rows)
+    return MeshLaunchOutcome(out, premerged, launch_id, wall_ns, retraced, s)
 
 
 def clear_caches() -> None:
-    _BUNDLE_CACHE.clear()
+    registry.clear()
     _PROGRAM_CACHE.clear()
     _MESH_CACHE.clear()

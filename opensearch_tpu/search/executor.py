@@ -253,7 +253,11 @@ class ShardContext:
                             retraced,
                         )
 
-                    out = batcher_mod.dispatch(key, qv[0], launch_streaming)
+                    # shards=1: this is the per-shard fallback path (the
+                    # shard-mesh launch in service.py passes its mesh
+                    # width); the batcher's cross-shard stats stay honest
+                    out = batcher_mod.dispatch(key, qv[0], launch_streaming,
+                                               shards=1)
                     vals, ids = out.value
                     if prof is not None:
                         # a batched operator owns its SHARE of the fenced
@@ -286,7 +290,8 @@ class ShardContext:
                             [b_scores[i] for i in range(len(rows))], retraced,
                         )
 
-                    out = batcher_mod.dispatch(key, qv[0], launch_exact)
+                    out = batcher_mod.dispatch(key, qv[0], launch_exact,
+                                               shards=1)
                     scores = out.value
                     if prof is not None:
                         prof.record_kernel(

@@ -152,6 +152,10 @@ class ShardProfiler:
         self.collect_ns = 0
         # agg name -> {"time_ns": int, "collect_count": int}
         self.agg_times: dict[str, int] = {}
+        # sharded-launch records (record_sharded_launch): one entry per
+        # device launch this shard participated in; every shard covered by
+        # the same launch carries the same launch_id
+        self.launches: list[dict] = []
 
     # -- operator tree ------------------------------------------------------
 
@@ -184,6 +188,25 @@ class ShardProfiler:
     def record_agg(self, name: str, time_ns: int) -> None:
         self.agg_times[name] = self.agg_times.get(name, 0) + time_ns
 
+    def record_sharded_launch(self, type_: str, description: str, *,
+                              name: str, launch_id: int, shards: int,
+                              wall_ns: int, transfer_bytes: int,
+                              retraced: bool) -> None:
+        """Attribute this shard's share of ONE sharded device launch (the
+        shard-mesh kNN program covers S shards in a single `shard_map`
+        dispatch). The fenced launch wall splits evenly across the shards
+        it served; the shared `launch_id` is how a reader of the per-shard
+        profile entries proves they came from one launch, not S."""
+        op = self._stack[-1].child(type_, description)
+        op.calls += 1
+        share = wall_ns // max(shards, 1)
+        op.time_ns += share
+        op.record_kernel(name, share, transfer_bytes, retraced)
+        self.launches.append({
+            "name": name, "launch_id": launch_id, "shards": shards,
+            "wall_ns": wall_ns, "share_ns": share, "retraced": retraced,
+        })
+
     # -- rollups ------------------------------------------------------------
 
     @property
@@ -210,11 +233,14 @@ class ShardProfiler:
 
     def tpu_summary(self) -> dict:
         device, transfer, retraced = self._totals()
-        return {
+        out = {
             "device_time_in_nanos": device,
             "transfer_bytes": transfer,
             "jit_retrace": retraced,
         }
+        if self.launches:
+            out["launches"] = list(self.launches)
+        return out
 
 
 def describe_node(node: Any) -> str:

@@ -22,6 +22,7 @@ numbers.
 
 from __future__ import annotations
 
+import heapq
 import math
 from typing import Any
 
@@ -96,12 +97,19 @@ def reduce_hits(
     """Merge per-node hit lists. Each partial is a full search response
     whose hits carry `_tb` = [shard, segment, doc]. With `collapse_field`,
     per-node collapsed hits are re-collapsed across nodes (first-per-group
-    survives both levels)."""
+    survives both levels).
+
+    Partials flagged `_premerged` (the shard-mesh launch already produced
+    them in the canonical (-score, _tb) order — search/service.py) are
+    k-way STREAM-merged with a heap instead of globally re-sorted: the
+    launch did the per-node merge on device, so the coordinator only
+    interleaves S sorted streams."""
     from opensearch_tpu.search.service import _values_key
 
-    rows: list[tuple[Any, dict]] = []
+    streams: list[list[tuple[Any, dict]]] = []
     total = 0
     max_score = None
+    all_premerged = bool(partials) and not sort
     for p in partials:
         h = p.get("hits") or {}
         t = h.get("total")
@@ -110,6 +118,9 @@ def reduce_hits(
         ms = h.get("max_score")
         if ms is not None and (max_score is None or ms > max_score):
             max_score = ms
+        if not p.get("_premerged"):
+            all_premerged = False
+        stream: list[tuple[Any, dict]] = []
         for hit in h.get("hits") or []:
             tb = tuple(hit.get("_tb") or [0, 0, 0])
             if sort:
@@ -117,8 +128,13 @@ def reduce_hits(
             else:
                 score = hit.get("_score") or 0.0
                 key = (-score, *tb)
-            rows.append((key, hit))
-    rows.sort(key=lambda r: r[0])
+            stream.append((key, hit))
+        streams.append(stream)
+    if all_premerged:
+        rows = list(heapq.merge(*streams, key=lambda r: r[0]))
+    else:
+        rows = [r for stream in streams for r in stream]
+        rows.sort(key=lambda r: r[0])
     if collapse_field is not None:
         seen: set = set()
         deduped = []

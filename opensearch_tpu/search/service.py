@@ -163,6 +163,11 @@ def search(
     shard_profilers: list = []
     skipped_shards = 0
 
+    # set when the shard-mesh device path ran: the flat device-merged rows
+    # (so the host re-sort below can be skipped) and launch attribution
+    mesh_premerged: list | None = None
+    mesh_launch: dict | None = None
+
     fetch_k = from_ + size
     if body.get("rescore") is not None:
         # the query phase must collect the full rescore window
@@ -226,12 +231,44 @@ def search(
         # straight to reduce/fetch
         per_shard_results = precomputed_results
         if per_shard_results is None:
-            per_shard_results = _try_distributed_query_phase(
+            mesh_out = _try_distributed_query_phase(
                 shards, acquired, node,
                 sort=sort, search_after=search_after, aggs_body=aggs_body,
                 min_score=min_score, filter_nodes=filter_nodes,
                 want_profile=want_profile, fetch_k=fetch_k, task=task,
             )
+            if mesh_out is not None:
+                per_shard_results, mesh_premerged, mesh_launch = mesh_out
+                if want_profile:
+                    # per-shard attribution of the ONE sharded launch: each
+                    # shard profiler carries its share of the fenced wall
+                    # and the shared launch_id (profile.py)
+                    desc = search_profile.describe_node(node)
+                    qbytes = 4 * len(getattr(node, "vector", ()))
+                    # a coalesced launch served `merged` queries: this
+                    # query's share of the fenced wall is wall/merged (the
+                    # executor path applies the same split via
+                    # BatchOutcome.kernel_share_ns) — attributing the full
+                    # wall to every member would read as merged x the real
+                    # device time
+                    query_wall_ns = (mesh_launch["wall_ns"]
+                                     // max(mesh_launch.get("merged", 1), 1))
+                    for _ in per_shard_results:
+                        prof = search_profile.ShardProfiler()
+                        prof.record_sharded_launch(
+                            type(node).__name__, desc,
+                            name="shard_mesh_knn",
+                            launch_id=mesh_launch["launch_id"],
+                            shards=mesh_launch["shards"],
+                            wall_ns=query_wall_ns,
+                            transfer_bytes=qbytes,
+                            retraced=mesh_launch["retraced"],
+                        )
+                        shard_profilers.append(prof)
+                        shard_query_ns.append(
+                            query_wall_ns
+                            // max(mesh_launch["shards"], 1)
+                        )
         if per_shard_results is None:
             per_shard_results = []
             for shard_i, shard in enumerate(shards):
@@ -328,8 +365,19 @@ def search(
                 while len(h.sort_values) <= i:
                     h.sort_values.append(None)
                 h.sort_values[i] = packed
+    used_premerged = False
     if not sort:
-        merged.sort(key=lambda sh: (-sh[1].score, sh[0], sh[1].segment, sh[1].doc))
+        if mesh_premerged is not None and not index_boosts:
+            # the device launch already merged: its row order is exactly
+            # (-score, shard asc, segment asc, doc asc) — the host re-sort
+            # is redundant work (search/reduce.py applies the same skip at
+            # the cross-node layer via the _premerged flag)
+            merged = mesh_premerged
+            used_premerged = True
+        else:
+            merged.sort(
+                key=lambda sh: (-sh[1].score, sh[0], sh[1].segment, sh[1].doc)
+            )
     else:
         key_fn = _sort_key_fn(sort)
         merged.sort(key=lambda sh: key_fn(sh[1]))
@@ -350,6 +398,10 @@ def search(
     if body.get("rescore") is not None or body.get("collapse") is not None:
         from opensearch_tpu.search import phases
 
+        # these phases re-rank/regroup AFTER the device merge: the page no
+        # longer follows the canonical (-score, _tb) order, so the
+        # coordinator must re-sort (never stream-merge) these partials
+        used_premerged = False
         if body.get("rescore") is not None:
             if sort:
                 raise ParsingException(
@@ -689,6 +741,11 @@ def search(
                 else shard.shard_id.shard): snap.generation
             for i, (shard, snap, _r) in enumerate(per_shard_results)
         }
+        if used_premerged:
+            # the hits page came straight out of the device merge, already
+            # in the canonical (-score, _tb) order: the coordinator's
+            # reduce can k-way stream-merge instead of re-sorting
+            response["_premerged"] = True
 
     if want_profile:
         # per-shard deep profile (search/profile.ShardProfiler): the
@@ -958,17 +1015,21 @@ def _try_distributed_query_phase(
     want_profile: bool,
     fetch_k: int,
     task=None,
-) -> list | None:
+) -> tuple[list, list, dict] | None:
     """Route eligible knn queries (multi- OR single-shard, filtered or
     not) through the on-device all_gather + top_k merge
-    (parallel/distributed.build_knn_serving_step). Returns the per-shard
-    results list shaped exactly like the host path's, or None when the
-    host merge must run (every other query shape)."""
+    (parallel/distributed.build_knn_serving_step). Returns
+    (per_shard_results, premerged_rows, launch_info): the per-shard
+    results list shaped exactly like the host path's, the same winning
+    hits flat in the device merge order, and the launch attribution
+    (launch_id / wall_ns / retraced / shards / merged) for per-shard
+    profiling. None when the host merge must run (every other query
+    shape, or a non-resident shard set the mesh cannot serve — the
+    caller's per-shard loop is the fallback)."""
     if not isinstance(node, query_dsl.KnnQuery):
         return None
     if (not shards or sort or search_after is not None
-            or aggs_body is not None or min_score is not None
-            or want_profile):
+            or aggs_body is not None or min_score is not None):
         return None
     from opensearch_tpu.search import distributed_serving
 
@@ -999,27 +1060,43 @@ def _try_distributed_query_phase(
         )
 
     if key is None:
-        results = distributed_serving.try_distributed_knn(
-            shards, snaps, node, fetch_k, alias_filters=filter_nodes
+        out = distributed_serving.mesh_knn_batch(
+            shards, snaps, [node], fetch_k, alias_filters=filter_nodes
         )
+        if out is None:
+            return None
+        results, premerged = out.per_query[0], out.premerged[0]
+        launch_info = {"launch_id": out.launch_id, "wall_ns": out.wall_ns,
+                       "retraced": out.retraced, "shards": out.shards,
+                       "merged": 1}
     else:
         from opensearch_tpu.search import batcher as batcher_mod
 
         def launch(nodes_batch):
-            batched = distributed_serving.try_distributed_knn_batch(
+            out_b = distributed_serving.mesh_knn_batch(
                 shards, snaps, list(nodes_batch), fetch_k
             )
-            if batched is None:  # ineligible: every member falls back
+            if out_b is None:  # ineligible: every member falls back
                 return [None] * len(nodes_batch), False
-            return batched, False
+            info = {"launch_id": out_b.launch_id, "wall_ns": out_b.wall_ns,
+                    "retraced": out_b.retraced, "shards": out_b.shards}
+            return [
+                (out_b.per_query[i], out_b.premerged[i], info)
+                for i in range(len(nodes_batch))
+            ], out_b.retraced
 
-        results = batcher_mod.dispatch(key, node, launch).value
-    if results is None:
-        return None
-    return [
-        (shard, snap, res)
-        for shard, snap, res in zip(shards, snaps, results)
-    ]
+        outcome = batcher_mod.dispatch(key, node, launch,
+                                       shards=len(shards))
+        if outcome.value is None:
+            return None
+        results, premerged, launch_info = outcome.value
+        launch_info = dict(launch_info, merged=outcome.merged)
+    return (
+        [(shard, snap, res)
+         for shard, snap, res in zip(shards, snaps, results)],
+        premerged,
+        launch_info,
+    )
 
 
 _BATCHABLE_KNN_KEYS = {

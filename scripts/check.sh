@@ -3,12 +3,14 @@
 #
 #   scripts/check.sh            # lint gate + lint/transport/cluster tests
 #   scripts/check.sh --lint     # lint gate only (pre-commit speed)
-#   scripts/check.sh --bench    # + the bench-regression gate: a quick
+#   scripts/check.sh --bench    # + the bench-regression gates: a quick
 #                               # bench.py --gate run must stay within a
 #                               # CPU/TPU-aware tolerance of the same
-#                               # platform's BENCH_CACHE.json entry, so a
-#                               # PR that slows the hot path fails HERE,
-#                               # not in the next round's headline number
+#                               # platform's BENCH_CACHE.json entry, and
+#                               # bench.py --mesh-gate holds the shard-mesh
+#                               # cluster bench to BENCH_MESH.json the same
+#                               # way, so a PR that slows a hot path fails
+#                               # HERE, not in the next round's headline
 #
 # The lint gate runs three ways on purpose:
 #   1. repo-wide lint vs the (EMPTY) baseline ratchet (json report),
@@ -43,4 +45,6 @@ JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
 if [[ "${1:-}" == "--bench" ]]; then
   echo "== bench-regression gate (quick run vs BENCH_CACHE.json) =="
   python bench.py --gate
+  echo "== shard-mesh gate (quick cluster run vs BENCH_MESH.json) =="
+  python bench.py --mesh-gate
 fi

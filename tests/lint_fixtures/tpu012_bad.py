@@ -1,0 +1,27 @@
+"""TPU012 true positives: paths that abandon a begin_span'd span —
+no end_span, no handoff — so the tracing ring holds it open forever."""
+
+
+def early_return_drops_span(tracer, req):
+    span = tracer.begin_span("op", {"id": req.id})
+    if not req.valid:
+        return None  # EXPECT: TPU012
+    result = req.run()
+    span.set_attribute("ok", True)
+    tracer.end_span(span)
+    return result
+
+
+def forgets_to_end(tracer, req):
+    span = tracer.begin_span("op", {"id": req.id})
+    span.set_attribute("phase", "run")
+    return req.run()  # EXPECT: TPU012
+
+
+def one_branch_leaks(tracer, req):
+    span = tracer.begin_span("op")
+    if req.fast_path:
+        out = req.quick()
+        tracer.end_span(span)
+        return out
+    return req.slow()  # EXPECT: TPU012

@@ -1,0 +1,419 @@
+"""The on-device shard mesh data plane (ISSUE 7): one sharded launch per
+node with an on-device top-k reduce.
+
+Covers the tentpole contracts:
+ - the device merge order IS the host merge order, bit-for-bit (scores and
+   doc ids), across 1/2/4 shards — the property that lets service.py skip
+   its host re-sort and reduce.py stream-merge pre-merged partials;
+ - refresh generation isolation: a refresh mid-stream is a different
+   residency key, never a merge across snapshots;
+ - cluster mode: a multi-shard kNN search fans out ONE search[node] RPC
+   per node (one shard_map launch each), reduces to the same results as
+   the legacy per-shard scatter, and degrades to per-shard execution when
+   a shard's copy is missing (`_shards.failed` when no copy remains);
+ - profiler: one launch record (shared launch_id) across every shard of a
+   node, `retraced: false` at steady state.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.cluster.shard_mesh import ShardMeshRegistry
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.search import distributed_serving, query_dsl
+
+
+@pytest.fixture(autouse=True)
+def _clear():
+    distributed_serving.clear_caches()
+    distributed_serving.registry.reset_stats()
+    for key in distributed_serving.stats:
+        distributed_serving.stats[key] = 0
+    distributed_serving.enabled = True
+    yield
+    distributed_serving.enabled = True
+
+
+DIMS = 8
+
+
+def _mk_node(tmp_path, n_shards=4, n_docs=64, seed=0):
+    node = TpuNode(tmp_path / "data")
+    node.create_index("vecs", {
+        "settings": {"number_of_shards": n_shards},
+        "mappings": {"properties": {
+            "v": {"type": "knn_vector", "dimension": DIMS,
+                  "space_type": "l2"},
+        }},
+    })
+    rng = np.random.default_rng(seed)
+    node.bulk([
+        ("index", {"_index": "vecs", "_id": f"d{i}"},
+         {"v": rng.standard_normal(DIMS).round(3).tolist()})
+        for i in range(n_docs)
+    ], refresh=True)
+    return node
+
+
+def _knn_body(vector, k=5, size=10):
+    return {"query": {"knn": {"v": {"vector": vector, "k": k}}},
+            "size": size}
+
+
+# -- device merge == host merge, bit for bit --------------------------------
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4])
+def test_device_merge_order_is_host_merge_order(tmp_path, n_shards):
+    """The premerged rows a launch returns must equal a host-side re-sort
+    of the SAME launch's per-shard results — scores and ids bit-identical,
+    order included. This is the invariant the host-merge skip
+    (service.py `used_premerged`) and the reduce-side stream merge rest
+    on."""
+    node = _mk_node(tmp_path, n_shards=n_shards)
+    svc = node.indices["vecs"]
+    shards = [svc.shards[i] for i in sorted(svc.shards)]
+    snaps = [s.acquire_searcher() for s in shards]
+    rng = np.random.default_rng(1)
+    for _ in range(3):
+        qnode = query_dsl.parse_query(
+            {"knn": {"v": {"vector": rng.standard_normal(DIMS).tolist(),
+                           "k": 5}}})
+        out = distributed_serving.mesh_knn_batch(shards, snaps, [qnode], 10)
+        assert out is not None
+        assert out.shards == n_shards
+        premerged = out.premerged[0]
+        assert premerged, "launch returned no winners"
+        # host merge of the same per-shard results
+        rows = [
+            (shard_idx, h)
+            for shard_idx, res in enumerate(out.per_query[0])
+            for h in res.hits
+        ]
+        rows.sort(key=lambda sh: (-sh[1].score, sh[0], sh[1].segment,
+                                  sh[1].doc))
+        assert [(si, h.score, h.segment, h.doc) for si, h in premerged] == \
+            [(si, h.score, h.segment, h.doc) for si, h in rows]
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4])
+def test_mesh_topk_matches_host_path(tmp_path, n_shards):
+    """End to end: the mesh launch returns the same top-k ids in the same
+    order as the per-shard host path, at f32-ULP-equal scores."""
+    node = _mk_node(tmp_path, n_shards=n_shards, seed=n_shards)
+    rng = np.random.default_rng(7)
+    for _ in range(3):
+        body = _knn_body(rng.standard_normal(DIMS).round(3).tolist())
+        before = distributed_serving.stats["distributed_searches"]
+        mesh = node.search("vecs", body)
+        assert distributed_serving.stats["distributed_searches"] == before + 1
+        distributed_serving.enabled = False
+        host = node.search("vecs", body)
+        distributed_serving.enabled = True
+        assert [h["_id"] for h in mesh["hits"]["hits"]] == \
+            [h["_id"] for h in host["hits"]["hits"]]
+        m = np.asarray([h["_score"] for h in mesh["hits"]["hits"]],
+                       np.float32)
+        h_ = np.asarray([h["_score"] for h in host["hits"]["hits"]],
+                        np.float32)
+        # identical modulo the last f32 ulp (different XLA contraction
+        # shapes); the selection and ordering must agree exactly
+        assert np.all(np.abs(m - h_) <= 4 * np.spacing(np.maximum(m, h_))), \
+            (m.tolist(), h_.tolist())
+
+
+# -- refresh generation isolation --------------------------------------------
+
+
+def test_refresh_generation_isolation(tmp_path):
+    """A refresh never merges across snapshots: the old snapshot's
+    residency key keeps serving the old view, the new snapshot gets its
+    own bundle under a new key."""
+    node = _mk_node(tmp_path, n_shards=2, n_docs=20)
+    svc = node.indices["vecs"]
+    shards = [svc.shards[i] for i in sorted(svc.shards)]
+    old_snaps = [s.acquire_searcher() for s in shards]
+    old_key = ShardMeshRegistry.residency_key("vecs", "v", shards, old_snaps)
+
+    # a doc engineered to win any query outright
+    node.index_doc("vecs", "winner", {"v": [0.0] * DIMS})
+    node.refresh("vecs")
+    new_snaps = [s.acquire_searcher() for s in shards]
+    new_key = ShardMeshRegistry.residency_key("vecs", "v", shards, new_snaps)
+    assert old_key != new_key
+
+    qnode = query_dsl.parse_query(
+        {"knn": {"v": {"vector": [0.0] * DIMS, "k": 3}}})
+    old_out = distributed_serving.mesh_knn_batch(shards, old_snaps, [qnode], 5)
+    new_out = distributed_serving.mesh_knn_batch(shards, new_snaps, [qnode], 5)
+    assert old_out is not None and new_out is not None
+
+    def ids(out, snaps):
+        found = []
+        for shard_idx, res in enumerate(out.per_query[0]):
+            for h in res.hits:
+                host = snaps[shard_idx].segments[h.segment][0]
+                found.append(host.doc_ids[h.doc])
+        return found
+
+    assert "winner" not in ids(old_out, old_snaps)
+    assert "winner" in ids(new_out, new_snaps)
+    # two generations resident => two builds, and the new insert evicted
+    # the superseded generation of the same (index, field) slot
+    stats = distributed_serving.registry.snapshot_stats()
+    assert stats["builds"] == 2
+    assert stats["evictions"] >= 1
+
+
+def test_registry_residency_hits_and_stats(tmp_path):
+    node = _mk_node(tmp_path, n_shards=2, n_docs=16)
+    body = _knn_body([0.1] * DIMS, k=3, size=3)
+    node.search("vecs", body)
+    node.search("vecs", body)
+    stats = distributed_serving.registry.snapshot_stats()
+    assert stats["builds"] == 1          # one cold upload
+    assert stats["hits"] >= 1            # second search reused the slab
+    assert stats["launches"] >= 2
+    assert stats["resident_bundles"] == 1
+    resident = distributed_serving.registry.resident()
+    assert resident[0]["index"] == "vecs" and resident[0]["shards"] == 2
+
+
+# -- profiler: one launch record per node ------------------------------------
+
+
+def test_profile_reports_one_launch_record(tmp_path):
+    node = _mk_node(tmp_path, n_shards=4)
+    body = _knn_body([0.2] * DIMS)
+    node.search("vecs", body)  # warm: compile + upload
+    resp = node.search("vecs", {**body, "profile": True})
+    shards_prof = resp["profile"]["shards"]
+    assert len(shards_prof) == 4
+    launch_ids = set()
+    for sp in shards_prof:
+        launches = sp["tpu"]["launches"]
+        assert len(launches) == 1, "each shard reports exactly one launch"
+        rec = launches[0]
+        assert rec["name"] == "shard_mesh_knn"
+        assert rec["shards"] == 4
+        assert rec["retraced"] is False, "steady state must not retrace"
+        launch_ids.add(rec["launch_id"])
+        # the operator tree carries the attributed kernel share
+        (entry,) = sp["searches"][0]["query"]
+        assert entry["type"] == "KnnQuery"
+        assert entry["kernels"][0]["name"] == "shard_mesh_knn"
+    assert len(launch_ids) == 1, "all shards came from ONE sharded launch"
+
+
+# -- reduce: pre-merged partials stream-merge --------------------------------
+
+
+def test_reduce_hits_premerged_stream_merge_equals_sort():
+    from opensearch_tpu.search.reduce import reduce_hits
+
+    def partial(hits, premerged):
+        p = {
+            "hits": {
+                "total": {"value": len(hits), "relation": "eq"},
+                "max_score": max((h["_score"] for h in hits), default=None),
+                "hits": hits,
+            },
+        }
+        if premerged:
+            p["_premerged"] = True
+        return p
+
+    h1 = [{"_id": "a", "_score": 0.9, "_tb": [0, 0, 1]},
+          {"_id": "b", "_score": 0.5, "_tb": [0, 0, 7]}]
+    h2 = [{"_id": "c", "_score": 0.7, "_tb": [1, 0, 2]},
+          {"_id": "d", "_score": 0.5, "_tb": [1, 0, 0]}]
+    merged_fast = reduce_hits(
+        [partial(h1, True), partial(h2, True)],
+        size=10, from_=0, sort=None, track_total=True)
+    merged_slow = reduce_hits(
+        [partial(h1, False), partial(h2, False)],
+        size=10, from_=0, sort=None, track_total=True)
+    assert merged_fast == merged_slow
+    assert [h["_id"] for h in merged_fast["hits"]] == ["a", "c", "b", "d"]
+
+
+def test_cluster_partials_carry_premerged_flag(tmp_path):
+    """service.search(partial=True) flags device-merged partials so the
+    coordinator reduce can stream-merge."""
+    from opensearch_tpu.search import service as search_service
+
+    node = _mk_node(tmp_path, n_shards=2, n_docs=16)
+    svc = node.indices["vecs"]
+    shards = [svc.shards[i] for i in sorted(svc.shards)]
+    resp = search_service.search(
+        shards, _knn_body([0.1] * DIMS, k=3, size=3),
+        partial=True, shard_numbers=[0, 1])
+    assert resp.get("_premerged") is True
+    distributed_serving.enabled = False
+    resp2 = search_service.search(
+        shards, _knn_body([0.1] * DIMS, k=3, size=3),
+        partial=True, shard_numbers=[0, 1])
+    assert "_premerged" not in resp2
+
+
+def test_rescored_partials_are_not_premerged(tmp_path):
+    """rescore re-ranks AFTER the device merge (window hits re-scored, the
+    tail keeps raw scores — the combined page can be non-monotonic): the
+    partial must NOT invite the coordinator's stream-merge."""
+    from opensearch_tpu.search import service as search_service
+
+    node = _mk_node(tmp_path, n_shards=2, n_docs=16)
+    svc = node.indices["vecs"]
+    shards = [svc.shards[i] for i in sorted(svc.shards)]
+    body = {
+        **_knn_body([0.1] * DIMS, k=8, size=8),
+        "rescore": {"window_size": 3, "query": {
+            "rescore_query": {"match_all": {}},
+            "score_mode": "multiply",
+            "rescore_query_weight": 0.01,
+        }},
+    }
+    before = distributed_serving.stats["distributed_searches"]
+    resp = search_service.search(shards, body, partial=True,
+                                 shard_numbers=[0, 1])
+    # the knn query phase itself still rides the mesh launch...
+    assert distributed_serving.stats["distributed_searches"] == before + 1
+    # ...but the rescored page no longer follows (-score, _tb) order, so
+    # it must not claim pre-merged order to the coordinator
+    assert "_premerged" not in resp
+
+
+# -- batcher: cross-shard launch accounting ----------------------------------
+
+
+def test_batcher_counts_cross_shard_launches(tmp_path):
+    node = _mk_node(tmp_path, n_shards=4)
+    node.knn_batcher.reset()
+    node.search("vecs", _knn_body([0.3] * DIMS))
+    stats = node.knn_batcher.snapshot_stats()
+    assert stats["cross_shard_launches"] >= 1
+    assert stats["cross_shard_queries"] >= 1
+
+
+# -- cluster mode: one launch per node + degrade -----------------------------
+
+
+def _mk_sim(tmp_path, n_shards=4, replicas=1, n_docs=40):
+    from tests.test_cluster_data import DataSim
+
+    sim = DataSim(3, seed=42, tmp_path=tmp_path)
+    sim.run(5_000)
+    sim.call(sim.nodes["n0"].create_index, "vecs",
+             {"settings": {"index": {"number_of_shards": n_shards,
+                                     "number_of_replicas": replicas}},
+              "mappings": {"properties": {
+                  "v": {"type": "knn_vector", "dimension": DIMS}}}})
+    sim.run(5_000)
+    rng = np.random.default_rng(3)
+    for i in range(n_docs):
+        sim.call(sim.nodes["n0"].index_doc, "vecs", f"d{i}",
+                 {"v": rng.standard_normal(DIMS).round(3).tolist()})
+    sim.run(2_000)
+    sim.call(sim.nodes["n0"].refresh, "vecs")
+    sim.run(2_000)
+    return sim
+
+
+def test_cluster_knn_is_one_launch_per_node(tmp_path):
+    sim = _mk_sim(tmp_path)
+    try:
+        body = _knn_body([0.2] * DIMS, k=5, size=10)
+        # nodes holding >= 1 target shard (primaries preferred)
+        state = sim.leader().applied_state
+        primary_nodes = {
+            r.node_id for r in state.shards_for_index("vecs") if r.primary
+        }
+        before = distributed_serving.stats["distributed_searches"]
+        resp = sim.call(sim.nodes["n1"].search, "vecs", body)
+        launches = distributed_serving.stats["distributed_searches"] - before
+        assert launches == len(primary_nodes), \
+            "one sharded launch per node, not per shard"
+        assert resp["_shards"] == {"total": 4, "successful": 4,
+                                   "skipped": 0, "failed": 0}
+
+        # identical results to the legacy per-shard scatter path (forced
+        # by an ineligible body key)
+        legacy = sim.call(sim.nodes["n1"].search, "vecs",
+                          dict(body, min_score=0.0))
+        assert [h["_id"] for h in resp["hits"]["hits"]] == \
+            [h["_id"] for h in legacy["hits"]["hits"]]
+    finally:
+        for n in sim.nodes.values():
+            n.close()
+
+
+def test_cluster_missing_copy_degrades_to_per_shard(tmp_path):
+    """One shard's copy missing on its serving node: the mesh path
+    degrades that shard to per-shard execution against the replica copy —
+    full results, nothing failed."""
+    sim = _mk_sim(tmp_path, n_shards=2, replicas=1)
+    try:
+        state = sim.leader().applied_state
+        primary0 = next(r for r in state.shards_for_index("vecs")
+                        if r.shard == 0 and r.primary)
+        victim = sim.nodes[primary0.node_id]
+        dropped = victim.local_shards.pop(("vecs", 0))
+        try:
+            resp = sim.call(sim.nodes["n1"].search, "vecs",
+                            _knn_body([0.2] * DIMS, k=40, size=40))
+            assert resp["_shards"]["total"] == 2
+            assert resp["_shards"]["failed"] == 0, \
+                "replica copy must recover the missing shard"
+            assert len(resp["hits"]["hits"]) == 40
+        finally:
+            victim.local_shards[("vecs", 0)] = dropped
+    finally:
+        for n in sim.nodes.values():
+            n.close()
+
+
+def test_cluster_lost_copy_counts_shard_failed(tmp_path):
+    """No other copy exists (0 replicas): the shard counts into
+    _shards.failed and the present shards still answer."""
+    sim = _mk_sim(tmp_path, n_shards=2, replicas=0)
+    try:
+        state = sim.leader().applied_state
+        primary0 = next(r for r in state.shards_for_index("vecs")
+                        if r.shard == 0 and r.primary)
+        victim = sim.nodes[primary0.node_id]
+        dropped = victim.local_shards.pop(("vecs", 0))
+        try:
+            resp = sim.call(sim.nodes["n1"].search, "vecs",
+                            _knn_body([0.2] * DIMS, k=40, size=40))
+            assert resp["_shards"]["failed"] == 1
+            assert resp["_shards"]["total"] == 2
+            assert 0 < len(resp["hits"]["hits"]) < 40, \
+                "the present shard answers; the lost one is reported"
+        finally:
+            victim.local_shards[("vecs", 0)] = dropped
+    finally:
+        for n in sim.nodes.values():
+            n.close()
+
+
+def test_cluster_node_stats_surface_mesh_registry(tmp_path):
+    sim = _mk_sim(tmp_path, n_shards=2, replicas=0, n_docs=12)
+    try:
+        sim.call(sim.nodes["n1"].search, "vecs", _knn_body([0.1] * DIMS))
+        out = []
+        sim.nodes["n0"].transport.send(
+            "n0", "n0", "indices:monitor/stats[node]", {},
+            on_response=out.append, on_failure=lambda e: out.append(e))
+        for _ in range(200):
+            if out:
+                break
+            sim.queue.run_one()
+        assert isinstance(out[0], dict)
+        mesh_stats = out[0]["shard_mesh"]
+        assert mesh_stats["launches"] >= 1
+        assert mesh_stats["builds"] >= 1
+    finally:
+        for n in sim.nodes.values():
+            n.close()

@@ -21,6 +21,26 @@ from opensearch_tpu.testing.soak import (
 SUBSET = dict(cycles=2, ops_per_cycle=18)
 
 
+def test_soak_mesh_seed_exercises_sharded_launch(tmp_path):
+    """Mesh-enabled seed (ISSUE 7 satellite): the chaos harness's kNN
+    workload must route through the shard-mesh device path — one sharded
+    launch per node via search[node] — under kill/partition faults, with
+    every existing invariant holding at each quiesce."""
+    from opensearch_tpu.search import distributed_serving
+
+    distributed_serving.clear_caches()
+    distributed_serving.registry.reset_stats()
+    before = distributed_serving.stats["distributed_searches"]
+    report = run_soak(17, tmp_path, **SUBSET)
+    assert report.cycles_completed == 2
+    assert report.ops_completed == report.ops_issued
+    assert report.faults_injected, "chaos cycles must inject faults"
+    launches = distributed_serving.stats["distributed_searches"] - before
+    assert launches > 0, "soak kNN searches never hit the mesh launch path"
+    mesh_stats = distributed_serving.registry.snapshot_stats()
+    assert mesh_stats["launches"] >= launches
+
+
 def test_soak_deterministic_subset_green(tmp_path):
     """The tier-1 soak: 2 chaos cycles of mixed ingest + query + faults,
     every default invariant passing at each quiesce."""
