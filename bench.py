@@ -641,6 +641,164 @@ def mesh_child() -> None:
     }))
 
 
+OTEL_OUT = Path(__file__).resolve().parent / "BENCH_OTEL.json"
+OTEL_BUDGET_S = int(os.environ.get("BENCH_OTEL_BUDGET_S", "600"))
+# observability must be near-free: the gate fails if turning the span
+# exporter ON (file sink, sample-everything worst case) costs more than
+# this fraction of streaming kNN QPS
+OTEL_TOLERANCE = float(os.environ.get("BENCH_OTEL_TOLERANCE", "0.05"))
+
+
+def otel_parent() -> int:
+    """`bench.py --otel-overhead`: streaming kNN QPS with the span
+    exporter OFF vs ON (file sink, sample_ratio 1.0 — every trace
+    exported, the worst case), in a watchdogged child. Writes
+    BENCH_OTEL.json next to BENCH_CACHE and exits 1 when the overhead
+    exceeds OTEL_TOLERANCE (default 5%, env BENCH_OTEL_TOLERANCE) — wired
+    into scripts/check.sh --bench so an expensive exporter change fails
+    the gate, not the next perf round."""
+    result, reason = _run(["--otel-child"], OTEL_BUDGET_S)
+    if result is None:
+        print(json.dumps({
+            "metric": "otel_overhead", "value": 0, "unit": "error",
+            "vs_baseline": 0, "detail": f"otel child failed: {reason}",
+            "ok": False,
+        }))
+        return 1
+    overhead = float(result.get("overhead_pct", 100.0))
+    ok = overhead <= OTEL_TOLERANCE * 100.0
+    result["ok"] = ok
+    result["tolerance_pct"] = OTEL_TOLERANCE * 100.0
+    if not ok:
+        result["detail"] = (
+            f"span export costs {overhead:.1f}% QPS "
+            f"(> {OTEL_TOLERANCE:.0%} budget)")
+    try:
+        OTEL_OUT.write_text(json.dumps(result, indent=1) + "\n")
+    except OSError as e:
+        result["write_error"] = str(e)
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+def otel_child() -> None:
+    """One node, concurrent kNN clients, exporter off vs on. Configs run
+    in ALTERNATING repeats (off, on, off, on, ...) and report per-config
+    medians, so a co-tenant CPU burst hits both sides instead of poisoning
+    one — the 5%-budget comparison needs that symmetry."""
+    import tempfile
+    import threading
+
+    _pin_platform()
+    import numpy as np
+
+    import jax
+
+    from opensearch_tpu.node import TpuNode
+    from opensearch_tpu.search import executor
+    from opensearch_tpu.telemetry.export import apply_tracing_settings
+
+    platform = jax.devices()[0].platform
+    d = 64
+    n_docs = 20_000 if platform != "cpu" else 3_000
+    clients = int(os.environ.get("BENCH_OTEL_CLIENTS", "8"))
+    per_client = int(os.environ.get("BENCH_OTEL_QUERIES", "40"))
+    reps = int(os.environ.get("BENCH_OTEL_REPS", "5"))
+    executor.STREAMING_MIN_DOCS = min(executor.STREAMING_MIN_DOCS, 1_024)
+
+    rng = np.random.default_rng(17)
+    tmp = Path(tempfile.mkdtemp(prefix="bench_otel_"))
+    node = TpuNode(tmp / "node")
+    node.create_index("bench", {
+        "settings": {"number_of_shards": 1},
+        "mappings": {"properties": {
+            "v": {"type": "knn_vector", "dimension": d, "space_type": "l2"},
+        }},
+    })
+    node.bulk([
+        ("index", {"_index": "bench", "_id": str(i)},
+         {"v": rng.standard_normal(d).astype(np.float32).tolist()})
+        for i in range(n_docs)
+    ], refresh=True)
+    queries = [
+        rng.standard_normal(d).astype(np.float32).tolist()
+        for _ in range(clients * per_client)
+    ]
+
+    exported_total = 0
+
+    def harvest_exported() -> None:
+        # each off-toggle DISCARDS the exporter (mode none detaches and
+        # closes), so the ledger must be banked before every rebuild —
+        # flush first so queued spans count
+        nonlocal exported_total
+        exporter = node.telemetry.tracer.exporter
+        if exporter is not None:
+            exporter.flush()
+            exported_total += exporter.snapshot_stats().get(
+                "spans_exported", 0)
+
+    def set_exporter(enabled: bool) -> None:
+        harvest_exported()
+        flat = ({"telemetry.tracing.exporter": "file",
+                 "telemetry.tracing.sample_ratio": 1.0,
+                 "telemetry.tracing.slow_threshold_ms": 0}
+                if enabled else {})
+        apply_tracing_settings(node.telemetry, flat, tmp / "node")
+
+    def one_round() -> float:
+        lat_done = [0] * clients
+        barrier = threading.Barrier(clients + 1)
+
+        def client(ci: int) -> None:
+            mine = queries[ci * per_client:(ci + 1) * per_client]
+            barrier.wait()
+            for q in mine:
+                node.search("bench", {"size": 10, "query": {
+                    "knn": {"v": {"vector": q, "k": 10}}}})
+                lat_done[ci] += 1
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return sum(lat_done) / wall
+
+    # warm both configs (compile batch-width programs, open the sink)
+    for enabled in (False, True):
+        set_exporter(enabled)
+        for q in queries[:4]:
+            node.search("bench", {"size": 10, "query": {
+                "knn": {"v": {"vector": q, "k": 10}}}})
+    walls: dict[bool, list] = {False: [], True: []}
+    for _ in range(reps):
+        for enabled in (False, True):
+            set_exporter(enabled)
+            walls[enabled].append(one_round())
+    qps_off = float(np.median(walls[False]))
+    qps_on = float(np.median(walls[True]))
+    harvest_exported()  # bank the final ON round's ledger post-flush
+    node.close()
+    overhead_pct = max(0.0, (1.0 - qps_on / max(qps_off, 1e-9)) * 100.0)
+    print(json.dumps({
+        "metric": f"otel_overhead_knn_{clients}x{per_client}",
+        "value": round(qps_on, 1),
+        "unit": "queries/s",
+        "vs_baseline": round(qps_on / max(qps_off, 1e-9), 3),
+        "platform": platform,
+        "qps_exporter_off": round(qps_off, 1),
+        "qps_exporter_on": round(qps_on, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "spans_exported": exported_total,
+        "corpus": {"docs": n_docs, "dim": d},
+    }))
+
+
 def concurrency_parent() -> int:
     """`bench.py --concurrency`: the concurrent-clients serving workload
     (CONC_CLIENTS threads x CONC_QUERIES kNN searches each through the real
@@ -972,6 +1130,18 @@ if __name__ == "__main__":
             }))
             sys.exit(1)
         sys.exit(0)
+    if "--otel-child" in sys.argv:
+        try:
+            otel_child()
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "metric": "bench_error", "value": 0, "unit": "error",
+                "vs_baseline": 0, "detail": str(e)[:200],
+            }))
+            sys.exit(1)
+        sys.exit(0)
+    if "--otel-overhead" in sys.argv:
+        sys.exit(otel_parent())
     if "--gate" in sys.argv:
         sys.exit(gate_parent())
     if "--concurrency" in sys.argv:

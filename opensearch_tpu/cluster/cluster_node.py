@@ -146,6 +146,19 @@ class ClusterNode:
         self.settings_consumers.register(
             "search.knn.batch.", self.knn_batcher.apply_settings
         )
+        # span exporter: per-node (its ring is per-node); dynamic
+        # telemetry.tracing.* updates rebuild/retune it at state application
+        from opensearch_tpu.telemetry.export import apply_tracing_settings
+
+        self.settings_consumers.register(
+            "telemetry.tracing.",
+            lambda eff: apply_tracing_settings(
+                self.telemetry, eff, self.data_path, service_name=node_id),
+        )
+        # extra per-node stats sections for the cluster-wide _nodes/stats
+        # fan-out: coordinator-side services (the facade's request cache)
+        # register a provider here so the node RPC can report them
+        self.stats_providers: dict[str, Callable[[], dict]] = {}
         # workload-management groups: one registry per node, shared with the
         # REST facade; bulk admission (wlm.admit_bulk) sheds tagged bulk
         # traffic past its group's slot share with 429 BEFORE fan-out
@@ -238,6 +251,9 @@ class ClusterNode:
         from opensearch_tpu.cluster.shard_mesh import default_registry
 
         self.shard_mesh = default_registry
+        # mesh launch walls land in this node's histograms (exemplar-linked
+        # like the batcher's queue-wait: a p99 launch links to its trace)
+        self.shard_mesh.metrics = self.telemetry.metrics
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -2495,8 +2511,48 @@ class ClusterNode:
                 "primary": bool(shard.primary),
                 "docs": shard.num_docs,
             }
-        return {"shards": out,
-                "shard_mesh": self.shard_mesh.snapshot_stats()}
+        resp: dict[str, Any] = {
+            "shards": out,
+            "shard_mesh": self.shard_mesh.snapshot_stats(),
+        }
+        if payload.get("full"):
+            # the cluster-wide _nodes/stats fan-out: this node's whole
+            # telemetry surface rides back to the coordinator — metrics
+            # with exemplars, the spans-ring tail, exporter accounting,
+            # batcher stats and any coordinator-registered extras (the
+            # facade's request cache). The light form (no flag) stays cheap
+            # for index_stats' per-shard doc counts. An optional "sections"
+            # list narrows the payload: a recurring Prometheus scrape asks
+            # for ["metrics"] alone instead of shipping ~100 serialized
+            # spans per node over the transport every 15 seconds.
+            sections = payload.get("sections")
+
+            def want(section: str) -> bool:
+                return sections is None or section in sections
+
+            telemetry: dict[str, Any] = dict(self.telemetry.metrics.stats())
+            if want("spans"):
+                telemetry["spans"] = [
+                    s.to_dict()
+                    for s in self.telemetry.tracer.finished_spans()[-100:]
+                ]
+                exporter = self.telemetry.tracer.exporter
+                if exporter is not None:
+                    telemetry["exporter"] = exporter.snapshot_stats()
+            resp["name"] = self.node_id
+            resp["telemetry"] = telemetry
+            if want("knn_batch"):
+                resp["knn_batch"] = self.knn_batcher.snapshot_stats()
+            if want("providers"):
+                for name, provider in list(self.stats_providers.items()):
+                    try:
+                        resp[name] = provider()
+                    except Exception as e:  # noqa: BLE001 - never fail stats
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "stats provider [%s] failed: %s", name, e)
+        return resp
 
     def _on_shard_search(self, sender: str, payload: dict):
         def run() -> dict:
@@ -2620,6 +2676,11 @@ class ClusterNode:
 
     def close(self) -> None:
         self._closed = True
+        # flush-on-shutdown: pending trace fragments decide + drain before
+        # the rest of the node tears down
+        from opensearch_tpu.telemetry.export import close_exporter
+
+        close_exporter(self.telemetry)
         timer = getattr(self, "_shard_tick_timer", None)
         if timer is not None:
             timer.cancel()

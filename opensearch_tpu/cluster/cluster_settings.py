@@ -118,8 +118,9 @@ def _register_typed_settings() -> None:
     # component's own parsing
     from opensearch_tpu.index.request_cache import CACHE_SIZE_SETTING
     from opensearch_tpu.search.batcher import BATCH_SETTINGS
+    from opensearch_tpu.telemetry.export import TRACING_SETTINGS
 
-    for s in (*BATCH_SETTINGS, CACHE_SIZE_SETTING):
+    for s in (*BATCH_SETTINGS, CACHE_SIZE_SETTING, *TRACING_SETTINGS):
         DYNAMIC_CLUSTER_SETTINGS[s.key] = _validate_with_setting(s)
 
 

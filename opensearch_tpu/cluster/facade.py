@@ -130,6 +130,12 @@ class ClusterFacade:
 
         cluster_node.settings_consumers.register(
             CACHE_SIZE_SETTING.key, _apply_cache_size)
+        # the request cache is coordinator-side (it lives with the REST
+        # surface, not the data plane): register it as a stats provider so
+        # the cluster-wide _nodes/stats fan-out reports THIS node's cache
+        # alongside the data-plane sections
+        cluster_node.stats_providers["request_cache"] = \
+            self.request_cache.stats
         # the kNN dispatch batcher is process-wide (one process == one
         # device); the facade shares it so cluster-mode stats see the same
         # coalescing the data plane performs
@@ -637,11 +643,13 @@ class ClusterFacade:
                     for (nid, idx, nums), err in failures
                     for num in (nums or [-1])
                 ]
-        # same request metrics the single-node path records, so
-        # /_prometheus/metrics is useful in cluster mode too
-        self.telemetry.metrics.counter("search.total").add(1)
-        self.telemetry.metrics.histogram("search.took_ms").record(
-            resp.get("took", 0))
+            # same request metrics the single-node path records, so
+            # /_prometheus/metrics is useful in cluster mode too; INSIDE
+            # the coordinator span so the histogram exemplar captures this
+            # trace id (a p99 bucket links straight to the trace)
+            self.telemetry.metrics.counter("search.total").add(1)
+            self.telemetry.metrics.histogram("search.took_ms").record(
+                resp.get("took", 0))
         if keep:
             contexts = {
                 f"{nid}|{idx}": p["_ctx_id"]
@@ -1153,6 +1161,73 @@ class ClusterFacade:
                 k: v for k, v in TpuNode._CLUSTER_SETTING_DEFAULTS.items()
                 if k not in state.settings
                 and k not in state.transient_settings})
+        return out
+
+    def cluster_nodes_stats(self, metrics: list[str] | None = None) -> dict:
+        """Cluster-wide `_nodes/stats`: ONE fan-out RPC per node
+        (TransportNodesStatsAction), merging every node's telemetry ring,
+        exporter accounting, kNN-batch stats, shard-mesh stats and
+        registered extras (request cache) into one response. A node that
+        fails to answer counts in `_nodes.failed` instead of failing the
+        whole call — stats must work mid-chaos. A metric filter narrows
+        the RPC payload via the same `sections` mechanism the federated
+        Prometheus scrape uses — `_nodes/stats/knn_batch` must not ship
+        every node's span ring over the transport just to discard it."""
+        payload: dict[str, Any] = {"full": True}
+        if metrics and "_all" not in metrics:
+            section_of = {"telemetry": "spans", "knn_batch": "knn_batch",
+                          "indices": "providers"}
+            payload["sections"] = sorted(
+                {section_of[m] for m in metrics if m in section_of})
+        nodes = sorted(self.state.nodes)
+        results = self._rpc_many([
+            (nid, "indices:monitor/stats[node]", dict(payload))
+            for nid in nodes
+        ])
+        entries: dict[str, dict] = {}
+        failed = 0
+        for nid, r in zip(nodes, results):
+            if not isinstance(r, dict) or set(r) <= {"error", "status"}:
+                failed += 1
+                continue
+            entries[nid] = {
+                "name": r.get("name", nid),
+                "roles": ["cluster_manager", "data"],
+                "telemetry": r.get("telemetry", {}),
+                "knn_batch": r.get("knn_batch", {}),
+                "shard_mesh": r.get("shard_mesh", {}),
+                "indices": {
+                    "request_cache": r.get("request_cache", {}),
+                },
+                "shards": r.get("shards", {}),
+            }
+        return {
+            "_nodes": {"total": len(nodes), "successful": len(entries),
+                       "failed": failed},
+            "cluster_name": "opensearch-tpu",
+            "nodes": entries,
+        }
+
+    def cluster_metrics(self) -> dict[str, dict]:
+        """Per-node metrics registries (counters + exemplar-carrying
+        histograms) for the federated `/_prometheus/metrics?cluster=true`
+        view: node id -> MetricsRegistry.stats() shape. Scrapes recur
+        every few seconds, so the fan-out asks each node for its metrics
+        SECTION only — no span ring, exporter ledger, batcher or provider
+        payloads ride the transport just to be discarded here."""
+        nodes = sorted(self.state.nodes)
+        results = self._rpc_many([
+            (nid, "indices:monitor/stats[node]",
+             {"full": True, "sections": ["metrics"]})
+            for nid in nodes
+        ])
+        out: dict[str, dict] = {}
+        for nid, r in zip(nodes, results):
+            if not isinstance(r, dict) or set(r) <= {"error", "status"}:
+                continue
+            tel = r.get("telemetry", {})
+            out[nid] = {"counters": tel.get("counters", {}),
+                        "histograms": tel.get("histograms", {})}
         return out
 
     def _all_shard_stats(self) -> dict[str, dict]:

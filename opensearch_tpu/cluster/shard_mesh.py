@@ -31,12 +31,17 @@ from typing import Any
 # insertion-ordered dict as LRU: hits re-insert, eviction pops the head
 _DEFAULT_MAX_BUNDLES = 8
 
+# registered metric name for the fenced sharded-launch wall (metric names
+# are constants, never built at the record site — tpulint TPU013)
+MESH_LAUNCH_WALL_MS = "mesh.launch.wall_ms"
+
 
 class ShardMeshRegistry:
     """Tracks device-resident shard bundles keyed by reader generation."""
 
     def __init__(self, max_bundles: int = _DEFAULT_MAX_BUNDLES):
         self.max_bundles = max_bundles
+        self.metrics = None  # MetricsRegistry sink (ClusterNode attaches)
         self._lock = threading.Lock()
         self._bundles: dict[tuple, Any] = {}
         self._launch_seq = 0
@@ -115,6 +120,18 @@ class ShardMeshRegistry:
             self._launch_seq += 1
             self.stats["launches"] += 1
             return self._launch_seq
+
+    def record_launch_wall(self, wall_ns: int) -> None:
+        """Feed the fenced launch wall into the EXECUTING node's metrics
+        (the activate() scope its request handler opened — so in-process
+        sim nodes don't all record into the last-attached sink), falling
+        back to the attached MetricsRegistry; records an exemplar-linked
+        `mesh.launch.wall_ms` histogram point."""
+        from opensearch_tpu.telemetry.tracing import active_metrics
+
+        metrics = active_metrics() or self.metrics
+        if metrics is not None:
+            metrics.histogram(MESH_LAUNCH_WALL_MS).record(wall_ns / 1e6)
 
     # -- introspection ------------------------------------------------------
 

@@ -1899,6 +1899,63 @@ class SpanLeakChecker(Checker):
 
 
 # ---------------------------------------------------------------------------
+# TPU013 — metric-hygiene (metric names must be registered constants)
+# ---------------------------------------------------------------------------
+
+
+def _is_dynamic_string(node: ast.AST) -> bool:
+    """A string expression built AT THE CALL SITE: f-strings, + / %
+    concatenation, and .format()/str.join() calls. Literals, module
+    constants (Name/Attribute reads) and plain variables are fine — a
+    variable can only be flagged where IT was built."""
+    if isinstance(node, ast.JoinedStr):
+        return any(isinstance(v, ast.FormattedValue) for v in node.values)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add, ast.Mod)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        # "x.{}".format(...) / ".".join(...) — the receiver is usually a
+        # string CONSTANT, which dotted_name cannot resolve
+        if node.func.attr in ("format", "join"):
+            return True
+    return False
+
+
+class MetricHygieneChecker(Checker):
+    """TPU013: `metrics.histogram(name)` / `metrics.counter(name)` with a
+    name BUILT at the record site (f-string, concatenation, %-format,
+    .format()) silently explodes Prometheus cardinality: every distinct
+    interpolation mints a new time series, and the registry holds them all
+    forever (a TPU009-shaped leak the growth rule cannot see). Metric
+    names must be string literals or registered constants; varying
+    dimensions belong in labels or in bucketed values, not the name."""
+
+    rule_id = "TPU013"
+    name = "metric-hygiene"
+    description = ("histogram/counter metric names must be registered "
+                   "constants, not strings built at the record site")
+
+    _METHODS = ("histogram", "counter")
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._METHODS
+                    and node.args):
+                continue
+            arg = node.args[0]
+            if _is_dynamic_string(arg):
+                out.append(ctx.violation(
+                    "TPU013", node,
+                    f"metric name passed to .{node.func.attr}() is built "
+                    f"at the record site — every distinct interpolation "
+                    f"mints a new Prometheus series; use a registered "
+                    f"constant name (vary labels, not names)"))
+        return out
+
+
+# ---------------------------------------------------------------------------
 
 ALL_CHECKERS: list[Checker] = [
     JitPurityChecker(),
@@ -1913,6 +1970,7 @@ ALL_CHECKERS: list[Checker] = [
     InterproceduralLockOrderChecker(),
     BlockingOnDataWorkerChecker(),
     SpanLeakChecker(),
+    MetricHygieneChecker(),
 ]
 
 RULES: dict[str, Checker] = {c.rule_id: c for c in ALL_CHECKERS}

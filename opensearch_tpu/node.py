@@ -3081,8 +3081,10 @@ class TpuNode:
             self.search_slowlog.maybe_log(
                 took, expr, json.dumps(body.get("query") or {})
             )
-        self.telemetry.metrics.counter("search.total").add(1)
-        self.telemetry.metrics.histogram("search.took_ms").record(took)
+            # metrics record INSIDE the span so the histogram exemplar
+            # captures this trace id (a p99 bucket links to the trace)
+            self.telemetry.metrics.counter("search.total").add(1)
+            self.telemetry.metrics.histogram("search.took_ms").record(took)
         if pl is not None:
             resp = self.search_pipelines.transform_response(
                 pl, {**body, **pl_ctx}, resp
@@ -3528,6 +3530,13 @@ class TpuNode:
             self.knn_batcher.apply_settings(eff)
         self.request_cache.set_max_bytes(
             CACHE_SIZE_SETTING.get(Settings.from_flat(eff)))
+        # span exporter: per-node (like the request cache), applies
+        # unconditionally — absent keys resolve to the "none" default so a
+        # null deletion detaches a live exporter
+        from opensearch_tpu.telemetry.export import apply_tracing_settings
+
+        apply_tracing_settings(self.telemetry, eff, self.data_path,
+                               service_name=self.node_name)
 
     def put_cluster_settings(self, body: dict, *, flat: bool = False) -> dict:
         """Single-node /_cluster/settings: same validation + persistent/
@@ -4519,6 +4528,11 @@ class TpuNode:
         return out
 
     def close(self) -> None:
+        # flush-on-shutdown: buffered trace fragments decide + drain so an
+        # investigation never loses the tail that was in flight
+        from opensearch_tpu.telemetry.export import close_exporter
+
+        close_exporter(self.telemetry)
         for svc in self.indices.values():
             svc.close()
 

@@ -1564,52 +1564,122 @@ def list_tasks(node: TpuNode, params, query, body):
     }}}
 
 
+def _prom_name(name: str) -> str:
+    import re as _re
+
+    return "opensearch_tpu_" + _re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def _prom_fmt(v) -> str:
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def _prom_labels(labels: dict | None, extra: dict | None = None) -> str:
+    merged = {**(labels or {}), **(extra or {})}
+    if not merged:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in merged.items())
+    return "{" + inner + "}"
+
+
+def _prom_registry_lines(stats: dict, labels: dict | None,
+                         declare_types: bool,
+                         want_exemplars: bool) -> list[str]:
+    """Render one MetricsRegistry.stats() snapshot. With `want_exemplars`,
+    histogram buckets that carry an exemplar append it in OpenMetrics
+    exemplar syntax — `... # {trace_id="..."} value` — so a p99 bucket
+    links directly to the trace the span exporter can ship (the closed
+    telemetry loop). That suffix is only legal in the OpenMetrics format,
+    so it is opt-in: the default exposition stays classic-text-parseable
+    by a stock Prometheus scrape."""
+    lines: list[str] = []
+    for name in sorted(stats.get("counters", {})):
+        m = _prom_name(name)
+        if declare_types:
+            lines.append(f"# TYPE {m} counter")
+        lines.append(
+            f"{m}{_prom_labels(labels)} {_prom_fmt(stats['counters'][name])}")
+    for name in sorted(stats.get("histograms", {})):
+        h = stats["histograms"][name]
+        m = _prom_name(name)
+        if declare_types:
+            lines.append(f"# TYPE {m} histogram")
+        exemplars = ({e["le"]: e for e in h.get("exemplars", [])}
+                     if want_exemplars else {})
+
+        def bucket_line(le_text, count, le_key):
+            line = (f'{m}_bucket{_prom_labels(labels, {"le": le_text})} '
+                    f"{_prom_fmt(count)}")
+            ex = exemplars.get(le_key)
+            if ex is not None:
+                line += (f' # {{trace_id="{ex["trace_id"]}"}} '
+                         f'{_prom_fmt(ex["value"])}')
+            return line
+
+        for b in h.get("buckets", []):
+            lines.append(bucket_line(_prom_fmt(b["le"]), b["count"], b["le"]))
+        lines.append(bucket_line("+Inf", h["count"], "+Inf"))
+        lines.append(f"{m}_count{_prom_labels(labels)} {_prom_fmt(h['count'])}")
+        lines.append(f"{m}_sum{_prom_labels(labels)} {_prom_fmt(h['sum'])}")
+        for gauge in ("min", "max"):
+            if declare_types:
+                lines.append(f"# TYPE {m}_{gauge} gauge")
+            lines.append(
+                f"{m}_{gauge}{_prom_labels(labels)} {_prom_fmt(h[gauge])}")
+    return lines
+
+
 def prometheus_metrics(node: TpuNode, params, query, body):
     """GET /_prometheus/metrics — the node's MetricsRegistry rendered in
     Prometheus text exposition format (the prometheus-exporter plugin
     surface): counters as `counter` samples, histograms as classic
     bucketed `histogram` families (`_bucket{le=...}` cumulative series +
-    `_count`/`_sum`) plus `_min`/`_max` gauges. Batch-size and queue-wait
-    of the kNN dispatch batcher are the first bucketed users."""
-    import re as _re
+    `_count`/`_sum`) plus `_min`/`_max` gauges. `?exemplars=true` appends
+    OpenMetrics exemplar suffixes linking latency buckets to trace ids
+    (opt-in: the suffix is not part of the classic text format, so the
+    default response stays parseable by a stock Prometheus scrape; an
+    exemplar-aware collector opts in via the scrape job's params). With
+    `?cluster=true` on a cluster node, the response FEDERATES every
+    node's registry with a per-node label — one scrape sees the whole
+    cluster."""
 
-    def metric_name(name: str) -> str:
-        return "opensearch_tpu_" + _re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    def flag(name: str) -> bool:
+        return str(query.get(name, "false")) in ("true", "")
 
-    def fmt(v) -> str:
-        f = float(v)
-        return str(int(f)) if f.is_integer() else repr(f)
-
-    stats = node.telemetry.metrics.stats()
+    want_exemplars = flag("exemplars")
     lines: list[str] = []
-    for name in sorted(stats["counters"]):
-        m = metric_name(name)
-        lines.append(f"# TYPE {m} counter")
-        lines.append(f"{m} {fmt(stats['counters'][name])}")
-    for name in sorted(stats["histograms"]):
-        h = stats["histograms"][name]
-        m = metric_name(name)
-        lines.append(f"# TYPE {m} histogram")
-        for b in h.get("buckets", []):
-            lines.append(
-                f'{m}_bucket{{le="{fmt(b["le"])}"}} {fmt(b["count"])}')
-        lines.append(f'{m}_bucket{{le="+Inf"}} {fmt(h["count"])}')
-        lines.append(f"{m}_count {fmt(h['count'])}")
-        lines.append(f"{m}_sum {fmt(h['sum'])}")
-        for gauge in ("min", "max"):
-            lines.append(f"# TYPE {m}_{gauge} gauge")
-            lines.append(f"{m}_{gauge} {fmt(h[gauge])}")
+    cluster_metrics = getattr(node, "cluster_metrics", None)
+    federated = flag("cluster") and cluster_metrics is not None
+    if federated:
+        # federated view: per-node sample series distinguished by a
+        # {node=...} label; TYPE comments are omitted (several nodes carry
+        # the same family and duplicate declarations are invalid)
+        per_node = cluster_metrics()
+        for nid in sorted(per_node):
+            lines.extend(_prom_registry_lines(
+                per_node[nid], {"node": nid}, declare_types=False,
+                want_exemplars=want_exemplars))
+    else:
+        lines.extend(_prom_registry_lines(
+            node.telemetry.metrics.stats(), None, declare_types=True,
+            want_exemplars=want_exemplars))
     # task-manager liveness gauges ride along (cheap, always useful on a
-    # scrape dashboard)
+    # scrape dashboard). They are LOCAL to the serving node: the federated
+    # view labels them so scrapes of different nodes never emit the same
+    # unlabeled series with different values
     tm = node.task_manager
+    task_labels = ({"node": getattr(node, "node_name", "node-0")}
+                   if federated else None)
     for gname, gval in (
         ("tasks_running", len(tm.list_tasks())),
         ("tasks_completed", tm.completed),
         ("tasks_cancelled", tm.cancelled_count),
     ):
         m = f"opensearch_tpu_{gname}"
-        lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m} {gval}")
+        if not federated:
+            lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m}{_prom_labels(task_labels)} {gval}")
     return 200, "\n".join(lines) + "\n"
 
 
@@ -2923,6 +2993,7 @@ _NODES_STATS_METRICS = {
     "transport", "http", "breaker", "script", "discovery", "ingest",
     "adaptive_selection", "indexing_pressure", "search_backpressure",
     "shard_indexing_pressure", "tasks", "telemetry", "slowlog", "knn_batch",
+    "shard_mesh",
 }
 
 
@@ -2944,6 +3015,20 @@ def nodes_stats(node: TpuNode, params, query, body):
             raise IllegalArgumentException(
                 f"request [/_nodes/stats/{raw_metric}] contains "
                 f"unrecognized metric: [{m}]{hint}")
+    # cluster mode: the facade fans ONE stats RPC to every node and merges
+    # the rings — every node's telemetry (spans + exporter accounting),
+    # knn-batch, shard-mesh and request-cache stats in one response
+    cluster_stats = getattr(node, "cluster_nodes_stats", None)
+    if cluster_stats is not None:
+        resp = cluster_stats(metrics)
+        if "_all" not in metrics:
+            base = {"name", "roles"}
+            keep = set(metrics) | base
+            resp["nodes"] = {
+                nid: {k: v for k, v in entry.items() if k in keep}
+                for nid, entry in resp["nodes"].items()
+            }
+        return 200, resp
     raw_im = params.get("index_metric") or query.get("index_metric")
     index_metrics = ([m.strip() for m in str(raw_im).split(",")
                       if m.strip()] if raw_im else ["_all"])
@@ -3030,6 +3115,10 @@ def nodes_stats(node: TpuNode, params, query, body):
                 s.to_dict()
                 for s in node.telemetry.tracer.finished_spans()[-100:]
             ],
+            # exporter ledger (spans_exported/spans_dropped/resident
+            # accounting) — same surface the cluster fan-out merges
+            **({"exporter": node.telemetry.tracer.exporter.snapshot_stats()}
+               if node.telemetry.tracer.exporter is not None else {}),
         },
         "slowlog": {
             "search": node.search_slowlog.entries()[-10:],

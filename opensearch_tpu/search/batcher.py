@@ -419,7 +419,13 @@ class KnnDispatchBatcher:
             if shards > 1:
                 self.stats["cross_shard_launches"] += 1
                 self.stats["cross_shard_queries"] += merged
-        metrics = self.metrics
+        # record into the EXECUTING node's registry when a request scope is
+        # active (multi-node sims share this process-wide batcher; the
+        # exemplar trace_id must resolve in the recording node's ring),
+        # else the attached sink
+        from opensearch_tpu.telemetry.tracing import active_metrics
+
+        metrics = active_metrics() or self.metrics
         if metrics is not None:
             metrics.histogram("knn.batch.size").record(merged)
             metrics.histogram("knn.batch.queue_wait_ms").record(max_wait_ms)

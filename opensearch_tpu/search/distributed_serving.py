@@ -389,6 +389,7 @@ def mesh_knn_batch(
     counts = np.asarray(counts)[:, :b]   # [s, b]
     wall_ns = time.perf_counter_ns() - t0
     launch_id = registry.next_launch_id()
+    registry.record_launch_wall(wall_ns)
     _count("distributed_searches")
     if has_filter:
         _count("filtered")

@@ -243,6 +243,67 @@ class ShardProfiler:
         return out
 
 
+# fetch sub-phase keys -> the reference's subphase class names
+# (fetch/subphase/*; search/fetch/FetchPhase.java runs them per winning doc)
+FETCH_SUBPHASES = {
+    "load_source": "FetchSourcePhase",
+    "docvalue_fields": "FetchDocValuesPhase",
+    "fields": "FetchFieldsPhase",
+    "stored_fields": "StoredFieldsPhase",
+    "highlight": "HighlightPhase",
+    "script_fields": "ScriptFieldsPhase",
+    "explain": "ExplainPhase",
+}
+
+
+class FetchProfiler:
+    """Per-shard fetch-phase sub-phase timings: the `"profile": true`
+    coverage for fetch that the operator tree provides for the query phase
+    (the reference's FetchProfiler / ProfileResult over the 17-subphase
+    chain). One instance covers one search request; hits attribute to the
+    shard they came from, so per-shard entries merge across a cluster
+    exactly like the query profiles do."""
+
+    def __init__(self, n_shards: int) -> None:
+        # shard idx -> {subphase: [time_ns, count]}
+        self._phases: list[dict[str, list[int]]] = [
+            {} for _ in range(n_shards)
+        ]
+        self._hits: list[int] = [0] * n_shards
+
+    def hit(self, shard_idx: int) -> None:
+        self._hits[shard_idx] += 1
+
+    def add(self, shard_idx: int, phase: str, t0_ns: int) -> None:
+        cell = self._phases[shard_idx].setdefault(phase, [0, 0])
+        cell[0] += time.perf_counter_ns() - t0_ns
+        cell[1] += 1
+
+    def entry(self, shard_idx: int) -> dict:
+        phases = self._phases[shard_idx]
+        total = sum(c[0] for c in phases.values())
+        breakdown: dict[str, int] = {}
+        children = []
+        for key, cls in FETCH_SUBPHASES.items():
+            ns, count = phases.get(key, (0, 0))
+            breakdown[key] = ns
+            breakdown[f"{key}_count"] = count
+            if count:
+                children.append({
+                    "type": cls, "description": key,
+                    "time_in_nanos": ns,
+                    "breakdown": {key: ns, f"{key}_count": count},
+                })
+        return {
+            "type": "fetch",
+            "description": "fetch",
+            "time_in_nanos": total,
+            "breakdown": breakdown,
+            "debug": {"hits_fetched": self._hits[shard_idx]},
+            "children": children,
+        }
+
+
 def describe_node(node: Any) -> str:
     """Compact operator description: the node's salient config, not the
     whole query JSON (which the reference also truncates)."""

@@ -488,11 +488,19 @@ def search(
         body.get("include_named_queries_score", "false")
     ).lower() in ("true", "")
     named_cache: dict = {}
+    # fetch-phase sub-phase profiler: times source load / highlight /
+    # stored+doc-value fields per shard, the way the operator tree covers
+    # the query phase (profile.shards[*].fetch)
+    fetch_prof = (search_profile.FetchProfiler(len(per_shard_results))
+                  if want_profile else None)
+    _now_ns = time.perf_counter_ns
     hits_json = []
     for page_i, (shard_idx, h) in enumerate(page):
         shard, snapshot, _ = per_shard_results[shard_idx]
         host = snapshot.segments[h.segment][0]
         ms = shard.mapper_service
+        if fetch_prof is not None:
+            fetch_prof.hit(shard_idx)
         doc_id = host.doc_ids[h.doc]
         hit: dict[str, Any] = {
             "_index": shard.shard_id.index,
@@ -512,23 +520,33 @@ def search(
                 hit["_ignored"] = sorted(
                     ig.ord_values[int(o)] for o in ig.mv_ords[s_:e_]
                 )
+        _t0 = _now_ns() if fetch_prof is not None else 0
         raw_source = json.loads(host.sources[h.doc])
         src = source_filter(raw_source)
         if src is not None:
             hit["_source"] = src
+        if fetch_prof is not None:
+            fetch_prof.add(shard_idx, "load_source", _t0)
         if sort:
             hit["sort"] = h.sort_values
         if docvalue_specs:
+            _t0 = _now_ns() if fetch_prof is not None else 0
             dv = fetch.docvalue_fields_for_doc(docvalue_specs, host, h.doc, ms)
             if dv:
                 hit.setdefault("fields", {}).update(dv)
+            if fetch_prof is not None:
+                fetch_prof.add(shard_idx, "docvalue_fields", _t0)
         if fields_specs:
+            _t0 = _now_ns() if fetch_prof is not None else 0
             fv = fetch.fields_option_for_doc(fields_specs, raw_source, host, h.doc, ms)
             if fv:
                 hit.setdefault("fields", {}).update(fv)
+            if fetch_prof is not None:
+                fetch_prof.add(shard_idx, "fields", _t0)
         if stored_specs:
             # explicitly stored fields surface under "fields" (stored-field
             # loading reads the segment columns in this engine)
+            _t0 = _now_ns() if fetch_prof is not None else 0
             for sf in stored_specs:
                 if sf in ("_source", "_id", "_routing", "*"):
                     continue
@@ -538,13 +556,19 @@ def search(
                 vals = fetch._doc_column_values(host, h.doc, sf, ms, None)
                 if vals:
                     hit.setdefault("fields", {})[sf] = vals
+            if fetch_prof is not None:
+                fetch_prof.add(shard_idx, "stored_fields", _t0)
         if highlight_conf:
+            _t0 = _now_ns() if fetch_prof is not None else 0
             hl = fetch.compute_highlight(highlight_conf, preds_by_field, raw_source, ms)
             if hl:
                 hit["highlight"] = hl
+            if fetch_prof is not None:
+                fetch_prof.add(shard_idx, "highlight", _t0)
         if script_fields:
             from opensearch_tpu.script import default_script_service
 
+            _t0 = _now_ns() if fetch_prof is not None else 0
             for sf_name, (ast, sf_params) in compiled_scripts.items():
                 val = default_script_service.field(
                     ast, sf_params, host, h.doc, ms, source=raw_source
@@ -552,8 +576,13 @@ def search(
                 hit.setdefault("fields", {})[sf_name] = (
                     val if isinstance(val, list) else [val]
                 )
+            if fetch_prof is not None:
+                fetch_prof.add(shard_idx, "script_fields", _t0)
         if want_explain:
+            _t0 = _now_ns() if fetch_prof is not None else 0
             hit["_explanation"] = fetch.explain_for_hit(h.score, node)
+            if fetch_prof is not None:
+                fetch_prof.add(shard_idx, "explain", _t0)
         if want_version or want_seqno:
             # read from the pinned snapshot's seal-time doc-values, not the
             # live version_map — scroll/PIT hits must report the version of
@@ -782,6 +811,11 @@ def search(
                 }]
             shards_profile.append({
                 "id": f"[{shard.shard_id.index}][{shard.shard_id.shard}]",
+                # per-fetch-subphase breakdown (source load / highlight /
+                # stored+doc-value fields), covering fetch the way the
+                # operator tree covers query
+                "fetch": (fetch_prof.entry(shard_idx)
+                          if fetch_prof is not None else None),
                 "searches": [{
                     "query": query_entries,
                     "rewrite_time": prof.rewrite_ns if prof else 0,

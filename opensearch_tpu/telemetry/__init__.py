@@ -15,3 +15,8 @@ __all__ = [
     "current_trace_context", "default_telemetry", "restore_trace_context",
     "span",
 ]
+
+# telemetry.export (SpanExporter/sinks/OTLP codec) imports lazily where
+# needed: it pulls common.settings, which this package must not require at
+# import time for the ops-only consumers
+

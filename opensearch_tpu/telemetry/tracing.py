@@ -161,6 +161,18 @@ def active_tracer() -> "Tracer":
     return _active_tracer.get() or default_telemetry.tracer
 
 
+def active_metrics() -> "MetricsRegistry | None":
+    """MetricsRegistry of the node handling the current request, or None
+    outside an activate() scope. Process-wide singletons (the kNN dispatch
+    batcher, the shard-mesh registry) record through this so that in
+    multi-node in-process sims a launch lands in the EXECUTING node's
+    histograms — and its exemplar trace_id resolves in the same node's
+    span ring — instead of whichever node attached its sink last."""
+    tracer = _active_tracer.get()
+    owner = getattr(tracer, "owner", None) if tracer is not None else None
+    return owner.metrics if owner is not None else None
+
+
 def span(name: str, attributes: dict | None = None):
     """Open a span on the active tracer (see `activate`)."""
     return active_tracer().start_span(name, attributes)
@@ -169,12 +181,19 @@ def span(name: str, attributes: dict | None = None):
 class Tracer:
     """Span factory with contextvar propagation and a bounded ring of
     finished spans (the exporter slot). `name` prefixes span ids so traces
-    stitched across several tracers (sim cluster nodes) stay unambiguous."""
+    stitched across several tracers (sim cluster nodes) stay unambiguous.
+
+    When an exporter (telemetry/export.py SpanExporter) is attached, every
+    finished span is also offered to it; the exporter's tail-keeping
+    sampler decides which traces leave the process as OTLP-JSON."""
 
     def __init__(self, max_finished: int = 2048, enabled: bool = True,
                  name: str = "t0"):
         self.enabled = enabled
         self.name = name
+        self.max_finished = max_finished
+        self.exporter = None  # SpanExporter | None (export.py)
+        self.owner = None  # Telemetry backref (set by Telemetry.__init__)
         self._ids = itertools.count(1)
         self._finished: deque[Span] = deque(maxlen=max_finished)
         self._lock = threading.Lock()
@@ -203,6 +222,11 @@ class Tracer:
         if self.enabled:
             with self._lock:
                 self._finished.append(span)
+            exporter = self.exporter
+            if exporter is not None:
+                # outside self._lock: the exporter takes its own lock and
+                # may call back into sinks
+                exporter.on_span_end(span, self.name)
 
     def current_span(self) -> Span | None:
         return _current_span.get()
@@ -235,6 +259,12 @@ DEFAULT_BUCKETS = (
 )
 
 
+# an exemplar covers this many observations before it is considered stale
+# and any fresh observation (not only a larger one) may replace it: a p99
+# spike from an hour ago must not shadow today's outliers forever
+EXEMPLAR_WINDOW = 1024
+
+
 class _Histogram:
     def __init__(self, buckets: tuple = DEFAULT_BUCKETS):
         self.count = 0
@@ -244,17 +274,46 @@ class _Histogram:
         self.buckets = tuple(sorted(buckets))
         # cumulative counts per upper bound (le semantics); +Inf == count
         self.bucket_counts = [0] * len(self.buckets)
+        # bucket index (len(buckets) == +Inf) -> the max-latency observation
+        # of the current window with the trace that produced it, so a p99
+        # bucket links straight to an exportable trace (OpenMetrics
+        # exemplars; OTel's exemplar reservoir with a keep-max policy)
+        self.exemplars: dict[int, dict] = {}
         self._lock = threading.Lock()
 
-    def record(self, value: float) -> None:
+    def record(self, value: float, trace_id: str | None = None) -> None:
+        if trace_id is None:
+            span = _current_span.get()
+            trace_id = span.trace_id if span is not None else None
         with self._lock:
             self.count += 1
             self.total += value
             self.min = min(self.min, value)
             self.max = max(self.max, value)
+            bucket_idx = len(self.buckets)  # +Inf unless a bound catches it
             for i, le in enumerate(self.buckets):
                 if value <= le:
                     self.bucket_counts[i] += 1
+                    bucket_idx = min(bucket_idx, i)
+            if trace_id is not None:
+                window = self.count // EXEMPLAR_WINDOW
+                cur = self.exemplars.get(bucket_idx)
+                if cur is None or cur["window"] != window \
+                        or value >= cur["value"]:
+                    self.exemplars[bucket_idx] = {
+                        "value": value, "trace_id": trace_id,
+                        "window": window,
+                    }
+
+    def _exemplars_locked(self) -> list[dict]:
+        out = []
+        for i in sorted(self.exemplars):
+            e = self.exemplars[i]
+            out.append({
+                "le": self.buckets[i] if i < len(self.buckets) else "+Inf",
+                "value": e["value"], "trace_id": e["trace_id"],
+            })
+        return out
 
     def stats(self) -> dict:
         with self._lock:  # consistent snapshot: record() holds this too
@@ -264,7 +323,7 @@ class _Histogram:
                         "buckets": [
                             {"le": le, "count": 0} for le in self.buckets
                         ]}
-            return {
+            out = {
                 "count": self.count, "sum": self.total,
                 "avg": self.total / self.count,
                 "min": self.min, "max": self.max,
@@ -273,6 +332,10 @@ class _Histogram:
                     for le, c in zip(self.buckets, self.bucket_counts)
                 ],
             }
+            exemplars = self._exemplars_locked()
+            if exemplars:
+                out["exemplars"] = exemplars
+            return out
 
 
 class MetricsRegistry:
@@ -303,6 +366,9 @@ class Telemetry:
     def __init__(self, name: str = "t0"):
         self.tracer = Tracer(name=name)
         self.metrics = MetricsRegistry()
+        # backref so active_metrics() can resolve the executing node's
+        # registry from the activate() scope its request handlers open
+        self.tracer.owner = self
 
 
 default_telemetry = Telemetry()

@@ -118,6 +118,9 @@ class SoakReport:
     faults_injected: list = field(default_factory=list)
     invariants_checked: int = 0
     flood: dict = field(default_factory=dict)
+    # aggregate span-exporter accounting across nodes at final quiesce
+    # (the telemetry-bounded invariant's post-flush numbers)
+    telemetry: dict = field(default_factory=dict)
     digest: str = ""
 
     def to_dict(self) -> dict:
@@ -129,6 +132,7 @@ class SoakReport:
             "faults_injected": self.faults_injected,
             "invariants_checked": self.invariants_checked,
             "flood": self.flood,
+            "telemetry": self.telemetry,
             "digest": self.digest,
         }
 
@@ -374,9 +378,68 @@ class InteractiveUnderFlood(Invariant):
                          f"{done}/{inter} completed")
 
 
+class TelemetryBounded(Invariant):
+    """Telemetry stays bounded under chaos: each node's span exporter
+    keeps its pending-trace buffer and export queue inside their caps and
+    accounts for every span it was offered —
+    ``exported + dropped + resident == seen`` — while the tracer ring
+    honors its maxlen. At the final quiesce a flush must leave nothing
+    resident: a span fragment surviving kill/heal cycles in the pending
+    buffer would be a leak (its trace's local root never completed and
+    eviction never claimed it)."""
+
+    name = "telemetry-bounded"
+
+    def at_probe(self, h: "SoakHarness") -> None:
+        for nid, node in h.nodes.items():
+            tracer = node.telemetry.tracer
+            if len(tracer.finished_spans()) > tracer.max_finished:
+                h.fail(self, f"span ring on {nid} exceeds maxlen "
+                             f"{tracer.max_finished}")
+            exp = tracer.exporter
+            if exp is None:
+                continue
+            st = exp.snapshot_stats()
+            if st["pending_traces"] > st["max_pending_traces"]:
+                h.fail(self, f"exporter pending-trace buffer on {nid} "
+                             f"over cap: {st['pending_traces']} > "
+                             f"{st['max_pending_traces']}")
+            if st["queued_spans"] > st["max_queue"]:
+                h.fail(self, f"exporter queue on {nid} over cap: "
+                             f"{st['queued_spans']} > {st['max_queue']}")
+            resident = st["pending_spans"] + st["queued_spans"]
+            accounted = st["spans_exported"] + st["spans_dropped"] + resident
+            if st["spans_seen"] != accounted:
+                h.fail(self, f"exporter accounting broken on {nid}: "
+                             f"seen {st['spans_seen']} != exported "
+                             f"{st['spans_exported']} + dropped "
+                             f"{st['spans_dropped']} + resident {resident}")
+
+    def at_quiesce(self, h: "SoakHarness") -> None:
+        self.at_probe(h)
+        if not h.final_quiesce:
+            return
+        for nid, node in h.nodes.items():
+            exp = node.telemetry.tracer.exporter
+            if exp is None:
+                continue
+            exp.flush()
+            st = exp.snapshot_stats()
+            if st["pending_spans"] or st["queued_spans"]:
+                h.fail(self, f"spans leaked across kill/heal on {nid}: "
+                             f"{st['pending_spans']} pending / "
+                             f"{st['queued_spans']} queued after flush")
+            accounted = st["spans_exported"] + st["spans_dropped"]
+            if st["spans_seen"] != accounted:
+                h.fail(self, f"post-flush accounting broken on {nid}: "
+                             f"seen {st['spans_seen']} != exported+dropped "
+                             f"{accounted}")
+
+
 DEFAULT_INVARIANTS: tuple[Callable[[], Invariant], ...] = (
     AckedWritesSurvive, SnapshotIsolation, RecoveryMonotonicity,
     ShedCorrectness, BoundedQueues, ClusterConverges, InteractiveUnderFlood,
+    TelemetryBounded,
 )
 
 
@@ -618,6 +681,20 @@ class SoakHarness:
             n.bootstrap(self.node_ids)
         for n in self.nodes.values():
             n.start()
+        # span exporters ride the soak: SYNCHRONOUS (no threads under the
+        # deterministic queue), in-memory sinks (no file IO), and a
+        # seed-derived private RNG per node so tail-sampling decisions
+        # replay byte-identically without perturbing the workload streams.
+        # The telemetry-bounded invariant audits their accounting.
+        from opensearch_tpu.telemetry.export import MemorySink, SpanExporter
+
+        for i, nid in enumerate(self.node_ids):
+            self.nodes[nid].telemetry.tracer.exporter = SpanExporter(
+                MemorySink(), service_name=nid,
+                slow_threshold_ms=250, sample_ratio=0.25,
+                rng=random.Random(cfg.seed * 31_337 + 11 + i),
+                synchronous=True, mode="memory",
+            )
         self.client = SoakClient(self)
         # seed-derived decision streams, independent of the queue's RNG so
         # transport-delay draws can't shift workload plans
@@ -1382,6 +1459,15 @@ class SoakHarness:
         for inv in self.invariants:
             inv.at_quiesce(self)
         self.report.flood = dict(self.flood_stats)
+        totals = {"spans_seen": 0, "spans_exported": 0, "spans_dropped": 0}
+        for node in self.nodes.values():
+            exp = node.telemetry.tracer.exporter
+            if exp is None:
+                continue
+            st = exp.snapshot_stats()
+            for k in totals:
+                totals[k] += st[k]
+        self.report.telemetry = totals
         self.report.digest = self.digest()
 
     def close(self) -> None:

@@ -47,4 +47,6 @@ if [[ "${1:-}" == "--bench" ]]; then
   python bench.py --gate
   echo "== shard-mesh gate (quick cluster run vs BENCH_MESH.json) =="
   python bench.py --mesh-gate
+  echo "== otel-overhead gate (span export must cost <= 5% QPS) =="
+  python bench.py --otel-overhead
 fi

@@ -1,0 +1,22 @@
+"""TPU013 true positives: metric names BUILT at the record site — each
+distinct interpolation mints a fresh Prometheus series forever."""
+
+
+def per_index_histogram(metrics, index, took_ms):
+    metrics.histogram(f"search.took_ms.{index}").record(took_ms)  # EXPECT: TPU013
+
+
+def concatenated_counter(metrics, shard):
+    metrics.counter("knn.dispatches." + str(shard)).add(1)  # EXPECT: TPU013
+
+
+def percent_formatted(metrics, node_id, wait):
+    metrics.histogram("queue.wait.%s" % node_id).record(wait)  # EXPECT: TPU013
+
+
+def format_call(metrics, kind):
+    metrics.counter("ops.{}.total".format(kind)).add(1)  # EXPECT: TPU013
+
+
+def joined_name(metrics, parts, value):
+    metrics.histogram(".".join(parts)).record(value)  # EXPECT: TPU013

@@ -1,0 +1,41 @@
+"""TPU013 false-positive guards: every accepted metric-name shape.
+
+- string literals at the record site;
+- module-level registered constants (Name or Attribute reads);
+- plain variables (the build site, not the record site, is flagged);
+- f-strings in NON-metric calls (log lines, span names) stay untouched.
+"""
+
+import logging
+
+QUEUE_WAIT_MS = "knn.batch.queue_wait_ms"
+
+
+class Names:
+    DISPATCHES = "knn.batch.dispatches"
+
+
+def literal_name(metrics, wait_ms):
+    metrics.histogram("knn.batch.queue_wait_ms").record(wait_ms)
+    metrics.counter("knn.batch.dispatches").add(1)
+
+
+def registered_constant(metrics, wait_ms):
+    metrics.histogram(QUEUE_WAIT_MS).record(wait_ms)
+    metrics.counter(Names.DISPATCHES).add(1)
+
+
+def name_in_variable(metrics, wait_ms):
+    name = QUEUE_WAIT_MS
+    metrics.histogram(name).record(wait_ms)
+
+
+def fstrings_elsewhere_are_fine(tracer, index, took_ms):
+    logging.getLogger(__name__).info(f"search on {index} took {took_ms}ms")
+    with tracer.start_span("search", {"index": f"{index}"}):
+        pass
+
+
+def non_metric_counter_calls(collections, items):
+    # collections.Counter is a constructor, not a metrics record site
+    return collections.Counter(f"{items}")

@@ -393,3 +393,143 @@ def test_flush_and_forcemerge_through_cluster(cluster_ports):
                         {"query": {"match_all": {}}, "size": 0,
                          "track_total_hits": True})
     assert status == 200 and resp["hits"]["total"]["value"] == 60
+
+
+# -- ISSUE 8: the closed telemetry loop, live over REST ---------------------
+
+
+async def _http_text(port: int, path: str, timeout: float = 10.0) -> str:
+    """Raw-text GET (the prometheus exposition is not JSON)."""
+
+    async def _exchange():
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        try:
+            writer.write((f"GET {path} HTTP/1.1\r\nhost: x\r\n"
+                          f"content-length: 0\r\n\r\n").encode())
+            await writer.drain()
+            await reader.readline()
+            length = 0
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode().partition(":")
+                if k.strip().lower() == "content-length":
+                    length = int(v)
+            return (await reader.readexactly(length)).decode()
+        finally:
+            writer.close()
+
+    return await asyncio.wait_for(_exchange(), timeout)
+
+
+def test_telemetry_loop_closes_over_rest(cluster_ports):
+    """Acceptance: dynamic settings turn on the file exporter with a 0ms
+    slow threshold, a search's trace is (a) tail-kept and present in the
+    OTLP-JSON export with a coordinator->node->reduce tree, (b) reachable
+    from the Prometheus exemplar on its latency bucket, and (c) visible in
+    ONE cluster-wide _nodes/stats response carrying every node's ring."""
+    from pathlib import Path
+
+    from opensearch_tpu.telemetry.export import parse_otlp
+
+    loop, ports = cluster_ports
+    status, resp = _req(loop, ports["n0"], "PUT", "/_cluster/settings", {
+        "persistent": {"telemetry": {"tracing": {
+            "exporter": "file", "slow_threshold_ms": "0ms",
+            "sample_ratio": 0.0}}},
+    })
+    assert status == 200, resp
+    # a query through n0: with threshold 0ms every trace counts as slow
+    status, resp = _req(loop, ports["n0"], "POST", "/items/_search",
+                        {"query": {"match": {"title": "alpha"}}})
+    assert status == 200 and resp["hits"]["hits"], resp
+
+    # (c) ONE cluster-wide _nodes/stats with every node's ring + exporter
+    status, stats = _req(loop, ports["n1"], "GET", "/_nodes/stats")
+    assert status == 200, stats
+    assert stats["_nodes"]["successful"] == 3, stats["_nodes"]
+    assert set(stats["nodes"]) == {"n0", "n1", "n2"}
+    for nid, entry in stats["nodes"].items():
+        assert "spans" in entry["telemetry"], nid
+        assert entry["telemetry"]["exporter"]["mode"] == "file", nid
+    coord_spans = [s for s in stats["nodes"]["n0"]["telemetry"]["spans"]
+                   if s["name"] == "search.coordinator"]
+    assert coord_spans, "coordinator span missing from n0's ring"
+    trace_id = coord_spans[-1]["trace_id"]
+
+    # (a) the trace was tail-kept and exported as OTLP-JSON with the tree
+    exporter_stats = stats["nodes"]["n0"]["telemetry"]["exporter"]
+    assert exporter_stats["traces_kept_slow"] >= 1, exporter_stats
+    export_path = Path(exporter_stats["sink"]["path"])
+    assert export_path.exists(), export_path
+    # the exporter worker drains asynchronously: poll briefly
+    import time as _time
+
+    exported = []
+    for _ in range(40):
+        exported = [s for line in export_path.read_text().splitlines()
+                    for s in parse_otlp(json.loads(line))
+                    if s.trace_id == trace_id]
+        if any(s.name == "search.coordinator" for s in exported):
+            break
+        _time.sleep(0.05)
+    names = {s.name for s in exported}
+    assert "search.coordinator" in names, names
+    assert "search.reduce" in names, names
+    by_id = {s.span_id: s for s in exported}
+    (root,) = [s for s in exported
+               if s.parent_id is None or s.parent_id not in by_id]
+    # the REST layer's http_request span roots the tree; the coordinator
+    # and reduce spans hang under it
+    assert root.name == "http_request"
+    (coord_exported,) = [s for s in exported
+                         if s.name == "search.coordinator"]
+    assert coord_exported.parent_id == root.span_id
+    (reduce_exported,) = [s for s in exported if s.name == "search.reduce"]
+    assert reduce_exported.parent_id == coord_exported.span_id
+
+    # (b) the prometheus exemplar on the took histogram links to a trace
+    # (?exemplars=true: the suffix is OpenMetrics-only syntax, opted into
+    # by the scrape job; the default exposition stays classic-parseable)
+    plain = loop.run_until_complete(
+        _http_text(ports["n0"], "/_prometheus/metrics"))
+    assert " # {trace_id=" not in plain
+    text = loop.run_until_complete(
+        _http_text(ports["n0"], "/_prometheus/metrics?exemplars=true"))
+    ex_lines = [ln for ln in text.splitlines()
+                if "search_took_ms_bucket" in ln and " # {trace_id=" in ln]
+    assert ex_lines, "no exemplar on the took histogram"
+    ex_trace = ex_lines[0].split('trace_id="')[1].split('"')[0]
+    ring_traces = {s["trace_id"]
+                   for s in stats["nodes"]["n0"]["telemetry"]["spans"]}
+    assert ex_trace in ring_traces, "exemplar trace not in the ring"
+
+    # federated scrape: per-node labels, one request. Each node records
+    # search.took_ms when IT coordinates, so route one search through
+    # every node first.
+    for nid in ("n1", "n2"):
+        status, resp = _req(loop, ports[nid], "POST", "/items/_search",
+                            {"query": {"match_all": {}}, "size": 1})
+        assert status == 200, resp
+    fed = loop.run_until_complete(
+        _http_text(ports["n2"], "/_prometheus/metrics?cluster=true"))
+    for nid in ("n0", "n1", "n2"):
+        assert f'node="{nid}"' in fed, f"{nid} missing from federated view"
+    assert 'opensearch_tpu_search_total{node="n0"}' in fed
+
+
+def test_nodes_stats_metric_filter_cluster(cluster_ports):
+    loop, ports = cluster_ports
+    status, stats = _req(loop, ports["n0"], "GET",
+                         "/_nodes/stats/knn_batch")
+    assert status == 200, stats
+    for entry in stats["nodes"].values():
+        assert "knn_batch" in entry
+        assert "telemetry" not in entry
+    status, stats = _req(loop, ports["n0"], "GET",
+                         "/_nodes/stats/shard_mesh")
+    assert status == 200, stats
+    assert all("shard_mesh" in e for e in stats["nodes"].values())
+    status, resp = _req(loop, ports["n0"], "GET", "/_nodes/stats/bogus")
+    assert status == 400, resp

@@ -41,6 +41,21 @@ def test_soak_mesh_seed_exercises_sharded_launch(tmp_path):
     assert mesh_stats["launches"] >= launches
 
 
+def test_soak_telemetry_stays_bounded(tmp_path):
+    """ISSUE 8 satellite: span exporters ride every soak node (synchronous,
+    memory-sink, seed-derived sampling) and the telemetry-bounded invariant
+    holds under chaos — queue/ring caps respected, every span accounted
+    (exported + dropped + resident == seen), nothing resident after the
+    final flush. Runs on an existing soak seed (7, the tier-1 subset)."""
+    report = run_soak(7, tmp_path, **SUBSET)
+    t = report.telemetry
+    assert t["spans_seen"] > 0, "soak produced no spans to export"
+    assert t["spans_exported"] > 0, \
+        "tail sampler kept nothing (error/slow traces exist under chaos)"
+    # post-flush: everything offered was either exported or dropped
+    assert t["spans_seen"] == t["spans_exported"] + t["spans_dropped"]
+
+
 def test_soak_deterministic_subset_green(tmp_path):
     """The tier-1 soak: 2 chaos cycles of mixed ingest + query + faults,
     every default invariant passing at each quiesce."""

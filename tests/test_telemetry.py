@@ -130,6 +130,49 @@ class TestProfile:
         res = node.search("t", {"query": {"match_all": {}}})
         assert "profile" not in res
 
+    def test_profile_covers_fetch_subphases(self, node):
+        """ISSUE 8 tentpole (4): `"profile": true` breaks the fetch phase
+        into sub-phases (source load, highlight, stored/doc-value fields)
+        the way the operator tree covers query/aggs."""
+        res = node.search("t", {
+            "profile": True,
+            "query": {"match": {"msg": "message"}},
+            "highlight": {"fields": {"msg": {}}},
+        })
+        shards = res["profile"]["shards"]
+        assert all("fetch" in sh for sh in shards)
+        fetched = [sh["fetch"] for sh in shards
+                   if sh["fetch"]["debug"]["hits_fetched"]]
+        assert fetched, "no shard profiled any fetched hit"
+        total_src = sum(f["breakdown"]["load_source"] for f in fetched)
+        total_hl = sum(f["breakdown"]["highlight"] for f in fetched)
+        assert total_src > 0 and total_hl > 0
+        assert sum(f["breakdown"]["load_source_count"] for f in fetched) \
+            == sum(f["debug"]["hits_fetched"] for f in fetched)
+        # sub-phases that ran appear as children with the reference's
+        # subphase class names; absent ones don't
+        kinds = {c["type"] for f in fetched for c in f["children"]}
+        assert {"FetchSourcePhase", "HighlightPhase"} <= kinds
+        assert "ScriptFieldsPhase" not in kinds
+        for f in fetched:
+            assert f["time_in_nanos"] == sum(
+                f["breakdown"][k] for k in f["breakdown"]
+                if not k.endswith("_count"))
+
+    def test_fetch_profile_rides_cluster_partials(self, node):
+        """Partial (wire) responses carry the fetch section too, so the
+        cluster coordinator's profile merge includes it per shard."""
+        from opensearch_tpu.search import service as search_service
+
+        svc = node.indices["t"]
+        shards = list(svc.shards.values())
+        resp = search_service.search(
+            shards, {"profile": True,
+                     "query": {"match": {"msg": "message"}}},
+            partial=True, shard_numbers=list(range(len(shards))),
+        )
+        assert all("fetch" in sh for sh in resp["profile"]["shards"])
+
 
 class TestTraceContextPropagation:
     def test_restore_context_stitches_across_tracers(self):
@@ -219,6 +262,8 @@ class TestPrometheusExposition:
         for line in text.splitlines():
             if not line or line.startswith("#"):
                 continue
+            # strip an OpenMetrics exemplar suffix (` # {trace_id=...} v`)
+            line = line.split(" # ")[0]
             name, _, value = line.rpartition(" ")
             samples[name] = float(value)
         return text, samples
@@ -328,3 +373,30 @@ class TestTraceIntegration:
         # nothing leaked into the process-global fallback ring
         assert not any(s.name == "search.rescore"
                        for s in default_telemetry.tracer.finished_spans())
+
+    def test_singleton_metrics_attribute_to_executing_node(self):
+        """Process-wide singletons (kNN batcher, shard-mesh registry)
+        record into the node handling the current request, not whichever
+        in-process sim node attached its metrics sink last — else the
+        federated scrape folds every node's launches under one label and
+        the exemplar trace_id points into the wrong node's ring."""
+        from opensearch_tpu.cluster.shard_mesh import (
+            MESH_LAUNCH_WALL_MS, ShardMeshRegistry,
+        )
+        from opensearch_tpu.telemetry.tracing import Telemetry, activate
+
+        tel_a, tel_b = Telemetry("na"), Telemetry("nb")
+        registry = ShardMeshRegistry()
+        registry.metrics = tel_b.metrics  # "last-constructed node" sink
+        with activate(tel_a.tracer), tel_a.tracer.start_span("search"):
+            registry.record_launch_wall(7_000_000)
+        hist_a = tel_a.metrics.stats()["histograms"]
+        assert MESH_LAUNCH_WALL_MS in hist_a
+        assert MESH_LAUNCH_WALL_MS not in tel_b.metrics.stats()["histograms"]
+        # the exemplar resolves in the SAME node's ring
+        ring = {s.trace_id for s in tel_a.tracer.finished_spans()}
+        exemplars = hist_a[MESH_LAUNCH_WALL_MS]["exemplars"]
+        assert exemplars and all(ex["trace_id"] in ring for ex in exemplars)
+        # outside any request scope the attached sink still receives
+        registry.record_launch_wall(3_000_000)
+        assert MESH_LAUNCH_WALL_MS in tel_b.metrics.stats()["histograms"]
