@@ -934,6 +934,272 @@ def concurrency_child() -> None:
     }))
 
 
+ANN_OUT = Path(__file__).resolve().parent / "BENCH_ANN.json"
+ANN_BUDGET_S = int(os.environ.get("BENCH_ANN_BUDGET_S", "900"))
+ANN_CLIENTS = int(os.environ.get("BENCH_ANN_CLIENTS", "16"))
+ANN_QUERIES = int(os.environ.get("BENCH_ANN_QUERIES", "60"))
+# the recall ratchet (ISSUE 9 acceptance): ANN serving may never silently
+# buy speed with recall — batched IVF-PQ must hold recall@10 vs the exact
+# scan at or above this floor, at EVERY adc precision
+ANN_RECALL_FLOOR = float(os.environ.get("BENCH_ANN_RECALL_FLOOR", "0.95"))
+# and the batched path must actually amortize launches: batched/unbatched
+# QPS at the default precision
+ANN_MIN_SPEEDUP = float(os.environ.get("BENCH_ANN_MIN_SPEEDUP", "1.3"))
+
+
+def ann_parent() -> int:
+    """`bench.py --ann`: batched IVF-PQ serving bench — ANN_CLIENTS
+    concurrent clients against one ivf_pq index, dispatch batcher ON vs
+    OFF, per ADC precision (fp32/bf16/int8), with recall@10 of the SERVED
+    ANN path measured against the exact scan on an identical corpus.
+    Writes BENCH_ANN.json keyed by platform. Headline value is batched
+    fp32 QPS; vs_baseline the batched/unbatched speedup. Exits 1 when the
+    recall ratchet (>= ANN_RECALL_FLOOR at every precision) or the
+    speedup floor (>= ANN_MIN_SPEEDUP) fails."""
+    platform = _detect_platform()
+    result, reason = _run(["--ann-child"], ANN_BUDGET_S,
+                          platform_env="cpu" if platform == "cpu" else None)
+    if result is None:
+        print(json.dumps({
+            "metric": "bench_error", "value": 0, "unit": "error",
+            "vs_baseline": 0, "detail": f"ann child failed: {reason}",
+        }))
+        return 1
+    recalls = result.get("recall_at_10", {})
+    min_recall = min(recalls.values()) if recalls else 0.0
+    speedup = float(result.get("vs_baseline", 0.0))
+    ok = min_recall >= ANN_RECALL_FLOOR and speedup >= ANN_MIN_SPEEDUP
+    result["ok"] = ok
+    result["recall_floor"] = ANN_RECALL_FLOOR
+    result["min_speedup"] = ANN_MIN_SPEEDUP
+    if not ok:
+        result["detail"] = (
+            f"recall@10 min {min_recall:.3f} (floor {ANN_RECALL_FLOOR}) / "
+            f"batched speedup {speedup:.2f}x (floor {ANN_MIN_SPEEDUP}x)")
+    book = _load_book(ANN_OUT)
+    book[result.get("platform", "cpu")] = result
+    try:
+        ANN_OUT.write_text(json.dumps(book, indent=1) + "\n")
+    except OSError as e:
+        result["write_error"] = str(e)
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+def ann_gate_parent() -> int:
+    """`bench.py --ann-gate`: the check.sh gate for the ANN serving path —
+    a QUICK run must (a) hold the recall@10 ratchet at every precision,
+    (b) keep the batched speedup above ANN_MIN_SPEEDUP, and (c) stay
+    within the platform tolerance of BENCH_ANN.json's recorded QPS (same
+    contract as the streaming/mesh gates; no baseline => (c) passes with
+    a note)."""
+    platform = _detect_platform()
+    result, reason = _run(
+        ["--ann-child"], ANN_BUDGET_S,
+        platform_env="cpu" if platform == "cpu" else None,
+        extra_env={"BENCH_ANN_QUERIES": "30"},
+    )
+    if result is None:
+        print(json.dumps({
+            "metric": "ann_gate", "value": 0, "unit": "error",
+            "vs_baseline": 0,
+            "detail": f"ann gate child failed: {reason}", "ok": False,
+        }))
+        return 1
+    recalls = result.get("recall_at_10", {})
+    min_recall = min(recalls.values()) if recalls else 0.0
+    speedup = float(result.get("vs_baseline", 0.0))
+    out, floor_ok = _gate_compare(
+        "ann_gate", result.get("value", 0),
+        _load_book(ANN_OUT).get(platform), platform,
+        "batched ANN regression")
+    ratchet_ok = min_recall >= ANN_RECALL_FLOOR
+    speed_ok = speedup >= ANN_MIN_SPEEDUP
+    ok = floor_ok and ratchet_ok and speed_ok
+    out.update({
+        "ok": ok,
+        "recall_at_10": recalls,
+        "recall_floor": ANN_RECALL_FLOOR,
+        "batched_speedup": speedup,
+        "min_speedup": ANN_MIN_SPEEDUP,
+    })
+    if not ratchet_ok:
+        out["detail"] = (f"recall@10 ratchet broken: min {min_recall:.3f} "
+                         f"< {ANN_RECALL_FLOOR}")
+    elif not speed_ok:
+        out["detail"] = (f"batched ANN speedup {speedup:.2f}x below "
+                         f"{ANN_MIN_SPEEDUP}x floor")
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+def ann_child() -> None:
+    """One node, twin indices over an identical clustered corpus — `ann`
+    (ivf_pq) and `exact` (flat scan, the ground truth) — serving
+    ANN_CLIENTS concurrent clients. Measures, through the REAL search
+    API: recall@10 of the served ANN path per adc precision, unbatched
+    ANN QPS (batcher off), and batched ANN QPS per precision."""
+    import tempfile
+    import threading
+
+    _pin_platform()
+    import numpy as np
+
+    import jax
+
+    from opensearch_tpu.node import TpuNode
+    from opensearch_tpu.search import ann as ann_mod
+
+    platform = jax.devices()[0].platform
+    d = 64
+    n_docs = 4_000 if platform == "cpu" else 50_000
+    clients = ANN_CLIENTS
+    per_client = int(os.environ.get("BENCH_ANN_QUERIES", ANN_QUERIES))
+    n_recall_q = 48
+
+    # clustered corpus: IVF coarse quantization needs real cluster
+    # structure for nprobe lists to cover the true neighbors
+    rng = np.random.default_rng(23)
+    n_centers = 16
+    centers = rng.standard_normal((n_centers, d)).astype(np.float32) * 5.0
+    data = (centers[rng.integers(0, n_centers, n_docs)]
+            + rng.standard_normal((n_docs, d))).astype(np.float32)
+
+    tmp = Path(tempfile.mkdtemp(prefix="bench_ann_"))
+    node = TpuNode(tmp / "node")
+    node.create_index("ann", {
+        "settings": {"number_of_shards": 1},
+        "mappings": {"properties": {"v": {
+            "type": "knn_vector", "dimension": d,
+            "method": {"name": "ivf_pq", "parameters": {
+                "nlist": 32, "m": 8, "nprobe": 8}},
+        }}},
+    })
+    node.create_index("exact", {
+        "settings": {"number_of_shards": 1},
+        "mappings": {"properties": {
+            "v": {"type": "knn_vector", "dimension": d},
+        }},
+    })
+    for index in ("ann", "exact"):
+        node.bulk([
+            ("index", {"_index": index, "_id": str(i)},
+             {"v": data[i].round(4).tolist()})
+            for i in range(n_docs)
+        ], refresh=True)
+
+    queries = [
+        (centers[rng.integers(0, n_centers)]
+         + rng.standard_normal(d)).astype(np.float32).tolist()
+        for _ in range(max(clients * per_client, n_recall_q))
+    ]
+
+    def search(index, q):
+        return node.search(index, {"size": 10, "query": {
+            "knn": {"v": {"vector": q, "k": 10}}}})
+
+    def hit_ids(resp):
+        return {h["_id"] for h in resp["hits"]["hits"]}
+
+    truth = [hit_ids(search("exact", q)) for q in queries[:n_recall_q]]
+
+    def recall_round() -> float:
+        got = [hit_ids(search("ann", q)) for q in queries[:n_recall_q]]
+        return float(np.mean([
+            len(g & t) / max(len(t), 1) for g, t in zip(got, truth)
+        ]))
+
+    def qps_round() -> float:
+        done = [0] * clients
+        barrier = threading.Barrier(clients + 1)
+
+        def client(ci):
+            mine = queries[ci * per_client:(ci + 1) * per_client]
+            barrier.wait()
+            for q in mine:
+                search("ann", q)
+                done[ci] += 1
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        return sum(done) / (time.perf_counter() - t0)
+
+    def configure_batcher(enabled: bool) -> None:
+        node.knn_batcher.configure(
+            enabled=enabled, max_batch_size=clients, max_wait_ms=3,
+            max_queue=4 * clients * per_client,
+        )
+        node.knn_batcher.reset()
+
+    def warm_concurrent() -> None:
+        # compile every power-of-two batch width this config can produce
+        # BEFORE the timed round (arrivals split unpredictably, and a
+        # retrace inside the measurement would bill compile time as
+        # serving time)
+        barrier = threading.Barrier(clients)
+
+        def warm(ci):
+            barrier.wait()
+            for q in queries[ci::clients][:4]:
+                search("ann", q)
+
+        threads = [threading.Thread(target=warm, args=(ci,))
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    # the serving knob pair under test: widened exact-rescore pool (the
+    # ANNS-AMP recall recovery) on top of each ADC precision
+    ann_mod.default_config.configure(rescore_multiplier=8)
+    recalls: dict = {}
+    qps_batched: dict = {}
+    for precision in ("fp32", "bf16", "int8"):
+        ann_mod.default_config.configure(adc_precision=precision)
+        configure_batcher(True)
+        recalls[precision] = round(recall_round(), 4)  # solo-width warm
+        warm_concurrent()
+        node.knn_batcher.reset()
+        qps_batched[precision] = round(qps_round(), 1)
+
+    # the headline comparison runs in ALTERNATING repeats (off, on, ...)
+    # with per-config medians — a co-tenant CPU burst hits both sides
+    # instead of poisoning one (same symmetry recipe as the otel bench)
+    ann_mod.default_config.configure(adc_precision="fp32")
+    reps = int(os.environ.get("BENCH_ANN_REPS", "3"))
+    walls: dict = {False: [], True: []}
+    configure_batcher(False)
+    for q in queries[:4]:
+        search("ann", q)  # warm the solo program shapes
+    for _ in range(reps):
+        for enabled in (False, True):
+            configure_batcher(enabled)
+            walls[enabled].append(qps_round())
+    qps_unbatched = round(float(np.median(walls[False])), 1)
+    qps_batched["fp32"] = round(float(np.median(walls[True])), 1)
+    node.close()
+
+    speedup = qps_batched["fp32"] / max(qps_unbatched, 1e-9)
+    print(json.dumps({
+        "metric": f"ann_knn_batched_{clients}x{per_client}",
+        "value": qps_batched["fp32"],
+        "unit": "queries/s",
+        "vs_baseline": round(speedup, 3),
+        "platform": platform,
+        "qps_batched": qps_batched,
+        "qps_unbatched_fp32": qps_unbatched,
+        "recall_at_10": recalls,
+        "corpus": {"docs": n_docs, "dim": d, "nlist": 32, "nprobe": 8},
+    }))
+
+
 def _pin_platform():
     import jax
 
@@ -1140,6 +1406,20 @@ if __name__ == "__main__":
             }))
             sys.exit(1)
         sys.exit(0)
+    if "--ann-child" in sys.argv:
+        try:
+            ann_child()
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "metric": "bench_error", "value": 0, "unit": "error",
+                "vs_baseline": 0, "detail": str(e)[:200],
+            }))
+            sys.exit(1)
+        sys.exit(0)
+    if "--ann-gate" in sys.argv:
+        sys.exit(ann_gate_parent())
+    if "--ann" in sys.argv:
+        sys.exit(ann_parent())
     if "--otel-overhead" in sys.argv:
         sys.exit(otel_parent())
     if "--gate" in sys.argv:

@@ -146,6 +146,13 @@ class ClusterNode:
         self.settings_consumers.register(
             "search.knn.batch.", self.knn_batcher.apply_settings
         )
+        # ANN serving knobs (search/ann.py): process-wide like the batcher,
+        # applied live the same way
+        from opensearch_tpu.search import ann as _ann_mod
+
+        self.settings_consumers.register(
+            "search.knn.ann.", _ann_mod.default_config.apply_settings
+        )
         # span exporter: per-node (its ring is per-node); dynamic
         # telemetry.tracing.* updates rebuild/retune it at state application
         from opensearch_tpu.telemetry.export import apply_tracing_settings
@@ -911,6 +918,20 @@ class ClusterNode:
                     "seq_no": entry2.seq_no if entry2 else 0,
                     "routing": None,
                 })
+        # tombstones make the dump a COMPLETE logical point-in-time copy:
+        # a STALE target (an old replica re-recovering after a fault) may
+        # still hold docs deleted here while it was away — live docs alone
+        # can't tell it, and the checkpoint fast-forward at the end of the
+        # dump apply would jump the delete's seq_no without ever applying
+        # it (a lost delete: the doc resurrects on the replica). Shipping
+        # each retained tombstone at its TRUE seq_no lets the target apply
+        # the miss; the per-doc stale check keeps replays idempotent.
+        ops.extend(sorted(
+            ({"op": "delete", "id": doc_id, "seq_no": entry3.seq_no}
+             for doc_id, entry3 in engine.version_map.items()
+             if entry3.deleted),
+            key=lambda o: o["seq_no"],
+        ))
         # the dump stays on the source as a SESSION; the target pulls it in
         # bounded batches (chunked phase2 instead of one giant frame)
         self.recovery_stats["dump_based"] += 1

@@ -15,6 +15,8 @@ Padding invariants relied on by the ops kernels:
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass
 
 import jax
@@ -26,6 +28,17 @@ from opensearch_tpu.index.segment import (
     pad_size,
     split_i64,
 )
+
+# IVF-PQ publish-time build accounting (surfaced via the knn_batch stats
+# section's `ann.index_builds`): builds happen on the refresh/merge path,
+# which can run concurrently with stats readers
+_ann_build_lock = threading.Lock()
+_ann_build_stats = {"builds": 0, "build_wall_ns": 0, "last_generation": 0}
+
+
+def ann_build_stats() -> dict:
+    with _ann_build_lock:
+        return dict(_ann_build_stats)
 
 
 def _pad1(a: np.ndarray, n: int, fill=0) -> np.ndarray:
@@ -125,15 +138,23 @@ def _maybe_build_ann(vf, device):
     while dims % m != 0 and m > 1:
         m -= 1
     doc_ids = np.nonzero(vf.present)[0].astype(np.int32)
+    t0 = time.perf_counter_ns()
     ann = ivfpq.build(
         vf.vectors[doc_ids],
         doc_ids,
         nlist=int(params.get("nlist", ivfpq.DEFAULT_NLIST)),
         m=m,
+        ks=int(params.get("ks", ivfpq.DEFAULT_KS)),
         iters=int(params.get("iters", 10)),
         normalized=vf.similarity in ("cosine", "cosinesimil"),
         device=device,
     )
+    with _ann_build_lock:
+        _ann_build_stats["builds"] += 1
+        _ann_build_stats["build_wall_ns"] += time.perf_counter_ns() - t0
+        # the newest generation published by THIS process: serving batch
+        # keys carry it, so a stats reader can line launches up with builds
+        _ann_build_stats["last_generation"] = ann.build_generation
     return ann, int(params.get("nprobe", ivfpq.DEFAULT_NPROBE))
 
 

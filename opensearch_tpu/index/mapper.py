@@ -292,6 +292,46 @@ def parse_date_nanos(value: Any) -> int:
 
 import re as _re_mod
 
+# ANN method config (k-NN plugin style) accepted on dense_vector fields.
+# Only the IVF-PQ family is validated strictly — the index build at publish
+# time (index/device._maybe_build_ann) consumes exactly these parameters,
+# so a typo'd key or an impossible shape must 400 at mapping time, not
+# fail (or be silently ignored by) the refresh-time build.
+_IVF_METHOD_NAMES = {"ivf_pq", "ivfpq", "ivf"}
+_IVF_INT_PARAMS = {"nlist", "m", "code_size", "ks", "nprobe", "min_train",
+                   "iters"}
+
+
+def validate_ann_method(full: str, method: dict, dims: int) -> None:
+    name = str(method.get("name", "")).lower().replace("-", "_")
+    if name not in _IVF_METHOD_NAMES:
+        return  # other engines' configs pass through untouched
+    params = method.get("parameters")
+    if params is None:
+        return
+    if not isinstance(params, dict):
+        raise MapperParsingException(
+            f"[method.parameters] of field [{full}] must be an object"
+        )
+    for key, value in params.items():
+        if key not in _IVF_INT_PARAMS:
+            raise MapperParsingException(
+                f"unknown [method.parameters] key [{key}] for ivf_pq "
+                f"field [{full}] (known: {sorted(_IVF_INT_PARAMS)})"
+            )
+        if isinstance(value, bool) or not isinstance(value, int) \
+                or value < 1:
+            raise MapperParsingException(
+                f"[method.parameters.{key}] of field [{full}] must be a "
+                f"positive integer, got [{value!r}]"
+            )
+    m = params.get("m", params.get("code_size"))
+    if m is not None and dims % int(m) != 0:
+        raise MapperParsingException(
+            f"[method.parameters.m]=[{m}] of field [{full}] must divide "
+            f"the vector dimension [{dims}]"
+        )
+
 _re_frac = _re_mod.compile(r"\.(\d+)")
 
 
@@ -520,6 +560,8 @@ class MapperService:
             raise MapperParsingException(
                 f"dense_vector field [{full}] requires positive [dims]"
             )
+        if ftype == "dense_vector" and mapper.method is not None:
+            validate_ann_method(full, mapper.method, mapper.dims)
         existing = self.mappers.get(full)
         if existing is not None and existing.type != mapper.type:
             raise IllegalArgumentException(

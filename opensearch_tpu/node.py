@@ -3528,6 +3528,12 @@ class TpuNode:
         )
         if any(s.key in eff or s.key in changed for s in BATCH_SETTINGS):
             self.knn_batcher.apply_settings(eff)
+        # ANN serving knobs share the batcher's process-wide guard: only an
+        # update that actually names an ANN key may touch the live config
+        from opensearch_tpu.search.ann import ANN_SETTINGS, default_config
+
+        if any(s.key in eff or s.key in changed for s in ANN_SETTINGS):
+            default_config.apply_settings(eff)
         self.request_cache.set_max_bytes(
             CACHE_SIZE_SETTING.get(Settings.from_flat(eff)))
         # span exporter: per-node (like the request cache), applies

@@ -12,12 +12,22 @@ IVF-PQ path; BASELINE configs #2/#3). Everything heavy runs on device:
   vmapped k-means (all m subspaces in one program).
 - The built index is a padded, static-shape layout: codes [nlist, L_pad, m]
   uint8 + ids/mask — the TPU analog of FAISS's inverted lists.
-- Search is one fused program per (k, nprobe) shape: coarse top-nprobe,
-  per-probe LUT build ([B, nprobe, m, ks] einsum), ADC gather-accumulate,
-  candidate top-R, then an exact fp32 rescore pass over gathered full
-  vectors (the FusionANNS-style rerank SURVEY.md §7 calls for) ending in
-  jax.lax.top_k. Scores land in the k-NN plugin's score space so ANN and
-  exact hits merge comparably.
+- Search is one fused program per (k, nprobe, adc precision) shape: coarse
+  top-nprobe, per-probe LUT build ([B, nprobe, m, ks] einsum), ADC
+  gather-accumulate, candidate top-R, then an exact fp32 rescore pass over
+  gathered full vectors (the FusionANNS-style rerank SURVEY.md §7 calls
+  for) ending in jax.lax.top_k. Scores land in the k-NN plugin's score
+  space so ANN and exact hits merge comparably.
+- ADC accumulation precision is a static knob (ANNS-AMP): "fp32" is the
+  reference, "bf16" halves LUT bytes through the gather, "int8" quantizes
+  each (query, probe) LUT affinely to uint8 and accumulates in int32.
+  Reduced precision only ranks CANDIDATES — the widened rescore pool R
+  (``rescore_multiplier``) feeds the exact fp32 rescore, which restores
+  score fidelity and recovers recall.
+
+Every built index carries a process-unique ``build_generation``: the
+serving tier's batch keys include it so no cross-request batch can ever
+merge queries against two different builds of the same column.
 
 Only l2 and cosine are served by ANN (cosine = l2 on unit-normalized
 vectors); inner-product falls back to the exact scan upstream.
@@ -26,6 +36,7 @@ vectors); inner-product falls back to the exact scan upstream.
 from __future__ import annotations
 
 import functools
+import itertools
 from dataclasses import dataclass
 
 import jax
@@ -38,8 +49,16 @@ DEFAULT_NLIST = 128
 DEFAULT_M = 8
 DEFAULT_KS = 256
 DEFAULT_NPROBE = 8
+# exact-rescore pool width = multiplier * k (floored at 64 candidates)
+DEFAULT_RESCORE_MULTIPLIER = 4
+# ADC accumulation dtypes the fused search compiles for
+ADC_PRECISIONS = ("fp32", "bf16", "int8")
 # below this many docs a flat scan beats list overhead; stay exact
 MIN_TRAIN_DOCS = 512
+
+# monotonically increasing per-process build ids: rebuilds of the same
+# column get a fresh generation, so batch keys never alias across builds
+_build_generation = itertools.count(1)
 
 
 # --------------------------------------------------------------------------
@@ -124,10 +143,15 @@ def train(
         raise ValueError(f"dims [{d}] not divisible by pq m [{m}]")
     ks = min(ks, 256)
     rng = np.random.default_rng(seed)
-    sample_idx = (
-        rng.choice(n, size=train_sample, replace=False) if n > train_sample
-        else np.arange(n)
-    )
+    # bucket the training-sample row count to a power of two: the kmeans /
+    # _train_pq programs are shape-specialized under jit, and index builds
+    # happen on the refresh path — raw corpus sizes would compile a fresh
+    # training program for every distinct segment size (sampling with
+    # replacement when the bucket exceeds n is statistically harmless for
+    # Lloyd's iterations)
+    want = min(n, train_sample)
+    bucket = 1 << (want - 1).bit_length()
+    sample_idx = rng.choice(n, size=bucket, replace=bucket > n)
     sample = jnp.asarray(vectors[sample_idx], jnp.float32)
 
     coarse_init = jnp.asarray(
@@ -161,20 +185,25 @@ def _encode_chunk(chunk: jnp.ndarray, coarse: jnp.ndarray, codebooks: jnp.ndarra
 
 
 def encode(vectors: np.ndarray, params: IVFPQParams, *, chunk: int = 65_536):
-    """Stream-encode the full corpus: (list_ids [n], codes [n, m]) on host."""
+    """Stream-encode the full corpus: (list_ids [n], codes [n, m]) on host.
+
+    Chunks are padded to power-of-two row counts (outputs sliced off) so
+    repeated builds over growing corpora reuse compiled encode programs
+    instead of retracing on every ragged tail."""
     n = vectors.shape[0]
     lists_out = np.empty(n, np.int32)
     codes_out = np.empty((n, params.m), np.uint8)
     for lo in range(0, n, chunk):
         hi = min(lo + chunk, n)
+        rows = hi - lo
+        pad = 1 << (rows - 1).bit_length()
+        block = np.zeros((pad, vectors.shape[1]), np.float32)
+        block[:rows] = vectors[lo:hi]
         l, c = _encode_chunk(
-            jnp.asarray(vectors[lo:hi], jnp.float32),
-            params.coarse,
-            params.codebooks,
-            m=params.m,
+            jnp.asarray(block), params.coarse, params.codebooks, m=params.m,
         )
-        lists_out[lo:hi] = np.asarray(l)
-        codes_out[lo:hi] = np.asarray(c)
+        lists_out[lo:hi] = np.asarray(l)[:rows]
+        codes_out[lo:hi] = np.asarray(c)[:rows]
     return lists_out, codes_out
 
 
@@ -192,6 +221,9 @@ class IVFPQIndex:
     l_pad: int
     n: int
     normalized: bool       # True when built for cosine (unit vectors)
+    # process-unique id of this build: serving batch keys carry it so a
+    # rebuild (refresh / force-merge) can never merge into an old batch
+    build_generation: int = 0
 
 
 def build(
@@ -248,6 +280,7 @@ def build(
         l_pad=l_pad,
         n=n,
         normalized=normalized,
+        build_generation=next(_build_generation),
     )
 
 
@@ -258,7 +291,8 @@ def build(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "nprobe", "rerank", "similarity", "chunk"),
+    static_argnames=("k", "nprobe", "rerank", "similarity", "chunk",
+                     "adc_precision"),
 )
 def search(
     coarse: jnp.ndarray,       # [nlist, d]
@@ -276,13 +310,21 @@ def search(
     rerank: int,
     similarity: str = "l2_norm",
     chunk: int = 8,
+    adc_precision: str = "fp32",
 ):
     """Fused IVF-PQ ADC search + exact fp32 rescore.
 
     Returns (scores [B, k] in k-NN score space, doc_ids [B, k], -1 pads).
     lax.map over query chunks bounds the [chunk, nprobe, L_pad, m] ADC
-    working set regardless of request batch size.
+    working set regardless of request batch size. ``adc_precision``
+    selects the ADC accumulation dtype (candidate RANKING only — the
+    rescore below is always exact fp32).
     """
+    if adc_precision not in ADC_PRECISIONS:
+        raise ValueError(
+            f"unknown adc_precision [{adc_precision}] "
+            f"(choose from {list(ADC_PRECISIONS)})"
+        )
     nlist, l_pad, m = codes.shape
     ks = codebooks.shape[1]
     d = coarse.shape[1]
@@ -317,13 +359,44 @@ def search(
         pcodes = codes[probe].astype(jnp.int32)               # [c, P, L, m]
         pids = ids[probe]                                     # [c, P, L]
         pmask = mask[probe]
-        # ADC: sum_m lut[c,p,m,code]
-        gathered = jnp.take_along_axis(
-            lut[:, :, None, :, :],                            # [c,P,1,m,ks]
-            pcodes[..., None],                                # [c,P,L,m,1]
-            axis=-1,
-        )[..., 0]                                             # [c,P,L,m]
-        adc = jnp.sum(gathered, axis=-1)                      # [c,P,L] ~ d^2
+        # ADC: sum_m lut[c,p,m,code] — accumulation precision is the
+        # ANNS-AMP knob; reduced precision only ranks candidates, the
+        # exact fp32 rescore below restores score fidelity
+        if adc_precision == "int8":
+            # per-(query, probe) affine uint8 quantization of the LUT;
+            # int32 accumulate, then dequantize so candidates stay
+            # comparable ACROSS probes (each probe has its own affine)
+            lo = jnp.min(lut, axis=(-2, -1), keepdims=True)   # [c,P,1,1]
+            hi = jnp.max(lut, axis=(-2, -1), keepdims=True)
+            scale = jnp.maximum(hi - lo, 1e-12) / 255.0
+            lut_q = jnp.clip(
+                jnp.round((lut - lo) / scale), 0.0, 255.0
+            ).astype(jnp.uint8)
+            # gather MOVES uint8 entries (the whole point of this mode:
+            # 1/4 the LUT bytes through the gather); widen only the
+            # gathered [c,P,L,m] values for the int32 accumulate
+            gathered = jnp.take_along_axis(
+                lut_q[:, :, None, :, :],                      # [c,P,1,m,ks]
+                pcodes[..., None],                            # [c,P,L,m,1]
+                axis=-1,
+            )[..., 0]                                         # [c,P,L,m] u8
+            acc = jnp.sum(gathered, axis=-1, dtype=jnp.int32)  # [c,P,L]
+            adc = (acc.astype(jnp.float32) * scale[..., 0, 0][..., None]
+                   + m * lo[..., 0, 0][..., None])
+        elif adc_precision == "bf16":
+            gathered = jnp.take_along_axis(
+                lut.astype(jnp.bfloat16)[:, :, None, :, :],   # [c,P,1,m,ks]
+                pcodes[..., None],                            # [c,P,L,m,1]
+                axis=-1,
+            )[..., 0]                                         # [c,P,L,m]
+            adc = jnp.sum(gathered, axis=-1).astype(jnp.float32)
+        else:
+            gathered = jnp.take_along_axis(
+                lut[:, :, None, :, :],                        # [c,P,1,m,ks]
+                pcodes[..., None],                            # [c,P,L,m,1]
+                axis=-1,
+            )[..., 0]                                         # [c,P,L,m]
+            adc = jnp.sum(gathered, axis=-1)                  # [c,P,L] ~ d^2
         adc = jnp.where(pmask, adc, jnp.inf)
 
         flat_adc = adc.reshape(q.shape[0], nprobe * l_pad)
@@ -368,6 +441,23 @@ def search(
     )
 
 
+def default_rerank(k: int, rescore_multiplier: int | None = None) -> int:
+    """Exact-rescore pool width before the candidate-count clamp."""
+    mult = rescore_multiplier or DEFAULT_RESCORE_MULTIPLIER
+    return max(mult * k, 64)
+
+
+def rescore_pool(index: IVFPQIndex, k: int, nprobe: int,
+                 rerank: int) -> int:
+    """The EFFECTIVE rescore candidate count `search` will use for this
+    index/shape (the same clamp the kernel applies) — surfaced by the
+    profiler so "profile": true shows the real pool width."""
+    nprobe = min(nprobe, index.params.nlist)
+    cap = nprobe * index.l_pad
+    k_eff = min(k, cap)
+    return max(k_eff, min(rerank, cap))
+
+
 def search_index(
     index: IVFPQIndex,
     vectors: jnp.ndarray,
@@ -379,10 +469,13 @@ def search_index(
     nprobe: int | None = None,
     rerank: int | None = None,
     similarity: str = "l2_norm",
+    adc_precision: str = "fp32",
+    rescore_multiplier: int | None = None,
 ):
     """Convenience wrapper binding an IVFPQIndex's arrays to `search`."""
     nprobe = nprobe or DEFAULT_NPROBE
-    rerank = rerank or max(4 * k, 64)
+    if rerank is None:
+        rerank = default_rerank(k, rescore_multiplier)
     similarity = knn_ops.canonical_similarity(similarity)
     if index.normalized:
         q_norm = jnp.linalg.norm(queries, axis=-1, keepdims=True)
@@ -401,4 +494,5 @@ def search_index(
         nprobe=min(nprobe, index.params.nlist),
         rerank=rerank,
         similarity=similarity,
+        adc_precision=adc_precision,
     )

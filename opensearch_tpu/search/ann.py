@@ -1,0 +1,118 @@
+"""Live-tunable ANN serving configuration (the ANNS-AMP knob pair).
+
+The IVF-PQ serving path (executor.shard_knn_selection's ANN branch) reads
+two dynamic settings on every dispatch:
+
+  search.knn.ann.adc_precision       "fp32" | "bf16" | "int8"
+  search.knn.ann.rescore_multiplier  exact-rescore pool = multiplier * k
+
+Reduced-precision ADC (ops/ivfpq.search) only ranks CANDIDATES; the fused
+program always ends in an exact fp32 rescore over the widened pool, so
+recall recovers while the ADC scan sheds bytes (ANNS-AMP, PAPERS.md). Both
+values ride the batch key: flipping a knob mid-stream starts new batches
+under the new configuration and can never re-rank an in-flight one.
+
+The config object is PROCESS-wide for the same reason the kNN dispatch
+batcher is (search/batcher.py `default_batcher`): the executor's dispatch
+sites are module-level code with no node handle, and one process serves
+one device. TpuNode / ClusterNode apply dynamic settings into it with the
+same guarded adapter shape as the batch settings, so a sibling in-process
+node's unrelated update can never clobber live configuration.
+
+``bucket_nprobe`` is the serving tier's nprobe shape policy: nprobe is a
+static jit argument, so raw per-request values would compile one fused
+program per distinct nprobe. Bucketing to the next power of two (clamped
+to nlist) keeps the program cache warm; extra probes only ever ADD recall.
+"""
+
+from __future__ import annotations
+
+from opensearch_tpu.common.settings import Property, Setting
+
+
+def _validate_precision(v: str) -> None:
+    # single source of truth for the precision set is the kernel module
+    # (ops/ivfpq.ADC_PRECISIONS — the dtypes the fused search compiles
+    # for); imported lazily so settings registration stays jax-free
+    from opensearch_tpu.ops.ivfpq import ADC_PRECISIONS
+
+    if v not in ADC_PRECISIONS:
+        raise ValueError(
+            f"unknown [search.knn.ann.adc_precision] value [{v}] "
+            f"(choose from {list(ADC_PRECISIONS)})"
+        )
+
+
+ADC_PRECISION_SETTING: Setting[str] = Setting(
+    "search.knn.ann.adc_precision", "fp32", str,
+    Property.NODE_SCOPE, Property.DYNAMIC,
+    validator=_validate_precision,
+)
+RESCORE_MULTIPLIER_SETTING = Setting.int_setting(
+    "search.knn.ann.rescore_multiplier", 4,
+    Property.NODE_SCOPE, Property.DYNAMIC, min_value=1, max_value=256,
+)
+
+ANN_SETTINGS = (ADC_PRECISION_SETTING, RESCORE_MULTIPLIER_SETTING)
+
+
+def bucket_nprobe(nprobe: int, nlist: int) -> int:
+    """Power-of-two ceiling, clamped to [1, nlist] (nprobe is a static
+    shape arg of the fused search; more probes never lose recall)."""
+    nprobe = max(1, int(nprobe))
+    return min(1 << (nprobe - 1).bit_length(), max(1, int(nlist)))
+
+
+class AnnServingConfig:
+    """Process-wide ANN serving knobs, applied live by the settings tier.
+
+    Fields are plain atomic assignments read racily by design (the
+    dynamic-settings contract, same as KnnDispatchBatcher.configure): a
+    dispatch that read the old values completes under the old policy — and
+    since both values are part of the batch key, never inside a batch
+    formed under the new one.
+    """
+
+    def __init__(self) -> None:
+        from opensearch_tpu.common.settings import Settings
+
+        self.adc_precision: str = ADC_PRECISION_SETTING.default(
+            Settings.EMPTY)
+        self.rescore_multiplier: int = RESCORE_MULTIPLIER_SETTING.default(
+            Settings.EMPTY)
+
+    def configure(self, *, adc_precision: str | None = None,
+                  rescore_multiplier: int | None = None) -> None:
+        if adc_precision is not None:
+            _validate_precision(adc_precision)
+            self.adc_precision = adc_precision
+        if rescore_multiplier is not None:
+            self.rescore_multiplier = max(1, int(rescore_multiplier))
+
+    def apply_settings(self, flat: dict) -> None:
+        """Pick this config's keys out of a flat effective-settings map
+        (the cluster-settings update consumer; absent keys -> defaults)."""
+        from opensearch_tpu.common.settings import Settings
+
+        s = Settings.from_flat({
+            st.key: flat[st.key] for st in ANN_SETTINGS if st.key in flat
+        })
+        self.configure(
+            adc_precision=ADC_PRECISION_SETTING.get(s),
+            rescore_multiplier=RESCORE_MULTIPLIER_SETTING.get(s),
+        )
+
+    def snapshot(self) -> dict:
+        out = {
+            "adc_precision": self.adc_precision,
+            "rescore_multiplier": self.rescore_multiplier,
+        }
+        # index-build accounting (index/device.py): how many IVF-PQ
+        # structures this process built at publish time, and their cost
+        from opensearch_tpu.index.device import ann_build_stats
+
+        out["index_builds"] = ann_build_stats()
+        return out
+
+
+default_config = AnnServingConfig()

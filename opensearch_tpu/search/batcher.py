@@ -45,6 +45,15 @@ the span via ``dispatch(..., shards=S)``; `cross_shard_launches` /
 `cross_shard_queries` in the stats (and the `knn.batch.shards` histogram)
 show when that amortization is happening.
 
+Since the batched ANN path (ISSUE 9) the batcher also serves IVF-PQ
+launches: the executor's ANN branch dispatches with kernel kind "ivfpq"
+keys carrying the INDEX-BUILD GENERATION (a rebuild can never merge into
+an old batch), nprobe/k buckets, and the live ADC precision pair
+(search/ann.py). ``kind="ann"`` splits the `ann_dispatches` /
+`exact_dispatches` counters, and ``alt_keys`` enables CROSS-K coalescing:
+a k=5 arrival rides a same-family k=8 batch already forming
+(`cross_k_served`), since the bigger-k rows truncate for free.
+
 Backpressure: the pending-query queue is bounded by a
 :class:`~opensearch_tpu.index.pressure.QueuePressure` budget — crossing it
 sheds the request with RejectedExecutionException (HTTP 429) instead of
@@ -100,9 +109,10 @@ _EWMA_DECAY = 0.7
 
 class _Entry:
     __slots__ = ("payload", "enq_ms", "taken", "done", "result", "error",
-                 "batch_size", "wall_ns", "retraced", "wait_ms")
+                 "batch_size", "wall_ns", "retraced", "wait_ms", "launch",
+                 "rank")
 
-    def __init__(self, payload: Any, enq_ms: int):
+    def __init__(self, payload: Any, enq_ms: int, launch=None, rank: int = 0):
         self.payload = payload
         self.enq_ms = enq_ms
         self.taken = False
@@ -113,6 +123,12 @@ class _Entry:
         self.wall_ns = 0
         self.retraced = False
         self.wait_ms = 0
+        # the entry's own launch closure + its k-bucket rank: a batch is
+        # always launched by the closure of its LARGEST-rank member, so a
+        # smaller-k joiner (cross-k coalescing) can ride a bigger-k launch
+        # but can never shrink one
+        self.launch = launch
+        self.rank = rank
 
 
 class _Bucket:
@@ -183,6 +199,13 @@ class KnnDispatchBatcher:
             # so the batcher amortized across shards AND requests
             "cross_shard_launches": 0,
             "cross_shard_queries": 0,
+            # ANN (IVF-PQ) vs exact-scan launch split, and queries served
+            # from a LARGER k-bucket's pending batch (cross-k coalescing:
+            # a k=5 arrival rides an in-formation k=8 batch of the same
+            # family, truncation is free — extra rows never win the cut)
+            "ann_dispatches": 0,
+            "exact_dispatches": 0,
+            "cross_k_served": 0,
         }
 
     # -- config ------------------------------------------------------------
@@ -233,6 +256,12 @@ class KnnDispatchBatcher:
         out["enabled"] = self.enabled
         out["max_batch_size"] = self.max_batch_size
         out["max_wait_ms"] = self.max_wait_ms
+        # live ANN serving knobs + index-build accounting ride the same
+        # stats section (one `knn_batch` surface for the whole kNN
+        # dispatch tier, single-node and cluster alike)
+        from opensearch_tpu.search import ann as ann_mod
+
+        out["ann"] = ann_mod.default_config.snapshot()
         return out
 
     def reset(self) -> None:
@@ -249,7 +278,9 @@ class KnnDispatchBatcher:
     def dispatch(self, key: Any, payload: Any,
                  launch: Callable[[Sequence[Any]],
                                   tuple[list, bool]],
-                 shards: int = 1) -> DispatchOutcome:
+                 shards: int = 1, *, kind: str = "exact",
+                 rank: int = 0,
+                 alt_keys: Sequence[Any] = ()) -> DispatchOutcome:
         """Run `payload` through the batch identified by `key`.
 
         `launch(payloads)` performs ONE device launch for the whole batch
@@ -265,13 +296,34 @@ class KnnDispatchBatcher:
         shard-mesh path passes its mesh width): cross-shard launches are
         tracked separately so the stats show when one launch amortized
         across the whole node instead of one shard.
+
+        `kind` ("exact" | "ann") splits the dispatch counters so the
+        stats/Prometheus surface shows which scan family launches serve.
+
+        `alt_keys` (cross-k coalescing) are LARGER-k-bucket variants of
+        `key`, nearest first, that this request may ride: if one already
+        has a batch forming, the entry joins it instead of opening its own
+        bucket — the bigger-k result is a superset, the caller's top-k cut
+        truncates for free. `rank` orders the k-buckets: a batch launches
+        with its largest-rank member's closure, so joiners can never
+        shrink the launch the natives asked for.
         """
         if key is None or not self.enabled or self.max_batch_size <= 1:
-            return self._solo(payload, launch, shards)
+            return self._solo(payload, launch, shards, kind)
         with self._cond:
             self.pressure.acquire()
-            entry = _Entry(payload, timeutil.monotonic_millis())
+            entry = _Entry(payload, timeutil.monotonic_millis(),
+                           launch=launch, rank=rank)
             deadline = entry.enq_ms + max(self.max_wait_ms, 0)
+            for alt in alt_keys:
+                alt_bucket = self._buckets.get(alt)
+                if (alt_bucket is not None and alt_bucket.entries
+                        and len(alt_bucket.entries) < self.max_batch_size):
+                    # ride the bigger-k batch already forming; never CREATE
+                    # a bigger-k bucket just for a smaller-k request
+                    key = alt
+                    self.stats["cross_k_served"] += 1
+                    break
             bucket = self._buckets.get(key)
             if bucket is None:
                 bucket = self._buckets[key] = _Bucket()
@@ -289,8 +341,8 @@ class KnnDispatchBatcher:
                 batch = None
         while True:
             if batch is not None:
-                out = self._run_batch(key, batch, launch, own=entry,
-                                      shards=shards)
+                out = self._run_batch(key, batch, own=entry,
+                                      shards=shards, kind=kind)
                 if out is not None:
                     return out
                 # we led a batch that did not include our own entry (the
@@ -309,11 +361,12 @@ class KnnDispatchBatcher:
 
     # -- internals ---------------------------------------------------------
 
-    def _solo(self, payload: Any, launch, shards: int = 1) -> DispatchOutcome:
+    def _solo(self, payload: Any, launch, shards: int = 1,
+              kind: str = "exact") -> DispatchOutcome:
         t0 = time.perf_counter_ns()
         results, retraced = launch([payload])
         wall = time.perf_counter_ns() - t0
-        self._record_launch(1, wall, 0, shards)
+        self._record_launch(1, wall, 0, shards, kind)
         return DispatchOutcome(results[0], 1, wall, retraced, 0)
 
     def _take_locked(self, key: Any) -> list[_Entry]:
@@ -363,10 +416,15 @@ class KnnDispatchBatcher:
                     # deterministic-sim runs from hanging on wall time)
                     deadline = now
 
-    def _run_batch(self, key: Any, batch: list[_Entry], launch,
-                   own: _Entry, shards: int = 1) -> DispatchOutcome | None:
+    def _run_batch(self, key: Any, batch: list[_Entry],
+                   own: _Entry, shards: int = 1,
+                   kind: str = "exact") -> DispatchOutcome | None:
         """Launch one batch; returns the outcome for `own`, or None when
         `own` was not part of this batch (its caller keeps waiting)."""
+        # cross-k coalescing: the batch launches with its LARGEST-rank
+        # member's closure — every smaller-k joiner's result is a prefix
+        # truncation of that launch's rows
+        launch = max(batch, key=lambda e: e.rank).launch
         t0 = time.perf_counter_ns()
         try:
             results, retraced = launch([e.payload for e in batch])
@@ -388,7 +446,7 @@ class KnnDispatchBatcher:
             self._finish_locked(key, len(batch))
         self._record_launch(len(batch), wall,
                             max((e.wait_ms for e in batch), default=0),
-                            shards)
+                            shards, kind)
         if not any(e is own for e in batch):
             return None
         return DispatchOutcome(own.result, len(batch), wall, retraced,
@@ -409,7 +467,8 @@ class KnnDispatchBatcher:
         self._cond.notify_all()
 
     def _record_launch(self, merged: int, wall_ns: int,
-                       max_wait_ms: int, shards: int = 1) -> None:
+                       max_wait_ms: int, shards: int = 1,
+                       kind: str = "exact") -> None:
         with self._cond:
             self.stats["dispatches"] += 1
             self.stats["merged_queries"] += merged
@@ -419,6 +478,10 @@ class KnnDispatchBatcher:
             if shards > 1:
                 self.stats["cross_shard_launches"] += 1
                 self.stats["cross_shard_queries"] += merged
+            if kind == "ann":
+                self.stats["ann_dispatches"] += 1
+            else:
+                self.stats["exact_dispatches"] += 1
         # record into the EXECUTING node's registry when a request scope is
         # active (multi-node sims share this process-wide batcher; the
         # exemplar trace_id must resolve in the recording node's ring),
@@ -431,6 +494,10 @@ class KnnDispatchBatcher:
             metrics.histogram("knn.batch.queue_wait_ms").record(max_wait_ms)
             metrics.histogram("knn.batch.shards").record(shards)
             metrics.counter("knn.batch.dispatches").add(1)
+            if kind == "ann":
+                metrics.counter("knn.dispatch.ann").add(1)
+            else:
+                metrics.counter("knn.dispatch.exact").add(1)
 
 
 # process-wide default: the executor's dispatch sites are module-level code
@@ -441,6 +508,8 @@ class KnnDispatchBatcher:
 default_batcher = KnnDispatchBatcher()
 
 
-def dispatch(key: Any, payload: Any, launch,
-             shards: int = 1) -> DispatchOutcome:
-    return default_batcher.dispatch(key, payload, launch, shards=shards)
+def dispatch(key: Any, payload: Any, launch, shards: int = 1, *,
+             kind: str = "exact", rank: int = 0,
+             alt_keys: Sequence[Any] = ()) -> DispatchOutcome:
+    return default_batcher.dispatch(key, payload, launch, shards=shards,
+                                    kind=kind, rank=rank, alt_keys=alt_keys)

@@ -70,13 +70,25 @@ STREAMING_CHUNK = 32_768
 # Searches run on a parallel pool (rest/http.py), so increments go through
 # _count_knn_path — a bare `dict[k] += 1` is read-modify-write and drops
 # counts under concurrency.
-knn_path_stats = {"streaming": 0, "materializing": 0}
+knn_path_stats = {"streaming": 0, "materializing": 0, "ann": 0}
 _knn_path_stats_lock = threading.Lock()
 
 
 def _count_knn_path(kind: str) -> None:
     with _knn_path_stats_lock:
         knn_path_stats[kind] += 1
+
+
+def _record_ann_metrics(nprobe: int) -> None:
+    """`knn.batch.nprobe` histogram for an ANN dispatch — recorded into the
+    EXECUTING node's registry when a request scope is active (the batcher's
+    attribution rule), else the attached sink."""
+    from opensearch_tpu.search import batcher as batcher_mod
+    from opensearch_tpu.telemetry.tracing import active_metrics
+
+    metrics = active_metrics() or batcher_mod.default_batcher.metrics
+    if metrics is not None:
+        metrics.histogram("knn.batch.nprobe").record(nprobe)
 
 
 def _pad_query_batch(rows: list) -> np.ndarray:
@@ -172,41 +184,102 @@ class ShardContext:
             prof = profile.active()
             if vf.ann is not None and node.filter is None:
                 # ANN path: IVF-PQ ADC + exact rescore gives candidate-only
-                # scores; non-candidates stay -inf (they can never win)
+                # scores; non-candidates stay -inf (they can never win).
+                # Dispatch rides search/batcher.py with a REAL batch key —
+                # (kernel "ivfpq", device column, INDEX-BUILD GENERATION,
+                # reader generation, k bucket, nprobe bucket, similarity,
+                # live precision pair) — so concurrent ANN queries against
+                # the same built index coalesce into ONE search_index
+                # launch, and a rebuild (fresh build generation) can never
+                # merge into an old batch.
                 from opensearch_tpu.ops import ivfpq
+                from opensearch_tpu.search import ann as ann_mod
+                from opensearch_tpu.search import batcher as batcher_mod
 
-                nprobe = int(
+                cfg = ann_mod.default_config
+                precision = cfg.adc_precision
+                mult = cfg.rescore_multiplier
+                # bucket k AND nprobe to powers of two: both are static jit
+                # args, so raw values would compile a fresh program per
+                # distinct request shape (the query-shape cache concern,
+                # SURVEY.md §7 hard part #3). Extra candidates/probes are
+                # harmless — the shard-level cut below still takes exactly
+                # node.k, and more probes only add recall.
+                nprobe_req = int(
                     (node.method_parameters or {}).get(
                         "nprobe", vf.nprobe_default
                     )
                 )
-                # bucket k to the next power of two: k/rerank are static jit
-                # args, so raw k values would compile a fresh program per
-                # distinct request k (the query-shape cache concern,
-                # SURVEY.md §7 hard part #3). Extra candidates are harmless —
-                # the shard-level cut below still takes exactly node.k.
+                nprobe = ann_mod.bucket_nprobe(
+                    nprobe_req, vf.ann.params.nlist)
                 k_req = max(1, min(node.k, host.n_docs))
                 k_bucket = 1 << (k_req - 1).bit_length()
-                t_k = time.perf_counter_ns()
-                a_vals, a_ids = ivfpq.search_index(
-                    vf.ann, vf.vectors, vf.norms_sq, valid, qv,
-                    k=k_bucket,
-                    nprobe=nprobe,
-                    similarity=vf.similarity,
-                )
-                # the host materialization is the fence for this launch
-                a_vals, a_ids = np.asarray(a_vals[0]), np.asarray(a_ids[0])
-                if prof is not None:
-                    prof.record_kernel(
-                        "ivfpq_search", time.perf_counter_ns() - t_k,
-                        int(qv.nbytes),
-                        profile.signature_retraced(
-                            "ivfpq_search", (vf.vectors, qv),
-                            (k_bucket, nprobe)),
+                sim = knn_ops.canonical_similarity(vf.similarity)
+                gen = self.snapshot.generation
+
+                def ann_key(kb: int):
+                    return ("ivfpq", id(vf), vf.ann.build_generation, gen,
+                            kb, nprobe, sim, precision, mult)
+
+                def launch_ann(rows):
+                    q_batch = _pad_query_batch(rows)
+                    with profile.profiling(None):
+                        b_vals, b_ids = ivfpq.search_index(
+                            vf.ann, vf.vectors, vf.norms_sq, valid,
+                            q_batch, k=k_bucket, nprobe=nprobe,
+                            similarity=vf.similarity,
+                            adc_precision=precision,
+                            rescore_multiplier=mult,
+                        )
+                    # host materialization is the fence for this launch
+                    b_vals = np.asarray(b_vals)
+                    b_ids = np.asarray(b_ids)
+                    retraced = profile.signature_retraced(
+                        "ivfpq_search", (vf.vectors, q_batch),
+                        (k_bucket, nprobe, precision, mult))
+                    return (
+                        [(b_vals[i], b_ids[i]) for i in range(len(rows))],
+                        retraced,
                     )
+
+                # cross-k coalescing: this request may ride an already-
+                # forming batch of the next-larger k buckets (its rows
+                # truncate for free); it never creates one
+                out = batcher_mod.dispatch(
+                    ann_key(k_bucket), qv[0], launch_ann, shards=1,
+                    kind="ann", rank=k_bucket,
+                    alt_keys=(ann_key(k_bucket * 2), ann_key(k_bucket * 4)),
+                )
+                a_vals, a_ids = out.value
+                # the batch leader may have run a LARGER k bucket: the
+                # scatter below accepts any row count, the shard cut
+                # truncates to node.k
+                if prof is not None:
+                    rerank = ivfpq.default_rerank(k_bucket, mult)
+                    prof.record_kernel(
+                        "ivfpq_search", out.kernel_share_ns,
+                        int(qv.nbytes), out.retraced,
+                        annotations={
+                            "adc_precision": precision,
+                            "rescore_candidates": ivfpq.rescore_pool(
+                                vf.ann, k_bucket, nprobe, rerank),
+                            "nprobe": nprobe,
+                        },
+                    )
+                _record_ann_metrics(nprobe)
+                _count_knn_path("ann")
                 scores = np.full(dev.n_pad, -np.inf, np.float32)
                 hit = a_ids >= 0
                 scores[a_ids[hit]] = a_vals[hit]
+                # the launch already returned the top candidates sorted —
+                # skip the generic argpartition below and feed them to the
+                # shard cut directly (host work on the serving path is
+                # GIL-serial; every avoided O(n) pass widens the batch win)
+                per_seg_scores.append(scores)
+                for v, d in zip(a_vals[hit][: node.k], a_ids[hit][: node.k]):
+                    if np.isfinite(v):
+                        candidates.append((float(v), seg_idx, int(d)))
+                continue
             else:
                 n_pad = dev.n_pad
                 k_req = max(1, min(int(node.k), host.n_docs))
@@ -230,11 +303,23 @@ class ShardContext:
                     from opensearch_tpu.ops import fused
 
                     jfn = fused.cached_knn_streaming(k_bucket, sim, chunk)
+
+                    def stream_key(kb: int):
+                        return ("knn_topk_streaming", id(vf),
+                                self.snapshot.generation, kb, sim, chunk)
+
                     key = (
-                        ("knn_topk_streaming", id(vf),
-                         self.snapshot.generation, k_bucket, sim, chunk)
+                        stream_key(k_bucket)
                         if node.filter is None else None
                     )
+                    # cross-k coalescing: ride an already-forming batch of
+                    # the next-larger k buckets (result rows truncate for
+                    # free; kb stays within the streaming chunk bound)
+                    alt_keys = tuple(
+                        stream_key(kb)
+                        for kb in (k_bucket * 2, k_bucket * 4)
+                        if kb <= chunk
+                    ) if key is not None else ()
 
                     def launch_streaming(rows):
                         q_batch = _pad_query_batch(rows)
@@ -257,7 +342,8 @@ class ShardContext:
                     # shard-mesh launch in service.py passes its mesh
                     # width); the batcher's cross-shard stats stay honest
                     out = batcher_mod.dispatch(key, qv[0], launch_streaming,
-                                               shards=1)
+                                               shards=1, rank=k_bucket,
+                                               alt_keys=alt_keys)
                     vals, ids = out.value
                     if prof is not None:
                         # a batched operator owns its SHARE of the fenced
@@ -637,6 +723,39 @@ class NodeResult:
     scores: jnp.ndarray            # f32 [n_pad], 0 where not matching
     mask: jnp.ndarray              # bool [n_pad]
     scoring: bool                  # False => pure filter (score ignored)
+
+
+class HostNodeResult:
+    """NodeResult duck-type for host-resident selections (the bare-kNN hot
+    path): the shard cut already picked <= k winners on host, so a
+    top-level consumer (execute_query_phase's host fast path) never needs
+    device arrays — uploading the scatter arrays and re-top-k'ing them on
+    device costs more than the whole remaining request. A COMPOUND parent
+    (knn inside bool, rescore, ...) touching `.scores`/`.mask` transparently
+    materializes the device arrays, so query semantics never change."""
+
+    __slots__ = ("host_scores", "host_mask", "scoring",
+                 "_dev_scores", "_dev_mask")
+
+    def __init__(self, host_scores: np.ndarray, host_mask: np.ndarray,
+                 scoring: bool = True):
+        self.host_scores = host_scores    # f32 [n_pad], 0 where unselected
+        self.host_mask = host_mask        # bool [n_pad]
+        self.scoring = scoring
+        self._dev_scores = None
+        self._dev_mask = None
+
+    @property
+    def scores(self) -> jnp.ndarray:
+        if self._dev_scores is None:
+            self._dev_scores = jnp.asarray(self.host_scores)
+        return self._dev_scores
+
+    @property
+    def mask(self) -> jnp.ndarray:
+        if self._dev_mask is None:
+            self._dev_mask = jnp.asarray(self.host_mask)
+        return self._dev_mask
 
 
 def _const_result(mask: jnp.ndarray, boost: float, scoring: bool) -> NodeResult:
@@ -1553,10 +1672,14 @@ class SegmentExecutor:
         sel_host, scores_host = selections[seg_idx]
         if scores_host is None:
             return _empty(self.dev)
-        sel = jnp.asarray(sel_host)
-        scores = jnp.asarray(np.where(np.isfinite(scores_host), scores_host, 0.0))
-        out_scores = jnp.where(sel, scores, 0.0)
-        return NodeResult(scores=out_scores * node.boost, mask=sel, scoring=True)
+        # host-resident result: the shard cut already chose the winners;
+        # device arrays materialize only if a compound parent needs them
+        out_scores = np.where(
+            sel_host & np.isfinite(scores_host), scores_host, 0.0
+        ).astype(np.float32)
+        if node.boost != 1.0:
+            out_scores *= np.float32(node.boost)
+        return HostNodeResult(out_scores, sel_host, scoring=True)
 
     def _exec_ScriptScoreQuery(self, node: q.ScriptScoreQuery) -> NodeResult:
         inner = self.execute(node.query) if node.query else self._exec_MatchAllQuery(q.MatchAllQuery())
@@ -2178,6 +2301,32 @@ def execute_query_phase(
     for seg_idx, (host, dev) in enumerate(snapshot.segments):
         ex = SegmentExecutor(ctx, host, dev)
         result = ex.execute(query_node)
+        if isinstance(result, HostNodeResult) and not sort:
+            # host fast path (bare kNN): the selection is already the
+            # shard-level top-k cut, computed against the SNAPSHOT's
+            # device live mask — re-uploading the scatter arrays just to
+            # segment_top_k <= k winners on device would cost more than
+            # the rest of the request (a real serving-path tax: one
+            # launch + two transfers + a fence, all GIL-serial)
+            prof = profile.active()
+            t_collect = time.perf_counter_ns()
+            mask_h = result.host_mask
+            scores_h = result.host_scores
+            if min_score is not None:
+                mask_h = mask_h & (scores_h >= np.float32(min_score))
+            if need_masks:
+                masks.append(mask_h[: host.n_docs])
+                score_arrays.append(scores_h[: host.n_docs])
+            total += int(mask_h.sum())
+            if size > 0:
+                for d in np.nonzero(mask_h)[0]:
+                    v = float(scores_h[d])
+                    all_hits.append(ShardHit(v, seg_idx, int(d)))
+                    if max_score is None or v > max_score:
+                        max_score = v
+            if prof is not None:
+                prof.collect_ns += time.perf_counter_ns() - t_collect
+            continue
         mask = result.mask & dev.live
         if min_score is not None:
             # min_score excludes docs from hits AND total (reference:

@@ -70,7 +70,7 @@ class OpProfile:
 
     __slots__ = ("type", "description", "time_ns", "device_ns",
                  "transfer_bytes", "retraced", "kernels", "children",
-                 "_child_index", "calls")
+                 "_child_index", "calls", "kernel_annotations")
 
     def __init__(self, type_: str, description: str):
         self.type = type_
@@ -82,6 +82,10 @@ class OpProfile:
         self.calls = 0
         # kernel name -> [calls, time_ns, transfer_bytes, retraces]
         self.kernels: dict[str, list] = {}
+        # kernel name -> static launch configuration (e.g. the ANN path's
+        # adc_precision / rescore candidate pool); last write wins — the
+        # values are constant within one batch key
+        self.kernel_annotations: dict[str, dict] = {}
         self.children: list[OpProfile] = []
         self._child_index: dict[tuple[str, str], OpProfile] = {}
 
@@ -95,7 +99,7 @@ class OpProfile:
         return op
 
     def record_kernel(self, name: str, time_ns: int, transfer_bytes: int,
-                      retraced: bool) -> None:
+                      retraced: bool, annotations: dict | None = None) -> None:
         self.device_ns += time_ns
         self.transfer_bytes += transfer_bytes
         self.retraced = self.retraced or retraced
@@ -104,6 +108,8 @@ class OpProfile:
         cell[1] += time_ns
         cell[2] += transfer_bytes
         cell[3] += int(retraced)
+        if annotations:
+            self.kernel_annotations[name] = dict(annotations)
 
     def to_dict(self) -> dict:
         # children's wall time is nested inside self.time_ns (inclusive),
@@ -132,7 +138,8 @@ class OpProfile:
         if self.kernels:
             out["kernels"] = [
                 {"name": name, "calls": c[0], "time_in_nanos": c[1],
-                 "transfer_bytes": c[2], "retraces": c[3]}
+                 "transfer_bytes": c[2], "retraces": c[3],
+                 **(self.kernel_annotations.get(name) or {})}
                 for name, c in sorted(self.kernels.items())
             ]
         if self.children:
@@ -182,8 +189,9 @@ class ShardProfiler:
         return ShardProfiler._OpScope(self, op)
 
     def record_kernel(self, name: str, time_ns: int, transfer_bytes: int,
-                      retraced: bool) -> None:
-        self._stack[-1].record_kernel(name, time_ns, transfer_bytes, retraced)
+                      retraced: bool, annotations: dict | None = None) -> None:
+        self._stack[-1].record_kernel(name, time_ns, transfer_bytes, retraced,
+                                      annotations)
 
     def record_agg(self, name: str, time_ns: int) -> None:
         self.agg_times[name] = self.agg_times.get(name, 0) + time_ns

@@ -1076,6 +1076,21 @@ def _try_distributed_query_phase(
         list(acquired) if acquired is not None
         else [s.acquire_searcher() for s in shards]
     )
+    # ANN-indexed columns never ride the mesh on UNFILTERED queries (the
+    # host path answers those with IVF-PQ, and the mesh must stay
+    # bit-identical to the host — distributed_serving._can_serve declines
+    # them). Skip the batcher round-trip up front: without this pre-check
+    # every bare ANN query would queue under the distributed key, merge,
+    # and only then learn the mesh cannot serve it — paying a batch wait
+    # just to fall back. The per-shard loop below dispatches it through
+    # the ANN batch key instead (executor.shard_knn_selection).
+    if (node.filter is None
+            and not any(f is not None for f in filter_nodes)
+            and any(
+                (vf := dev.vector_fields.get(node.field)) is not None
+                and vf.ann is not None
+                for snap in snaps for _host, dev in snap.segments)):
+        return None
     # cross-request micro-batching (search/batcher.py): concurrent
     # filterless knn searches against the same (index, field, k,
     # reader-generations) coalesce into ONE serving-program launch via the

@@ -700,7 +700,7 @@ class SoakHarness:
         # transport-delay draws can't shift workload plans
         self.wrng = random.Random(cfg.seed * 7_919 + 1)
         self.frng = random.Random(cfg.seed * 104_729 + 2)
-        self.indices = ["logs", "vec", "hyb"]
+        self.indices = ["logs", "vec", "hyb", "annvec"]
         self.cycle = -1
         self.final_quiesce = False
         self.report = SoakReport(seed=cfg.seed)
@@ -843,7 +843,7 @@ class SoakHarness:
         doc_id = f"d{i}"
         if index == "logs":
             src = {"msg": f"hello world {i}", "tag": f"t{i % 5}", "n": i}
-        elif index == "vec":
+        elif index in ("vec", "annvec"):
             src = {"x": [round(self.wrng.uniform(-1.0, 1.0), 4)
                          for _ in range(_VEC_DIM)], "tag": f"t{i % 3}"}
         else:
@@ -861,7 +861,7 @@ class SoakHarness:
         ("flush", 3), ("force_merge", 3),
         ("search_match", 12), ("search_knn", 10), ("search_aggs", 7),
         ("search_hybrid", 5), ("msearch", 5), ("scroll_chain", 4),
-        ("pit_chain", 3),
+        ("pit_chain", 3), ("search_ann", 6),
     ]
 
     def _plan_cycle_ops(self, flood: bool) -> list[dict]:
@@ -908,6 +908,12 @@ class SoakHarness:
                 plan["index"] = "vec"
                 plan["body"] = {"query": {"knn": {"x": {
                     "vector": self._vec(), "k": 5}}}, "size": 5}
+            elif kind == "search_ann":
+                # IVF-PQ serving path (ISSUE 9): the annvec index carries
+                # an ANN structure, so these ride the batched ADC dispatch
+                plan["index"] = "annvec"
+                plan["body"] = {"query": {"knn": {"x": {
+                    "vector": self._vec(), "k": 5}}}, "size": 5}
             elif kind == "search_aggs":
                 plan["index"] = "logs"
                 plan["body"] = {
@@ -932,6 +938,16 @@ class SoakHarness:
             elif kind == "pit_chain":
                 plan["index"] = self.wrng.choice(["logs", "vec"])
             plans.append(plan)
+        if self.cycle == 1:
+            # one mid-soak ANN index rebuild (fresh docs + refresh + force
+            # merge): the merged segment re-trains its IVF-PQ structure,
+            # so in-flight batched ANN traffic must observe a NEW build
+            # generation — the generation-isolation contract under chaos
+            plans.append({
+                "kind": "ann_rebuild", "via": "n0", "index": "annvec",
+                "offset": self.cfg.cycle_ms // 2,
+                "docs": [self._next_doc("annvec") for _ in range(6)],
+            })
         if flood:
             # one burst of bulks tagged to the enforced flood group, all
             # issued in a single callback so admission sees them together,
@@ -1061,8 +1077,40 @@ class SoakHarness:
 
     _issue_search_match = _search_op
     _issue_search_knn = _search_op
+    _issue_search_ann = _search_op
     _issue_search_aggs = _search_op
     _issue_search_hybrid = _search_op
+
+    def _issue_ann_rebuild(self, op: dict) -> None:
+        """Mid-soak ANN rebuild: bulk fresh docs, refresh, force-merge. The
+        merged segment re-trains its IVF-PQ index (index/device.py build
+        path), so the serving batch keys pick up a fresh build generation
+        while batched ANN queries are in flight."""
+        node = self.nodes[op["via"]]
+        operations = []
+        for doc_id, src in op["docs"]:
+            self._record_write(op["index"], doc_id, op["i"], "index")
+            operations.append(
+                ("index", {"_index": op["index"], "_id": doc_id}, src))
+
+        def merged(resp: dict) -> None:
+            self._complete(op, resp)
+
+        def refreshed(_resp: dict) -> None:
+            self.client.broadcast(op["via"], "indices:admin/forcemerge[node]",
+                                  {"indices": [op["index"]],
+                                   "max_num_segments": 1},
+                                  merged)
+
+        def indexed(resp: dict) -> None:
+            for item in resp.get("items") or []:
+                for _action, r in (item or {}).items():
+                    if r and "error" not in r and \
+                            r.get("_shards", {}).get("failed", 1) == 0:
+                        self._ack_write(op["index"], r.get("_id"), op["i"])
+            node.refresh(op["index"], refreshed)
+
+        node.bulk(operations, indexed)
 
     def _issue_index(self, op: dict) -> None:
         doc_id, src = op["doc"]
@@ -1349,6 +1397,18 @@ class SoakHarness:
                     {"properties": {"msg": {"type": "text"},
                                     "x": {"type": "knn_vector",
                                           "dimension": _VEC_DIM}}}),
+            # IVF-PQ index (ISSUE 9): tiny method params so the structure
+            # builds from the seed corpus and rebuilds stay cheap under
+            # the deterministic queue; knn queries against it exercise the
+            # batched ANN dispatch path under kill/partition faults
+            "annvec": ({"number_of_shards": 1,
+                        "number_of_replicas": self.cfg.replica_count},
+                       {"properties": {"x": {
+                           "type": "knn_vector", "dimension": _VEC_DIM,
+                           "method": {"name": "ivf_pq", "parameters": {
+                               "nlist": 4, "m": 2, "nprobe": 4,
+                               "min_train": 24, "iters": 2}}},
+                           "tag": {"type": "keyword"}}}),
         }
         for name, (settings, mappings) in specs.items():
             resp = self.call(self.nodes["n0"].create_index, name,
@@ -1357,9 +1417,13 @@ class SoakHarness:
             if not resp.get("acknowledged"):
                 self.fail("setup", f"create [{name}] failed: {resp}")
         self.run_ms(8_000)
-        # a seed corpus so the first cycle's queries have data to hit
-        for _ in range(6):
-            for index in self.indices:
+        # a seed corpus so the first cycle's queries have data to hit; the
+        # annvec index seeds PAST its min_train so the first refresh
+        # publishes a built IVF-PQ structure
+        seed_counts = {i: 6 for i in self.indices}
+        seed_counts["annvec"] = 30
+        for index in self.indices:
+            for _ in range(seed_counts[index]):
                 doc_id, src = self._next_doc(index)
                 self._writes[index][doc_id] = [
                     {"op": -1, "kind": "index", "acked": False}]

@@ -9,8 +9,12 @@
 #                               # platform's BENCH_CACHE.json entry, and
 #                               # bench.py --mesh-gate holds the shard-mesh
 #                               # cluster bench to BENCH_MESH.json the same
-#                               # way, so a PR that slows a hot path fails
-#                               # HERE, not in the next round's headline
+#                               # way, and bench.py --ann-gate holds the
+#                               # batched IVF-PQ path to BENCH_ANN.json plus
+#                               # the recall@10 >= 0.95 ratchet, so a PR that
+#                               # slows a hot path (or buys speed with
+#                               # recall) fails HERE, not in the next
+#                               # round's headline
 #
 # The lint gate runs three ways on purpose:
 #   1. repo-wide lint vs the (EMPTY) baseline ratchet (json report),
@@ -49,4 +53,6 @@ if [[ "${1:-}" == "--bench" ]]; then
   python bench.py --mesh-gate
   echo "== otel-overhead gate (span export must cost <= 5% QPS) =="
   python bench.py --otel-overhead
+  echo "== ANN gate (recall@10 >= 0.95 ratchet + batched >= 1.3x + QPS floor) =="
+  python bench.py --ann-gate
 fi
