@@ -59,6 +59,16 @@ CONC_CLIENTS = int(os.environ.get("BENCH_CONC_CLIENTS", "16"))
 CONC_QUERIES = int(os.environ.get("BENCH_CONC_QUERIES", "125"))
 
 
+def _assert_ledger_identity() -> None:
+    """Gate-child epilogue: the device-residency ledger's accounting
+    identity (resident == allocated − freed == sum of live bytes) must
+    hold after a full bench workload — a broken identity fails the gate
+    here, not in a later session's stats mystery (ISSUE 10)."""
+    from opensearch_tpu.telemetry.device_ledger import default_ledger
+
+    default_ledger.verify_identity()
+
+
 def _load_cache() -> dict:
     if not CACHE.exists():
         return {}
@@ -307,6 +317,7 @@ def gate_child() -> None:
         np.asarray(jfn(vectors, norms, valid, qs)[0])
         walls.append(time.perf_counter() - t0)
     wall = float(np.median(walls))
+    _assert_ledger_identity()
     print(json.dumps({
         "metric": f"gate_knn_qps_{n // 1000}k_{d}d_top{k}",
         "value": round(total_q / wall, 1),
@@ -627,6 +638,7 @@ def mesh_child() -> None:
     on = run_config(True)
     distributed_serving.enabled = True
 
+    _assert_ledger_identity()
     print(json.dumps({
         "metric": f"mesh_knn_qps_{MESH_SHARDS}shards_{MESH_CLIENTS}clients",
         "value": on["qps"],
@@ -703,7 +715,11 @@ def otel_child() -> None:
     n_docs = 20_000 if platform != "cpu" else 3_000
     clients = int(os.environ.get("BENCH_OTEL_CLIENTS", "8"))
     per_client = int(os.environ.get("BENCH_OTEL_QUERIES", "40"))
-    reps = int(os.environ.get("BENCH_OTEL_REPS", "5"))
+    # 9 alternating off/on repeats: shared-container CPU throughput drifts
+    # enough that 5-rep medians swung the measured overhead 0-17% run to
+    # run (observed while gating ISSUE 10) — with 9 the medians settle at
+    # the real ~2-3% and the 5% gate stops flapping
+    reps = int(os.environ.get("BENCH_OTEL_REPS", "9"))
     executor.STREAMING_MIN_DOCS = min(executor.STREAMING_MIN_DOCS, 1_024)
 
     rng = np.random.default_rng(17)
@@ -785,6 +801,7 @@ def otel_child() -> None:
     harvest_exported()  # bank the final ON round's ledger post-flush
     node.close()
     overhead_pct = max(0.0, (1.0 - qps_on / max(qps_off, 1e-9)) * 100.0)
+    _assert_ledger_identity()
     print(json.dumps({
         "metric": f"otel_overhead_knn_{clients}x{per_client}",
         "value": round(qps_on, 1),
@@ -1187,6 +1204,7 @@ def ann_child() -> None:
     node.close()
 
     speedup = qps_batched["fp32"] / max(qps_unbatched, 1e-9)
+    _assert_ledger_identity()
     print(json.dumps({
         "metric": f"ann_knn_batched_{clients}x{per_client}",
         "value": qps_batched["fp32"],

@@ -153,6 +153,14 @@ class ClusterNode:
         self.settings_consumers.register(
             "search.knn.ann.", _ann_mod.default_config.apply_settings
         )
+        # shard-mesh HBM byte budget (cluster/shard_mesh.py): dynamic
+        # search.mesh.hbm_budget_bytes reaches the registry at state
+        # application, so a PUT retunes residency pressure cluster-wide
+        from opensearch_tpu.cluster.shard_mesh import default_registry
+
+        self.settings_consumers.register(
+            "search.mesh.", default_registry.apply_settings
+        )
         # span exporter: per-node (its ring is per-node); dynamic
         # telemetry.tracing.* updates rebuild/retune it at state application
         from opensearch_tpu.telemetry.export import apply_tracing_settings
@@ -221,6 +229,7 @@ class ClusterNode:
         reg(node_id, "indices:admin/flush[node]", self._on_node_flush)
         reg(node_id, "indices:admin/forcemerge[node]", self._on_node_forcemerge)
         reg(node_id, "indices:monitor/stats[node]", self._on_node_stats)
+        reg(node_id, "cluster:admin/otel/flush[node]", self._on_otel_flush)
         reg(node_id, "indices:replication/checkpoint", self._on_replication_checkpoint)
         reg(node_id, "indices:replication/get_segments", self._on_get_segments)
         reg(node_id, "internal:index/shard/recovery/start", self._on_start_recovery)
@@ -593,7 +602,7 @@ class ClusterNode:
 
         driver = RecoveryTargetDriver(
             self.transport, self.scheduler, self.node_id, primary.node_id,
-            index, shard, progress, trace=rec_trace,
+            index, shard, progress, trace=rec_trace, root_span=rec_span,
         )
         self._recovery_drivers[(index, shard)] = driver
 
@@ -2564,6 +2573,23 @@ class ClusterNode:
             resp["telemetry"] = telemetry
             if want("knn_batch"):
                 resp["knn_batch"] = self.knn_batcher.snapshot_stats()
+            if want("device"):
+                # device-memory residency (telemetry/device_ledger.py):
+                # per-structure HBM bytes, the accounting identity, and the
+                # per-kernel-family compile table. Process-wide — in-process
+                # sim nodes report the shared ledger, like the batcher.
+                from opensearch_tpu.telemetry import device_ledger
+
+                resp["device"] = device_ledger.stats_section()
+            if want("device_totals"):
+                # lightweight per-device byte totals for the recurring
+                # federated Prometheus scrape (the full structure rows stay
+                # off that path, like the span-ring narrowing)
+                from opensearch_tpu.telemetry.device_ledger import (
+                    default_ledger as _ledger,
+                )
+
+                resp["device_totals"] = _ledger.device_totals()
             if want("providers"):
                 for name, provider in list(self.stats_providers.items()):
                     try:
@@ -2574,6 +2600,24 @@ class ClusterNode:
                         logging.getLogger(__name__).warning(
                             "stats provider [%s] failed: %s", name, e)
         return resp
+
+    def _on_otel_flush(self, sender: str, payload: dict) -> dict:
+        """`POST /_otel/flush` per-node leg: force the span exporter to
+        decide + drain everything it holds, then report the exporter
+        ledger and the device-residency snapshot — the admin's "show me
+        the telemetry truth right now" button."""
+        from opensearch_tpu.telemetry import device_ledger
+
+        exporter = self.telemetry.tracer.exporter
+        if exporter is not None:
+            exporter.flush()
+        return {
+            "name": self.node_id,
+            "flushed": exporter is not None,
+            "exporter": (exporter.snapshot_stats()
+                         if exporter is not None else None),
+            "device": device_ledger.stats_section(),
+        }
 
     def _on_shard_search(self, sender: str, payload: dict):
         def run() -> dict:

@@ -650,6 +650,12 @@ class ClusterFacade:
             self.telemetry.metrics.counter("search.total").add(1)
             self.telemetry.metrics.histogram("search.took_ms").record(
                 resp.get("took", 0))
+            # per-index series under the same constant name (labels, not
+            # names — TPU013; the registry bounds label cardinality)
+            if index and "*" not in str(index) and "," not in str(index):
+                self.telemetry.metrics.histogram(
+                    "search.took_ms", labels={"index": str(index)},
+                ).record(resp.get("took", 0))
         if keep:
             contexts = {
                 f"{nid}|{idx}": p["_ctx_id"]
@@ -1176,7 +1182,7 @@ class ClusterFacade:
         payload: dict[str, Any] = {"full": True}
         if metrics and "_all" not in metrics:
             section_of = {"telemetry": "spans", "knn_batch": "knn_batch",
-                          "indices": "providers"}
+                          "indices": "providers", "device": "device"}
             payload["sections"] = sorted(
                 {section_of[m] for m in metrics if m in section_of})
         nodes = sorted(self.state.nodes)
@@ -1196,6 +1202,7 @@ class ClusterFacade:
                 "telemetry": r.get("telemetry", {}),
                 "knn_batch": r.get("knn_batch", {}),
                 "shard_mesh": r.get("shard_mesh", {}),
+                "device": r.get("device", {}),
                 "indices": {
                     "request_cache": r.get("request_cache", {}),
                 },
@@ -1218,7 +1225,7 @@ class ClusterFacade:
         nodes = sorted(self.state.nodes)
         results = self._rpc_many([
             (nid, "indices:monitor/stats[node]",
-             {"full": True, "sections": ["metrics"]})
+             {"full": True, "sections": ["metrics", "device_totals"]})
             for nid in nodes
         ])
         out: dict[str, dict] = {}
@@ -1227,8 +1234,34 @@ class ClusterFacade:
                 continue
             tel = r.get("telemetry", {})
             out[nid] = {"counters": tel.get("counters", {}),
-                        "histograms": tel.get("histograms", {})}
+                        "histograms": tel.get("histograms", {}),
+                        # per-device resident-byte totals: the federated
+                        # exposition renders them as labeled gauges
+                        "device": r.get("device_totals", {})}
         return out
+
+    def cluster_otel_flush(self) -> dict:
+        """`POST /_otel/flush`: force every node's span exporter to decide
+        and drain, and collect each node's exporter ledger + device-memory
+        snapshot. Nodes that fail to answer count in `_nodes.failed` —
+        the flush must work mid-chaos, like stats."""
+        nodes = sorted(self.state.nodes)
+        results = self._rpc_many([
+            (nid, "cluster:admin/otel/flush[node]", {}) for nid in nodes
+        ])
+        entries: dict[str, dict] = {}
+        failed = 0
+        for nid, r in zip(nodes, results):
+            if not isinstance(r, dict) or set(r) <= {"error", "status"}:
+                failed += 1
+                continue
+            entries[nid] = r
+        return {
+            "_nodes": {"total": len(nodes), "successful": len(entries),
+                       "failed": failed},
+            "cluster_name": "opensearch-tpu",
+            "nodes": entries,
+        }
 
     def _all_shard_stats(self) -> dict[str, dict]:
         nodes = sorted(self.state.nodes)

@@ -18,6 +18,15 @@ keys carry). One bundle stays live per (index, field); superseded
 generations are evicted on insert, and `invalidate_index` drops an index's
 bundles when its shards leave the node (cluster-state application).
 
+HBM budgeting (ISSUE 10): the registry enforces a BYTE budget — dynamic
+``search.mesh.hbm_budget_bytes`` — with LRU-by-bytes eviction, replacing
+the old bundle-count bound (eight tiny one-shard bundles and eight
+million-doc slabs are not the same residency pressure; TPU-KNN's roofline
+is bytes, not bundle counts). Every eviction frees the bundle's
+device-residency-ledger allocation and lands a ``mesh.evict`` span EVENT
+on whichever request triggered it, so the decision is observable in
+``_nodes/stats`` AND in traces.
+
 The registry is process-wide (one process == one device set — the same
 scope as the kNN dispatch batcher); sim nodes sharing an interpreter share
 it safely because engine instance ids keep their keys disjoint.
@@ -28,30 +37,96 @@ from __future__ import annotations
 import threading
 from typing import Any
 
-# insertion-ordered dict as LRU: hits re-insert, eviction pops the head
-_DEFAULT_MAX_BUNDLES = 8
+from opensearch_tpu.common.settings import Property, Setting, parse_bytes
 
 # registered metric name for the fenced sharded-launch wall (metric names
 # are constants, never built at the record site — tpulint TPU013)
 MESH_LAUNCH_WALL_MS = "mesh.launch.wall_ms"
 
+# -- settings (registered dynamic in cluster/cluster_settings.py) -----------
+
+
+def _validate_budget(v: int) -> None:
+    if v < 0:
+        raise ValueError(
+            f"search.mesh.hbm_budget_bytes must be >= 0 (0 disables the "
+            f"byte bound), got [{v}]")
+
+
+# default one GiB of mesh-bundle residency; "1gb"-style values accepted on
+# PUT (parse_bytes), 0 disables the byte bound
+MESH_HBM_BUDGET_SETTING = Setting(
+    "search.mesh.hbm_budget_bytes", 1 << 30, parse_bytes,
+    Property.NODE_SCOPE, Property.DYNAMIC, validator=_validate_budget,
+)
+
+MESH_SETTINGS = (MESH_HBM_BUDGET_SETTING,)
+
+
+def _bundle_nbytes(bundle: Any) -> int:
+    return int(getattr(bundle, "nbytes", 0) or 0)
+
+
+def _free_bundle(bundle: Any, reason: str) -> None:
+    alloc = getattr(bundle, "allocation", None)
+    if alloc is not None:
+        alloc.free(reason=reason)
+
 
 class ShardMeshRegistry:
-    """Tracks device-resident shard bundles keyed by reader generation."""
+    """Tracks device-resident shard bundles keyed by reader generation,
+    bounded by an HBM byte budget (LRU-by-bytes)."""
 
-    def __init__(self, max_bundles: int = _DEFAULT_MAX_BUNDLES):
+    def __init__(self, hbm_budget_bytes: int | None = None,
+                 max_bundles: int | None = None):
+        from opensearch_tpu.common.settings import Settings
+
+        self.hbm_budget_bytes = (
+            hbm_budget_bytes if hbm_budget_bytes is not None
+            else MESH_HBM_BUDGET_SETTING.default(Settings.EMPTY))
+        # optional legacy count backstop (tests may pin it); the byte
+        # budget is the production bound
         self.max_bundles = max_bundles
         self.metrics = None  # MetricsRegistry sink (ClusterNode attaches)
         self._lock = threading.Lock()
+        # insertion-ordered dict as LRU: hits re-insert, eviction pops head
         self._bundles: dict[tuple, Any] = {}
+        # dict cell (not a bare attribute) so the *_locked helpers mutate
+        # it by subscript under the caller-held lock
+        self._mem = {"resident_bytes": 0}
         self._launch_seq = 0
         self.stats = {
             "builds": 0,          # slabs uploaded (cold generations)
             "hits": 0,            # launches served by a resident bundle
-            "evictions": 0,       # superseded generations + LRU pressure
+            "evictions": 0,       # superseded generations + budget pressure
+            "evicted_bytes": 0,   # bytes released by those evictions
             "invalidations": 0,   # index-level drops (shard left the node)
+            "invalidated_bytes": 0,  # bytes released by those drops
             "launches": 0,        # sharded device launches issued
         }
+
+    # -- config --------------------------------------------------------------
+
+    def configure(self, *, hbm_budget_bytes: int | None = None) -> None:
+        if hbm_budget_bytes is None:
+            return
+        # plain atomic rebind read racily by design (the dynamic-settings
+        # contract, same as the batcher's config fields); the eviction pass
+        # below then enforces the new bound under the lock
+        self.hbm_budget_bytes = max(0, int(hbm_budget_bytes))
+        with self._lock:
+            self._enforce_budget_locked(incoming=0)
+
+    def apply_settings(self, flat: dict) -> None:
+        """Pick this registry's keys out of a flat effective-settings map
+        (the cluster-settings update consumer — same adapter shape as the
+        kNN batcher's)."""
+        from opensearch_tpu.common.settings import Settings
+
+        s = Settings.from_flat({
+            st.key: flat[st.key] for st in MESH_SETTINGS if st.key in flat
+        })
+        self.configure(hbm_budget_bytes=MESH_HBM_BUDGET_SETTING.get(s))
 
     # -- keys ---------------------------------------------------------------
 
@@ -82,23 +157,57 @@ class ShardMeshRegistry:
                 self._bundles[key] = bundle
             return bundle
 
+    def _evict_locked(self, key: tuple, reason: str) -> None:
+        bundle = self._bundles.pop(key)
+        nbytes = _bundle_nbytes(bundle)
+        self._mem["resident_bytes"] -= nbytes
+        self.stats["evictions"] += 1
+        self.stats["evicted_bytes"] += nbytes
+        _free_bundle(bundle, reason=reason)
+        # the eviction decision rides the triggering request's trace as a
+        # span EVENT (no-op outside a span): budget pressure is diagnosable
+        # from the trace that paid for it, not only from counters
+        from opensearch_tpu.telemetry.tracing import add_span_event
+
+        add_span_event("mesh.evict", {
+            "index": key[0], "field": key[1], "reason": reason,
+            "bytes": nbytes,
+        })
+
+    def _enforce_budget_locked(self, incoming: int) -> None:
+        """LRU-by-bytes: evict from the cold end until `incoming` more
+        bytes fit the budget. A single bundle larger than the whole budget
+        is still admitted (the query must be served; everything else
+        evicts) — the stats make that state visible."""
+        budget = self.hbm_budget_bytes
+        if budget <= 0:
+            return
+        while self._bundles and \
+                self._mem["resident_bytes"] + incoming > budget:
+            self._evict_locked(next(iter(self._bundles)), "hbm-budget")
+
     def put(self, key: tuple, bundle: Any) -> Any:
         """Insert a freshly built bundle; returns the WINNING bundle (an
         entry another thread raced in first wins, so callers always launch
-        against the cached slab)."""
+        against the cached slab — the losing duplicate's ledger allocation
+        is freed here)."""
         with self._lock:
             existing = self._bundles.get(key)
             if existing is not None:
+                if existing is not bundle:
+                    _free_bundle(bundle, reason="duplicate-build")
                 return existing
             # one live bundle per (index, field): superseded generations
-            # of the same residency slot evict now, not at LRU pressure
+            # of the same residency slot evict now, not at budget pressure
             for stale in [k for k in self._bundles if k[:2] == key[:2]]:
-                del self._bundles[stale]
-                self.stats["evictions"] += 1
-            while len(self._bundles) >= self.max_bundles:
-                del self._bundles[next(iter(self._bundles))]
-                self.stats["evictions"] += 1
+                self._evict_locked(stale, "superseded")
+            self._enforce_budget_locked(incoming=_bundle_nbytes(bundle))
+            if self.max_bundles is not None:
+                while len(self._bundles) >= self.max_bundles:
+                    self._evict_locked(next(iter(self._bundles)),
+                                       "bundle-count")
             self._bundles[key] = bundle
+            self._mem["resident_bytes"] += _bundle_nbytes(bundle)
             self.stats["builds"] += 1
             return bundle
 
@@ -107,10 +216,20 @@ class ShardMeshRegistry:
         index was deleted); returns the number of bundles dropped."""
         with self._lock:
             stale = [k for k in self._bundles if k[0] == index]
+            stale_bytes = sum(
+                _bundle_nbytes(self._bundles[k]) for k in stale)
             for k in stale:
-                del self._bundles[k]
+                self._evict_locked(k, "invalidated")
             if stale:
+                # invalidations are their own counters; _evict_locked
+                # already counted them as evictions (count AND bytes), so
+                # rebalance both — evicted_bytes must reconcile with the
+                # evictions counter it documents
+                self.stats["evictions"] -= len(stale)
+                self.stats["evicted_bytes"] -= stale_bytes
                 self.stats["invalidations"] += len(stale)
+                self.stats["invalidated_bytes"] = (
+                    self.stats.get("invalidated_bytes", 0) + stale_bytes)
             return len(stale)
 
     # -- launch bookkeeping -------------------------------------------------
@@ -136,28 +255,42 @@ class ShardMeshRegistry:
     # -- introspection ------------------------------------------------------
 
     def resident(self) -> list[dict]:
-        """What is device-resident right now (for node stats / debugging)."""
+        """What is device-resident right now (for node stats / debugging):
+        one row per bundle with its byte size."""
         with self._lock:
             return [
                 {"index": k[0], "field": k[1], "shards": k[2],
-                 "generations": list(k[4])}
-                for k in self._bundles
+                 "generations": list(k[4]),
+                 "bytes": _bundle_nbytes(b)}
+                for k, b in self._bundles.items()
             ]
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._mem["resident_bytes"]
 
     def snapshot_stats(self) -> dict:
         with self._lock:
             out = dict(self.stats)
             out["resident_bundles"] = len(self._bundles)
+            out["resident_bytes"] = self._mem["resident_bytes"]
+            out["hbm_budget_bytes"] = self.hbm_budget_bytes
         return out
 
     def clear(self) -> None:
         with self._lock:
+            for bundle in self._bundles.values():
+                _free_bundle(bundle, reason="cleared")
             self._bundles.clear()
+            # fixed-key accounting cell, not a growing buffer
+            self._mem["resident_bytes"] = 0  # tpulint: disable=TPU009
 
     def reset_stats(self) -> None:
         """Test hook: zero the counters (never the resident bundles)."""
         with self._lock:
-            self.stats = dict.fromkeys(self.stats, 0)
+            zeroed = dict.fromkeys(self.stats, 0)
+            self.stats.clear()
+            self.stats.update(zeroed)
 
 
 # process-wide default registry: adopted by serving nodes (TpuNode /

@@ -28,6 +28,11 @@ from opensearch_tpu.index.segment import (
     pad_size,
     split_i64,
 )
+from opensearch_tpu.telemetry.device_ledger import (
+    KIND_COLUMN,
+    array_nbytes,
+    default_ledger,
+)
 
 # IVF-PQ publish-time build accounting (surfaced via the knn_batch stats
 # section's `ann.index_builds`): builds happen on the refresh/merge path,
@@ -96,24 +101,45 @@ class DeviceSegment:
     keyword_fields: dict[str, DeviceKeywordField]
     numeric_fields: dict[str, DeviceNumericField]
     vector_fields: dict[str, DeviceVectorField]
+    # residency-ledger handles for this segment's device arrays, keyed by
+    # logical part ("<field>", "_live", "ivfpq:<field>"): the engine frees
+    # them when it retires the segment (merge, replicated-install, close)
+    allocations: dict | None = None
 
     def with_live(self, live_host: np.ndarray) -> "DeviceSegment":
         """Republishes the deletes bitmap (refresh after deletes)."""
         live = np.zeros(self.n_pad, dtype=bool)
         live[: self.n_docs] = live_host[: self.n_docs]
+        live_dev = jax.device_put(jnp.asarray(live))
+        # the republished bitmap supersedes the old one on device: swap the
+        # ledger allocation so residency tracks the PUBLISHED set (column
+        # allocations move to the new segment object unchanged)
+        allocs = dict(self.allocations or {})
+        old_live = allocs.pop("_live", None)
+        if old_live is not None:
+            old_live.free(reason="live-republished")
+        allocs["_live"] = default_ledger.register(
+            KIND_COLUMN, array_nbytes(live_dev), field="_live")
         return DeviceSegment(
             name=self.name,
             n_docs=self.n_docs,
             n_pad=self.n_pad,
-            live=jax.device_put(jnp.asarray(live)),
+            live=live_dev,
             text_fields=self.text_fields,
             keyword_fields=self.keyword_fields,
             numeric_fields=self.numeric_fields,
             vector_fields=self.vector_fields,
+            allocations=allocs,
         )
 
+    def free_allocations(self, reason: str = "retired") -> None:
+        """Release this segment's residency-ledger entries (the engine's
+        retirement hook; idempotent)."""
+        for alloc in (self.allocations or {}).values():
+            alloc.free(reason=reason)
 
-def _maybe_build_ann(vf, device):
+
+def _maybe_build_ann(vf, device, field: str | None = None):
     """Build an IVF-PQ index for a sealed vector column when asked for.
 
     Returns (ann_or_None, nprobe_default). ANN serves l2/cosine; dot_product
@@ -139,16 +165,21 @@ def _maybe_build_ann(vf, device):
         m -= 1
     doc_ids = np.nonzero(vf.present)[0].astype(np.int32)
     t0 = time.perf_counter_ns()
-    ann = ivfpq.build(
-        vf.vectors[doc_ids],
-        doc_ids,
-        nlist=int(params.get("nlist", ivfpq.DEFAULT_NLIST)),
-        m=m,
-        ks=int(params.get("ks", ivfpq.DEFAULT_KS)),
-        iters=int(params.get("iters", 10)),
-        normalized=vf.similarity in ("cosine", "cosinesimil"),
-        device=device,
-    )
+    from opensearch_tpu.telemetry.device_ledger import upload_scope
+
+    # field attribution for the slab's ledger allocation (ivfpq.build
+    # registers it; index/shard/generation come from the engine's scope)
+    with upload_scope(field=field):
+        ann = ivfpq.build(
+            vf.vectors[doc_ids],
+            doc_ids,
+            nlist=int(params.get("nlist", ivfpq.DEFAULT_NLIST)),
+            m=m,
+            ks=int(params.get("ks", ivfpq.DEFAULT_KS)),
+            iters=int(params.get("iters", 10)),
+            normalized=vf.similarity in ("cosine", "cosinesimil"),
+            device=device,
+        )
     with _ann_build_lock:
         _ann_build_stats["builds"] += 1
         _ann_build_stats["build_wall_ns"] += time.perf_counter_ns() - t0
@@ -161,6 +192,14 @@ def _maybe_build_ann(vf, device):
 def to_device(seg: HostSegment, device=None) -> DeviceSegment:
     n_pad = pad_size(seg.n_docs)
     put = lambda a: jax.device_put(jnp.asarray(a), device)
+    # residency accounting: one ledger allocation per published column
+    # (bytes == the device arrays' summed .nbytes); index/shard/generation
+    # attribution rides the engine's upload_scope
+    allocs: dict[str, object] = {}
+
+    def track(fname: str, *arrays) -> None:
+        allocs[fname] = default_ledger.register(
+            KIND_COLUMN, array_nbytes(*arrays), field=fname)
 
     live = np.zeros(n_pad, dtype=bool)
     live[: seg.n_docs] = seg.live
@@ -168,27 +207,29 @@ def to_device(seg: HostSegment, device=None) -> DeviceSegment:
     text_fields: dict[str, DeviceTextField] = {}
     for fname, tf in seg.text_fields.items():
         p_pad = pad_size(max(len(tf.postings_docs), 1))
-        text_fields[fname] = DeviceTextField(
+        text_fields[fname] = dtf = DeviceTextField(
             postings_docs=put(_pad1(tf.postings_docs, p_pad)),
             postings_tfs=put(_pad1(tf.postings_tfs, p_pad)),
             doc_len=put(_pad1(tf.doc_len, n_pad)),
         )
+        track(fname, dtf.postings_docs, dtf.postings_tfs, dtf.doc_len)
 
     keyword_fields: dict[str, DeviceKeywordField] = {}
     for fname, kf in seg.keyword_fields.items():
         e_pad = pad_size(max(len(kf.mv_ords), 1))
-        keyword_fields[fname] = DeviceKeywordField(
+        keyword_fields[fname] = dkf = DeviceKeywordField(
             first_ord=put(_pad1(kf.first_ord, n_pad, fill=-1)),
             mv_ords=put(_pad1(kf.mv_ords, e_pad, fill=-2)),
             mv_docs=put(_pad1(kf.mv_docs, e_pad, fill=0)),
         )
+        track(fname, dkf.first_ord, dkf.mv_ords, dkf.mv_docs)
 
     numeric_fields: dict[str, DeviceNumericField] = {}
     for fname, nf in seg.numeric_fields.items():
         present = put(_pad1(nf.present, n_pad, fill=False))
         if nf.kind == "int":
             hi, lo = split_i64(nf.values_i64)
-            numeric_fields[fname] = DeviceNumericField(
+            numeric_fields[fname] = dnf = DeviceNumericField(
                 kind="int",
                 hi=put(_pad1(hi, n_pad)),
                 lo=put(_pad1(lo, n_pad)),
@@ -196,19 +237,20 @@ def to_device(seg: HostSegment, device=None) -> DeviceSegment:
                 present=present,
             )
         else:
-            numeric_fields[fname] = DeviceNumericField(
+            numeric_fields[fname] = dnf = DeviceNumericField(
                 kind="float",
                 hi=None,
                 lo=None,
                 values=put(_pad1(nf.values_f64.astype(np.float32), n_pad)),
                 present=present,
             )
+        track(fname, dnf.hi, dnf.lo, dnf.values, dnf.present)
 
     vector_fields: dict[str, DeviceVectorField] = {}
     for fname, vf in seg.vector_fields.items():
         vecs = _pad1(vf.vectors, n_pad)
-        ann, nprobe_default = _maybe_build_ann(vf, device)
-        vector_fields[fname] = DeviceVectorField(
+        ann, nprobe_default = _maybe_build_ann(vf, device, field=fname)
+        vector_fields[fname] = dvf = DeviceVectorField(
             vectors=put(vecs),
             norms_sq=put((vecs.astype(np.float64) ** 2).sum(axis=1).astype(np.float32)),
             present=put(_pad1(vf.present, n_pad, fill=False)),
@@ -217,14 +259,21 @@ def to_device(seg: HostSegment, device=None) -> DeviceSegment:
             ann=ann,
             nprobe_default=nprobe_default,
         )
+        track(fname, dvf.vectors, dvf.norms_sq, dvf.present)
+        if ann is not None and getattr(ann, "allocation", None) is not None:
+            allocs[f"ivfpq:{fname}"] = ann.allocation
 
+    live_dev = put(live)
+    allocs["_live"] = default_ledger.register(
+        KIND_COLUMN, array_nbytes(live_dev), field="_live")
     return DeviceSegment(
         name=seg.name,
         n_docs=seg.n_docs,
         n_pad=n_pad,
-        live=put(live),
+        live=live_dev,
         text_fields=text_fields,
         keyword_fields=keyword_fields,
         numeric_fields=numeric_fields,
         vector_fields=vector_fields,
+        allocations=allocs,
     )

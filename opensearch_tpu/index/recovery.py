@@ -224,7 +224,8 @@ class RecoveryTargetDriver:
                  ops_batch: int = DEFAULT_OPS_BATCH,
                  max_retries: int = MAX_CHUNK_RETRIES,
                  chunk_timeout_ms: int = 30_000,
-                 trace: dict | None = None):
+                 trace: dict | None = None,
+                 root_span=None):
         self.transport = transport
         self.scheduler = scheduler
         self.node_id = node_id
@@ -242,6 +243,10 @@ class RecoveryTargetDriver:
         # included — re-enters it, so one recovery is ONE trace tree even
         # across scheduler callbacks where contextvars don't survive
         self.trace = trace
+        # the root Span OBJECT (when the owner holds one): chunk retries
+        # land on it as span EVENTS, so the exported recovery trace shows
+        # every backoff without a span per retry
+        self.root_span = root_span
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -264,6 +269,13 @@ class RecoveryTargetDriver:
                 on_give_up(e)
                 return
             self.progress.retries += 1
+            if self.root_span is not None:
+                # per-span log of the retry (bounded by the span's event
+                # cap): the exported trace shows what backed off and why
+                self.root_span.add_event("recovery.chunk_retry", {
+                    "action": action, "attempt": attempt + 1,
+                    "error": str(e),
+                })
             self.scheduler.schedule(
                 backoff_delay_ms(attempt + 1),
                 lambda: self._request_with_retry(

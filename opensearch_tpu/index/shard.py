@@ -76,7 +76,8 @@ class IndexShard:
                  durability: str = "request", replication: str = "DOCUMENT"):
         self.shard_id = shard_id
         self.mapper_service = mapper_service
-        self.engine = Engine(path, mapper_service, durability=durability)
+        self.engine = Engine(path, mapper_service, durability=durability,
+                             shard_label=(shard_id.index, shard_id.shard))
         self.primary = True
         self.replication = replication
         # peer-recovery bookkeeping (IndexShard.recoveryState analog, read

@@ -1956,6 +1956,96 @@ class MetricHygieneChecker(Checker):
 
 
 # ---------------------------------------------------------------------------
+# TPU014 — naked-device-put (uploads must route through the residency ledger)
+# ---------------------------------------------------------------------------
+
+# modules whose jax.device_put calls publish serving-path structures into
+# HBM: every upload there must be accounted by the device-residency ledger
+# (telemetry/device_ledger.py) or device memory goes dark again (ISSUE 10)
+_DEVICE_MODULE_PATTERNS = (
+    "opensearch_tpu/index/",
+    "opensearch_tpu/ops/",
+    "opensearch_tpu/search/",
+    "opensearch_tpu/cluster/",
+)
+# explicit opt-in for fixtures / new device modules; line-start anchored
+# like the sim marker so merely MENTIONING it doesn't opt a file in
+_DEVICE_MARKER = "# tpulint: device-module"
+_DEVICE_MARKER_RE = None  # compiled lazily
+
+
+def _device_scoped(display_path: str, source: str) -> bool:
+    global _DEVICE_MARKER_RE
+    if any(p in display_path for p in _DEVICE_MODULE_PATTERNS):
+        return True
+    if _DEVICE_MARKER not in source:
+        return False
+    if _DEVICE_MARKER_RE is None:
+        import re
+
+        _DEVICE_MARKER_RE = re.compile(
+            r"(?m)^\s*" + re.escape(_DEVICE_MARKER))
+    return _DEVICE_MARKER_RE.search(source) is not None
+
+
+def _calls_ledger(scope: ast.AST) -> bool:
+    """True when the scope contains any call whose callee path names the
+    ledger (``default_ledger.register``, ``ledger.record_transient``,
+    ``bundle.allocation.free`` ...): the evidence that this function's
+    uploads are accounted."""
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name is not None and ("ledger" in name.lower()
+                                 or "allocation" in name.lower()):
+            return True
+    return False
+
+
+class NakedDevicePutChecker(Checker):
+    """TPU014: a ``jax.device_put`` in a device-serving module whose
+    enclosing function never touches the residency ledger is an
+    UNACCOUNTED HBM upload — the bytes exist on device but `_nodes/stats`
+    `device`, the Prometheus gauges and the mesh byte budget can't see
+    them, so every placement/budget decision reads a lie. Route the upload
+    through ``telemetry/device_ledger`` (register / record_transient) in
+    the same function, or suppress with a comment where residency is
+    genuinely not the function's concern."""
+
+    rule_id = "TPU014"
+    name = "naked-device-put"
+    description = ("jax.device_put in serving modules must route through "
+                   "the device-residency ledger")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return _device_scoped(display_path, source)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+
+        def visit(node: ast.AST, ok: bool) -> None:
+            # evidence is per-FUNCTION: a module-level ledger import alone
+            # proves nothing about a given upload site. Nested functions
+            # (and the `put = lambda ...` idiom) inherit their enclosing
+            # function's evidence.
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ok = ok or _calls_ledger(node)
+            if (isinstance(node, ast.Call)
+                    and ctx.canonical(call_name(node)) == "jax.device_put"
+                    and not ok):
+                out.append(ctx.violation(
+                    "TPU014", node,
+                    "jax.device_put without residency accounting: "
+                    "register the upload with telemetry/device_ledger "
+                    "(or record_transient for per-launch uploads) in "
+                    "this function"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, ok)
+
+        visit(ctx.tree, ok=False)
+        return out
+
 
 ALL_CHECKERS: list[Checker] = [
     JitPurityChecker(),
@@ -1971,6 +2061,7 @@ ALL_CHECKERS: list[Checker] = [
     BlockingOnDataWorkerChecker(),
     SpanLeakChecker(),
     MetricHygieneChecker(),
+    NakedDevicePutChecker(),
 ]
 
 RULES: dict[str, Checker] = {c.rule_id: c for c in ALL_CHECKERS}

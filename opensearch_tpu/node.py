@@ -575,6 +575,13 @@ class TpuNode:
             svc = self._get_index(name)
             svc.close()
             del self.indices[name]
+            # release the index's device-resident mesh bundles promptly
+            # (the cluster path does this at state application; without it
+            # a deleted index's slab sat in HBM until LRU/budget pressure —
+            # a leak the residency ledger made visible)
+            from opensearch_tpu.cluster.shard_mesh import default_registry
+
+            default_registry.invalidate_index(name)
             shutil.rmtree(self._index_path(name), ignore_errors=True)
         self._persist_index_registry()
         self._configure_slowlogs()
@@ -3085,6 +3092,12 @@ class TpuNode:
             # captures this trace id (a p99 bucket links to the trace)
             self.telemetry.metrics.counter("search.total").add(1)
             self.telemetry.metrics.histogram("search.took_ms").record(took)
+            # per-index series under the SAME constant metric name (vary
+            # labels, not names — TPU013); wildcard/multi-index targets
+            # stay base-series-only, and the registry bounds cardinality
+            if len(index_names) == 1 and "*" not in expr:
+                self.telemetry.metrics.histogram(
+                    "search.took_ms", labels={"index": expr}).record(took)
         if pl is not None:
             resp = self.search_pipelines.transform_response(
                 pl, {**body, **pl_ctx}, resp
@@ -3534,6 +3547,15 @@ class TpuNode:
 
         if any(s.key in eff or s.key in changed for s in ANN_SETTINGS):
             default_config.apply_settings(eff)
+        # shard-mesh HBM byte budget: the registry is process-wide like the
+        # batcher, so the same only-when-named guard applies
+        from opensearch_tpu.cluster.shard_mesh import (
+            MESH_SETTINGS,
+            default_registry,
+        )
+
+        if any(s.key in eff or s.key in changed for s in MESH_SETTINGS):
+            default_registry.apply_settings(eff)
         self.request_cache.set_max_bytes(
             CACHE_SIZE_SETTING.get(Settings.from_flat(eff)))
         # span exporter: per-node (like the request cache), applies

@@ -224,6 +224,18 @@ class IVFPQIndex:
     # process-unique id of this build: serving batch keys carry it so a
     # rebuild (refresh / force-merge) can never merge into an old batch
     build_generation: int = 0
+    # device-residency ledger handle for this build's slab (freed when the
+    # owning segment retires — the engine's retirement path walks it)
+    allocation: object | None = None
+
+    @property
+    def nbytes(self) -> int:
+        """Summed device bytes of the slab: packed lists + coarse/PQ
+        codebooks (what the residency ledger accounts for this build)."""
+        return sum(int(a.nbytes) for a in (
+            self.codes, self.ids, self.mask,
+            self.params.coarse, self.params.codebooks,
+        ))
 
 
 def build(
@@ -268,7 +280,7 @@ def build(
         packed_mask[li, : len(rows)] = True
 
     put = lambda a: jax.device_put(jnp.asarray(a), device)
-    return IVFPQIndex(
+    out = IVFPQIndex(
         params=IVFPQParams(
             coarse=put(np.asarray(params.coarse)),
             codebooks=put(np.asarray(params.codebooks)),
@@ -282,6 +294,14 @@ def build(
         normalized=normalized,
         build_generation=next(_build_generation),
     )
+    # HBM residency accounting: the slab is device-resident until the
+    # owning segment retires (index/field attribution rides the caller's
+    # upload_scope; the generation is this build's own id)
+    from opensearch_tpu.telemetry.device_ledger import KIND_IVFPQ, default_ledger
+
+    out.allocation = default_ledger.register(
+        KIND_IVFPQ, out.nbytes, generation=out.build_generation)
+    return out
 
 
 # --------------------------------------------------------------------------

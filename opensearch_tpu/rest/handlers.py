@@ -303,6 +303,9 @@ def build_router() -> Router:
     reg("POST", "/{index}/_delete_by_query", delete_by_query_handler)
     # metrics exposition (prometheus-exporter plugin surface)
     reg("GET", "/_prometheus/metrics", prometheus_metrics)
+    # span-export admin: flush every node's exporter, return exporter
+    # ledgers + device-memory residency snapshots
+    reg("POST", "/_otel/flush", otel_flush)
     # tasks
     reg("GET", "/_tasks", list_tasks)
     reg("GET", "/_tasks/{task_id}", get_task)
@@ -1600,16 +1603,15 @@ def _prom_registry_lines(stats: dict, labels: dict | None,
             lines.append(f"# TYPE {m} counter")
         lines.append(
             f"{m}{_prom_labels(labels)} {_prom_fmt(stats['counters'][name])}")
-    for name in sorted(stats.get("histograms", {})):
-        h = stats["histograms"][name]
-        m = _prom_name(name)
-        if declare_types:
-            lines.append(f"# TYPE {m} histogram")
+
+    def histogram_series(m: str, h: dict, series_labels: dict | None,
+                         with_minmax: bool) -> None:
         exemplars = ({e["le"]: e for e in h.get("exemplars", [])}
                      if want_exemplars else {})
 
         def bucket_line(le_text, count, le_key):
-            line = (f'{m}_bucket{_prom_labels(labels, {"le": le_text})} '
+            line = (f'{m}_bucket'
+                    f'{_prom_labels(series_labels, {"le": le_text})} '
                     f"{_prom_fmt(count)}")
             ex = exemplars.get(le_key)
             if ex is not None:
@@ -1620,13 +1622,31 @@ def _prom_registry_lines(stats: dict, labels: dict | None,
         for b in h.get("buckets", []):
             lines.append(bucket_line(_prom_fmt(b["le"]), b["count"], b["le"]))
         lines.append(bucket_line("+Inf", h["count"], "+Inf"))
-        lines.append(f"{m}_count{_prom_labels(labels)} {_prom_fmt(h['count'])}")
-        lines.append(f"{m}_sum{_prom_labels(labels)} {_prom_fmt(h['sum'])}")
+        lines.append(
+            f"{m}_count{_prom_labels(series_labels)} {_prom_fmt(h['count'])}")
+        lines.append(
+            f"{m}_sum{_prom_labels(series_labels)} {_prom_fmt(h['sum'])}")
+        if not with_minmax:
+            return
         for gauge in ("min", "max"):
             if declare_types:
                 lines.append(f"# TYPE {m}_{gauge} gauge")
-            lines.append(
-                f"{m}_{gauge}{_prom_labels(labels)} {_prom_fmt(h[gauge])}")
+            lines.append(f"{m}_{gauge}{_prom_labels(series_labels)} "
+                         f"{_prom_fmt(h[gauge])}")
+
+    for name in sorted(stats.get("histograms", {})):
+        h = stats["histograms"][name]
+        m = _prom_name(name)
+        if declare_types:
+            lines.append(f"# TYPE {m} histogram")
+        histogram_series(m, h, labels, with_minmax=True)
+        # labeled series of the same family (per-index took etc.): one
+        # sample set per label combination, node label preserved in the
+        # federated view; min/max gauges stay base-series-only
+        for series in h.get("series", []):
+            histogram_series(m, series,
+                             {**series.get("labels", {}), **(labels or {})},
+                             with_minmax=False)
     return lines
 
 
@@ -1649,6 +1669,18 @@ def prometheus_metrics(node: TpuNode, params, query, body):
 
     want_exemplars = flag("exemplars")
     lines: list[str] = []
+
+    def device_gauges(totals: dict, extra: dict | None) -> None:
+        # per-device HBM residency gauges from the device ledger: the
+        # roofline-facing number every placement decision reads
+        m = "opensearch_tpu_device_resident_bytes"
+        if extra is None:
+            lines.append(f"# TYPE {m} gauge")
+        for dev in sorted(totals):
+            lines.append(
+                f"{m}{_prom_labels({'device': dev}, extra)} "
+                f"{_prom_fmt(totals[dev])}")
+
     cluster_metrics = getattr(node, "cluster_metrics", None)
     federated = flag("cluster") and cluster_metrics is not None
     if federated:
@@ -1660,10 +1692,14 @@ def prometheus_metrics(node: TpuNode, params, query, body):
             lines.extend(_prom_registry_lines(
                 per_node[nid], {"node": nid}, declare_types=False,
                 want_exemplars=want_exemplars))
+            device_gauges(per_node[nid].get("device", {}), {"node": nid})
     else:
         lines.extend(_prom_registry_lines(
             node.telemetry.metrics.stats(), None, declare_types=True,
             want_exemplars=want_exemplars))
+        from opensearch_tpu.telemetry.device_ledger import default_ledger
+
+        device_gauges(default_ledger.device_totals(), None)
     # task-manager liveness gauges ride along (cheap, always useful on a
     # scrape dashboard). They are LOCAL to the serving node: the federated
     # view labels them so scrapes of different nodes never emit the same
@@ -1681,6 +1717,33 @@ def prometheus_metrics(node: TpuNode, params, query, body):
             lines.append(f"# TYPE {m} gauge")
         lines.append(f"{m}{_prom_labels(task_labels)} {gval}")
     return 200, "\n".join(lines) + "\n"
+
+
+def otel_flush(node: TpuNode, params, query, body):
+    """POST /_otel/flush — force the span exporter(s) to decide every
+    pending trace fragment and drain to the sink, across all nodes in
+    cluster mode; returns each node's exporter ledger and device-memory
+    residency snapshot. The admin's "make the telemetry land NOW" button
+    (crash investigation, pre-scrape sync, test determinism)."""
+    cluster_flush = getattr(node, "cluster_otel_flush", None)
+    if cluster_flush is not None:
+        return 200, cluster_flush()
+    from opensearch_tpu.telemetry import device_ledger
+
+    exporter = node.telemetry.tracer.exporter
+    if exporter is not None:
+        exporter.flush()
+    return 200, {
+        "_nodes": {"total": 1, "successful": 1, "failed": 0},
+        "cluster_name": "opensearch-tpu",
+        "nodes": {"node-0": {
+            "name": node.node_name,
+            "flushed": exporter is not None,
+            "exporter": (exporter.snapshot_stats()
+                         if exporter is not None else None),
+            "device": device_ledger.stats_section(),
+        }},
+    }
 
 
 def get_task(node: TpuNode, params, query, body):
@@ -2993,7 +3056,7 @@ _NODES_STATS_METRICS = {
     "transport", "http", "breaker", "script", "discovery", "ingest",
     "adaptive_selection", "indexing_pressure", "search_backpressure",
     "shard_indexing_pressure", "tasks", "telemetry", "slowlog", "knn_batch",
-    "shard_mesh",
+    "shard_mesh", "device",
 }
 
 
@@ -3003,6 +3066,8 @@ def nodes_stats(node: TpuNode, params, query, body):
     metric/index_metric filtering."""
     import difflib
     import resource
+
+    from opensearch_tpu.telemetry import device_ledger
 
     raw_metric = params.get("metric") or query.get("metric")
     metrics = ([m.strip() for m in str(raw_metric).split(",") if m.strip()]
@@ -3107,6 +3172,11 @@ def nodes_stats(node: TpuNode, params, query, body):
         # kNN dispatch batcher (search/batcher.py): merged-batch /
         # queue-depth / shed counters for the cross-request micro-batching
         "knn_batch": node.knn_batcher.snapshot_stats(),
+        # device-memory residency (telemetry/device_ledger.py): what is in
+        # HBM in bytes — per-structure rows, the accounting identity
+        # (resident == allocated − freed), per-kernel-family compile
+        # accounting, and the shard-mesh byte-budget state
+        "device": device_ledger.stats_section(),
         "telemetry": {
             **node.telemetry.metrics.stats(),
             # the tail of the spans ring: one stitched trace tree per

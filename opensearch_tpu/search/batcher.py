@@ -280,7 +280,8 @@ class KnnDispatchBatcher:
                                   tuple[list, bool]],
                  shards: int = 1, *, kind: str = "exact",
                  rank: int = 0,
-                 alt_keys: Sequence[Any] = ()) -> DispatchOutcome:
+                 alt_keys: Sequence[Any] = (),
+                 family: str | None = None) -> DispatchOutcome:
         """Run `payload` through the batch identified by `key`.
 
         `launch(payloads)` performs ONE device launch for the whole batch
@@ -307,9 +308,13 @@ class KnnDispatchBatcher:
         truncates for free. `rank` orders the k-buckets: a batch launches
         with its largest-rank member's closure, so joiners can never
         shrink the launch the natives asked for.
+
+        `family` names the kernel family for the device-residency ledger's
+        retrace/compile accounting: a launch whose retraced flag fires
+        counts one jit-cache entry (plus its first-launch wall) there.
         """
         if key is None or not self.enabled or self.max_batch_size <= 1:
-            return self._solo(payload, launch, shards, kind)
+            return self._solo(payload, launch, shards, kind, family)
         with self._cond:
             self.pressure.acquire()
             entry = _Entry(payload, timeutil.monotonic_millis(),
@@ -329,28 +334,29 @@ class KnnDispatchBatcher:
                 bucket = self._buckets[key] = _Bucket()
             bucket.entries.append(entry)
             if len(bucket.entries) >= self.max_batch_size:
-                batch = self._take_locked(key)
+                batch, reason = self._take_locked(key), "size"
             elif self.max_wait_ms <= 0 or (
                 self._in_flight.get(key, 0) == 0
                 and self._ewma <= _SOLO_EWMA_THRESHOLD
             ):
                 if len(bucket.entries) == 1:
                     self.stats["solo_fast_path"] += 1
-                batch = self._take_locked(key)
+                batch, reason = self._take_locked(key), "solo"
             else:
-                batch = None
+                batch, reason = None, ""
         while True:
             if batch is not None:
                 out = self._run_batch(key, batch, own=entry,
-                                      shards=shards, kind=kind)
+                                      shards=shards, kind=kind,
+                                      family=family, reason=reason)
                 if out is not None:
                     return out
                 # we led a batch that did not include our own entry (the
                 # size bound shrank under us): keep waiting for ours
                 batch = None
                 continue
-            batch = self._await_or_lead(key, entry, deadline)
-            if batch is None:
+            led = self._await_or_lead(key, entry, deadline)
+            if led is None:
                 # another leader served us
                 if entry.error is not None:
                     raise entry.error
@@ -358,16 +364,43 @@ class KnnDispatchBatcher:
                     entry.result, entry.batch_size, entry.wall_ns,
                     entry.retraced, entry.wait_ms,
                 )
+            batch, reason = led
 
     # -- internals ---------------------------------------------------------
 
     def _solo(self, payload: Any, launch, shards: int = 1,
-              kind: str = "exact") -> DispatchOutcome:
+              kind: str = "exact",
+              family: str | None = None) -> DispatchOutcome:
         t0 = time.perf_counter_ns()
         results, retraced = launch([payload])
         wall = time.perf_counter_ns() - t0
         self._record_launch(1, wall, 0, shards, kind)
+        self._after_launch(kind, family, retraced, wall, merged=1,
+                           reason="unbatched")
         return DispatchOutcome(results[0], 1, wall, retraced, 0)
+
+    def _after_launch(self, kind: str, family: str | None, retraced: bool,
+                      wall_ns: int, merged: int, reason: str) -> None:
+        """Post-launch observability: the flush reason rides the leader's
+        span as an event, and a retraced launch counts one jit-cache entry
+        (first-launch wall = compile + run) in the residency ledger's
+        per-kernel-family compile table. Only NOTEWORTHY flushes emit an
+        event — a coalesced batch or a wait-policy decision (size/
+        deadline/backlog); the steady solo fast path stays event-free so
+        the per-span export payload (the ≤5% otel-overhead gate) doesn't
+        grow with every launch."""
+        from opensearch_tpu.telemetry.device_ledger import default_ledger
+
+        if merged > 1 or reason in ("size", "deadline", "backlog"):
+            from opensearch_tpu.telemetry.tracing import add_span_event
+
+            add_span_event("knn.batch.flush", {
+                "reason": reason, "merged": merged, "kind": kind,
+            })
+        # launch closures that account their own compiles (the mesh path)
+        # pass no family — recording here too would double-count the entry
+        if retraced and family is not None:
+            default_ledger.record_compile(family, wall_ns)
 
     def _take_locked(self, key: Any) -> list[_Entry]:
         """Detach the key's pending entries (<= max_batch_size of them) as
@@ -389,9 +422,10 @@ class KnnDispatchBatcher:
         return batch
 
     def _await_or_lead(self, key: Any, entry: _Entry,
-                       deadline: int) -> list[_Entry] | None:
+                       deadline: int) -> tuple[list[_Entry], str] | None:
         """Wait until the entry is served, or its bucket qualifies for a
-        flush it can lead. Returns the batch to lead, or None if done."""
+        flush it can lead. Returns (batch, flush reason) to lead, or None
+        if done."""
         with self._cond:
             while True:
                 if entry.done:
@@ -403,10 +437,15 @@ class KnnDispatchBatcher:
                     continue
                 bucket = self._buckets.get(key)
                 now = timeutil.monotonic_millis()
-                if (bucket is not None
-                        and (len(bucket.entries) >= self.max_batch_size
-                             or bucket.flush_now)) or now >= deadline:
-                    return self._take_locked(key)
+                if bucket is not None and (
+                        len(bucket.entries) >= self.max_batch_size
+                        or bucket.flush_now):
+                    reason = ("size"
+                              if len(bucket.entries) >= self.max_batch_size
+                              else "backlog")
+                    return self._take_locked(key), reason
+                if now >= deadline:
+                    return self._take_locked(key), "deadline"
                 remaining = max((deadline - now) / 1000.0, 0.0)
                 signaled = self._cond.wait(remaining)
                 if not signaled and timeutil.monotonic_millis() <= now:
@@ -418,7 +457,8 @@ class KnnDispatchBatcher:
 
     def _run_batch(self, key: Any, batch: list[_Entry],
                    own: _Entry, shards: int = 1,
-                   kind: str = "exact") -> DispatchOutcome | None:
+                   kind: str = "exact", family: str | None = None,
+                   reason: str = "") -> DispatchOutcome | None:
         """Launch one batch; returns the outcome for `own`, or None when
         `own` was not part of this batch (its caller keeps waiting)."""
         # cross-k coalescing: the batch launches with its LARGEST-rank
@@ -447,6 +487,8 @@ class KnnDispatchBatcher:
         self._record_launch(len(batch), wall,
                             max((e.wait_ms for e in batch), default=0),
                             shards, kind)
+        self._after_launch(kind, family, retraced, wall,
+                           merged=len(batch), reason=reason or "lead")
         if not any(e is own for e in batch):
             return None
         return DispatchOutcome(own.result, len(batch), wall, retraced,
@@ -510,6 +552,8 @@ default_batcher = KnnDispatchBatcher()
 
 def dispatch(key: Any, payload: Any, launch, shards: int = 1, *,
              kind: str = "exact", rank: int = 0,
-             alt_keys: Sequence[Any] = ()) -> DispatchOutcome:
+             alt_keys: Sequence[Any] = (),
+             family: str | None = None) -> DispatchOutcome:
     return default_batcher.dispatch(key, payload, launch, shards=shards,
-                                    kind=kind, rank=rank, alt_keys=alt_keys)
+                                    kind=kind, rank=rank, alt_keys=alt_keys,
+                                    family=family)

@@ -113,13 +113,22 @@ class _IndexBundle:
     """[S, n_flat, d] mesh-sharded slabs + host-side flat->segment maps."""
 
     def __init__(self, vectors, norms_sq, valid, n_flat: int,
-                 seg_offsets: list[list[tuple[int, int, int]]]):
+                 seg_offsets: list[list[tuple[int, int, int]]],
+                 allocation=None):
         self.vectors = vectors          # jnp [S, n_flat, d] on mesh
         self.norms_sq = norms_sq        # jnp [S, n_flat]
         self.valid = valid              # jnp [S, n_flat]
         self.n_flat = n_flat
         # per shard: [(flat_start, seg_idx, n_docs)] in segment order
         self.seg_offsets = seg_offsets
+        # device-residency ledger handle; the ShardMeshRegistry frees it
+        # on eviction/invalidation (and on a lost duplicate-build race)
+        self.allocation = allocation
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes) for a in
+                   (self.vectors, self.norms_sq, self.valid))
 
     def locate(self, shard_idx: int, flat: int) -> tuple[int, int]:
         for start, seg_idx, n_docs in self.seg_offsets[shard_idx]:
@@ -175,7 +184,9 @@ def _can_serve(snaps: list, field: str, *,
     return similarity, dims
 
 
-def _build_bundle(snaps: list, field: str, dims: int, mesh: Mesh) -> _IndexBundle:
+def _build_bundle(snaps: list, field: str, dims: int, mesh: Mesh,
+                  index_name: str = "_unknown",
+                  generations: tuple = ()) -> _IndexBundle:
     per_shard_vecs: list[np.ndarray] = []
     per_shard_norms: list[np.ndarray] = []
     per_shard_valid: list[np.ndarray] = []
@@ -236,13 +247,26 @@ def _build_bundle(snaps: list, field: str, dims: int, mesh: Mesh) -> _IndexBundl
     valid = np.stack([pad(v, fill=False) for v in per_shard_valid])
 
     sharding = NamedSharding(mesh, P(DATA_AXIS))
-    return _IndexBundle(
+    bundle = _IndexBundle(
         vectors=jax.device_put(jnp.asarray(vecs), NamedSharding(mesh, P(DATA_AXIS, None, None))),
         norms_sq=jax.device_put(jnp.asarray(norms), sharding),
         valid=jax.device_put(jnp.asarray(valid), sharding),
         n_flat=n_flat,
         seg_offsets=seg_offsets,
     )
+    # HBM residency: the slab stays device-resident until the registry
+    # evicts it (superseded generation, byte budget, invalidation)
+    from opensearch_tpu.telemetry.device_ledger import (
+        KIND_MESH_BUNDLE,
+        default_ledger,
+    )
+
+    bundle.allocation = default_ledger.register(
+        KIND_MESH_BUNDLE, bundle.nbytes, index=index_name, field=field,
+        generation=tuple(generations),
+        device=f"mesh[{len(mesh.devices.flat)}]",
+    )
+    return bundle
 
 
 def _filter_valid_mask(
@@ -339,9 +363,13 @@ def mesh_knn_batch(
         # seconds for a large index and must not stall warm-path queries of
         # other indexes. A same-key race (two cold misses) wastes one
         # duplicate upload at worst — registry.put keeps the cache itself
-        # consistent and returns the winning bundle.
+        # consistent, returns the winning bundle, and frees the loser's
+        # ledger allocation.
         bundle = registry.put(
-            cache_key, _build_bundle(snaps, first.field, dims, mesh)
+            cache_key,
+            _build_bundle(snaps, first.field, dims, mesh,
+                          index_name=index_name,
+                          generations=cache_key[4]),
         )
 
     valid = bundle.valid
@@ -349,6 +377,14 @@ def mesh_knn_batch(
         fmask = _filter_valid_mask(
             shards, snaps, first.filter, alias_filters, bundle.n_flat
         )
+        # per-request upload, consumed by this launch: transient in the
+        # residency ledger (allocated and freed in one step)
+        from opensearch_tpu.telemetry.device_ledger import (
+            KIND_QUERY_BATCH,
+            default_ledger,
+        )
+
+        default_ledger.record_transient(KIND_QUERY_BATCH, fmask.nbytes)
         valid = valid & jax.device_put(
             jnp.asarray(fmask), NamedSharding(mesh, P(DATA_AXIS))
         )
@@ -390,6 +426,16 @@ def mesh_knn_batch(
     wall_ns = time.perf_counter_ns() - t0
     launch_id = registry.next_launch_id()
     registry.record_launch_wall(wall_ns)
+    from opensearch_tpu.telemetry.device_ledger import (
+        KIND_QUERY_BATCH,
+        default_ledger,
+    )
+
+    default_ledger.record_transient(KIND_QUERY_BATCH, q_host.nbytes)
+    if retraced:
+        # program-cache miss == fresh jit entry for the mesh kernel family;
+        # the first launch wall includes the compile
+        default_ledger.record_compile("mesh_knn", wall_ns)
     _count("distributed_searches")
     if has_filter:
         _count("filtered")

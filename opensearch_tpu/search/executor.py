@@ -95,12 +95,20 @@ def _pad_query_batch(rows: list) -> np.ndarray:
     """Stack per-request query vectors into a [B_pad, d] batch, B padded to
     the next power of two (zero rows, results sliced off by the caller) so
     merged batch widths share compiled programs instead of retracing per
-    distinct concurrency level."""
+    distinct concurrency level. The padded batch is a per-launch
+    host->device upload: the residency ledger counts it as transient
+    (allocated and freed in one step)."""
+    from opensearch_tpu.telemetry.device_ledger import (
+        KIND_QUERY_BATCH,
+        default_ledger,
+    )
+
     b = len(rows)
     b_pad = 1 << (b - 1).bit_length()
     out = np.zeros((b_pad, len(rows[0])), np.float32)
     for i, row in enumerate(rows):
         out[i] = row
+    default_ledger.record_transient(KIND_QUERY_BATCH, out.nbytes)
     return out
 
 
@@ -249,6 +257,7 @@ class ShardContext:
                     ann_key(k_bucket), qv[0], launch_ann, shards=1,
                     kind="ann", rank=k_bucket,
                     alt_keys=(ann_key(k_bucket * 2), ann_key(k_bucket * 4)),
+                    family="ivfpq_search",
                 )
                 a_vals, a_ids = out.value
                 # the batch leader may have run a LARGER k bucket: the
@@ -343,7 +352,8 @@ class ShardContext:
                     # width); the batcher's cross-shard stats stay honest
                     out = batcher_mod.dispatch(key, qv[0], launch_streaming,
                                                shards=1, rank=k_bucket,
-                                               alt_keys=alt_keys)
+                                               alt_keys=alt_keys,
+                                               family="knn_topk_streaming")
                     vals, ids = out.value
                     if prof is not None:
                         # a batched operator owns its SHARE of the fenced
@@ -377,7 +387,8 @@ class ShardContext:
                         )
 
                     out = batcher_mod.dispatch(key, qv[0], launch_exact,
-                                               shards=1)
+                                               shards=1,
+                                               family="knn_exact_scores")
                     scores = out.value
                     if prof is not None:
                         prof.record_kernel(
@@ -502,27 +513,35 @@ class ShardContext:
         tmp_ctx = ShardContext(tmp_snap, tmp_ms)
         tmp_ex = SegmentExecutor(tmp_ctx, tmp_host, tmp_dev)
 
-        masks = []
-        for host, dev in self.snapshot.segments:
-            mask = np.zeros(dev.n_pad, bool)
-            for d in range(host.n_docs):
-                if not host.live[d]:
-                    continue
-                source = _json.loads(host.sources[d])
-                stored = source.get(node.field)
-                if not isinstance(stored, dict):
-                    continue
-                try:
-                    parsed = qd.parse_query(stored)
-                    r = tmp_ex.execute(parsed)
-                    if bool(np.asarray(r.mask)[: tmp_host.n_docs].any()):
-                        mask[d] = True
-                except Exception as e:  # noqa: BLE001
-                    # malformed stored query never matches
-                    logger.debug(
-                        "percolate: stored query for doc %d unusable: %s", d, e)
-                    continue
-            masks.append(mask)
+        try:
+            masks = []
+            for host, dev in self.snapshot.segments:
+                mask = np.zeros(dev.n_pad, bool)
+                for d in range(host.n_docs):
+                    if not host.live[d]:
+                        continue
+                    source = _json.loads(host.sources[d])
+                    stored = source.get(node.field)
+                    if not isinstance(stored, dict):
+                        continue
+                    try:
+                        parsed = qd.parse_query(stored)
+                        r = tmp_ex.execute(parsed)
+                        if bool(np.asarray(r.mask)[: tmp_host.n_docs].any()):
+                            mask[d] = True
+                    except Exception as e:  # noqa: BLE001
+                        # malformed stored query never matches
+                        logger.debug(
+                            "percolate: stored query for doc %d unusable: %s",
+                            d, e)
+                        continue
+                masks.append(mask)
+        finally:
+            # the throwaway memory-index's device arrays die with this
+            # query: release their residency-ledger entries (to_device
+            # registered them; without this every percolate query leaked
+            # resident_bytes forever)
+            tmp_dev.free_allocations(reason="percolate-transient")
         self._qs_cache[("perc", id(node))] = masks
         return masks
 

@@ -419,9 +419,17 @@ def profiled_kernel(name: str) -> Callable:
             # fence: without it async dispatch returns immediately and the
             # kernel time lands on whoever np.asarray()s the result later
             _block_until_ready(out)
-            prof.record_kernel(
-                name, time.perf_counter_ns() - t0, transfer, retraced
-            )
+            elapsed = time.perf_counter_ns() - t0
+            prof.record_kernel(name, elapsed, transfer, retraced)
+            if retraced:
+                # retrace oracle fired: one jit-cache entry for this kernel
+                # family in the device ledger's compile table (the first
+                # launch wall includes the compile)
+                from opensearch_tpu.telemetry.device_ledger import (
+                    default_ledger,
+                )
+
+                default_ledger.record_compile(name, elapsed)
             return out
 
         return wrapper

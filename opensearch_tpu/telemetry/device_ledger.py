@@ -1,0 +1,332 @@
+"""Device-memory residency ledger: what is in HBM, in bytes, right now.
+
+ISSUE 10's answer to "device memory is completely dark": TPU-KNN (arxiv
+2206.14286) argues TPU kNN serving lives or dies on HBM footprint and
+bandwidth against the roofline, and FusionANNS (arxiv 2409.16576) makes
+memory-tier residency the central serving-architecture question — neither
+is answerable without seeing what is resident. Every device-upload path
+registers its allocations here:
+
+- exact segment columns (``index/device.to_device`` / ``with_live``),
+- IVF-PQ slabs (``ops/ivfpq.build``),
+- shard-mesh bundles (``search/distributed_serving._build_bundle``,
+  freed by ``cluster/shard_mesh.ShardMeshRegistry`` evictions),
+- padded query/filter-mask batch uploads (transient: allocated and freed
+  in the same launch).
+
+Allocations are keyed (index, field, structure kind, generation, device)
+with ``bytes == array.nbytes`` summed over the structure's arrays, and the
+accounting identity ``resident == allocated − freed`` holds at all times
+(``verify_identity``; the chaos soak's ``device-ledger-bounded`` invariant
+asserts it under kill/partition/rebuild). Upload sites that cannot thread
+ownership context through their signatures inherit it from the nearest
+:func:`upload_scope` (a contextvar, the same pattern as the profiler).
+
+Retrace/compile accounting rides along per KERNEL FAMILY: every launch
+path that consults the profiler's retrace oracle
+(``search/profile.signature_retraced`` / a program-cache miss) reports the
+jit-cache entry and its first-launch wall here, so "how many programs has
+this process compiled, and what did that cost" is one stats read.
+
+The ledger is process-wide (one process == one device set — the same
+scope as the kNN dispatch batcher and the shard-mesh registry); sim nodes
+sharing an interpreter share it, and the cluster ``_nodes/stats`` fan-out
+reports it per node like the other process-wide singletons.
+
+tpulint TPU014 (naked-device-put) enforces coverage: a ``jax.device_put``
+in a serving module whose enclosing function never touches the ledger is
+an unaccounted upload and fails the lint gate.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+from contextlib import contextmanager
+from typing import Any
+
+# structure kinds the serving tier registers (free-form strings are
+# accepted; these are the ones the stats surfaces document)
+KIND_COLUMN = "column"            # exact segment columns (+ the live bitmap)
+KIND_IVFPQ = "ivfpq_slab"         # packed IVF-PQ inverted lists + codebooks
+KIND_MESH_BUNDLE = "mesh_bundle"  # [S, n_flat, d] shard-mesh slabs
+KIND_QUERY_BATCH = "query_batch"  # padded per-launch query/mask uploads
+
+_scope_var: contextvars.ContextVar[dict | None] = contextvars.ContextVar(
+    "opensearch_tpu_upload_scope", default=None
+)
+
+
+@contextmanager
+def upload_scope(index: str | None = None, shard: int | None = None,
+                 generation: Any = None, field: str | None = None,
+                 device: str | None = None):
+    """Attribution context for uploads below this point: ``register`` calls
+    that omit index/shard/generation/field/device inherit them from the
+    nearest enclosing scope (scopes nest; inner non-None values win). The
+    engine opens one around refresh/merge/recovery publishes so
+    ``to_device`` / ``ivfpq.build`` need no signature changes."""
+    outer = _scope_var.get() or {}
+    merged = dict(outer)
+    for key, value in (("index", index), ("shard", shard),
+                       ("generation", generation), ("field", field),
+                       ("device", device)):
+        if value is not None:
+            merged[key] = value
+    token = _scope_var.set(merged)
+    try:
+        yield
+    finally:
+        _scope_var.reset(token)
+
+
+def active_scope() -> dict:
+    return dict(_scope_var.get() or {})
+
+
+def _default_device() -> str:
+    try:
+        import jax
+
+        return str(jax.devices()[0])
+    except (ImportError, RuntimeError):  # no backend: still account bytes
+        return "device:0"
+
+
+class Allocation:
+    """One registered device-resident structure. ``free()`` is idempotent —
+    retirement paths (merge, eviction, close, invalidation) may race or
+    overlap and double-accounting would break the identity."""
+
+    __slots__ = ("ledger", "alloc_id", "index", "shard", "field", "kind",
+                 "generation", "device", "bytes", "freed", "freed_reason")
+
+    def __init__(self, ledger: "DeviceResidencyLedger", alloc_id: int,
+                 index: str, shard: int, field: str, kind: str,
+                 generation: Any, device: str, nbytes: int):
+        self.ledger = ledger
+        self.alloc_id = alloc_id
+        self.index = index
+        self.shard = shard
+        self.field = field
+        self.kind = kind
+        self.generation = generation
+        self.device = device
+        self.bytes = int(nbytes)
+        self.freed = False
+        self.freed_reason = None
+
+    def free(self, reason: str = "retired") -> None:
+        self.ledger.free(self, reason)
+
+    def row(self) -> dict:
+        gen = self.generation
+        return {
+            "index": self.index, "shard": self.shard, "field": self.field,
+            "kind": self.kind,
+            "generation": gen if isinstance(gen, (int, str)) else str(gen),
+            "device": self.device, "bytes": self.bytes,
+        }
+
+
+class DeviceResidencyLedger:
+    """Process-wide accounting of device-resident bytes.
+
+    Invariant (checked by ``verify_identity`` and the soak):
+    ``allocated_bytes - freed_bytes == resident_bytes == sum(live.bytes)``.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._live: dict[int, Allocation] = {}
+        self._resident_bytes = 0
+        self.counters = {
+            "allocations": 0, "frees": 0,
+            "allocated_bytes": 0, "freed_bytes": 0,
+            "transient_uploads": 0, "transient_bytes": 0,
+        }
+        # kernel family -> [jit-cache entries, cumulative compile wall ns]
+        self._compile: dict[str, list[int]] = {}
+
+    # -- producer side -------------------------------------------------------
+
+    def register(self, kind: str, nbytes: int, *, index: str | None = None,
+                 shard: int | None = None, field: str | None = None,
+                 generation: Any = None,
+                 device: str | None = None) -> Allocation:
+        """Account a device-resident structure of ``nbytes`` (the summed
+        ``.nbytes`` of its live arrays). Missing attribution falls back to
+        the active :func:`upload_scope`, then to placeholders — bytes are
+        never dropped for want of a label."""
+        scope = _scope_var.get() or {}
+        with self._lock:
+            self._next_id += 1
+            alloc = Allocation(
+                self, self._next_id,
+                index=index if index is not None
+                else scope.get("index", "_unattributed"),
+                shard=shard if shard is not None else scope.get("shard", 0),
+                field=field if field is not None
+                else scope.get("field", "_none"),
+                kind=kind,
+                generation=generation if generation is not None
+                else scope.get("generation", 0),
+                device=device if device is not None
+                else scope.get("device") or _default_device(),
+                nbytes=nbytes,
+            )
+            self._live[alloc.alloc_id] = alloc
+            self.counters["allocations"] += 1
+            self.counters["allocated_bytes"] += alloc.bytes
+            self._resident_bytes += alloc.bytes
+        return alloc
+
+    def free(self, allocation: Allocation, reason: str = "retired") -> None:
+        with self._lock:
+            if allocation.freed:
+                return
+            allocation.freed = True
+            allocation.freed_reason = reason
+            self._live.pop(allocation.alloc_id, None)
+            self.counters["frees"] += 1
+            self.counters["freed_bytes"] += allocation.bytes
+            self._resident_bytes -= allocation.bytes
+
+    def record_transient(self, kind: str, nbytes: int) -> None:
+        """A per-launch upload (padded query batch, filter mask) that the
+        launch consumes and releases: allocated and freed in one step, so
+        the identity holds while the cumulative counters still show the
+        host->device traffic these paths generate."""
+        nbytes = int(nbytes)
+        with self._lock:
+            self.counters["transient_uploads"] += 1
+            self.counters["transient_bytes"] += nbytes
+            self.counters["allocated_bytes"] += nbytes
+            self.counters["freed_bytes"] += nbytes
+
+    def record_compile(self, family: str, wall_ns: int = 0) -> None:
+        """One jit-cache entry for ``family`` (the profiler's retrace
+        oracle fired): count it and bank the first-launch wall, which
+        includes the compile."""
+        with self._lock:
+            cell = self._compile.setdefault(family, [0, 0])
+            cell[0] += 1
+            cell[1] += int(wall_ns)
+
+    # -- introspection -------------------------------------------------------
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._resident_bytes
+
+    def current_id(self) -> int:
+        """High-water allocation id: a leak check scoped to 'allocations
+        made after this point' (the soak invariant) starts here."""
+        with self._lock:
+            return self._next_id
+
+    def live_allocations(self) -> list[Allocation]:
+        with self._lock:
+            return list(self._live.values())
+
+    def structures(self, index: str | None = None) -> list[dict]:
+        """Per-structure rows grouped by (index, field, kind, generation,
+        device): what is resident, in bytes, structure by structure."""
+        with self._lock:
+            grouped: dict[tuple, dict] = {}
+            for alloc in self._live.values():
+                if index is not None and alloc.index != index:
+                    continue
+                row = alloc.row()
+                key = (row["index"], row["field"], row["kind"],
+                       row["generation"], row["device"])
+                cell = grouped.get(key)
+                if cell is None:
+                    cell = grouped[key] = {**row, "allocations": 0,
+                                           "bytes": 0}
+                    del cell["shard"]
+                cell["bytes"] += row["bytes"]
+                cell["allocations"] += 1
+        return sorted(grouped.values(),
+                      key=lambda r: (r["index"], r["field"], r["kind"],
+                                     str(r["generation"])))
+
+    def device_totals(self) -> dict[str, int]:
+        with self._lock:
+            out: dict[str, int] = {}
+            for alloc in self._live.values():
+                out[alloc.device] = out.get(alloc.device, 0) + alloc.bytes
+        return out
+
+    def compile_stats(self) -> dict[str, dict]:
+        with self._lock:
+            return {
+                family: {"entries": cell[0], "compile_wall_ns": cell[1]}
+                for family, cell in sorted(self._compile.items())
+            }
+
+    def verify_identity(self) -> None:
+        """Raises AssertionError unless resident == allocated − freed ==
+        sum of live allocation bytes (check.sh / bench gates call this)."""
+        with self._lock:
+            live_sum = sum(a.bytes for a in self._live.values())
+            delta = (self.counters["allocated_bytes"]
+                     - self.counters["freed_bytes"])
+            resident = self._resident_bytes
+        assert resident == delta == live_sum, (
+            f"device ledger identity broken: resident={resident} "
+            f"allocated-freed={delta} live_sum={live_sum}")
+
+    def snapshot_stats(self) -> dict:
+        with self._lock:
+            live_sum = sum(a.bytes for a in self._live.values())
+            out = {
+                **self.counters,
+                "resident_bytes": self._resident_bytes,
+                "live_allocations": len(self._live),
+                "identity_ok": (
+                    self._resident_bytes == live_sum
+                    == self.counters["allocated_bytes"]
+                    - self.counters["freed_bytes"]),
+            }
+        out["by_device"] = self.device_totals()
+        out["structures"] = self.structures()
+        out["compile"] = self.compile_stats()
+        return out
+
+    def reset(self) -> None:
+        """Test hook: forget everything (callers must own no live
+        structures — production code never resets the ledger)."""
+        with self._lock:
+            self._live.clear()
+            self._resident_bytes = 0
+            for k in self.counters:
+                self.counters[k] = 0
+            self._compile.clear()
+
+
+# process-wide default: upload sites are module-level code with no node
+# handle (the batcher/registry pattern); one process == one device set,
+# so per-process accounting is the semantically right scope even when
+# several sim nodes share the interpreter.
+default_ledger = DeviceResidencyLedger()
+
+
+def array_nbytes(*arrays: Any) -> int:
+    """Summed ``.nbytes`` over arrays, skipping Nones (device dataclasses
+    carry optional columns)."""
+    return sum(int(a.nbytes) for a in arrays if a is not None)
+
+
+def stats_section() -> dict:
+    """The `_nodes/stats` `device` section (also returned by
+    `/_otel/flush`): the process-wide ledger snapshot plus the shard-mesh
+    registry's byte-budget state — ONE assembly shared by the single-node
+    REST handler and the cluster per-node RPC so the two surfaces cannot
+    drift."""
+    from opensearch_tpu.cluster.shard_mesh import default_registry
+
+    out = default_ledger.snapshot_stats()
+    out["shard_mesh"] = default_registry.snapshot_stats()
+    return out

@@ -128,6 +128,20 @@ def span_to_otlp(span: Span) -> dict:
     }
     if span.parent_id is not None:
         out["parentSpanId"] = span.parent_id
+    # span EVENTS (per-span logs) ride the export in the OTLP event shape;
+    # the bound lives at record time (tracing.MAX_SPAN_EVENTS) and the
+    # overflow count survives as droppedEventsCount
+    if span.events:
+        out["events"] = [
+            {"timeUnixNano": str(e["ts_ns"]), "name": e["name"],
+             "attributes": [
+                 {"key": k, "value": _otlp_value(v)}
+                 for k, v in e["attributes"].items()
+             ]}
+            for e in span.events
+        ]
+    if span.dropped_events:
+        out["droppedEventsCount"] = span.dropped_events
     return out
 
 
@@ -165,6 +179,16 @@ def parse_otlp(doc: dict) -> list[Span]:
                     },
                     start_ns=int(s["startTimeUnixNano"]),
                     end_ns=int(s["endTimeUnixNano"]),
+                    events=[
+                        {"name": e["name"],
+                         "ts_ns": int(e["timeUnixNano"]),
+                         "attributes": {
+                             a["key"]: _from_otlp_value(a["value"])
+                             for a in e.get("attributes", [])
+                         }}
+                        for e in s.get("events", [])
+                    ],
+                    dropped_events=int(s.get("droppedEventsCount", 0)),
                 ))
     return out
 

@@ -40,6 +40,12 @@ _active_tracer: contextvars.ContextVar["Tracer | None"] = contextvars.ContextVar
 )
 
 
+# per-span event cap (OTel's default span event limit ballpark): a span
+# that witnesses hundreds of evictions/retries keeps the first window and
+# counts the rest, so one hot span can never balloon the ring or export
+MAX_SPAN_EVENTS = 32
+
+
 @dataclass
 class Span:
     trace_id: str
@@ -49,6 +55,11 @@ class Span:
     attributes: dict[str, Any] = dc_field(default_factory=dict)
     start_ns: int = 0
     end_ns: int = 0
+    # span EVENTS (per-span logs): point-in-time records riding the span —
+    # batcher flush reasons, mesh/ledger evictions, recovery chunk retries.
+    # Bounded by MAX_SPAN_EVENTS; overflow counts into dropped_events.
+    events: list[dict] = dc_field(default_factory=list)
+    dropped_events: int = 0
 
     @property
     def duration_ns(self) -> int:
@@ -57,8 +68,18 @@ class Span:
     def set_attribute(self, key: str, value: Any) -> None:
         self.attributes[key] = value
 
+    def add_event(self, name: str, attributes: dict | None = None) -> None:
+        if len(self.events) >= MAX_SPAN_EVENTS:
+            self.dropped_events += 1
+            return
+        self.events.append({
+            "name": name,
+            "ts_ns": time.perf_counter_ns(),
+            "attributes": dict(attributes or {}),
+        })
+
     def to_dict(self) -> dict:
-        return {
+        out = {
             "trace_id": self.trace_id,
             "span_id": self.span_id,
             "parent_id": self.parent_id,
@@ -66,6 +87,11 @@ class Span:
             "attributes": dict(self.attributes),
             "duration_ns": self.duration_ns,
         }
+        if self.events:
+            out["events"] = [dict(e) for e in self.events]
+        if self.dropped_events:
+            out["dropped_events"] = self.dropped_events
+        return out
 
 
 class _SpanScope:
@@ -176,6 +202,18 @@ def active_metrics() -> "MetricsRegistry | None":
 def span(name: str, attributes: dict | None = None):
     """Open a span on the active tracer (see `activate`)."""
     return active_tracer().start_span(name, attributes)
+
+
+def add_span_event(name: str, attributes: dict | None = None) -> None:
+    """Attach a span EVENT to the current span, if one is open (library
+    code — the batcher, the mesh registry — records what happened inside
+    whoever's request is executing; a no-op outside any span). Remote
+    placeholder spans restored from transport headers are skipped: their
+    events would never reach a ring or the exporter."""
+    current = _current_span.get()
+    if current is None or current.name == "<remote>":
+        return
+    current.add_event(name, attributes)
 
 
 class Tracer:
@@ -338,27 +376,73 @@ class _Histogram:
             return out
 
 
+# labeled-series cardinality bound per histogram family: beyond this many
+# distinct label sets, new ones record into the base (unlabeled) series and
+# a dropped counter ticks — an unbounded label value (doc ids, trace ids)
+# must never mint unbounded Prometheus series (the TPU013 concern, enforced
+# at runtime for the label dimension)
+MAX_LABEL_SETS = 64
+# reserved label set collecting observations past the cap: one visible
+# overflow bucket instead of a 65th+ series
+OVERFLOW_LABEL_KEY = (("_overflow", "true"),)
+
+
 class MetricsRegistry:
     def __init__(self):
         self._counters: dict[str, _Counter] = {}
         self._histograms: dict[str, _Histogram] = {}
+        # family name -> sorted-label-tuple -> series (histogram LABEL
+        # support: per-index `search.took_ms{index=...}` under a constant
+        # metric name — vary labels, never names)
+        self._labeled: dict[str, dict[tuple, _Histogram]] = {}
+        self._labels_dropped: dict[str, int] = {}
         self._lock = threading.Lock()
 
     def counter(self, name: str) -> _Counter:
         with self._lock:
             return self._counters.setdefault(name, _Counter())
 
-    def histogram(self, name: str) -> _Histogram:
+    def histogram(self, name: str, labels: dict | None = None) -> _Histogram:
         with self._lock:
+            if labels:
+                family = self._labeled.setdefault(name, {})
+                key = tuple(sorted(
+                    (str(k), str(v)) for k, v in labels.items()))
+                series = family.get(key)
+                if series is None:
+                    if len(family) >= MAX_LABEL_SETS:
+                        # cardinality bound: overflow collects in ONE
+                        # reserved series (not the base — record sites feed
+                        # base AND labeled, so routing overflow to base
+                        # would double-count it there), visibly counted
+                        self._labels_dropped[name] = (
+                            self._labels_dropped.get(name, 0) + 1)
+                        overflow = family.get(OVERFLOW_LABEL_KEY)
+                        if overflow is None:
+                            overflow = family[OVERFLOW_LABEL_KEY] = \
+                                _Histogram()
+                        return overflow
+                    series = family[key] = _Histogram()
+                return series
             return self._histograms.setdefault(name, _Histogram())
 
     def stats(self) -> dict:
         with self._lock:
+            histograms: dict[str, dict] = {
+                n: h.stats() for n, h in self._histograms.items()
+            }
+            for name, family in self._labeled.items():
+                entry = histograms.setdefault(name, _Histogram().stats())
+                entry["series"] = [
+                    {"labels": dict(key), **series.stats()}
+                    for key, series in family.items()
+                ]
+                dropped = self._labels_dropped.get(name)
+                if dropped:
+                    entry["label_sets_dropped"] = dropped
             return {
                 "counters": {n: c.value for n, c in self._counters.items()},
-                "histograms": {
-                    n: h.stats() for n, h in self._histograms.items()
-                },
+                "histograms": histograms,
             }
 
 

@@ -436,10 +436,85 @@ class TelemetryBounded(Invariant):
                              f"{accounted}")
 
 
+class DeviceLedgerBounded(Invariant):
+    """Device-memory residency stays accounted under chaos: the ledger's
+    identity ``resident == allocated − freed == sum(live bytes)`` holds at
+    every probe, the shard-mesh registry never exceeds its HBM byte
+    budget, and at the FINAL quiesce every live allocation made during the
+    soak is reachable from a live owner — an engine's published segment
+    set or the mesh registry. An unreachable allocation is leaked HBM: its
+    owner retired (kill, relocation, rebuild, eviction) without freeing."""
+
+    name = "device-ledger-bounded"
+
+    def __init__(self) -> None:
+        from opensearch_tpu.telemetry.device_ledger import default_ledger
+
+        # leak checks only cover allocations made DURING this soak: the
+        # process-wide ledger may carry live structures from other owners
+        # in the same interpreter (other tests' engines)
+        self._start_id = default_ledger.current_id()
+
+    def at_probe(self, h: "SoakHarness") -> None:
+        from opensearch_tpu.cluster.shard_mesh import default_registry
+        from opensearch_tpu.telemetry.device_ledger import default_ledger
+
+        st = default_ledger.snapshot_stats()
+        if not st["identity_ok"]:
+            h.fail(self, f"ledger identity broken: resident "
+                         f"{st['resident_bytes']} != allocated "
+                         f"{st['allocated_bytes']} - freed "
+                         f"{st['freed_bytes']}")
+        mesh = default_registry.snapshot_stats()
+        budget = mesh.get("hbm_budget_bytes") or 0
+        if budget and mesh["resident_bytes"] > budget:
+            # one bundle larger than the whole budget is deliberately
+            # ADMITTED (the query must serve; everything else evicts), so
+            # the bound that must hold is max(budget, largest bundle)
+            largest = max(
+                (r["bytes"] for r in default_registry.resident()),
+                default=0)
+            if mesh["resident_bytes"] > max(budget, largest):
+                h.fail(self, f"mesh registry over its HBM budget: "
+                             f"{mesh['resident_bytes']} > {budget} "
+                             f"(largest bundle {largest})")
+
+    def at_quiesce(self, h: "SoakHarness") -> None:
+        self.at_probe(h)
+        if not h.final_quiesce:
+            return
+        from opensearch_tpu.cluster.shard_mesh import default_registry
+        from opensearch_tpu.telemetry.device_ledger import default_ledger
+
+        reachable: set[int] = set()
+        for node in h.nodes.values():
+            for shard in node.local_shards.values():
+                for _host, dev in shard.engine._segments:
+                    for alloc in (getattr(dev, "allocations", None)
+                                  or {}).values():
+                        reachable.add(alloc.alloc_id)
+        with default_registry._lock:
+            bundles = list(default_registry._bundles.values())
+        for bundle in bundles:
+            alloc = getattr(bundle, "allocation", None)
+            if alloc is not None:
+                reachable.add(alloc.alloc_id)
+        leaked = [
+            a for a in default_ledger.live_allocations()
+            if a.alloc_id > self._start_id
+            and a.alloc_id not in reachable
+            and a.index in (set(h.indices) | {"_unattributed"})
+        ]
+        if leaked:
+            rows = [a.row() for a in leaked[:5]]
+            h.fail(self, f"device allocations leaked across kill/heal "
+                         f"({len(leaked)} total): {rows}")
+
+
 DEFAULT_INVARIANTS: tuple[Callable[[], Invariant], ...] = (
     AckedWritesSurvive, SnapshotIsolation, RecoveryMonotonicity,
     ShedCorrectness, BoundedQueues, ClusterConverges, InteractiveUnderFlood,
-    TelemetryBounded,
+    TelemetryBounded, DeviceLedgerBounded,
 )
 
 

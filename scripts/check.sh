@@ -55,4 +55,28 @@ if [[ "${1:-}" == "--bench" ]]; then
   python bench.py --otel-overhead
   echo "== ANN gate (recall@10 >= 0.95 ratchet + batched >= 1.3x + QPS floor) =="
   python bench.py --ann-gate
+  # every gate child already asserts the device-ledger identity before
+  # printing its result; this step proves it once more in THIS process
+  # over a full publish/merge/delete cycle (ISSUE 10 acceptance)
+  echo "== device-ledger identity (resident == allocated - freed) =="
+  JAX_PLATFORMS=cpu python - <<'PY'
+import tempfile
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.telemetry.device_ledger import default_ledger
+
+node = TpuNode(tempfile.mkdtemp(prefix="ledger_check_"))
+node.create_index("ck", {"mappings": {"properties": {
+    "msg": {"type": "text"}, "n": {"type": "integer"}}}})
+for i in range(64):
+    node.index_doc("ck", str(i), {"msg": f"w{i} common", "n": i})
+node.refresh("ck")
+node.force_merge("ck")
+assert default_ledger.structures("ck"), "no ledger rows after publish"
+default_ledger.verify_identity()
+node.delete_index("ck")
+assert default_ledger.structures("ck") == [], "rows survived index delete"
+default_ledger.verify_identity()
+node.close()
+print("device-ledger identity holds")
+PY
 fi

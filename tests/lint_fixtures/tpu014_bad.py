@@ -1,0 +1,27 @@
+"""TPU014 true positives: jax.device_put in a device-serving module with
+no residency-ledger accounting in the enclosing function — the bytes land
+in HBM but every budget/placement surface is blind to them."""
+# tpulint: device-module
+
+import jax
+import jax.numpy as jnp
+
+
+def publish_column(host_array):
+    return jax.device_put(jnp.asarray(host_array))  # EXPECT: TPU014
+
+
+def publish_many(arrays, device):
+    put = lambda a: jax.device_put(a, device)  # EXPECT: TPU014
+    return [put(a) for a in arrays]
+
+
+class SlabCache:
+    def upload(self, slab):
+        self._slab = jax.device_put(slab)  # EXPECT: TPU014
+        return self._slab
+
+
+def logging_is_not_accounting(host_array, logger):
+    logger.info("uploading %d bytes", host_array.nbytes)
+    return jax.device_put(host_array)  # EXPECT: TPU014

@@ -1,0 +1,37 @@
+"""TPU014 false-positive guards: every accepted upload shape.
+
+- registration with the residency ledger in the same function;
+- transient recording for per-launch uploads;
+- nested helpers (the `put = lambda` idiom) under an accounting function;
+- freeing through an allocation handle counts as ledger-aware;
+- device_put in a module that is NOT device-scoped is out of scope.
+"""
+# tpulint: device-module
+
+import jax
+import jax.numpy as jnp
+
+from opensearch_tpu.telemetry.device_ledger import default_ledger
+
+
+def publish_column(host_array, field):
+    dev = jax.device_put(jnp.asarray(host_array))
+    default_ledger.register("column", dev.nbytes, field=field)
+    return dev
+
+
+def transient_query_upload(batch):
+    default_ledger.record_transient("query_batch", batch.nbytes)
+    return jax.device_put(batch)
+
+
+def nested_put_inherits_evidence(arrays, ledger):
+    put = lambda a: jax.device_put(a)
+    out = [put(a) for a in arrays]
+    ledger.register("column", sum(a.nbytes for a in out))
+    return out
+
+
+def swap_with_allocation_handle(bundle, fresh):
+    bundle.allocation.free(reason="superseded")
+    return jax.device_put(fresh)

@@ -286,13 +286,21 @@ class TestPrometheusExposition:
         # an +Inf bucket that equals _count
         assert samples['opensearch_tpu_search_took_ms_bucket{le="+Inf"}'] \
             == h["count"]
+        # base (unlabeled) family only: the per-index labeled series of the
+        # same metric name is its own cumulative ladder
         bucket_series = [
             (name, v) for name, v in samples.items()
-            if name.startswith("opensearch_tpu_search_took_ms_bucket")
+            if name.startswith('opensearch_tpu_search_took_ms_bucket{le=')
         ]
         assert len(bucket_series) >= 5
         counts = [v for _n, v in bucket_series]
         assert counts == sorted(counts)  # cumulative
+        # the per-index series rides the SAME constant metric name with an
+        # index label (histogram label support, ISSUE 10)
+        labeled = [n for n in samples
+                   if n.startswith("opensearch_tpu_search_took_ms_bucket{")
+                   and 'index="t"' in n]
+        assert labeled, "per-index took_ms series missing from exposition"
 
     def test_names_are_prometheus_safe(self, node):
         node.search("t", {"query": {"match_all": {}}})
