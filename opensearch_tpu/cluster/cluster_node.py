@@ -32,9 +32,11 @@ from typing import Any, Callable
 from opensearch_tpu.common.errors import (
     IndexNotFoundException,
     OpenSearchTpuException,
+    RejectedExecutionException,
     ShardNotFoundException,
 )
 from opensearch_tpu.common.hashing import shard_id_for_routing
+from opensearch_tpu.cluster import residency as residency_mod
 from opensearch_tpu.cluster.allocation import (
     mark_shard_started,
     reroute,
@@ -170,6 +172,29 @@ class ClusterNode:
             lambda eff: apply_tracing_settings(
                 self.telemetry, eff, self.data_path, service_name=node_id),
         )
+        # priority lanes (search/lanes.py): process-wide policy like the
+        # batcher; dynamic search.lanes.* retunes the pool split + the
+        # background queue bound at state application
+        from opensearch_tpu.search import lanes as _lanes_mod
+
+        self.settings_consumers.register(
+            "search.lanes.", _lanes_mod.default_config.apply_settings
+        )
+        self.lane_tracker = _lanes_mod.LaneTracker()
+        # residency-aware replica routing (cluster/residency.py): this
+        # node's COORDINATOR-side board of warm copies, fed by the
+        # _residency stamps kNN partials carry back; the dynamic toggle
+        # rides the settings consumer like the lanes
+        self.settings_consumers.register(
+            "search.routing.", residency_mod.default_config.apply_settings
+        )
+        self.residency_board = residency_mod.ResidencyBoard()
+        # round-robin sequence for cold routing decisions (no warm copy
+        # known yet): one draw per fan-out keeps the shard set on one
+        # replica rank instead of scattering the first build
+        import itertools as _it
+
+        self._route_rr = _it.count(0)
         # extra per-node stats sections for the cluster-wide _nodes/stats
         # fan-out: coordinator-side services (the facade's request cache)
         # register a provider here so the node RPC can report them
@@ -254,12 +279,15 @@ class ClusterNode:
         # discipline — and serializing them behind the data worker meant
         # concurrent search[node] requests could never reach the kNN
         # dispatch batcher together, so cross-request coalescing (and the
-        # shard-mesh launch amortization) never engaged in cluster mode
+        # shard-mesh launch amortization) never engaged in cluster mode.
+        # Background-lane work (msearch[node] fan-outs and anything the
+        # coordinator marked background) runs its OWN smaller pool so a
+        # flood of it can never occupy the interactive workers (ISSUE 11).
         self._search_executor = None
+        self._bg_search_executor = None
         # ctx ids mint on the parallel pool: itertools.count is atomic
         # under the GIL where `self._ctx_seq += 1` is read-modify-write
-        import itertools as _it
-
+        # (_it imported above for the routing round-robin)
         self._ctx_counter = _it.count(1)
         # device-resident shard bundles for the mesh kNN path, keyed by
         # reader generation (cluster/shard_mesh.py); process-wide like the
@@ -353,6 +381,13 @@ class ClusterNode:
             nid: pct for nid, pct in self._node_disk.items()
             if nid in state.nodes
         }
+        # residency-routing board: a departed node or deleted index must
+        # never look warm to the replica router (candidates re-filter by
+        # routing state anyway — this is the memory bound + staleness cut)
+        self.residency_board.prune(
+            live_nodes=set(state.nodes),
+            live_indices=set(state.indices),
+        )
         my_shards = {
             (r.index, r.shard): r for r in state.shards_for_node(self.node_id)
         }
@@ -1987,7 +2022,24 @@ class ClusterNode:
     # -- distributed search (scatter-gather, SURVEY §3.2) -------------------
 
     def search(self, index: str, body: dict | None,
-               callback: Callable[[dict], None]) -> None:
+               callback: Callable[[dict], None],
+               query_group: str | None = None,
+               lane: str | None = None) -> None:
+        # wlm search admission BEFORE the fan-out (the bulk twin): an
+        # enforced group past its slot share sheds a typed 429 here and
+        # burns no transport or device work; the slot releases exactly
+        # once when the (possibly degraded) response completes
+        try:
+            release_admission = self.query_groups.admit_search(query_group)
+        except RejectedExecutionException as e:
+            callback({"error": f"{type(e).__name__}: {e}", "status": 429})
+            return
+        inner_callback = callback
+
+        def callback(resp: dict) -> None:  # noqa: F811 - admission wrapper
+            release_admission()
+            inner_callback(resp)
+
         state = self.applied_state
         meta = state.indices.get(index)
         if meta is None:
@@ -2002,17 +2054,15 @@ class ClusterNode:
             # coordinator must agree on the sort spec
             sort = [sort]
             body["sort"] = sort
-        # pick one STARTED copy per shard (prefer primary; adaptive replica
-        # selection is a later refinement)
-        targets: dict[int, ShardRoutingEntry] = {}
+        # candidate copies per shard (every STARTED/RELOCATING copy)
+        candidates: dict[int, list[ShardRoutingEntry]] = {}
         for r in state.shards_for_index(index):
             # RELOCATING sources keep serving reads until the routing swap
             if r.state not in ("STARTED", "RELOCATING") or r.node_id is None:
                 continue
-            if r.shard not in targets or r.primary:
-                targets[r.shard] = r
-        missing = meta.num_shards - len(targets)
-        if not targets:
+            candidates.setdefault(r.shard, []).append(r)
+        missing = meta.num_shards - len(candidates)
+        if not candidates:
             callback({"error": "not all shards available"})
             return
         # device-kNN bodies route through the shard-mesh data plane: ONE
@@ -2021,11 +2071,24 @@ class ClusterNode:
         # — instead of one RPC per shard with a host-Python merge; the
         # coordinator stream-merges the pre-merged node partials
         # (search/reduce.py). Ineligible bodies keep the per-shard path.
+        # RESIDENCY-AWARE ROUTING (ISSUE 11): for the kNN path, each
+        # shard's launch lands on the copy whose mesh bundle / IVF-PQ slab
+        # is already HBM-resident (the board learned it from earlier
+        # partials' _residency stamps); no warm copy -> round-robin.
         if self._mesh_search_eligible(body):
+            field = residency_mod.knn_query_field(body)
+            targets, _warm = residency_mod.choose_copies(
+                self.residency_board, index, field, candidates,
+                next(self._route_rr))
             self._search_node_grouped(
-                index, body, targets, missing, size, from_, callback
+                index, body, targets, missing, size, from_, callback,
+                lane=lane, field=field,
             )
             return
+        # non-mesh bodies keep the legacy prefer-primary selection
+        targets: dict[int, ShardRoutingEntry] = {}
+        for num, cands in candidates.items():
+            targets[num] = next((r for r in cands if r.primary), cands[0])
         # shards with no serving copy (mid-failover) degrade the response
         # instead of refusing it: the reachable shards answer and the
         # missing ones count into _shards.failed
@@ -2098,7 +2161,9 @@ class ClusterNode:
 
     def _search_node_grouped(self, index: str, body: dict, targets: dict,
                              missing: int, size: int, from_: int,
-                             callback: Callable[[dict], None]) -> None:
+                             callback: Callable[[dict], None],
+                             lane: str | None = None,
+                             field: str | None = None) -> None:
         """Device-kNN fan-out grouped BY NODE: each data node receives one
         search[node] request covering every target shard it holds, executes
         them as one shard_map launch (service.search -> shard-mesh path),
@@ -2163,6 +2228,13 @@ class ClusterNode:
                         index, node_body, nums, nid, partials,
                         extra_failed, one_node_done)
                     return
+                # residency stamp: the data node consulted its ledger/
+                # registry rows after serving — the board learns which
+                # copies are warm so the NEXT fan-out lands on them
+                res = resp.pop("_residency", None)
+                if isinstance(res, dict) and res.get("field"):
+                    self.residency_board.observe(
+                        nid, index, res["field"], bool(res.get("warm")))
                 failed_nums = resp.pop("_failed_shards", None)
                 if failed_nums:
                     # hand the missing copies to the fallback instead of
@@ -2188,9 +2260,13 @@ class ClusterNode:
         with tracing.restore_trace_context(ctx):
             for nid, nums in sorted(by_node.items()):
                 handle, fail = make_handlers(nid, nums)
+                payload = {"index": index, "shards": nums,
+                           "body": node_body}
+                if lane is not None:
+                    payload["lane"] = lane
                 self.transport.send(
                     self.node_id, nid, "indices:data/read/search[node]",
-                    {"index": index, "shards": nums, "body": node_body},
+                    payload,
                     on_response=handle, on_failure=fail,
                 )
 
@@ -2283,23 +2359,66 @@ class ClusterNode:
             )
         return self._submit_deferred(loop, self._data_executor, fn)
 
-    def _offload_search(self, fn):
+    # background lane pool: half the interactive width (min 1) — enough to
+    # keep msearch/bulk-adjacent fan-outs flowing, small enough that a
+    # flood of them leaves the interactive workers untouched
+    _BG_POOL_WORKERS = 2
+
+    def _offload_search(self, fn, lane: str | None = None):
         """Run read-only query work on the BOUNDED PARALLEL search pool:
         executions touch only immutable acquired snapshots, so concurrent
         search[node] requests proceed side by side — which is what lets the
         kNN dispatch batcher coalesce them into one shard-mesh launch (and
-        what parallelizes the non-mesh per-shard fallback path)."""
+        what parallelizes the non-mesh per-shard fallback path).
+
+        `lane` (search/lanes.py) picks the pool: background-lane work runs
+        a separate, smaller executor so a background flood can saturate
+        only its own workers — an interactive search[node] always finds an
+        interactive slot. Lanes disabled -> everything shares the
+        interactive pool (the pre-lane behavior)."""
+        from opensearch_tpu.search import lanes as lanes_mod
+
+        lane = lane or lanes_mod.INTERACTIVE
         loop = getattr(self.scheduler, "loop", None)
         if loop is None:
-            return fn()
+            # deterministic sim: synchronous, but the lane scope still
+            # rides into the batcher and the tracker still counts
+            self.lane_tracker.try_submit(lane)
+            try:
+                with lanes_mod.lane_scope(lane):
+                    return fn()
+            finally:
+                self.lane_tracker.complete(lane)
         from concurrent.futures import ThreadPoolExecutor
 
-        if self._search_executor is None:
-            self._search_executor = ThreadPoolExecutor(
-                max_workers=self._SEARCH_POOL_WORKERS,
-                thread_name_prefix=f"{self.node_id}-search",
-            )
-        return self._submit_deferred(loop, self._search_executor, fn)
+        background = (lanes_mod.default_config.enabled
+                      and lane == lanes_mod.BACKGROUND)
+        if background:
+            if self._bg_search_executor is None:
+                self._bg_search_executor = ThreadPoolExecutor(
+                    max_workers=self._BG_POOL_WORKERS,
+                    thread_name_prefix=f"{self.node_id}-search-bg",
+                )
+            executor = self._bg_search_executor
+        else:
+            if self._search_executor is None:
+                self._search_executor = ThreadPoolExecutor(
+                    max_workers=self._SEARCH_POOL_WORKERS,
+                    thread_name_prefix=f"{self.node_id}-search",
+                )
+            executor = self._search_executor
+        self.lane_tracker.try_submit(lane)
+        lanes_mod.record_lane_metrics(
+            self.telemetry.metrics, lane, self.lane_tracker.depth(lane))
+
+        def tracked():
+            try:
+                with lanes_mod.lane_scope(lane):
+                    return fn()
+            finally:
+                self.lane_tracker.complete(lane)
+
+        return self._submit_deferred(loop, executor, tracked)
 
     @staticmethod
     def _submit_deferred(loop, executor, fn):
@@ -2341,6 +2460,7 @@ class ClusterNode:
         index = payload["index"]
         nums = list(payload["shards"])
         body = payload.get("body") or {}
+        lane = payload.get("lane")
         keep = bool(payload.get("keep_context"))
         keep_alive_ms = int(payload.get("keep_alive_ms") or 60_000)
         self._reap_reader_contexts()
@@ -2370,6 +2490,18 @@ class ClusterNode:
                     shards, body, acquired=snaps, partial=True,
                     shard_numbers=present,
                 )
+            # residency stamp for the coordinator's replica router: after
+            # serving, consult THIS node's registry/ledger rows — a kNN
+            # body leaves the mesh bundle (or finds the IVF-PQ slab)
+            # HBM-resident, so the stamp teaches the board this copy is
+            # the warm one for the next fan-out. The kill switch disables
+            # the bookkeeping too: routing off must cost nothing on the
+            # hot path (no warm_for scan, no extra wire bytes).
+            if residency_mod.default_config.enabled:
+                field = residency_mod.knn_query_field(body)
+                if field is not None:
+                    resp["_residency"] = self._residency_stamp(
+                        index, field, shards, snaps)
             if missing:
                 resp["_shards"]["total"] += len(missing)
                 resp["_shards"]["failed"] += len(missing)
@@ -2387,17 +2519,42 @@ class ClusterNode:
                 resp["_ctx_id"] = ctx_id
             return resp
 
-        return self._offload_search(run)
+        return self._offload_search(run, lane=lane)
+
+    def _residency_stamp(self, index: str, field: str, shards: list,
+                         snaps: list) -> dict:
+        """This node's residency truth for (index, field): a mesh bundle
+        keyed to these shards' engines resident in the registry, or a
+        published IVF-PQ structure (its slab is device-resident from
+        publish to retirement)."""
+        engines = {sh.engine.instance_id for sh in shards}
+        mesh_warm = self.shard_mesh.warm_for(index, field, engines)
+        ann_warm = any(
+            (vf := dev.vector_fields.get(field)) is not None
+            and vf.ann is not None
+            for snap in snaps for _host, dev in snap.segments
+        )
+        # both signals ARE ledger-backed residency: a registry bundle
+        # holds its ledger allocation until eviction frees it, and a
+        # published ANN structure's slab is registered at build and freed
+        # at segment retirement — so no per-query scan of the ledger's
+        # full live-allocation table is needed (it grows with every
+        # resident column and this runs on the hot serving path)
+        return {"field": field, "warm": bool(mesh_warm or ann_warm)}
 
     def _on_node_msearch(self, sender: str, payload: dict):
         """Execute several search bodies over this node's local shards of
         one index, returning one wire partial per body. Bodies that are all
         bare knn queries run their query phase as ONE batched device
         dispatch (search_service.try_batched_knn_msearch); otherwise each
-        body runs exactly like search[node]."""
+        body runs exactly like search[node]. msearch fan-outs are
+        BACKGROUND-lane work unless the coordinator says otherwise."""
+        from opensearch_tpu.search import lanes as lanes_mod
+
         index = payload["index"]
         nums = list(payload["shards"])
         bodies = list(payload.get("bodies") or [])
+        lane = payload.get("lane") or lanes_mod.BACKGROUND
 
         shards = [self._local_shard(index, n) for n in nums]
         snaps = [s.acquire_searcher() for s in shards]
@@ -2422,7 +2579,7 @@ class ClusterNode:
                     out.append({"error": f"{type(e).__name__}: {e}"})
             return {"responses": out}
 
-        return self._offload_search(run)
+        return self._offload_search(run, lane=lane)
 
     @staticmethod
     def _now_ms() -> int:
@@ -2590,6 +2747,8 @@ class ClusterNode:
                 )
 
                 resp["device_totals"] = _ledger.device_totals()
+            if want("tail"):
+                resp["tail"] = self.tail_stats()
             if want("providers"):
                 for name, provider in list(self.stats_providers.items()):
                     try:
@@ -2600,6 +2759,30 @@ class ClusterNode:
                         logging.getLogger(__name__).warning(
                             "stats provider [%s] failed: %s", name, e)
         return resp
+
+    def tail_stats(self) -> dict:
+        """The `tail` stats section (ISSUE 11): lane queue depths + shed
+        counts, residency-routing decisions, and wlm search-slot budgets —
+        the whole tail-latency control plane in one read. `lanes` is the
+        data-plane (search-pool) tracker; `http_lanes` — present when a
+        REST facade is attached — is the HTTP boundary's, which is where
+        the bounded background queue sheds 429s."""
+        from opensearch_tpu.search import lanes as lanes_mod
+
+        out = {
+            "lanes": {
+                "enabled": lanes_mod.default_config.enabled,
+                "background_max_queue":
+                    lanes_mod.default_config.background_max_queue,
+                **self.lane_tracker.snapshot(),
+            },
+            "routing": self.residency_board.snapshot_stats(),
+            "wlm_search": self.query_groups.search_slot_stats(),
+        }
+        http_tracker = getattr(self, "http_lane_tracker", None)
+        if http_tracker is not None:
+            out["http_lanes"] = http_tracker.snapshot()
+        return out
 
     def _on_otel_flush(self, sender: str, payload: dict) -> dict:
         """`POST /_otel/flush` per-node leg: force the span exporter to
@@ -2630,7 +2813,7 @@ class ClusterNode:
                         "node": self.node_id}):
                 return self._shard_search_local(payload)
 
-        return self._offload_search(run)
+        return self._offload_search(run, lane=payload.get("lane"))
 
     def _shard_search_local(self, payload: dict) -> dict:
         """Per-shard query+fetch (the combined phase; split q/f is the
@@ -2757,6 +2940,8 @@ class ClusterNode:
             self._data_executor.shutdown(wait=False)
         if self._search_executor is not None:
             self._search_executor.shutdown(wait=False)
+        if self._bg_search_executor is not None:
+            self._bg_search_executor.shutdown(wait=False)
         self._reader_contexts.clear()
         for shard in self.local_shards.values():
             shard.close()

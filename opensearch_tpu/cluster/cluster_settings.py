@@ -117,14 +117,17 @@ def _register_typed_settings() -> None:
     # parser/validator/default; the registry reuses them so
     # PUT /_cluster/settings validation cannot drift from the component's
     # own parsing
+    from opensearch_tpu.cluster.residency import ROUTING_SETTINGS
     from opensearch_tpu.cluster.shard_mesh import MESH_SETTINGS
     from opensearch_tpu.index.request_cache import CACHE_SIZE_SETTING
     from opensearch_tpu.search.ann import ANN_SETTINGS
     from opensearch_tpu.search.batcher import BATCH_SETTINGS
+    from opensearch_tpu.search.lanes import LANE_SETTINGS
     from opensearch_tpu.telemetry.export import TRACING_SETTINGS
 
     for s in (*BATCH_SETTINGS, *ANN_SETTINGS, CACHE_SIZE_SETTING,
-              *TRACING_SETTINGS, *MESH_SETTINGS):
+              *TRACING_SETTINGS, *MESH_SETTINGS, *LANE_SETTINGS,
+              *ROUTING_SETTINGS):
         DYNAMIC_CLUSTER_SETTINGS[s.key] = _validate_with_setting(s)
 
 

@@ -149,6 +149,19 @@ class ClusterFacade:
         # and the cluster node (bulk admission) must see the same groups
         # and share the same slot budgets
         self.query_groups = cluster_node.query_groups
+        # the facade keeps its OWN lane tracker for the HTTP boundary:
+        # sharing the cluster node's cells would double-count every
+        # coordinator-local request (once at REST submit, again when its
+        # search[node]/msearch[node] leg lands on this node's search
+        # pools) and halve the effective background_max_queue shed bound.
+        # The node's `tail` stats section reports BOTH trackers (the
+        # boundary one as `http_lanes` — that is where the bounded
+        # background queue actually sheds).
+        from opensearch_tpu.search import lanes as _lanes_mod
+
+        self.lane_tracker = _lanes_mod.LaneTracker()
+        cluster_node.http_lane_tracker = self.lane_tracker
+        self.tail_stats = cluster_node.tail_stats
         from opensearch_tpu.persistent import PersistentTasksService
 
         self.persistent_tasks = PersistentTasksService(
@@ -525,24 +538,40 @@ class ClusterFacade:
     # search (per-node fan-out + coordinator reduce)
     # ------------------------------------------------------------------ #
 
-    def _node_assignments(self, names: list[str]) -> list[tuple[str, str, list[int]]]:
-        """[(node_id, index, [shard_nums])] — one entry per (node, index),
-        preferring primaries (ARS is a later refinement)."""
+    def _node_assignments(
+        self, names: list[str], body: dict | None = None,
+    ) -> list[tuple[str, str, list[int]]]:
+        """[(node_id, index, [shard_nums])] — one entry per (node, index).
+        Bare-kNN bodies route RESIDENCY-AWARE (cluster/residency.py): each
+        shard's launch lands on the copy whose mesh bundle / IVF-PQ slab
+        the board knows to be HBM-resident, round-robin when no copy is
+        warm; everything else keeps the prefer-primary selection."""
+        from opensearch_tpu.cluster import residency as residency_mod
+
         state = self.state
+        field = residency_mod.knn_query_field(body) if body else None
         out: dict[tuple[str, str], list[int]] = {}
         for name in names:
             meta = self._meta(name)
-            targets: dict[int, Any] = {}
+            candidates: dict[int, list] = {}
             for r in state.shards_for_index(name):
                 # RELOCATING sources still serve until the routing swap
                 if r.state not in ("STARTED", "RELOCATING") or r.node_id is None:
                     continue
-                if r.shard not in targets or r.primary:
-                    targets[r.shard] = r
-            if len(targets) < meta.num_shards:
+                candidates.setdefault(r.shard, []).append(r)
+            if len(candidates) < meta.num_shards:
                 raise OpenSearchTpuException(
                     f"not all shards of [{name}] are available"
                 )
+            if field is not None:
+                targets, _warm = residency_mod.choose_copies(
+                    self.node.residency_board, name, field, candidates,
+                    next(self.node._route_rr))
+            else:
+                targets = {
+                    num: next((r for r in cands if r.primary), cands[0])
+                    for num, cands in candidates.items()
+                }
             for num, r in targets.items():
                 out.setdefault((r.node_id, name), []).append(num)
         return [(nid, idx, sorted(nums)) for (nid, idx), nums in
@@ -596,7 +625,27 @@ class ClusterFacade:
         node_body["from"] = 0
         node_body["size"] = from_ + size
         node_body["track_total_hits"] = True  # coordinator applies the cap
-        assignments = self._node_assignments(names)
+        # wlm search admission BEFORE the fan-out (the bulk twin): an
+        # enforced group past its slot share sheds a typed 429 here —
+        # RejectedExecutionException surfaces through the REST envelope —
+        # and burns no transport or device work
+        release_admission = self.query_groups.admit_search(query_group)
+        try:
+            return self._search_fanned(
+                names, body, node_body, size, from_, track_total, keep,
+                keep_alive_ms, index, allow_partial_search_results)
+        finally:
+            release_admission()
+
+    def _search_fanned(self, names, body, node_body, size, from_,
+                       track_total, keep, keep_alive_ms, index,
+                       allow_partial_search_results) -> dict:
+        from opensearch_tpu.search.reduce import reduce_search_responses
+
+        from opensearch_tpu.search import lanes as lanes_mod
+
+        assignments = self._node_assignments(names, body)
+        lane = lanes_mod.active_lane()
         from opensearch_tpu.telemetry import tracing
 
         tracer = self.telemetry.tracer
@@ -610,9 +659,19 @@ class ClusterFacade:
             partials = self._rpc_many([
                 (nid, "indices:data/read/search[node]",
                  {"index": idx, "shards": nums, "body": node_body,
-                  "keep_context": keep, "keep_alive_ms": keep_alive_ms})
+                  "keep_context": keep, "keep_alive_ms": keep_alive_ms,
+                  "lane": lane})
                 for nid, idx, nums in assignments
             ])
+            # residency stamps teach the coordinator's board which copies
+            # are warm BEFORE the next fan-out routes (pop so the stamp
+            # never reaches the reduce)
+            for (nid, idx, _nums), p in zip(assignments, partials):
+                if isinstance(p, dict):
+                    res = p.pop("_residency", None)
+                    if isinstance(res, dict) and res.get("field"):
+                        self.node.residency_board.observe(
+                            nid, idx, res["field"], bool(res.get("warm")))
             # a scroll must pin a context on EVERY node, so partial
             # tolerance only applies to plain searches
             if keep or not allow_partial_search_results:
@@ -656,6 +715,11 @@ class ClusterFacade:
                 self.telemetry.metrics.histogram(
                     "search.took_ms", labels={"index": str(index)},
                 ).record(resp.get("took", 0))
+            # per-LANE series (ISSUE 11): interactive vs background tails
+            # separate under the same constant family name
+            self.telemetry.metrics.histogram(
+                "search.took_ms", labels={"lane": lane},
+            ).record(resp.get("took", 0))
         if keep:
             contexts = {
                 f"{nid}|{idx}": p["_ctx_id"]
@@ -857,7 +921,9 @@ class ClusterFacade:
 
         try:
             names = self.resolve_indices(index)
-            assignments = self._node_assignments(names)
+            # residency routing sees the first body (the group shares one
+            # knn field); msearch fan-outs are background-lane work
+            assignments = self._node_assignments(names, bodies[0])
             node_bodies = []
             for body in bodies:
                 nb = dict(body)
@@ -865,9 +931,12 @@ class ClusterFacade:
                 nb["size"] = int(body.get("from", 0)) + int(body.get("size", 10))
                 nb["track_total_hits"] = True
                 node_bodies.append(nb)
+            from opensearch_tpu.search import lanes as lanes_mod
+
             partials_per_node = self._rpc_many([
                 (nid, "indices:data/read/msearch[node]",
-                 {"index": idx, "shards": nums, "bodies": node_bodies})
+                 {"index": idx, "shards": nums, "bodies": node_bodies,
+                  "lane": lanes_mod.BACKGROUND})
                 for nid, idx, nums in assignments
             ])
         except OpenSearchTpuException:
@@ -1182,7 +1251,8 @@ class ClusterFacade:
         payload: dict[str, Any] = {"full": True}
         if metrics and "_all" not in metrics:
             section_of = {"telemetry": "spans", "knn_batch": "knn_batch",
-                          "indices": "providers", "device": "device"}
+                          "indices": "providers", "device": "device",
+                          "tail": "tail"}
             payload["sections"] = sorted(
                 {section_of[m] for m in metrics if m in section_of})
         nodes = sorted(self.state.nodes)
@@ -1203,6 +1273,7 @@ class ClusterFacade:
                 "knn_batch": r.get("knn_batch", {}),
                 "shard_mesh": r.get("shard_mesh", {}),
                 "device": r.get("device", {}),
+                "tail": r.get("tail", {}),
                 "indices": {
                     "request_cache": r.get("request_cache", {}),
                 },

@@ -197,9 +197,16 @@ class ShardMeshRegistry:
                 if existing is not bundle:
                     _free_bundle(bundle, reason="duplicate-build")
                 return existing
-            # one live bundle per (index, field): superseded generations
-            # of the same residency slot evict now, not at budget pressure
-            for stale in [k for k in self._bundles if k[:2] == key[:2]]:
+            # one live bundle per residency SLOT — (index, field, engine
+            # instance ids), i.e. per node's shard set: a refresh bumps
+            # the generations but keeps the engines, so the old
+            # generation's bundle evicts now, not at budget pressure.
+            # Keying the slot by engine ids (not just index/field) lets
+            # in-process sim nodes hold their OWN copies' bundles side by
+            # side — the residency-aware router depends on a warm copy
+            # STAYING warm while another node serves its disjoint shards.
+            for stale in [k for k in self._bundles
+                          if k[:2] == key[:2] and k[3] == key[3]]:
                 self._evict_locked(stale, "superseded")
             self._enforce_budget_locked(incoming=_bundle_nbytes(bundle))
             if self.max_bundles is not None:
@@ -210,6 +217,21 @@ class ShardMeshRegistry:
             self._mem["resident_bytes"] += _bundle_nbytes(bundle)
             self.stats["builds"] += 1
             return bundle
+
+    def warm_for(self, index: str, field: str,
+                 engine_ids: set | frozenset) -> bool:
+        """True when a resident bundle serves (index, field) for shards
+        whose engines are all in `engine_ids` — the node-side residency
+        truth the coordinator's replica router learns from (a bundle
+        keyed to ANOTHER node's engine instances in a shared-process sim
+        never counts as this node's warmth). Pure read: no LRU touch, no
+        hit accounting — consulting residency is not serving from it."""
+        with self._lock:
+            return any(
+                k[0] == index and k[1] == field
+                and set(k[3]) <= set(engine_ids)
+                for k in self._bundles
+            )
 
     def invalidate_index(self, index: str) -> int:
         """Drop every bundle of `index` (its shards left this node or the

@@ -405,6 +405,12 @@ class TpuNode:
 
         self.knn_batcher = _batcher_mod.default_batcher
         self.knn_batcher.metrics = self.telemetry.metrics
+        # priority-lane bookkeeping (search/lanes.py): the HTTP server
+        # submits/sheds against this tracker so the `tail` stats section
+        # (and the bench) can read lane depths off the node handle
+        from opensearch_tpu.search import lanes as _lanes_mod
+
+        self.lane_tracker = _lanes_mod.LaneTracker()
         from opensearch_tpu.index.remote_store import RemoteStoreService
 
         self.remote_store = RemoteStoreService(self)
@@ -3098,6 +3104,14 @@ class TpuNode:
             if len(index_names) == 1 and "*" not in expr:
                 self.telemetry.metrics.histogram(
                     "search.took_ms", labels={"index": expr}).record(took)
+            # per-LANE series (ISSUE 11): the lane rides the request's
+            # contextvar scope from the REST boundary, so interactive vs
+            # background tail behavior separates in one histogram family
+            from opensearch_tpu.search import lanes as lanes_mod
+
+            self.telemetry.metrics.histogram(
+                "search.took_ms",
+                labels={"lane": lanes_mod.active_lane()}).record(took)
         if pl is not None:
             resp = self.search_pipelines.transform_response(
                 pl, {**body, **pl_ctx}, resp
@@ -3556,6 +3570,17 @@ class TpuNode:
 
         if any(s.key in eff or s.key in changed for s in MESH_SETTINGS):
             default_registry.apply_settings(eff)
+        # priority lanes + residency routing (ISSUE 11): process-wide
+        # policy toggles under the same only-when-named guard
+        from opensearch_tpu.cluster import residency as residency_mod
+        from opensearch_tpu.search import lanes as lanes_mod
+
+        if any(s.key in eff or s.key in changed
+               for s in lanes_mod.LANE_SETTINGS):
+            lanes_mod.default_config.apply_settings(eff)
+        if any(s.key in eff or s.key in changed
+               for s in residency_mod.ROUTING_SETTINGS):
+            residency_mod.default_config.apply_settings(eff)
         self.request_cache.set_max_bytes(
             CACHE_SIZE_SETTING.get(Settings.from_flat(eff)))
         # span exporter: per-node (like the request cache), applies

@@ -3056,8 +3056,32 @@ _NODES_STATS_METRICS = {
     "transport", "http", "breaker", "script", "discovery", "ingest",
     "adaptive_selection", "indexing_pressure", "search_backpressure",
     "shard_indexing_pressure", "tasks", "telemetry", "slowlog", "knn_batch",
-    "shard_mesh", "device",
+    "shard_mesh", "device", "tail",
 }
+
+
+def _tail_section(node) -> dict:
+    """The single-node `tail` stats section; ClusterNode builds its own
+    (tail_stats) with the residency board included — the single node has
+    no replicas to route, so routing stays an empty shape here."""
+    from opensearch_tpu.search import lanes as lanes_mod
+
+    tracker = getattr(node, "lane_tracker", None)
+    groups = getattr(node, "query_groups", None)
+    tail_stats = getattr(node, "tail_stats", None)
+    if callable(tail_stats):
+        return tail_stats()
+    return {
+        "lanes": {
+            "enabled": lanes_mod.default_config.enabled,
+            "background_max_queue":
+                lanes_mod.default_config.background_max_queue,
+            **(tracker.snapshot() if tracker is not None else {}),
+        },
+        "routing": {},
+        "wlm_search": (groups.search_slot_stats()
+                       if groups is not None else {}),
+    }
 
 
 def nodes_stats(node: TpuNode, params, query, body):
@@ -3177,6 +3201,9 @@ def nodes_stats(node: TpuNode, params, query, body):
         # (resident == allocated − freed), per-kernel-family compile
         # accounting, and the shard-mesh byte-budget state
         "device": device_ledger.stats_section(),
+        # tail-latency control plane (ISSUE 11): lane queue depths + shed
+        # counts, residency-routing decisions, wlm search-slot budgets
+        "tail": _tail_section(node),
         "telemetry": {
             **node.telemetry.metrics.stats(),
             # the tail of the spans ring: one stitched trace tree per

@@ -57,12 +57,29 @@ class HttpServer:
         # worker they would serialize upstream and never merge. Scroll/PIT
         # lifecycle requests stay on the serial data worker (they mutate
         # the reader-context registry).
+        #
+        # PRIORITY LANES (ISSUE 11): the parallel pool is the INTERACTIVE
+        # lane; background-classified requests (_msearch and anything
+        # ?lane=background) run a separate, smaller pool with a BOUNDED
+        # queue — a background flood saturates only its own workers and
+        # sheds 429 past its queue bound, so it can never occupy every
+        # slot an interactive _search needs (search/lanes.py).
         import os as _os
 
         self._search_executor = ThreadPoolExecutor(
             max_workers=min(8, (_os.cpu_count() or 2)),
             thread_name_prefix="search",
         )
+        self._background_executor = ThreadPoolExecutor(
+            max_workers=max(2, min(4, (_os.cpu_count() or 2) // 2)),
+            thread_name_prefix="search-bg",
+        )
+        from opensearch_tpu.search import lanes as _lanes
+
+        # share the node's tracker when it has one, so the `_nodes/stats`
+        # tail section reads the same cells the HTTP boundary updates
+        self.lane_tracker = (getattr(node, "lane_tracker", None)
+                             or _lanes.LaneTracker())
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -83,7 +100,7 @@ class HttpServer:
         # per process, and idle non-daemon pool threads would otherwise
         # accumulate for the process lifetime
         for pool in (self._executor, self._mgmt_executor,
-                     self._search_executor):
+                     self._search_executor, self._background_executor):
             pool.shutdown(wait=False)
 
     # -- connection handling ----------------------------------------------
@@ -197,34 +214,72 @@ class HttpServer:
             # only the lock-protected TaskManager endpoints may run
             # concurrently with the data worker; stats/cat iterate engine
             # structures that are single-writer. Read-only searches run on
-            # the parallel search pool (see __init__).
-            if path.startswith("/_tasks"):
-                executor = self._mgmt_executor
-            elif self._is_parallel_search(path, query):
-                executor = self._search_executor
-            else:
-                executor = self._executor
+            # the parallel search pool — split by PRIORITY LANE (see
+            # __init__) so background msearch floods can't occupy the
+            # interactive workers.
+            from opensearch_tpu.search import lanes as lanes_mod
             from opensearch_tpu.telemetry import default_telemetry
 
             telemetry = getattr(self.node, "telemetry", default_telemetry)
+            lane_cfg = lanes_mod.default_config
+            lane = (lanes_mod.classify_rest(path, query)
+                    if lane_cfg.enabled else lanes_mod.INTERACTIVE)
+            # the lane reaches handlers through the lane_scope contextvar
+            # below — never the query dict (strict handlers reject
+            # unrecognized parameters)
+            tracked = False
+            if path.startswith("/_tasks"):
+                executor = self._mgmt_executor
+            elif self._is_parallel_search(path, query):
+                tracked = True
+                if lane_cfg.enabled and lane == lanes_mod.BACKGROUND:
+                    executor = self._background_executor
+                    if not self.lane_tracker.try_submit(
+                            lane, lane_cfg.background_max_queue):
+                        # bounded background lane: shed, never queue
+                        # without bound (the QueuePressure contract)
+                        lanes_mod.record_lane_shed(telemetry.metrics, lane)
+                        if breakers is not None and raw_body:
+                            breakers.in_flight_requests.release(len(raw_body))
+                        return 429, {
+                            "error": {
+                                "type": "rejected_execution_exception",
+                                "reason": "background lane queue is full",
+                            },
+                            "status": 429,
+                        }, "application/json"
+                else:
+                    executor = self._search_executor
+                    self.lane_tracker.try_submit(lane)
+                lanes_mod.record_lane_metrics(
+                    telemetry.metrics, lane, self.lane_tracker.depth(lane))
+            else:
+                executor = self._executor
             span_cm = telemetry.tracer.start_span(
-                "http_request", {"method": method, "path": path}
+                "http_request", {"method": method, "path": path,
+                                 "lane": lane}
             )
             try:
                 with span_cm as span:
                     # handlers are synchronous work; run them off the event
                     # loop so slow searches don't stall socket IO. The
                     # contextvars context is copied into the worker thread so
-                    # handler spans parent under this http_request span.
+                    # handler spans parent under this http_request span (and
+                    # the lane scope rides it into the dispatch batcher).
                     import contextvars as _cv
+
+                    def run_handler():
+                        with lanes_mod.lane_scope(lane):
+                            return handler(self.node, params, query, body)
 
                     ctx = _cv.copy_context()
                     status, payload = await asyncio.get_running_loop().run_in_executor(
-                        executor, ctx.run, handler, self.node, params, query,
-                        body,
+                        executor, ctx.run, run_handler,
                     )
                     span.set_attribute("status", status)
             finally:
+                if tracked:
+                    self.lane_tracker.complete(lane)
                 if breakers is not None and raw_body:
                     breakers.in_flight_requests.release(len(raw_body))
             if "filter_path" in query and status < 400:

@@ -60,11 +60,26 @@ sheds the request with RejectedExecutionException (HTTP 429) instead of
 growing the queue (the IndexingPressure shedding contract, and the
 tpulint unbounded-queue concern).
 
+Since the tail-latency control plane (ISSUE 11) the wait window is
+PER-KEY AUTO-TUNED: a :class:`_KeyTuner` per stable key family (the
+``tune_key`` callers pass — the batch key minus its generation terms, so
+a refresh doesn't reset what the controller learned) tracks the EWMA of
+merged batch sizes, measured per-entry queue waits, and inter-arrival
+gaps, and derives each arrival's effective wait from them. Solo traffic
+converges to a ~0 ms window (no added latency); bursty keys earn up to
+the configured ``max_wait_ms``. The request's priority LANE
+(search/lanes.py contextvar) rides along: background entries accept a
+longer deadline (they earn bigger merges), but because every entry keeps
+its OWN deadline and a flush takes the whole bucket, an interactive
+arrival's short deadline flushes any backlog of background entries it
+joins — background queueing can never extend an interactive wait.
+
 Settings (dynamic, cluster scope — see common/settings.py Setting model):
-  search.knn.batch.max_wait_ms   flush deadline      (default 2ms)
+  search.knn.batch.max_wait_ms   flush deadline ceiling (default 2ms)
   search.knn.batch.max_batch_size  flush size bound  (default 32)
   search.knn.batch.max_queue     pending-query bound (default 1024)
   search.knn.batch.enabled       kill switch         (default true)
+  search.knn.batch.auto_tune     per-key wait tuner  (default true)
 """
 
 from __future__ import annotations
@@ -95,24 +110,104 @@ ENABLED_SETTING = Setting.bool_setting(
     "search.knn.batch.enabled", True,
     Property.NODE_SCOPE, Property.DYNAMIC,
 )
+AUTO_TUNE_SETTING = Setting.bool_setting(
+    "search.knn.batch.auto_tune", True,
+    Property.NODE_SCOPE, Property.DYNAMIC,
+)
 
 BATCH_SETTINGS = (
     MAX_WAIT_MS_SETTING, MAX_BATCH_SIZE_SETTING, MAX_QUEUE_SETTING,
-    ENABLED_SETTING,
+    ENABLED_SETTING, AUTO_TUNE_SETTING,
 )
 
 # EWMA of merged batch sizes at/below this -> no recent concurrency ->
 # skip the wait window for idle-device arrivals
 _SOLO_EWMA_THRESHOLD = 1.25
 _EWMA_DECAY = 0.7
+# background-lane entries accept this multiple of the configured wait:
+# they are throughput traffic, and a longer window earns bigger merges —
+# interactive entries in the same bucket still flush it at THEIR deadline
+_BACKGROUND_WAIT_FACTOR = 4
+# per-key tuner table bound (LRU): tune_keys are generation-free and few,
+# but a pathological workload must not grow the table without bound
+_MAX_TUNERS = 256
+
+
+class _KeyTuner:
+    """Per-key-family wait controller. Fed (under the batcher lock) by
+    every arrival and every flush; read at dispatch time to derive the
+    entry's effective wait window from what this key's traffic has
+    actually been doing — the measured queue-wait and arrival-rate
+    distributions, not the static ceiling."""
+
+    __slots__ = ("ewma_merged", "ewma_wait_ms", "ewma_gap_ms", "flushes",
+                 "last_arrival_ms")
+
+    def __init__(self) -> None:
+        # optimistic start (matches the batcher's global EWMA): assume
+        # concurrency until flushes prove otherwise, so a key's first
+        # burst coalesces instead of stampeding solo
+        self.ewma_merged = 2.0 * _SOLO_EWMA_THRESHOLD
+        self.ewma_wait_ms = 0.0
+        self.ewma_gap_ms: float | None = None
+        self.flushes = 0
+        self.last_arrival_ms: int | None = None
+
+    def note_arrival(self, now_ms: int) -> None:
+        if self.last_arrival_ms is not None:
+            gap = max(0, now_ms - self.last_arrival_ms)
+            self.ewma_gap_ms = (
+                gap if self.ewma_gap_ms is None
+                else _EWMA_DECAY * self.ewma_gap_ms + (1 - _EWMA_DECAY) * gap)
+        self.last_arrival_ms = now_ms
+
+    def note_flush(self, merged: int, max_wait_ms: int) -> None:
+        self.ewma_merged = (_EWMA_DECAY * self.ewma_merged
+                            + (1 - _EWMA_DECAY) * merged)
+        self.ewma_wait_ms = (_EWMA_DECAY * self.ewma_wait_ms
+                             + (1 - _EWMA_DECAY) * max_wait_ms)
+        self.flushes += 1
+
+    @property
+    def solo(self) -> bool:
+        return self.ewma_merged <= _SOLO_EWMA_THRESHOLD
+
+    def effective_wait(self, ceiling_ms: int) -> int:
+        """0 for solo traffic; for concurrent traffic, scale toward the
+        ceiling with the observed merge factor, CAPPED at the measured
+        wait the key's batches actually needed (batches that fill by size
+        before the deadline never needed the whole window), and floored
+        at the observed inter-arrival gap (waiting less than one gap can
+        never coalesce the next arrival)."""
+        if ceiling_ms <= 0 or self.solo:
+            return 0
+        frac = min(1.0, self.ewma_merged - 1.0)
+        wait = max(1, round(ceiling_ms * frac))
+        if self.flushes >= 4:
+            # enough history: the window need not exceed what the
+            # measured per-entry waits show this key's merges cost
+            wait = min(wait, max(1, round(self.ewma_wait_ms) + 1))
+        if self.ewma_gap_ms is not None and self.ewma_gap_ms < ceiling_ms:
+            wait = max(wait, min(ceiling_ms, int(self.ewma_gap_ms) + 1))
+        return min(wait, ceiling_ms)
+
+    def snapshot(self) -> dict:
+        return {
+            "ewma_merged": round(self.ewma_merged, 3),
+            "ewma_wait_ms": round(self.ewma_wait_ms, 3),
+            "ewma_gap_ms": (round(self.ewma_gap_ms, 3)
+                            if self.ewma_gap_ms is not None else None),
+            "flushes": self.flushes,
+        }
 
 
 class _Entry:
     __slots__ = ("payload", "enq_ms", "taken", "done", "result", "error",
                  "batch_size", "wall_ns", "retraced", "wait_ms", "launch",
-                 "rank")
+                 "rank", "tune_key")
 
-    def __init__(self, payload: Any, enq_ms: int, launch=None, rank: int = 0):
+    def __init__(self, payload: Any, enq_ms: int, launch=None, rank: int = 0,
+                 tune_key: Any = None):
         self.payload = payload
         self.enq_ms = enq_ms
         self.taken = False
@@ -129,6 +224,8 @@ class _Entry:
         # but can never shrink one
         self.launch = launch
         self.rank = rank
+        # generation-free key family feeding the per-key wait auto-tuner
+        self.tune_key = tune_key
 
 
 class _Bucket:
@@ -167,6 +264,7 @@ class KnnDispatchBatcher:
                  max_wait_ms: int | None = None,
                  max_queue: int | None = None,
                  enabled: bool | None = None,
+                 auto_tune: bool | None = None,
                  metrics=None):
         from opensearch_tpu.common.settings import Settings
 
@@ -176,6 +274,8 @@ class KnnDispatchBatcher:
                             else MAX_WAIT_MS_SETTING.default(Settings.EMPTY))
         self.enabled = (enabled if enabled is not None
                         else ENABLED_SETTING.default(Settings.EMPTY))
+        self.auto_tune = (auto_tune if auto_tune is not None
+                          else AUTO_TUNE_SETTING.default(Settings.EMPTY))
         limit = (max_queue if max_queue is not None
                  else MAX_QUEUE_SETTING.default(Settings.EMPTY))
         self.pressure = QueuePressure(limit, operation="knn batch dispatch")
@@ -183,6 +283,8 @@ class KnnDispatchBatcher:
         self._cond = threading.Condition()
         self._buckets: dict[Any, _Bucket] = {}
         self._in_flight: dict[Any, int] = {}
+        # per-key-family wait controllers (LRU-bounded, guarded by _cond)
+        self._tuners: dict[Any, _KeyTuner] = {}
         # optimistic start (above the solo threshold): a fresh node assumes
         # concurrency until flushes prove otherwise, so the very first burst
         # coalesces instead of stampeding solo
@@ -213,7 +315,8 @@ class KnnDispatchBatcher:
     def configure(self, *, max_batch_size: int | None = None,
                   max_wait_ms: int | None = None,
                   max_queue: int | None = None,
-                  enabled: bool | None = None) -> None:
+                  enabled: bool | None = None,
+                  auto_tune: bool | None = None) -> None:
         # config fields are plain atomic assignments read racily by design:
         # a dispatch that reads the old value completes under the old
         # policy, which is exactly the dynamic-settings contract
@@ -223,6 +326,8 @@ class KnnDispatchBatcher:
             self.max_wait_ms = int(max_wait_ms)
         if enabled is not None:
             self.enabled = bool(enabled)
+        if auto_tune is not None:
+            self.auto_tune = bool(auto_tune)
         if max_queue is not None:
             self.pressure.set_limit(max_queue)
         with self._cond:
@@ -241,7 +346,12 @@ class KnnDispatchBatcher:
             max_batch_size=MAX_BATCH_SIZE_SETTING.get(s),
             max_queue=MAX_QUEUE_SETTING.get(s),
             enabled=ENABLED_SETTING.get(s),
+            auto_tune=AUTO_TUNE_SETTING.get(s),
         )
+
+    # tuner entries surfaced in stats (the table itself is bounded at
+    # _MAX_TUNERS; the stats payload shows the busiest few)
+    _STATS_TUNER_ROWS = 16
 
     def snapshot_stats(self) -> dict:
         with self._cond:
@@ -251,6 +361,20 @@ class KnnDispatchBatcher:
                 if out["dispatches"] else 0.0
             )
             out["ewma_batch"] = round(self._ewma, 3)
+            busiest = sorted(self._tuners.items(),
+                             key=lambda kv: -kv[1].flushes)
+            out["auto_tune"] = {
+                "enabled": self.auto_tune,
+                "tuned_keys": len(self._tuners),
+                "keys": {
+                    str(tk): {
+                        **tuner.snapshot(),
+                        "effective_wait_ms": tuner.effective_wait(
+                            self.max_wait_ms),
+                    }
+                    for tk, tuner in busiest[: self._STATS_TUNER_ROWS]
+                },
+            }
         out["queue"] = self.pressure.stats()
         out["rejections"] = out["queue"]["rejections"]
         out["enabled"] = self.enabled
@@ -270,6 +394,7 @@ class KnnDispatchBatcher:
         for k in self.stats:
             self.stats[k] = 0
         self._ewma = 2.0 * _SOLO_EWMA_THRESHOLD
+        self._tuners.clear()
         self.pressure.rejections = 0
         self.pressure.total = 0
 
@@ -281,7 +406,8 @@ class KnnDispatchBatcher:
                  shards: int = 1, *, kind: str = "exact",
                  rank: int = 0,
                  alt_keys: Sequence[Any] = (),
-                 family: str | None = None) -> DispatchOutcome:
+                 family: str | None = None,
+                 tune_key: Any = None) -> DispatchOutcome:
         """Run `payload` through the batch identified by `key`.
 
         `launch(payloads)` performs ONE device launch for the whole batch
@@ -312,14 +438,43 @@ class KnnDispatchBatcher:
         `family` names the kernel family for the device-residency ledger's
         retrace/compile accounting: a launch whose retraced flag fires
         counts one jit-cache entry (plus its first-launch wall) there.
+
+        `tune_key` names the entry's GENERATION-FREE key family for the
+        per-key wait auto-tuner (defaults to `key` itself): the controller
+        derives this arrival's effective wait window from the family's
+        measured merge factor / queue waits / arrival gaps instead of the
+        static `max_wait_ms` ceiling. The active priority lane
+        (search/lanes.py) widens the window for background entries.
         """
         if key is None or not self.enabled or self.max_batch_size <= 1:
             return self._solo(payload, launch, shards, kind, family)
+        from opensearch_tpu.search import lanes as lanes_mod
+
+        # the lanes kill switch governs the batcher's wait-widening too:
+        # control-plane-off must be exactly the pre-lane behavior (and the
+        # bench's OFF baseline must not keep one lever engaged)
+        background = (lanes_mod.default_config.enabled
+                      and lanes_mod.active_lane() == lanes_mod.BACKGROUND)
+        if tune_key is None:
+            tune_key = key
         with self._cond:
             self.pressure.acquire()
             entry = _Entry(payload, timeutil.monotonic_millis(),
-                           launch=launch, rank=rank)
-            deadline = entry.enq_ms + max(self.max_wait_ms, 0)
+                           launch=launch, rank=rank, tune_key=tune_key)
+            tuner = None
+            if self.auto_tune:
+                tuner = self._tuner_locked(tune_key)
+                tuner.note_arrival(entry.enq_ms)
+                eff_wait = tuner.effective_wait(self.max_wait_ms)
+            else:
+                eff_wait = self.max_wait_ms
+            if background:
+                # background traffic accepts a longer window (it earns
+                # bigger merges); never BELOW the configured ceiling so a
+                # tuned-down interactive window doesn't shrink it
+                eff_wait = max(self.max_wait_ms, eff_wait) \
+                    * _BACKGROUND_WAIT_FACTOR
+            deadline = entry.enq_ms + max(eff_wait, 0)
             for alt in alt_keys:
                 alt_bucket = self._buckets.get(alt)
                 if (alt_bucket is not None and alt_bucket.entries
@@ -333,11 +488,14 @@ class KnnDispatchBatcher:
             if bucket is None:
                 bucket = self._buckets[key] = _Bucket()
             bucket.entries.append(entry)
+            # the per-key controller's solo verdict wins when auto-tuning;
+            # the global EWMA stays the fallback signal
+            solo_now = (tuner.solo if tuner is not None
+                        else self._ewma <= _SOLO_EWMA_THRESHOLD)
             if len(bucket.entries) >= self.max_batch_size:
                 batch, reason = self._take_locked(key), "size"
             elif self.max_wait_ms <= 0 or (
-                self._in_flight.get(key, 0) == 0
-                and self._ewma <= _SOLO_EWMA_THRESHOLD
+                self._in_flight.get(key, 0) == 0 and solo_now
             ):
                 if len(bucket.entries) == 1:
                     self.stats["solo_fast_path"] += 1
@@ -374,10 +532,21 @@ class KnnDispatchBatcher:
         t0 = time.perf_counter_ns()
         results, retraced = launch([payload])
         wall = time.perf_counter_ns() - t0
-        self._record_launch(1, wall, 0, shards, kind)
+        self._record_launch(1, wall, (0,), shards, kind)
         self._after_launch(kind, family, retraced, wall, merged=1,
                            reason="unbatched")
         return DispatchOutcome(results[0], 1, wall, retraced, 0)
+
+    def _tuner_locked(self, tune_key: Any) -> _KeyTuner:
+        """The key family's controller (caller holds the lock); LRU touch
+        + bound so generations of abandoned families age out."""
+        tuner = self._tuners.pop(tune_key, None)
+        if tuner is None:
+            tuner = _KeyTuner()
+        self._tuners[tune_key] = tuner
+        while len(self._tuners) > _MAX_TUNERS:
+            self._tuners.pop(next(iter(self._tuners)))
+        return tuner
 
     def _after_launch(self, kind: str, family: str | None, retraced: bool,
                       wall_ns: int, merged: int, reason: str) -> None:
@@ -473,7 +642,7 @@ class KnnDispatchBatcher:
                 for e in batch:
                     e.error = err
                     e.done = True
-                self._finish_locked(key, len(batch))
+                self._finish_locked(key, batch)
             raise
         wall = time.perf_counter_ns() - t0
         with self._cond:
@@ -483,9 +652,9 @@ class KnnDispatchBatcher:
                 e.wall_ns = wall
                 e.retraced = retraced
                 e.done = True
-            self._finish_locked(key, len(batch))
+            self._finish_locked(key, batch)
         self._record_launch(len(batch), wall,
-                            max((e.wait_ms for e in batch), default=0),
+                            tuple(e.wait_ms for e in batch),
                             shards, kind)
         self._after_launch(kind, family, retraced, wall,
                            merged=len(batch), reason=reason or "lead")
@@ -494,13 +663,25 @@ class KnnDispatchBatcher:
         return DispatchOutcome(own.result, len(batch), wall, retraced,
                                own.wait_ms)
 
-    def _finish_locked(self, key: Any, merged: int) -> None:
+    def _finish_locked(self, key: Any, batch: list[_Entry]) -> None:
+        merged = len(batch)
         n = self._in_flight.get(key, 0) - 1
         if n > 0:
             self._in_flight[key] = n
         else:
             self._in_flight.pop(key, None)
         self._ewma = _EWMA_DECAY * self._ewma + (1 - _EWMA_DECAY) * merged
+        if self.auto_tune:
+            # every key family represented in the batch (cross-k joiners
+            # carry their own tune_key) learns this flush's merge factor
+            # and its members' MEASURED waits
+            by_family: dict[Any, int] = {}
+            for e in batch:
+                if e.tune_key is not None:
+                    by_family[e.tune_key] = max(
+                        by_family.get(e.tune_key, 0), e.wait_ms)
+            for tk, max_wait in by_family.items():
+                self._tuner_locked(tk).note_flush(merged, max_wait)
         bucket = self._buckets.get(key)
         if bucket is not None and bucket.entries:
             # continuous batching: the backlog that formed while this
@@ -509,7 +690,7 @@ class KnnDispatchBatcher:
         self._cond.notify_all()
 
     def _record_launch(self, merged: int, wall_ns: int,
-                       max_wait_ms: int, shards: int = 1,
+                       wait_ms_per_entry: Sequence[int], shards: int = 1,
                        kind: str = "exact") -> None:
         with self._cond:
             self.stats["dispatches"] += 1
@@ -533,7 +714,11 @@ class KnnDispatchBatcher:
         metrics = active_metrics() or self.metrics
         if metrics is not None:
             metrics.histogram("knn.batch.size").record(merged)
-            metrics.histogram("knn.batch.queue_wait_ms").record(max_wait_ms)
+            # one observation PER ENTRY with its MEASURED queue wait (the
+            # auto-tuner and its operators need the real distribution, not
+            # one per-batch point — and never the configured ceiling)
+            for w in wait_ms_per_entry:
+                metrics.histogram("knn.batch.queue_wait_ms").record(w)
             metrics.histogram("knn.batch.shards").record(shards)
             metrics.counter("knn.batch.dispatches").add(1)
             if kind == "ann":
@@ -553,7 +738,8 @@ default_batcher = KnnDispatchBatcher()
 def dispatch(key: Any, payload: Any, launch, shards: int = 1, *,
              kind: str = "exact", rank: int = 0,
              alt_keys: Sequence[Any] = (),
-             family: str | None = None) -> DispatchOutcome:
+             family: str | None = None,
+             tune_key: Any = None) -> DispatchOutcome:
     return default_batcher.dispatch(key, payload, launch, shards=shards,
                                     kind=kind, rank=rank, alt_keys=alt_keys,
-                                    family=family)
+                                    family=family, tune_key=tune_key)

@@ -258,6 +258,10 @@ class ShardContext:
                     kind="ann", rank=k_bucket,
                     alt_keys=(ann_key(k_bucket * 2), ann_key(k_bucket * 4)),
                     family="ivfpq_search",
+                    # generation-free family for the wait auto-tuner: a
+                    # rebuild/refresh must not reset the learned window
+                    tune_key=("ivfpq", id(self.mapper_service),
+                              node.field, k_bucket),
                 )
                 a_vals, a_ids = out.value
                 # the batch leader may have run a LARGER k bucket: the
@@ -350,10 +354,14 @@ class ShardContext:
                     # shards=1: this is the per-shard fallback path (the
                     # shard-mesh launch in service.py passes its mesh
                     # width); the batcher's cross-shard stats stay honest
-                    out = batcher_mod.dispatch(key, qv[0], launch_streaming,
-                                               shards=1, rank=k_bucket,
-                                               alt_keys=alt_keys,
-                                               family="knn_topk_streaming")
+                    out = batcher_mod.dispatch(
+                        key, qv[0], launch_streaming,
+                        shards=1, rank=k_bucket,
+                        alt_keys=alt_keys,
+                        family="knn_topk_streaming",
+                        tune_key=("knn_topk_streaming",
+                                  id(self.mapper_service), node.field,
+                                  k_bucket))
                     vals, ids = out.value
                     if prof is not None:
                         # a batched operator owns its SHARE of the fenced
@@ -386,9 +394,11 @@ class ShardContext:
                             [b_scores[i] for i in range(len(rows))], retraced,
                         )
 
-                    out = batcher_mod.dispatch(key, qv[0], launch_exact,
-                                               shards=1,
-                                               family="knn_exact_scores")
+                    out = batcher_mod.dispatch(
+                        key, qv[0], launch_exact, shards=1,
+                        family="knn_exact_scores",
+                        tune_key=("knn_exact_scores",
+                                  id(self.mapper_service), node.field))
                     scores = out.value
                     if prof is not None:
                         prof.record_kernel(

@@ -1146,8 +1146,11 @@ def _try_distributed_query_phase(
                 for i in range(len(nodes_batch))
             ], out_b.retraced
 
-        outcome = batcher_mod.dispatch(key, node, launch,
-                                       shards=len(shards))
+        outcome = batcher_mod.dispatch(
+            key, node, launch, shards=len(shards),
+            # generation-free family for the wait auto-tuner
+            tune_key=("distributed_knn", shards[0].shard_id.index,
+                      node.field, int(node.k)))
         if outcome.value is None:
             return None
         results, premerged, launch_info = outcome.value

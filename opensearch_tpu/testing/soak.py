@@ -101,6 +101,10 @@ class SoakConfig:
     chaos: bool = True
     # which cycle runs the wlm bulk-flood scenario (-1 disables)
     flood_cycle: int = 1
+    # ISSUE 11 tail scenario: EVERY cycle runs background bulk+msearch
+    # flood pressure, with interactive probes whose virtual-time latency
+    # the interactive-p99-floor invariant ratchets per cycle
+    flood_all: bool = False
     # test hook: deterministically corrupt one copy mid-run so the
     # no-acked-write-loss invariant MUST fire (replay regression tests)
     inject_acked_write_loss: bool = False
@@ -359,6 +363,50 @@ class ClusterConverges(Invariant):
                              f"{r.node_id} but no local shard exists")
 
 
+class InteractiveP99Floor(Invariant):
+    """Tail slice (ISSUE 11): interactive queries issued under background
+    bulk+msearch flood pressure must not just COMPLETE — their
+    virtual-time latency must hold a per-cycle RATCHET. The first flood
+    cycle's p99 sets the baseline; every later cycle's p99 must stay
+    within the ratchet band (baseline-relative with an absolute floor so
+    a fast baseline doesn't make noise a failure). Latencies are pure
+    virtual time, so a violation replays byte-identically."""
+
+    name = "interactive-p99-floor"
+
+    # a later cycle may be at most this multiple of the baseline p99
+    # (with the absolute floor below); the workload is seeded, so any
+    # drift past the band is a scheduling regression, not noise
+    RATCHET_FACTOR = 3.0
+    FLOOR_MS = 2_000
+
+    @staticmethod
+    def _p99(samples: list[int]) -> int:
+        ordered = sorted(samples)
+        return ordered[min(len(ordered) - 1, int(len(ordered) * 0.99))]
+
+    def __init__(self) -> None:
+        self.baseline_p99: int | None = None
+
+    def at_quiesce(self, h: "SoakHarness") -> None:
+        samples = h.interactive_latencies.get(h.cycle) or []
+        if not samples:
+            return
+        p99 = self._p99(samples)
+        h.log_event("interactive_p99", cycle=h.cycle, p99_ms=p99,
+                    n=len(samples))
+        if self.baseline_p99 is None:
+            self.baseline_p99 = p99
+            return
+        bound = max(int(self.baseline_p99 * self.RATCHET_FACTOR),
+                    self.FLOOR_MS)
+        if p99 > bound:
+            h.fail(self, f"interactive p99 ratchet broken in cycle "
+                         f"{h.cycle}: {p99}ms > bound {bound}ms "
+                         f"(baseline {self.baseline_p99}ms, "
+                         f"{len(samples)} samples)")
+
+
 class InteractiveUnderFlood(Invariant):
     """wlm slice: the flood group's bulks shed 429 at its slot share while
     every interactive query issued during the flood completes."""
@@ -366,7 +414,8 @@ class InteractiveUnderFlood(Invariant):
     name = "interactive-under-flood"
 
     def at_quiesce(self, h: "SoakHarness") -> None:
-        if h.cycle != h.cfg.flood_cycle or not h.flood_stats["bulks"]:
+        flood_cycle = (h.cycle == h.cfg.flood_cycle or h.cfg.flood_all)
+        if not flood_cycle or not h.flood_stats["bulks"]:
             return
         if h.flood_stats["sheds"] == 0:
             h.fail(self, f"bulk flood past the group share never shed: "
@@ -514,7 +563,7 @@ class DeviceLedgerBounded(Invariant):
 DEFAULT_INVARIANTS: tuple[Callable[[], Invariant], ...] = (
     AckedWritesSurvive, SnapshotIsolation, RecoveryMonotonicity,
     ShedCorrectness, BoundedQueues, ClusterConverges, InteractiveUnderFlood,
-    TelemetryBounded, DeviceLedgerBounded,
+    InteractiveP99Floor, TelemetryBounded, DeviceLedgerBounded,
 )
 
 
@@ -789,7 +838,10 @@ class SoakHarness:
         # scroll/PIT contexts the workload currently holds open
         self._open_contexts: dict[int, dict[str, str]] = {}
         self.flood_stats = {"bulks": 0, "sheds": 0, "interactive": 0,
-                            "interactive_ok": 0}
+                            "interactive_ok": 0, "msearches": 0}
+        # per-cycle VIRTUAL-time latencies of interactive probes (the
+        # interactive-p99-floor invariant's ratchet input)
+        self.interactive_latencies: dict[int, list[int]] = {}
         self._probe_timer: Any = None
 
     # -- plumbing ----------------------------------------------------------
@@ -1032,6 +1084,17 @@ class SoakHarness:
                           "bulks": [[self._next_doc("logs")
                                      for _ in range(3)]
                                     for _ in range(8)]})
+            # background msearch pressure alongside the bulk flood (the
+            # ISSUE 11 tail scenario: BOTH background kinds push on the
+            # serving tier while the interactive probes run)
+            plans.append({
+                "kind": "msearch_flood", "offset": at + 20,
+                "via": self.wrng.choice(self.node_ids), "index": "vec",
+                "bursts": 4,
+                "bodies": [
+                    {"query": {"knn": {"x": {"vector": self._vec(),
+                                             "k": 4}}}, "size": 4}
+                    for _ in range(3)]})
             for j in range(4):
                 plans.append({
                     "kind": "search_match", "offset": at + 40 * (j + 1),
@@ -1039,6 +1102,15 @@ class SoakHarness:
                     "index": "logs", "interactive": True,
                     "body": {"query": {"match": {"msg": "hello"}},
                              "size": 5}})
+            # interactive kNN probes ride the flood too: the tail lever
+            # under test is the QUERY path, lanes + batcher included
+            for j in range(2):
+                plans.append({
+                    "kind": "search_knn", "offset": at + 60 * (j + 1),
+                    "via": self.wrng.choice(self.node_ids),
+                    "index": "vec", "interactive": True,
+                    "body": {"query": {"knn": {"x": {
+                        "vector": self._vec(), "k": 5}}}, "size": 5}})
         plans.sort(key=lambda p: p["offset"])
         return plans
 
@@ -1048,7 +1120,8 @@ class SoakHarness:
         its adversarial condition, and the interactive-under-flood
         invariant needs clean-network determinism (a partitioned search
         failing is degradation, not starvation)."""
-        if not self.cfg.chaos or self.cycle == self.cfg.flood_cycle:
+        if not self.cfg.chaos or self.cycle == self.cfg.flood_cycle \
+                or self.cfg.flood_all:
             return []
         out = []
         t = self.frng.randint(1_500, 3_000)
@@ -1071,6 +1144,8 @@ class SoakHarness:
         op["i"] = len(self.ops)
         op["completions"] = 0
         self.ops.append(op)
+        op["issued_ms"] = self.queue.now_ms
+        op["cycle"] = self.cycle
         self.report.ops_issued += 1
         self.log_event("issue", i=op["i"], kind=op["kind"],
                        index=op.get("index"), via=op["via"])
@@ -1102,6 +1177,12 @@ class SoakHarness:
         if op.get("interactive") and "hits" in resp and \
                 not resp["_shards"]["failed"]:
             self.flood_stats["interactive_ok"] += 1
+        if op.get("interactive"):
+            # virtual-time latency of the interactive probe, per issuing
+            # cycle (the p99-floor ratchet's input; pure function of seed)
+            self.interactive_latencies.setdefault(
+                op.get("cycle", self.cycle), []).append(
+                max(0, self.queue.now_ms - op["issued_ms"]))
 
     @staticmethod
     def _outcome_digest(op: dict, resp: dict) -> dict:
@@ -1261,6 +1342,25 @@ class SoakHarness:
                 operations.append(
                     ("index", {"_index": "logs", "_id": doc_id}, src))
             node.bulk(operations, one_done, query_group="flood")
+
+    def _issue_msearch_flood(self, op: dict) -> None:
+        """Background msearch pressure riding the flood window: `bursts`
+        concurrent msearch fan-outs (the background lane's traffic) while
+        the interactive probes run. Completes exactly once when every
+        burst answered; sub-responses feed no hit invariants (they race
+        the flood's writes by design)."""
+        pending = [op["bursts"]]
+
+        def one_done(_resp: dict) -> None:
+            self.flood_stats["msearches"] += 1
+            pending[0] -= 1
+            if pending[0] == 0:
+                self._complete(op, {"responses": [],
+                                    "flood": dict(self.flood_stats)})
+
+        for _ in range(op["bursts"]):
+            self.client.msearch(op["via"], op["index"], op["bodies"],
+                                one_done)
 
     def _issue_refresh(self, op: dict) -> None:
         self.nodes[op["via"]].refresh(op["index"],
@@ -1511,7 +1611,7 @@ class SoakHarness:
             self.call(self.nodes["n0"].refresh, index)
         self.run_ms(2_000)
         # wlm flood group (enforced, tiny share -> ~3 bulk slots of 64)
-        if self.cfg.flood_cycle >= 0:
+        if self.cfg.flood_cycle >= 0 or self.cfg.flood_all:
             for node in self.nodes.values():
                 node.query_groups.put({
                     "name": "flood", "resiliency_mode": "enforced",
@@ -1521,7 +1621,7 @@ class SoakHarness:
     def run_cycle(self, cycle: int) -> None:
         self.cycle = cycle
         self.log_event("cycle_start", cycle=cycle)
-        flood = cycle == self.cfg.flood_cycle
+        flood = cycle == self.cfg.flood_cycle or self.cfg.flood_all
         plans = self._plan_cycle_ops(flood)
         faults = self._plan_cycle_faults()
         base = self.queue.now_ms
@@ -1617,6 +1717,7 @@ class SoakHarness:
 def run_soak(seed: int, tmp_path, *, cycles: int = 3, nodes: int = 3,
              ops_per_cycle: int = 30, cycle_ms: int = 20_000,
              chaos: bool = True, flood_cycle: int = 1,
+             flood_all: bool = False,
              inject_acked_write_loss: bool = False,
              extra_invariants: tuple = ()) -> SoakReport:
     """Run the soak; returns the SoakReport, raises SoakFailure (seed and
@@ -1626,6 +1727,7 @@ def run_soak(seed: int, tmp_path, *, cycles: int = 3, nodes: int = 3,
     cfg = SoakConfig(seed=seed, cycles=cycles, nodes=nodes,
                      ops_per_cycle=ops_per_cycle, cycle_ms=cycle_ms,
                      chaos=chaos, flood_cycle=flood_cycle,
+                     flood_all=flood_all,
                      inject_acked_write_loss=inject_acked_write_loss)
     harness = SoakHarness(cfg, Path(tmp_path))
     for inv in extra_invariants:

@@ -35,6 +35,13 @@ TOTAL_SEARCH_PERMITS = 64
 # before it can starve interactive search traffic.
 TOTAL_BULK_SLOTS = 64
 
+# search admission budget (the bulk twin, ISSUE 11): in-flight SEARCH
+# requests a group may hold open across the cluster fan-out. Shares carve
+# by the group's cpu (else memory) limit; enforced groups shed 429 BEFORE
+# the coordinator fans out — a tagged search flood burns no transport/
+# device work past its share.
+TOTAL_SEARCH_SLOTS = 64
+
 
 class QueryGroupService:
     """Query group registry + per-group admission control."""
@@ -46,9 +53,10 @@ class QueryGroupService:
         if self._file.exists():
             self.groups = json.loads(self._file.read_text())
         self._in_flight: dict[str, int] = {}
-        # per-group bulk slot budgets (QueuePressure), built lazily for
-        # enforced groups — see admit_bulk
+        # per-group bulk/search slot budgets (QueuePressure), built lazily
+        # for enforced groups — see admit_bulk / admit_search
         self._bulk_pressure: dict[str, Any] = {}
+        self._search_pressure: dict[str, Any] = {}
         # lifetime counters per group (WlmStats.WorkloadGroupStats);
         # untagged requests account to the default group like the reference
         self._totals: dict[str, dict[str, int]] = {}
@@ -145,17 +153,25 @@ class QueryGroupService:
                     f"no query group exists with name [{name}]"
                 )
             del self.groups[gid]
-            # the slot budget dies with the group — a re-created group
+            # the slot budgets die with the group — a re-created group
             # gets a fresh _id, so a kept entry would be an unbounded
-            # ghost in bulk_stats (TPU009's bound-or-evict contract)
+            # ghost in bulk_stats/search_slot_stats (TPU009's
+            # bound-or-evict contract)
             self._bulk_pressure.pop(gid, None)
+            self._search_pressure.pop(gid, None)
             self._save()
         return {"acknowledged": True}
 
     # -- admission (QueryGroupService.rejectIfNeeded) ----------------------
 
     def admit(self, group_id: str | None):
-        """Context manager guarding one search on behalf of `group_id`."""
+        """Context manager guarding one search on behalf of `group_id` —
+        the SINGLE-NODE in-process concurrency check (TpuNode.search):
+        in-flight count against the cpu share of TOTAL_SEARCH_PERMITS.
+        The cluster fan-out path uses :meth:`admit_search` instead (the
+        QueuePressure slot budget taken BEFORE any transport work); the
+        two guard different execution models by design and keep separate
+        books — see admit_search's docstring."""
         return _Admission(self, group_id)
 
     # -- bulk admission (QueuePressure-backed slot budget) ------------------
@@ -215,6 +231,72 @@ class QueryGroupService:
     def bulk_stats(self) -> dict:
         with self._lock:
             pressures = dict(self._bulk_pressure)
+        return {
+            gid: p.stats() for gid, p in pressures.items()
+        }
+
+    # -- search admission (QueuePressure-backed slot budget, ISSUE 11) ------
+
+    def _search_pressure_for(self, group: dict):
+        """Lazily build (and resize on limit change) the group's search
+        slot budget — the bulk twin, carved by the cpu (else memory)
+        share. Only `enforced` groups shed; soft/monitor run
+        unconstrained."""
+        from opensearch_tpu.index.pressure import QueuePressure
+
+        limits = group.get("resource_limits") or {}
+        share = limits.get("cpu", limits.get("memory"))
+        if group.get("resiliency_mode") != "enforced" or share is None:
+            return None
+        slots = max(1, int(TOTAL_SEARCH_SLOTS * float(share)))
+        with self._lock:
+            p = self._search_pressure.get(group["_id"])
+            if p is None:
+                p = self._search_pressure[group["_id"]] = QueuePressure(
+                    slots, operation=f"search [{group['name']}]"
+                )
+            elif p.limit != slots:
+                p.set_limit(slots)
+        return p
+
+    def admit_search(self, group_id: str | None) -> "Callable[[], None]":
+        """Admit one search on behalf of `group_id` BEFORE the coordinator
+        fans out; returns the release callable (idempotent — completion
+        paths may overlap under degradation). Raises
+        RejectedExecutionException (HTTP 429) past the group's slot share:
+        the caller must shed, never queue.
+
+        This is the CLUSTER-path guard (ClusterNode.search / facade) —
+        a slot covers the whole distributed operation including its
+        transport legs, so it must be a held-until-callback budget, not
+        the with-statement concurrency check :meth:`admit` applies on the
+        single-node synchronous path. Shares deliberately resolve
+        cpu-else-memory (search is compute-shaped) where the bulk twin
+        resolves memory-else-cpu; rejections from either book land in
+        the group's total_rejections tally."""
+        group = self._resolve(group_id) if group_id else None
+        if group is None:
+            return lambda: None
+        pressure = self._search_pressure_for(group)
+        if pressure is None:
+            return lambda: None
+        try:
+            pressure.acquire()
+        except RejectedExecutionException:
+            self._tally(group["_id"], "total_rejections")
+            raise
+        released = [False]
+
+        def release() -> None:
+            if not released[0]:
+                released[0] = True
+                pressure.release()
+
+        return release
+
+    def search_slot_stats(self) -> dict:
+        with self._lock:
+            pressures = dict(self._search_pressure)
         return {
             gid: p.stats() for gid, p in pressures.items()
         }

@@ -11,9 +11,15 @@
 #                               # cluster bench to BENCH_MESH.json the same
 #                               # way, and bench.py --ann-gate holds the
 #                               # batched IVF-PQ path to BENCH_ANN.json plus
-#                               # the recall@10 >= 0.95 ratchet, so a PR that
-#                               # slows a hot path (or buys speed with
-#                               # recall) fails HERE, not in the next
+#                               # the recall@10 >= 0.95 ratchet, and
+#                               # bench.py --tail-gate asserts the tail
+#                               # control plane (lanes + wait auto-tuner +
+#                               # residency routing) still buys >= 1.5x
+#                               # interactive p99 under mixed flood at no
+#                               # aggregate-QPS cost with zero interactive
+#                               # sheds, so a PR that slows a hot path (or
+#                               # buys speed with recall, or regresses the
+#                               # tail) fails HERE, not in the next
 #                               # round's headline
 #
 # The lint gate runs three ways on purpose:
@@ -55,6 +61,8 @@ if [[ "${1:-}" == "--bench" ]]; then
   python bench.py --otel-overhead
   echo "== ANN gate (recall@10 >= 0.95 ratchet + batched >= 1.3x + QPS floor) =="
   python bench.py --ann-gate
+  echo "== tail gate (interactive p99 >= 1.5x better with lanes+tuner+routing on, no aggregate-QPS regression, zero interactive sheds) =="
+  python bench.py --tail-gate
   # every gate child already asserts the device-ledger identity before
   # printing its result; this step proves it once more in THIS process
   # over a full publish/merge/delete cycle (ISSUE 10 acceptance)

@@ -118,8 +118,26 @@ def test_flood_cycle_sheds_while_interactive_completes(tmp_path):
                       flood_cycle=0)
     assert report.flood["bulks"] == 8
     assert report.flood["sheds"] >= 1
-    assert report.flood["interactive"] == 4
-    assert report.flood["interactive_ok"] == 4
+    # 4 match probes + 2 interactive kNN probes ride the flood (ISSUE 11)
+    assert report.flood["interactive"] == 6
+    assert report.flood["interactive_ok"] == 6
+    assert report.flood["msearches"] > 0
+
+
+def test_tail_flood_seed_holds_interactive_p99_floor(tmp_path):
+    """ISSUE 11 satellite: a flood seed where background bulk+msearch
+    pressure runs EVERY cycle of the soak. Interactive probes issued
+    during the floods must complete un-starved (interactive-under-flood)
+    AND hold the per-cycle p99 latency ratchet (interactive-p99-floor) —
+    completion alone is no longer the bar."""
+    report = run_soak(29, tmp_path, cycles=3, ops_per_cycle=14,
+                      chaos=False, flood_all=True)
+    assert report.cycles_completed == 3
+    assert report.flood["bulks"] > 0 and report.flood["sheds"] > 0
+    assert report.flood["msearches"] > 0, \
+        "background msearch pressure never ran"
+    assert report.flood["interactive"] >= 3 * 6
+    assert report.flood["interactive_ok"] == report.flood["interactive"]
 
 
 def test_extra_invariant_hooks_fire(tmp_path):
