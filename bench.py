@@ -1503,6 +1503,164 @@ def tail_child() -> None:
     }))
 
 
+ROOFLINE_OUT = Path(__file__).resolve().parent / "BENCH_ROOFLINE.json"
+ROOFLINE_BUDGET_S = int(os.environ.get("BENCH_ROOFLINE_BUDGET_S", "600"))
+
+
+def roofline_parent() -> int:
+    """`bench.py --roofline`: run the exact-streaming, materializing,
+    mesh, and ANN (all three adc precisions) serving workloads plus a
+    profiled BM25 scan in a watchdogged child, record every family's
+    achieved FLOP/s + roofline fraction to BENCH_ROOFLINE.json, and FAIL
+    unless the sanity gate holds (fractions in (0, 1], all expected
+    families modeled, `accounted_flops == Σ per-family model FLOPs`).
+    check.sh --bench runs this as the roofline gate."""
+    platform = _detect_platform()
+    result, reason = _run(["--roofline-child"], ROOFLINE_BUDGET_S,
+                          platform_env="cpu" if platform == "cpu" else None)
+    if result is None:
+        print(json.dumps({
+            "metric": "bench_error", "value": 0, "unit": "error",
+            "vs_baseline": 0, "detail": f"roofline child failed: {reason}",
+        }))
+        return 1
+    book = _load_book(ROOFLINE_OUT)
+    book[result.get("platform", "cpu")] = result
+    try:
+        ROOFLINE_OUT.write_text(json.dumps(book, indent=1) + "\n")
+    except OSError as e:
+        result["write_error"] = str(e)
+    print(json.dumps(result))
+    return 0
+
+
+def roofline_child() -> None:
+    """One node, every kernel family the registry models, measured
+    through the REAL search API: filtered kNN over a small column
+    (materializing exact scan) and a streaming-sized column (chunked
+    streaming scan), bare kNN over a 2-shard index (the mesh program),
+    IVF-PQ at each adc precision, and a profiled BM25 match. Asserts the
+    roofline sanity gate before printing."""
+    import tempfile
+
+    _pin_platform()
+    import numpy as np
+
+    import jax
+
+    from opensearch_tpu.node import TpuNode
+    from opensearch_tpu.search import ann as ann_mod
+    from opensearch_tpu.search import executor as executor_mod
+    from opensearch_tpu.telemetry import roofline
+
+    platform = jax.devices()[0].platform
+    reps = int(os.environ.get("BENCH_ROOFLINE_QUERIES", "12"))
+    d = 64
+    rng = np.random.default_rng(31)
+
+    peaks = roofline.calibrate(force=True)
+    roofline.default_recorder.reset()
+
+    # the streaming scan engages at this (lowered) corpus size so the
+    # bench stays quick; the cost model is size-agnostic
+    executor_mod.STREAMING_MIN_DOCS = 1024
+
+    tmp = Path(tempfile.mkdtemp(prefix="bench_roofline_"))
+    node = TpuNode(tmp / "node")
+
+    def vec_index(name, n_docs, shards=1, method=None):
+        mapping: dict = {"type": "knn_vector", "dimension": d}
+        if method is not None:
+            mapping["method"] = method
+        node.create_index(name, {
+            "settings": {"number_of_shards": shards},
+            "mappings": {"properties": {
+                "v": mapping, "g": {"type": "integer"}}},
+        })
+        data = rng.standard_normal((n_docs, d)).astype(np.float32)
+        node.bulk([
+            ("index", {"_index": name, "_id": str(i)},
+             {"v": data[i].round(4).tolist(), "g": i % 2})
+            for i in range(n_docs)
+        ], refresh=True)
+
+    vec_index("exact", 512)          # < streaming floor: materializing
+    vec_index("stream", 2048)        # >= streaming floor: chunked scan
+    vec_index("mesh2", 512, shards=2)
+    vec_index("annv", 2048, method={
+        "name": "ivf_pq", "parameters": {"nlist": 16, "m": 8, "nprobe": 4}})
+    node.create_index("lex", {"mappings": {"properties": {
+        "msg": {"type": "text"}}}})
+    node.bulk([
+        ("index", {"_index": "lex", "_id": str(i)},
+         {"msg": f"common token w{i} w{i % 7}"})
+        for i in range(256)
+    ], refresh=True)
+
+    def run_queries(index):
+        for _ in range(reps):
+            q = rng.standard_normal(d).astype(np.float32).round(4).tolist()
+            node.search(index, {"size": 5, "query": {
+                "knn": {"v": {"vector": q, "k": 5}}}})
+
+    # per-shard scan families: the mesh serves every bare (and filtered)
+    # exact body since PR 7, so the ops kill switch is what exposes the
+    # materializing + streaming executor launches to measurement
+    from opensearch_tpu.search import distributed_serving
+
+    distributed_serving.enabled = False
+    try:
+        run_queries("exact")               # knn_exact_scores
+        run_queries("stream")              # knn_topk_streaming
+    finally:
+        distributed_serving.enabled = True
+    run_queries("mesh2")                   # mesh_knn
+    for precision in ("fp32", "bf16", "int8"):
+        ann_mod.default_config.configure(adc_precision=precision)
+        run_queries("annv")                # ivfpq_search[precision]
+    ann_mod.default_config.configure(adc_precision="fp32")
+    for _ in range(reps):
+        node.search("lex", {"query": {"match": {"msg": "common"}},
+                            "profile": True})  # bm25_term_scores
+
+    report = roofline.default_recorder.report()
+    families = {row["family"]: row for row in report["families"]}
+
+    # --- sanity gate -------------------------------------------------------
+    expected = {"knn_exact_scores", "knn_topk_streaming", "mesh_knn",
+                "bm25_term_scores", "ivfpq_search[fp32]",
+                "ivfpq_search[bf16]", "ivfpq_search[int8]"}
+    missing = expected - set(families)
+    assert not missing, f"families missing from the report: {missing}"
+    bad = {name: row["roofline_fraction"] for name, row in families.items()
+           if not (0.0 < row["roofline_fraction"] <= 1.0)}
+    assert not bad, f"roofline fractions outside (0, 1]: {bad}"
+    assert report["identity_ok"], "accounted_flops != sum of family FLOPs"
+    counters = report["counters"]
+    assert counters["unmodeled_launches"] == 0, (
+        f"unmodeled launches: {counters['unmodeled_launches']}")
+    _assert_ledger_identity()
+    node.close()
+
+    print(json.dumps({
+        "metric": "roofline_families",
+        "value": len(families),
+        "unit": "modeled kernel families",
+        "vs_baseline": 1.0,
+        "platform": platform,
+        "peaks": peaks.to_dict(),
+        "top_offender": report["top_offender"],
+        "identity_ok": report["identity_ok"],
+        "families": {
+            name: {k: row[k] for k in (
+                "launches", "achieved_gflops", "ewma_gflops", "intensity",
+                "roofline_fraction", "bound", "lost_ms")}
+            for name, row in families.items()
+        },
+        "ok": True,
+    }))
+
+
 def _pin_platform():
     import jax
 
@@ -1737,6 +1895,18 @@ if __name__ == "__main__":
         sys.exit(tail_gate_parent())
     if "--tail" in sys.argv:
         sys.exit(tail_parent())
+    if "--roofline-child" in sys.argv:
+        try:
+            roofline_child()
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "metric": "bench_error", "value": 0, "unit": "error",
+                "vs_baseline": 0, "detail": str(e)[:200],
+            }))
+            sys.exit(1)
+        sys.exit(0)
+    if "--roofline" in sys.argv:
+        sys.exit(roofline_parent())
     if "--otel-overhead" in sys.argv:
         sys.exit(otel_parent())
     if "--gate" in sys.argv:

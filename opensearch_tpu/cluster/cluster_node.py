@@ -148,6 +148,15 @@ class ClusterNode:
         self.settings_consumers.register(
             "search.knn.batch.", self.knn_batcher.apply_settings
         )
+        # roofline recorder: process-wide like the batcher; this node
+        # becomes its fallback metrics sink (active_metrics() still wins
+        # per request, so in-process sims attribute per executing node).
+        # Peaks calibrate at boot (cached per platform; a sim's stub
+        # wins) — never lazily inside a stats poll.
+        from opensearch_tpu.telemetry import roofline as _roofline_mod
+
+        _roofline_mod.default_recorder.metrics = self.telemetry.metrics
+        _roofline_mod.ensure_peaks()
         # ANN serving knobs (search/ann.py): process-wide like the batcher,
         # applied live the same way
         from opensearch_tpu.search import ann as _ann_mod
@@ -2749,6 +2758,14 @@ class ClusterNode:
                 resp["device_totals"] = _ledger.device_totals()
             if want("tail"):
                 resp["tail"] = self.tail_stats()
+            if want("roofline"):
+                # kernel roofline accounting (telemetry/roofline.py):
+                # per-family achieved FLOP/s + roofline fractions against
+                # the calibrated peaks. Process-wide — in-process sim
+                # nodes report the shared recorder, like the ledger.
+                from opensearch_tpu.telemetry import roofline
+
+                resp["roofline"] = roofline.stats_section()
             if want("providers"):
                 for name, provider in list(self.stats_providers.items()):
                     try:

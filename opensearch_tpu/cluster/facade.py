@@ -1252,7 +1252,7 @@ class ClusterFacade:
         if metrics and "_all" not in metrics:
             section_of = {"telemetry": "spans", "knn_batch": "knn_batch",
                           "indices": "providers", "device": "device",
-                          "tail": "tail"}
+                          "tail": "tail", "roofline": "roofline"}
             payload["sections"] = sorted(
                 {section_of[m] for m in metrics if m in section_of})
         nodes = sorted(self.state.nodes)
@@ -1274,6 +1274,7 @@ class ClusterFacade:
                 "shard_mesh": r.get("shard_mesh", {}),
                 "device": r.get("device", {}),
                 "tail": r.get("tail", {}),
+                "roofline": r.get("roofline", {}),
                 "indices": {
                     "request_cache": r.get("request_cache", {}),
                 },
@@ -1296,7 +1297,8 @@ class ClusterFacade:
         nodes = sorted(self.state.nodes)
         results = self._rpc_many([
             (nid, "indices:monitor/stats[node]",
-             {"full": True, "sections": ["metrics", "device_totals"]})
+             {"full": True,
+              "sections": ["metrics", "device_totals", "roofline"]})
             for nid in nodes
         ])
         out: dict[str, dict] = {}
@@ -1308,7 +1310,10 @@ class ClusterFacade:
                         "histograms": tel.get("histograms", {}),
                         # per-device resident-byte totals: the federated
                         # exposition renders them as labeled gauges
-                        "device": r.get("device_totals", {})}
+                        "device": r.get("device_totals", {}),
+                        # per-family roofline fractions/FLOP/s, rendered
+                        # as {family=,node=}-labeled gauges
+                        "roofline": r.get("roofline", {})}
         return out
 
     def cluster_otel_flush(self) -> dict:

@@ -2047,6 +2047,82 @@ class NakedDevicePutChecker(Checker):
         return out
 
 
+# ---------------------------------------------------------------------------
+# TPU015 — unmodeled-kernel (launch sites must have a roofline cost model)
+# ---------------------------------------------------------------------------
+
+_ROOFLINE_FAMILIES: frozenset | None = None
+
+
+def _roofline_families() -> frozenset:
+    """The registered cost-model families (telemetry/roofline.py). Loaded
+    lazily ONCE per process: the module is import-light (no jax at import
+    time), and reading the real registry keeps this rule incapable of
+    drifting from it — a family registered there is known here."""
+    global _ROOFLINE_FAMILIES
+    if _ROOFLINE_FAMILIES is None:
+        from opensearch_tpu.telemetry.roofline import KNOWN_FAMILIES
+
+        _ROOFLINE_FAMILIES = KNOWN_FAMILIES
+    return _ROOFLINE_FAMILIES
+
+
+class UnmodeledKernelChecker(Checker):
+    """TPU015: a ``profiled_kernel("name")``-decorated entry point, or a
+    batcher ``dispatch(..., family="name")`` site, whose family has NO
+    registered roofline cost model (telemetry/roofline.py COST_MODELS) is
+    a kernel the roofline report cannot place: its launches count only as
+    ``unmodeled_launches`` and every "what would a rewrite buy" ranking
+    silently omits it. New kernels arrive WITH their FLOP/byte model (or
+    a suppression where modeling is genuinely out of scope). Families may
+    carry a ``[variant]`` suffix (``ivfpq_search[int8]``) — the base name
+    is what must be registered. Non-constant family expressions are out
+    of static reach and not flagged."""
+
+    rule_id = "TPU015"
+    name = "unmodeled-kernel"
+    description = ("profiled_kernel / dispatch(family=...) sites must "
+                   "name a registered roofline cost model")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return _device_scoped(display_path, source)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        from opensearch_tpu.telemetry.roofline import base_family
+
+        known = _roofline_families()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            family = None
+            if (name == "profiled_kernel"
+                    or name.endswith(".profiled_kernel")):
+                if (node.args and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    family = node.args[0].value
+            elif name == "dispatch" or name.endswith(".dispatch"):
+                for kw in node.keywords:
+                    if (kw.arg == "family"
+                            and isinstance(kw.value, ast.Constant)
+                            and isinstance(kw.value.value, str)):
+                        family = kw.value.value
+                        break
+            if family is None:
+                continue
+            if base_family(family) not in known:
+                out.append(ctx.violation(
+                    "TPU015", node,
+                    f"kernel family [{family}] has no registered roofline "
+                    f"cost model: add it to telemetry/roofline.py "
+                    f"COST_MODELS so the roofline report can place its "
+                    f"launches"))
+        return out
+
+
 ALL_CHECKERS: list[Checker] = [
     JitPurityChecker(),
     BlockingInAsyncChecker(),
@@ -2062,6 +2138,7 @@ ALL_CHECKERS: list[Checker] = [
     SpanLeakChecker(),
     MetricHygieneChecker(),
     NakedDevicePutChecker(),
+    UnmodeledKernelChecker(),
 ]
 
 RULES: dict[str, Checker] = {c.rule_id: c for c in ALL_CHECKERS}

@@ -405,6 +405,17 @@ class TpuNode:
 
         self.knn_batcher = _batcher_mod.default_batcher
         self.knn_batcher.metrics = self.telemetry.metrics
+        # roofline recorder (telemetry/roofline.py): process-wide like the
+        # batcher; this node is its fallback metrics sink (active_metrics()
+        # still attributes per executing request scope). Peaks calibrate
+        # HERE, at boot (cached per platform; a stub installed earlier
+        # wins) — never lazily inside a stats poll or Prometheus scrape,
+        # where the one-shot microbenchmark would block the monitoring
+        # path and measure a contended ceiling.
+        from opensearch_tpu.telemetry import roofline as _roofline_mod
+
+        _roofline_mod.default_recorder.metrics = self.telemetry.metrics
+        _roofline_mod.ensure_peaks()
         # priority-lane bookkeeping (search/lanes.py): the HTTP server
         # submits/sheds against this tracker so the `tail` stats section
         # (and the bench) can read lane depths off the node handle

@@ -306,6 +306,10 @@ def build_router() -> Router:
     # span-export admin: flush every node's exporter, return exporter
     # ledgers + device-memory residency snapshots
     reg("POST", "/_otel/flush", otel_flush)
+    # kernel roofline report (telemetry/roofline.py): families ranked by
+    # lost time, plus the re-calibration button
+    reg("GET", "/_roofline", roofline_report)
+    reg("POST", "/_roofline/calibrate", roofline_calibrate)
     # tasks
     reg("GET", "/_tasks", list_tasks)
     reg("GET", "/_tasks/{task_id}", get_task)
@@ -1681,6 +1685,26 @@ def prometheus_metrics(node: TpuNode, params, query, body):
                 f"{m}{_prom_labels({'device': dev}, extra)} "
                 f"{_prom_fmt(totals[dev])}")
 
+    def roofline_gauges(section: dict, extra: dict | None) -> None:
+        # per-kernel-family roofline gauges (telemetry/roofline.py):
+        # achieved fraction of the calibrated roofline + achieved FLOP/s,
+        # labeled by family (federated scrapes add the node label)
+        fams = section.get("families") or {}
+        frac_m = "opensearch_tpu_roofline_fraction"
+        flops_m = "opensearch_tpu_roofline_achieved_flops"
+        if extra is None and fams:
+            lines.append(f"# TYPE {frac_m} gauge")
+            lines.append(f"# TYPE {flops_m} gauge")
+        for fam in sorted(fams):
+            row = fams[fam]
+            labels = _prom_labels({"family": fam}, extra)
+            lines.append(
+                f"{frac_m}{labels} "
+                f"{_prom_fmt(row['roofline_fraction'])}")
+            lines.append(
+                f"{flops_m}{labels} "
+                f"{_prom_fmt(row['achieved_gflops'] * 1e9)}")
+
     cluster_metrics = getattr(node, "cluster_metrics", None)
     federated = flag("cluster") and cluster_metrics is not None
     if federated:
@@ -1693,13 +1717,17 @@ def prometheus_metrics(node: TpuNode, params, query, body):
                 per_node[nid], {"node": nid}, declare_types=False,
                 want_exemplars=want_exemplars))
             device_gauges(per_node[nid].get("device", {}), {"node": nid})
+            roofline_gauges(per_node[nid].get("roofline", {}),
+                            {"node": nid})
     else:
         lines.extend(_prom_registry_lines(
             node.telemetry.metrics.stats(), None, declare_types=True,
             want_exemplars=want_exemplars))
+        from opensearch_tpu.telemetry import roofline
         from opensearch_tpu.telemetry.device_ledger import default_ledger
 
         device_gauges(default_ledger.device_totals(), None)
+        roofline_gauges(roofline.stats_section(), None)
     # task-manager liveness gauges ride along (cheap, always useful on a
     # scrape dashboard). They are LOCAL to the serving node: the federated
     # view labels them so scrapes of different nodes never emit the same
@@ -1744,6 +1772,29 @@ def otel_flush(node: TpuNode, params, query, body):
             "device": device_ledger.stats_section(),
         }},
     }
+
+
+def roofline_report(node: TpuNode, params, query, body):
+    """GET /_roofline — kernel families ranked by LOST TIME (cumulative
+    fenced wall × gap-to-roofline) against the calibrated platform peaks:
+    the literal priority list for kernel-rewrite work (ROADMAP item 2).
+    The recorder is process-wide (one process == one device set, the
+    batcher/ledger scope), so in-process sim nodes share one report; on a
+    TCP cluster each node answers for its own device set."""
+    from opensearch_tpu.telemetry import roofline
+
+    return 200, roofline.default_recorder.report()
+
+
+def roofline_calibrate(node: TpuNode, params, query, body):
+    """POST /_roofline/calibrate — re-run the one-shot matmul/memcpy
+    platform microbenchmark and swap the peak table every roofline
+    fraction divides by (an operator's answer to a bad first calibration
+    on a cold or contended box)."""
+    from opensearch_tpu.telemetry import roofline
+
+    peaks = roofline.calibrate(force=True)
+    return 200, {"acknowledged": True, "peaks": peaks.to_dict()}
 
 
 def get_task(node: TpuNode, params, query, body):
@@ -3056,7 +3107,7 @@ _NODES_STATS_METRICS = {
     "transport", "http", "breaker", "script", "discovery", "ingest",
     "adaptive_selection", "indexing_pressure", "search_backpressure",
     "shard_indexing_pressure", "tasks", "telemetry", "slowlog", "knn_batch",
-    "shard_mesh", "device", "tail",
+    "shard_mesh", "device", "tail", "roofline",
 }
 
 
@@ -3091,7 +3142,7 @@ def nodes_stats(node: TpuNode, params, query, body):
     import difflib
     import resource
 
-    from opensearch_tpu.telemetry import device_ledger
+    from opensearch_tpu.telemetry import device_ledger, roofline
 
     raw_metric = params.get("metric") or query.get("metric")
     metrics = ([m.strip() for m in str(raw_metric).split(",") if m.strip()]
@@ -3204,6 +3255,10 @@ def nodes_stats(node: TpuNode, params, query, body):
         # tail-latency control plane (ISSUE 11): lane queue depths + shed
         # counts, residency-routing decisions, wlm search-slot budgets
         "tail": _tail_section(node),
+        # kernel roofline accounting (telemetry/roofline.py): per-family
+        # achieved FLOP/s + bytes/s, arithmetic intensity, roofline
+        # fraction against the calibrated peaks, and the bound verdict
+        "roofline": roofline.stats_section(),
         "telemetry": {
             **node.telemetry.metrics.stats(),
             # the tail of the spans ring: one stitched trace tree per

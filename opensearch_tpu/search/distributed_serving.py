@@ -426,6 +426,14 @@ def mesh_knn_batch(
     wall_ns = time.perf_counter_ns() - t0
     launch_id = registry.next_launch_id()
     registry.record_launch_wall(wall_ns)
+    # roofline accounting: ONE sharded launch against the mesh cost model
+    # (per-slot scan + on-device all_gather/top_k merge)
+    from opensearch_tpu.telemetry import roofline
+
+    roofline.record_launch(
+        "mesh_knn", wall_ns, b=b_pad, s=s, n_flat=bundle.n_flat, d=dims,
+        k_shard=k_shard, devices=n_devices,
+    )
     from opensearch_tpu.telemetry.device_ledger import (
         KIND_QUERY_BATCH,
         default_ledger,

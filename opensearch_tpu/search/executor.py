@@ -52,6 +52,7 @@ from opensearch_tpu.index.segment import (
 from opensearch_tpu.ops import bm25, filters, knn
 from opensearch_tpu.search import profile
 from opensearch_tpu.search import query_dsl as q
+from opensearch_tpu.telemetry import roofline
 
 logger = logging.getLogger(__name__)
 
@@ -229,8 +230,13 @@ class ShardContext:
                     return ("ivfpq", id(vf), vf.ann.build_generation, gen,
                             kb, nprobe, sim, precision, mult)
 
+                rerank = ivfpq.default_rerank(k_bucket, mult)
+                rescore = ivfpq.rescore_pool(vf.ann, k_bucket, nprobe,
+                                             rerank)
+
                 def launch_ann(rows):
                     q_batch = _pad_query_batch(rows)
+                    t0 = time.perf_counter_ns()
                     with profile.profiling(None):
                         b_vals, b_ids = ivfpq.search_index(
                             vf.ann, vf.vectors, vf.norms_sq, valid,
@@ -242,6 +248,18 @@ class ShardContext:
                     # host materialization is the fence for this launch
                     b_vals = np.asarray(b_vals)
                     b_ids = np.asarray(b_ids)
+                    # roofline accounting: one fenced launch against the
+                    # IVF-PQ cost model, keyed per ADC precision so the
+                    # report can compare the lowerings (ANNS-AMP)
+                    roofline.record_launch(
+                        f"ivfpq_search[{precision}]",
+                        time.perf_counter_ns() - t0,
+                        b=int(q_batch.shape[0]),
+                        nlist=vf.ann.params.nlist, d=vf.ann.params.d,
+                        m=vf.ann.params.m, ks=vf.ann.params.ks,
+                        nprobe=nprobe, l_pad=vf.ann.l_pad,
+                        rescore=rescore, adc_precision=precision,
+                    )
                     retraced = profile.signature_retraced(
                         "ivfpq_search", (vf.vectors, q_batch),
                         (k_bucket, nprobe, precision, mult))
@@ -268,14 +286,12 @@ class ShardContext:
                 # scatter below accepts any row count, the shard cut
                 # truncates to node.k
                 if prof is not None:
-                    rerank = ivfpq.default_rerank(k_bucket, mult)
                     prof.record_kernel(
                         "ivfpq_search", out.kernel_share_ns,
                         int(qv.nbytes), out.retraced,
                         annotations={
                             "adc_precision": precision,
-                            "rescore_candidates": ivfpq.rescore_pool(
-                                vf.ann, k_bucket, nprobe, rerank),
+                            "rescore_candidates": rescore,
                             "nprobe": nprobe,
                         },
                     )
@@ -336,6 +352,7 @@ class ShardContext:
 
                     def launch_streaming(rows):
                         q_batch = _pad_query_batch(rows)
+                        t0 = time.perf_counter_ns()
                         with profile.profiling(None):
                             b_vals, b_ids = jfn(
                                 vf.vectors, vf.norms_sq, valid, q_batch
@@ -343,6 +360,13 @@ class ShardContext:
                         # host materialization is the fence for this launch
                         b_vals = np.asarray(b_vals)
                         b_ids = np.asarray(b_ids)
+                        roofline.record_launch(
+                            "knn_topk_streaming",
+                            time.perf_counter_ns() - t0,
+                            b=int(q_batch.shape[0]),
+                            n=int(vf.vectors.shape[0]),
+                            d=int(vf.vectors.shape[1]), k=k_bucket,
+                        )
                         retraced = profile.signature_retraced(
                             "knn_topk_streaming", (vf.vectors, q_batch),
                             (k_bucket, chunk))
@@ -383,11 +407,19 @@ class ShardContext:
 
                     def launch_exact(rows):
                         q_batch = _pad_query_batch(rows)
+                        t0 = time.perf_counter_ns()
                         with profile.profiling(None):
                             b_scores = np.asarray(knn_ops.exact_knn_scores(
                                 q_batch, vf.vectors, vf.norms_sq, valid,
                                 vf.similarity,
                             ))
+                        roofline.record_launch(
+                            "knn_exact_scores",
+                            time.perf_counter_ns() - t0,
+                            b=int(q_batch.shape[0]),
+                            n=int(vf.vectors.shape[0]),
+                            d=int(vf.vectors.shape[1]),
+                        )
                         retraced = profile.signature_retraced(
                             "knn_exact_scores", (vf.vectors, q_batch), (sim,))
                         return (

@@ -83,8 +83,11 @@ class OpProfile:
         # kernel name -> [calls, time_ns, transfer_bytes, retraces]
         self.kernels: dict[str, list] = {}
         # kernel name -> static launch configuration (e.g. the ANN path's
-        # adc_precision / rescore candidate pool); last write wins — the
-        # values are constant within one batch key
+        # adc_precision / rescore candidate pool); merged PER KEY — when a
+        # request's records disagree on a value (a live precision flip
+        # between segments, a coalesced mixed batch) the key keeps every
+        # distinct value as a list instead of silently reporting only the
+        # last writer's
         self.kernel_annotations: dict[str, dict] = {}
         self.children: list[OpProfile] = []
         self._child_index: dict[tuple[str, str], OpProfile] = {}
@@ -109,7 +112,16 @@ class OpProfile:
         cell[2] += transfer_bytes
         cell[3] += int(retraced)
         if annotations:
-            self.kernel_annotations[name] = dict(annotations)
+            merged = self.kernel_annotations.setdefault(name, {})
+            for key, value in annotations.items():
+                have = merged.get(key)
+                if key not in merged:
+                    merged[key] = value
+                elif isinstance(have, list):
+                    if value not in have:
+                        have.append(value)
+                elif have != value:
+                    merged[key] = [have, value]
 
     def to_dict(self) -> dict:
         # children's wall time is nested inside self.time_ns (inclusive),
@@ -136,9 +148,16 @@ class OpProfile:
             "retraced": self.retraced,
         }
         if self.kernels:
+            # roofline attribution per kernel row (telemetry/roofline.py):
+            # the family's EWMA achieved GFLOP/s, arithmetic intensity,
+            # fraction of the calibrated roofline, and the bound verdict —
+            # "profile": true answers "is this kernel worth rewriting"
+            from opensearch_tpu.telemetry.roofline import default_recorder
+
             out["kernels"] = [
                 {"name": name, "calls": c[0], "time_in_nanos": c[1],
                  "transfer_bytes": c[2], "retraces": c[3],
+                 **default_recorder.kernel_row_fields(name),
                  **(self.kernel_annotations.get(name) or {})}
                 for name, c in sorted(self.kernels.items())
             ]
@@ -421,6 +440,11 @@ def profiled_kernel(name: str) -> Callable:
             _block_until_ready(out)
             elapsed = time.perf_counter_ns() - t0
             prof.record_kernel(name, elapsed, transfer, retraced)
+            # roofline accounting: the fenced wall + the call's argument
+            # shapes are exactly what the family's cost model needs
+            from opensearch_tpu.telemetry import roofline
+
+            roofline.observe_kernel(name, args, kwargs, elapsed)
             if retraced:
                 # retrace oracle fired: one jit-cache entry for this kernel
                 # family in the device ledger's compile table (the first

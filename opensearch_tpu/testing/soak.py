@@ -560,10 +560,65 @@ class DeviceLedgerBounded(Invariant):
                          f"({len(leaked)} total): {rows}")
 
 
+class RooflineBounded(Invariant):
+    """Kernel roofline accounting stays bounded and truthful under
+    chaos: the recorder's family map never exceeds its bound, every
+    cumulative counter is monotone probe-over-probe, and the accounting
+    identity ``accounted_flops == Σ per-family model FLOPs`` holds at
+    every probe and at the final quiesce. A deterministic calibration
+    stub is installed up front so the wall-clock matmul/memcpy
+    microbenchmark can never fire inside the virtual-clock sim — replayed
+    runs stay byte-identical from one seed."""
+
+    name = "roofline-bounded"
+
+    def __init__(self) -> None:
+        from opensearch_tpu.telemetry import roofline
+
+        # seeded stub: peaks become a pure function of the seed, and
+        # lazily-triggered calibration (a stats probe reading fractions)
+        # never measures real wall time mid-soak
+        if roofline.current_peaks() is None:
+            roofline.set_peaks(roofline.stub_peaks(seed=0))
+        self._recorder = roofline.default_recorder
+        self._max_families = roofline.MAX_FAMILIES
+        self._prev: dict | None = None
+
+    def at_probe(self, h: "SoakHarness") -> None:
+        snap = self._recorder.snapshot_stats()
+        fams = snap["families"]
+        # + 1: the reserved overflow row may coexist with a full map
+        if len(fams) > self._max_families + 1:
+            h.fail(self, f"roofline family map unbounded: {len(fams)} "
+                         f"families > {self._max_families}")
+        counters = snap["counters"]
+        total = sum(row["flops"] for row in fams.values())
+        if total != counters["accounted_flops"]:
+            h.fail(self, f"roofline accounting identity broken: "
+                         f"sum(family flops) {total} != accounted_flops "
+                         f"{counters['accounted_flops']}")
+        for row in fams.values():
+            if not (0.0 < row["roofline_fraction"] <= 1.0):
+                h.fail(self, f"roofline fraction out of (0, 1] for "
+                             f"{row['family']}: {row['roofline_fraction']}")
+        if self._prev is not None:
+            for key in ("launches", "accounted_flops", "accounted_bytes",
+                        "wall_ns", "unmodeled_launches"):
+                if counters[key] < self._prev[key]:
+                    h.fail(self, f"roofline counter [{key}] went "
+                                 f"backwards: {counters[key]} < "
+                                 f"{self._prev[key]}")
+        self._prev = dict(counters)
+
+    def at_quiesce(self, h: "SoakHarness") -> None:
+        self.at_probe(h)
+
+
 DEFAULT_INVARIANTS: tuple[Callable[[], Invariant], ...] = (
     AckedWritesSurvive, SnapshotIsolation, RecoveryMonotonicity,
     ShedCorrectness, BoundedQueues, ClusterConverges, InteractiveUnderFlood,
     InteractiveP99Floor, TelemetryBounded, DeviceLedgerBounded,
+    RooflineBounded,
 )
 
 

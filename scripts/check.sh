@@ -63,6 +63,8 @@ if [[ "${1:-}" == "--bench" ]]; then
   python bench.py --ann-gate
   echo "== tail gate (interactive p99 >= 1.5x better with lanes+tuner+routing on, no aggregate-QPS regression, zero interactive sheds) =="
   python bench.py --tail-gate
+  echo "== roofline gate (every family modeled, fractions in (0,1], accounted_flops == sum of per-launch model FLOPs) =="
+  python bench.py --roofline
   # every gate child already asserts the device-ledger identity before
   # printing its result; this step proves it once more in THIS process
   # over a full publish/merge/delete cycle (ISSUE 10 acceptance)

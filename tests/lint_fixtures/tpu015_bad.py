@@ -1,0 +1,26 @@
+"""TPU015 true positives: kernel launch sites whose family has no
+registered roofline cost model — the roofline report can't place their
+launches, so every "what would a Pallas rewrite buy" ranking silently
+omits them."""
+# tpulint: device-module
+
+from opensearch_tpu.search import batcher as batcher_mod
+from opensearch_tpu.search.profile import profiled_kernel
+
+
+@profiled_kernel("my_custom_scan")  # EXPECT: TPU015
+def custom_scan(vectors, queries):
+    return vectors @ queries
+
+
+# the call (non-decorator) registration form is a launch site too
+fast_scan = profiled_kernel("another_unmodeled_scan")(custom_scan)  # EXPECT: TPU015
+
+
+def serve(key, payload, launch):
+    return batcher_mod.dispatch(key, payload, launch, family="unregistered_family")  # EXPECT: TPU015
+
+
+def serve_variant(key, payload, launch):
+    # a [variant] suffix doesn't excuse a missing BASE registration
+    return batcher_mod.dispatch(key, payload, launch, family="unregistered_family[int8]")  # EXPECT: TPU015

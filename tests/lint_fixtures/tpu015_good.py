@@ -1,0 +1,42 @@
+"""TPU015 false-positive guards: every accepted launch-site shape.
+
+- profiled_kernel names with a registered cost model;
+- dispatch(family=...) naming a registered family, with or without a
+  ``[variant]`` suffix (the base name is what the registry keys);
+- dispatch with NO family (the caller accounts the launch itself);
+- non-constant family expressions (out of static reach);
+- profiled_kernel in a module that is NOT device-scoped is out of scope
+  (this file opts in via the marker, so everything here is checked).
+"""
+# tpulint: device-module
+
+from opensearch_tpu.search import batcher as batcher_mod
+from opensearch_tpu.search.profile import profiled_kernel
+
+
+@profiled_kernel("knn_exact_scores")
+def registered_scan(queries, vectors, norms_sq, valid, similarity):
+    return queries @ vectors
+
+
+raw = profiled_kernel("knn_raw_similarity")(registered_scan)
+
+
+def serve_registered(key, payload, launch):
+    return batcher_mod.dispatch(key, payload, launch, family="ivfpq_search")
+
+
+def serve_variant(key, payload, launch):
+    return batcher_mod.dispatch(key, payload, launch,
+                                family="ivfpq_search[int8]")
+
+
+def serve_unattributed(key, payload, launch):
+    # no family: the launch closure accounts itself (the mesh pattern)
+    return batcher_mod.dispatch(key, payload, launch)
+
+
+def serve_dynamic(key, payload, launch, family_name):
+    # a non-constant family is not statically checkable; the runtime
+    # unmodeled_launches counter (and the soak invariant) covers it
+    return batcher_mod.dispatch(key, payload, launch, family=family_name)
