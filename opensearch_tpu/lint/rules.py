@@ -2123,6 +2123,149 @@ class UnmodeledKernelChecker(Checker):
         return out
 
 
+# ---------------------------------------------------------------------------
+# TPU016 — naked-pallas-call (kernels live in ops/, behind *_auto guards)
+# ---------------------------------------------------------------------------
+
+# hand-scheduled kernels are allowed ONLY here: everything else consumes
+# them through the module's *_auto wrappers, which own the platform /
+# interpret dispatch (a pallas_call elsewhere bypasses the selection
+# policy, and compiles-or-crashes depending on the backend it happens to
+# meet at runtime)
+_OPS_MODULE_PATTERNS = ("opensearch_tpu/ops/",)
+_OPS_MARKER = "# tpulint: ops-module"
+_OPS_MARKER_RE = None  # compiled lazily
+
+
+def _ops_scoped(display_path: str, source: str) -> bool:
+    global _OPS_MARKER_RE
+    if any(p in display_path for p in _OPS_MODULE_PATTERNS):
+        return True
+    if _OPS_MARKER not in source:
+        return False
+    if _OPS_MARKER_RE is None:
+        import re
+
+        _OPS_MARKER_RE = re.compile(r"(?m)^\s*" + re.escape(_OPS_MARKER))
+    return _OPS_MARKER_RE.search(source) is not None
+
+
+def _is_pallas_call(ctx: FileContext, node: ast.Call) -> bool:
+    name = ctx.canonical(call_name(node))
+    return name is not None and name.split(".")[-1] == "pallas_call"
+
+
+def _fn_params(fn: ast.AST) -> set[str]:
+    a = fn.args
+    return {p.arg for p in (*a.args, *a.posonlyargs, *a.kwonlyargs)}
+
+
+class NakedPallasCallChecker(Checker):
+    """TPU016: hand-scheduled Pallas kernels have exactly one home and one
+    front door. A ``pl.pallas_call`` OUTSIDE ``ops/`` is a kernel launch
+    that bypasses the selection-policy layer entirely. INSIDE ``ops/``,
+    every function containing a ``pallas_call`` must (a) expose an
+    ``interpret`` parameter (the CPU-sim parity path is part of the kernel
+    contract, not an afterthought), and (b) be reachable — directly or
+    through module-internal helpers — from a module-level ``*_auto``
+    wrapper that carries the platform guard (an attribute read of
+    ``.platform``), the ``knn_*_auto`` / ``adc_topr_auto`` shape. That
+    wrapper is the ONLY supported entry: it decides pallas-vs-interpret
+    -vs-fallback per backend, so serving code can never hard-bind a Mosaic
+    compile to a backend that lacks it."""
+
+    rule_id = "TPU016"
+    name = "naked-pallas-call"
+    description = ("pl.pallas_call only under ops/, reachable only "
+                   "through *_auto wrappers carrying the "
+                   "platform/interpret guard")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return "pallas_call" in source
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        if not _ops_scoped(ctx.display_path, ctx.source):
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Call) and _is_pallas_call(ctx, node):
+                    out.append(ctx.violation(
+                        "TPU016", node,
+                        "pl.pallas_call outside ops/: hand-scheduled "
+                        "kernels live in ops/ behind an *_auto wrapper "
+                        "that owns the platform/interpret dispatch"))
+            return out
+
+        # ops scope: assign every pallas_call to its INNERMOST enclosing
+        # function — module-level functions, methods, and nested helpers
+        # alike (a class-wrapped kernel is still a kernel entry). A call
+        # enclosed by nothing is a module-scope launch with no guard.
+        entries: dict[ast.AST, list] = {}  # entry fn -> enclosing stack
+
+        def collect(node: ast.AST, stack: list) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack = stack + [node]
+            if isinstance(node, ast.Call) and _is_pallas_call(ctx, node):
+                if not stack:
+                    out.append(ctx.violation(
+                        "TPU016", node,
+                        "pl.pallas_call at module scope: kernel "
+                        "launches belong inside a guarded entry point"))
+                else:
+                    entries.setdefault(stack[-1], stack)
+            for child in ast.iter_child_nodes(node):
+                collect(child, stack)
+
+        collect(ctx.tree, [])
+
+        # reference graph over EVERY function in the file (methods too):
+        # fn -> names it references, by bare Name or Attribute (the
+        # `self.scale(...)` / `_BANK.scale(...)` spellings)
+        all_fns = [n for n in ast.walk(ctx.tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        names = {fn.name for fn in all_fns}
+        refs: dict[str, set] = {}
+        for fn in all_fns:
+            rs = refs.setdefault(fn.name, set())
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Name) and n.id in names \
+                        and n.id != fn.name:
+                    rs.add(n.id)
+                elif isinstance(n, ast.Attribute) and n.attr in names \
+                        and n.attr != fn.name:
+                    rs.add(n.attr)
+        guarded_auto = [
+            fn.name for fn in all_fns
+            if fn.name.endswith("_auto") and any(
+                isinstance(n, ast.Attribute) and n.attr == "platform"
+                for n in ast.walk(fn))
+        ]
+        reachable: set[str] = set(guarded_auto)
+        frontier = list(guarded_auto)
+        while frontier:
+            for ref in refs.get(frontier.pop(), ()):
+                if ref not in reachable:
+                    reachable.add(ref)
+                    frontier.append(ref)
+
+        for fn, stack in entries.items():
+            # an enclosing function carrying the knob guards its nested
+            # helpers; reachability may land on any frame of the stack
+            if not any("interpret" in _fn_params(f) for f in stack):
+                out.append(ctx.violation(
+                    "TPU016", fn,
+                    f"kernel entry [{fn.name}] has no `interpret` "
+                    f"parameter: the CPU-sim parity path is part of the "
+                    f"kernel contract (the knn_*_auto shape)"))
+            if not any(f.name in reachable for f in stack):
+                out.append(ctx.violation(
+                    "TPU016", fn,
+                    f"kernel entry [{fn.name}] is not reachable from any "
+                    f"*_auto wrapper carrying a platform guard: add the "
+                    f"pad-and-dispatch wrapper that owns pallas-vs-"
+                    f"interpret selection"))
+        return out
+
+
 ALL_CHECKERS: list[Checker] = [
     JitPurityChecker(),
     BlockingInAsyncChecker(),
@@ -2139,6 +2282,7 @@ ALL_CHECKERS: list[Checker] = [
     MetricHygieneChecker(),
     NakedDevicePutChecker(),
     UnmodeledKernelChecker(),
+    NakedPallasCallChecker(),
 ]
 
 RULES: dict[str, Checker] = {c.rule_id: c for c in ALL_CHECKERS}

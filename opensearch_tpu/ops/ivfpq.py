@@ -227,6 +227,12 @@ class IVFPQIndex:
     # device-residency ledger handle for this build's slab (freed when the
     # owning segment retires — the engine's retirement path walks it)
     allocation: object | None = None
+    # host copies of the coarse centroids (+ precomputed squared norms):
+    # the FusionANNS-style cooperative split runs coarse quantization and
+    # probe selection host-side (host_probe_select), so the fused-kernel
+    # path never pays a device round-trip just to pick its lists
+    coarse_host: np.ndarray | None = None
+    coarse_sq_host: np.ndarray | None = None
 
     @property
     def nbytes(self) -> int:
@@ -280,9 +286,10 @@ def build(
         packed_mask[li, : len(rows)] = True
 
     put = lambda a: jax.device_put(jnp.asarray(a), device)
+    coarse_host = np.asarray(params.coarse, dtype=np.float32)
     out = IVFPQIndex(
         params=IVFPQParams(
-            coarse=put(np.asarray(params.coarse)),
+            coarse=put(coarse_host),
             codebooks=put(np.asarray(params.codebooks)),
             nlist=nlist, m=params.m, ks=params.ks, d=d,
         ),
@@ -293,6 +300,8 @@ def build(
         n=n,
         normalized=normalized,
         build_generation=next(_build_generation),
+        coarse_host=coarse_host,
+        coarse_sq_host=np.sum(coarse_host * coarse_host, axis=1),
     )
     # HBM residency accounting: the slab is device-resident until the
     # owning segment retires (index/field attribution rides the caller's
@@ -307,6 +316,53 @@ def build(
 # --------------------------------------------------------------------------
 # search
 # --------------------------------------------------------------------------
+
+
+def lut_for_probes(queries: jnp.ndarray, coarse: jnp.ndarray,
+                   codebooks: jnp.ndarray, probes: jnp.ndarray):
+    """f32 [B, P, m, ks] residual ADC lookup tables for the given probe
+    table. ONE implementation shared by the monolithic XLA lowering
+    (:func:`search`) and the fused Pallas pipeline (ops/pallas_adc) — the
+    two paths' score-space parity is enforced by construction, not by
+    keeping copies in sync."""
+    m, ks, dsub = codebooks.shape
+    resid = queries[:, None, :] - coarse[probes]          # [B, P, d]
+    r_sub = resid.reshape(queries.shape[0], probes.shape[1], m, dsub)
+    r_dot = jnp.einsum(
+        "bpms,mks->bpmk", r_sub, codebooks,
+        preferred_element_type=jnp.float32,
+    )
+    r_sq = jnp.sum(r_sub * r_sub, axis=-1)                # [B, P, m]
+    cb_sq = jnp.sum(codebooks * codebooks, axis=-1)       # [m, ks]
+    return r_sq[..., None] - 2.0 * r_dot + cb_sq[None, None]  # [B,P,m,ks]
+
+
+def exact_rescore(queries: jnp.ndarray, cand: jnp.ndarray,
+                  vectors: jnp.ndarray, norms_sq: jnp.ndarray,
+                  valid: jnp.ndarray, *, similarity: str, k_eff: int):
+    """Exact fp32 rescore of the [B, R] candidate pool into k-NN score
+    space: (scores [B, k_eff], doc_ids [B, k_eff], -1 where no finite
+    candidate). Shared by both lowerings — see :func:`lut_for_probes`."""
+    cand_safe = jnp.maximum(cand, 0)
+    cvecs = vectors[cand_safe]                            # [B, R, d]
+    cdots = jnp.einsum(
+        "bd,brd->br", queries, cvecs, preferred_element_type=jnp.float32
+    )
+    if similarity == knn_ops.COSINE:
+        q_norm = jnp.sqrt(jnp.sum(queries * queries, axis=-1,
+                                  keepdims=True))
+        v_norm = jnp.sqrt(jnp.maximum(norms_sq[cand_safe], 1e-24))
+        raw = cdots / jnp.maximum(q_norm * v_norm, 1e-12)
+        score = (1.0 + raw) / 2.0
+    else:
+        q_sq = jnp.sum(queries * queries, axis=-1, keepdims=True)
+        d_sq = jnp.maximum(q_sq - 2.0 * cdots + norms_sq[cand_safe], 0.0)
+        score = 1.0 / (1.0 + d_sq)
+    ok = (cand >= 0) & valid[cand_safe]
+    score = jnp.where(ok, score, -jnp.inf)
+    best, best_pos = jax.lax.top_k(score, k_eff)
+    best_ids = jnp.take_along_axis(cand, best_pos, axis=1)
+    return best, jnp.where(jnp.isfinite(best), best_ids, -1)
 
 
 @functools.partial(
@@ -346,9 +402,7 @@ def search(
             f"(choose from {list(ADC_PRECISIONS)})"
         )
     nlist, l_pad, m = codes.shape
-    ks = codebooks.shape[1]
     d = coarse.shape[1]
-    dsub = d // m
     similarity = knn_ops.canonical_similarity(similarity)
     nprobe = min(nprobe, nlist)
     # at most nprobe * l_pad candidates exist; clamp both cut points so
@@ -358,7 +412,6 @@ def search(
     B = queries.shape[0]
 
     c_sq = jnp.sum(coarse * coarse, axis=-1)
-    cb_sq = jnp.sum(codebooks * codebooks, axis=-1)  # [m, ks]
 
     def one_chunk(q):  # q: [chunk, d]
         qdots = jnp.einsum(
@@ -367,14 +420,7 @@ def search(
         # negative l2^2 up to the constant ||q||^2
         _, probe = jax.lax.top_k(2.0 * qdots - c_sq[None, :], nprobe)  # [c, P]
 
-        resid = q[:, None, :] - coarse[probe]                 # [c, P, d]
-        r_sub = resid.reshape(q.shape[0], nprobe, m, dsub)
-        r_dot = jnp.einsum(
-            "bpms,mks->bpmk", r_sub, codebooks,
-            preferred_element_type=jnp.float32,
-        )
-        r_sq = jnp.sum(r_sub * r_sub, axis=-1)                # [c, P, m]
-        lut = r_sq[..., None] - 2.0 * r_dot + cb_sq[None, None]  # [c,P,m,ks]
+        lut = lut_for_probes(q, coarse, codebooks, probe)     # [c,P,m,ks]
 
         pcodes = codes[probe].astype(jnp.int32)               # [c, P, L, m]
         pids = ids[probe]                                     # [c, P, L]
@@ -423,27 +469,10 @@ def search(
         flat_ids = pids.reshape(q.shape[0], nprobe * l_pad)
         _, cand_pos = jax.lax.top_k(-flat_adc, rerank)
         cand = jnp.take_along_axis(flat_ids, cand_pos, axis=1)  # [c, R]
-        cand_safe = jnp.maximum(cand, 0)
 
-        # exact fp32 rescore over the candidates
-        cvecs = vectors[cand_safe]                            # [c, R, d]
-        cdots = jnp.einsum(
-            "bd,brd->br", q, cvecs, preferred_element_type=jnp.float32
-        )
-        if similarity == knn_ops.COSINE:
-            q_norm = jnp.sqrt(jnp.sum(q * q, axis=-1, keepdims=True))
-            v_norm = jnp.sqrt(jnp.maximum(norms_sq[cand_safe], 1e-24))
-            raw = cdots / jnp.maximum(q_norm * v_norm, 1e-12)
-            score = (1.0 + raw) / 2.0
-        else:
-            q_sq = jnp.sum(q * q, axis=-1, keepdims=True)
-            d_sq = jnp.maximum(q_sq - 2.0 * cdots + norms_sq[cand_safe], 0.0)
-            score = 1.0 / (1.0 + d_sq)
-        ok = (cand >= 0) & valid[cand_safe]
-        score = jnp.where(ok, score, -jnp.inf)
-        best, best_pos = jax.lax.top_k(score, k_eff)
-        best_ids = jnp.take_along_axis(cand, best_pos, axis=1)
-        best_ids = jnp.where(jnp.isfinite(best), best_ids, -1)
+        best, best_ids = exact_rescore(
+            q, cand, vectors, norms_sq, valid,
+            similarity=similarity, k_eff=k_eff)
         if k_eff < k:  # fewer candidates than asked for: pad to [*, k]
             pad = ((0, 0), (0, k - k_eff))
             best = jnp.pad(best, pad, constant_values=-jnp.inf)
@@ -478,6 +507,34 @@ def rescore_pool(index: IVFPQIndex, k: int, nprobe: int,
     return max(k_eff, min(rerank, cap))
 
 
+def host_probe_select(index: IVFPQIndex, queries: np.ndarray,
+                      nprobe: int) -> np.ndarray:
+    """FusionANNS-style host routing: coarse quantization + probe
+    selection in numpy over the cached host centroids. Returns the probe
+    table [B, nprobe] int32, rows ordered by DESCENDING coarse score with
+    list-id ascending tie-break (``lax.top_k``'s ordering, so the fused
+    kernel's probe-major candidate order matches the device convention).
+    The fused device program consumes this table as its scalar-prefetch
+    operand — candidate-list assembly never touches the device."""
+    coarse = index.coarse_host
+    c_sq = index.coarse_sq_host
+    if coarse is None or c_sq is None:  # pre-cooperative builds
+        coarse = np.asarray(index.params.coarse, dtype=np.float32)
+        c_sq = np.sum(coarse * coarse, axis=1)
+        index.coarse_host, index.coarse_sq_host = coarse, c_sq
+    nprobe = min(nprobe, index.params.nlist)
+    # negative l2^2 up to the constant ||q||^2 — the same probe ranking
+    # the device path's top_k uses
+    score = 2.0 * (queries @ coarse.T) - c_sq[None, :]
+    part = np.argpartition(-score, nprobe - 1, axis=1)[:, :nprobe]
+    rows = np.take_along_axis(score, part, axis=1)
+    # per-row ordering: score desc, then list id asc (lexsort is stable)
+    order = np.stack([
+        np.lexsort((part[i], -rows[i])) for i in range(part.shape[0])
+    ])
+    return np.take_along_axis(part, order, axis=1).astype(np.int32)
+
+
 def search_index(
     index: IVFPQIndex,
     vectors: jnp.ndarray,
@@ -491,12 +548,36 @@ def search_index(
     similarity: str = "l2_norm",
     adc_precision: str = "fp32",
     rescore_multiplier: int | None = None,
+    kernel: str = "xla",
 ):
-    """Convenience wrapper binding an IVFPQIndex's arrays to `search`."""
+    """Convenience wrapper binding an IVFPQIndex's arrays to the selected
+    ADC scan. ``kernel`` is the RESOLVED serving policy
+    (search/ann.py resolve_kernel): "xla" runs the monolithic
+    :func:`search` lowering; "pallas" runs the cooperative split — coarse
+    quantization + probe selection host-side (:func:`host_probe_select`),
+    then ONE batched fused Pallas scan + exact rescore on device
+    (ops/pallas_adc.adc_topr_auto, interpret-mode off-TPU)."""
     nprobe = nprobe or DEFAULT_NPROBE
     if rerank is None:
         rerank = default_rerank(k, rescore_multiplier)
     similarity = knn_ops.canonical_similarity(similarity)
+    if kernel == "pallas":
+        from opensearch_tpu.ops import pallas_adc
+
+        qh = np.asarray(queries, dtype=np.float32)
+        if index.normalized:
+            q_norm = np.linalg.norm(qh, axis=-1, keepdims=True)
+            qh = qh / np.maximum(q_norm, 1e-12)
+        probes = host_probe_select(
+            index, qh, min(nprobe, index.params.nlist))
+        return pallas_adc.adc_topr_auto(
+            index.params.coarse, index.params.codebooks,
+            index.codes, index.ids, index.mask,
+            vectors, norms_sq, valid,
+            jnp.asarray(qh), jnp.asarray(probes),
+            k=k, rerank=rerank,
+            similarity=similarity, adc_precision=adc_precision,
+            impl="pallas")
     if index.normalized:
         q_norm = jnp.linalg.norm(queries, axis=-1, keepdims=True)
         queries = queries / jnp.maximum(q_norm, 1e-12)

@@ -1,16 +1,28 @@
-"""Live-tunable ANN serving configuration (the ANNS-AMP knob pair).
+"""Live-tunable ANN serving configuration (the ANNS-AMP knobs + the
+kernel selection policy).
 
 The IVF-PQ serving path (executor.shard_knn_selection's ANN branch) reads
-two dynamic settings on every dispatch:
+three dynamic settings on every dispatch:
 
   search.knn.ann.adc_precision       "fp32" | "bf16" | "int8"
   search.knn.ann.rescore_multiplier  exact-rescore pool = multiplier * k
+  search.knn.ann.kernel              "auto" | "pallas" | "xla"
 
 Reduced-precision ADC (ops/ivfpq.search) only ranks CANDIDATES; the fused
 program always ends in an exact fp32 rescore over the widened pool, so
-recall recovers while the ADC scan sheds bytes (ANNS-AMP, PAPERS.md). Both
-values ride the batch key: flipping a knob mid-stream starts new batches
-under the new configuration and can never re-rank an in-flight one.
+recall recovers while the ADC scan sheds bytes (ANNS-AMP, PAPERS.md). All
+three values ride the batch key: flipping a knob mid-stream starts new
+batches under the new configuration and can never re-rank (or re-route)
+an in-flight one.
+
+``kernel`` selects the ADC scan implementation (:func:`resolve_kernel`):
+"xla" is the monolithic ops/ivfpq.search lowering; "pallas" is the fused
+blockwise scan (ops/pallas_adc) behind the FusionANNS-style host/device
+cooperative split — host coarse quantization + probe selection, one
+batched device scan — running interpret-mode off-TPU (the parity path,
+mirroring ``knn_*_auto``; NOT a speed path on the CPU sim). "auto"
+resolves to "pallas" on a TPU backend and "xla" elsewhere, so the CPU sim
+keeps the fast lowering unless a test/soak forces the kernel.
 
 The config object is PROCESS-wide for the same reason the kNN dispatch
 batcher is (search/batcher.py `default_batcher`): the executor's dispatch
@@ -43,6 +55,19 @@ def _validate_precision(v: str) -> None:
         )
 
 
+# ADC kernel selection policies the serving tier accepts ("auto" resolves
+# per platform at dispatch time; see resolve_kernel)
+ANN_KERNELS = ("auto", "pallas", "xla")
+
+
+def _validate_kernel(v: str) -> None:
+    if v not in ANN_KERNELS:
+        raise ValueError(
+            f"unknown [search.knn.ann.kernel] value [{v}] "
+            f"(choose from {list(ANN_KERNELS)})"
+        )
+
+
 ADC_PRECISION_SETTING: Setting[str] = Setting(
     "search.knn.ann.adc_precision", "fp32", str,
     Property.NODE_SCOPE, Property.DYNAMIC,
@@ -52,8 +77,28 @@ RESCORE_MULTIPLIER_SETTING = Setting.int_setting(
     "search.knn.ann.rescore_multiplier", 4,
     Property.NODE_SCOPE, Property.DYNAMIC, min_value=1, max_value=256,
 )
+KERNEL_SETTING: Setting[str] = Setting(
+    "search.knn.ann.kernel", "auto", str,
+    Property.NODE_SCOPE, Property.DYNAMIC,
+    validator=_validate_kernel,
+)
 
-ANN_SETTINGS = (ADC_PRECISION_SETTING, RESCORE_MULTIPLIER_SETTING)
+ANN_SETTINGS = (ADC_PRECISION_SETTING, RESCORE_MULTIPLIER_SETTING,
+                KERNEL_SETTING)
+
+
+def resolve_kernel(policy: str) -> str:
+    """The EFFECTIVE ADC scan for this dispatch: "pallas" or "xla". The
+    resolved value (not the policy) rides the batch key — two nodes of one
+    process can never disagree about what a merged batch will launch, and
+    a policy flip mid-stream starts new batches instead of re-routing an
+    in-flight one. "auto" keeps the XLA lowering off-TPU because
+    interpret-mode Pallas is a parity tool, not a serving speed path."""
+    if policy in ("pallas", "xla"):
+        return policy
+    import jax
+
+    return "pallas" if jax.devices()[0].platform == "tpu" else "xla"
 
 
 def bucket_nprobe(nprobe: int, nlist: int) -> int:
@@ -80,14 +125,19 @@ class AnnServingConfig:
             Settings.EMPTY)
         self.rescore_multiplier: int = RESCORE_MULTIPLIER_SETTING.default(
             Settings.EMPTY)
+        self.kernel: str = KERNEL_SETTING.default(Settings.EMPTY)
 
     def configure(self, *, adc_precision: str | None = None,
-                  rescore_multiplier: int | None = None) -> None:
+                  rescore_multiplier: int | None = None,
+                  kernel: str | None = None) -> None:
         if adc_precision is not None:
             _validate_precision(adc_precision)
             self.adc_precision = adc_precision
         if rescore_multiplier is not None:
             self.rescore_multiplier = max(1, int(rescore_multiplier))
+        if kernel is not None:
+            _validate_kernel(kernel)
+            self.kernel = kernel
 
     def apply_settings(self, flat: dict) -> None:
         """Pick this config's keys out of a flat effective-settings map
@@ -100,12 +150,14 @@ class AnnServingConfig:
         self.configure(
             adc_precision=ADC_PRECISION_SETTING.get(s),
             rescore_multiplier=RESCORE_MULTIPLIER_SETTING.get(s),
+            kernel=KERNEL_SETTING.get(s),
         )
 
     def snapshot(self) -> dict:
         out = {
             "adc_precision": self.adc_precision,
             "rescore_multiplier": self.rescore_multiplier,
+            "kernel": self.kernel,
         }
         # index-build accounting (index/device.py): how many IVF-PQ
         # structures this process built at publish time, and their cost

@@ -54,6 +54,13 @@ an old batch), nprobe/k buckets, and the live ADC precision pair
 a k=5 arrival rides a same-family k=8 batch already forming
 (`cross_k_served`), since the bigger-k rows truncate for free.
 
+Since the fused Pallas ADC scan (ISSUE 14) the ANN key ALSO carries the
+RESOLVED KERNEL VARIANT (search/ann.resolve_kernel: "pallas" fused scan
+vs "xla" monolithic lowering): a live `search.knn.ann.kernel` flip starts
+new batches under the new variant, and because the key still carries the
+build generation, a mid-stream ANN rebuild can never merge old-generation
+queries into the new kernel variant either.
+
 Backpressure: the pending-query queue is bounded by a
 :class:`~opensearch_tpu.index.pressure.QueuePressure` budget — crossing it
 sheds the request with RejectedExecutionException (HTTP 429) instead of

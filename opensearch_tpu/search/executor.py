@@ -208,6 +208,12 @@ class ShardContext:
                 cfg = ann_mod.default_config
                 precision = cfg.adc_precision
                 mult = cfg.rescore_multiplier
+                # the RESOLVED ADC kernel ("pallas" fused scan vs "xla"
+                # monolithic lowering) rides the batch key: a policy flip
+                # mid-stream starts new batches, it never re-routes one —
+                # and a rebuild (fresh build generation) can never merge
+                # old-generation queries into the new kernel variant
+                kernel = ann_mod.resolve_kernel(cfg.kernel)
                 # bucket k AND nprobe to powers of two: both are static jit
                 # args, so raw values would compile a fresh program per
                 # distinct request shape (the query-shape cache concern,
@@ -228,11 +234,17 @@ class ShardContext:
 
                 def ann_key(kb: int):
                     return ("ivfpq", id(vf), vf.ann.build_generation, gen,
-                            kb, nprobe, sim, precision, mult)
+                            kb, nprobe, sim, precision, mult, kernel)
 
                 rerank = ivfpq.default_rerank(k_bucket, mult)
                 rescore = ivfpq.rescore_pool(vf.ann, k_bucket, nprobe,
                                              rerank)
+                # roofline family per kernel variant: the fused Pallas
+                # scan has its OWN cost model (no per-slot LUT gather
+                # traffic, no [B, nprobe, L_pad] intermediate), so the
+                # report can show exactly what the swap bought
+                family = ("ivfpq_adc_pallas" if kernel == "pallas"
+                          else "ivfpq_search")
 
                 def launch_ann(rows):
                     q_batch = _pad_query_batch(rows)
@@ -244,15 +256,16 @@ class ShardContext:
                             similarity=vf.similarity,
                             adc_precision=precision,
                             rescore_multiplier=mult,
+                            kernel=kernel,
                         )
                     # host materialization is the fence for this launch
                     b_vals = np.asarray(b_vals)
                     b_ids = np.asarray(b_ids)
                     # roofline accounting: one fenced launch against the
-                    # IVF-PQ cost model, keyed per ADC precision so the
+                    # variant's cost model, keyed per ADC precision so the
                     # report can compare the lowerings (ANNS-AMP)
                     roofline.record_launch(
-                        f"ivfpq_search[{precision}]",
+                        f"{family}[{precision}]",
                         time.perf_counter_ns() - t0,
                         b=int(q_batch.shape[0]),
                         nlist=vf.ann.params.nlist, d=vf.ann.params.d,
@@ -262,7 +275,7 @@ class ShardContext:
                     )
                     retraced = profile.signature_retraced(
                         "ivfpq_search", (vf.vectors, q_batch),
-                        (k_bucket, nprobe, precision, mult))
+                        (k_bucket, nprobe, precision, mult, kernel))
                     return (
                         [(b_vals[i], b_ids[i]) for i in range(len(rows))],
                         retraced,
@@ -275,7 +288,7 @@ class ShardContext:
                     ann_key(k_bucket), qv[0], launch_ann, shards=1,
                     kind="ann", rank=k_bucket,
                     alt_keys=(ann_key(k_bucket * 2), ann_key(k_bucket * 4)),
-                    family="ivfpq_search",
+                    family=family,
                     # generation-free family for the wait auto-tuner: a
                     # rebuild/refresh must not reset the learned window
                     tune_key=("ivfpq", id(self.mapper_service),
@@ -287,12 +300,13 @@ class ShardContext:
                 # truncates to node.k
                 if prof is not None:
                     prof.record_kernel(
-                        "ivfpq_search", out.kernel_share_ns,
+                        family, out.kernel_share_ns,
                         int(qv.nbytes), out.retraced,
                         annotations={
                             "adc_precision": precision,
                             "rescore_candidates": rescore,
                             "nprobe": nprobe,
+                            "kernel": kernel,
                         },
                     )
                 _record_ann_metrics(nprobe)

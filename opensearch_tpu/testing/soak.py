@@ -1093,6 +1093,10 @@ class SoakHarness:
             elif kind == "search_ann":
                 # IVF-PQ serving path (ISSUE 9): the annvec index carries
                 # an ANN structure, so these ride the batched ADC dispatch
+                # — under the FUSED kernel policy (ISSUE 14): run_soak
+                # forces search.knn.ann.kernel="pallas", so every one of
+                # these runs the interpret parity path's cooperative
+                # host/device split under kill/partition chaos
                 plan["index"] = "annvec"
                 plan["body"] = {"query": {"knn": {"x": {
                     "vector": self._vec(), "k": 5}}}, "size": 5}
@@ -1777,6 +1781,7 @@ def run_soak(seed: int, tmp_path, *, cycles: int = 3, nodes: int = 3,
              extra_invariants: tuple = ()) -> SoakReport:
     """Run the soak; returns the SoakReport, raises SoakFailure (seed and
     replay command attached) on any invariant violation."""
+    from opensearch_tpu.search import ann as ann_mod
     from opensearch_tpu.search import batcher as batcher_mod
 
     cfg = SoakConfig(seed=seed, cycles=cycles, nodes=nodes,
@@ -1788,6 +1793,15 @@ def run_soak(seed: int, tmp_path, *, cycles: int = 3, nodes: int = 3,
     for inv in extra_invariants:
         harness.add_invariant(inv)
     batcher_mod.default_batcher.reset()
+    # the search_ann workload exercises the FUSED kernel selection policy
+    # (ISSUE 14): forcing kernel="pallas" runs the interpret parity path
+    # on the CPU sim, so the cooperative split (host probe select + one
+    # batched fused scan) faces kill/partition chaos, and the mid-soak
+    # ann_rebuild proves old-generation batches never merge into the new
+    # kernel variant (both terms ride the batch key). A static policy is
+    # seed-deterministic; restored on exit so sibling tests keep "auto".
+    prev_kernel = ann_mod.default_config.kernel
+    ann_mod.default_config.configure(kernel="pallas")
     try:
         with timeutil.clock_scope(harness.queue.clock()), \
                 randutil.rng_scope(harness.queue.random):
@@ -1801,6 +1815,7 @@ def run_soak(seed: int, tmp_path, *, cycles: int = 3, nodes: int = 3,
               f"opensearch_tpu.testing.soak --replay {failure.seed}")
         raise
     finally:
+        ann_mod.default_config.configure(kernel=prev_kernel)
         harness.close()
     return harness.report
 

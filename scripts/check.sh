@@ -11,7 +11,11 @@
 #                               # cluster bench to BENCH_MESH.json the same
 #                               # way, and bench.py --ann-gate holds the
 #                               # batched IVF-PQ path to BENCH_ANN.json plus
-#                               # the recall@10 >= 0.95 ratchet, and
+#                               # the recall@10 >= 0.95 ratchet on BOTH the
+#                               # XLA and fused-Pallas ADC paths (on TPU it
+#                               # also asserts fused int8/bf16 QPS >= fp32 —
+#                               # the inversion resolution; the CPU sim's
+#                               # interpret path is recall-only), and
 #                               # bench.py --tail-gate asserts the tail
 #                               # control plane (lanes + wait auto-tuner +
 #                               # residency routing) still buys >= 1.5x
@@ -59,7 +63,7 @@ if [[ "${1:-}" == "--bench" ]]; then
   python bench.py --mesh-gate
   echo "== otel-overhead gate (span export must cost <= 5% QPS) =="
   python bench.py --otel-overhead
-  echo "== ANN gate (recall@10 >= 0.95 ratchet + batched >= 1.3x + QPS floor) =="
+  echo "== ANN gate (recall@10 >= 0.95 ratchet incl. fused-Pallas path + batched >= 1.3x + QPS floor) =="
   python bench.py --ann-gate
   echo "== tail gate (interactive p99 >= 1.5x better with lanes+tuner+routing on, no aggregate-QPS regression, zero interactive sheds) =="
   python bench.py --tail-gate

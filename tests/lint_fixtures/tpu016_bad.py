@@ -1,0 +1,28 @@
+"""TPU016 true positives (outside ops/): a ``pl.pallas_call`` in serving
+code bypasses the ops/ *_auto selection layer entirely — the launch
+hard-binds a Mosaic compile to whatever backend it meets at runtime
+instead of dispatching pallas / interpret / fallback per platform."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import pallas_call as raw_pallas_call
+
+
+def _double_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:] * 2.0
+
+
+def serve_scores(x):
+    return pl.pallas_call(  # EXPECT: TPU016
+        _double_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+    )(x)
+
+
+def serve_scores_direct_import(x):
+    # the direct-import spelling is the same launch
+    return raw_pallas_call(  # EXPECT: TPU016
+        _double_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+    )(x)

@@ -1,0 +1,57 @@
+"""TPU016 true positives (ops scope): kernel entries that break the
+*_auto contract — one hides the interpret knob (the CPU-sim parity path
+is part of the kernel contract), one is unreachable from any
+platform-guarded *_auto wrapper (nothing owns its pallas-vs-interpret
+selection), and one launches at module scope with no guard at all."""
+# tpulint: ops-module
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _scale_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:] * 2.0
+
+
+def pallas_scale_no_interpret(x):  # EXPECT: TPU016
+    # no `interpret` parameter: the kernel can never run the CPU-sim
+    # parity path (it is still reachable from the *_auto below, so only
+    # the missing-parameter finding fires)
+    return pl.pallas_call(
+        _scale_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+    )(x)
+
+
+def pallas_scale_orphan(x, *, interpret=False):  # EXPECT: TPU016
+    # carries the interpret knob but NO *_auto wrapper reaches it: no
+    # entry point owns its platform dispatch
+    return pl.pallas_call(
+        _scale_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+        interpret=interpret,
+    )(x)
+
+
+def scale_auto(x):
+    interpret = jax.devices()[0].platform != "tpu"
+    del interpret
+    return pallas_scale_no_interpret(x)
+
+
+_warmed = pl.pallas_call(  # EXPECT: TPU016
+    _scale_kernel,
+    out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+)(jnp.zeros((8, 128), jnp.float32))
+
+
+class _OrphanBank:
+    # a class-wrapped kernel is still a kernel entry: this method carries
+    # the interpret knob but no *_auto wrapper ever reaches it
+    def orphan_scale(self, x, *, interpret=False):  # EXPECT: TPU016
+        return pl.pallas_call(
+            _scale_kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+            interpret=interpret,
+        )(x)

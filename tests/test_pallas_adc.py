@@ -1,0 +1,401 @@
+"""Fused Pallas blockwise ADC scan (ISSUE 14): kernel parity, the
+host/device cooperative split, and the serving selection policy.
+
+Acceptance properties:
+ - interpret-mode parity vs the fused pipeline's XLA ADC scan per
+   precision: int8 pools are BIT-identical (integer accumulation), fp32 /
+   bf16 pools agree in candidate ORDER with scores equal to summation
+   order, and the post-rescore [B, k] results are identical;
+ - the served fused path (kernel="pallas", interpret on the CPU sim)
+   holds a recall@10 parity bound vs the exact scan;
+ - the running top-R pool is correct across VMEM block boundaries
+   (l_pad > l_blk) and over ragged probe lengths (short and EMPTY
+   inverted lists), with (-inf, -1) past the candidate count;
+ - the kernel variant rides the batch key: dispatches under different
+   resolved kernels never merge, and the ``search.knn.ann.kernel``
+   setting round-trips /_cluster/settings with validation + live
+   application (resolve_kernel maps "auto" per platform).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax
+
+from opensearch_tpu.common.errors import IllegalArgumentException
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.ops import ivfpq, pallas_adc
+from opensearch_tpu.search import ann as ann_mod
+from opensearch_tpu.search.batcher import KnnDispatchBatcher
+
+DIM = 16
+N_DOCS = 600
+PRECISIONS = ("fp32", "bf16", "int8")
+
+
+def _clustered(rng, n, d, n_centers=8, spread=5.0):
+    centers = rng.standard_normal((n_centers, d)) * spread
+    return (
+        centers[rng.integers(0, n_centers, n)] + rng.standard_normal((n, d))
+    ).astype(np.float32)
+
+
+def _padded_corpus(data):
+    n, d = data.shape
+    n_pad = 1 << (n - 1).bit_length()
+    vecs = jnp.asarray(np.pad(data, ((0, n_pad - n), (0, 0))))
+    norms = jnp.sum(vecs * vecs, axis=1)
+    valid = jnp.asarray(np.arange(n_pad) < n)
+    return vecs, norms, valid
+
+
+@pytest.fixture()
+def built():
+    rng = np.random.default_rng(11)
+    data = _clustered(rng, N_DOCS, DIM)
+    index = ivfpq.build(data, nlist=8, m=4, iters=3, seed=2)
+    vecs, norms, valid = _padded_corpus(data)
+    queries = _clustered(rng, 6, DIM)
+    return index, vecs, norms, valid, data, queries
+
+
+def _scan_inputs(index, queries, nprobe, precision):
+    probes = ivfpq.host_probe_select(
+        index, queries.astype(np.float32), nprobe)
+    lut = pallas_adc.build_luts(
+        jnp.asarray(queries), index.params.coarse, index.params.codebooks,
+        jnp.asarray(probes), adc_precision=precision)
+    maskf = index.mask.astype(jnp.float32)
+    return lut, maskf, jnp.asarray(probes)
+
+
+# ---------------------------------------------------------------------------
+# interpret-mode parity vs the XLA ADC scan, per precision
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("precision", PRECISIONS)
+def test_pool_parity_interpret_vs_xla(built, precision):
+    index, _vecs, _norms, _valid, _data, queries = built
+    lut, maskf, probes = _scan_inputs(index, queries, 4, precision)
+    pv, pi = pallas_adc.pallas_adc_topr(
+        lut, index.codes, index.ids, maskf, probes,
+        r=32, l_blk=min(pallas_adc.L_BLOCK, index.l_pad), interpret=True)
+    xv, xi = pallas_adc.adc_scan_xla(
+        lut, index.codes, index.ids, maskf, probes, r=32)
+    pv, pi, xv, xi = map(np.asarray, (pv, pi, xv, xi))
+    if precision == "int8":
+        # integer accumulation: the pool must be BIT-identical
+        assert np.array_equal(pv, xv)
+        assert np.array_equal(pi, xi)
+    else:
+        # float accumulation: candidate ORDER must match (the carried-
+        # first pool merge reproduces lax.top_k's probe-major tie-break);
+        # scores agree to summation order
+        assert np.array_equal(pi, xi)
+        assert np.allclose(pv, xv, atol=1e-5, equal_nan=True)
+
+
+@pytest.mark.parametrize("precision", PRECISIONS)
+def test_fused_search_parity_pallas_vs_xla_fallback(built, precision):
+    """The post-rescore [B, k] contract: the interpret-mode kernel and the
+    fused pipeline's XLA fallback return identical ids (and fp32-rescored
+    scores) for every precision."""
+    index, vecs, norms, valid, _data, queries = built
+    probes = jnp.asarray(ivfpq.host_probe_select(index, queries, 4))
+    out = {}
+    for use_pallas in (True, False):
+        out[use_pallas] = pallas_adc.fused_adc_search(
+            index.params.coarse, index.params.codebooks, index.codes,
+            index.ids, index.mask, vecs, norms, valid,
+            jnp.asarray(queries), probes,
+            k=10, rerank=48, adc_precision=precision,
+            use_pallas=use_pallas, interpret=use_pallas)
+    pv, pi = map(np.asarray, out[True])
+    xv, xi = map(np.asarray, out[False])
+    assert np.array_equal(pi, xi)
+    assert np.allclose(pv, xv, atol=1e-6, equal_nan=True)
+
+
+def test_fused_rejects_unknown_precision(built):
+    """The fused path guards adc_precision like ivfpq.search does: an
+    unknown value errors instead of silently serving the fp32 LUT."""
+    index, vecs, norms, valid, _data, queries = built
+    with pytest.raises(ValueError, match="adc_precision"):
+        ivfpq.search_index(
+            index, vecs, norms, valid, jnp.asarray(queries), k=5,
+            nprobe=4, adc_precision="int4", kernel="pallas")
+
+
+def test_fused_matches_legacy_monolithic_path(built):
+    """Same index, same nprobe: the cooperative split (host probe select +
+    fused scan) returns the same top-k as ops/ivfpq.search — host and
+    device coarse quantization agree on this corpus."""
+    index, vecs, norms, valid, _data, queries = built
+    lv, li = ivfpq.search_index(
+        index, vecs, norms, valid, jnp.asarray(queries), k=10, nprobe=4,
+        kernel="xla")
+    pv, pi = ivfpq.search_index(
+        index, vecs, norms, valid, jnp.asarray(queries), k=10, nprobe=4,
+        kernel="pallas")
+    assert np.array_equal(np.asarray(li), np.asarray(pi))
+    assert np.allclose(np.asarray(lv), np.asarray(pv), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# running-pool correctness: block boundaries + ragged probe lengths
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("l_blk", (8, 16))
+def test_pool_across_block_boundaries(built, l_blk):
+    """Force l_pad > l_blk so every probe spans MULTIPLE grid blocks: the
+    running pool must accumulate across block (and probe) iterations to
+    the same winners the one-shot XLA top_k picks."""
+    index, _vecs, _norms, _valid, _data, queries = built
+    assert index.l_pad > l_blk, "fixture corpus too small to split blocks"
+    lut, maskf, probes = _scan_inputs(index, queries, 8, "fp32")
+    pv, pi = pallas_adc.pallas_adc_topr(
+        lut, index.codes, index.ids, maskf, probes,
+        r=24, l_blk=l_blk, interpret=True)
+    xv, xi = pallas_adc.adc_scan_xla(
+        lut, index.codes, index.ids, maskf, probes, r=24)
+    assert np.array_equal(np.asarray(pi), np.asarray(xi))
+    assert np.allclose(np.asarray(pv), np.asarray(xv), atol=1e-5)
+
+
+def test_pool_ragged_and_empty_lists():
+    """Synthetic slab with raggedly filled lists (including one EMPTY
+    list): masked tail slots never enter the pool, pool slots past the
+    real candidate count carry (-inf, -1), and the pallas/XLA pools agree
+    bit-for-bit on the surviving candidates."""
+    rng = np.random.default_rng(5)
+    nlist, l_pad, m, ks = 6, 32, 4, 16
+    codes = rng.integers(0, ks, (nlist, l_pad, m), dtype=np.uint8)
+    ids = np.arange(nlist * l_pad, dtype=np.int32).reshape(nlist, l_pad)
+    fills = [0, 1, 3, 32, 7, 20]  # one empty, several ragged, one full
+    mask = np.zeros((nlist, l_pad), np.float32)
+    for li, fill in enumerate(fills):
+        mask[li, :fill] = 1.0
+        ids[li, fill:] = -1
+    B, P = 3, 4
+    probes = np.stack([
+        rng.choice(nlist, P, replace=False) for _ in range(B)
+    ]).astype(np.int32)
+    # query 0 probes ONLY sparse lists so its candidate count < R
+    probes[0] = [0, 1, 2, 4]
+    lut = jnp.asarray(rng.standard_normal((B, P, m, ks)).astype(np.float32))
+    r = 16
+    pv, pi = pallas_adc.pallas_adc_topr(
+        jnp.asarray(lut), jnp.asarray(codes), jnp.asarray(ids),
+        jnp.asarray(mask), jnp.asarray(probes),
+        r=r, l_blk=8, interpret=True)
+    xv, xi = pallas_adc.adc_scan_xla(
+        jnp.asarray(lut), jnp.asarray(codes), jnp.asarray(ids),
+        jnp.asarray(mask), jnp.asarray(probes), r=r)
+    pv, pi, xv, xi = map(np.asarray, (pv, pi, xv, xi))
+    assert np.array_equal(pi, xi)
+    assert np.allclose(pv, xv, atol=1e-5)
+    # query 0 reaches only 1 + 3 + 7 = 11 live slots (+0 from the empty
+    # list): the pool tail must be explicit (-inf, -1) padding
+    n_cand = sum(fills[li] for li in probes[0])
+    assert n_cand < r
+    assert np.all(pi[0, n_cand:] == -1)
+    assert np.all(np.isneginf(pv[0, n_cand:]))
+    # no masked slot's id may appear anywhere in the pool
+    live_ids = {int(i) for i in ids[mask > 0.5].ravel()}
+    pooled = {int(i) for i in pi.ravel() if i >= 0}
+    assert pooled <= live_ids
+
+
+# ---------------------------------------------------------------------------
+# served path: recall parity bound vs exact
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def twin_node(tmp_path):
+    n = TpuNode(tmp_path / "node")
+    for name, method in (
+        ("annv", {"name": "ivf_pq", "parameters": {
+            "nlist": 8, "m": 4, "nprobe": 8, "min_train": 100}}),
+        ("exact", None),
+    ):
+        mapping: dict = {"type": "knn_vector", "dimension": DIM}
+        if method is not None:
+            mapping["method"] = method
+        n.create_index(name, {
+            "settings": {"number_of_shards": 1},
+            "mappings": {"properties": {"x": mapping}},
+        })
+    rng = np.random.default_rng(17)
+    data = _clustered(rng, N_DOCS, DIM)
+    for name in ("annv", "exact"):
+        n.bulk([
+            ("index", {"_index": name, "_id": str(i)},
+             {"x": data[i].round(3).tolist()})
+            for i in range(N_DOCS)
+        ], refresh=True)
+    n._test_data = data
+    n._test_rng = rng
+    yield n
+    ann_mod.default_config.configure(
+        adc_precision="fp32", rescore_multiplier=4, kernel="auto")
+    n.close()
+
+
+@pytest.mark.parametrize("precision", PRECISIONS)
+def test_served_fused_recall_parity_vs_exact(twin_node, precision):
+    """kernel="pallas" on the CPU sim runs the interpret parity path end
+    to end through the REAL search API; recall@10 vs the exact twin must
+    hold the 0.95 serving floor at every precision (the ANNS-AMP rescore
+    does its job regardless of the scan implementation)."""
+    data, rng = twin_node._test_data, twin_node._test_rng
+    queries = [
+        (data[rng.integers(0, N_DOCS)]
+         + 0.05 * rng.standard_normal(DIM)).astype(np.float32)
+        for _ in range(12)
+    ]
+
+    def top10(index, q):
+        r = twin_node.search(index, {"size": 10, "query": {
+            "knn": {"x": {"vector": q.tolist(), "k": 10}}}})
+        return {h["_id"] for h in r["hits"]["hits"]}
+
+    truth = [top10("exact", q) for q in queries]
+    ann_mod.default_config.configure(
+        kernel="pallas", adc_precision=precision, rescore_multiplier=8)
+    got = [top10("annv", q) for q in queries]
+    recall = float(np.mean([
+        len(g & t) / max(len(t), 1) for g, t in zip(got, truth)]))
+    assert recall >= 0.95, f"fused-path recall@10 {recall} < 0.95"
+
+
+# ---------------------------------------------------------------------------
+# batcher-key isolation for the kernel variant
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_variant_keys_never_merge():
+    """Keys differing ONLY in the resolved kernel variant never share a
+    launch — a live policy flip (or an ann_rebuild racing one) can never
+    re-route queries into a batch formed under the other scan."""
+    batcher = KnnDispatchBatcher(max_batch_size=8, max_wait_ms=300)
+    seen: dict[str, list] = {}
+    lock = threading.Lock()
+
+    def launch_for(kernel):
+        def launch(payloads):
+            with lock:
+                seen.setdefault(kernel, []).append(sorted(payloads))
+            return [f"{kernel}:{p}" for p in payloads], False
+        return launch
+
+    barrier = threading.Barrier(4)
+    out = {}
+
+    def run(kernel, payload):
+        key = ("ivfpq", 1234, 7, 0, 8, 8, "l2_norm", "fp32", 4, kernel)
+        barrier.wait()
+        out[(kernel, payload)] = batcher.dispatch(
+            key, payload, launch_for(kernel), kind="ann").value
+
+    threads = [threading.Thread(target=run, args=args) for args in [
+        ("pallas", "a"), ("pallas", "b"), ("xla", "c"), ("xla", "d")]]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert out == {("pallas", "a"): "pallas:a", ("pallas", "b"): "pallas:b",
+                   ("xla", "c"): "xla:c", ("xla", "d"): "xla:d"}
+    for kernel, batches in seen.items():
+        for batch in batches:
+            assert all(p in ("a", "b") if kernel == "pallas"
+                       else p in ("c", "d") for p in batch)
+
+
+# ---------------------------------------------------------------------------
+# selection policy: resolve + settings round-trip + live application
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_kernel_policy():
+    platform = jax.devices()[0].platform
+    assert ann_mod.resolve_kernel("pallas") == "pallas"
+    assert ann_mod.resolve_kernel("xla") == "xla"
+    expect_auto = "pallas" if platform == "tpu" else "xla"
+    assert ann_mod.resolve_kernel("auto") == expect_auto
+
+
+def test_kernel_setting_roundtrip_and_live_application(twin_node):
+    twin_node.put_cluster_settings({"persistent": {"search": {"knn": {
+        "ann": {"kernel": "pallas"}}}}})
+    assert ann_mod.default_config.kernel == "pallas"
+    st = twin_node.knn_batcher.snapshot_stats()
+    assert st["ann"]["kernel"] == "pallas"
+
+    # applied live: the next search serves through the fused scan (the
+    # roofline recorder sees the ivfpq_adc_pallas family)
+    from opensearch_tpu.telemetry import roofline
+
+    def fused_launches():
+        fams = roofline.default_recorder.snapshot_stats()["families"]
+        return sum(row["launches"] for name, row in fams.items()
+                   if name.startswith("ivfpq_adc_pallas["))
+
+    data = twin_node._test_data
+    before = fused_launches()
+    r = twin_node.search("annv", {"size": 5, "query": {
+        "knn": {"x": {"vector": data[5].tolist(), "k": 5}}}})
+    assert [h["_id"] for h in r["hits"]["hits"]][0] == "5"
+    assert fused_launches() > before
+
+    with pytest.raises(IllegalArgumentException):
+        twin_node.put_cluster_settings({"persistent": {"search": {"knn": {
+            "ann": {"kernel": "mosaic"}}}}})
+
+    # null deletion restores the default policy
+    twin_node.put_cluster_settings({"persistent": {"search": {"knn": {
+        "ann": {"kernel": None}}}}})
+    assert ann_mod.default_config.kernel == "auto"
+
+
+def test_report_inversion_note_clears_when_fused_selected():
+    """The /_roofline int8-inversion note names the fix while only the
+    XLA lowering is serving, and CLEARS (points at the fused rows) once
+    ivfpq_adc_pallas launches are recorded."""
+    from opensearch_tpu.telemetry import roofline
+
+    rec = roofline.RooflineRecorder()
+    roofline.set_peaks(roofline.stub_peaks(seed=0))
+    shape = dict(b=8, nlist=8, d=DIM, m=4, ks=256, nprobe=4, l_pad=64,
+                 rescore=32)
+    # fp32 fast, int8 slower on the same model: the inversion
+    rec.record("ivfpq_search[fp32]", 10_000_000, params=dict(
+        shape, adc_precision="fp32"))
+    rec.record("ivfpq_search[int8]", 40_000_000, params=dict(
+        shape, adc_precision="int8"))
+    rows = {r["family"]: r for r in rec.report()["families"]}
+    assert "note" in rows["ivfpq_search[int8]"]
+    assert "search.knn.ann.kernel=pallas" in rows["ivfpq_search[int8]"]["note"]
+
+    rec.record("ivfpq_adc_pallas[int8]", 5_000_000, params=dict(
+        shape, adc_precision="int8"))
+    rows = {r["family"]: r for r in rec.report()["families"]}
+    note = rows["ivfpq_search[int8]"].get("note", "")
+    assert "legacy XLA lowering" in note
+    assert "ivfpq_adc_pallas" in note
+
+    # the deferral is RECENCY, not presence: reverting the policy (the
+    # XLA family fed again, fused rows now stale) restores the actionable
+    # guidance instead of latching "the fused path is serving" forever
+    rec.record("ivfpq_search[int8]", 40_000_000, params=dict(
+        shape, adc_precision="int8"))
+    rows = {r["family"]: r for r in rec.report()["families"]}
+    assert "search.knn.ann.kernel=pallas" in \
+        rows["ivfpq_search[int8]"]["note"]

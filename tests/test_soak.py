@@ -41,6 +41,32 @@ def test_soak_mesh_seed_exercises_sharded_launch(tmp_path):
     assert mesh_stats["launches"] >= launches
 
 
+def test_soak_exercises_fused_adc_kernel_policy(tmp_path):
+    """ISSUE 14 satellite: run_soak forces search.knn.ann.kernel="pallas",
+    so the search_ann workload serves through the fused blockwise ADC
+    scan's interpret parity path (host probe select + one batched device
+    scan) under kill/partition chaos — the roofline recorder must show
+    ivfpq_adc_pallas launches, the roofline-bounded invariant holds their
+    fractions in (0, 1] at every probe, and the forced policy is restored
+    on exit (a static, seed-deterministic config)."""
+    from opensearch_tpu.search import ann as ann_mod
+    from opensearch_tpu.telemetry import roofline
+
+    fams = roofline.default_recorder.snapshot_stats()["families"]
+    before = sum(row["launches"] for name, row in fams.items()
+                 if name.startswith("ivfpq_adc_pallas["))
+    prev_kernel = ann_mod.default_config.kernel
+    report = run_soak(7, tmp_path, **SUBSET)
+    assert report.ops_completed == report.ops_issued
+    assert report.faults_injected, "chaos cycles must inject faults"
+    fams = roofline.default_recorder.snapshot_stats()["families"]
+    after = sum(row["launches"] for name, row in fams.items()
+                if name.startswith("ivfpq_adc_pallas["))
+    assert after > before, "soak ANN searches never ran the fused kernel"
+    assert ann_mod.default_config.kernel == prev_kernel, \
+        "run_soak must restore the kernel policy it forced"
+
+
 def test_soak_telemetry_stays_bounded(tmp_path):
     """ISSUE 8 satellite: span exporters ride every soak node (synchronous,
     memory-sink, seed-derived sampling) and the telemetry-bounded invariant
