@@ -816,6 +816,146 @@ def otel_child() -> None:
     }))
 
 
+HEAT_OUT = Path(__file__).resolve().parent / "BENCH_HEAT.json"
+HEAT_BUDGET_S = int(os.environ.get("BENCH_HEAT_BUDGET_S", "600"))
+# heat/touch accounting must be near-free: the gate fails if recording a
+# touch per launch (telemetry/device_ledger.touch) costs more than this
+# fraction of streaming kNN QPS
+HEAT_TOLERANCE = float(os.environ.get("BENCH_HEAT_TOLERANCE", "0.05"))
+
+
+def heat_parent() -> int:
+    """`bench.py --heat-overhead`: streaming kNN QPS with heat/touch
+    recording OFF vs ON (the default), in a watchdogged child. Writes
+    BENCH_HEAT.json next to BENCH_CACHE and exits 1 when the overhead
+    exceeds HEAT_TOLERANCE (default 5%, env BENCH_HEAT_TOLERANCE) — wired
+    into scripts/check.sh --bench so an expensive touch-path change fails
+    the gate, not the next perf round."""
+    result, reason = _run(["--heat-child"], HEAT_BUDGET_S)
+    if result is None:
+        print(json.dumps({
+            "metric": "heat_overhead", "value": 0, "unit": "error",
+            "vs_baseline": 0, "detail": f"heat child failed: {reason}",
+            "ok": False,
+        }))
+        return 1
+    overhead = float(result.get("overhead_pct", 100.0))
+    ok = overhead <= HEAT_TOLERANCE * 100.0
+    result["ok"] = ok
+    result["tolerance_pct"] = HEAT_TOLERANCE * 100.0
+    if not ok:
+        result["detail"] = (
+            f"heat recording costs {overhead:.1f}% QPS "
+            f"(> {HEAT_TOLERANCE:.0%} budget)")
+    try:
+        HEAT_OUT.write_text(json.dumps(result, indent=1) + "\n")
+    except OSError as e:
+        result["write_error"] = str(e)
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+def heat_child() -> None:
+    """One node, concurrent kNN clients, touch recording off vs on.
+    Configs run in ALTERNATING repeats (off, on, off, on, ...) and report
+    per-config medians, so a co-tenant CPU burst hits both sides instead
+    of poisoning one — the same symmetry recipe as the otel bench."""
+    import tempfile
+    import threading
+
+    _pin_platform()
+    import numpy as np
+
+    import jax
+
+    from opensearch_tpu.node import TpuNode
+    from opensearch_tpu.search import executor
+    from opensearch_tpu.telemetry.device_ledger import default_ledger
+
+    platform = jax.devices()[0].platform
+    d = 64
+    n_docs = 20_000 if platform != "cpu" else 3_000
+    clients = int(os.environ.get("BENCH_HEAT_CLIENTS", "8"))
+    per_client = int(os.environ.get("BENCH_HEAT_QUERIES", "40"))
+    # 9 alternating off/on repeats: the otel bench showed 5-rep medians
+    # swing the measured overhead run-to-run on this shared container
+    reps = int(os.environ.get("BENCH_HEAT_REPS", "9"))
+    executor.STREAMING_MIN_DOCS = min(executor.STREAMING_MIN_DOCS, 1_024)
+
+    rng = np.random.default_rng(19)
+    tmp = Path(tempfile.mkdtemp(prefix="bench_heat_"))
+    node = TpuNode(tmp / "node")
+    node.create_index("bench", {
+        "settings": {"number_of_shards": 1},
+        "mappings": {"properties": {
+            "v": {"type": "knn_vector", "dimension": d, "space_type": "l2"},
+        }},
+    })
+    node.bulk([
+        ("index", {"_index": "bench", "_id": str(i)},
+         {"v": rng.standard_normal(d).astype(np.float32).tolist()})
+        for i in range(n_docs)
+    ], refresh=True)
+    queries = [
+        rng.standard_normal(d).astype(np.float32).tolist()
+        for _ in range(clients * per_client)
+    ]
+
+    def one_round() -> float:
+        lat_done = [0] * clients
+        barrier = threading.Barrier(clients + 1)
+
+        def client(ci: int) -> None:
+            mine = queries[ci * per_client:(ci + 1) * per_client]
+            barrier.wait()
+            for q in mine:
+                node.search("bench", {"size": 10, "query": {
+                    "knn": {"v": {"vector": q, "k": 10}}}})
+                lat_done[ci] += 1
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return sum(lat_done) / wall
+
+    # warm both configs (compile batch-width programs)
+    for enabled in (False, True):
+        default_ledger.configure_heat(enabled=enabled)
+        for q in queries[:4]:
+            node.search("bench", {"size": 10, "query": {
+                "knn": {"v": {"vector": q, "k": 10}}}})
+    walls: dict[bool, list] = {False: [], True: []}
+    for _ in range(reps):
+        for enabled in (False, True):
+            default_ledger.configure_heat(enabled=enabled)
+            walls[enabled].append(one_round())
+    default_ledger.configure_heat(enabled=True)
+    qps_off = float(np.median(walls[False]))
+    qps_on = float(np.median(walls[True]))
+    touches = default_ledger.heat_counters["touches"]
+    node.close()
+    overhead_pct = max(0.0, (1.0 - qps_on / max(qps_off, 1e-9)) * 100.0)
+    _assert_ledger_identity()
+    print(json.dumps({
+        "metric": f"heat_overhead_knn_{clients}x{per_client}",
+        "value": round(qps_on, 1),
+        "unit": "queries/s",
+        "vs_baseline": round(qps_on / max(qps_off, 1e-9), 3),
+        "platform": platform,
+        "qps_heat_off": round(qps_off, 1),
+        "qps_heat_on": round(qps_on, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "touches_recorded": touches,
+        "corpus": {"docs": n_docs, "dim": d},
+    }))
+
+
 def concurrency_parent() -> int:
     """`bench.py --concurrency`: the concurrent-clients serving workload
     (CONC_CLIENTS threads x CONC_QUERIES kNN searches each through the real
@@ -1980,6 +2120,18 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--roofline" in sys.argv:
         sys.exit(roofline_parent())
+    if "--heat-child" in sys.argv:
+        try:
+            heat_child()
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "metric": "bench_error", "value": 0, "unit": "error",
+                "vs_baseline": 0, "detail": str(e)[:200],
+            }))
+            sys.exit(1)
+        sys.exit(0)
+    if "--heat-overhead" in sys.argv:
+        sys.exit(heat_parent())
     if "--otel-overhead" in sys.argv:
         sys.exit(otel_parent())
     if "--gate" in sys.argv:

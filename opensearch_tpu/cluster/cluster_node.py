@@ -26,6 +26,7 @@ binary payloads.
 from __future__ import annotations
 
 import json
+import threading
 from pathlib import Path
 from typing import Any, Callable
 
@@ -198,6 +199,28 @@ class ClusterNode:
             "search.routing.", residency_mod.default_config.apply_settings
         )
         self.residency_board = residency_mod.ResidencyBoard()
+        # heat/touch accounting (telemetry/device_ledger.py): the ledger
+        # is process-wide like the batcher; dynamic telemetry.heat.*
+        # (enabled, advisor ring size) reaches it at state application
+        from opensearch_tpu.telemetry.device_ledger import (
+            default_ledger as _heat_ledger,
+        )
+
+        self.settings_consumers.register(
+            "telemetry.heat.", _heat_ledger.apply_heat_settings
+        )
+        # cross-node residency advertisement (ISSUE 15): a fresh
+        # coordinator seeds its board from the data nodes' warm sets
+        # piggybacked on the light stats RPC — fired once, at the first
+        # state application that shows other nodes (join traffic), so
+        # cold-start routing stops round-robining onto warm copies
+        self._residency_seeded = False
+        # last advertisement seen per node, so a pair that DROPS OUT of a
+        # node's warm set (bundle evicted under budget pressure) is
+        # observed cold — an advertise-only board would latch stale
+        # warmth forever; pruned with the board at state application
+        self._advertised_residency: dict[str, set] = {}
+        self._advertised_lock = threading.Lock()
         # round-robin sequence for cold routing decisions (no warm copy
         # known yet): one draw per fan-out keeps the shard set on one
         # replica rank instead of scattering the first build
@@ -397,6 +420,10 @@ class ClusterNode:
             live_nodes=set(state.nodes),
             live_indices=set(state.indices),
         )
+        with self._advertised_lock:
+            for nid in [n for n in self._advertised_residency
+                        if n not in state.nodes]:
+                del self._advertised_residency[nid]
         my_shards = {
             (r.index, r.shard): r for r in state.shards_for_node(self.node_id)
         }
@@ -557,6 +584,10 @@ class ClusterNode:
         self._last_routing_state = {
             key: entry.state for key, entry in my_shards.items()
         }
+        # cross-node residency advertisement (ISSUE 15): a coordinator
+        # seeing other nodes for the first time (its own join, or theirs)
+        # seeds its ResidencyBoard from their advertised warm sets
+        self._maybe_seed_residency_board()
 
     # -- shard started / recovery ------------------------------------------
 
@@ -2530,6 +2561,69 @@ class ClusterNode:
 
         return self._offload_search(run, lane=lane)
 
+    def _residency_advertisement(self) -> list[tuple]:
+        """This node's warm (index, field) set: mesh bundles keyed to OUR
+        engines (in-process sims share the registry, so the engine filter
+        keeps another node's bundles out), plus published IVF-PQ
+        structures (their slabs are device-resident from publish to
+        retirement) — the same two signals as _residency_stamp, for the
+        whole node instead of one query's shards."""
+        engines = {
+            sh.engine.instance_id for sh in self.local_shards.values()
+        }
+        pairs = set(self.shard_mesh.warm_pairs(engines))
+        for (index, _num), shard in list(self.local_shards.items()):
+            for _host, dev in list(shard.engine._segments):
+                for fname, vf in dev.vector_fields.items():
+                    if vf.ann is not None:
+                        pairs.add((index, fname))
+        return sorted(pairs)
+
+    def _observe_residency(self, node_id: str, resp: Any) -> None:
+        """Feed a stats answer's piggybacked warm set into the board.
+        The advertisement is the node's WHOLE warm set, so a pair that
+        dropped out since the last answer (its bundle evicted under
+        budget pressure) is observed COLD — advertise-only learning
+        would latch stale warmth and route launches onto a copy that
+        must rebuild the slab."""
+        pairs = resp.get("residency") if isinstance(resp, dict) else None
+        if pairs is None:
+            return
+        warm = {
+            (pair[0], pair[1]) for pair in pairs
+            if isinstance(pair, (list, tuple)) and len(pair) == 2
+        }
+        with self._advertised_lock:
+            gone = self._advertised_residency.get(node_id, set()) - warm
+            self._advertised_residency[node_id] = warm
+        for index, field in sorted(gone):
+            self.residency_board.observe(node_id, index, field, False)
+        for index, field in sorted(warm):
+            self.residency_board.observe(node_id, index, field, True)
+
+    def _maybe_seed_residency_board(self) -> None:
+        """Cold-start seeding (ISSUE 15): at the first state application
+        that shows other data nodes, fan ONE light stats RPC per node and
+        learn their advertised warm sets — a coordinator that just joined
+        a warm cluster routes its first kNN fan-out onto the copies that
+        already hold the mesh bundles instead of round-robining a
+        duplicate build. Best-effort: failures are ignored (the stamped
+        partials keep teaching the board as before)."""
+        if self._residency_seeded or not residency_mod.default_config.enabled:
+            return
+        others = [nid for nid in sorted(self.applied_state.nodes)
+                  if nid != self.node_id]
+        if not others:
+            return
+        self._residency_seeded = True
+        for nid in others:
+            self.transport.send(
+                self.node_id, nid, "indices:monitor/stats[node]", {},
+                on_response=(
+                    lambda r, nid=nid: self._observe_residency(nid, r)),
+                on_failure=lambda e: None,
+            )
+
     def _residency_stamp(self, index: str, field: str, shards: list,
                          snaps: list) -> dict:
         """This node's residency truth for (index, field): a mesh bundle
@@ -2711,6 +2805,15 @@ class ClusterNode:
             "shards": out,
             "shard_mesh": self.shard_mesh.snapshot_stats(),
         }
+        # cross-node residency advertisement (ISSUE 15): this node's warm
+        # (index, field) set piggybacks on EVERY stats answer — light and
+        # full — so any coordinator that talks stats to us learns which
+        # copies are warm without waiting for a stamped kNN partial. The
+        # kill switch drops it (routing off must cost nothing).
+        if residency_mod.default_config.enabled:
+            resp["residency"] = [
+                list(p) for p in self._residency_advertisement()
+            ]
         if payload.get("full"):
             # the cluster-wide _nodes/stats fan-out: this node's whole
             # telemetry surface rides back to the coordinator — metrics
@@ -2766,6 +2869,13 @@ class ClusterNode:
                 from opensearch_tpu.telemetry import roofline
 
                 resp["roofline"] = roofline.stats_section()
+            if want("heat"):
+                # structure access heat (telemetry/device_ledger.py touch
+                # accounting): per-structure touch/recency/class rows the
+                # tiering advisor replays. Process-wide, like the ledger.
+                from opensearch_tpu.telemetry import device_ledger
+
+                resp["heat"] = device_ledger.heat_section()
             if want("providers"):
                 for name, provider in list(self.stats_providers.items()):
                     try:

@@ -123,11 +123,12 @@ def _register_typed_settings() -> None:
     from opensearch_tpu.search.ann import ANN_SETTINGS
     from opensearch_tpu.search.batcher import BATCH_SETTINGS
     from opensearch_tpu.search.lanes import LANE_SETTINGS
+    from opensearch_tpu.telemetry.device_ledger import HEAT_SETTINGS
     from opensearch_tpu.telemetry.export import TRACING_SETTINGS
 
     for s in (*BATCH_SETTINGS, *ANN_SETTINGS, CACHE_SIZE_SETTING,
               *TRACING_SETTINGS, *MESH_SETTINGS, *LANE_SETTINGS,
-              *ROUTING_SETTINGS):
+              *ROUTING_SETTINGS, *HEAT_SETTINGS):
         DYNAMIC_CLUSTER_SETTINGS[s.key] = _validate_with_setting(s)
 
 

@@ -1252,7 +1252,8 @@ class ClusterFacade:
         if metrics and "_all" not in metrics:
             section_of = {"telemetry": "spans", "knn_batch": "knn_batch",
                           "indices": "providers", "device": "device",
-                          "tail": "tail", "roofline": "roofline"}
+                          "tail": "tail", "roofline": "roofline",
+                          "heat": "heat"}
             payload["sections"] = sorted(
                 {section_of[m] for m in metrics if m in section_of})
         nodes = sorted(self.state.nodes)
@@ -1266,6 +1267,9 @@ class ClusterFacade:
             if not isinstance(r, dict) or set(r) <= {"error", "status"}:
                 failed += 1
                 continue
+            # piggybacked residency advertisement (ISSUE 15): every stats
+            # fan-out refreshes the coordinator's warm-copy board for free
+            self.node._observe_residency(nid, r)
             entries[nid] = {
                 "name": r.get("name", nid),
                 "roles": ["cluster_manager", "data"],
@@ -1275,6 +1279,7 @@ class ClusterFacade:
                 "device": r.get("device", {}),
                 "tail": r.get("tail", {}),
                 "roofline": r.get("roofline", {}),
+                "heat": r.get("heat", {}),
                 "indices": {
                     "request_cache": r.get("request_cache", {}),
                 },
@@ -1298,7 +1303,8 @@ class ClusterFacade:
         results = self._rpc_many([
             (nid, "indices:monitor/stats[node]",
              {"full": True,
-              "sections": ["metrics", "device_totals", "roofline"]})
+              "sections": ["metrics", "device_totals", "roofline",
+                           "heat"]})
             for nid in nodes
         ])
         out: dict[str, dict] = {}
@@ -1313,7 +1319,10 @@ class ClusterFacade:
                         "device": r.get("device_totals", {}),
                         # per-family roofline fractions/FLOP/s, rendered
                         # as {family=,node=}-labeled gauges
-                        "roofline": r.get("roofline", {})}
+                        "roofline": r.get("roofline", {}),
+                        # per-structure heat classes, rendered as
+                        # {kind=,index=,node=}-labeled gauges
+                        "heat": r.get("heat", {})}
         return out
 
     def cluster_otel_flush(self) -> dict:
@@ -1345,8 +1354,10 @@ class ClusterFacade:
             (nid, "indices:monitor/stats[node]", {}) for nid in nodes
         ])
         out: dict[str, dict] = {}
-        for r in results:
+        for nid, r in zip(nodes, results):
             if isinstance(r, dict):
+                # piggybacked residency advertisement (ISSUE 15)
+                self.node._observe_residency(nid, r)
                 for key, s in (r.get("shards") or {}).items():
                     if s.get("primary") or key not in out:
                         out[key] = s

@@ -233,6 +233,17 @@ class ShardMeshRegistry:
                 for k in self._bundles
             )
 
+    def warm_pairs(self, engine_ids: set | frozenset) -> list[tuple]:
+        """Every (index, field) with a resident bundle keyed to engines in
+        `engine_ids` — THIS node's warm set, advertised on stats/join
+        traffic so a fresh coordinator's ResidencyBoard seeds before the
+        first stamped partial (ISSUE 15). Pure read, like warm_for."""
+        ids = set(engine_ids)
+        with self._lock:
+            return sorted({
+                (k[0], k[1]) for k in self._bundles if set(k[3]) <= ids
+            })
+
     def invalidate_index(self, index: str) -> int:
         """Drop every bundle of `index` (its shards left this node or the
         index was deleted); returns the number of bundles dropped."""

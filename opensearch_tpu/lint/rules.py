@@ -2266,6 +2266,77 @@ class NakedPallasCallChecker(Checker):
         return out
 
 
+# ---------------------------------------------------------------------------
+# TPU017 — untracked-structure-read (launches over resident structures must
+# record a heat touch)
+# ---------------------------------------------------------------------------
+
+
+def _calls_touch(scope: ast.AST) -> bool:
+    """True when the scope contains a call whose callee's LAST path
+    segment names a touch (``default_ledger.touch``, ``ledger.touch``,
+    ``touch_structures`` ...): the evidence that this launch's structure
+    reads feed the heat map."""
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name is not None and "touch" in name.rsplit(".", 1)[-1].lower():
+            return True
+    return False
+
+
+class UntrackedStructureReadChecker(Checker):
+    """TPU017: a launch site in a device-serving module that folds a
+    fenced launch into the roofline (``roofline.record_launch``) reads a
+    ledger-registered structure — but if the enclosing function never
+    records a ledger TOUCH, that access is invisible to the heat map and
+    the tiering advisor replays a lie: the structure looks cold while a
+    launch path hammers it, and the demotion policy evicts exactly the
+    wrong slab. The twin of TPU014 (naked-device-put) for READS: record
+    ``default_ledger.touch(...)`` against the structures the launch
+    scanned in the same function (the modeled bytes come from the same
+    cost-model params the roofline fold uses), or suppress with a comment
+    where the launch genuinely reads no resident structure."""
+
+    rule_id = "TPU017"
+    name = "untracked-structure-read"
+    description = ("roofline.record_launch sites in serving modules must "
+                   "record a device-ledger heat touch")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return (_device_scoped(display_path, source)
+                and "record_launch" in source)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+
+        def visit(node: ast.AST, ok: bool) -> None:
+            # evidence is per-FUNCTION, like TPU014: nested launch
+            # closures inherit their enclosing function's touch call
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ok = ok or _calls_touch(node)
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                # exactly record_launch — record_launch_wall (the mesh
+                # metrics hook) and other *_launch* helpers are not reads
+                if (name is not None
+                        and name.rsplit(".", 1)[-1] == "record_launch"
+                        and not ok):
+                    out.append(ctx.violation(
+                        "TPU017", node,
+                        "launch reads a ledger-registered structure "
+                        "without touch accounting: record "
+                        "default_ledger.touch(...) for the structures "
+                        "this launch scanned in this function (or the "
+                        "heat map and tiering advisor go blind to it)"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, ok)
+
+        visit(ctx.tree, ok=False)
+        return out
+
+
 ALL_CHECKERS: list[Checker] = [
     JitPurityChecker(),
     BlockingInAsyncChecker(),
@@ -2283,6 +2354,7 @@ ALL_CHECKERS: list[Checker] = [
     NakedDevicePutChecker(),
     UnmodeledKernelChecker(),
     NakedPallasCallChecker(),
+    UntrackedStructureReadChecker(),
 ]
 
 RULES: dict[str, Checker] = {c.rule_id: c for c in ALL_CHECKERS}

@@ -3592,6 +3592,15 @@ class TpuNode:
         if any(s.key in eff or s.key in changed
                for s in residency_mod.ROUTING_SETTINGS):
             residency_mod.default_config.apply_settings(eff)
+        # heat/touch accounting (telemetry/device_ledger.py): the ledger
+        # is process-wide like the batcher — same only-when-named guard
+        from opensearch_tpu.telemetry.device_ledger import (
+            HEAT_SETTINGS,
+            default_ledger,
+        )
+
+        if any(s.key in eff or s.key in changed for s in HEAT_SETTINGS):
+            default_ledger.apply_heat_settings(eff)
         self.request_cache.set_max_bytes(
             CACHE_SIZE_SETTING.get(Settings.from_flat(eff)))
         # span exporter: per-node (like the request cache), applies

@@ -310,6 +310,9 @@ def build_router() -> Router:
     # lost time, plus the re-calibration button
     reg("GET", "/_roofline", roofline_report)
     reg("POST", "/_roofline/calibrate", roofline_calibrate)
+    # what-if tiering advisor (telemetry/device_ledger.py): replay the
+    # recorded access stream against a candidate HBM budget
+    reg("GET", "/_tiering/advise", tiering_advise)
     # tasks
     reg("GET", "/_tasks", list_tasks)
     reg("GET", "/_tasks/{task_id}", get_task)
@@ -1705,6 +1708,27 @@ def prometheus_metrics(node: TpuNode, params, query, body):
                 f"{flops_m}{labels} "
                 f"{_prom_fmt(row['achieved_gflops'] * 1e9)}")
 
+    def heat_gauges(section: dict, extra: dict | None) -> None:
+        # structure-heat gauges (telemetry/device_ledger.py touch
+        # accounting): per (kind, index), the numeric class of the
+        # HOTTEST touched structure in the group — 2 hot / 1 warm /
+        # 0 cold (federated scrapes add the node label)
+        from opensearch_tpu.telemetry.device_ledger import HEAT_CLASS_VALUE
+
+        rows = section.get("rows") or []
+        m = "opensearch_tpu_structure_heat"
+        agg: dict[tuple, int] = {}
+        for row in rows:
+            key = (row["kind"], row["index"])
+            val = HEAT_CLASS_VALUE.get(row["class"], 0)
+            agg[key] = max(agg.get(key, 0), val)
+        if extra is None and agg:
+            lines.append(f"# TYPE {m} gauge")
+        for kind, index in sorted(agg):
+            lines.append(
+                f"{m}{_prom_labels({'kind': kind, 'index': index}, extra)}"
+                f" {agg[(kind, index)]}")
+
     cluster_metrics = getattr(node, "cluster_metrics", None)
     federated = flag("cluster") and cluster_metrics is not None
     if federated:
@@ -1719,15 +1743,17 @@ def prometheus_metrics(node: TpuNode, params, query, body):
             device_gauges(per_node[nid].get("device", {}), {"node": nid})
             roofline_gauges(per_node[nid].get("roofline", {}),
                             {"node": nid})
+            heat_gauges(per_node[nid].get("heat", {}), {"node": nid})
     else:
         lines.extend(_prom_registry_lines(
             node.telemetry.metrics.stats(), None, declare_types=True,
             want_exemplars=want_exemplars))
-        from opensearch_tpu.telemetry import roofline
+        from opensearch_tpu.telemetry import device_ledger, roofline
         from opensearch_tpu.telemetry.device_ledger import default_ledger
 
         device_gauges(default_ledger.device_totals(), None)
         roofline_gauges(roofline.stats_section(), None)
+        heat_gauges(device_ledger.heat_section(), None)
     # task-manager liveness gauges ride along (cheap, always useful on a
     # scrape dashboard). They are LOCAL to the serving node: the federated
     # view labels them so scrapes of different nodes never emit the same
@@ -1795,6 +1821,38 @@ def roofline_calibrate(node: TpuNode, params, query, body):
 
     peaks = roofline.calibrate(force=True)
     return 200, {"acknowledged": True, "peaks": peaks.to_dict()}
+
+
+def tiering_advise(node: TpuNode, params, query, body):
+    """GET /_tiering/advise?hbm_budget=... — the what-if tiering advisor
+    (telemetry/device_ledger.py): replay the recorded structure-access
+    stream against an HBM tier of the given budget (the shard-mesh
+    registry's LRU-by-bytes semantics) and report projected hit bytes,
+    re-upload traffic and estimated added latency per structure, with an
+    HBM / host-RAM / evicted tier recommendation. `hbm_budget` accepts
+    human-readable sizes ("512mb"); absent, the current
+    `search.mesh.hbm_budget_bytes` is simulated. The ledger is
+    process-wide (the batcher/registry scope): in-process sim nodes share
+    one advisor; on a TCP cluster each node answers for its own device
+    set."""
+    from opensearch_tpu.cluster.shard_mesh import default_registry
+    from opensearch_tpu.common.settings import parse_bytes
+    from opensearch_tpu.telemetry.device_ledger import default_ledger
+
+    raw = query.get("hbm_budget")
+    if raw in (None, ""):
+        budget = default_registry.hbm_budget_bytes
+    else:
+        try:
+            budget = parse_bytes(raw)
+        except (ValueError, TypeError):
+            raise IllegalArgumentException(
+                f"failed to parse [hbm_budget] value [{raw}]")
+        if budget < 0:
+            raise IllegalArgumentException(
+                f"[hbm_budget] must be >= 0 (0 simulates an unbounded "
+                f"tier), got [{raw}]")
+    return 200, default_ledger.advise_tiering(budget)
 
 
 def get_task(node: TpuNode, params, query, body):
@@ -3107,7 +3165,7 @@ _NODES_STATS_METRICS = {
     "transport", "http", "breaker", "script", "discovery", "ingest",
     "adaptive_selection", "indexing_pressure", "search_backpressure",
     "shard_indexing_pressure", "tasks", "telemetry", "slowlog", "knn_batch",
-    "shard_mesh", "device", "tail", "roofline",
+    "shard_mesh", "device", "tail", "roofline", "heat",
 }
 
 
@@ -3259,6 +3317,11 @@ def nodes_stats(node: TpuNode, params, query, body):
         # achieved FLOP/s + bytes/s, arithmetic intensity, roofline
         # fraction against the calibrated peaks, and the bound verdict
         "roofline": roofline.stats_section(),
+        # structure access heat (telemetry/device_ledger.py touch
+        # accounting): per-structure touch counts, bytes read, EWMA
+        # cadence, gap histogram and hot/warm/cold class — what the
+        # tiering advisor replays (GET /_tiering/advise)
+        "heat": device_ledger.heat_section(),
         "telemetry": {
             **node.telemetry.metrics.stats(),
             # the tail of the spans ring: one stitched trace tree per

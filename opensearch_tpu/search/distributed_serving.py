@@ -430,16 +430,19 @@ def mesh_knn_batch(
     # (per-slot scan + on-device all_gather/top_k merge)
     from opensearch_tpu.telemetry import roofline
 
-    roofline.record_launch(
-        "mesh_knn", wall_ns, b=b_pad, s=s, n_flat=bundle.n_flat, d=dims,
-        k_shard=k_shard, devices=n_devices,
-    )
+    launch_params = dict(b=b_pad, s=s, n_flat=bundle.n_flat, d=dims,
+                         k_shard=k_shard, devices=n_devices)
+    roofline.record_launch("mesh_knn", wall_ns, **launch_params)
     from opensearch_tpu.telemetry.device_ledger import (
         KIND_QUERY_BATCH,
         default_ledger,
     )
 
     default_ledger.record_transient(KIND_QUERY_BATCH, q_host.nbytes)
+    # heat touch against the mesh bundle this launch scanned, bytes from
+    # the same cost model the roofline fold used (telemetry/device_ledger)
+    default_ledger.touch([getattr(bundle, "allocation", None)],
+                         family="mesh_knn", params=launch_params)
     if retraced:
         # program-cache miss == fresh jit entry for the mesh kernel family;
         # the first launch wall includes the compile

@@ -113,6 +113,18 @@ def _pad_query_batch(rows: list) -> np.ndarray:
     return out
 
 
+def _touch_targets(dev, field: str, ann=None) -> list:
+    """The ledger allocations a kNN launch over this segment READS — the
+    vector column, the live bitmap, and (ANN path) the IVF-PQ slab: the
+    launch closures record a heat touch against them with the launch's
+    modeled HBM bytes (telemetry/device_ledger.touch; tpulint TPU017)."""
+    allocs = getattr(dev, "allocations", None) or {}
+    out = [allocs.get(field), allocs.get("_live")]
+    if ann is not None:
+        out.append(getattr(ann, "allocation", None))
+    return [a for a in out if a is not None]
+
+
 # --------------------------------------------------------------------------
 # Shard-level statistics (Lucene collection statistics analog)
 # --------------------------------------------------------------------------
@@ -246,6 +258,8 @@ class ShardContext:
                 family = ("ivfpq_adc_pallas" if kernel == "pallas"
                           else "ivfpq_search")
 
+                touch_allocs = _touch_targets(dev, node.field, ann=vf.ann)
+
                 def launch_ann(rows):
                     q_batch = _pad_query_batch(rows)
                     t0 = time.perf_counter_ns()
@@ -264,15 +278,28 @@ class ShardContext:
                     # roofline accounting: one fenced launch against the
                     # variant's cost model, keyed per ADC precision so the
                     # report can compare the lowerings (ANNS-AMP)
-                    roofline.record_launch(
-                        f"{family}[{precision}]",
-                        time.perf_counter_ns() - t0,
+                    launch_params = dict(
                         b=int(q_batch.shape[0]),
                         nlist=vf.ann.params.nlist, d=vf.ann.params.d,
                         m=vf.ann.params.m, ks=vf.ann.params.ks,
                         nprobe=nprobe, l_pad=vf.ann.l_pad,
                         rescore=rescore, adc_precision=precision,
                     )
+                    roofline.record_launch(
+                        f"{family}[{precision}]",
+                        time.perf_counter_ns() - t0,
+                        **launch_params,
+                    )
+                    # heat touch against the structures this launch READ
+                    # (IVF-PQ slab + rescore column + live bitmap), bytes
+                    # from the same cost model the roofline fold used
+                    from opensearch_tpu.telemetry.device_ledger import (
+                        default_ledger,
+                    )
+
+                    default_ledger.touch(
+                        touch_allocs, family=f"{family}[{precision}]",
+                        params=launch_params)
                     retraced = profile.signature_retraced(
                         "ivfpq_search", (vf.vectors, q_batch),
                         (k_bucket, nprobe, precision, mult, kernel))
@@ -364,6 +391,8 @@ class ShardContext:
                         if kb <= chunk
                     ) if key is not None else ()
 
+                    touch_allocs = _touch_targets(dev, node.field)
+
                     def launch_streaming(rows):
                         q_batch = _pad_query_batch(rows)
                         t0 = time.perf_counter_ns()
@@ -374,13 +403,25 @@ class ShardContext:
                         # host materialization is the fence for this launch
                         b_vals = np.asarray(b_vals)
                         b_ids = np.asarray(b_ids)
-                        roofline.record_launch(
-                            "knn_topk_streaming",
-                            time.perf_counter_ns() - t0,
+                        launch_params = dict(
                             b=int(q_batch.shape[0]),
                             n=int(vf.vectors.shape[0]),
                             d=int(vf.vectors.shape[1]), k=k_bucket,
                         )
+                        roofline.record_launch(
+                            "knn_topk_streaming",
+                            time.perf_counter_ns() - t0,
+                            **launch_params,
+                        )
+                        # heat touch: the column + live bitmap this scan
+                        # read, bytes from the same cost model
+                        from opensearch_tpu.telemetry.device_ledger import (
+                            default_ledger,
+                        )
+
+                        default_ledger.touch(
+                            touch_allocs, family="knn_topk_streaming",
+                            params=launch_params)
                         retraced = profile.signature_retraced(
                             "knn_topk_streaming", (vf.vectors, q_batch),
                             (k_bucket, chunk))
@@ -419,6 +460,8 @@ class ShardContext:
                         if node.filter is None else None
                     )
 
+                    touch_allocs = _touch_targets(dev, node.field)
+
                     def launch_exact(rows):
                         q_batch = _pad_query_batch(rows)
                         t0 = time.perf_counter_ns()
@@ -427,13 +470,25 @@ class ShardContext:
                                 q_batch, vf.vectors, vf.norms_sq, valid,
                                 vf.similarity,
                             ))
-                        roofline.record_launch(
-                            "knn_exact_scores",
-                            time.perf_counter_ns() - t0,
+                        launch_params = dict(
                             b=int(q_batch.shape[0]),
                             n=int(vf.vectors.shape[0]),
                             d=int(vf.vectors.shape[1]),
                         )
+                        roofline.record_launch(
+                            "knn_exact_scores",
+                            time.perf_counter_ns() - t0,
+                            **launch_params,
+                        )
+                        # heat touch: the column + live bitmap, bytes from
+                        # the same cost model
+                        from opensearch_tpu.telemetry.device_ledger import (
+                            default_ledger,
+                        )
+
+                        default_ledger.touch(
+                            touch_allocs, family="knn_exact_scores",
+                            params=launch_params)
                         retraced = profile.signature_retraced(
                             "knn_exact_scores", (vf.vectors, q_batch), (sim,))
                         return (

@@ -846,14 +846,17 @@ def search(
         # per-structure device-residency rows for the indices this request
         # touched (telemetry/device_ledger.py): what was resident in HBM —
         # exact columns, IVF-PQ slabs, mesh bundles — while this query ran,
-        # with bytes per structure (TPU-KNN's roofline denominators)
+        # with bytes per structure (TPU-KNN's roofline denominators) and,
+        # for touched structures, the per-structure HEAT summary (touch
+        # count, bytes read, EWMA cadence, hot/warm/cold class)
         from opensearch_tpu.telemetry.device_ledger import default_ledger
 
         device_rows: list[dict] = []
         for index_name in sorted(
             {shard.shard_id.index for shard, _snap, _r in per_shard_results}
         ):
-            device_rows.extend(default_ledger.structures(index=index_name))
+            device_rows.extend(default_ledger.structures(
+                index=index_name, with_heat=True))
         response["profile"] = {"shards": shards_profile,
                                "device": device_rows}
     return response

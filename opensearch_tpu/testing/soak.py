@@ -560,6 +560,91 @@ class DeviceLedgerBounded(Invariant):
                          f"({len(leaked)} total): {rows}")
 
 
+class HeatBounded(Invariant):
+    """Structure-heat accounting stays bounded and truthful under chaos:
+    every heat row belongs to a LIVE allocation group (heat retires with
+    its structure — a rebuild/eviction/kill may never leave ghost rows),
+    the cumulative touch counters are monotone probe-over-probe, the
+    advisor's access ring respects its capacity, and at the FINAL quiesce
+    every structure still carrying heat is reachable from a live owner —
+    an engine's published segment set or the mesh registry (the PR 10
+    leak-check idiom). Touch timestamps ride the injectable clock and the
+    classification is a pure threshold function, so replayed runs see
+    byte-identical heat under ``clock_scope``/``rng_scope``."""
+
+    name = "heat-bounded"
+
+    def __init__(self) -> None:
+        from opensearch_tpu.telemetry.device_ledger import default_ledger
+
+        self._ledger = default_ledger
+        # reachability only covers structures allocated DURING this soak:
+        # the process-wide ledger may hold live same-named structures from
+        # other owners in the interpreter (the DeviceLedgerBounded
+        # watermark idiom)
+        self._start_id = default_ledger.current_id()
+        self._prev: dict | None = None
+
+    def at_probe(self, h: "SoakHarness") -> None:
+        live = set(self._ledger.live_group_keys())
+        ghosts = [k for k in self._ledger.heat_group_keys()
+                  if k not in live]
+        if ghosts:
+            h.fail(self, f"heat rows outlive their structures "
+                         f"({len(ghosts)} ghosts): {ghosts[:5]}")
+        st = self._ledger.heat_stats()
+        ring = st["ring"]
+        if ring["size"] > ring["capacity"]:
+            h.fail(self, f"advisor access ring over capacity: "
+                         f"{ring['size']} > {ring['capacity']}")
+        counters = st["counters"]
+        if self._prev is not None:
+            for key in ("touches", "touched_bytes", "transitions"):
+                if counters[key] < self._prev[key]:
+                    h.fail(self, f"heat counter [{key}] went backwards: "
+                                 f"{counters[key]} < {self._prev[key]}")
+        self._prev = dict(counters)
+
+    def at_quiesce(self, h: "SoakHarness") -> None:
+        self.at_probe(h)
+        if not h.final_quiesce:
+            return
+        from opensearch_tpu.cluster.shard_mesh import default_registry
+        from opensearch_tpu.telemetry.device_ledger import group_key
+
+        # reachable groups: every allocation owned by a live engine's
+        # published segments or a resident mesh bundle (the
+        # device-ledger-bounded reachability set, folded to group keys)
+        reachable: set[tuple] = set()
+        for node in h.nodes.values():
+            for shard in node.local_shards.values():
+                for _host, dev in shard.engine._segments:
+                    for alloc in (getattr(dev, "allocations", None)
+                                  or {}).values():
+                        reachable.add(group_key(alloc))
+        with default_registry._lock:
+            bundles = list(default_registry._bundles.values())
+        for bundle in bundles:
+            alloc = getattr(bundle, "allocation", None)
+            if alloc is not None:
+                reachable.add(group_key(alloc))
+        # groups with at least one allocation made DURING this soak: a
+        # pre-existing same-named structure (another test's engine in
+        # this interpreter) is not ours to account
+        mine: set[tuple] = {
+            group_key(a) for a in self._ledger.live_allocations()
+            if a.alloc_id > self._start_id
+        }
+        orphans = [
+            k for k in self._ledger.heat_group_keys()
+            if k[0] in set(h.indices) and k in mine and k not in reachable
+        ]
+        if orphans:
+            h.fail(self, f"touched structures unreachable from any live "
+                         f"engine/registry at quiesce ({len(orphans)}): "
+                         f"{orphans[:5]}")
+
+
 class RooflineBounded(Invariant):
     """Kernel roofline accounting stays bounded and truthful under
     chaos: the recorder's family map never exceeds its bound, every
@@ -618,7 +703,7 @@ DEFAULT_INVARIANTS: tuple[Callable[[], Invariant], ...] = (
     AckedWritesSurvive, SnapshotIsolation, RecoveryMonotonicity,
     ShedCorrectness, BoundedQueues, ClusterConverges, InteractiveUnderFlood,
     InteractiveP99Floor, TelemetryBounded, DeviceLedgerBounded,
-    RooflineBounded,
+    RooflineBounded, HeatBounded,
 )
 
 

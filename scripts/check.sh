@@ -63,6 +63,8 @@ if [[ "${1:-}" == "--bench" ]]; then
   python bench.py --mesh-gate
   echo "== otel-overhead gate (span export must cost <= 5% QPS) =="
   python bench.py --otel-overhead
+  echo "== heat-overhead gate (touch accounting must cost <= 5% QPS) =="
+  python bench.py --heat-overhead
   echo "== ANN gate (recall@10 >= 0.95 ratchet incl. fused-Pallas path + batched >= 1.3x + QPS floor) =="
   python bench.py --ann-gate
   echo "== tail gate (interactive p99 >= 1.5x better with lanes+tuner+routing on, no aggregate-QPS regression, zero interactive sheds) =="

@@ -1,0 +1,37 @@
+"""TPU017 true positives: a fenced launch folded into the roofline reads
+a ledger-registered structure, but the enclosing function never records a
+heat touch — the access is invisible to the heat map, so the tiering
+advisor replays a lie and demotes exactly the wrong slab."""
+# tpulint: device-module
+
+from opensearch_tpu.telemetry import roofline
+
+
+def launch_scan(column, queries, wall_ns):
+    scores = column.scan(queries)
+    roofline.record_launch(  # EXPECT: TPU017
+        "knn_exact_scores", wall_ns,
+        b=queries.shape[0], n=column.n, d=column.d)
+    return scores
+
+
+def batched_leader(bundle, q_batch, wall_ns):
+    out = bundle.program(q_batch)
+
+    def fold():
+        roofline.record_launch(  # EXPECT: TPU017
+            "mesh_knn", wall_ns, b=q_batch.shape[0], s=bundle.s,
+            n_flat=bundle.n_flat, d=bundle.d, k_shard=8)
+
+    fold()
+    return out
+
+
+class SlabServer:
+    def serve(self, slab, queries, wall_ns):
+        vals = slab.adc(queries)
+        roofline.record_launch(  # EXPECT: TPU017
+            "ivfpq_search", wall_ns, b=queries.shape[0],
+            nlist=slab.nlist, d=slab.d, m=slab.m, ks=slab.ks,
+            nprobe=8, l_pad=slab.l_pad, rescore=64)
+        return vals

@@ -1,0 +1,38 @@
+"""TPU017 false-positive guards: every accepted launch shape.
+
+- a touch recorded in the same function as the roofline fold;
+- a nested launch closure inheriting its enclosing function's touch;
+- record_launch_wall (the mesh metrics hook) is NOT a structure read;
+- record_launch in a module that is not device-scoped is out of scope
+  (covered by the scoping test, not spelled here).
+"""
+# tpulint: device-module
+
+from opensearch_tpu.telemetry import roofline
+from opensearch_tpu.telemetry.device_ledger import default_ledger
+
+
+def launch_scan(column, queries, wall_ns):
+    scores = column.scan(queries)
+    params = dict(b=queries.shape[0], n=column.n, d=column.d)
+    roofline.record_launch("knn_exact_scores", wall_ns, **params)
+    default_ledger.touch([column.allocation],
+                         family="knn_exact_scores", params=params)
+    return scores
+
+
+def leader_closure_inherits_touch(bundle, q_batch, wall_ns):
+    def fold():
+        roofline.record_launch(
+            "mesh_knn", wall_ns, b=q_batch.shape[0], s=bundle.s,
+            n_flat=bundle.n_flat, d=bundle.d, k_shard=8)
+
+    out = bundle.program(q_batch)
+    fold()
+    default_ledger.touch([bundle.allocation], nbytes=bundle.nbytes)
+    return out
+
+
+def metrics_hook_is_not_a_read(registry, wall_ns):
+    registry.record_launch_wall(wall_ns)
+    return registry.next_launch_id()

@@ -1,0 +1,619 @@
+"""Access heat + what-if tiering advisor (ISSUE 15): touch accounting on
+the device-residency ledger, heat lifecycle across rebuilds/evictions,
+the advisor's LRU replay validated against the REAL shard-mesh registry,
+and the REST/Prometheus/profile surfaces.
+
+Acceptance bar: on a replayed access stream the advisor's projected hit
+bytes are within 10% of the mesh registry's measured LRU-by-bytes
+behavior at the same budget; heat retires WITH its structure (no ghost
+rows after an ann_rebuild or a mesh eviction); transient query uploads
+never enter heat; and two replays of one recorded stream are
+byte-identical.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.telemetry.device_ledger import (
+    HEAT_COLD,
+    HEAT_COLD_AGE_MS,
+    HEAT_HOT,
+    HEAT_WARM,
+    DeviceResidencyLedger,
+    classify_heat,
+    default_ledger,
+    group_key,
+)
+
+# ---------------------------------------------------------------------------
+# touch accounting unit semantics
+# ---------------------------------------------------------------------------
+
+
+class TestTouchCore:
+    def test_touch_splits_model_bytes_exactly(self):
+        led = DeviceResidencyLedger()
+        a = led.register("column", 1000, index="i", field="v", generation=1)
+        b = led.register("ivfpq_slab", 3000, index="i", field="v",
+                         generation=5)
+        led.touch([a, b], nbytes=400, at_ms=100)
+        rows = {r["kind"]: r for r in led.heat_rows()}
+        # shares proportional to resident bytes, summing EXACTLY to the
+        # modeled launch traffic
+        assert rows["column"]["bytes_read"] == 100
+        assert rows["ivfpq_slab"]["bytes_read"] == 300
+        assert led.heat_counters["touched_bytes"] == 400
+        assert led.heat_counters["touches"] == 2
+
+    def test_touched_bytes_agree_with_cost_model(self):
+        from opensearch_tpu.telemetry.roofline import COST_MODELS
+
+        led = DeviceResidencyLedger()
+        a = led.register("column", 4096, index="i", field="v", generation=1)
+        params = dict(b=4, n=1024, d=16)
+        led.touch([a], family="knn_exact_scores", params=params, at_ms=10)
+        _flops, model_bytes = COST_MODELS["knn_exact_scores"](params)
+        assert led.heat_rows()[0]["bytes_read"] == model_bytes
+
+    def test_gap_histogram_and_ewma(self):
+        led = DeviceResidencyLedger()
+        a = led.register("column", 100, index="i", field="v", generation=1)
+        for at in (0, 100, 200, 300):
+            led.touch([a], nbytes=10, at_ms=at)
+        (row,) = led.heat_rows()
+        assert row["touches"] == 4
+        # three 100ms gaps land in the le=100 bucket
+        assert row["gap_histogram"]["100"] == 3
+        assert row["ewma_gap_ms"] == 100.0
+
+    def test_classification_thresholds(self):
+        assert classify_heat(HEAT_COLD_AGE_MS + 1, 0.0, 5) == HEAT_COLD
+        assert classify_heat(0, 50.0, 5) == HEAT_HOT
+        assert classify_heat(0, 50.0, 1) == HEAT_WARM  # one touch: no cadence
+        assert classify_heat(0, 60_000.0, 5) == HEAT_WARM
+
+    def test_heat_retires_with_structure_no_ghosts(self):
+        led = DeviceResidencyLedger()
+        a = led.register("column", 100, index="i", field="v", generation=1)
+        led.touch([a], nbytes=10, at_ms=0)
+        assert led.heat_group_keys() == [group_key(a)]
+        a.free(reason="retired")
+        assert led.heat_group_keys() == []
+        # cumulative counters survive retirement (monotone under chaos)
+        assert led.heat_counters["touches"] == 1
+
+    def test_group_survives_until_last_allocation_frees(self):
+        led = DeviceResidencyLedger()
+        a = led.register("column", 100, index="i", field="v", generation=1,
+                         device="d0")
+        b = led.register("column", 100, index="i", field="v", generation=1,
+                         device="d0")
+        led.touch([a], nbytes=10, at_ms=0)
+        a.free()
+        assert led.heat_group_keys()  # b keeps the group alive
+        b.free()
+        assert led.heat_group_keys() == []
+
+    def test_transients_never_enter_heat(self):
+        led = DeviceResidencyLedger()
+        led.record_transient("query_batch", 4096)
+        assert led.heat_group_keys() == []
+        assert led.heat_stats()["ring"]["size"] == 0
+        led.verify_identity()
+
+    def test_freed_allocation_is_never_touched(self):
+        led = DeviceResidencyLedger()
+        a = led.register("column", 100, index="i", field="v", generation=1)
+        a.free()
+        led.touch([a], nbytes=10, at_ms=0)
+        assert led.heat_group_keys() == []
+        assert led.heat_counters["touches"] == 0
+
+    def test_kill_switch_disables_touches(self):
+        led = DeviceResidencyLedger()
+        a = led.register("column", 100, index="i", field="v", generation=1)
+        led.configure_heat(enabled=False)
+        led.touch([a], nbytes=10, at_ms=0)
+        assert led.heat_counters["touches"] == 0
+        led.configure_heat(enabled=True)
+        led.touch([a], nbytes=10, at_ms=0)
+        assert led.heat_counters["touches"] == 1
+
+    def test_ring_resize_keeps_newest(self):
+        led = DeviceResidencyLedger()
+        a = led.register("column", 100, index="i", field="v", generation=1)
+        for at in range(64):
+            led.touch([a], nbytes=1, at_ms=at)
+        led.configure_heat(ring=16)
+        st = led.heat_stats()
+        assert st["ring"]["size"] == 16 and st["ring"]["capacity"] == 16
+        adv = led.advise_tiering(0, memcpy_bytes_per_s=1e9)
+        assert adv["window"]["from_ms"] == 48  # newest 16 survive
+
+    def test_transition_emits_span_event(self):
+        from opensearch_tpu.telemetry.tracing import Telemetry, activate
+
+        led = DeviceResidencyLedger()
+        a = led.register("column", 100, index="i", field="v", generation=1)
+        tel = Telemetry(name="heat-evt")
+        with activate(tel.tracer), tel.tracer.start_span("req") as span:
+            # two quick touches: the structure classifies HOT on the
+            # second (sub-second EWMA cadence) — warm -> hot transition
+            led.touch([a], nbytes=10, at_ms=0)
+            led.touch([a], nbytes=10, at_ms=50)
+            events = [e for e in span.events
+                      if e["name"] == "heat.transition"]
+            assert events
+            attrs = events[0]["attributes"]
+            assert attrs["index"] == "i" and attrs["to"] == HEAT_HOT
+        assert led.heat_counters["transitions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# what-if tiering advisor: replay semantics + mesh-registry validation
+# ---------------------------------------------------------------------------
+
+
+class _Bundle:
+    def __init__(self, led, name, nbytes):
+        self.nbytes = nbytes
+        self.allocation = led.register(
+            "mesh_bundle", nbytes, index=name, field="v", generation=(1,),
+            device="mesh[1]")
+
+
+class TestAdvisor:
+    def test_projection_matches_real_mesh_registry_lru(self):
+        """The acceptance criterion: replay a recorded access stream and
+        land within 10% of the ACTUAL ShardMeshRegistry's LRU-by-bytes
+        behavior at the same budget. The advisor mirrors the registry's
+        semantics (hit re-inserts warm, miss evicts from the cold end
+        until the incoming bundle fits, oversized admitted), so on a
+        clean stream the match is exact — the 10% bound is the ratchet."""
+        from opensearch_tpu.cluster.shard_mesh import ShardMeshRegistry
+
+        budget = 1000
+        led = DeviceResidencyLedger()
+        reg = ShardMeshRegistry(hbm_budget_bytes=budget)
+        sizes = {"s0": 400, "s1": 400, "s2": 400}
+        read_bytes = {"s0": 120, "s1": 80, "s2": 200}
+        keys = {n: (n, "v", 1, (i,), (0,), (1,))
+                for i, n in enumerate(sizes)}
+        current: dict[str, _Bundle] = {}
+        rng = np.random.default_rng(17)
+        measured_hit_bytes = 0
+        measured_hits = 0
+        for at in range(200):
+            name = rng.choice(sorted(sizes))
+            hit = reg.get(keys[name]) is not None
+            if hit:
+                measured_hit_bytes += read_bytes[name]
+                measured_hits += 1
+            else:
+                current[name] = _Bundle(led, name, sizes[name])
+                reg.put(keys[name], current[name])
+            led.touch([current[name].allocation],
+                      nbytes=read_bytes[name], at_ms=at)
+        adv = led.advise_tiering(budget, memcpy_bytes_per_s=1e9)
+        proj = adv["projected"]
+        assert measured_hits > 0 and proj["hits"] > 0
+        assert abs(proj["hit_bytes"] - measured_hit_bytes) <= \
+            0.1 * max(measured_hit_bytes, 1)
+        # and the registry's own counters corroborate the replay
+        st = reg.snapshot_stats()
+        assert st["hits"] == measured_hits == proj["hits"]
+        reg.clear()
+
+    def test_two_replays_of_one_seed_are_byte_identical(self):
+        def record(led: DeviceResidencyLedger) -> None:
+            rng = np.random.default_rng(23)
+            allocs = {
+                n: led.register("mesh_bundle", s, index=n, field="v",
+                                generation=(1,), device="mesh[1]")
+                for n, s in (("a", 300), ("b", 500), ("c", 700))
+            }
+            for at in range(150):
+                name = rng.choice(sorted(allocs))
+                led.touch([allocs[name]], nbytes=64, at_ms=at)
+
+        led1, led2 = DeviceResidencyLedger(), DeviceResidencyLedger()
+        record(led1)
+        record(led2)
+        one = led1.advise_tiering(800, memcpy_bytes_per_s=5e10)
+        two = led2.advise_tiering(800, memcpy_bytes_per_s=5e10)
+        assert json.dumps(one, sort_keys=True) == \
+            json.dumps(two, sort_keys=True)
+        # and replaying the SAME ledger twice is idempotent
+        assert json.dumps(led1.advise_tiering(
+            800, memcpy_bytes_per_s=5e10), sort_keys=True) == \
+            json.dumps(one, sort_keys=True)
+
+    def test_unbounded_budget_hits_everything_after_first(self):
+        led = DeviceResidencyLedger()
+        a = led.register("mesh_bundle", 100, index="i", field="v",
+                         generation=(1,))
+        for at in range(5):
+            led.touch([a], nbytes=10, at_ms=at)
+        adv = led.advise_tiering(0, memcpy_bytes_per_s=1e9)
+        assert adv["projected"]["misses"] == 1
+        assert adv["projected"]["hits"] == 4
+        (row,) = adv["structures"]
+        assert row["tier"] == "hbm"
+        assert row["reupload_bytes"] == 100
+
+    def test_tier_recommendations(self):
+        led = DeviceResidencyLedger()
+        small = led.register("column", 100, index="keep", field="v",
+                             generation=1)
+        once = led.register("column", 100, index="once", field="v",
+                            generation=1)
+        big_a = led.register("mesh_bundle", 900, index="churn_a", field="v",
+                             generation=(1,))
+        big_b = led.register("mesh_bundle", 900, index="churn_b", field="v",
+                             generation=(1,))
+        at = [0]
+
+        def touch(alloc):
+            led.touch([alloc], nbytes=50, at_ms=at[0])
+            at[0] += 1
+
+        touch(once)
+        for _ in range(6):   # the two big slabs thrash each other out
+            touch(small)
+            touch(big_a)
+            touch(big_b)
+        adv = led.advise_tiering(1000, memcpy_bytes_per_s=1e9)
+        tiers = {r["index"]: r["tier"] for r in adv["structures"]}
+        assert tiers["once"] == "evicted"
+        assert tiers["churn_a"] == "host_ram" or tiers["churn_b"] == \
+            "host_ram"
+        # added latency is the re-upload bytes over the memcpy bandwidth
+        churn = next(r for r in adv["structures"]
+                     if r["tier"] == "host_ram")
+        assert churn["added_latency_ms"] == round(
+            churn["reupload_bytes"] / 1e9 * 1e3, 3)
+
+
+# ---------------------------------------------------------------------------
+# heat lifecycle on the real serving paths (node-level)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def node(tmp_path):
+    from opensearch_tpu.node import TpuNode
+
+    n = TpuNode(data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def _knn_index(node, name, docs=32, dims=8, seed=3, method=None):
+    rng = np.random.default_rng(seed)
+    props = {"v": {"type": "knn_vector", "dimension": dims,
+                   "space_type": "l2"}}
+    if method is not None:
+        props["v"]["method"] = method
+    node.create_index(name, {
+        "settings": {"number_of_shards": 1},
+        "mappings": {"properties": props},
+    })
+    node.bulk([
+        ("index", {"_index": name, "_id": str(i)},
+         {"v": rng.standard_normal(dims).astype(np.float32).tolist()})
+        for i in range(docs)
+    ], refresh=True)
+    return rng
+
+
+def _knn_search(node, name, rng, dims=8, k=3, profile=False):
+    body = {"size": k, "query": {"knn": {"v": {
+        "vector": rng.standard_normal(dims).tolist(), "k": k}}}}
+    if profile:
+        body["profile"] = True
+    return node.search(name, body)
+
+
+class TestHeatLifecycle:
+    def test_mesh_search_heats_the_bundle(self, node):
+        rng = _knn_index(node, "hm")
+        for _ in range(3):
+            _knn_search(node, "hm", rng)
+        rows = [r for r in default_ledger.heat_rows(index="hm")]
+        kinds = {r["kind"] for r in rows}
+        assert "mesh_bundle" in kinds
+        mesh = next(r for r in rows if r["kind"] == "mesh_bundle")
+        assert mesh["touches"] == 3 and mesh["bytes_read"] > 0
+        assert mesh["class"] == HEAT_HOT
+        # transient query uploads never enter heat scoring
+        assert "query_batch" not in kinds
+
+    def test_ann_rebuild_retires_old_generation_heat(self, node):
+        method = {"name": "ivf_pq",
+                  "parameters": {"nlist": 8, "m": 4, "min_train": 512}}
+        rng = _knn_index(node, "ha", docs=600, dims=16, method=method)
+        _knn_search(node, "ha", rng, dims=16)
+        slabs = [r for r in default_ledger.heat_rows(index="ha")
+                 if r["kind"] == "ivfpq_slab"]
+        assert len(slabs) == 1, "ANN search did not touch the slab"
+        old_gen = slabs[0]["generation"]
+        # ann_rebuild: more docs + refresh + force-merge re-trains the
+        # structure under a fresh build generation; the old slab frees
+        node.bulk([
+            ("index", {"_index": "ha", "_id": f"x{i}"},
+             {"v": rng.standard_normal(16).astype(np.float32).tolist()})
+            for i in range(64)
+        ], refresh=True)
+        node.force_merge("ha")
+        after = [r for r in default_ledger.heat_rows(index="ha")
+                 if r["kind"] == "ivfpq_slab"]
+        assert all(r["generation"] != old_gen for r in after), \
+            "old generation's heat outlived its slab (ghost row)"
+        # the rebuilt slab earns fresh heat on the next search
+        _knn_search(node, "ha", rng, dims=16)
+        rebuilt = [r for r in default_ledger.heat_rows(index="ha")
+                   if r["kind"] == "ivfpq_slab"]
+        assert len(rebuilt) == 1 and rebuilt[0]["generation"] != old_gen
+        assert rebuilt[0]["touches"] == 1
+
+    def test_index_delete_clears_mesh_heat(self, node):
+        rng = _knn_index(node, "hd")
+        _knn_search(node, "hd", rng)
+        assert default_ledger.heat_rows(index="hd")
+        node.delete_index("hd")
+        assert default_ledger.heat_rows(index="hd") == []
+
+    def test_mesh_budget_eviction_clears_heat(self, node):
+        from opensearch_tpu.cluster.shard_mesh import default_registry
+
+        rng = _knn_index(node, "he1")
+        _knn_search(node, "he1", rng)
+        assert any(r["kind"] == "mesh_bundle"
+                   for r in default_ledger.heat_rows(index="he1"))
+        bundle_bytes = next(
+            r["bytes"] for r in default_registry.resident()
+            if r["index"] == "he1")
+        old_budget = default_registry.hbm_budget_bytes
+        try:
+            # a budget that fits ONE bundle: building the second evicts
+            # the first, and its heat must leave with it
+            default_registry.configure(
+                hbm_budget_bytes=int(bundle_bytes * 1.5))
+            rng2 = _knn_index(node, "he2")
+            _knn_search(node, "he2", rng2)
+            assert not any(
+                r["kind"] == "mesh_bundle"
+                for r in default_ledger.heat_rows(index="he1"))
+            assert any(r["kind"] == "mesh_bundle"
+                       for r in default_ledger.heat_rows(index="he2"))
+        finally:
+            default_registry.configure(hbm_budget_bytes=old_budget)
+
+
+# ---------------------------------------------------------------------------
+# surfaces: _nodes/stats heat, /_tiering/advise, Prometheus, profile rows
+# ---------------------------------------------------------------------------
+
+
+def _handle(node, method, path, query=None, body=None):
+    from opensearch_tpu.rest.handlers import build_router
+
+    router = build_router()
+    handler, params = router.resolve(method, path)
+    return handler(node, params, query or {}, body)
+
+
+class TestSurfaces:
+    def test_nodes_stats_heat_section_and_filter(self, node):
+        rng = _knn_index(node, "hs")
+        _knn_search(node, "hs", rng)
+        status, resp = _handle(node, "GET", "/_nodes/stats")
+        assert status == 200
+        heat = resp["nodes"]["node-0"]["heat"]
+        assert heat["enabled"] is True
+        assert any(r["index"] == "hs" for r in heat["rows"])
+        assert heat["counters"]["touches"] >= 1
+        assert set(heat["classes"]) == {HEAT_HOT, HEAT_WARM, HEAT_COLD}
+        # metric-filter narrowing keeps only the heat section
+        status, resp = _handle(node, "GET", "/_nodes/stats/heat")
+        entry = resp["nodes"]["node-0"]
+        assert "heat" in entry and "indices" not in entry
+
+    def test_prometheus_heat_gauge(self, node):
+        rng = _knn_index(node, "hp")
+        # two quick scans: hot needs an observed cadence (>= 2 touches)
+        _knn_search(node, "hp", rng)
+        _knn_search(node, "hp", rng)
+        status, text = _handle(node, "GET", "/_prometheus/metrics")
+        assert status == 200
+        assert "# TYPE opensearch_tpu_structure_heat gauge" in text
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("opensearch_tpu_structure_heat")
+            and 'index="hp"' in ln)
+        assert 'kind="mesh_bundle"' in line
+        assert line.rsplit(" ", 1)[1] == "2"  # hot
+
+    def test_tiering_advise_endpoint(self, node):
+        rng = _knn_index(node, "ht")
+        for _ in range(3):
+            _knn_search(node, "ht", rng)
+        status, resp = _handle(node, "GET", "/_tiering/advise",
+                               query={"hbm_budget": "1gb"})
+        assert status == 200
+        assert resp["hbm_budget_bytes"] == 1 << 30
+        assert resp["projected"]["accesses"] >= 3
+        mine = [r for r in resp["structures"] if r["index"] == "ht"]
+        assert mine and mine[0]["tier"] in ("hbm", "host_ram", "evicted")
+        assert mine[0]["hits"] >= 1  # repeated scans of a resident slab
+        # absent budget simulates the live mesh budget
+        status, resp = _handle(node, "GET", "/_tiering/advise")
+        from opensearch_tpu.cluster.shard_mesh import default_registry
+
+        assert resp["hbm_budget_bytes"] == default_registry.hbm_budget_bytes
+        # unparseable budget -> 400
+        from opensearch_tpu.common.errors import IllegalArgumentException
+
+        with pytest.raises(IllegalArgumentException):
+            _handle(node, "GET", "/_tiering/advise",
+                    query={"hbm_budget": "lots"})
+
+    def test_profile_rows_carry_heat_fields(self, node):
+        rng = _knn_index(node, "hf")
+        _knn_search(node, "hf", rng)
+        resp = _knn_search(node, "hf", rng, profile=True)
+        rows = resp["profile"]["device"]
+        touched = [r for r in rows if "heat" in r]
+        assert touched, "no profiled device row carries heat"
+        heat = touched[0]["heat"]
+        assert {"touches", "bytes_read", "class", "ewma_gap_ms",
+                "age_ms"} <= set(heat)
+        assert heat["touches"] >= 1
+
+    def test_heat_settings_round_trip(self, node):
+        rng = _knn_index(node, "hk")
+        try:
+            node.put_cluster_settings({"persistent": {
+                "telemetry.heat.enabled": "false"}})
+            assert default_ledger.heat_config["enabled"] is False
+            before = default_ledger.heat_counters["touches"]
+            _knn_search(node, "hk", rng)
+            assert default_ledger.heat_counters["touches"] == before
+            # null deletion restores the default (enabled)
+            node.put_cluster_settings({"persistent": {
+                "telemetry.heat.enabled": None}})
+            assert default_ledger.heat_config["enabled"] is True
+            # ring setting validates
+            from opensearch_tpu.common.errors import (
+                IllegalArgumentException,
+            )
+
+            with pytest.raises(IllegalArgumentException):
+                node.put_cluster_settings({"persistent": {
+                    "telemetry.heat.ring": "2"}})
+            node.put_cluster_settings({"persistent": {
+                "telemetry.heat.ring": "128"}})
+            assert default_ledger.heat_config["ring"] == 128
+        finally:
+            node.put_cluster_settings({"persistent": {
+                "telemetry.heat.enabled": None,
+                "telemetry.heat.ring": None}})
+
+
+# ---------------------------------------------------------------------------
+# cluster: heat section fan-out + cross-node residency advertisement
+# ---------------------------------------------------------------------------
+
+
+class TestClusterSurfaces:
+    def _knn_cluster(self, tmp_path, seed):
+        from tests.test_cluster_data import DataSim
+
+        sim = DataSim(2, seed=seed, tmp_path=tmp_path)
+        for _ in range(30):  # run until every node knows the leader
+            sim.run(1_000)
+            if all(n.coordinator.leader_id is not None
+                   for n in sim.nodes.values()):
+                break
+        rng = np.random.default_rng(seed)
+        resp = sim.call(sim.nodes["n0"].create_index, "cv", {
+            "settings": {"index": {"number_of_shards": 1,
+                                   "number_of_replicas": 0}},
+            "mappings": {"properties": {
+                "v": {"type": "knn_vector", "dimension": 8,
+                      "space_type": "l2"}}}})
+        assert resp.get("acknowledged"), resp
+        sim.run(3_000)
+        for i in range(24):
+            r = sim.call(sim.nodes["n0"].index_doc, "cv", str(i),
+                         {"v": rng.standard_normal(8).tolist()})
+            assert "error" not in r, r
+        sim.call(sim.nodes["n0"].refresh, "cv")
+        sim.run(1_000)
+        resp = sim.call(sim.nodes["n0"].search, "cv", {
+            "size": 3, "query": {"knn": {"v": {
+                "vector": rng.standard_normal(8).tolist(), "k": 3}}}})
+        assert "error" not in resp, resp
+        return sim
+
+    def test_cluster_heat_section_and_narrowing(self, tmp_path):
+        sim = self._knn_cluster(tmp_path, seed=41)
+        try:
+            n0 = sim.nodes["n0"]
+            full = n0._on_node_stats("x", {"full": True})
+            assert any(r["index"] == "cv" for r in full["heat"]["rows"])
+            narrowed = n0._on_node_stats(
+                "x", {"full": True, "sections": ["metrics"]})
+            assert "heat" not in narrowed
+        finally:
+            for n in sim.nodes.values():
+                n.close()
+
+    def test_residency_advertisement_seeds_fresh_board(self, tmp_path):
+        sim = self._knn_cluster(tmp_path, seed=67)
+        try:
+            owner = next(n for n in sim.nodes.values()
+                         if ("cv", 0) in n.local_shards)
+            other = next(n for n in sim.nodes.values() if n is not owner)
+            # the warm set piggybacks on the LIGHT stats answer
+            resp = owner._on_node_stats("x", {})
+            assert ["cv", "v"] in resp.get("residency", [])
+            # a fresh coordinator (empty board) seeds from join-time
+            # stats traffic: before any stamped partial reaches it, the
+            # board already knows the warm copy
+            other.residency_board.prune(live_nodes=set())
+            assert other.residency_board.warm_nodes("cv", "v") == set()
+            other._residency_seeded = False
+            other._maybe_seed_residency_board()
+            sim.run(2_000)
+            assert owner.node_id in \
+                other.residency_board.warm_nodes("cv", "v")
+        finally:
+            for n in sim.nodes.values():
+                n.close()
+
+    def test_dropped_advertisement_revokes_warmth(self, tmp_path):
+        """A pair that leaves a node's advertised warm set (its bundle
+        evicted under budget pressure) must be observed COLD — an
+        advertise-only board would latch stale warmth and route launches
+        onto a copy that has to rebuild the slab."""
+        sim = self._knn_cluster(tmp_path, seed=67)
+        try:
+            owner = next(n for n in sim.nodes.values()
+                         if ("cv", 0) in n.local_shards)
+            other = next(n for n in sim.nodes.values() if n is not owner)
+            other._observe_residency(
+                owner.node_id, owner._on_node_stats("x", {}))
+            assert owner.node_id in \
+                other.residency_board.warm_nodes("cv", "v")
+            # the bundle leaves the owner's registry (budget eviction
+            # path); the next stats answer no longer advertises the pair
+            owner.shard_mesh.invalidate_index("cv")
+            other._observe_residency(
+                owner.node_id, owner._on_node_stats("x", {}))
+            assert owner.node_id not in \
+                other.residency_board.warm_nodes("cv", "v")
+        finally:
+            for n in sim.nodes.values():
+                n.close()
+
+    def test_advertisement_respects_kill_switch(self, tmp_path):
+        from opensearch_tpu.cluster import residency as residency_mod
+
+        sim = self._knn_cluster(tmp_path, seed=71)
+        try:
+            owner = next(n for n in sim.nodes.values()
+                         if ("cv", 0) in n.local_shards)
+            residency_mod.default_config.enabled = False
+            try:
+                resp = owner._on_node_stats("x", {})
+                assert "residency" not in resp
+            finally:
+                residency_mod.default_config.enabled = True
+        finally:
+            for n in sim.nodes.values():
+                n.close()
