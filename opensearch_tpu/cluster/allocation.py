@@ -46,6 +46,10 @@ class AllocationSettings:
     rebalance_threshold: int = 1
     # per-node observed disk usage pct (fs stats fed by heartbeats)
     disk_usage: dict[str, float] = field(default_factory=dict)
+    # cluster-level FilterAllocationDecider: node NAMES being drained
+    # (cluster.routing.allocation.exclude._name) — no new copies land
+    # there and existing copies relocate off (graceful decommission)
+    exclude_names: tuple[str, ...] = ()
 
     @staticmethod
     def from_cluster(state: ClusterState,
@@ -55,6 +59,7 @@ class AllocationSettings:
         persistent over default — ClusterSettings.java:205)."""
         eff = {**state.settings, **state.transient_settings}
         aw = eff.get("cluster.routing.allocation.awareness.attributes")
+        excl = eff.get("cluster.routing.allocation.exclude._name")
         return AllocationSettings(
             max_concurrent_recoveries_per_node=int(eff.get(
                 "cluster.routing.allocation.node_concurrent_recoveries", 4
@@ -70,6 +75,9 @@ class AllocationSettings:
                 "cluster.routing.rebalance.enable", "all"
             )).lower() != "none",
             disk_usage=dict(disk_usage or {}),
+            exclude_names=tuple(
+                n.strip() for n in str(excl).split(",") if n.strip()
+            ) if excl else (),
         )
 
 
@@ -101,6 +109,11 @@ def _decide(
         exclude = meta.settings.get("routing.allocation.exclude._name")
         if exclude is not None and node.name in str(exclude).split(","):
             return False
+    # cluster-level FilterAllocationDecider: a node being drained takes no
+    # new copies (the evacuation pass moves existing ones off it); matches
+    # the node name, falling back to the id for unnamed nodes
+    if (node.name or node.node_id) in settings.exclude_names:
+        return False
     # DiskThresholdDecider (low watermark): no NEW shard on a filling node
     usage = settings.disk_usage.get(node_id)
     if usage is not None and usage >= settings.disk_low_watermark_pct:
@@ -143,19 +156,6 @@ def reroute(state: ClusterState, settings: AllocationSettings | None = None) -> 
     settings = settings or AllocationSettings()
     new_routing: list[ShardRoutingEntry] = []
     data_nodes = [n.node_id for n in state.nodes.values() if n.is_data]
-    # DiskThresholdDecider high watermark: REPLICAS on nodes above high
-    # drain away (drop the assignment; the allocator below re-places them
-    # on nodes the deciders approve). Primaries stay put — moving the only
-    # authoritative copy on a full disk trades availability for space.
-    drain = {
-        nid for nid, pct in settings.disk_usage.items()
-        if pct >= settings.disk_high_watermark_pct
-    }
-    if drain:
-        state = state.with_(routing=tuple(
-            r for r in state.routing
-            if not (not r.primary and r.node_id in drain)
-        ))
 
     def node_load(node_id: str) -> int:
         return sum(1 for r in new_routing if r.node_id == node_id)
@@ -290,9 +290,106 @@ def reroute(state: ClusterState, settings: AllocationSettings | None = None) -> 
                 else:
                     new_routing.append(entry)  # UNASSIGNED
 
-    if settings.rebalance_enabled:
+    # evacuation (DiskThresholdDecider high watermark + cluster exclude
+    # filter) runs before the balance pass; at most one topology change
+    # per publication, so a reshape converges over successive publications
+    evacuated = _evacuate(state, new_routing, data_nodes, settings)
+    if evacuated is not new_routing:
+        new_routing = evacuated
+    elif settings.rebalance_enabled:
         new_routing = _rebalance(state, new_routing, data_nodes, settings)
     return state.with_(routing=tuple(new_routing))
+
+
+def _evacuate(state: ClusterState, routing: list[ShardRoutingEntry],
+              data_nodes: list[str],
+              settings: AllocationSettings) -> list[ShardRoutingEntry]:
+    """Move shard copies OFF nodes that must not hold them: nodes at or
+    above the disk high watermark (replicas evacuate; primaries stay put —
+    moving the only authoritative copy on a full disk trades availability
+    for space) and nodes named by the cluster exclude filter (graceful
+    decommission: replicas relocate off, primaries hand their ROLE to a
+    started replica elsewhere first, and a node holding the only serving
+    copy of a shard is REFUSED — the copy stays until another exists).
+
+    Every move is a real relocation: the source keeps serving in state
+    RELOCATING while the shadow target recovers, and `mark_shard_started`
+    performs the atomic swap — per-shard unavailability stays bounded by
+    the swap itself, not the copy duration. One move per publication."""
+    if any(r.state == "RELOCATING" or r.is_relocation_target
+           for r in routing):
+        return routing
+    over = {
+        nid for nid, pct in settings.disk_usage.items()
+        if pct >= settings.disk_high_watermark_pct
+    }
+    excluded = {
+        nid for nid in data_nodes
+        if (state.nodes[nid].name or nid) in settings.exclude_names
+    }
+    leaving = over | excluded
+    if not leaving:
+        return routing
+
+    def load(nid: str) -> int:
+        return sum(1 for r in routing if r.node_id == nid)
+
+    for i, r in enumerate(routing):
+        if r.node_id not in leaving or r.primary or r.state != "STARTED":
+            continue
+        others = [x for j, x in enumerate(routing) if j != i]
+        candidates = sorted(
+            (nid for nid in data_nodes
+             if nid not in leaving
+             and _decide(state, r, nid, others, settings)),
+            key=lambda nid: (load(nid), nid),
+        )
+        if candidates:
+            target = candidates[0]
+            routing = list(routing)
+            routing[i] = ShardRoutingEntry(
+                r.index, r.shard, r.node_id, primary=False,
+                state="RELOCATING", relocating_node=target,
+            )
+            routing.append(ShardRoutingEntry(
+                r.index, r.shard, target, primary=False,
+                state="INITIALIZING", relocating_node=r.node_id,
+            ))
+            return routing
+        if r.node_id in over:
+            # no decider-approved target but the disk is critical: drop
+            # the replica to free space — but NEVER the only serving copy
+            serving_elsewhere = any(
+                x.index == r.index and x.shard == r.shard
+                and x.state in ("STARTED", "RELOCATING")
+                for x in others
+            )
+            if serving_elsewhere:
+                return [x for j, x in enumerate(routing) if j != i]
+    # primaries on EXCLUDED nodes (decommission only — watermark leaves
+    # primaries in place): swap the primary role onto a started replica
+    # on a staying node; the demoted copy becomes a replica the next
+    # round relocates
+    for i, r in enumerate(routing):
+        if not (r.node_id in excluded and r.primary
+                and r.state == "STARTED"):
+            continue
+        for j, other in enumerate(routing):
+            if (other.index == r.index and other.shard == r.shard
+                    and not other.primary and other.state == "STARTED"
+                    and other.node_id is not None
+                    and other.node_id not in leaving):
+                routing = list(routing)
+                routing[i] = ShardRoutingEntry(
+                    r.index, r.shard, r.node_id, primary=False,
+                    state="STARTED",
+                )
+                routing[j] = ShardRoutingEntry(
+                    other.index, other.shard, other.node_id, primary=True,
+                    state="STARTED",
+                )
+                return routing
+    return routing
 
 
 def _rebalance(state: ClusterState, routing: list[ShardRoutingEntry],

@@ -102,6 +102,15 @@ class ClusterNode:
         # disk_usage_pct directly (the FsHealthService probe analog)
         self.disk_usage_pct: float | None = None
         self._node_disk: dict[str, float] = {}
+        # fault-injection hooks (testing/soak.py FaultScheduler): a clock
+        # skew offsets THIS node's monotonic reads (the timeutil clock is
+        # process-global under the sim, so skew must be per-node here);
+        # a worker delay stalls the serial data executor's jobs
+        self.clock_skew_ms: int = 0
+        self.data_worker_delay_ms: int = 0
+        # leader-side watermark classification per node (low/high) — a
+        # crossing triggers a reroute publication (DiskThresholdMonitor)
+        self._disk_classes: dict[str, tuple[bool, bool]] = {}
         from opensearch_tpu.cluster.allocation import AllocationSettings
 
         def transform(state: ClusterState) -> ClusterState:
@@ -130,6 +139,7 @@ class ClusterNode:
             pct = extras.get("disk_used_pct")
             if pct is not None:
                 self._node_disk[peer] = float(pct)
+                self._maybe_reroute_on_disk(peer, float(pct))
 
         self.coordinator.on_follower_extras = on_extras
         # addSettingsUpdateConsumer registry, notified at state application
@@ -297,6 +307,9 @@ class ClusterNode:
         reg(node_id, "internal:index/shard/recovery/finalize",
             self._on_recovery_finalize)
         reg(node_id, "indices:monitor/recovery[node]", self._on_node_recovery)
+        reg(node_id, "internal:snapshot/shard_dump", self._on_snapshot_shard_dump)
+        reg(node_id, "internal:snapshot/restore_dump",
+            self._on_snapshot_restore_dump)
         # per-node reader contexts (scroll/PIT pin snapshots node-side; the
         # coordinator's scroll id maps node -> local ctx — ReaderContext
         # .java:64 semantics distributed)
@@ -357,6 +370,50 @@ class ClusterNode:
             self._SHARD_STATE_TICK_MS, self._shard_state_tick
         )
 
+    def _maybe_reroute_on_disk(self, nid: str, pct: float | None) -> None:
+        """DiskThresholdMonitor analog: disk stats arrive on heartbeat
+        acks, but reroute only runs INSIDE a publication — without a
+        trigger, a node filling past the high watermark would sit full
+        until some unrelated state change. A watermark-classification
+        crossing (below/above low, below/above high, either direction)
+        on any node submits an identity task so the publication
+        transform's reroute evaluates the new disk picture."""
+        if not self.is_leader:
+            return
+        from opensearch_tpu.cluster.allocation import AllocationSettings
+
+        s = AllocationSettings.from_cluster(self.applied_state)
+        cls = (False, False) if pct is None else (
+            pct >= s.disk_low_watermark_pct,
+            pct >= s.disk_high_watermark_pct,
+        )
+        if self._disk_classes.get(nid, (False, False)) == cls:
+            return
+        self._disk_classes[nid] = cls
+        from opensearch_tpu.cluster.coordination import CoordinationError
+
+        try:
+            self.coordinator.submit_state_update(lambda st: st)
+        except CoordinationError:
+            pass
+
+    def _allocator_pending(self) -> bool:
+        """Would the publication transform's reroute change the applied
+        routing table? Uses the same disk picture the transform uses, so
+        a True here means the next publication makes progress."""
+        from opensearch_tpu.cluster.allocation import (
+            AllocationSettings,
+            reroute,
+        )
+
+        state = self.applied_state
+        disk = dict(self._node_disk)
+        own = self._disk_usage()
+        if own is not None:
+            disk[self.node_id] = own
+        out = reroute(state, AllocationSettings.from_cluster(state, disk))
+        return set(out.routing) != set(state.routing)
+
     def _shard_state_tick(self) -> None:
         if getattr(self, "_closed", False):
             return
@@ -366,6 +423,26 @@ class ClusterNode:
         # pin expired scroll/PIT snapshots forever (the reference runs a
         # dedicated keep-alive reaper thread for the same reason)
         self._reap_reader_contexts()
+        # the leader's OWN disk crossing a watermark must trigger a
+        # reroute too (no heartbeat carries it back to itself)
+        if self.is_leader:
+            self._maybe_reroute_on_disk(self.node_id, self._disk_usage())
+            # RoutingService analog: multi-step reshapes (rebalance chains,
+            # primary-role swaps, evacuations) apply ONE change per
+            # publication and rely on a follow-up to continue — but the
+            # last change of a chain has no natural follow-up event. If
+            # the allocator still wants changes against the applied state,
+            # nudge a publication so the chain converges instead of
+            # stalling one step short.
+            if self._allocator_pending():
+                from opensearch_tpu.cluster.coordination import (
+                    CoordinationError,
+                )
+
+                try:
+                    self.coordinator.submit_state_update(lambda st: st)
+                except CoordinationError:
+                    pass
         for r in self.applied_state.shards_for_node(self.node_id):
             if r.state != "INITIALIZING":
                 continue
@@ -411,6 +488,10 @@ class ClusterNode:
         # map on the sim/serving path needs eviction)
         self._node_disk = {
             nid: pct for nid, pct in self._node_disk.items()
+            if nid in state.nodes
+        }
+        self._disk_classes = {
+            nid: cls for nid, cls in self._disk_classes.items()
             if nid in state.nodes
         }
         # residency-routing board: a departed node or deleted index must
@@ -1104,6 +1185,62 @@ class ClusterNode:
                 self.recoveries.items())
             if want is None or index in want
         ]}
+
+    # -- cluster snapshots (ClusterSnapshotsService orchestrates) -----------
+
+    def _on_snapshot_shard_dump(self, sender: str, payload: dict):
+        """Logical point-in-time live-doc set of a local shard copy: the
+        unrefreshed buffer (later write wins), segment live docs, minus
+        anything the version map says is deleted. Runs on the data worker
+        so the engine's single-writer discipline holds while we walk the
+        buffer."""
+
+        def run() -> dict:
+            shard = self._local_shard(payload["index"], payload["shard"])
+            engine = shard.engine
+            by_id: dict[str, Any] = {}
+            for entry in engine._buffer:
+                if entry is None:
+                    continue
+                parsed, _seq = entry
+                by_id[parsed.doc_id] = parsed.source
+            snapshot = engine.acquire_searcher()
+            for host, _dev in snapshot.segments:
+                for d in range(host.n_docs):
+                    if not host.live[d]:
+                        continue
+                    doc_id = host.doc_ids[d]
+                    if doc_id not in by_id:
+                        by_id[doc_id] = json.loads(host.sources[d])
+            for doc_id, vme in engine.version_map.items():
+                if vme.deleted:
+                    by_id.pop(doc_id, None)
+            return {
+                "docs": [{"id": i, "source": by_id[i]} for i in sorted(by_id)],
+                "max_seq_no": engine.max_seq_no,
+            }
+
+        return self._offload(run)
+
+    def _on_snapshot_restore_dump(self, sender: str, payload: dict):
+        """Install a snapshot shard's doc set into a freshly created
+        primary (restore targets are replicas=0, so primary-only install
+        is the complete copy)."""
+
+        def run() -> dict:
+            shard = self._local_shard(payload["index"], payload["shard"])
+            if not shard.primary:
+                raise OpenSearchTpuException(
+                    f"restore target [{payload['index']}][{payload['shard']}]"
+                    f" on [{self.node_id}] is not the primary"
+                )
+            for op in payload["docs"]:
+                shard.apply_index_on_primary(op["id"], op["source"])
+            shard.engine.translog.sync()
+            shard.refresh()
+            return {"restored": len(payload["docs"])}
+
+        return self._offload(run)
 
     # ------------------------------------------------------------------ #
     # metadata APIs (routed to the leader)
@@ -2390,7 +2527,26 @@ class ClusterNode:
         (no loop, no threads)."""
         loop = getattr(self.scheduler, "loop", None)
         if loop is None:
-            return fn()
+            delay = self.data_worker_delay_ms
+            if delay <= 0:
+                return fn()
+            # slow-data-worker fault injection: the job runs after a
+            # virtual-time stall, resolving the same DeferredResponse the
+            # threaded path uses (every consumer isinstance-checks it)
+            from opensearch_tpu.transport.base import DeferredResponse
+
+            deferred = DeferredResponse()
+
+            def run() -> None:
+                try:
+                    result = fn()
+                except Exception as e:  # noqa: BLE001 - travels back as error
+                    deferred.set_exception(e)
+                else:
+                    deferred.set_result(result)
+
+            self.scheduler.schedule(delay, run)
+            return deferred
         from concurrent.futures import ThreadPoolExecutor
 
         if self._data_executor is None:
@@ -2684,12 +2840,14 @@ class ClusterNode:
 
         return self._offload_search(run, lane=lane)
 
-    @staticmethod
-    def _now_ms() -> int:
-        # injectable clock: the deterministic sim controls context expiry
+    def _now_ms(self) -> int:
+        # injectable clock: the deterministic sim controls context expiry.
+        # clock_skew_ms shifts only THIS node's reads (the fault-injection
+        # hook: the sim's clock is process-global, so per-node skew lives
+        # here) — expiry decisions degrade gracefully, never wedge
         from opensearch_tpu.common.timeutil import monotonic_millis
 
-        return monotonic_millis()
+        return monotonic_millis() + self.clock_skew_ms
 
     def _reap_reader_contexts(self) -> None:
         now = self._now_ms()

@@ -43,6 +43,10 @@ DYNAMIC_CLUSTER_SETTINGS: dict[str, Callable[[Any], None] | None] = {
     "cluster.routing.allocation.disk.watermark.low": _validate_pct,
     "cluster.routing.allocation.disk.watermark.high": _validate_pct,
     "cluster.routing.allocation.awareness.attributes": None,
+    # cluster-level FilterAllocationDecider: comma-separated node NAMES to
+    # drain (graceful decommission — shards relocate off, then the node
+    # can leave with zero acked-write loss)
+    "cluster.routing.allocation.exclude._name": None,
     "cluster.routing.allocation.enable": _validate_enable,
     "cluster.routing.rebalance.enable": _validate_enable,
     "search.max_buckets": _validate_pos_int,

@@ -398,6 +398,11 @@ class Coordinator:
         try:
             publish_request = self.coord.handle_client_value(new_state)
         except CoordinationError:
+            # a refused publication must not eat the submitted tasks:
+            # leave them queued for the next trigger (or to die with
+            # leadership) instead of silently dropping client updates
+            # submitted during a term flap
+            self._pending_tasks = tasks + self._pending_tasks
             return
         self._publishing = True
         self._run_publication(publish_request)

@@ -6,8 +6,24 @@ refresh / force-merge (and the relocations and re-recoveries that node
 kill/heal cycles force) against a live mixed query stream — BM25 match,
 kNN through the dispatch batcher, aggregations, hybrid BM25+kNN fusion,
 msearch, scroll and PIT — on a multi-node simulated cluster, while a
-fault scheduler injects node kills, partitions, slow links and one-way
-drops from the MockTransport disruption machinery.
+:class:`FaultScheduler` injects node kills, partitions, slow links,
+one-way drops, disk-full ramps (the DiskThresholdDecider must evacuate),
+clock skew and slow data workers from the MockTransport disruption
+machinery and the node-level fault hooks.
+
+Cluster SHAPE is part of the seeded plan too: a topology cycle
+(``topology_cycle``) runs an elastic reshape under the live mixed
+traffic — a fresh node boots mid-soak and joins (receiving peer
+recoveries and warming its residency board before it takes query
+traffic), the join triggers an online rebalance, a ``disk_usage_pct``
+ramp pushes one node over the high watermark so the decider evacuates
+its replicas, and finally one node is gracefully drained
+(``cluster.routing.allocation.exclude._name``) and departs with zero
+acked-write loss. Optional cluster-mode snapshots
+(:class:`~opensearch_tpu.snapshots.service.ClusterSnapshotsService`)
+ride the op mix: create/status/restore cycles interleave with bulk and
+chaos, and every restored index must match the acked-write ledger at
+snapshot time.
 
 Everything is replayable from ONE seed: virtual time comes from the
 DeterministicTaskQueue (installed via timeutil.clock_scope), entropy from
@@ -39,7 +55,20 @@ quiesce:
   all shards STARTED on live nodes, nothing relocating or unassigned;
 - **interactive-under-flood** — with a wlm `enforced` group flooding
   bulk, the flood sheds 429s at its slot share while every interactive
-  query issued during the flood completes.
+  query issued during the flood completes;
+- **watermark-respected** — no shard is ever newly assigned to a node
+  the leader already knew was over the high disk watermark;
+- **relocation-isolation** — one response never merges two copies of
+  the same shard (a pre-move and a post-move snapshot);
+- **bounded-unavailability** — every shard keeps a live serving copy,
+  with only a bounded probe-streak of unavailability tolerated while
+  fault recovery runs (zero tolerance while a relocation's live source
+  should be serving);
+- **balanced-convergence** — the routing table at quiesce is a FIXED
+  POINT of the allocator (re-running reroute with the leader's disk
+  view changes nothing: balanced, fully STARTED);
+- **throughput-floor** — per-cycle per-class ops/sec never drop below
+  a seed-recorded baseline floor (the `soak_baseline.json` ratchet).
 
 Run it::
 
@@ -55,6 +84,7 @@ it via ``run_soak(extra_invariants=[...])`` — hooks fire per response
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import json
 import random
@@ -109,6 +139,20 @@ class SoakConfig:
     # no-acked-write-loss invariant MUST fire (replay regression tests)
     inject_acked_write_loss: bool = False
     replica_count: int = 1
+    # which cycle runs the elastic-topology reshape (join -> online
+    # rebalance -> watermark evacuation -> graceful drain) under the live
+    # mixed traffic; -1 disables. The reshape cycle runs no random faults
+    # — the reshape IS its adversarial condition.
+    topology_cycle: int = -1
+    # the fault kinds the FaultScheduler may draw from
+    fault_kinds: tuple = ("kill", "partition", "slow_link", "one_way",
+                          "disk_full", "clock_skew", "slow_worker")
+    # run a snapshot create/status/restore/verify chain in every cycle's
+    # op mix (ClusterSnapshotsService against the "logs" index)
+    snapshots: bool = False
+    # per-class ops/sec floors (the soak_baseline.json ratchet): any
+    # cycle whose rate drops below floor * ThroughputFloor.FACTOR fails
+    throughput_floors: dict | None = None
 
 
 @dataclass
@@ -125,6 +169,12 @@ class SoakReport:
     # aggregate span-exporter accounting across nodes at final quiesce
     # (the telemetry-bounded invariant's post-flush numbers)
     telemetry: dict = field(default_factory=dict)
+    # topology reshape milestones (join / watermark_evacuation / drain)
+    topology: list = field(default_factory=list)
+    # per-cycle per-class completed ops/sec of virtual time
+    throughput: dict = field(default_factory=dict)
+    # snapshot workload accounting (creates / restores / verified docs)
+    snapshots: dict = field(default_factory=dict)
     digest: str = ""
 
     def to_dict(self) -> dict:
@@ -137,6 +187,9 @@ class SoakReport:
             "invariants_checked": self.invariants_checked,
             "flood": self.flood,
             "telemetry": self.telemetry,
+            "topology": self.topology,
+            "throughput": {str(k): v for k, v in self.throughput.items()},
+            "snapshots": self.snapshots,
             "digest": self.digest,
         }
 
@@ -699,12 +752,313 @@ class RooflineBounded(Invariant):
         self.at_probe(h)
 
 
+class WatermarkRespected(Invariant):
+    """No shard is ever NEWLY assigned (INITIALIZING — fresh allocation or
+    relocation target) on a node the leader already knew was over the high
+    disk watermark. Compares each probe's fresh assignments against the
+    leader's disk view at the PREVIOUS probe, so heartbeat lag (a node
+    ramping over the watermark after the assignment decision) cannot fire
+    a false positive — only a knowing assignment violates."""
+
+    name = "watermark-respected"
+
+    def __init__(self) -> None:
+        self._prev_entries: set[tuple] = set()
+        self._prev_over: set[str] = set()
+
+    def at_probe(self, h: "SoakHarness") -> None:
+        from opensearch_tpu.cluster.allocation import AllocationSettings
+
+        leader = h.maybe_live_leader()
+        if leader is None:
+            return
+        state = leader.applied_state
+        settings = AllocationSettings.from_cluster(state)
+        disk = dict(leader._node_disk)
+        own = leader._disk_usage()
+        if own is not None:
+            disk[leader.node_id] = own
+        cur_over = {nid for nid, pct in disk.items()
+                    if pct >= settings.disk_high_watermark_pct}
+        cur = {(r.index, r.shard, r.node_id) for r in state.routing
+               if r.state == "INITIALIZING" and r.node_id is not None}
+        # a node must be over at BOTH bracketing probes to convict: over
+        # only now means it ramped after the decision; over only before
+        # means it legitimately dropped below before the assignment
+        for index, shard, nid in sorted(cur - self._prev_entries):
+            if nid in self._prev_over and nid in cur_over:
+                h.fail(self, f"[{index}][{shard}] assigned on {nid}, which "
+                             f"the leader already knew was over the high "
+                             f"watermark")
+        self._prev_over = cur_over
+        self._prev_entries = cur
+
+    def at_quiesce(self, h: "SoakHarness") -> None:
+        self.at_probe(h)
+
+
+class RelocationGenerationIsolation(Invariant):
+    """One response never merges two copies of the same shard: across a
+    relocation swap the pre-move and post-move snapshots both exist, and a
+    query that collected partials from BOTH would double-serve (or tear)
+    the shard. The per-shard generation stamps carry the serving node, so
+    two nodes answering one shard inside one response is the violation."""
+
+    name = "relocation-isolation"
+
+    def on_response(self, h: "SoakHarness", op: dict, resp: dict) -> None:
+        served: dict[tuple[str, int], set[str]] = {}
+        for (index, shard_num, nid, _engine_id) in \
+                (op.get("generations") or {}):
+            served.setdefault((index, shard_num), set()).add(nid)
+        for (index, shard_num), nids in sorted(served.items()):
+            if len(nids) > 1:
+                h.fail(self, f"op#{op['i']} [{op['kind']}] merged "
+                             f"[{index}][{shard_num}] partials from "
+                             f"{sorted(nids)} — a query crossed a "
+                             f"relocation swap")
+
+
+class BoundedShardUnavailability(Invariant):
+    """Every workload shard keeps a live serving copy (STARTED or
+    RELOCATING source on an up node). Faults may take copies away, but
+    only for a BOUNDED streak of probes — recovery must reinstate a
+    serving copy; a shard dark past the bound is stuck, not degraded.
+    While a relocation is in flight with its source alive the source
+    still serves, so moves get zero tolerance by construction."""
+
+    name = "bounded-unavailability"
+
+    # consecutive 500ms probes a shard may lack a live serving copy
+    # (covers kill -> shard-failed -> reassign -> recover under chaos)
+    LIMIT = 60
+
+    def __init__(self) -> None:
+        self._streak: dict[tuple[str, int], int] = {}
+
+    def at_probe(self, h: "SoakHarness") -> None:
+        leader = h.maybe_live_leader()
+        if leader is None:
+            # an election in progress is leadership unavailability, not
+            # shard unavailability; the convergence invariant owns it
+            self._streak.clear()
+            return
+        state = leader.applied_state
+        down = h.transport.down
+        for index in h.indices:
+            meta = state.indices.get(index)
+            if meta is None:
+                continue
+            copies_by_shard: dict[int, list] = {n: []
+                                                for n in
+                                                range(meta.num_shards)}
+            for r in state.routing:
+                if r.index == index and r.shard in copies_by_shard:
+                    copies_by_shard[r.shard].append(r)
+            for num, copies in copies_by_shard.items():
+                serving = [r for r in copies
+                           if r.state in ("STARTED", "RELOCATING")
+                           and r.node_id is not None
+                           and r.node_id not in down]
+                key = (index, num)
+                if serving:
+                    self._streak.pop(key, None)
+                    continue
+                streak = self._streak.get(key, 0) + 1
+                self._streak[key] = streak
+                if streak > self.LIMIT:
+                    h.fail(self, f"[{index}][{num}] had no live serving "
+                                 f"copy for {streak} consecutive probes "
+                                 f"(routing: {copies})")
+
+    def at_quiesce(self, h: "SoakHarness") -> None:
+        self._streak.clear()
+
+
+class BalancedConvergence(Invariant):
+    """The quiesced routing table is a FIXED POINT of the allocator:
+    re-running reroute with the leader's own disk view must change
+    nothing. Convergence (everything STARTED) is not enough after a
+    reshape — the table must also be where the balancer would have put
+    it, or the next publication silently starts moving shards again."""
+
+    name = "balanced-convergence"
+
+    def at_quiesce(self, h: "SoakHarness") -> None:
+        from opensearch_tpu.cluster.allocation import (
+            AllocationSettings,
+            reroute,
+        )
+
+        leader = h.live_leader()
+        state = leader.applied_state
+        disk = dict(leader._node_disk)
+        own = leader._disk_usage()
+        if own is not None:
+            disk[leader.node_id] = own
+        out = reroute(state, AllocationSettings.from_cluster(state, disk))
+        before = sorted(repr(r) for r in state.routing)
+        after = sorted(repr(r) for r in out.routing)
+        if before != after:
+            moved = [r for r in after if r not in before]
+            h.fail(self, f"routing at quiesce is not an allocator fixed "
+                         f"point — reroute still wants: {moved[:4]}")
+
+
+class ThroughputFloor(Invariant):
+    """Per-cycle per-class throughput ratchet: completed ops per virtual
+    second must stay above the seed-recorded baseline floor (times the
+    tolerance factor) for every workload class the baseline covers. A
+    chaos cycle that quietly grinds to a crawl is a regression even when
+    every op eventually completes."""
+
+    name = "throughput-floor"
+
+    # a cycle may degrade to this fraction of the recorded floor before
+    # the invariant fires (chaos cycles legitimately run slower than the
+    # baseline recording's best cycle)
+    FACTOR = 0.5
+
+    def at_quiesce(self, h: "SoakHarness") -> None:
+        floors = h.cfg.throughput_floors or {}
+        rates = h.report.throughput.get(h.cycle) or {}
+        for cls, floor in sorted(floors.items()):
+            rate = rates.get(cls)
+            if rate is None:
+                continue
+            bound = floor * self.FACTOR
+            if rate < bound:
+                h.fail(self, f"cycle {h.cycle} [{cls}] throughput "
+                             f"{rate:.3f} ops/s below floor {bound:.3f} "
+                             f"(baseline {floor:.3f} x {self.FACTOR})")
+
+
 DEFAULT_INVARIANTS: tuple[Callable[[], Invariant], ...] = (
     AckedWritesSurvive, SnapshotIsolation, RecoveryMonotonicity,
     ShedCorrectness, BoundedQueues, ClusterConverges, InteractiveUnderFlood,
     InteractiveP99Floor, TelemetryBounded, DeviceLedgerBounded,
-    RooflineBounded, HeatBounded,
+    RooflineBounded, HeatBounded, WatermarkRespected,
+    RelocationGenerationIsolation, BoundedShardUnavailability,
+    BalancedConvergence, ThroughputFloor,
 )
+
+
+# --------------------------------------------------------------------- #
+# fault scheduling
+# --------------------------------------------------------------------- #
+
+
+# workload classes for the per-cycle throughput ratchet
+_OP_CLASS = {
+    "index": "ingest", "bulk": "ingest", "delete": "ingest",
+    "bulk_flood": "ingest", "ann_rebuild": "ingest",
+    "refresh": "maint", "flush": "maint", "force_merge": "maint",
+    "snapshot_cycle": "snapshot",
+}
+
+
+class FaultScheduler:
+    """Plans and injects the per-cycle fault schedule from the seeded
+    fault stream. Transport faults (kill / partition / slow_link /
+    one_way) ride the MockTransport disruption machinery; node faults
+    ride the ClusterNode fault hooks — ``disk_full`` ramps
+    ``disk_usage_pct`` so the heartbeat path carries it to the leader and
+    the DiskThresholdDecider evacuates, ``clock_skew`` offsets the node's
+    reader-context clock, ``slow_worker`` delays the serial data worker.
+    ``heal_all`` restores every baseline at quiesce."""
+
+    BASELINE_DISK_PCT = 40.0
+
+    def __init__(self, harness: "SoakHarness"):
+        self.h = harness
+
+    def plan_cycle(self) -> list[dict]:
+        """1-2 sequential faults per chaos cycle, all healed well before
+        the cycle ends. Flood cycles run fault-free (the bulk flood IS
+        the adversarial condition and interactive-under-flood needs
+        clean-network determinism); the topology cycle runs fault-free
+        too (the reshape is its chaos — concurrent kills are covered by
+        the fault-injection edge-case tests)."""
+        h = self.h
+        if not h.cfg.chaos or h.cycle == h.cfg.flood_cycle \
+                or h.cfg.flood_all or h.cycle == h.cfg.topology_cycle:
+            return []
+        out = []
+        t = h.frng.randint(1_500, 3_000)
+        for _ in range(h.frng.randint(1, 2)):
+            kind = h.frng.choice(list(h.cfg.fault_kinds))
+            duration = h.frng.randint(2_500, 6_000)
+            if t + duration > h.cfg.cycle_ms - 5_000:
+                break
+            a, b = h.frng.sample(h.node_ids, 2)
+            fault = {"kind": kind, "at": t, "duration": duration,
+                     "a": a, "b": b}
+            if kind == "clock_skew":
+                fault["skew"] = h.frng.choice([-4_000, -2_000,
+                                               2_000, 4_000])
+            elif kind == "slow_worker":
+                fault["delay"] = h.frng.randint(80, 150)
+            out.append(fault)
+            t += duration + h.frng.randint(1_500, 3_000)
+        return out
+
+    def inject(self, fault: dict) -> None:
+        h = self.h
+        kind, a, b = fault["kind"], fault["a"], fault["b"]
+        h.log_event("fault", kind=kind, a=a, b=b)
+        h.report.faults_injected.append(kind)
+        node = h.nodes.get(a)
+        if kind == "kill":
+            h.transport.take_down(a)
+        elif kind == "partition":
+            h.transport.partition({a}, {b})
+        elif kind == "slow_link":
+            h.transport.set_latency(a, b, 150)
+        elif kind == "one_way":
+            h.transport.drop_one_way(a, b)
+        elif kind == "disk_full" and node is not None:
+            node.disk_usage_pct = 95.0
+        elif kind == "clock_skew" and node is not None:
+            node.clock_skew_ms = fault["skew"]
+        elif kind == "slow_worker" and node is not None:
+            node.data_worker_delay_ms = fault["delay"]
+
+    def heal(self, fault: dict) -> None:
+        h = self.h
+        kind, a, b = fault["kind"], fault["a"], fault["b"]
+        h.log_event("heal", kind=kind, a=a, b=b)
+        node = h.nodes.get(a)
+        if kind == "kill":
+            h.transport.bring_up(a)
+        elif kind == "partition":
+            h.transport.blackholed.discard((a, b))
+            h.transport.blackholed.discard((b, a))
+        elif kind == "slow_link":
+            h.transport.set_latency(a, b, 0)
+        elif kind == "one_way":
+            h.transport.restore_one_way(a, b)
+        elif kind == "disk_full" and node is not None:
+            node.disk_usage_pct = self.BASELINE_DISK_PCT
+        elif kind == "clock_skew" and node is not None:
+            node.clock_skew_ms = 0
+        elif kind == "slow_worker" and node is not None:
+            node.data_worker_delay_ms = 0
+
+    def heal_all(self) -> None:
+        """Quiesce-time belt and braces: every disruption cleared, every
+        node fault hook back at baseline. Departed nodes stay down; a
+        topology reshape mid-flight keeps ownership of its disk ramp."""
+        h = self.h
+        h.transport.heal()
+        for nid in list(h.transport.down):
+            if nid in h.nodes:
+                h.transport.bring_up(nid)
+        reshaping = h._topology_pending > 0
+        for node in h.nodes.values():
+            node.clock_skew_ms = 0
+            node.data_worker_delay_ms = 0
+            if not reshaping:
+                node.disk_usage_pct = self.BASELINE_DISK_PCT
 
 
 # --------------------------------------------------------------------- #
@@ -934,17 +1288,27 @@ class SoakHarness:
         self.cfg = cfg
         self.queue = DeterministicTaskQueue(cfg.seed)
         self.transport = MockTransport(self.queue, timeout_ms=400)
+        self._tmp_path = Path(tmp_path)
+        self._snap_root = self._tmp_path / "csnap"
+        # node_ids is the LIVE member list: topology reshapes append
+        # joiners and remove drained nodes; the bootstrap configuration
+        # stays pinned to the founding members
         self.node_ids = [f"n{i}" for i in range(cfg.nodes)]
+        self._next_ordinal = cfg.nodes
+        bootstrap_ids = list(self.node_ids)
         self.nodes: dict[str, Any] = {}
         for nid in self.node_ids:
             self.nodes[nid] = ClusterNode(
-                nid, Path(tmp_path) / nid, self.transport, self.queue,
+                nid, self._tmp_path / nid, self.transport, self.queue,
                 list(self.node_ids),
             )
         for n in self.nodes.values():
-            n.bootstrap(self.node_ids)
+            n.bootstrap(bootstrap_ids)
         for n in self.nodes.values():
             n.start()
+            # a known disk baseline: fault ramps and topology reshapes
+            # move this, never the host filesystem's real numbers
+            n.disk_usage_pct = FaultScheduler.BASELINE_DISK_PCT
         # span exporters ride the soak: SYNCHRONOUS (no threads under the
         # deterministic queue), in-memory sinks (no file IO), and a
         # seed-derived private RNG per node so tail-sampling decisions
@@ -960,6 +1324,7 @@ class SoakHarness:
                 synchronous=True, mode="memory",
             )
         self.client = SoakClient(self)
+        self.faults = FaultScheduler(self)
         # seed-derived decision streams, independent of the queue's RNG so
         # transport-delay draws can't shift workload plans
         self.wrng = random.Random(cfg.seed * 7_919 + 1)
@@ -983,6 +1348,14 @@ class SoakHarness:
         # interactive-p99-floor invariant's ratchet input)
         self.interactive_latencies: dict[int, list[int]] = {}
         self._probe_timer: Any = None
+        # elastic-topology bookkeeping: >0 while a join/rebalance/drain
+        # chain is in flight (quiesce waits for it; heal_all leaves its
+        # disk ramp alone)
+        self._topology_pending = 0
+        # per-cycle completed-op counts by workload class (throughput
+        # ratchet input); the cycle's virtual start stamp divides them
+        self._cycle_counts: dict[int, dict[str, int]] = {}
+        self._cycle_start_ms = 0
 
     # -- plumbing ----------------------------------------------------------
 
@@ -1010,6 +1383,22 @@ class SoakHarness:
             self.fail("convergence",
                       f"no single live leader: {[n.node_id for n in leaders]}")
         return leaders[0]
+
+    def maybe_live_leader(self):
+        """The single live leader, or None while an election is in
+        flight — probe-time invariants skip rather than convict."""
+        leaders = [n for nid, n in self.nodes.items()
+                   if nid not in self.transport.down and n.is_leader]
+        return leaders[0] if len(leaders) == 1 else None
+
+    def anchor(self) -> str:
+        """A live member to issue control-plane calls through. 'n0' in a
+        static soak, but topology reshapes may drain any node — the
+        anchor follows the membership."""
+        for nid in self.node_ids:
+            if nid in self.nodes and nid not in self.transport.down:
+                return nid
+        return self.node_ids[0]
 
     def call(self, fn, *args, **kwargs) -> dict:
         """Setup-phase helper: run a callback API to completion."""
@@ -1215,9 +1604,20 @@ class SoakHarness:
             # so in-flight batched ANN traffic must observe a NEW build
             # generation — the generation-isolation contract under chaos
             plans.append({
-                "kind": "ann_rebuild", "via": "n0", "index": "annvec",
-                "offset": self.cfg.cycle_ms // 2,
+                "kind": "ann_rebuild", "via": self.anchor(),
+                "index": "annvec", "offset": self.cfg.cycle_ms // 2,
                 "docs": [self._next_doc("annvec") for _ in range(6)],
+            })
+        if self.cfg.snapshots:
+            # one cluster-snapshot create/status/restore/verify cycle per
+            # soak cycle, interleaved with the bulk+chaos mix: the restored
+            # index must match the acked-write ledger at snapshot time
+            plans.append({
+                "kind": "snapshot_cycle",
+                "offset": int(self.cfg.cycle_ms * 0.45),
+                "via": self.wrng.choice(self.node_ids),
+                "name": f"s{self.cycle}",
+                "dest": f"logs-restore-{self.cycle}",
             })
         if flood:
             # one burst of bulks tagged to the enforced flood group, all
@@ -1258,29 +1658,6 @@ class SoakHarness:
         plans.sort(key=lambda p: p["offset"])
         return plans
 
-    def _plan_cycle_faults(self) -> list[dict]:
-        """1-2 sequential faults per chaos cycle, all healed well before
-        the cycle ends. The flood cycle runs fault-free: the bulk flood IS
-        its adversarial condition, and the interactive-under-flood
-        invariant needs clean-network determinism (a partitioned search
-        failing is degradation, not starvation)."""
-        if not self.cfg.chaos or self.cycle == self.cfg.flood_cycle \
-                or self.cfg.flood_all:
-            return []
-        out = []
-        t = self.frng.randint(1_500, 3_000)
-        for _ in range(self.frng.randint(1, 2)):
-            kind = self.frng.choice(
-                ["kill", "partition", "slow_link", "one_way"])
-            duration = self.frng.randint(2_500, 6_000)
-            if t + duration > self.cfg.cycle_ms - 5_000:
-                break
-            a, b = self.frng.sample(self.node_ids, 2)
-            out.append({"kind": kind, "at": t, "duration": duration,
-                        "a": a, "b": b})
-            t += duration + self.frng.randint(1_500, 3_000)
-        return out
-
     # -- op execution ------------------------------------------------------
 
     def _issue(self, plan: dict) -> None:
@@ -1311,6 +1688,14 @@ class SoakHarness:
         outcome = self._outcome_digest(op, resp)
         if outcome.get("error") or outcome.get("failed"):
             self.report.ops_degraded += 1
+        if not outcome.get("error"):
+            # successful completions feed the per-cycle throughput ratchet,
+            # attributed to the ISSUING cycle (stragglers count where they
+            # were planned)
+            per = self._cycle_counts.setdefault(
+                op.get("cycle", self.cycle), {})
+            cls = _OP_CLASS.get(op["kind"], "query")
+            per[cls] = per.get(cls, 0) + 1
         if outcome.get("shed"):
             self.report.sheds += 1
         self.log_event("complete", i=op["i"], kind=op["kind"], **outcome)
@@ -1637,32 +2022,6 @@ class SoakHarness:
 
     # -- faults ------------------------------------------------------------
 
-    def _inject_fault(self, fault: dict) -> None:
-        kind, a, b = fault["kind"], fault["a"], fault["b"]
-        self.log_event("fault", kind=kind, a=a, b=b)
-        self.report.faults_injected.append(kind)
-        if kind == "kill":
-            self.transport.take_down(a)
-        elif kind == "partition":
-            self.transport.partition({a}, {b})
-        elif kind == "slow_link":
-            self.transport.set_latency(a, b, 150)
-        elif kind == "one_way":
-            self.transport.drop_one_way(a, b)
-
-    def _heal_fault(self, fault: dict) -> None:
-        kind, a, b = fault["kind"], fault["a"], fault["b"]
-        self.log_event("heal", kind=kind, a=a, b=b)
-        if kind == "kill":
-            self.transport.bring_up(a)
-        elif kind == "partition":
-            self.transport.blackholed.discard((a, b))
-            self.transport.blackholed.discard((b, a))
-        elif kind == "slow_link":
-            self.transport.set_latency(a, b, 0)
-        elif kind == "one_way":
-            self.transport.restore_one_way(a, b)
-
     def _corrupt_one_copy(self) -> None:
         """Failure-injection hook: remove one acked doc from the primary
         copy, bypassing replication. no-acked-write-loss MUST catch it."""
@@ -1685,6 +2044,298 @@ class SoakHarness:
                        node=primary.node_id, shard=num)
         shard.apply_delete_on_primary(doc_id)
         shard.refresh()
+
+    # -- cluster-mode snapshots (satellite: snapshots in the soak mix) -----
+
+    def _issue_snapshot_cycle(self, op: dict) -> None:
+        """Create -> status -> restore -> verify -> drop, interleaved with
+        the live bulk+chaos mix. The restored index must surface exactly
+        the acked-write ledger at snapshot time: every acked-present doc
+        whose ledger is untouched afterwards must come back, no
+        acked-deleted doc may resurrect, and nothing never-written may
+        appear. Transport-level failures degrade the op (chaos may
+        legitimately break a snapshot); ledger mismatches FAIL the soak."""
+        from opensearch_tpu.snapshots.service import ClusterSnapshotsService
+
+        via = op["via"] if op["via"] in self.nodes else self.anchor()
+        node = self.nodes[via]
+        svc = ClusterSnapshotsService(node, self._snap_root)
+        name, dest = op["name"], op["dest"]
+        base_present = self.acked_present("logs")
+        base_deleted = self.acked_deleted("logs")
+        base_len = {d: len(e) for d, e in self._writes["logs"].items()}
+
+        def degrade(stage: str, err: Any) -> None:
+            self._complete(op, {"error": f"snapshot {stage}: {err}"})
+
+        def cleanup(then) -> None:
+            # the restore target is replicas=0; drop it as soon as the
+            # verdict is in so a stray copy can't wedge convergence later
+            if dest not in node.applied_state.indices:
+                then()
+                return
+            try:
+                node.delete_index(dest, lambda _r: then())
+            except Exception as e:  # noqa: BLE001 - no leader; leave to chaos
+                self.log_event("snapshot_cleanup_error", dest=dest,
+                               error=str(e)[:120])
+                then()
+
+        def on_verified(resp: dict) -> None:
+            if "error" in resp or resp.get("_shards", {}).get("failed"):
+                cleanup(lambda: degrade(
+                    "verify-search",
+                    resp.get("error") or resp.get("_shards")))
+                return
+            restored = {h["_id"] for h in resp["hits"]["hits"]}
+            untouched = {d for d, n in base_len.items()
+                         if len(self._writes["logs"].get(d, ())) == n}
+            missing = sorted((base_present & untouched) - restored)
+            zombies = sorted(restored & (base_deleted & untouched))
+            phantoms = sorted(restored - self.attempted_ids("logs"))
+            if missing:
+                self.fail("snapshot-restore",
+                          f"acked docs absent from restored [{dest}]: "
+                          f"{missing[:5]}")
+            if zombies:
+                self.fail("snapshot-restore",
+                          f"acked-deleted docs resurrected in [{dest}]: "
+                          f"{zombies[:5]}")
+            if phantoms:
+                self.fail("snapshot-restore",
+                          f"never-written docs in restored [{dest}]: "
+                          f"{phantoms[:5]}")
+            snaps = self.report.snapshots
+            snaps["cycles"] = snaps.get("cycles", 0) + 1
+            snaps["verified_docs"] = (snaps.get("verified_docs", 0)
+                                      + len(restored))
+            cleanup(lambda: self._complete(op, {
+                "snapshot": name, "restored": len(restored),
+                "verified": len(base_present & untouched)}))
+
+        def on_restored(resp: dict) -> None:
+            if resp.get("error"):
+                cleanup(lambda: degrade("restore", resp["error"]))
+                return
+            self.client.search(
+                via, dest,
+                {"query": {"match_all": {}}, "size": len(base_len) + 50},
+                on_verified)
+
+        def on_created(resp: dict) -> None:
+            if resp.get("error"):
+                degrade("create", resp["error"])
+                return
+            st = svc.status(name)
+            if st.get("error") or st.get("state") != "SUCCESS":
+                degrade("status", st)
+                return
+            svc.restore(name, dest, on_restored)
+
+        svc.create(name, "logs", on_created)
+
+    # -- elastic topology (tentpole: join / rebalance / drain) -------------
+
+    def _topology_poll(self, what: str, cond, on_ok,
+                       deadline_ms: int) -> None:
+        """Re-check `cond` every 500ms of virtual time until it holds,
+        then advance the reshape chain; a blown deadline fails the soak
+        (the reshape wedging IS the bug this harness exists to catch)."""
+
+        def tick() -> None:
+            if cond():
+                on_ok()
+            elif self.queue.now_ms > deadline_ms:
+                self.fail("topology",
+                          f"reshape stage [{what}] did not complete by "
+                          f"its virtual deadline")
+            else:
+                self.queue.schedule(500, tick)
+
+        tick()
+
+    def _topology_milestone(self, event: str, **fields: Any) -> None:
+        self.log_event(f"topology_{event}", **fields)
+        self.report.topology.append(
+            {"event": event, "at_ms": self.queue.now_ms, **fields})
+
+    def _start_topology_reshape(self) -> None:
+        """The seeded elastic-topology chain, run under live traffic:
+        a fresh node JOINS (peer recovery + residency warm-up before it
+        is counted on), the router REBALANCES onto it, a disk ramp pushes
+        one replica-holder over the high watermark (the decider must
+        EVACUATE), and finally one founding member is DRAINED via
+        allocation filtering and departs with zero acked-write loss."""
+        self._topology_pending += 1
+        self._topology_milestone("reshape_start", members=list(self.node_ids))
+        self._topology_join()
+
+    def _topology_join(self) -> None:
+        from opensearch_tpu.cluster.cluster_node import ClusterNode
+        from opensearch_tpu.cluster import residency as residency_mod
+        from opensearch_tpu.telemetry.export import MemorySink, SpanExporter
+
+        ordinal = self._next_ordinal
+        self._next_ordinal += 1
+        nid = f"n{ordinal}"
+        # no bootstrap: an empty voting config cannot self-elect, so the
+        # fresh node discovers the sitting leader via pre-vote and JOINS
+        node = ClusterNode(nid, self._tmp_path / nid, self.transport,
+                           self.queue, list(self.node_ids) + [nid])
+        node.telemetry.tracer.exporter = SpanExporter(
+            MemorySink(), service_name=nid,
+            slow_threshold_ms=250, sample_ratio=0.25,
+            rng=random.Random(self.cfg.seed * 31_337 + 11 + ordinal),
+            synchronous=True, mode="memory",
+        )
+        node.disk_usage_pct = FaultScheduler.BASELINE_DISK_PCT
+        node.start()
+        self.nodes[nid] = node
+        self.node_ids.append(nid)
+        self._topology_milestone("join_started", node=nid)
+
+        def warm() -> bool:
+            leader = self.maybe_live_leader()
+            if leader is None or nid not in leader.applied_state.nodes:
+                return False
+            if nid not in node.applied_state.nodes:
+                return False
+            # mesh bundles warm from the residency advertisement before
+            # the joiner is treated as a full member
+            return (node._residency_seeded
+                    or not residency_mod.default_config.enabled)
+
+        self._topology_poll(
+            "join-warm", warm,
+            lambda: self._topology_joined(nid),
+            self.queue.now_ms + 120_000)
+
+    def _topology_joined(self, nid: str) -> None:
+        self._topology_milestone("join_warm", node=nid)
+
+        def settled() -> bool:
+            leader = self.maybe_live_leader()
+            if leader is None:
+                return False
+            state = leader.applied_state
+            return (len(state.nodes) == len(self.node_ids)
+                    and all(r.state == "STARTED" and r.node_id is not None
+                            and not r.relocating_node
+                            for r in state.routing))
+
+        self._topology_poll(
+            "post-join-rebalance", settled,
+            lambda: self._begin_disk_ramp(nid),
+            self.queue.now_ms + 120_000)
+
+    def _begin_disk_ramp(self, joined: str) -> None:
+        """Push one replica-holder over the high watermark in two steps
+        (through the heartbeat path, like a real disk filling up); the
+        DiskThresholdDecider must evacuate its replicas while queries
+        keep flowing."""
+        leader = self.live_leader()
+        state = leader.applied_state
+        holders = sorted({r.node_id for r in state.routing
+                          if not r.primary and r.node_id is not None
+                          and r.node_id not in (joined, leader.node_id)})
+        if not holders:
+            # degenerate layouts skip the ramp; the drain still runs
+            self._topology_milestone("ramp_skipped")
+            self._topology_drain(joined, None)
+            return
+        victim = holders[0]
+        self._topology_milestone("disk_ramp", node=victim)
+        self.nodes[victim].disk_usage_pct = 70.0
+        self.queue.schedule(
+            1_000, lambda: self._ramp_to_high(joined, victim))
+
+    def _ramp_to_high(self, joined: str, victim: str) -> None:
+        if victim in self.nodes:
+            self.nodes[victim].disk_usage_pct = 95.0
+
+        def evacuated() -> bool:
+            leader = self.maybe_live_leader()
+            if leader is None:
+                return False
+            state = leader.applied_state
+            return (not any(r.relocating_node for r in state.routing)
+                    and not any(r.node_id == victim and not r.primary
+                                for r in state.routing))
+
+        self._topology_poll(
+            "watermark-evacuation", evacuated,
+            lambda: self._after_evacuation(joined, victim),
+            self.queue.now_ms + 120_000)
+
+    def _after_evacuation(self, joined: str, victim: str) -> None:
+        self._topology_milestone("evacuated", node=victim)
+        if victim in self.nodes:
+            self.nodes[victim].disk_usage_pct = \
+                FaultScheduler.BASELINE_DISK_PCT
+        self._topology_drain(joined, victim)
+
+    def _topology_drain(self, joined: str, victim: str | None) -> None:
+        """Graceful decommission via allocation filtering: exclude one
+        founding member by name, wait for its shards to relocate off,
+        then let it depart."""
+        leader = self.live_leader()
+        target = next(nid for nid in sorted(self.node_ids)
+                      if nid != leader.node_id and nid != joined)
+        self._topology_milestone("drain_started", node=target)
+        self.transport.send(
+            self.anchor(), leader.node_id, "cluster:admin/settings/update",
+            {"transient":
+             {"cluster.routing.allocation.exclude._name": target}},
+            on_response=lambda _r: None,
+            on_failure=lambda e: self.fail(
+                "topology", f"drain settings update failed: {e}"))
+
+        def drained() -> bool:
+            leader = self.maybe_live_leader()
+            if leader is None:
+                return False
+            state = leader.applied_state
+            return (not any(r.node_id == target or r.relocating_node
+                            == target for r in state.routing)
+                    and all(r.state == "STARTED" for r in state.routing))
+
+        self._topology_poll(
+            "drain", drained,
+            lambda: self._depart(target),
+            self.queue.now_ms + 180_000)
+
+    def _depart(self, target: str) -> None:
+        """The drained node leaves: it goes dark FIRST, then the exclude
+        filter lifts — order matters, or the still-running node would
+        soak shards right back up before shutdown."""
+        self._topology_milestone("depart", node=target)
+        self.transport.take_down(target)
+        node = self.nodes.pop(target)
+        node.close()
+        self.node_ids.remove(target)
+        leader = self.live_leader()
+        self.transport.send(
+            self.anchor(), leader.node_id, "cluster:admin/settings/update",
+            {"transient":
+             {"cluster.routing.allocation.exclude._name": None}},
+            on_response=lambda _r: None,
+            on_failure=lambda e: self.fail(
+                "topology", f"exclude cleanup failed: {e}"))
+
+        def departed() -> bool:
+            leader = self.maybe_live_leader()
+            return (leader is not None
+                    and target not in leader.applied_state.nodes)
+
+        self._topology_poll(
+            "departure-eviction", departed,
+            self._topology_done,
+            self.queue.now_ms + 120_000)
+
+    def _topology_done(self) -> None:
+        self._topology_milestone("reshape_done",
+                                 members=list(self.node_ids))
+        self._topology_pending -= 1
 
     # -- probes ------------------------------------------------------------
 
@@ -1729,8 +2380,9 @@ class SoakHarness:
                                "min_train": 24, "iters": 2}}},
                            "tag": {"type": "keyword"}}}),
         }
+        anchor = self.nodes[self.anchor()]
         for name, (settings, mappings) in specs.items():
-            resp = self.call(self.nodes["n0"].create_index, name,
+            resp = self.call(anchor.create_index, name,
                              {"settings": {"index": settings},
                               "mappings": mappings})
             if not resp.get("acknowledged"):
@@ -1746,13 +2398,12 @@ class SoakHarness:
                 doc_id, src = self._next_doc(index)
                 self._writes[index][doc_id] = [
                     {"op": -1, "kind": "index", "acked": False}]
-                resp = self.call(self.nodes["n0"].index_doc, index,
-                                 doc_id, src)
+                resp = self.call(anchor.index_doc, index, doc_id, src)
                 if "error" not in resp and \
                         resp.get("_shards", {}).get("failed", 1) == 0:
                     self._writes[index][doc_id][0]["acked"] = True
         for index in self.indices:
-            self.call(self.nodes["n0"].refresh, index)
+            self.call(anchor.refresh, index)
         self.run_ms(2_000)
         # wlm flood group (enforced, tiny share -> ~3 bulk slots of 64)
         if self.cfg.flood_cycle >= 0 or self.cfg.flood_all:
@@ -1767,16 +2418,21 @@ class SoakHarness:
         self.log_event("cycle_start", cycle=cycle)
         flood = cycle == self.cfg.flood_cycle or self.cfg.flood_all
         plans = self._plan_cycle_ops(flood)
-        faults = self._plan_cycle_faults()
+        faults = self.faults.plan_cycle()
         base = self.queue.now_ms
+        self._cycle_start_ms = base
         for plan in plans:
             self.queue.schedule(plan["offset"],
                                 lambda p=plan: self._issue(p))
         for fault in faults:
             self.queue.schedule(fault["at"],
-                                lambda f=fault: self._inject_fault(f))
+                                lambda f=fault: self.faults.inject(f))
             self.queue.schedule(fault["at"] + fault["duration"],
-                                lambda f=fault: self._heal_fault(f))
+                                lambda f=fault: self.faults.heal(f))
+        if cycle == self.cfg.topology_cycle:
+            # the cluster reshape IS this cycle's chaos: join -> rebalance
+            # -> watermark evacuation -> drain, under the live op mix
+            self.queue.schedule(500, self._start_topology_reshape)
         if self.cfg.inject_acked_write_loss and cycle == 0:
             self.queue.schedule(self.cfg.cycle_ms // 2,
                                 self._corrupt_one_copy)
@@ -1790,23 +2446,34 @@ class SoakHarness:
 
     def _quiesce(self) -> None:
         # heal everything and wait for convergence + every op to complete
-        self.transport.heal()
-        for nid in list(self.transport.down):
-            self.transport.bring_up(nid)
+        # + any in-flight topology reshape to finish its chain
+        self.faults.heal_all()
         deadline = self.queue.now_ms + 240_000
         while self.queue.now_ms < deadline:
             self.run_ms(2_000)
-            if self._converged() and all(
+            if self._converged() and self._topology_pending == 0 and all(
                     op["completions"] > 0 for op in self.ops):
                 break
         else:
             stuck = [op["i"] for op in self.ops if op["completions"] == 0]
             self.fail("convergence",
                       f"cluster/ops did not quiesce in 240s of virtual "
-                      f"time (stuck ops: {stuck[:10]})")
+                      f"time (stuck ops: {stuck[:10]}, "
+                      f"topology_pending: {self._topology_pending})")
+        anchor = self.nodes[self.anchor()]
         for index in self.indices:
-            self.call(self.nodes["n0"].refresh, index)
+            self.call(anchor.refresh, index)
         self.run_ms(2_000)
+        # per-class throughput for the cycle: every op issued this cycle
+        # has completed (the loop above waits for that), so the counts are
+        # final; elapsed spans issue window + quiesce, all virtual time
+        elapsed_s = max((self.queue.now_ms - self._cycle_start_ms) / 1000.0,
+                        0.001)
+        counts = self._cycle_counts.get(self.cycle, {})
+        rates = {cls: round(n / elapsed_s, 3)
+                 for cls, n in sorted(counts.items())}
+        self.report.throughput[self.cycle] = rates
+        self.log_event("throughput", cycle=self.cycle, **rates)
         for inv in self.invariants:
             inv.at_quiesce(self)
             self.report.invariants_checked += 1
@@ -1830,15 +2497,17 @@ class SoakHarness:
         """Final quiesce: close every held context, advance past keep-alive
         so expiry reaps strays, then assert zero leftovers."""
         self.final_quiesce = True
+        anchor = self.anchor()
         for op_i, ctxs in sorted(self._open_contexts.items()):
             self.call(lambda callback, c=ctxs: self.client.ctx_close(
-                "n0", c, callback))
+                anchor, c, callback))
         self._open_contexts.clear()
         self.run_ms(130_000)  # past every keep_alive
         for index in self.indices:
             # any search triggers the reap on each node it touches
             self.call(lambda callback, i=index: self.client.search(
-                "n0", i, {"query": {"match_all": {}}, "size": 1}, callback))
+                anchor, i, {"query": {"match_all": {}}, "size": 1},
+                callback))
         for inv in self.invariants:
             inv.at_quiesce(self)
         self.report.flood = dict(self.flood_stats)
@@ -1863,6 +2532,10 @@ def run_soak(seed: int, tmp_path, *, cycles: int = 3, nodes: int = 3,
              chaos: bool = True, flood_cycle: int = 1,
              flood_all: bool = False,
              inject_acked_write_loss: bool = False,
+             topology_cycle: int = -1,
+             fault_kinds: tuple | None = None,
+             snapshots: bool = False,
+             throughput_floors: dict | None = None,
              extra_invariants: tuple = ()) -> SoakReport:
     """Run the soak; returns the SoakReport, raises SoakFailure (seed and
     replay command attached) on any invariant violation."""
@@ -1873,7 +2546,12 @@ def run_soak(seed: int, tmp_path, *, cycles: int = 3, nodes: int = 3,
                      ops_per_cycle=ops_per_cycle, cycle_ms=cycle_ms,
                      chaos=chaos, flood_cycle=flood_cycle,
                      flood_all=flood_all,
-                     inject_acked_write_loss=inject_acked_write_loss)
+                     inject_acked_write_loss=inject_acked_write_loss,
+                     topology_cycle=topology_cycle,
+                     snapshots=snapshots,
+                     throughput_floors=throughput_floors)
+    if fault_kinds is not None:
+        cfg = dataclasses.replace(cfg, fault_kinds=tuple(fault_kinds))
     harness = SoakHarness(cfg, Path(tmp_path))
     for inv in extra_invariants:
         harness.add_invariant(inv)
@@ -1905,6 +2583,31 @@ def run_soak(seed: int, tmp_path, *, cycles: int = 3, nodes: int = 3,
     return harness.report
 
 
+def floors_from_report(report: SoakReport) -> dict:
+    """The per-class floor a recorded run establishes: the MINIMUM rate
+    any cycle achieved, per workload class (only classes every cycle
+    produced — a class absent from some cycle can't ratchet)."""
+    floors: dict[str, float] = {}
+    cycles = list(report.throughput.values())
+    if not cycles:
+        return floors
+    classes = set(cycles[0])
+    for rates in cycles[1:]:
+        classes &= set(rates)
+    for cls in sorted(classes):
+        floors[cls] = min(rates[cls] for rates in cycles)
+    return floors
+
+
+def load_baseline(path) -> dict | None:
+    """Floors from a soak_baseline.json ratchet file, or None if absent."""
+    p = Path(path)
+    if not p.exists():
+        return None
+    doc = json.loads(p.read_text())
+    return doc.get("floors") or None
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
     import tempfile
@@ -1917,16 +2620,37 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--cycles", type=int, default=3)
     parser.add_argument("--ops", type=int, default=30)
     parser.add_argument("--no-chaos", action="store_true")
+    parser.add_argument("--topology-cycle", type=int, default=-1,
+                        help="cycle index running the elastic-topology "
+                             "reshape (join/rebalance/drain); -1 disables")
+    parser.add_argument("--snapshots", action="store_true",
+                        help="interleave cluster snapshot create/restore "
+                             "cycles with the chaos mix")
+    parser.add_argument("--baseline", default=None,
+                        help="soak_baseline.json to enforce per-cycle "
+                             "throughput floors against")
+    parser.add_argument("--record-baseline", default=None,
+                        help="write this run's per-class minimum rates "
+                             "as a new throughput ratchet file")
     args = parser.parse_args(argv)
     seed = args.replay if args.replay is not None else args.seed
+    floors = load_baseline(args.baseline) if args.baseline else None
     with tempfile.TemporaryDirectory() as tmp:
         try:
             report = run_soak(seed, tmp, cycles=args.cycles,
                               ops_per_cycle=args.ops,
-                              chaos=not args.no_chaos)
+                              chaos=not args.no_chaos,
+                              topology_cycle=args.topology_cycle,
+                              snapshots=args.snapshots,
+                              throughput_floors=floors)
         except SoakFailure as e:
             print(str(e))
             return 1
+    if args.record_baseline:
+        Path(args.record_baseline).write_text(json.dumps({
+            "seed": seed, "cycles": args.cycles, "ops": args.ops,
+            "floors": floors_from_report(report),
+        }, indent=1, sort_keys=True) + "\n")
     print(json.dumps(report.to_dict(), indent=1))
     return 0
 

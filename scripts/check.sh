@@ -3,6 +3,11 @@
 #
 #   scripts/check.sh            # lint gate + lint/transport/cluster tests
 #   scripts/check.sh --lint     # lint gate only (pre-commit speed)
+#   scripts/check.sh --soak-tcp # + the elastic-topology soak on the REAL
+#                               # TCP transport: node join, rebalance,
+#                               # watermark evacuation and graceful drain
+#                               # under live loopback traffic, invariants
+#                               # only (~60s wall-clock budget)
 #   scripts/check.sh --bench    # + the bench-regression gates: a quick
 #                               # bench.py --gate run must stay within a
 #                               # CPU/TPU-aware tolerance of the same
@@ -55,6 +60,11 @@ JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
   tests/test_cluster_data.py \
   tests/test_fault_injection.py \
   tests/test_soak.py
+
+if [[ "${1:-}" == "--soak-tcp" ]]; then
+  echo "== elastic-topology soak on the real TCP transport (invariants-only) =="
+  JAX_PLATFORMS=cpu python -m opensearch_tpu.testing.soak_tcp --seconds 60
+fi
 
 if [[ "${1:-}" == "--bench" ]]; then
   echo "== bench-regression gate (quick run vs BENCH_CACHE.json) =="

@@ -544,3 +544,49 @@ def test_write_retries_through_transient_routing_error(tmp_path):
     finally:
         for n in sim.nodes.values():
             n.close()
+
+
+# -- cluster snapshots --------------------------------------------------------
+
+
+def test_cluster_snapshot_create_status_restore(sim, tmp_path):
+    """ClusterSnapshotsService: per-primary shard_dump -> content-addressed
+    repo -> restore into a FRESH index whose contents exactly match the
+    docs acked at create time (including a delete and an unrefreshed
+    write)."""
+    from opensearch_tpu.snapshots.service import ClusterSnapshotsService
+
+    sim.call(sim.nodes["n0"].create_index, "snaplogs",
+             {"settings": {"index": {"number_of_shards": 2,
+                                     "number_of_replicas": 1}}})
+    sim.run(5_000)
+    for i in range(8):
+        sim.call(sim.nodes["n0"].index_doc, "snaplogs", f"d{i}", {"n": i})
+    sim.call(sim.nodes["n0"].delete_doc, "snaplogs", "d3")
+    sim.call(sim.nodes["n1"].refresh, "snaplogs")
+    # one more write AFTER the refresh: it sits in the engine buffer and
+    # must still be captured by the dump
+    sim.call(sim.nodes["n0"].index_doc, "snaplogs", "buffered", {"n": 99})
+    svc = ClusterSnapshotsService(sim.nodes["n0"], tmp_path / "snaprepo")
+    resp = sim.call(svc.create, "snap1", "snaplogs")
+    assert resp.get("state") == "SUCCESS", resp
+    assert resp["docs"] == 8, resp  # 8 indexed - 1 deleted + 1 buffered
+
+    # writes AFTER the snapshot must not appear in the restore
+    sim.call(sim.nodes["n0"].index_doc, "snaplogs", "later", {"n": 100})
+
+    st = svc.status("snap1")
+    assert st["state"] == "SUCCESS" and st["docs"] == 8, st
+    assert svc.list_snapshots() == ["snap1"]
+
+    resp = sim.call(svc.restore, "snap1", "snaplogs-restored")
+    assert resp.get("state") == "SUCCESS", resp
+    assert resp["docs"] == 8, resp
+    sim.run(2_000)
+    sim.call(sim.nodes["n2"].refresh, "snaplogs-restored")
+    out = sim.call(sim.nodes["n2"].search, "snaplogs-restored",
+                   {"query": {"match_all": {}}, "size": 50})
+    ids = {h["_id"] for h in out["hits"]["hits"]}
+    assert ids == {f"d{i}" for i in range(8) if i != 3} | {"buffered"}, ids
+    # the restored copy is a fresh index: source index unaffected
+    assert "snaplogs-restored" in sim.leader().applied_state.indices

@@ -43,6 +43,12 @@ def test_disk_low_watermark_blocks_new_allocation():
 
 
 def test_disk_high_watermark_drains_replicas():
+    """Evacuation is a real RELOCATION: the full node's replica keeps
+    serving (RELOCATING) while the shadow target recovers; the shard-
+    started swap moves it off — never a moment with fewer serving
+    copies."""
+    from opensearch_tpu.cluster.allocation import mark_shard_started
+
     nodes = [DiscoveryNode("a"), DiscoveryNode("b"), DiscoveryNode("c")]
     routing = [
         ShardRoutingEntry("i", 0, "a", True, "STARTED"),
@@ -51,11 +57,68 @@ def test_disk_high_watermark_drains_replicas():
     state = _state(nodes, [IndexMeta("i", 1, 1)], routing)
     settings = AllocationSettings(disk_usage={"b": 95.0})
     out = reroute(state, settings)
-    replica = next(r for r in out.routing if not r.primary)
+    # mid-move: source still serving, shadow target initializing on c
+    source = next(r for r in out.routing if r.state == "RELOCATING")
+    assert source.node_id == "b" and source.relocating_node == "c"
+    shadow = next(r for r in out.routing if r.is_relocation_target)
+    assert shadow.node_id == "c"
+    # target catches up -> atomic swap completes the evacuation
+    done = mark_shard_started(out, "i", 0, "c")
+    replica = next(r for r in done.routing if not r.primary)
     assert replica.node_id == "c"          # drained off the full node
-    assert replica.state == "INITIALIZING"
-    primary = next(r for r in out.routing if r.primary)
+    assert replica.state == "STARTED"
+    primary = next(r for r in done.routing if r.primary)
     assert primary.node_id == "a"          # primaries stay put
+    # stable: another reroute with the same disk picture changes nothing
+    again = reroute(done, settings)
+    assert set(again.routing) == set(done.routing)
+
+
+def test_cluster_exclude_filter_drains_node():
+    """cluster.routing.allocation.exclude._name (graceful decommission):
+    replicas relocate off; a primary hands its role to a started replica
+    elsewhere, then the demoted copy moves; iterating publications
+    empties the node."""
+    from opensearch_tpu.cluster.allocation import mark_shard_started
+
+    nodes = [DiscoveryNode("a"), DiscoveryNode("b"), DiscoveryNode("c")]
+    routing = [
+        ShardRoutingEntry("i", 0, "a", True, "STARTED"),
+        ShardRoutingEntry("i", 0, "b", False, "STARTED"),
+        ShardRoutingEntry("i", 1, "b", True, "STARTED"),
+        ShardRoutingEntry("i", 1, "c", False, "STARTED"),
+    ]
+    state = _state(nodes, [IndexMeta("i", 2, 1)], routing)
+    state = state.with_(settings={
+        "cluster.routing.allocation.exclude._name": "b",
+    })
+    for _ in range(8):
+        state = reroute(state, AllocationSettings.from_cluster(state))
+        for r in [r for r in state.routing if r.state == "INITIALIZING"]:
+            state = mark_shard_started(state, r.index, r.shard, r.node_id)
+    assert not any(r.node_id == "b" for r in state.routing), state.routing
+    assert all(r.state == "STARTED" for r in state.routing)
+    # both shards still have primary + replica
+    for s in (0, 1):
+        copies = [r for r in state.routing if r.shard == s]
+        assert len(copies) == 2 and sum(r.primary for r in copies) == 1
+
+
+def test_drain_refuses_to_drop_sole_started_copy():
+    """Decommission of the node holding the ONLY started copy of a shard
+    (zero replicas): the drain must refuse — the copy stays put rather
+    than being dropped (never trade acked writes for a clean exit).
+    With no staying candidate the primary cannot swap or move."""
+    nodes = [DiscoveryNode("a"), DiscoveryNode("b")]
+    routing = [ShardRoutingEntry("solo", 0, "b", True, "STARTED")]
+    state = _state(nodes, [IndexMeta("solo", 1, 0)], routing)
+    state = state.with_(settings={
+        "cluster.routing.allocation.exclude._name": "b",
+    })
+    for _ in range(4):
+        state = reroute(state, AllocationSettings.from_cluster(state))
+    entry = next(r for r in state.routing)
+    assert entry.node_id == "b" and entry.state == "STARTED", state.routing
 
 
 def test_awareness_spreads_copies_across_zones():

@@ -977,3 +977,219 @@ def test_tcp_send_unserializable_payload_fails_listener_once():
         assert not t._pending  # no orphaned timer/callbacks
     finally:
         loop.close()
+
+
+# -- elastic-topology edge cases: rebalance/drain/join under faults ----------
+
+
+def _put_cluster_settings(sim, transient):
+    leader = sim.leader()
+    out = []
+    sim.transport.send(
+        leader.node_id, leader.node_id, "cluster:admin/settings/update",
+        {"transient": transient},
+        on_response=out.append,
+        on_failure=lambda e: out.append({"error": str(e)}))
+    for _ in range(500):
+        if out:
+            break
+        sim.queue.run_one()
+    assert out and "error" not in out[0], out
+    sim.run(1_000)
+
+
+def test_watermark_evacuation_survives_concurrent_node_kill(tmp_path):
+    """A disk ramp starts evacuating a replica; the relocation TARGET dies
+    mid-move. The half-dead pair must repair (source keeps serving), no
+    acked write may vanish, and once the dead node returns the cluster
+    converges with the full node holding no replica."""
+    sim = DataSim(3, seed=11, tmp_path=tmp_path)
+    sim.run(5_000)
+    try:
+        _make_index(sim, "dfull", shards=1, replicas=1)
+        _acked_writes(sim, "dfull", 12)
+        state = sim.leader().applied_state
+        replica = next(r for r in state.shards_for_index("dfull")
+                       if not r.primary)
+        full = replica.node_id
+        target = next(nid for nid in sim.node_ids
+                      if not any(r.node_id == nid for r in
+                                 state.shards_for_index("dfull")))
+        # widen the mid-move window so the kill lands during the copy
+        sim.nodes[target].data_worker_delay_ms = 120
+        sim.nodes[full].disk_usage_pct = 95.0
+        # step until the evacuation relocation is visible, then kill the
+        # node the shadow copy is recovering onto
+        moving = False
+        for _ in range(300):
+            sim.run(100)
+            routing = sim.leader().applied_state.shards_for_index("dfull")
+            if any(r.is_relocation_target and r.node_id == target
+                   for r in routing):
+                moving = True
+                break
+        assert moving, "evacuation relocation never started"
+        sim.transport.take_down(target)
+        sim.run(30_000)
+        # repaired: the source still serves; nothing points at the corpse
+        leader = _live_leader(sim, {target})
+        routing = leader.applied_state.shards_for_index("dfull")
+        assert not any(r.node_id == target or r.relocating_node == target
+                       for r in routing), routing
+        # the dead node returns; with the full node still over watermark
+        # the replica must land on the RETURNED node, not the full one
+        sim.nodes[target].data_worker_delay_ms = 0
+        sim.transport.bring_up(target)
+        sim.run(60_000)
+        leader = sim.leader()
+        routing = leader.applied_state.shards_for_index("dfull")
+        assert all(r.state == "STARTED" for r in routing), routing
+        rep = next(r for r in routing if not r.primary)
+        assert rep.node_id != full, routing
+        _assert_docs_survive(sim, "dfull", 12)
+    finally:
+        for n in sim.nodes.values():
+            n.close()
+
+
+def test_drain_of_sole_started_copy_refuses_live(tmp_path):
+    """Decommission (cluster exclude) of the node holding the ONLY
+    started copy of a zero-replica index: the drain must REFUSE — the
+    copy stays put and keeps serving rather than being dropped for a
+    clean exit."""
+    sim = DataSim(3, seed=13, tmp_path=tmp_path)
+    sim.run(5_000)
+    try:
+        _make_index(sim, "solo", shards=1, replicas=0)
+        _acked_writes(sim, "solo", 8)
+        holder = sim.leader().applied_state.primary("solo", 0).node_id
+        _put_cluster_settings(
+            sim, {"cluster.routing.allocation.exclude._name": holder})
+        sim.run(25_000)
+        entry = sim.leader().applied_state.primary("solo", 0)
+        assert entry.node_id == holder and entry.state == "STARTED", entry
+        # still fully serving through any node
+        via = next(nid for nid in sim.node_ids if nid != holder)
+        sim.call(sim.nodes[via].refresh, "solo")
+        resp = sim.call(sim.nodes[via].search, "solo",
+                        {"query": {"match_all": {}}, "size": 10})
+        assert resp["hits"]["total"]["value"] == 8, resp
+        # lifting the filter leaves the copy exactly where it was
+        _put_cluster_settings(
+            sim, {"cluster.routing.allocation.exclude._name": None})
+        sim.run(10_000)
+        entry = sim.leader().applied_state.primary("solo", 0)
+        assert entry.node_id == holder and entry.state == "STARTED", entry
+    finally:
+        for n in sim.nodes.values():
+            n.close()
+
+
+def test_mesh_invalidation_races_relocation_swap(tmp_path):
+    """kNN mesh traffic rides THROUGH a relocation swap: queries issued
+    while the copy moves must stay green and consistent, and after the
+    swap every resident mesh bundle must be keyed to a LIVE engine (the
+    moved-away copy's bundles invalidate with it — a query can never
+    merge pre- and post-move snapshots)."""
+    from opensearch_tpu.cluster.shard_mesh import default_registry
+
+    sim = DataSim(3, seed=17, tmp_path=tmp_path)
+    sim.run(5_000)
+    try:
+        resp = sim.call(sim.nodes["n0"].create_index, "mvec", {
+            "settings": {"index": {"number_of_shards": 1,
+                                   "number_of_replicas": 1}},
+            "mappings": {"properties": {
+                "x": {"type": "knn_vector", "dimension": 4}}},
+        })
+        assert resp.get("acknowledged"), resp
+        sim.run(5_000)
+        for i in range(10):
+            r = sim.call(sim.nodes["n0"].index_doc, "mvec", str(i),
+                         {"x": [float(i), 1.0, 0.0, 0.5]})
+            assert r["_shards"]["failed"] == 0, r
+        sim.call(sim.nodes["n0"].refresh, "mvec")
+        sim.run(2_000)
+
+        def knn(via):
+            return sim.call(sim.nodes[via].search, "mvec", {
+                "query": {"knn": {"x": {"vector": [3.0, 1.0, 0.0, 0.5],
+                                        "k": 3}}}, "size": 3})
+
+        baseline = knn("n0")
+        assert baseline["_shards"]["failed"] == 0, baseline
+        base_ids = [h["_id"] for h in baseline["hits"]["hits"]]
+        # drain the replica holder so its copy RELOCATES; keep querying
+        # through the move — every response must be green and identical
+        replica = next(r for r in sim.leader().applied_state
+                       .shards_for_index("mvec") if not r.primary)
+        _put_cluster_settings(
+            sim, {"cluster.routing.allocation.exclude._name":
+                  replica.node_id})
+        for _ in range(40):
+            sim.run(500)
+            resp = knn("n0")
+            assert resp["_shards"]["failed"] == 0, resp
+            assert [h["_id"] for h in resp["hits"]["hits"]] == base_ids, resp
+            routing = sim.leader().applied_state.shards_for_index("mvec")
+            if (not any(r.node_id == replica.node_id for r in routing)
+                    and all(r.state == "STARTED" for r in routing)):
+                break
+        routing = sim.leader().applied_state.shards_for_index("mvec")
+        assert not any(r.node_id == replica.node_id for r in routing)
+        # every resident mvec bundle is keyed to engines that still exist
+        live_engines = {
+            sh.engine.instance_id
+            for node in sim.nodes.values()
+            for k, sh in node.local_shards.items() if k[0] == "mvec"
+        }
+        with default_registry._lock:
+            stale = [k for k in default_registry._bundles
+                     if k[0] == "mvec" and not set(k[3]) <= live_engines]
+        assert not stale, stale
+    finally:
+        for n in sim.nodes.values():
+            n.close()
+
+
+def test_node_joins_mid_traffic_and_takes_load(tmp_path):
+    """A fresh node boots into a running cluster mid-traffic (no
+    bootstrap — it discovers the sitting leader and JOINS), receives peer
+    recoveries, and the balancer spreads copies onto it; writes issued
+    while it joins stay acked and searchable through the NEW node."""
+    from opensearch_tpu.cluster.cluster_node import ClusterNode
+
+    sim = DataSim(3, seed=19, tmp_path=tmp_path)
+    sim.run(5_000)
+    try:
+        _make_index(sim, "grow", shards=2, replicas=1)
+        _acked_writes(sim, "grow", 10)
+        joiner = ClusterNode("n3", tmp_path / "n3", sim.transport,
+                             sim.queue, sim.node_ids + ["n3"])
+        joiner.start()
+        sim.nodes["n3"] = joiner
+        # traffic keeps flowing while the join + rebalance run
+        for i in range(10, 16):
+            r = sim.call(sim.nodes["n0"].index_doc, "grow", str(i), {"n": i})
+            assert r["_shards"]["failed"] == 0, r
+            sim.run(3_000)
+        sim.run(40_000)
+        leader = sim.leader()
+        state = leader.applied_state
+        assert "n3" in state.nodes
+        routing = state.shards_for_index("grow")
+        assert all(r.state == "STARTED" and not r.relocating_node
+                   for r in routing), routing
+        # the balancer actually used the new capacity
+        assert any(r.node_id == "n3" for r in routing), routing
+        # acked docs (including those written DURING the join) searchable
+        # through the joiner itself
+        sim.call(joiner.refresh, "grow")
+        sim.run(1_000)
+        resp = sim.call(joiner.search, "grow",
+                        {"query": {"match_all": {}}, "size": 20})
+        assert resp["_shards"]["failed"] == 0, resp
+        assert resp["hits"]["total"]["value"] == 16, resp
+    finally:
+        for n in sim.nodes.values():
+            n.close()

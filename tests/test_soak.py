@@ -15,10 +15,21 @@ import pytest
 from opensearch_tpu.testing.soak import (
     Invariant,
     SoakFailure,
+    floors_from_report,
+    load_baseline,
     run_soak,
 )
 
 SUBSET = dict(cycles=2, ops_per_cycle=18)
+
+# the elastic-topology scenario: join -> rebalance -> watermark
+# evacuation -> drain, run under live traffic in the middle cycle
+TOPOLOGY = dict(cycles=2, ops_per_cycle=14, topology_cycle=0)
+
+# every milestone the reshape chain must land, in order
+RESHAPE_CHAIN = ["reshape_start", "join_started", "join_warm",
+                 "disk_ramp", "evacuated", "drain_started", "depart",
+                 "reshape_done"]
 
 
 def test_soak_mesh_seed_exercises_sharded_launch(tmp_path):
@@ -205,6 +216,101 @@ def test_extra_invariant_failure_carries_seed(tmp_path):
     assert "--replay 13" in str(err.value)
 
 
+def test_soak_topology_reshape_completes_under_traffic(tmp_path):
+    """Tentpole, tier-1 seed: node join -> rebalance -> watermark-driven
+    evacuation -> graceful drain, all while the mixed workload flows.
+    Every milestone of the chain must land, every op must complete, and
+    the cluster must end converged (the at-quiesce invariants include
+    watermark-respected + balanced-convergence)."""
+    report = run_soak(7, tmp_path, **TOPOLOGY)
+    assert report.cycles_completed == TOPOLOGY["cycles"]
+    assert report.ops_completed == report.ops_issued
+    events = [m["event"] for m in report.topology]
+    assert events == RESHAPE_CHAIN, events
+    # milestones carry virtual timestamps and are strictly ordered
+    times = [m["at_ms"] for m in report.topology]
+    assert times == sorted(times)
+
+
+def test_soak_topology_reshape_replays_byte_identically(tmp_path):
+    """The replay contract survives the reshape: a join/evacuate/drain
+    scenario is a pure function of the seed, byte-for-byte — membership
+    changes, relocations and all."""
+    a = run_soak(21, tmp_path / "a", **TOPOLOGY)
+    b = run_soak(21, tmp_path / "b", **TOPOLOGY)
+    assert a.digest == b.digest
+    assert [m["event"] for m in a.topology] == \
+        [m["event"] for m in b.topology]
+    assert [m["at_ms"] for m in a.topology] == \
+        [m["at_ms"] for m in b.topology]
+
+
+def test_soak_snapshot_cycles_in_mix(tmp_path):
+    """Satellite: create/status/restore snapshot cycles ride inside the
+    chaos mix; the restored index must match the acked-write ledger at
+    snapshot time (verified in _issue_snapshot_cycle against the op's
+    captured base set)."""
+    report = run_soak(7, tmp_path, snapshots=True, **SUBSET)
+    assert report.ops_completed == report.ops_issued
+    assert report.snapshots.get("cycles") == SUBSET["cycles"]
+    assert report.snapshots.get("verified_docs", 0) > 0
+
+
+@pytest.mark.parametrize("kind", ["disk_full", "clock_skew", "slow_worker"])
+def test_soak_single_fault_kind_degrades_gracefully(tmp_path, kind):
+    """Satellite: each new fault kind, isolated, must leave the soak
+    green — disk_full pushes a node over the watermarks (the decider
+    evacuates), clock_skew shears node clocks, slow_worker drags the
+    data path below the transport timeout."""
+    report = run_soak(31, tmp_path, cycles=2, ops_per_cycle=12,
+                      fault_kinds=(kind,))
+    assert report.cycles_completed == 2
+    assert report.ops_completed == report.ops_issued
+    assert report.faults_injected, "the fault plan must fire"
+    assert set(report.faults_injected) == {kind}
+
+
+def test_soak_throughput_ratchet_against_repo_baseline(tmp_path):
+    """Satellite: the committed soak_baseline.json floors the per-cycle
+    per-class throughput (virtual-time rates, so the ratchet is exactly
+    reproducible — no wall-clock flake). The tier-1 subset run must stay
+    above every recorded floor."""
+    import pathlib
+
+    baseline_path = pathlib.Path(__file__).resolve().parents[1] \
+        / "soak_baseline.json"
+    floors = load_baseline(baseline_path)
+    assert floors, "repo must carry a recorded soak_baseline.json"
+    report = run_soak(7, tmp_path, throughput_floors=floors, **SUBSET)
+    assert report.cycles_completed == SUBSET["cycles"]
+    # the run recorded per-cycle rates for every ratcheted class
+    for rates in report.throughput.values():
+        for cls in floors:
+            assert cls in rates, (cls, rates)
+
+
+def test_soak_throughput_floor_violation_fails_with_seed(tmp_path):
+    """An impossible floor must trip the throughput-floor invariant and
+    carry the replay seed, like every other invariant failure."""
+    with pytest.raises(SoakFailure) as err:
+        run_soak(7, tmp_path, throughput_floors={"query": 1e9}, **SUBSET)
+    assert err.value.invariant == "throughput-floor"
+    assert "--replay 7" in str(err.value)
+
+
+def test_floors_from_report_takes_cycle_minimum(tmp_path):
+    """floors_from_report records the WORST cycle per class, and only
+    classes every cycle produced (a class absent somewhere can't
+    ratchet)."""
+    report = run_soak(7, tmp_path, **SUBSET)
+    floors = floors_from_report(report)
+    assert floors, "subset run must produce ratchetable classes"
+    for cls, floor in floors.items():
+        rates = [r[cls] for r in report.throughput.values()]
+        assert len(rates) == SUBSET["cycles"]
+        assert floor == min(rates)
+
+
 @pytest.mark.slow
 @pytest.mark.chaos
 @pytest.mark.parametrize("seed", [101, 202])
@@ -215,3 +321,20 @@ def test_chaos_soak_five_cycles(tmp_path, seed):
     assert report.cycles_completed == 5
     assert report.ops_completed == report.ops_issued
     assert report.faults_injected
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_soak_topology_with_snapshots_acceptance(tmp_path):
+    """Acceptance: the full elastic-topology scenario (join, rebalance,
+    watermark evacuation, drain) with snapshot cycles in the mix, soaked
+    across 3 cycles — and its digest replays byte-identically."""
+    kwargs = dict(cycles=3, ops_per_cycle=18, topology_cycle=1,
+                  snapshots=True)
+    a = run_soak(7, tmp_path / "a", **kwargs)
+    assert a.cycles_completed == 3
+    assert a.ops_completed == a.ops_issued
+    assert [m["event"] for m in a.topology] == RESHAPE_CHAIN
+    assert a.snapshots.get("verified_docs", 0) > 0
+    b = run_soak(7, tmp_path / "b", **kwargs)
+    assert a.digest == b.digest

@@ -502,3 +502,32 @@ def test_lazy_connection_reopens_after_peer_restart(tmp_path):
         await b2.aclose()
 
     asyncio.run(scenario())
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_tcp_elastic_topology_soak(tmp_path):
+    """The full elastic reshape on real sockets: a node joins mid-traffic,
+    the allocator rebalances onto it, a disk ramp evacuates a
+    replica-holder over the high watermark, and a founding member drains
+    and departs — with live HTTP writes/searches flowing throughout and
+    the invariants-only audit at the end (testing/soak_tcp.py, the same
+    runner `scripts/check.sh --soak-tcp` drives)."""
+    from opensearch_tpu.testing.soak_tcp import TcpSoak
+
+    async def scenario():
+        soak = TcpSoak(tmp_path, seconds=90.0)
+        try:
+            return await soak.run()
+        finally:
+            await soak.stop()
+
+    report = asyncio.run(scenario())
+    events = [m["event"] for m in report["milestones"]]
+    for want in ("join_started", "join_warm", "rebalanced", "disk_ramp",
+                 "evacuated", "drain_started", "depart", "reshape_done",
+                 "verified"):
+        assert want in events, events
+    assert report["writes_acked"] > 0
+    assert report["searches_ok"] > 0
+    assert len(report["members"]) == 3
