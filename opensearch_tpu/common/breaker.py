@@ -91,6 +91,11 @@ class HierarchyBreakerService:
         self.parent_limit = int(settings.get(
             "parent_limit_bytes", total_bytes * PARENT_FRACTION
         ))
+        # check_parent() runs from every child's add path at once (http
+        # in-flight accounting, search-pool request/fielddata charges), so
+        # the trip counter needs its own lock — the children's locks are
+        # per-child and never held here
+        self._lock = threading.Lock()
         self.parent_trip_count = 0
         self.request = CircuitBreaker(
             "request",
@@ -122,7 +127,8 @@ class HierarchyBreakerService:
     def check_parent(self, label: str) -> None:
         total = sum(c.used for c in self.children)
         if total > self.parent_limit:
-            self.parent_trip_count += 1
+            with self._lock:
+                self.parent_trip_count += 1
             raise CircuitBreakingException(
                 f"[parent] Data too large, data for [{label}] would be "
                 f"[{total}/{_human(total)}], which is larger than the limit "
@@ -131,12 +137,14 @@ class HierarchyBreakerService:
 
     def stats(self) -> dict:
         out = {c.name: c.stats() for c in self.children}
+        with self._lock:
+            parent_tripped = self.parent_trip_count
         out["parent"] = {
             "limit_size_in_bytes": self.parent_limit,
             "limit_size": _human(self.parent_limit),
             "estimated_size_in_bytes": sum(c.used for c in self.children),
             "estimated_size": _human(sum(c.used for c in self.children)),
             "overhead": 1.0,
-            "tripped": self.parent_trip_count,
+            "tripped": parent_tripped,
         }
         return out

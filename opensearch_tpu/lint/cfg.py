@@ -48,6 +48,40 @@ MAX_PATHS = 4_000
 MAX_VISITS_PER_PATH = 2
 
 
+class ScopeEnter(ast.AST):
+    """Marker emitted into a block when a ``with`` body begins.
+
+    ``_fields`` stays empty on purpose: ``ast.walk``/``iter_child_nodes``
+    see a leaf, so every pre-existing analysis treats the marker as inert.
+    Lock-epoch analyses (TPU019) read ``context_expr`` to know which
+    context manager was entered; ``exit_marker`` is the paired ScopeExit.
+    """
+
+    _fields = ()
+
+    def __init__(self, item: ast.withitem):
+        super().__init__()
+        self.item = item
+        self.context_expr = item.context_expr
+        self.lineno = getattr(item.context_expr, "lineno", 1)
+        self.col_offset = getattr(item.context_expr, "col_offset", 0)
+
+
+class ScopeExit(ast.AST):
+    """Paired marker for leaving a ``with`` body (including abrupt exits:
+    return/break/continue run the exit like a pending finally)."""
+
+    _fields = ()
+
+    def __init__(self, enter: ScopeEnter):
+        super().__init__()
+        self.enter = enter
+        self.context_expr = enter.context_expr
+        self.lineno = enter.lineno
+        self.col_offset = enter.col_offset
+        enter.exit_marker = self
+
+
 class Edge:
     __slots__ = ("dst", "kind", "cond")
 
@@ -123,8 +157,12 @@ class _Builder:
         # (break_target, continue_target, finally_depth_at_loop_entry)
         self._loops: list[tuple[Block, Block, int]] = []
         self._finallies: list[list[ast.stmt]] = []
-        # innermost try frame: handler entry blocks + uncaught continuation
-        self._exc_frames: list[tuple[list[Block], Block]] = []
+        # innermost try frame: handler entry blocks, uncaught continuation,
+        # and the _finallies depth at frame push (a raise runs only the
+        # pending finallies ABOVE the frame's own finalbody — with-exit
+        # markers interleave on this stack, so depth is recorded, not
+        # recomputed from the frame count)
+        self._exc_frames: list[tuple[list[Block], Block, int]] = []
 
     # -- plumbing ----------------------------------------------------------
 
@@ -181,9 +219,22 @@ class _Builder:
         elif isinstance(stmt, ast.Try):
             self._build_try(stmt)
         elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            enters = []
             for item in stmt.items:
                 self._emit(item.context_expr)
+                enter = ScopeEnter(item)
+                self._emit(enter)
+                enters.append(enter)
+            exits: list = [ScopeExit(e) for e in reversed(enters)]
+            # the exits behave like a pending finally: an abrupt jump out
+            # of the body (return/break/continue) releases the context
+            # managers on its way, exactly like the runtime does
+            self._finallies.append(exits)
             self._stmts(stmt.body)
+            self._finallies.pop()
+            if self.current is not None:
+                for marker in exits:
+                    self._emit(marker)
         elif isinstance(stmt, ast.Return):
             self._emit(stmt)
             self._jump(self.cfg.exit)
@@ -193,8 +244,9 @@ class _Builder:
             if frames:
                 # jump into the innermost uncaught continuation, which
                 # inlines that try's finally itself — only finallies of
-                # frames we skip OVER (handler bodies) run here
-                self._jump(frames[-1][1], len(frames))
+                # frames we skip OVER (handler bodies, with-exits inside
+                # the try body) run here
+                self._jump(frames[-1][1], frames[-1][2])
             else:
                 self._jump(self.cfg.raise_exit)
         elif isinstance(stmt, ast.Break):
@@ -286,7 +338,8 @@ class _Builder:
         uncaught = self.cfg.new_block("try-uncaught")
 
         self._finallies.append(stmt.finalbody)
-        self._exc_frames.append((handler_entries, uncaught))
+        self._exc_frames.append((handler_entries, uncaught,
+                                 len(self._finallies)))
 
         # try body: a fresh block per statement, with exc edges from each
         # statement boundary (the handler sees the state BEFORE the

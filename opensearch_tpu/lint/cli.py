@@ -42,7 +42,7 @@ def _default_baseline() -> str | None:
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="python -m opensearch_tpu.lint",
-        description="AST+dataflow invariant checker (rules TPU001-TPU010)",
+        description="AST+dataflow invariant checker (rules TPU001-TPU019)",
     )
     parser.add_argument(
         "paths", nargs="*", default=None,
@@ -65,6 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--list-rules", action="store_true",
         help="print the rule catalog and exit")
+    parser.add_argument(
+        "--explain", default=None, metavar="TPUXXX",
+        help="print one rule's documentation with a minimal bad/good "
+             "example and exit")
     parser.add_argument(
         "--fix", action="store_true",
         help="apply mechanical rewrites (wallclock -> timeutil, entropy "
@@ -124,6 +128,16 @@ def main(argv: list[str] | None = None) -> int:
     if args.list_rules:
         for rule_id, checker in sorted(RULES.items()):
             print(f"{rule_id} {checker.name}: {checker.description}")
+        return 0
+
+    if args.explain:
+        from opensearch_tpu.lint.explain import explain
+
+        text = explain(args.explain.strip().upper())
+        if text is None:
+            print(f"unknown rule: {args.explain}", file=sys.stderr)
+            return 2
+        print(text, end="")
         return 0
 
     checkers = ALL_CHECKERS

@@ -1,4 +1,4 @@
-"""The tpulint rules (TPU001–TPU010).
+"""The tpulint rules (TPU001–TPU019).
 
 TPU001-TPU007 are single AST walks with a small amount of per-file context
 (scope, decorators, held locks). TPU008 and TPU010 sit on the dataflow
@@ -18,6 +18,7 @@ import ast
 from typing import Iterable
 
 from opensearch_tpu.lint import cfg as cfg_mod
+from opensearch_tpu.lint import threadroles
 from opensearch_tpu.lint.core import (
     Checker,
     FileContext,
@@ -1453,14 +1454,25 @@ _SUMMARY_DEPTH = 4  # call-chain depth for acquired-lock summaries
 
 class _LockCallScan(ast.NodeVisitor):
     """One method: locks acquired, plus self-method calls annotated with
-    the locks held at the callsite (the summary TPU010 propagates)."""
+    the locks held at the callsite (the summary TPU010 propagates).
 
-    def __init__(self, lock_attrs: set[str]):
+    Lock names are *qualified*: a lock of this class is its attr name
+    (``_lock``); a member object's lock reached through ``self._x`` —
+    either directly (``with self._x._lock:``) or via a member-method
+    summary — is ``_x._lock``, so inversions that cross a class boundary
+    join on one name space."""
+
+    def __init__(self, lock_attrs: set[str],
+                 member_locks: dict[str, set[str]] | None = None):
         self.lock_attrs = lock_attrs
+        # member attr -> that member class's own lock attr names
+        self.member_locks = member_locks or {}
         self.held: list[str] = []
         self.acquired: set[str] = set()
         # (callee method name, frozenset(held at callsite), call node)
         self.calls: list[tuple[str, frozenset, ast.Call]] = []
+        # (member attr, callee method, frozenset(held), call node)
+        self.member_calls: list[tuple[str, str, frozenset, ast.Call]] = []
         # intra-method ordered pairs (outer, inner) -> acquisition node
         self.pairs: dict[tuple[str, str], ast.AST] = {}
 
@@ -1471,17 +1483,30 @@ class _LockCallScan(ast.NodeVisitor):
             return node.attr
         return None
 
+    def _lock_name(self, node: ast.AST) -> str | None:
+        """The qualified lock name an expression acquires, if any."""
+        attr = self._self_attr(node)
+        if attr is not None:
+            return attr if attr in self.lock_attrs else None
+        # self._x._lock: a member object's lock taken directly
+        if isinstance(node, ast.Attribute):
+            owner = self._self_attr(node.value)
+            if owner is not None and node.attr in \
+                    self.member_locks.get(owner, ()):
+                return f"{owner}.{node.attr}"
+        return None
+
     def visit_With(self, node: ast.With) -> None:
         acquired: list[str] = []
         for item in node.items:
-            attr = self._self_attr(item.context_expr)
-            if attr is not None and attr in self.lock_attrs:
-                self.acquired.add(attr)
+            name = self._lock_name(item.context_expr)
+            if name is not None:
+                self.acquired.add(name)
                 for outer in self.held + acquired:
-                    if outer != attr:
-                        self.pairs.setdefault((outer, attr),
+                    if outer != name:
+                        self.pairs.setdefault((outer, name),
                                               item.context_expr)
-                acquired.append(attr)
+                acquired.append(name)
             else:
                 self.visit(item.context_expr)
         self.held.extend(acquired)
@@ -1498,6 +1523,12 @@ class _LockCallScan(ast.NodeVisitor):
                 and isinstance(fn.value, ast.Name)
                 and fn.value.id == "self"):
             self.calls.append((fn.attr, frozenset(self.held), node))
+        elif isinstance(fn, ast.Attribute):
+            # self._x.method(): a call into a member class's summary
+            owner = self._self_attr(fn.value)
+            if owner is not None and owner in self.member_locks:
+                self.member_calls.append(
+                    (owner, fn.attr, frozenset(self.held), node))
         self.generic_visit(node)
 
     # nested defs run later, in an unknown lock context — skip
@@ -1513,35 +1544,62 @@ class InterproceduralLockOrderChecker(Checker):
     name = "lock-order-interprocedural"
     description = ("lock-order inversions ACROSS method boundaries: "
                    "calling self.m() while holding lock A acquires lock B "
-                   "(via the callee's acquired-locks summary) while another "
-                   "path takes B before A")
+                   "(via the callee's acquired-locks summary — including a "
+                   "member object's lock taken through self._x.method()) "
+                   "while another path takes B before A")
 
     def applies_to(self, display_path: str, source: str) -> bool:
         return ("Lock" in source or "_lock" in source
                 or "Condition" in source or "Semaphore" in source)
 
     def check(self, ctx: FileContext) -> Iterable[Violation]:
+        classes: dict[str, ast.ClassDef] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                classes.setdefault(node.name, node)
         out: list[Violation] = []
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.ClassDef):
-                out.extend(self._check_class(ctx, node))
+                out.extend(self._check_class(ctx, node, classes))
         return out
 
-    def _check_class(self, ctx: FileContext,
-                     cls: ast.ClassDef) -> list[Violation]:
-        locks = LockDisciplineChecker()._lock_attrs(cls)
-        if len(locks) < 2:
-            return []  # an inversion needs two locks
+    @staticmethod
+    def _member_classes(cls: ast.ClassDef,
+                        classes: dict[str, ast.ClassDef]) -> dict[str, str]:
+        """Member attrs constructed from a same-file class:
+        ``self._x = ClassName(...)`` -> {"_x": "ClassName"}."""
+        out: dict[str, str] = {}
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            t = node.targets[0]
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                continue
+            v = node.value
+            if (isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+                    and v.func.id in classes):
+                out.setdefault(t.attr, v.func.id)
+        return out
+
+    @staticmethod
+    def _scan_methods(cls: ast.ClassDef, locks: set[str],
+                      member_locks: dict[str, set[str]] | None = None,
+                      ) -> dict[str, _LockCallScan]:
         scans: dict[str, _LockCallScan] = {}
         for item in cls.body:
             if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                scan = _LockCallScan(locks)
+                scan = _LockCallScan(locks, member_locks)
                 for stmt in item.body:
                     scan.visit(stmt)
                 # latest def wins on duplicate names (matches runtime)
                 scans[item.name] = scan
+        return scans
 
-        # transitive acquired-locks summary per method
+    @staticmethod
+    def _acquires_fn(scans: dict[str, _LockCallScan]):
+        """Transitive acquired-locks summary over one class's scans."""
         summary: dict[str, set[str]] = {}
 
         def acquires(method: str, depth: int, seen: frozenset) -> set[str]:
@@ -1556,6 +1614,31 @@ class InterproceduralLockOrderChecker(Checker):
             if depth == _SUMMARY_DEPTH:
                 summary[method] = acc
             return acc
+
+        return acquires
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef,
+                     classes: dict[str, ast.ClassDef]) -> list[Violation]:
+        locks = LockDisciplineChecker()._lock_attrs(cls)
+        members = self._member_classes(cls, classes)
+        member_locks = {
+            attr: mlocks for attr, cname in members.items()
+            if cname != cls.name
+            and (mlocks := LockDisciplineChecker()._lock_attrs(
+                classes[cname]))
+        }
+        if len(locks) + len(member_locks) < 2:
+            return []  # an inversion needs two distinct locks
+        scans = self._scan_methods(cls, locks, member_locks)
+        acquires = self._acquires_fn(scans)
+
+        # one acquired-locks summary per member class (its OWN locks; a
+        # member's member is depth-2 cross-class and out of scope)
+        member_acquires: dict[str, Any] = {}
+        for attr in member_locks:
+            mcls = classes[members[attr]]
+            member_acquires[attr] = self._acquires_fn(
+                self._scan_methods(mcls, member_locks[attr]))
 
         # ordered pairs: intra-method (TPU003 territory, kept for the
         # inversion join) + interprocedural via callee summaries
@@ -1573,6 +1656,18 @@ class InterproceduralLockOrderChecker(Checker):
                         if outer != inner:
                             inter.setdefault(
                                 (outer, inner), (node, name, callee))
+            for attr, callee, held, node in scan.member_calls:
+                if not held:
+                    continue
+                got = member_acquires[attr](callee, _SUMMARY_DEPTH,
+                                            frozenset())
+                qualified = {f"{attr}.{lk}" for lk in got}
+                for inner in qualified - set(held):
+                    for outer in held:
+                        if outer != inner:
+                            inter.setdefault(
+                                (outer, inner),
+                                (node, name, f"{attr}.{callee}"))
 
         out: list[Violation] = []
         reported: set[frozenset] = set()
@@ -2337,6 +2432,242 @@ class UntrackedStructureReadChecker(Checker):
         return out
 
 
+# ---------------------------------------------------------------------------
+# TPU018 — cross-pool shared state (thread-role race analysis)
+# ---------------------------------------------------------------------------
+
+# a file can only produce roles if it contains a dispatch idiom at all
+def _role_gate(source: str) -> bool:
+    return "self." in source and (
+        "_offload" in source or "register" in source
+        or "schedule" in source or ".submit(" in source)
+
+
+def _fmt_roles(roles: set[str]) -> str:
+    return "/".join(sorted(roles))
+
+
+_KIND_DESC = {
+    threadroles.ITER: "live iteration",
+    threadroles.RMW: "read-modify-write",
+    threadroles.MUTATE: "mutation",
+    threadroles.REBIND: "rebind",
+}
+
+
+class CrossPoolSharedStateChecker(Checker):
+    rule_id = "TPU018"
+    name = "cross-pool-shared-state"
+    description = ("mutable attribute reachable from >= 2 thread roles "
+                   "(data worker / search pool / http / timer / transport) "
+                   "with a racy access pair holding no lock in common; "
+                   "snapshot reads (list(d)/dict(d)) and single-op "
+                   "GIL-atomic accesses are recognized as safe, "
+                   "`# tpulint: single-role` opts an attribute out")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return _role_gate(source)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(ctx, node))
+        return out
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> list[Violation]:
+        analysis = threadroles.analyze_class(ctx, cls)
+        out: list[Violation] = []
+        for conflict in analysis.conflicts():
+            a, b = conflict.a, conflict.b
+            if a.node is b.node:
+                detail = (f"this {_KIND_DESC[a.kind]} runs under roles "
+                          f"{_fmt_roles(a.scope.roles)} with no lock held")
+            else:
+                detail = (f"this {_KIND_DESC[a.kind]} "
+                          f"({_fmt_roles(a.scope.roles)}) races the "
+                          f"{_KIND_DESC[b.kind]} in {b.scope.name}() "
+                          f"line {getattr(b.node, 'lineno', '?')} "
+                          f"({_fmt_roles(b.scope.roles)}) — no common lock")
+            out.append(ctx.violation(
+                "TPU018", a.node,
+                f"self.{conflict.attr} in {cls.name} is shared across "
+                f"thread roles: {detail}; hold one lock on every racy "
+                f"path, snapshot with list()/dict() first, or mark the "
+                f"attribute `# tpulint: single-role`"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# TPU019 — atomicity: check-then-act / rmw across a lock release
+# ---------------------------------------------------------------------------
+
+def _key_repr(node: ast.AST) -> str | None:
+    """A stable key identity for check-then-act matching: names,
+    constants, and simple dotted attrs. Anything else is unmatched."""
+    if isinstance(node, ast.Constant):
+        return f"const:{node.value!r}"
+    name = dotted_name(node)
+    if name is not None:
+        return f"name:{name}"
+    if isinstance(node, ast.Tuple):
+        parts = [_key_repr(e) for e in node.elts]
+        if all(p is not None for p in parts):
+            return "tuple:" + ",".join(parts)  # type: ignore[arg-type]
+    return None
+
+
+def _shallow_nodes(node: ast.AST):
+    """Pre-order walk that does not descend into nested defs/lambdas —
+    those are separate scopes with their own lock context."""
+    yield node
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return
+    for child in ast.iter_child_nodes(node):
+        yield from _shallow_nodes(child)
+
+
+class AtomicityChecker(Checker):
+    rule_id = "TPU019"
+    name = "atomicity"
+    description = ("check-then-act (`if k in d:` then `d[k]`/`d.pop(k)`) "
+                   "and unlocked read-modify-write (`d[k] += v`) on state "
+                   "shared across thread roles, where the test and the "
+                   "act are not covered by one continuous lock hold")
+
+    def applies_to(self, display_path: str, source: str) -> bool:
+        return _role_gate(source)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(ctx, node))
+        return out
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> list[Violation]:
+        analysis = threadroles.analyze_class(ctx, cls)
+        shared = analysis.multi_role_attrs()
+        if not shared:
+            return []
+        out: list[Violation] = []
+        reported: set[int] = set()
+        for scope in analysis.scopes:
+            if not scope.roles or \
+                    scope.method in threadroles._EXEMPT_METHODS:
+                continue
+            if not any(a.attr in shared for a in scope.accesses):
+                continue
+            out.extend(self._check_scope(
+                ctx, cls, analysis, shared, scope, reported))
+        out.sort(key=Violation.sort_key)
+        return out
+
+    def _check_scope(self, ctx: FileContext, cls: ast.ClassDef,
+                     analysis, shared: dict, scope,
+                     reported: set[int]) -> list[Violation]:
+        out: list[Violation] = []
+        cfg = cfg_mod.build_cfg(scope.node)
+        for path in cfg_mod.enumerate_paths(cfg):
+            held: list[tuple[str, int]] = []
+            epoch = 0
+            # (attr, key) -> (held-pairs at the test, test node)
+            tests: dict[tuple[str, str], tuple[frozenset, ast.AST]] = {}
+            for block in path.blocks:
+                for stmt in block.stmts:
+                    if isinstance(stmt, cfg_mod.ScopeEnter):
+                        lock = threadroles.self_attr_of(stmt.context_expr)
+                        if lock in analysis.lock_attrs:
+                            epoch += 1
+                            held.append((lock, epoch))
+                        continue
+                    if isinstance(stmt, cfg_mod.ScopeExit):
+                        lock = threadroles.self_attr_of(stmt.context_expr)
+                        if lock in analysis.lock_attrs:
+                            for i in range(len(held) - 1, -1, -1):
+                                if held[i][0] == lock:
+                                    del held[i]
+                                    break
+                        continue
+                    self._scan(ctx, cls, stmt, shared, held, tests,
+                               reported, scope, out)
+        return out
+
+    def _scan(self, ctx, cls, stmt, shared, held, tests, reported,
+              scope, out) -> None:
+        held_now = frozenset(held)
+        for node in _shallow_nodes(stmt):
+            # containment test: `k in self.d` / `k not in self.d`
+            if isinstance(node, ast.Compare):
+                for op, comp in zip(node.ops, node.comparators):
+                    if not isinstance(op, (ast.In, ast.NotIn)):
+                        continue
+                    attr = threadroles.self_attr_of(comp)
+                    if attr in shared:
+                        key = _key_repr(node.left)
+                        if key is not None:
+                            tests[(attr, key)] = (held_now, node)
+                continue
+            # dependent act: self.d[k] (load/store/del)
+            if isinstance(node, ast.Subscript):
+                attr = threadroles.self_attr_of(node.value)
+                if attr in shared:
+                    key = _key_repr(node.slice)
+                    self._act(ctx, cls, node, attr, key, held_now,
+                              tests, reported, shared, out)
+                continue
+            # dependent act: self.d.pop(k) with no default
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "pop" and len(node.args) == 1:
+                attr = threadroles.self_attr_of(node.func.value)
+                if attr in shared:
+                    key = _key_repr(node.args[0])
+                    self._act(ctx, cls, node, attr, key, held_now,
+                              tests, reported, shared, out)
+                continue
+            # unlocked read-modify-write on shared state
+            if isinstance(node, ast.AugAssign) and not held_now:
+                target = node.target
+                attr = threadroles.self_attr_of(target)
+                if attr is None and isinstance(target, ast.Subscript):
+                    attr = threadroles.self_attr_of(target.value)
+                if attr in shared and id(node) not in reported:
+                    reported.add(id(node))
+                    out.append(ctx.violation(
+                        "TPU019", node,
+                        f"read-modify-write on self.{attr} in {cls.name} "
+                        f"with no lock held; the attribute is shared "
+                        f"across roles {_fmt_roles(shared[attr])}, so a "
+                        f"concurrent update is lost (wrap in the lock "
+                        f"that guards self.{attr})"))
+
+    def _act(self, ctx, cls, node, attr, key, held_now, tests,
+             reported, shared, out) -> None:
+        if key is None:
+            return
+        test = tests.get((attr, key))
+        if test is None:
+            return
+        test_held, test_node = test
+        if test_held & held_now:
+            return  # one continuous acquisition covers test and act
+        if id(node) in reported:
+            return
+        reported.add(id(node))
+        out.append(ctx.violation(
+            "TPU019", node,
+            f"check-then-act on self.{attr} in {cls.name}: the membership "
+            f"test at line {getattr(test_node, 'lineno', '?')} and this "
+            f"access are not covered by one continuous lock hold, and "
+            f"self.{attr} is shared across roles "
+            f"{_fmt_roles(shared[attr])} — another role can mutate it "
+            f"in between (take the lock around both, or use "
+            f".get()/.pop(k, default))"))
+
+
 ALL_CHECKERS: list[Checker] = [
     JitPurityChecker(),
     BlockingInAsyncChecker(),
@@ -2355,6 +2686,8 @@ ALL_CHECKERS: list[Checker] = [
     UnmodeledKernelChecker(),
     NakedPallasCallChecker(),
     UntrackedStructureReadChecker(),
+    CrossPoolSharedStateChecker(),
+    AtomicityChecker(),
 ]
 
 RULES: dict[str, Checker] = {c.rule_id: c for c in ALL_CHECKERS}

@@ -11,6 +11,8 @@ the task manager's cooperative cancellation.
 
 from __future__ import annotations
 
+import threading
+
 from opensearch_tpu.common.errors import (
     RejectedExecutionException,
     ResourceNotFoundException,
@@ -27,6 +29,10 @@ class SearchBackpressureService:
         self._tasks = task_manager
         self.max_concurrent = max_concurrent
         self.max_runtime_ms = max_runtime_ms
+        # admit() runs on every searching thread at once (the parallel
+        # search pool, the data worker's scroll/PIT path, and the http
+        # search pool all call it); the counters are read-modify-write
+        self._stats_lock = threading.Lock()
         self.rejections = 0
         self.cancellations = 0
 
@@ -40,7 +46,8 @@ class SearchBackpressureService:
         if len(self._active_searches()) >= self.max_concurrent:
             # before shedding, try to reclaim capacity from overrunners
             if not self.cancel_overrunning():
-                self.rejections += 1
+                with self._stats_lock:
+                    self.rejections += 1
                 raise RejectedExecutionException(
                     "rejected execution of search: node search capacity "
                     f"saturated [{self.max_concurrent} concurrent searches]"
@@ -64,10 +71,13 @@ class SearchBackpressureService:
                 ))
             except ResourceNotFoundException:
                 pass  # finished between list and cancel: capacity freed anyway
-        self.cancellations += len(cancelled)
+        with self._stats_lock:
+            self.cancellations += len(cancelled)
         return cancelled
 
     def stats(self) -> dict:
+        with self._stats_lock:
+            rejections, cancellations = self.rejections, self.cancellations
         return {
             "mode": "enforced",
             "active_searches": len(self._active_searches()),
@@ -75,6 +85,6 @@ class SearchBackpressureService:
                 "max_concurrent": self.max_concurrent,
                 "max_runtime_ms": self.max_runtime_ms,
             },
-            "rejections": self.rejections,
-            "cancellations": self.cancellations,
+            "rejections": rejections,
+            "cancellations": cancellations,
         }

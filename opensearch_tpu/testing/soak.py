@@ -2632,19 +2632,42 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--record-baseline", default=None,
                         help="write this run's per-class minimum rates "
                              "as a new throughput ratchet file")
+    parser.add_argument("--race-probe", action="store_true",
+                        help="run under the runtime race instrumentation "
+                             "(testing/race_probe.py): tagged roles + "
+                             "wrapped locks; fail on any confirmed "
+                             "unlocked cross-role write")
     args = parser.parse_args(argv)
     seed = args.replay if args.replay is not None else args.seed
     floors = load_baseline(args.baseline) if args.baseline else None
+    probe = None
+    if args.race_probe:
+        from opensearch_tpu.testing.race_probe import probe_scope
+
+        probe_ctx = probe_scope()
+    else:
+        import contextlib
+
+        probe_ctx = contextlib.nullcontext()
     with tempfile.TemporaryDirectory() as tmp:
         try:
-            report = run_soak(seed, tmp, cycles=args.cycles,
-                              ops_per_cycle=args.ops,
-                              chaos=not args.no_chaos,
-                              topology_cycle=args.topology_cycle,
-                              snapshots=args.snapshots,
-                              throughput_floors=floors)
+            with probe_ctx as probe:
+                report = run_soak(seed, tmp, cycles=args.cycles,
+                                  ops_per_cycle=args.ops,
+                                  chaos=not args.no_chaos,
+                                  topology_cycle=args.topology_cycle,
+                                  snapshots=args.snapshots,
+                                  throughput_floors=floors)
         except SoakFailure as e:
             print(str(e))
+            return 1
+    if probe is not None:
+        probe_report = probe.report()
+        confirmed = probe_report["confirmed"]
+        print(json.dumps({"race_probe": probe_report}, indent=1))
+        if confirmed:
+            print(f"RACE PROBE: {len(confirmed)} confirmed unlocked "
+                  "cross-role write(s)")
             return 1
     if args.record_baseline:
         Path(args.record_baseline).write_text(json.dumps({

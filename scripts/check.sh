@@ -8,6 +8,12 @@
 #                               # watermark evacuation and graceful drain
 #                               # under live loopback traffic, invariants
 #                               # only (~60s wall-clock budget)
+#   scripts/check.sh --race-probe
+#                               # + the runtime race confirmation: one
+#                               # seeded soak cycle plus a threaded drill
+#                               # under lock/role instrumentation
+#                               # (testing/race_probe.py), asserting zero
+#                               # unconfirmed-unlocked cross-role writes
 #   scripts/check.sh --bench    # + the bench-regression gates: a quick
 #                               # bench.py --gate run must stay within a
 #                               # CPU/TPU-aware tolerance of the same
@@ -48,6 +54,12 @@ echo "== tpulint --fix --dry-run (zero pending rewrites) =="
 python -m opensearch_tpu.lint --fix --dry-run opensearch_tpu > /dev/null
 echo "ok"
 
+echo "== tpulint thread-role rules active (TPU018/TPU019) =="
+rules="$(python -m opensearch_tpu.lint --list-rules)"
+grep -q '^TPU018 ' <<<"$rules"
+grep -q '^TPU019 ' <<<"$rules"
+echo "ok"
+
 if [[ "${1:-}" == "--lint" ]]; then
   exit 0
 fi
@@ -56,10 +68,17 @@ echo "== tier-1 subset (lint semantics + transport/cluster/fault/soak) =="
 JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
   -p no:cacheprovider \
   tests/test_lint.py \
+  tests/test_race_probe.py \
   tests/test_coordination.py \
   tests/test_cluster_data.py \
   tests/test_fault_injection.py \
   tests/test_soak.py
+
+if [[ "${1:-}" == "--race-probe" ]]; then
+  echo "== runtime race probe (one seeded soak cycle + threaded drill) =="
+  JAX_PLATFORMS=cpu python -m opensearch_tpu.testing.race_probe \
+    --seed 7 --cycles 1
+fi
 
 if [[ "${1:-}" == "--soak-tcp" ]]; then
   echo "== elastic-topology soak on the real TCP transport (invariants-only) =="

@@ -22,3 +22,35 @@ class Inverted:
         with self._beta:
             with self._alpha:
                 return dict(self._stats)
+
+
+class Ledger:
+    """A member class with its own lock (the cross-class half of the
+    inversion below)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+
+    def add(self, key):
+        with self._lock:
+            self._rows[key] = key
+
+
+class Registry:
+    """Cross-class inversion: publish() holds the registry's own lock
+    while self._ledger.add() acquires the member's — but evict() takes
+    the member's lock directly before the registry's own."""
+
+    def __init__(self):
+        self._own = threading.Lock()
+        self._ledger = Ledger()
+
+    def publish(self, key):
+        with self._own:
+            self._ledger.add(key)  # EXPECT: TPU010
+
+    def evict(self, key):
+        with self._ledger._lock:
+            with self._own:
+                pass

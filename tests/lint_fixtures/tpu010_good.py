@@ -30,3 +30,31 @@ class Consistent:
     def _drain(self):
         with self._beta:
             self._items.clear()
+
+
+class Ledger:
+    """A member class with its own lock, always acquired INSIDE the
+    owner's lock — one global order, no inversion."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+
+    def add(self, key):
+        with self._lock:
+            self._rows[key] = key
+
+
+class Registry:
+    def __init__(self):
+        self._own = threading.Lock()
+        self._ledger = Ledger()
+
+    def publish(self, key):
+        with self._own:
+            self._ledger.add(key)
+
+    def evict(self, key):
+        with self._own:
+            with self._ledger._lock:
+                pass

@@ -1,0 +1,74 @@
+"""TPU018 true positives: mutable state shared across executor pools with
+no common lock — the pre-fix shapes of the historical review-round races
+(reader-context sequence counter, heat-ledger iteration, routing-book
+scan; PRs 4, 7 and 10 respectively)."""
+
+
+class ReaderContextBook:
+    """A bare sequence counter bumped from the serial data worker AND the
+    parallel search pool: `+=` is read-modify-write, so concurrent opens
+    mint duplicate context ids (the scroll/PIT id race, pre-fix)."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._ctx_seq = 0
+
+    def open_on_worker(self):
+        return self._offload(self._next_id)
+
+    def open_on_search_pool(self):
+        return self._search_pool.submit(self._next_id)
+
+    def _next_id(self):
+        self._ctx_seq += 1  # EXPECT: TPU018  # EXPECT: TPU019
+        return self._ctx_seq
+
+    def _offload(self, fn):
+        return fn()
+
+
+class HeatLedger:
+    """Timer-tick iteration over rows the data worker mutates: the tick
+    walks a live dict while writes land — RuntimeError("dictionary changed
+    size during iteration") under load (the heat-ledger walk, pre-fix)."""
+
+    def __init__(self, scheduler):
+        self._rows = {}
+        scheduler.schedule(1000, self._tick)
+
+    def record(self, key, nbytes):
+        def write():
+            self._rows[key] = nbytes
+
+        return self._offload(write)
+
+    def _tick(self):
+        total = 0
+        for _key, nbytes in self._rows.items():  # EXPECT: TPU018
+            total += nbytes
+        return total
+
+    def _offload(self, fn):
+        return fn()
+
+
+class RoutingBook:
+    """Search-pool scan racing transport-handler writes with no common
+    lock and no snapshot (the allocation/routing-book race, pre-fix)."""
+
+    def __init__(self, transport, search_pool):
+        transport.register("node-1", "routing/update", self._on_routing_update)
+        self._search_pool = search_pool
+        self._routes = {}
+
+    def _on_routing_update(self, sender, payload):
+        self._routes[payload["index"]] = payload["nodes"]
+
+    def pick(self, index):
+        return self._search_pool.submit(self._scan, index)
+
+    def _scan(self, index):
+        for name, nodes in self._routes.items():  # EXPECT: TPU018
+            if name == index:
+                return nodes
+        return None

@@ -1,0 +1,75 @@
+"""TPU018 false-positive guards: the same cross-pool shapes made safe —
+a common lock, an atomic list() snapshot before iterating, GIL-atomic
+single-op accesses, and the `# tpulint: single-role` opt-out."""
+
+import threading
+
+
+class LockedReaderContextBook:
+    """The counter race fixed the standard way: one lock serializes the
+    read-modify-write from both pools."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._lock = threading.Lock()
+        self._ctx_seq = 0
+
+    def open_on_worker(self):
+        return self._offload(self._next_id)
+
+    def open_on_search_pool(self):
+        return self._search_pool.submit(self._next_id)
+
+    def _next_id(self):
+        with self._lock:
+            self._ctx_seq += 1
+            return self._ctx_seq
+
+    def _offload(self, fn):
+        return fn()
+
+
+class SnapshotHeatLedger:
+    """Iteration over an atomic list() snapshot is safe against
+    concurrent single-key writes: both sides are one C-level dict op."""
+
+    def __init__(self, scheduler):
+        self._rows = {}
+        scheduler.schedule(1000, self._tick)
+
+    def record(self, key, nbytes):
+        def write():
+            self._rows[key] = nbytes
+
+        return self._offload(write)
+
+    def _tick(self):
+        total = 0
+        for _key, nbytes in list(self._rows.items()):
+            total += nbytes
+        return total
+
+    def _offload(self, fn):
+        return fn()
+
+
+class SingleRoleRoutingBook:
+    """The opt-out: the deployment guarantees one writer (documented at
+    the init site), so the analyzer stands down for this attribute."""
+
+    def __init__(self, transport, search_pool):
+        transport.register("node-1", "routing/update", self._on_routing_update)
+        self._search_pool = search_pool
+        self._routes = {}  # tpulint: single-role
+
+    def _on_routing_update(self, sender, payload):
+        self._routes[payload["index"]] = payload["nodes"]
+
+    def pick(self, index):
+        return self._search_pool.submit(self._scan, index)
+
+    def _scan(self, index):
+        for name, nodes in self._routes.items():
+            if name == index:
+                return nodes
+        return None

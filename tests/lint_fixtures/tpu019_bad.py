@@ -1,0 +1,85 @@
+"""TPU019 true positives: non-atomic compound operations on state shared
+across pools — check-then-act with no lock, a subscript `+=` on a shared
+dict, and a pop whose contains-test happened under an EARLIER lock hold
+(the cache-insert and double-delete review shapes, pre-fix)."""
+
+import threading
+
+
+class QueryCache:
+    """Lockless check-then-act: between `k in d` and `d[k]` another pool's
+    eviction can remove the key — KeyError under load."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._cache = {}
+
+    def lookup(self, key):
+        return self._search_pool.submit(self._get, key)
+
+    def store(self, key, value):
+        def write():
+            self._cache[key] = value
+
+        return self._offload(write)
+
+    def _get(self, key):
+        if key in self._cache:
+            return self._cache[key]  # EXPECT: TPU019
+        return None
+
+    def _offload(self, fn):
+        return fn()
+
+
+class HitBook:
+    """A subscript read-modify-write on a shared dict: `d[k] += 1` is
+    load + add + store, and concurrent bumps lose counts."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._hits = {"total": 0}
+
+    def bump_on_worker(self):
+        return self._offload(self._bump)
+
+    def read_on_search_pool(self):
+        return self._search_pool.submit(lambda: self._hits.get("total"))
+
+    def _bump(self):
+        self._hits["total"] += 1  # EXPECT: TPU019
+
+    def _offload(self, fn):
+        return fn()
+
+
+class JobTable:
+    """Pop-after-contains across a lock release: the test and the act sit
+    in two separate critical sections, so the decision is stale by the
+    time the pop runs (the double-delete shape, pre-fix)."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._lock = threading.Lock()
+        self._jobs = {}
+
+    def submit_job(self, key, job):
+        def write():
+            with self._lock:
+                self._jobs[key] = job
+
+        return self._offload(write)
+
+    def reap(self, key):
+        return self._search_pool.submit(self._reap_one, key)
+
+    def _reap_one(self, key):
+        with self._lock:
+            present = key in self._jobs
+        if present:
+            with self._lock:
+                return self._jobs.pop(key)  # EXPECT: TPU019
+        return None
+
+    def _offload(self, fn):
+        return fn()

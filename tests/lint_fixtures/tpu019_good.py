@@ -1,0 +1,81 @@
+"""TPU019 false-positive guards: the same compound shapes made atomic —
+get() with a default instead of check-then-act, the whole test+act inside
+ONE lock hold, and pop(k, None) absorbing a concurrent delete."""
+
+import threading
+
+
+class QueryCache:
+    """dict.get is one C-level operation: no window between the test and
+    the read."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._cache = {}
+
+    def lookup(self, key):
+        return self._search_pool.submit(self._get, key)
+
+    def store(self, key, value):
+        def write():
+            self._cache[key] = value
+
+        return self._offload(write)
+
+    def _get(self, key):
+        return self._cache.get(key)
+
+    def _offload(self, fn):
+        return fn()
+
+
+class HitBook:
+    """The subscript read-modify-write serialized under one lock from
+    every pool that bumps it."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._lock = threading.Lock()
+        self._hits = {"total": 0}
+
+    def bump_on_worker(self):
+        return self._offload(self._bump)
+
+    def bump_on_search_pool(self):
+        return self._search_pool.submit(self._bump)
+
+    def _bump(self):
+        with self._lock:
+            self._hits["total"] += 1
+
+    def _offload(self, fn):
+        return fn()
+
+
+class JobTable:
+    """Test and act inside ONE critical section: the contains decision is
+    still true when the pop runs."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._lock = threading.Lock()
+        self._jobs = {}
+
+    def submit_job(self, key, job):
+        def write():
+            with self._lock:
+                self._jobs[key] = job
+
+        return self._offload(write)
+
+    def reap(self, key):
+        return self._search_pool.submit(self._reap_one, key)
+
+    def _reap_one(self, key):
+        with self._lock:
+            if key in self._jobs:
+                return self._jobs.pop(key)
+        return None
+
+    def _offload(self, fn):
+        return fn()

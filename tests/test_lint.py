@@ -653,3 +653,107 @@ def test_fix_bare_except_keeps_baseexception_breadth():
     # propagate — a mechanical fixer must only add the logging
     assert "except BaseException as e:" in new_source
     ast.parse(new_source)
+
+
+# ---------------------------------------------------------------------------
+# --explain: per-rule documentation that cannot rot
+# ---------------------------------------------------------------------------
+
+def test_every_rule_has_an_explain_example():
+    from opensearch_tpu.lint.explain import EXAMPLES
+
+    for rule_id in RULES:
+        assert rule_id in EXAMPLES, f"{rule_id} has no --explain example"
+
+
+@pytest.mark.parametrize("rule_id", sorted(RULES), ids=str)
+def test_explain_example_bad_fires_and_good_is_clean(rule_id):
+    from opensearch_tpu.lint.explain import EXAMPLES
+
+    ex = EXAMPLES[rule_id]
+    bad_rules = {v.rule for v in lint_source("example.py", ex.bad, ALL_CHECKERS)}
+    assert rule_id in bad_rules, f"{rule_id} bad example does not fire"
+    good_rules = {v.rule for v in lint_source("example.py", ex.good, ALL_CHECKERS)}
+    assert rule_id not in good_rules, f"{rule_id} good example still fires"
+
+
+def test_cli_explain_renders_rule_and_rejects_unknown():
+    proc = _run_cli("--explain", "tpu018")
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.startswith("TPU018 ")
+    assert "BAD:" in proc.stdout and "GOOD:" in proc.stdout
+    proc = _run_cli("--explain", "TPU999")
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# thread-role inference: who-runs-what on dispatch idioms
+# ---------------------------------------------------------------------------
+
+def _roles_of(source, attr):
+    import ast as ast_mod
+
+    from opensearch_tpu.lint import threadroles
+    from opensearch_tpu.lint.core import FileContext
+
+    ctx = FileContext(path="m.py", source=source)
+    cls = next(n for n in ctx.tree.body if isinstance(n, ast_mod.ClassDef))
+    analysis = threadroles.analyze_class(ctx, cls)
+    roles = set()
+    for access in analysis.counted_accesses(attr):
+        roles |= access.scope.roles
+    return roles
+
+
+def test_dispatch_idioms_assign_expected_roles():
+    src = (
+        "class Node:\n"
+        "    def __init__(self, scheduler, search_pool):\n"
+        "        self._search_pool = search_pool\n"
+        "        scheduler.schedule(1000, self._tick)\n"
+        "        self._seq = 0\n"
+        "    def index(self, doc):\n"
+        "        return self._offload(self._bump)\n"
+        "    def search(self, q):\n"
+        "        return self._search_pool.submit(self._bump)\n"
+        "    def _tick(self):\n"
+        "        self._seq += 1\n"
+        "    def _bump(self):\n"
+        "        self._seq += 1\n"
+        "    def _offload(self, fn):\n"
+        "        return fn()\n"
+    )
+    from opensearch_tpu.lint import threadroles
+
+    roles = _roles_of(src, "_seq")
+    assert threadroles.ROLE_DATA in roles
+    assert threadroles.ROLE_SEARCH in roles
+    assert threadroles.ROLE_TIMER in roles
+
+
+def test_timer_and_transport_collapse_to_one_loop_domain():
+    # LoopScheduler runs ticks AND transport handlers on the single
+    # event-loop thread: timer-vs-transport sharing is NOT a race
+    from opensearch_tpu.lint import threadroles
+
+    assert threadroles.domains(
+        {threadroles.ROLE_TIMER, threadroles.ROLE_TRANSPORT}
+    ) == {"loop"}
+    assert len(threadroles.domains(
+        {threadroles.ROLE_TIMER, threadroles.ROLE_DATA})) == 2
+
+
+def test_timer_vs_transport_sharing_does_not_fire_tpu018():
+    src = (
+        "class Book:\n"
+        "    def __init__(self, scheduler, transport):\n"
+        "        scheduler.schedule(1000, self._tick)\n"
+        "        transport.register('n', 'route/update', self._on_update)\n"
+        "        self._rows = {}\n"
+        "    def _tick(self):\n"
+        "        return sum(n for _k, n in self._rows.items())\n"
+        "    def _on_update(self, sender, payload):\n"
+        "        self._rows[payload['k']] = payload['n']\n"
+    )
+    assert lint_source("m.py", src, ALL_CHECKERS) == []

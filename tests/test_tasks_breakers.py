@@ -177,3 +177,67 @@ class TestMaxBuckets:
         with pytest.raises(svc_mod.TooManyBucketsException):
             node.search("mb", {"size": 0, "aggs": {
                 "t": {"terms": {"field": "k", "size": 100}}}})
+
+
+class TestCounterRaces:
+    """Regression: the shared saturation counters are read-modify-write
+    state hammered by every pool at once (TPU018 hot spots confirmed by
+    testing/race_probe.py). Pre-fix, `rejections += 1` and
+    `parent_trip_count += 1` ran unlocked and lost increments under a tiny
+    GIL switch interval; the exact-count asserts below flake without the
+    locks."""
+
+    @pytest.fixture(autouse=True)
+    def _tight_switch_interval(self):
+        import sys
+
+        old = sys.getswitchinterval()
+        sys.setswitchinterval(1e-6)
+        yield
+        sys.setswitchinterval(old)
+
+    def test_backpressure_rejections_exact_under_contention(self):
+        import threading
+
+        tm = TaskManager()
+        bp = SearchBackpressureService(tm, max_concurrent=1,
+                                       max_runtime_ms=60_000)
+        tm.register("indices:data/read/search")  # saturate: every admit sheds
+        threads, per_thread = 8, 200
+        start = threading.Barrier(threads)
+
+        def hammer():
+            start.wait()
+            for _ in range(per_thread):
+                with pytest.raises(RejectedExecutionException):
+                    bp.admit()
+
+        workers = [threading.Thread(target=hammer) for _ in range(threads)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        assert bp.rejections == threads * per_thread
+
+    def test_parent_trip_count_exact_under_contention(self):
+        import threading
+
+        svc = HierarchyBreakerService(total_bytes=1000, settings={
+            "request_limit_bytes": 1 << 30, "parent_limit_bytes": 100,
+        })
+        svc.request.used = 500  # seed past the parent limit
+        threads, per_thread = 8, 200
+        start = threading.Barrier(threads)
+
+        def hammer():
+            start.wait()
+            for _ in range(per_thread):
+                with pytest.raises(CircuitBreakingException):
+                    svc.check_parent("hammer")
+
+        workers = [threading.Thread(target=hammer) for _ in range(threads)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        assert svc.parent_trip_count == threads * per_thread
