@@ -168,12 +168,16 @@ class ClusterNode:
 
         _roofline_mod.default_recorder.metrics = self.telemetry.metrics
         _roofline_mod.ensure_peaks()
-        # ANN serving knobs (search/ann.py): process-wide like the batcher,
-        # applied live the same way
+        # kNN serving knobs (search/ann.py): process-wide like the batcher,
+        # applied live the same way. The prefix is "search.knn." (not
+        # ".ann.") because the exact-path policy keys — search.knn.kernel
+        # and search.knn.score_precision — sit directly under it;
+        # apply_settings re-derives every field from the effective map, so
+        # firing on an unrelated search.knn.batch.* change is a no-op
         from opensearch_tpu.search import ann as _ann_mod
 
         self.settings_consumers.register(
-            "search.knn.ann.", _ann_mod.default_config.apply_settings
+            "search.knn.", _ann_mod.default_config.apply_settings
         )
         # shard-mesh HBM byte budget (cluster/shard_mesh.py): dynamic
         # search.mesh.hbm_budget_bytes reaches the registry at state

@@ -103,7 +103,14 @@ class ShardMeshRegistry:
             "invalidations": 0,   # index-level drops (shard left the node)
             "invalidated_bytes": 0,  # bytes released by those drops
             "launches": 0,        # sharded device launches issued
+            "fused_launches": 0,  # launches served by the fused per-shard
+            #                       scan (search.knn.kernel = pallas)
         }
+        # last resolved exact-path policy a launch ran under (attribution
+        # for _nodes/stats; the roofline report names the kernel family,
+        # this names the policy that picked it)
+        self.last_kernel: str | None = None
+        self.last_score_precision: str | None = None
 
     # -- config --------------------------------------------------------------
 
@@ -273,6 +280,16 @@ class ShardMeshRegistry:
             self.stats["launches"] += 1
             return self._launch_seq
 
+    def record_launch_kernel(self, kernel: str, precision: str) -> None:
+        """Per-launch exact-path policy attribution (search.knn.kernel):
+        counts launches the fused per-shard scan served and pins the last
+        resolved kernel/precision into the stats surface."""
+        with self._lock:
+            if kernel == "pallas":
+                self.stats["fused_launches"] += 1
+            self.last_kernel = kernel
+            self.last_score_precision = precision
+
     def record_launch_wall(self, wall_ns: int) -> None:
         """Feed the fenced launch wall into the EXECUTING node's metrics
         (the activate() scope its request handler opened — so in-process
@@ -308,6 +325,9 @@ class ShardMeshRegistry:
             out["resident_bundles"] = len(self._bundles)
             out["resident_bytes"] = self._mem["resident_bytes"]
             out["hbm_budget_bytes"] = self.hbm_budget_bytes
+            if self.last_kernel is not None:
+                out["last_kernel"] = self.last_kernel
+                out["last_score_precision"] = self.last_score_precision
         return out
 
     def clear(self) -> None:

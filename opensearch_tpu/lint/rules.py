@@ -2528,11 +2528,33 @@ def _shallow_nodes(node: ast.AST):
         yield from _shallow_nodes(child)
 
 
+# Counter methods that merge counts key-by-key: each key is a
+# read-modify-write, so the whole call needs the lock.
+_COUNTER_RMW = frozenset({"update", "subtract"})
+
+# Mutators that, applied to a defaultdict slot (`self.d[k].append(v)`),
+# perform get-or-insert plus mutate as two separate dict operations.
+_VIVIFY_MUTATORS = frozenset({"append", "appendleft", "extend", "add",
+                              "update", "insert", "remove", "discard",
+                              "subtract"})
+
+# Pseudo-key under which an `is None` sentinel test is recorded; the
+# prefix cannot collide with _key_repr output ("const:"/"name:"/"tuple:").
+_NONE_KEY = "is-none:"
+
+# Value shapes that look like lazy initialisation (a fresh object), as
+# opposed to a reset (`= None`) or a plain rebind of a parameter.
+_INIT_SHAPES = (ast.Call, ast.Dict, ast.List, ast.Set, ast.ListComp,
+                ast.DictComp, ast.SetComp)
+
+
 class AtomicityChecker(Checker):
     rule_id = "TPU019"
     name = "atomicity"
-    description = ("check-then-act (`if k in d:` then `d[k]`/`d.pop(k)`) "
-                   "and unlocked read-modify-write (`d[k] += v`) on state "
+    description = ("check-then-act (`if k in d:` then `d[k]`/`d.pop(k)`), "
+                   "unlocked read-modify-write (`d[k] += v`, "
+                   "`Counter.update`, `defaultdict[k].append`), and "
+                   "double-checked init without a locked re-test, on state "
                    "shared across thread roles, where the test and the "
                    "act are not covered by one continuous lock hold")
 
@@ -2552,6 +2574,7 @@ class AtomicityChecker(Checker):
         shared = analysis.multi_role_attrs()
         if not shared:
             return []
+        ctors = self._ctor_types(cls)
         out: list[Violation] = []
         reported: set[int] = set()
         for scope in analysis.scopes:
@@ -2561,12 +2584,30 @@ class AtomicityChecker(Checker):
             if not any(a.attr in shared for a in scope.accesses):
                 continue
             out.extend(self._check_scope(
-                ctx, cls, analysis, shared, scope, reported))
+                ctx, cls, analysis, shared, ctors, scope, reported))
         out.sort(key=Violation.sort_key)
         return out
 
+    @staticmethod
+    def _ctor_types(cls: ast.ClassDef) -> dict[str, str]:
+        """attr -> ctor name (last dotted segment) for ctor-assigned
+        attrs, e.g. ``self._counts = collections.Counter()`` -> Counter."""
+        ctors: dict[str, str] = {}
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                name = dotted_name(node.value.func)
+                if name is None:
+                    continue
+                last = name.split(".")[-1]
+                for t in node.targets:
+                    attr = threadroles.self_attr_of(t)
+                    if attr is not None:
+                        ctors[attr] = last
+        return ctors
+
     def _check_scope(self, ctx: FileContext, cls: ast.ClassDef,
-                     analysis, shared: dict, scope,
+                     analysis, shared: dict, ctors: dict, scope,
                      reported: set[int]) -> list[Violation]:
         out: list[Violation] = []
         cfg = cfg_mod.build_cfg(scope.node)
@@ -2591,24 +2632,30 @@ class AtomicityChecker(Checker):
                                     del held[i]
                                     break
                         continue
-                    self._scan(ctx, cls, stmt, shared, held, tests,
-                               reported, scope, out)
+                    self._scan(ctx, cls, stmt, shared, ctors, held,
+                               tests, reported, scope, out)
         return out
 
-    def _scan(self, ctx, cls, stmt, shared, held, tests, reported,
+    def _scan(self, ctx, cls, stmt, shared, ctors, held, tests, reported,
               scope, out) -> None:
         held_now = frozenset(held)
         for node in _shallow_nodes(stmt):
-            # containment test: `k in self.d` / `k not in self.d`
+            # containment test: `k in self.d` / `k not in self.d`,
+            # or lazy-init sentinel test: `self.x is None`
             if isinstance(node, ast.Compare):
                 for op, comp in zip(node.ops, node.comparators):
-                    if not isinstance(op, (ast.In, ast.NotIn)):
-                        continue
-                    attr = threadroles.self_attr_of(comp)
-                    if attr in shared:
-                        key = _key_repr(node.left)
-                        if key is not None:
-                            tests[(attr, key)] = (held_now, node)
+                    if isinstance(op, (ast.In, ast.NotIn)):
+                        attr = threadroles.self_attr_of(comp)
+                        if attr in shared:
+                            key = _key_repr(node.left)
+                            if key is not None:
+                                tests[(attr, key)] = (held_now, node)
+                    elif isinstance(op, (ast.Is, ast.IsNot)) and \
+                            isinstance(comp, ast.Constant) and \
+                            comp.value is None:
+                        attr = threadroles.self_attr_of(node.left)
+                        if attr in shared:
+                            tests[(attr, _NONE_KEY)] = (held_now, node)
                 continue
             # dependent act: self.d[k] (load/store/del)
             if isinstance(node, ast.Subscript):
@@ -2618,15 +2665,53 @@ class AtomicityChecker(Checker):
                     self._act(ctx, cls, node, attr, key, held_now,
                               tests, reported, shared, out)
                 continue
-            # dependent act: self.d.pop(k) with no default
             if isinstance(node, ast.Call) and \
-                    isinstance(node.func, ast.Attribute) and \
-                    node.func.attr == "pop" and len(node.args) == 1:
-                attr = threadroles.self_attr_of(node.func.value)
-                if attr in shared:
-                    key = _key_repr(node.args[0])
-                    self._act(ctx, cls, node, attr, key, held_now,
-                              tests, reported, shared, out)
+                    isinstance(node.func, ast.Attribute):
+                meth = node.func.attr
+                # dependent act: self.d.pop(k) with no default
+                if meth == "pop" and len(node.args) == 1:
+                    attr = threadroles.self_attr_of(node.func.value)
+                    if attr in shared:
+                        key = _key_repr(node.args[0])
+                        self._act(ctx, cls, node, attr, key, held_now,
+                                  tests, reported, shared, out)
+                    continue
+                # unlocked rmw: Counter.update/.subtract merges per key
+                if meth in _COUNTER_RMW and not held_now:
+                    attr = threadroles.self_attr_of(node.func.value)
+                    if attr in shared and \
+                            ctors.get(attr) == "Counter" and \
+                            id(node) not in reported:
+                        reported.add(id(node))
+                        out.append(ctx.violation(
+                            "TPU019", node,
+                            f"Counter.{meth} on self.{attr} in {cls.name} "
+                            f"with no lock held: each merged key is a "
+                            f"read-modify-write, and self.{attr} is shared "
+                            f"across roles {_fmt_roles(shared[attr])}, so "
+                            f"concurrent increments are lost (wrap in the "
+                            f"lock that guards self.{attr})"))
+                    continue
+                # unlocked vivify-then-mutate: self.d[k].append(v) on a
+                # defaultdict is get-or-insert plus mutate in two steps
+                if meth in _VIVIFY_MUTATORS and not held_now and \
+                        isinstance(node.func.value, ast.Subscript):
+                    attr = threadroles.self_attr_of(node.func.value.value)
+                    if attr in shared and \
+                            ctors.get(attr) == "defaultdict" and \
+                            id(node) not in reported:
+                        reported.add(id(node))
+                        out.append(ctx.violation(
+                            "TPU019", node,
+                            f"defaultdict vivify-and-mutate on "
+                            f"self.{attr} in {cls.name} with no lock "
+                            f"held: `self.{attr}[k].{meth}(...)` inserts "
+                            f"the default and mutates it as two separate "
+                            f"steps, and self.{attr} is shared across "
+                            f"roles {_fmt_roles(shared[attr])}, so two "
+                            f"roles can vivify distinct defaults and one "
+                            f"mutation is lost (wrap in the lock that "
+                            f"guards self.{attr})"))
                 continue
             # unlocked read-modify-write on shared state
             if isinstance(node, ast.AugAssign) and not held_now:
@@ -2643,6 +2728,77 @@ class AtomicityChecker(Checker):
                         f"across roles {_fmt_roles(shared[attr])}, so a "
                         f"concurrent update is lost (wrap in the lock "
                         f"that guards self.{attr})"))
+                continue
+            if isinstance(node, ast.Assign):
+                # unlocked rmw spelled as assignment:
+                # `self.d[k] = f(self.d[k])`
+                if not held_now:
+                    for target in node.targets:
+                        if not isinstance(target, ast.Subscript):
+                            continue
+                        attr = threadroles.self_attr_of(target.value)
+                        if attr not in shared:
+                            continue
+                        key = _key_repr(target.slice)
+                        if key is None or id(node) in reported:
+                            continue
+                        if self._reads_slot(node.value, attr, key):
+                            reported.add(id(node))
+                            out.append(ctx.violation(
+                                "TPU019", node,
+                                f"read-modify-write on self.{attr}[...] "
+                                f"in {cls.name} spelled as an assignment "
+                                f"whose right-hand side reads the same "
+                                f"slot, with no lock held; self.{attr} is "
+                                f"shared across roles "
+                                f"{_fmt_roles(shared[attr])}, so a "
+                                f"concurrent update is lost (wrap in the "
+                                f"lock that guards self.{attr})"))
+                # lazy-init act: `self.x = <fresh object>` after an
+                # `is None` test — double-checked init must re-test
+                # under the lock it initialises under
+                for target in node.targets:
+                    attr = threadroles.self_attr_of(target)
+                    if attr in shared and \
+                            isinstance(node.value, _INIT_SHAPES):
+                        self._lazy_init_act(
+                            ctx, cls, node, attr, held_now, tests,
+                            reported, shared, out)
+
+    @staticmethod
+    def _reads_slot(value: ast.AST, attr: str, key: str) -> bool:
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Subscript) and \
+                    threadroles.self_attr_of(sub.value) == attr and \
+                    _key_repr(sub.slice) == key:
+                return True
+        return False
+
+    def _lazy_init_act(self, ctx, cls, node, attr, held_now, tests,
+                       reported, shared, out) -> None:
+        test = tests.get((attr, _NONE_KEY))
+        # Only the double-checked shape is flagged: the init happens
+        # under a lock hold that did not cover the sentinel test.  A
+        # fully unlocked lazy init is an ordinary (benign-until-shared)
+        # race the rmw clauses already police; requiring a hold here
+        # keeps the rule from firing on plain cached-property idioms.
+        if test is None or not held_now:
+            return
+        test_held, test_node = test
+        if test_held & held_now:
+            return  # sentinel re-tested (or tested) under this hold
+        if id(node) in reported:
+            return
+        reported.add(id(node))
+        out.append(ctx.violation(
+            "TPU019", node,
+            f"double-checked init of self.{attr} in {cls.name}: the "
+            f"`is None` test at line "
+            f"{getattr(test_node, 'lineno', '?')} ran outside the lock "
+            f"this assignment holds and is not repeated inside it, so "
+            f"two roles {_fmt_roles(shared[attr])} can both pass the "
+            f"test and build self.{attr} twice (re-test under the lock "
+            f"before assigning)"))
 
     def _act(self, ctx, cls, node, attr, key, held_now, tests,
              reported, shared, out) -> None:

@@ -269,6 +269,8 @@ class ClassRoleAnalysis:
         self._propagate()
 
     def _collect_mutable_attrs(self) -> None:
+        none_sentinel: set[str] = set()
+        lazy_built: dict[str, ast.AST] = {}
         for node in ast.walk(self.cls):
             if isinstance(node, (ast.Assign, ast.AnnAssign)):
                 targets = (node.targets if isinstance(node, ast.Assign)
@@ -282,11 +284,28 @@ class ClassRoleAnalysis:
                         self.mutable_attrs.setdefault(attr, node)
                         if node.lineno in self._marker_lines:
                             self.single_role.add(attr)
+                    elif isinstance(node.value, ast.Constant) and \
+                            node.value.value is None:
+                        none_sentinel.add(attr)
+                    elif isinstance(node.value, ast.Call) and \
+                            not self._is_atomic_ctor(node.value):
+                        lazy_built.setdefault(attr, node)
             elif isinstance(node, ast.AugAssign):
                 attr = self_attr_of(node.target)
                 if attr is not None and attr not in self.lock_attrs:
                     # a scalar counter: += makes it read-modify-write state
                     self.mutable_attrs.setdefault(attr, node)
+        # lazy-init state: `self.x = None` plus a later `self.x = build()`
+        # is the double-checked-init shape — mutable even though neither
+        # assign is a container literal or ctor
+        for attr in none_sentinel & set(lazy_built):
+            self.mutable_attrs.setdefault(attr, lazy_built[attr])
+
+    @staticmethod
+    def _is_atomic_ctor(value: ast.Call) -> bool:
+        name = dotted_name(value.func)
+        return (name is not None
+                and name.split(".")[-1] in _ATOMIC_CTORS)
 
     def _is_mutable_value(self, value: ast.expr) -> bool:
         if isinstance(value, _MUTABLE_LITERALS):
@@ -652,6 +671,21 @@ class _ScopeWalker(ast.NodeVisitor):
                     self.visit(kw.value)
                 self._dispatch_tags(node)
                 return
+            # slot mutation through the container: self.d[k].append(x)
+            # mutates d's contents (and on a defaultdict vivifies the
+            # slot as a separate step first)
+            if isinstance(fn.value, ast.Subscript) and \
+                    fn.attr in _MUTATOR_METHODS:
+                owner = self_attr_of(fn.value.value)
+                if owner is not None:
+                    self._rec(owner, node, MUTATE)
+                    self.visit(fn.value.slice)
+                    for arg in node.args:
+                        self.visit(arg)
+                    for kw in node.keywords:
+                        self.visit(kw.value)
+                    self._dispatch_tags(node)
+                    return
 
         self._dispatch_tags(node)
         self.generic_visit(node)

@@ -28,8 +28,9 @@ block from the device-resident ``[nlist, L_pad, m]`` code slab — no
 KERNEL. Grid ``(B, nprobe, L_pad // l_blk)`` (sequential on a TensorCore,
 so VMEM scratch persists across iterations — the ``pallas_knn.py``
 accumulation pattern). Per step: decode the ``[l_blk, m]`` code tile
-against the resident ``[m, ks]`` LUT (per-subspace masked select-and-sum on
-the VPU — the TPU gather idiom), mask ragged list tails, and fold the
+against the resident ``[m, ks]`` LUT as ONE one-hot matmul on the MXU
+(``[l_blk, m·ks] × [m·ks, 1]``; the one-hot operand is m lane-compares
+concatenated lane-wise — no gather), mask ragged list tails, and fold the
 block's candidates into a running ``[1, R]`` top-R pool in VMEM scratch via
 R extract-max rounds, guarded by the kth-best threshold early-exit so
 steady-state tiles cost one decode + one row-max. Carried entries merge
@@ -42,9 +43,10 @@ PRECISION (ANNS-AMP): "fp32" accumulates f32; "bf16" keeps the LUT
 resident in VMEM at half width and accumulates f32; "int8" quantizes each
 QUERY's LUT affinely to uint8 (one shared affine across its probes, so
 integer sums stay comparable ACROSS probes without a dequantize in the
-scan) and accumulates int32 — sums are ≤ m·255, exactly representable, so
-the pool ranks on integers and the exact fp32 rescore restores score
-fidelity. No gather ever widens the LUT: that is the whole point.
+scan) and rides the one-hot matmul at bf16 (0..255 is exact in bf16) with
+an f32 accumulator — sums are ≤ m·255 < 2^24, exactly representable in any
+summation order, so the pool still ranks on integers and the exact fp32
+rescore restores score fidelity. No gather ever widens the LUT: that is the whole point.
 
 SELECTION. Serving reaches this kernel only through
 :func:`adc_topr_auto` / the ``search.knn.ann.kernel`` policy
@@ -64,6 +66,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from opensearch_tpu.search.profile import profiled_kernel
 
 # inverted-list block width streamed through VMEM per grid step; l_pad is
 # a power of two, so min(L_BLOCK, l_pad) always divides it evenly
@@ -101,28 +105,33 @@ def _adc_scan_kernel(
     lut = lut_ref[0, 0]                                   # [m, ks] native
     iota_ks = jax.lax.broadcasted_iota(
         jnp.int32, (codes.shape[0], ks), 1)
-    # ADC decode: sum_m lut[m, code[l, m]] via per-subspace masked
-    # select-and-sum (the TPU gather idiom — one [l_blk, ks] compare +
-    # select per subspace, no gather, LUT never leaves VMEM or widens)
-    if precision == "int8":
-        acc = jnp.zeros((codes.shape[0],), jnp.int32)
-        for mi in range(m):
-            onehot = iota_ks == codes[:, mi][:, None]
-            acc = acc + jnp.sum(
-                jnp.where(onehot, lut[mi][None, :].astype(jnp.int32), 0),
-                axis=1)
-        # sums are <= m * 255: exactly representable in f32, so ranking
-        # on the float pool is ranking on the integers
-        adc = acc.astype(jnp.float32)
+    # MXU one-hot decode (ROADMAP 2b): sum_m lut[m, code[l, m]] as ONE
+    # [l_blk, m*ks] x [m*ks, 1] matmul. The one-hot operand is m 2D
+    # lane-compares concatenated lane-wise (no gather, LUT never leaves
+    # VMEM); the [m, ks] LUT flattens m-major so lanes line up. The old
+    # VPU select-and-sum ran m [l_blk, ks] reduces per block — this is
+    # one systolic pass over the same m*ks contraction.
+    onehot = jnp.concatenate(
+        [iota_ks == codes[:, mi][:, None] for mi in range(m)], axis=1)
+    lut_col = lut.reshape(m * ks, 1)
+    dn = (((1,), (0,)), ((), ()))
+    if precision == "fp32":
+        # f32 x f32 at HIGHEST: the MXU's six-pass fp32-faithful mode —
+        # products are exact (one-hot), so only summation order can move
+        acc = jax.lax.dot_general(
+            onehot.astype(jnp.float32), lut_col,
+            dn, preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
     else:
-        acc = jnp.zeros((codes.shape[0],), jnp.float32)
-        for mi in range(m):
-            onehot = iota_ks == codes[:, mi][:, None]
-            acc = acc + jnp.sum(
-                jnp.where(onehot,
-                          lut[mi][None, :].astype(jnp.float32), 0.0),
-                axis=1)
-        adc = acc
+        # bf16 LUT entries are native; uint8 0..255 is EXACT in bf16
+        # (8 mantissa bits), products are exact one-hot selects, and the
+        # f32 accumulator holds integer sums <= m * 255 < 2^24 exactly in
+        # ANY order — so the int8 pool stays bit-identical to the old
+        # integer accumulation, now at one MXU pass per block
+        acc = jax.lax.dot_general(
+            onehot.astype(jnp.bfloat16), lut_col.astype(jnp.bfloat16),
+            dn, preferred_element_type=jnp.float32)
+    adc = acc[:, 0]
     # smaller ADC distance = better candidate; ragged tails -> -inf
     scores = jnp.where(mask_ref[0] > 0.5, -adc, _NEG_INF)[None, :]
     cand_ids = ids_ref[:]                                 # [1, l_blk]
@@ -347,6 +356,7 @@ def fused_adc_search(
     return best, best_ids
 
 
+@profiled_kernel("ivfpq_adc_pallas")
 def adc_topr_auto(
     coarse, codebooks, codes, ids, mask, vectors, norms_sq, valid,
     queries, probes, *,
@@ -361,7 +371,9 @@ def adc_topr_auto(
     None (auto) runs the Pallas kernel natively on TPU and the XLA
     fallback scan elsewhere; "pallas" forces the kernel — interpret-mode
     on a non-TPU backend, the CPU-sim parity path; "xla" forces the
-    fallback scan."""
+    fallback scan. ``profiled_kernel`` covers it like the exact entries,
+    so the profiler's ``retraced`` oracle and the roofline fold see
+    direct launches of the fused ADC program too."""
     platform = jax.devices()[0].platform
     if impl == "pallas":
         use_pallas, interpret = True, platform != "tpu"

@@ -239,6 +239,9 @@ def build_knn_serving_step(
     k_shard: int,
     k_final: int,
     similarity: str,
+    kernel: str = "xla",
+    score_precision: str = "fp32",
+    interpret: bool = False,
 ):
     """Exact k-NN over S shards laid out on D devices (S % D == 0; each
     device owns a block of S/D shards — the two-level layout of the
@@ -248,37 +251,72 @@ def build_knn_serving_step(
       -> (scores [B, k_final], global_ids [B, k_final], counts [S, B])
 
     global id = shard_idx * n + flat_doc; counts[s, b] = number of finite
-    per-shard winners (the shard's matched-doc count, ≤ k_shard). Scoring
-    runs in fp32 with HIGHEST matmul precision so results are exact and
-    identical to the host path (VERDICT r2 weak #2). The S % D == 0
-    precondition is the caller's (distributed_serving picks D as a divisor
-    of S)."""
+    per-shard winners (the shard's matched-doc count, ≤ k_shard). At the
+    default (kernel="xla", score_precision="fp32") scoring runs in fp32
+    with HIGHEST matmul precision so results are exact and identical to
+    the host path (VERDICT r2 weak #2). Any other combination routes each
+    local shard's scan through ops/pallas_knn.knn_fused_shard — the fused
+    blockwise kernel (kernel="pallas"; `interpret` threads the caller's
+    platform resolution, ONE read per program build) or its bit-compatible
+    XLA reference (kernel="xla" at a reduced precision), so pallas-vs-xla
+    mesh programs compare identical math per precision. Reduced-precision
+    scans end in the kernel's exact fp32 rescore, keeping scores in the
+    serving score space; fused slots past a shard's valid-doc count carry
+    explicit (-inf, -1) global ids. The S % D == 0 precondition is the
+    caller's (distributed_serving picks D as a divisor of S)."""
+    fused = (kernel, score_precision) != ("xla", "fp32")
 
     def step(vectors, norms_sq, valid, queries):
         # block shapes: [S_local, n, d], [S_local, n], [S_local, n], [B, d]
         s_local, n_flat, _d = vectors.shape
-        dots = jnp.einsum(
-            "bd,snd->sbn", queries, vectors,
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        q_sq = jnp.sum(queries * queries, axis=-1)[None, :, None]  # [1, B, 1]
-        if similarity == "l2_norm":
-            d_sq = jnp.maximum(q_sq - 2.0 * dots + norms_sq[:, None, :], 0.0)
-            scores = 1.0 / (1.0 + d_sq)
-        elif similarity == "cosine":
-            denom = jnp.sqrt(q_sq) * jnp.sqrt(norms_sq)[:, None, :]
-            scores = (1.0 + dots / jnp.maximum(denom, 1e-12)) / 2.0
-        else:  # dot_product
-            scores = jnp.where(dots >= 0, dots + 1.0, 1.0 / (1.0 - dots))
-        scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+        if fused:
+            # one fused blockwise scan per LOCAL shard (s_local is a
+            # static block shape, so this unrolls at trace time into the
+            # single compiled per-device program)
+            from opensearch_tpu.ops import pallas_knn
 
-        # per-shard top-k (k-NN plugin: k applies per shard)
-        vals, ids = jax.vmap(lambda s: jax.lax.top_k(s, k_shard))(scores)
+            per_v, per_i = [], []
+            for si in range(s_local):
+                v, i = pallas_knn.knn_fused_shard(
+                    vectors[si], norms_sq[si], valid[si], queries,
+                    k=k_shard, similarity=similarity,
+                    score_precision=score_precision,
+                    impl=kernel, interpret=interpret,
+                )
+                per_v.append(v)
+                per_i.append(i)
+            vals = jnp.stack(per_v)                    # [S_local, B, k]
+            ids = jnp.stack(per_i)
+        else:
+            dots = jnp.einsum(
+                "bd,snd->sbn", queries, vectors,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            q_sq = jnp.sum(queries * queries, axis=-1)[None, :, None]
+            if similarity == "l2_norm":
+                d_sq = jnp.maximum(
+                    q_sq - 2.0 * dots + norms_sq[:, None, :], 0.0)
+                scores = 1.0 / (1.0 + d_sq)
+            elif similarity == "cosine":
+                denom = jnp.sqrt(q_sq) * jnp.sqrt(norms_sq)[:, None, :]
+                scores = (1.0 + dots / jnp.maximum(denom, 1e-12)) / 2.0
+            else:  # dot_product
+                scores = jnp.where(dots >= 0, dots + 1.0, 1.0 / (1.0 - dots))
+            scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+
+            # per-shard top-k (k-NN plugin: k applies per shard)
+            vals, ids = jax.vmap(lambda s: jax.lax.top_k(s, k_shard))(scores)
         counts = jnp.sum(jnp.isfinite(vals), axis=-1)          # [S_local, B]
 
         shard0 = jax.lax.axis_index(DATA_AXIS) * s_local
-        gids = ids + (shard0 + jnp.arange(s_local))[:, None, None] * n_flat
+        offsets = (shard0 + jnp.arange(s_local))[:, None, None] * n_flat
+        if fused:
+            # fused scans mark empty slots id -1: keep them explicit
+            # instead of wrapping them into a neighbouring shard's range
+            gids = jnp.where(ids >= 0, ids + offsets, -1)
+        else:
+            gids = ids + offsets
 
         # merge: local shards concat in shard order, gather device blocks in
         # data-axis order — candidate position order is (shard asc, rank

@@ -1,12 +1,23 @@
 """Live-tunable ANN serving configuration (the ANNS-AMP knobs + the
 kernel selection policy).
 
-The IVF-PQ serving path (executor.shard_knn_selection's ANN branch) reads
-three dynamic settings on every dispatch:
+The kNN serving paths (executor.shard_knn_selection's ANN and exact
+branches) read five dynamic settings on every dispatch:
 
   search.knn.ann.adc_precision       "fp32" | "bf16" | "int8"
   search.knn.ann.rescore_multiplier  exact-rescore pool = multiplier * k
   search.knn.ann.kernel              "auto" | "pallas" | "xla"
+  search.knn.kernel                  "auto" | "pallas" | "xla" (EXACT path)
+  search.knn.score_precision         "fp32" | "bf16" | "int8" (EXACT scan)
+
+``search.knn.kernel`` extends the ANN policy's auto/pallas/xla shape to
+the EXACT path (ISSUE 19): "pallas" serves the fused blockwise exact-kNN
+kernel (ops/pallas_knn.knn_fused_auto — running top-R pool in VMEM, only
+[B, R] winners to HBM) instead of the materializing / streaming XLA
+lowerings; ``search.knn.score_precision`` picks the fused SCAN's matmul
+width (reduced precisions widen the pool and exact-rescore in fp32, so
+returned scores stay in the serving score space). Both values ride the
+batch key, so a live flip never re-ranks an in-flight batch.
 
 Reduced-precision ADC (ops/ivfpq.search) only ranks CANDIDATES; the fused
 program always ends in an exact fp32 rescore over the widened pool, so
@@ -83,8 +94,45 @@ KERNEL_SETTING: Setting[str] = Setting(
     validator=_validate_kernel,
 )
 
+
+def _validate_exact_kernel(v: str) -> None:
+    if v not in ANN_KERNELS:
+        raise ValueError(
+            f"unknown [search.knn.kernel] value [{v}] "
+            f"(choose from {list(ANN_KERNELS)})"
+        )
+
+
+def _validate_score_precision(v: str) -> None:
+    # single source of truth is the fused exact kernel module
+    # (ops/pallas_knn.SCORE_PRECISIONS); lazy import keeps settings
+    # registration jax-free
+    from opensearch_tpu.ops.pallas_knn import SCORE_PRECISIONS
+
+    if v not in SCORE_PRECISIONS:
+        raise ValueError(
+            f"unknown [search.knn.score_precision] value [{v}] "
+            f"(choose from {list(SCORE_PRECISIONS)})"
+        )
+
+
+# the EXACT path's kernel policy (ISSUE 19): same auto/pallas/xla shape as
+# the ANN policy, applied to the fused exact-kNN scan (ops/pallas_knn.
+# knn_fused_auto) vs the XLA exact lowerings (fused.knn_topk / streaming)
+EXACT_KERNEL_SETTING: Setting[str] = Setting(
+    "search.knn.kernel", "auto", str,
+    Property.NODE_SCOPE, Property.DYNAMIC,
+    validator=_validate_exact_kernel,
+)
+SCORE_PRECISION_SETTING: Setting[str] = Setting(
+    "search.knn.score_precision", "fp32", str,
+    Property.NODE_SCOPE, Property.DYNAMIC,
+    validator=_validate_score_precision,
+)
+
 ANN_SETTINGS = (ADC_PRECISION_SETTING, RESCORE_MULTIPLIER_SETTING,
-                KERNEL_SETTING)
+                KERNEL_SETTING, EXACT_KERNEL_SETTING,
+                SCORE_PRECISION_SETTING)
 
 
 def resolve_kernel(policy: str) -> str:
@@ -126,10 +174,16 @@ class AnnServingConfig:
         self.rescore_multiplier: int = RESCORE_MULTIPLIER_SETTING.default(
             Settings.EMPTY)
         self.kernel: str = KERNEL_SETTING.default(Settings.EMPTY)
+        self.exact_kernel: str = EXACT_KERNEL_SETTING.default(
+            Settings.EMPTY)
+        self.score_precision: str = SCORE_PRECISION_SETTING.default(
+            Settings.EMPTY)
 
     def configure(self, *, adc_precision: str | None = None,
                   rescore_multiplier: int | None = None,
-                  kernel: str | None = None) -> None:
+                  kernel: str | None = None,
+                  exact_kernel: str | None = None,
+                  score_precision: str | None = None) -> None:
         if adc_precision is not None:
             _validate_precision(adc_precision)
             self.adc_precision = adc_precision
@@ -138,6 +192,12 @@ class AnnServingConfig:
         if kernel is not None:
             _validate_kernel(kernel)
             self.kernel = kernel
+        if exact_kernel is not None:
+            _validate_exact_kernel(exact_kernel)
+            self.exact_kernel = exact_kernel
+        if score_precision is not None:
+            _validate_score_precision(score_precision)
+            self.score_precision = score_precision
 
     def apply_settings(self, flat: dict) -> None:
         """Pick this config's keys out of a flat effective-settings map
@@ -151,6 +211,8 @@ class AnnServingConfig:
             adc_precision=ADC_PRECISION_SETTING.get(s),
             rescore_multiplier=RESCORE_MULTIPLIER_SETTING.get(s),
             kernel=KERNEL_SETTING.get(s),
+            exact_kernel=EXACT_KERNEL_SETTING.get(s),
+            score_precision=SCORE_PRECISION_SETTING.get(s),
         )
 
     def snapshot(self) -> dict:
@@ -158,6 +220,8 @@ class AnnServingConfig:
             "adc_precision": self.adc_precision,
             "rescore_multiplier": self.rescore_multiplier,
             "kernel": self.kernel,
+            "exact_kernel": self.exact_kernel,
+            "score_precision": self.score_precision,
         }
         # index-build accounting (index/device.py): how many IVF-PQ
         # structures this process built at publish time, and their cost

@@ -401,14 +401,31 @@ def mesh_knn_batch(
 
     k_shard = max(1, min(int(first.k), bundle.n_flat))
     k_final = min(max(k_shard, int(fetch_k)), s * k_shard)
+    # EXACT-path kernel policy (search.knn.kernel / score_precision): the
+    # RESOLVED kernel + precision are part of the program key, so a live
+    # flip compiles a fresh mesh program and never re-ranks a batch formed
+    # under the old policy. The platform read happens ONCE per program
+    # build (pallas off-TPU runs interpret-mode — the parity path).
+    from opensearch_tpu.search.ann import (
+        default_config as ann_config,
+        resolve_kernel,
+    )
+
+    exact_kernel = resolve_kernel(ann_config.exact_kernel)
+    score_precision = ann_config.score_precision
+    fused = (exact_kernel, score_precision) != ("xla", "fp32")
     prog_key = (n_devices, s, bundle.n_flat, dims, k_shard, k_final,
-                similarity, b_pad)
+                similarity, b_pad, exact_kernel, score_precision)
     with _CACHE_LOCK:
         program = _PROGRAM_CACHE.get(prog_key)
         retraced = program is None
         if program is None:
+            interpret = (exact_kernel == "pallas"
+                         and jax.devices()[0].platform != "tpu")
             program = build_knn_serving_step(
-                mesh, k_shard=k_shard, k_final=k_final, similarity=similarity
+                mesh, k_shard=k_shard, k_final=k_final,
+                similarity=similarity, kernel=exact_kernel,
+                score_precision=score_precision, interpret=interpret,
             )
             _PROGRAM_CACHE[prog_key] = program
 
@@ -426,13 +443,27 @@ def mesh_knn_batch(
     wall_ns = time.perf_counter_ns() - t0
     launch_id = registry.next_launch_id()
     registry.record_launch_wall(wall_ns)
+    registry.record_launch_kernel(exact_kernel, score_precision)
     # roofline accounting: ONE sharded launch against the mesh cost model
     # (per-slot scan + on-device all_gather/top_k merge)
     from opensearch_tpu.telemetry import roofline
 
     launch_params = dict(b=b_pad, s=s, n_flat=bundle.n_flat, d=dims,
                          k_shard=k_shard, devices=n_devices)
-    roofline.record_launch("mesh_knn", wall_ns, **launch_params)
+    if fused:
+        from opensearch_tpu.ops.pallas_knn import fused_pool_width
+
+        launch_params.update(
+            precision=score_precision,
+            r=fused_pool_width(k_shard, score_precision),
+            kernel=exact_kernel,
+        )
+        mesh_family = "mesh_knn_fused"
+        roofline.record_launch(
+            f"mesh_knn_fused[{score_precision}]", wall_ns, **launch_params)
+    else:
+        mesh_family = "mesh_knn"
+        roofline.record_launch("mesh_knn", wall_ns, **launch_params)
     from opensearch_tpu.telemetry.device_ledger import (
         KIND_QUERY_BATCH,
         default_ledger,
@@ -442,11 +473,11 @@ def mesh_knn_batch(
     # heat touch against the mesh bundle this launch scanned, bytes from
     # the same cost model the roofline fold used (telemetry/device_ledger)
     default_ledger.touch([getattr(bundle, "allocation", None)],
-                         family="mesh_knn", params=launch_params)
+                         family=mesh_family, params=launch_params)
     if retraced:
         # program-cache miss == fresh jit entry for the mesh kernel family;
         # the first launch wall includes the compile
-        default_ledger.record_compile("mesh_knn", wall_ns)
+        default_ledger.record_compile(mesh_family, wall_ns)
     _count("distributed_searches")
     if has_filter:
         _count("filtered")

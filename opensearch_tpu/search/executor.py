@@ -71,7 +71,7 @@ STREAMING_CHUNK = 32_768
 # Searches run on a parallel pool (rest/http.py), so increments go through
 # _count_knn_path — a bare `dict[k] += 1` is read-modify-write and drops
 # counts under concurrency.
-knn_path_stats = {"streaming": 0, "materializing": 0, "ann": 0}
+knn_path_stats = {"streaming": 0, "materializing": 0, "ann": 0, "fused": 0}
 _knn_path_stats_lock = threading.Lock()
 
 
@@ -366,9 +366,106 @@ class ShardContext:
                 # valid mask, so they never merge (key=None -> solo).
                 # The key's generation term is the snapshot-safety
                 # invariant: a refresh mid-flight is a different key.
+                from opensearch_tpu.ops import pallas_knn as pallas_knn_ops
                 from opensearch_tpu.search import batcher as batcher_mod
+                from opensearch_tpu.search.ann import (
+                    default_config as ann_config,
+                    resolve_kernel,
+                )
 
-                if (host.n_docs >= STREAMING_MIN_DOCS
+                # EXACT-path kernel policy (search.knn.kernel): when it
+                # resolves to "pallas", BOTH exact strategies (streaming
+                # and materializing) serve through the fused blockwise
+                # kernel instead — the RESOLVED kernel and scan precision
+                # ride the batch key, so a live flip starts new batches
+                # and never re-ranks an in-flight one
+                exact_kernel = resolve_kernel(ann_config.exact_kernel)
+                score_precision = ann_config.score_precision
+                if (exact_kernel == "pallas"
+                        and k_bucket <= pallas_knn_ops.FUSED_MAX_K):
+
+                    def fused_key(kb: int):
+                        return ("knn_fused", id(vf),
+                                self.snapshot.generation, kb, sim,
+                                score_precision, exact_kernel)
+
+                    key = (
+                        fused_key(k_bucket)
+                        if node.filter is None else None
+                    )
+                    alt_keys = tuple(
+                        fused_key(kb)
+                        for kb in (k_bucket * 2, k_bucket * 4)
+                        if kb <= pallas_knn_ops.FUSED_MAX_K
+                    ) if key is not None else ()
+
+                    touch_allocs = _touch_targets(dev, node.field)
+
+                    def launch_fused(rows):
+                        q_batch = _pad_query_batch(rows)
+                        t0 = time.perf_counter_ns()
+                        with profile.profiling(None):
+                            b_vals, b_ids = pallas_knn_ops.knn_fused_auto(
+                                vf.vectors, vf.norms_sq, valid, q_batch,
+                                k=k_bucket, similarity=sim,
+                                score_precision=score_precision,
+                                impl=exact_kernel,
+                            )
+                        # host materialization is the fence for this launch
+                        b_vals = np.asarray(b_vals)
+                        b_ids = np.asarray(b_ids)
+                        launch_params = dict(
+                            b=int(q_batch.shape[0]),
+                            n=int(vf.vectors.shape[0]),
+                            d=int(vf.vectors.shape[1]), k=k_bucket,
+                            r=pallas_knn_ops.fused_pool_width(
+                                k_bucket, score_precision),
+                            precision=score_precision,
+                        )
+                        roofline.record_launch(
+                            f"knn_fused_pallas[{score_precision}]",
+                            time.perf_counter_ns() - t0,
+                            **launch_params,
+                        )
+                        from opensearch_tpu.telemetry.device_ledger import (
+                            default_ledger,
+                        )
+
+                        default_ledger.touch(
+                            touch_allocs, family="knn_fused_pallas",
+                            params=launch_params)
+                        retraced = profile.signature_retraced(
+                            "knn_fused_pallas", (vf.vectors, q_batch),
+                            (k_bucket, sim, score_precision, exact_kernel))
+                        return (
+                            [(b_vals[i], b_ids[i])
+                             for i in range(len(rows))],
+                            retraced,
+                        )
+
+                    out = batcher_mod.dispatch(
+                        key, qv[0], launch_fused,
+                        shards=1, rank=k_bucket,
+                        alt_keys=alt_keys,
+                        family="knn_fused_pallas",
+                        tune_key=("knn_fused_pallas",
+                                  id(self.mapper_service), node.field,
+                                  k_bucket))
+                    vals, ids = out.value
+                    if prof is not None:
+                        prof.record_kernel(
+                            "knn_fused_pallas", out.kernel_share_ns,
+                            int(qv.nbytes), out.retraced,
+                            annotations={
+                                "score_precision": score_precision,
+                                "kernel": exact_kernel,
+                            },
+                        )
+                    scores = np.full(n_pad, -np.inf, np.float32)
+                    hit = ids >= 0
+                    scores[ids[hit]] = vals[hit]
+                    _count_knn_path("fused")
+                elif (host.n_docs >= STREAMING_MIN_DOCS
                         and n_pad % chunk == 0 and k_bucket <= chunk):
                     from opensearch_tpu.ops import fused
 

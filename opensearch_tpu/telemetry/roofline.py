@@ -222,6 +222,64 @@ def _model_mesh(p: dict) -> tuple[int, int]:
     return flops, nbytes
 
 
+#: HBM bytes per corpus element at each fused-scan precision
+_FUSED_SCAN_BYTES = {"fp32": 4, "bf16": 2, "int8": 1}
+
+
+def _fused_scan_terms(b: int, n: int, d: int, r: int,
+                      precision: str) -> tuple[int, int]:
+    """Per-corpus terms of the fused blockwise exact-kNN scan
+    (ops/pallas_knn.knn_fused): one [B,d]x[n,d] matmul at the scan
+    precision + the transform/pool merge, [B,R] winners out, exact fp32
+    rescore when the scan ran reduced. Reduced precisions pay an honest
+    per-launch prep pass (read the f32 corpus + write the narrowed
+    operand — knn_fused quantizes per launch, nothing is cached), so
+    their byte floor is HIGHER than fp32's here; the kernel's win is the
+    never-materialized [B,n] score matrix, not corpus bytes."""
+    w = _FUSED_SCAN_BYTES.get(precision, _F32)
+    flops = 2 * b * n * d + 6 * b * n        # matmul + transform/merge
+    nbytes = (w * n * d                      # corpus at scan width
+              + _F32 * 2 * n                 # norms + valid
+              + _F32 * b * d                 # queries
+              + _IDX * b * r)                # [B, R] winners out
+    if precision != "fp32":
+        flops += 2 * b * r * d + 6 * b * r   # exact fp32 rescore
+        nbytes += _F32 * (n * d + b * r * d)  # prep read + rescore gather
+        nbytes += w * n * d                  # prep write (narrow operand)
+    if precision == "int8":
+        flops += 2 * (n * d + b * d)         # quantize round/clip passes
+    return flops, nbytes
+
+
+def _model_knn_fused(p: dict) -> tuple[int, int]:
+    """Fused blockwise exact-kNN kernel (ops/pallas_knn.knn_fused_auto,
+    family knn_fused_pallas): the [B,n] score matrix of the XLA exact
+    lowerings NEVER exists — only [B,R] winners land in HBM. That delta
+    vs _model_knn_exact's B·n term is what the kernel swap buys on the
+    materializing path; vs _model_knn_streaming the win is on-chip
+    selection width (R rounds in VMEM scratch, no per-chunk carries)."""
+    b, n, d = int(p["b"]), int(p["n"]), int(p["d"])
+    r = int(p.get("r", p.get("k", 10)))
+    precision = str(p.get("precision", "fp32"))
+    return _fused_scan_terms(b, n, d, r, precision)
+
+
+def _model_mesh_fused(p: dict) -> tuple[int, int]:
+    """Shard-mesh kNN program with the fused per-shard scan (ISSUE 19):
+    S independent fused corpus scans (the _model_knn_fused terms per
+    shard slab) + the unchanged on-device all_gather/top_k merge."""
+    b, s = int(p["b"]), int(p["s"])
+    n_flat, d = int(p["n_flat"]), int(p["d"])
+    k_shard = int(p["k_shard"])
+    devices = int(p.get("devices", s))
+    r = int(p.get("r", k_shard))
+    precision = str(p.get("precision", "fp32"))
+    flops_1, nbytes_1 = _fused_scan_terms(b, n_flat, d, r, precision)
+    flops = s * flops_1
+    nbytes = s * nbytes_1 + _IDX * devices * b * k_shard
+    return flops, nbytes
+
+
 def _model_bm25(p: dict) -> tuple[int, int]:
     """BM25 postings scan (ops/bm25.bm25_term_scores): Q padded term
     windows gathered + tf/norm math + scatter-add. 6 FLOPs per posting
@@ -250,7 +308,9 @@ COST_MODELS: dict[str, Callable[[dict], tuple[int, int]]] = {
     "knn_topk_streaming": _model_knn_streaming,
     "ivfpq_search": _model_ivfpq,
     "ivfpq_adc_pallas": _model_ivfpq_adc_pallas,
+    "knn_fused_pallas": _model_knn_fused,
     "mesh_knn": _model_mesh,
+    "mesh_knn_fused": _model_mesh_fused,
     "bm25_term_scores": _model_bm25,
     "constant_term_scores": _model_constant_terms,
 }
@@ -289,9 +349,37 @@ def _adapt_constant(args: tuple, kwargs: dict) -> dict:
             "n_pad": int(_arg(args, kwargs, 4, "n_pad"))}
 
 
+def _adapt_knn_fused(args: tuple, kwargs: dict) -> dict:
+    # ops/pallas_knn.knn_fused_auto(vectors, norms_sq, valid, queries, *,
+    # k, similarity, score_precision, impl)
+    vectors, queries = args[0], args[3]
+    k = int(kwargs.get("k", 10))
+    precision = str(kwargs.get("score_precision", "fp32"))
+    from opensearch_tpu.ops.pallas_knn import fused_pool_width
+
+    return {"b": int(queries.shape[0]), "n": int(vectors.shape[0]),
+            "d": int(vectors.shape[1]), "k": k,
+            "r": fused_pool_width(k, precision), "precision": precision}
+
+
+def _adapt_adc_topr(args: tuple, kwargs: dict) -> dict:
+    # ops/pallas_adc.adc_topr_auto(coarse, codebooks, codes, ids, mask,
+    # vectors, norms_sq, valid, queries, probes, *, k, rerank, ...)
+    coarse, codebooks, codes = args[0], args[1], args[2]
+    queries, probes = args[8], args[9]
+    return {"b": int(queries.shape[0]),
+            "nlist": int(coarse.shape[0]), "d": int(coarse.shape[1]),
+            "m": int(codebooks.shape[0]), "ks": int(codebooks.shape[1]),
+            "nprobe": int(probes.shape[1]), "l_pad": int(codes.shape[1]),
+            "rescore": int(kwargs.get("rerank", 0)),
+            "adc_precision": str(kwargs.get("adc_precision", "fp32"))}
+
+
 _KERNEL_PARAM_ADAPTERS: dict[str, Callable[[tuple, dict], dict]] = {
     "knn_exact_scores": _adapt_knn,
     "knn_raw_similarity": _adapt_knn,
+    "knn_fused_pallas": _adapt_knn_fused,
+    "ivfpq_adc_pallas": _adapt_adc_topr,
     "bm25_term_scores": _adapt_bm25,
     "constant_term_scores": _adapt_constant,
 }

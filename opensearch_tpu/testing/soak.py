@@ -2561,10 +2561,16 @@ def run_soak(seed: int, tmp_path, *, cycles: int = 3, nodes: int = 3,
     # on the CPU sim, so the cooperative split (host probe select + one
     # batched fused scan) faces kill/partition chaos, and the mid-soak
     # ann_rebuild proves old-generation batches never merge into the new
-    # kernel variant (both terms ride the batch key). A static policy is
-    # seed-deterministic; restored on exit so sibling tests keep "auto".
+    # kernel variant (both terms ride the batch key). ISSUE 19 extends the
+    # same forcing to the EXACT path (search.knn.kernel="pallas"): exact
+    # knn ops under FUSED_MAX_K serve through the fused blockwise kernel,
+    # so its pool/padding/tie-break math also soaks under chaos. A static
+    # policy is seed-deterministic; restored on exit so siblings keep
+    # "auto".
     prev_kernel = ann_mod.default_config.kernel
-    ann_mod.default_config.configure(kernel="pallas")
+    prev_exact_kernel = ann_mod.default_config.exact_kernel
+    ann_mod.default_config.configure(kernel="pallas",
+                                     exact_kernel="pallas")
     try:
         with timeutil.clock_scope(harness.queue.clock()), \
                 randutil.rng_scope(harness.queue.random):
@@ -2578,7 +2584,8 @@ def run_soak(seed: int, tmp_path, *, cycles: int = 3, nodes: int = 3,
               f"opensearch_tpu.testing.soak --replay {failure.seed}")
         raise
     finally:
-        ann_mod.default_config.configure(kernel=prev_kernel)
+        ann_mod.default_config.configure(kernel=prev_kernel,
+                                         exact_kernel=prev_exact_kernel)
         harness.close()
     return harness.report
 

@@ -27,6 +27,14 @@
 #                               # also asserts fused int8/bf16 QPS >= fp32 —
 #                               # the inversion resolution; the CPU sim's
 #                               # interpret path is recall-only), and
+#                               # bench.py --fused-knn-gate holds the fused
+#                               # exact-kNN path to BENCH_KNN_FUSED.json:
+#                               # served fp32 recall@10 must be EXACTLY 1.0
+#                               # under search.knn.kernel="pallas", reduced
+#                               # precisions above the recall floor, and the
+#                               # fused program >= 1.0x the legacy XLA exact
+#                               # scorer within tolerance (on TPU the fused
+#                               # qps rows are the real Pallas kernel), and
 #                               # bench.py --tail-gate asserts the tail
 #                               # control plane (lanes + wait auto-tuner +
 #                               # residency routing) still buys >= 1.5x
@@ -96,6 +104,8 @@ if [[ "${1:-}" == "--bench" ]]; then
   python bench.py --heat-overhead
   echo "== ANN gate (recall@10 >= 0.95 ratchet incl. fused-Pallas path + batched >= 1.3x + QPS floor) =="
   python bench.py --ann-gate
+  echo "== fused exact-kNN gate (served fp32 recall@10 == 1.0 under kernel=pallas, fused >= 1.0x XLA within tolerance, QPS floor vs BENCH_KNN_FUSED.json) =="
+  python bench.py --fused-knn-gate
   echo "== tail gate (interactive p99 >= 1.5x better with lanes+tuner+routing on, no aggregate-QPS regression, zero interactive sheds) =="
   python bench.py --tail-gate
   echo "== roofline gate (every family modeled, fractions in (0,1], accounted_flops == sum of per-launch model FLOPs) =="

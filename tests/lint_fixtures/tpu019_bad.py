@@ -1,8 +1,11 @@
 """TPU019 true positives: non-atomic compound operations on state shared
 across pools — check-then-act with no lock, a subscript `+=` on a shared
-dict, and a pop whose contains-test happened under an EARLIER lock hold
-(the cache-insert and double-delete review shapes, pre-fix)."""
+dict, a pop whose contains-test happened under an EARLIER lock hold
+(the cache-insert and double-delete review shapes, pre-fix), unlocked
+Counter/defaultdict read-modify-write, an assignment-spelled rmw, and a
+double-checked init whose sentinel test is not repeated under the lock."""
 
+import collections
 import threading
 
 
@@ -80,6 +83,111 @@ class JobTable:
             with self._lock:
                 return self._jobs.pop(key)  # EXPECT: TPU019
         return None
+
+    def _offload(self, fn):
+        return fn()
+
+
+class TermTally:
+    """Counter.update merges counts key by key — each key is a
+    load+add+store, so concurrent merges from two pools lose bumps."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._counts = collections.Counter()
+
+    def bump_async(self, terms):
+        return self._search_pool.submit(self._bump, terms)
+
+    def drain_on_worker(self):
+        def read():
+            return dict(self._counts)
+
+        return self._offload(read)
+
+    def _bump(self, terms):
+        self._counts.update(terms)  # EXPECT: TPU019
+
+    def _offload(self, fn):
+        return fn()
+
+
+class TopDocsBook:
+    """defaultdict vivify-and-mutate: `d[k].append(v)` inserts the
+    default list and appends as two separate dict operations, so two
+    pools can vivify distinct lists and one append vanishes."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._groups = collections.defaultdict(list)
+
+    def collect(self, shard, hit):
+        return self._search_pool.submit(self._add, shard, hit)
+
+    def drain(self):
+        def read():
+            return dict(self._groups)
+
+        return self._offload(read)
+
+    def _add(self, shard, hit):
+        self._groups[shard].append(hit)  # EXPECT: TPU019
+
+    def _offload(self, fn):
+        return fn()
+
+
+class ScrollLedger:
+    """Read-modify-write spelled as an assignment: the right-hand side
+    reads the same slot the target stores, with no lock held."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._scrolls = {}
+
+    def extend_async(self, key, ids):
+        return self._search_pool.submit(self._extend, key, ids)
+
+    def seed(self, key):
+        def write():
+            self._scrolls[key] = []
+
+        return self._offload(write)
+
+    def _extend(self, key, ids):
+        self._scrolls[key] = self._scrolls[key] + ids  # EXPECT: TPU019
+
+    def _offload(self, fn):
+        return fn()
+
+
+class CodebookCache:
+    """Double-checked init without the second check: the `is None` test
+    ran before the lock was taken and is not repeated inside it, so two
+    pools can both pass the test and build the codebooks twice."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._lock = threading.Lock()
+        self._codebooks = None
+
+    def get_async(self):
+        return self._search_pool.submit(self._ensure)
+
+    def peek_on_worker(self):
+        def read():
+            return self._codebooks
+
+        return self._offload(read)
+
+    def _ensure(self):
+        if self._codebooks is None:  # EXPECT: TPU003
+            with self._lock:
+                self._codebooks = self._build()  # EXPECT: TPU019
+        return self._codebooks  # EXPECT: TPU003
+
+    def _build(self):
+        return {}
 
     def _offload(self, fn):
         return fn()

@@ -1,7 +1,10 @@
 """TPU019 false-positive guards: the same compound shapes made atomic —
 get() with a default instead of check-then-act, the whole test+act inside
-ONE lock hold, and pop(k, None) absorbing a concurrent delete."""
+ONE lock hold, pop(k, None) absorbing a concurrent delete, locked
+Counter/defaultdict merges, a locked assignment-rmw, and double-checked
+init that re-tests the sentinel under the lock."""
 
+import collections
 import threading
 
 
@@ -76,6 +79,118 @@ class JobTable:
             if key in self._jobs:
                 return self._jobs.pop(key)
         return None
+
+    def _offload(self, fn):
+        return fn()
+
+
+class TermTally:
+    """Counter merges serialized under one lock from every pool."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._lock = threading.Lock()
+        self._counts = collections.Counter()
+
+    def bump_async(self, terms):
+        return self._search_pool.submit(self._bump, terms)
+
+    def drain_on_worker(self):
+        def read():
+            with self._lock:
+                return dict(self._counts)
+
+        return self._offload(read)
+
+    def _bump(self, terms):
+        with self._lock:
+            self._counts.update(terms)
+
+    def _offload(self, fn):
+        return fn()
+
+
+class TopDocsBook:
+    """Vivify-and-append under the lock: the default insert and the
+    mutation are one critical section."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._lock = threading.Lock()
+        self._groups = collections.defaultdict(list)
+
+    def collect(self, shard, hit):
+        return self._search_pool.submit(self._add, shard, hit)
+
+    def drain(self):
+        def read():
+            with self._lock:
+                return dict(self._groups)
+
+        return self._offload(read)
+
+    def _add(self, shard, hit):
+        with self._lock:
+            self._groups[shard].append(hit)
+
+    def _offload(self, fn):
+        return fn()
+
+
+class ScrollLedger:
+    """The assignment-spelled read-modify-write held under one lock, so
+    the read and the store are a single critical section."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._lock = threading.Lock()
+        self._scrolls = {}
+
+    def extend_async(self, key, ids):
+        return self._search_pool.submit(self._extend, key, ids)
+
+    def seed(self, key):
+        def write():
+            with self._lock:
+                self._scrolls[key] = []
+
+        return self._offload(write)
+
+    def _extend(self, key, ids):
+        with self._lock:
+            self._scrolls[key] = self._scrolls[key] + ids
+
+    def _offload(self, fn):
+        return fn()
+
+
+class CodebookCache:
+    """Lazy init done atomically: the sentinel test and the build sit in
+    one critical section, so only one pool ever builds the codebooks."""
+
+    def __init__(self, search_pool):
+        self._search_pool = search_pool
+        self._lock = threading.Lock()
+        self._codebooks = None
+
+    def get_async(self):
+        return self._search_pool.submit(self._ensure)
+
+    def peek_on_worker(self):
+        def read():
+            with self._lock:
+                return self._codebooks
+
+        return self._offload(read)
+
+    def _ensure(self):
+        with self._lock:
+            if self._codebooks is None:
+                self._codebooks = self._build()
+            return self._codebooks
+
+    def _build(self):
+        return {}
 
     def _offload(self, fn):
         return fn()

@@ -99,6 +99,55 @@ def test_nested_async_def_reports_once():
     assert [(v.line, v.rule) for v in violations] == [(4, "TPU002")]
 
 
+_DOUBLE_CHECKED = textwrap.dedent("""\
+    import threading
+
+
+    class Cache:
+        def __init__(self, search_pool):
+            self._search_pool = search_pool
+            self._lock = threading.Lock()
+            self._table = None
+
+        def get_async(self):
+            return self._search_pool.submit(self._ensure)
+
+        def peek_on_worker(self):
+            def read():
+                return self._table
+
+            return self._offload(read)
+
+        def _ensure(self):
+            if self._table is None:
+                with self._lock:
+                    {retest}self._table = self._build()
+            return self._table
+
+        def _build(self):
+            return {{}}
+
+        def _offload(self, fn):
+            return fn()
+""")
+
+
+def test_tpu019_double_checked_init_retest_under_lock_passes():
+    """The locked re-test of the `is None` sentinel is what makes
+    double-checked init safe: with it TPU019 stays silent, without it
+    the init assignment is flagged (the fast-path read is TPU003's
+    business either way, so only TPU019 is asserted here)."""
+    broken = _DOUBLE_CHECKED.format(retest="")
+    fixed = _DOUBLE_CHECKED.format(
+        retest="if self._table is None:\n                    ")
+    flagged = [v for v in lint_source("x.py", broken, ALL_CHECKERS)
+               if v.rule == "TPU019"]
+    assert [v.line for v in flagged] == [22]
+    assert "double-checked init" in flagged[0].message
+    assert not [v for v in lint_source("x.py", fixed, ALL_CHECKERS)
+                if v.rule == "TPU019"]
+
+
 # ---------------------------------------------------------------------------
 # baseline ratchet semantics
 # ---------------------------------------------------------------------------

@@ -1,0 +1,479 @@
+"""Fused exact-kNN Pallas kernel (ISSUE 19): per-precision parity, the
+mesh one-launch-per-node program, and the exact-path kernel policy.
+
+Acceptance properties:
+ - interpret-mode parity vs the XLA reference per score precision: int8
+   pools are BIT-identical (integer matmul + scalar dequant), fp32/bf16
+   ids identical with scores equal to summation order, and every reduced
+   precision ends in the exact fp32 rescore (serving score space);
+ - padding (n not a block multiple), the valid mask, (-inf, -1) tail
+   slots past the live-doc count, and lowest-doc-id tie-break all match
+   the XLA path bit for bit;
+ - the shard_map serving program (parallel/distributed) returns identical
+   vals/gids/counts for kernel="pallas" vs the XLA reference at 1/2/4
+   devices, and the fp32 fused program equals the legacy einsum program;
+ - ``search.knn.kernel`` / ``search.knn.score_precision`` round-trip
+   /_cluster/settings with validation + None-deletion, apply live, ride
+   the dispatch batch key (no cross-kernel merges), and serve through the
+   executor's fused branch with roofline + ledger + retraced accounting.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax
+
+from opensearch_tpu.common.errors import IllegalArgumentException
+from opensearch_tpu.node import TpuNode
+from opensearch_tpu.ops import fused, pallas_knn
+from opensearch_tpu.search import ann as ann_mod
+from opensearch_tpu.search import distributed_serving
+from opensearch_tpu.search import executor as executor_mod
+from opensearch_tpu.search.batcher import KnnDispatchBatcher
+from opensearch_tpu.telemetry import roofline
+
+DIM = 16
+N_DOCS = 700
+PRECISIONS = pallas_knn.SCORE_PRECISIONS
+SIMS = ("l2_norm", "cosine", "dot_product")
+
+
+def _corpus(rng, n, d, n_centers=8, spread=5.0):
+    centers = rng.standard_normal((n_centers, d)) * spread
+    return (
+        centers[rng.integers(0, n_centers, n)] + rng.standard_normal((n, d))
+    ).astype(np.float32)
+
+
+def _operands(rng, n=N_DOCS, d=DIM, b=6, n_dead=25):
+    data = _corpus(rng, n, d)
+    vecs = jnp.asarray(data)
+    norms = jnp.sum(vecs * vecs, axis=1)
+    valid = np.ones(n, bool)
+    valid[rng.choice(n, n_dead, replace=False)] = False
+    queries = jnp.asarray(_corpus(rng, b, d))
+    return vecs, norms, jnp.asarray(valid), queries, valid
+
+
+# ---------------------------------------------------------------------------
+# interpret-mode parity vs the XLA reference, per precision x similarity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("similarity", SIMS)
+@pytest.mark.parametrize("precision", PRECISIONS)
+def test_fused_parity_interpret_vs_xla(precision, similarity):
+    """The kernel and its XLA reference share the dot/transform/rescore
+    math, so the [B, k] contract is identical — int8 bit-for-bit (integer
+    accumulation + scalar dequant), floats to summation order."""
+    rng = np.random.default_rng(3)
+    vecs, norms, valid, queries, _ = _operands(rng)
+    out = {}
+    for impl in ("pallas", "xla"):
+        out[impl] = pallas_knn.knn_fused(
+            vecs, norms, valid, queries, k=10, similarity=similarity,
+            score_precision=precision, impl=impl, interpret=True)
+    pv, pi = map(np.asarray, out["pallas"])
+    xv, xi = map(np.asarray, out["xla"])
+    assert np.array_equal(pi, xi)
+    if precision == "int8":
+        assert np.array_equal(pv, xv)
+    else:
+        assert np.allclose(pv, xv, atol=1e-6, equal_nan=True)
+
+
+@pytest.mark.parametrize("precision", PRECISIONS)
+def test_fused_recall_vs_exact_reference(precision):
+    """fp32 must reproduce ops/fused.knn_topk exactly; the reduced
+    precisions widen the pool then rescore in exact fp32, holding
+    recall@10 == 1.0 on the clustered corpus (the --fused-knn bench
+    gate's recall floor, asserted here on the CPU sim)."""
+    rng = np.random.default_rng(11)
+    vecs, norms, valid, queries, _ = _operands(rng)
+    ev, ei = map(np.asarray, fused.knn_topk(
+        vecs, norms, valid, queries, k=10, similarity="l2_norm"))
+    fv, fi = map(np.asarray, pallas_knn.knn_fused(
+        vecs, norms, valid, queries, k=10, similarity="l2_norm",
+        score_precision=precision, impl="pallas", interpret=True))
+    if precision == "fp32":
+        assert np.array_equal(fi, ei)
+        assert np.allclose(fv, ev, rtol=1e-6)
+    else:
+        recall = np.mean([
+            len(set(fi[b]) & set(ei[b])) / 10 for b in range(fi.shape[0])])
+        assert recall == 1.0, f"{precision} recall@10 {recall} < 1.0"
+        # the rescore is exact fp32: same winners carry the same
+        # serving-space scores the reference computed
+        assert np.allclose(np.sort(fv, axis=1), np.sort(ev, axis=1),
+                           atol=1e-4)
+
+
+@pytest.mark.parametrize("impl", ("pallas", "xla"))
+def test_fused_fewer_live_docs_than_k_pads(impl):
+    rng = np.random.default_rng(5)
+    n, k = 300, 16
+    data = _corpus(rng, n, DIM)
+    vecs = jnp.asarray(data)
+    norms = jnp.sum(vecs * vecs, axis=1)
+    valid = np.zeros(n, bool)
+    valid[:5] = True
+    queries = jnp.asarray(_corpus(rng, 3, DIM))
+    vals, ids = map(np.asarray, pallas_knn.knn_fused(
+        vecs, norms, jnp.asarray(valid), queries, k=k,
+        similarity="l2_norm", score_precision="fp32", impl=impl,
+        interpret=True))
+    assert vals.shape == (3, k) and ids.shape == (3, k)
+    for b in range(3):
+        assert set(ids[b, :5]) == {0, 1, 2, 3, 4}
+    assert np.all(ids[:, 5:] == -1)
+    assert np.all(np.isneginf(vals[:, 5:]))
+
+
+def test_fused_tie_break_prefers_lower_doc_id():
+    """Duplicate vectors straddling a block boundary: the carried-first
+    pool merge must reproduce lax.top_k's lowest-index tie-break."""
+    rng = np.random.default_rng(7)
+    n = pallas_knn.FK_BLOCK + 64
+    data = rng.standard_normal((n, 8)).astype(np.float32)
+    dup = data[3].copy()
+    data[pallas_knn.FK_BLOCK + 11] = dup  # same vector, later block
+    vecs = jnp.asarray(data)
+    norms = jnp.sum(vecs * vecs, axis=1)
+    valid = jnp.asarray(np.ones(n, bool))
+    queries = jnp.asarray(dup[None, :] + 0.0)
+    for precision in PRECISIONS:
+        pv, pi = map(np.asarray, pallas_knn.knn_fused(
+            vecs, norms, valid, queries, k=4, similarity="l2_norm",
+            score_precision=precision, impl="pallas", interpret=True))
+        xv, xi = map(np.asarray, pallas_knn.knn_fused(
+            vecs, norms, valid, queries, k=4, similarity="l2_norm",
+            score_precision=precision, impl="xla", interpret=True))
+        assert np.array_equal(pi, xi), precision
+        both = {3, pallas_knn.FK_BLOCK + 11}
+        assert both <= set(pi[0].tolist()), precision
+        # the duplicate pair ties exactly: lower doc id must rank first
+        assert list(pi[0]).index(3) < list(pi[0]).index(
+            pallas_knn.FK_BLOCK + 11), precision
+
+
+def test_fused_quantize_symmetric_int8_contract():
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((32, DIM)).astype(np.float32) * 3
+    q, scale = pallas_knn.quantize_symmetric_int8(jnp.asarray(x))
+    q, scale = np.asarray(q), float(scale)
+    assert q.dtype == np.int8
+    assert np.max(np.abs(q)) <= 127
+    assert np.allclose(q * scale, x, atol=scale)
+
+
+# ---------------------------------------------------------------------------
+# mesh one-launch-per-node program: parity at 1/2/4 devices
+# ---------------------------------------------------------------------------
+
+
+def _mesh_inputs(rng, s, n, d, b):
+    vectors = rng.standard_normal((s, n, d)).astype(np.float32)
+    norms = np.sum(vectors * vectors, axis=2)
+    valid = rng.random((s, n)) > 0.1
+    queries = rng.standard_normal((b, d)).astype(np.float32)
+    return (jnp.asarray(vectors), jnp.asarray(norms),
+            jnp.asarray(valid), jnp.asarray(queries))
+
+
+@pytest.mark.parametrize("n_dev", (1, 2, 4))
+def test_mesh_fused_parity_across_shard_counts(n_dev):
+    """build_knn_serving_step with kernel="pallas" (interpret on the CPU
+    sim) and the XLA reference agree bit for bit on vals/gids/counts at
+    every device count, at every precision; the fp32 fused program also
+    equals the legacy einsum program exactly."""
+    from jax.sharding import Mesh
+
+    from opensearch_tpu.parallel import distributed as dist_mod
+
+    devices = np.array(jax.devices()[:n_dev])
+    assert devices.size == n_dev
+    rng = np.random.default_rng(21)
+    s, n, d, b = 4, 256, DIM, 8
+    vectors, norms, valid, queries = _mesh_inputs(rng, s, n, d, b)
+    mesh = Mesh(devices, ("data",))
+    legacy = dist_mod.build_knn_serving_step(
+        mesh, k_shard=8, k_final=10, similarity="l2")
+    lv, lg, lc = map(np.asarray, legacy(vectors, norms, valid, queries))
+    for precision in PRECISIONS:
+        out = {}
+        for kernel in ("pallas", "xla"):
+            step = dist_mod.build_knn_serving_step(
+                mesh, k_shard=8, k_final=10, similarity="l2",
+                kernel=kernel, score_precision=precision,
+                interpret=True)
+            out[kernel] = tuple(map(
+                np.asarray, step(vectors, norms, valid, queries)))
+        pv, pg, pc = out["pallas"]
+        xv, xg, xc = out["xla"]
+        assert np.array_equal(pg, xg), (n_dev, precision)
+        assert np.array_equal(pc, xc), (n_dev, precision)
+        if precision == "int8":
+            assert np.array_equal(pv, xv), n_dev
+        else:
+            assert np.allclose(pv, xv, atol=1e-6), (n_dev, precision)
+        if precision == "fp32":
+            assert np.array_equal(pg, lg), n_dev
+            assert np.allclose(pv, lv, rtol=1e-6), n_dev
+            assert np.array_equal(pc, lc), n_dev
+
+
+# ---------------------------------------------------------------------------
+# settings: round-trip, validation, live application, batch-key isolation
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def exact_node(tmp_path):
+    prev_peaks = roofline.current_peaks()
+    roofline.set_peaks(roofline.stub_peaks(seed=3))
+    n = TpuNode(tmp_path / "node")
+    n.create_index("ex", {
+        "settings": {"number_of_shards": 1},
+        "mappings": {"properties": {
+            "x": {"type": "knn_vector", "dimension": DIM}}},
+    })
+    rng = np.random.default_rng(17)
+    data = _corpus(rng, 200, DIM)
+    n.bulk([
+        ("index", {"_index": "ex", "_id": str(i)},
+         {"x": data[i].round(3).tolist()})
+        for i in range(200)
+    ], refresh=True)
+    n._test_data = data
+    yield n
+    ann_mod.default_config.configure(
+        exact_kernel="auto", score_precision="fp32", kernel="auto")
+    distributed_serving.enabled = True
+    n.close()
+    if prev_peaks is not None:
+        roofline.set_peaks(prev_peaks)
+
+
+def test_exact_kernel_settings_roundtrip(exact_node):
+    exact_node.put_cluster_settings({"persistent": {"search": {"knn": {
+        "kernel": "pallas", "score_precision": "int8"}}}})
+    assert ann_mod.default_config.exact_kernel == "pallas"
+    assert ann_mod.default_config.score_precision == "int8"
+    st = exact_node.knn_batcher.snapshot_stats()
+    assert st["ann"]["exact_kernel"] == "pallas"
+    assert st["ann"]["score_precision"] == "int8"
+
+    for bad in ({"kernel": "mosaic"}, {"score_precision": "int4"}):
+        with pytest.raises(IllegalArgumentException):
+            exact_node.put_cluster_settings(
+                {"persistent": {"search": {"knn": bad}}})
+
+    # null deletion restores the defaults
+    exact_node.put_cluster_settings({"persistent": {"search": {"knn": {
+        "kernel": None, "score_precision": None}}}})
+    assert ann_mod.default_config.exact_kernel == "auto"
+    assert ann_mod.default_config.score_precision == "fp32"
+
+
+def test_served_fused_path_accounting(exact_node):
+    """kernel=pallas on the CPU sim serves the exact path through the
+    fused branch end to end: same hits as the XLA path, knn_path_stats
+    counts it, the roofline recorder sees knn_fused_pallas[precision]
+    with a non-zero achieved fraction, the padded query batch lands in
+    the ledger's transient counters, and the steady state does not
+    retrace."""
+    from opensearch_tpu.telemetry.device_ledger import default_ledger
+
+    data = exact_node._test_data
+    distributed_serving.enabled = False
+    try:
+        body = {"size": 10, "query": {
+            "knn": {"x": {"vector": data[5].tolist(), "k": 10}}}}
+        truth = [h["_id"] for h in
+                 exact_node.search("ex", body)["hits"]["hits"]]
+
+        exact_node.put_cluster_settings({"persistent": {"search": {"knn": {
+            "kernel": "pallas"}}}})
+        fams0 = roofline.default_recorder.snapshot_stats()["families"]
+        before = sum(r["launches"] for f, r in fams0.items()
+                     if f.startswith("knn_fused_pallas["))
+        fused_before = executor_mod.knn_path_stats["fused"]
+        transients0 = default_ledger.snapshot_stats()["transient_uploads"]
+
+        got = [h["_id"] for h in
+               exact_node.search("ex", body)["hits"]["hits"]]
+        assert got == truth
+
+        assert executor_mod.knn_path_stats["fused"] > fused_before
+        fams1 = roofline.default_recorder.snapshot_stats()["families"]
+        after = sum(r["launches"] for f, r in fams1.items()
+                    if f.startswith("knn_fused_pallas["))
+        assert after > before
+        assert default_ledger.snapshot_stats()["transient_uploads"] \
+            > transients0
+
+        # /_roofline ranks the family with non-zero achieved fractions
+        from opensearch_tpu.rest.handlers import build_router
+
+        router = build_router()
+        handler, params = router.resolve("GET", "/_roofline")
+        status, report = handler(exact_node, params, {}, None)
+        assert status == 200
+        rows = {r["family"]: r for r in report["families"]}
+        assert "knn_fused_pallas[fp32]" in rows
+        row = rows["knn_fused_pallas[fp32]"]
+        assert row["achieved_gflops"] > 0
+        assert 0.0 < row["roofline_fraction"] <= 1.0
+        assert row["bound"] in ("memory", "compute")
+
+        # steady state: the same shape does not retrace, and the kernel
+        # row carries the policy annotations + roofline fields
+        resp = exact_node.search("ex", {**body, "profile": True})
+
+        def kernel_rows(entry):
+            yield from entry.get("kernels", [])
+            for child in entry.get("children", []):
+                yield from kernel_rows(child)
+
+        recs = [rec for sp in resp["profile"]["shards"]
+                for entry in sp["searches"][0]["query"]
+                for rec in kernel_rows(entry)
+                if rec["name"] == "knn_fused_pallas"]
+        assert recs, "profiled search must report the fused kernel"
+        for rec in recs:
+            assert rec["retraces"] == 0, "steady state must not retrace"
+            assert rec["kernel"] == "pallas"
+            assert rec["score_precision"] == "fp32"
+    finally:
+        distributed_serving.enabled = True
+
+
+def test_mesh_serving_uses_fused_family_under_policy(exact_node):
+    """A multi-shard knn search with kernel=pallas runs the fused
+    shard_map program: hits identical to the host merge, the
+    mesh_knn_fused roofline family fed, and the shard-mesh registry
+    pinned to the serving kernel/precision."""
+    from opensearch_tpu.cluster.shard_mesh import default_registry
+
+    rng = np.random.default_rng(29)
+    data = _corpus(rng, 120, DIM)
+    exact_node.create_index("m4", {
+        "settings": {"number_of_shards": 4},
+        "mappings": {"properties": {
+            "x": {"type": "knn_vector", "dimension": DIM}}},
+    })
+    exact_node.bulk([
+        ("index", {"_index": "m4", "_id": str(i)},
+         {"x": data[i].round(3).tolist()})
+        for i in range(120)
+    ], refresh=True)
+    body = {"size": 10, "query": {
+        "knn": {"x": {"vector": data[7].tolist(), "k": 10}}}}
+
+    exact_node.put_cluster_settings({"persistent": {"search": {"knn": {
+        "kernel": "pallas", "score_precision": "bf16"}}}})
+    fams0 = roofline.default_recorder.snapshot_stats()["families"]
+    before = sum(r["launches"] for f, r in fams0.items()
+                 if f.startswith("mesh_knn_fused["))
+    dist = exact_node.search("m4", body)
+
+    distributed_serving.enabled = False
+    try:
+        host = exact_node.search("m4", body)
+    finally:
+        distributed_serving.enabled = True
+    assert [h["_id"] for h in dist["hits"]["hits"]] == \
+        [h["_id"] for h in host["hits"]["hits"]]
+
+    fams1 = roofline.default_recorder.snapshot_stats()["families"]
+    after = sum(r["launches"] for f, r in fams1.items()
+                if f.startswith("mesh_knn_fused["))
+    assert after > before
+    st = default_registry.snapshot_stats()
+    assert st["fused_launches"] > 0
+    assert st["last_kernel"] == "pallas"
+    assert st["last_score_precision"] == "bf16"
+
+
+def test_policy_flip_never_merges_inflight_batches():
+    """Keys differing ONLY in (kernel, score_precision) never share a
+    launch: a live flip of search.knn.kernel or score_precision cannot
+    re-rank queries already batched under the other program."""
+    batcher = KnnDispatchBatcher(max_batch_size=8, max_wait_ms=300)
+    seen: dict[tuple, list] = {}
+    lock = threading.Lock()
+
+    def launch_for(variant):
+        def launch(payloads):
+            with lock:
+                seen.setdefault(variant, []).append(sorted(payloads))
+            return [f"{variant[0]}/{variant[1]}:{p}" for p in payloads], False
+        return launch
+
+    variants = [("pallas", "fp32"), ("pallas", "int8"),
+                ("xla", "fp32"), ("xla", "int8")]
+    barrier = threading.Barrier(len(variants))
+    out = {}
+
+    def run(kernel, precision, payload):
+        key = ("knn_fused", 4321, 7, 10, "l2_norm", precision, kernel)
+        barrier.wait()
+        out[(kernel, precision)] = batcher.dispatch(
+            key, payload, launch_for((kernel, precision)),
+            kind="exact").value
+
+    threads = [
+        threading.Thread(target=run, args=(k, p, f"{k}-{p}"))
+        for k, p in variants
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for kernel, precision in variants:
+        assert out[(kernel, precision)] == \
+            f"{kernel}/{precision}:{kernel}-{precision}"
+    for variant, batches in seen.items():
+        for batch in batches:
+            assert batch == [f"{variant[0]}-{variant[1]}"], \
+                "cross-variant payloads merged into one launch"
+
+
+# ---------------------------------------------------------------------------
+# roofline cost models for the two new families
+# ---------------------------------------------------------------------------
+
+
+def test_cost_models_rank_fused_families_with_nonzero_fractions():
+    rec = roofline.RooflineRecorder()
+    roofline.set_peaks(roofline.stub_peaks(seed=0))
+    knn_shape = dict(b=8, n=4096, d=DIM, k=10, r=40)
+    rec.record("knn_fused_pallas[fp32]", 4_000_000,
+               params=dict(knn_shape, precision="fp32"))
+    rec.record("knn_fused_pallas[int8]", 2_500_000,
+               params=dict(knn_shape, precision="int8"))
+    rec.record("mesh_knn_fused[bf16]", 6_000_000, params=dict(
+        s=4, n_flat=1024, d=DIM, b=8, k_shard=8, devices=4,
+        precision="bf16"))
+    report = rec.report()
+    rows = {r["family"]: r for r in report["families"]}
+    for fam in ("knn_fused_pallas[fp32]", "knn_fused_pallas[int8]",
+                "mesh_knn_fused[bf16]"):
+        assert fam in rows, fam
+        assert rows[fam]["achieved_gflops"] > 0, fam
+        assert 0.0 < rows[fam]["roofline_fraction"] <= 1.0, fam
+        assert rows[fam]["bound"] in ("memory", "compute")
+    losses = [r["lost_ms"] for r in report["families"]]
+    assert losses == sorted(losses, reverse=True)
+    # the reduced-precision byte model charges the per-launch quantize
+    # pass (prep read+write and the rescore gather), so int8 carries a
+    # HIGHER modeled byte floor than fp32 — the model is honest about
+    # nothing being cached across launches
+    int8 = rows["knn_fused_pallas[int8]"]
+    fp32 = rows["knn_fused_pallas[fp32]"]
+    assert int8["bytes"] > fp32["bytes"]

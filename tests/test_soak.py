@@ -78,6 +78,33 @@ def test_soak_exercises_fused_adc_kernel_policy(tmp_path):
         "run_soak must restore the kernel policy it forced"
 
 
+def test_soak_exercises_fused_exact_kernel_policy(tmp_path):
+    """ISSUE 19 satellite: run_soak also forces search.knn.kernel="pallas",
+    so the exact kNN workloads (search_knn / msearch against "vec", k well
+    under FUSED_MAX_K) serve through the fused blockwise distance kernel —
+    single-shard ops through the executor's knn_fused_pallas launch, mesh
+    ops through the one-launch-per-node mesh_knn_fused program — under
+    kill/partition chaos, and the forced policy is restored on exit."""
+    from opensearch_tpu.search import ann as ann_mod
+    from opensearch_tpu.telemetry import roofline
+
+    def fused_launches():
+        fams = roofline.default_recorder.snapshot_stats()["families"]
+        return sum(row["launches"] for name, row in fams.items()
+                   if name.startswith(("knn_fused_pallas[",
+                                       "mesh_knn_fused[")))
+
+    before = fused_launches()
+    prev_exact = ann_mod.default_config.exact_kernel
+    report = run_soak(7, tmp_path, **SUBSET)
+    assert report.ops_completed == report.ops_issued
+    assert report.faults_injected, "chaos cycles must inject faults"
+    assert fused_launches() > before, \
+        "soak exact kNN searches never ran the fused kernel"
+    assert ann_mod.default_config.exact_kernel == prev_exact, \
+        "run_soak must restore the exact-kernel policy it forced"
+
+
 def test_soak_telemetry_stays_bounded(tmp_path):
     """ISSUE 8 satellite: span exporters ride every soak node (synchronous,
     memory-sink, seed-derived sampling) and the telemetry-bounded invariant
