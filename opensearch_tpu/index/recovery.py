@@ -32,6 +32,7 @@ GET [/{index}]/_recovery and GET /_cat/recovery.
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -120,6 +121,14 @@ class RecoverySourceSessions:
     snapshot — a retried chunk returns byte-identical data no matter what
     the live engine did in between (the reference holds the Lucene commit
     via a retention lock; here the packed blobs themselves are retained).
+
+    Thread contract: the registry is touched from TWO domains —
+    recovery starts and file-chunk packing run on the data worker
+    (``_offload`` in cluster_node), while ops batches, finalize, and
+    cluster-state target drops run inline on the transport loop — so
+    every registry operation holds ``_lock`` (the whole-program TPU018/
+    TPU019 pass surfaced the torn ``reap`` walk vs a concurrent
+    ``close``, and the evict scan in ``open`` racing the same pop).
     """
 
     # sessions idle longer than this are reaped (a target that died without
@@ -135,6 +144,7 @@ class RecoverySourceSessions:
 
     def __init__(self):
         self._sessions: dict[tuple[str, int, str], dict] = {}
+        self._lock = threading.Lock()
 
     def open(self, index: str, shard: int, target: str, *,
              mode: str, blobs: dict[str, bytes] | None = None,
@@ -147,32 +157,38 @@ class RecoverySourceSessions:
             "touched_ms": _now_ms(),
         }
         key = (index, shard, target)
-        while len(self._sessions) >= self.MAX_SESSIONS and \
-                key not in self._sessions:
-            stalest = min(self._sessions,
-                          key=lambda k: self._sessions[k]["touched_ms"])
-            del self._sessions[stalest]
-        self._sessions[key] = session
+        with self._lock:
+            # evict-then-insert under ONE hold: the stalest scan and its
+            # del must not interleave with a transport-loop close()
+            while len(self._sessions) >= self.MAX_SESSIONS and \
+                    key not in self._sessions:
+                stalest = min(self._sessions,
+                              key=lambda k: self._sessions[k]["touched_ms"])
+                del self._sessions[stalest]
+            self._sessions[key] = session
         return session
 
     def get(self, index: str, shard: int, target: str) -> dict | None:
-        s = self._sessions.get((index, shard, target))
+        with self._lock:
+            s = self._sessions.get((index, shard, target))
         if s is not None:
             s["touched_ms"] = _now_ms()
         return s
 
     def close(self, index: str, shard: int, target: str) -> None:
-        self._sessions.pop((index, shard, target), None)
+        with self._lock:
+            self._sessions.pop((index, shard, target), None)
 
     def drop_target(self, index: str, shard: int, target: str) -> None:
         self.close(index, shard, target)
 
     def reap(self, now_ms: int | None = None) -> list[tuple]:
         now = now_ms if now_ms is not None else _now_ms()
-        dead = [k for k, s in self._sessions.items()
-                if now - s["touched_ms"] > self.SESSION_TTL_MS]
-        for k in dead:
-            del self._sessions[k]
+        with self._lock:
+            dead = [k for k, s in self._sessions.items()
+                    if now - s["touched_ms"] > self.SESSION_TTL_MS]
+            for k in dead:
+                del self._sessions[k]
         return dead
 
     # -- chunk reads --------------------------------------------------------

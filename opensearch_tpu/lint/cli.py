@@ -84,6 +84,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--jobs", type=int, default=None, metavar="N",
         help="parse/check files in N worker processes "
              "(default: auto for repo-wide runs, serial for small ones)")
+    parser.add_argument(
+        "--no-cache", action="store_true",
+        help="bypass the whole-program role-summary cache "
+             "(.tpulint_cache.json): re-extract every module summary")
     return parser
 
 
@@ -198,7 +202,8 @@ def main(argv: list[str] | None = None) -> int:
     if jobs is None:
         # auto: a repo-wide run amortizes pool startup; tiny runs don't
         jobs = min(8, os.cpu_count() or 1)
-    violations, files_checked = lint_paths(paths, checkers, jobs=jobs)
+    violations, files_checked = lint_paths(paths, checkers, jobs=jobs,
+                                           use_cache=not args.no_cache)
     elapsed = time.monotonic() - t0
 
     baseline_path = None if args.no_baseline else (
@@ -240,10 +245,15 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.format == "json":
         report = {
-            "version": 1,
+            "version": 2,
             "files_checked": files_checked,
             "elapsed_seconds": round(elapsed, 3),
             "baseline": baseline_path,
+            # the active rule catalog, so gate scripts assert "rule X ran"
+            # from the same report they read findings from (no text grep)
+            "rules": [{"id": c.rule_id, "name": c.name,
+                       "description": c.description}
+                      for c in sorted(checkers, key=lambda c: c.rule_id)],
             "total_violations": len(violations),
             "violations": [v.to_dict() for v in violations],
             "regressions": [r.to_dict() for r in regressions],

@@ -29,18 +29,25 @@ class Violation:
     line: int
     col: int
     message: str
+    # structured evidence (domains, roles, lock sets) for --format json
+    # consumers; omitted from to_dict when absent so the text-era shape
+    # is unchanged
+    meta: tuple | None = None
 
     def sort_key(self) -> tuple:
         return (self.path, self.line, self.col, self.rule)
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "rule": self.rule,
             "path": self.path,
             "line": self.line,
             "col": self.col,
             "message": self.message,
         }
+        if self.meta is not None:
+            out["meta"] = dict(self.meta)
+        return out
 
     def render(self) -> str:
         return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
@@ -127,13 +134,16 @@ class FileContext:
         ids = self.suppressed.get(line, ())
         return ids is None or rule in ids
 
-    def violation(self, rule: str, node: ast.AST, message: str) -> Violation:
+    def violation(self, rule: str, node: ast.AST, message: str,
+                  meta: dict | None = None) -> Violation:
         return Violation(
             rule=rule,
             path=self.display_path,
             line=getattr(node, "lineno", 1),
             col=getattr(node, "col_offset", 0) + 1,
             message=message,
+            meta=(tuple(sorted(meta.items()))
+                  if meta is not None else None),
         )
 
 
@@ -193,11 +203,29 @@ def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
                 yield path
 
 
+# rules that consume whole-program role summaries (lint/callgraph.py)
+ROLE_RULES = ("TPU018", "TPU019")
+
+
+def _file_local_roles(source: str, tree: ast.AST) -> dict:
+    """Cross-CLASS role propagation within ONE file — the fallback when
+    no whole-program pass ran (single-snippet lint, fixtures), so the
+    cross-module shapes stay testable as self-contained files."""
+    from opensearch_tpu.lint import callgraph
+
+    try:
+        summary = callgraph.extract_module(source, tree=tree)
+    except (ValueError, RecursionError):  # pragma: no cover - defensive
+        return {}
+    return callgraph.compute_program_roles({"<file>": summary})
+
+
 def lint_source(
     path: str,
     source: str,
     checkers: Iterable[Checker],
     display_path: str | None = None,
+    external_roles: dict | None = None,
 ) -> list[Violation]:
     display = display_path or normalize_path(path)
     try:
@@ -208,6 +236,11 @@ def lint_source(
             line=e.lineno or 1, col=(e.offset or 0) + 1,
             message=f"syntax error: {e.msg}",
         )]
+    checkers = list(checkers)
+    if external_roles is None and \
+            any(c.rule_id in ROLE_RULES for c in checkers):
+        external_roles = _file_local_roles(source, ctx.tree)
+    ctx.external_roles = external_roles or {}
     out: list[Violation] = []
     for checker in checkers:
         if not checker.applies_to(display, source):
@@ -219,7 +252,8 @@ def lint_source(
     return out
 
 
-def _lint_file(f: str, checkers: Iterable[Checker]) -> list[Violation]:
+def _lint_file(f: str, checkers: Iterable[Checker],
+               external_roles: dict | None = None) -> list[Violation]:
     try:
         with open(f, encoding="utf-8") as fh:
             source = fh.read()
@@ -228,22 +262,47 @@ def _lint_file(f: str, checkers: Iterable[Checker]) -> list[Violation]:
             rule=PARSE_ERROR_RULE, path=normalize_path(f),
             line=1, col=1, message=f"cannot read file: {e}",
         )]
-    return lint_source(f, source, checkers)
+    return lint_source(f, source, checkers, external_roles=external_roles)
 
 
-def _lint_file_by_rules(args: tuple[str, tuple[str, ...]]) -> list[Violation]:
+def _lint_file_by_rules(args: tuple) -> list[Violation]:
     """Process-pool worker: files are dispatched with RULE IDS (picklable)
-    and each worker resolves them against its own module-level registry."""
-    f, rule_ids = args
+    and each worker resolves them against its own module-level registry.
+    The per-file external-role slice rides along so the whole-program
+    fixpoint runs ONCE in the parent, never per worker."""
+    f, rule_ids, external_roles = args
     from opensearch_tpu.lint.rules import RULES
 
-    return _lint_file(f, [RULES[r] for r in rule_ids])
+    return _lint_file(f, [RULES[r] for r in rule_ids],
+                      external_roles=external_roles)
+
+
+def _program_pass(files: list[str], use_cache: bool):
+    """Whole-program role summaries for a lint run.  When every linted
+    file lives inside the package, the analysis scope widens to the WHOLE
+    package so single-file lint still sees cross-module callers (cache
+    hits make that cheap); otherwise the scope is the linted set."""
+    from opensearch_tpu.lint import callgraph
+
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    abs_files = [os.path.abspath(f) for f in files]
+    if abs_files and all(f.startswith(pkg_dir + os.sep) for f in abs_files):
+        scope = list(iter_py_files([pkg_dir]))
+    else:
+        scope = abs_files
+    roles, summaries = callgraph.program_roles(scope, use_cache=use_cache)
+
+    def for_file(f: str) -> dict:
+        return callgraph.roles_for_file(summaries, roles, f) or {}
+
+    return for_file
 
 
 def lint_paths(
     paths: Iterable[str],
     checkers: Iterable[Checker] | None = None,
     jobs: int | None = None,
+    use_cache: bool = True,
 ) -> tuple[list[Violation], int]:
     """Lint every .py file under `paths`. Returns (violations, files_checked).
 
@@ -252,6 +311,11 @@ def lint_paths(
     Parallel dispatch requires registry checkers (rule ids are what
     crosses the process boundary); custom checker instances fall back to
     serial, as does any pool failure.
+
+    When the checker set includes the thread-role rules, a whole-program
+    pre-pass (lint/callgraph.py) runs first and each file is linted with
+    its classes' externally derived roles; ``use_cache=False`` bypasses
+    the on-disk summary cache.
     """
     if checkers is None:
         from opensearch_tpu.lint.rules import ALL_CHECKERS
@@ -260,6 +324,13 @@ def lint_paths(
     checkers = list(checkers)
     files = list(iter_py_files(paths))
     violations: list[Violation] = []
+
+    roles_for = None
+    if any(c.rule_id in ROLE_RULES for c in checkers):
+        roles_for = _program_pass(files, use_cache)
+
+    def external(f: str) -> dict:
+        return roles_for(f) if roles_for is not None else {}
 
     if jobs is not None and jobs > 1 and len(files) >= 2 * jobs:
         from opensearch_tpu.lint.rules import RULES
@@ -275,7 +346,7 @@ def lint_paths(
                 with _cf.ProcessPoolExecutor(max_workers=jobs) as pool:
                     for batch in pool.map(
                         _lint_file_by_rules,
-                        [(f, rule_ids) for f in files],
+                        [(f, rule_ids, external(f)) for f in files],
                         chunksize=max(1, len(files) // (jobs * 4)),
                     ):
                         violations.extend(batch)
@@ -286,6 +357,6 @@ def lint_paths(
                 violations = []  # pool unavailable: fall through to serial
 
     for f in files:
-        violations.extend(_lint_file(f, checkers))
+        violations.extend(_lint_file(f, checkers, external_roles=external(f)))
     violations.sort(key=Violation.sort_key)
     return violations, len(files)

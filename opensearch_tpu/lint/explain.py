@@ -517,6 +517,145 @@ class QueryCache:
 }
 
 
+# The thread-role rules also fire on CROSS-MODULE shapes: the racing class
+# has no dispatch idiom of its own — its roles arrive from a caller class
+# that constructs/injects it (lint/callgraph.py propagates roles through
+# ``self.<attr>.<method>()`` edges). These pairs document exactly that
+# shape; like EXAMPLES they are real lintable sources exercised by
+# tests/test_lint.py (the bad snippet must fire its own rule and nothing
+# else from the role family, the good snippet must stay clean).
+CROSS_MODULE_EXAMPLES: dict[str, Example] = {
+    "TPU018": Example(
+        bad='''\
+class ShardStatsService:
+    """No dispatch idiom in sight: roles arrive from the caller below."""
+
+    def __init__(self):
+        self._rows = {}
+
+    def record(self, key, nbytes):
+        self._rows[key] = nbytes
+
+    def total(self):
+        # live iteration vs the data worker's writes — no common lock
+        return sum(n for _k, n in self._rows.items())
+
+
+class StatsNode:
+    def __init__(self, scheduler):
+        self.stats = ShardStatsService()
+        scheduler.schedule(1000, self._tick)  # _tick: timer role
+
+    def handle_index(self, key, nbytes):
+        def write():
+            self.stats.record(key, nbytes)
+
+        return self._offload(write)  # record(): data-worker role
+
+    def _tick(self):
+        return self.stats.total()  # total(): timer role
+
+    def _offload(self, fn):
+        return fn()
+''',
+        good='''\
+class ShardStatsService:
+    def __init__(self):
+        self._rows = {}
+
+    def record(self, key, nbytes):
+        self._rows[key] = nbytes
+
+    def total(self):
+        # list() snapshots atomically against single-key writes
+        return sum(n for _k, n in list(self._rows.items()))
+
+
+class StatsNode:
+    def __init__(self, scheduler):
+        self.stats = ShardStatsService()
+        scheduler.schedule(1000, self._tick)
+
+    def handle_index(self, key, nbytes):
+        def write():
+            self.stats.record(key, nbytes)
+
+        return self._offload(write)
+
+    def _tick(self):
+        return self.stats.total()
+
+    def _offload(self, fn):
+        return fn()
+''',
+    ),
+    "TPU019": Example(
+        bad='''\
+class SessionTable:
+    """Check-then-act that is only racy because of how callers role it."""
+
+    def __init__(self):
+        self._sessions = {}
+
+    def open(self, sid, session):
+        if sid not in self._sessions:    # the slot can be filled between
+            self._sessions[sid] = session  # the test and the insert
+
+    def close(self, sid):
+        return self._sessions.pop(sid, None)
+
+
+class RecoveryNode:
+    def __init__(self, transport):
+        self.sessions = SessionTable()
+        transport.register("n1", "recovery:start", self._on_start)
+
+    def _on_start(self, msg):
+        self.sessions.open(msg["sid"], msg)  # open(): transport role
+
+    def begin_local(self, sid):
+        def work():
+            self.sessions.close(sid)
+
+        return self._offload(work)  # close(): data-worker role
+
+    def _offload(self, fn):
+        return fn()
+''',
+        good='''\
+class SessionTable:
+    def __init__(self):
+        self._sessions = {}
+
+    def open(self, sid, session):
+        # one atomic dict op: no window between membership test and insert
+        self._sessions.setdefault(sid, session)
+
+    def close(self, sid):
+        return self._sessions.pop(sid, None)
+
+
+class RecoveryNode:
+    def __init__(self, transport):
+        self.sessions = SessionTable()
+        transport.register("n1", "recovery:start", self._on_start)
+
+    def _on_start(self, msg):
+        self.sessions.open(msg["sid"], msg)
+
+    def begin_local(self, sid):
+        def work():
+            self.sessions.close(sid)
+
+        return self._offload(work)
+
+    def _offload(self, fn):
+        return fn()
+''',
+    ),
+}
+
+
 def explain(rule_id: str) -> str | None:
     """The full ``--explain`` text for one rule, or None if unknown."""
     from opensearch_tpu.lint.rules import RULES
@@ -528,6 +667,11 @@ def explain(rule_id: str) -> str | None:
     parts = [f"{rule_id} {checker.name}", "", checker.description, ""]
     if ex is not None:
         parts += ["BAD:", "", _indent(ex.bad), "GOOD:", "", _indent(ex.good)]
+    xex = CROSS_MODULE_EXAMPLES.get(rule_id)
+    if xex is not None:
+        parts += ["CROSS-MODULE BAD (roles arrive from the caller class):",
+                  "", _indent(xex.bad),
+                  "CROSS-MODULE GOOD:", "", _indent(xex.good)]
     return "\n".join(parts).rstrip() + "\n"
 
 

@@ -2436,15 +2436,34 @@ class UntrackedStructureReadChecker(Checker):
 # TPU018 — cross-pool shared state (thread-role race analysis)
 # ---------------------------------------------------------------------------
 
-# a file can only produce roles if it contains a dispatch idiom at all
+# a file can only produce roles ON ITS OWN if it contains a dispatch
+# idiom; files without one can still be roled by the whole-program pass
+# (ctx.external_roles, lint/callgraph.py) — the check()-level gate below
 def _role_gate(source: str) -> bool:
     return "self." in source and (
         "_offload" in source or "register" in source
-        or "schedule" in source or ".submit(" in source)
+        or "schedule" in source or ".submit(" in source
+        or "run_in_executor" in source or "start_server" in source)
+
+
+def _external_roles(ctx: FileContext) -> dict:
+    return getattr(ctx, "external_roles", None) or {}
 
 
 def _fmt_roles(roles: set[str]) -> str:
     return "/".join(sorted(roles))
+
+
+def _role_meta(roles: set[str], **extra) -> dict:
+    """Structured evidence for --format json: executor roles, collapsed
+    domains, plus rule-specific lock evidence (hashable values only —
+    Violation.meta is stored as a sorted item tuple)."""
+    meta = {
+        "roles": tuple(sorted(roles)),
+        "domains": tuple(sorted(threadroles.domains(roles))),
+    }
+    meta.update(extra)
+    return meta
 
 
 _KIND_DESC = {
@@ -2466,12 +2485,19 @@ class CrossPoolSharedStateChecker(Checker):
                    "`# tpulint: single-role` opts an attribute out")
 
     def applies_to(self, display_path: str, source: str) -> bool:
-        return _role_gate(source)
+        # wide textual gate: the real decision needs ctx.external_roles
+        return "class " in source and "self." in source
 
     def check(self, ctx: FileContext) -> Iterable[Violation]:
+        gate = _role_gate(ctx.source)
+        ext = _external_roles(ctx)
+        if not gate and not any(ext.values()):
+            return []
         out: list[Violation] = []
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.ClassDef):
+                if not gate and not ext.get(node.name):
+                    continue
                 out.extend(self._check_class(ctx, node))
         return out
 
@@ -2495,7 +2521,14 @@ class CrossPoolSharedStateChecker(Checker):
                 f"self.{conflict.attr} in {cls.name} is shared across "
                 f"thread roles: {detail}; hold one lock on every racy "
                 f"path, snapshot with list()/dict() first, or mark the "
-                f"attribute `# tpulint: single-role`"))
+                f"attribute `# tpulint: single-role`",
+                meta=_role_meta(
+                    a.scope.roles | b.scope.roles,
+                    attr=conflict.attr,
+                    locks=(tuple(sorted(a.held)),
+                           tuple(sorted(b.held))),
+                    races=(f"{a.kind}@{getattr(a.node, 'lineno', 0)}",
+                           f"{b.kind}@{getattr(b.node, 'lineno', 0)}"))))
         return out
 
 
@@ -2559,12 +2592,19 @@ class AtomicityChecker(Checker):
                    "act are not covered by one continuous lock hold")
 
     def applies_to(self, display_path: str, source: str) -> bool:
-        return _role_gate(source)
+        # wide textual gate: the real decision needs ctx.external_roles
+        return "class " in source and "self." in source
 
     def check(self, ctx: FileContext) -> Iterable[Violation]:
+        gate = _role_gate(ctx.source)
+        ext = _external_roles(ctx)
+        if not gate and not any(ext.values()):
+            return []
         out: list[Violation] = []
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.ClassDef):
+                if not gate and not ext.get(node.name):
+                    continue
                 out.extend(self._check_class(ctx, node))
         return out
 
@@ -2636,6 +2676,12 @@ class AtomicityChecker(Checker):
                                tests, reported, scope, out)
         return out
 
+    @staticmethod
+    def _meta(shared: dict, attr: str, held_now: frozenset,
+              shape: str) -> dict:
+        return _role_meta(shared[attr], attr=attr, shape=shape,
+                          locks=tuple(sorted(l for l, _ in held_now)))
+
     def _scan(self, ctx, cls, stmt, shared, ctors, held, tests, reported,
               scope, out) -> None:
         held_now = frozenset(held)
@@ -2690,7 +2736,9 @@ class AtomicityChecker(Checker):
                             f"read-modify-write, and self.{attr} is shared "
                             f"across roles {_fmt_roles(shared[attr])}, so "
                             f"concurrent increments are lost (wrap in the "
-                            f"lock that guards self.{attr})"))
+                            f"lock that guards self.{attr})",
+                            meta=self._meta(shared, attr, held_now,
+                                            "counter-rmw")))
                     continue
                 # unlocked vivify-then-mutate: self.d[k].append(v) on a
                 # defaultdict is get-or-insert plus mutate in two steps
@@ -2711,7 +2759,9 @@ class AtomicityChecker(Checker):
                             f"roles {_fmt_roles(shared[attr])}, so two "
                             f"roles can vivify distinct defaults and one "
                             f"mutation is lost (wrap in the lock that "
-                            f"guards self.{attr})"))
+                            f"guards self.{attr})",
+                            meta=self._meta(shared, attr, held_now,
+                                            "vivify-mutate")))
                 continue
             # unlocked read-modify-write on shared state
             if isinstance(node, ast.AugAssign) and not held_now:
@@ -2727,7 +2777,8 @@ class AtomicityChecker(Checker):
                         f"with no lock held; the attribute is shared "
                         f"across roles {_fmt_roles(shared[attr])}, so a "
                         f"concurrent update is lost (wrap in the lock "
-                        f"that guards self.{attr})"))
+                        f"that guards self.{attr})",
+                        meta=self._meta(shared, attr, held_now, "rmw")))
                 continue
             if isinstance(node, ast.Assign):
                 # unlocked rmw spelled as assignment:
@@ -2753,7 +2804,9 @@ class AtomicityChecker(Checker):
                                 f"shared across roles "
                                 f"{_fmt_roles(shared[attr])}, so a "
                                 f"concurrent update is lost (wrap in the "
-                                f"lock that guards self.{attr})"))
+                                f"lock that guards self.{attr})",
+                                meta=self._meta(shared, attr, held_now,
+                                                "assign-rmw")))
                 # lazy-init act: `self.x = <fresh object>` after an
                 # `is None` test — double-checked init must re-test
                 # under the lock it initialises under
@@ -2798,7 +2851,8 @@ class AtomicityChecker(Checker):
             f"this assignment holds and is not repeated inside it, so "
             f"two roles {_fmt_roles(shared[attr])} can both pass the "
             f"test and build self.{attr} twice (re-test under the lock "
-            f"before assigning)"))
+            f"before assigning)",
+            meta=self._meta(shared, attr, held_now, "double-checked-init")))
 
     def _act(self, ctx, cls, node, attr, key, held_now, tests,
              reported, shared, out) -> None:
@@ -2821,7 +2875,8 @@ class AtomicityChecker(Checker):
             f"self.{attr} is shared across roles "
             f"{_fmt_roles(shared[attr])} — another role can mutate it "
             f"in between (take the lock around both, or use "
-            f".get()/.pop(k, default))"))
+            f".get()/.pop(k, default))",
+            meta=self._meta(shared, attr, held_now, "check-then-act")))
 
 
 ALL_CHECKERS: list[Checker] = [

@@ -24,7 +24,26 @@ role                      entry recognizer
                           handler)`` — first arg an HTTP-method constant
 ``timer``                 callable arg of ``*.schedule(delay_ms, fn)`` and
                           friends (coordinator/shard ticks, sim timers)
+``event-loop``            connection-handler arg of
+                          ``asyncio.start_server(handler, ...)`` — the
+                          accept path runs as loop callbacks, same domain
+                          as timers/transport
+``data-worker`` /         callable handed to
+``search-pool``           ``loop.run_in_executor(executor, fn)`` — the
+                          executor's name decides the pool (``search`` ->
+                          search-pool, ``executor``/``pool``/``worker`` ->
+                          data-worker); with a branch-assigned executor the
+                          callable gets the union of every branch's role
 ========================  =================================================
+
+Cross-MODULE roles arrive through ``lint/callgraph.py``: a two-pass run
+first extracts per-module summaries (per class: in-file roles per
+method, attribute/parameter type bindings, outgoing call chains), runs a
+global fixpoint resolving chains like ``handler -> node.search() ->
+self.search_backpressure.admit()``, and hands each class's externally
+derived roles back here as ``entry_roles`` seeds (the ``external``
+ctor argument, plumbed via ``ctx.external_roles``).  Single-file runs
+fall back to file-local propagation so fixtures stay self-contained.
 
 Propagation is caller -> callee: if a timer tick calls ``self._m()``,
 ``_m`` runs on the timer too; a nested ``def``/``lambda`` handed to a
@@ -68,9 +87,10 @@ ROLE_HTTP = "http-pool"
 ROLE_TIMER = "timer"
 ROLE_TRANSPORT = "transport"
 ROLE_THREAD = "background-thread"
+ROLE_LOOP = "event-loop"
 
 ALL_ROLES = (ROLE_DATA, ROLE_SEARCH, ROLE_HTTP, ROLE_TIMER, ROLE_TRANSPORT,
-             ROLE_THREAD)
+             ROLE_THREAD, ROLE_LOOP)
 
 # Execution DOMAINS: which roles can actually interleave. Timers and
 # transport handlers both run on the single-threaded event loop
@@ -87,6 +107,7 @@ DOMAIN = {
     ROLE_TIMER: "loop",
     ROLE_TRANSPORT: "loop",
     ROLE_THREAD: "thread",
+    ROLE_LOOP: "loop",
 }
 
 
@@ -190,7 +211,8 @@ class Scope:
     """A method, nested function, or lambda — the unit roles attach to."""
 
     __slots__ = ("name", "node", "parent", "method", "entry_roles", "roles",
-                 "accesses", "self_calls", "local_calls", "local_defs")
+                 "accesses", "self_calls", "local_calls", "local_defs",
+                 "ext_calls")
 
     def __init__(self, name: str, node: ast.AST, parent: "Scope | None"):
         self.name = name
@@ -204,6 +226,11 @@ class Scope:
         self.self_calls: set[str] = set()
         self.local_calls: set[str] = set()
         self.local_defs: dict[str, "Scope"] = {}
+        # outgoing cross-object call chains, alias-resolved:
+        # (root, attr_chain, callee) — root is "self" or a bare name the
+        # summary layer binds to a parameter; e.g. self._svc.admit() ->
+        # ("self", ("_svc",), "admit"), node.search() -> ("node", (), "search")
+        self.ext_calls: list[tuple[str, tuple[str, ...], str]] = []
 
     def lookup_local(self, name: str) -> "Scope | None":
         scope: Scope | None = self
@@ -234,8 +261,13 @@ class Conflict:
 class ClassRoleAnalysis:
     """Role inference + shared-state access classification for one class."""
 
-    def __init__(self, cls: ast.ClassDef, lines: list[str]):
+    def __init__(self, cls: ast.ClassDef, lines: list[str],
+                 external: "dict[str, object] | None" = None):
         self.cls = cls
+        # method -> iterable of roles derived by the whole-program pass
+        # (callgraph.py); seeded as entry_roles so in-class propagation
+        # carries them into self-called helpers and nested defs
+        self.external = external or {}
         self.lock_attrs = lock_attrs(cls)
         self.mutable_attrs: dict[str, ast.AST] = {}
         self.single_role: set[str] = set()
@@ -265,6 +297,11 @@ class ClassRoleAnalysis:
             walker = _ScopeWalker(self, scope)
             for stmt in scope.node.body:
                 walker.visit(stmt)
+        for name, roles in self.external.items():
+            scope = self.methods.get(name)
+            if scope is not None:
+                scope.entry_roles.update(
+                    r for r in roles if r in DOMAIN)
         self._apply_tags()
         self._propagate()
 
@@ -449,8 +486,13 @@ class _ScopeWalker(ast.NodeVisitor):
         self.scope = scope
         self.held: list[str] = []
         # local name -> dotted source, for alias resolution at dispatch
-        # sites: `reg = transport.register`, `t = self.transport`
+        # sites: `reg = transport.register`, `t = self.transport`,
+        # `b = getattr(self.node, "breakers", None)`
         self.name_sources: dict[str, str] = {}
+        # same, but keeping EVERY branch's assignment (`executor = a`
+        # in one arm, `executor = b` in the other) — run_in_executor
+        # roles the callable with the union over branches
+        self.name_sources_multi: dict[str, set[str]] = {}
 
     # -- helpers -----------------------------------------------------------
 
@@ -622,9 +664,28 @@ class _ScopeWalker(ast.NodeVisitor):
         if (len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)):
             source = dotted_name(node.value)
+            if source is None:
+                source = self._getattr_source(node.value)
             if source is not None:
-                self.name_sources[node.targets[0].id] = source
+                target = node.targets[0].id
+                self.name_sources[target] = source
+                self.name_sources_multi.setdefault(target, set()).add(source)
         self.generic_visit(node)
+
+    @staticmethod
+    def _getattr_source(value: ast.AST) -> str | None:
+        """'self.node.breakers' for ``getattr(self.node, "breakers", d)``
+        — the duck-typed attribute walk the wiring code favors."""
+        if (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id == "getattr"
+                and len(value.args) >= 2
+                and isinstance(value.args[1], ast.Constant)
+                and isinstance(value.args[1].value, str)):
+            base = dotted_name(value.args[0])
+            if base is not None:
+                return f"{base}.{value.args[1].value}"
+        return None
 
     def _call_source(self, fn: ast.AST) -> str:
         """The call target's dotted source with local aliases resolved
@@ -698,6 +759,20 @@ class _ScopeWalker(ast.NodeVisitor):
         elif isinstance(fn, ast.Name):
             last = fn.id
 
+        # cross-object call chains for the whole-program summary:
+        # self.a.b.m() -> ("self", ("a","b"), "m"); param.m() ->
+        # ("param", (), "m").  `self.m()` stays an intra-class edge.
+        resolved = self._call_source(fn)
+        if resolved:
+            parts = resolved.split(".")
+            if parts[0] == "self":
+                if len(parts) >= 3:
+                    self.scope.ext_calls.append(
+                        ("self", tuple(parts[1:-1]), parts[-1]))
+            elif len(parts) >= 2:
+                self.scope.ext_calls.append(
+                    (parts[0], tuple(parts[1:-1]), parts[-1]))
+
         # self._offload(fn) / self._after_offload(fn, cb) / _offload_search
         self_method = self_attr_of(fn)
         if self_method is not None:
@@ -748,6 +823,33 @@ class _ScopeWalker(ast.NodeVisitor):
         if last in _SCHEDULE_SEGMENTS and len(node.args) >= 2:
             self._tag(node.args[1], ROLE_TIMER)
 
+        # the accept path: asyncio.start_server(self._handle_conn, ...)
+        # runs the handler as loop callbacks — same domain as timers
+        if last == "start_server" and node.args:
+            self._tag(node.args[0], ROLE_LOOP)
+
+        # loop.run_in_executor(executor, fn, *args): fn runs on the pool
+        # the executor names; a contextvars trampoline
+        # (`run_in_executor(ex, ctx.run, fn)`) unwraps to the real fn
+        if last == "run_in_executor" and len(node.args) >= 2:
+            target = node.args[1]
+            tname = self._call_source(target) or dotted_name(target) or ""
+            if tname.split(".")[-1] == "run" and len(node.args) >= 3:
+                target = node.args[2]
+            sources: set[str] = set()
+            direct = dotted_name(node.args[0])
+            if direct is not None:
+                sources.add(direct)
+            if isinstance(node.args[0], ast.Name):
+                sources |= self.name_sources_multi.get(node.args[0].id,
+                                                       set())
+            for src in sources:
+                low = src.lower()
+                if "search" in low:
+                    self._tag(target, ROLE_SEARCH)
+                elif "executor" in low or "pool" in low or "worker" in low:
+                    self._tag(target, ROLE_DATA)
+
         # a dedicated OS thread: threading.Thread(target=fn)
         if last == "Thread":
             for kw in node.keywords:
@@ -757,10 +859,14 @@ class _ScopeWalker(ast.NodeVisitor):
 
 def analyze_class(ctx, cls: ast.ClassDef) -> ClassRoleAnalysis:
     """Memoized per-FileContext analysis so TPU018 and TPU019 share one
-    pass over each class."""
+    pass over each class.  ``ctx.external_roles`` (set by the lint driver
+    from the callgraph fixpoint: ``{class: {method: [roles]}}``) seeds
+    entry roles derived from callers in OTHER modules."""
     cache = ctx.__dict__.setdefault("_threadrole_cache", {})
     analysis = cache.get(id(cls))
     if analysis is None:
-        analysis = ClassRoleAnalysis(cls, ctx.lines)
+        ext = getattr(ctx, "external_roles", None) or {}
+        analysis = ClassRoleAnalysis(cls, ctx.lines,
+                                     external=ext.get(cls.name))
         cache[id(cls)] = analysis
     return analysis

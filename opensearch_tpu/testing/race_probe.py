@@ -2,11 +2,14 @@
 
 The static analyzer (lint/threadroles.py) infers which executor runs each
 method and flags shared mutable state reachable from >= 2 execution
-domains without a common lock. Statics stop at the file boundary though:
-a class whose callers live elsewhere (SearchBackpressureService,
-HierarchyBreakerService) carries no inferable roles, and a flagged
-pattern may in fact be protected by discipline the recognizers don't
-model. This probe closes the loop at runtime:
+domains without a common lock. Since ISSUE 20 the whole-program pass
+(lint/callgraph.py) resolves roles ACROSS files too — classes like
+SearchBackpressureService and HierarchyBreakerService, whose callers live
+elsewhere, now carry static roles and no longer need a dynamic drill.
+What remains for runtime confirmation: flagged patterns may in fact be
+protected by discipline the recognizers don't model, and any class the
+cross-module pass still cannot role (``statically_unroled()``) keeps its
+place in the drill. This probe closes that loop:
 
 - ``role_scope(role)`` tags the current thread with an executor role;
   ``probe_scope()`` auto-tags the sim's dispatch points (ClusterNode
@@ -24,9 +27,11 @@ would, but from OBSERVED events: writes from >= 2 domains with no common
 lock and a non-atomic kind are **confirmed** races; a common lock across
 every access **confirms the fix**; single C-level dict ops cross-domain
 are **refuted** (GIL-atomic, the static ATOMIC exemption). The CLI runs
-one seeded soak cycle plus a threaded drill of the statically-unroled
-services and exits 1 on any confirmed finding — wired into
-``scripts/check.sh --race-probe``.
+one seeded soak cycle plus a threaded drill of whatever is STILL
+statically unroled and exits 1 on any confirmed finding — wired into
+``scripts/check.sh --race-probe``. ``--tcp`` drives the TcpSoak reshape
+chain (real sockets, real thread pools, invariants-only) under the same
+instrumentation — ``scripts/check.sh --race-probe-tcp``.
 """
 
 from __future__ import annotations
@@ -113,6 +118,13 @@ class ProbeLock:
 
     def locked(self):
         return self._inner.locked()
+
+    def _at_fork_reinit(self):
+        # stdlib modules built on module-level locks register this with
+        # os.register_at_fork at IMPORT time (concurrent.futures.thread) —
+        # a lock constructed in-scope must expose it or the import breaks
+        self._inner._at_fork_reinit()
+        _state.held.pop(self.name, None)
 
     def __enter__(self):
         self.acquire()
@@ -389,7 +401,7 @@ def probe_scope():
 
     from opensearch_tpu.cluster.cluster_node import ClusterNode
     from opensearch_tpu.testing.sim import DeterministicTaskQueue, MockTransport
-    from opensearch_tpu.transport.tcp import LoopScheduler
+    from opensearch_tpu.transport.tcp import LoopScheduler, TcpTransport
 
     probe = Probe()
     recorder = probe.recorder
@@ -416,15 +428,19 @@ def probe_scope():
         patch(sched_cls, "schedule",
               lambda self, delay_ms, fn, _orig=orig_schedule:
               _orig(self, delay_ms, _wrap_dispatch(fn, ROLE_TIMER)))
-    orig_register = MockTransport.register
+    # both transports share the register(node_id, action, handler) shape
+    # and call handlers as handler(sender, payload) — tag them identically
+    # so the TcpSoak reshape chain (--tcp) arrives pre-labelled too
+    for transport_cls in (MockTransport, TcpTransport):
+        orig_register = transport_cls.register
 
-    def register(self, node_id, action, handler):
-        def tagged(sender, payload):
-            with role_scope(ROLE_TRANSPORT):
-                return handler(sender, payload)
-        return orig_register(self, node_id, action, tagged)
+        def register(self, node_id, action, handler, _orig=orig_register):
+            def tagged(sender, payload):
+                with role_scope(ROLE_TRANSPORT):
+                    return handler(sender, payload)
+            return _orig(self, node_id, action, tagged)
 
-    patch(MockTransport, "register", register)
+        patch(transport_cls, "register", register)
 
     # 3. auto-watch: new instances of the hot-spot classes record writes
     for (mod_name, cls_name), (scalars, dicts) in WATCH_SPECS.items():
@@ -445,18 +461,29 @@ def probe_scope():
 
 
 # ---------------------------------------------------------------------------
-# threaded drill: the statically-unroled suspects
+# threaded drill: only what the static pass STILL cannot role
 # ---------------------------------------------------------------------------
 
-def run_drill(threads: int = 4, per_thread: int = 50) -> None:
-    """Hammer the cross-file-dispatched services from tagged REAL threads
-    (alternating data-worker/search-pool roles, the pools that actually
-    call them) so the report carries observed evidence for state the
-    static analyzer cannot role. Must run inside probe_scope()."""
-    from opensearch_tpu.common.breaker import (
-        CircuitBreakingException,
-        HierarchyBreakerService,
-    )
+def statically_unroled(candidates=None) -> list[str]:
+    """Class names among ``candidates`` to which the whole-program static
+    pass (lint/callgraph.py) assigns NO executor roles — the set that
+    still needs a dynamic drill.  Default candidates: every watched or
+    drillable class.  Since ISSUE 20 this is expected to be EMPTY for the
+    PR 17 drill services (asserted in tests), which is the point: the
+    drill shrinks as the statics grow."""
+    import os
+
+    from opensearch_tpu.lint import callgraph
+    from opensearch_tpu.lint.core import iter_py_files
+
+    if candidates is None:
+        candidates = sorted({cls for _, cls in WATCH_SPECS} | set(DRILLS))
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    roles, _ = callgraph.program_roles(list(iter_py_files([pkg])))
+    return sorted(c for c in candidates if not roles.get(c))
+
+
+def _drill_backpressure():
     from opensearch_tpu.search.backpressure import (
         RejectedExecutionException,
         SearchBackpressureService,
@@ -467,10 +494,57 @@ def run_drill(threads: int = 4, per_thread: int = 50) -> None:
     bp = SearchBackpressureService(tm, max_concurrent=1,
                                    max_runtime_ms=60_000)
     tm.register("indices:data/read/search")  # saturate: every admit sheds
+
+    def hit():
+        try:
+            bp.admit()
+        except RejectedExecutionException:
+            pass
+    return hit
+
+
+def _drill_breakers():
+    from opensearch_tpu.common.breaker import (
+        CircuitBreakingException,
+        HierarchyBreakerService,
+    )
+
     brk = HierarchyBreakerService(total_bytes=1000, settings={
         "request_limit_bytes": 1 << 30, "parent_limit_bytes": 100,
     })
     brk.request.used = 500  # past the parent limit: every check trips
+
+    def hit():
+        try:
+            brk.check_parent("race-probe")
+        except CircuitBreakingException:
+            pass
+    return hit
+
+
+# class name -> setup returning the per-iteration hammer callable
+DRILLS = {
+    "SearchBackpressureService": _drill_backpressure,
+    "HierarchyBreakerService": _drill_breakers,
+}
+
+
+def run_drill(threads: int = 4, per_thread: int = 50,
+              targets=None) -> list[str]:
+    """Hammer the targeted services from tagged REAL threads (alternating
+    data-worker/search-pool roles, the pools that actually call them) so
+    the report carries observed evidence. Must run inside probe_scope().
+
+    ``targets`` defaults to ``statically_unroled()`` ∩ DRILLS — services
+    the cross-module pass now roles statically are NOT drilled (the
+    ISSUE 20 drill shrink). Pass explicit class names to force a drill
+    (how tests re-confirm the PR 17 lock fixes). Returns what was
+    drilled."""
+    if targets is None:
+        targets = [c for c in statically_unroled() if c in DRILLS]
+    hits = [DRILLS[c]() for c in targets]
+    if not hits:
+        return []
     start = threading.Barrier(threads)
     roles = (ROLE_DATA, ROLE_SEARCH)
 
@@ -478,14 +552,8 @@ def run_drill(threads: int = 4, per_thread: int = 50) -> None:
         start.wait()
         with role_scope(role):
             for _ in range(per_thread):
-                try:
-                    bp.admit()
-                except RejectedExecutionException:
-                    pass
-                try:
-                    brk.check_parent("race-probe")
-                except CircuitBreakingException:
-                    pass
+                for hit in hits:
+                    hit()
 
     workers = [threading.Thread(target=hammer, args=(roles[i % 2],))
                for i in range(threads)]
@@ -493,6 +561,7 @@ def run_drill(threads: int = 4, per_thread: int = 50) -> None:
         w.start()
     for w in workers:
         w.join()
+    return list(targets)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -508,16 +577,51 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--ops", type=int, default=20)
     parser.add_argument("--no-soak", action="store_true",
                         help="drill only (skip the seeded soak cycle)")
+    parser.add_argument("--tcp", action="store_true",
+                        help="drive the TcpSoak reshape chain (real "
+                             "sockets, real pools; invariants-only) under "
+                             "the probe instead of the sim soak")
+    parser.add_argument("--seconds", type=float, default=45.0,
+                        help="--tcp: wall-clock budget for the reshape "
+                             "chain (default 45)")
     args = parser.parse_args(argv)
 
-    from opensearch_tpu.testing.soak import run_soak
+    if args.tcp:
+        # import the full server stack BEFORE the patches land: stdlib
+        # modules construct module-level locks at import time and must
+        # get real ones
+        import opensearch_tpu.testing.soak_tcp  # noqa: F401
 
     with probe_scope() as probe:
-        if not args.no_soak:
+        if args.tcp:
+            import asyncio
+            from pathlib import Path
+
+            from opensearch_tpu.testing.soak_tcp import TcpSoak, TcpSoakError
+
+            async def scenario(tmp) -> dict:
+                soak = TcpSoak(Path(tmp), seconds=args.seconds)
+                try:
+                    return await soak.run()
+                finally:
+                    await soak.stop()
+
+            with tempfile.TemporaryDirectory() as tmp:
+                try:
+                    asyncio.run(scenario(tmp))
+                except TcpSoakError as e:
+                    print(f"TCP SOAK FAILED under probe: {e}")
+                    return 1
+        elif not args.no_soak:
+            from opensearch_tpu.testing.soak import run_soak
+
             with tempfile.TemporaryDirectory() as tmp:
                 run_soak(args.seed, tmp, cycles=args.cycles,
                          ops_per_cycle=args.ops)
-        run_drill()
+        drilled = run_drill()
+    what = (", ".join(drilled) if drilled else
+            "nothing — the cross-module pass roles every watched service")
+    print(f"drilled (statically unroled): {what}")
     report = probe.report()
     print(json.dumps(report, indent=1))
     if report["confirmed"]:

@@ -11,9 +11,16 @@
 #   scripts/check.sh --race-probe
 #                               # + the runtime race confirmation: one
 #                               # seeded soak cycle plus a threaded drill
-#                               # under lock/role instrumentation
-#                               # (testing/race_probe.py), asserting zero
-#                               # unconfirmed-unlocked cross-role writes
+#                               # of whatever the cross-module static pass
+#                               # still cannot role, under lock/role
+#                               # instrumentation (testing/race_probe.py),
+#                               # asserting zero unconfirmed-unlocked
+#                               # cross-role writes
+#   scripts/check.sh --race-probe-tcp
+#                               # + the same instrumentation over the REAL
+#                               # TcpTransport reshape chain (soak_tcp's
+#                               # join/evacuate/drain under live loopback
+#                               # traffic, invariants-only)
 #   scripts/check.sh --bench    # + the bench-regression gates: a quick
 #                               # bench.py --gate run must stay within a
 #                               # CPU/TPU-aware tolerance of the same
@@ -52,20 +59,27 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== tpulint (repo-wide, baseline must hold) =="
+echo "== tpulint (repo-wide, baseline must hold, role rules must run) =="
+# one JSON report answers both questions: did anything regress past the
+# (EMPTY) baseline, and did the thread-role rules actually run (the
+# "rules" catalog in the same report — no --list-rules text grep)
 python -m opensearch_tpu.lint --format json opensearch_tpu \
-  | python -c 'import json,sys; r = json.load(sys.stdin); print(
-    "%(files_checked)s files, %(total_violations)s violations in "
-    "%(elapsed_seconds)ss" % r); sys.exit(1 if r["regressions"] else 0)'
+  | python -c 'import json,sys
+r = json.load(sys.stdin)
+ran = {c["id"] for c in r["rules"]}
+missing = {"TPU018", "TPU019"} - ran
+assert not missing, f"thread-role rules did not run: {sorted(missing)}"
+print("%(files_checked)s files, %(total_violations)s violations in "
+      "%(elapsed_seconds)ss; role rules ran" % r)
+for v in r["new_violations"]:
+    meta = v.get("meta", {})
+    print("  NEW %s %s:%s domains=%s locks=%s" % (
+        v["rule"], v["path"], v["line"],
+        ",".join(meta.get("domains", [])), meta.get("locks", "")))
+sys.exit(1 if r["regressions"] else 0)'
 
 echo "== tpulint --fix --dry-run (zero pending rewrites) =="
 python -m opensearch_tpu.lint --fix --dry-run opensearch_tpu > /dev/null
-echo "ok"
-
-echo "== tpulint thread-role rules active (TPU018/TPU019) =="
-rules="$(python -m opensearch_tpu.lint --list-rules)"
-grep -q '^TPU018 ' <<<"$rules"
-grep -q '^TPU019 ' <<<"$rules"
 echo "ok"
 
 if [[ "${1:-}" == "--lint" ]]; then
@@ -86,6 +100,12 @@ if [[ "${1:-}" == "--race-probe" ]]; then
   echo "== runtime race probe (one seeded soak cycle + threaded drill) =="
   JAX_PLATFORMS=cpu python -m opensearch_tpu.testing.race_probe \
     --seed 7 --cycles 1
+fi
+
+if [[ "${1:-}" == "--race-probe-tcp" ]]; then
+  echo "== runtime race probe over the REAL TCP reshape chain (invariants-only) =="
+  JAX_PLATFORMS=cpu python -m opensearch_tpu.testing.race_probe \
+    --tcp --seconds 90
 fi
 
 if [[ "${1:-}" == "--soak-tcp" ]]; then

@@ -1,0 +1,34 @@
+"""Cross-module TPU018 shape as a self-contained pair: the service class
+has NO dispatch idiom of its own — its thread roles arrive through the
+caller class that constructs it and fans its methods out to a timer and a
+data worker (lint/callgraph.py cross-class propagation)."""
+
+
+class ShardStatsService:
+    def __init__(self):
+        self._rows = {}
+
+    def record(self, key, nbytes):
+        self._rows[key] = nbytes
+
+    def total(self):
+        # live iteration vs the data worker's writes — no common lock
+        return sum(n for _k, n in self._rows.items())  # EXPECT: TPU018
+
+
+class StatsNode:
+    def __init__(self, scheduler):
+        self.stats = ShardStatsService()
+        scheduler.schedule(1000, self._tick)  # _tick: timer role
+
+    def handle_index(self, key, nbytes):
+        def write():
+            self.stats.record(key, nbytes)
+
+        return self._offload(write)  # record(): data-worker role
+
+    def _tick(self):
+        return self.stats.total()  # total(): timer role
+
+    def _offload(self, fn):
+        return fn()

@@ -1,0 +1,33 @@
+"""FP guard for the cross-module TPU018 shape: same caller-derived roles,
+but the timer reads an atomic ``list()`` snapshot instead of iterating
+the dict the data worker is writing."""
+
+
+class ShardStatsService:
+    def __init__(self):
+        self._rows = {}
+
+    def record(self, key, nbytes):
+        self._rows[key] = nbytes
+
+    def total(self):
+        # list() snapshots atomically against single-key writes
+        return sum(n for _k, n in list(self._rows.items()))
+
+
+class StatsNode:
+    def __init__(self, scheduler):
+        self.stats = ShardStatsService()
+        scheduler.schedule(1000, self._tick)
+
+    def handle_index(self, key, nbytes):
+        def write():
+            self.stats.record(key, nbytes)
+
+        return self._offload(write)
+
+    def _tick(self):
+        return self.stats.total()
+
+    def _offload(self, fn):
+        return fn()

@@ -1,0 +1,33 @@
+"""Cross-module TPU019 shape: the check-then-act lives in a class with no
+dispatch idiom; it is only racy because the caller class injects it into
+a transport handler AND a data-worker offload (caller-derived roles)."""
+
+
+class SessionTable:
+    def __init__(self):
+        self._sessions = {}
+
+    def open(self, sid, session):
+        if sid not in self._sessions:  # the slot can be filled between
+            self._sessions[sid] = session  # EXPECT: TPU019
+
+    def close(self, sid):
+        return self._sessions.pop(sid, None)
+
+
+class RecoveryNode:
+    def __init__(self, transport):
+        self.sessions = SessionTable()
+        transport.register("n1", "recovery:start", self._on_start)
+
+    def _on_start(self, msg):
+        self.sessions.open(msg["sid"], msg)  # open(): transport role
+
+    def begin_local(self, sid):
+        def work():
+            self.sessions.close(sid)
+
+        return self._offload(work)  # close(): data-worker role
+
+    def _offload(self, fn):
+        return fn()

@@ -1,0 +1,33 @@
+"""FP guard for the cross-module TPU019 shape: ``setdefault`` collapses
+the membership test and the insert into one atomic dict op, so the
+caller-derived transport/data roles no longer expose a window."""
+
+
+class SessionTable:
+    def __init__(self):
+        self._sessions = {}
+
+    def open(self, sid, session):
+        # one atomic dict op: no window between membership test and insert
+        self._sessions.setdefault(sid, session)
+
+    def close(self, sid):
+        return self._sessions.pop(sid, None)
+
+
+class RecoveryNode:
+    def __init__(self, transport):
+        self.sessions = SessionTable()
+        transport.register("n1", "recovery:start", self._on_start)
+
+    def _on_start(self, msg):
+        self.sessions.open(msg["sid"], msg)
+
+    def begin_local(self, sid):
+        def work():
+            self.sessions.close(sid)
+
+        return self._offload(work)
+
+    def _offload(self, fn):
+        return fn()

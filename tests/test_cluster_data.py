@@ -590,3 +590,96 @@ def test_cluster_snapshot_create_status_restore(sim, tmp_path):
     assert ids == {f"d{i}" for i in range(8) if i != 3} | {"buffered"}, ids
     # the restored copy is a fresh index: source index unaffected
     assert "snaplogs-restored" in sim.leader().applied_state.indices
+
+
+# ---------------------------------------------------------------------------
+# recovery-session registry contention (ISSUE 20 cross-module findings)
+# ---------------------------------------------------------------------------
+
+class TestRecoverySessionRaces:
+    """Regression: RecoverySourceSessions is touched from two domains —
+    recovery starts and chunk packing on the data worker, ops/finalize/
+    target drops inline on the transport loop. The whole-program TPU018/
+    TPU019 pass surfaced the torn ``reap`` walk vs a concurrent ``close``
+    and the evict scan in ``open`` racing the same pop; pre-fix, the
+    hammer below raises RuntimeError (dict changed size during iteration)
+    or breaks the MAX_SESSIONS bound. Mirrors TestCounterRaces in
+    test_tasks_breakers.py: exact invariants under a tiny GIL switch
+    interval."""
+
+    @pytest.fixture(autouse=True)
+    def _tight_switch_interval(self):
+        import sys
+
+        old = sys.getswitchinterval()
+        sys.setswitchinterval(1e-6)
+        yield
+        sys.setswitchinterval(old)
+
+    def test_open_evict_close_reap_hold_the_bound_under_contention(self):
+        import threading
+
+        from opensearch_tpu.index.recovery import RecoverySourceSessions
+
+        reg = RecoverySourceSessions()
+        threads, per_thread = 8, 150
+        start = threading.Barrier(threads)
+        errors: list[BaseException] = []
+
+        def hammer(tid):
+            try:
+                start.wait()
+                for i in range(per_thread):
+                    # distinct keys per thread force the evict scan in
+                    # open() once the registry crosses MAX_SESSIONS
+                    reg.open(f"idx{tid}", i % 4, f"t{tid}-{i}",
+                             mode="file", blobs={})
+                    if i % 3 == 0:
+                        reg.close(f"idx{tid}", i % 4, f"t{tid}-{i}")
+                    if i % 7 == 0:
+                        # nothing is TTL-stale, but the walk itself must
+                        # not tear against concurrent del/insert
+                        reg.reap()
+            except BaseException as e:  # noqa: BLE001 - collected
+                errors.append(e)
+
+        workers = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(threads)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        assert errors == [], errors
+        # the bound-or-evict contract survived the stampede
+        assert len(reg._sessions) <= RecoverySourceSessions.MAX_SESSIONS
+
+    def test_reap_is_exact_when_everything_is_stale(self):
+        import threading
+
+        from opensearch_tpu.index.recovery import RecoverySourceSessions
+
+        reg = RecoverySourceSessions()
+        total = 48
+        for i in range(total):
+            reg.open("idx", 0, f"t{i}", mode="file", blobs={})
+        future = 10**15  # everything is stale relative to this clock
+        threads = 8
+        start = threading.Barrier(threads)
+        reaped: list[tuple] = []
+        lock = threading.Lock()
+
+        def hammer():
+            start.wait()
+            dead = reg.reap(now_ms=future)
+            with lock:
+                reaped.extend(dead)
+
+        workers = [threading.Thread(target=hammer) for _ in range(threads)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        # every session reaped EXACTLY once across all racing reapers
+        assert sorted(reaped) == sorted(("idx", 0, f"t{i}")
+                                        for i in range(total))
+        assert reg._sessions == {}

@@ -211,8 +211,10 @@ def test_repo_is_clean_against_committed_baseline(monkeypatch):
     assert regressions == [], (
         "new lint violations past lint_baseline.json:\n"
         + "\n".join(r.render() for r in regressions))
-    # ISSUE 2 budget: single pass over the full tree in well under 10s
-    assert elapsed < 10.0, f"lint took {elapsed:.1f}s (budget 10s)"
+    # ISSUE 2 set a 10s budget for the per-file pass; ISSUE 20 adds the
+    # whole-program role pre-pass (~2s cold extraction, cached on warm
+    # runs) with an explicit <=2x allowance over the old wall time
+    assert elapsed < 13.0, f"lint took {elapsed:.1f}s (budget 13s)"
 
 
 def test_linter_lints_its_own_source_clean():
@@ -736,6 +738,29 @@ def test_cli_explain_renders_rule_and_rejects_unknown():
     assert "unknown rule" in proc.stderr
 
 
+def test_explain_cross_module_examples_fire_their_own_rule():
+    """The cross-module pairs document role propagation through a caller
+    class: each bad snippet fires exactly its own role rule (nothing else
+    from the TPU018/TPU019 family) and each good snippet is fully clean."""
+    from opensearch_tpu.lint.explain import CROSS_MODULE_EXAMPLES
+
+    assert set(CROSS_MODULE_EXAMPLES) == {"TPU018", "TPU019"}
+    for rule_id, ex in CROSS_MODULE_EXAMPLES.items():
+        bad = {v.rule for v in lint_source("x.py", ex.bad, ALL_CHECKERS)}
+        assert bad == {rule_id}, (
+            f"{rule_id} cross-module bad fired {sorted(bad)}")
+        good = lint_source("x.py", ex.good, ALL_CHECKERS)
+        assert good == [], "\n".join(v.render() for v in good)
+
+
+def test_cli_explain_renders_cross_module_sections():
+    for rule_id in ("TPU018", "TPU019"):
+        proc = _run_cli("--explain", rule_id)
+        assert proc.returncode == 0, proc.stderr
+        assert "CROSS-MODULE BAD" in proc.stdout
+        assert "CROSS-MODULE GOOD" in proc.stdout
+
+
 # ---------------------------------------------------------------------------
 # thread-role inference: who-runs-what on dispatch idioms
 # ---------------------------------------------------------------------------
@@ -806,3 +831,159 @@ def test_timer_vs_transport_sharing_does_not_fire_tpu018():
         "        self._rows[payload['k']] = payload['n']\n"
     )
     assert lint_source("m.py", src, ALL_CHECKERS) == []
+
+
+# ---------------------------------------------------------------------------
+# whole-program role summaries (ISSUE 20): callgraph pass, cache, JSON meta
+# ---------------------------------------------------------------------------
+
+PKG = REPO / "opensearch_tpu"
+
+
+def _package_roles(use_cache=False):
+    from opensearch_tpu.lint import callgraph
+    from opensearch_tpu.lint.core import iter_py_files
+
+    files = list(iter_py_files([str(PKG)]))
+    roles, _summaries = callgraph.program_roles(files, use_cache=use_cache)
+    return roles
+
+
+def test_static_pass_roles_services_that_needed_dynamic_drilling():
+    """ISSUE 20 acceptance: SearchBackpressureService and
+    HierarchyBreakerService — roled only by PR 17's runtime drill before —
+    must now carry static roles from the cross-module pass alone (their
+    own modules contain no dispatch idiom for these paths)."""
+    roles = _package_roles()
+
+    bp = roles.get("SearchBackpressureService", {})
+    # admit() is called from the HTTP search handler via TpuNode.search
+    assert "http" in {_domain(r) for r in bp.get("admit", ())}, bp
+
+    hbs = roles.get("HierarchyBreakerService", {})
+    # check_parent() is reached from the TCP accept loop through the
+    # per-breaker CircuitBreaker._parent injection
+    assert "loop" in {_domain(r) for r in hbs.get("check_parent", ())}, hbs
+    assert "http" in {_domain(r) for r in hbs.get("stats", ())}, hbs
+
+
+def _domain(role):
+    from opensearch_tpu.lint import threadroles
+
+    return threadroles.DOMAIN.get(role, role)
+
+
+def test_cache_hit_and_cold_runs_produce_identical_findings(tmp_path):
+    """The on-disk summary cache must be a pure memoization: cold
+    (use_cache=False), cache-building, and cache-hit runs all yield the
+    same program roles, and the cache file round-trips through JSON."""
+    from opensearch_tpu.lint import callgraph
+    from opensearch_tpu.lint.core import iter_py_files
+
+    files = sorted(iter_py_files([str(PKG / "lint")]))
+    cache = tmp_path / "cache.json"
+
+    cold, _ = callgraph.program_roles(files, use_cache=False,
+                                      cache_path=str(cache))
+    assert not cache.exists()  # use_cache=False must not even write
+
+    build, _ = callgraph.program_roles(files, use_cache=True,
+                                       cache_path=str(cache))
+    assert cache.exists()
+    blob = json.loads(cache.read_text())
+    assert blob["version"] == callgraph.SUMMARY_VERSION
+    assert len(blob["files"]) == len(files)
+
+    warm, _ = callgraph.program_roles(files, use_cache=True,
+                                      cache_path=str(cache))
+    assert cold == build == warm
+
+
+def test_cache_invalidates_on_content_change(tmp_path):
+    from opensearch_tpu.lint import callgraph
+
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "class Svc:\n"
+        "    def __init__(self):\n"
+        "        self._n = 0\n"
+        "    def bump(self):\n"
+        "        self._n += 1\n"
+        "class Node:\n"
+        "    def __init__(self, scheduler):\n"
+        "        self.svc = Svc()\n"
+        "        scheduler.schedule(1000, self._tick)\n"
+        "    def _tick(self):\n"
+        "        self.svc.bump()\n"
+    )
+    cache = tmp_path / "cache.json"
+    roles, _ = callgraph.program_roles([str(mod)], use_cache=True,
+                                       cache_path=str(cache))
+    assert "timer" in roles.get("Svc", {}).get("bump", ())
+    # rewire the timer to a data-worker offload: stale summaries would
+    # keep reporting the old role
+    mod.write_text(mod.read_text().replace(
+        "scheduler.schedule(1000, self._tick)", "pass").replace(
+        "def _tick(self):", "def index(self):\n"
+        "        return self._offload(self._go)\n"
+        "    def _offload(self, fn):\n"
+        "        return fn()\n"
+        "    def _go(self):"))
+    roles2, _ = callgraph.program_roles([str(mod)], use_cache=True,
+                                        cache_path=str(cache))
+    got = roles2.get("Svc", {}).get("bump", ())
+    assert "data-worker" in got and "timer" not in got, roles2
+
+
+def test_cli_no_cache_matches_cached_run(tmp_path):
+    """--no-cache and the cached path must agree on findings for the same
+    tree (the xmod fixtures exercise the cross-class propagation)."""
+    import shutil
+
+    for name in ("tpu018_xmod_bad.py", "tpu019_xmod_bad.py"):
+        shutil.copy(FIXTURES / name, tmp_path / name)
+    runs = []
+    for extra in ((), ("--no-cache",)):
+        proc = _run_cli(str(tmp_path), "--format", "json",
+                        "--no-baseline", *extra)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        runs.append(sorted((v["path"], v["line"], v["rule"])
+                           for v in report["violations"]))
+    assert runs[0] == runs[1]
+    assert {r for _, _, r in runs[0]} == {"TPU018", "TPU019"}
+
+
+def test_role_violations_carry_structured_meta():
+    """--format json findings for the role rules expose domains and lock
+    evidence so gate scripts consume structure, not message text."""
+    from opensearch_tpu.lint.explain import CROSS_MODULE_EXAMPLES
+
+    v18 = [v for v in lint_source(
+        "x.py", CROSS_MODULE_EXAMPLES["TPU018"].bad, ALL_CHECKERS)
+        if v.rule == "TPU018"]
+    v19 = [v for v in lint_source(
+        "x.py", CROSS_MODULE_EXAMPLES["TPU019"].bad, ALL_CHECKERS)
+        if v.rule == "TPU019"]
+    assert v18 and v19
+    m18 = v18[0].to_dict()["meta"]
+    assert set(m18) >= {"roles", "domains", "attr", "locks"}
+    assert sorted(m18["domains"]) == ["data", "loop"]
+    m19 = v19[0].to_dict()["meta"]
+    assert set(m19) >= {"roles", "domains", "attr", "locks", "shape"}
+    assert m19["shape"] == "check-then-act"
+    assert sorted(m19["domains"]) == ["data", "loop"]
+
+
+def test_cli_json_reports_rule_catalog():
+    """Report version 2: the gate script asserts the role rules RAN from
+    the same JSON it reads findings from (no --list-rules text grep)."""
+    proc = _run_cli(str(FIXTURES / "tpu005_good.py"),
+                    "--format", "json", "--no-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["version"] == 2
+    ids = {r["id"] for r in report["rules"]}
+    assert {"TPU018", "TPU019"} <= ids
+    for r in report["rules"]:
+        assert set(r) == {"id", "name", "description"}

@@ -147,12 +147,24 @@ def test_probe_scope_restores_all_patches():
     assert threading.RLock is before_rlock
 
 
-def test_drill_confirms_the_live_counter_fixes_locked():
-    # the statically-unroled suspects (callers live in other files): the
-    # threaded drill must observe every cross-role counter write under
-    # one common lock — the runtime confirmation of the TPU018 fixes
+def test_default_drill_shrinks_to_nothing():
+    # ISSUE 20: the cross-module static pass now roles every service the
+    # PR 17 drill covered dynamically — the default drill target set
+    # (statically_unroled ∩ DRILLS) must be EMPTY, and run_drill() must
+    # report it drilled nothing
+    assert rp.statically_unroled() == []
+    with rp.probe_scope():
+        assert rp.run_drill(threads=2, per_thread=1) == []
+
+
+def test_explicit_drill_confirms_the_live_counter_fixes_locked():
+    # the PR 17 lock fixes stay re-confirmable on demand: an EXPLICIT
+    # drill of the (now statically roled) services must observe every
+    # cross-role counter write under one common lock
     with rp.probe_scope() as probe:
-        rp.run_drill(threads=4, per_thread=25)
+        drilled = rp.run_drill(threads=4, per_thread=25,
+                               targets=sorted(rp.DRILLS))
+    assert drilled == sorted(rp.DRILLS)
     report = probe.report()
     assert report["confirmed"] == []
     verdicts = {(f["class"], f["attr"]): f["verdict"]
